@@ -1,0 +1,56 @@
+"""paddle_tpu — a TPU-native deep-learning framework with the capabilities
+of PaddlePaddle Fluid (reference: /root/reference, PaddlePaddle v1.6).
+
+Front-end: fluid-compatible static-graph Program/Block/Op IR, layers,
+optimizers, executors — so fluid model code ports nearly verbatim.
+Back-end: every op is a pure JAX function; the Executor traces a whole
+Program (forward+backward+optimizer) into ONE jax.jit/pjit XLA computation
+with donated parameter buffers; distribution is jax.sharding over a TPU mesh
+(ICI collectives), not parameter servers.
+"""
+from . import ops            # registers all op kernels
+from .framework import (Program, Variable, Parameter, default_main_program,
+                        default_startup_program, program_guard, name_scope,
+                        TPUPlace, CPUPlace, Scope, global_scope, scope_guard,
+                        Executor, CompiledProgram, BuildStrategy,
+                        ExecutionStrategy, unique_name)
+from .framework.backward import append_backward, gradients
+from .param_attr import ParamAttr, WeightNormParamAttr
+from . import initializer
+from . import layers
+from . import nets
+from . import optimizer
+from . import regularizer
+from . import clip
+from . import metrics
+from . import io
+from .io import (save_params, save_persistables, load_params,
+                 load_persistables, save_inference_model,
+                 load_inference_model)
+from . import reader
+from . import dygraph
+from . import distributed
+from . import profiler
+from .layers.io import data
+from .install_check import run_check
+
+__version__ = "0.1.0"
+
+
+def cuda_places(device_ids=None):
+    """API-compat shim: on TPU builds, 'accelerator places' are TPU chips."""
+    import jax
+    n = len(jax.devices())
+    ids = range(n) if device_ids is None else device_ids
+    return [TPUPlace(i) for i in ids]
+
+
+def tpu_places(device_ids=None):
+    import jax
+    n = len(jax.devices())
+    ids = range(n) if device_ids is None else device_ids
+    return [TPUPlace(i) for i in ids]
+
+
+def cpu_places(device_count=None):
+    return [CPUPlace()]

@@ -1,0 +1,126 @@
+"""Gradient / error clipping.
+
+Reference parity: python/paddle/fluid/clip.py (ErrorClipByValue,
+GradientClipByValue, GradientClipByNorm, GradientClipByGlobalNorm,
+set_gradient_clip). Global-norm clip builds the norm reduction in-graph so
+it fuses into the train step (and under data parallelism the norm is over
+the full global gradient because grads are already mesh-reduced by XLA).
+"""
+from .layer_helper import LayerHelper
+from . import layers
+
+
+class BaseErrorClipAttr(object):
+    def _append_clip_op(self, block, grad_name):
+        raise NotImplementedError
+
+
+class ErrorClipByValue(BaseErrorClipAttr):
+    def __init__(self, max, min=None):
+        self.max = max
+        self.min = -max if min is None else min
+
+    def _append_clip_op(self, block, grad_name):
+        block.append_op("clip", inputs={"X": [grad_name]},
+                        outputs={"Out": [grad_name]},
+                        attrs={"min": self.min, "max": self.max,
+                               "op_role": "backward"})
+
+
+class GradientClipBase(object):
+    def _process(self, params_grads):
+        raise NotImplementedError
+
+
+class GradientClipByValue(GradientClipBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(-max if min is None else min)
+
+    def _process(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+            out.append((p, layers.clip(g, self.min, self.max)))
+        return out
+
+
+class GradientClipByNorm(GradientClipBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def _process(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+            out.append((p, layers.clip_by_norm(g, self.clip_norm)))
+        return out
+
+
+class GradientClipByGlobalNorm(GradientClipBase):
+    def __init__(self, clip_norm, group_name="default_group"):
+        self.clip_norm = float(clip_norm)
+
+    def _process(self, params_grads):
+        helper = LayerHelper("global_norm_clip")
+        sq_norms = []
+        for p, g in params_grads:
+            if g is None:
+                continue
+            sq = helper.create_variable_for_type_inference("float32", (1,))
+            helper.append_op("squared_l2_norm", inputs={"X": [g.name]},
+                             outputs={"Out": [sq.name]},
+                             attrs={"op_role": "optimize"})
+            sq_norms.append(sq)
+        if not sq_norms:
+            return params_grads
+        total = layers.sums(sq_norms) if len(sq_norms) > 1 else sq_norms[0]
+        global_norm = layers.sqrt(total)
+        max_norm = layers.fill_constant([1], "float32", self.clip_norm)
+        scale = layers.elementwise_div(
+            max_norm, layers.elementwise_max(global_norm, max_norm))
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+            out.append((p, layers.elementwise_mul(g, scale)))
+        return out
+
+
+_gradient_clip = None
+
+
+def set_gradient_clip(clip, param_list=None, program=None):
+    """Reference parity: fluid.clip.set_gradient_clip."""
+    global _gradient_clip
+    _gradient_clip = clip
+    if param_list:
+        for p in param_list:
+            p.gradient_clip_attr = clip
+
+
+def append_gradient_clip_ops(params_grads):
+    clip = _gradient_clip
+    per_param = any(getattr(p, "gradient_clip_attr", None) is not None
+                    for p, _ in params_grads)
+    if clip is None and not per_param:
+        return params_grads
+    if per_param and not isinstance(clip, GradientClipByGlobalNorm):
+        out = []
+        for p, g in params_grads:
+            c = getattr(p, "gradient_clip_attr", None) or clip
+            if c is None or g is None:
+                out.append((p, g))
+            else:
+                out.extend(c._process([(p, g)]))
+        return out
+    return clip._process(params_grads)
+
+
+def error_clip_callback(block, op):
+    pass

@@ -1,0 +1,23 @@
+"""DataFeeder — convert python/numpy samples into feed dicts.
+
+Reference parity: python/paddle/fluid/data_feeder.py.
+"""
+import numpy as np
+
+from .framework.dtypes import to_jax_dtype
+
+
+class DataFeeder(object):
+    def __init__(self, feed_list, place=None, program=None):
+        self.feed_vars = feed_list
+        self.place = place
+
+    def feed(self, iterable):
+        """iterable: list of samples, each a tuple aligned with feed_list."""
+        columns = list(zip(*iterable))
+        out = {}
+        for var, col in zip(self.feed_vars, columns):
+            arr = np.stack([np.asarray(x) for x in col])
+            dtype = np.dtype(to_jax_dtype(var.dtype))
+            out[var.name] = arr.astype(dtype, copy=False)
+        return out

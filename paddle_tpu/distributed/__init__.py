@@ -1,0 +1,12 @@
+"""Distributed runtime: device meshes, fleet API, sequence parallelism.
+
+Reference parity: operators/collective (NCCL), operators/distributed
+(pserver), incubate/fleet, transpiler/distribute_transpiler.py. TPU-native
+replacement: one jax.sharding.Mesh spanning all chips (ICI) / hosts (DCN),
+sharding annotations instead of program transpilation, XLA collectives
+instead of NCCL/brpc.
+"""
+from .mesh import (init_mesh, get_mesh, mesh_axes, DistributedStrategy,
+                   shard_parameter, column_parallel_attr, row_parallel_attr)
+from . import fleet
+from .ring_attention import ring_attention

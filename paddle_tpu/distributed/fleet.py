@@ -1,0 +1,87 @@
+"""Fleet-style distributed training API.
+
+Reference parity: python/paddle/fluid/incubate/fleet/ (collective mode) +
+transpiler/distribute_transpiler.py. The reference rewrites programs into
+pserver/trainer pairs or inserts NCCL allreduce; TPU-native fleet simply
+(1) installs a mesh, (2) annotates parameter shardings per strategy, and
+(3) hands the program to CompiledProgram/pjit — XLA does the communication.
+"""
+import jax
+
+from . import mesh as mesh_mod
+
+_role = {"initialized": False}
+
+
+class PaddleCloudRoleMaker(object):
+    """Multi-host role discovery (reference role_maker.py). Under JAX each
+    host runs the same program; rank/size come from jax.distributed."""
+
+    def __init__(self, is_collective=True):
+        self.is_collective = is_collective
+
+    def worker_index(self):
+        return jax.process_index()
+
+    def worker_num(self):
+        return jax.process_count()
+
+    def is_first_worker(self):
+        return jax.process_index() == 0
+
+
+def init(role_maker=None, is_collective=True, strategy=None):
+    _role["initialized"] = True
+    _role["role_maker"] = role_maker or PaddleCloudRoleMaker(is_collective)
+    strategy = strategy or mesh_mod.DistributedStrategy()
+    _role["strategy"] = strategy
+    if mesh_mod.get_mesh() is None:
+        mesh_mod.init_mesh(strategy.mesh_axes)
+    return _role["role_maker"]
+
+
+def worker_index():
+    return _role["role_maker"].worker_index() if _role.get("role_maker") \
+        else 0
+
+
+def worker_num():
+    return _role["role_maker"].worker_num() if _role.get("role_maker") else 1
+
+
+def is_first_worker():
+    return worker_index() == 0
+
+
+class DistributedOptimizer(object):
+    def __init__(self, optimizer, strategy=None):
+        self._inner = optimizer
+        self._strategy = strategy or _role.get(
+            "strategy", mesh_mod.DistributedStrategy())
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        ops, pgs = self._inner.minimize(loss, startup_program,
+                                        parameter_list, no_grad_set)
+        # ZeRO-1: shard optimizer moments over dp when requested
+        if self._strategy.sharding_optimizer_state:
+            for (name, pname), var in getattr(self._inner, "_accumulators",
+                                              {}).items():
+                if var.shape and len(var.shape) >= 1 and var.shape[0] > 1:
+                    var.sharding = ("dp",) + (None,) * (len(var.shape) - 1)
+        return ops, pgs
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    return DistributedOptimizer(optimizer, strategy)
+
+
+def main_program_compiled(loss_program=None):
+    """Build the CompiledProgram for the installed mesh."""
+    from ..framework.program import default_main_program
+    from ..framework.compiler import CompiledProgram, BuildStrategy
+    program = loss_program or default_main_program()
+    strategy = _role.get("strategy", mesh_mod.DistributedStrategy())
+    bs = BuildStrategy()
+    bs.mesh_axes = dict(strategy.mesh_axes)
+    return CompiledProgram(program, bs)

@@ -1,0 +1,76 @@
+"""Device-mesh management.
+
+Reference parity: the reference builds NCCL communicators per ring
+(c_comm_init / gen_nccl_id over brpc); TPU-native: a single jax.sharding.Mesh
+over all devices. Axes convention:
+
+  dp — data parallel (batch)          mp — tensor/model parallel
+  pp — pipeline stages                sp — sequence/context parallel
+
+Multi-host: jax.distributed.initialize() enrolls every host in the same
+mesh; XLA routes collectives over ICI within a pod slice and DCN across
+slices — no parameter server processes needed.
+"""
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+_mesh = None
+
+
+class DistributedStrategy(object):
+    """Reference parity: fleet DistributedStrategy. Fields map reference
+    knobs onto mesh/sharding decisions."""
+
+    def __init__(self):
+        self.mesh_axes = {"dp": 1}
+        self.amp = False
+        self.recompute = False
+        self.gradient_merge_steps = 1
+        self.sharding_optimizer_state = False  # ZeRO-1 style
+        self.collective_timeout_s = 600.0
+
+
+def init_mesh(mesh_axes=None, devices=None, multihost=False):
+    """Create and install the global mesh. mesh_axes e.g. {"dp":2,"mp":4}."""
+    global _mesh
+    if multihost and jax.process_count() == 1:
+        try:
+            jax.distributed.initialize()
+        except Exception:
+            pass
+    devices = devices if devices is not None else jax.devices()
+    mesh_axes = mesh_axes or {"dp": len(devices)}
+    sizes = list(mesh_axes.values())
+    n = int(np.prod(sizes))
+    dev = np.array(devices[:n]).reshape(sizes)
+    _mesh = Mesh(dev, tuple(mesh_axes.keys()))
+    return _mesh
+
+
+def get_mesh():
+    return _mesh
+
+
+def mesh_axes():
+    return tuple(_mesh.axis_names) if _mesh is not None else ()
+
+
+def shard_parameter(param, spec):
+    """Annotate a Parameter's sharding, e.g. shard_parameter(w, ("mp", None))."""
+    param.sharding = tuple(spec)
+    return param
+
+
+def column_parallel_attr(name=None, **kw):
+    """ParamAttr for a column-parallel fc weight (out-dim sharded on mp):
+    matmul is local; XLA all-gathers activations only when needed."""
+    from ..param_attr import ParamAttr
+    return ParamAttr(name=name, sharding=(None, "mp"), **kw)
+
+
+def row_parallel_attr(name=None, **kw):
+    """ParamAttr for a row-parallel fc weight (in-dim sharded on mp);
+    XLA inserts the psum on the output."""
+    from ..param_attr import ParamAttr
+    return ParamAttr(name=name, sharding=("mp", None), **kw)

@@ -1,0 +1,82 @@
+"""Ring attention — sequence/context parallelism over the mesh.
+
+First-class long-context support: the sequence axis is sharded over mesh
+axis "sp"; each device holds a Q/K/V shard and K/V blocks rotate around the
+ring via lax.ppermute while partial softmax statistics accumulate in
+log-sum-exp form (online softmax). Communication rides ICI neighbor links —
+bandwidth-optimal, memory O(T/n) per chip, exact (not approximate) attention.
+
+No reference counterpart (the reference caps at single-device attention);
+this is the capability the north star demands for pod-scale long sequences.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+try:
+    from jax import shard_map as _shard_map_mod
+    shard_map = _shard_map_mod
+except Exception:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map  # type: ignore
+
+
+def _ring_attention_local(q, k, v, axis_name, causal, scale, q_offset_blocks):
+    """Per-shard body. q,k,v: (B, H, Tl, D) local shards."""
+    n = lax.axis_size(axis_name)
+    my_idx = lax.axis_index(axis_name)
+    b, h, tl, d = q.shape
+
+    # online softmax accumulators
+    acc = jnp.zeros((b, h, tl, d), jnp.float32)
+    row_max = jnp.full((b, h, tl), -jnp.inf, jnp.float32)
+    row_sum = jnp.zeros((b, h, tl), jnp.float32)
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def block(carry, step):
+        acc, row_max, row_sum, kk, vv = carry
+        kv_idx = (my_idx - step) % n
+        logits = jnp.einsum("bhqd,bhkd->bhqk", q, kk,
+                            preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = my_idx * tl + jnp.arange(tl)
+            k_pos = kv_idx * tl + jnp.arange(tl)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            logits = jnp.where(mask[None, None], logits, -1e30)
+        blk_max = jnp.max(logits, axis=-1)
+        new_max = jnp.maximum(row_max, blk_max)
+        correction = jnp.exp(row_max - new_max)
+        p = jnp.exp(logits - new_max[..., None])
+        acc = acc * correction[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, vv.astype(jnp.float32))
+        row_sum = row_sum * correction + jnp.sum(p, axis=-1)
+        kk = lax.ppermute(kk, axis_name, perm)
+        vv = lax.ppermute(vv, axis_name, perm)
+        return (acc, new_max, row_sum, kk, vv), None
+
+    (acc, row_max, row_sum, _, _), _ = lax.scan(
+        block, (acc, row_max, row_sum, k, v), jnp.arange(n))
+    out = acc / jnp.maximum(row_sum[..., None], 1e-30)
+    return out.astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh=None, axis_name="sp", causal=False,
+                   scale=None):
+    """q,k,v: (B, H, T, D) arrays (or sharded jax.Arrays); T sharded on
+    `axis_name`. Returns attention output with the same sharding."""
+    from .mesh import get_mesh
+    mesh = mesh or get_mesh()
+    if mesh is None or axis_name not in mesh.axis_names:
+        raise ValueError("ring_attention needs a mesh with axis %r"
+                         % axis_name)
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    spec = P(None, None, axis_name, None)
+    fn = shard_map(
+        functools.partial(_ring_attention_local, axis_name=axis_name,
+                          causal=causal, scale=scale, q_offset_blocks=0),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return fn(q, k, v)

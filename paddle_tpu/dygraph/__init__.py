@@ -1,0 +1,15 @@
+"""Dygraph (eager/imperative) mode.
+
+Reference parity: python/paddle/fluid/dygraph/* + paddle/fluid/imperative/.
+TPU-native eager: Variables wrap jax.Arrays directly (no tracer/engine —
+JAX IS the tracer); Layer modules hold parameters; backward() uses jax.grad
+over the recorded functional call.
+"""
+from .base import guard, enabled, to_variable, no_grad, enable_dygraph, \
+    disable_dygraph
+from .layers import Layer
+from .container import Sequential, LayerList, ParameterList
+from .nn import (Linear, Conv2D, BatchNorm, Embedding, LayerNorm, Dropout,
+                 Pool2D, GRUUnit)
+from .checkpoint import save_dygraph, load_dygraph
+from .jit import TracedLayer, dygraph_to_static_graph

@@ -1,0 +1,140 @@
+"""Dygraph runtime: eager Variables on jax arrays.
+
+Reference parity: dygraph/base.py + imperative/tracer.cc. The reference
+records ops on a tape for autograd; here eager math happens directly on
+jax.Arrays and gradients come from jax.grad over Layer.__call__ (see
+layers.py), so there is no tape to maintain.
+"""
+import contextlib
+import functools
+
+import numpy as np
+import jax.numpy as jnp
+
+_in_dygraph = [False]
+_no_grad_depth = [0]
+
+
+def enabled():
+    return _in_dygraph[0]
+
+
+def enable_dygraph(place=None):
+    _in_dygraph[0] = True
+
+
+def disable_dygraph():
+    _in_dygraph[0] = False
+
+
+@contextlib.contextmanager
+def guard(place=None):
+    old = _in_dygraph[0]
+    _in_dygraph[0] = True
+    try:
+        yield
+    finally:
+        _in_dygraph[0] = old
+
+
+class EagerVariable(object):
+    """Eager tensor: thin wrapper over a jax.Array with fluid's dygraph
+    Variable surface (numpy(), backward(), gradient())."""
+
+    def __init__(self, value, name=None, stop_gradient=False):
+        self._value = jnp.asarray(value)
+        self.name = name or "eager_var"
+        self.stop_gradient = stop_gradient
+        self._grad = None
+
+    # value plumbing -------------------------------------------------------
+    @property
+    def value(self):
+        return self._value
+
+    @property
+    def shape(self):
+        return tuple(self._value.shape)
+
+    @property
+    def dtype(self):
+        return str(self._value.dtype)
+
+    def numpy(self):
+        return np.asarray(self._value)
+
+    def astype(self, dtype):
+        from ..framework.dtypes import to_jax_dtype
+        return EagerVariable(self._value.astype(to_jax_dtype(dtype)))
+
+    def detach(self):
+        return EagerVariable(self._value, stop_gradient=True)
+
+    def gradient(self):
+        return None if self._grad is None else np.asarray(self._grad)
+
+    def backward(self, backward_strategy=None):
+        raise RuntimeError(
+            "paddle_tpu dygraph computes gradients functionally: use "
+            "dygraph.grad(loss_fn, layer) or Layer.backward helpers "
+            "(JAX autodiff replaces the reference's tape)")
+
+    # operator sugar -------------------------------------------------------
+    def _b(self, other, fn):
+        o = other._value if isinstance(other, EagerVariable) else other
+        return EagerVariable(fn(self._value, o))
+
+    def __add__(self, o):
+        return self._b(o, jnp.add)
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._b(o, jnp.subtract)
+
+    def __rsub__(self, o):
+        return self._b(o, lambda a, b: jnp.subtract(b, a))
+
+    def __mul__(self, o):
+        return self._b(o, jnp.multiply)
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._b(o, jnp.divide)
+
+    def __matmul__(self, o):
+        return self._b(o, jnp.matmul)
+
+    def __neg__(self):
+        return EagerVariable(-self._value)
+
+    def __getitem__(self, idx):
+        return EagerVariable(self._value[idx])
+
+    def __repr__(self):
+        return "EagerVariable(%s, shape=%s)" % (self._value, self.shape)
+
+
+def to_variable(value, name=None, zero_copy=None):
+    if isinstance(value, EagerVariable):
+        return value
+    return EagerVariable(np.asarray(value), name=name)
+
+
+@contextlib.contextmanager
+def no_grad_ctx():
+    _no_grad_depth[0] += 1
+    try:
+        yield
+    finally:
+        _no_grad_depth[0] -= 1
+
+
+def no_grad(fn=None):
+    if fn is None:
+        return no_grad_ctx()
+
+    @functools.wraps(fn)
+    def wrapper(*a, **k):
+        with no_grad_ctx():
+            return fn(*a, **k)
+    return wrapper

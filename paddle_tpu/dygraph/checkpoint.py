@@ -1,0 +1,16 @@
+"""Dygraph checkpoint save/load (reference: dygraph/checkpoint.py)."""
+import os
+
+import numpy as np
+
+
+def save_dygraph(state_dict, model_path):
+    os.makedirs(os.path.dirname(model_path) or ".", exist_ok=True)
+    arrays = {k: np.asarray(v) for k, v in state_dict.items()}
+    np.savez(model_path + ".pdparams.npz", **arrays)
+
+
+def load_dygraph(model_path):
+    path = model_path + ".pdparams.npz"
+    with np.load(path, allow_pickle=False) as data:
+        return {k: data[k] for k in data.files}, None

@@ -1,0 +1,56 @@
+"""Dygraph -> static capture.
+
+Reference parity: dygraph/jit.py (TracedLayer) + ProgramTranslator. Here the
+capture IS jax.jit: TracedLayer wraps a dygraph Layer's functional forward
+in a jitted callable (one XLA computation), which is also what the static
+Executor produces — the two modes converge on the same backend.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .base import EagerVariable, to_variable
+
+
+class TracedLayer(object):
+    def __init__(self, layer, jitted, params):
+        self._layer = layer
+        self._jitted = jitted
+        self._params = params
+
+    @staticmethod
+    def trace(layer, inputs):
+        params = layer.parameters()
+
+        def functional(param_vals, *raw):
+            for p, v in zip(params, param_vals):
+                p._value = v
+            outs = layer.forward(*[to_variable(x) for x in raw])
+            if isinstance(outs, (list, tuple)):
+                return tuple(o._value for o in outs)
+            return outs._value
+
+        jitted = jax.jit(functional)
+        raw = [x._value if isinstance(x, EagerVariable) else jnp.asarray(x)
+               for x in inputs]
+        out_vals = jitted([p._value for p in params], *raw)
+        outs = ([EagerVariable(v) for v in out_vals]
+                if isinstance(out_vals, tuple) else EagerVariable(out_vals))
+        return outs, TracedLayer(layer, jitted, params)
+
+    def __call__(self, inputs):
+        raw = [x._value if isinstance(x, EagerVariable) else jnp.asarray(x)
+               for x in inputs]
+        out = self._jitted([p._value for p in self._params], *raw)
+        if isinstance(out, tuple):
+            return [EagerVariable(v) for v in out]
+        return EagerVariable(out)
+
+    def save_inference_model(self, dirname, feed=None, fetch=None):
+        from .checkpoint import save_dygraph
+        save_dygraph(self._layer.state_dict(), dirname + "/traced")
+
+
+def dygraph_to_static_graph(fn):
+    """Decorator stub mirroring @dygraph_to_static_graph; functional jit."""
+    return fn

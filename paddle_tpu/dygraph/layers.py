@@ -1,0 +1,174 @@
+"""Dygraph Layer base.
+
+Reference parity: dygraph/layers.py (Layer). Functional-grad design:
+``layer.loss_and_grad(loss_fn, *inputs)`` returns (loss, grads-dict) via
+jax.value_and_grad over the layer's parameters — the TPU-idiomatic
+replacement for tape-based .backward(); minimize() on dygraph optimizers
+consumes the grads dict.
+"""
+import collections
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .base import EagerVariable, to_variable
+
+
+class Layer(object):
+    def __init__(self, name_scope=None, dtype="float32"):
+        self._full_name = name_scope or self.__class__.__name__.lower()
+        self._dtype = dtype
+        self._parameters = collections.OrderedDict()
+        self._sub_layers = collections.OrderedDict()
+        self.training = True
+
+    # ---- naming / registration ------------------------------------------
+    def full_name(self):
+        return self._full_name
+
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        subs = self.__dict__.get("_sub_layers")
+        if params is not None and isinstance(value, EagerVariable) \
+                and getattr(value, "_is_param", False):
+            params[name] = value
+        elif subs is not None and isinstance(value, Layer):
+            subs[name] = value
+        object.__setattr__(self, name, value)
+
+    def create_parameter(self, shape, dtype=None, initializer=None,
+                         attr=None, is_bias=False):
+        from ..initializer import (XavierInitializer, ConstantInitializer,
+                                   Initializer)
+        dtype = dtype or self._dtype
+        init = initializer
+        if attr is not None and getattr(attr, "initializer", None):
+            init = attr.initializer
+        key = np.random.RandomState(len(self._parameters) + 1)
+        shape = tuple(int(s) for s in shape)
+        if init is None:
+            if is_bias:
+                value = np.zeros(shape, dtype=np.float32)
+            else:
+                fan_in = shape[0] if shape else 1
+                fan_out = shape[-1] if shape else 1
+                limit = float(np.sqrt(6.0 / (fan_in + fan_out)))
+                value = key.uniform(-limit, limit, shape).astype(np.float32)
+        else:
+            value = _materialize_init(init, shape)
+        p = EagerVariable(jnp.asarray(value))
+        p._is_param = True
+        return p
+
+    def add_parameter(self, name, param):
+        param._is_param = True
+        self._parameters[name] = param
+        object.__setattr__(self, name, param)
+        return param
+
+    def add_sublayer(self, name, layer):
+        self._sub_layers[name] = layer
+        object.__setattr__(self, name, layer)
+        return layer
+
+    # ---- traversal -------------------------------------------------------
+    def parameters(self, include_sublayers=True):
+        out = list(self._parameters.values())
+        if include_sublayers:
+            for l in self._sub_layers.values():
+                out.extend(l.parameters())
+        return out
+
+    def named_parameters(self, prefix=""):
+        for n, p in self._parameters.items():
+            yield (prefix + n, p)
+        for ln, l in self._sub_layers.items():
+            for n, p in l.named_parameters(prefix + ln + "."):
+                yield (n, p)
+
+    def sublayers(self, include_sublayers=True):
+        out = list(self._sub_layers.values())
+        if include_sublayers:
+            for l in self._sub_layers.values():
+                out.extend(l.sublayers())
+        return out
+
+    def train(self):
+        self.training = True
+        for l in self.sublayers():
+            l.training = True
+
+    def eval(self):
+        self.training = False
+        for l in self.sublayers():
+            l.training = False
+
+    # ---- state dict ------------------------------------------------------
+    def state_dict(self, destination=None, include_sublayers=True,
+                   prefix=""):
+        dest = destination if destination is not None else \
+            collections.OrderedDict()
+        for name, p in self.named_parameters(prefix):
+            dest[name] = p.numpy()
+        return dest
+
+    def set_dict(self, state, include_sublayers=True):
+        named = dict(self.named_parameters())
+        for name, value in state.items():
+            if name in named:
+                named[name]._value = jnp.asarray(value)
+
+    load_dict = set_dict
+
+    # ---- calling / autodiff ---------------------------------------------
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *inputs, **kwargs):
+        return self.forward(*inputs, **kwargs)
+
+    def loss_and_grad(self, loss_fn, *inputs):
+        """loss_fn(outputs...) -> scalar EagerVariable. Returns
+        (loss, {param_id: grad jnp array}) using jax.value_and_grad over a
+        functionalized forward."""
+        params = self.parameters()
+        vals = [p._value for p in params]
+
+        def functional(vals_list, *raw_inputs):
+            for p, v in zip(params, vals_list):
+                p._value = v
+            outs = self.forward(*[to_variable(x) for x in raw_inputs])
+            loss = loss_fn(outs) if loss_fn is not None else outs
+            return loss._value.reshape(())
+
+        raw = [x._value if isinstance(x, EagerVariable) else jnp.asarray(x)
+               for x in inputs]
+        loss_val, grads = jax.value_and_grad(functional)(vals, *raw)
+        for p, v in zip(params, vals):
+            p._value = v
+        for p, g in zip(params, grads):
+            p._grad = g
+        return EagerVariable(loss_val), dict(zip(
+            [id(p) for p in params], grads))
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p._grad = None
+
+
+def _materialize_init(init, shape):
+    """Run a graph-mode Initializer eagerly to get a numpy value."""
+    from ..framework.program import Program, program_guard
+    from ..framework.executor import Executor
+    from ..framework.scope import Scope, scope_guard
+    prog = Program()
+    with program_guard(prog, prog):
+        blk = prog.global_block()
+        var = blk.create_var(name="init_target", shape=shape,
+                             dtype="float32", persistable=True)
+        init(var, blk)
+    scope = Scope()
+    with scope_guard(scope):
+        Executor().run(prog, feed={}, fetch_list=[])
+        return scope.get_numpy("init_target")

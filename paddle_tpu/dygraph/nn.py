@@ -1,0 +1,218 @@
+"""Dygraph nn modules.
+
+Reference parity: dygraph/nn.py (Conv2D, Pool2D, FC/Linear, BatchNorm,
+Embedding, LayerNorm, GRUUnit, Dropout ...). Forward math calls the SAME op
+kernels as graph mode (ops/*), eagerly.
+"""
+import numpy as np
+import jax.numpy as jnp
+
+from .base import EagerVariable
+from .layers import Layer
+from ..ops.registry import get_op
+
+
+class _EagerCtx(object):
+    """Minimal ctx for running op kernels eagerly."""
+    def __init__(self, seed=None):
+        import jax
+        self._key = jax.random.PRNGKey(
+            np.random.randint(0, 2**31) if seed is None else seed)
+        self._n = 0
+
+    def rng(self):
+        import jax
+        self._n += 1
+        return jax.random.fold_in(self._key, self._n)
+
+
+def run_op(op_type, ins, attrs=None, ctx=None):
+    """Eagerly run a registered kernel on EagerVariables/arrays."""
+    kernel = get_op(op_type)
+    jins = {k: [v._value if isinstance(v, EagerVariable) else jnp.asarray(v)
+                for v in vs] for k, vs in ins.items()}
+    outs = kernel.fn(ctx or _EagerCtx(), jins, attrs or {})
+    return {k: ([EagerVariable(x) for x in v] if isinstance(v, (list, tuple))
+                else EagerVariable(v)) for k, v in outs.items()}
+
+
+class Linear(Layer):
+    def __init__(self, input_dim, output_dim, param_attr=None,
+                 bias_attr=None, act=None, dtype="float32"):
+        super(Linear, self).__init__(dtype=dtype)
+        self.weight = self.add_parameter(
+            "weight", self.create_parameter([input_dim, output_dim],
+                                            attr=param_attr))
+        self.bias = self.add_parameter(
+            "bias", self.create_parameter([output_dim], is_bias=True,
+                                          attr=bias_attr))
+        self._act = act
+
+    def forward(self, input):
+        out = EagerVariable(jnp.matmul(input._value, self.weight._value)
+                            + self.bias._value)
+        if self._act:
+            out = run_op(self._act, {"X": [out]})["Out"]
+        return out
+
+
+class Conv2D(Layer):
+    def __init__(self, num_channels, num_filters, filter_size, stride=1,
+                 padding=0, dilation=1, groups=1, param_attr=None,
+                 bias_attr=None, act=None, dtype="float32"):
+        super(Conv2D, self).__init__(dtype=dtype)
+        fs = [filter_size] * 2 if isinstance(filter_size, int) \
+            else list(filter_size)
+        std = (2.0 / (fs[0] * fs[1] * num_channels)) ** 0.5
+        w = np.random.normal(
+            0, std, [num_filters, num_channels // groups] + fs
+        ).astype(np.float32)
+        self.weight = self.add_parameter("weight", EagerVariable(w))
+        self.bias = self.add_parameter(
+            "bias", self.create_parameter([num_filters], is_bias=True))
+        self._attrs = {"strides": [stride] * 2 if isinstance(stride, int)
+                       else list(stride),
+                       "paddings": [padding] * 2 if isinstance(padding, int)
+                       else list(padding),
+                       "dilations": [dilation] * 2
+                       if isinstance(dilation, int) else list(dilation),
+                       "groups": groups}
+        self._act = act
+
+    def forward(self, input):
+        out = run_op("conv2d", {"Input": [input], "Filter": [self.weight]},
+                     self._attrs)["Output"]
+        out = EagerVariable(out._value +
+                            self.bias._value.reshape(1, -1, 1, 1))
+        if self._act:
+            out = run_op(self._act, {"X": [out]})["Out"]
+        return out
+
+
+class Pool2D(Layer):
+    def __init__(self, pool_size=-1, pool_type="max", pool_stride=1,
+                 pool_padding=0, global_pooling=False, ceil_mode=False,
+                 exclusive=True):
+        super(Pool2D, self).__init__()
+        self._attrs = {"ksize": [pool_size] * 2
+                       if isinstance(pool_size, int) else list(pool_size),
+                       "pooling_type": pool_type,
+                       "strides": [pool_stride] * 2
+                       if isinstance(pool_stride, int) else list(pool_stride),
+                       "paddings": [pool_padding] * 2
+                       if isinstance(pool_padding, int)
+                       else list(pool_padding),
+                       "global_pooling": global_pooling,
+                       "exclusive": exclusive}
+
+    def forward(self, input):
+        return run_op("pool2d", {"X": [input]}, self._attrs)["Out"]
+
+
+class BatchNorm(Layer):
+    def __init__(self, num_channels, act=None, is_test=False, momentum=0.9,
+                 epsilon=1e-5, param_attr=None, bias_attr=None,
+                 dtype="float32", data_layout="NCHW",
+                 use_global_stats=False):
+        super(BatchNorm, self).__init__(dtype=dtype)
+        c = num_channels
+        self.weight = self.add_parameter(
+            "weight", EagerVariable(np.ones(c, np.float32)))
+        self.bias = self.add_parameter(
+            "bias", EagerVariable(np.zeros(c, np.float32)))
+        self._mean = EagerVariable(np.zeros(c, np.float32),
+                                   stop_gradient=True)
+        self._variance = EagerVariable(np.ones(c, np.float32),
+                                       stop_gradient=True)
+        self._attrs = {"momentum": momentum, "epsilon": epsilon,
+                       "data_layout": data_layout,
+                       "use_global_stats": use_global_stats}
+        self._act = act
+
+    def forward(self, input):
+        attrs = dict(self._attrs)
+        attrs["is_test"] = not self.training
+        outs = run_op("batch_norm",
+                      {"X": [input], "Scale": [self.weight],
+                       "Bias": [self.bias], "Mean": [self._mean],
+                       "Variance": [self._variance]}, attrs)
+        self._mean._value = outs["MeanOut"]._value
+        self._variance._value = outs["VarianceOut"]._value
+        out = outs["Y"]
+        if self._act:
+            out = run_op(self._act, {"X": [out]})["Out"]
+        return out
+
+
+class Embedding(Layer):
+    def __init__(self, size, is_sparse=False, padding_idx=None,
+                 param_attr=None, dtype="float32"):
+        super(Embedding, self).__init__(dtype=dtype)
+        w = np.random.normal(0, 0.02, size).astype(np.float32)
+        self.weight = self.add_parameter("weight", EagerVariable(w))
+        self._padding_idx = -1 if padding_idx is None else padding_idx
+
+    def forward(self, input):
+        return run_op("lookup_table",
+                      {"W": [self.weight], "Ids": [input]},
+                      {"padding_idx": self._padding_idx})["Out"]
+
+
+class LayerNorm(Layer):
+    def __init__(self, normalized_shape, scale=True, shift=True,
+                 epsilon=1e-5, param_attr=None, bias_attr=None,
+                 act=None, dtype="float32"):
+        super(LayerNorm, self).__init__(dtype=dtype)
+        if isinstance(normalized_shape, int):
+            normalized_shape = [normalized_shape]
+        n = int(np.prod(normalized_shape))
+        self.weight = self.add_parameter(
+            "weight", EagerVariable(np.ones(n, np.float32)))
+        self.bias = self.add_parameter(
+            "bias", EagerVariable(np.zeros(n, np.float32)))
+        self._epsilon = epsilon
+        self._act = act
+
+    def forward(self, input):
+        out = run_op("layer_norm",
+                     {"X": [input], "Scale": [self.weight],
+                      "Bias": [self.bias]},
+                     {"epsilon": self._epsilon,
+                      "begin_norm_axis": len(input.shape) - 1})["Y"]
+        if self._act:
+            out = run_op(self._act, {"X": [out]})["Out"]
+        return out
+
+
+class Dropout(Layer):
+    def __init__(self, p=0.5, mode="downgrade_in_infer"):
+        super(Dropout, self).__init__()
+        self._p = p
+        self._mode = mode
+
+    def forward(self, input):
+        return run_op("dropout", {"X": [input]},
+                      {"dropout_prob": self._p,
+                       "is_test": not self.training,
+                       "dropout_implementation": self._mode})["Out"]
+
+
+class GRUUnit(Layer):
+    def __init__(self, size, param_attr=None, bias_attr=None,
+                 activation="tanh", gate_activation="sigmoid",
+                 dtype="float32"):
+        super(GRUUnit, self).__init__(dtype=dtype)
+        h = size // 3
+        self.weight = self.add_parameter(
+            "weight", self.create_parameter([h, 3 * h]))
+        self.bias = self.add_parameter(
+            "bias", self.create_parameter([3 * h], is_bias=True))
+        self._attrs = {"activation": activation,
+                       "gate_activation": gate_activation}
+
+    def forward(self, input, hidden):
+        outs = run_op("gru_unit",
+                      {"Input": [input], "HiddenPrev": [hidden],
+                       "Weight": [self.weight], "Bias": [self.bias]},
+                      self._attrs)
+        return outs["Hidden"], outs["ResetHiddenPrev"], outs["Gate"]

@@ -1,0 +1,10 @@
+from .program import (Program, Block, Operator, Variable, Parameter,  # noqa
+                      default_main_program, default_startup_program,
+                      program_guard, name_scope, switch_main_program,
+                      switch_startup_program, grad_var_name)
+from .place import TPUPlace, CPUPlace, _current_expected_place  # noqa
+from .scope import Scope, global_scope, scope_guard  # noqa
+from .executor import Executor  # noqa
+from .backward import append_backward, gradients  # noqa
+from .compiler import CompiledProgram, BuildStrategy, ExecutionStrategy  # noqa
+from . import unique_name  # noqa

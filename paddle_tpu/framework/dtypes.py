@@ -1,0 +1,67 @@
+"""Dtype system for paddle_tpu.
+
+Reference parity: paddle/fluid/framework/data_type.h (proto VarType dtypes).
+TPU-first: bfloat16 is first-class; fp64 is supported but discouraged (TPUs
+emulate it slowly), so layers default to float32/bfloat16.
+"""
+import numpy as np
+import jax.numpy as jnp
+
+# Canonical dtype names -> jnp dtypes.
+_STR2DTYPE = {
+    "bool": jnp.bool_,
+    "int8": jnp.int8,
+    "uint8": jnp.uint8,
+    "int16": jnp.int16,
+    "int32": jnp.int32,
+    "int64": jnp.int64,
+    "float16": jnp.float16,
+    "bfloat16": jnp.bfloat16,
+    "float32": jnp.float32,
+    "float64": jnp.float64,
+    "complex64": jnp.complex64,
+}
+
+_ALIASES = {
+    "float": "float32",
+    "double": "float64",
+    "int": "int32",
+    "long": "int64",
+    "half": "float16",
+    "bf16": "bfloat16",
+    "fp16": "float16",
+    "fp32": "float32",
+    "fp64": "float64",
+}
+
+FLOAT_DTYPES = ("float16", "bfloat16", "float32", "float64")
+INT_DTYPES = ("int8", "uint8", "int16", "int32", "int64")
+
+
+def normalize_dtype(dtype):
+    """Return the canonical string name for *dtype* (str, np dtype or jnp dtype)."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        name = _ALIASES.get(dtype, dtype)
+        if name not in _STR2DTYPE:
+            raise TypeError("unsupported dtype string: %r" % (dtype,))
+        return name
+    # numpy / jax dtype objects and python types
+    name = np.dtype(dtype).name if not hasattr(dtype, "name") else dtype.name
+    name = _ALIASES.get(name, name)
+    if name not in _STR2DTYPE:
+        raise TypeError("unsupported dtype: %r" % (dtype,))
+    return name
+
+
+def to_jax_dtype(dtype):
+    return _STR2DTYPE[normalize_dtype(dtype)]
+
+
+def is_float(dtype):
+    return normalize_dtype(dtype) in FLOAT_DTYPES
+
+
+def is_integer(dtype):
+    return normalize_dtype(dtype) in INT_DTYPES

@@ -1,0 +1,57 @@
+"""Device places.
+
+Reference parity: paddle/fluid/platform/place.h (CPUPlace/CUDAPlace/...).
+TPU-first: TPUPlace is the primary device; it resolves to a jax TPU device.
+"""
+import jax
+
+
+class Place(object):
+    _backend = None
+
+    def __init__(self, device_id=0):
+        self.device_id = int(device_id)
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self.device_id == other.device_id
+
+    def __hash__(self):
+        return hash((type(self).__name__, self.device_id))
+
+    def __repr__(self):
+        return "%s(%d)" % (type(self).__name__, self.device_id)
+
+    def jax_device(self):
+        """Resolve to a concrete jax.Device."""
+        if self._backend is None:  # "best available" place
+            return jax.devices()[self.device_id]
+        try:
+            return jax.devices(self._backend)[self.device_id]
+        except RuntimeError:
+            # Backend unavailable (e.g. asking for TPU in a CPU-only test
+            # environment): fall back to the default backend so programs stay
+            # runnable everywhere.
+            return jax.devices()[self.device_id]
+
+
+class TPUPlace(Place):
+    _backend = "tpu"
+
+
+class CPUPlace(Place):
+    _backend = "cpu"
+
+    def __init__(self):
+        super(CPUPlace, self).__init__(0)
+
+
+class DefaultPlace(Place):
+    """Whatever jax considers the default backend (TPU when attached)."""
+    _backend = None
+
+
+def _current_expected_place():
+    devs = jax.devices()
+    if devs and devs[0].platform in ("tpu", "axon"):
+        return TPUPlace(0)
+    return CPUPlace()

@@ -1,0 +1,66 @@
+"""Scope: persistent name -> device-array storage.
+
+Reference parity: paddle/fluid/framework/scope.{h,cc} + pybind global scope.
+Parameters and optimizer state live here between Executor.run calls as
+jax.Arrays (resident in TPU HBM); the Executor donates them into each step so
+updates are in-place in XLA.
+"""
+import numpy as np
+
+
+class Scope(object):
+    def __init__(self):
+        self._vars = {}
+
+    def var(self, name):
+        """Create-or-get slot (reference Scope::Var)."""
+        return self._vars.setdefault(name, None)
+
+    def find_var(self, name):
+        return self._vars.get(name, None)
+
+    def has_var(self, name):
+        return name in self._vars
+
+    def set_var(self, name, value):
+        self._vars[name] = value
+
+    def erase(self, name):
+        self._vars.pop(name, None)
+
+    def keys(self):
+        return self._vars.keys()
+
+    def items(self):
+        return self._vars.items()
+
+    def get_numpy(self, name):
+        v = self._vars.get(name)
+        return None if v is None else np.asarray(v)
+
+    def new_scope(self):
+        return Scope()
+
+    def drop_kids(self):
+        pass
+
+
+_global_scope = Scope()
+
+
+def global_scope():
+    return _global_scope
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    global _global_scope
+    old = _global_scope
+    _global_scope = scope
+    try:
+        yield
+    finally:
+        _global_scope = old

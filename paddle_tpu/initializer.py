@@ -1,0 +1,153 @@
+"""Parameter initializers.
+
+Reference parity: python/paddle/fluid/initializer.py (Constant, Uniform,
+Normal, TruncatedNormal, Xavier, MSRA, Bilinear, NumpyArray). Each emits an
+init op into the STARTUP program, exactly like the reference; the Executor
+runs startup eagerly once and parameters live in Scope/HBM thereafter.
+"""
+import math
+
+import numpy as np
+
+
+class Initializer(object):
+    def __call__(self, param, block):
+        raise NotImplementedError
+
+
+class ConstantInitializer(Initializer):
+    def __init__(self, value=0.0, force_cpu=False):
+        self.value = value
+
+    def __call__(self, param, block):
+        block.append_op(
+            "fill_constant", outputs={"Out": [param.name]},
+            attrs={"shape": list(param.shape), "dtype": param.dtype,
+                   "value": float(self.value), "op_role": "init"})
+
+
+class UniformInitializer(Initializer):
+    def __init__(self, low=-1.0, high=1.0, seed=0):
+        self.low, self.high, self.seed = low, high, seed
+
+    def __call__(self, param, block):
+        block.append_op(
+            "uniform_random", outputs={"Out": [param.name]},
+            attrs={"shape": list(param.shape), "dtype": param.dtype,
+                   "min": self.low, "max": self.high, "seed": self.seed,
+                   "op_role": "init"})
+
+
+class NormalInitializer(Initializer):
+    def __init__(self, loc=0.0, scale=1.0, seed=0):
+        self.loc, self.scale, self.seed = loc, scale, seed
+
+    def __call__(self, param, block):
+        block.append_op(
+            "gaussian_random", outputs={"Out": [param.name]},
+            attrs={"shape": list(param.shape), "dtype": param.dtype,
+                   "mean": self.loc, "std": self.scale, "seed": self.seed,
+                   "op_role": "init"})
+
+
+class TruncatedNormalInitializer(Initializer):
+    def __init__(self, loc=0.0, scale=1.0, seed=0):
+        self.loc, self.scale, self.seed = loc, scale, seed
+
+    def __call__(self, param, block):
+        block.append_op(
+            "truncated_gaussian_random", outputs={"Out": [param.name]},
+            attrs={"shape": list(param.shape), "dtype": param.dtype,
+                   "mean": self.loc, "std": self.scale, "seed": self.seed,
+                   "op_role": "init"})
+
+
+def _fans(shape):
+    if len(shape) == 2:
+        fan_in, fan_out = shape[0], shape[1]
+    elif len(shape) > 2:
+        rf = int(np.prod(shape[2:]))
+        fan_in, fan_out = shape[1] * rf, shape[0] * rf
+    else:
+        fan_in = fan_out = int(np.prod(shape))
+    return fan_in, fan_out
+
+
+class XavierInitializer(Initializer):
+    def __init__(self, uniform=True, fan_in=None, fan_out=None, seed=0):
+        self.uniform, self.fan_in, self.fan_out, self.seed = \
+            uniform, fan_in, fan_out, seed
+
+    def __call__(self, param, block):
+        fi, fo = _fans(param.shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        if self.uniform:
+            limit = math.sqrt(6.0 / (fi + fo))
+            UniformInitializer(-limit, limit, self.seed)(param, block)
+        else:
+            std = math.sqrt(2.0 / (fi + fo))
+            NormalInitializer(0.0, std, self.seed)(param, block)
+
+
+class MSRAInitializer(Initializer):
+    def __init__(self, uniform=True, fan_in=None, seed=0):
+        self.uniform, self.fan_in, self.seed = uniform, fan_in, seed
+
+    def __call__(self, param, block):
+        fi, _ = _fans(param.shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        if self.uniform:
+            limit = math.sqrt(6.0 / fi)
+            UniformInitializer(-limit, limit, self.seed)(param, block)
+        else:
+            std = math.sqrt(2.0 / fi)
+            NormalInitializer(0.0, std, self.seed)(param, block)
+
+
+class BilinearInitializer(Initializer):
+    """For conv-transpose upsampling kernels (reference initializer.py)."""
+
+    def __call__(self, param, block):
+        shape = param.shape
+        if len(shape) != 4:
+            raise ValueError("bilinear init needs a 4-D conv weight")
+        c_out, c_in, h, w = shape
+        f = math.ceil(w / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        weight = np.zeros(shape, dtype=np.float32)
+        og = np.ogrid[:h, :w]
+        filt = (1 - abs(og[0] / f - c)) * (1 - abs(og[1] / f - c))
+        weight[range(c_out), range(c_in) if c_in == c_out else 0, :, :] = filt
+        NumpyArrayInitializer(weight)(param, block)
+
+
+class NumpyArrayInitializer(Initializer):
+    def __init__(self, value):
+        self.value = np.asarray(value)
+
+    def __call__(self, param, block):
+        block.append_op(
+            "assign_value", outputs={"Out": [param.name]},
+            attrs={"shape": list(self.value.shape), "dtype": param.dtype,
+                   "values": self.value.reshape(-1).tolist(),
+                   "op_role": "init"})
+
+
+# fluid-style aliases
+Constant = ConstantInitializer
+Uniform = UniformInitializer
+Normal = NormalInitializer
+TruncatedNormal = TruncatedNormalInitializer
+Xavier = XavierInitializer
+MSRA = MSRAInitializer
+Bilinear = BilinearInitializer
+NumpyArray = NumpyArrayInitializer
+
+
+def _global_weight_initializer():
+    return XavierInitializer()
+
+
+def _global_bias_initializer():
+    return ConstantInitializer(0.0)

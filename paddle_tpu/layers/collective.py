@@ -1,0 +1,53 @@
+"""Collective layers.
+
+Reference parity: python/paddle/fluid/layers/collective.py (_c_allreduce,
+_c_allgather, ...). On TPU these lower to XLA collectives over the mesh
+(ops/collective_ops.py); axis_name selects the mesh axis (default "dp").
+"""
+from ..layer_helper import LayerHelper
+
+
+def _collective(op_type, x, attrs=None, out_shape=None):
+    helper = LayerHelper(op_type)
+    out = helper.create_variable_for_type_inference(
+        x.dtype, out_shape if out_shape is not None else x.shape)
+    helper.append_op(op_type, inputs={"X": [x.name]},
+                     outputs={"Out": [out.name]}, attrs=attrs or {})
+    return out
+
+
+def c_allreduce(x, op="sum", axis_name="dp", use_calc_stream=True):
+    return _collective("c_allreduce_" + op, x, {"axis_name": axis_name})
+
+
+def c_allreduce_sum(x, axis_name="dp"):
+    return _collective("c_allreduce_sum", x, {"axis_name": axis_name})
+
+
+def c_allgather(x, nranks=None, axis_name="dp"):
+    shape = None
+    if x.shape is not None and nranks:
+        shape = (x.shape[0] * nranks,) + tuple(x.shape[1:])
+    return _collective("c_allgather", x, {"axis_name": axis_name}, shape)
+
+
+def c_reducescatter(x, nranks=None, axis_name="dp"):
+    shape = None
+    if x.shape is not None and nranks:
+        shape = (x.shape[0] // nranks,) + tuple(x.shape[1:])
+    return _collective("c_reducescatter", x, {"axis_name": axis_name}, shape)
+
+
+def c_broadcast(x, root=0, axis_name="dp"):
+    return _collective("c_broadcast", x, {"axis_name": axis_name,
+                                          "root": root})
+
+
+def ppermute(x, shift=1, axis_name="sp"):
+    """Ring shift along a mesh axis (sequence-parallel building block)."""
+    return _collective("ppermute", x, {"axis_name": axis_name,
+                                       "shift": shift})
+
+
+def barrier(x, axis_name="dp"):
+    return _collective("barrier", x, {"axis_name": axis_name})

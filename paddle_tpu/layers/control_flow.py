@@ -1,0 +1,185 @@
+"""Control-flow layers: cond / while_loop / case / switch_case.
+
+Reference parity: python/paddle/fluid/layers/control_flow.py. Sub-blocks are
+built at layer time (ops recorded into child Blocks) and traced into
+lax.cond / lax.while_loop at executor compile time — on-device control flow.
+"""
+from ..layer_helper import LayerHelper
+from ..framework.program import Variable, default_main_program
+
+
+def _compare(x, y, op_type):
+    from . import tensor as tensor_layers
+    helper = LayerHelper(op_type)
+    if not isinstance(y, Variable):
+        y = tensor_layers.fill_constant([1], x.dtype, float(y))
+    out = helper.create_variable_for_type_inference("bool", x.shape)
+    helper.append_op(op_type, inputs={"X": [x.name], "Y": [y.name]},
+                     outputs={"Out": [out.name]})
+    out.stop_gradient = True
+    return out
+
+
+def less_than(x, y, force_cpu=None, cond=None):
+    return _compare(x, y, "less_than")
+
+
+def less_equal(x, y, cond=None):
+    return _compare(x, y, "less_equal")
+
+
+def greater_than(x, y, cond=None):
+    return _compare(x, y, "greater_than")
+
+
+def greater_equal(x, y, cond=None):
+    return _compare(x, y, "greater_equal")
+
+
+def equal(x, y, cond=None):
+    return _compare(x, y, "equal")
+
+
+def not_equal(x, y, cond=None):
+    return _compare(x, y, "not_equal")
+
+
+def logical_and(x, y, out=None, name=None):
+    return _compare(x, y, "logical_and")
+
+
+def logical_or(x, y, out=None, name=None):
+    return _compare(x, y, "logical_or")
+
+
+def logical_not(x, out=None, name=None):
+    helper = LayerHelper("logical_not")
+    out = helper.create_variable_for_type_inference("bool", x.shape)
+    helper.append_op("logical_not", inputs={"X": [x.name]},
+                     outputs={"Out": [out.name]})
+    return out
+
+
+def increment(x, value=1.0, in_place=True):
+    helper = LayerHelper("increment")
+    if in_place:
+        out = x
+    else:
+        out = helper.create_variable_for_type_inference(x.dtype, x.shape)
+    helper.append_op("increment", inputs={"X": [x.name]},
+                     outputs={"Out": [out.name]}, attrs={"step": float(value)})
+    return out
+
+
+def _build_subblock(fn, program):
+    """Run fn() with a fresh child block current; return (block, outputs)."""
+    block = program._create_block()
+    try:
+        outs = fn() if fn is not None else None
+    finally:
+        program._rollback()
+    if outs is None:
+        outs = []
+    if isinstance(outs, Variable):
+        outs = [outs]
+    return block, list(outs)
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None):
+    """layers.cond(pred, true_fn, false_fn) -> vars with matching structure.
+
+    Both branches run as traced lax.cond branches on device.
+    """
+    helper = LayerHelper("cond", name=name)
+    program = default_main_program()
+    true_block, true_outs = _build_subblock(true_fn, program)
+    false_block, false_outs = _build_subblock(false_fn, program)
+    if len(true_outs) != len(false_outs):
+        raise ValueError(
+            "cond branches returned different numbers of outputs: %d vs %d"
+            % (len(true_outs), len(false_outs)))
+    outs = [helper.create_variable_for_type_inference(v.dtype, v.shape)
+            for v in true_outs]
+    helper.append_op(
+        "cond", inputs={"Cond": [pred.name]},
+        outputs={"Out": [o.name for o in outs]},
+        attrs={"true_block": true_block.idx, "false_block": false_block.idx,
+               "true_out_names": [v.name for v in true_outs],
+               "false_out_names": [v.name for v in false_outs]})
+    if not outs:
+        return None
+    return outs[0] if len(outs) == 1 else outs
+
+
+def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
+    """layers.while_loop — on-device lax.while_loop."""
+    helper = LayerHelper("while_loop", name=name)
+    program = default_main_program()
+
+    cond_block = program._create_block()
+    try:
+        pred = cond_fn(*loop_vars)
+    finally:
+        program._rollback()
+
+    body_block = program._create_block()
+    try:
+        new_vars = body_fn(*loop_vars)
+    finally:
+        program._rollback()
+    if isinstance(new_vars, Variable):
+        new_vars = [new_vars]
+    new_vars = list(new_vars)
+    if len(new_vars) != len(loop_vars):
+        raise ValueError("while_loop body must return as many vars as "
+                         "loop_vars")
+    # the body must write back into the loop var names; emit assigns
+    for lv, nv in zip(loop_vars, new_vars):
+        if nv.name != lv.name:
+            body_block.append_op("assign", inputs={"X": [nv.name]},
+                                 outputs={"Out": [lv.name]})
+
+    outs = [helper.create_variable_for_type_inference(v.dtype, v.shape)
+            for v in loop_vars]
+    helper.append_op(
+        "while_loop",
+        inputs={"LoopVars": [v.name for v in loop_vars]},
+        outputs={"Out": [o.name for o in outs]},
+        attrs={"cond_block": cond_block.idx, "body_block": body_block.idx,
+               "loop_var_names": [v.name for v in loop_vars],
+               "cond_out_name": pred.name})
+    return outs
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """Reference layers.case — nested cond chain."""
+    def build(pairs):
+        pred, fn = pairs[0]
+        rest = pairs[1:]
+        if not rest:
+            if default is None:
+                return cond(pred, fn, fn)
+            return cond(pred, fn, default)
+        return cond(pred, fn, lambda: build(rest))
+    return build(list(pred_fn_pairs))
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    pairs = [(equal(branch_index, float(i)), fn)
+             for i, fn in (branch_fns.items()
+                           if isinstance(branch_fns, dict)
+                           else enumerate(branch_fns))]
+    return case(pairs, default=default, name=name)
+
+
+def piecewise_select(step, boundaries, values, dtype="float32"):
+    """select values[i] where boundaries[i-1] <= step < boundaries[i] —
+    the TPU-friendly lowering of the reference's Switch construct
+    (a chain of `where` selects, fully on device)."""
+    from . import tensor as tensor_layers
+    from .nn import where
+    out = tensor_layers.fill_constant([1], dtype, values[-1])
+    for b, v in reversed(list(zip(boundaries, values[:-1]))):
+        v_var = tensor_layers.fill_constant([1], dtype, v)
+        out = where(less_than(step, float(b)), v_var, out)
+    return out

@@ -1,0 +1,95 @@
+"""Probability distributions.
+
+Reference parity: python/paddle/fluid/layers/distributions.py
+(Uniform, Normal, Categorical, MultivariateNormalDiag subset).
+"""
+import math
+
+from . import tensor as T
+from . import ops
+from .nn import elementwise_add, elementwise_sub, elementwise_mul, \
+    elementwise_div, reduce_sum, softmax
+from ..framework.program import Variable
+
+
+def _as_var(v, like=None, dtype="float32"):
+    if isinstance(v, Variable):
+        return v
+    return T.fill_constant([1], dtype, float(v))
+
+
+class Distribution(object):
+    def sample(self, shape, seed=0):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def entropy(self):
+        raise NotImplementedError
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high):
+        self.low = _as_var(low)
+        self.high = _as_var(high)
+
+    def sample(self, shape, seed=0):
+        u = ops.uniform_random(shape, min=0.0, max=1.0, seed=seed)
+        return elementwise_add(
+            elementwise_mul(u, elementwise_sub(self.high, self.low)),
+            self.low)
+
+    def log_prob(self, value):
+        rng = elementwise_sub(self.high, self.low)
+        return ops.log(elementwise_div(T.ones([1]), rng)) + (value * 0.0)
+
+    def entropy(self):
+        return ops.log(elementwise_sub(self.high, self.low))
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = _as_var(loc)
+        self.scale = _as_var(scale)
+
+    def sample(self, shape, seed=0):
+        z = ops.gaussian_random(shape, mean=0.0, std=1.0, seed=seed)
+        return elementwise_add(elementwise_mul(z, self.scale), self.loc)
+
+    def log_prob(self, value):
+        var = elementwise_mul(self.scale, self.scale)
+        d = elementwise_sub(value, self.loc)
+        return (elementwise_div(elementwise_mul(d, d), var) * (-0.5)) \
+            - math.log(math.sqrt(2.0 * math.pi)) - ops.log(self.scale)
+
+    def entropy(self):
+        return ops.log(self.scale) + 0.5 * math.log(2.0 * math.pi * math.e)
+
+    def kl_divergence(self, other):
+        var_ratio = elementwise_div(self.scale, other.scale)
+        var_ratio = elementwise_mul(var_ratio, var_ratio)
+        t1 = elementwise_div(elementwise_sub(self.loc, other.loc),
+                             other.scale)
+        t1 = elementwise_mul(t1, t1)
+        return (var_ratio + t1 - 1.0 - ops.log(var_ratio)) * 0.5
+
+
+class Categorical(Distribution):
+    def __init__(self, logits):
+        self.logits = logits
+
+    def sample(self, shape=None, seed=0):
+        probs = softmax(self.logits)
+        return ops.sampling_id(probs, seed=seed)
+
+    def log_prob(self, value):
+        from .nn import log_softmax, gather_nd
+        logp = log_softmax(self.logits)
+        raise NotImplementedError("compose with gather_nd on label indices")
+
+    def entropy(self):
+        from .nn import log_softmax
+        p = softmax(self.logits)
+        logp = log_softmax(self.logits)
+        return reduce_sum(elementwise_mul(p, logp), dim=-1) * (-1.0)

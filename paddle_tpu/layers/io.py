@@ -1,0 +1,20 @@
+"""Input layers.
+
+Reference parity: python/paddle/fluid/layers/io.py (data) + fluid.data.
+"""
+from ..framework.program import default_main_program, default_startup_program
+
+
+def data(name, shape, dtype="float32", lod_level=0, append_batch_size=True,
+         stop_gradient=True):
+    """Declare a feed variable. append_batch_size=True prepends -1 (batch),
+    matching fluid.layers.data; fluid.data (v1.6+) passes the full shape."""
+    shape = list(shape)
+    if append_batch_size:
+        shape = [-1] + shape
+    for prog in (default_main_program(),):
+        blk = prog.global_block()
+        var = blk.create_var(name=name, shape=tuple(shape), dtype=dtype,
+                             is_data=True, stop_gradient=stop_gradient,
+                             lod_level=lod_level)
+    return var

@@ -1,0 +1,117 @@
+"""Learning-rate schedulers (static graph, in-graph computation).
+
+Reference parity: python/paddle/fluid/layers/learning_rate_scheduler.py —
+noam, exponential, natural_exp, inverse_time, polynomial, piecewise, cosine,
+linear_lr_warmup. Same design: a persistable global-step var is incremented
+each run and the LR is computed by ops inside the (jitted) step.
+"""
+import math
+
+from ..layer_helper import LayerHelper
+from .nn import autoincreased_step_counter, elementwise_div, elementwise_mul
+from . import tensor
+from . import ops
+from .control_flow import less_than, piecewise_select
+from .nn import where
+
+
+def _decay_step_counter(begin=0):
+    counter = autoincreased_step_counter(
+        counter_name="@LR_DECAY_COUNTER@", begin=begin, step=1)
+    return tensor.cast(counter, "float32")
+
+
+def noam_decay(d_model, warmup_steps, learning_rate=1.0):
+    step = _decay_step_counter(begin=1)
+    a = ops.pow(step, -0.5)
+    b = step * (warmup_steps ** -1.5)
+    lr = (d_model ** -0.5) * elementwise_min_var(a, b)
+    return scale_lr(lr, learning_rate)
+
+
+def elementwise_min_var(a, b):
+    from .nn import elementwise_min
+    return elementwise_min(a, b)
+
+
+def scale_lr(lr, factor):
+    from .nn import scale as scale_layer
+    if factor == 1.0:
+        return lr
+    return scale_layer(lr, scale=float(factor))
+
+
+def exponential_decay(learning_rate, decay_steps, decay_rate, staircase=False):
+    step = _decay_step_counter()
+    div = step / float(decay_steps)
+    if staircase:
+        div = ops.floor(div)
+    return scale_lr(ops.exp(div * math.log(decay_rate)), learning_rate)
+
+
+def natural_exp_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    step = _decay_step_counter()
+    div = step / float(decay_steps)
+    if staircase:
+        div = ops.floor(div)
+    return scale_lr(ops.exp(div * (-decay_rate)), learning_rate)
+
+
+def inverse_time_decay(learning_rate, decay_steps, decay_rate,
+                       staircase=False):
+    step = _decay_step_counter()
+    div = step / float(decay_steps)
+    if staircase:
+        div = ops.floor(div)
+    denom = div * decay_rate + 1.0
+    one = tensor.fill_constant([1], "float32", 1.0)
+    return scale_lr(elementwise_div(one, denom), learning_rate)
+
+
+def polynomial_decay(learning_rate, decay_steps, end_learning_rate=0.0001,
+                     power=1.0, cycle=False):
+    step = _decay_step_counter()
+    if cycle:
+        div = ops.ceil(step / float(decay_steps))
+        from .nn import elementwise_max
+        one = tensor.fill_constant([1], "float32", 1.0)
+        div = elementwise_max(div, one)
+        decay_steps_var = div * float(decay_steps)
+        frac = step / decay_steps_var
+    else:
+        from .nn import elementwise_min
+        cap = tensor.fill_constant([1], "float32", float(decay_steps))
+        step = elementwise_min(step, cap)
+        frac = step / float(decay_steps)
+    base = (1.0 - frac) ** power if power == 1.0 else None
+    one = tensor.fill_constant([1], "float32", 1.0)
+    pw = ops.pow(one - frac, factor=power)
+    return pw * (learning_rate - end_learning_rate) + end_learning_rate
+
+
+def piecewise_decay(boundaries, values):
+    if len(values) - len(boundaries) != 1:
+        raise ValueError("len(values) must be len(boundaries)+1")
+    step = autoincreased_step_counter(counter_name="@LR_DECAY_COUNTER@",
+                                      begin=0, step=1)
+    return piecewise_select(tensor.cast(step, "float32"),
+                            [float(b) for b in boundaries],
+                            [float(v) for v in values])
+
+
+def cosine_decay(learning_rate, step_each_epoch, epochs):
+    step = _decay_step_counter()
+    epoch = ops.floor(step / float(step_each_epoch))
+    return learning_rate * 0.5 * (ops.cos(epoch * (math.pi / epochs)) + 1.0)
+
+
+def linear_lr_warmup(learning_rate, warmup_steps, start_lr, end_lr):
+    step = _decay_step_counter()
+    from ..framework.program import Variable
+    if not isinstance(learning_rate, Variable):
+        learning_rate = tensor.fill_constant([1], "float32",
+                                             float(learning_rate))
+    warm = float(start_lr) + (float(end_lr) - float(start_lr)) * \
+        (step / float(warmup_steps))
+    return where(less_than(step, float(warmup_steps)), warm, learning_rate)

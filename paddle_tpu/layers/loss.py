@@ -1,0 +1,139 @@
+"""Loss layers.
+
+Reference parity: python/paddle/fluid/layers/loss.py.
+"""
+from ..layer_helper import LayerHelper
+
+
+def cross_entropy(input, label, soft_label=False, ignore_index=-100):
+    helper = LayerHelper("cross_entropy")
+    shape = tuple(input.shape[:-1]) + (1,) if input.shape else None
+    out = helper.create_variable_for_type_inference(input.dtype, shape)
+    helper.append_op("cross_entropy",
+                     inputs={"X": [input.name], "Label": [label.name]},
+                     outputs={"Y": [out.name]},
+                     attrs={"soft_label": soft_label,
+                            "ignore_index": ignore_index})
+    return out
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    helper = LayerHelper("softmax_with_cross_entropy")
+    softmax = helper.create_variable_for_type_inference(logits.dtype,
+                                                        logits.shape)
+    loss_shape = None
+    if logits.shape is not None:
+        loss_shape = list(logits.shape)
+        loss_shape[axis] = 1
+        loss_shape = tuple(loss_shape)
+    loss = helper.create_variable_for_type_inference(logits.dtype, loss_shape)
+    helper.append_op(
+        "softmax_with_cross_entropy",
+        inputs={"Logits": [logits.name], "Label": [label.name]},
+        outputs={"Softmax": [softmax.name], "Loss": [loss.name]},
+        attrs={"soft_label": soft_label, "ignore_index": ignore_index,
+               "axis": axis})
+    if return_softmax:
+        return loss, softmax
+    return loss
+
+
+def square_error_cost(input, label):
+    helper = LayerHelper("square_error_cost")
+    out = helper.create_variable_for_type_inference(input.dtype, input.shape)
+    helper.append_op("square_error_cost",
+                     inputs={"X": [input.name], "Y": [label.name]},
+                     outputs={"Out": [out.name]})
+    return out
+
+
+def sigmoid_cross_entropy_with_logits(x, label, ignore_index=-100, name=None,
+                                      normalize=False):
+    helper = LayerHelper("sigmoid_cross_entropy_with_logits", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype, x.shape)
+    helper.append_op("sigmoid_cross_entropy_with_logits",
+                     inputs={"X": [x.name], "Label": [label.name]},
+                     outputs={"Out": [out.name]},
+                     attrs={"ignore_index": ignore_index,
+                            "normalize": normalize})
+    return out
+
+
+def smooth_l1(x, y, inside_weight=None, outside_weight=None, sigma=None):
+    helper = LayerHelper("smooth_l1_loss")
+    inputs = {"X": [x.name], "Y": [y.name]}
+    if inside_weight is not None:
+        inputs["InsideWeight"] = [inside_weight.name]
+    if outside_weight is not None:
+        inputs["OutsideWeight"] = [outside_weight.name]
+    loss = helper.create_variable_for_type_inference(x.dtype)
+    diff = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("smooth_l1_loss", inputs=inputs,
+                     outputs={"Out": [loss.name], "Diff": [diff.name]},
+                     attrs={"sigma": sigma or 1.0})
+    return loss
+
+
+def huber_loss(input, label, delta):
+    helper = LayerHelper("huber_loss")
+    loss = helper.create_variable_for_type_inference(input.dtype)
+    resid = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("huber_loss",
+                     inputs={"X": [input.name], "Y": [label.name]},
+                     outputs={"Out": [loss.name], "Residual": [resid.name]},
+                     attrs={"delta": float(delta)})
+    return loss
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    helper = LayerHelper("log_loss", name=name)
+    loss = helper.create_variable_for_type_inference(input.dtype, input.shape)
+    helper.append_op("log_loss",
+                     inputs={"Predicted": [input.name],
+                             "Labels": [label.name]},
+                     outputs={"Loss": [loss.name]},
+                     attrs={"epsilon": float(epsilon)})
+    return loss
+
+
+def kldiv_loss(x, target, reduction="mean", name=None):
+    helper = LayerHelper("kldiv_loss", name=name)
+    loss = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("kldiv_loss",
+                     inputs={"X": [x.name], "Target": [target.name]},
+                     outputs={"Loss": [loss.name]},
+                     attrs={"reduction": reduction})
+    return loss
+
+
+def bpr_loss(input, label, name=None):
+    helper = LayerHelper("bpr_loss", name=name)
+    shape = (input.shape[0], 1) if input.shape else None
+    out = helper.create_variable_for_type_inference(input.dtype, shape)
+    helper.append_op("bpr_loss",
+                     inputs={"X": [input.name], "Label": [label.name]},
+                     outputs={"Y": [out.name]})
+    return out
+
+
+def margin_rank_loss(label, left, right, margin=0.1, name=None):
+    helper = LayerHelper("margin_rank_loss", name=name)
+    out = helper.create_variable_for_type_inference(left.dtype, left.shape)
+    act = helper.create_variable_for_type_inference(left.dtype, left.shape)
+    helper.append_op("margin_rank_loss",
+                     inputs={"X1": [left.name], "X2": [right.name],
+                             "Label": [label.name]},
+                     outputs={"Out": [out.name], "Activated": [act.name]},
+                     attrs={"margin": float(margin)})
+    return out
+
+
+def mse_loss(input, label):
+    helper = LayerHelper("mse_loss")
+    out = helper.create_variable_for_type_inference(input.dtype, input.shape)
+    helper.append_op("mse_loss",
+                     inputs={"Input": [input.name], "Label": [label.name]},
+                     outputs={"Out": [out.name]})
+    return out

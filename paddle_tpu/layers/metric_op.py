@@ -1,0 +1,48 @@
+"""Metric layers (accuracy, auc).
+
+Reference parity: python/paddle/fluid/layers/metric_op.py.
+"""
+from ..layer_helper import LayerHelper
+from ..initializer import ConstantInitializer
+from .nn import topk
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    helper = LayerHelper("accuracy")
+    values, indices = topk(input, k=k)
+    acc = helper.create_variable_for_type_inference("float32", (1,))
+    if correct is None:
+        correct = helper.create_variable_for_type_inference("int32", (1,))
+    if total is None:
+        total = helper.create_variable_for_type_inference("int32", (1,))
+    helper.append_op(
+        "accuracy",
+        inputs={"Out": [values.name], "Indices": [indices.name],
+                "Label": [label.name]},
+        outputs={"Accuracy": [acc.name], "Correct": [correct.name],
+                 "Total": [total.name]})
+    acc.stop_gradient = True
+    return acc
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1):
+    helper = LayerHelper("auc")
+    stat_pos = helper.create_or_get_global_variable(
+        name=helper.name + "_stat_pos", dtype="int64",
+        shape=(num_thresholds + 1,), persistable=True)
+    helper.set_variable_initializer(stat_pos, ConstantInitializer(0.0))
+    stat_neg = helper.create_or_get_global_variable(
+        name=helper.name + "_stat_neg", dtype="int64",
+        shape=(num_thresholds + 1,), persistable=True)
+    helper.set_variable_initializer(stat_neg, ConstantInitializer(0.0))
+    auc_out = helper.create_variable_for_type_inference("float32", (1,))
+    helper.append_op(
+        "auc",
+        inputs={"Predict": [input.name], "Label": [label.name],
+                "StatPos": [stat_pos.name], "StatNeg": [stat_neg.name]},
+        outputs={"AUC": [auc_out.name], "StatPosOut": [stat_pos.name],
+                 "StatNegOut": [stat_neg.name]},
+        attrs={"num_thresholds": num_thresholds, "curve": curve})
+    auc_out.stop_gradient = True
+    return auc_out, [stat_pos, stat_neg]

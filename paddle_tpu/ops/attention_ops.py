@@ -1,0 +1,49 @@
+"""Attention op kernels.
+
+Reference parity: the reference composes attention from matmul/softmax ops
+(e.g. PaddlePaddle/models transformer, fluid nets.scaled_dot_product_attention).
+TPU-native: one fused op so XLA keeps QK^T / softmax / PV in registers, plus
+a Pallas flash-attention path (ops/pallas/) for long sequences that tiles the
+computation through VMEM without materializing the (T,T) scores in HBM.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+
+def _sdpa_xla(q, k, v, mask, scale, causal):
+    # q,k,v: (B, H, T, D)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        tq, tk = logits.shape[-2], logits.shape[-1]
+        cm = jnp.tril(jnp.ones((tq, tk), bool), tk - tq)
+        logits = jnp.where(cm, logits, -1e30)
+    if mask is not None:
+        logits = logits + mask.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v)
+
+
+@register_op("scaled_dot_product_attention")
+def _sdpa(ctx, ins, attrs):
+    q, k, v = ins["Q"][0], ins["K"][0], ins["V"][0]
+    mask = ins["Mask"][0] if ins.get("Mask") else None
+    scale = attrs.get("scale", None)
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    causal = attrs.get("causal", False)
+    impl = attrs.get("impl", "auto")
+    if impl in ("auto", "flash"):
+        try:
+            from .pallas.flash_attention import flash_attention
+            out = flash_attention(q, k, v, mask=mask, scale=scale,
+                                  causal=causal)
+            return {"Out": out}
+        except Exception:
+            if impl == "flash":
+                raise
+    return {"Out": _sdpa_xla(q, k, v, mask, scale, causal)}

@@ -1,0 +1,97 @@
+"""Collective communication op kernels.
+
+Reference parity: paddle/fluid/operators/collective/{c_allreduce_*,
+c_allgather,c_reducescatter,c_broadcast}.cc (NCCL). TPU-native: XLA
+collectives (lax.psum/all_gather/psum_scatter/ppermute) over the ICI mesh.
+
+These kernels are meaningful when traced under shard_map with a bound mesh
+axis (paddle_tpu.distributed). Single-device traces degrade to identity, so
+the same program runs anywhere — mirroring the reference where ring_id 0 on
+one rank is a no-op.
+"""
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op
+
+
+def _axis(ctx, attrs):
+    """Axis name for the collective; None → not inside shard_map → no-op."""
+    name = attrs.get("axis_name", "dp")
+    bound = getattr(ctx, "bound_axes", ())
+    return name if name in bound else None
+
+
+def _make_allreduce(op_name, reduce_fn):
+    @register_op(op_name, differentiable=True)
+    def _kernel(ctx, ins, attrs, _fn=reduce_fn):
+        x = ins["X"][0]
+        ax = _axis(ctx, attrs)
+        return {"Out": x if ax is None else _fn(x, ax)}
+    return _kernel
+
+
+_make_allreduce("c_allreduce_sum", lax.psum)
+_make_allreduce("c_allreduce_max", lax.pmax)
+_make_allreduce("c_allreduce_min", lax.pmin)
+_make_allreduce("c_allreduce_prod",
+                lambda x, ax: jnp.exp(lax.psum(jnp.log(x), ax)))
+
+
+@register_op("c_allgather")
+def _c_allgather(ctx, ins, attrs):
+    x = ins["X"][0]
+    ax = _axis(ctx, attrs)
+    if ax is None:
+        return {"Out": x}
+    return {"Out": lax.all_gather(x, ax, axis=0, tiled=True)}
+
+
+@register_op("c_reducescatter")
+def _c_reducescatter(ctx, ins, attrs):
+    x = ins["X"][0]
+    ax = _axis(ctx, attrs)
+    if ax is None:
+        return {"Out": x}
+    return {"Out": lax.psum_scatter(x, ax, scatter_dimension=0, tiled=True)}
+
+
+@register_op("c_broadcast")
+def _c_broadcast(ctx, ins, attrs):
+    x = ins["X"][0]
+    ax = _axis(ctx, attrs)
+    if ax is None:
+        return {"Out": x}
+    root = attrs.get("root", 0)
+    idx = lax.axis_index(ax)
+    masked = jnp.where(idx == root, x, jnp.zeros_like(x))
+    return {"Out": lax.psum(masked, ax)}
+
+
+@register_op("c_sync_comm_stream")
+def _c_sync(ctx, ins, attrs):
+    # XLA orders collectives itself; kept for program parity.
+    return {"Out": list(ins["X"])}
+
+
+@register_op("barrier", differentiable=False)
+def _barrier(ctx, ins, attrs):
+    x = ins["X"][0]
+    ax = _axis(ctx, attrs)
+    if ax is None:
+        return {"Out": x}
+    return {"Out": x + 0 * lax.psum(jnp.zeros((), x.dtype), ax)}
+
+
+@register_op("ppermute")
+def _ppermute(ctx, ins, attrs):
+    """Ring shift (building block of ring attention / pipeline parallel)."""
+    x = ins["X"][0]
+    ax = _axis(ctx, attrs)
+    if ax is None:
+        return {"Out": x}
+    n = lax.axis_size(ax)
+    shift = attrs.get("shift", 1)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return {"Out": lax.ppermute(x, ax, perm)}

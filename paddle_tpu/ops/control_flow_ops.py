@@ -1,0 +1,113 @@
+"""Control-flow op kernels: cond / while_loop via lax.
+
+Reference parity: paddle/fluid/operators/controlflow/{conditional_block_op,
+while_op}.cc + python layers/control_flow.py. The reference executes
+sub-blocks with a nested executor on the host; here sub-blocks are traced
+into lax.cond / lax.while_loop so control flow stays ON DEVICE inside the
+single compiled step — no host round-trips (the TPU-idiomatic form).
+
+Round-1 limitation (documented): gradients do not flow through cond/while
+(reference backward-through-While parity tracked in SURVEY §2.3); recurrent
+models use the differentiable ``recurrent_scan`` op instead (lax.scan).
+"""
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op
+
+
+def _branch_fn(ctx, block, out_names, env_snapshot):
+    def fn(_):
+        local = dict(env_snapshot)
+        ctx.trace_block(block, local)
+        return tuple(local[n] for n in out_names)
+    return fn
+
+
+@register_op("cond", uses_subblock=True, nondiff=("Cond",),
+             differentiable=False)
+def _cond(ctx, ins, attrs):
+    pred = ins["Cond"][0].reshape(())
+    program = ctx.program
+    tb = program.block(attrs["true_block"])
+    fb = program.block(attrs["false_block"])
+    env = dict(ctx.outer_env)  # snapshot; lax.cond closes over tracers
+    outs = lax.cond(pred,
+                    _branch_fn(ctx, tb, attrs["true_out_names"], env),
+                    _branch_fn(ctx, fb, attrs["false_out_names"], env),
+                    operand=0)
+    return {"Out": list(outs)}
+
+
+@register_op("while_loop", uses_subblock=True, nondiff=("LoopVars",),
+             differentiable=False)
+def _while_loop(ctx, ins, attrs):
+    program = ctx.program
+    cond_block = program.block(attrs["cond_block"])
+    body_block = program.block(attrs["body_block"])
+    var_names = attrs["loop_var_names"]
+    cond_out = attrs["cond_out_name"]
+    env = dict(ctx.outer_env)
+
+    def cond_fn(vals):
+        local = dict(env)
+        local.update(zip(var_names, vals))
+        ctx.trace_block(cond_block, local)
+        return local[cond_out].reshape(())
+
+    def body_fn(vals):
+        local = dict(env)
+        local.update(zip(var_names, vals))
+        ctx.trace_block(body_block, local)
+        return tuple(local[n] for n in var_names)
+
+    outs = lax.while_loop(cond_fn, body_fn, tuple(ins["LoopVars"]))
+    return {"Out": list(outs)}
+
+
+@register_op("recurrent_scan", uses_subblock=True)
+def _recurrent_scan(ctx, ins, attrs):
+    """Differentiable recurrence: lax.scan over a sub-block step function.
+
+    inputs:  Seq    — per-step sequences, scanned over axis `time_axis` (=0)
+             Init   — initial carry values
+             Extra  — loop-invariant captures (weights etc.)
+    The sub-block reads vars named attrs[seq_var_names][i] (current step
+    slice), attrs[carry_var_names][i], attrs[extra_var_names][i] and must
+    define attrs[carry_out_names] and attrs[step_out_names].
+    Grad support comes for free: the whole kernel is differentiable, so the
+    generic vjp grad op handles BPTT (reference: recurrent_op.cc backward).
+    """
+    program = ctx.program
+    block = program.block(attrs["sub_block"])
+    seqs = ins.get("Seq", [])
+    init = ins.get("Init", [])
+    extra = ins.get("Extra", [])
+    seq_names = attrs.get("seq_var_names", [])
+    carry_names = attrs.get("carry_var_names", [])
+    extra_names = attrs.get("extra_var_names", [])
+    carry_out = attrs.get("carry_out_names", [])
+    step_out = attrs.get("step_out_names", [])
+    reverse = attrs.get("is_reverse", False)
+
+    def step(carry, xs):
+        local = dict(zip(extra_names, extra))
+        local.update(zip(carry_names, carry))
+        local.update(zip(seq_names, xs))
+        ctx.trace_block(block, local)
+        new_carry = tuple(local[n] for n in carry_out)
+        outs = tuple(local[n] for n in step_out)
+        return new_carry, outs
+
+    carry, ys = lax.scan(step, tuple(init), tuple(seqs), reverse=reverse)
+    return {"FinalCarry": list(carry), "SeqOut": list(ys)}
+
+
+@register_op("select_input", nondiff=("Mask",))
+def _select_input(ctx, ins, attrs):
+    mask = ins["Mask"][0].reshape(()).astype(jnp.int32)
+    xs = ins["X"]
+    out = xs[0]
+    for i, x in enumerate(xs[1:], 1):
+        out = lax.select(mask == i, x, out)
+    return {"Out": out}

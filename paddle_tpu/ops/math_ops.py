@@ -1,0 +1,332 @@
+"""Math / elementwise / reduction / activation op kernels.
+
+Reference parity: paddle/fluid/operators/{activation_op,elementwise/*,
+reduce_ops/*,matmul_op,mul_op,sum_op,scale_op,clip_op,cast_op,...}.cc — each
+reference op has CPU+CUDA kernels; here each is one pure JAX function that XLA
+fuses/tiles for the TPU MXU/VPU.
+"""
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op
+
+
+def _x(ins, slot="X"):
+    return ins[slot][0]
+
+
+# ---------------------------------------------------------------------------
+# elementwise binary ops with fluid axis-broadcast semantics
+# (reference: operators/elementwise/elementwise_op_function.h)
+# ---------------------------------------------------------------------------
+
+def _bcast(x, y, axis):
+    if x.ndim == y.ndim:
+        return x, y
+    if y.ndim > x.ndim:   # fluid requires rank(X) >= rank(Y); be permissive
+        x, y = y, x
+        x, y = _bcast(x, y, axis)
+        return y, x
+    if axis is None or axis == -1:
+        axis = x.ndim - y.ndim
+    new_shape = (1,) * axis + y.shape + (1,) * (x.ndim - axis - y.ndim)
+    return x, y.reshape(new_shape)
+
+
+def _elementwise(fn):
+    def kernel(ctx, ins, attrs):
+        x, y = _bcast(ins["X"][0], ins["Y"][0], attrs.get("axis", -1))
+        return {"Out": fn(x, y)}
+    return kernel
+
+
+for _name, _fn in [
+        ("elementwise_add", jnp.add),
+        ("elementwise_sub", jnp.subtract),
+        ("elementwise_mul", jnp.multiply),
+        ("elementwise_div", jnp.divide),
+        ("elementwise_max", jnp.maximum),
+        ("elementwise_min", jnp.minimum),
+        ("elementwise_pow", jnp.power),
+        ("elementwise_mod", jnp.mod),
+        ("elementwise_floordiv", jnp.floor_divide),
+]:
+    register_op(_name)(_elementwise(_fn))
+
+
+# ---------------------------------------------------------------------------
+# activations (reference: operators/activation_op.cc ~40 kernels)
+# ---------------------------------------------------------------------------
+
+def _act(fn):
+    def kernel(ctx, ins, attrs):
+        return {"Out": fn(_x(ins), attrs)}
+    return kernel
+
+
+_ACTIVATIONS = {
+    "relu": lambda x, a: jax.nn.relu(x),
+    "relu6": lambda x, a: jnp.clip(x, 0.0, a.get("threshold", 6.0)),
+    "sigmoid": lambda x, a: jax.nn.sigmoid(x),
+    "logsigmoid": lambda x, a: jax.nn.log_sigmoid(x),
+    "tanh": lambda x, a: jnp.tanh(x),
+    "tanh_shrink": lambda x, a: x - jnp.tanh(x),
+    "softplus": lambda x, a: jax.nn.softplus(x),
+    "softsign": lambda x, a: jax.nn.soft_sign(x),
+    "exp": lambda x, a: jnp.exp(x),
+    "log": lambda x, a: jnp.log(x),
+    "sqrt": lambda x, a: jnp.sqrt(x),
+    "rsqrt": lambda x, a: lax.rsqrt(x),
+    "square": lambda x, a: jnp.square(x),
+    "abs": lambda x, a: jnp.abs(x),
+    "ceil": lambda x, a: jnp.ceil(x),
+    "floor": lambda x, a: jnp.floor(x),
+    "round": lambda x, a: jnp.round(x),
+    "reciprocal": lambda x, a: 1.0 / x,
+    "sin": lambda x, a: jnp.sin(x),
+    "cos": lambda x, a: jnp.cos(x),
+    "acos": lambda x, a: jnp.arccos(x),
+    "asin": lambda x, a: jnp.arcsin(x),
+    "atan": lambda x, a: jnp.arctan(x),
+    "erf": lambda x, a: jax.scipy.special.erf(x),
+    "gelu": lambda x, a: jax.nn.gelu(x, approximate=a.get("approximate",
+                                                          False)),
+    "leaky_relu": lambda x, a: jax.nn.leaky_relu(
+        x, negative_slope=a.get("alpha", 0.02)),
+    "elu": lambda x, a: jax.nn.elu(x, alpha=a.get("alpha", 1.0)),
+    "selu": lambda x, a: a.get("scale", 1.0507009873554805) * jnp.where(
+        x > 0, x, a.get("alpha", 1.6732632423543772) * (jnp.exp(x) - 1)),
+    "swish": lambda x, a: x * jax.nn.sigmoid(a.get("beta", 1.0) * x),
+    "hard_sigmoid": lambda x, a: jnp.clip(
+        a.get("slope", 0.2) * x + a.get("offset", 0.5), 0.0, 1.0),
+    "hard_swish": lambda x, a: x * jnp.clip(
+        x + a.get("offset", 3.0), 0.0, a.get("threshold", 6.0)) /
+        a.get("scale", 6.0),
+    "hard_shrink": lambda x, a: jnp.where(
+        jnp.abs(x) > a.get("threshold", 0.5), x, 0.0),
+    "softshrink": lambda x, a: jnp.sign(x) * jax.nn.relu(
+        jnp.abs(x) - a.get("lambda", 0.5)),
+    "thresholded_relu": lambda x, a: jnp.where(
+        x > a.get("threshold", 1.0), x, 0.0),
+    "brelu": lambda x, a: jnp.clip(x, a.get("t_min", 0.0),
+                                   a.get("t_max", 24.0)),
+    "soft_relu": lambda x, a: jnp.log(
+        1 + jnp.exp(jnp.clip(x, -a.get("threshold", 40.0),
+                             a.get("threshold", 40.0)))),
+    "stanh": lambda x, a: a.get("scale_b", 1.7159) * jnp.tanh(
+        a.get("scale_a", 0.67) * x),
+    "sign": lambda x, a: jnp.sign(x),
+    "log1p": lambda x, a: jnp.log1p(x),
+    "expm1": lambda x, a: jnp.expm1(x),
+    "silu": lambda x, a: jax.nn.silu(x),
+    "mish": lambda x, a: x * jnp.tanh(jax.nn.softplus(x)),
+}
+
+for _name, _fn in _ACTIVATIONS.items():
+    register_op(_name)(_act(_fn))
+
+
+@register_op("pow")
+def _pow(ctx, ins, attrs):
+    return {"Out": jnp.power(_x(ins), attrs.get("factor", 1.0))}
+
+
+@register_op("scale")
+def _scale(ctx, ins, attrs):
+    x = _x(ins)
+    scale = attrs.get("scale", 1.0)
+    bias = attrs.get("bias", 0.0)
+    if attrs.get("bias_after_scale", True):
+        return {"Out": x * scale + bias}
+    return {"Out": (x + bias) * scale}
+
+
+@register_op("clip")
+def _clip(ctx, ins, attrs):
+    return {"Out": jnp.clip(_x(ins), attrs["min"], attrs["max"])}
+
+
+@register_op("clip_by_norm")
+def _clip_by_norm(ctx, ins, attrs):
+    x = _x(ins)
+    max_norm = attrs["max_norm"]
+    norm = jnp.sqrt(jnp.sum(jnp.square(x)))
+    return {"Out": x * (max_norm / jnp.maximum(norm, max_norm))}
+
+
+@register_op("squared_l2_norm")
+def _squared_l2_norm(ctx, ins, attrs):
+    return {"Out": jnp.sum(jnp.square(_x(ins))).reshape(())}
+
+
+@register_op("cast")
+def _cast(ctx, ins, attrs):
+    from ..framework.dtypes import to_jax_dtype
+    return {"Out": _x(ins).astype(to_jax_dtype(attrs["out_dtype"]))}
+
+
+@register_op("sum")
+def _sum(ctx, ins, attrs):
+    xs = ins["X"]
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return {"Out": out}
+
+
+@register_op("mean")
+def _mean(ctx, ins, attrs):
+    return {"Out": jnp.mean(_x(ins)).reshape((1,))}
+
+
+# ---------------------------------------------------------------------------
+# matmul / mul (reference: matmul_op.cc, mul_op.cc — MXU territory)
+# ---------------------------------------------------------------------------
+
+@register_op("matmul")
+def _matmul(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    tx, ty = attrs.get("transpose_X", False), attrs.get("transpose_Y", False)
+    alpha = attrs.get("alpha", 1.0)
+    if x.ndim == 1:
+        x = x[None, :]
+    if y.ndim == 1:
+        y = y[:, None]
+    if tx:
+        x = jnp.swapaxes(x, -1, -2)
+    if ty:
+        y = jnp.swapaxes(y, -1, -2)
+    out = jnp.matmul(x, y)
+    if alpha != 1.0:
+        out = out * alpha
+    return {"Out": out}
+
+
+@register_op("mul")
+def _mul(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    xn = attrs.get("x_num_col_dims", 1)
+    yn = attrs.get("y_num_col_dims", 1)
+    xs, ys = x.shape, y.shape
+    x2 = x.reshape((-1, math.prod(xs[xn:])))
+    y2 = y.reshape((math.prod(ys[:yn]), -1))
+    out = jnp.matmul(x2, y2)
+    return {"Out": out.reshape(xs[:xn] + ys[yn:])}
+
+
+# ---------------------------------------------------------------------------
+# reductions (reference: operators/reduce_ops/*)
+# ---------------------------------------------------------------------------
+
+def _reduce(fn):
+    def kernel(ctx, ins, attrs):
+        x = _x(ins)
+        dims = attrs.get("dim", [0])
+        if attrs.get("reduce_all", False) or dims is None:
+            axes = tuple(range(x.ndim))
+        else:
+            if not isinstance(dims, (list, tuple)):
+                dims = [dims]
+            axes = tuple(d % x.ndim for d in dims)
+        return {"Out": fn(x, axis=axes,
+                          keepdims=attrs.get("keep_dim", False))}
+    return kernel
+
+
+for _name, _fn in [
+        ("reduce_sum", jnp.sum), ("reduce_mean", jnp.mean),
+        ("reduce_max", jnp.max), ("reduce_min", jnp.min),
+        ("reduce_prod", jnp.prod),
+        ("reduce_all", jnp.all), ("reduce_any", jnp.any),
+]:
+    register_op(_name)(_reduce(_fn))
+
+
+@register_op("logsumexp")
+def _logsumexp(ctx, ins, attrs):
+    x = _x(ins)
+    dims = attrs.get("dim", None)
+    axes = tuple(d % x.ndim for d in dims) if dims else None
+    return {"Out": jax.scipy.special.logsumexp(
+        x, axis=axes, keepdims=attrs.get("keep_dim", False))}
+
+
+@register_op("cumsum")
+def _cumsum(ctx, ins, attrs):
+    x = _x(ins)
+    axis = attrs.get("axis", -1)
+    if attrs.get("flatten", False):
+        x = x.reshape(-1)
+        axis = 0
+    out = jnp.cumsum(x, axis=axis)
+    if attrs.get("exclusive", False):
+        out = out - x
+    if attrs.get("reverse", False):
+        out = jnp.flip(jnp.cumsum(jnp.flip(x, axis), axis=axis), axis)
+        if attrs.get("exclusive", False):
+            out = out - x
+    return {"Out": out}
+
+
+# ---------------------------------------------------------------------------
+# comparison / logical (reference: operators/controlflow/compare_op.cc)
+# ---------------------------------------------------------------------------
+
+def _compare(fn):
+    def kernel(ctx, ins, attrs):
+        x, y = _bcast(ins["X"][0], ins["Y"][0], attrs.get("axis", -1))
+        return {"Out": fn(x, y)}
+    return kernel
+
+
+for _name, _fn in [
+        ("less_than", jnp.less), ("less_equal", jnp.less_equal),
+        ("greater_than", jnp.greater), ("greater_equal", jnp.greater_equal),
+        ("equal", jnp.equal), ("not_equal", jnp.not_equal),
+]:
+    register_op(_name)(_compare(_fn))
+
+for _name, _fn in [
+        ("logical_and", jnp.logical_and), ("logical_or", jnp.logical_or),
+        ("logical_xor", jnp.logical_xor),
+]:
+    register_op(_name)(_compare(_fn))
+
+
+@register_op("logical_not")
+def _logical_not(ctx, ins, attrs):
+    return {"Out": jnp.logical_not(_x(ins))}
+
+
+@register_op("isfinite")
+def _isfinite(ctx, ins, attrs):
+    return {"Out": jnp.all(jnp.isfinite(_x(ins))).reshape((1,))}
+
+
+@register_op("isnan")
+def _isnan(ctx, ins, attrs):
+    return {"Out": jnp.isnan(_x(ins))}
+
+
+@register_op("isinf")
+def _isinf(ctx, ins, attrs):
+    return {"Out": jnp.isinf(_x(ins))}
+
+
+@register_op("maximum")
+def _maximum(ctx, ins, attrs):
+    return {"Out": jnp.maximum(ins["X"][0], ins["Y"][0])}
+
+
+@register_op("minimum")
+def _minimum(ctx, ins, attrs):
+    return {"Out": jnp.minimum(ins["X"][0], ins["Y"][0])}
+
+
+@register_op("dot")
+def _dot(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    return {"Out": jnp.sum(x * y, axis=-1, keepdims=True)}

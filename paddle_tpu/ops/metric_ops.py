@@ -1,0 +1,54 @@
+"""Metric op kernels (accuracy, auc).
+
+Reference parity: paddle/fluid/operators/metrics/{accuracy_op,auc_op}.cc.
+"""
+import jax.numpy as jnp
+
+from .registry import register_op
+
+
+@register_op("accuracy", nondiff=("Out", "Indices", "Label"),
+             differentiable=False)
+def _accuracy(ctx, ins, attrs):
+    indices = ins["Indices"][0]          # (N, k) top-k indices
+    label = ins["Label"][0].reshape(-1, 1)
+    correct = jnp.any(indices == label, axis=1)
+    num_correct = jnp.sum(correct.astype(jnp.float32))
+    total = indices.shape[0]
+    return {"Accuracy": (num_correct / total).reshape((1,)),
+            "Correct": num_correct.astype(jnp.int32).reshape((1,)),
+            "Total": jnp.asarray([total], dtype=jnp.int32)}
+
+
+@register_op("auc", nondiff=("Predict", "Label", "StatPos", "StatNeg"),
+             differentiable=False)
+def _auc(ctx, ins, attrs):
+    """Streaming AUC with binned positive/negative histograms, matching the
+    reference auc_op's bucket algorithm."""
+    predict = ins["Predict"][0]
+    label = ins["Label"][0].reshape(-1)
+    stat_pos = ins["StatPos"][0]
+    stat_neg = ins["StatNeg"][0]
+    num_thresholds = attrs.get("num_thresholds", 4095)
+    score = predict[:, -1] if predict.ndim == 2 else predict.reshape(-1)
+    idx = jnp.clip((score * num_thresholds).astype(jnp.int32), 0,
+                   num_thresholds)
+    pos = jnp.zeros_like(stat_pos).at[idx].add(
+        (label > 0).astype(stat_pos.dtype))
+    neg = jnp.zeros_like(stat_neg).at[idx].add(
+        (label <= 0).astype(stat_neg.dtype))
+    stat_pos = stat_pos + pos
+    stat_neg = stat_neg + neg
+    # integrate: sum over bins from high to low threshold
+    tp = jnp.cumsum(stat_pos[::-1])[::-1].astype(jnp.float64)
+    fp = jnp.cumsum(stat_neg[::-1])[::-1].astype(jnp.float64)
+    tot_pos = tp[0]
+    tot_neg = fp[0]
+    # trapezoid over ROC points (appending origin)
+    tp_next = jnp.concatenate([tp[1:], jnp.zeros((1,), tp.dtype)])
+    fp_next = jnp.concatenate([fp[1:], jnp.zeros((1,), fp.dtype)])
+    area = jnp.sum((fp - fp_next) * (tp + tp_next) / 2.0)
+    auc = jnp.where((tot_pos > 0) & (tot_neg > 0),
+                    area / jnp.maximum(tot_pos * tot_neg, 1.0), 0.0)
+    return {"AUC": auc.astype(jnp.float32).reshape((1,)),
+            "StatPosOut": stat_pos, "StatNegOut": stat_neg}

@@ -1,0 +1,200 @@
+"""Optimizer update op kernels.
+
+Reference parity: paddle/fluid/operators/optimizers/{sgd_op,momentum_op,
+adam_op,adagrad_op,rmsprop_op,adamax_op,lamb_op,lars_momentum_op,ftrl_op,
+decayed_adagrad_op,...}.cc.
+
+These ops are appended by paddle_tpu.optimizer.*.minimize() and run INSIDE
+the same jitted step as forward/backward — XLA fuses the whole update, and
+because the Executor donates parameter buffers the update is in-place in HBM.
+All slot names match the reference so programs read identically.
+"""
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op
+
+
+def _p(ins, slot):
+    return ins[slot][0]
+
+
+@register_op("sgd")
+def _sgd(ctx, ins, attrs):
+    p, g, lr = _p(ins, "Param"), _p(ins, "Grad"), _p(ins, "LearningRate")
+    return {"ParamOut": p - lr.reshape(()).astype(p.dtype) * g}
+
+
+@register_op("momentum")
+def _momentum(ctx, ins, attrs):
+    p, g, v = _p(ins, "Param"), _p(ins, "Grad"), _p(ins, "Velocity")
+    lr = _p(ins, "LearningRate").reshape(()).astype(p.dtype)
+    mu = attrs["mu"]
+    v_new = mu * v + g
+    if attrs.get("use_nesterov", False):
+        p_new = p - (g + mu * v_new) * lr
+    else:
+        p_new = p - lr * v_new
+    return {"ParamOut": p_new, "VelocityOut": v_new}
+
+
+@register_op("lars_momentum")
+def _lars_momentum(ctx, ins, attrs):
+    p, g, v = _p(ins, "Param"), _p(ins, "Grad"), _p(ins, "Velocity")
+    lr = _p(ins, "LearningRate").reshape(()).astype(p.dtype)
+    mu = attrs["mu"]
+    coeff = attrs.get("lars_coeff", 0.001)
+    decay = attrs.get("lars_weight_decay", 0.0005)
+    eps = attrs.get("epsilon", 1e-9)
+    pn = jnp.sqrt(jnp.sum(jnp.square(p)))
+    gn = jnp.sqrt(jnp.sum(jnp.square(g)))
+    local_lr = jnp.where(pn > 0,
+                         lr * coeff * pn / (gn + decay * pn + eps), lr)
+    v_new = mu * v + local_lr * (g + decay * p)
+    return {"ParamOut": p - v_new, "VelocityOut": v_new}
+
+
+@register_op("adam")
+def _adam(ctx, ins, attrs):
+    p, g = _p(ins, "Param"), _p(ins, "Grad")
+    m1, m2 = _p(ins, "Moment1"), _p(ins, "Moment2")
+    b1p = _p(ins, "Beta1Pow").reshape(()).astype(jnp.float32)
+    b2p = _p(ins, "Beta2Pow").reshape(()).astype(jnp.float32)
+    lr = _p(ins, "LearningRate").reshape(()).astype(jnp.float32)
+    b1, b2 = attrs.get("beta1", 0.9), attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-8)
+    gf = g.astype(jnp.float32)
+    m1n = b1 * m1 + (1 - b1) * gf
+    m2n = b2 * m2 + (1 - b2) * gf * gf
+    lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
+    p_new = p.astype(jnp.float32) - lr_t * m1n / (jnp.sqrt(m2n) + eps)
+    return {"ParamOut": p_new.astype(p.dtype), "Moment1Out": m1n,
+            "Moment2Out": m2n,
+            "Beta1PowOut": (b1p * b1).reshape(ins["Beta1Pow"][0].shape),
+            "Beta2PowOut": (b2p * b2).reshape(ins["Beta2Pow"][0].shape)}
+
+
+@register_op("adamw")
+def _adamw(ctx, ins, attrs):
+    outs = _adam(ctx, ins, attrs)
+    coeff = attrs.get("coeff", 0.01)
+    lr = _p(ins, "LearningRate").reshape(()).astype(jnp.float32)
+    p = _p(ins, "Param")
+    outs["ParamOut"] = (outs["ParamOut"].astype(jnp.float32) -
+                        lr * coeff * p.astype(jnp.float32)).astype(p.dtype)
+    return outs
+
+
+@register_op("adagrad")
+def _adagrad(ctx, ins, attrs):
+    p, g, m = _p(ins, "Param"), _p(ins, "Grad"), _p(ins, "Moment")
+    lr = _p(ins, "LearningRate").reshape(()).astype(p.dtype)
+    eps = attrs.get("epsilon", 1e-6)
+    m_new = m + g * g
+    return {"ParamOut": p - lr * g / (jnp.sqrt(m_new) + eps),
+            "MomentOut": m_new}
+
+
+@register_op("decayed_adagrad")
+def _decayed_adagrad(ctx, ins, attrs):
+    p, g, m = _p(ins, "Param"), _p(ins, "Grad"), _p(ins, "Moment")
+    lr = _p(ins, "LearningRate").reshape(()).astype(p.dtype)
+    decay = attrs.get("decay", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    m_new = decay * m + (1 - decay) * g * g
+    return {"ParamOut": p - lr * g / (jnp.sqrt(m_new) + eps),
+            "MomentOut": m_new}
+
+
+@register_op("rmsprop")
+def _rmsprop(ctx, ins, attrs):
+    p, g = _p(ins, "Param"), _p(ins, "Grad")
+    ms, mom = _p(ins, "MeanSquare"), _p(ins, "Moment")
+    lr = _p(ins, "LearningRate").reshape(()).astype(p.dtype)
+    rho = attrs.get("decay", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    momentum = attrs.get("momentum", 0.0)
+    ms_new = rho * ms + (1 - rho) * g * g
+    if attrs.get("centered", False):
+        mg = _p(ins, "MeanGrad")
+        mg_new = rho * mg + (1 - rho) * g
+        mom_new = momentum * mom + lr * g / jnp.sqrt(
+            ms_new - mg_new * mg_new + eps)
+        return {"ParamOut": p - mom_new, "MeanSquareOut": ms_new,
+                "MomentOut": mom_new, "MeanGradOut": mg_new}
+    mom_new = momentum * mom + lr * g / jnp.sqrt(ms_new + eps)
+    return {"ParamOut": p - mom_new, "MeanSquareOut": ms_new,
+            "MomentOut": mom_new}
+
+
+@register_op("adamax")
+def _adamax(ctx, ins, attrs):
+    p, g = _p(ins, "Param"), _p(ins, "Grad")
+    m, inf = _p(ins, "Moment"), _p(ins, "InfNorm")
+    b1p = _p(ins, "Beta1Pow").reshape(()).astype(jnp.float32)
+    lr = _p(ins, "LearningRate").reshape(()).astype(p.dtype)
+    b1, b2 = attrs.get("beta1", 0.9), attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-8)
+    m_new = b1 * m + (1 - b1) * g
+    inf_new = jnp.maximum(b2 * inf, jnp.abs(g))
+    lr_t = lr / (1 - b1p)
+    return {"ParamOut": p - lr_t * m_new / (inf_new + eps),
+            "MomentOut": m_new, "InfNormOut": inf_new}
+
+
+@register_op("lamb")
+def _lamb(ctx, ins, attrs):
+    p, g = _p(ins, "Param"), _p(ins, "Grad")
+    m1, m2 = _p(ins, "Moment1"), _p(ins, "Moment2")
+    b1p = _p(ins, "Beta1Pow").reshape(()).astype(jnp.float32)
+    b2p = _p(ins, "Beta2Pow").reshape(()).astype(jnp.float32)
+    lr = _p(ins, "LearningRate").reshape(()).astype(jnp.float32)
+    b1, b2 = attrs.get("beta1", 0.9), attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-6)
+    wd = attrs.get("weight_decay", 0.01)
+    gf = g.astype(jnp.float32)
+    pf = p.astype(jnp.float32)
+    m1n = b1 * m1 + (1 - b1) * gf
+    m2n = b2 * m2 + (1 - b2) * gf * gf
+    m1h = m1n / (1 - b1p)
+    m2h = m2n / (1 - b2p)
+    r = m1h / (jnp.sqrt(m2h) + eps) + wd * pf
+    pn = jnp.sqrt(jnp.sum(pf * pf))
+    rn = jnp.sqrt(jnp.sum(r * r))
+    ratio = jnp.where((pn > 0) & (rn > 0), pn / rn, 1.0)
+    p_new = pf - lr * ratio * r
+    return {"ParamOut": p_new.astype(p.dtype), "Moment1Out": m1n,
+            "Moment2Out": m2n,
+            "Beta1PowOut": (b1p * b1).reshape(ins["Beta1Pow"][0].shape),
+            "Beta2PowOut": (b2p * b2).reshape(ins["Beta2Pow"][0].shape)}
+
+
+@register_op("ftrl")
+def _ftrl(ctx, ins, attrs):
+    p, g = _p(ins, "Param"), _p(ins, "Grad")
+    sq, lin = _p(ins, "SquaredAccumulator"), _p(ins, "LinearAccumulator")
+    lr = _p(ins, "LearningRate").reshape(()).astype(p.dtype)
+    l1 = attrs.get("l1", 0.0)
+    l2 = attrs.get("l2", 0.0)
+    power = attrs.get("lr_power", -0.5)
+    sq_new = sq + g * g
+    sigma = (jnp.power(sq_new, -power) - jnp.power(sq, -power)) / lr
+    lin_new = lin + g - sigma * p
+    quad = jnp.power(sq_new, -power) / lr + 2 * l2
+    pre = jnp.clip(lin_new, -l1, l1) - lin_new
+    p_new = jnp.where(jnp.abs(lin_new) > l1, pre / quad, 0.0)
+    return {"ParamOut": p_new, "SquaredAccumOut": sq_new,
+            "LinearAccumOut": lin_new}
+
+
+@register_op("dpsgd", uses_rng=True)
+def _dpsgd(ctx, ins, attrs):
+    import jax
+    p, g = _p(ins, "Param"), _p(ins, "Grad")
+    lr = _p(ins, "LearningRate").reshape(()).astype(p.dtype)
+    clip = attrs.get("clip", 10.0)
+    sigma = attrs.get("sigma", 1.0)
+    gn = jnp.sqrt(jnp.sum(jnp.square(g)))
+    g = g * jnp.minimum(1.0, clip / jnp.maximum(gn, 1e-12))
+    noise = sigma * clip * jax.random.normal(ctx.rng(), g.shape, g.dtype)
+    return {"ParamOut": p - lr * (g + noise)}

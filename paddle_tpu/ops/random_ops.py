@@ -1,0 +1,79 @@
+"""Random op kernels — stateless TPU-friendly PRNG.
+
+Reference parity: paddle/fluid/operators/{gaussian_random_op,
+uniform_random_op,truncated_gaussian_random_op,randint_op}.cc.
+The reference uses stateful per-device generators; here keys derive
+deterministically from (program.random_seed, step, op.desc_id) via
+threefry fold-ins (framework/trace.py), so results are reproducible and
+identical under any sharding.
+"""
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+from ..framework.dtypes import to_jax_dtype
+
+
+def _key(ctx, attrs):
+    seed = attrs.get("seed", 0)
+    if seed:
+        return jax.random.PRNGKey(seed)
+    return ctx.rng()
+
+
+@register_op("gaussian_random", uses_rng=True)
+def _gaussian_random(ctx, ins, attrs):
+    shape = tuple(attrs["shape"])
+    dtype = to_jax_dtype(attrs.get("dtype", "float32"))
+    out = attrs.get("mean", 0.0) + attrs.get("std", 1.0) * \
+        jax.random.normal(_key(ctx, attrs), shape, dtype=jnp.float32)
+    return {"Out": out.astype(dtype)}
+
+
+@register_op("uniform_random", uses_rng=True)
+def _uniform_random(ctx, ins, attrs):
+    shape = tuple(attrs["shape"])
+    dtype = to_jax_dtype(attrs.get("dtype", "float32"))
+    out = jax.random.uniform(_key(ctx, attrs), shape, dtype=jnp.float32,
+                             minval=attrs.get("min", -1.0),
+                             maxval=attrs.get("max", 1.0))
+    return {"Out": out.astype(dtype)}
+
+
+@register_op("truncated_gaussian_random", uses_rng=True)
+def _truncated_gaussian_random(ctx, ins, attrs):
+    shape = tuple(attrs["shape"])
+    dtype = to_jax_dtype(attrs.get("dtype", "float32"))
+    out = jax.random.truncated_normal(_key(ctx, attrs), -2.0, 2.0, shape,
+                                      dtype=jnp.float32)
+    out = attrs.get("mean", 0.0) + attrs.get("std", 1.0) * out
+    return {"Out": out.astype(dtype)}
+
+
+@register_op("randint", uses_rng=True)
+def _randint(ctx, ins, attrs):
+    shape = tuple(attrs["shape"])
+    dtype = to_jax_dtype(attrs.get("dtype", "int64"))
+    return {"Out": jax.random.randint(_key(ctx, attrs), shape,
+                                      attrs.get("low", 0),
+                                      attrs.get("high", 100)).astype(dtype)}
+
+
+@register_op("randperm", uses_rng=True)
+def _randperm(ctx, ins, attrs):
+    n = attrs["n"]
+    dtype = to_jax_dtype(attrs.get("dtype", "int64"))
+    return {"Out": jax.random.permutation(_key(ctx, attrs), n).astype(dtype)}
+
+
+@register_op("bernoulli", uses_rng=True)
+def _bernoulli(ctx, ins, attrs):
+    x = ins["X"][0]
+    return {"Out": jax.random.bernoulli(_key(ctx, attrs), x).astype(x.dtype)}
+
+
+@register_op("sampling_id", uses_rng=True, nondiff=("X",))
+def _sampling_id(ctx, ins, attrs):
+    x = ins["X"][0]  # (batch, num_classes) probabilities
+    return {"Out": jax.random.categorical(
+        _key(ctx, attrs), jnp.log(jnp.maximum(x, 1e-20)), axis=-1)}

@@ -1,0 +1,64 @@
+"""Operator registry: op type -> pure JAX kernel.
+
+Reference parity: paddle/fluid/framework/op_registry.h + op_info.cc. Where the
+reference registers per-device OpKernels (CPU Eigen / CUDA), we register ONE
+pure JAX function per op; XLA compiles it for TPU/CPU. Gradients need no
+per-op registration: the generic ``grad_of`` op (framework/trace.py) computes
+them with jax.vjp against the paired forward op — the TPU-native analogue of
+GradOpDescMaker.
+
+Kernel signature::
+
+    fn(ctx, ins, attrs) -> {out_slot: [jax.Array, ...]}
+
+  - ``ins``: dict slot -> list of jax.Arrays (slot order = OpDesc order)
+  - ``attrs``: JSON-able dict
+  - ``ctx``: trace context (ctx.rng() for PRNG keys, ctx.trace_block for
+    control-flow sub-blocks). Kernels MUST be pure given (ins, attrs, ctx
+    keys) — everything is traced under jax.jit.
+"""
+
+_REGISTRY = {}
+
+
+class OpDef(object):
+    __slots__ = ("type", "fn", "nondiff", "uses_rng", "uses_subblock",
+                 "differentiable")
+
+    def __init__(self, type, fn, nondiff=(), uses_rng=False,
+                 uses_subblock=False, differentiable=True):
+        self.type = type
+        self.fn = fn
+        # input slots excluded from differentiation (besides integer inputs,
+        # which jax.vjp already maps to float0 and we drop)
+        self.nondiff = tuple(nondiff)
+        self.uses_rng = uses_rng
+        self.uses_subblock = uses_subblock
+        self.differentiable = differentiable
+
+
+def register_op(type, nondiff=(), uses_rng=False, uses_subblock=False,
+                differentiable=True):
+    def deco(fn):
+        if type in _REGISTRY:
+            raise ValueError("op %r already registered" % type)
+        _REGISTRY[type] = OpDef(type, fn, nondiff, uses_rng, uses_subblock,
+                                differentiable)
+        return fn
+    return deco
+
+
+def get_op(type):
+    op = _REGISTRY.get(type)
+    if op is None:
+        raise NotImplementedError(
+            "op %r has no registered JAX kernel in paddle_tpu" % type)
+    return op
+
+
+def has_op(type):
+    return type in _REGISTRY
+
+
+def registered_ops():
+    return sorted(_REGISTRY)

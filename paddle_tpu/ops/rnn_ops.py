@@ -1,0 +1,115 @@
+"""Recurrent op kernels: LSTM / GRU over whole sequences via lax.scan.
+
+Reference parity: paddle/fluid/operators/{lstm_op,gru_op}.cc. The reference
+consumes LoD (ragged) sequences; the TPU-native design is batch-major dense
+(N, T, ...) with optional masks — static shapes so XLA can pipeline the scan
+across the MXU. Differentiable end-to-end (BPTT = vjp of lax.scan).
+
+Activations follow the reference attr names: gate/cell/candidate activation.
+"""
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op
+
+_ACT = {
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "relu": jax.nn.relu,
+    "identity": lambda x: x,
+}
+
+
+@register_op("lstm_seq")
+def _lstm_seq(ctx, ins, attrs):
+    """ins: Input (N,T,4H) — already projected by an fc (reference
+    dynamic_lstm takes the same pre-projected layout); Weight (H,4H)
+    recurrent weights; Bias (4H); optional H0/C0 (N,H).
+    outs: Hidden (N,T,H), Cell (N,T,H), LastH, LastC.
+    Gate order matches reference lstm_op: i, f, c(candidate), o."""
+    x = ins["Input"][0]
+    w = ins["Weight"][0]
+    n, t, h4 = x.shape
+    h = h4 // 4
+    bias = ins["Bias"][0].reshape(-1) if ins.get("Bias") else None
+    h0 = ins["H0"][0] if ins.get("H0") else jnp.zeros((n, h), x.dtype)
+    c0 = ins["C0"][0] if ins.get("C0") else jnp.zeros((n, h), x.dtype)
+    gate_act = _ACT[attrs.get("gate_activation", "sigmoid")]
+    cell_act = _ACT[attrs.get("cell_activation", "tanh")]
+    cand_act = _ACT[attrs.get("candidate_activation", "tanh")]
+
+    def step(carry, xt):
+        hp, cp = carry
+        gates = xt + hp @ w
+        if bias is not None:
+            gates = gates + bias
+        i, f, c_hat, o = jnp.split(gates, 4, axis=-1)
+        i, f, o = gate_act(i), gate_act(f), gate_act(o)
+        c = f * cp + i * cand_act(c_hat)
+        hh = o * cell_act(c)
+        return (hh, c), (hh, c)
+
+    xs = jnp.swapaxes(x, 0, 1)  # (T, N, 4H)
+    if attrs.get("is_reverse", False):
+        xs = jnp.flip(xs, 0)
+    (last_h, last_c), (hs, cs) = lax.scan(step, (h0, c0), xs)
+    if attrs.get("is_reverse", False):
+        hs, cs = jnp.flip(hs, 0), jnp.flip(cs, 0)
+    return {"Hidden": jnp.swapaxes(hs, 0, 1),
+            "Cell": jnp.swapaxes(cs, 0, 1),
+            "LastH": last_h, "LastC": last_c}
+
+
+@register_op("gru_seq")
+def _gru_seq(ctx, ins, attrs):
+    """ins: Input (N,T,3H) pre-projected; Weight (H,3H) recurrent
+    [update,reset | candidate]; optional Bias (3H), H0.
+    Gate math matches reference gru_op (gate_weight (H,2H) + state_weight
+    (H,H) concatenated)."""
+    x = ins["Input"][0]
+    w = ins["Weight"][0]
+    n, t, h3 = x.shape
+    h = h3 // 3
+    w_gate = w[:, :2 * h]
+    w_cand = w[:, 2 * h:]
+    bias = ins["Bias"][0].reshape(-1) if ins.get("Bias") else None
+    h0 = ins["H0"][0] if ins.get("H0") else jnp.zeros((n, h), x.dtype)
+    gate_act = _ACT[attrs.get("gate_activation", "sigmoid")]
+    cand_act = _ACT[attrs.get("activation", "tanh")]
+
+    def step(hp, xt):
+        if bias is not None:
+            xt = xt + bias
+        ur = gate_act(xt[:, :2 * h] + hp @ w_gate)
+        u, r = ur[:, :h], ur[:, h:]
+        c = cand_act(xt[:, 2 * h:] + (r * hp) @ w_cand)
+        hh = u * hp + (1 - u) * c
+        return hh, hh
+
+    xs = jnp.swapaxes(x, 0, 1)
+    if attrs.get("is_reverse", False):
+        xs = jnp.flip(xs, 0)
+    last_h, hs = lax.scan(step, h0, xs)
+    if attrs.get("is_reverse", False):
+        hs = jnp.flip(hs, 0)
+    return {"Hidden": jnp.swapaxes(hs, 0, 1), "LastH": last_h}
+
+
+@register_op("gru_unit")
+def _gru_unit(ctx, ins, attrs):
+    """Single GRU step (reference gru_unit_op.cc)."""
+    x = ins["Input"][0]          # (N, 3H)
+    hp = ins["HiddenPrev"][0]    # (N, H)
+    w = ins["Weight"][0]         # (H, 3H)
+    h = hp.shape[-1]
+    if ins.get("Bias"):
+        x = x + ins["Bias"][0].reshape(-1)
+    gate_act = _ACT[attrs.get("gate_activation", "sigmoid")]
+    cand_act = _ACT[attrs.get("activation", "tanh")]
+    ur = gate_act(x[:, :2 * h] + hp @ w[:, :2 * h])
+    u, r = ur[:, :h], ur[:, h:]
+    c = cand_act(x[:, 2 * h:] + (r * hp) @ w[:, 2 * h:])
+    hh = u * hp + (1 - u) * c
+    return {"Hidden": hh, "Gate": jnp.concatenate([ur, c], -1),
+            "ResetHiddenPrev": r * hp}

@@ -1,0 +1,380 @@
+"""Tensor manipulation op kernels.
+
+Reference parity: paddle/fluid/operators/{reshape_op,transpose_op,concat_op,
+split_op,slice_op,gather_op,scatter_op,expand_op,stack_op,fill_constant_op,
+assign_op,one_hot_op,range_op,arg_min_max,top_k_op,argsort_op,...}.
+All shapes are static under the trace, so ops like ``shape`` constant-fold.
+"""
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op
+from ..framework.dtypes import to_jax_dtype
+
+
+def _x(ins, slot="X"):
+    return ins[slot][0]
+
+
+@register_op("fill_constant")
+def _fill_constant(ctx, ins, attrs):
+    shape = attrs.get("shape", [1])
+    dtype = to_jax_dtype(attrs.get("dtype", "float32"))
+    return {"Out": jnp.full(tuple(shape), attrs.get("value", 0.0),
+                            dtype=dtype)}
+
+
+@register_op("fill_any_like")
+def _fill_any_like(ctx, ins, attrs):
+    x = _x(ins)
+    dtype = attrs.get("dtype")
+    dtype = to_jax_dtype(dtype) if dtype else x.dtype
+    return {"Out": jnp.full(x.shape, attrs.get("value", 0.0), dtype=dtype)}
+
+
+@register_op("fill_constant_batch_size_like", nondiff=("Input",))
+def _fill_constant_batch_size_like(ctx, ins, attrs):
+    x = ins["Input"][0]
+    shape = list(attrs["shape"])
+    shape[attrs.get("output_dim_idx", 0)] = \
+        x.shape[attrs.get("input_dim_idx", 0)]
+    return {"Out": jnp.full(tuple(shape), attrs.get("value", 0.0),
+                            dtype=to_jax_dtype(attrs.get("dtype",
+                                                         "float32")))}
+
+
+@register_op("fill_zeros_like")
+def _fill_zeros_like(ctx, ins, attrs):
+    return {"Out": jnp.zeros_like(_x(ins))}
+
+
+@register_op("assign")
+def _assign(ctx, ins, attrs):
+    return {"Out": _x(ins)}
+
+
+@register_op("assign_value")
+def _assign_value(ctx, ins, attrs):
+    vals = attrs["values"]
+    shape = attrs["shape"]
+    dtype = to_jax_dtype(attrs.get("dtype", "float32"))
+    return {"Out": jnp.asarray(np.array(vals).reshape(shape), dtype=dtype)}
+
+
+@register_op("shape", nondiff=("Input",))
+def _shape(ctx, ins, attrs):
+    return {"Out": jnp.asarray(ins["Input"][0].shape, dtype=jnp.int32)}
+
+
+@register_op("increment")
+def _increment(ctx, ins, attrs):
+    return {"Out": _x(ins) + attrs.get("step", 1.0)}
+
+
+@register_op("reshape2")
+def _reshape2(ctx, ins, attrs):
+    x = _x(ins)
+    shape = list(attrs["shape"])
+    # fluid semantics: 0 means "copy this dim from input"
+    shape = [x.shape[i] if s == 0 else s for i, s in enumerate(shape)]
+    return {"Out": x.reshape(shape)}
+
+
+@register_op("flatten2")
+def _flatten2(ctx, ins, attrs):
+    x = _x(ins)
+    axis = attrs.get("axis", 1)
+    lead = math.prod(x.shape[:axis]) if axis else 1
+    return {"Out": x.reshape(lead, -1)}
+
+
+@register_op("flatten_contiguous_range")
+def _flatten_range(ctx, ins, attrs):
+    x = _x(ins)
+    start = attrs.get("start_axis", 1) % x.ndim
+    stop = attrs.get("stop_axis", -1) % x.ndim
+    shape = x.shape[:start] + (-1,) + x.shape[stop + 1:]
+    return {"Out": x.reshape(shape)}
+
+
+@register_op("transpose2")
+def _transpose2(ctx, ins, attrs):
+    return {"Out": jnp.transpose(_x(ins), attrs["axis"])}
+
+
+@register_op("concat")
+def _concat(ctx, ins, attrs):
+    return {"Out": jnp.concatenate(ins["X"], axis=attrs.get("axis", 0))}
+
+
+@register_op("split")
+def _split(ctx, ins, attrs):
+    x = _x(ins)
+    axis = attrs.get("axis", 0)
+    num = attrs.get("num", 0)
+    sections = attrs.get("sections", [])
+    if num:
+        outs = jnp.split(x, num, axis=axis)
+    else:
+        idx = np.cumsum(sections[:-1]).tolist()
+        outs = jnp.split(x, idx, axis=axis)
+    return {"Out": list(outs)}
+
+
+@register_op("squeeze2")
+def _squeeze2(ctx, ins, attrs):
+    x = _x(ins)
+    axes = attrs.get("axes", [])
+    if not axes:
+        return {"Out": jnp.squeeze(x)}
+    axes = tuple(a % x.ndim for a in axes if x.shape[a % x.ndim] == 1)
+    return {"Out": jnp.squeeze(x, axis=axes) if axes else x}
+
+
+@register_op("unsqueeze2")
+def _unsqueeze2(ctx, ins, attrs):
+    x = _x(ins)
+    for a in sorted(attrs["axes"]):
+        x = jnp.expand_dims(x, a)
+    return {"Out": x}
+
+
+@register_op("stack")
+def _stack(ctx, ins, attrs):
+    return {"Y": jnp.stack(ins["X"], axis=attrs.get("axis", 0))}
+
+
+@register_op("unstack")
+def _unstack(ctx, ins, attrs):
+    x = _x(ins)
+    axis = attrs.get("axis", 0)
+    return {"Y": [jnp.squeeze(s, axis=axis)
+                  for s in jnp.split(x, x.shape[axis], axis=axis)]}
+
+
+@register_op("slice")
+def _slice(ctx, ins, attrs):
+    x = ins["Input"][0]
+    axes, starts, ends = attrs["axes"], attrs["starts"], attrs["ends"]
+    idx = [slice(None)] * x.ndim
+    for a, s, e in zip(axes, starts, ends):
+        dim = x.shape[a]
+        s = max(s + dim, 0) if s < 0 else min(s, dim)
+        e = max(e + dim, 0) if e < 0 else min(e, dim)
+        idx[a] = slice(s, e)
+    out = x[tuple(idx)]
+    for a in sorted(attrs.get("decrease_axis", []) or [], reverse=True):
+        out = jnp.squeeze(out, axis=a)
+    return {"Out": out}
+
+
+@register_op("strided_slice")
+def _strided_slice(ctx, ins, attrs):
+    x = ins["Input"][0]
+    idx = [slice(None)] * x.ndim
+    for a, s, e, st in zip(attrs["axes"], attrs["starts"], attrs["ends"],
+                           attrs["strides"]):
+        idx[a] = slice(s, e, st)
+    return {"Out": x[tuple(idx)]}
+
+
+@register_op("gather", nondiff=("Index",))
+def _gather(ctx, ins, attrs):
+    x, index = ins["X"][0], ins["Index"][0]
+    if index.ndim == 2 and index.shape[1] == 1:
+        index = index.reshape(-1)
+    return {"Out": jnp.take(x, index.astype(jnp.int32),
+                            axis=attrs.get("axis", 0) or 0)}
+
+
+@register_op("gather_nd", nondiff=("Index",))
+def _gather_nd(ctx, ins, attrs):
+    x, index = ins["X"][0], ins["Index"][0]
+    idx = tuple(jnp.moveaxis(index.astype(jnp.int32), -1, 0))
+    return {"Out": x[idx]}
+
+
+@register_op("scatter", nondiff=("Ids",))
+def _scatter(ctx, ins, attrs):
+    x, ids, updates = ins["X"][0], ins["Ids"][0], ins["Updates"][0]
+    ids = ids.reshape(-1).astype(jnp.int32)
+    if attrs.get("overwrite", True):
+        return {"Out": x.at[ids].set(updates)}
+    return {"Out": x.at[ids].add(updates)}
+
+
+@register_op("scatter_nd_add", nondiff=("Index",))
+def _scatter_nd_add(ctx, ins, attrs):
+    x, index, updates = ins["X"][0], ins["Index"][0], ins["Updates"][0]
+    idx = tuple(jnp.moveaxis(index.astype(jnp.int32), -1, 0))
+    return {"Out": x.at[idx].add(updates)}
+
+
+@register_op("index_select", nondiff=("Index",))
+def _index_select(ctx, ins, attrs):
+    x, index = ins["X"][0], ins["Index"][0]
+    return {"Out": jnp.take(x, index.astype(jnp.int32),
+                            axis=attrs.get("dim", 0))}
+
+
+@register_op("expand")
+def _expand(ctx, ins, attrs):
+    x = _x(ins)
+    times = attrs["expand_times"]
+    return {"Out": jnp.tile(x, tuple(times))}
+
+
+@register_op("expand_as")
+def _expand_as(ctx, ins, attrs):
+    x, target = ins["X"][0], ins["target_tensor"][0]
+    times = [t // s for t, s in zip(target.shape, x.shape)]
+    return {"Out": jnp.tile(x, tuple(times))}
+
+
+@register_op("tile")
+def _tile(ctx, ins, attrs):
+    return {"Out": jnp.tile(_x(ins), tuple(attrs["repeat_times"]))}
+
+
+@register_op("range", nondiff=("Start", "End", "Step"))
+def _range(ctx, ins, attrs):
+    s = ins["Start"][0].reshape(())
+    e = ins["End"][0].reshape(())
+    st = ins["Step"][0].reshape(())
+    # shapes must be static: require concrete python scalars at build time
+    s, e, st = float(s), float(e), float(st)
+    n = max(0, int(math.ceil((e - s) / st)))
+    return {"Out": (s + st * jnp.arange(n)).astype(ins["Start"][0].dtype)}
+
+
+@register_op("linspace", nondiff=("Start", "Stop", "Num"))
+def _linspace(ctx, ins, attrs):
+    s = float(ins["Start"][0].reshape(()))
+    e = float(ins["Stop"][0].reshape(()))
+    n = int(ins["Num"][0].reshape(()))
+    return {"Out": jnp.linspace(s, e, n, dtype=ins["Start"][0].dtype)}
+
+
+@register_op("arg_max", nondiff=("X",))
+def _arg_max(ctx, ins, attrs):
+    x = _x(ins)
+    axis = attrs.get("axis", -1)
+    out = jnp.argmax(x, axis=axis).astype(jnp.int64)
+    if attrs.get("keepdims", False):
+        out = jnp.expand_dims(out, axis)
+    return {"Out": out}
+
+
+@register_op("arg_min", nondiff=("X",))
+def _arg_min(ctx, ins, attrs):
+    x = _x(ins)
+    axis = attrs.get("axis", -1)
+    return {"Out": jnp.argmin(x, axis=axis).astype(jnp.int64)}
+
+
+@register_op("argsort")
+def _argsort(ctx, ins, attrs):
+    x = _x(ins)
+    axis = attrs.get("axis", -1)
+    desc = attrs.get("descending", False)
+    idx = jnp.argsort(-x if desc else x, axis=axis)
+    out = jnp.take_along_axis(x, idx, axis=axis)
+    return {"Out": out, "Indices": idx.astype(jnp.int64)}
+
+
+@register_op("top_k")
+def _top_k(ctx, ins, attrs):
+    x = _x(ins)
+    k = attrs["k"]
+    vals, idx = lax.top_k(x, k)
+    return {"Out": vals, "Indices": idx.astype(jnp.int64)}
+
+
+@register_op("where")
+def _where(ctx, ins, attrs):
+    cond, x, y = ins["Condition"][0], ins["X"][0], ins["Y"][0]
+    return {"Out": jnp.where(cond, x, y)}
+
+
+@register_op("where_index", nondiff=("Condition",))
+def _where_index(ctx, ins, attrs):
+    # Dynamic-shaped in the reference; here only usable outside jit traces.
+    cond = ins["Condition"][0]
+    return {"Out": jnp.argwhere(cond).astype(jnp.int64)}
+
+
+@register_op("flip")
+def _flip(ctx, ins, attrs):
+    return {"Out": jnp.flip(_x(ins), axis=tuple(attrs["axis"]))}
+
+
+@register_op("roll")
+def _roll(ctx, ins, attrs):
+    return {"Out": jnp.roll(_x(ins), tuple(attrs["shifts"]),
+                            axis=tuple(attrs["axis"]))}
+
+
+@register_op("tril_triu")
+def _tril_triu(ctx, ins, attrs):
+    x = _x(ins)
+    k = attrs.get("diagonal", 0)
+    if attrs.get("lower", True):
+        return {"Out": jnp.tril(x, k)}
+    return {"Out": jnp.triu(x, k)}
+
+
+@register_op("eye")
+def _eye(ctx, ins, attrs):
+    return {"Out": jnp.eye(attrs["num_rows"],
+                           attrs.get("num_columns", attrs["num_rows"]),
+                           dtype=to_jax_dtype(attrs.get("dtype", "float32")))}
+
+
+@register_op("diag")
+def _diag(ctx, ins, attrs):
+    return {"Out": jnp.diag(ins["Diagonal"][0])}
+
+
+@register_op("sequence_mask", nondiff=("X",))
+def _sequence_mask(ctx, ins, attrs):
+    x = _x(ins)
+    maxlen = attrs.get("maxlen", -1)
+    if maxlen is None or maxlen < 0:
+        raise ValueError("sequence_mask needs a static maxlen on TPU")
+    mask = jnp.arange(maxlen)[None, :] < x.reshape(-1, 1)
+    mask = mask.reshape(tuple(x.shape) + (maxlen,))
+    return {"Y": mask.astype(to_jax_dtype(attrs.get("out_dtype", "int64")))}
+
+
+@register_op("unique_with_counts", nondiff=("X",))
+def _unique_with_counts(ctx, ins, attrs):
+    x = _x(ins)
+    u, idx, counts = jnp.unique(x, return_inverse=True, return_counts=True,
+                                size=x.size)
+    return {"Out": u, "Index": idx.astype(jnp.int32),
+            "Count": counts.astype(jnp.int32)}
+
+
+@register_op("take_along_axis", nondiff=("Index",))
+def _take_along_axis(ctx, ins, attrs):
+    x, index = ins["Input"][0], ins["Index"][0]
+    return {"Result": jnp.take_along_axis(x, index.astype(jnp.int32),
+                                          axis=attrs.get("Axis", 0))}
+
+
+@register_op("meshgrid")
+def _meshgrid(ctx, ins, attrs):
+    outs = jnp.meshgrid(*ins["X"], indexing="ij")
+    return {"Out": list(outs)}
+
+
+@register_op("coalesce_tensor")
+def _coalesce_tensor(ctx, ins, attrs):
+    # Reference fuses grads into one buffer for NCCL; XLA does its own
+    # buffer management, so this is an identity pass-through.
+    return {"Output": list(ins["Input"]), "FusedOutput":
+            jnp.concatenate([x.reshape(-1) for x in ins["Input"]])}

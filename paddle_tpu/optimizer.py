@@ -1,0 +1,598 @@
+"""Optimizers — graph-building API.
+
+Reference parity: python/paddle/fluid/optimizer.py. ``minimize(loss)``
+appends backward + update ops to the main program, exactly like the
+reference; the Executor then compiles forward+backward+update into ONE XLA
+computation with donated parameter buffers (in-place HBM updates).
+"""
+import math
+
+from .framework.backward import append_backward
+from .framework.program import (Program, Variable, default_main_program,
+                                default_startup_program, program_guard)
+from .framework import unique_name
+from .initializer import ConstantInitializer
+from .layer_helper import LayerHelper
+from .regularizer import append_regularization_ops
+from . import clip as clip_mod
+
+
+class Optimizer(object):
+    _op_type = None
+
+    def __init__(self, learning_rate, regularization=None, name=None,
+                 grad_clip=None):
+        self._learning_rate = learning_rate
+        self.regularization = regularization
+        self._grad_clip = grad_clip
+        self._name = name
+        self._accumulators = {}       # name -> {param_name: var}
+        self._learning_rate_map = {}  # program -> lr var
+        self.helper = None
+
+    # ---- learning rate ----------------------------------------------------
+    def _create_global_learning_rate(self):
+        program = default_main_program()
+        if isinstance(self._learning_rate, Variable):
+            self._learning_rate_map[id(program)] = self._learning_rate
+            return
+        if id(program) in self._learning_rate_map:
+            return
+        helper = LayerHelper("learning_rate")
+        lr = helper.create_global_variable(
+            name=unique_name.generate("learning_rate"), dtype="float32",
+            shape=(1,), persistable=True)
+        helper.set_variable_initializer(
+            lr, ConstantInitializer(float(self._learning_rate)))
+        self._learning_rate_map[id(program)] = lr
+
+    def _global_learning_rate(self, program=None):
+        program = program or default_main_program()
+        return self._learning_rate_map.get(id(program))
+
+    def _create_param_lr(self, param):
+        lr = self._global_learning_rate()
+        param_lr = getattr(param, "optimize_attr",
+                           {"learning_rate": 1.0}).get("learning_rate", 1.0)
+        if param_lr == 1.0:
+            return lr
+        from .layers import scale as scale_layer
+        return scale_layer(lr, scale=float(param_lr))
+
+    # ---- accumulators -----------------------------------------------------
+    def _add_accumulator(self, name, param, dtype=None, fill_value=0.0,
+                         shape=None):
+        key = (name, param.name)
+        if key in self._accumulators:
+            return self._accumulators[key]
+        helper = LayerHelper(name)
+        shape = list(shape if shape is not None else param.shape)
+        var = helper.create_global_variable(
+            name=unique_name.generate("%s_%s" % (param.name, name)),
+            dtype=dtype or "float32", shape=tuple(shape), persistable=True)
+        # moments follow the param's sharding so optimizer state is
+        # distributed with the weights (ZeRO-like by construction)
+        var.sharding = param.sharding if shape == list(param.shape) else None
+        helper.set_variable_initializer(var,
+                                        ConstantInitializer(fill_value))
+        self._accumulators[key] = var
+        return var
+
+    def _get_accumulator(self, name, param):
+        return self._accumulators[(name, param.name)]
+
+    # ---- hooks ------------------------------------------------------------
+    def _create_accumulators(self, block, parameters):
+        pass
+
+    def _append_optimize_op(self, block, param_and_grad):
+        raise NotImplementedError
+
+    def _finish_update(self, block, parameters_and_grads):
+        pass
+
+    # ---- main entry points ------------------------------------------------
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, callbacks=None):
+        return append_backward(loss, parameter_list, no_grad_set, callbacks)
+
+    def apply_gradients(self, params_grads):
+        params_grads = append_regularization_ops(params_grads,
+                                                 self.regularization)
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip._process(params_grads)
+        else:
+            params_grads = clip_mod.append_gradient_clip_ops(params_grads)
+        block = default_main_program().global_block()
+        self._create_global_learning_rate()
+        self._create_accumulators(block,
+                                  [p for p, g in params_grads
+                                   if getattr(p, "trainable", True)])
+        for param_and_grad in params_grads:
+            if param_and_grad[1] is None:
+                continue
+            self._append_optimize_op(block, param_and_grad)
+        self._finish_update(block, params_grads)
+        return []
+
+    def apply_optimize(self, loss, startup_program, params_grads):
+        return self.apply_gradients(params_grads)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, grad_clip=None):
+        if grad_clip is not None:
+            self._grad_clip = grad_clip
+        params_grads = self.backward(loss, startup_program, parameter_list,
+                                     no_grad_set)
+        optimize_ops = self.apply_gradients(params_grads)
+        return optimize_ops, params_grads
+
+
+class SGDOptimizer(Optimizer):
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        lr = self._create_param_lr(param)
+        block.append_op(
+            "sgd",
+            inputs={"Param": [param.name], "Grad": [grad.name],
+                    "LearningRate": [lr.name]},
+            outputs={"ParamOut": [param.name]},
+            attrs={"op_role": "optimize"})
+
+
+class MomentumOptimizer(Optimizer):
+    def __init__(self, learning_rate, momentum, use_nesterov=False, **kw):
+        super(MomentumOptimizer, self).__init__(learning_rate, **kw)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("velocity", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        velocity = self._get_accumulator("velocity", param)
+        lr = self._create_param_lr(param)
+        block.append_op(
+            "momentum",
+            inputs={"Param": [param.name], "Grad": [grad.name],
+                    "Velocity": [velocity.name], "LearningRate": [lr.name]},
+            outputs={"ParamOut": [param.name],
+                     "VelocityOut": [velocity.name]},
+            attrs={"mu": self._momentum, "use_nesterov": self._use_nesterov,
+                   "op_role": "optimize"})
+
+
+class LarsMomentumOptimizer(Optimizer):
+    def __init__(self, learning_rate, momentum, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, **kw):
+        super(LarsMomentumOptimizer, self).__init__(learning_rate, **kw)
+        self._momentum = momentum
+        self._lars_coeff = lars_coeff
+        self._lars_weight_decay = lars_weight_decay
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("velocity", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        velocity = self._get_accumulator("velocity", param)
+        lr = self._create_param_lr(param)
+        block.append_op(
+            "lars_momentum",
+            inputs={"Param": [param.name], "Grad": [grad.name],
+                    "Velocity": [velocity.name], "LearningRate": [lr.name]},
+            outputs={"ParamOut": [param.name],
+                     "VelocityOut": [velocity.name]},
+            attrs={"mu": self._momentum, "lars_coeff": self._lars_coeff,
+                   "lars_weight_decay": self._lars_weight_decay,
+                   "op_role": "optimize"})
+
+
+class AdagradOptimizer(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6,
+                 initial_accumulator_value=0.0, **kw):
+        super(AdagradOptimizer, self).__init__(learning_rate, **kw)
+        self._epsilon = epsilon
+        self._initial = initial_accumulator_value
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment", p, fill_value=self._initial)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        moment = self._get_accumulator("moment", param)
+        lr = self._create_param_lr(param)
+        block.append_op(
+            "adagrad",
+            inputs={"Param": [param.name], "Grad": [grad.name],
+                    "Moment": [moment.name], "LearningRate": [lr.name]},
+            outputs={"ParamOut": [param.name], "MomentOut": [moment.name]},
+            attrs={"epsilon": self._epsilon, "op_role": "optimize"})
+
+
+class DecayedAdagradOptimizer(Optimizer):
+    def __init__(self, learning_rate, decay=0.95, epsilon=1e-6, **kw):
+        super(DecayedAdagradOptimizer, self).__init__(learning_rate, **kw)
+        self._decay = decay
+        self._epsilon = epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        moment = self._get_accumulator("moment", param)
+        lr = self._create_param_lr(param)
+        block.append_op(
+            "decayed_adagrad",
+            inputs={"Param": [param.name], "Grad": [grad.name],
+                    "Moment": [moment.name], "LearningRate": [lr.name]},
+            outputs={"ParamOut": [param.name], "MomentOut": [moment.name]},
+            attrs={"decay": self._decay, "epsilon": self._epsilon,
+                   "op_role": "optimize"})
+
+
+class _AdamLike(Optimizer):
+    _update_op = "adam"
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, lazy_mode=False, **kw):
+        super(_AdamLike, self).__init__(learning_rate, **kw)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment1", p)
+            self._add_accumulator("moment2", p)
+            self._add_accumulator("beta1_pow_acc", p, shape=[1],
+                                  fill_value=self._beta1)
+            self._add_accumulator("beta2_pow_acc", p, shape=[1],
+                                  fill_value=self._beta2)
+
+    def _extra_attrs(self):
+        return {}
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        m1 = self._get_accumulator("moment1", param)
+        m2 = self._get_accumulator("moment2", param)
+        b1p = self._get_accumulator("beta1_pow_acc", param)
+        b2p = self._get_accumulator("beta2_pow_acc", param)
+        lr = self._create_param_lr(param)
+        attrs = {"beta1": self._beta1, "beta2": self._beta2,
+                 "epsilon": self._epsilon, "op_role": "optimize"}
+        attrs.update(self._extra_attrs())
+        block.append_op(
+            self._update_op,
+            inputs={"Param": [param.name], "Grad": [grad.name],
+                    "Moment1": [m1.name], "Moment2": [m2.name],
+                    "Beta1Pow": [b1p.name], "Beta2Pow": [b2p.name],
+                    "LearningRate": [lr.name]},
+            outputs={"ParamOut": [param.name], "Moment1Out": [m1.name],
+                     "Moment2Out": [m2.name], "Beta1PowOut": [b1p.name],
+                     "Beta2PowOut": [b2p.name]},
+            attrs=attrs)
+
+
+class AdamOptimizer(_AdamLike):
+    _update_op = "adam"
+
+
+class AdamWOptimizer(_AdamLike):
+    _update_op = "adamw"
+
+    def __init__(self, learning_rate=0.001, weight_decay=0.01, **kw):
+        super(AdamWOptimizer, self).__init__(learning_rate, **kw)
+        self._coeff = weight_decay
+
+    def _extra_attrs(self):
+        return {"coeff": self._coeff}
+
+
+class LambOptimizer(_AdamLike):
+    _update_op = "lamb"
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6,
+                 exclude_from_weight_decay_fn=None, **kw):
+        super(LambOptimizer, self).__init__(learning_rate, beta1, beta2,
+                                            epsilon, **kw)
+        self._weight_decay = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param = param_and_grad[0]
+        wd = self._weight_decay
+        if self._exclude_fn is not None and self._exclude_fn(param):
+            wd = 0.0
+        self._wd_current = wd
+        super(LambOptimizer, self)._append_optimize_op(block, param_and_grad)
+
+    def _extra_attrs(self):
+        return {"weight_decay": getattr(self, "_wd_current",
+                                        self._weight_decay)}
+
+
+class AdamaxOptimizer(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kw):
+        super(AdamaxOptimizer, self).__init__(learning_rate, **kw)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment", p)
+            self._add_accumulator("inf_norm", p)
+            self._add_accumulator("beta1_pow_acc", p, shape=[1],
+                                  fill_value=self._beta1)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        moment = self._get_accumulator("moment", param)
+        inf_norm = self._get_accumulator("inf_norm", param)
+        b1p = self._get_accumulator("beta1_pow_acc", param)
+        lr = self._create_param_lr(param)
+        block.append_op(
+            "adamax",
+            inputs={"Param": [param.name], "Grad": [grad.name],
+                    "Moment": [moment.name], "InfNorm": [inf_norm.name],
+                    "Beta1Pow": [b1p.name], "LearningRate": [lr.name]},
+            outputs={"ParamOut": [param.name], "MomentOut": [moment.name],
+                     "InfNormOut": [inf_norm.name]},
+            attrs={"beta1": self._beta1, "beta2": self._beta2,
+                   "epsilon": self._epsilon, "op_role": "optimize"})
+        # beta1_pow update
+        block.append_op("scale", inputs={"X": [b1p.name]},
+                        outputs={"Out": [b1p.name]},
+                        attrs={"scale": self._beta1, "op_role": "optimize"})
+
+
+class RMSPropOptimizer(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, **kw):
+        super(RMSPropOptimizer, self).__init__(learning_rate, **kw)
+        self._rho, self._epsilon = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("mean_square", p)
+            self._add_accumulator("momentum", p)
+            if self._centered:
+                self._add_accumulator("mean_grad", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        ms = self._get_accumulator("mean_square", param)
+        mom = self._get_accumulator("momentum", param)
+        lr = self._create_param_lr(param)
+        inputs = {"Param": [param.name], "Grad": [grad.name],
+                  "MeanSquare": [ms.name], "Moment": [mom.name],
+                  "LearningRate": [lr.name]}
+        outputs = {"ParamOut": [param.name], "MeanSquareOut": [ms.name],
+                   "MomentOut": [mom.name]}
+        if self._centered:
+            mg = self._get_accumulator("mean_grad", param)
+            inputs["MeanGrad"] = [mg.name]
+            outputs["MeanGradOut"] = [mg.name]
+        block.append_op(
+            "rmsprop", inputs=inputs, outputs=outputs,
+            attrs={"decay": self._rho, "epsilon": self._epsilon,
+                   "momentum": self._momentum, "centered": self._centered,
+                   "op_role": "optimize"})
+
+
+class FtrlOptimizer(Optimizer):
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, lr_power=-0.5, **kw):
+        super(FtrlOptimizer, self).__init__(learning_rate, **kw)
+        self._l1, self._l2, self._lr_power = l1, l2, lr_power
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("squared", p)
+            self._add_accumulator("linear", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        sq = self._get_accumulator("squared", param)
+        lin = self._get_accumulator("linear", param)
+        lr = self._create_param_lr(param)
+        block.append_op(
+            "ftrl",
+            inputs={"Param": [param.name], "Grad": [grad.name],
+                    "SquaredAccumulator": [sq.name],
+                    "LinearAccumulator": [lin.name],
+                    "LearningRate": [lr.name]},
+            outputs={"ParamOut": [param.name], "SquaredAccumOut": [sq.name],
+                     "LinearAccumOut": [lin.name]},
+            attrs={"l1": self._l1, "l2": self._l2,
+                   "lr_power": self._lr_power, "op_role": "optimize"})
+
+
+class DpsgdOptimizer(Optimizer):
+    def __init__(self, learning_rate, clip=10.0, batch_size=16.0,
+                 sigma=1.0, **kw):
+        super(DpsgdOptimizer, self).__init__(learning_rate, **kw)
+        self._clip, self._sigma = clip, sigma
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        lr = self._create_param_lr(param)
+        block.append_op(
+            "dpsgd",
+            inputs={"Param": [param.name], "Grad": [grad.name],
+                    "LearningRate": [lr.name]},
+            outputs={"ParamOut": [param.name]},
+            attrs={"clip": self._clip, "sigma": self._sigma,
+                   "op_role": "optimize"})
+
+
+class ExponentialMovingAverage(object):
+    """EMA of parameters (reference optimizer.py ExponentialMovingAverage).
+    update() appends in-graph EMA ops; apply()/restore() swap params."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = decay
+        self._name = name or "ema"
+        self._ema_vars = {}
+
+    def update(self):
+        program = default_main_program()
+        block = program.global_block()
+        helper = LayerHelper(self._name)
+        for param in program.all_parameters():
+            if not getattr(param, "trainable", True):
+                continue
+            ema = helper.create_global_variable(
+                name=unique_name.generate(param.name + ".ema"),
+                dtype=param.dtype, shape=param.shape, persistable=True)
+            helper.set_variable_initializer(ema, ConstantInitializer(0.0))
+            self._ema_vars[param.name] = ema
+            tmp1 = helper.create_variable_for_type_inference(param.dtype,
+                                                             param.shape)
+            block.append_op("scale", inputs={"X": [ema.name]},
+                            outputs={"Out": [tmp1.name]},
+                            attrs={"scale": self._decay,
+                                   "op_role": "optimize"})
+            tmp2 = helper.create_variable_for_type_inference(param.dtype,
+                                                             param.shape)
+            block.append_op("scale", inputs={"X": [param.name]},
+                            outputs={"Out": [tmp2.name]},
+                            attrs={"scale": 1.0 - self._decay,
+                                   "op_role": "optimize"})
+            block.append_op("sum", inputs={"X": [tmp1.name, tmp2.name]},
+                            outputs={"Out": [ema.name]},
+                            attrs={"op_role": "optimize"})
+
+    def apply(self, executor, need_restore=True):
+        """Swap params with their EMA values in the scope."""
+        from .framework.scope import global_scope
+        import numpy as np
+        scope = global_scope()
+        self._backup = {}
+        for pname, ema in self._ema_vars.items():
+            pv = scope.find_var(pname)
+            ev = scope.find_var(ema.name)
+            if pv is None or ev is None:
+                continue
+            self._backup[pname] = pv
+            scope.set_var(pname, ev)
+        import contextlib
+
+        @contextlib.contextmanager
+        def guard():
+            try:
+                yield
+            finally:
+                if need_restore:
+                    self.restore(executor)
+        return guard()
+
+    def restore(self, executor=None):
+        from .framework.scope import global_scope
+        scope = global_scope()
+        for pname, val in getattr(self, "_backup", {}).items():
+            scope.set_var(pname, val)
+        self._backup = {}
+
+
+class LookaheadOptimizer(object):
+    """Reference optimizer.py LookaheadOptimizer: wraps a fast optimizer,
+    every k steps slow weights interpolate toward fast weights. The k-step
+    branch runs on device via a where-select on a step counter."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = k
+
+    def minimize(self, loss, startup_program=None):
+        ops, pgs = self.inner_optimizer.minimize(loss, startup_program)
+        program = default_main_program()
+        block = program.global_block()
+        helper = LayerHelper("lookahead")
+        from . import layers as L
+        step = L.autoincreased_step_counter(
+            counter_name="@LOOKAHEAD_STEP@", begin=1)
+        stepf = L.cast(step, "float32")
+        k = L.fill_constant([1], "float32", float(self.k))
+        rem = L.elementwise_sub(
+            stepf, L.elementwise_mul(L.floor(L.elementwise_div(stepf, k)), k))
+        is_sync = L.equal(rem, 0.0)
+        for param, _ in pgs:
+            slow = helper.create_global_variable(
+                name=unique_name.generate(param.name + ".slow"),
+                dtype=param.dtype, shape=param.shape, persistable=True)
+            helper.set_variable_initializer(slow, ConstantInitializer(0.0))
+            mixed = helper.create_variable_for_type_inference(param.dtype,
+                                                              param.shape)
+            t1 = helper.create_variable_for_type_inference(param.dtype,
+                                                           param.shape)
+            block.append_op("scale", inputs={"X": [param.name]},
+                            outputs={"Out": [t1.name]},
+                            attrs={"scale": self.alpha,
+                                   "op_role": "optimize"})
+            t2 = helper.create_variable_for_type_inference(param.dtype,
+                                                           param.shape)
+            block.append_op("scale", inputs={"X": [slow.name]},
+                            outputs={"Out": [t2.name]},
+                            attrs={"scale": 1.0 - self.alpha,
+                                   "op_role": "optimize"})
+            block.append_op("sum", inputs={"X": [t1.name, t2.name]},
+                            outputs={"Out": [mixed.name]},
+                            attrs={"op_role": "optimize"})
+            new_p = L.where(is_sync, mixed, param)
+            new_slow = L.where(is_sync, mixed, slow)
+            block.append_op("assign", inputs={"X": [new_p.name]},
+                            outputs={"Out": [param.name]},
+                            attrs={"op_role": "optimize"})
+            block.append_op("assign", inputs={"X": [new_slow.name]},
+                            outputs={"Out": [slow.name]},
+                            attrs={"op_role": "optimize"})
+        return ops, pgs
+
+
+class RecomputeOptimizer(object):
+    """Reference RecomputeOptimizer trades memory for compute by re-running
+    checkpointed segments in backward. On TPU the equivalent lever is XLA
+    rematerialization: our grad ops already recompute via vjp when the
+    executor marks segments (see SURVEY §2.5); this wrapper keeps API parity
+    and records checkpoint vars for the build strategy."""
+
+    def __init__(self, optimizer):
+        self.inner_optimizer = optimizer
+        self._checkpoints = None
+
+    def _set_checkpoints(self, checkpoints):
+        self._checkpoints = checkpoints
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        program = loss.block.program
+        program._recompute_checkpoints = [
+            v.name if hasattr(v, "name") else v
+            for v in (self._checkpoints or [])]
+        return self.inner_optimizer.minimize(loss, startup_program,
+                                             parameter_list, no_grad_set)
+
+
+# fluid-style aliases
+SGD = SGDOptimizer
+Momentum = MomentumOptimizer
+Adagrad = AdagradOptimizer
+Adam = AdamOptimizer
+AdamW = AdamWOptimizer
+Adamax = AdamaxOptimizer
+DecayedAdagrad = DecayedAdagradOptimizer
+RMSProp = RMSPropOptimizer
+Ftrl = FtrlOptimizer
+Lamb = LambOptimizer
+LarsMomentum = LarsMomentumOptimizer
+Dpsgd = DpsgdOptimizer

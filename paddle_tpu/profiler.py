@@ -1,0 +1,36 @@
+"""Profiler.
+
+Reference parity: python/paddle/fluid/profiler.py — but TPU profiling goes
+through jax.profiler (XPlane traces viewable in TensorBoard/Perfetto).
+"""
+import contextlib
+
+import jax
+
+
+@contextlib.contextmanager
+def profiler(state="All", sorted_key=None, profile_path="/tmp/paddle_tpu_profile"):
+    jax.profiler.start_trace(profile_path)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def start_profiler(state="All", tracer_option=None,
+                   profile_path="/tmp/paddle_tpu_profile"):
+    jax.profiler.start_trace(profile_path)
+
+
+def stop_profiler(sorted_key=None, profile_path=None):
+    jax.profiler.stop_trace()
+
+
+def reset_profiler():
+    pass
+
+
+@contextlib.contextmanager
+def annotate(name):
+    with jax.profiler.TraceAnnotation(name):
+        yield

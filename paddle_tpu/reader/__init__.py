@@ -1,0 +1,9 @@
+"""Data readers.
+
+Reference parity: python/paddle/reader/decorator.py + fluid/reader.py.
+Python reader decorators here; the native C++ prefetch ring buffer lives in
+paddle_tpu/native (SURVEY §2.9) with this module as its fallback.
+"""
+from .decorator import (batch, shuffle, buffered, chain, compose, firstn,
+                        map_readers, xmap_readers, cache, multiprocess_reader)
+from .dataloader import DataLoader  # noqa

@@ -1,0 +1,93 @@
+"""DataLoader: batched host->device pipeline with async prefetch.
+
+Reference parity: fluid.io.DataLoader / PyReader (python/paddle/fluid/
+reader.py). TPU-native: batches are staged to device ahead of compute via a
+background thread + jax.device_put, overlapping host preprocessing with TPU
+step execution (JAX dispatch is async, so one-deep pipelining suffices).
+"""
+import queue
+import threading
+
+import numpy as np
+import jax
+
+
+class DataLoader(object):
+    @staticmethod
+    def from_generator(feed_list=None, capacity=4, use_double_buffer=True,
+                       iterable=True, return_list=False,
+                       use_multiprocess=False):
+        return _GeneratorLoader(feed_list, capacity, use_double_buffer)
+
+
+class _GeneratorLoader(object):
+    def __init__(self, feed_list, capacity, use_double_buffer):
+        self._feed_list = feed_list or []
+        self._capacity = capacity
+        self._double_buffer = use_double_buffer
+        self._batch_reader = None
+        self._places = None
+
+    def set_batch_generator(self, reader, places=None):
+        self._batch_reader = reader
+        self._places = places
+        return self
+
+    def set_sample_list_generator(self, reader, places=None):
+        names = [v.name for v in self._feed_list]
+
+        def batched():
+            for samples in reader():
+                cols = list(zip(*samples))
+                yield {n: np.stack([np.asarray(c) for c in col])
+                       for n, col in zip(names, cols)}
+        self._batch_reader = batched
+        self._places = places
+        return self
+
+    def set_sample_generator(self, reader, batch_size, drop_last=True,
+                             places=None):
+        from .decorator import batch as batch_dec
+        return self.set_sample_list_generator(
+            batch_dec(lambda: ([s] for s in reader()), batch_size,
+                      drop_last=drop_last), places)
+
+    def __call__(self):
+        return iter(self)
+
+    def __iter__(self):
+        names = [v.name for v in self._feed_list]
+
+        def to_feed(item):
+            if isinstance(item, dict):
+                return item
+            if isinstance(item, (list, tuple)):
+                return {n: np.asarray(v) for n, v in zip(names, item)}
+            raise TypeError("batch generator must yield dict or tuple")
+
+        if not self._double_buffer:
+            for item in self._batch_reader():
+                yield to_feed(item)
+            return
+
+        q = queue.Queue(maxsize=self._capacity)
+        END = object()
+
+        def producer():
+            try:
+                for item in self._batch_reader():
+                    feed = to_feed(item)
+                    # stage to device early: overlaps H2D with TPU compute
+                    feed = {k: jax.device_put(np.asarray(v))
+                            for k, v in feed.items()}
+                    q.put(feed)
+            finally:
+                q.put(END)
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is END:
+                return
+            yield item

@@ -1,0 +1,73 @@
+"""Weight-decay regularizers.
+
+Reference parity: python/paddle/fluid/regularizer.py. Regularization is
+appended as grad += coeff * f(param) ops, fused by XLA into the update.
+"""
+from .layer_helper import LayerHelper
+
+
+class WeightDecayRegularizer(object):
+    def __call__(self, param, grad, block):
+        raise NotImplementedError
+
+
+class L2DecayRegularizer(WeightDecayRegularizer):
+    def __init__(self, regularization_coeff=0.0):
+        self._coeff = regularization_coeff
+
+    def __call__(self, param, grad, block):
+        helper = LayerHelper("l2_decay")
+        decay = helper.create_variable_for_type_inference(param.dtype,
+                                                          param.shape)
+        block.append_op("scale", inputs={"X": [param.name]},
+                        outputs={"Out": [decay.name]},
+                        attrs={"scale": self._coeff, "op_role": "optimize"})
+        new_grad = helper.create_variable_for_type_inference(param.dtype,
+                                                             param.shape)
+        block.append_op("sum", inputs={"X": [grad.name, decay.name]},
+                        outputs={"Out": [new_grad.name]},
+                        attrs={"op_role": "optimize"})
+        return new_grad
+
+
+class L1DecayRegularizer(WeightDecayRegularizer):
+    def __init__(self, regularization_coeff=0.0):
+        self._coeff = regularization_coeff
+
+    def __call__(self, param, grad, block):
+        helper = LayerHelper("l1_decay")
+        sign = helper.create_variable_for_type_inference(param.dtype,
+                                                         param.shape)
+        block.append_op("sign", inputs={"X": [param.name]},
+                        outputs={"Out": [sign.name]},
+                        attrs={"op_role": "optimize"})
+        decay = helper.create_variable_for_type_inference(param.dtype,
+                                                          param.shape)
+        block.append_op("scale", inputs={"X": [sign.name]},
+                        outputs={"Out": [decay.name]},
+                        attrs={"scale": self._coeff, "op_role": "optimize"})
+        new_grad = helper.create_variable_for_type_inference(param.dtype,
+                                                             param.shape)
+        block.append_op("sum", inputs={"X": [grad.name, decay.name]},
+                        outputs={"Out": [new_grad.name]},
+                        attrs={"op_role": "optimize"})
+        return new_grad
+
+
+L1Decay = L1DecayRegularizer
+L2Decay = L2DecayRegularizer
+
+
+def append_regularization_ops(parameters_and_grads, regularization=None):
+    out = []
+    for param, grad in parameters_and_grads:
+        if grad is None:
+            out.append((param, grad))
+            continue
+        regularizer = getattr(param, "regularizer", None) or regularization
+        if regularizer is None:
+            out.append((param, grad))
+            continue
+        new_grad = regularizer(param, grad, grad.block)
+        out.append((param, new_grad))
+    return out
