@@ -29,10 +29,19 @@ def _ring_attention_local(q, k, v, axis_name, causal, scale, q_offset_blocks):
     my_idx = lax.axis_index(axis_name)
     b, h, tl, d = q.shape
 
-    # online softmax accumulators
-    acc = jnp.zeros((b, h, tl, d), jnp.float32)
-    row_max = jnp.full((b, h, tl), -jnp.inf, jnp.float32)
-    row_sum = jnp.zeros((b, h, tl), jnp.float32)
+    # online softmax accumulators (pvary: mark as device-varying for the
+    # shard_map carry type system)
+    def _vary(x):
+        try:
+            return lax.pcast(x, (axis_name,), to="varying")
+        except (AttributeError, TypeError):  # older jax spellings
+            try:
+                return lax.pvary(x, (axis_name,))
+            except AttributeError:
+                return x
+    acc = _vary(jnp.zeros((b, h, tl, d), jnp.float32))
+    row_max = _vary(jnp.full((b, h, tl), -jnp.inf, jnp.float32))
+    row_sum = _vary(jnp.zeros((b, h, tl), jnp.float32))
 
     perm = [(i, (i + 1) % n) for i in range(n)]
 

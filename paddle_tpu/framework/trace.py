@@ -51,8 +51,10 @@ class TraceContext(object):
         self._op_rng_count = 0
         self.outer_env = None  # set while tracing a uses_subblock op
 
-    def begin_op(self, desc_id):
-        self._op_key = jax.random.fold_in(self.base_key, desc_id % (2**31))
+    def begin_op(self, rng_tag):
+        """rng_tag is the op's structural position (block, index) hash —
+        stable across program rebuilds, unlike the global desc_id."""
+        self._op_key = jax.random.fold_in(self.base_key, rng_tag % (2**31))
         self._op_rng_count = 0
 
     def rng(self):
@@ -95,25 +97,29 @@ def _bind_outputs(op, outs, env):
                 env[name] = val
 
 
+def _rng_tag(block, idx):
+    return (block.idx + 1) * 1000003 + idx
+
+
 def trace_block(block, env, ctx):
-    for op in block.ops:
-        trace_op(op, env, ctx)
+    for i, op in enumerate(block.ops):
+        trace_op(op, env, ctx, _rng_tag(block, i))
 
 
-def trace_op(op, env, ctx):
+def trace_op(op, env, ctx, rng_tag=0):
     if op.type == GRAD_OP_TYPE:
         return _trace_grad_op(op, env, ctx)
 
     opdef = get_op(op.type)
     ins = _gather_inputs(op, env)
-    ctx.begin_op(op.desc_id)
+    ctx.begin_op(rng_tag)
 
     prev_outer = ctx.outer_env
     if opdef.uses_subblock:
         ctx.outer_env = env
     try:
         if op.desc_id in ctx.want_vjp and opdef.differentiable:
-            outs = _trace_with_vjp(op, opdef, ins, ctx)
+            outs = _trace_with_vjp(op, opdef, ins, ctx, rng_tag=rng_tag)
         else:
             outs = opdef.fn(ctx, ins, op.attrs)
     finally:
@@ -133,7 +139,7 @@ def _split_diff(opdef, ins):
     return flat, slots
 
 
-def _trace_with_vjp(op, opdef, ins, ctx, desc_id=None):
+def _trace_with_vjp(op, opdef, ins, ctx, desc_id=None, rng_tag=0):
     desc_id = op.desc_id if desc_id is None else desc_id
     flat, in_slots = _split_diff(opdef, ins)
 
@@ -141,7 +147,7 @@ def _trace_with_vjp(op, opdef, ins, ctx, desc_id=None):
         ins2 = {s: list(vs) for s, vs in ins.items()}
         for (slot, i), v in zip(in_slots, flat_vals):
             ins2[slot][i] = v
-        ctx.begin_op(desc_id)  # reset rng so replays are identical
+        ctx.begin_op(rng_tag)  # reset rng so replays are identical
         outs = opdef.fn(ctx, ins2, op.attrs)
         return {s: (list(v) if isinstance(v, (list, tuple)) else [v])
                 for s, v in outs.items()}

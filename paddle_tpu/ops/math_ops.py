@@ -225,14 +225,17 @@ def _reduce(fn):
     def kernel(ctx, ins, attrs):
         x = _x(ins)
         dims = attrs.get("dim", [0])
-        if attrs.get("reduce_all", False) or dims is None:
+        reduce_all = attrs.get("reduce_all", False) or dims is None
+        if reduce_all:
             axes = tuple(range(x.ndim))
         else:
             if not isinstance(dims, (list, tuple)):
                 dims = [dims]
             axes = tuple(d % x.ndim for d in dims)
-        return {"Out": fn(x, axis=axes,
-                          keepdims=attrs.get("keep_dim", False))}
+        out = fn(x, axis=axes, keepdims=attrs.get("keep_dim", False))
+        if reduce_all and not attrs.get("keep_dim", False):
+            out = out.reshape((1,))  # fluid returns shape [1], not 0-d
+        return {"Out": out}
     return kernel
 
 

@@ -1,0 +1,47 @@
+"""Test config: force CPU backend with 8 virtual devices BEFORE jax import,
+so sharding/collective tests run anywhere (mirrors how the driver validates
+multi-chip via xla_force_host_platform_device_count)."""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# site customizations (e.g. the axon TPU plugin) may force jax_platforms;
+# override via config so tests always get the 8-device virtual CPU mesh.
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def fresh_programs():
+    """Give every test fresh default programs + scope + name generator."""
+    import paddle_tpu as pt
+    from paddle_tpu.framework.program import (switch_main_program,
+                                              switch_startup_program,
+                                              Program)
+    from paddle_tpu.framework.scope import Scope, _global_scope
+    import paddle_tpu.framework.scope as scope_mod
+    from paddle_tpu.framework import unique_name
+
+    old_main = switch_main_program(Program())
+    old_startup = switch_startup_program(Program())
+    old_scope = scope_mod._global_scope
+    scope_mod._global_scope = Scope()
+    old_gen = unique_name.switch()
+    yield
+    switch_main_program(old_main)
+    switch_startup_program(old_startup)
+    scope_mod._global_scope = old_scope
+    unique_name.switch(old_gen)
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(42)

@@ -1,0 +1,149 @@
+"""Autodiff tests: graph-level append_backward vs jax.grad ground truth
+(reference test model: OpTest numeric grad checks in tests/unittests)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+
+
+def _run_train_grads(build_fn, feeds, param_names):
+    """Build model, append backward, return dict of param grads."""
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        loss = build_fn()
+        pgs = pt.append_backward(loss)
+    exe = pt.Executor()
+    exe.run(startup)
+    fetch = [g.name for p, g in pgs]
+    outs = exe.run(main, feed=feeds, fetch_list=fetch + [loss.name])
+    grads = {p.name: o for (p, g), o in zip(pgs, outs[:-1])}
+    return grads, outs[-1], {p.name: pt.global_scope().get_numpy(p.name)
+                             for p, _ in pgs}
+
+
+def test_fc_grads_match_jax():
+    x = np.random.RandomState(0).rand(5, 4).astype(np.float32)
+    t = np.random.RandomState(1).rand(5, 2).astype(np.float32)
+
+    def build():
+        xin = layers.data("x", [4], dtype="float32")
+        tin = layers.data("t", [2], dtype="float32")
+        y = layers.fc(xin, size=2, param_attr=pt.ParamAttr(name="w"),
+                      bias_attr=pt.ParamAttr(name="b"))
+        return layers.mean(layers.square_error_cost(y, tin))
+
+    grads, loss, params = _run_train_grads(build, {"x": x, "t": t},
+                                           ["w", "b"])
+    w, b = params["w"], params["b"]
+
+    def ref_loss(w, b):
+        y = x @ w + b
+        return jnp.mean((y - t) ** 2)
+
+    gw, gb = jax.grad(ref_loss, argnums=(0, 1))(w, b)
+    np.testing.assert_allclose(grads["w"], gw, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(grads["b"], gb, rtol=1e-5, atol=1e-6)
+
+
+def test_grad_accumulation_multi_consumer():
+    """A var consumed by two ops must receive summed gradients."""
+    x = np.array([[2.0, 3.0]], np.float32)
+
+    def build():
+        xin = layers.data("x", [2], dtype="float32")
+        w = layers.create_parameter([2], "float32", name="wp",
+                                    default_initializer=
+                                    pt.initializer.Constant(2.0))
+        a = layers.elementwise_mul(xin, w)   # consumer 1
+        b = layers.elementwise_add(xin, w)   # consumer 2
+        s = layers.elementwise_add(a, b)
+        return layers.mean(s)
+
+    grads, loss, params = _run_train_grads(build, {"x": x}, ["wp"])
+    # d/dw mean(x*w + x + w) = (x + 1) / 2
+    np.testing.assert_allclose(grads["wp"], (x[0] + 1) / 2, rtol=1e-6)
+
+
+def test_stop_gradient_blocks_path():
+    x = np.ones((2, 3), np.float32)
+
+    def build():
+        xin = layers.data("x", [3], dtype="float32")
+        w = layers.create_parameter([3], "float32", name="w1",
+                                    default_initializer=
+                                    pt.initializer.Constant(1.0))
+        w2 = layers.create_parameter([3], "float32", name="w2",
+                                     default_initializer=
+                                     pt.initializer.Constant(1.0))
+        a = layers.elementwise_mul(xin, w)
+        a.stop_gradient = True
+        b = layers.elementwise_mul(a, w2)
+        return layers.mean(b)
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        loss = build()
+        pgs = pt.append_backward(loss)
+    names = [p.name for p, g in pgs]
+    assert "w2" in names and "w1" not in names
+
+
+def test_gradients_api():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [3], dtype="float32")
+        x.stop_gradient = False
+        y = layers.reduce_sum(layers.square(x))
+        gx, = pt.gradients(y, [x])
+    exe = pt.Executor()
+    xv = np.array([[1.0, 2.0, 3.0]], np.float32)
+    out, = exe.run(main, feed={"x": xv}, fetch_list=[gx])
+    np.testing.assert_allclose(out, 2 * xv, rtol=1e-6)
+
+
+def test_conv_bn_pool_backward_runs():
+    x = np.random.RandomState(0).rand(2, 3, 8, 8).astype(np.float32)
+    lbl = np.random.RandomState(1).randint(0, 10, (2, 1)).astype(np.int64)
+
+    def build():
+        xin = layers.data("im", [3, 8, 8], dtype="float32")
+        lin = layers.data("lbl", [1], dtype="int64")
+        c = layers.conv2d(xin, 4, 3, padding=1, act="relu")
+        c = layers.batch_norm(c)
+        p = layers.pool2d(c, 2, "max", 2)
+        f = layers.fc(p, size=10)
+        loss = layers.mean(
+            layers.softmax_with_cross_entropy(f, lin))
+        return loss
+
+    grads, loss, params = _run_train_grads(build, {"im": x, "lbl": lbl}, [])
+    assert np.isfinite(loss).all()
+    for g in grads.values():
+        assert np.isfinite(g).all()
+
+
+def test_dropout_grad_deterministic_with_forward():
+    """grad must use the SAME mask as forward (vjp pairing)."""
+    x = np.ones((1, 400), np.float32)
+
+    def build():
+        xin = layers.data("x", [400], dtype="float32")
+        w = layers.create_parameter([400], "float32", name="wd",
+                                    default_initializer=
+                                    pt.initializer.Constant(1.0))
+        h = layers.elementwise_mul(xin, w)
+        d = layers.dropout(h, 0.5)
+        return layers.mean(d)
+
+    grads, loss, _ = _run_train_grads(build, {"x": x}, ["wd"])
+    g = grads["wd"]
+    # gradient nonzero exactly where the forward mask kept elements ->
+    # about half, each contributing 1/400 (mean over 400 elements)
+    nz = (np.abs(g) > 0).mean()
+    assert 0.3 < nz < 0.7
+    vals = g[np.abs(g) > 0]
+    np.testing.assert_allclose(vals, 1.0 / 400, rtol=1e-5)
+    # and the kept fraction must equal the forward loss (same mask!)
+    np.testing.assert_allclose(float(loss[0]), nz * 1.0, rtol=1e-5)

@@ -1,0 +1,115 @@
+"""Core IR + executor tests (reference test model: tests/unittests/
+test_program.py, test_executor_*.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+
+
+def test_program_build():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [4], dtype="float32")
+        y = layers.fc(x, size=3)
+    assert x.shape == (-1, 4)
+    assert y.shape == (-1, 3)
+    types = [op.type for op in main.global_block().ops]
+    assert "mul" in types and "elementwise_add" in types
+    # params created in both programs, init ops in startup
+    assert len(main.all_parameters()) == 2
+    assert len(startup.global_block().ops) == 2
+
+
+def test_program_clone_and_serialize():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [4], dtype="float32")
+        h = layers.fc(x, size=3, act="relu")
+        d = layers.dropout(h, 0.5)
+    test_prog = main.clone(for_test=True)
+    drop_ops = [op for op in test_prog.global_block().ops
+                if op.type == "dropout"]
+    assert drop_ops[0].attrs["is_test"] is True
+    # round trip
+    js = main.to_json()
+    restored = pt.Program.from_json(js)
+    assert [o.type for o in restored.global_block().ops] == \
+        [o.type for o in main.global_block().ops]
+    assert len(restored.all_parameters()) == len(main.all_parameters())
+
+
+def test_executor_feed_fetch():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [3], dtype="float32")
+        y = layers.scale(x, scale=2.0, bias=1.0)
+    exe = pt.Executor()
+    xv = np.arange(6, dtype=np.float32).reshape(2, 3)
+    out, = exe.run(main, feed={"x": xv}, fetch_list=[y])
+    np.testing.assert_allclose(out, xv * 2 + 1, rtol=1e-6)
+
+
+def test_executor_compile_cache():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [3], dtype="float32")
+        y = layers.scale(x, scale=3.0)
+    exe = pt.Executor()
+    xv = np.ones((2, 3), np.float32)
+    exe.run(main, feed={"x": xv}, fetch_list=[y])
+    assert len(exe._cache) == 1
+    exe.run(main, feed={"x": xv * 2}, fetch_list=[y])
+    assert len(exe._cache) == 1            # same signature -> cached
+    exe.run(main, feed={"x": np.ones((4, 3), np.float32)}, fetch_list=[y])
+    assert len(exe._cache) == 2            # new batch size -> new entry
+
+
+def test_persistable_state_updates():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        counter = layers.create_global_var([1], 0.0, "float32",
+                                           persistable=True)
+        layers.increment(counter, value=1.0)
+        out = layers.scale(counter, scale=1.0)
+    exe = pt.Executor()
+    exe.run(startup)
+    for i in range(3):
+        val, = exe.run(main, feed={}, fetch_list=[out])
+    assert float(val[0]) == 3.0
+
+
+def test_startup_initializers():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [4], dtype="float32")
+        layers.fc(x, size=8,
+                  param_attr=pt.ParamAttr(
+                      name="w_init_test",
+                      initializer=pt.initializer.Constant(0.5)))
+    exe = pt.Executor()
+    exe.run(startup)
+    w = pt.global_scope().get_numpy("w_init_test")
+    assert w.shape == (4, 8)
+    np.testing.assert_allclose(w, 0.5)
+
+
+def test_scope_guard_isolation():
+    from paddle_tpu.framework.scope import Scope, scope_guard
+    s1 = Scope()
+    with scope_guard(s1):
+        pt.global_scope().set_var("a", 1)
+    assert s1.find_var("a") == 1
+    assert pt.global_scope().find_var("a") is None
+
+
+def test_prune():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [4], dtype="float32")
+        h = layers.fc(x, size=3)
+        y = layers.softmax(h)
+        z = layers.scale(h, scale=5.0)  # not needed for y
+    pruned = main._prune(["x"], [y.name])
+    types = [op.type for op in pruned.global_block().ops]
+    assert "softmax" in types and "scale" not in types

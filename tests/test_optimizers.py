@@ -1,0 +1,154 @@
+"""Optimizer tests (reference model: tests/unittests/test_*_op.py for
+optimizer ops + convergence behavior)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers, optimizer
+
+
+def _quadratic_setup(opt, steps=60):
+    """Minimize ||w - 3||^2; return final w."""
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        w = layers.create_parameter(
+            [4], "float32", name="wq",
+            default_initializer=pt.initializer.Constant(0.0))
+        target = layers.fill_constant([4], "float32", 3.0)
+        loss = layers.reduce_mean(layers.square(w - target))
+        opt.minimize(loss)
+    exe = pt.Executor()
+    exe.run(startup)
+    for _ in range(steps):
+        loss_v, = exe.run(main, feed={}, fetch_list=[loss])
+    return pt.global_scope().get_numpy("wq"), float(loss_v[0])
+
+
+@pytest.mark.parametrize("opt_fn", [
+    lambda: optimizer.SGD(learning_rate=0.4),
+    lambda: optimizer.Momentum(learning_rate=0.2, momentum=0.9),
+    lambda: optimizer.Momentum(learning_rate=0.2, momentum=0.9,
+                               use_nesterov=True),
+    lambda: optimizer.Adam(learning_rate=0.3),
+    lambda: optimizer.AdamW(learning_rate=0.3, weight_decay=0.001),
+    lambda: optimizer.Adagrad(learning_rate=0.9),
+    lambda: optimizer.DecayedAdagrad(learning_rate=0.5),
+    lambda: optimizer.RMSProp(learning_rate=0.3),
+    lambda: optimizer.Adamax(learning_rate=0.4),
+    lambda: optimizer.Lamb(learning_rate=0.1, lamb_weight_decay=0.0),
+    lambda: optimizer.LarsMomentum(learning_rate=0.2, momentum=0.9),
+    lambda: optimizer.Ftrl(learning_rate=0.8),
+], ids=["sgd", "momentum", "nesterov", "adam", "adamw", "adagrad",
+        "decayed_adagrad", "rmsprop", "adamax", "lamb", "lars", "ftrl"])
+def test_optimizer_converges(opt_fn):
+    w, loss = _quadratic_setup(opt_fn())
+    assert loss < 0.5, "final loss %.4f too high" % loss
+    np.testing.assert_allclose(w, 3.0, atol=1.0)
+
+
+def test_sgd_exact_step():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        w = layers.create_parameter(
+            [2], "float32", name="w_sgd",
+            default_initializer=pt.initializer.Constant(1.0))
+        loss = layers.reduce_sum(layers.square(w))  # dL/dw = 2w
+        optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = pt.Executor()
+    exe.run(startup)
+    exe.run(main, feed={}, fetch_list=[loss])
+    np.testing.assert_allclose(pt.global_scope().get_numpy("w_sgd"),
+                               0.8, rtol=1e-6)  # 1 - 0.1*2
+
+
+def test_regularizer_l2():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        w = layers.create_parameter(
+            [2], "float32", name="w_l2",
+            default_initializer=pt.initializer.Constant(1.0))
+        loss = layers.reduce_sum(w * 0.0)  # zero data grad
+        opt = optimizer.SGD(learning_rate=0.1,
+                            regularization=pt.regularizer.L2Decay(0.5))
+        opt.minimize(loss)
+    exe = pt.Executor()
+    exe.run(startup)
+    exe.run(main, feed={}, fetch_list=[loss])
+    # grad = 0 + 0.5*w -> w_new = 1 - 0.1*0.5 = 0.95
+    np.testing.assert_allclose(pt.global_scope().get_numpy("w_l2"),
+                               0.95, rtol=1e-6)
+
+
+def test_grad_clip_by_global_norm():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        w = layers.create_parameter(
+            [4], "float32", name="w_gc",
+            default_initializer=pt.initializer.Constant(10.0))
+        loss = layers.reduce_sum(layers.square(w))  # grad = 2w = 20 each
+        opt = optimizer.SGD(
+            learning_rate=1.0,
+            grad_clip=pt.clip.GradientClipByGlobalNorm(1.0))
+        opt.minimize(loss)
+    exe = pt.Executor()
+    exe.run(startup)
+    exe.run(main, feed={}, fetch_list=[loss])
+    w_new = pt.global_scope().get_numpy("w_gc")
+    # global norm = 40; scale = 1/40; step = 20/40 = 0.5 per element
+    np.testing.assert_allclose(w_new, 9.5, rtol=1e-5)
+
+
+def test_lr_scheduler_piecewise():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        lr = layers.piecewise_decay([2, 4], [1.0, 0.5, 0.25])
+        w = layers.create_parameter(
+            [1], "float32", name="w_lr",
+            default_initializer=pt.initializer.Constant(0.0))
+        loss = layers.reduce_sum(w)  # grad = 1
+        optimizer.SGD(learning_rate=lr).minimize(loss)
+    exe = pt.Executor()
+    exe.run(startup)
+    seen = []
+    for _ in range(5):
+        lv, = exe.run(main, feed={}, fetch_list=[lr])
+        seen.append(float(lv[0]))
+    assert seen == [1.0, 1.0, 0.5, 0.5, 0.25]
+
+
+def test_noam_and_exponential_decay_run():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        lr = layers.exponential_decay(0.1, decay_steps=2, decay_rate=0.5)
+        w = layers.create_parameter(
+            [1], "float32", name="w_e",
+            default_initializer=pt.initializer.Constant(0.0))
+        loss = layers.reduce_sum(w)
+        optimizer.SGD(learning_rate=lr).minimize(loss)
+    exe = pt.Executor()
+    exe.run(startup)
+    vals = [float(exe.run(main, feed={}, fetch_list=[lr])[0][0])
+            for _ in range(4)]
+    np.testing.assert_allclose(
+        vals, [0.1 * 0.5 ** (i / 2.0) for i in range(4)], rtol=1e-5)
+
+
+def test_ema():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        w = layers.create_parameter(
+            [1], "float32", name="w_ema",
+            default_initializer=pt.initializer.Constant(1.0))
+        loss = layers.reduce_sum(w)
+        optimizer.SGD(learning_rate=0.0).minimize(loss)
+        ema = optimizer.ExponentialMovingAverage(0.5)
+        ema.update()
+    exe = pt.Executor()
+    exe.run(startup)
+    exe.run(main, feed={}, fetch_list=[loss])
+    exe.run(main, feed={}, fetch_list=[loss])
+    # ema after 2 steps from 0: 0.5*(0.5*0+0.5*1)+0.5*1 = 0.75
+    with ema.apply(exe):
+        np.testing.assert_allclose(
+            pt.global_scope().get_numpy("w_ema"), 0.75, rtol=1e-6)
+    np.testing.assert_allclose(pt.global_scope().get_numpy("w_ema"), 1.0)
