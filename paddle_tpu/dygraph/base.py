@@ -117,7 +117,9 @@ class EagerVariable(object):
 def to_variable(value, name=None, zero_copy=None):
     if isinstance(value, EagerVariable):
         return value
-    return EagerVariable(np.asarray(value), name=name)
+    # jnp.asarray in the constructor handles numpy, jax arrays AND tracers
+    # (so functionalized forwards can be jitted/grad-ed through)
+    return EagerVariable(value, name=name)
 
 
 @contextlib.contextmanager

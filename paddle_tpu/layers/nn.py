@@ -508,7 +508,12 @@ def split(input, num_or_sections, dim=-1, name=None):
 
 def stack(x, axis=0):
     helper = LayerHelper("stack")
-    out = helper.create_variable_for_type_inference(x[0].dtype)
+    shape = None
+    if x[0].shape is not None:
+        shape = list(x[0].shape)
+        shape.insert(axis if axis >= 0 else axis + len(shape) + 1, len(x))
+        shape = tuple(shape)
+    out = helper.create_variable_for_type_inference(x[0].dtype, shape)
     helper.append_op("stack", inputs={"X": [v.name for v in x]},
                      outputs={"Y": [out.name]}, attrs={"axis": axis})
     return out
@@ -527,7 +532,18 @@ def unstack(x, axis=0, num=None):
 
 def slice(input, axes, starts, ends):
     helper = LayerHelper("slice")
-    out = helper.create_variable_for_type_inference(input.dtype)
+    shape = None
+    if input.shape is not None:
+        shape = list(input.shape)
+        for a, s, e in zip(axes, starts, ends):
+            dim = shape[a]
+            if dim == -1:
+                continue
+            s2 = max(s + dim, 0) if s < 0 else min(s, dim)
+            e2 = max(e + dim, 0) if e < 0 else min(e, dim)
+            shape[a] = max(e2 - s2, 0)
+        shape = tuple(shape)
+    out = helper.create_variable_for_type_inference(input.dtype, shape)
     helper.append_op("slice", inputs={"Input": [input.name]},
                      outputs={"Out": [out.name]},
                      attrs={"axes": list(axes), "starts": list(starts),
@@ -550,7 +566,11 @@ def expand(x, expand_times, name=None):
 
 def gather(input, index, overwrite=True):
     helper = LayerHelper("gather")
-    out = helper.create_variable_for_type_inference(input.dtype)
+    shape = None
+    if input.shape is not None and index.shape is not None:
+        m = index.shape[0]
+        shape = (m,) + tuple(input.shape[1:])
+    out = helper.create_variable_for_type_inference(input.dtype, shape)
     helper.append_op("gather", inputs={"X": [input.name],
                                        "Index": [index.name]},
                      outputs={"Out": [out.name]})

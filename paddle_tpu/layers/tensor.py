@@ -117,9 +117,17 @@ def fill_constant_batch_size_like(input, shape, dtype, value,
     return out
 
 
+def _argminmax_shape(x, axis):
+    if x.shape is None:
+        return None
+    nd = len(x.shape)
+    return tuple(s for i, s in enumerate(x.shape) if i != axis % nd)
+
+
 def argmin(x, axis=0):
     helper = LayerHelper("argmin")
-    out = helper.create_variable_for_type_inference("int64")
+    out = helper.create_variable_for_type_inference(
+        "int64", _argminmax_shape(x, axis))
     helper.append_op("arg_min", inputs={"X": [x.name]},
                      outputs={"Out": [out.name]}, attrs={"axis": axis})
     out.stop_gradient = True
@@ -128,7 +136,8 @@ def argmin(x, axis=0):
 
 def argmax(x, axis=0):
     helper = LayerHelper("argmax")
-    out = helper.create_variable_for_type_inference("int64")
+    out = helper.create_variable_for_type_inference(
+        "int64", _argminmax_shape(x, axis))
     helper.append_op("arg_max", inputs={"X": [x.name]},
                      outputs={"Out": [out.name]}, attrs={"axis": axis})
     out.stop_gradient = True

@@ -1,0 +1,14 @@
+"""Model zoo — the BASELINE.json configs (reference: PaddlePaddle/models +
+LARK/ERNIE repos, rebuilt on paddle_tpu layers).
+
+- bert: BERT-base / ERNIE 1.0 pretraining (flagship benchmark)
+- resnet: ResNet-50 image classification
+- transformer: Transformer-base NMT
+- deepfm: DeepFM CTR with high-dim sparse embeddings
+- simple: MLP/word2vec smoke models (book tests)
+"""
+from . import bert
+from . import resnet
+from . import transformer
+from . import deepfm
+from . import simple

@@ -1,0 +1,81 @@
+"""Dygraph tests (reference: tests/unittests/test_imperative_*)."""
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import dygraph
+from paddle_tpu.dygraph import (Linear, Conv2D, BatchNorm, Embedding,
+                                LayerNorm, Sequential, to_variable)
+
+
+def test_eager_math():
+    with dygraph.guard():
+        a = to_variable(np.array([1.0, 2.0], np.float32))
+        b = to_variable(np.array([3.0, 4.0], np.float32))
+        c = a * b + 2.0
+        np.testing.assert_allclose(c.numpy(), [5.0, 10.0])
+
+
+def test_linear_forward_and_grad():
+    with dygraph.guard():
+        layer = Linear(4, 2)
+        x = np.random.RandomState(0).rand(3, 4).astype(np.float32)
+
+        def loss_fn(out):
+            from paddle_tpu.dygraph.nn import run_op
+            return run_op("reduce_mean",
+                          {"X": [out]}, {"reduce_all": True})["Out"]
+
+        loss, grads = layer.loss_and_grad(loss_fn, x)
+        assert np.isfinite(loss.numpy()).all()
+        gw = layer.weight.gradient()
+        # d(mean(xW+b))/dW = x.mean(0)/out_dim broadcast
+        expect = np.tile(x.mean(0, keepdims=True).T / 2, (1, 2))
+        np.testing.assert_allclose(gw, expect, rtol=1e-5)
+
+
+def test_sequential_conv_bn():
+    with dygraph.guard():
+        model = Sequential(Conv2D(3, 8, 3, padding=1),
+                           BatchNorm(8, act="relu"))
+        x = to_variable(np.random.RandomState(0)
+                        .rand(2, 3, 8, 8).astype(np.float32))
+        out = model(x)
+        assert out.shape == (2, 8, 8, 8)
+        model.eval()
+        out2 = model(x)
+        assert out2.shape == (2, 8, 8, 8)
+
+
+def test_embedding_layernorm():
+    with dygraph.guard():
+        emb = Embedding([50, 16])
+        ln = LayerNorm(16)
+        ids = to_variable(np.array([[1], [4]], np.int64))
+        e = emb(ids)
+        out = ln(e)
+        assert out.shape == (2, 16)
+        np.testing.assert_allclose(out.numpy().mean(-1), 0.0, atol=1e-5)
+
+
+def test_state_dict_roundtrip(tmp_path):
+    from paddle_tpu.dygraph import save_dygraph, load_dygraph
+    with dygraph.guard():
+        l1 = Linear(4, 2)
+        sd = l1.state_dict()
+        save_dygraph(sd, str(tmp_path / "model"))
+        loaded, _ = load_dygraph(str(tmp_path / "model"))
+        l2 = Linear(4, 2)
+        l2.set_dict(loaded)
+        np.testing.assert_allclose(l2.weight.numpy(), l1.weight.numpy())
+
+
+def test_traced_layer_jit():
+    from paddle_tpu.dygraph.jit import TracedLayer
+    with dygraph.guard():
+        layer = Linear(4, 2)
+        x = np.random.RandomState(0).rand(3, 4).astype(np.float32)
+        eager = layer(to_variable(x)).numpy()
+        out, traced = TracedLayer.trace(layer, [x])
+        np.testing.assert_allclose(out.numpy(), eager, rtol=1e-6)
+        again = traced([x])
+        np.testing.assert_allclose(again.numpy(), eager, rtol=1e-6)

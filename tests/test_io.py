@@ -1,0 +1,93 @@
+"""save/load + inference freeze + checkpoint tests (reference:
+tests/unittests/test_io_save_load*, test_inference_model_io)."""
+import os
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import layers, optimizer
+
+
+def _simple_model():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [4], dtype="float32")
+        y = layers.fc(x, size=3, param_attr=pt.ParamAttr(name="w_io"),
+                      bias_attr=pt.ParamAttr(name="b_io"))
+    return main, startup, x, y
+
+
+def test_save_load_params(tmp_path):
+    main, startup, x, y = _simple_model()
+    exe = pt.Executor()
+    exe.run(startup)
+    w0 = pt.global_scope().get_numpy("w_io").copy()
+    pt.save_params(exe, str(tmp_path), main_program=main)
+    # clobber and reload
+    import jax.numpy as jnp
+    pt.global_scope().set_var("w_io", jnp.zeros_like(w0))
+    pt.load_params(exe, str(tmp_path), main_program=main)
+    np.testing.assert_allclose(pt.global_scope().get_numpy("w_io"), w0)
+
+
+def test_inference_model_roundtrip(tmp_path):
+    main, startup, x, y = _simple_model()
+    exe = pt.Executor()
+    exe.run(startup)
+    xv = np.random.RandomState(0).rand(2, 4).astype(np.float32)
+    ref, = exe.run(main, feed={"x": xv}, fetch_list=[y])
+    pt.save_inference_model(str(tmp_path), ["x"], [y], exe,
+                            main_program=main)
+    # fresh scope + load
+    from paddle_tpu.framework.scope import Scope, scope_guard
+    with scope_guard(Scope()):
+        prog, feed_names, fetch_names = pt.load_inference_model(
+            str(tmp_path), exe)
+        out, = exe.run(prog, feed={feed_names[0]: xv},
+                       fetch_list=fetch_names)
+    np.testing.assert_allclose(out, ref, rtol=1e-6)
+
+
+def test_checkpoint_resume(tmp_path):
+    from paddle_tpu.io import save_checkpoint, load_checkpoint
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        w = layers.create_parameter(
+            [2], "float32", name="w_ck",
+            default_initializer=pt.initializer.Constant(0.0))
+        target = layers.fill_constant([2], "float32", 3.0)
+        loss = layers.reduce_mean(layers.square(w - target))
+        optimizer.Adam(0.1).minimize(loss)
+    exe = pt.Executor()
+    exe.run(startup)
+    for step in range(5):
+        exe.run(main, feed={}, fetch_list=[loss])
+    save_checkpoint(exe, str(tmp_path), main, step=5)
+    w5 = pt.global_scope().get_numpy("w_ck").copy()
+    for step in range(3):
+        exe.run(main, feed={}, fetch_list=[loss])
+    w8 = pt.global_scope().get_numpy("w_ck").copy()
+    # resume back to step 5 state (params + adam moments restored)
+    step = load_checkpoint(exe, str(tmp_path), main)
+    assert step == 5
+    np.testing.assert_allclose(pt.global_scope().get_numpy("w_ck"), w5)
+    for _ in range(3):
+        exe.run(main, feed={}, fetch_list=[loss])
+    np.testing.assert_allclose(pt.global_scope().get_numpy("w_ck"), w8,
+                               rtol=1e-6)
+
+
+def test_program_clone_for_test_dropout_deterministic():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [8], dtype="float32")
+        d = layers.dropout(layers.fc(x, 8), 0.5,
+                           dropout_implementation="upscale_in_train")
+        out = layers.reduce_sum(d)
+    test_prog = main.clone(for_test=True)
+    exe = pt.Executor()
+    exe.run(startup)
+    xv = np.ones((2, 8), np.float32)
+    a, = exe.run(test_prog, feed={"x": xv}, fetch_list=[out])
+    b, = exe.run(test_prog, feed={"x": xv}, fetch_list=[out])
+    np.testing.assert_allclose(a, b)  # no randomness in test mode

@@ -1,0 +1,108 @@
+"""Model zoo smoke tests: one train step per BASELINE config, loss finite
+and decreasing over a few steps (reference model: tests/book/)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers, optimizer
+
+
+def _train(main, startup, fetch, feed, steps=6):
+    exe = pt.Executor()
+    exe.run(startup)
+    losses = []
+    for _ in range(steps):
+        out, = exe.run(main, feed=feed, fetch_list=[fetch["loss"]])
+        losses.append(float(np.asarray(out).reshape(-1)[0]))
+    assert all(np.isfinite(losses)), losses
+    assert losses[-1] < losses[0], losses
+    return losses
+
+
+def test_bert_tiny_pretrain():
+    from paddle_tpu.models import bert
+    cfg = bert.BertConfig(vocab_size=256, hidden_size=32, num_layers=2,
+                          num_heads=2, ff_size=64, max_position=32)
+    main, startup, feeds, fetch = bert.bert_pretrain_program(
+        cfg, 2, 16, 4,
+        optimizer_fn=lambda l: optimizer.Adam(1e-3).minimize(l))
+    batch = bert.synthetic_batch(cfg, 2, 16, 4)
+    _train(main, startup, fetch, batch)
+
+
+def test_resnet18_tiny():
+    from paddle_tpu.models import resnet
+    main, startup, feeds, fetch = resnet.resnet_train_program(
+        depth=18, class_dim=10, image_shape=(3, 32, 32),
+        optimizer_fn=lambda l: optimizer.Momentum(0.01, 0.9).minimize(l))
+    rng = np.random.RandomState(0)
+    feed = {"image": rng.rand(4, 3, 32, 32).astype(np.float32),
+            "label": rng.randint(0, 10, (4, 1)).astype(np.int64)}
+    _train(main, startup, fetch, feed)
+
+
+def test_transformer_tiny():
+    from paddle_tpu.models import transformer as tr
+    cfg = tr.TransformerConfig(src_vocab=128, trg_vocab=128, d_model=32,
+                               d_inner=64, n_head=2, n_layer=2)
+    main, startup, feeds, fetch = tr.transformer_train_program(
+        cfg, 12, 10,
+        optimizer_fn=lambda l: optimizer.Adam(1e-3).minimize(l))
+    feed = tr.synthetic_batch(cfg, 2, 12, 10)
+    _train(main, startup, fetch, feed)
+
+
+def test_deepfm_tiny():
+    from paddle_tpu.models import deepfm
+    main, startup, feeds, fetch = deepfm.deepfm_train_program(
+        feature_dim=5000, embedding_size=8,
+        optimizer_fn=lambda l: optimizer.Adam(1e-2).minimize(l))
+    feed = deepfm.synthetic_batch(8, feature_dim=5000)
+    _train(main, startup, fetch, feed)
+
+
+def test_mlp_mnist_style_convergence():
+    """Book-style e2e: separable synthetic data to >90% accuracy."""
+    from paddle_tpu.models import simple
+    main, startup, feeds, fetch = simple.mlp_classifier_program(
+        input_dim=16, hidden=(32,), classes=2,
+        optimizer_fn=lambda l: optimizer.Adam(1e-2).minimize(l))
+    rng = np.random.RandomState(0)
+    w_true = rng.randn(16)
+    x = rng.randn(256, 16).astype(np.float32)
+    y = (x @ w_true > 0).astype(np.int64).reshape(-1, 1)
+    exe = pt.Executor()
+    exe.run(startup)
+    for _ in range(60):
+        loss, acc = exe.run(main, feed={"x": x, "y": y},
+                            fetch_list=[fetch["loss"], fetch["acc"]])
+    assert float(acc[0]) > 0.9, float(acc[0])
+
+
+def test_word2vec_tiny():
+    from paddle_tpu.models import simple
+    main, startup, feeds, fetch = simple.word2vec_program(
+        vocab_size=100, emb_size=16,
+        optimizer_fn=lambda l: optimizer.SGD(0.5).minimize(l))
+    rng = np.random.RandomState(0)
+    feed = {n: rng.randint(0, 100, (16, 1)).astype(np.int64) for n in feeds}
+    _train(main, startup, fetch, feed, steps=8)
+
+
+def test_transformer_greedy_decode_builds():
+    from paddle_tpu.models import transformer as tr
+    cfg = tr.TransformerConfig(src_vocab=64, trg_vocab=64, d_model=16,
+                               d_inner=32, n_head=2, n_layer=1, dropout=0.0)
+    # build + run train first so params exist
+    main, startup, feeds, fetch = tr.transformer_train_program(
+        cfg, 8, 6, optimizer_fn=None)
+    exe = pt.Executor()
+    exe.run(startup)
+    dec_main, dec_startup, dfeeds, dfetch = tr.greedy_decode_program(cfg, 8, 4)
+    rng = np.random.RandomState(0)
+    out, = exe.run(dec_main,
+                   feed={"src_ids": rng.randint(1, 64, (2, 8, 1))
+                         .astype(np.int64),
+                         "src_mask": np.ones((2, 8, 1), np.float32)},
+                   fetch_list=[dfetch["out_ids"]])
+    assert out.shape == (2, 4, 1)
