@@ -1,0 +1,7 @@
+"""contrib: mixed precision, quantization, extended optimizers.
+
+Reference parity: python/paddle/fluid/contrib/*.
+"""
+from . import mixed_precision
+from . import extend_optimizer
+from . import quantize

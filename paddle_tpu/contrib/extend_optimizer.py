@@ -1,0 +1,88 @@
+"""Extended optimizers: gradient merge (accumulation) + pipeline.
+
+Reference parity: fluid optimizer.py GradientMergeOptimizer /
+PipelineOptimizer (+ contrib/extend_optimizer). TPU-native notes:
+- GradientMerge: accumulate grads in persistable buffers, apply every k
+  steps via an on-device where-select on a step counter (no host branch —
+  everything stays inside the single jitted step).
+- Pipeline: on TPU, pipeline parallelism is expressed as a mesh "pp" axis
+  with stage-sharded weights; this wrapper annotates stage shardings. A
+  microbatched 1F1B schedule via lax.scan is tracked in SURVEY §7.
+"""
+from ..framework.program import default_main_program
+from ..framework import unique_name
+from ..initializer import ConstantInitializer
+from ..layer_helper import LayerHelper
+from .. import layers
+
+
+class GradientMergeOptimizer(object):
+    def __init__(self, inner_optimizer, k_steps=1, avg=True):
+        self.inner_optimizer = inner_optimizer
+        self.k_steps = k_steps
+        self.avg = avg
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        inner = self.inner_optimizer
+        params_grads = inner.backward(loss, startup_program,
+                                      parameter_list, no_grad_set)
+        if self.k_steps == 1:
+            inner.apply_gradients(params_grads)
+            return [], params_grads
+
+        helper = LayerHelper("gradient_merge")
+        step = layers.autoincreased_step_counter(
+            counter_name="@GRAD_MERGE_STEP@", begin=1)
+        stepf = layers.cast(step, "float32")
+        k = layers.fill_constant([1], "float32", float(self.k_steps))
+        rem = layers.elementwise_sub(
+            stepf,
+            layers.elementwise_mul(
+                layers.floor(layers.elementwise_div(stepf, k)), k))
+        is_apply = layers.equal(rem, 0.0)
+
+        merged = []
+        for p, g in params_grads:
+            acc = helper.create_global_variable(
+                name=unique_name.generate(p.name + ".grad_acc"),
+                dtype="float32", shape=p.shape, persistable=True)
+            helper.set_variable_initializer(acc, ConstantInitializer(0.0))
+            acc_new = layers.elementwise_add(acc, g)
+            scale = 1.0 / self.k_steps if self.avg else 1.0
+            apply_grad = layers.scale(acc_new, scale=scale)
+            # zero the buffer on apply steps, keep accumulating otherwise
+            from ..layers import tensor as T
+            T.assign(layers.where(is_apply, layers.zeros_like(acc_new),
+                                  acc_new), acc)
+            merged.append((p, apply_grad, acc_new))
+
+        # gate the actual update: on non-apply steps feed zero grads
+        gated = []
+        for p, apply_grad, _ in merged:
+            gated.append((p, layers.where(
+                is_apply, apply_grad, layers.zeros_like(apply_grad))))
+        inner.apply_gradients(gated)
+        return [], [(p, g) for p, g, _ in merged]
+
+
+class PipelineOptimizer(object):
+    def __init__(self, inner_optimizer, num_stages=2, num_microbatches=1,
+                 stage_axis="pp"):
+        self.inner_optimizer = inner_optimizer
+        self.num_stages = num_stages
+        self.num_microbatches = num_microbatches
+        self.stage_axis = stage_axis
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        program = loss.block.program
+        params = program.all_parameters()
+        # annotate contiguous parameter groups to pipeline stages; XLA's
+        # SPMD partitioner places each stage's weights on its pp slice
+        per_stage = max(1, len(params) // self.num_stages)
+        for i, p in enumerate(params):
+            stage = min(i // per_stage, self.num_stages - 1)
+            p.pipeline_stage = stage
+        return self.inner_optimizer.minimize(loss, startup_program,
+                                             parameter_list, no_grad_set)

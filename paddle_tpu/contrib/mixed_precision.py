@@ -1,0 +1,188 @@
+"""Automatic mixed precision (AMP).
+
+Reference parity: python/paddle/fluid/contrib/mixed_precision/
+(decorate, AutoMixedPrecisionLists, static+dynamic loss scaling).
+
+TPU-first: default compute dtype is bfloat16 — same exponent range as fp32,
+so loss scaling is OFF by default (reference needs it for fp16 on V100).
+The fp16 path with static/dynamic loss scaling is kept for parity.
+
+Mechanics: a program pass rewrites the already-built forward — inputs of
+white-list ops (matmul/conv/mul) are cast to the compute dtype, black-list
+ops (softmax_with_cross_entropy, layer_norm stats, sums/means) stay fp32.
+Parameters remain fp32 masters; XLA fuses/dedupes the inserted casts, so a
+parameter is cast once per step regardless of fan-out.
+"""
+from ..framework.program import Operator
+from ..framework import unique_name
+from ..layer_helper import LayerHelper
+from .. import layers
+
+WHITE_LIST = {"mul", "matmul", "conv2d", "depthwise_conv2d",
+              "conv2d_transpose", "conv3d", "scaled_dot_product_attention",
+              "lstm_seq", "gru_seq"}
+BLACK_LIST = {"softmax_with_cross_entropy", "cross_entropy", "layer_norm",
+              "batch_norm", "group_norm", "instance_norm", "mean",
+              "reduce_mean", "reduce_sum", "sum", "softmax", "log_softmax",
+              "exp", "log", "square", "sqrt", "rsqrt",
+              "sigmoid_cross_entropy_with_logits", "accuracy", "auc"}
+
+
+class AutoMixedPrecisionLists(object):
+    def __init__(self, custom_white_list=None, custom_black_list=None):
+        self.white_list = set(WHITE_LIST) | set(custom_white_list or ())
+        self.black_list = set(BLACK_LIST) | set(custom_black_list or ())
+
+
+def _cast_program_io(block, loss_name, lists, dtype):
+    """Insert casts so white-list ops run in `dtype`. Operates up to the
+    loss producer; rebuilds the op list in one pass."""
+    last = -1
+    for i, op in enumerate(block.ops):
+        if loss_name in op.output_names():
+            last = i
+    low_version = {}   # fp32 var name -> low-precision cast name
+    new_ops = []
+
+    def cast_to(name, target):
+        var = block._find_var_recursive(name)
+        if var is None or var.dtype not in ("float32",):
+            return name
+        key = (name, target)
+        if key in low_version:
+            return low_version[key]
+        out = unique_name.generate(name + ".cast_" + target)
+        block.create_var(name=out, shape=var.shape, dtype=target,
+                         stop_gradient=var.stop_gradient)
+        new_ops.append(Operator(
+            block, "cast", {"X": [name]}, {"Out": [out]},
+            {"in_dtype": "float32", "out_dtype": target,
+             "op_role": "amp"}))
+        low_version[key] = out
+        return out
+
+    produced_low = set()
+    for i, op in enumerate(block.ops):
+        if i > last >= 0:
+            new_ops.append(op)
+            continue
+        if op.type in lists.white_list:
+            op.inputs = {slot: [cast_to(n, dtype) for n in names]
+                         for slot, names in op.inputs.items()}
+            for n in op.output_names():
+                v = block._find_var_recursive(n)
+                if v is not None and v.dtype == "float32":
+                    v.dtype = dtype
+                    produced_low.add(n)
+            new_ops.append(op)
+        elif op.type in lists.black_list:
+            # force fp32 inputs
+            op.inputs = {slot: [cast_to(n, "float32")
+                                if n in produced_low else n
+                                for n in names]
+                         for slot, names in op.inputs.items()}
+            new_ops.append(op)
+        else:
+            new_ops.append(op)
+    block.ops = new_ops
+    block.program._version += 1
+
+
+class OptimizerWithMixedPrecision(object):
+    def __init__(self, optimizer, amp_lists, init_loss_scaling,
+                 use_dynamic_loss_scaling, incr_every_n_steps,
+                 decr_every_n_nan_or_inf, incr_ratio, decr_ratio, dtype):
+        self._optimizer = optimizer
+        self._amp_lists = amp_lists
+        self._init_loss_scaling = init_loss_scaling
+        self._dynamic = use_dynamic_loss_scaling
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._dtype = dtype
+        self._loss_scaling = None
+
+    def get_loss_scaling(self):
+        return self._loss_scaling
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        block = loss.block
+        _cast_program_io(block, loss.name, self._amp_lists, self._dtype)
+        # bf16 has fp32's exponent range: plain path, no scaling needed
+        use_scaling = (self._dtype == "float16" or
+                       self._init_loss_scaling != 1.0)
+        if not use_scaling:
+            return self._optimizer.minimize(loss, startup_program,
+                                            parameter_list, no_grad_set)
+
+        self._loss_scaling = layers.create_global_var(
+            [1], self._init_loss_scaling, "float32", persistable=True,
+            name=unique_name.generate("loss_scaling"))
+        scaled_loss = layers.elementwise_mul(loss, self._loss_scaling)
+        params_grads = self._optimizer.backward(
+            scaled_loss, startup_program, parameter_list, no_grad_set)
+
+        # check finiteness over all grads, unscale, zero on overflow
+        finite_flags = [layers.isfinite(g) for _, g in params_grads]
+        all_finite = finite_flags[0]
+        for f in finite_flags[1:]:
+            all_finite = layers.logical_and(all_finite, f)
+        inv_scale = layers.elementwise_div(
+            layers.fill_constant([1], "float32", 1.0), self._loss_scaling)
+        new_pgs = []
+        zero = layers.fill_constant([1], "float32", 0.0)
+        for p, g in params_grads:
+            g32 = layers.cast(g, "float32") if g.dtype != "float32" else g
+            unscaled = layers.elementwise_mul(g32, inv_scale)
+            safe = layers.where(all_finite, unscaled,
+                                layers.zeros_like(unscaled))
+            new_pgs.append((p, safe))
+
+        if self._dynamic:
+            self._append_dynamic_scale_update(all_finite)
+        self._optimizer.apply_gradients(new_pgs)
+        return [], new_pgs
+
+    def _append_dynamic_scale_update(self, all_finite):
+        """reference update_loss_scaling op: grow scale after N clean steps,
+        shrink on overflow — in-graph counters, no host sync."""
+        good = layers.create_global_var([1], 0.0, "float32",
+                                        persistable=True,
+                                        name=unique_name.generate(
+                                            "good_steps"))
+        one = layers.fill_constant([1], "float32", 1.0)
+        good_next = layers.where(all_finite,
+                                 layers.elementwise_add(good, one),
+                                 layers.zeros_like(good))
+        grow = layers.greater_equal(
+            good_next, layers.fill_constant([1], "float32",
+                                            float(self._incr_every)))
+        scale_grown = layers.elementwise_mul(
+            self._loss_scaling,
+            layers.fill_constant([1], "float32", self._incr_ratio))
+        scale_shrunk = layers.elementwise_mul(
+            self._loss_scaling,
+            layers.fill_constant([1], "float32", self._decr_ratio))
+        new_scale = layers.where(
+            all_finite,
+            layers.where(grow, scale_grown, self._loss_scaling),
+            scale_shrunk)
+        good_final = layers.where(grow, layers.zeros_like(good_next),
+                                  good_next)
+        from ..layers import tensor as T
+        T.assign(new_scale, self._loss_scaling)
+        T.assign(good_final, good)
+
+
+def decorate(optimizer, amp_lists=None, init_loss_scaling=1.0,
+             incr_every_n_steps=1000, decr_every_n_nan_or_inf=2,
+             incr_ratio=2.0, decr_ratio=0.8,
+             use_dynamic_loss_scaling=False, dtype="bfloat16"):
+    """fluid.contrib.mixed_precision.decorate work-alike; dtype="bfloat16"
+    (TPU default, no scaling) or "float16" (parity path with scaling)."""
+    return OptimizerWithMixedPrecision(
+        optimizer, amp_lists or AutoMixedPrecisionLists(),
+        init_loss_scaling, use_dynamic_loss_scaling, incr_every_n_steps,
+        decr_every_n_nan_or_inf, incr_ratio, decr_ratio, dtype)

@@ -1,0 +1,75 @@
+"""Post-training quantization (simulated int8).
+
+Reference parity: fluid/contrib/quantize + slim quantization passes —
+the subset that matters for inference: per-tensor abs-max int8 weight
+quantization with dequant-at-load, keeping XLA as the int8->bf16 engine.
+"""
+import numpy as np
+
+
+def quantize_weights_abs_max(arrays, bits=8):
+    """arrays: {name: np.ndarray fp32} -> ({name: int8 array},
+    {name: scale}). Symmetric per-tensor abs-max."""
+    qmax = 2 ** (bits - 1) - 1
+    q, scales = {}, {}
+    for name, arr in arrays.items():
+        a = np.asarray(arr, np.float32)
+        s = float(np.max(np.abs(a))) / qmax if a.size else 1.0
+        s = s if s > 0 else 1.0
+        q[name] = np.clip(np.round(a / s), -qmax - 1, qmax).astype(np.int8)
+        scales[name] = s
+    return q, scales
+
+
+def dequantize_weights(q, scales):
+    return {name: q[name].astype(np.float32) * scales[name] for name in q}
+
+
+def save_quantized_inference_model(dirname, feeded_var_names, target_vars,
+                                   executor, main_program=None, bits=8):
+    """save_inference_model variant storing int8 weights + scales."""
+    import os
+    import json
+    from ..io import (save_inference_model, _collect, _atomic_savez,
+                      PARAMS_FILE)
+    from ..framework.scope import global_scope
+    from ..framework.program import Parameter, default_main_program
+    program = main_program or default_main_program()
+    save_inference_model(dirname, feeded_var_names, target_vars, executor,
+                         main_program=program, program_only=True)
+    arrays = _collect(program, global_scope(),
+                      lambda v: isinstance(v, Parameter))
+    others = _collect(program, global_scope(),
+                      lambda v: v.persistable and
+                      not isinstance(v, Parameter))
+    q, scales = quantize_weights_abs_max(arrays, bits)
+    blob = dict(others)
+    for name in q:
+        blob[name + ".int8"] = q[name]
+    _atomic_savez(dirname, PARAMS_FILE, blob)
+    with open(os.path.join(dirname, "quant_scales.json"), "w") as f:
+        json.dump(scales, f)
+
+
+def load_quantized_inference_model(dirname, executor):
+    import os
+    import json
+    import jax.numpy as jnp
+    from ..io import _load_arrays, MODEL_FILE
+    from ..framework.program import Program
+    from ..framework.scope import global_scope
+    with open(os.path.join(dirname, MODEL_FILE)) as f:
+        meta = json.load(f)
+    with open(os.path.join(dirname, "quant_scales.json")) as f:
+        scales = json.load(f)
+    arrays = _load_arrays(dirname, None)
+    scope = global_scope()
+    for name, arr in arrays.items():
+        if name.endswith(".int8"):
+            base = name[:-5]
+            scope.set_var(base, jnp.asarray(
+                arr.astype(np.float32) * scales[base]))
+        else:
+            scope.set_var(name, jnp.asarray(arr))
+    program = Program.from_dict(meta["program"])
+    return program, meta["feed_var_names"], meta["fetch_var_names"]

@@ -23,9 +23,14 @@ class TracedLayer(object):
         params = layer.parameters()
 
         def functional(param_vals, *raw):
-            for p, v in zip(params, param_vals):
-                p._value = v
-            outs = layer.forward(*[to_variable(x) for x in raw])
+            saved = [p._value for p in params]
+            try:
+                for p, v in zip(params, param_vals):
+                    p._value = v
+                outs = layer.forward(*[to_variable(x) for x in raw])
+            finally:
+                for p, v in zip(params, saved):
+                    p._value = v
             if isinstance(outs, (list, tuple)):
                 return tuple(o._value for o in outs)
             return outs._value

@@ -1,6 +1,208 @@
-"""Pallas TPU flash attention — placeholder raising until the kernel lands
-later this round; callers fall back to the fused XLA path."""
+"""Pallas TPU flash attention.
+
+The hot op of every BASELINE transformer config. Tiles Q/K/V blocks through
+VMEM with online-softmax accumulation — the (T,T) score matrix never touches
+HBM, so attention becomes MXU-bound instead of HBM-bound for long sequences.
+
+Forward: Pallas kernel, grid (B*H, Tq/BQ, Tk/BK), f32 accumulators in VMEM
+scratch persisting across the (innermost, sequential) k-block dimension.
+Backward: custom_vjp; this round it recomputes probabilities in plain XLA
+(O(T^2) only inside the fused backward, still exact); a Pallas backward
+kernel is the tracked next perf step (SURVEY §7).
+
+Layout contract: q, k, v are (B, H, T, D); additive mask broadcastable
+(B, 1, 1, Tk) or (B, 1, Tq, Tk). On CPU (tests) the kernel runs in
+interpret mode.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:
+    from jax.experimental.pallas import tpu as pltpu
+    _HAS_TPU_PALLAS = True
+except Exception:  # pragma: no cover
+    pltpu = None
+    _HAS_TPU_PALLAS = False
+
+_NEG_INF = -1e30
 
 
-def flash_attention(q, k, v, mask=None, scale=1.0, causal=False):
-    raise NotImplementedError("pallas flash attention not built yet")
+def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, acc_ref, m_ref, l_ref,
+                *, scale, causal, block_q, block_k, mask_mode):
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    def body():
+        q = q_ref[0].astype(jnp.float32)          # (BQ, D)
+        k = k_ref[0].astype(jnp.float32)          # (BK, D)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # (BQ, BK)
+        if mask_mode == "qk":
+            s = s + mask_ref[0, 0].astype(jnp.float32)
+        elif mask_mode == "k":
+            s = s + mask_ref[0, 0, 0][None, :].astype(jnp.float32)
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = kj * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+
+        m_prev = m_ref[:, :1]                      # (BQ, 1)
+        m_blk = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_blk)
+        p = jnp.exp(s - m_new)                     # (BQ, BK)
+        corr = jnp.exp(m_prev - m_new)             # (BQ, 1)
+        l_new = corr * l_ref[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
+        v = v_ref[0].astype(jnp.float32)
+        pv = jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)    # (BQ, D)
+        acc_ref[:] = acc_ref[:] * corr + pv
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    if causal:
+        # skip k-blocks strictly above the diagonal
+        @pl.when(kj * block_k <= qi * block_q + (block_q - 1))
+        def _():
+            body()
+    else:
+        body()
+
+    @pl.when(kj == nk - 1)
+    def _finalize():
+        o_ref[0] = (acc_ref[:] /
+                    jnp.maximum(l_ref[:, :1], 1e-30)).astype(o_ref.dtype)
+
+
+def _pallas_forward(q, k, v, mask, scale, causal, block_q, block_k,
+                    interpret):
+    if not _HAS_TPU_PALLAS:
+        raise NotImplementedError("pallas tpu backend unavailable")
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    bh = b * h
+    q3 = q.reshape(bh, tq, d)
+    k3 = k.reshape(bh, tk, d)
+    v3 = v.reshape(bh, tk, d)
+
+    grid = (bh, tq // block_q, tk // block_k)
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda bb, i, j: (bb, i, 0)),
+        pl.BlockSpec((1, block_k, d), lambda bb, i, j: (bb, j, 0)),
+        pl.BlockSpec((1, block_k, d), lambda bb, i, j: (bb, j, 0)),
+    ]
+    if mask is None:
+        mask_mode = "none"
+        mask_in = jnp.zeros((1, 1, 1, 1), q.dtype)
+        in_specs.append(pl.BlockSpec((1, 1, 1, 1),
+                                     lambda bb, i, j: (0, 0, 0, 0)))
+    elif mask.shape[2] == 1:
+        mask_mode = "k"
+        mask_in = mask
+
+        def _mask_idx_k(bb, i, j, hh=h):
+            return (bb // hh, 0, 0, j)
+        in_specs.append(pl.BlockSpec((1, 1, 1, block_k), _mask_idx_k))
+    else:
+        mask_mode = "qk"
+        mask_in = mask
+
+        def _mask_idx_qk(bb, i, j, hh=h):
+            return (bb // hh, 0, i, j)
+        in_specs.append(pl.BlockSpec((1, 1, block_q, block_k),
+                                     _mask_idx_qk))
+
+    scratch = [
+        pltpu.VMEM((block_q, d), jnp.float32),
+        pltpu.VMEM((block_q, 128), jnp.float32),
+        pltpu.VMEM((block_q, 128), jnp.float32),
+    ]
+
+    out = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k,
+                          mask_mode=mask_mode),
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bb, i, j: (bb, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
+        scratch_shapes=scratch,
+        interpret=interpret,
+    )(q3, k3, v3, mask_in)
+    return out.reshape(b, h, tq, d)
+
+
+def _xla_attention(q, k, v, mask, scale, causal):
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if mask is not None:
+        logits = logits + mask.astype(jnp.float32)
+    if causal:
+        tq, tk = logits.shape[-2], logits.shape[-1]
+        cm = jnp.tril(jnp.ones((tq, tk), bool), tk - tq)
+        logits = jnp.where(cm, logits, _NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash(q, k, v, mask, scale, causal, block_q, block_k, interpret):
+    return _pallas_forward(q, k, v, mask, scale, causal, block_q, block_k,
+                           interpret)
+
+
+def _flash_fwd(q, k, v, mask, scale, causal, block_q, block_k, interpret):
+    out = _pallas_forward(q, k, v, mask, scale, causal, block_q, block_k,
+                          interpret)
+    return out, (q, k, v, mask)
+
+
+def _flash_bwd(scale, causal, block_q, block_k, interpret, res, g):
+    q, k, v, mask = res
+
+    def f(q, k, v, mask):
+        return _xla_attention(q, k, v, mask, scale, causal)
+
+    if mask is None:
+        _, vjp = jax.vjp(lambda a, b, c: f(a, b, c, None), q, k, v)
+        dq, dk, dv = vjp(g)
+        return dq, dk, dv, None
+    _, vjp = jax.vjp(f, q, k, v, mask)
+    dq, dk, dv, dmask = vjp(g)
+    return dq, dk, dv, dmask
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, mask=None, scale=1.0, causal=False,
+                    block_q=128, block_k=128, interpret=None):
+    """Flash attention entry. q,k,v: (B,H,T,D). Falls back to interpret
+    mode off-TPU so tests exercise the same kernel, and to plain fused XLA
+    attention when shapes are too small to tile."""
+    if interpret is None:
+        interpret = jax.default_backend() not in ("tpu", "axon")
+    tq, tk = q.shape[2], k.shape[2]
+    bq, bk = min(block_q, tq), min(block_k, tk)
+    while tq % bq:
+        bq //= 2
+    while tk % bk:
+        bk //= 2
+    if bq < 8 or bk < 8 or q.shape[-1] % 8:
+        return _xla_attention(q, k, v, mask, scale, causal)
+    return _flash(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                  None if mask is None else jnp.asarray(mask),
+                  scale, causal, bq, bk, interpret)

@@ -1,0 +1,103 @@
+"""AMP / gradient merge / quantization tests."""
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import layers, optimizer
+from paddle_tpu.contrib import mixed_precision, extend_optimizer, quantize
+
+
+def _mlp_loss():
+    x = layers.data("x", [8], dtype="float32")
+    y = layers.data("y", [1], dtype="int64")
+    h = layers.fc(x, 16, act="relu")
+    logits = layers.fc(h, 4)
+    return layers.mean(layers.softmax_with_cross_entropy(logits, y)), x, y
+
+
+def _feed(rng):
+    return {"x": rng.rand(8, 8).astype(np.float32),
+            "y": rng.randint(0, 4, (8, 1)).astype(np.int64)}
+
+
+def test_amp_bf16_trains():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        loss, _, _ = _mlp_loss()
+        opt = mixed_precision.decorate(optimizer.Adam(1e-2),
+                                       dtype="bfloat16")
+        opt.minimize(loss)
+    # cast ops inserted; mul ops now consume bf16
+    types = [op.type for op in main.global_block().ops]
+    assert "cast" in types
+    exe = pt.Executor()
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    feed = _feed(rng)
+    l0 = float(exe.run(main, feed=feed, fetch_list=[loss])[0][0])
+    for _ in range(10):
+        l1 = float(exe.run(main, feed=feed, fetch_list=[loss])[0][0])
+    assert np.isfinite(l1) and l1 < l0
+
+
+def test_amp_fp16_dynamic_loss_scaling():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        loss, _, _ = _mlp_loss()
+        opt = mixed_precision.decorate(
+            optimizer.SGD(1e-2), dtype="float16",
+            init_loss_scaling=128.0, use_dynamic_loss_scaling=True,
+            incr_every_n_steps=2)
+        opt.minimize(loss)
+    exe = pt.Executor()
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    feed = _feed(rng)
+    scale_var = opt.get_loss_scaling()
+    l0 = float(exe.run(main, feed=feed, fetch_list=[loss])[0][0])
+    scales = []
+    for _ in range(4):
+        out = exe.run(main, feed=feed, fetch_list=[loss, scale_var])
+        scales.append(float(out[1][0]))
+    assert np.isfinite(out[0]).all()
+    assert scales[-1] >= 128.0  # grew after clean steps
+
+
+def test_gradient_merge():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        w = layers.create_parameter(
+            [1], "float32", name="w_gm",
+            default_initializer=pt.initializer.Constant(0.0))
+        loss = layers.reduce_sum(w)  # grad = 1 every step
+        gm = extend_optimizer.GradientMergeOptimizer(
+            optimizer.SGD(1.0), k_steps=4, avg=True)
+        gm.minimize(loss)
+    exe = pt.Executor()
+    exe.run(startup)
+    vals = []
+    for _ in range(8):
+        exe.run(main, feed={}, fetch_list=[loss])
+        vals.append(float(pt.global_scope().get_numpy("w_gm")[0]))
+    # updates (by -1.0 avg grad * lr) land only on steps 4 and 8
+    np.testing.assert_allclose(vals, [0, 0, 0, -1, -1, -1, -1, -2],
+                               atol=1e-6)
+
+
+def test_quantize_roundtrip(tmp_path):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [4], dtype="float32")
+        y = layers.fc(x, 3, param_attr=pt.ParamAttr(name="wq8"))
+    exe = pt.Executor()
+    exe.run(startup)
+    xv = np.random.RandomState(0).rand(2, 4).astype(np.float32)
+    ref, = exe.run(main, feed={"x": xv}, fetch_list=[y])
+    quantize.save_quantized_inference_model(str(tmp_path), ["x"], [y], exe,
+                                            main_program=main)
+    from paddle_tpu.framework.scope import Scope, scope_guard
+    with scope_guard(Scope()):
+        prog, feeds, fetches = quantize.load_quantized_inference_model(
+            str(tmp_path), exe)
+        out, = exe.run(prog, feed={"x": xv}, fetch_list=fetches)
+    # int8 quantization error bound
+    np.testing.assert_allclose(out, ref, atol=0.05)

@@ -1,0 +1,92 @@
+"""Pallas flash attention vs reference XLA attention (interpret mode on
+CPU — same kernel code path as TPU)."""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops.pallas.flash_attention import (flash_attention,
+                                                   _xla_attention)
+
+
+def _rand(shape, seed):
+    return np.random.RandomState(seed).randn(*shape).astype(np.float32)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_matches_reference(causal):
+    b, h, t, d = 2, 2, 64, 32
+    q, k, v = _rand((b, h, t, d), 0), _rand((b, h, t, d), 1), \
+        _rand((b, h, t, d), 2)
+    scale = d ** -0.5
+    out = flash_attention(q, k, v, scale=scale, causal=causal,
+                          block_q=16, block_k=16, interpret=True)
+    ref = _xla_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                         None, scale, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_flash_with_key_mask():
+    b, h, t, d = 2, 2, 32, 16
+    q, k, v = _rand((b, h, t, d), 0), _rand((b, h, t, d), 1), \
+        _rand((b, h, t, d), 2)
+    mask = np.zeros((b, 1, 1, t), np.float32)
+    mask[:, :, :, t // 2:] = -1e9  # mask out second half of keys
+    out = flash_attention(q, k, v, mask=mask, scale=0.25, block_q=8,
+                          block_k=8, interpret=True)
+    ref = _xla_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                         jnp.asarray(mask), 0.25, False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_flash_gradients():
+    b, h, t, d = 1, 2, 32, 16
+    q, k, v = _rand((b, h, t, d), 0), _rand((b, h, t, d), 1), \
+        _rand((b, h, t, d), 2)
+    scale = d ** -0.5
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, scale=scale, causal=True,
+                                       block_q=8, block_k=8,
+                                       interpret=True) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(_xla_attention(q, k, v, None, scale, True) ** 2)
+
+    g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=2e-3, atol=2e-4)
+
+
+def test_sdpa_op_uses_flash_on_request():
+    """The fused attention op routes impl='flash' through the kernel."""
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    from paddle_tpu.layers.attention import fused_attention
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        q = layers.data("q", [2, 32, 16], dtype="float32",
+                        append_batch_size=False)
+        q2 = layers.data("q2", [2, 2, 32, 16], dtype="float32",
+                         append_batch_size=False)
+    # direct kernel check through the op registry
+    from paddle_tpu.ops.registry import get_op
+
+    class Ctx:
+        def rng(self):
+            return jax.random.PRNGKey(0)
+
+    qv = _rand((2, 2, 32, 16), 0)
+    kv = _rand((2, 2, 32, 16), 1)
+    vv = _rand((2, 2, 32, 16), 2)
+    outs = get_op("scaled_dot_product_attention").fn(
+        Ctx(), {"Q": [jnp.asarray(qv)], "K": [jnp.asarray(kv)],
+                "V": [jnp.asarray(vv)]}, {"scale": 0.25, "impl": "auto"})
+    ref = _xla_attention(jnp.asarray(qv), jnp.asarray(kv), jnp.asarray(vv),
+                         None, 0.25, False)
+    np.testing.assert_allclose(np.asarray(outs["Out"]), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
