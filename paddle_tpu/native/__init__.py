@@ -1,0 +1,10 @@
+"""Native (C++) runtime components.
+
+Reference parity: the reference's C++ data plane (framework/data_feed.cc,
+operators/reader/*). Compiled on first use with g++ (cached under
+~/.cache/paddle_tpu); everything has a pure-Python fallback so the
+framework works without a toolchain.
+"""
+from .build import load_dataplane, native_available
+from .recordio import (RecordWriter, RecordReader, write_records,
+                       NativeDataLoader)

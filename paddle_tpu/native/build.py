@@ -1,0 +1,69 @@
+"""Build + load the native data plane via g++/ctypes (no pybind11 needed)."""
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+
+_SRC = os.path.join(os.path.dirname(__file__), "dataplane.cc")
+_lock = threading.Lock()
+_lib = None
+_build_error = None
+
+
+def _cache_dir():
+    d = os.environ.get("PADDLE_TPU_CACHE",
+                       os.path.expanduser("~/.cache/paddle_tpu"))
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _build():
+    with open(_SRC, "rb") as f:
+        digest = hashlib.sha1(f.read()).hexdigest()[:16]
+    so_path = os.path.join(_cache_dir(), "libdataplane_%s.so" % digest)
+    if not os.path.exists(so_path):
+        tmp = so_path + ".tmp.%d" % os.getpid()
+        cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+               _SRC, "-o", tmp]
+        subprocess.run(cmd, check=True, capture_output=True)
+        os.replace(tmp, so_path)
+    lib = ctypes.CDLL(so_path)
+    lib.dp_reader_create.restype = ctypes.c_void_p
+    lib.dp_reader_create.argtypes = [
+        ctypes.POINTER(ctypes.c_char_p), ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_uint, ctypes.c_int]
+    lib.dp_reader_next.restype = ctypes.c_int
+    lib.dp_reader_next.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.POINTER(ctypes.c_char)),
+        ctypes.POINTER(ctypes.c_int64)]
+    lib.dp_reader_destroy.argtypes = [ctypes.c_void_p]
+    lib.dp_free.argtypes = [ctypes.POINTER(ctypes.c_char)]
+    lib.dp_writer_create.restype = ctypes.c_void_p
+    lib.dp_writer_create.argtypes = [ctypes.c_char_p]
+    lib.dp_writer_write.restype = ctypes.c_int
+    lib.dp_writer_write.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                    ctypes.c_int64]
+    lib.dp_writer_close.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+def load_dataplane():
+    """Return the loaded native library, or None if unavailable."""
+    global _lib, _build_error
+    with _lock:
+        if _lib is not None or _build_error is not None:
+            return _lib
+        try:
+            _lib = _build()
+        except Exception as e:  # toolchain missing etc. -> python fallback
+            _build_error = e
+        return _lib
+
+
+def native_available():
+    return load_dataplane() is not None
+
+
+def build_error():
+    return _build_error

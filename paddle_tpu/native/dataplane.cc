@@ -1,0 +1,233 @@
+// Native data plane: threaded record reader with ring buffer + shuffle pool.
+//
+// Reference parity: paddle/fluid/framework/data_feed.cc (MultiSlotDataFeed,
+// channel-based readers) + operators/reader/buffered_reader.cc. The
+// reference feeds CUDA streams; here the consumer is the Python host thread
+// staging batches to TPU via jax.device_put, so the contract is:
+// N file-reader threads -> bounded ring buffer (+ optional shuffle pool)
+// -> single consumer pop.
+//
+// Record file format ("ptrec"):
+//   magic  u32 = 0x70747263 ("ptrc")
+//   len    u64 little-endian payload byte length
+//   hash   u64 FNV-1a of payload (integrity check, no zlib dependency)
+//   payload bytes
+//
+// Build: g++ -O2 -shared -fPIC -pthread (see build.py); exposed via ctypes.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <condition_variable>
+#include <deque>
+#include <mutex>
+#include <random>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMagic = 0x70747263u;
+
+uint64_t fnv1a(const char* data, size_t n) {
+  uint64_t h = 1469598103934665603ull;
+  for (size_t i = 0; i < n; ++i) {
+    h ^= static_cast<unsigned char>(data[i]);
+    h *= 1099511628211ull;
+  }
+  return h;
+}
+
+struct Record {
+  char* data;
+  int64_t len;
+};
+
+class RingBuffer {
+ public:
+  explicit RingBuffer(size_t capacity) : capacity_(capacity) {}
+
+  void Push(Record r) {
+    std::unique_lock<std::mutex> lk(mu_);
+    not_full_.wait(lk, [&] { return q_.size() < capacity_ || closed_; });
+    if (closed_) { std::free(r.data); return; }
+    q_.push_back(r);
+    not_empty_.notify_one();
+  }
+
+  bool Pop(Record* out) {
+    std::unique_lock<std::mutex> lk(mu_);
+    not_empty_.wait(lk, [&] { return !q_.empty() || (done_ && active_ == 0) ||
+                                     closed_; });
+    if (closed_ || (q_.empty() && done_ && active_ == 0)) return false;
+    *out = q_.front();
+    q_.pop_front();
+    not_full_.notify_one();
+    return true;
+  }
+
+  void ProducerStart() {
+    std::lock_guard<std::mutex> lk(mu_);
+    ++active_;
+  }
+
+  void ProducerDone() {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (--active_ == 0) { done_ = true; not_empty_.notify_all(); }
+  }
+
+  void Close() {
+    std::lock_guard<std::mutex> lk(mu_);
+    closed_ = true;
+    for (auto& r : q_) std::free(r.data);
+    q_.clear();
+    not_empty_.notify_all();
+    not_full_.notify_all();
+  }
+
+ private:
+  size_t capacity_;
+  std::deque<Record> q_;
+  std::mutex mu_;
+  std::condition_variable not_empty_, not_full_;
+  int active_ = 0;
+  bool done_ = false, closed_ = false;
+};
+
+class Reader {
+ public:
+  Reader(std::vector<std::string> paths, int buffer_records, int shuffle_pool,
+         unsigned seed, int num_threads)
+      : paths_(std::move(paths)),
+        ring_(buffer_records > 0 ? buffer_records : 256),
+        shuffle_pool_(shuffle_pool),
+        rng_(seed) {
+    int n = num_threads > 0 ? num_threads : 1;
+    if (n > static_cast<int>(paths_.size())) n = paths_.size();
+    if (n < 1) n = 1;
+    for (int t = 0; t < n; ++t) {
+      ring_.ProducerStart();
+      threads_.emplace_back([this, t, n] { ReadFiles(t, n); });
+    }
+  }
+
+  ~Reader() {
+    ring_.Close();
+    for (auto& th : threads_) th.join();
+  }
+
+  // Pops through the shuffle pool: fill pool to size, then emit a random
+  // element per pop (reference InMemoryDataFeed local shuffle).
+  bool Next(char** data, int64_t* len) {
+    while (shuffle_pool_ > 0 &&
+           static_cast<int>(pool_.size()) < shuffle_pool_) {
+      Record r;
+      if (!ring_.Pop(&r)) break;
+      pool_.push_back(r);
+    }
+    if (!pool_.empty()) {
+      std::uniform_int_distribution<size_t> d(0, pool_.size() - 1);
+      size_t i = d(rng_);
+      Record r = pool_[i];
+      pool_[i] = pool_.back();
+      pool_.pop_back();
+      *data = r.data;
+      *len = r.len;
+      return true;
+    }
+    Record r;
+    if (!ring_.Pop(&r)) return false;
+    *data = r.data;
+    *len = r.len;
+    return true;
+  }
+
+ private:
+  void ReadFiles(int tid, int stride) {
+    for (size_t i = tid; i < paths_.size(); i += stride) {
+      FILE* f = std::fopen(paths_[i].c_str(), "rb");
+      if (!f) continue;
+      while (true) {
+        uint32_t magic;
+        if (std::fread(&magic, 4, 1, f) != 1) break;
+        if (magic != kMagic) break;  // corrupt/truncated tail
+        uint64_t len, hash;
+        if (std::fread(&len, 8, 1, f) != 1) break;
+        if (std::fread(&hash, 8, 1, f) != 1) break;
+        if (len > (1ull << 33)) break;
+        char* buf = static_cast<char*>(std::malloc(len));
+        if (!buf || std::fread(buf, 1, len, f) != len) {
+          std::free(buf);
+          break;
+        }
+        if (fnv1a(buf, len) != hash) {  // integrity failure: stop this file
+          std::free(buf);
+          break;
+        }
+        ring_.Push({buf, static_cast<int64_t>(len)});
+      }
+      std::fclose(f);
+    }
+    ring_.ProducerDone();
+  }
+
+  std::vector<std::string> paths_;
+  RingBuffer ring_;
+  int shuffle_pool_;
+  std::vector<Record> pool_;
+  std::mt19937 rng_;
+  std::vector<std::thread> threads_;
+};
+
+struct Writer {
+  FILE* f;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* dp_reader_create(const char** paths, int n_paths, int buffer_records,
+                       int shuffle_pool, unsigned seed, int num_threads) {
+  std::vector<std::string> p;
+  for (int i = 0; i < n_paths; ++i) p.emplace_back(paths[i]);
+  return new Reader(std::move(p), buffer_records, shuffle_pool, seed,
+                    num_threads);
+}
+
+int dp_reader_next(void* r, char** data, int64_t* len) {
+  return static_cast<Reader*>(r)->Next(data, len) ? 1 : 0;
+}
+
+void dp_reader_destroy(void* r) { delete static_cast<Reader*>(r); }
+
+void dp_free(char* p) { std::free(p); }
+
+void* dp_writer_create(const char* path) {
+  FILE* f = std::fopen(path, "wb");
+  if (!f) return nullptr;
+  auto* w = new Writer();
+  w->f = f;
+  return w;
+}
+
+int dp_writer_write(void* vw, const char* data, int64_t len) {
+  auto* w = static_cast<Writer*>(vw);
+  uint64_t ulen = static_cast<uint64_t>(len);
+  uint64_t hash = fnv1a(data, len);
+  if (std::fwrite(&kMagic, 4, 1, w->f) != 1) return 0;
+  if (std::fwrite(&ulen, 8, 1, w->f) != 1) return 0;
+  if (std::fwrite(&hash, 8, 1, w->f) != 1) return 0;
+  if (std::fwrite(data, 1, len, w->f) != static_cast<size_t>(len)) return 0;
+  return 1;
+}
+
+void dp_writer_close(void* vw) {
+  auto* w = static_cast<Writer*>(vw);
+  std::fclose(w->f);
+  delete w;
+}
+
+}  // extern "C"

@@ -1,0 +1,176 @@
+"""RecordIO-style binary sample files + native prefetching loader.
+
+Reference parity: the reference's recordio reader (operators/reader/
+create_recordio_file_reader) and MultiSlot data feed. Samples are pickled
+tuples of numpy arrays; files are written/read through the C++ plane when
+available (threaded, checksummed, shuffle pool), pure Python otherwise.
+"""
+import ctypes
+import os
+import pickle
+import struct
+
+import numpy as np
+
+from .build import load_dataplane
+
+_MAGIC = 0x70747263
+
+
+def _fnv1a(data):
+    h = 0xcbf29ce484222325
+    for b in data:
+        h = ((h ^ b) * 0x100000001b3) & 0xFFFFFFFFFFFFFFFF
+    return h
+
+
+class RecordWriter(object):
+    def __init__(self, path):
+        self._lib = load_dataplane()
+        self._path = path
+        if self._lib is not None:
+            self._w = self._lib.dp_writer_create(path.encode())
+            if not self._w:
+                raise IOError("cannot open %s" % path)
+        else:
+            self._f = open(path, "wb")
+
+    def write(self, payload):
+        if not isinstance(payload, (bytes, bytearray)):
+            payload = pickle.dumps(payload, protocol=4)
+        if self._lib is not None:
+            ok = self._lib.dp_writer_write(self._w, bytes(payload),
+                                           len(payload))
+            if not ok:
+                raise IOError("write failed")
+        else:
+            self._f.write(struct.pack("<IQQ", _MAGIC, len(payload),
+                                      _fnv1a(payload)))
+            self._f.write(payload)
+
+    def write_sample(self, arrays):
+        self.write(pickle.dumps(tuple(np.asarray(a) for a in arrays),
+                                protocol=4))
+
+    def close(self):
+        if self._lib is not None:
+            self._lib.dp_writer_close(self._w)
+            self._w = None
+        else:
+            self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def write_records(path, samples):
+    with RecordWriter(path) as w:
+        n = 0
+        for s in samples:
+            w.write_sample(s)
+            n += 1
+    return n
+
+
+class RecordReader(object):
+    """Iterates raw payload bytes from one or more record files.
+
+    Native path: N reader threads + ring buffer + shuffle pool in C++.
+    """
+
+    def __init__(self, paths, buffer_records=256, shuffle_pool=0, seed=0,
+                 num_threads=2):
+        if isinstance(paths, str):
+            paths = [paths]
+        self._paths = list(paths)
+        self._buffer = buffer_records
+        self._pool = shuffle_pool
+        self._seed = seed
+        self._threads = num_threads
+        self._lib = load_dataplane()
+
+    def __iter__(self):
+        if self._lib is not None:
+            return self._iter_native()
+        return self._iter_python()
+
+    def _iter_native(self):
+        lib = self._lib
+        arr = (ctypes.c_char_p * len(self._paths))(
+            *[p.encode() for p in self._paths])
+        r = lib.dp_reader_create(arr, len(self._paths), self._buffer,
+                                 self._pool, self._seed, self._threads)
+        try:
+            data = ctypes.POINTER(ctypes.c_char)()
+            ln = ctypes.c_int64()
+            while lib.dp_reader_next(r, ctypes.byref(data),
+                                     ctypes.byref(ln)):
+                payload = ctypes.string_at(data, ln.value)
+                lib.dp_free(data)
+                yield payload
+        finally:
+            lib.dp_reader_destroy(r)
+
+    def _iter_python(self):
+        import random
+        rng = random.Random(self._seed)
+        pool = []
+        for path in self._paths:
+            with open(path, "rb") as f:
+                while True:
+                    head = f.read(20)
+                    if len(head) < 20:
+                        break
+                    magic, ln, hsh = struct.unpack("<IQQ", head)
+                    if magic != _MAGIC:
+                        break
+                    payload = f.read(ln)
+                    if len(payload) < ln or _fnv1a(payload) != hsh:
+                        break
+                    if self._pool > 0:
+                        pool.append(payload)
+                        if len(pool) >= self._pool:
+                            i = rng.randrange(len(pool))
+                            pool[i], pool[-1] = pool[-1], pool[i]
+                            yield pool.pop()
+                    else:
+                        yield payload
+        rng.shuffle(pool)
+        for p in pool:
+            yield p
+
+    def samples(self):
+        for payload in self:
+            yield pickle.loads(payload)
+
+
+class NativeDataLoader(object):
+    """Batched loader over record files feeding Executor.run.
+
+    feed_names: var names aligned with each sample tuple's arrays.
+    """
+
+    def __init__(self, paths, feed_names, batch_size, shuffle_pool=0,
+                 seed=0, num_threads=2, drop_last=True):
+        self._reader = RecordReader(paths, shuffle_pool=shuffle_pool,
+                                    seed=seed, num_threads=num_threads)
+        self._feed_names = list(feed_names)
+        self._batch_size = batch_size
+        self._drop_last = drop_last
+
+    def __iter__(self):
+        buf = []
+        for sample in self._reader.samples():
+            buf.append(sample)
+            if len(buf) == self._batch_size:
+                yield self._collate(buf)
+                buf = []
+        if buf and not self._drop_last:
+            yield self._collate(buf)
+
+    def _collate(self, samples):
+        cols = list(zip(*samples))
+        return {n: np.stack(c) for n, c in zip(self._feed_names, cols)}
