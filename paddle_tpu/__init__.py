@@ -28,8 +28,12 @@ from .io import (save_params, save_persistables, load_params,
                  load_persistables, save_inference_model,
                  load_inference_model)
 from . import reader
+from .data_feeder import DataFeeder
 from . import dygraph
 from . import distributed
+from . import inference
+from . import contrib
+from . import native
 from . import profiler
 from .layers.io import data
 from .install_check import run_check

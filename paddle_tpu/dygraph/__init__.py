@@ -13,3 +13,4 @@ from .nn import (Linear, Conv2D, BatchNorm, Embedding, LayerNorm, Dropout,
                  Pool2D, GRUUnit)
 from .checkpoint import save_dygraph, load_dygraph
 from .jit import TracedLayer, dygraph_to_static_graph
+from . import optimizers

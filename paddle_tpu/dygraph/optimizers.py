@@ -1,0 +1,123 @@
+"""Dygraph optimizers: functional updates over Layer parameter grads.
+
+Reference parity: fluid optimizers used under dygraph.guard (minimize on a
+loss Variable with tape grads). Here minimize consumes the grads produced
+by Layer.loss_and_grad; update math reuses the SAME op kernels as graph
+mode (ops/optimizer_ops.py), jit-compiled per parameter shape.
+"""
+import jax.numpy as jnp
+
+from ..ops.registry import get_op
+
+
+class _Ctx:
+    def rng(self):
+        import jax
+        return jax.random.PRNGKey(0)
+
+
+class DygraphOptimizer(object):
+    _op = None
+
+    def __init__(self, learning_rate=0.01, parameter_list=None, **attrs):
+        self._lr = learning_rate
+        self._params = parameter_list
+        self._attrs = attrs
+        self._state = {}
+
+    def _lr_value(self):
+        lr = self._lr
+        if callable(lr):
+            lr = lr()
+        return jnp.asarray([float(lr)], jnp.float32)
+
+    def _slots(self, p):
+        raise NotImplementedError
+
+    def _inputs(self, p, g, slots):
+        raise NotImplementedError
+
+    def _apply_outs(self, p, slots, outs):
+        raise NotImplementedError
+
+    def minimize(self, layer_or_params, grads=None):
+        """minimize(layer) after layer.loss_and_grad(...), or
+        minimize(params, grads_dict)."""
+        params = layer_or_params.parameters() \
+            if hasattr(layer_or_params, "parameters") else layer_or_params
+        kernel = get_op(self._op).fn
+        for p in params:
+            g = p._grad if grads is None else grads.get(id(p))
+            if g is None:
+                continue
+            slots = self._state.setdefault(id(p), self._slots(p))
+            ins = self._inputs(p, g, slots)
+            outs = kernel(_Ctx(), ins, self._attrs)
+            self._apply_outs(p, slots, outs)
+            p._grad = None
+
+
+class SGD(DygraphOptimizer):
+    _op = "sgd"
+
+    def _slots(self, p):
+        return {}
+
+    def _inputs(self, p, g, slots):
+        return {"Param": [p._value], "Grad": [g],
+                "LearningRate": [self._lr_value()]}
+
+    def _apply_outs(self, p, slots, outs):
+        p._value = outs["ParamOut"]
+
+
+class Momentum(DygraphOptimizer):
+    _op = "momentum"
+
+    def __init__(self, learning_rate=0.01, momentum=0.9, **kw):
+        super(Momentum, self).__init__(learning_rate, mu=momentum, **kw)
+
+    def _slots(self, p):
+        return {"v": jnp.zeros_like(p._value)}
+
+    def _inputs(self, p, g, slots):
+        return {"Param": [p._value], "Grad": [g], "Velocity": [slots["v"]],
+                "LearningRate": [self._lr_value()]}
+
+    def _apply_outs(self, p, slots, outs):
+        p._value = outs["ParamOut"]
+        slots["v"] = outs["VelocityOut"]
+
+
+class Adam(DygraphOptimizer):
+    _op = "adam"
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kw):
+        super(Adam, self).__init__(learning_rate, beta1=beta1, beta2=beta2,
+                                   epsilon=epsilon, **kw)
+        self._b1, self._b2 = beta1, beta2
+
+    def _slots(self, p):
+        return {"m1": jnp.zeros(p._value.shape, jnp.float32),
+                "m2": jnp.zeros(p._value.shape, jnp.float32),
+                "b1p": jnp.asarray([self._b1], jnp.float32),
+                "b2p": jnp.asarray([self._b2], jnp.float32)}
+
+    def _inputs(self, p, g, slots):
+        return {"Param": [p._value], "Grad": [g],
+                "Moment1": [slots["m1"]], "Moment2": [slots["m2"]],
+                "Beta1Pow": [slots["b1p"]], "Beta2Pow": [slots["b2p"]],
+                "LearningRate": [self._lr_value()]}
+
+    def _apply_outs(self, p, slots, outs):
+        p._value = outs["ParamOut"]
+        slots["m1"] = outs["Moment1Out"]
+        slots["m2"] = outs["Moment2Out"]
+        slots["b1p"] = outs["Beta1PowOut"]
+        slots["b2p"] = outs["Beta2PowOut"]
+
+
+AdamOptimizer = Adam
+SGDOptimizer = SGD
+MomentumOptimizer = Momentum

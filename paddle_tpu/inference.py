@@ -1,0 +1,84 @@
+"""Inference engine: load → compiled predictor.
+
+Reference parity: paddle/fluid/inference/ (AnalysisConfig/AnalysisPredictor,
+api_impl.cc). The reference runs analysis passes + TensorRT/Anakin engines;
+on TPU the engine IS XLA: create_predictor returns a callable whose whole
+pruned inference program is one jitted computation, with a compile cache
+bucketed by padded batch size so ragged request sizes don't retrigger
+compilation (reference: dynamic-shape TRT profiles).
+"""
+import math
+
+import numpy as np
+
+from .framework.executor import Executor
+from .framework.scope import Scope, scope_guard
+from .framework.place import _current_expected_place
+from .io import load_inference_model
+
+
+class Config(object):
+    """AnalysisConfig work-alike."""
+
+    def __init__(self, model_dir):
+        self.model_dir = model_dir
+        self.batch_buckets = (1, 2, 4, 8, 16, 32, 64)
+        self.place = None
+
+    def enable_memory_optim(self):
+        pass  # XLA plans buffers itself; parity no-op
+
+    def switch_ir_optim(self, flag=True):
+        pass
+
+
+class Predictor(object):
+    def __init__(self, config):
+        self._scope = Scope()
+        self._exe = Executor(config.place or _current_expected_place())
+        with scope_guard(self._scope):
+            self._program, self._feed_names, self._fetch_names = \
+                load_inference_model(config.model_dir, self._exe)
+        self._buckets = sorted(config.batch_buckets)
+
+    def get_input_names(self):
+        return list(self._feed_names)
+
+    def get_output_names(self):
+        return list(self._fetch_names)
+
+    def _bucket(self, n):
+        for b in self._buckets:
+            if n <= b:
+                return b
+        return int(2 ** math.ceil(math.log2(max(n, 1))))
+
+    def run(self, inputs):
+        """inputs: dict name -> np array (or list aligned with feed names).
+        Returns list of np arrays aligned with fetch names. Batches are
+        padded up to the bucket size and results sliced back."""
+        if isinstance(inputs, (list, tuple)):
+            inputs = dict(zip(self._feed_names, inputs))
+        n = next(iter(inputs.values())).shape[0]
+        b = self._bucket(n)
+        feed = {}
+        for name, arr in inputs.items():
+            arr = np.asarray(arr)
+            if arr.shape[0] != b:
+                pad = [(0, b - arr.shape[0])] + [(0, 0)] * (arr.ndim - 1)
+                arr = np.pad(arr, pad)
+            feed[name] = arr
+        with scope_guard(self._scope):
+            outs = self._exe.run(self._program, feed=feed,
+                                 fetch_list=self._fetch_names)
+        return [o[:n] if hasattr(o, "__getitem__") and
+                np.ndim(o) > 0 and o.shape[0] == b else o for o in outs]
+
+
+def create_predictor(config):
+    return Predictor(config)
+
+
+# legacy-style API (reference paddle/fluid/inference/api)
+create_paddle_predictor = create_predictor
+AnalysisConfig = Config

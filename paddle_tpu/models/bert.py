@@ -92,8 +92,9 @@ def encoder_layer(x, attn_bias, cfg, name, is_test=False):
 
 
 def bert_encoder(src_ids, position_ids, sentence_ids, input_mask, cfg,
-                 is_test=False):
-    """Returns (sequence_output (N,T,H), pooled [CLS] output (N,H))."""
+                 is_test=False, task_ids=None, task_vocab_size=16):
+    """Returns (sequence_output (N,T,H), pooled [CLS] output (N,H)).
+    task_ids (ERNIE 2.0 continual multi-task) adds a task-type embedding."""
     emb = layers.embedding(
         src_ids, [cfg.vocab_size, cfg.hidden_size],
         param_attr=_attr(cfg, "word_embedding", ("mp", None)),
@@ -107,6 +108,13 @@ def bert_encoder(src_ids, position_ids, sentence_ids, input_mask, cfg,
         param_attr=ParamAttr(name="sent_embedding", initializer=_init(cfg)),
         dtype="float32")
     x = layers.elementwise_add(layers.elementwise_add(emb, pos), sent)
+    if task_ids is not None:
+        task = layers.embedding(
+            task_ids, [task_vocab_size, cfg.hidden_size],
+            param_attr=ParamAttr(name="task_embedding",
+                                 initializer=_init(cfg)),
+            dtype="float32")
+        x = layers.elementwise_add(x, task)
     x = layers.layer_norm(x, begin_norm_axis=2,
                           param_attr=ParamAttr(name="pre_encoder_ln_s"),
                           bias_attr=ParamAttr(name="pre_encoder_ln_b"))
@@ -228,3 +236,96 @@ def synthetic_batch(cfg, batch_size, seq_len, max_preds_per_seq=20, seed=0):
 ErnieConfig = BertConfig
 ernie_base = bert_base
 ernie_pretrain_program = bert_pretrain_program
+
+
+# ---------------------------------------------------------------------------
+# ERNIE 2.0 continual multi-task pretraining (BASELINE stretch config).
+# Reference: ERNIE 2.0 paper / LARK repo — BERT-style encoder + task-id
+# embedding + a battery of heads (word-aware / structure-aware /
+# semantic-aware) trained jointly; losses summed with per-task weights.
+# ---------------------------------------------------------------------------
+
+def ernie2_multitask_program(cfg, batch_size, seq_len, max_preds_per_seq=20,
+                             num_sent_classes=3, num_ir_classes=3,
+                             task_weights=(1.0, 1.0, 1.0),
+                             optimizer_fn=None, is_test=False):
+    """Three representative ERNIE-2.0 tasks on one shared encoder:
+      1. masked LM (word-aware, knowledge masking comes from the data gen)
+      2. sentence-reorder classification on [CLS] (structure-aware)
+      3. IR relevance classification on [CLS] (semantic-aware)
+    Feeds add task_ids (N,T,1) — the task-id embedding of ERNIE 2.0.
+    """
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        src_ids = layers.data("src_ids", [seq_len, 1], dtype="int64")
+        pos_ids = layers.data("pos_ids", [seq_len, 1], dtype="int64")
+        sent_ids = layers.data("sent_ids", [seq_len, 1], dtype="int64")
+        task_ids = layers.data("task_ids", [seq_len, 1], dtype="int64")
+        input_mask = layers.data("input_mask", [seq_len, 1],
+                                 dtype="float32")
+        mask_pos = layers.data("mask_pos", [1], dtype="int64")
+        mask_label = layers.data("mask_label", [1], dtype="int64")
+        reorder_label = layers.data("reorder_label", [1], dtype="int64")
+        ir_label = layers.data("ir_label", [1], dtype="int64")
+
+        # task-id embedding joins the usual three embeddings
+        seq_out, pooled = bert_encoder(src_ids, pos_ids, sent_ids,
+                                       input_mask, cfg, is_test=is_test,
+                                       task_ids=task_ids)
+
+        flat = layers.reshape(seq_out, [-1, cfg.hidden_size])
+        picked = layers.gather(flat, mask_pos)
+        trans = layers.fc(picked, cfg.hidden_size, act="gelu",
+                          param_attr=ParamAttr(name="mask_lm_trans_fc.w_0",
+                                               initializer=_init(cfg)),
+                          bias_attr=ParamAttr(name="mask_lm_trans_fc.b_0"))
+        trans = layers.layer_norm(
+            trans, begin_norm_axis=1,
+            param_attr=ParamAttr(name="mask_lm_trans_ln_s"),
+            bias_attr=ParamAttr(name="mask_lm_trans_ln_b"))
+        word_emb = main.global_block().var("word_embedding")
+        mlm_logits = layers.matmul(trans, word_emb, transpose_y=True)
+        mlm_bias = layers.create_parameter(
+            [cfg.vocab_size], "float32", name="mask_lm_out_fc.b_0",
+            default_initializer=pt.initializer.Constant(0.0))
+        mlm_loss = layers.mean(layers.softmax_with_cross_entropy(
+            layers.elementwise_add(mlm_logits, mlm_bias), mask_label))
+
+        def _cls_head(name, n_cls, label):
+            logits = layers.fc(
+                pooled, n_cls,
+                param_attr=ParamAttr(name=name + ".w_0",
+                                     initializer=_init(cfg)),
+                bias_attr=ParamAttr(name=name + ".b_0"))
+            return layers.mean(
+                layers.softmax_with_cross_entropy(logits, label))
+
+        reorder_loss = _cls_head("task_reorder_fc", num_sent_classes,
+                                 reorder_label)
+        ir_loss = _cls_head("task_ir_fc", num_ir_classes, ir_label)
+
+        w = task_weights
+        loss = layers.scale(mlm_loss, scale=float(w[0]))
+        loss = layers.elementwise_add(
+            loss, layers.scale(reorder_loss, scale=float(w[1])))
+        loss = layers.elementwise_add(
+            loss, layers.scale(ir_loss, scale=float(w[2])))
+        if optimizer_fn is not None:
+            optimizer_fn(loss)
+    feeds = ["src_ids", "pos_ids", "sent_ids", "task_ids", "input_mask",
+             "mask_pos", "mask_label", "reorder_label", "ir_label"]
+    fetch = {"loss": loss, "mlm_loss": mlm_loss,
+             "reorder_loss": reorder_loss, "ir_loss": ir_loss}
+    return main, startup, feeds, fetch
+
+
+def ernie2_synthetic_batch(cfg, batch_size, seq_len, max_preds_per_seq=20,
+                           seed=0):
+    import numpy as np
+    b = synthetic_batch(cfg, batch_size, seq_len, max_preds_per_seq, seed)
+    rng = np.random.RandomState(seed + 1)
+    b["task_ids"] = np.zeros((batch_size, seq_len, 1), np.int64)
+    b["reorder_label"] = rng.randint(0, 3, (batch_size, 1)).astype(np.int64)
+    b["ir_label"] = rng.randint(0, 3, (batch_size, 1)).astype(np.int64)
+    del b["labels"]
+    return b

@@ -1,0 +1,54 @@
+"""Predictor API + dygraph optimizer tests."""
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+
+
+def test_predictor_bucketing(tmp_path):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [4], dtype="float32")
+        y = layers.softmax(layers.fc(x, 3))
+    exe = pt.Executor()
+    exe.run(startup)
+    xv = np.random.RandomState(0).rand(3, 4).astype(np.float32)
+    ref, = exe.run(main, feed={"x": xv}, fetch_list=[y])
+
+    pt.save_inference_model(str(tmp_path), ["x"], [y], exe,
+                            main_program=main)
+    from paddle_tpu.inference import Config, create_predictor
+    pred = create_predictor(Config(str(tmp_path)))
+    assert pred.get_input_names() == ["x"]
+    out, = pred.run({"x": xv})           # batch 3 -> bucket 4, sliced back
+    assert out.shape == (3, 3)
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+    out2, = pred.run({"x": xv[:1]})      # bucket 1
+    np.testing.assert_allclose(out2, ref[:1], rtol=1e-5)
+
+
+def test_dygraph_adam_converges():
+    from paddle_tpu import dygraph
+    from paddle_tpu.dygraph import Linear, to_variable
+    from paddle_tpu.dygraph.optimizers import Adam
+    from paddle_tpu.dygraph.nn import run_op
+    rng = np.random.RandomState(0)
+    x = rng.rand(64, 4).astype(np.float32)
+    w_true = rng.randn(4, 1).astype(np.float32)
+    t = x @ w_true
+
+    with dygraph.guard():
+        layer = Linear(4, 1)
+        opt = Adam(0.05)
+        losses = []
+        for _ in range(40):
+            def loss_fn(out):
+                diff = out - to_variable(t)
+                return run_op("reduce_mean",
+                              {"X": [run_op("square",
+                                            {"X": [diff]})["Out"]]},
+                              {"reduce_all": True})["Out"]
+            loss, grads = layer.loss_and_grad(loss_fn, x)
+            opt.minimize(layer)
+            losses.append(float(loss.numpy()))
+    assert losses[-1] < 0.05 * losses[0], losses[::8]

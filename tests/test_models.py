@@ -106,3 +106,14 @@ def test_transformer_greedy_decode_builds():
                          "src_mask": np.ones((2, 8, 1), np.float32)},
                    fetch_list=[dfetch["out_ids"]])
     assert out.shape == (2, 4, 1)
+
+
+def test_ernie2_multitask_tiny():
+    from paddle_tpu.models import bert
+    cfg = bert.BertConfig(vocab_size=256, hidden_size=32, num_layers=2,
+                          num_heads=2, ff_size=64, max_position=32)
+    main, startup, feeds, fetch = bert.ernie2_multitask_program(
+        cfg, 2, 16, 4,
+        optimizer_fn=lambda l: optimizer.Adam(1e-3).minimize(l))
+    batch = bert.ernie2_synthetic_batch(cfg, 2, 16, 4)
+    _train(main, startup, fetch, batch)
