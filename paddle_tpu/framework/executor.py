@@ -108,20 +108,33 @@ class Executor(object):
                 state_names.append(STEP_VAR)
 
         feed_vals = self._convert_feed(program, feed)
+        check_numerics = bool(
+            getattr(program, "_check_numerics", False) or
+            (strategy is not None and
+             getattr(strategy._build_strategy, "check_numerics", False)))
         key = (id(program), program._version, _feed_signature(feed_vals),
-               tuple(fetch_names), tuple(state_names),
+               tuple(fetch_names), tuple(state_names), check_numerics,
                None if strategy is None else strategy._cache_token())
         entry = self._cache.get(key) if use_program_cache else None
         if entry is None:
             entry = self._compile(program, feed_vals, fetch_names,
-                                  state_names, uses_rng, strategy)
+                                  state_names, uses_rng, strategy,
+                                  check_numerics)
             if use_program_cache:
                 self._cache[key] = entry
         step_fn = entry
 
         state_vals = tuple(scope.find_var(n) for n in state_names)
         feed_tuple = tuple(feed_vals[k] for k in sorted(feed_vals))
-        fetches, new_state = step_fn(state_vals, feed_tuple)
+        if check_numerics:
+            fetches, new_state, finite = step_fn(state_vals, feed_tuple)
+            if not bool(np.asarray(finite)):
+                raise FloatingPointError(
+                    "check_numerics: non-finite value (NaN/Inf) detected "
+                    "in fetches or updated state of this step (reference "
+                    "parity: check_nan_inf)")
+        else:
+            fetches, new_state = step_fn(state_vals, feed_tuple)
         for n, v in zip(state_names, new_state):
             scope.set_var(n, v)
         if return_numpy:
@@ -148,7 +161,7 @@ class Executor(object):
         return out
 
     def _compile(self, program, feed_vals, fetch_names, state_names,
-                 uses_rng, strategy):
+                 uses_rng, strategy, check_numerics=False):
         want_vjp = _want_vjp_set(program)
         seed = program.random_seed
 
@@ -167,11 +180,19 @@ class Executor(object):
             fetches = tuple(
                 trace_mod._lookup(env, n, _FetchOp) for n in fetch_names)
             new_state = tuple(env[n] for n in state_names)
+            if check_numerics:
+                flag = jnp.asarray(True)
+                for v in list(fetches) + list(new_state):
+                    if jnp.issubdtype(jnp.result_type(v), jnp.inexact):
+                        flag = jnp.logical_and(flag,
+                                               jnp.all(jnp.isfinite(v)))
+                return fetches, new_state, flag
             return fetches, new_state
 
         if strategy is not None:
             return strategy._build_step(self, step, program, state_names,
-                                        sorted(feed_vals), feed_vals)
+                                        sorted(feed_vals), feed_vals,
+                                        check_numerics)
         with warnings.catch_warnings():
             warnings.simplefilter("ignore")  # CPU ignores donation; fine.
             jitted = jax.jit(step, donate_argnums=(0,))

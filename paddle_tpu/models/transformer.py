@@ -218,3 +218,95 @@ def synthetic_batch(cfg, batch, src_len, trg_len, seed=0):
         "lbl_ids": rng.randint(1, cfg.trg_vocab,
                                (batch, trg_len, 1)).astype(np.int64),
     }
+
+
+def beam_search_decode_program(cfg, src_len, max_out_len, beam_size=4,
+                               bos_id=0, eos_id=1, len_penalty=0.6):
+    """Beam-search decode (reference: operators/beam_search_op.cc + the
+    models-repo fast_decoder). TPU design: beams are a flattened (N*B)
+    batch with STATIC shapes; each unrolled step re-decodes the prefix and
+    expands the top-(B*V) frontier with topk + gather — no dynamic LoD
+    beam structures. Returns out_ids (N, beam, T, 1), scores (N, beam)."""
+    import numpy as np
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        src_ids = layers.data("src_ids", [src_len, 1], dtype="int64")
+        src_mask = layers.data("src_mask", [src_len, 1], dtype="float32")
+        src_bias = _attn_bias(src_mask)
+        enc_in = _embed(src_ids, cfg.src_vocab, cfg, "src_word_emb", True)
+        enc_out = encoder(enc_in, src_bias, cfg, True)
+
+        b, v, t_max = beam_size, cfg.trg_vocab, max_out_len
+
+        # tile encoder state across beams: (N,S,D) -> (N*B,S,D)
+        enc_rep = layers.unsqueeze(enc_out, [1])
+        enc_rep = layers.expand(enc_rep, [1, b, 1, 1])
+        enc_rep = layers.reshape(enc_rep, [-1, src_len, cfg.d_model])
+        bias_rep = layers.unsqueeze(src_bias, [1])
+        bias_rep = layers.expand(bias_rep, [1, b, 1, 1, 1])
+        bias_rep = layers.reshape(bias_rep, [-1, 1, 1, src_len])
+
+        # ids (N*B,T,1) init BOS; scores (N,B): beam0=0, others -1e9 so the
+        # first expansion draws B distinct words from beam 0
+        ids = layers.fill_constant_batch_size_like(
+            enc_rep, [-1, t_max, 1], "int64", float(bos_id))
+        zeros_nb = layers.fill_constant_batch_size_like(
+            src_ids, [-1, b], "float32", 0.0)
+        init_row = layers.assign(
+            np.array([[0.0] + [-1e9] * (b - 1)], dtype=np.float32))
+        scores = layers.elementwise_add(zeros_nb, init_row)
+        # per-(N,B) row index, built from a cumsum of ones (static-safe)
+        ones_nb = layers.fill_constant_batch_size_like(
+            src_ids, [-1, b], "float32", 1.0)
+        row_idx = layers.cast(
+            layers.scale(layers.cumsum(ones_nb, axis=0), bias=-1.0),
+            "int64")                                        # (N,B)
+
+        ones_mask = layers.fill_constant_batch_size_like(
+            enc_rep, [-1, t_max, 1], "float32", 1.0)
+        trg_bias = _attn_bias(ones_mask)
+
+        for t in range(t_max - 1):
+            dec_in = _embed(ids, cfg.trg_vocab, cfg, "trg_word_emb", True)
+            dec_out = decoder(dec_in, enc_rep, trg_bias, bias_rep, cfg,
+                              True)
+            logits = layers.fc(dec_out, v, num_flatten_dims=2,
+                               param_attr=ParamAttr(name="dec_out_fc.w"),
+                               bias_attr=False)
+            step_logits = layers.slice(logits, axes=[1], starts=[t],
+                                       ends=[t + 1])         # (N*B,1,V)
+            logp = layers.log_softmax(
+                layers.reshape(step_logits, [-1, v]))        # (N*B,V)
+            logp_nbv = layers.reshape(logp, [-1, b * v])     # (N,B*V)
+            prev = layers.reshape(scores, [-1, b, 1])
+            prev = layers.expand(prev, [1, 1, v])
+            prev = layers.reshape(prev, [-1, b * v])
+            total = layers.elementwise_add(logp_nbv, prev)
+            top_scores, top_idx = layers.topk(total, k=b)    # (N,B)
+            beam_sel = layers.cast(
+                layers.elementwise_floordiv(
+                    top_idx, layers.fill_constant([1], "int64", v)),
+                "int64")
+            word_sel = layers.elementwise_sub(
+                top_idx, layers.scale(beam_sel, scale=float(v)))
+            flat_rows = layers.reshape(
+                layers.elementwise_add(
+                    layers.scale(row_idx, scale=float(b)), beam_sel),
+                [-1])                                        # (N*B,)
+            ids_kept = layers.gather(
+                layers.reshape(ids, [-1, t_max]), flat_rows)  # (N*B,T)
+            before = layers.slice(ids_kept, axes=[1], starts=[0],
+                                  ends=[t + 1])
+            after = layers.slice(ids_kept, axes=[1], starts=[t + 2],
+                                 ends=[t_max])
+            word_col = layers.reshape(word_sel, [-1, 1])
+            ids = layers.reshape(
+                layers.concat([before, word_col, after], axis=1),
+                [-1, t_max, 1])
+            scores = top_scores
+
+        out_ids = layers.reshape(ids, [-1, b, t_max, 1])
+        final_scores = layers.scale(scores,
+                                    scale=1.0 / (t_max ** len_penalty))
+    return main, startup, ["src_ids", "src_mask"], \
+        {"out_ids": out_ids, "scores": final_scores}

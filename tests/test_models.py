@@ -117,3 +117,26 @@ def test_ernie2_multitask_tiny():
         optimizer_fn=lambda l: optimizer.Adam(1e-3).minimize(l))
     batch = bert.ernie2_synthetic_batch(cfg, 2, 16, 4)
     _train(main, startup, fetch, batch)
+
+
+def test_transformer_beam_search():
+    from paddle_tpu.models import transformer as tr
+    cfg = tr.TransformerConfig(src_vocab=64, trg_vocab=64, d_model=16,
+                               d_inner=32, n_head=2, n_layer=1, dropout=0.0)
+    main, startup, feeds, fetch = tr.transformer_train_program(
+        cfg, 8, 6, optimizer_fn=None)
+    exe = pt.Executor()
+    exe.run(startup)
+    bmain, _, bfeeds, bfetch = tr.beam_search_decode_program(
+        cfg, 8, 5, beam_size=3)
+    rng = np.random.RandomState(0)
+    out, scores = exe.run(
+        bmain,
+        feed={"src_ids": rng.randint(1, 64, (2, 8, 1)).astype(np.int64),
+              "src_mask": np.ones((2, 8, 1), np.float32)},
+        fetch_list=[bfetch["out_ids"], bfetch["scores"]])
+    assert out.shape == (2, 3, 5, 1)
+    assert scores.shape == (2, 3)
+    # beams sorted by score, all finite
+    assert np.isfinite(scores).all()
+    assert (np.diff(scores, axis=1) <= 1e-5).all()
