@@ -30,7 +30,7 @@ def main():
     on_tpu = any(d.platform in ("tpu", "axon") for d in jax.devices())
     # BERT/ERNIE-base, seq 128 — bf16 on TPU; tiny shapes on CPU fallback
     if on_tpu:
-        batch, seq, preds = 64, 128, 20
+        batch, seq, preds = 128, 128, 20
         cfg = bert.bert_base(dtype="bfloat16")
         steps, warmup = 20, 3
     else:
@@ -51,11 +51,19 @@ def main():
         out = exe.run(main_prog, feed=feed, fetch_list=[fetch["loss"]])
     np.asarray(out[0])  # sync
 
+    # steady state: JAX dispatch is async, so successive steps pipeline on
+    # the chip (each consumes the previous step's donated state); losses are
+    # device futures materialized once at the end — how a real training loop
+    # behaves, and it keeps host/tunnel latency off the critical path.
     t0 = time.perf_counter()
+    losses = []
     for _ in range(steps):
-        out = exe.run(main_prog, feed=feed, fetch_list=[fetch["loss"]])
-    loss = float(np.asarray(out[0]).reshape(-1)[0])  # sync on fetch
+        out = exe.run(main_prog, feed=feed, fetch_list=[fetch["loss"]],
+                      return_numpy=False)
+        losses.append(out[0])
+    loss_vals = [float(np.asarray(l).reshape(-1)[0]) for l in losses]
     dt = time.perf_counter() - t0
+    loss = loss_vals[-1]
 
     sps = batch * steps / dt
     assert np.isfinite(loss), "non-finite loss in benchmark"

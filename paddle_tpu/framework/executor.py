@@ -35,7 +35,9 @@ logger = logging.getLogger("paddle_tpu")
 
 
 def _feed_signature(feed):
-    return tuple(sorted((k, tuple(np.shape(v)), str(np.asarray(v).dtype))
+    # NB: use .dtype/.shape attributes — np.asarray on a jax.Array would
+    # sync it to host, putting a D2H round-trip on every step.
+    return tuple(sorted((k, tuple(v.shape), str(v.dtype))
                         for k, v in feed.items()))
 
 
@@ -143,12 +145,21 @@ class Executor(object):
 
     # ------------------------------------------------------------------
     def _convert_feed(self, program, feed):
+        """Host-side dtype normalization + ONE batched device_put for all
+        feeds (a single transfer keeps per-array latency — significant over
+        remote/tunneled TPU links — off the step critical path)."""
         out = {}
         blk = program.global_block()
         for name, val in feed.items():
+            if isinstance(val, jax.Array):   # already device-resident
+                out[name] = val
+                continue
             var = blk._find_var_recursive(name)
-            dtype = to_jax_dtype(var.dtype) if var is not None else None
-            arr = jnp.asarray(val, dtype=dtype)
+            dtype = np.dtype(jax.dtypes.canonicalize_dtype(
+                to_jax_dtype(var.dtype))) if var is not None else None
+            arr = np.asarray(val)
+            if dtype is not None and arr.dtype != dtype:
+                arr = arr.astype(dtype)
             if var is not None and var.shape is not None:
                 want = var.shape
                 if len(want) == arr.ndim:
@@ -158,6 +169,10 @@ class Executor(object):
                                 "feed %r shape %s incompatible with declared "
                                 "%s" % (name, arr.shape, want))
             out[name] = arr
+        host = [k for k, v in out.items() if not isinstance(v, jax.Array)]
+        if host:
+            staged = jax.device_put([out[k] for k in host])
+            out.update(zip(host, staged))
         return out
 
     def _compile(self, program, feed_vals, fetch_names, state_names,

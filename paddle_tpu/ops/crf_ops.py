@@ -1,0 +1,133 @@
+"""Linear-chain CRF kernels.
+
+Reference parity: paddle/fluid/operators/{linear_chain_crf_op,
+crf_decoding_op}.cc. The reference iterates sequences on CPU with LoD;
+TPU-native: dense (N, T, C) emissions + (N,) lengths, forward algorithm and
+Viterbi as lax.scan over time — differentiable (grad via vjp-of-scan) and
+batch-parallel on the VPU.
+
+Transition layout matches the reference: w[0]=start, w[1]=stop,
+w[2:2+C, :] = transition[from, to].
+"""
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op
+
+
+def _unpack_transition(w):
+    start, stop, trans = w[0], w[1], w[2:]
+    return start, stop, trans
+
+
+@register_op("linear_chain_crf", nondiff=("Label", "Length"))
+def _linear_chain_crf(ctx, ins, attrs):
+    """ins: Emission (N,T,C), Transition (C+2,C), Label (N,T,1) or (N,T),
+    optional Length (N,). outs: LogLikelihood (N,1) (+ alpha)."""
+    em = ins["Emission"][0].astype(jnp.float32)
+    w = ins["Transition"][0].astype(jnp.float32)
+    label = ins["Label"][0]
+    if label.ndim == 3:
+        label = label.reshape(label.shape[:2])
+    label = label.astype(jnp.int32)
+    n, t, c = em.shape
+    start, stop, trans = _unpack_transition(w)
+    if ins.get("Length"):
+        length = ins["Length"][0].reshape(-1).astype(jnp.int32)
+    else:
+        length = jnp.full((n,), t, jnp.int32)
+    steps = jnp.arange(t)
+    valid = steps[None, :] < length[:, None]          # (N,T)
+
+    # ---- partition function: alpha recursion over time ----
+    def fwd(alpha, xs):
+        em_t, valid_t = xs                            # (N,C), (N,)
+        # alpha'(j) = logsumexp_i alpha(i) + trans(i,j) + em(j)
+        scores = alpha[:, :, None] + trans[None, :, :]
+        new = jax.scipy.special.logsumexp(scores, axis=1) + em_t
+        alpha = jnp.where(valid_t[:, None], new, alpha)
+        return alpha, alpha
+
+    alpha0 = start[None, :] + em[:, 0, :]
+    alphas, _ = fwd(alpha0, (em[:, 0, :], jnp.zeros((n,), bool)))  # no-op
+    alpha_last, _ = lax.scan(
+        fwd, alpha0,
+        (jnp.swapaxes(em, 0, 1)[1:], jnp.swapaxes(valid, 0, 1)[1:]))
+    log_z = jax.scipy.special.logsumexp(alpha_last + stop[None, :], axis=1)
+
+    # ---- gold path score ----
+    first_em = jnp.take_along_axis(em[:, 0, :], label[:, :1], axis=1)[:, 0]
+    path = start[label[:, 0]] + first_em
+
+    def gold(carry, xs):
+        path, prev_lbl = carry
+        em_t, lbl_t, valid_t = xs
+        em_score = jnp.take_along_axis(em_t, lbl_t[:, None], axis=1)[:, 0]
+        tr_score = trans[prev_lbl, lbl_t]
+        path = jnp.where(valid_t, path + em_score + tr_score, path)
+        prev_lbl = jnp.where(valid_t, lbl_t, prev_lbl)
+        return (path, prev_lbl), None
+
+    (path, last_lbl), _ = lax.scan(
+        gold, (path, label[:, 0]),
+        (jnp.swapaxes(em, 0, 1)[1:], jnp.swapaxes(label, 0, 1)[1:],
+         jnp.swapaxes(valid, 0, 1)[1:]))
+    path = path + stop[last_lbl]
+
+    ll = (path - log_z)[:, None]
+    return {"LogLikelihood": ll,
+            "Alpha": lax.stop_gradient(alpha_last),
+            "EmissionExps": lax.stop_gradient(jnp.exp(em)),
+            "TransitionExps": lax.stop_gradient(jnp.exp(w))}
+
+
+@register_op("crf_decoding", nondiff=("Emission", "Transition", "Label",
+                                      "Length"), differentiable=False)
+def _crf_decoding(ctx, ins, attrs):
+    """Viterbi decode. outs: ViterbiPath (N,T,1) int64."""
+    em = ins["Emission"][0].astype(jnp.float32)
+    w = ins["Transition"][0].astype(jnp.float32)
+    n, t, c = em.shape
+    start, stop, trans = _unpack_transition(w)
+    if ins.get("Length"):
+        length = ins["Length"][0].reshape(-1).astype(jnp.int32)
+    else:
+        length = jnp.full((n,), t, jnp.int32)
+    valid = jnp.arange(t)[None, :] < length[:, None]
+
+    def vit(carry, xs):
+        score = carry                                  # (N,C)
+        em_t, valid_t = xs
+        cand = score[:, :, None] + trans[None, :, :]   # (N, from, to)
+        best_prev = jnp.argmax(cand, axis=1)           # (N,C)
+        new = jnp.max(cand, axis=1) + em_t
+        new = jnp.where(valid_t[:, None], new, score)
+        bp = jnp.where(valid_t[:, None], best_prev,
+                       jnp.arange(c)[None, :])
+        return new, bp
+
+    score0 = start[None, :] + em[:, 0, :]
+    final, bps = lax.scan(
+        vit, score0,
+        (jnp.swapaxes(em, 0, 1)[1:], jnp.swapaxes(valid, 0, 1)[1:]))
+    final = final + stop[None, :]
+    last = jnp.argmax(final, axis=1)                   # (N,)
+
+    def back(carry, bp):
+        lbl = carry
+        prev = jnp.take_along_axis(bp, lbl[:, None], axis=1)[:, 0]
+        return prev, lbl
+
+    _, path_rev = lax.scan(back, last, bps, reverse=True)
+    # path_rev holds labels for steps 1..T-1 (each yields its own label);
+    # prepend the step-0 label via one more backpointer application
+    first = jnp.take_along_axis(bps[0], path_rev[0][:, None],
+                                axis=1)[:, 0] if t > 1 else last
+    if t > 1:
+        path = jnp.concatenate([first[None], path_rev], axis=0)
+    else:
+        path = last[None]
+    path = jnp.swapaxes(path, 0, 1)                    # (N,T)
+    path = jnp.where(valid, path, 0)
+    return {"ViterbiPath": path[..., None].astype(jnp.int64)}
