@@ -19,6 +19,47 @@ import time
 import numpy as np
 
 REFERENCE_SAMPLES_PER_SEC = 50.0
+# Secondary config (BASELINE metric string also names ResNet-50 images/sec):
+# reference-era fluid ResNet-50 on one V100 ~ 360 images/sec.
+REFERENCE_RESNET_IPS = 360.0
+
+
+def _run_steps(exe, prog, feed, loss_var, steps, warmup):
+    import numpy as np
+    for _ in range(warmup):
+        out = exe.run(prog, feed=feed, fetch_list=[loss_var])
+    np.asarray(out[0])
+    t0 = time.perf_counter()
+    losses = [exe.run(prog, feed=feed, fetch_list=[loss_var],
+                      return_numpy=False)[0] for _ in range(steps)]
+    vals = [float(np.asarray(l).reshape(-1)[0]) for l in losses]
+    dt = time.perf_counter() - t0
+    assert np.isfinite(vals).all() if hasattr(np, "isfinite") else True
+    return dt, vals[-1]
+
+
+def bench_resnet():
+    import jax
+    import paddle_tpu as pt
+    from paddle_tpu.models import resnet
+    from paddle_tpu import optimizer
+    on_tpu = any(d.platform in ("tpu", "axon") for d in jax.devices())
+    batch = 128 if on_tpu else 4
+    shape = (3, 224, 224) if on_tpu else (3, 32, 32)
+    steps, warmup = (20, 3) if on_tpu else (3, 1)
+    main_prog, startup, feeds, fetch = resnet.resnet_train_program(
+        depth=50, class_dim=1000, image_shape=shape,
+        optimizer_fn=lambda l: optimizer.Momentum(0.1, 0.9).minimize(l))
+    exe = pt.Executor()
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    feed = {"image": rng.rand(batch, *shape).astype(np.float32),
+            "label": rng.randint(0, 1000, (batch, 1)).astype(np.int64)}
+    dt, loss = _run_steps(exe, main_prog, feed, fetch["loss"], steps, warmup)
+    ips = batch * steps / dt
+    print(json.dumps({"metric": "ResNet-50 train images/sec/chip",
+                      "value": round(ips, 2), "unit": "images/sec/chip",
+                      "vs_baseline": round(ips / REFERENCE_RESNET_IPS, 3)}))
 
 
 def main():
@@ -77,4 +118,7 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) > 1 and sys.argv[1] == "resnet":
+        bench_resnet()
+    else:
+        main()

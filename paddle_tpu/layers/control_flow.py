@@ -183,3 +183,59 @@ def piecewise_select(step, boundaries, values, dtype="float32"):
         v_var = tensor_layers.fill_constant([1], dtype, v)
         out = where(less_than(step, float(b)), v_var, out)
     return out
+
+
+def recompute_segment(fn, inputs, name=None):
+    """Run fn(*inputs) inside a rematerialized segment: activations inside
+    the segment are not kept for backward — XLA recomputes them
+    (jax.checkpoint). The segment's parameter reads are auto-detected as
+    captures so gradients still flow to them.
+
+    Reference parity: RecomputeOptimizer/_set_checkpoints; here recompute is
+    per-segment and composes with any optimizer."""
+    from ..layer_helper import LayerHelper
+    helper = LayerHelper("recompute", name=name)
+    program = default_main_program()
+    if not isinstance(inputs, (list, tuple)):
+        inputs = [inputs]
+    inputs = list(inputs)
+
+    block = program._create_block()
+    try:
+        outs = fn(*inputs)
+    finally:
+        program._rollback()
+    if isinstance(outs, Variable):
+        outs = [outs]
+    outs = list(outs)
+
+    # captures: names read before written inside the segment, beyond inputs
+    input_names = {v.name for v in inputs}
+    defined = set(input_names)
+    captured = []
+    for op in block.ops:
+        for n in op.input_names():
+            if n not in defined and n not in captured:
+                captured.append(n)
+        defined.update(op.output_names())
+    parent = program.current_block()
+    cap_vars = []
+    for n in captured:
+        v = parent._find_var_recursive(n)
+        if v is None:
+            v = block._find_var_recursive(n)
+        cap_vars.append(v)
+
+    in_all = inputs + [v for v in cap_vars if v is not None]
+    out_vars = [helper.create_variable_for_type_inference(v.dtype, v.shape)
+                for v in outs]
+    helper.append_op(
+        "remat_block",
+        inputs={"In": [v.name for v in in_all]},
+        outputs={"Out": [v.name for v in out_vars]},
+        attrs={"sub_block": block.idx,
+               "in_names": [v.name for v in in_all],
+               "out_names": [v.name for v in outs]})
+    if len(out_vars) == 1:
+        return out_vars[0]
+    return out_vars

@@ -23,7 +23,8 @@ class BertConfig(object):
     def __init__(self, vocab_size=30522, hidden_size=768, num_layers=12,
                  num_heads=12, ff_size=3072, max_position=512,
                  type_vocab_size=2, hidden_dropout=0.1, attn_dropout=0.1,
-                 initializer_range=0.02, dtype="float32", tp=False):
+                 initializer_range=0.02, dtype="float32", tp=False,
+                 recompute=False):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -36,6 +37,10 @@ class BertConfig(object):
         self.initializer_range = initializer_range
         self.dtype = dtype
         self.tp = tp
+        # rematerialize each encoder layer (jax.checkpoint): ~T*H HBM per
+        # layer traded for one extra forward in backward — how long-context
+        # / large-batch configs fit on a chip
+        self.recompute = recompute
 
 
 def bert_base(**kw):
@@ -133,8 +138,14 @@ def bert_encoder(src_ids, position_ids, sentence_ids, input_mask, cfg,
         attn_bias = layers.cast(attn_bias, "bfloat16")
 
     for i in range(cfg.num_layers):
-        x = encoder_layer(x, attn_bias, cfg, "encoder_layer_%d" % i,
-                          is_test=is_test)
+        if cfg.recompute and not is_test:
+            x = layers.recompute_segment(
+                lambda h, i=i: encoder_layer(
+                    h, attn_bias, cfg, "encoder_layer_%d" % i,
+                    is_test=is_test), [x])
+        else:
+            x = encoder_layer(x, attn_bias, cfg, "encoder_layer_%d" % i,
+                              is_test=is_test)
     if cfg.dtype == "bfloat16":
         x = layers.cast(x, "float32")
 

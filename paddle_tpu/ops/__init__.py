@@ -7,6 +7,8 @@ from . import random_ops    # noqa: F401
 from . import optimizer_ops  # noqa: F401
 from . import control_flow_ops  # noqa: F401
 from . import metric_ops    # noqa: F401
+from . import crf_ops       # noqa: F401
+from . import detection_ops  # noqa: F401
 from . import rnn_ops       # noqa: F401
 from . import attention_ops  # noqa: F401
 from . import collective_ops  # noqa: F401

@@ -111,3 +111,27 @@ def _select_input(ctx, ins, attrs):
     for i, x in enumerate(xs[1:], 1):
         out = lax.select(mask == i, x, out)
     return {"Out": out}
+
+
+@register_op("remat_block", uses_subblock=True)
+def _remat_block(ctx, ins, attrs):
+    """Rematerialized segment: the sub-block is traced under
+    jax.checkpoint, so XLA drops its intermediates after forward and
+    recomputes them in backward — HBM for FLOPs, the TPU-native form of
+    the reference's RecomputeOptimizer (reference: recompute pass in
+    optimizer.py). Differentiable: the generic vjp grad op sees one
+    checkpointed function."""
+    import jax
+    program = ctx.program
+    block = program.block(attrs["sub_block"])
+    in_names = attrs["in_names"]
+    out_names = attrs["out_names"]
+    vals = ins["In"]
+
+    def fn(*vals):
+        local = dict(zip(in_names, vals))
+        ctx.trace_block(block, local)
+        return tuple(local[n] for n in out_names)
+
+    outs = jax.checkpoint(fn)(*vals)
+    return {"Out": list(outs)}

@@ -1,0 +1,183 @@
+"""Detection op kernels (subset).
+
+Reference parity: paddle/fluid/operators/detection/{prior_box_op,
+box_coder_op,iou_similarity_op,yolo_box_op}.cc — the building blocks of the
+SSD/YOLO heads. NMS variants are host-side post-processing in the TPU
+design (dynamic output shapes don't belong in XLA graphs); a top-k-capped
+static NMS is provided for on-device use.
+"""
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+
+@register_op("prior_box", nondiff=("Input", "Image"), differentiable=False)
+def _prior_box(ctx, ins, attrs):
+    feat = ins["Input"][0]     # (N, C, H, W)
+    img = ins["Image"][0]      # (N, C, IH, IW)
+    h, w = feat.shape[2], feat.shape[3]
+    ih, iw = img.shape[2], img.shape[3]
+    min_sizes = [float(s) for s in attrs["min_sizes"]]
+    max_sizes = [float(s) for s in attrs.get("max_sizes", [])]
+    ars = [1.0]
+    for ar in attrs.get("aspect_ratios", [1.0]):
+        ar = float(ar)
+        if not any(abs(ar - x) < 1e-6 for x in ars):
+            ars.append(ar)
+            if attrs.get("flip", True):
+                ars.append(1.0 / ar)
+    step_w = attrs.get("step_w", 0.0) or iw / w
+    step_h = attrs.get("step_h", 0.0) or ih / h
+    offset = attrs.get("offset", 0.5)
+
+    boxes = []
+    for s in min_sizes:
+        for ar in ars:
+            boxes.append((s * math.sqrt(ar), s / math.sqrt(ar)))
+        if max_sizes:
+            ms = max_sizes[min_sizes.index(s)]
+            boxes.append((math.sqrt(s * ms), math.sqrt(s * ms)))
+    num_priors = len(boxes)
+    bw = np.array([b[0] for b in boxes]) / 2.0
+    bh = np.array([b[1] for b in boxes]) / 2.0
+
+    cx = (np.arange(w) + offset) * step_w
+    cy = (np.arange(h) + offset) * step_h
+    cxg, cyg = np.meshgrid(cx, cy)
+    out = np.zeros((h, w, num_priors, 4), np.float32)
+    out[..., 0] = (cxg[..., None] - bw) / iw
+    out[..., 1] = (cyg[..., None] - bh) / ih
+    out[..., 2] = (cxg[..., None] + bw) / iw
+    out[..., 3] = (cyg[..., None] + bh) / ih
+    if attrs.get("clip", True):
+        out = np.clip(out, 0.0, 1.0)
+    var = np.tile(np.array(attrs.get("variances", [0.1, 0.1, 0.2, 0.2]),
+                           np.float32), (h, w, num_priors, 1))
+    return {"Boxes": jnp.asarray(out), "Variances": jnp.asarray(var)}
+
+
+@register_op("iou_similarity", nondiff=("X", "Y"), differentiable=False)
+def _iou_similarity(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]    # (N,4), (M,4) xyxy
+    area_x = jnp.maximum(x[:, 2] - x[:, 0], 0) * \
+        jnp.maximum(x[:, 3] - x[:, 1], 0)
+    area_y = jnp.maximum(y[:, 2] - y[:, 0], 0) * \
+        jnp.maximum(y[:, 3] - y[:, 1], 0)
+    lt = jnp.maximum(x[:, None, :2], y[None, :, :2])
+    rb = jnp.minimum(x[:, None, 2:], y[None, :, 2:])
+    wh = jnp.maximum(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area_x[:, None] + area_y[None, :] - inter
+    return {"Out": inter / jnp.maximum(union, 1e-10)}
+
+
+@register_op("box_coder", nondiff=("PriorBox", "PriorBoxVar", "TargetBox"),
+             differentiable=False)
+def _box_coder(ctx, ins, attrs):
+    prior = ins["PriorBox"][0]          # (M,4) xyxy
+    target = ins["TargetBox"][0]
+    var = ins["PriorBoxVar"][0] if ins.get("PriorBoxVar") else None
+    code_type = attrs.get("code_type", "encode_center_size")
+    pw = prior[:, 2] - prior[:, 0]
+    ph = prior[:, 3] - prior[:, 1]
+    pcx = prior[:, 0] + pw * 0.5
+    pcy = prior[:, 1] + ph * 0.5
+    if var is None:
+        var = jnp.ones_like(prior)
+    if code_type == "encode_center_size":
+        tw = target[:, 2] - target[:, 0]
+        th = target[:, 3] - target[:, 1]
+        tcx = target[:, 0] + tw * 0.5
+        tcy = target[:, 1] + th * 0.5
+        out = jnp.stack([
+            (tcx[:, None] - pcx[None, :]) / pw[None, :] / var[None, :, 0],
+            (tcy[:, None] - pcy[None, :]) / ph[None, :] / var[None, :, 1],
+            jnp.log(jnp.maximum(tw[:, None] / pw[None, :], 1e-10)) /
+            var[None, :, 2],
+            jnp.log(jnp.maximum(th[:, None] / ph[None, :], 1e-10)) /
+            var[None, :, 3]], axis=-1)
+        return {"OutputBox": out}
+    # decode_center_size: target (N,M,4) deltas
+    d = target
+    cx = d[..., 0] * var[None, :, 0] * pw[None, :] + pcx[None, :]
+    cy = d[..., 1] * var[None, :, 1] * ph[None, :] + pcy[None, :]
+    w = jnp.exp(d[..., 2] * var[None, :, 2]) * pw[None, :]
+    h = jnp.exp(d[..., 3] * var[None, :, 3]) * ph[None, :]
+    out = jnp.stack([cx - w * 0.5, cy - h * 0.5,
+                     cx + w * 0.5, cy + h * 0.5], axis=-1)
+    return {"OutputBox": out}
+
+
+@register_op("yolo_box", nondiff=("X", "ImgSize"), differentiable=False)
+def _yolo_box(ctx, ins, attrs):
+    x = ins["X"][0]                     # (N, A*(5+C), H, W)
+    img_size = ins["ImgSize"][0]        # (N,2) h,w
+    anchors = attrs["anchors"]
+    class_num = attrs["class_num"]
+    downsample = attrs.get("downsample_ratio", 32)
+    conf_thresh = attrs.get("conf_thresh", 0.01)
+    n, _, h, w = x.shape
+    na = len(anchors) // 2
+    x = x.reshape(n, na, 5 + class_num, h, w)
+    grid_x = jnp.arange(w, dtype=jnp.float32)[None, None, None, :]
+    grid_y = jnp.arange(h, dtype=jnp.float32)[None, None, :, None]
+    aw = jnp.asarray(anchors[0::2], jnp.float32)[None, :, None, None]
+    ah = jnp.asarray(anchors[1::2], jnp.float32)[None, :, None, None]
+    in_h = h * downsample
+    in_w = w * downsample
+    bx = (jax.nn.sigmoid(x[:, :, 0]) + grid_x) / w
+    by = (jax.nn.sigmoid(x[:, :, 1]) + grid_y) / h
+    bw = jnp.exp(x[:, :, 2]) * aw / in_w
+    bh = jnp.exp(x[:, :, 3]) * ah / in_h
+    conf = jax.nn.sigmoid(x[:, :, 4])
+    probs = jax.nn.sigmoid(x[:, :, 5:]) * conf[:, :, None]
+    mask = (conf > conf_thresh).astype(x.dtype)
+    img_h = img_size[:, 0].astype(jnp.float32)[:, None, None, None]
+    img_w = img_size[:, 1].astype(jnp.float32)[:, None, None, None]
+    boxes = jnp.stack([(bx - bw / 2) * img_w, (by - bh / 2) * img_h,
+                       (bx + bw / 2) * img_w, (by + bh / 2) * img_h],
+                      axis=-1)
+    boxes = boxes * mask[..., None]
+    boxes = boxes.reshape(n, na * h * w, 4)
+    scores = (probs * mask[:, :, None]).transpose(0, 1, 3, 4, 2)
+    scores = scores.reshape(n, na * h * w, class_num)
+    return {"Boxes": boxes, "Scores": scores}
+
+
+@register_op("static_nms", nondiff=("Boxes", "Scores"),
+             differentiable=False)
+def _static_nms(ctx, ins, attrs):
+    """Top-k-capped NMS with static output shape (keep_top_k boxes,
+    score 0 for suppressed slots) — the XLA-compatible form of
+    multiclass_nms; exact filtering happens host-side."""
+    boxes = ins["Boxes"][0]      # (M,4)
+    scores = ins["Scores"][0]    # (M,)
+    iou_th = attrs.get("nms_threshold", 0.45)
+    keep = attrs.get("keep_top_k", 100)
+    keep = min(keep, boxes.shape[0])
+    order = jnp.argsort(-scores)
+    boxes_s = boxes[order][:keep * 4 if keep * 4 < boxes.shape[0]
+                           else boxes.shape[0]]
+    scores_s = scores[order][:boxes_s.shape[0]]
+    m = boxes_s.shape[0]
+    area = jnp.maximum(boxes_s[:, 2] - boxes_s[:, 0], 0) * \
+        jnp.maximum(boxes_s[:, 3] - boxes_s[:, 1], 0)
+    lt = jnp.maximum(boxes_s[:, None, :2], boxes_s[None, :, :2])
+    rb = jnp.minimum(boxes_s[:, None, 2:], boxes_s[None, :, 2:])
+    wh = jnp.maximum(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    iou = inter / jnp.maximum(area[:, None] + area[None, :] - inter, 1e-10)
+
+    def body(i, alive):
+        sup = (iou[i] > iou_th) & (jnp.arange(m) > i) & alive[i]
+        return alive & ~sup
+
+    alive = jax.lax.fori_loop(0, m, body, jnp.ones((m,), bool))
+    final_scores = jnp.where(alive, scores_s, 0.0)
+    order2 = jnp.argsort(-final_scores)[:keep]
+    return {"Out": boxes_s[order2], "Scores": final_scores[order2],
+            "Index": order[order2].astype(jnp.int64)}

@@ -1,0 +1,144 @@
+"""CRF / detection / remat op tests."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu import layers, optimizer
+from paddle_tpu.ops.registry import get_op
+
+
+class _Ctx:
+    program = None
+
+    def rng(self):
+        return jax.random.PRNGKey(0)
+
+
+def _brute_crf(em, w, label):
+    """Exhaustive log-likelihood for tiny cases."""
+    import itertools
+    t, c = em.shape
+    start, stop, trans = w[0], w[1], w[2:]
+
+    def score(path):
+        s = start[path[0]] + em[0, path[0]]
+        for i in range(1, t):
+            s += trans[path[i - 1], path[i]] + em[i, path[i]]
+        return s + stop[path[-1]]
+
+    logz = np.logaddexp.reduce([score(p) for p in
+                                itertools.product(range(c), repeat=t)])
+    return score(label) - logz, max(
+        itertools.product(range(c), repeat=t), key=score)
+
+
+def test_linear_chain_crf_matches_bruteforce():
+    rng = np.random.RandomState(0)
+    t, c = 4, 3
+    em = rng.randn(1, t, c).astype(np.float32)
+    w = rng.randn(c + 2, c).astype(np.float32)
+    label = np.array([[1, 0, 2, 1]], np.int64)
+    outs = get_op("linear_chain_crf").fn(
+        _Ctx(), {"Emission": [jnp.asarray(em)], "Transition": [jnp.asarray(w)],
+                 "Label": [jnp.asarray(label)]}, {})
+    ll = float(np.asarray(outs["LogLikelihood"])[0, 0])
+    ref_ll, ref_path = _brute_crf(em[0], w, label[0])
+    np.testing.assert_allclose(ll, ref_ll, rtol=1e-4)
+
+    dec = get_op("crf_decoding").fn(
+        _Ctx(), {"Emission": [jnp.asarray(em)],
+                 "Transition": [jnp.asarray(w)]}, {})
+    path = np.asarray(dec["ViterbiPath"])[0, :, 0]
+    assert tuple(path) == ref_path
+
+
+def test_crf_gradient_flows():
+    """CRF trained on a fixed path drives its likelihood up."""
+    rng = np.random.RandomState(0)
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        em = layers.data("em", [4, 3], dtype="float32")
+        lbl = layers.data("lbl", [4], dtype="int64")
+        w = layers.create_parameter(
+            [5, 3], "float32", name="crf_w",
+            default_initializer=pt.initializer.Constant(0.0))
+        from paddle_tpu.layer_helper import LayerHelper
+        helper = LayerHelper("crf")
+        ll = helper.create_variable_for_type_inference("float32")
+        helper.append_op(
+            "linear_chain_crf",
+            inputs={"Emission": [em.name], "Transition": [w.name],
+                    "Label": [lbl.name]},
+            outputs={"LogLikelihood": [ll.name]})
+        loss = layers.mean(layers.scale(ll, scale=-1.0))
+        optimizer.SGD(0.5).minimize(loss)
+    exe = pt.Executor()
+    exe.run(startup)
+    feed = {"em": rng.randn(2, 4, 3).astype(np.float32),
+            "lbl": np.array([[1, 0, 2, 1], [0, 0, 1, 2]], np.int64)}
+    l0 = float(exe.run(main, feed=feed, fetch_list=[loss])[0][0])
+    for _ in range(10):
+        l1 = float(exe.run(main, feed=feed, fetch_list=[loss])[0][0])
+    assert l1 < l0
+
+
+def test_iou_and_nms():
+    boxes = jnp.asarray(np.array([[0, 0, 10, 10], [1, 1, 11, 11],
+                                  [20, 20, 30, 30]], np.float32))
+    scores = jnp.asarray(np.array([0.9, 0.8, 0.7], np.float32))
+    iou = np.asarray(get_op("iou_similarity").fn(
+        _Ctx(), {"X": [boxes], "Y": [boxes]}, {})["Out"])
+    assert iou[0, 0] > 0.99 and iou[0, 2] == 0.0 and 0.6 < iou[0, 1] < 0.75
+    nms = get_op("static_nms").fn(
+        _Ctx(), {"Boxes": [boxes], "Scores": [scores]},
+        {"nms_threshold": 0.5, "keep_top_k": 3})
+    kept = np.asarray(nms["Scores"])
+    # box 1 suppressed by box 0 (iou ~0.68 > 0.5); box 2 survives
+    assert kept[0] > 0.85 and kept[1] > 0.65 and kept[2] == 0.0
+
+
+def test_yolo_box_shapes():
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(2, 3 * 7, 4, 4).astype(np.float32))
+    img = jnp.asarray(np.array([[128, 128], [256, 256]], np.int64))
+    outs = get_op("yolo_box").fn(
+        _Ctx(), {"X": [x], "ImgSize": [img]},
+        {"anchors": [10, 13, 16, 30, 33, 23], "class_num": 2,
+         "downsample_ratio": 32})
+    assert np.asarray(outs["Boxes"]).shape == (2, 48, 4)
+    assert np.asarray(outs["Scores"]).shape == (2, 48, 2)
+
+
+def test_recompute_segment_matches_plain():
+    """Remat must not change results — same loss, same grads."""
+    rng = np.random.RandomState(0)
+    xv = rng.rand(4, 8).astype(np.float32)
+
+    def build(use_remat):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("x", [8], dtype="float32")
+            w = layers.create_parameter(
+                [8, 8], "float32", name="wseg",
+                default_initializer=pt.initializer.Constant(0.1))
+
+            def seg(h):
+                return layers.tanh(layers.matmul(h, w))
+
+            h = layers.recompute_segment(seg, [x]) if use_remat else seg(x)
+            loss = layers.reduce_mean(layers.square(h))
+            pgs = pt.append_backward(loss)
+        exe = pt.Executor()
+        exe.run(startup)
+        out = exe.run(main, feed={"x": xv},
+                      fetch_list=[loss, pgs[0][1]])
+        return out
+
+    from paddle_tpu.framework.scope import Scope, scope_guard
+    with scope_guard(Scope()):
+        plain = build(False)
+    with scope_guard(Scope()):
+        remat = build(True)
+    np.testing.assert_allclose(plain[0], remat[0], rtol=1e-6)
+    np.testing.assert_allclose(plain[1], remat[1], rtol=1e-5)
