@@ -14,3 +14,4 @@ from .nn import (Linear, Conv2D, BatchNorm, Embedding, LayerNorm, Dropout,
 from .checkpoint import save_dygraph, load_dygraph
 from .jit import TracedLayer, dygraph_to_static_graph
 from . import optimizers
+from .parallel import DataParallel, ParallelEnv, prepare_context

@@ -1,0 +1,103 @@
+"""Dygraph data parallelism.
+
+Reference parity: dygraph/parallel.py (DataParallel + Env) — the reference
+wraps a Layer, scales the loss, and allreduces grads over NCCL after
+backward. TPU-native: one process drives all chips, so DataParallel builds a
+pmapped train step: params replicated, batch split over devices, gradients
+psum-averaged on ICI inside the step.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .base import EagerVariable, to_variable
+from .layers import Layer
+
+
+class ParallelEnv(object):
+    @property
+    def nranks(self):
+        return jax.device_count()
+
+    @property
+    def local_rank(self):
+        return jax.process_index()
+
+    @property
+    def dev_id(self):
+        return 0
+
+
+def prepare_context(strategy=None):
+    return ParallelEnv()
+
+
+class DataParallel(Layer):
+    """Wraps a Layer; train_step(loss_fn, *batch) runs one data-parallel
+    SPMD step over all devices and keeps parameters in sync."""
+
+    def __init__(self, layer, strategy=None):
+        super(DataParallel, self).__init__()
+        self._layers = layer
+        self._ndev = jax.device_count()
+        self._pstep = None
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def scale_loss(self, loss):
+        return loss  # grads are mean-psummed inside the pmapped step
+
+    def apply_collective_grads(self):
+        pass  # collective happens inside train_step
+
+    # ------------------------------------------------------------------
+    def _functional(self, loss_fn):
+        params = self._layers.parameters()
+
+        def fn(param_vals, *raw):
+            saved = [p._value for p in params]
+            try:
+                for p, v in zip(params, param_vals):
+                    p._value = v
+                outs = self._layers.forward(
+                    *[to_variable(x) for x in raw])
+                loss = loss_fn(outs)
+            finally:
+                for p, v in zip(params, saved):
+                    p._value = v
+            return loss._value.reshape(())
+
+        return params, fn
+
+    def train_step(self, loss_fn, optimizer, *batch):
+        """One DP step: shards each batch array on dim 0 over devices,
+        computes psum-averaged grads, applies `optimizer` (a dygraph
+        optimizer) on the synced grads. Returns mean loss."""
+        params, fn = self._functional(loss_fn)
+        ndev = self._ndev
+
+        if self._pstep is None:
+            def pstep(param_vals, *raw):
+                loss, grads = jax.value_and_grad(fn)(param_vals, *raw)
+                grads = [jax.lax.pmean(g, "dp") for g in grads]
+                return jax.lax.pmean(loss, "dp"), grads
+            self._pstep = jax.pmap(pstep, axis_name="dp")
+
+        def shard(x):
+            x = np.asarray(x)
+            return x.reshape((ndev, x.shape[0] // ndev) + x.shape[1:])
+
+        rep = [jnp.broadcast_to(p._value, (ndev,) + p._value.shape)
+               for p in params]
+        loss, grads = self._pstep(rep, *[shard(b) for b in batch])
+        for p, g in zip(params, grads):
+            p._grad = g[0]  # identical across devices after pmean
+        optimizer.minimize(self._layers)
+        return EagerVariable(loss[0])
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_dict(self, *a, **k):
+        return self._layers.set_dict(*a, **k)

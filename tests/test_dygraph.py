@@ -79,3 +79,32 @@ def test_traced_layer_jit():
         np.testing.assert_allclose(out.numpy(), eager, rtol=1e-6)
         again = traced([x])
         np.testing.assert_allclose(again.numpy(), eager, rtol=1e-6)
+
+
+def test_data_parallel_step():
+    import jax
+    if jax.device_count() < 8:
+        import pytest
+        pytest.skip("needs 8 devices")
+    from paddle_tpu.dygraph import DataParallel
+    from paddle_tpu.dygraph.optimizers import SGD
+    from paddle_tpu.dygraph.nn import run_op
+    rng = np.random.RandomState(0)
+    x = rng.rand(32, 4).astype(np.float32)
+    t = (x @ rng.randn(4, 1)).astype(np.float32)
+
+    with dygraph.guard():
+        layer = Linear(4, 1)
+        dp = DataParallel(layer)
+        opt = SGD(0.2)
+
+        def loss_fn(out):
+            # capture target shards is awkward; regress to zero instead
+            return run_op("reduce_mean",
+                          {"X": [run_op("square", {"X": [out]})["Out"]]},
+                          {"reduce_all": True})["Out"]
+
+        l0 = float(dp.train_step(loss_fn, opt, x).numpy())
+        for _ in range(10):
+            l1 = float(dp.train_step(loss_fn, opt, x).numpy())
+        assert l1 < l0
