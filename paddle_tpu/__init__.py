@@ -35,6 +35,11 @@ from . import inference
 from . import contrib
 from . import native
 from . import profiler
+from . import dataset
+from .dataset import DatasetFactory
+from .parallel_executor import ParallelExecutor
+from . import average
+from .framework.compiler import make_mesh
 from .layers.io import data
 from .install_check import run_check
 

@@ -1,0 +1,34 @@
+"""ParallelExecutor API shim.
+
+Reference parity: python/paddle/fluid/parallel_executor.py. The reference
+class owns per-device scopes + NCCL; here it is a thin veneer over
+CompiledProgram/pjit — kept so fluid training scripts run unchanged.
+"""
+from .framework.compiler import BuildStrategy, CompiledProgram, \
+    ExecutionStrategy
+from .framework.executor import Executor
+from .framework.program import default_main_program
+
+
+class ParallelExecutor(object):
+    def __init__(self, use_cuda=False, loss_name=None, main_program=None,
+                 share_vars_from=None, exec_strategy=None,
+                 build_strategy=None, num_trainers=1, trainer_id=0,
+                 scope=None):
+        self._program = main_program or default_main_program()
+        self._compiled = CompiledProgram(
+            self._program, build_strategy).with_data_parallel(
+                loss_name=loss_name, exec_strategy=exec_strategy)
+        self._exe = Executor()
+        self._scope = scope
+
+    def run(self, fetch_list, feed=None, feed_dict=None, return_numpy=True):
+        feed = feed if feed is not None else feed_dict
+        return self._exe.run(self._compiled, feed=feed,
+                             fetch_list=fetch_list, scope=self._scope,
+                             return_numpy=return_numpy)
+
+    @property
+    def device_count(self):
+        import jax
+        return len(jax.devices())
