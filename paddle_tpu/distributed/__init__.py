@@ -10,3 +10,5 @@ from .mesh import (init_mesh, get_mesh, mesh_axes, DistributedStrategy,
                    shard_parameter, column_parallel_attr, row_parallel_attr)
 from . import fleet
 from .ring_attention import ring_attention
+from .pipeline import (pipeline_forward, pipeline_loss_and_grads,
+                       stack_stage_params)

@@ -1,0 +1,116 @@
+"""Pipeline parallelism over the "pp" mesh axis.
+
+Reference parity: fluid PipelineOptimizer + section_worker (device_worker.cc)
+— the reference runs program "sections" on different GPUs connected by
+queues. TPU-native: every chip on the pp axis holds ONE stage's weights;
+a shard_map SPMD program runs `n_micro + n_stage - 1` ticks of lax.scan,
+rotating microbatch activations around the ring with lax.ppermute (GPipe
+schedule: the skew fills/drains the bubble). All chips execute the same
+code — stage identity comes from lax.axis_index — which is exactly how XLA
+wants MPMD expressed as SPMD.
+
+This is a library-level facility (like ring_attention): stage functions are
+JAX callables (e.g. built from dygraph layers or op kernels); the static
+Program path reaches it through fleet strategy pp_stage_fns.
+"""
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:
+    from jax import shard_map
+except Exception:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map  # type: ignore
+
+
+def _pvary(x, axis_name):
+    try:
+        return lax.pcast(x, (axis_name,), to="varying")
+    except (AttributeError, TypeError):
+        try:
+            return lax.pvary(x, (axis_name,))
+        except AttributeError:
+            return x
+
+
+def pipeline_forward(stage_fn, params_stacked, x_micro, mesh,
+                     axis_name="pp"):
+    """Run a GPipe forward over the pp ring.
+
+    stage_fn(stage_params, h) -> h        (same signature every stage)
+    params_stacked: pytree with leading dim n_stage (stage-sharded on pp)
+    x_micro: (n_micro, micro_batch, ...) microbatched input
+    Returns (n_micro, micro_batch, ...) outputs of the LAST stage.
+    """
+    n_stage = mesh.shape[axis_name]
+    n_micro = x_micro.shape[0]
+    ticks = n_micro + n_stage - 1
+    perm = [(i, (i + 1) % n_stage) for i in range(n_stage)]
+
+    def local_fn(params_local, x_local):
+        # params_local: this stage's params (leading dim 1) ; x_local: all
+        # microbatches (replicated input to stage 0)
+        stage = lax.axis_index(axis_name)
+        params_me = jax.tree.map(lambda p: p[0], params_local)
+        h_shape = x_local.shape[1:]
+        carry_in = _pvary(jnp.zeros(h_shape, x_local.dtype), axis_name)
+        outputs = _pvary(jnp.zeros((n_micro,) + h_shape, x_local.dtype),
+                         axis_name)
+
+        def tick(state, t):
+            carry, outputs = state
+            # stage 0 ingests microbatch t (if any); others use carry
+            mb_idx = jnp.clip(t, 0, n_micro - 1)
+            inject = lax.dynamic_index_in_dim(x_local, mb_idx, 0,
+                                              keepdims=False)
+            h_in = jnp.where(stage == 0, inject, carry)
+            h_out = stage_fn(params_me, h_in)
+            # last stage records its result for microbatch t - (n_stage-1)
+            out_idx = jnp.clip(t - (n_stage - 1), 0, n_micro - 1)
+            is_valid = (t >= n_stage - 1) & (stage == n_stage - 1)
+            cur = lax.dynamic_index_in_dim(outputs, out_idx, 0,
+                                           keepdims=False)
+            upd = jnp.where(is_valid, h_out, cur)
+            outputs = lax.dynamic_update_index_in_dim(outputs, upd,
+                                                      out_idx, 0)
+            # rotate activations forward around the ring
+            carry = lax.ppermute(h_out, axis_name, perm)
+            return (carry, outputs), None
+
+        (carry, outputs), _ = lax.scan(tick, (carry_in, outputs),
+                                       jnp.arange(ticks))
+        # only the last stage holds real outputs; broadcast to all so the
+        # result is replicated (psum of one-hot contribution)
+        contrib = jnp.where(stage == n_stage - 1, outputs,
+                            jnp.zeros_like(outputs))
+        return lax.psum(contrib, axis_name)
+
+    fn = shard_map(
+        local_fn, mesh=mesh,
+        in_specs=(jax.tree.map(lambda _: P(axis_name), params_stacked),
+                  P()),
+        out_specs=P())
+    return fn(params_stacked, x_micro)
+
+
+def pipeline_loss_and_grads(stage_fn, loss_fn, params_stacked, x_micro,
+                            y_micro, mesh, axis_name="pp"):
+    """Differentiable pipeline step: mean loss over microbatches and grads
+    for every stage's params (stage-sharded like the params)."""
+
+    def total_loss(params_stacked):
+        out = pipeline_forward(stage_fn, params_stacked, x_micro, mesh,
+                               axis_name)
+        return loss_fn(out, y_micro)
+
+    return jax.value_and_grad(total_loss)(params_stacked)
+
+
+def stack_stage_params(per_stage_params):
+    """[stage0_tree, stage1_tree, ...] -> one tree with leading stage dim
+    (requires homogeneous stages, the GPipe-on-SPMD contract)."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *per_stage_params)
