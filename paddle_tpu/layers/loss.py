@@ -137,3 +137,26 @@ def mse_loss(input, label):
                      inputs={"Input": [input.name], "Label": [label.name]},
                      outputs={"Out": [out.name]})
     return out
+
+
+def warpctc(input, label, blank=0, norm_by_times=False,
+            input_length=None, label_length=None):
+    """CTC loss (reference: python/paddle/fluid/layers/loss.py warpctc,
+    paddle/fluid/operators/warpctc_op.cc). Dense/padded calling convention:
+    ``input`` (T, N, C) time-major unnormalized logits, ``label`` (N, Lmax)
+    int labels, with per-example ``input_length``/``label_length``. Returns
+    (N, 1) loss. Softmax is applied inside the op, matching warp-ctc.
+    """
+    helper = LayerHelper("warpctc")
+    n = input.shape[1] if input.shape is not None else None
+    out = helper.create_variable_for_type_inference(
+        input.dtype, (n, 1) if n is not None else None)
+    inputs = {"Logits": [input.name], "Label": [label.name]}
+    if input_length is not None:
+        inputs["LogitsLength"] = [input_length.name]
+    if label_length is not None:
+        inputs["LabelLength"] = [label_length.name]
+    helper.append_op("warpctc", inputs=inputs, outputs={"Loss": [out.name]},
+                     attrs={"blank": int(blank),
+                            "norm_by_times": bool(norm_by_times)})
+    return out

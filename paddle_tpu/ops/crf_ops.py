@@ -82,6 +82,90 @@ def _linear_chain_crf(ctx, ins, attrs):
             "TransitionExps": lax.stop_gradient(jnp.exp(w))}
 
 
+@register_op("warpctc", nondiff=("Label", "LogitsLength", "LabelLength"))
+def _warpctc(ctx, ins, attrs):
+    """CTC loss (reference: paddle/fluid/operators/warpctc_op.{h,cc} wrapping
+    baidu-research/warp-ctc). The reference calls a hand-written CUDA library;
+    TPU-native: log-space alpha recursion over the blank-interleaved extended
+    label sequence as one lax.scan — batch-parallel on the VPU, exact gradient
+    via vjp-of-scan (no custom backward needed).
+
+    ins: Logits (T, N, C) time-major unnormalized (softmax applied inside,
+    matching warp-ctc), Label (N, Lmax) int, optional LogitsLength (N,),
+    LabelLength (N,). attrs: blank (default 0), norm_by_times.
+    outs: Loss (N, 1).
+    """
+    logits = ins["Logits"][0].astype(jnp.float32)
+    label = ins["Label"][0]
+    if label.ndim == 3:
+        label = label.reshape(label.shape[:2])
+    label = label.astype(jnp.int32)
+    t, n, c = logits.shape
+    lmax = label.shape[1]
+    blank = int(attrs.get("blank", 0))
+    if ins.get("LogitsLength"):
+        in_len = ins["LogitsLength"][0].reshape(-1).astype(jnp.int32)
+    else:
+        in_len = jnp.full((n,), t, jnp.int32)
+    if ins.get("LabelLength"):
+        lbl_len = ins["LabelLength"][0].reshape(-1).astype(jnp.int32)
+    else:
+        lbl_len = jnp.full((n,), lmax, jnp.int32)
+
+    logp = jax.nn.log_softmax(logits, axis=-1)        # (T,N,C)
+    neg_inf = jnp.float32(-1e30)
+
+    # extended sequence: blank, l1, blank, l2, ..., lL, blank  → S = 2L+1
+    s = 2 * lmax + 1
+    pos = jnp.arange(s)
+    ext = jnp.where(pos[None, :] % 2 == 1,
+                    label[:, jnp.clip(pos // 2, 0, lmax - 1)],
+                    blank)                             # (N,S)
+    valid_s = pos[None, :] < (2 * lbl_len[:, None] + 1)
+    # skip-transition allowed into s when ext[s] != blank and ext[s]!=ext[s-2]
+    ext_m2 = jnp.concatenate(
+        [jnp.full((n, 2), -1, jnp.int32), ext[:, :-2]], axis=1)
+    allow_skip = (pos[None, :] >= 2) & (ext != blank) & (ext != ext_m2)
+
+    def emit(logp_t):                                  # (N,C) -> (N,S)
+        return jnp.take_along_axis(logp_t, ext, axis=1)
+
+    alpha0 = jnp.where((pos[None, :] < 2) & valid_s, emit(logp[0]), neg_inf)
+
+    def step(alpha, xs):
+        logp_t, active = xs                            # (N,C), (N,)
+        a1 = jnp.concatenate(
+            [jnp.full((n, 1), neg_inf), alpha[:, :-1]], axis=1)
+        a2 = jnp.concatenate(
+            [jnp.full((n, 2), neg_inf), alpha[:, :-2]], axis=1)
+        a2 = jnp.where(allow_skip, a2, neg_inf)
+        tot = jax.scipy.special.logsumexp(jnp.stack([alpha, a1, a2]), axis=0)
+        new = jnp.where(valid_s, tot + emit(logp_t), neg_inf)
+        alpha = jnp.where(active[:, None], new, alpha)
+        return alpha, None
+
+    active = (jnp.arange(1, t)[:, None] < in_len[None, :])     # (T-1,N)
+    alpha, _ = lax.scan(step, alpha0, (logp[1:], active))
+
+    # p(label) = alpha[2L] + alpha[2L-1] at t = in_len-1
+    end = 2 * lbl_len                                   # last blank index
+    a_end = jnp.take_along_axis(alpha, end[:, None], axis=1)[:, 0]
+    a_end1 = jnp.take_along_axis(
+        alpha, jnp.maximum(end - 1, 0)[:, None], axis=1)[:, 0]
+    a_end1 = jnp.where(lbl_len > 0, a_end1, neg_inf)
+    ll = jnp.logaddexp(a_end, a_end1)
+    # infeasible alignment (in_len too short for label + required blanks):
+    # report inf like warp-ctc/torch, but keep the gradient finite (zero for
+    # those examples) instead of NaN-poisoning the whole batch
+    loss = jnp.where(ll > 0.5 * neg_inf, -ll, jnp.inf)
+    if attrs.get("norm_by_times"):
+        # reference normalizes the *gradient* by sequence length, leaving the
+        # loss value untouched — same trick, expressed functionally
+        scale = 1.0 / jnp.maximum(in_len.astype(jnp.float32), 1.0)
+        loss = (lax.stop_gradient(loss * (1.0 - scale)) + loss * scale)
+    return {"Loss": loss[:, None]}
+
+
 @register_op("crf_decoding", nondiff=("Emission", "Transition", "Label",
                                       "Length"), differentiable=False)
 def _crf_decoding(ctx, ins, attrs):

@@ -142,3 +142,75 @@ def test_recompute_segment_matches_plain():
         remat = build(True)
     np.testing.assert_allclose(plain[0], remat[0], rtol=1e-6)
     np.testing.assert_allclose(plain[1], remat[1], rtol=1e-5)
+
+
+def test_warpctc_matches_torch():
+    torch = __import__("pytest").importorskip("torch")
+    rng = np.random.RandomState(3)
+    t, n, c, lmax = 12, 4, 6, 5
+    logits = rng.randn(t, n, c).astype(np.float32)
+    label = rng.randint(1, c, size=(n, lmax)).astype(np.int32)
+    in_len = np.array([12, 10, 12, 7], np.int32)
+    lbl_len = np.array([5, 3, 1, 4], np.int32)
+
+    outs = get_op("warpctc").fn(
+        _Ctx(), {"Logits": [jnp.asarray(logits)],
+                 "Label": [jnp.asarray(label)],
+                 "LogitsLength": [jnp.asarray(in_len)],
+                 "LabelLength": [jnp.asarray(lbl_len)]}, {"blank": 0})
+    ours = np.asarray(outs["Loss"])[:, 0]
+
+    tl = torch.from_numpy(logits).log_softmax(-1)
+    ref = torch.nn.functional.ctc_loss(
+        tl, torch.from_numpy(label.astype(np.int64)),
+        torch.from_numpy(in_len.astype(np.int64)),
+        torch.from_numpy(lbl_len.astype(np.int64)),
+        blank=0, reduction="none")
+    np.testing.assert_allclose(ours, ref.numpy(), rtol=1e-4, atol=1e-4)
+
+
+def test_warpctc_gradient_matches_torch():
+    torch = __import__("pytest").importorskip("torch")
+    rng = np.random.RandomState(7)
+    t, n, c, lmax = 8, 2, 5, 3
+    logits = rng.randn(t, n, c).astype(np.float32)
+    label = rng.randint(1, c, size=(n, lmax)).astype(np.int32)
+    in_len = np.array([8, 6], np.int32)
+    lbl_len = np.array([3, 2], np.int32)
+
+    def loss_fn(lg):
+        outs = get_op("warpctc").fn(
+            _Ctx(), {"Logits": [lg], "Label": [jnp.asarray(label)],
+                     "LogitsLength": [jnp.asarray(in_len)],
+                     "LabelLength": [jnp.asarray(lbl_len)]}, {"blank": 0})
+        return jnp.sum(outs["Loss"])
+
+    g = np.asarray(jax.grad(loss_fn)(jnp.asarray(logits)))
+
+    tlg = torch.from_numpy(logits).requires_grad_(True)
+    ref = torch.nn.functional.ctc_loss(
+        tlg.log_softmax(-1), torch.from_numpy(label.astype(np.int64)),
+        torch.from_numpy(in_len.astype(np.int64)),
+        torch.from_numpy(lbl_len.astype(np.int64)),
+        blank=0, reduction="sum")
+    ref.backward()
+    np.testing.assert_allclose(g, tlg.grad.numpy(), rtol=1e-3, atol=1e-4)
+
+
+def test_warpctc_layer_builds_and_trains():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        feat = layers.data("lg", (6, 2, 4), "float32",
+                           append_batch_size=False)
+        logits = layers.fc(feat, size=5, num_flatten_dims=2)
+        lbl = layers.data("lb", (2, 3), "int32", append_batch_size=False)
+        loss = layers.warpctc(logits, lbl, blank=0)
+        avg = layers.mean(loss)
+        optimizer.SGD(0.1).minimize(avg)
+    exe = pt.Executor()
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    feed = {"lg": rng.randn(6, 2, 4).astype(np.float32),
+            "lb": np.array([[1, 2, 1], [3, 1, 2]], np.int32)}
+    out, = exe.run(main, feed=feed, fetch_list=[avg])
+    assert np.isfinite(out).all()
