@@ -7,6 +7,7 @@ sharding of the head dimension and sequence-parallel ring attention.
 """
 from ..layer_helper import LayerHelper
 from .nn import fc, matmul, softmax, dropout, reshape, transpose
+from .tensor import concat
 from ..param_attr import ParamAttr
 
 
@@ -47,6 +48,29 @@ def fused_attention(q, k, v, mask=None, scale=None, causal=False,
     return out
 
 
+def mha_kv_projection(keys, values, d_key, d_value, n_head,
+                      name="multi_head_att"):
+    """Project encoder output once into head-split K/V for cross-attention
+    caching (reference: fast_decoder's static_k/static_v). Uses the same
+    parameter names as multi_head_attention's k/v projections, so a decoder
+    built for training reuses the identical weights at decode time.
+    Returns (static_k, static_v), each (N, H, T_src, Dh)."""
+    def _attr(suffix):
+        return ParamAttr(name=None if name is None else name + suffix)
+
+    k = fc(keys, d_key * n_head, num_flatten_dims=2,
+           param_attr=_attr("_key_fc.w_0"), bias_attr=_attr("_key_fc.b_0"))
+    v = fc(values, d_value * n_head, num_flatten_dims=2,
+           param_attr=_attr("_value_fc.w_0"), bias_attr=_attr("_value_fc.b_0"))
+
+    def _split_heads(x, dh):
+        r = reshape(x, [0, -1 if x.shape[1] == -1 else x.shape[1],
+                        n_head, dh])
+        return transpose(r, [0, 2, 1, 3])
+
+    return _split_heads(k, d_key), _split_heads(v, d_value)
+
+
 def multi_head_attention(queries, keys, values, attn_bias, d_key, d_value,
                          d_model, n_head=1, dropout_rate=0.0, cache=None,
                          param_initializer=None, name="multi_head_att",
@@ -60,21 +84,34 @@ def multi_head_attention(queries, keys, values, attn_bias, d_key, d_value,
         return ParamAttr(name=None if name is None else name + suffix,
                          initializer=param_initializer)
 
-    q = fc(queries, d_key * n_head, num_flatten_dims=2,
-           param_attr=_attr("_query_fc.w_0"), bias_attr=_attr("_query_fc.b_0"))
-    k = fc(keys, d_key * n_head, num_flatten_dims=2,
-           param_attr=_attr("_key_fc.w_0"), bias_attr=_attr("_key_fc.b_0"))
-    v = fc(values, d_value * n_head, num_flatten_dims=2,
-           param_attr=_attr("_value_fc.w_0"),
-           bias_attr=_attr("_value_fc.b_0"))
-
     def _split_heads(x, dh):
         r = reshape(x, [0, -1 if x.shape[1] == -1 else x.shape[1],
                         n_head, dh])
         return transpose(r, [0, 2, 1, 3])
 
-    qh, kh, vh = _split_heads(q, d_key), _split_heads(k, d_key), \
-        _split_heads(v, d_value)
+    q = fc(queries, d_key * n_head, num_flatten_dims=2,
+           param_attr=_attr("_query_fc.w_0"), bias_attr=_attr("_query_fc.b_0"))
+    qh = _split_heads(q, d_key)
+
+    if cache is not None and "static_k" in cache:
+        # cross-attention with precomputed encoder K/V (see mha_kv_projection)
+        kh, vh = cache["static_k"], cache["static_v"]
+    else:
+        k = fc(keys, d_key * n_head, num_flatten_dims=2,
+               param_attr=_attr("_key_fc.w_0"), bias_attr=_attr("_key_fc.b_0"))
+        v = fc(values, d_value * n_head, num_flatten_dims=2,
+               param_attr=_attr("_value_fc.w_0"),
+               bias_attr=_attr("_value_fc.b_0"))
+        kh, vh = _split_heads(k, d_key), _split_heads(v, d_value)
+        if cache is not None:
+            # incremental self-attention: append this step's K/V to the cache
+            # (reference: PaddlePaddle/models transformer fast_decoder cache)
+            if cache.get("k") is not None:
+                kh = concat([cache["k"], kh], axis=2)
+                vh = concat([cache["v"], vh], axis=2)
+            cache["k"], cache["v"] = kh, vh
+            if queries.shape[1] == 1:
+                causal = False    # single newest query sees the whole cache
     ctx = fused_attention(qh, kh, vh, mask=attn_bias,
                           scale=d_key ** -0.5, causal=causal)
     ctx = transpose(ctx, [0, 2, 1, 3])

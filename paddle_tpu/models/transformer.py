@@ -8,7 +8,8 @@ static shapes throughout.
 """
 import paddle_tpu as pt
 from paddle_tpu import layers
-from paddle_tpu.layers.attention import multi_head_attention, fused_attention
+from paddle_tpu.layers.attention import (multi_head_attention,
+                                         fused_attention, mha_kv_projection)
 from paddle_tpu.param_attr import ParamAttr
 from paddle_tpu.initializer import XavierInitializer
 
@@ -29,14 +30,14 @@ class TransformerConfig(object):
         self.tp = tp
 
 
-def _embed(ids, vocab, cfg, name, is_test):
+def _embed(ids, vocab, cfg, name, is_test, pos_offset=0):
     emb = layers.embedding(
         ids, [vocab, cfg.d_model],
         param_attr=ParamAttr(name=name,
                              initializer=pt.initializer.Normal(
                                  0.0, cfg.d_model ** -0.5)))
     emb = layers.scale(emb, scale=cfg.d_model ** 0.5)
-    helper_out = _pos_enc(emb, cfg)
+    helper_out = _pos_enc(emb, cfg, pos_offset)
     if cfg.dropout:
         helper_out = layers.dropout(helper_out, cfg.dropout,
                                     is_test=is_test,
@@ -45,14 +46,14 @@ def _embed(ids, vocab, cfg, name, is_test):
     return helper_out
 
 
-def _pos_enc(x, cfg):
-    helper = layers.scale(x, scale=1.0)
+def _pos_enc(x, cfg, pos_offset=0):
     from ..layer_helper import LayerHelper
     h = LayerHelper("pos_enc")
     out = h.create_variable_for_type_inference(x.dtype, x.shape)
     h.append_op("add_position_encoding", inputs={"X": [x.name]},
                 outputs={"Out": [out.name]},
-                attrs={"alpha": 1.0, "beta": 1.0})
+                attrs={"alpha": 1.0, "beta": 1.0,
+                       "pos_offset": int(pos_offset)})
     return out
 
 
@@ -117,6 +118,52 @@ def decoder(trg_emb, enc_out, trg_bias, src_bias, cfg, is_test):
     return x
 
 
+def _embed_step(ids_t, cfg, name, pos):
+    """Embed a single decode-step token at absolute position ``pos``."""
+    return _embed(ids_t, cfg.trg_vocab, cfg, name, True, pos_offset=pos)
+
+
+def init_decoder_caches(cfg, enc_out, name_prefix="dec"):
+    """Per-layer KV caches for incremental decode (reference: the models-repo
+    fast_decoder's caches list). Self-attention caches start empty and grow
+    by one position per step; cross-attention K/V are projected from the
+    encoder output once and reused every step."""
+    caches = []
+    for i in range(cfg.n_layer):
+        name = "%s_%d" % (name_prefix, i)
+        sk, sv = mha_kv_projection(
+            enc_out, enc_out, cfg.d_model // cfg.n_head,
+            cfg.d_model // cfg.n_head, cfg.n_head,
+            name=name + "_cross_att")
+        caches.append({"self": {"k": None, "v": None},
+                       "cross": {"static_k": sk, "static_v": sv}})
+    return caches
+
+
+def decoder_cached_step(x_t, caches, src_bias, cfg, name_prefix="dec"):
+    """One decoder pass over a single new token x_t (N, 1, D), attending over
+    the KV caches — O(T) per generated token instead of the O(T^2) prefix
+    re-decode. Mutates ``caches`` in place (appends this step's K/V)."""
+    x = x_t
+    for i in range(cfg.n_layer):
+        name = "%s_%d" % (name_prefix, i)
+        self_attn = multi_head_attention(
+            x, None, None, None, cfg.d_model // cfg.n_head,
+            cfg.d_model // cfg.n_head, cfg.d_model, cfg.n_head,
+            0.0, cache=caches[i]["self"], name=name + "_self_att",
+            is_test=True, causal=True)
+        x = _prepost(self_attn, x, cfg, name + "_post_self", True)
+        cross = multi_head_attention(
+            x, None, None, src_bias, cfg.d_model // cfg.n_head,
+            cfg.d_model // cfg.n_head, cfg.d_model, cfg.n_head,
+            0.0, cache=caches[i]["cross"], name=name + "_cross_att",
+            is_test=True)
+        x = _prepost(cross, x, cfg, name + "_post_cross", True)
+        ff = _ffn(x, cfg, name + "_ffn", True)
+        x = _prepost(ff, x, cfg, name + "_post_ffn", True)
+    return x
+
+
 def _attn_bias(mask):
     """(N,T,1) 1/0 mask -> (N,1,1,T) additive bias."""
     m = layers.transpose(mask, [0, 2, 1])
@@ -167,9 +214,11 @@ def transformer_train_program(cfg, src_len, trg_len, optimizer_fn=None,
                            "lbl_ids"], {"loss": avg_cost}
 
 
-def greedy_decode_program(cfg, src_len, max_out_len):
-    """Greedy autoregressive decode via on-device while_loop (inference
-    parity for the reference's beam-search path; beam tracked in SURVEY)."""
+def greedy_decode_program(cfg, src_len, max_out_len, use_cache=True):
+    """Greedy autoregressive decode. With ``use_cache`` (default), each step
+    embeds only the newest token and attends over per-layer KV caches —
+    O(T) work per token. ``use_cache=False`` keeps the O(T^2) prefix
+    re-decode (used as the equivalence oracle in tests)."""
     main, startup = pt.Program(), pt.Program()
     with pt.program_guard(main, startup):
         src_ids = layers.data("src_ids", [src_len, 1], dtype="int64")
@@ -177,8 +226,26 @@ def greedy_decode_program(cfg, src_len, max_out_len):
         src_bias = _attn_bias(src_mask)
         enc_in = _embed(src_ids, cfg.src_vocab, cfg, "src_word_emb", True)
         enc_out = encoder(enc_in, src_bias, cfg, True)
-        # iterative re-decode (O(T^2) but static-shape; KV cache tracked
-        # in SURVEY §7 next-rounds)
+
+        if use_cache:
+            caches = init_decoder_caches(cfg, enc_out)
+            bos = layers.fill_constant_batch_size_like(
+                src_ids, [-1, 1, 1], "int64", 0)
+            tokens = [bos]
+            x_t = _embed_step(bos, cfg, "trg_word_emb", 0)
+            for t in range(max_out_len - 1):
+                dec_out = decoder_cached_step(x_t, caches, src_bias, cfg)
+                logits = layers.fc(dec_out, cfg.trg_vocab,
+                                   num_flatten_dims=2,
+                                   param_attr=ParamAttr(name="dec_out_fc.w"),
+                                   bias_attr=False)       # (N,1,V)
+                nxt = layers.unsqueeze(layers.argmax(logits, axis=-1), [2])
+                tokens.append(nxt)
+                if t + 1 < max_out_len - 1:
+                    x_t = _embed_step(nxt, cfg, "trg_word_emb", t + 1)
+            trg = layers.concat(tokens, axis=1)           # (N,T,1)
+            return main, startup, ["src_ids", "src_mask"], {"out_ids": trg}
+
         batch = src_ids.shape[0]
         trg = layers.fill_constant_batch_size_like(src_ids,
                                                    [-1, max_out_len, 1],
@@ -221,12 +288,16 @@ def synthetic_batch(cfg, batch, src_len, trg_len, seed=0):
 
 
 def beam_search_decode_program(cfg, src_len, max_out_len, beam_size=4,
-                               bos_id=0, eos_id=1, len_penalty=0.6):
+                               bos_id=0, eos_id=1, len_penalty=0.6,
+                               use_cache=True):
     """Beam-search decode (reference: operators/beam_search_op.cc + the
     models-repo fast_decoder). TPU design: beams are a flattened (N*B)
-    batch with STATIC shapes; each unrolled step re-decodes the prefix and
-    expands the top-(B*V) frontier with topk + gather — no dynamic LoD
-    beam structures. Returns out_ids (N, beam, T, 1), scores (N, beam)."""
+    batch with STATIC shapes; the top-(B*V) frontier is expanded with
+    topk + gather — no dynamic LoD beam structures. With ``use_cache``
+    (default) each step decodes only the newest token against per-layer KV
+    caches, gather-reordering the caches on beam selection; otherwise the
+    prefix is re-decoded each step (equivalence oracle).
+    Returns out_ids (N, beam, T, 1), scores (N, beam)."""
     import numpy as np
     main, startup = pt.Program(), pt.Program()
     with pt.program_guard(main, startup):
@@ -262,6 +333,64 @@ def beam_search_decode_program(cfg, src_len, max_out_len, beam_size=4,
             layers.scale(layers.cumsum(ones_nb, axis=0), bias=-1.0),
             "int64")                                        # (N,B)
 
+        if use_cache:
+            # project cross-attention K/V from the untiled encoder output
+            # (N rows), then tile the head-split result across beams — the
+            # projection FCs run once per source row, not once per beam
+            caches = init_decoder_caches(cfg, enc_out)
+            dh = cfg.d_model // cfg.n_head
+            for c in caches:
+                for key in ("static_k", "static_v"):
+                    x = layers.unsqueeze(c["cross"][key], [1])
+                    x = layers.expand(x, [1, b, 1, 1, 1])
+                    c["cross"][key] = layers.reshape(
+                        x, [-1, cfg.n_head, src_len, dh])
+            bos = layers.fill_constant_batch_size_like(
+                enc_rep, [-1, 1, 1], "int64", float(bos_id))
+            ids_mat = layers.reshape(bos, [-1, 1])        # (N*B, t+1)
+            x_t = _embed_step(bos, cfg, "trg_word_emb", 0)
+            for t in range(t_max - 1):
+                dec_out = decoder_cached_step(x_t, caches, bias_rep, cfg)
+                logits = layers.fc(dec_out, v, num_flatten_dims=2,
+                                   param_attr=ParamAttr(name="dec_out_fc.w"),
+                                   bias_attr=False)        # (N*B,1,V)
+                logp = layers.log_softmax(
+                    layers.reshape(logits, [-1, v]))       # (N*B,V)
+                logp_nbv = layers.reshape(logp, [-1, b * v])
+                prev = layers.reshape(scores, [-1, b, 1])
+                prev = layers.expand(prev, [1, 1, v])
+                prev = layers.reshape(prev, [-1, b * v])
+                total = layers.elementwise_add(logp_nbv, prev)
+                top_scores, top_idx = layers.topk(total, k=b)   # (N,B)
+                beam_sel = layers.cast(
+                    layers.elementwise_floordiv(
+                        top_idx, layers.fill_constant([1], "int64", v)),
+                    "int64")
+                word_sel = layers.cast(layers.elementwise_sub(
+                    top_idx, layers.scale(beam_sel, scale=float(v))),
+                    "int64")
+                flat_rows = layers.reshape(
+                    layers.elementwise_add(
+                        layers.scale(row_idx, scale=float(b)), beam_sel),
+                    [-1])                                   # (N*B,)
+                # reorder survivors: token history and every layer's
+                # self-attention KV cache follow their source beam
+                word_col = layers.reshape(word_sel, [-1, 1])
+                ids_mat = layers.concat(
+                    [layers.gather(ids_mat, flat_rows), word_col], axis=1)
+                for c in caches:
+                    c["self"]["k"] = layers.gather(c["self"]["k"], flat_rows)
+                    c["self"]["v"] = layers.gather(c["self"]["v"], flat_rows)
+                scores = top_scores
+                if t + 1 < t_max - 1:
+                    x_t = _embed_step(layers.reshape(word_col, [-1, 1, 1]),
+                                      cfg, "trg_word_emb", t + 1)
+            out_ids = layers.reshape(ids_mat, [-1, b, t_max, 1])
+            final_scores = layers.scale(
+                scores, scale=1.0 / (t_max ** len_penalty))
+            return main, startup, ["src_ids", "src_mask"], \
+                {"out_ids": out_ids, "scores": final_scores}
+
         ones_mask = layers.fill_constant_batch_size_like(
             enc_rep, [-1, t_max, 1], "float32", 1.0)
         trg_bias = _attn_bias(ones_mask)
@@ -287,8 +416,8 @@ def beam_search_decode_program(cfg, src_len, max_out_len, beam_size=4,
                 layers.elementwise_floordiv(
                     top_idx, layers.fill_constant([1], "int64", v)),
                 "int64")
-            word_sel = layers.elementwise_sub(
-                top_idx, layers.scale(beam_sel, scale=float(v)))
+            word_sel = layers.cast(layers.elementwise_sub(
+                top_idx, layers.scale(beam_sel, scale=float(v))), "int64")
             flat_rows = layers.reshape(
                 layers.elementwise_add(
                     layers.scale(row_idx, scale=float(b)), beam_sel),

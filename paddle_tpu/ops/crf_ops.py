@@ -154,15 +154,17 @@ def _warpctc(ctx, ins, attrs):
         alpha, jnp.maximum(end - 1, 0)[:, None], axis=1)[:, 0]
     a_end1 = jnp.where(lbl_len > 0, a_end1, neg_inf)
     ll = jnp.logaddexp(a_end, a_end1)
+    loss = -ll                                          # finite sentinel
+    if attrs.get("norm_by_times"):
+        # reference normalizes the *gradient* by sequence length, leaving the
+        # loss value untouched — same trick, expressed functionally (applied
+        # while loss is still finite so inf examples don't turn into NaN)
+        scale = 1.0 / jnp.maximum(in_len.astype(jnp.float32), 1.0)
+        loss = (lax.stop_gradient(loss * (1.0 - scale)) + loss * scale)
     # infeasible alignment (in_len too short for label + required blanks):
     # report inf like warp-ctc/torch, but keep the gradient finite (zero for
     # those examples) instead of NaN-poisoning the whole batch
-    loss = jnp.where(ll > 0.5 * neg_inf, -ll, jnp.inf)
-    if attrs.get("norm_by_times"):
-        # reference normalizes the *gradient* by sequence length, leaving the
-        # loss value untouched — same trick, expressed functionally
-        scale = 1.0 / jnp.maximum(in_len.astype(jnp.float32), 1.0)
-        loss = (lax.stop_gradient(loss * (1.0 - scale)) + loss * scale)
+    loss = jnp.where(ll > 0.5 * neg_inf, loss, jnp.inf)
     return {"Loss": loss[:, None]}
 
 

@@ -494,7 +494,10 @@ def _add_position_encoding(ctx, ins, attrs):
     alpha = attrs.get("alpha", 1.0)
     beta = attrs.get("beta", 1.0)
     n, l, d = x.shape
-    pos = jnp.arange(l, dtype=jnp.float32)[:, None]
+    # pos_offset: incremental decode adds the encoding for absolute position
+    # t to a single-token slice (KV-cache path)
+    pos = (jnp.arange(l, dtype=jnp.float32)
+           + float(attrs.get("pos_offset", 0)))[:, None]
     i = jnp.arange(d // 2, dtype=jnp.float32)[None, :]
     angle = pos / jnp.power(10000.0, 2 * i / d)
     pe = jnp.concatenate([jnp.sin(angle), jnp.cos(angle)], axis=-1)

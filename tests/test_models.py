@@ -140,3 +140,44 @@ def test_transformer_beam_search():
     # beams sorted by score, all finite
     assert np.isfinite(scores).all()
     assert (np.diff(scores, axis=1) <= 1e-5).all()
+
+
+def test_greedy_decode_kv_cache_matches_redecode():
+    """Cached incremental decode must produce the same tokens as the O(T^2)
+    prefix re-decode (same params, same feed)."""
+    from paddle_tpu.models import transformer as tr
+    cfg = tr.TransformerConfig(src_vocab=50, trg_vocab=50, d_model=16,
+                               d_inner=32, n_head=2, n_layer=2, dropout=0.0)
+    cmain, cstart, _, cfetch = tr.greedy_decode_program(
+        cfg, 7, 6, use_cache=True)
+    rmain, _, _, rfetch = tr.greedy_decode_program(
+        cfg, 7, 6, use_cache=False)
+    exe = pt.Executor()
+    exe.run(cstart)
+    rng = np.random.RandomState(1)
+    feed = {"src_ids": rng.randint(1, 50, (3, 7, 1)).astype(np.int64),
+            "src_mask": np.ones((3, 7, 1), np.float32)}
+    cached, = exe.run(cmain, feed=feed, fetch_list=[cfetch["out_ids"]])
+    redec, = exe.run(rmain, feed=feed, fetch_list=[rfetch["out_ids"]])
+    np.testing.assert_array_equal(cached, redec)
+
+
+def test_beam_search_kv_cache_matches_redecode():
+    from paddle_tpu.models import transformer as tr
+    cfg = tr.TransformerConfig(src_vocab=40, trg_vocab=40, d_model=16,
+                               d_inner=32, n_head=2, n_layer=1, dropout=0.0)
+    cmain, cstart, _, cfetch = tr.beam_search_decode_program(
+        cfg, 6, 5, beam_size=3, use_cache=True)
+    rmain, _, _, rfetch = tr.beam_search_decode_program(
+        cfg, 6, 5, beam_size=3, use_cache=False)
+    exe = pt.Executor()
+    exe.run(cstart)
+    rng = np.random.RandomState(2)
+    feed = {"src_ids": rng.randint(1, 40, (2, 6, 1)).astype(np.int64),
+            "src_mask": np.ones((2, 6, 1), np.float32)}
+    c_ids, c_sc = exe.run(cmain, feed=feed,
+                          fetch_list=[cfetch["out_ids"], cfetch["scores"]])
+    r_ids, r_sc = exe.run(rmain, feed=feed,
+                          fetch_list=[rfetch["out_ids"], rfetch["scores"]])
+    np.testing.assert_array_equal(c_ids, r_ids)
+    np.testing.assert_allclose(c_sc, r_sc, rtol=1e-4, atol=1e-5)
