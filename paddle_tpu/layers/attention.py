@@ -49,14 +49,15 @@ def fused_attention(q, k, v, mask=None, scale=None, causal=False,
 
 
 def mha_kv_projection(keys, values, d_key, d_value, n_head,
-                      name="multi_head_att"):
+                      param_initializer=None, name="multi_head_att"):
     """Project encoder output once into head-split K/V for cross-attention
     caching (reference: fast_decoder's static_k/static_v). Uses the same
     parameter names as multi_head_attention's k/v projections, so a decoder
     built for training reuses the identical weights at decode time.
     Returns (static_k, static_v), each (N, H, T_src, Dh)."""
     def _attr(suffix):
-        return ParamAttr(name=None if name is None else name + suffix)
+        return ParamAttr(name=None if name is None else name + suffix,
+                         initializer=param_initializer)
 
     k = fc(keys, d_key * n_head, num_flatten_dims=2,
            param_attr=_attr("_key_fc.w_0"), bias_attr=_attr("_key_fc.b_0"))
@@ -97,12 +98,9 @@ def multi_head_attention(queries, keys, values, attn_bias, d_key, d_value,
         # cross-attention with precomputed encoder K/V (see mha_kv_projection)
         kh, vh = cache["static_k"], cache["static_v"]
     else:
-        k = fc(keys, d_key * n_head, num_flatten_dims=2,
-               param_attr=_attr("_key_fc.w_0"), bias_attr=_attr("_key_fc.b_0"))
-        v = fc(values, d_value * n_head, num_flatten_dims=2,
-               param_attr=_attr("_value_fc.w_0"),
-               bias_attr=_attr("_value_fc.b_0"))
-        kh, vh = _split_heads(k, d_key), _split_heads(v, d_value)
+        kh, vh = mha_kv_projection(keys, values, d_key, d_value, n_head,
+                                   param_initializer=param_initializer,
+                                   name=name)
         if cache is not None:
             # incremental self-attention: append this step's K/V to the cache
             # (reference: PaddlePaddle/models transformer fast_decoder cache)

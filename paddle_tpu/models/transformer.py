@@ -317,10 +317,8 @@ def beam_search_decode_program(cfg, src_len, max_out_len, beam_size=4,
         bias_rep = layers.expand(bias_rep, [1, b, 1, 1, 1])
         bias_rep = layers.reshape(bias_rep, [-1, 1, 1, src_len])
 
-        # ids (N*B,T,1) init BOS; scores (N,B): beam0=0, others -1e9 so the
+        # scores (N,B): beam0=0, others -1e9 so the
         # first expansion draws B distinct words from beam 0
-        ids = layers.fill_constant_batch_size_like(
-            enc_rep, [-1, t_max, 1], "int64", float(bos_id))
         zeros_nb = layers.fill_constant_batch_size_like(
             src_ids, [-1, b], "float32", 0.0)
         init_row = layers.assign(
@@ -391,6 +389,9 @@ def beam_search_decode_program(cfg, src_len, max_out_len, beam_size=4,
             return main, startup, ["src_ids", "src_mask"], \
                 {"out_ids": out_ids, "scores": final_scores}
 
+        # ids (N*B,T,1) init BOS — full-history buffer for the re-decode path
+        ids = layers.fill_constant_batch_size_like(
+            enc_rep, [-1, t_max, 1], "int64", float(bos_id))
         ones_mask = layers.fill_constant_batch_size_like(
             enc_rep, [-1, t_max, 1], "float32", 1.0)
         trg_bias = _attn_bias(ones_mask)

@@ -15,6 +15,7 @@ Layout contract: q, k, v are (B, H, T, D); additive mask broadcastable
 interpret mode.
 """
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -194,7 +195,11 @@ def flash_attention(q, k, v, mask=None, scale=1.0, causal=False,
     mode off-TPU so tests exercise the same kernel, and to plain fused XLA
     attention when shapes are too small to tile."""
     if interpret is None:
-        interpret = jax.default_backend() not in ("tpu", "axon")
+        env = os.environ.get("PADDLE_TPU_PALLAS_INTERPRET")
+        if env is not None:
+            interpret = env not in ("0", "false", "")
+        else:
+            interpret = jax.default_backend() not in ("tpu", "axon")
     tq, tk = q.shape[2], k.shape[2]
     bq, bk = min(block_q, tq), min(block_k, tk)
     while tq % bq:
