@@ -85,10 +85,35 @@ def _build_subblock(fn, program):
     return block, list(outs)
 
 
+def _collect_captures(blocks_and_outs, bound_names):
+    """Outer-scope names the sub-blocks read (read-before-written, plus
+    returned-but-never-defined), beyond `bound_names`. Listing these as
+    explicit op inputs is what lets gradients flow through control flow:
+    jax.vjp differentiates w.r.t. declared inputs, not closures."""
+    captured, seen = [], set(bound_names)
+    for block, out_names in blocks_and_outs:
+        defined = set(bound_names)
+        for op in block.ops:
+            for n in op.input_names():
+                if n not in defined and n not in seen and \
+                        not n.startswith("@"):
+                    captured.append(n)
+                    seen.add(n)
+            defined.update(op.output_names())
+        for n in out_names:
+            if n not in defined and n not in seen and not n.startswith("@"):
+                captured.append(n)
+                seen.add(n)
+    return captured
+
+
 def cond(pred, true_fn=None, false_fn=None, name=None):
     """layers.cond(pred, true_fn, false_fn) -> vars with matching structure.
 
-    Both branches run as traced lax.cond branches on device.
+    Both branches run as traced lax.cond branches on device. Differentiable:
+    outer vars the branches read are lifted to explicit `Captures` inputs,
+    so append_backward pairs this op with a vjp like any other (reference:
+    conditional_block_grad_op in operators/controlflow).
     """
     helper = LayerHelper("cond", name=name)
     program = default_main_program()
@@ -98,21 +123,36 @@ def cond(pred, true_fn=None, false_fn=None, name=None):
         raise ValueError(
             "cond branches returned different numbers of outputs: %d vs %d"
             % (len(true_outs), len(false_outs)))
+    captures = _collect_captures(
+        [(true_block, [v.name for v in true_outs]),
+         (false_block, [v.name for v in false_outs])], bound_names=())
     outs = [helper.create_variable_for_type_inference(v.dtype, v.shape)
             for v in true_outs]
     helper.append_op(
-        "cond", inputs={"Cond": [pred.name]},
+        "cond", inputs={"Cond": [pred.name], "Captures": captures},
         outputs={"Out": [o.name for o in outs]},
         attrs={"true_block": true_block.idx, "false_block": false_block.idx,
                "true_out_names": [v.name for v in true_outs],
-               "false_out_names": [v.name for v in false_outs]})
+               "false_out_names": [v.name for v in false_outs],
+               "capture_names": captures})
     if not outs:
         return None
     return outs[0] if len(outs) == 1 else outs
 
 
-def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
-    """layers.while_loop — on-device lax.while_loop."""
+def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None,
+               maximum_trip_count=None):
+    """layers.while_loop — on-device loop.
+
+    Without `maximum_trip_count`: lax.while_loop (dynamic trip count;
+    forward-only — XLA cannot reverse-differentiate an unbounded loop).
+    With `maximum_trip_count=N`: a bounded differentiable form — lax.scan of
+    N steps where iterations past the cond turning false are masked out
+    (jnp.where keeps the old carry). Gradients then flow to both the initial
+    loop values and any captured outer vars (reference: while_grad_op in
+    operators/controlflow/while_op.cc; the bound replaces the reference's
+    per-iteration activation stack, which has no static-shape TPU form).
+    """
     helper = LayerHelper("while_loop", name=name)
     program = default_main_program()
 
@@ -139,15 +179,24 @@ def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
             body_block.append_op("assign", inputs={"X": [nv.name]},
                                  outputs={"Out": [lv.name]})
 
+    loop_names = [v.name for v in loop_vars]
+    captures = _collect_captures(
+        [(cond_block, [pred.name]), (body_block, [])],
+        bound_names=loop_names)
     outs = [helper.create_variable_for_type_inference(v.dtype, v.shape)
             for v in loop_vars]
+    attrs = {"cond_block": cond_block.idx, "body_block": body_block.idx,
+             "loop_var_names": loop_names, "cond_out_name": pred.name,
+             "capture_names": captures}
+    op_type = "while_loop"
+    if maximum_trip_count is not None:
+        op_type = "bounded_while"
+        attrs["max_trip_count"] = int(maximum_trip_count)
     helper.append_op(
-        "while_loop",
-        inputs={"LoopVars": [v.name for v in loop_vars]},
+        op_type,
+        inputs={"LoopVars": loop_names, "Captures": captures},
         outputs={"Out": [o.name for o in outs]},
-        attrs={"cond_block": cond_block.idx, "body_block": body_block.idx,
-               "loop_var_names": [v.name for v in loop_vars],
-               "cond_out_name": pred.name})
+        attrs=attrs)
     return outs
 
 
@@ -211,13 +260,8 @@ def recompute_segment(fn, inputs, name=None):
 
     # captures: names read before written inside the segment, beyond inputs
     input_names = {v.name for v in inputs}
-    defined = set(input_names)
-    captured = []
-    for op in block.ops:
-        for n in op.input_names():
-            if n not in defined and n not in captured:
-                captured.append(n)
-        defined.update(op.output_names())
+    captured = _collect_captures([(block, [v.name for v in outs])],
+                                 bound_names=input_names)
     parent = program.current_block()
     cap_vars = []
     for n in captured:

@@ -6,14 +6,27 @@ sub-blocks with a nested executor on the host; here sub-blocks are traced
 into lax.cond / lax.while_loop so control flow stays ON DEVICE inside the
 single compiled step — no host round-trips (the TPU-idiomatic form).
 
-Round-1 limitation (documented): gradients do not flow through cond/while
-(reference backward-through-While parity tracked in SURVEY §2.3); recurrent
-models use the differentiable ``recurrent_scan`` op instead (lax.scan).
+Gradients flow through ``cond`` and bounded ``while_loop``: the layer
+builder lifts every outer var a sub-block reads into an explicit `Captures`
+input (layers/control_flow.py), so the generic trace-time vjp pairing sees
+them as arguments (reference: conditional_block_grad_op / while_grad_op).
+Unbounded ``while_loop`` stays forward-only — XLA cannot reverse-diff a
+dynamic trip count; pass maximum_trip_count for the differentiable form.
 """
 import jax.numpy as jnp
 from jax import lax
 
 from .registry import register_op
+
+
+def _subblock_env(ctx, ins, attrs):
+    """Environment for tracing a sub-block: outer-env snapshot overlaid with
+    the op's explicit captures. The explicit values take precedence — under
+    jax.vjp they are the traced arguments gradients flow back to, while the
+    outer-env copies of the same names would be opaque closure constants."""
+    env = dict(ctx.outer_env or {})
+    env.update(zip(attrs.get("capture_names", []), ins.get("Captures", [])))
+    return env
 
 
 def _branch_fn(ctx, block, out_names, env_snapshot):
@@ -24,14 +37,13 @@ def _branch_fn(ctx, block, out_names, env_snapshot):
     return fn
 
 
-@register_op("cond", uses_subblock=True, nondiff=("Cond",),
-             differentiable=False)
+@register_op("cond", uses_subblock=True, nondiff=("Cond",))
 def _cond(ctx, ins, attrs):
     pred = ins["Cond"][0].reshape(())
     program = ctx.program
     tb = program.block(attrs["true_block"])
     fb = program.block(attrs["false_block"])
-    env = dict(ctx.outer_env)  # snapshot; lax.cond closes over tracers
+    env = _subblock_env(ctx, ins, attrs)
     outs = lax.cond(pred,
                     _branch_fn(ctx, tb, attrs["true_out_names"], env),
                     _branch_fn(ctx, fb, attrs["false_out_names"], env),
@@ -47,7 +59,7 @@ def _while_loop(ctx, ins, attrs):
     body_block = program.block(attrs["body_block"])
     var_names = attrs["loop_var_names"]
     cond_out = attrs["cond_out_name"]
-    env = dict(ctx.outer_env)
+    env = _subblock_env(ctx, ins, attrs)
 
     def cond_fn(vals):
         local = dict(env)
@@ -63,6 +75,41 @@ def _while_loop(ctx, ins, attrs):
 
     outs = lax.while_loop(cond_fn, body_fn, tuple(ins["LoopVars"]))
     return {"Out": list(outs)}
+
+
+@register_op("bounded_while", uses_subblock=True)
+def _bounded_while(ctx, ins, attrs):
+    """Differentiable while: lax.scan of max_trip_count steps; once the cond
+    turns false the carry passes through unchanged (jnp.where), which is a
+    fixpoint since blocks are pure — so the result equals the dynamic loop
+    whenever the true trip count fits the bound."""
+    program = ctx.program
+    cond_block = program.block(attrs["cond_block"])
+    body_block = program.block(attrs["body_block"])
+    var_names = attrs["loop_var_names"]
+    cond_out = attrs["cond_out_name"]
+    env = _subblock_env(ctx, ins, attrs)
+
+    def run_body(vals):
+        local = dict(env)
+        local.update(zip(var_names, vals))
+        ctx.trace_block(body_block, local)
+        return tuple(local[n] for n in var_names)
+
+    def step(vals, _):
+        local = dict(env)
+        local.update(zip(var_names, vals))
+        ctx.trace_block(cond_block, local)
+        pred = local[cond_out].reshape(())
+        # lax.cond (not jnp.where): its vjp differentiates only the taken
+        # branch, so finished iterations contribute an exact identity —
+        # a body with a non-finite Jacobian at the fixpoint (e.g. sqrt at
+        # 0) cannot poison gradients with 0*inf=NaN.
+        return lax.cond(pred, run_body, lambda vs: vs, vals), None
+
+    vals, _ = lax.scan(step, tuple(ins["LoopVars"]), None,
+                       length=int(attrs["max_trip_count"]))
+    return {"Out": list(vals)}
 
 
 @register_op("recurrent_scan", uses_subblock=True)
