@@ -15,3 +15,11 @@ from .rnn import *           # noqa: F401,F403
 from .attention import *     # noqa: F401,F403
 from .collective import *    # noqa: F401,F403
 from .distributions import Normal, Uniform, Categorical  # noqa: F401
+from . import detection  # noqa: F401
+from .detection import (  # noqa: F401
+    prior_box, density_prior_box, multi_box_head, anchor_generator,
+    bipartite_match, target_assign, detection_output, ssd_loss,
+    sigmoid_focal_loss, iou_similarity, box_coder, polygon_box_transform,
+    yolov3_loss, yolo_box, box_clip, multiclass_nms,
+    distribute_fpn_proposals, collect_fpn_proposals, box_decoder_and_assign,
+    generate_proposals, roi_align, roi_pool)

@@ -148,6 +148,44 @@ def _yolo_box(ctx, ins, attrs):
     return {"Boxes": boxes, "Scores": scores}
 
 
+def _nms_alive(boxes, scores, iou_th, score_th=0.0, normalized=True,
+               nms_eta=1.0):
+    """Greedy NMS survivor mask with static shapes (boxes (M,4), scores (M,)).
+
+    Shared core of static_nms / multiclass_nms / generate_proposals. Boxes are
+    visited in score order; a box dies if it overlaps a higher-scoring live
+    box by > iou_th. normalized=False adds the reference's +1 pixel offset to
+    widths/heights; nms_eta < 1 decays the threshold adaptively, as in
+    multiclass_nms_op.cc. Returns a bool mask aligned with the input order.
+    """
+    m = boxes.shape[0]
+    off = 0.0 if normalized else 1.0
+    order = jnp.argsort(-scores)
+    b = boxes[order]
+    s = scores[order]
+    area = jnp.maximum(b[:, 2] - b[:, 0] + off, 0) * \
+        jnp.maximum(b[:, 3] - b[:, 1] + off, 0)
+    lt = jnp.maximum(b[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(b[:, None, 2:], b[None, :, 2:])
+    wh = jnp.maximum(rb - lt + off, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    iou = inter / jnp.maximum(area[:, None] + area[None, :] - inter, 1e-10)
+
+    def body(i, state):
+        alive, th = state
+        sup = (iou[i] > th) & (jnp.arange(m) > i) & alive[i]
+        th = jnp.where((nms_eta < 1.0) & (th > 0.5) & alive[i],
+                       th * nms_eta, th)
+        return alive & ~sup, th
+
+    alive, _ = jax.lax.fori_loop(
+        0, m, body, (jnp.ones((m,), bool), jnp.asarray(iou_th, jnp.float32)))
+    alive = alive & (s > score_th)
+    # scatter back to input order
+    inv = jnp.zeros((m,), jnp.int32).at[order].set(jnp.arange(m, dtype=jnp.int32))
+    return alive[inv]
+
+
 @register_op("static_nms", nondiff=("Boxes", "Scores"),
              differentiable=False)
 def _static_nms(ctx, ins, attrs):
@@ -181,3 +219,811 @@ def _static_nms(ctx, ins, attrs):
     order2 = jnp.argsort(-final_scores)[:keep]
     return {"Out": boxes_s[order2], "Scores": final_scores[order2],
             "Index": order[order2].astype(jnp.int64)}
+
+
+@register_op("anchor_generator", nondiff=("Input",), differentiable=False)
+def _anchor_generator(ctx, ins, attrs):
+    """FasterRCNN-style anchors (reference detection/anchor_generator_op.h:28).
+
+    Anchors are in input-image coordinates (NOT normalized like prior_box);
+    centers at (idx*stride + offset*(stride-1)); base w/h from the stride
+    cell area re-shaped by the aspect ratio, scaled by size/stride.
+    """
+    feat = ins["Input"][0]            # (N, C, H, W)
+    h, w = feat.shape[2], feat.shape[3]
+    sizes = [float(s) for s in attrs["anchor_sizes"]]
+    ratios = [float(r) for r in attrs.get("aspect_ratios", [1.0])]
+    sw, sh = [float(s) for s in attrs.get("stride", [16.0, 16.0])]
+    offset = float(attrs.get("offset", 0.5))
+    variances = attrs.get("variances", [0.1, 0.1, 0.2, 0.2])
+
+    aw, ah = [], []
+    for ar in ratios:
+        base_w = round(math.sqrt(sw * sh / ar))
+        base_h = round(base_w * ar)
+        for s in sizes:
+            aw.append(s / sw * base_w)
+            ah.append(s / sh * base_h)
+    aw = np.asarray(aw, np.float32)
+    ah = np.asarray(ah, np.float32)
+    num_anchors = aw.shape[0]
+
+    cx = np.arange(w, dtype=np.float32) * sw + offset * (sw - 1)
+    cy = np.arange(h, dtype=np.float32) * sh + offset * (sh - 1)
+    cxg, cyg = np.meshgrid(cx, cy)
+    out = np.empty((h, w, num_anchors, 4), np.float32)
+    out[..., 0] = cxg[..., None] - 0.5 * (aw - 1)
+    out[..., 1] = cyg[..., None] - 0.5 * (ah - 1)
+    out[..., 2] = cxg[..., None] + 0.5 * (aw - 1)
+    out[..., 3] = cyg[..., None] + 0.5 * (ah - 1)
+    var = np.tile(np.asarray(variances, np.float32), (h, w, num_anchors, 1))
+    return {"Anchors": jnp.asarray(out), "Variances": jnp.asarray(var)}
+
+
+@register_op("density_prior_box", nondiff=("Input", "Image"),
+             differentiable=False)
+def _density_prior_box(ctx, ins, attrs):
+    """Density prior boxes (reference detection/density_prior_box_op.h:25):
+    per fixed_size a density x density grid of shifted centers, one box per
+    fixed_ratio, normalized to [0,1] by the image size."""
+    feat, img = ins["Input"][0], ins["Image"][0]
+    h, w = feat.shape[2], feat.shape[3]
+    ih, iw = img.shape[2], img.shape[3]
+    fixed_sizes = [float(s) for s in attrs["fixed_sizes"]]
+    fixed_ratios = [float(r) for r in attrs["fixed_ratios"]]
+    densities = [int(d) for d in attrs["densities"]]
+    step_w = float(attrs.get("step_w", 0.0)) or iw / w
+    step_h = float(attrs.get("step_h", 0.0)) or ih / h
+    offset = float(attrs.get("offset", 0.5))
+    step_avg = int((step_w + step_h) * 0.5)
+
+    # per-prior (dx, dy, bw/2, bh/2) offsets relative to the cell center
+    offs = []
+    for fs, density in zip(fixed_sizes, densities):
+        shift = step_avg // density
+        for r in fixed_ratios:
+            bw = fs * math.sqrt(r)
+            bh = fs / math.sqrt(r)
+            base = -step_avg / 2.0 + shift / 2.0
+            for di in range(density):
+                for dj in range(density):
+                    offs.append((base + dj * shift, base + di * shift,
+                                 bw / 2.0, bh / 2.0))
+    offs = np.asarray(offs, np.float32)
+    num_priors = offs.shape[0]
+
+    cx = (np.arange(w, dtype=np.float32) + offset) * step_w
+    cy = (np.arange(h, dtype=np.float32) + offset) * step_h
+    cxg, cyg = np.meshgrid(cx, cy)
+    px = cxg[..., None] + offs[:, 0]
+    py = cyg[..., None] + offs[:, 1]
+    out = np.stack([np.maximum((px - offs[:, 2]) / iw, 0.0),
+                    np.maximum((py - offs[:, 3]) / ih, 0.0),
+                    np.minimum((px + offs[:, 2]) / iw, 1.0),
+                    np.minimum((py + offs[:, 3]) / ih, 1.0)], axis=-1)
+    if attrs.get("clip", False):
+        out = np.clip(out, 0.0, 1.0)
+    var = np.tile(np.asarray(attrs.get("variances", [0.1, 0.1, 0.2, 0.2]),
+                             np.float32), (h, w, num_priors, 1))
+    out = out.astype(np.float32)
+    if attrs.get("flatten_to_2d", False):
+        out = out.reshape(-1, 4)
+        var = var.reshape(-1, 4)
+    return {"Boxes": jnp.asarray(out), "Variances": jnp.asarray(var)}
+
+
+@register_op("box_clip", nondiff=("ImInfo",))
+def _box_clip(ctx, ins, attrs):
+    """Clip boxes to image bounds (reference detection/box_clip_op.h:25).
+    ImInfo rows are (h, w, scale); boxes clip to [0, dim/scale - 1]."""
+    boxes = ins["Input"][0]       # (N, M, 4) or (M, 4)
+    im_info = ins["ImInfo"][0]    # (N, 3)
+    squeeze = boxes.ndim == 2
+    if squeeze:
+        boxes = boxes[None]
+    hmax = im_info[:, 0] / im_info[:, 2] - 1.0   # (N,)
+    wmax = im_info[:, 1] / im_info[:, 2] - 1.0
+    x1 = jnp.clip(boxes[..., 0], 0.0, wmax[:, None])
+    y1 = jnp.clip(boxes[..., 1], 0.0, hmax[:, None])
+    x2 = jnp.clip(boxes[..., 2], 0.0, wmax[:, None])
+    y2 = jnp.clip(boxes[..., 3], 0.0, hmax[:, None])
+    out = jnp.stack([x1, y1, x2, y2], axis=-1)
+    return {"Output": out[0] if squeeze else out}
+
+
+def _bipartite_match_single(dist, match_type, overlap_threshold):
+    """Greedy max bipartite matching on one (R, C) distance matrix —
+    reference detection/bipartite_match_op.cc:61 (BipartiteMatch then
+    optional ArgMaxMatch for still-unmatched columns)."""
+    r, c = dist.shape
+    eps = 1e-6
+
+    def body(_, state):
+        col_match, col_dist, row_used = state
+        masked = jnp.where(row_used[:, None] | (col_match[None, :] >= 0),
+                           -jnp.inf, dist)
+        flat = jnp.argmax(masked)
+        i, j = flat // c, flat % c
+        best = masked[i, j]
+        take = best > eps
+        col_match = jnp.where(take, col_match.at[j].set(i.astype(jnp.int32)),
+                              col_match)
+        col_dist = jnp.where(take, col_dist.at[j].set(best), col_dist)
+        row_used = jnp.where(take, row_used.at[i].set(True), row_used)
+        return col_match, col_dist, row_used
+
+    init = (jnp.full((c,), -1, jnp.int32), jnp.zeros((c,), dist.dtype),
+            jnp.zeros((r,), bool))
+    col_match, col_dist, _ = jax.lax.fori_loop(0, min(r, c), body, init)
+
+    if match_type == "per_prediction":
+        best_row = jnp.argmax(dist, axis=0).astype(jnp.int32)
+        best_val = jnp.max(dist, axis=0)
+        extra = (col_match < 0) & (best_val > overlap_threshold)
+        col_match = jnp.where(extra, best_row, col_match)
+        col_dist = jnp.where(extra, best_val, col_dist)
+    return col_match, col_dist
+
+
+@register_op("bipartite_match", nondiff=("DistMat",), differentiable=False)
+def _bipartite_match(ctx, ins, attrs):
+    dist = ins["DistMat"][0]
+    match_type = attrs.get("match_type", "bipartite")
+    th = float(attrs.get("dist_threshold", 0.5))
+    if dist.ndim == 2:
+        dist = dist[None]
+    m, d = jax.vmap(lambda dm: _bipartite_match_single(dm, match_type, th))(dist)
+    return {"ColToRowMatchIndices": m, "ColToRowMatchDist": d}
+
+
+@register_op("target_assign", nondiff=("X", "MatchIndices", "NegIndices"),
+             differentiable=False)
+def _target_assign(ctx, ins, attrs):
+    """Assign row entities to matched columns (reference
+    detection/target_assign_op.h): out[i,j] = x[i, match[i,j]] when
+    match >= 0 else mismatch_value; weight 1 where matched (or negative)."""
+    x = ins["X"][0]                      # (N, R, K)
+    match = ins["MatchIndices"][0]       # (N, C) int32, -1 = unmatched
+    mismatch = attrs.get("mismatch_value", 0)
+    safe = jnp.maximum(match, 0)
+    out = jnp.take_along_axis(x, safe[..., None], axis=1)
+    out = jnp.where((match >= 0)[..., None], out,
+                    jnp.asarray(mismatch, x.dtype))
+    wt = (match >= 0).astype(jnp.float32)[..., None]
+    if ins.get("NegIndices"):
+        neg = ins["NegIndices"][0]       # (N, C) bool/int mask of negatives
+        wt = jnp.maximum(wt, neg.astype(jnp.float32).reshape(wt.shape))
+    return {"Out": out, "OutWeight": wt}
+
+
+@register_op("sigmoid_focal_loss", nondiff=("Label", "FgNum"))
+def _sigmoid_focal_loss(ctx, ins, attrs):
+    """Focal loss (reference detection/sigmoid_focal_loss_op.h:26). Labels in
+    0..C with 0 = background, -1 = ignored; normalized by FgNum."""
+    x = ins["X"][0]                      # (N, C) logits
+    label = ins["Label"][0].reshape(-1)  # (N,)
+    fg = ins["FgNum"][0].reshape(-1)[0]
+    gamma = float(attrs.get("gamma", 2.0))
+    alpha = float(attrs.get("alpha", 0.25))
+    c = x.shape[1]
+    d = jnp.arange(1, c + 1)
+    c_pos = (label[:, None] == d).astype(x.dtype)
+    c_neg = ((label[:, None] != -1) & (label[:, None] != d)).astype(x.dtype)
+    fg_num = jnp.maximum(fg, 1).astype(x.dtype)
+    p = jax.nn.sigmoid(x)
+    term_pos = jnp.power(1.0 - p, gamma) * jnp.log(jnp.maximum(p, 1e-37))
+    # log(1-p) computed stably as -x*(x>=0) - log1p(exp(x - 2x*(x>=0)))
+    pos_x = (x >= 0).astype(x.dtype)
+    term_neg = jnp.power(p, gamma) * (
+        -x * pos_x - jnp.log1p(jnp.exp(x - 2.0 * x * pos_x)))
+    out = -c_pos * term_pos * (alpha / fg_num) \
+        - c_neg * term_neg * ((1.0 - alpha) / fg_num)
+    return {"Out": out}
+
+
+@register_op("polygon_box_transform", differentiable=False)
+def _polygon_box_transform(ctx, ins, attrs):
+    """EAST geo-map offsets -> absolute quad coords (reference
+    detection/polygon_box_transform_op.cc:23): even channels use 4*w - in,
+    odd channels 4*h - in."""
+    x = ins["Input"][0]                  # (N, G, H, W)
+    n, g, h, w = x.shape
+    wi = jnp.arange(w, dtype=x.dtype)[None, None, None, :]
+    hi = jnp.arange(h, dtype=x.dtype)[None, None, :, None]
+    even = (jnp.arange(g) % 2 == 0)[None, :, None, None]
+    return {"Output": jnp.where(even, 4.0 * wi - x, 4.0 * hi - x)}
+
+
+def _roi_batch_index(rois_num, num_rois, n):
+    """RoisNum (N,) per-image counts -> (num_rois,) image index."""
+    ends = jnp.cumsum(rois_num)
+    return jnp.sum(jnp.arange(num_rois)[:, None] >= ends[None, :],
+                   axis=1).astype(jnp.int32)
+
+
+@register_op("roi_align", nondiff=("ROIs", "RoisNum"))
+def _roi_align(ctx, ins, attrs):
+    """RoIAlign (reference detection-era roi_align_op.h): average of bilinear
+    samples per bin; XLA gathers give exact scatter-add gradients. With
+    sampling_ratio <= 0 the reference adapts samples to the roi size
+    (dynamic); we use a fixed 2x2 grid per bin — the detectron default."""
+    x = ins["X"][0]                      # (N, C, H, W)
+    rois = ins["ROIs"][0]                # (R, 4) xyxy in input-image coords
+    n, c, h, w = x.shape
+    r = rois.shape[0]
+    ph = int(attrs.get("pooled_height", 1))
+    pw = int(attrs.get("pooled_width", 1))
+    scale = float(attrs.get("spatial_scale", 1.0))
+    sr = int(attrs.get("sampling_ratio", -1))
+    if sr <= 0:
+        sr = 2
+    if ins.get("RoisNum"):
+        bidx = _roi_batch_index(ins["RoisNum"][0], r, n)
+    else:
+        bidx = jnp.zeros((r,), jnp.int32)
+
+    x1 = rois[:, 0] * scale
+    y1 = rois[:, 1] * scale
+    rw = jnp.maximum(rois[:, 2] * scale - x1, 1.0)
+    rh = jnp.maximum(rois[:, 3] * scale - y1, 1.0)
+    bin_h = rh / ph
+    bin_w = rw / pw
+    iy = (jnp.arange(sr) + 0.5) / sr                       # (sr,)
+    gy = y1[:, None, None] + (jnp.arange(ph)[None, :, None] +
+                              iy[None, None, :]) * bin_h[:, None, None]
+    gx = x1[:, None, None] + (jnp.arange(pw)[None, :, None] +
+                              iy[None, None, :]) * bin_w[:, None, None]
+    gy = gy.reshape(r, ph * sr)                            # (R, PH*S)
+    gx = gx.reshape(r, pw * sr)
+
+    def bilinear_1d(coord, size):
+        coord = jnp.clip(coord, 0.0, size - 1.0)
+        lo = jnp.floor(coord).astype(jnp.int32)
+        hi = jnp.minimum(lo + 1, size - 1)
+        frac = coord - lo
+        return lo, hi, frac
+
+    y0, y1i, fy = bilinear_1d(gy, h)
+    x0, x1i, fx = bilinear_1d(gx, w)
+    xb = x[bidx]                                           # (R, C, H, W)
+    ridx = jnp.arange(r)[:, None, None]
+    ya, yb_, xa, xb_ = (y0[:, :, None], y1i[:, :, None],
+                       x0[:, None, :], x1i[:, None, :])
+    v00 = xb[ridx, :, ya, xa]                              # (R, PH*S, PW*S, C)
+    v01 = xb[ridx, :, ya, xb_]
+    v10 = xb[ridx, :, yb_, xa]
+    v11 = xb[ridx, :, yb_, xb_]
+    fyb = fy[:, :, None, None]
+    fxb = fx[:, None, :, None]
+    vals = (v00 * (1 - fyb) * (1 - fxb) + v01 * (1 - fyb) * fxb +
+            v10 * fyb * (1 - fxb) + v11 * fyb * fxb)       # (R,PH*S,PW*S,C)
+    vals = vals.reshape(r, ph, sr, pw, sr, c)
+    out = vals.mean(axis=(2, 4)).transpose(0, 3, 1, 2)     # (R, C, PH, PW)
+    return {"Out": out}
+
+
+@register_op("roi_pool", nondiff=("ROIs", "RoisNum"))
+def _roi_pool(ctx, ins, attrs):
+    """RoIPool (reference roi_pool_op.h): quantized bins, max per bin.
+    Computed as a masked max over the full map — static shapes, exact
+    reference bin arithmetic, differentiable through max."""
+    x = ins["X"][0]
+    rois = ins["ROIs"][0]
+    n, c, h, w = x.shape
+    r = rois.shape[0]
+    ph = int(attrs.get("pooled_height", 1))
+    pw = int(attrs.get("pooled_width", 1))
+    scale = float(attrs.get("spatial_scale", 1.0))
+    if ins.get("RoisNum"):
+        bidx = _roi_batch_index(ins["RoisNum"][0], r, n)
+    else:
+        bidx = jnp.zeros((r,), jnp.int32)
+
+    x1 = jnp.round(rois[:, 0] * scale)
+    y1 = jnp.round(rois[:, 1] * scale)
+    x2 = jnp.round(rois[:, 2] * scale)
+    y2 = jnp.round(rois[:, 3] * scale)
+    rh = jnp.maximum(y2 - y1 + 1, 1.0)
+    rw = jnp.maximum(x2 - x1 + 1, 1.0)
+
+    def bin_mask(start, extent, p, size):
+        # (R, P, size) True where pixel in [start + floor(i*e/p),
+        #                                   start + ceil((i+1)*e/p))
+        i = jnp.arange(p, dtype=jnp.float32)
+        lo = start[:, None] + jnp.floor(i * extent[:, None] / p)
+        hi = start[:, None] + jnp.ceil((i + 1) * extent[:, None] / p)
+        lo = jnp.clip(lo, 0, size)
+        hi = jnp.clip(hi, 0, size)
+        pix = jnp.arange(size, dtype=jnp.float32)
+        return (pix[None, None, :] >= lo[..., None]) & \
+               (pix[None, None, :] < hi[..., None])
+
+    mh = bin_mask(y1, rh, ph, h)                           # (R, PH, H)
+    mw = bin_mask(x1, rw, pw, w)                           # (R, PW, W)
+    xb = x[bidx]                                           # (R, C, H, W)
+    neg = jnp.asarray(-jnp.inf, x.dtype)
+    # reduce one output bin per (unrolled, static-count) iteration so the
+    # largest intermediate stays O(R*C*H*W) — a broadcast over all PH*PW
+    # bins at once would be PW (then PH) times larger
+    cols = [jnp.where(mw[:, None, None, j, :], xb, neg).max(axis=-1)
+            for j in range(pw)]
+    t = jnp.stack(cols, axis=-1)                           # (R, C, H, PW)
+    rows = [jnp.where(mh[:, None, i, :, None], t, neg).max(axis=2)
+            for i in range(ph)]
+    out = jnp.stack(rows, axis=2)                          # (R, C, PH, PW)
+    empty = ~(mh.any(-1)[:, None, :, None] & mw.any(-1)[:, None, None, :])
+    out = jnp.where(empty, 0.0, out)
+    return {"Out": out}
+
+
+@register_op("multiclass_nms", nondiff=("BBoxes", "Scores"),
+             differentiable=False)
+def _multiclass_nms(ctx, ins, attrs):
+    """Static-shape multiclass NMS (reference detection/multiclass_nms_op.cc).
+    Output is (N, keep_top_k, 6) [label, score, x1, y1, x2, y2] with -1
+    labels / 0 scores in suppressed slots (the reference emits a variable-
+    length LoD tensor; a fixed-capacity tensor is the XLA-native form)."""
+    bboxes = ins["BBoxes"][0]            # (N, M, 4)
+    scores = ins["Scores"][0]            # (N, C, M)
+    score_th = float(attrs.get("score_threshold", 0.0))
+    iou_th = float(attrs.get("nms_threshold", 0.3))
+    nms_top_k = int(attrs.get("nms_top_k", -1))
+    keep_top_k = int(attrs.get("keep_top_k", -1))
+    bg = int(attrs.get("background_label", 0))
+    normalized = bool(attrs.get("normalized", True))
+    nms_eta = float(attrs.get("nms_eta", 1.0))
+    n, cc, m = scores.shape
+    m_eff = min(m, nms_top_k) if nms_top_k > 0 else m
+    if keep_top_k <= 0:
+        keep_top_k = m
+    keep_top_k = min(keep_top_k, cc * m_eff)
+
+    def per_class(boxes, sc):
+        cand = jnp.arange(m)
+        if m_eff < m:
+            _, top = jax.lax.top_k(sc, m_eff)
+            boxes, sc, cand = boxes[top], sc[top], top
+        alive = _nms_alive(boxes, sc, iou_th, score_th, normalized, nms_eta)
+        return boxes, jnp.where(alive, sc, 0.0), cand
+
+    def per_image(boxes, sc):
+        cb, cs, cidx = jax.vmap(lambda s: per_class(boxes, s))(sc)
+        labels = jnp.broadcast_to(jnp.arange(cc)[:, None], cs.shape)
+        flat_s = cs.reshape(-1)
+        flat_b = cb.reshape(-1, 4)
+        flat_l = labels.reshape(-1)
+        flat_i = cidx.reshape(-1)
+        if bg >= 0:
+            flat_s = jnp.where(flat_l == bg, 0.0, flat_s)
+        top_s, idx = jax.lax.top_k(flat_s, keep_top_k)
+        sel_b = flat_b[idx]
+        sel_l = jnp.where(top_s > 0, flat_l[idx], -1).astype(jnp.float32)
+        # Index into the per-image BBoxes rows (-1 for empty slots), the
+        # multiclass_nms2 "Index" output
+        sel_i = jnp.where(top_s > 0, flat_i[idx], -1).astype(jnp.int32)
+        return (jnp.concatenate([sel_l[:, None], top_s[:, None], sel_b], -1),
+                sel_i)
+
+    out, index = jax.vmap(per_image)(bboxes, scores)
+    nms_rois_num = (out[..., 1] > 0).sum(-1).astype(jnp.int32)
+    return {"Out": out, "Index": index, "NmsRoisNum": nms_rois_num}
+
+
+@register_op("box_decoder_and_assign",
+             nondiff=("PriorBox", "PriorBoxVar", "TargetBox", "BoxScore"),
+             differentiable=False)
+def _box_decoder_and_assign(ctx, ins, attrs):
+    """Decode per-class deltas and pick each roi's best-class box
+    (reference detection/box_decoder_and_assign_op.h)."""
+    prior = ins["PriorBox"][0]           # (M, 4)
+    var = ins["PriorBoxVar"][0]          # (M, 4) or (4,)
+    deltas = ins["TargetBox"][0]         # (M, 4*C)
+    score = ins["BoxScore"][0]           # (M, C)
+    clip = float(attrs.get("box_clip", 4.135))
+    m, c = score.shape
+    d = deltas.reshape(m, c, 4)
+    if var.ndim == 1:
+        var = jnp.broadcast_to(var, (m, 4))
+    pw = prior[:, 2] - prior[:, 0] + 1.0
+    ph = prior[:, 3] - prior[:, 1] + 1.0
+    pcx = prior[:, 0] + pw * 0.5
+    pcy = prior[:, 1] + ph * 0.5
+    dx = d[..., 0] * var[:, None, 0]
+    dy = d[..., 1] * var[:, None, 1]
+    dw = jnp.clip(d[..., 2] * var[:, None, 2], -clip, clip)
+    dh = jnp.clip(d[..., 3] * var[:, None, 3], -clip, clip)
+    cx = dx * pw[:, None] + pcx[:, None]
+    cy = dy * ph[:, None] + pcy[:, None]
+    bw = jnp.exp(dw) * pw[:, None]
+    bh = jnp.exp(dh) * ph[:, None]
+    decoded = jnp.stack([cx - bw / 2, cy - bh / 2,
+                         cx + bw / 2 - 1, cy + bh / 2 - 1], -1)  # (M, C, 4)
+    best = jnp.argmax(score, axis=1)
+    assigned = jnp.take_along_axis(
+        decoded, best[:, None, None].repeat(4, -1), axis=1)[:, 0]
+    return {"DecodeBox": decoded.reshape(m, c * 4), "OutputAssignBox": assigned}
+
+
+@register_op("generate_proposals",
+             nondiff=("Scores", "BboxDeltas", "ImInfo", "Anchors", "Variances"),
+             differentiable=False)
+def _generate_proposals(ctx, ins, attrs):
+    """RPN proposal generation (reference detection/generate_proposals_op.cc)
+    with static shapes: decode -> clip -> small-box filter (as score mask)
+    -> pre_nms top-k -> NMS -> post_nms top-k padded with zeros."""
+    scores = ins["Scores"][0]            # (N, A, H, W)
+    deltas = ins["BboxDeltas"][0]        # (N, A*4, H, W)
+    im_info = ins["ImInfo"][0]           # (N, 3)
+    anchors = ins["Anchors"][0].reshape(-1, 4)
+    variances = ins["Variances"][0].reshape(-1, 4)
+    pre_n = int(attrs.get("pre_nms_topN", 6000))
+    post_n = int(attrs.get("post_nms_topN", 1000))
+    iou_th = float(attrs.get("nms_thresh", 0.5))
+    min_size = float(attrs.get("min_size", 0.1))
+    n, a, h, w = scores.shape
+    total = a * h * w
+    pre_n = min(pre_n, total)
+    post_n = min(post_n, pre_n)
+
+    def per_image(sc, dl, info):
+        sc = sc.transpose(1, 2, 0).reshape(-1)               # (H*W*A,)
+        dl = dl.reshape(a, 4, h, w).transpose(2, 3, 0, 1).reshape(-1, 4)
+        anc = anchors.reshape(h, w, a, 4).reshape(-1, 4)
+        vr = variances.reshape(h, w, a, 4).reshape(-1, 4)
+        aw = anc[:, 2] - anc[:, 0] + 1.0
+        ah_ = anc[:, 3] - anc[:, 1] + 1.0
+        acx = anc[:, 0] + aw * 0.5
+        acy = anc[:, 1] + ah_ * 0.5
+        cx = vr[:, 0] * dl[:, 0] * aw + acx
+        cy = vr[:, 1] * dl[:, 1] * ah_ + acy
+        bw = jnp.exp(jnp.minimum(vr[:, 2] * dl[:, 2], 10.0)) * aw
+        bh = jnp.exp(jnp.minimum(vr[:, 3] * dl[:, 3], 10.0)) * ah_
+        props = jnp.stack([cx - bw / 2, cy - bh / 2,
+                           cx + bw / 2 - 1, cy + bh / 2 - 1], -1)
+        hmax = info[0] / info[2] - 1.0
+        wmax = info[1] / info[2] - 1.0
+        props = jnp.stack([jnp.clip(props[:, 0], 0, wmax),
+                           jnp.clip(props[:, 1], 0, hmax),
+                           jnp.clip(props[:, 2], 0, wmax),
+                           jnp.clip(props[:, 3], 0, hmax)], -1)
+        ms = min_size * info[2]
+        keep = ((props[:, 2] - props[:, 0] + 1 >= ms) &
+                (props[:, 3] - props[:, 1] + 1 >= ms))
+        sc = jnp.where(keep, sc, -jnp.inf)
+        top_s, idx = jax.lax.top_k(sc, pre_n)
+        pb = props[idx]
+        alive = _nms_alive(pb, top_s, iou_th)
+        final = jnp.where(alive, top_s, -jnp.inf)
+        out_s, oidx = jax.lax.top_k(final, post_n)
+        ob = pb[oidx]
+        good = jnp.isfinite(out_s)
+        return (jnp.where(good[:, None], ob, 0.0),
+                jnp.where(good, out_s, 0.0), good.sum().astype(jnp.int32))
+
+    rois, rscores, num = jax.vmap(per_image)(scores, deltas, im_info)
+    return {"RpnRois": rois, "RpnRoiProbs": rscores[..., None],
+            "RpnRoisNum": num}
+
+
+@register_op("distribute_fpn_proposals", nondiff=("FpnRois", "RoisNum"),
+             differentiable=False)
+def _distribute_fpn_proposals(ctx, ins, attrs):
+    """Assign each roi to an FPN level (reference
+    detection/distribute_fpn_proposals_op.h): level = floor(log2(
+    sqrt(area) / refer_scale + 1e-6)) + refer_level, clipped. Static form:
+    per-level outputs keep full length with a validity mask encoded by
+    zeroed rois + per-level RoisNum counts; RestoreIndex maps the
+    level-sorted concat back to input order."""
+    rois = ins["FpnRois"][0]             # (R, 4)
+    min_level = int(attrs["min_level"])
+    max_level = int(attrs["max_level"])
+    refer_level = int(attrs["refer_level"])
+    refer_scale = int(attrs["refer_scale"])
+    r = rois.shape[0]
+    scale = jnp.sqrt(jnp.maximum(
+        (rois[:, 2] - rois[:, 0] + 1) * (rois[:, 3] - rois[:, 1] + 1), 0.0))
+    lvl = jnp.floor(jnp.log2(scale / refer_scale + 1e-6)) + refer_level
+    lvl = jnp.clip(lvl, min_level, max_level).astype(jnp.int32)
+    outs = {}
+    multi = []
+    nums = []
+    for i, level in enumerate(range(min_level, max_level + 1)):
+        mask = lvl == level
+        # stable sort: members first, preserving order
+        order = jnp.argsort(~mask, stable=True)
+        cnt = mask.sum().astype(jnp.int32)
+        sel = jnp.where((jnp.arange(r) < cnt)[:, None], rois[order], 0.0)
+        multi.append(sel)
+        nums.append(cnt)
+    # RestoreIndex (reference distribute_fpn_proposals_op.h:136):
+    # restore[orig] = position in the level-sorted concat, so
+    # gather(concat, restore) recovers the input order.
+    counts = jnp.stack(nums)
+    offsets = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                               jnp.cumsum(counts)[:-1]])
+    lidx = lvl - min_level
+    # rank within level = number of same-level rois before this one
+    same = (lidx[None, :] == lidx[:, None]) & \
+        (jnp.arange(r)[None, :] < jnp.arange(r)[:, None])
+    rank_in_level = same.sum(1).astype(jnp.int32)
+    pos = offsets[lidx] + rank_in_level
+    outs["MultiFpnRois"] = multi
+    outs["RestoreIndex"] = pos[:, None]
+    outs["MultiLevelRoIsNum"] = [c[None] for c in nums]
+    return outs
+
+
+@register_op("collect_fpn_proposals",
+             nondiff=("MultiLevelRois", "MultiLevelScores", "MultiLevelRoisNum"),
+             differentiable=False)
+def _collect_fpn_proposals(ctx, ins, attrs):
+    """Concat per-level proposals and keep global top-N by score (reference
+    detection/collect_fpn_proposals_op.h). Static shapes: output is exactly
+    post_nms_topN rois, zero-padded when fewer are valid."""
+    rois = jnp.concatenate([x.reshape(-1, 4) for x in ins["MultiLevelRois"]], 0)
+    scores = jnp.concatenate([x.reshape(-1) for x in ins["MultiLevelScores"]], 0)
+    if ins.get("MultiLevelRoisNum"):
+        valid = []
+        for roi_t, cnt in zip(ins["MultiLevelRois"],
+                              ins["MultiLevelRoisNum"]):
+            m = roi_t.reshape(-1, 4).shape[0]
+            valid.append(jnp.arange(m) < cnt.reshape(()))
+        vmask = jnp.concatenate(valid)
+        scores = jnp.where(vmask, scores, -jnp.inf)
+    post_n = min(int(attrs.get("post_nms_topN", 100)), scores.shape[0])
+    top_s, idx = jax.lax.top_k(scores, post_n)
+    good = jnp.isfinite(top_s)
+    return {"FpnRois": jnp.where(good[:, None], rois[idx], 0.0),
+            "RoisNum": good.sum().astype(jnp.int32)[None]}
+
+
+@register_op("mine_hard_examples",
+             nondiff=("ClsLoss", "LocLoss", "MatchIndices", "MatchDist"),
+             differentiable=False)
+def _mine_hard_examples(ctx, ins, attrs):
+    """OHEM negative mining (reference detection/mine_hard_examples_op.cc).
+    Static form: returns a (N, P) 0/1 mask of selected negatives (the
+    reference emits LoD index lists) plus UpdatedMatchIndices."""
+    cls_loss = ins["ClsLoss"][0]         # (N, P)
+    match = ins["MatchIndices"][0]       # (N, P)
+    loc_loss = ins["LocLoss"][0] if ins.get("LocLoss") else None
+    neg_pos_ratio = float(attrs.get("neg_pos_ratio", 3.0))
+    neg_dist_threshold = float(attrs.get("neg_dist_threshold", 0.5))
+    mining_type = attrs.get("mining_type", "max_negative")
+    sample_size = int(attrs.get("sample_size", 0))
+    dist = ins["MatchDist"][0] if ins.get("MatchDist") else None
+    loss = cls_loss if loc_loss is None else cls_loss + loc_loss
+    is_neg = match < 0
+    if dist is not None and mining_type == "max_negative":
+        is_neg = is_neg & (dist < neg_dist_threshold)
+    num_pos = (match >= 0).sum(axis=1)
+    if mining_type == "hard_example" and sample_size > 0:
+        limit = jnp.full_like(num_pos, sample_size)
+    else:
+        limit = jnp.ceil(num_pos * neg_pos_ratio).astype(jnp.int32)
+    neg_loss = jnp.where(is_neg, loss, -jnp.inf)
+    order = jnp.argsort(-neg_loss, axis=1)
+    rank = jnp.zeros_like(order).at[
+        jnp.arange(order.shape[0])[:, None], order].set(
+        jnp.broadcast_to(jnp.arange(order.shape[1]), order.shape))
+    sel = is_neg & (rank < limit[:, None])
+    upd = jnp.where(sel, -1, match)
+    return {"NegIndices": sel.astype(jnp.int32), "UpdatedMatchIndices": upd}
+
+
+def _bce_logits(x, label):
+    # SigmoidCrossEntropy of reference yolov3_loss_op.h:34 — numerically
+    # stable BCE-with-logits
+    return jnp.maximum(x, 0.0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+
+
+@register_op("yolov3_loss", nondiff=("GTBox", "GTLabel", "GTScore"))
+def _yolov3_loss(ctx, ins, attrs):
+    """YOLOv3 training loss (reference detection/yolov3_loss_op.h:258).
+
+    Vectorized: per-prediction best-IoU-vs-gt computes the ignore mask; each
+    gt picks its best anchor by shifted wh-IoU and, when that anchor is in
+    anchor_mask, contributes location (BCE xy + L1 wh, scaled by
+    (2 - w*h) * score), class (sigmoid CE vs smoothed one-hot) and
+    objectness targets. Differentiable w.r.t. X only.
+    """
+    x = ins["X"][0]                       # (N, M*(5+C), H, W)
+    gt_box = ins["GTBox"][0]              # (N, B, 4) xywh, normalized
+    gt_label = ins["GTLabel"][0]          # (N, B) int
+    anchors = [int(a) for a in attrs["anchors"]]
+    anchor_mask = [int(a) for a in attrs["anchor_mask"]]
+    class_num = int(attrs["class_num"])
+    ignore_thresh = float(attrs.get("ignore_thresh", 0.7))
+    downsample = int(attrs.get("downsample_ratio", 32))
+    use_label_smooth = bool(attrs.get("use_label_smooth", True))
+    n, _, h, w = x.shape
+    an_num = len(anchors) // 2
+    mask_num = len(anchor_mask)
+    b = gt_box.shape[1]
+    input_size = downsample * h
+    if gt_label.ndim == 3:
+        gt_label = gt_label[..., 0]
+    gt_score = (ins["GTScore"][0] if ins.get("GTScore")
+                else jnp.ones((n, b), x.dtype))
+
+    label_pos, label_neg = 1.0, 0.0
+    if use_label_smooth:
+        delta = min(1.0 / class_num, 1.0 / 40)
+        label_pos, label_neg = 1.0 - delta, delta
+
+    aw_all = jnp.asarray(anchors[0::2], x.dtype)           # (A,)
+    ah_all = jnp.asarray(anchors[1::2], x.dtype)
+    # static map: anchor index -> position in anchor_mask (or -1)
+    an2mask = np.full((an_num,), -1, np.int32)
+    for pos, a in enumerate(anchor_mask):
+        an2mask[a] = pos
+    an2mask = jnp.asarray(an2mask)
+    aw_m = jnp.asarray([anchors[2 * a] for a in anchor_mask], x.dtype)
+    ah_m = jnp.asarray([anchors[2 * a + 1] for a in anchor_mask], x.dtype)
+
+    def per_image(xi, gb, gl, gs):
+        xi = xi.reshape(mask_num, 5 + class_num, h, w)
+        valid = (gb[:, 2] > 1e-6) & (gb[:, 3] > 1e-6)      # (B,)
+
+        # --- predicted boxes and best-IoU ignore mask -------------------
+        gx = jnp.arange(w, dtype=x.dtype)[None, None, :]
+        gy = jnp.arange(h, dtype=x.dtype)[None, :, None]
+        px = (gx + jax.nn.sigmoid(xi[:, 0])) / w           # (M, H, W)
+        py = (gy + jax.nn.sigmoid(xi[:, 1])) / h
+        pw_ = jnp.exp(xi[:, 2]) * aw_m[:, None, None] / input_size
+        ph_ = jnp.exp(xi[:, 3]) * ah_m[:, None, None] / input_size
+
+        def iou_xywh(x1, y1, w1, h1, x2, y2, w2, h2):
+            ow = jnp.minimum(x1 + w1 / 2, x2 + w2 / 2) - \
+                jnp.maximum(x1 - w1 / 2, x2 - w2 / 2)
+            oh = jnp.minimum(y1 + h1 / 2, y2 + h2 / 2) - \
+                jnp.maximum(y1 - h1 / 2, y2 - h2 / 2)
+            inter = jnp.where((ow < 0) | (oh < 0), 0.0, ow * oh)
+            return inter / jnp.maximum(w1 * h1 + w2 * h2 - inter, 1e-10)
+
+        ious = iou_xywh(px[..., None], py[..., None], pw_[..., None],
+                        ph_[..., None], gb[:, 0], gb[:, 1], gb[:, 2],
+                        gb[:, 3])                          # (M, H, W, B)
+        ious = jnp.where(valid, ious, 0.0)
+        best_iou = ious.max(-1)                            # (M, H, W)
+        objness = jnp.where(best_iou > ignore_thresh, -1.0,
+                            0.0).astype(x.dtype)
+
+        # --- per-gt best anchor -----------------------------------------
+        a_iou = iou_xywh(0.0, 0.0, aw_all[None, :] / input_size,
+                         ah_all[None, :] / input_size,
+                         0.0, 0.0, gb[:, 2:3], gb[:, 3:4])  # (B, A)
+        best_n = jnp.argmax(a_iou, axis=1)                 # (B,)
+        midx = an2mask[best_n]                             # (B,)
+        pos = valid & (midx >= 0)
+        gi = jnp.clip((gb[:, 0] * w).astype(jnp.int32), 0, w - 1)
+        gj = jnp.clip((gb[:, 1] * h).astype(jnp.int32), 0, h - 1)
+        msafe = jnp.maximum(midx, 0)
+
+        tx = gb[:, 0] * w - gi
+        ty = gb[:, 1] * h - gj
+        tw = jnp.log(jnp.maximum(gb[:, 2] * input_size /
+                                 aw_all[best_n], 1e-10))
+        th = jnp.log(jnp.maximum(gb[:, 3] * input_size /
+                                 ah_all[best_n], 1e-10))
+        scale = (2.0 - gb[:, 2] * gb[:, 3]) * gs
+        cell = xi[msafe, :, gj, gi]                        # (B, 5+C)
+        loc = (_bce_logits(cell[:, 0], tx) + _bce_logits(cell[:, 1], ty) +
+               jnp.abs(cell[:, 2] - tw) + jnp.abs(cell[:, 3] - th)) * scale
+        onehot = (jnp.arange(class_num)[None, :] == gl[:, None])
+        tgt = jnp.where(onehot, label_pos, label_neg).astype(x.dtype)
+        lbl = (_bce_logits(cell[:, 5:], tgt).sum(-1)) * gs
+        pos_loss = jnp.where(pos, loc + lbl, 0.0).sum()
+
+        # --- objectness: positives overwrite in gt order (last wins, as
+        # the reference's sequential loop does) ---------------------------
+        def set_obj(t, obj):
+            return jnp.where(pos[t],
+                             obj.at[msafe[t], gj[t], gi[t]].set(gs[t]), obj)
+
+        objness = jax.lax.fori_loop(0, 
+                                    b, set_obj, objness)
+        obj_logit = xi[:, 4]
+        obj_loss = jnp.where(
+            objness > 1e-5, _bce_logits(obj_logit, 1.0) * objness,
+            jnp.where(objness > -0.5, _bce_logits(obj_logit, 0.0), 0.0)).sum()
+        match = jnp.where(valid, midx, -1)
+        return pos_loss + obj_loss, objness, match
+
+    loss, objness, match = jax.vmap(per_image)(x, gt_box, gt_label, gt_score)
+    return {"Loss": loss, "ObjectnessMask": objness, "GTMatchMask": match}
+
+
+@register_op("ssd_loss", nondiff=("GtBox", "GtLabel", "PriorBox",
+                                  "PriorBoxVar"))
+def _ssd_loss(ctx, ins, attrs):
+    """SSD multibox loss (reference python/paddle/fluid/layers/detection.py
+    ssd_loss): bipartite match on IoU, encode matched gts against priors,
+    smooth-L1 location loss on positives, softmax CE on positives plus
+    hard-mined negatives, normalized by the match count. Dense design: gt
+    padded to (N, G, 4) with zero boxes marking padding."""
+    loc = ins["Location"][0]             # (N, P, 4)
+    conf = ins["Confidence"][0]          # (N, P, C)
+    gt_box = ins["GtBox"][0]             # (N, G, 4) xyxy normalized
+    gt_label = ins["GtLabel"][0]         # (N, G) int
+    prior = ins["PriorBox"][0].reshape(-1, 4)     # (P, 4)
+    pvar = (ins["PriorBoxVar"][0].reshape(-1, 4) if ins.get("PriorBoxVar")
+            else jnp.full((prior.shape[0], 4), 1.0, loc.dtype))
+    background = int(attrs.get("background_label", 0))
+    overlap_threshold = float(attrs.get("overlap_threshold", 0.5))
+    neg_overlap = float(attrs.get("neg_overlap", 0.5))
+    neg_pos_ratio = float(attrs.get("neg_pos_ratio", 3.0))
+    loc_weight = float(attrs.get("loc_loss_weight", 1.0))
+    conf_weight = float(attrs.get("conf_loss_weight", 1.0))
+    match_type = attrs.get("match_type", "per_prediction")
+    mining_type = attrs.get("mining_type", "max_negative")
+    normalize = bool(attrs.get("normalize", True))
+    sample_size = int(attrs.get("sample_size", 0) or 0)
+    if mining_type not in ("max_negative", "hard_example"):
+        raise ValueError("ssd_loss: unsupported mining_type %r" % mining_type)
+    if gt_label.ndim == 3:
+        gt_label = gt_label[..., 0]
+    n, p, c = conf.shape
+
+    pw = prior[:, 2] - prior[:, 0]
+    ph = prior[:, 3] - prior[:, 1]
+    pcx = prior[:, 0] + pw * 0.5
+    pcy = prior[:, 1] + ph * 0.5
+
+    def per_image(li, ci, gb, gl):
+        valid = ((gb[:, 2] - gb[:, 0]) > 1e-6) & ((gb[:, 3] - gb[:, 1]) > 1e-6)
+        area_g = jnp.maximum(gb[:, 2] - gb[:, 0], 0) * \
+            jnp.maximum(gb[:, 3] - gb[:, 1], 0)
+        area_p = jnp.maximum(pw, 0) * jnp.maximum(ph, 0)
+        lt = jnp.maximum(gb[:, None, :2], prior[None, :, :2])
+        rb = jnp.minimum(gb[:, None, 2:], prior[None, :, 2:])
+        wh = jnp.maximum(rb - lt, 0)
+        inter = wh[..., 0] * wh[..., 1]
+        iou = inter / jnp.maximum(area_g[:, None] + area_p[None, :] - inter,
+                                  1e-10)
+        iou = jnp.where(valid[:, None], iou, 0.0)
+        match, mdist = _bipartite_match_single(iou, match_type,
+                                               overlap_threshold)
+        matched = match >= 0
+        msafe = jnp.maximum(match, 0)
+
+        # encode matched gt against priors (box_coder encode_center_size)
+        g = gb[msafe]
+        gw = g[:, 2] - g[:, 0]
+        gh = g[:, 3] - g[:, 1]
+        gcx = g[:, 0] + gw * 0.5
+        gcy = g[:, 1] + gh * 0.5
+        enc = jnp.stack([
+            (gcx - pcx) / pw / pvar[:, 0],
+            (gcy - pcy) / ph / pvar[:, 1],
+            jnp.log(jnp.maximum(gw / pw, 1e-10)) / pvar[:, 2],
+            jnp.log(jnp.maximum(gh / ph, 1e-10)) / pvar[:, 3]], -1)
+        diff = li - enc
+        ad = jnp.abs(diff)
+        sl1 = jnp.where(ad < 1.0, 0.5 * diff * diff, ad - 0.5).sum(-1)
+        loc_loss = jnp.where(matched, sl1, 0.0)
+
+        tlabel = jnp.where(matched, gl[msafe], background)
+        logz = jax.nn.logsumexp(ci, axis=-1)
+        ce = logz - jnp.take_along_axis(ci, tlabel[:, None], -1)[:, 0]
+
+        # hard negative mining on conf loss
+        num_pos = matched.sum()
+        if mining_type == "hard_example" and sample_size > 0:
+            limit = jnp.asarray(sample_size, jnp.int32)
+        else:
+            limit = jnp.ceil(num_pos * neg_pos_ratio).astype(jnp.int32)
+        is_neg = (~matched) & (mdist < neg_overlap)
+        neg_score = jnp.where(is_neg, ce, -jnp.inf)
+        order = jnp.argsort(-neg_score)
+        rank = jnp.zeros((p,), jnp.int32).at[order].set(
+            jnp.arange(p, dtype=jnp.int32))
+        sel_neg = is_neg & (rank < limit)
+        conf_loss = jnp.where(matched | sel_neg, ce, 0.0)
+
+        denom = (jnp.maximum(num_pos, 1).astype(li.dtype)
+                 if normalize else jnp.asarray(1.0, li.dtype))
+        return (conf_weight * conf_loss + loc_weight * loc_loss) / denom
+
+    loss = jax.vmap(per_image)(loc, conf, gt_box, gt_label)
+    return {"Loss": loss}
