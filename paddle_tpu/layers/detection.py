@@ -259,7 +259,7 @@ def detection_output(loc, scores, prior_box, prior_box_var,
                         code_type="decode_center_size")
     scores_t = _nn.transpose(scores, perm=[0, 2, 1])     # (N, C, P)
     return multiclass_nms(decoded, scores_t, score_threshold, nms_top_k,
-                          keep_top_k, nms_threshold,
+                          keep_top_k, nms_threshold, nms_eta=nms_eta,
                           background_label=background_label)
 
 
@@ -301,7 +301,8 @@ def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
         box, var = prior_box(inp, image, min_size,
                              [max_size] if max_size else None, ar, variance,
                              flip, clip, st, offset)
-        num_priors = 1
+        # same flip/dedup expansion as the prior_box kernel so the conv
+        # channel count matches the kernel's prior count
         ars = [1.0]
         for a in ar:
             if not any(abs(a - x) < 1e-6 for x in ars):
@@ -380,8 +381,11 @@ def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
     restore = helper.create_variable_for_type_inference("int32")
     lvl_nums = [helper.create_variable_for_type_inference("int32")
                 for _ in range(num_lvl)]
+    inputs = {"FpnRois": [fpn_rois.name]}
+    if rois_num is not None:
+        inputs["RoisNum"] = [rois_num.name]
     helper.append_op(
-        "distribute_fpn_proposals", inputs={"FpnRois": [fpn_rois.name]},
+        "distribute_fpn_proposals", inputs=inputs,
         outputs={"MultiFpnRois": [v.name for v in multi_rois],
                  "RestoreIndex": [restore.name],
                  "MultiLevelRoIsNum": [v.name for v in lvl_nums]},
@@ -407,6 +411,8 @@ def collect_fpn_proposals(multi_rois, multi_scores, min_level, max_level,
                      outputs={"FpnRois": [out.name], "RoisNum": [nums.name]},
                      attrs={"post_nms_topN": post_nms_top_n})
     out.stop_gradient = nums.stop_gradient = True
+    if rois_num_per_level is not None:
+        return out, nums
     return out
 
 

@@ -609,7 +609,12 @@ def scatter_nd_add(ref, index, updates, name=None):
 
 def pad(x, paddings, pad_value=0.0, name=None):
     helper = LayerHelper("pad", name=name)
-    out = helper.create_variable_for_type_inference(x.dtype)
+    shape = None
+    if x.shape is not None and len(paddings) >= 2 * len(x.shape):
+        shape = tuple(
+            d if d == -1 else d + paddings[2 * i] + paddings[2 * i + 1]
+            for i, d in enumerate(x.shape))
+    out = helper.create_variable_for_type_inference(x.dtype, shape)
     helper.append_op("pad", inputs={"X": [x.name]},
                      outputs={"Out": [out.name]},
                      attrs={"paddings": list(paddings),
@@ -740,7 +745,10 @@ def label_smooth(label, prior_dist=None, epsilon=0.1, dtype="float32",
 
 def sequence_mask(x, maxlen=None, dtype="int64", name=None):
     helper = LayerHelper("sequence_mask", name=name)
-    out = helper.create_variable_for_type_inference(dtype)
+    shape = None
+    if maxlen is not None and maxlen > 0 and x.shape is not None:
+        shape = tuple(x.shape) + (maxlen,)
+    out = helper.create_variable_for_type_inference(dtype, shape)
     helper.append_op("sequence_mask", inputs={"X": [x.name]},
                      outputs={"Y": [out.name]},
                      attrs={"maxlen": maxlen if maxlen is not None else -1,

@@ -720,15 +720,23 @@ def _distribute_fpn_proposals(ctx, ins, attrs):
     refer_level = int(attrs["refer_level"])
     refer_scale = int(attrs["refer_scale"])
     r = rois.shape[0]
+    num_lvl = max_level - min_level + 1
+    if ins.get("RoisNum"):
+        valid = jnp.arange(r) < ins["RoisNum"][0].reshape(-1)[0]
+    else:
+        valid = jnp.ones((r,), bool)
     scale = jnp.sqrt(jnp.maximum(
         (rois[:, 2] - rois[:, 0] + 1) * (rois[:, 3] - rois[:, 1] + 1), 0.0))
     lvl = jnp.floor(jnp.log2(scale / refer_scale + 1e-6)) + refer_level
     lvl = jnp.clip(lvl, min_level, max_level).astype(jnp.int32)
+    # padding rois get the past-the-end sentinel level so they sort after
+    # every real level and never count toward MultiLevelRoIsNum
+    lidx = jnp.where(valid, lvl - min_level, num_lvl)
     outs = {}
     multi = []
     nums = []
-    for i, level in enumerate(range(min_level, max_level + 1)):
-        mask = lvl == level
+    for i in range(num_lvl):
+        mask = lidx == i
         # stable sort: members first, preserving order
         order = jnp.argsort(~mask, stable=True)
         cnt = mask.sum().astype(jnp.int32)
@@ -737,11 +745,11 @@ def _distribute_fpn_proposals(ctx, ins, attrs):
         nums.append(cnt)
     # RestoreIndex (reference distribute_fpn_proposals_op.h:136):
     # restore[orig] = position in the level-sorted concat, so
-    # gather(concat, restore) recovers the input order.
+    # gather(concat, restore) recovers the input order (padding rois land
+    # after all valid ones).
     counts = jnp.stack(nums)
     offsets = jnp.concatenate([jnp.zeros((1,), jnp.int32),
-                               jnp.cumsum(counts)[:-1]])
-    lidx = lvl - min_level
+                               jnp.cumsum(counts)]).astype(jnp.int32)
     # rank within level = number of same-level rois before this one
     same = (lidx[None, :] == lidx[:, None]) & \
         (jnp.arange(r)[None, :] < jnp.arange(r)[:, None])
@@ -1020,10 +1028,11 @@ def _ssd_loss(ctx, ins, attrs):
             jnp.arange(p, dtype=jnp.int32))
         sel_neg = is_neg & (rank < limit)
         conf_loss = jnp.where(matched | sel_neg, ce, 0.0)
+        return (conf_weight * conf_loss + loc_weight * loc_loss), num_pos
 
-        denom = (jnp.maximum(num_pos, 1).astype(li.dtype)
-                 if normalize else jnp.asarray(1.0, li.dtype))
-        return (conf_weight * conf_loss + loc_weight * loc_loss) / denom
-
-    loss = jax.vmap(per_image)(loc, conf, gt_box, gt_label)
+    loss, num_pos = jax.vmap(per_image)(loc, conf, gt_box, gt_label)
+    if normalize:
+        # reference normalizes by the batch-global matched count (ssd_loss
+        # divides by reduce_sum of the loc target weights)
+        loss = loss / jnp.maximum(num_pos.sum(), 1).astype(loss.dtype)
     return {"Loss": loss}

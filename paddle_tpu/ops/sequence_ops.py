@@ -1,0 +1,131 @@
+"""Sequence op kernels — dense (batch, time, ...) + length-vector design.
+
+Reference parity: paddle/fluid/operators/sequence_ops/* which operate on
+ragged LoD tensors. Ragged rows are hostile to XLA's static shapes, so every
+op here takes dense (N, T, ...) tensors plus an explicit (N,) length vector
+and reproduces the per-sequence semantics with masks/gathers — identical
+results on the valid prefix, zeros (or pad_value) beyond it.
+"""
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+
+def _lengths(ins, n, t):
+    if ins.get("Length"):
+        return ins["Length"][0].reshape(-1).astype(jnp.int32)
+    return jnp.full((n,), t, jnp.int32)
+
+
+@register_op("sequence_reverse", nondiff=("Length",))
+def _sequence_reverse(ctx, ins, attrs):
+    """Reverse each sequence's valid prefix, keep padding in place
+    (reference sequence_ops/sequence_reverse_op.h)."""
+    x = ins["X"][0]                       # (N, T, ...)
+    n, t = x.shape[0], x.shape[1]
+    lens = _lengths(ins, n, t)
+    pos = jnp.arange(t)[None, :]
+    idx = jnp.where(pos < lens[:, None], lens[:, None] - 1 - pos, pos)
+    return {"Y": jnp.take_along_axis(
+        x, idx.reshape(idx.shape + (1,) * (x.ndim - 2)), axis=1)}
+
+
+@register_op("sequence_erase", nondiff=("X", "Length"), differentiable=False)
+def _sequence_erase(ctx, ins, attrs):
+    """Remove listed tokens and left-compact each row (reference
+    sequence_ops/sequence_erase_op.h). Output keeps the (N, T) shape with
+    pad_value in vacated slots; OutLength gives new lengths."""
+    x = ins["X"][0]                       # (N, T) int tokens
+    n, t = x.shape
+    lens = _lengths(ins, n, t)
+    tokens = jnp.asarray(list(attrs.get("tokens", [])), x.dtype)
+    pad_value = attrs.get("pad_value", 0)
+    valid = jnp.arange(t)[None, :] < lens[:, None]
+    keep = valid
+    if tokens.size:
+        keep = valid & ~jnp.isin(x, tokens)
+    # stable partition: kept tokens first, original order preserved
+    order = jnp.argsort(~keep, axis=1, stable=True)
+    gathered = jnp.take_along_axis(x, order, axis=1)
+    new_len = keep.sum(axis=1).astype(jnp.int32)
+    out = jnp.where(jnp.arange(t)[None, :] < new_len[:, None], gathered,
+                    jnp.asarray(pad_value, x.dtype))
+    return {"Out": out, "OutLength": new_len}
+
+
+@register_op("sequence_enumerate", nondiff=("X", "Length"),
+             differentiable=False)
+def _sequence_enumerate(ctx, ins, attrs):
+    """Sliding windows of win_size per position (reference
+    sequence_ops/sequence_enumerate_op.h): out[i,t,k] = x[i,t+k] while
+    t+k is inside the sequence, else pad_value."""
+    x = ins["X"][0]                       # (N, T) int
+    n, t = x.shape
+    lens = _lengths(ins, n, t)
+    win = int(attrs["win_size"])
+    pad_value = attrs.get("pad_value", 0)
+    pos = jnp.arange(t)[None, :, None] + jnp.arange(win)[None, None, :]
+    src = jnp.take_along_axis(x[:, :, None],
+                              jnp.minimum(pos, t - 1), axis=1)
+    ok = pos < lens[:, None, None]
+    return {"Out": jnp.where(ok, src, jnp.asarray(pad_value, x.dtype))}
+
+
+@register_op("sequence_slice", nondiff=("Offset", "SliceLength", "Length"))
+def _sequence_slice(ctx, ins, attrs):
+    """Per-row (offset, length) subsequence (reference
+    sequence_ops/sequence_slice_op.h), left-aligned with zero padding."""
+    x = ins["X"][0]                       # (N, T, ...)
+    n, t = x.shape[0], x.shape[1]
+    offset = ins["Offset"][0].reshape(-1).astype(jnp.int32)
+    slice_len = ins["SliceLength"][0].reshape(-1).astype(jnp.int32)
+    pos = jnp.arange(t)[None, :]
+    idx = jnp.clip(pos + offset[:, None], 0, t - 1)
+    out = jnp.take_along_axis(
+        x, idx.reshape(idx.shape + (1,) * (x.ndim - 2)), axis=1)
+    mask = pos < slice_len[:, None]
+    return {"Out": jnp.where(mask.reshape(mask.shape + (1,) * (x.ndim - 2)),
+                             out, 0),
+            "OutLength": slice_len}
+
+
+@register_op("sequence_expand_as", nondiff=("Y", "Length"))
+def _sequence_expand_as(ctx, ins, attrs):
+    """Broadcast each row of x over y's time steps (reference
+    sequence_ops/sequence_expand_as_op.h): row i repeats len_i times."""
+    x = ins["X"][0]                       # (N, D) or (N, 1, D)
+    y = ins["Y"][0]                       # (N, T, ...) provides T
+    t = y.shape[1]
+    if x.ndim == 2:
+        x = x[:, None, :]
+    n = x.shape[0]
+    lens = _lengths(ins, n, t)
+    out = jnp.broadcast_to(x, (n, t) + x.shape[2:])
+    mask = jnp.arange(t)[None, :] < lens[:, None]
+    return {"Out": jnp.where(mask.reshape(mask.shape + (1,) *
+                                          (out.ndim - 2)), out, 0)}
+
+
+@register_op("sequence_pad_dense", nondiff=("Length",))
+def _sequence_pad_dense(ctx, ins, attrs):
+    """Dense form of sequence_pad (reference sequence_ops/sequence_pad_op.h):
+    fill beyond each row's length with pad_value; optionally re-cap T at
+    padded_length."""
+    x = ins["X"][0]
+    n, t = x.shape[0], x.shape[1]
+    lens = _lengths(ins, n, t)
+    pad_value = attrs.get("pad_value", 0.0)
+    maxlen = int(attrs.get("padded_length", -1))
+    if maxlen > 0 and maxlen != t:
+        if maxlen < t:
+            x = x[:, :maxlen]
+        else:
+            cfg = [(0, 0, 0), (0, maxlen - t, 0)] + \
+                [(0, 0, 0)] * (x.ndim - 2)
+            x = jax.lax.pad(x, jnp.asarray(pad_value, x.dtype), cfg)
+        t = maxlen
+    mask = jnp.arange(t)[None, :] < lens[:, None]
+    out = jnp.where(mask.reshape(mask.shape + (1,) * (x.ndim - 2)), x,
+                    jnp.asarray(pad_value, x.dtype))
+    return {"Out": out, "Length": jnp.minimum(lens, t)}

@@ -449,6 +449,22 @@ def test_distribute_and_collect_fpn_proposals():
     assert int(np.asarray(col["RoisNum"])[0]) == 3
 
 
+def test_distribute_fpn_ignores_padding_rois():
+    """Zero-padded rois past RoisNum must not count toward any level."""
+    rois = np.array([[0, 0, 10, 10], [0, 0, 60, 60],
+                     [0, 0, 0, 0], [0, 0, 0, 0]], np.float32)
+    out = _run("distribute_fpn_proposals",
+               {"FpnRois": [rois], "RoisNum": [np.array([2], np.int32)]},
+               {"min_level": 2, "max_level": 5, "refer_level": 4,
+                "refer_scale": 224})
+    nums = [int(np.asarray(v)[0]) for v in out["MultiLevelRoIsNum"]]
+    assert sum(nums) == 2
+    restore = np.asarray(out["RestoreIndex"])[:, 0]
+    concat = np.concatenate(
+        [np.asarray(v)[:c] for v, c in zip(out["MultiFpnRois"], nums)], 0)
+    np.testing.assert_allclose(concat[restore[:2]], rois[:2])
+
+
 def test_mine_hard_examples():
     cls_loss = np.array([[5, 4, 3, 2, 1, 0.5]], np.float32)
     match = np.array([[0, -1, -1, -1, -1, -1]], np.int32)

@@ -1,0 +1,181 @@
+"""Sequence op long-tail tests (dense + lengths design vs numpy oracles).
+
+Mirrors reference tests/unittests/test_sequence_{reverse,erase,enumerate,
+slice,expand_as,...}_op.py on the padded-dense representation.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu import layers, optimizer
+from paddle_tpu.layers import sequence_lod as seq
+from paddle_tpu.ops.registry import get_op
+
+
+class _Ctx:
+    program = None
+
+    def rng(self):
+        return jax.random.PRNGKey(0)
+
+
+def _run(op, ins, attrs=None):
+    ins = {k: [jnp.asarray(v) for v in vs] for k, vs in ins.items()}
+    return get_op(op).fn(_Ctx(), ins, attrs or {})
+
+
+def test_sequence_reverse_respects_lengths():
+    x = np.arange(24, dtype=np.float32).reshape(2, 4, 3)
+    lens = np.array([3, 2], np.int32)
+    out = np.asarray(_run("sequence_reverse",
+                          {"X": [x], "Length": [lens]}, {})["Y"])
+    # row 0: steps 0..2 reversed, step 3 untouched
+    np.testing.assert_allclose(out[0], x[0][[2, 1, 0, 3]])
+    np.testing.assert_allclose(out[1], x[1][[1, 0, 2, 3]])
+
+
+def test_sequence_reverse_roundtrip_and_grads():
+    x = jnp.asarray(np.random.RandomState(0).rand(2, 5, 3)
+                    .astype(np.float32))
+    lens = jnp.asarray(np.array([4, 5], np.int32))
+
+    def rev(v):
+        return _run("sequence_reverse", {"X": [v], "Length": [lens]}, {})["Y"]
+
+    np.testing.assert_allclose(np.asarray(rev(rev(x))), np.asarray(x),
+                               rtol=1e-6)
+    g = jax.grad(lambda v: (rev(v) ** 2).sum())(x)
+    np.testing.assert_allclose(np.asarray(g), 2 * np.asarray(x), rtol=1e-6)
+
+
+def test_sequence_erase():
+    x = np.array([[2, 2, 6, 1, 3, 9, 6, 1, 0, 0],
+                  [1, 9, 8, 9, 1, 0, 0, 0, 0, 0]], np.int32)
+    lens = np.array([8, 5], np.int32)
+    out = _run("sequence_erase", {"X": [x], "Length": [lens]},
+               {"tokens": [2, 3, 5], "pad_value": -1})
+    o = np.asarray(out["Out"])
+    nl = np.asarray(out["OutLength"])
+    np.testing.assert_array_equal(nl, [5, 5])
+    np.testing.assert_array_equal(o[0, :5], [6, 1, 9, 6, 1])
+    np.testing.assert_array_equal(o[0, 5:], [-1] * 5)
+    np.testing.assert_array_equal(o[1, :5], [1, 9, 8, 9, 1])
+
+
+def test_sequence_enumerate():
+    x = np.array([[1, 2, 3, 4, 0]], np.int64)
+    lens = np.array([4], np.int32)
+    out = np.asarray(_run("sequence_enumerate",
+                          {"X": [x], "Length": [lens]},
+                          {"win_size": 2, "pad_value": 0})["Out"])
+    np.testing.assert_array_equal(
+        out[0], [[1, 2], [2, 3], [3, 4], [4, 0], [0, 0]])
+
+
+def test_sequence_slice():
+    x = np.arange(30, dtype=np.float32).reshape(2, 5, 3)
+    offset = np.array([1, 2], np.int32)
+    length = np.array([3, 2], np.int32)
+    out = _run("sequence_slice",
+               {"X": [x], "Offset": [offset], "SliceLength": [length]}, {})
+    o = np.asarray(out["Out"])
+    np.testing.assert_allclose(o[0, :3], x[0, 1:4])
+    np.testing.assert_allclose(o[0, 3:], 0)
+    np.testing.assert_allclose(o[1, :2], x[1, 2:4])
+    np.testing.assert_array_equal(np.asarray(out["OutLength"]), [3, 2])
+
+
+def test_sequence_expand_as():
+    x = np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)
+    y = np.zeros((2, 3, 5), np.float32)
+    lens = np.array([2, 3], np.int32)
+    out = np.asarray(_run("sequence_expand_as",
+                          {"X": [x], "Y": [y], "Length": [lens]}, {})["Out"])
+    np.testing.assert_allclose(out[0, :2], [[1, 2], [1, 2]])
+    np.testing.assert_allclose(out[0, 2], [0, 0])
+    np.testing.assert_allclose(out[1], [[3, 4]] * 3)
+
+
+def test_sequence_pad_dense():
+    x = np.ones((2, 4, 2), np.float32)
+    lens = np.array([2, 4], np.int32)
+    out = _run("sequence_pad_dense", {"X": [x], "Length": [lens]},
+               {"pad_value": -7.0, "padded_length": 6})
+    o = np.asarray(out["Out"])
+    assert o.shape == (2, 6, 2)
+    np.testing.assert_allclose(o[0, :2], 1.0)
+    np.testing.assert_allclose(o[0, 2:], -7.0)
+    np.testing.assert_allclose(o[1, :4], 1.0)
+    np.testing.assert_allclose(o[1, 4:], -7.0)
+
+
+# ----------------------------------------------------------- layer level
+
+def test_sequence_last_step_with_lengths():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", (4, 3), "float32")
+        lens = layers.data("len", (1,), "int32")
+        lens1 = layers.reshape(lens, shape=[-1])
+        last = seq.sequence_last_step(x, lens1)
+    exe = pt.Executor()
+    exe.run(startup)
+    xv = np.arange(24, dtype=np.float32).reshape(2, 4, 3)
+    out = exe.run(main, feed={"x": xv,
+                              "len": np.array([[2], [4]], np.int32)},
+                  fetch_list=[last])[0]
+    np.testing.assert_allclose(out[0], xv[0, 1])
+    np.testing.assert_allclose(out[1], xv[1, 3])
+
+
+def test_sequence_conv_trains_and_masks():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", (6, 4), "float32")
+        lens = layers.data("len", (1,), "int32")
+        lens1 = layers.reshape(lens, shape=[-1])
+        conv = seq.sequence_conv(x, num_filters=5, filter_size=3,
+                                 lengths=lens1)
+        loss = layers.reduce_mean(layers.square(conv))
+        optimizer.SGD(0.5).minimize(loss)
+    exe = pt.Executor()
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.rand(2, 6, 4).astype(np.float32),
+            "len": np.array([[4], [6]], np.int32)}
+    c0, l0 = exe.run(main, feed=feed, fetch_list=[conv, loss])
+    assert c0.shape == (2, 6, 5)
+    np.testing.assert_allclose(c0[0, 4:], 0.0, atol=1e-7)  # masked tail
+    for _ in range(10):
+        l1 = float(exe.run(main, feed=feed, fetch_list=[loss])[0])
+    assert l1 < float(l0)
+
+
+def test_sequence_reshape_layer():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", (4, 6), "float32")
+        out = seq.sequence_reshape(x, new_dim=3)
+    exe = pt.Executor()
+    exe.run(startup)
+    xv = np.arange(48, dtype=np.float32).reshape(2, 4, 6)
+    o = exe.run(main, feed={"x": xv}, fetch_list=[out])[0]
+    np.testing.assert_allclose(o, xv.reshape(2, 8, 3))
+
+
+def test_sequence_erase_layer_roundtrip():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", (6,), "int64")
+        lens = layers.data("len", (1,), "int32")
+        lens1 = layers.reshape(lens, shape=[-1])
+        out, new_len = seq.sequence_erase(x, tokens=[0], lengths=lens1,
+                                          pad_value=0)
+    exe = pt.Executor()
+    exe.run(startup)
+    o, nl = exe.run(main, feed={
+        "x": np.array([[5, 0, 4, 0, 3, 2]], np.int64),
+        "len": np.array([[6]], np.int32)}, fetch_list=[out, new_len])
+    np.testing.assert_array_equal(o[0], [5, 4, 3, 2, 0, 0])
+    np.testing.assert_array_equal(nl, [4])
