@@ -6,8 +6,8 @@ PipelineOptimizer (+ contrib/extend_optimizer). TPU-native notes:
   steps via an on-device where-select on a step counter (no host branch —
   everything stays inside the single jitted step).
 - Pipeline: on TPU, pipeline parallelism is expressed as a mesh "pp" axis
-  with stage-sharded weights; this wrapper annotates stage shardings. A
-  microbatched 1F1B schedule via lax.scan is tracked in SURVEY §7.
+  with stage-sharded weights; this wrapper annotates stage shardings. The
+  microbatched GPipe / 1F1B schedules live in distributed/pipeline.py.
 """
 from ..framework.program import default_main_program
 from ..framework import unique_name

@@ -11,4 +11,4 @@ from .mesh import (init_mesh, get_mesh, mesh_axes, DistributedStrategy,
 from . import fleet
 from .ring_attention import ring_attention
 from .pipeline import (pipeline_forward, pipeline_loss_and_grads,
-                       stack_stage_params)
+                       pipeline_1f1b_step, stack_stage_params)

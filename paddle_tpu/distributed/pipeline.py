@@ -114,3 +114,110 @@ def stack_stage_params(per_stage_params):
     """[stage0_tree, stage1_tree, ...] -> one tree with leading stage dim
     (requires homogeneous stages, the GPipe-on-SPMD contract)."""
     return jax.tree.map(lambda *xs: jnp.stack(xs), *per_stage_params)
+
+
+def pipeline_1f1b_step(stage_fn, loss_fn, params_stacked, x_micro, y_micro,
+                       mesh, axis_name="pp"):
+    """1F1B pipeline schedule (reference PipelineOptimizer's successor
+    schedule; fluid's section_worker runs plain GPipe).
+
+    Each scan tick performs ONE forward micro-step and ONE backward
+    micro-step per stage, so at most ~2*n_stage microbatch activations are
+    stashed per stage — GPipe-via-autodiff (pipeline_loss_and_grads) stashes
+    all n_micro. Backward uses per-tick jax.vjp on the stashed stage INPUT
+    (rematerialization: one extra forward per micro-step, the standard TPU
+    trade of FLOPs for HBM).
+
+    Schedule (stage s of n, tick k):
+      forward  of microbatch  mf = k - s
+      backward of microbatch  mb = k - (n-1) - (n-1-s)
+    The last stage backpropagates a microbatch in the same tick its forward
+    completes; grads ride the reverse ring one stage per tick, exactly one
+    tick behind the stage above — the classic 1F1B steady state.
+
+    loss_fn(h_out, y_one_micro) -> scalar per-microbatch loss; the returned
+    loss/grads correspond to  mean_m loss_fn(chain(x_m), y_m).
+
+    Returns (loss, grads_stacked) with grads sharded like params_stacked.
+    """
+    n_stage = mesh.shape[axis_name]
+    n_micro = x_micro.shape[0]
+    ticks = n_micro + 2 * (n_stage - 1)
+    slots = 2 * n_stage
+    perm_fwd = [(i, (i + 1) % n_stage) for i in range(n_stage)]
+    perm_bwd = [(i, (i - 1) % n_stage) for i in range(n_stage)]
+
+    def local_fn(params_local, x_local, y_local):
+        stage = lax.axis_index(axis_name)
+        params_me = jax.tree.map(lambda p: p[0], params_local)
+        h_shape = x_local.shape[1:]
+        dtype = x_local.dtype
+        zero_h = jnp.zeros(h_shape, dtype)
+
+        def fwd_of(h_in):
+            return stage_fn(params_me, h_in)
+
+        init = dict(
+            fwd_carry=_pvary(zero_h, axis_name),
+            bwd_carry=_pvary(zero_h, axis_name),
+            stash=_pvary(jnp.zeros((slots,) + h_shape, dtype), axis_name),
+            # params_me is pp-sharded, so its zeros are already "varying"
+            grad_acc=jax.tree.map(jnp.zeros_like, params_me),
+            loss_acc=_pvary(jnp.zeros((), jnp.float32), axis_name),
+        )
+
+        def tick(state, k):
+            mf = k - stage
+            fwd_valid = (mf >= 0) & (mf < n_micro)
+            mf_c = jnp.clip(mf, 0, n_micro - 1)
+            mb = k - (n_stage - 1) - (n_stage - 1 - stage)
+            bwd_valid = (mb >= 0) & (mb < n_micro)
+            mb_c = jnp.clip(mb, 0, n_micro - 1)
+
+            # ---- forward micro-step ------------------------------------
+            inject = lax.dynamic_index_in_dim(x_local, mf_c, 0,
+                                              keepdims=False)
+            h_in = jnp.where(stage == 0, inject, state["fwd_carry"])
+            h_out = fwd_of(h_in)
+            stash = jnp.where(
+                fwd_valid,
+                lax.dynamic_update_index_in_dim(
+                    state["stash"], h_in, mf_c % slots, 0),
+                state["stash"])
+
+            # last stage: per-micro loss + gradient seed, both this tick
+            y_m = lax.dynamic_index_in_dim(y_local, mf_c, 0, keepdims=False)
+            loss_m, loss_vjp = jax.vjp(lambda h: loss_fn(h, y_m), h_out)
+            is_last = stage == n_stage - 1
+            loss_acc = state["loss_acc"] + jnp.where(
+                fwd_valid & is_last, loss_m.astype(jnp.float32), 0.0)
+            (g_seed,) = loss_vjp(jnp.ones_like(loss_m))
+
+            # ---- backward micro-step (rematerialized vjp) --------------
+            h_in_b = lax.dynamic_index_in_dim(stash, mb_c % slots, 0,
+                                              keepdims=False)
+            _, stage_vjp = jax.vjp(stage_fn, params_me, h_in_b)
+            g_out = jnp.where(is_last, g_seed, state["bwd_carry"])
+            dparams, dh_in = stage_vjp(g_out.astype(dtype))
+            grad_acc = jax.tree.map(
+                lambda a, g: a + jnp.where(bwd_valid, g, 0.0),
+                state["grad_acc"], dparams)
+
+            return dict(
+                fwd_carry=lax.ppermute(h_out, axis_name, perm_fwd),
+                bwd_carry=lax.ppermute(
+                    jnp.where(bwd_valid, dh_in, jnp.zeros_like(dh_in)),
+                    axis_name, perm_bwd),
+                stash=stash, grad_acc=grad_acc, loss_acc=loss_acc), None
+
+        state, _ = lax.scan(tick, init, jnp.arange(ticks))
+        loss = lax.psum(state["loss_acc"], axis_name) / n_micro
+        grads = jax.tree.map(lambda g: (g / n_micro)[None], state["grad_acc"])
+        return loss, grads
+
+    fn = shard_map(
+        local_fn, mesh=mesh,
+        in_specs=(jax.tree.map(lambda _: P(axis_name), params_stacked),
+                  P(), P()),
+        out_specs=(P(), jax.tree.map(lambda _: P(axis_name), params_stacked)))
+    return fn(params_stacked, x_micro, y_micro)

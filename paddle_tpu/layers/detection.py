@@ -112,8 +112,10 @@ def bipartite_match(dist_matrix, match_type=None, dist_threshold=None,
         "bipartite_match", inputs={"DistMat": [dist_matrix.name]},
         outputs={"ColToRowMatchIndices": [match_indices.name],
                  "ColToRowMatchDist": [match_distance.name]},
-        attrs={"match_type": match_type or "bipartite",
-               "dist_threshold": dist_threshold or 0.5})
+        attrs={"match_type": "bipartite" if match_type is None
+               else match_type,
+               "dist_threshold": 0.5 if dist_threshold is None
+               else dist_threshold})
     match_indices.stop_gradient = match_distance.stop_gradient = True
     return match_indices, match_distance
 
@@ -129,7 +131,8 @@ def target_assign(input, matched_indices, negative_indices=None,
     helper.append_op("target_assign", inputs=inputs,
                      outputs={"Out": [out.name],
                               "OutWeight": [out_weight.name]},
-                     attrs={"mismatch_value": mismatch_value or 0})
+                     attrs={"mismatch_value": 0 if mismatch_value is None
+                            else mismatch_value})
     out.stop_gradient = out_weight.stop_gradient = True
     return out, out_weight
 

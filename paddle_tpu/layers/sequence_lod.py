@@ -233,6 +233,9 @@ def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
     im2col the +/- context window then one matmul — MXU-friendly.
     padding_start defaults to -(filter_size-1)/2 (centered window)."""
     from .nn import matmul
+    if filter_stride != 1:
+        raise ValueError("sequence_conv supports filter_stride=1 only "
+                         "(as the reference op enforces)")
     helper = LayerHelper("sequence_conv", input=input,
                          param_attr=param_attr, bias_attr=bias_attr,
                          act=act, name=name)

@@ -197,24 +197,12 @@ def _static_nms(ctx, ins, attrs):
     iou_th = attrs.get("nms_threshold", 0.45)
     keep = attrs.get("keep_top_k", 100)
     keep = min(keep, boxes.shape[0])
-    order = jnp.argsort(-scores)
-    boxes_s = boxes[order][:keep * 4 if keep * 4 < boxes.shape[0]
-                           else boxes.shape[0]]
-    scores_s = scores[order][:boxes_s.shape[0]]
-    m = boxes_s.shape[0]
-    area = jnp.maximum(boxes_s[:, 2] - boxes_s[:, 0], 0) * \
-        jnp.maximum(boxes_s[:, 3] - boxes_s[:, 1], 0)
-    lt = jnp.maximum(boxes_s[:, None, :2], boxes_s[None, :, :2])
-    rb = jnp.minimum(boxes_s[:, None, 2:], boxes_s[None, :, 2:])
-    wh = jnp.maximum(rb - lt, 0)
-    inter = wh[..., 0] * wh[..., 1]
-    iou = inter / jnp.maximum(area[:, None] + area[None, :] - inter, 1e-10)
-
-    def body(i, alive):
-        sup = (iou[i] > iou_th) & (jnp.arange(m) > i) & alive[i]
-        return alive & ~sup
-
-    alive = jax.lax.fori_loop(0, m, body, jnp.ones((m,), bool))
+    # cap the O(M^2) IoU matrix at 4*keep candidates before suppression
+    cap = min(keep * 4, boxes.shape[0])
+    order = jnp.argsort(-scores)[:cap]
+    boxes_s = boxes[order]
+    scores_s = scores[order]
+    alive = _nms_alive(boxes_s, scores_s, iou_th)
     final_scores = jnp.where(alive, scores_s, 0.0)
     order2 = jnp.argsort(-final_scores)[:keep]
     return {"Out": boxes_s[order2], "Scores": final_scores[order2],
@@ -575,7 +563,8 @@ def _multiclass_nms(ctx, ins, attrs):
     n, cc, m = scores.shape
     m_eff = min(m, nms_top_k) if nms_top_k > 0 else m
     if keep_top_k <= 0:
-        keep_top_k = m
+        # reference sentinel: no cap — keep every surviving candidate
+        keep_top_k = cc * m_eff
     keep_top_k = min(keep_top_k, cc * m_eff)
 
     def per_class(boxes, sc):
@@ -638,7 +627,10 @@ def _box_decoder_and_assign(ctx, ins, attrs):
     bh = jnp.exp(dh) * ph[:, None]
     decoded = jnp.stack([cx - bw / 2, cy - bh / 2,
                          cx + bw / 2 - 1, cy + bh / 2 - 1], -1)  # (M, C, 4)
-    best = jnp.argmax(score, axis=1)
+    # reference box_decoder_and_assign_op.h scans classes FROM 1 — the
+    # background column never wins the assignment
+    best = jnp.argmax(score[:, 1:], axis=1) + 1 if c > 1 else \
+        jnp.zeros((m,), jnp.int32)
     assigned = jnp.take_along_axis(
         decoded, best[:, None, None].repeat(4, -1), axis=1)[:, 0]
     return {"DecodeBox": decoded.reshape(m, c * 4), "OutputAssignBox": assigned}

@@ -78,16 +78,21 @@ def _sequence_slice(ctx, ins, attrs):
     sequence_ops/sequence_slice_op.h), left-aligned with zero padding."""
     x = ins["X"][0]                       # (N, T, ...)
     n, t = x.shape[0], x.shape[1]
-    offset = ins["Offset"][0].reshape(-1).astype(jnp.int32)
+    lens = _lengths(ins, n, t)
+    offset = jnp.maximum(ins["Offset"][0].reshape(-1).astype(jnp.int32), 0)
     slice_len = ins["SliceLength"][0].reshape(-1).astype(jnp.int32)
+    # the reference enforces offset + length <= seq_len; traced values
+    # can't error, so clamp the reported/valid window instead of
+    # fabricating duplicated timesteps
+    eff_len = jnp.clip(slice_len, 0, jnp.maximum(lens - offset, 0))
     pos = jnp.arange(t)[None, :]
     idx = jnp.clip(pos + offset[:, None], 0, t - 1)
     out = jnp.take_along_axis(
         x, idx.reshape(idx.shape + (1,) * (x.ndim - 2)), axis=1)
-    mask = pos < slice_len[:, None]
+    mask = pos < eff_len[:, None]
     return {"Out": jnp.where(mask.reshape(mask.shape + (1,) * (x.ndim - 2)),
                              out, 0),
-            "OutLength": slice_len}
+            "OutLength": eff_len}
 
 
 @register_op("sequence_expand_as", nondiff=("Y", "Length"))
