@@ -5,10 +5,12 @@ VMEM with online-softmax accumulation — the (T,T) score matrix never touches
 HBM, so attention becomes MXU-bound instead of HBM-bound for long sequences.
 
 Forward: Pallas kernel, grid (B*H, Tq/BQ, Tk/BK), f32 accumulators in VMEM
-scratch persisting across the (innermost, sequential) k-block dimension.
-Backward: custom_vjp; this round it recomputes probabilities in plain XLA
-(O(T^2) only inside the fused backward, still exact); a Pallas backward
-kernel is the tracked next perf step (SURVEY §7).
+scratch persisting across the (innermost, sequential) k-block dimension;
+emits a logsumexp residual alongside the output.
+Backward: Pallas dK/dV and dQ kernels that recompute p = exp(s - lse)
+per tile from the saved (out, lse) residuals — flash-attention-2 style, no
+(T,T) matrix in HBM in either direction. The additive-mask path keeps the
+exact XLA vjp (it must also produce the mask cotangent for learned biases).
 
 Layout contract: q, k, v are (B, H, T, D); additive mask broadcastable
 (B, 1, 1, Tk) or (B, 1, Tq, Tk). On CPU (tests) the kernel runs in
@@ -31,8 +33,44 @@ except Exception:  # pragma: no cover
 _NEG_INF = -1e30
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, acc_ref, m_ref, l_ref,
-                *, scale, causal, block_q, block_k, mask_mode):
+def _causal_keep(qi, kj, causal_offset, block_q, block_k):
+    """Bool (BQ, BK) tile of the bottom-right-aligned causal mask
+    (query i sees keys j <= i + causal_offset) — shared by all kernels."""
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    k_pos = kj * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    return q_pos + causal_offset >= k_pos
+
+
+def _bwd_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qi, kj, *,
+              scale, causal, causal_offset, block_q, block_k):
+    """Recompute the probability tile p = exp(s - lse) and the logit
+    cotangent ds = p * (dO V^T - delta) from the forward residuals —
+    the shared core of both backward kernels."""
+    q = q_ref[0].astype(jnp.float32)            # (BQ, D)
+    k = k_ref[0].astype(jnp.float32)            # (BK, D)
+    v = v_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)          # (BQ, D)
+    lse = lse_ref[0].astype(jnp.float32)        # (BQ,)
+    delta = delta_ref[0].astype(jnp.float32)    # (BQ,)
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale  # (BQ, BK)
+    p = jnp.exp(s - lse[:, None])
+    if causal:
+        p = jnp.where(_causal_keep(qi, kj, causal_offset, block_q,
+                                   block_k), p, 0.0)
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)     # (BQ, BK)
+    ds = p * (dp - delta[:, None])
+    return q, k, do, p, ds
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, acc_ref,
+                m_ref, l_ref, *, scale, causal, causal_offset, block_q,
+                block_k, mask_mode):
     qi = pl.program_id(1)
     kj = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -54,11 +92,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, acc_ref, m_ref, l_ref,
         elif mask_mode == "k":
             s = s + mask_ref[0, 0, 0][None, :].astype(jnp.float32)
         if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_pos = kj * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+            # bottom-right aligned for Tq != Tk (matches _xla_attention's
+            # tril(..., tk - tq)): query i sees keys j <= i + (tk - tq)
+            s = jnp.where(_causal_keep(qi, kj, causal_offset, block_q,
+                                       block_k), s, _NEG_INF)
 
         m_prev = m_ref[:, :1]                      # (BQ, 1)
         m_blk = jnp.max(s, axis=-1, keepdims=True)
@@ -75,8 +112,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, acc_ref, m_ref, l_ref,
         l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
 
     if causal:
-        # skip k-blocks strictly above the diagonal
-        @pl.when(kj * block_k <= qi * block_q + (block_q - 1))
+        # skip k-blocks strictly above the (offset) diagonal
+        @pl.when(kj * block_k <= qi * block_q + (block_q - 1) +
+                 causal_offset)
         def _():
             body()
     else:
@@ -86,6 +124,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, acc_ref, m_ref, l_ref,
     def _finalize():
         o_ref[0] = (acc_ref[:] /
                     jnp.maximum(l_ref[:, :1], 1e-30)).astype(o_ref.dtype)
+        # logsumexp residual for the Pallas backward: lse = m + log(l)
+        lse_ref[0] = (m_ref[:, 0] +
+                      jnp.log(jnp.maximum(l_ref[:, 0], 1e-30))).astype(
+                          lse_ref.dtype)
 
 
 def _pallas_forward(q, k, v, mask, scale, causal, block_q, block_k,
@@ -132,18 +174,163 @@ def _pallas_forward(q, k, v, mask, scale, causal, block_q, block_k,
         pltpu.VMEM((block_q, 128), jnp.float32),
     ]
 
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k,
-                          mask_mode=mask_mode),
+                          causal_offset=tk - tq, block_q=block_q,
+                          block_k=block_k, mask_mode=mask_mode),
         grid=grid,
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, block_q, d), lambda bb, i, j: (bb, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bb, i, j: (bb, i, 0)),
+            pl.BlockSpec((1, block_q), lambda bb, i, j: (bb, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, tq), jnp.float32),
+        ],
         scratch_shapes=scratch,
         interpret=interpret,
     )(q3, k3, v3, mask_in)
-    return out.reshape(b, h, tq, d)
+    return out.reshape(b, h, tq, d), lse.reshape(b, h, tq)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc,
+                    *, scale, causal, causal_offset, block_q, block_k):
+    """dK/dV for one k-block, accumulating over q-blocks (innermost grid
+    dim). Recomputes p = exp(s - lse) from residuals — no (T,T) in HBM."""
+    kj = pl.program_id(1)
+    qi = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    def body():
+        q, _, do, p, ds = _bwd_p_ds(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qi, kj,
+            scale=scale, causal=causal, causal_offset=causal_offset,
+            block_q=block_q, block_k=block_k)
+        # dv += p^T dO ; dk += scale * ds^T q
+        dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dk_acc[:] = dk_acc[:] + scale * jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        @pl.when(qi * block_q + (block_q - 1) + causal_offset >=
+                 kj * block_k)
+        def _():
+            body()
+    else:
+        body()
+
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, dq_acc, *, scale, causal, causal_offset,
+                   block_q, block_k):
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    def body():
+        _, k, _, _, ds = _bwd_p_ds(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qi, kj,
+            scale=scale, causal=causal, causal_offset=causal_offset,
+            block_q=block_q, block_k=block_k)
+        dq_acc[:] = dq_acc[:] + scale * jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        @pl.when(kj * block_k <= qi * block_q + (block_q - 1) +
+                 causal_offset)
+        def _():
+            body()
+    else:
+        body()
+
+    @pl.when(kj == nk - 1)
+    def _finalize():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _pallas_backward(q, k, v, out, lse, g, scale, causal, block_q, block_k,
+                     interpret):
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    bh = b * h
+    q3 = q.reshape(bh, tq, d)
+    k3 = k.reshape(bh, tk, d)
+    v3 = v.reshape(bh, tk, d)
+    do3 = g.reshape(bh, tq, d)
+    lse3 = lse.reshape(bh, tq)
+    # delta = rowsum(dO * O): cheap elementwise pass in XLA
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1).reshape(bh, tq)
+
+    common = dict(scale=scale, causal=causal, causal_offset=tk - tq,
+                  block_q=block_q, block_k=block_k)
+    dkv_specs = [
+        pl.BlockSpec((1, block_q, d), lambda bb, j, i: (bb, i, 0)),   # q
+        pl.BlockSpec((1, block_k, d), lambda bb, j, i: (bb, j, 0)),   # k
+        pl.BlockSpec((1, block_k, d), lambda bb, j, i: (bb, j, 0)),   # v
+        pl.BlockSpec((1, block_q, d), lambda bb, j, i: (bb, i, 0)),   # do
+        pl.BlockSpec((1, block_q), lambda bb, j, i: (bb, i)),         # lse
+        pl.BlockSpec((1, block_q), lambda bb, j, i: (bb, i)),         # delta
+    ]
+    dk3, dv3 = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, **common),
+        grid=(bh, tk // block_k, tq // block_q),
+        in_specs=dkv_specs,
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda bb, j, i: (bb, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bb, j, i: (bb, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, tk, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, tk, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q3, k3, v3, do3, lse3, delta)
+
+    dq_specs = [
+        pl.BlockSpec((1, block_q, d), lambda bb, i, j: (bb, i, 0)),
+        pl.BlockSpec((1, block_k, d), lambda bb, i, j: (bb, j, 0)),
+        pl.BlockSpec((1, block_k, d), lambda bb, i, j: (bb, j, 0)),
+        pl.BlockSpec((1, block_q, d), lambda bb, i, j: (bb, i, 0)),
+        pl.BlockSpec((1, block_q), lambda bb, i, j: (bb, i)),
+        pl.BlockSpec((1, block_q), lambda bb, i, j: (bb, i)),
+    ]
+    dq3 = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, **common),
+        grid=(bh, tq // block_q, tk // block_k),
+        in_specs=dq_specs,
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bb, i, j: (bb, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(q3, k3, v3, do3, lse3, delta)
+
+    return (dq3.reshape(b, h, tq, d), dk3.reshape(b, h, tk, d),
+            dv3.reshape(b, h, tk, d))
 
 
 def _xla_attention(q, k, v, mask, scale, causal):
@@ -161,26 +348,32 @@ def _xla_attention(q, k, v, mask, scale, causal):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
 def _flash(q, k, v, mask, scale, causal, block_q, block_k, interpret):
-    return _pallas_forward(q, k, v, mask, scale, causal, block_q, block_k,
-                           interpret)
+    out, _ = _pallas_forward(q, k, v, mask, scale, causal, block_q, block_k,
+                             interpret)
+    return out
 
 
 def _flash_fwd(q, k, v, mask, scale, causal, block_q, block_k, interpret):
-    out = _pallas_forward(q, k, v, mask, scale, causal, block_q, block_k,
-                          interpret)
-    return out, (q, k, v, mask)
+    out, lse = _pallas_forward(q, k, v, mask, scale, causal, block_q,
+                               block_k, interpret)
+    return out, (q, k, v, mask, out, lse)
 
 
 def _flash_bwd(scale, causal, block_q, block_k, interpret, res, g):
-    q, k, v, mask = res
+    q, k, v, mask, out, lse = res
 
+    if mask is None:
+        # Pallas backward: recompute p from (lse, delta) residuals — the
+        # (T,T) matrix never touches HBM in either direction
+        dq, dk, dv = _pallas_backward(q, k, v, out, lse, g, scale, causal,
+                                      block_q, block_k, interpret)
+        return dq, dk, dv, None
+
+    # masked path: exact XLA vjp (also produces the mask cotangent, which
+    # learned additive biases like T5 rel-pos need)
     def f(q, k, v, mask):
         return _xla_attention(q, k, v, mask, scale, causal)
 
-    if mask is None:
-        _, vjp = jax.vjp(lambda a, b, c: f(a, b, c, None), q, k, v)
-        dq, dk, dv = vjp(g)
-        return dq, dk, dv, None
     _, vjp = jax.vjp(f, q, k, v, mask)
     dq, dk, dv, dmask = vjp(g)
     return dq, dk, dv, dmask
@@ -201,6 +394,10 @@ def flash_attention(q, k, v, mask=None, scale=1.0, causal=False,
         else:
             interpret = jax.default_backend() not in ("tpu", "axon")
     tq, tk = q.shape[2], k.shape[2]
+    if causal and tq > tk:
+        # rows i < tq - tk see no keys at all; only the XLA reference
+        # defines that edge (uniform over all-masked logits)
+        return _xla_attention(q, k, v, mask, scale, causal)
     bq, bk = min(block_q, tq), min(block_k, tk)
     while tq % bq:
         bq //= 2

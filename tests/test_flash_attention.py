@@ -90,3 +90,48 @@ def test_sdpa_op_uses_flash_on_request():
                          None, 0.25, False)
     np.testing.assert_allclose(np.asarray(outs["Out"]), np.asarray(ref),
                                rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_pallas_backward_rectangular(causal):
+    """Pallas dQ/dK/dV kernels (mask=None path) vs XLA vjp, Tq != Tk."""
+    b, h, tq, tk, d = 1, 2, 32, 64, 16
+    q, k, v = _rand((b, h, tq, d), 3), _rand((b, h, tk, d), 4), \
+        _rand((b, h, tk, d), 5)
+    scale = d ** -0.5
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, scale=scale, causal=causal,
+                                       block_q=8, block_k=16,
+                                       interpret=True) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(_xla_attention(q, k, v, None, scale, causal) ** 2)
+
+    g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=2e-3, atol=2e-4)
+
+
+def test_flash_masked_backward_still_exact():
+    """Additive-mask path keeps the XLA vjp incl. mask cotangent."""
+    b, h, t, d = 1, 2, 16, 16
+    q, k, v = _rand((b, h, t, d), 6), _rand((b, h, t, d), 7), \
+        _rand((b, h, t, d), 8)
+    mask = _rand((b, 1, t, t), 9) * 0.1
+
+    def loss_flash(q, k, v, m):
+        return jnp.sum(flash_attention(q, k, v, mask=m, scale=0.25,
+                                       block_q=8, block_k=8,
+                                       interpret=True) ** 2)
+
+    def loss_ref(q, k, v, m):
+        return jnp.sum(_xla_attention(q, k, v, m, 0.25, False) ** 2)
+
+    g1 = jax.grad(loss_flash, argnums=(0, 1, 2, 3))(q, k, v, mask)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2, 3))(q, k, v, mask)
+    for a, b_ in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=2e-3, atol=2e-4)
