@@ -5,3 +5,4 @@ Reference parity: python/paddle/fluid/contrib/*.
 from . import mixed_precision
 from . import extend_optimizer
 from . import quantize
+from . import slim

@@ -1,0 +1,15 @@
+"""Model compression toolkit (reference fluid/contrib/slim).
+
+- prune: mask-based magnitude/structured pruning + sensitivity sweeps
+- distill: soft-label / L2 / FSP distillation losses + teacher merge
+- qat: quantization-aware training program pass (sim-quant with STE)
+- post-training int8 lives in paddle_tpu.contrib.quantize
+
+The reference's NAS (light_nas) searcher is a training-loop driver with no
+TPU-specific kernel surface; it is intentionally out of scope here.
+"""
+from .prune import (Pruner, MagnitudePruner, StructurePruner, PruneHelper,
+                    sensitivity)
+from .distill import (soft_label_loss, l2_distill_loss, fsp_matrix,
+                      fsp_loss, merge)
+from .qat import quant_aware, convert, QUANTIZABLE

@@ -1,0 +1,112 @@
+"""Knowledge distillation losses + teacher/student program merging.
+
+Reference parity: fluid/contrib/slim/distillation/distiller.py
+(L2Distiller, FSPDistiller, SoftLabelDistiller) and the strategy's
+program-merge step. Losses are plain layer compositions appended to the
+current Program; `merge` clones a frozen teacher program into the student's
+with a name prefix so one Executor step runs both.
+"""
+from ... import layers
+from ...framework.program import Parameter, default_main_program
+
+
+def soft_label_loss(student_logits, teacher_logits,
+                    student_temperature=1.0, teacher_temperature=1.0):
+    """Cross-entropy between temperature-softened distributions (reference
+    SoftLabelDistiller): mean(-sum(softmax(t/Tt) * log_softmax(s/Ts)))."""
+    s = layers.scale(student_logits, scale=1.0 / student_temperature)
+    t = layers.scale(teacher_logits, scale=1.0 / teacher_temperature)
+    t_prob = layers.softmax(t)
+    t_prob.stop_gradient = True
+    s_log = layers.log_softmax(s)
+    ce = layers.reduce_sum(layers.elementwise_mul(t_prob, s_log), dim=-1)
+    return layers.scale(layers.reduce_mean(ce), scale=-1.0)
+
+
+def l2_distill_loss(student_feature, teacher_feature):
+    """L2 feature-map distillation (reference L2Distiller)."""
+    teacher_feature.stop_gradient = True
+    diff = layers.elementwise_sub(student_feature, teacher_feature)
+    return layers.reduce_mean(layers.square(diff))
+
+
+def fsp_matrix(feature_a, feature_b):
+    """Flow-of-solution-procedure matrix (reference FSPDistiller
+    _fsp_matrix): (N, C1, H, W) x (N, C2, H, W) -> (N, C1, C2), the mean
+    over H*W of per-position channel outer products."""
+    n = feature_a.shape[0] if feature_a.shape else -1
+    c1 = feature_a.shape[1]
+    c2 = feature_b.shape[1]
+    h, w = feature_a.shape[2], feature_a.shape[3]
+    a = layers.reshape(feature_a, shape=[0, c1, h * w])
+    b = layers.reshape(feature_b, shape=[0, c2, h * w])
+    prod = layers.matmul(a, layers.transpose(b, perm=[0, 2, 1]))
+    return layers.scale(prod, scale=1.0 / (h * w))
+
+
+def fsp_loss(student_a, student_b, teacher_a, teacher_b):
+    """FSP distillation loss between a student layer pair and a teacher
+    layer pair (reference FSPDistiller)."""
+    sm = fsp_matrix(student_a, student_b)
+    tm = fsp_matrix(teacher_a, teacher_b)
+    tm.stop_gradient = True
+    return layers.reduce_mean(layers.square(
+        layers.elementwise_sub(sm, tm)))
+
+
+def merge(teacher_program, student_program=None, name_prefix="teacher_",
+          scope=None):
+    """Clone the teacher graph into the student program under a prefix
+    (reference slim distillation_strategy's merge): teacher vars/params are
+    renamed `prefix+name`, marked stop_gradient, and its feed vars keep
+    their ORIGINAL names so one feed dict drives both nets. Teacher
+    parameter values already initialized in the scope are copied to their
+    prefixed names.
+
+    Returns {original_teacher_var_name: merged Variable} for wiring
+    distillation losses.
+    """
+    from ...framework.scope import global_scope
+    scope = scope or global_scope()
+    student_program = student_program or default_main_program()
+    t_block = teacher_program.global_block()
+    s_block = student_program.global_block()
+
+    def mapped(name):
+        var = t_block.var(name)
+        if getattr(var, "is_data", False):
+            return name          # shared feeds
+        return name_prefix + name
+
+    var_map = {}
+    for name, var in t_block.vars.items():
+        new_name = mapped(name)
+        if s_block.has_var(new_name):
+            var_map[name] = s_block.var(new_name)
+            continue
+        kwargs = dict(name=new_name, shape=var.shape, dtype=var.dtype,
+                      stop_gradient=True,
+                      persistable=getattr(var, "persistable", False))
+        if isinstance(var, Parameter):
+            new = s_block.create_parameter(
+                trainable=False, **kwargs)
+            value = scope.find_var(name)
+            if value is not None:
+                # distinct buffer: the executor donates program params, and
+                # an aliased array would be deleted under the old name
+                import jax.numpy as jnp
+                scope.set_var(new_name, jnp.array(value, copy=True))
+        else:
+            kwargs["is_data"] = getattr(var, "is_data", False)
+            new = s_block.create_var(**kwargs)
+        var_map[name] = new
+
+    for op in t_block.ops:
+        s_block.append_op(
+            op.type,
+            inputs={slot: [mapped(n) for n in names]
+                    for slot, names in op.inputs.items()},
+            outputs={slot: [mapped(n) for n in names]
+                     for slot, names in op.outputs.items()},
+            attrs=dict(op.attrs))
+    return var_map

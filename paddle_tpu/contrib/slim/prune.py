@@ -1,0 +1,130 @@
+"""Model pruning — mask-based magnitude pruning + sensitivity analysis.
+
+Reference parity: fluid/contrib/slim/prune/{pruner.py,prune_strategy.py}.
+The reference physically shrinks tensors via graph surgery; on TPU static
+shapes are king, so the native design is persistent 0/1 masks applied to
+parameters in the Scope — XLA folds the multiplies, and sparsity-aware
+hardware (or a later export) can exploit the zeros. Masks survive optimizer
+updates by re-application (`apply_masks` after each step, or the
+PruneHelper attached to an Executor run loop).
+"""
+import numpy as np
+
+import jax.numpy as jnp
+
+from ...framework.scope import global_scope
+
+
+class Pruner(object):
+    """Base pruner (reference slim/prune/pruner.py Pruner)."""
+
+    def prune(self, param):
+        raise NotImplementedError
+
+
+class MagnitudePruner(Pruner):
+    """Unstructured abs-magnitude pruning: zero the smallest `ratio`
+    fraction of weights."""
+
+    def __init__(self, ratio):
+        self.ratio = float(ratio)
+
+    def mask(self, value):
+        v = np.asarray(value)
+        k = int(v.size * self.ratio)
+        if k <= 0:
+            return np.ones_like(v, np.float32)
+        # rank-based: prune exactly k elements — a threshold compare would
+        # wipe out every tied value (e.g. the whole zero-init bias)
+        mask = np.ones(v.size, np.float32)
+        mask[np.argsort(np.abs(v).ravel(), kind="stable")[:k]] = 0.0
+        return mask.reshape(v.shape)
+
+
+class StructurePruner(Pruner):
+    """Whole-slice (channel/neuron) pruning along `axis` ranked by the
+    given criterion (reference StructurePruner l1_norm)."""
+
+    def __init__(self, ratio, axis=0, criterion="l1_norm"):
+        self.ratio = float(ratio)
+        self.axis = int(axis)
+        if criterion != "l1_norm":
+            raise ValueError("unsupported criterion %r" % criterion)
+
+    def mask(self, value):
+        v = np.asarray(value)
+        red = tuple(i for i in range(v.ndim) if i != self.axis)
+        norms = np.abs(v).sum(axis=red)
+        n_prune = int(norms.size * self.ratio)
+        keep = np.ones(norms.size, np.float32)
+        if n_prune > 0:
+            keep[np.argsort(norms)[:n_prune]] = 0.0
+        shape = [1] * v.ndim
+        shape[self.axis] = -1
+        return np.broadcast_to(keep.reshape(shape), v.shape).astype(
+            np.float32).copy()
+
+
+class PruneHelper(object):
+    """Computes, applies, and re-applies pruning masks over Scope params."""
+
+    def __init__(self, program, ratios, pruner_cls=MagnitudePruner,
+                 scope=None, **pruner_kwargs):
+        """ratios: {param_name: ratio} or a single float for all params."""
+        self.program = program
+        self.scope = scope or global_scope()
+        params = [p.name for p in program.all_parameters()]
+        if not isinstance(ratios, dict):
+            ratios = {name: ratios for name in params}
+        self.pruners = {name: pruner_cls(ratio, **pruner_kwargs)
+                        for name, ratio in ratios.items()}
+        self.masks = {}
+
+    def compute_masks(self):
+        for name, pruner in self.pruners.items():
+            value = self.scope.find_var(name)
+            if value is None:
+                raise KeyError("parameter %r not in scope" % name)
+            self.masks[name] = jnp.asarray(pruner.mask(value))
+        return self.masks
+
+    def apply_masks(self):
+        """Zero pruned weights (idempotent; call after optimizer steps)."""
+        if not self.masks:
+            self.compute_masks()
+        for name, mask in self.masks.items():
+            self.scope.set_var(name, self.scope.find_var(name) * mask)
+
+    def sparsity(self):
+        total = live = 0
+        for name, mask in self.masks.items():
+            m = np.asarray(mask)
+            total += m.size
+            live += int(m.sum())
+        return 1.0 - live / max(total, 1)
+
+
+def sensitivity(program, executor, feed, fetch_loss, param_names=None,
+                ratios=(0.1, 0.3, 0.5, 0.7, 0.9), pruner_cls=MagnitudePruner,
+                scope=None):
+    """Per-parameter pruning sensitivity sweep (reference
+    slim/prune/auto_prune_strategy sensitivity analysis): for each param and
+    ratio, prune ONLY that param and measure the loss delta. Weights are
+    restored after every probe."""
+    scope = scope or global_scope()
+    if param_names is None:
+        param_names = [p.name for p in program.all_parameters()]
+    base = float(np.asarray(
+        executor.run(program, feed=feed, fetch_list=[fetch_loss])[0]).mean())
+    report = {}
+    for name in param_names:
+        orig = scope.find_var(name)
+        report[name] = {}
+        for ratio in ratios:
+            mask = jnp.asarray(pruner_cls(ratio).mask(orig))
+            scope.set_var(name, orig * mask)
+            loss = float(np.asarray(executor.run(
+                program, feed=feed, fetch_list=[fetch_loss])[0]).mean())
+            report[name][ratio] = loss - base
+            scope.set_var(name, orig)
+    return base, report

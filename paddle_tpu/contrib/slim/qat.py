@@ -1,0 +1,148 @@
+"""Quantization-aware training program pass.
+
+Reference parity: fluid/contrib/slim/quantization/quantization_pass.py
+(QuantizationTransformPass / QuantizationFreezePass). Rewrites a Program in
+place: every input of a quantizable op (conv2d / mul / matmul) is routed
+through a simulated quantize-dequantize op — per-channel abs-max for
+weights, moving-average abs-max (EMA state persisted in the Scope, updated
+in-place each step like optimizer state) for activations — with
+straight-through gradients, so training sees int8 rounding noise while XLA
+still runs fp matmuls on the MXU.
+"""
+import numpy as np
+
+from ...framework.program import Parameter
+from ...framework.scope import global_scope
+
+QUANTIZABLE = ("conv2d", "depthwise_conv2d", "mul", "matmul")
+
+_W_SLOTS = {"conv2d": "Filter", "depthwise_conv2d": "Filter",
+            "mul": "Y", "matmul": "Y"}
+
+
+def quant_aware(program, weight_bits=8, activation_bits=8,
+                quantizable_op_types=QUANTIZABLE, moving_rate=0.9,
+                skip_pattern="skip_quant", scope=None):
+    """Insert fake-quant ops before every quantizable op's inputs.
+    Activation EMA state vars are initialized directly in `scope`.
+    Returns the number of rewritten ops (mutates `program`)."""
+    import jax.numpy as jnp
+    scope = scope or global_scope()
+    block = program.global_block()
+    rewritten = 0
+    qdq_cache = {}      # (var name, is_weight) -> quantized replacement
+    i = 0
+    while i < len(block.ops):
+        op = block.ops[i]
+        if op.type not in quantizable_op_types or \
+                skip_pattern in str(op.attrs.get("op_namescope", "")):
+            i += 1
+            continue
+        w_slot = _W_SLOTS.get(op.type)
+        inserted = 0
+        for slot, names in list(op.inputs.items()):
+            new_names = []
+            for name in names:
+                var = block.var(name)
+                is_weight = isinstance(var, Parameter)
+                key = (name, is_weight)
+                if key in qdq_cache:
+                    new_names.append(qdq_cache[key])
+                    continue
+                q_name = name + ".quantized"
+                block.create_var(name=q_name, shape=var.shape,
+                                 dtype=var.dtype)
+                scale_var = block.create_var(
+                    name=q_name + ".scale", stop_gradient=True)
+                if is_weight and slot == w_slot:
+                    # per-output-channel for conv (axis 0 of OIHW), per
+                    # input-feature column for matmul/mul weights (axis 1)
+                    axis = 0 if "conv" in op.type else 1
+                    block._insert_op(
+                        i, "fake_channel_wise_quantize_dequantize_abs_max",
+                        inputs={"X": [name]},
+                        outputs={"Out": [q_name],
+                                 "OutScale": [scale_var.name]},
+                        attrs={"bit_length": weight_bits,
+                               "quant_axis": axis})
+                else:
+                    # EMA scale state lives in the scope and is updated
+                    # in-place every step, exactly like optimizer moments
+                    state = block.create_var(
+                        name=q_name + ".state", shape=(1,),
+                        persistable=True, stop_gradient=True)
+                    accum = block.create_var(
+                        name=q_name + ".accum", shape=(1,),
+                        persistable=True, stop_gradient=True)
+                    if scope.find_var(state.name) is None:
+                        scope.set_var(state.name, jnp.ones((1,)))
+                        scope.set_var(accum.name, jnp.zeros((1,)))
+                    block._insert_op(
+                        i,
+                        "fake_quantize_dequantize_moving_average_abs_max",
+                        inputs={"X": [name], "InState": [state.name],
+                                "InAccum": [accum.name]},
+                        outputs={"Out": [q_name],
+                                 "OutScale": [scale_var.name],
+                                 "OutState": [state.name],
+                                 "OutAccum": [accum.name]},
+                        attrs={"bit_length": activation_bits,
+                               "moving_rate": moving_rate})
+                qdq_cache[key] = q_name
+                new_names.append(q_name)
+                inserted += 1
+                i += 1   # the target op shifted right
+            op.inputs[slot] = new_names
+        if inserted:
+            rewritten += 1
+        i += 1
+    return rewritten
+
+
+def convert(program, scope=None):
+    """Freeze a quant-aware-trained program for int8 inference export:
+    strips activation fake-quant ops (their EMA scales are returned as
+    metadata) and computes PER-CHANNEL weight scales matching exactly what
+    training simulated (reference QuantizationFreezePass, XLA-native form:
+    weight qdq ops stay in the program so exported fp weights carry the
+    rounding).
+
+    Returns {"weights": {param: per-channel scale array},
+             "activations": {var: float scale}}."""
+    scope = scope or global_scope()
+    block = program.global_block()
+    # collect weight quant configs BEFORE stripping anything
+    w_cfg = {}
+    for op in block.ops:
+        if op.type == "fake_channel_wise_quantize_dequantize_abs_max":
+            w_cfg[op.inputs["X"][0]] = (int(op.attrs.get("quant_axis", 0)),
+                                        int(op.attrs.get("bit_length", 8)))
+    act_scales = {}
+    idx = 0
+    while idx < len(block.ops):
+        op = block.ops[idx]
+        if op.type == "fake_quantize_dequantize_moving_average_abs_max":
+            src = op.inputs["X"][0]
+            dst = op.outputs["Out"][0]
+            accum = scope.find_var(op.inputs["InAccum"][0])
+            state = scope.find_var(op.inputs["InState"][0])
+            if accum is not None and state is not None:
+                act_scales[src] = float(np.asarray(accum)[0] /
+                                        max(float(np.asarray(state)[0]),
+                                            1e-8))
+            for later in block.ops[idx + 1:]:
+                for slot, names in later.inputs.items():
+                    later.inputs[slot] = [src if n == dst else n
+                                          for n in names]
+            block._remove_op(idx)
+            continue
+        idx += 1
+    w_scales = {}
+    for name, (axis, bits) in w_cfg.items():
+        value = scope.find_var(name)
+        if value is None:
+            continue
+        v = np.asarray(value)
+        red = tuple(i for i in range(v.ndim) if i != axis)
+        w_scales[name] = np.maximum(np.abs(v).max(axis=red), 1e-8)
+    return {"weights": w_scales, "activations": act_scales}

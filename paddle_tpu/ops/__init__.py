@@ -13,3 +13,4 @@ from . import rnn_ops       # noqa: F401
 from . import attention_ops  # noqa: F401
 from . import collective_ops  # noqa: F401
 from . import sequence_ops  # noqa: F401
+from . import quant_ops     # noqa: F401

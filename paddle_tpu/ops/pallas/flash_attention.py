@@ -356,6 +356,10 @@ def _flash(q, k, v, mask, scale, causal, block_q, block_k, interpret):
 def _flash_fwd(q, k, v, mask, scale, causal, block_q, block_k, interpret):
     out, lse = _pallas_forward(q, k, v, mask, scale, causal, block_q,
                                block_k, interpret)
+    if mask is not None:
+        # masked path backprops via XLA vjp from (q,k,v,mask) only — don't
+        # pin an extra (B,H,T,D) out tensor in HBM until the backward
+        return out, (q, k, v, mask, None, None)
     return out, (q, k, v, mask, out, lse)
 
 

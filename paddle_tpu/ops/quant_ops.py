@@ -1,0 +1,70 @@
+"""Quantization op kernels.
+
+Reference parity: paddle/fluid/operators/fake_quantize_op.cc + the
+contrib/slim quantization passes. Simulated quantization: values are
+quantized->dequantized in fp so XLA still runs bf16/fp32 matmuls; gradients
+pass straight through (STE), expressed exactly as
+x + stop_gradient(qdq(x) - x).
+"""
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+
+def _qdq_abs_max(x, bits, scale=None):
+    qmax = 2.0 ** (bits - 1) - 1
+    if scale is None:
+        scale = jnp.max(jnp.abs(x))
+    scale = jnp.maximum(scale, 1e-8)
+    q = jnp.clip(jnp.round(x / scale * qmax), -qmax - 1, qmax)
+    return q * scale / qmax, scale
+
+
+@register_op("fake_quantize_dequantize_abs_max")
+def _fake_qdq_abs_max(ctx, ins, attrs):
+    """Per-tensor abs-max sim-quant with STE gradient (reference
+    fake_quantize_dequantize_abs_max op)."""
+    x = ins["X"][0]
+    bits = int(attrs.get("bit_length", 8))
+    qdq, scale = _qdq_abs_max(x, bits)
+    out = x + jax.lax.stop_gradient(qdq - x)
+    return {"Out": out, "OutScale": scale[None]}
+
+
+@register_op("fake_quantize_dequantize_moving_average_abs_max",
+             nondiff=("InScale", "InState", "InAccum"))
+def _fake_qdq_moving_avg(ctx, ins, attrs):
+    """Moving-average abs-max sim-quant (reference
+    fake_quantize_dequantize_moving_average_abs_max): scale tracks
+    rate * state + abs_max running average; STE gradient."""
+    x = ins["X"][0]
+    bits = int(attrs.get("bit_length", 8))
+    rate = float(attrs.get("moving_rate", 0.9))
+    state = ins["InState"][0] if ins.get("InState") else jnp.ones((1,))
+    accum = ins["InAccum"][0] if ins.get("InAccum") else jnp.zeros((1,))
+    cur = jnp.max(jnp.abs(x))
+    new_state = rate * state + 1.0
+    new_accum = rate * accum + cur
+    scale = new_accum / new_state
+    qdq, _ = _qdq_abs_max(x, bits, scale.reshape(()))
+    out = x + jax.lax.stop_gradient(qdq - x)
+    return {"Out": out, "OutScale": scale.reshape(1),
+            "OutState": new_state, "OutAccum": new_accum}
+
+
+@register_op("fake_channel_wise_quantize_dequantize_abs_max")
+def _fake_qdq_channel(ctx, ins, attrs):
+    """Per-output-channel abs-max sim-quant (reference
+    fake_channel_wise_quantize_abs_max); channel = axis 0 for conv
+    weights (OIHW), last axis for matmul weights via quant_axis."""
+    x = ins["X"][0]
+    bits = int(attrs.get("bit_length", 8))
+    axis = int(attrs.get("quant_axis", 0))
+    qmax = 2.0 ** (bits - 1) - 1
+    red = tuple(i for i in range(x.ndim) if i != axis)
+    scale = jnp.maximum(jnp.max(jnp.abs(x), axis=red, keepdims=True), 1e-8)
+    q = jnp.clip(jnp.round(x / scale * qmax), -qmax - 1, qmax)
+    qdq = q * scale / qmax
+    out = x + jax.lax.stop_gradient(qdq - x)
+    return {"Out": out, "OutScale": scale.reshape(-1)}

@@ -1,0 +1,225 @@
+"""contrib.slim tests: pruning, distillation, QAT."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu import layers, optimizer
+from paddle_tpu.contrib import slim
+from paddle_tpu.ops.registry import get_op
+
+
+class _Ctx:
+    program = None
+
+    def rng(self):
+        return jax.random.PRNGKey(0)
+
+
+# ------------------------------------------------------------------ prune
+
+def test_magnitude_pruner_mask():
+    v = np.array([[0.1, -2.0], [0.5, -0.05]], np.float32)
+    mask = slim.MagnitudePruner(0.5).mask(v)
+    np.testing.assert_array_equal(mask, [[0, 1], [1, 0]])
+
+
+def test_structure_pruner_prunes_whole_rows():
+    v = np.array([[1, 1, 1], [0.1, 0.1, 0.1], [2, 2, 2], [0.2, 0.2, 0.2]],
+                 np.float32)
+    mask = slim.StructurePruner(0.5, axis=0).mask(v)
+    np.testing.assert_array_equal(mask[:, 0], [1, 0, 1, 0])
+    assert (mask == mask[:, :1]).all()
+
+
+def test_prune_helper_sparsity_survives_training():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [8], "float32")
+        h = layers.fc(x, size=16, act="relu")
+        y = layers.fc(h, size=1)
+        lbl = layers.data("y", [1], "float32")
+        loss = layers.reduce_mean(layers.square_error_cost(y, lbl))
+        optimizer.SGD(0.1).minimize(loss)
+    exe = pt.Executor()
+    exe.run(startup)
+    helper = slim.PruneHelper(main, 0.5)
+    helper.compute_masks()
+    helper.apply_masks()
+    assert abs(helper.sparsity() - 0.5) < 0.1
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.rand(16, 8).astype(np.float32),
+            "y": rng.rand(16, 1).astype(np.float32)}
+    for _ in range(5):
+        exe.run(main, feed=feed, fetch_list=[loss])
+        helper.apply_masks()     # masks re-applied after each update
+    from paddle_tpu.framework.scope import global_scope
+    for name, mask in helper.masks.items():
+        w = np.asarray(global_scope().find_var(name))
+        assert np.all(w[np.asarray(mask) == 0] == 0)
+
+
+def test_sensitivity_reports_loss_deltas():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [4], "float32")
+        y = layers.fc(x, size=2)
+        loss = layers.reduce_mean(layers.square(y))
+    exe = pt.Executor()
+    exe.run(startup)
+    rng = np.random.RandomState(1)
+    feed = {"x": rng.rand(8, 4).astype(np.float32)}
+    base, report = slim.sensitivity(main, exe, feed, loss,
+                                    ratios=(0.5, 0.9))
+    assert np.isfinite(base)
+    for name, deltas in report.items():
+        assert set(deltas) == {0.5, 0.9}
+    # weights must be restored after probing
+    base2 = float(np.asarray(exe.run(main, feed=feed,
+                                     fetch_list=[loss])[0]).mean())
+    np.testing.assert_allclose(base, base2, rtol=1e-6)
+
+
+# ---------------------------------------------------------------- distill
+
+def test_soft_label_loss_minimized_when_matching():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        s = layers.data("s", [4], "float32")
+        t = layers.data("t", [4], "float32")
+        loss = slim.soft_label_loss(s, t, 2.0, 2.0)
+    exe = pt.Executor()
+    exe.run(startup)
+    logits = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+    same = float(exe.run(main, feed={"s": logits, "t": logits},
+                         fetch_list=[loss])[0])
+    diff = float(exe.run(main, feed={"s": logits,
+                                     "t": -logits},
+                         fetch_list=[loss])[0])
+    assert same < diff     # matching distributions give lower CE
+
+
+def test_fsp_matrix_matches_numpy():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        a = layers.data("a", (3, 4, 4), "float32")
+        b = layers.data("b", (5, 4, 4), "float32")
+        m = slim.fsp_matrix(a, b)
+    exe = pt.Executor()
+    exe.run(startup)
+    rng = np.random.RandomState(2)
+    av = rng.rand(2, 3, 4, 4).astype(np.float32)
+    bv = rng.rand(2, 5, 4, 4).astype(np.float32)
+    out = exe.run(main, feed={"a": av, "b": bv}, fetch_list=[m])[0]
+    ref = np.einsum("nchw,ndhw->ncd", av, bv) / 16.0
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+
+def test_teacher_merge_distillation_trains_student():
+    """Full distillation flow: frozen teacher merged into student program;
+    student learns to mimic teacher outputs."""
+    rng = np.random.RandomState(3)
+
+    teacher = pt.Program()
+    t_startup = pt.Program()
+    with pt.program_guard(teacher, t_startup):
+        x = layers.data("x", [4], "float32")
+        t_logits = layers.fc(x, size=3, param_attr=pt.ParamAttr(
+            name="t_w", initializer=pt.initializer.NumpyArrayInitializer(
+                rng.randn(4, 3).astype(np.float32))))
+
+    main, startup = pt.Program(), pt.Program()
+    exe = pt.Executor()
+    exe.run(t_startup)   # teacher params initialized under original names
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [4], "float32")
+        s_logits = layers.fc(x, size=3, param_attr=pt.ParamAttr(name="s_w"))
+        var_map = slim.merge(teacher, main)   # copies values to prefixed
+        loss = slim.soft_label_loss(s_logits, var_map[t_logits.name])
+        optimizer.Adam(0.05).minimize(loss)
+    exe.run(startup)
+    from paddle_tpu.framework.scope import global_scope
+    sc = global_scope()
+    assert sc.find_var("teacher_t_w") is not None
+
+    feed = {"x": rng.rand(16, 4).astype(np.float32)}
+    l0 = float(exe.run(main, feed=feed, fetch_list=[loss])[0])
+    for _ in range(60):
+        l1 = float(exe.run(main, feed=feed, fetch_list=[loss])[0])
+    assert l1 < l0
+    # teacher weights untouched by training
+    np.testing.assert_allclose(np.asarray(sc.find_var("teacher_t_w")),
+                               np.asarray(sc.find_var("t_w")))
+
+
+# -------------------------------------------------------------------- qat
+
+def test_fake_qdq_ste_gradient_is_identity():
+    x = jnp.asarray(np.linspace(-1, 1, 11).astype(np.float32))
+
+    def f(v):
+        out = get_op("fake_quantize_dequantize_abs_max").fn(
+            _Ctx(), {"X": [v]}, {"bit_length": 8})["Out"]
+        return jnp.sum(out * jnp.arange(11.0))
+
+    g = np.asarray(jax.grad(f)(x))
+    np.testing.assert_allclose(g, np.arange(11.0), rtol=1e-6)
+
+
+def test_fake_qdq_quantizes_to_levels():
+    x = jnp.asarray(np.array([0.0, 0.3, -1.0, 0.77], np.float32))
+    out = np.asarray(get_op("fake_quantize_dequantize_abs_max").fn(
+        _Ctx(), {"X": [x]}, {"bit_length": 4})["Out"])
+    # 4 bits: qmax=7, scale=1/7 -> all outputs are multiples of 1/7
+    np.testing.assert_allclose(out * 7, np.round(out * 7), atol=1e-5)
+    assert abs(out[1] - 0.3) < 1.0 / 7
+
+
+def test_channel_wise_qdq_per_channel_scales():
+    x = jnp.asarray(np.stack([np.full((4,), 0.1, np.float32),
+                              np.full((4,), 10.0, np.float32)]))
+    outs = get_op("fake_channel_wise_quantize_dequantize_abs_max").fn(
+        _Ctx(), {"X": [x]}, {"bit_length": 8, "quant_axis": 0})
+    scales = np.asarray(outs["OutScale"])
+    np.testing.assert_allclose(scales, [0.1, 10.0], rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(outs["Out"]), np.asarray(x),
+                               rtol=1e-2)
+
+
+def test_quant_aware_training_and_convert():
+    """QAT: program rewritten, still trains; convert strips act quant."""
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [8], "float32")
+        h = layers.fc(x, size=16, act="relu")
+        y = layers.fc(h, size=1)
+        lbl = layers.data("y", [1], "float32")
+        loss = layers.reduce_mean(layers.square_error_cost(y, lbl))
+    n = slim.quant_aware(main)
+    assert n >= 2            # both fc muls rewritten
+    types = [op.type for op in main.global_block().ops]
+    assert "fake_quantize_dequantize_moving_average_abs_max" in types
+    assert "fake_channel_wise_quantize_dequantize_abs_max" in types
+    with pt.program_guard(main, startup):
+        optimizer.SGD(0.1).minimize(loss)
+    exe = pt.Executor()
+    exe.run(startup)
+    rng = np.random.RandomState(4)
+    xv = rng.rand(32, 8).astype(np.float32)
+    feed = {"x": xv, "y": (xv.sum(1, keepdims=True) * 0.1).astype(np.float32)}
+    l0 = float(exe.run(main, feed=feed, fetch_list=[loss])[0])
+    for _ in range(30):
+        l1 = float(exe.run(main, feed=feed, fetch_list=[loss])[0])
+    assert l1 < l0
+
+    infer = main.clone(for_test=True) if hasattr(main, "clone") else main
+    scales = slim.convert(infer)
+    types = [op.type for op in infer.global_block().ops]
+    assert "fake_quantize_dequantize_moving_average_abs_max" not in types
+    assert len(scales["weights"]) >= 2 and len(scales["activations"]) >= 1
+    # per-channel export matches what channel-wise QAT simulated
+    for name, sc in scales["weights"].items():
+        assert np.asarray(sc).ndim == 1 and (np.asarray(sc) > 0).all()
+    # converted program still runs
+    out = exe.run(infer, feed=feed, fetch_list=[loss])[0]
+    assert np.isfinite(out).all()
