@@ -34,7 +34,6 @@ def fsp_matrix(feature_a, feature_b):
     """Flow-of-solution-procedure matrix (reference FSPDistiller
     _fsp_matrix): (N, C1, H, W) x (N, C2, H, W) -> (N, C1, C2), the mean
     over H*W of per-position channel outer products."""
-    n = feature_a.shape[0] if feature_a.shape else -1
     c1 = feature_a.shape[1]
     c2 = feature_b.shape[1]
     h, w = feature_a.shape[2], feature_a.shape[3]
@@ -69,6 +68,10 @@ def merge(teacher_program, student_program=None, name_prefix="teacher_",
     from ...framework.scope import global_scope
     scope = scope or global_scope()
     student_program = student_program or default_main_program()
+    if teacher_program.num_blocks > 1:
+        raise NotImplementedError(
+            "merge() supports single-block teacher programs; control-flow "
+            "sub-blocks would need index remapping")
     t_block = teacher_program.global_block()
     s_block = student_program.global_block()
 

@@ -44,17 +44,20 @@ def quant_aware(program, weight_bits=8, activation_bits=8,
             new_names = []
             for name in names:
                 var = block.var(name)
-                is_weight = isinstance(var, Parameter)
+                is_weight = isinstance(var, Parameter) and slot == w_slot
+                # cache per quantization MODE: a tied param reaching both a
+                # weight slot and an activation slot gets both variants
                 key = (name, is_weight)
                 if key in qdq_cache:
                     new_names.append(qdq_cache[key])
                     continue
-                q_name = name + ".quantized"
+                q_name = name + (".quantized" if is_weight
+                                 else ".quantized.act")
                 block.create_var(name=q_name, shape=var.shape,
                                  dtype=var.dtype)
                 scale_var = block.create_var(
                     name=q_name + ".scale", stop_gradient=True)
-                if is_weight and slot == w_slot:
+                if is_weight:
                     # per-output-channel for conv (axis 0 of OIHW), per
                     # input-feature column for matmul/mul weights (axis 1)
                     axis = 0 if "conv" in op.type else 1

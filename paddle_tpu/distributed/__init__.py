@@ -12,3 +12,5 @@ from . import fleet
 from .ring_attention import ring_attention
 from .pipeline import (pipeline_forward, pipeline_loss_and_grads,
                        pipeline_1f1b_step, stack_stage_params)
+from .sharded_embedding import (sharded_embedding_lookup, ShardedEmbedding,
+                                distributed_embedding_attr)

@@ -59,6 +59,11 @@ def embedding(input, size, is_sparse=False, is_distributed=False,
     helper = LayerHelper("embedding", param_attr=param_attr, dtype=dtype)
     w = helper.create_parameter(helper.param_attr, shape=list(size),
                                 dtype=dtype)
+    if is_distributed and getattr(w, "sharding", None) is None:
+        # pserver-equivalent: row-shard the table over the mp axis so
+        # CompiledProgram gives it a NamedSharding and XLA keeps
+        # lookups/updates on the owner shard (distributed/sharded_embedding)
+        w.sharding = ("mp", None)
     in_shape = input.shape or (-1,)
     out_shape = tuple(in_shape[:-1] if in_shape[-1] == 1 else in_shape) + \
         (size[1],)

@@ -68,6 +68,15 @@ def _adam(ctx, ins, attrs):
     m2n = b2 * m2 + (1 - b2) * gf * gf
     lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
     p_new = p.astype(jnp.float32) - lr_t * m1n / (jnp.sqrt(m2n) + eps)
+    if attrs.get("lazy_mode") and g.ndim >= 2:
+        # reference lazy-mode adam (adam_op.h sparse path): rows absent
+        # from the batch — all-zero grad rows for an embedding's dense
+        # scatter-add gradient — keep their param AND moments untouched
+        touched = jnp.any(gf != 0, axis=tuple(range(1, g.ndim)),
+                          keepdims=True)
+        m1n = jnp.where(touched, m1n, m1)
+        m2n = jnp.where(touched, m2n, m2)
+        p_new = jnp.where(touched, p_new, p.astype(jnp.float32))
     return {"ParamOut": p_new.astype(p.dtype), "Moment1Out": m1n,
             "Moment2Out": m2n,
             "Beta1PowOut": (b1p * b1).reshape(ins["Beta1Pow"][0].shape),
@@ -80,8 +89,16 @@ def _adamw(ctx, ins, attrs):
     coeff = attrs.get("coeff", 0.01)
     lr = _p(ins, "LearningRate").reshape(()).astype(jnp.float32)
     p = _p(ins, "Param")
-    outs["ParamOut"] = (outs["ParamOut"].astype(jnp.float32) -
-                        lr * coeff * p.astype(jnp.float32)).astype(p.dtype)
+    decayed = (outs["ParamOut"].astype(jnp.float32) -
+               lr * coeff * p.astype(jnp.float32))
+    g = _p(ins, "Grad")
+    if attrs.get("lazy_mode") and g.ndim >= 2:
+        # untouched rows must stay frozen — no decoupled decay either
+        touched = jnp.any(g.astype(jnp.float32) != 0,
+                          axis=tuple(range(1, g.ndim)), keepdims=True)
+        decayed = jnp.where(touched, decayed,
+                            outs["ParamOut"].astype(jnp.float32))
+    outs["ParamOut"] = decayed.astype(p.dtype)
     return outs
 
 

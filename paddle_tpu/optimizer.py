@@ -246,6 +246,7 @@ class _AdamLike(Optimizer):
         self._beta1 = beta1
         self._beta2 = beta2
         self._epsilon = epsilon
+        self._lazy_mode = lazy_mode
 
     def _create_accumulators(self, block, parameters):
         for p in parameters:
@@ -266,8 +267,15 @@ class _AdamLike(Optimizer):
         b1p = self._get_accumulator("beta1_pow_acc", param)
         b2p = self._get_accumulator("beta2_pow_acc", param)
         lr = self._create_param_lr(param)
+        # reference lazy mode applies only to SelectedRows grads, i.e.
+        # embedding tables — not dense weights that happen to have a
+        # zero-grad row this step (dead ReLU etc.)
+        lazy = self._lazy_mode and any(
+            op.type in ("lookup_table", "lookup_table_v2") and
+            param.name in op.input("W") for op in block.ops)
         attrs = {"beta1": self._beta1, "beta2": self._beta2,
-                 "epsilon": self._epsilon, "op_role": "optimize"}
+                 "epsilon": self._epsilon, "op_role": "optimize",
+                 "lazy_mode": lazy}
         attrs.update(self._extra_attrs())
         block.append_op(
             self._update_op,
