@@ -9,7 +9,6 @@ one shard, so the psum sums one hit and zeros), and the backward is the
 transposed scatter-add into the local shard — XLA keeps every update local
 to the owner shard. Pair with Adam(lazy_mode=True) for row-sparse moments.
 """
-import numpy as np
 import jax
 import jax.numpy as jnp
 from jax import lax

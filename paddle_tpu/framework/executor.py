@@ -78,6 +78,37 @@ class Executor(object):
         self._cache.clear()
 
     # ------------------------------------------------------------------
+    def train_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        """Run the whole dataset through the jitted train step (reference
+        executor.py train_from_dataset / MultiTrainer). The device_worker
+        thread pool maps to background batch prefetch + JAX async
+        dispatch: the host stages batch N+1 while the chip runs batch N.
+        Returns (steps_run, last_fetch_values)."""
+        from ..trainer_factory import TrainerFactory
+        if dataset is None:
+            raise ValueError("dataset is required")
+        program = program if program is not None else default_main_program()
+        trainer_cls = TrainerFactory()._create_trainer(
+            getattr(program, "_fleet_opt", None))
+        trainer = trainer_cls(self, program)
+        return trainer.run(dataset, fetch_list=fetch_list,
+                           fetch_info=fetch_info,
+                           print_period=print_period, debug=debug,
+                           scope=scope)
+
+    def infer_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        """Same loop with no parameter updates expected in `program`
+        (reference infer_from_dataset disables gradient push; here the
+        program simply contains no optimizer ops)."""
+        return self.train_from_dataset(program, dataset, scope, thread,
+                                       debug, fetch_list, fetch_info,
+                                       print_period)
+
+    # ------------------------------------------------------------------
     def run(self, program=None, feed=None, fetch_list=None, feed_var_name=None,
             fetch_var_name=None, scope=None, return_numpy=True,
             use_program_cache=True):

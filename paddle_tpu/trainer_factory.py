@@ -1,0 +1,146 @@
+"""Trainer / device-worker equivalents.
+
+Reference parity: python/paddle/fluid/trainer_factory.py +
+device_worker.py (MultiTrainer + HogwildWorker, section_worker etc.). The
+reference spins C++ worker threads each running the op list over a data
+queue. On TPU the jitted step IS the worker — XLA dispatch is host-async,
+so one Python thread keeps the chip busy while a background prefetch
+thread (the DataFeed queue equivalent) collates the next batch and ships
+it to HBM. Pipeline (section) scheduling lives in distributed/pipeline.py.
+"""
+import queue
+import threading
+
+_STOP = object()
+
+
+class PrefetchIterator(object):
+    """Background-thread batch pump: the device_worker's data queue.
+    Wraps any iterable of feed dicts; keeps up to `capacity` batches
+    staged ahead of the consumer. close() (or abandoning the iterator
+    after an error) unblocks and retires the pump thread."""
+
+    def __init__(self, iterable, capacity=4):
+        self._q = queue.Queue(maxsize=capacity)
+        self._err = None
+        self._stop = threading.Event()
+
+        def put(item):
+            while not self._stop.is_set():
+                try:
+                    self._q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def pump():
+            try:
+                for item in iterable:
+                    if not put(item):
+                        return
+            except BaseException as e:   # surfaced on the consumer side
+                self._err = e
+            finally:
+                put(_STOP)
+
+        self._thread = threading.Thread(target=pump, daemon=True)
+        self._thread.start()
+
+    def close(self):
+        """Stop the pump thread (safe to call any time)."""
+        self._stop.set()
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        item = self._q.get()
+        if item is _STOP:
+            if self._err is not None:
+                raise self._err
+            raise StopIteration
+        return item
+
+
+class DeviceWorker(object):
+    """Base device worker (reference device_worker.py DeviceWorker)."""
+
+    def __init__(self):
+        self._program = None
+
+    def _set_program(self, program):
+        self._program = program
+
+
+class Hogwild(DeviceWorker):
+    """Hogwild worker: plain step loop. On TPU, 'lock-free multithread
+    update' degenerates to async dispatch of one fused step — the chip,
+    not host threads, provides the parallelism."""
+
+
+class DownpourSGD(DeviceWorker):
+    """Pserver-style sparse push/pull worker. TPU-native: sharded
+    embedding tables + lazy-mode optimizers replace push/pull (see
+    distributed/sharded_embedding.py); the step loop is identical."""
+
+
+class Section(DeviceWorker):
+    """Pipeline section worker — superseded by the SPMD GPipe/1F1B
+    schedules in distributed/pipeline.py."""
+
+
+class TrainerDesc(object):
+    def __init__(self):
+        self._worker = Hogwild()
+        self._fetch_vars = []
+        self._fetch_info = []
+        self._print_period = 100
+
+
+class MultiTrainer(object):
+    """Runs the jitted step over a prefetched dataset (reference
+    MultiTrainer's thread pool collapses to prefetch + async dispatch)."""
+
+    def __init__(self, executor, program, worker=None):
+        self._exe = executor
+        self._program = program
+        self._worker = worker or Hogwild()
+        self._worker._set_program(program)
+
+    def run(self, dataset, fetch_list=None, fetch_info=None,
+            print_period=100, debug=False, scope=None):
+        import numpy as np
+        fetch_list = list(fetch_list or [])
+        fetch_info = list(fetch_info or
+                          [getattr(f, "name", str(f)) for f in fetch_list])
+        step = 0
+        last = []
+        it = PrefetchIterator(iter(dataset))
+        try:
+            for batch in it:
+                last = self._exe.run(self._program, feed=batch,
+                                     fetch_list=fetch_list, scope=scope)
+                step += 1
+                if debug and fetch_list and step % print_period == 0:
+                    # formatting syncs the async fetch values — the only
+                    # host/device sync point in the loop
+                    print("step %d: %s" % (step, ", ".join(
+                        "%s=%s" % (info, np.asarray(v).ravel()[:4])
+                        for info, v in zip(fetch_info, last))))
+        finally:
+            it.close()
+        return step, last
+
+
+class DistMultiTrainer(MultiTrainer):
+    """Distributed variant: same loop; the mesh/collectives inside the
+    compiled step (CompiledProgram shardings) replace the reference's
+    trainer-side communicator."""
+
+
+class TrainerFactory(object):
+    def _create_trainer(self, opt_info=None):
+        if opt_info and opt_info.get("trainer") == "DistMultiTrainer":
+            return DistMultiTrainer
+        return MultiTrainer
