@@ -52,8 +52,8 @@ def _bwd_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qi, kj, *,
     k = k_ref[0].astype(jnp.float32)            # (BK, D)
     v = v_ref[0].astype(jnp.float32)
     do = do_ref[0].astype(jnp.float32)          # (BQ, D)
-    lse = lse_ref[0].astype(jnp.float32)        # (BQ,)
-    delta = delta_ref[0].astype(jnp.float32)    # (BQ,)
+    lse = lse_ref[0, 0].astype(jnp.float32)     # (BQ,) — row 0 is real
+    delta = delta_ref[0, 0].astype(jnp.float32)
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * scale  # (BQ, BK)
@@ -124,10 +124,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, acc_ref,
     def _finalize():
         o_ref[0] = (acc_ref[:] /
                     jnp.maximum(l_ref[:, :1], 1e-30)).astype(o_ref.dtype)
-        # logsumexp residual for the Pallas backward: lse = m + log(l)
-        lse_ref[0] = (m_ref[:, 0] +
-                      jnp.log(jnp.maximum(l_ref[:, 0], 1e-30))).astype(
-                          lse_ref.dtype)
+        # logsumexp residual for the Pallas backward: lse = m + log(l).
+        # Stored with a sublane dim of 8 — Mosaic requires block last-two
+        # dims divisible by (8, 128); row 0 is the real data
+        lse = m_ref[:, 0] + jnp.log(jnp.maximum(l_ref[:, 0], 1e-30))
+        lse_ref[0] = jnp.broadcast_to(lse[None, :],
+                                      lse_ref.shape[1:]).astype(lse_ref.dtype)
 
 
 def _pallas_forward(q, k, v, mask, scale, causal, block_q, block_k,
@@ -182,16 +184,16 @@ def _pallas_forward(q, k, v, mask, scale, causal, block_q, block_k,
         in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda bb, i, j: (bb, i, 0)),
-            pl.BlockSpec((1, block_q), lambda bb, i, j: (bb, i)),
+            pl.BlockSpec((1, 8, block_q), lambda bb, i, j: (bb, 0, i)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, tq), jnp.float32),
+            jax.ShapeDtypeStruct((bh, 8, tq), jnp.float32),
         ],
         scratch_shapes=scratch,
         interpret=interpret,
     )(q3, k3, v3, mask_in)
-    return out.reshape(b, h, tq, d), lse.reshape(b, h, tq)
+    return out.reshape(b, h, tq, d), lse[:, 0, :].reshape(b, h, tq)
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
@@ -277,10 +279,12 @@ def _pallas_backward(q, k, v, out, lse, g, scale, causal, block_q, block_k,
     k3 = k.reshape(bh, tk, d)
     v3 = v.reshape(bh, tk, d)
     do3 = g.reshape(bh, tq, d)
-    lse3 = lse.reshape(bh, tq)
+    # lse/delta carry a sublane dim of 8 for Mosaic block alignment
+    lse3 = jnp.broadcast_to(lse.reshape(bh, 1, tq), (bh, 8, tq))
     # delta = rowsum(dO * O): cheap elementwise pass in XLA
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
-                    axis=-1).reshape(bh, tq)
+                    axis=-1).reshape(bh, 1, tq)
+    delta = jnp.broadcast_to(delta, (bh, 8, tq))
 
     common = dict(scale=scale, causal=causal, causal_offset=tk - tq,
                   block_q=block_q, block_k=block_k)
@@ -289,8 +293,8 @@ def _pallas_backward(q, k, v, out, lse, g, scale, causal, block_q, block_k,
         pl.BlockSpec((1, block_k, d), lambda bb, j, i: (bb, j, 0)),   # k
         pl.BlockSpec((1, block_k, d), lambda bb, j, i: (bb, j, 0)),   # v
         pl.BlockSpec((1, block_q, d), lambda bb, j, i: (bb, i, 0)),   # do
-        pl.BlockSpec((1, block_q), lambda bb, j, i: (bb, i)),         # lse
-        pl.BlockSpec((1, block_q), lambda bb, j, i: (bb, i)),         # delta
+        pl.BlockSpec((1, 8, block_q), lambda bb, j, i: (bb, 0, i)),   # lse
+        pl.BlockSpec((1, 8, block_q), lambda bb, j, i: (bb, 0, i)),   # delta
     ]
     dk3, dv3 = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, **common),
@@ -316,8 +320,8 @@ def _pallas_backward(q, k, v, out, lse, g, scale, causal, block_q, block_k,
         pl.BlockSpec((1, block_k, d), lambda bb, i, j: (bb, j, 0)),
         pl.BlockSpec((1, block_k, d), lambda bb, i, j: (bb, j, 0)),
         pl.BlockSpec((1, block_q, d), lambda bb, i, j: (bb, i, 0)),
-        pl.BlockSpec((1, block_q), lambda bb, i, j: (bb, i)),
-        pl.BlockSpec((1, block_q), lambda bb, i, j: (bb, i)),
+        pl.BlockSpec((1, 8, block_q), lambda bb, i, j: (bb, 0, i)),
+        pl.BlockSpec((1, 8, block_q), lambda bb, i, j: (bb, 0, i)),
     ]
     dq3 = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, **common),
