@@ -251,6 +251,13 @@ def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
     from .tensor import concat
     from .nn import pad as _pad
     t = input.shape[1]
+    seq_mask = None
+    if lengths is not None:
+        # zero the pad region first: shifted windows near the end of each
+        # row's valid prefix would otherwise pull in whatever garbage sits
+        # past its length (the output mask below can't undo that).
+        seq_mask = sequence_mask(lengths, maxlen=t, dtype=dtype)
+        input = elementwise_mul(input, unsqueeze(seq_mask, [2]))
     for k in range(filter_size):
         off = padding_start + k
         if off == 0:
@@ -266,9 +273,8 @@ def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
     out = matmul(windows, w)
     pre_act = helper.append_bias_op(out, dim_start=2)
     res = helper.append_activation(pre_act)
-    if lengths is not None:
-        mask = sequence_mask(lengths, maxlen=t, dtype=res.dtype)
-        res = elementwise_mul(res, unsqueeze(mask, [2]))
+    if seq_mask is not None:
+        res = elementwise_mul(res, unsqueeze(seq_mask, [2]))
     return res
 
 

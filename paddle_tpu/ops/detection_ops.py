@@ -652,6 +652,7 @@ def _generate_proposals(ctx, ins, attrs):
     post_n = int(attrs.get("post_nms_topN", 1000))
     iou_th = float(attrs.get("nms_thresh", 0.5))
     min_size = float(attrs.get("min_size", 0.1))
+    eta = float(attrs.get("eta", 1.0))
     n, a, h, w = scores.shape
     total = a * h * w
     pre_n = min(pre_n, total)
@@ -668,8 +669,9 @@ def _generate_proposals(ctx, ins, attrs):
         acy = anc[:, 1] + ah_ * 0.5
         cx = vr[:, 0] * dl[:, 0] * aw + acx
         cy = vr[:, 1] * dl[:, 1] * ah_ + acy
-        bw = jnp.exp(jnp.minimum(vr[:, 2] * dl[:, 2], 10.0)) * aw
-        bh = jnp.exp(jnp.minimum(vr[:, 3] * dl[:, 3], 10.0)) * ah_
+        clip = math.log(1000.0 / 16.0)   # kBBoxClipDefault
+        bw = jnp.exp(jnp.minimum(vr[:, 2] * dl[:, 2], clip)) * aw
+        bh = jnp.exp(jnp.minimum(vr[:, 3] * dl[:, 3], clip)) * ah_
         props = jnp.stack([cx - bw / 2, cy - bh / 2,
                            cx + bw / 2 - 1, cy + bh / 2 - 1], -1)
         hmax = info[0] / info[2] - 1.0
@@ -684,7 +686,7 @@ def _generate_proposals(ctx, ins, attrs):
         sc = jnp.where(keep, sc, -jnp.inf)
         top_s, idx = jax.lax.top_k(sc, pre_n)
         pb = props[idx]
-        alive = _nms_alive(pb, top_s, iou_th)
+        alive = _nms_alive(pb, top_s, iou_th, nms_eta=eta)
         final = jnp.where(alive, top_s, -jnp.inf)
         out_s, oidx = jax.lax.top_k(final, post_n)
         ob = pb[oidx]

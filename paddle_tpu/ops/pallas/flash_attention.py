@@ -9,8 +9,9 @@ scratch persisting across the (innermost, sequential) k-block dimension;
 emits a logsumexp residual alongside the output.
 Backward: Pallas dK/dV and dQ kernels that recompute p = exp(s - lse)
 per tile from the saved (out, lse) residuals — flash-attention-2 style, no
-(T,T) matrix in HBM in either direction. The additive-mask path keeps the
-exact XLA vjp (it must also produce the mask cotangent for learned biases).
+(T,T) matrix in HBM in either direction, with the additive mask applied
+in-kernel. The mask cotangent (needed only for learned biases) is a
+separate XLA expression that DCEs away when unused.
 
 Layout contract: q, k, v are (B, H, T, D); additive mask broadcastable
 (B, 1, 1, Tk) or (B, 1, Tq, Tk). On CPU (tests) the kernel runs in
@@ -43,8 +44,9 @@ def _causal_keep(qi, kj, causal_offset, block_q, block_k):
     return q_pos + causal_offset >= k_pos
 
 
-def _bwd_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qi, kj, *,
-              scale, causal, causal_offset, block_q, block_k):
+def _bwd_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
+              qi, kj, *, scale, causal, causal_offset, block_q, block_k,
+              mask_mode):
     """Recompute the probability tile p = exp(s - lse) and the logit
     cotangent ds = p * (dO V^T - delta) from the forward residuals —
     the shared core of both backward kernels."""
@@ -57,6 +59,10 @@ def _bwd_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qi, kj, *,
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * scale  # (BQ, BK)
+    if mask_mode == "qk":
+        s = s + mask_ref[0, 0].astype(jnp.float32)
+    elif mask_mode == "k":
+        s = s + mask_ref[0, 0, 0][None, :].astype(jnp.float32)
     p = jnp.exp(s - lse[:, None])
     if causal:
         p = jnp.where(_causal_keep(qi, kj, causal_offset, block_q,
@@ -132,6 +138,31 @@ def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, acc_ref,
                                       lse_ref.shape[1:]).astype(lse_ref.dtype)
 
 
+def _mask_spec(mask, h, q_dtype, block_q, block_k, kj_innermost):
+    """(mask_mode, mask_input, BlockSpec) for an additive mask broadcastable
+    (B,1,1,Tk) ["k" mode] or (B,1,Tq,Tk) ["qk"]. Grid index order is
+    (bh, i, j) for the forward/dQ kernels (kj_innermost) and (bh, j, i)
+    for dK/dV."""
+    if mask is None:
+        return "none", jnp.zeros((1, 1, 1, 1), q_dtype), pl.BlockSpec(
+            (1, 1, 1, 1), lambda bb, a, b_: (0, 0, 0, 0))
+    if mask.shape[2] == 1:
+        if kj_innermost:
+            def _idx(bb, i, j, hh=h):
+                return (bb // hh, 0, 0, j)
+        else:
+            def _idx(bb, j, i, hh=h):
+                return (bb // hh, 0, 0, j)
+        return "k", mask, pl.BlockSpec((1, 1, 1, block_k), _idx)
+    if kj_innermost:
+        def _idx(bb, i, j, hh=h):
+            return (bb // hh, 0, i, j)
+    else:
+        def _idx(bb, j, i, hh=h):
+            return (bb // hh, 0, i, j)
+    return "qk", mask, pl.BlockSpec((1, 1, block_q, block_k), _idx)
+
+
 def _pallas_forward(q, k, v, mask, scale, causal, block_q, block_k,
                     interpret):
     if not _HAS_TPU_PALLAS:
@@ -149,26 +180,9 @@ def _pallas_forward(q, k, v, mask, scale, causal, block_q, block_k,
         pl.BlockSpec((1, block_k, d), lambda bb, i, j: (bb, j, 0)),
         pl.BlockSpec((1, block_k, d), lambda bb, i, j: (bb, j, 0)),
     ]
-    if mask is None:
-        mask_mode = "none"
-        mask_in = jnp.zeros((1, 1, 1, 1), q.dtype)
-        in_specs.append(pl.BlockSpec((1, 1, 1, 1),
-                                     lambda bb, i, j: (0, 0, 0, 0)))
-    elif mask.shape[2] == 1:
-        mask_mode = "k"
-        mask_in = mask
-
-        def _mask_idx_k(bb, i, j, hh=h):
-            return (bb // hh, 0, 0, j)
-        in_specs.append(pl.BlockSpec((1, 1, 1, block_k), _mask_idx_k))
-    else:
-        mask_mode = "qk"
-        mask_in = mask
-
-        def _mask_idx_qk(bb, i, j, hh=h):
-            return (bb // hh, 0, i, j)
-        in_specs.append(pl.BlockSpec((1, 1, block_q, block_k),
-                                     _mask_idx_qk))
+    mask_mode, mask_in, mask_spec = _mask_spec(mask, h, q.dtype, block_q,
+                                               block_k, kj_innermost=True)
+    in_specs.append(mask_spec)
 
     scratch = [
         pltpu.VMEM((block_q, d), jnp.float32),
@@ -197,8 +211,9 @@ def _pallas_forward(q, k, v, mask, scale, causal, block_q, block_k,
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, dk_acc, dv_acc,
-                    *, scale, causal, causal_offset, block_q, block_k):
+                    mask_ref, dk_ref, dv_ref, dk_acc, dv_acc,
+                    *, scale, causal, causal_offset, block_q, block_k,
+                    mask_mode):
     """dK/dV for one k-block, accumulating over q-blocks (innermost grid
     dim). Recomputes p = exp(s - lse) from residuals — no (T,T) in HBM."""
     kj = pl.program_id(1)
@@ -212,9 +227,10 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     def body():
         q, _, do, p, ds = _bwd_p_ds(
-            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qi, kj,
-            scale=scale, causal=causal, causal_offset=causal_offset,
-            block_q=block_q, block_k=block_k)
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
+            qi, kj, scale=scale, causal=causal,
+            causal_offset=causal_offset, block_q=block_q,
+            block_k=block_k, mask_mode=mask_mode)
         # dv += p^T dO ; dk += scale * ds^T q
         dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
@@ -238,8 +254,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                   dq_ref, dq_acc, *, scale, causal, causal_offset,
-                   block_q, block_k):
+                   mask_ref, dq_ref, dq_acc, *, scale, causal,
+                   causal_offset, block_q, block_k, mask_mode):
     qi = pl.program_id(1)
     kj = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -250,9 +266,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     def body():
         _, k, _, _, ds = _bwd_p_ds(
-            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qi, kj,
-            scale=scale, causal=causal, causal_offset=causal_offset,
-            block_q=block_q, block_k=block_k)
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
+            qi, kj, scale=scale, causal=causal,
+            causal_offset=causal_offset, block_q=block_q,
+            block_k=block_k, mask_mode=mask_mode)
         dq_acc[:] = dq_acc[:] + scale * jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -270,8 +287,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
 
 
-def _pallas_backward(q, k, v, out, lse, g, scale, causal, block_q, block_k,
-                     interpret):
+def _pallas_backward(q, k, v, mask, out, lse, g, scale, causal, block_q,
+                     block_k, interpret):
     b, h, tq, d = q.shape
     tk = k.shape[2]
     bh = b * h
@@ -286,8 +303,10 @@ def _pallas_backward(q, k, v, out, lse, g, scale, causal, block_q, block_k,
                     axis=-1).reshape(bh, 1, tq)
     delta = jnp.broadcast_to(delta, (bh, 8, tq))
 
+    mask_mode, mask_in, dkv_mask_spec = _mask_spec(
+        mask, h, q.dtype, block_q, block_k, kj_innermost=False)
     common = dict(scale=scale, causal=causal, causal_offset=tk - tq,
-                  block_q=block_q, block_k=block_k)
+                  block_q=block_q, block_k=block_k, mask_mode=mask_mode)
     dkv_specs = [
         pl.BlockSpec((1, block_q, d), lambda bb, j, i: (bb, i, 0)),   # q
         pl.BlockSpec((1, block_k, d), lambda bb, j, i: (bb, j, 0)),   # k
@@ -295,6 +314,7 @@ def _pallas_backward(q, k, v, out, lse, g, scale, causal, block_q, block_k,
         pl.BlockSpec((1, block_q, d), lambda bb, j, i: (bb, i, 0)),   # do
         pl.BlockSpec((1, 8, block_q), lambda bb, j, i: (bb, 0, i)),   # lse
         pl.BlockSpec((1, 8, block_q), lambda bb, j, i: (bb, 0, i)),   # delta
+        dkv_mask_spec,
     ]
     dk3, dv3 = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, **common),
@@ -313,8 +333,10 @@ def _pallas_backward(q, k, v, out, lse, g, scale, causal, block_q, block_k,
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
         interpret=interpret,
-    )(q3, k3, v3, do3, lse3, delta)
+    )(q3, k3, v3, do3, lse3, delta, mask_in)
 
+    _, _, dq_mask_spec = _mask_spec(mask, h, q.dtype, block_q, block_k,
+                                    kj_innermost=True)
     dq_specs = [
         pl.BlockSpec((1, block_q, d), lambda bb, i, j: (bb, i, 0)),
         pl.BlockSpec((1, block_k, d), lambda bb, i, j: (bb, j, 0)),
@@ -322,6 +344,7 @@ def _pallas_backward(q, k, v, out, lse, g, scale, causal, block_q, block_k,
         pl.BlockSpec((1, block_q, d), lambda bb, i, j: (bb, i, 0)),
         pl.BlockSpec((1, 8, block_q), lambda bb, i, j: (bb, 0, i)),
         pl.BlockSpec((1, 8, block_q), lambda bb, i, j: (bb, 0, i)),
+        dq_mask_spec,
     ]
     dq3 = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, **common),
@@ -331,7 +354,7 @@ def _pallas_backward(q, k, v, out, lse, g, scale, causal, block_q, block_k,
         out_shape=jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
-    )(q3, k3, v3, do3, lse3, delta)
+    )(q3, k3, v3, do3, lse3, delta, mask_in)
 
     return (dq3.reshape(b, h, tq, d), dk3.reshape(b, h, tk, d),
             dv3.reshape(b, h, tk, d))
@@ -360,30 +383,41 @@ def _flash(q, k, v, mask, scale, causal, block_q, block_k, interpret):
 def _flash_fwd(q, k, v, mask, scale, causal, block_q, block_k, interpret):
     out, lse = _pallas_forward(q, k, v, mask, scale, causal, block_q,
                                block_k, interpret)
-    if mask is not None:
-        # masked path backprops via XLA vjp from (q,k,v,mask) only — don't
-        # pin an extra (B,H,T,D) out tensor in HBM until the backward
-        return out, (q, k, v, mask, None, None)
     return out, (q, k, v, mask, out, lse)
+
+
+def _xla_dmask(q, k, v, mask, out, lse, g, scale, causal):
+    """Mask cotangent via the straight softmax-backward formula. This DOES
+    materialize (B,H,Tq,Tk) — but it is emitted as a standalone expression,
+    so when the mask grad is unused (padding masks, the BERT/ERNIE case)
+    XLA dead-code-eliminates it and only the Pallas kernels remain."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    s = s + mask.astype(jnp.float32)
+    p = jnp.exp(s - lse[..., None])
+    if causal:
+        tq, tk = s.shape[-2], s.shape[-1]
+        p = jnp.where(jnp.tril(jnp.ones((tq, tk), bool), tk - tq), p, 0.0)
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)
+    dp = jnp.einsum("bhqd,bhkd->bhqk", g.astype(jnp.float32),
+                    v.astype(jnp.float32))
+    ds = p * (dp - delta[..., None])
+    reduce_axes = tuple(ax for ax in range(4)
+                        if mask.shape[ax] == 1 and ds.shape[ax] > 1)
+    return jnp.sum(ds, axis=reduce_axes, keepdims=True).astype(mask.dtype)
 
 
 def _flash_bwd(scale, causal, block_q, block_k, interpret, res, g):
     q, k, v, mask, out, lse = res
-
+    # Pallas backward: recompute p from (lse, delta) residuals with the
+    # mask applied in-kernel — the (T,T) matrix never touches HBM for
+    # dq/dk/dv in either direction
+    dq, dk, dv = _pallas_backward(q, k, v, mask, out, lse, g, scale,
+                                  causal, block_q, block_k, interpret)
     if mask is None:
-        # Pallas backward: recompute p from (lse, delta) residuals — the
-        # (T,T) matrix never touches HBM in either direction
-        dq, dk, dv = _pallas_backward(q, k, v, out, lse, g, scale, causal,
-                                      block_q, block_k, interpret)
         return dq, dk, dv, None
-
-    # masked path: exact XLA vjp (also produces the mask cotangent, which
-    # learned additive biases like T5 rel-pos need)
-    def f(q, k, v, mask):
-        return _xla_attention(q, k, v, mask, scale, causal)
-
-    _, vjp = jax.vjp(f, q, k, v, mask)
-    dq, dk, dv, dmask = vjp(g)
+    dmask = _xla_dmask(q, k, v, mask, out, lse, g, scale, causal)
     return dq, dk, dv, dmask
 
 
@@ -412,6 +446,11 @@ def flash_attention(q, k, v, mask=None, scale=1.0, causal=False,
     while tk % bk:
         bk //= 2
     if bq < 8 or bk < 8 or q.shape[-1] % 8:
+        return _xla_attention(q, k, v, mask, scale, causal)
+    if not interpret and (bq < 128 or bk < 128):
+        # Mosaic wants the last-two block dims 128-lane aligned (the lse
+        # block puts block_q on the lane dim); sub-128 tiles are only
+        # exercised in interpret mode — on device route them to XLA.
         return _xla_attention(q, k, v, mask, scale, causal)
     return _flash(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
                   None if mask is None else jnp.asarray(mask),

@@ -152,6 +152,32 @@ def test_sequence_conv_trains_and_masks():
     assert l1 < float(l0)
 
 
+def test_sequence_conv_pad_region_does_not_leak():
+    """Garbage past each row's length must not bleed into valid outputs
+    through the context window (input is masked before im2col)."""
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", (6, 4), "float32")
+        lens = layers.data("len", (1,), "int32")
+        lens1 = layers.reshape(lens, shape=[-1])
+        conv = seq.sequence_conv(x, num_filters=3, filter_size=3,
+                                 lengths=lens1)
+    exe = pt.Executor()
+    exe.run(startup)
+    rng = np.random.RandomState(1)
+    base = rng.rand(2, 6, 4).astype(np.float32)
+    lens_v = np.array([[4], [6]], np.int32)
+    clean = base.copy()
+    clean[0, 4:] = 0.0
+    dirty = base.copy()
+    dirty[0, 4:] = 1e6  # garbage in the pad region
+    o_clean = exe.run(main, feed={"x": clean, "len": lens_v},
+                      fetch_list=[conv])[0]
+    o_dirty = exe.run(main, feed={"x": dirty, "len": lens_v},
+                      fetch_list=[conv])[0]
+    np.testing.assert_allclose(o_dirty, o_clean, rtol=1e-6, atol=1e-6)
+
+
 def test_sequence_reshape_layer():
     main, startup = pt.Program(), pt.Program()
     with pt.program_guard(main, startup):
