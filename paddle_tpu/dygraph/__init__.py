@@ -6,7 +6,7 @@ JAX IS the tracer); Layer modules hold parameters; backward() uses jax.grad
 over the recorded functional call.
 """
 from .base import guard, enabled, to_variable, no_grad, enable_dygraph, \
-    disable_dygraph
+    disable_dygraph, reset_tape, pause_tape
 from .layers import Layer
 from .container import Sequential, LayerList, ParameterList
 from .nn import (Linear, Conv2D, BatchNorm, Embedding, LayerNorm, Dropout,

@@ -1,18 +1,158 @@
-"""Dygraph runtime: eager Variables on jax arrays.
+"""Dygraph runtime: eager Variables on jax arrays, with an op tape.
 
-Reference parity: dygraph/base.py + imperative/tracer.cc. The reference
-records ops on a tape for autograd; here eager math happens directly on
-jax.Arrays and gradients come from jax.grad over Layer.__call__ (see
-layers.py), so there is no tape to maintain.
+Reference parity: dygraph/base.py + imperative/tracer.cc. Like the
+reference's imperative tracer, every eager op records a tape node so
+``loss.backward(); opt.minimize(...)`` works verbatim — but each node
+stores the op's jax.vjp (JAX linearizes at execution time), so backward is
+a pure reverse walk calling stored vjps; no per-op grad kernels exist.
+The functional style (Layer.loss_and_grad / jax.grad over a functional
+forward) remains available and pauses the tape while tracing.
 """
 import contextlib
 import functools
+import weakref
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 
 _in_dygraph = [False]
 _no_grad_depth = [0]
+_tape_paused = [0]
+_tape = []
+# name -> EagerVariable, so static layer functions (which plumb var NAMES
+# through LayerHelper.append_op) can resolve eager values in dygraph mode
+_eager_registry = weakref.WeakValueDictionary()
+_name_counter = [0]
+
+
+def lookup_eager(name):
+    try:
+        return _eager_registry[name]
+    except KeyError:
+        raise KeyError(
+            "dygraph: no eager value named %r — if this is a parameter "
+            "from a static layer (fc/conv2d...), use the dygraph.nn "
+            "module equivalents under dygraph.guard" % (name,))
+
+
+class _TapeNode(object):
+    """Outputs are held WEAKLY: once every output of a node is garbage
+    (no user ref and no later node consumes it), backward can never reach
+    the node, so the periodic prune in record_node drops it — this keeps
+    forward-only (eval) loops from growing the tape without bound."""
+    __slots__ = ("vjp_fn", "in_vars", "out_refs", "out_meta")
+
+    def __init__(self, vjp_fn, in_vars, out_vars):
+        self.vjp_fn = vjp_fn        # cotangents(outs) -> grads aligned
+        self.in_vars = in_vars      # [EagerVariable] aligned with vjp grads
+        self.out_refs = [weakref.ref(v) for v in out_vars]
+        self.out_meta = [(v._value.shape, v._value.dtype)
+                         for v in out_vars]
+
+    def live(self):
+        return any(r() is not None for r in self.out_refs)
+
+
+_last_prune_size = [256]
+
+
+def record_node(vjp_fn, in_vars, out_vars):
+    _tape.append(_TapeNode(vjp_fn, in_vars, out_vars))
+    if len(_tape) >= 2 * _last_prune_size[0]:
+        _tape[:] = [n for n in _tape if n.live()]
+        _last_prune_size[0] = max(256, len(_tape))
+
+
+@contextlib.contextmanager
+def pause_tape():
+    """Disable tape recording (used inside functional jax traces — the
+    trace IS the autodiff there, and tracer values must not leak onto the
+    global tape)."""
+    _tape_paused[0] += 1
+    try:
+        yield
+    finally:
+        _tape_paused[0] -= 1
+
+
+def tape_active():
+    return (_in_dygraph[0] and not _tape_paused[0]
+            and not _no_grad_depth[0])
+
+
+def reset_tape():
+    del _tape[:]
+
+
+def _should_record(eager_inputs):
+    if not tape_active():
+        return False
+    for v in eager_inputs:
+        if isinstance(v._value, jax.core.Tracer):
+            return False  # inside someone else's functional trace
+    return any(not v.stop_gradient for v in eager_inputs)
+
+
+def apply_eager(fn, *eager_inputs):
+    """Run fn(*raw_values) eagerly; record a tape node when grads may be
+    needed. fn returns one raw array or a tuple; returns EagerVariable(s)
+    correspondingly."""
+    vals = [v._value for v in eager_inputs]
+    if not _should_record(eager_inputs):
+        out = fn(*vals)
+        if isinstance(out, tuple):
+            return tuple(EagerVariable(o) for o in out)
+        return EagerVariable(out)
+    single = [False]
+
+    def tupled(*a):
+        out = fn(*a)
+        if not isinstance(out, tuple):
+            single[0] = True
+            return (out,)
+        return out
+
+    outs, vjp_fn = jax.vjp(tupled, *vals)
+    out_vars = tuple(EagerVariable(o) for o in outs)
+    record_node(vjp_fn, list(eager_inputs), list(out_vars))
+    return out_vars[0] if single[0] else out_vars
+
+
+def _zero_cot(shape, dtype):
+    if jnp.issubdtype(dtype, jnp.inexact):
+        return jnp.zeros(shape, dtype)
+    return np.zeros(shape, jax.dtypes.float0)
+
+
+def _backward_from(root, retain_graph=False):
+    """Reverse tape walk from ``root`` (scalar or any-shape: seeded with
+    ones, as the reference does for non-scalar backward)."""
+    cot = {id(root): jnp.ones_like(root._value)}
+    keep = {id(root): root}
+    for node in reversed(_tape):
+        outs = [r() for r in node.out_refs]
+        if not any(v is not None and id(v) in cot for v in outs):
+            continue
+        out_cots = tuple(
+            cot[id(v)] if (v is not None and id(v) in cot)
+            else _zero_cot(shape, dtype)
+            for v, (shape, dtype) in zip(outs, node.out_meta))
+        grads = node.vjp_fn(out_cots)
+        for var, g in zip(node.in_vars, grads):
+            if g is None or (hasattr(g, "dtype")
+                             and g.dtype == jax.dtypes.float0):
+                continue
+            if var.stop_gradient:
+                continue
+            prev = cot.get(id(var))
+            cot[id(var)] = g if prev is None else prev + g
+            keep[id(var)] = var
+    for vid, var in keep.items():
+        g = cot[vid]
+        var._grad = g if var._grad is None else var._grad + g
+    if not retain_graph:
+        reset_tape()
 
 
 def enabled():
@@ -35,6 +175,8 @@ def guard(place=None):
         yield
     finally:
         _in_dygraph[0] = old
+        if not old:
+            reset_tape()
 
 
 class EagerVariable(object):
@@ -42,10 +184,21 @@ class EagerVariable(object):
     Variable surface (numpy(), backward(), gradient())."""
 
     def __init__(self, value, name=None, stop_gradient=False):
-        self._value = jnp.asarray(value)
-        self.name = name or "eager_var"
+        self._value = None if value is None else jnp.asarray(value)
+        if name is None:
+            _name_counter[0] += 1
+            name = "eager_var_%d" % _name_counter[0]
+        elif name in _eager_registry:
+            # user-supplied duplicate: uniquify so name-based op dispatch
+            # (LayerHelper eager path) can never resolve to the wrong var
+            base, n = name, 1
+            while name in _eager_registry:
+                n += 1
+                name = "%s_%d" % (base, n)
+        self.name = name
         self.stop_gradient = stop_gradient
         self._grad = None
+        _eager_registry[name] = self
 
     # value plumbing -------------------------------------------------------
     @property
@@ -65,7 +218,8 @@ class EagerVariable(object):
 
     def astype(self, dtype):
         from ..framework.dtypes import to_jax_dtype
-        return EagerVariable(self._value.astype(to_jax_dtype(dtype)))
+        return apply_eager(
+            lambda x: x.astype(to_jax_dtype(dtype)), self)
 
     def detach(self):
         return EagerVariable(self._value, stop_gradient=True)
@@ -73,16 +227,20 @@ class EagerVariable(object):
     def gradient(self):
         return None if self._grad is None else np.asarray(self._grad)
 
-    def backward(self, backward_strategy=None):
-        raise RuntimeError(
-            "paddle_tpu dygraph computes gradients functionally: use "
-            "dygraph.grad(loss_fn, layer) or Layer.backward helpers "
-            "(JAX autodiff replaces the reference's tape)")
+    def backward(self, backward_strategy=None, retain_graph=False):
+        """Tape backward (reference: imperative/tracer.cc Engine): fills
+        ``._grad`` on every reachable stop_gradient=False Variable, then
+        releases the tape."""
+        _backward_from(self, retain_graph=retain_graph)
+
+    def clear_gradient(self):
+        self._grad = None
 
     # operator sugar -------------------------------------------------------
     def _b(self, other, fn):
-        o = other._value if isinstance(other, EagerVariable) else other
-        return EagerVariable(fn(self._value, o))
+        if isinstance(other, EagerVariable):
+            return apply_eager(fn, self, other)
+        return apply_eager(lambda a: fn(a, other), self)
 
     def __add__(self, o):
         return self._b(o, jnp.add)
@@ -105,10 +263,10 @@ class EagerVariable(object):
         return self._b(o, jnp.matmul)
 
     def __neg__(self):
-        return EagerVariable(-self._value)
+        return apply_eager(jnp.negative, self)
 
     def __getitem__(self, idx):
-        return EagerVariable(self._value[idx])
+        return apply_eager(lambda x: x[idx], self)
 
     def __repr__(self):
         return "EagerVariable(%s, shape=%s)" % (self._value, self.shape)

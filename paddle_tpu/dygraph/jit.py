@@ -23,11 +23,13 @@ class TracedLayer(object):
         params = layer.parameters()
 
         def functional(param_vals, *raw):
+            from .base import pause_tape
             saved = [p._value for p in params]
             try:
-                for p, v in zip(params, param_vals):
-                    p._value = v
-                outs = layer.forward(*[to_variable(x) for x in raw])
+                with pause_tape():
+                    for p, v in zip(params, param_vals):
+                        p._value = v
+                    outs = layer.forward(*[to_variable(x) for x in raw])
             finally:
                 for p, v in zip(params, saved):
                     p._value = v

@@ -136,17 +136,22 @@ class Layer(object):
         vals = [p._value for p in params]
 
         def functional(vals_list, *raw_inputs):
-            for p, v in zip(params, vals_list):
-                p._value = v
-            outs = self.forward(*[to_variable(x) for x in raw_inputs])
-            loss = loss_fn(outs) if loss_fn is not None else outs
-            return loss._value.reshape(())
+            from .base import pause_tape
+            with pause_tape():
+                for p, v in zip(params, vals_list):
+                    p._value = v
+                outs = self.forward(*[to_variable(x) for x in raw_inputs])
+                loss = loss_fn(outs) if loss_fn is not None else outs
+                return loss._value.reshape(())
 
         raw = [x._value if isinstance(x, EagerVariable) else jnp.asarray(x)
                for x in inputs]
-        loss_val, grads = jax.value_and_grad(functional)(vals, *raw)
-        for p, v in zip(params, vals):
-            p._value = v
+        try:
+            loss_val, grads = jax.value_and_grad(functional)(vals, *raw)
+        finally:
+            # a trace-time failure must not leave tracers in p._value
+            for p, v in zip(params, vals):
+                p._value = v
         for p, g in zip(params, grads):
             p._grad = g
         return EagerVariable(loss_val), dict(zip(

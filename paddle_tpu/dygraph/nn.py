@@ -5,9 +5,10 @@ Embedding, LayerNorm, GRUUnit, Dropout ...). Forward math calls the SAME op
 kernels as graph mode (ops/*), eagerly.
 """
 import numpy as np
+import jax
 import jax.numpy as jnp
 
-from .base import EagerVariable
+from .base import EagerVariable, apply_eager
 from .layers import Layer
 from ..ops.registry import get_op
 
@@ -26,14 +27,76 @@ class _EagerCtx(object):
         return jax.random.fold_in(self._key, self._n)
 
 
-def run_op(op_type, ins, attrs=None, ctx=None):
-    """Eagerly run a registered kernel on EagerVariables/arrays."""
+def run_op(op_type, ins, attrs=None, ctx=None, out_binding=None):
+    """Eagerly run a registered kernel on EagerVariables/arrays, recording
+    a tape node (reference: imperative tracer TraceOp) so .backward()
+    reaches through it. Differentiable slots follow the registry's nondiff
+    metadata — the same partition the static trace engine uses.
+    out_binding: {slot: [EagerVariable]} — bind results onto existing
+    placeholder variables (the LayerHelper eager path) instead of
+    allocating fresh ones."""
+    from .base import _should_record, _tape, _TapeNode
     kernel = get_op(op_type)
-    jins = {k: [v._value if isinstance(v, EagerVariable) else jnp.asarray(v)
-                for v in vs] for k, vs in ins.items()}
-    outs = kernel.fn(ctx or _EagerCtx(), jins, attrs or {})
-    return {k: ([EagerVariable(x) for x in v] if isinstance(v, (list, tuple))
-                else EagerVariable(v)) for k, v in outs.items()}
+    evs = {k: [v if isinstance(v, EagerVariable)
+               else EagerVariable(v, stop_gradient=True) for v in vs]
+           for k, vs in ins.items()}
+    jins = {k: [v._value for v in vs] for k, vs in evs.items()}
+    attrs = attrs or {}
+    ctx = ctx or _EagerCtx()
+
+    flat_vars = []
+    flat_slots = []
+    for slot in sorted(evs):
+        if slot in kernel.nondiff:
+            continue
+        for i, v in enumerate(evs[slot]):
+            flat_vars.append(v)
+            flat_slots.append((slot, i))
+
+    def _bindvar(k, i, raw):
+        bound = (out_binding or {}).get(k)
+        if bound is not None and i < len(bound):
+            bound[i]._value = raw
+            return bound[i]
+        return EagerVariable(raw)
+
+    def _wrap(outs, listy):
+        return {k: ([_bindvar(k, i, x) for i, x in enumerate(v)]
+                    if listy[k] else _bindvar(k, 0, v[0]))
+                for k, v in outs.items()}
+
+    listy = {}
+
+    def pure(*flat_vals):
+        ins2 = {k: list(vs) for k, vs in jins.items()}
+        for (slot, i), v in zip(flat_slots, flat_vals):
+            ins2[slot][i] = v
+        outs = kernel.fn(ctx, ins2, attrs)
+        for k, v in outs.items():
+            listy[k] = isinstance(v, (list, tuple))
+        return {k: (list(v) if isinstance(v, (list, tuple)) else [v])
+                for k, v in outs.items()}
+
+    if not (kernel.differentiable and _should_record(flat_vars)):
+        outs = pure(*[v._value for v in flat_vars])
+        return _wrap(outs, listy)
+
+    outs, vjp_fn = jax.vjp(pure, *[v._value for v in flat_vars])
+    wrapped = _wrap(outs, listy)
+    out_vars = []
+    for k in sorted(outs):
+        vs = wrapped[k]
+        out_vars.extend(vs if isinstance(vs, list) else [vs])
+
+    def dict_vjp(out_cots, _keys=sorted(outs),
+                 _shapes={k: len(outs[k]) for k in outs}):
+        # re-nest the flat cotangent list to the dict-of-lists structure
+        it = iter(out_cots)
+        cot = {k: [next(it) for _ in range(_shapes[k])] for k in _keys}
+        return vjp_fn(cot)
+
+    _tape.append(_TapeNode(dict_vjp, flat_vars, out_vars))
+    return wrapped
 
 
 class Linear(Layer):
@@ -49,8 +112,8 @@ class Linear(Layer):
         self._act = act
 
     def forward(self, input):
-        out = EagerVariable(jnp.matmul(input._value, self.weight._value)
-                            + self.bias._value)
+        out = apply_eager(lambda x, w, b: jnp.matmul(x, w) + b,
+                          input, self.weight, self.bias)
         if self._act:
             out = run_op(self._act, {"X": [out]})["Out"]
         return out
@@ -82,8 +145,8 @@ class Conv2D(Layer):
     def forward(self, input):
         out = run_op("conv2d", {"Input": [input], "Filter": [self.weight]},
                      self._attrs)["Output"]
-        out = EagerVariable(out._value +
-                            self.bias._value.reshape(1, -1, 1, 1))
+        out = apply_eager(lambda o, b: o + b.reshape(1, -1, 1, 1),
+                          out, self.bias)
         if self._act:
             out = run_op(self._act, {"X": [out]})["Out"]
         return out

@@ -40,11 +40,25 @@ class DygraphOptimizer(object):
     def _apply_outs(self, p, slots, outs):
         raise NotImplementedError
 
-    def minimize(self, layer_or_params, grads=None):
-        """minimize(layer) after layer.loss_and_grad(...), or
-        minimize(params, grads_dict)."""
-        params = layer_or_params.parameters() \
-            if hasattr(layer_or_params, "parameters") else layer_or_params
+    def minimize(self, layer_or_loss=None, startup_program=None,
+                 parameter_list=None, no_grad_set=None, grads=None):
+        """Positional layout follows fluid's dygraph signature
+        minimize(loss, startup_program, parameter_list, no_grad_set):
+        minimize(loss_var) after loss.backward() with parameter_list from
+        the constructor or this call; minimize(layer) after
+        layer.loss_and_grad(...); or minimize(params, grads=grads_dict)."""
+        from .base import EagerVariable
+        if hasattr(layer_or_loss, "parameters"):
+            params = layer_or_loss.parameters()
+        elif isinstance(layer_or_loss, EagerVariable) or layer_or_loss is None:
+            params = parameter_list or self._params
+            if params is None:
+                raise ValueError(
+                    "minimize(loss) needs parameter_list — pass it to the "
+                    "optimizer constructor (fluid dygraph idiom) or to "
+                    "minimize()")
+        else:
+            params = layer_or_loss
         kernel = get_op(self._op).fn
         for p in params:
             g = p._grad if grads is None else grads.get(id(p))

@@ -56,13 +56,15 @@ class DataParallel(Layer):
         params = self._layers.parameters()
 
         def fn(param_vals, *raw):
+            from .base import pause_tape
             saved = [p._value for p in params]
             try:
-                for p, v in zip(params, param_vals):
-                    p._value = v
-                outs = self._layers.forward(
-                    *[to_variable(x) for x in raw])
-                loss = loss_fn(outs)
+                with pause_tape():
+                    for p, v in zip(params, param_vals):
+                        p._value = v
+                    outs = self._layers.forward(
+                        *[to_variable(x) for x in raw])
+                    loss = loss_fn(outs)
             finally:
                 for p, v in zip(params, saved):
                     p._value = v
