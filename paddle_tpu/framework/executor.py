@@ -16,6 +16,7 @@ fused ParallelExecutor graph, but compiler-driven.
 Programs with no fetch_list (e.g. the startup program) run eagerly op-by-op —
 initializers don't deserve a compile.
 """
+import contextlib
 import logging
 import warnings
 
@@ -71,8 +72,22 @@ def _uses_rng(program):
 
 class Executor(object):
     def __init__(self, place=None):
+        # Remember whether the caller chose the device. Only an EXPLICIT
+        # place may pin jax.default_device during execution — a defaulted
+        # Executor must respect an ambient jax.default_device(...) context
+        # (e.g. the multichip dryrun pinning everything to CPU while a TPU
+        # is attached); an unconditional inner pin would silently override
+        # the caller's outer pin.
+        self._explicit_place = place is not None
         self.place = place if place is not None else _current_expected_place()
         self._cache = {}
+
+    def _device_ctx(self):
+        """default_device context for execution: pin only when the user
+        picked a place; otherwise defer to the ambient default."""
+        if self._explicit_place:
+            return jax.default_device(self.place.jax_device())
+        return contextlib.nullcontext()
 
     def close(self):
         self._cache.clear()
@@ -243,10 +258,8 @@ class Executor(object):
             warnings.simplefilter("ignore")  # CPU ignores donation; fine.
             jitted = jax.jit(step, donate_argnums=(0,))
 
-        device = self.place.jax_device()
-
         def run_step(state_vals, feed_tuple):
-            with jax.default_device(device):
+            with self._device_ctx():
                 return jitted(state_vals, feed_tuple)
         return run_step
 
@@ -265,7 +278,7 @@ class Executor(object):
         base_key = jax.random.fold_in(
             jax.random.PRNGKey(program.random_seed), salt)
         ctx = TraceContext(program, base_key, _want_vjp_set(program))
-        with jax.default_device(self.place.jax_device()):
+        with self._device_ctx():
             trace_block(program.global_block(), env, ctx)
         for n in persistable:
             if n in env:

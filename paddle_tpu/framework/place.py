@@ -51,6 +51,15 @@ class DefaultPlace(Place):
 
 
 def _current_expected_place():
+    # An active jax.default_device(...) pin (config or context manager) is
+    # the caller's word on placement — honour it before consulting the
+    # process-global backend list, so code running inside e.g. a CPU-pinned
+    # dryrun never self-selects the attached TPU.
+    pinned = getattr(jax.config, "jax_default_device", None)
+    if pinned is not None:
+        if pinned.platform in ("tpu", "axon"):
+            return TPUPlace(getattr(pinned, "id", 0))
+        return CPUPlace()
     devs = jax.devices()
     if devs and devs[0].platform in ("tpu", "axon"):
         return TPUPlace(0)

@@ -174,11 +174,11 @@ class LayerHelper(object):
 
     # ---- bias / activation ----------------------------------------------
     def append_bias_op(self, input_var, dim_start=1, dim_end=None):
-        size = list(input_var.shape[dim_start:dim_end])
         bias_attr = self.bias_attr
         if bias_attr is False or bias_attr is None and \
                 self.kwargs.get("bias_attr") is False:
             return input_var
+        size = list(input_var.shape[dim_start:dim_end])
         b = self.create_parameter(bias_attr, shape=size,
                                   dtype=input_var.dtype, is_bias=True)
         if b is None:

@@ -11,6 +11,7 @@ from .learning_rate_scheduler import (  # noqa: F401
     noam_decay, exponential_decay, natural_exp_decay, inverse_time_decay,
     polynomial_decay, piecewise_decay, cosine_decay, linear_lr_warmup)
 from .sequence_lod import *  # noqa: F401,F403
+from .vision import *        # noqa: F401,F403
 from .rnn import *           # noqa: F401,F403
 from .attention import *     # noqa: F401,F403
 from .collective import *    # noqa: F401,F403

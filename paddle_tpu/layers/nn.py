@@ -330,8 +330,33 @@ def l2_normalize(x, axis, epsilon=1e-12, name=None):
 
 
 def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
-    raise NotImplementedError(
-        "spectral_norm lands with the GAN model family (SURVEY §2.4)")
+    """Ref nn.py:3156 / spectral_norm_op.h: weight / sigma_max via power
+    iteration; U and V iterates persist across steps (batch_norm-style
+    running state)."""
+    helper = LayerHelper("spectral_norm", name=name)
+    import math as _m
+    shape = weight.shape
+    perm_h = shape[dim]
+    perm_w = int(_m.prod(shape)) // perm_h
+    from ..framework import unique_name as _un
+    from ..initializer import NormalInitializer
+    u = helper.create_or_get_global_variable(
+        name=_un.generate(helper.name + ".u"), dtype="float32",
+        shape=(perm_h,), persistable=True)
+    helper.set_variable_initializer(u, NormalInitializer(0.0, 1.0))
+    v = helper.create_or_get_global_variable(
+        name=_un.generate(helper.name + ".v"), dtype="float32",
+        shape=(perm_w,), persistable=True)
+    helper.set_variable_initializer(v, NormalInitializer(0.0, 1.0))
+    out = helper.create_variable_for_type_inference(weight.dtype,
+                                                    weight.shape)
+    helper.append_op(
+        "spectral_norm",
+        inputs={"Weight": [weight.name], "U": [u.name], "V": [v.name]},
+        outputs={"Out": [out.name], "UOut": [u.name], "VOut": [v.name]},
+        attrs={"dim": int(dim), "power_iters": int(power_iters),
+               "eps": float(eps)})
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -821,8 +846,20 @@ def prelu(x, mode, param_attr=None, name=None):
     return elementwise_add(pos, neg)
 
 
-def embedding_bag(*a, **k):
-    raise NotImplementedError
+def embedding_bag(input, size, mode="sum", padding_idx=None,
+                  param_attr=None, dtype="float32"):
+    """Bagged embedding lookup: ids (N, bag) -> (N, D) reduced over the
+    bag axis. Composition of lookup_table + reduction; XLA fuses the
+    gather and the reduce into one pass."""
+    emb = embedding(input, size, padding_idx=padding_idx,
+                    param_attr=param_attr, dtype=dtype)   # (N, bag, D)
+    if mode == "sum":
+        return reduce_sum(emb, dim=1)
+    if mode == "mean":
+        return reduce_mean(emb, dim=1)
+    if mode == "max":
+        return reduce_max(emb, dim=1)
+    raise ValueError("embedding_bag mode must be sum/mean/max")
 
 
 def autoincreased_step_counter(counter_name=None, begin=1, step=1):

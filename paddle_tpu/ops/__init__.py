@@ -14,3 +14,5 @@ from . import attention_ops  # noqa: F401
 from . import collective_ops  # noqa: F401
 from . import sequence_ops  # noqa: F401
 from . import quant_ops     # noqa: F401
+from . import vision_ops    # noqa: F401
+from . import misc_ops      # noqa: F401

@@ -1,0 +1,284 @@
+"""Misc layer-tail kernels: multiplex, crop, cos_sim, bilinear tensor
+product, unique, mean_iou, chunk_eval, data_norm, spectral_norm.
+
+Reference parity: paddle/fluid/operators/{multiplex_op, crop_op,
+cos_sim_op, bilinear_tensor_product_op, unique_op, mean_iou_op,
+chunk_eval_op, data_norm_op, spectral_norm_op}. Reference kernels are
+Eigen/CUDA loops; these are vectorized jnp/lax programs (the chunk_eval
+segment extraction becomes a cummax scan; unique becomes a static-shape
+jnp.unique with a valid-count output since XLA has no dynamic shapes).
+"""
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op
+
+
+@register_op("multiplex", nondiff=("Ids",))
+def _multiplex(ctx, ins, attrs):
+    """out[i] = inputs[index[i]][i] (ref multiplex_op.h row gather)."""
+    xs = jnp.stack(ins["X"], axis=0)          # (K, N, D...)
+    ids = ins["Ids"][0].reshape(-1).astype(jnp.int32)   # (N,)
+    rows = jnp.arange(xs.shape[1])
+    return {"Out": xs[ids, rows]}
+
+
+@register_op("crop", nondiff=("Y", "Offsets"))
+def _crop(ctx, ins, attrs):
+    """Static crop (ref crop_op.h): slice `shape` at `offsets`."""
+    x = ins["X"][0]
+    shape = attrs.get("shape")
+    if shape is None and ins.get("Y"):
+        shape = list(ins["Y"][0].shape)
+    offsets = attrs.get("offsets") or [0] * x.ndim
+    idx = tuple(slice(int(o), int(o) + int(s))
+                for o, s in zip(offsets, shape))
+    return {"Out": x[idx]}
+
+
+@register_op("cos_sim")
+def _cos_sim(ctx, ins, attrs):
+    """Ref cos_sim_op.h: per-row cosine; Y may be (1, D) broadcast."""
+    x, y = ins["X"][0], ins["Y"][0]
+    xn = jnp.sqrt(jnp.sum(jnp.square(x), axis=1, keepdims=True))
+    yn = jnp.sqrt(jnp.sum(jnp.square(y), axis=1, keepdims=True))
+    num = jnp.sum(x * y, axis=1, keepdims=True)
+    out = num / jnp.maximum(xn * yn, 1e-12)
+    return {"Out": out, "XNorm": xn, "YNorm": yn}
+
+
+@register_op("bilinear_tensor_product")
+def _bilinear_tensor_product(ctx, ins, attrs):
+    """out[b, i] = x[b] @ W[i] @ y[b] (+ bias) — one MXU einsum
+    (ref bilinear_tensor_product_op.h loops over i)."""
+    x, w, y = ins["X"][0], ins["Weight"][0], ins["Y"][0]
+    out = jnp.einsum("bm,imn,bn->bi", x, w, y)
+    if ins.get("Bias"):
+        out = out + ins["Bias"][0]
+    return {"Out": out}
+
+
+def _n_unique(x):
+    """Number of distinct values: adjacent-difference count on sort(x)
+    (jnp.unique's pad slots repeat the minimum, so counting transitions
+    on its padded output overcounts)."""
+    s = jnp.sort(x)
+    return (1 + jnp.sum(s[1:] != s[:-1])).astype(jnp.int32)
+
+
+@register_op("unique", nondiff=("X",), differentiable=False)
+def _unique(ctx, ins, attrs):
+    """Ref unique_op.h returns a dynamically-sized unique list; XLA needs
+    static shapes, so Out is padded to len(X) (pad slots repeat the last
+    unique value) and the valid length is returned in Count — the
+    documented TPU-native deviation."""
+    x = ins["X"][0].reshape(-1)
+    uniq, index = jnp.unique(x, return_inverse=True, size=x.shape[0],
+                             fill_value=None)
+    return {"Out": uniq,
+            "Index": index.astype(jnp.int32).reshape(ins["X"][0].shape),
+            "Count": _n_unique(x)}
+
+
+@register_op("unique_with_counts", nondiff=("X",), differentiable=False)
+def _unique_with_counts(ctx, ins, attrs):
+    x = ins["X"][0].reshape(-1)
+    uniq, index, counts = jnp.unique(
+        x, return_inverse=True, return_counts=True, size=x.shape[0],
+        fill_value=None)
+    return {"Out": uniq, "Index": index.astype(jnp.int32),
+            "Counts": counts.astype(jnp.int32),
+            "Count": _n_unique(x)}
+
+
+@register_op("mean_iou", nondiff=("Predictions", "Labels"),
+             differentiable=False)
+def _mean_iou(ctx, ins, attrs):
+    """Ref mean_iou_op.h: per-class IoU from confusion counts, averaged
+    over classes that appear."""
+    pred = ins["Predictions"][0].reshape(-1).astype(jnp.int32)
+    label = ins["Labels"][0].reshape(-1).astype(jnp.int32)
+    nc = int(attrs["num_classes"])
+    oh_p = jax.nn.one_hot(pred, nc, dtype=jnp.float32)
+    oh_l = jax.nn.one_hot(label, nc, dtype=jnp.float32)
+    inter = jnp.sum(oh_p * oh_l, axis=0)          # diag of confusion
+    np_ = jnp.sum(oh_p, axis=0)
+    nl = jnp.sum(oh_l, axis=0)
+    union = np_ + nl - inter
+    present = union > 0
+    iou = jnp.where(present, inter / jnp.maximum(union, 1.0), 0.0)
+    denom = jnp.maximum(jnp.sum(present.astype(jnp.float32)), 1.0)
+    return {"OutMeanIou": jnp.sum(iou) / denom,
+            "OutWrong": (np_ + nl - 2 * inter).astype(jnp.int32),
+            "OutCorrect": inter.astype(jnp.int32)}
+
+
+# ---------------------------------------------------------------------------
+# chunk_eval — vectorized segment extraction (ref chunk_eval_op.h
+# GetSegments loop becomes boolean begin/end masks + a cummax over start
+# positions; a chunk matches iff both sequences end a chunk at the same
+# position with the same start and type)
+# ---------------------------------------------------------------------------
+
+_SCHEMES = {
+    # scheme: (num_tag_types, tag_begin, tag_inside, tag_end, tag_single)
+    "IOB": (2, 0, 1, -1, -1),
+    "IOE": (2, -1, 0, 1, -1),
+    "IOBES": (4, 0, 1, 2, 3),
+    "plain": (1, -1, -1, -1, -1),
+}
+
+
+def _chunk_begin_end(tag, typ, ntt, tb, ti, te, ts, other, seq_mask):
+    """begin[i]: position i starts a chunk; end[i]: i is a chunk's last
+    position. Mirrors ChunkBegin/ChunkEnd in chunk_eval_op.h."""
+    prev_tag = jnp.concatenate(
+        [jnp.full_like(tag[:, :1], -1), tag[:, :-1]], axis=1)
+    prev_typ = jnp.concatenate(
+        [jnp.full_like(typ[:, :1], other), typ[:, :-1]], axis=1)
+
+    def begins(ptag, ptyp, t, ty):
+        in_other = ty == other
+        p_other = ptyp == other
+        diff_type = ty != ptyp
+        tag_rule = ((t == tb) |
+                    ((t == ti) & ((ptag == te) | (ptag == ts))) |
+                    ((t == te) & ((ptag == te) | (ptag == ts))) |
+                    (t == ts))
+        return jnp.where(p_other, ~in_other,
+                         jnp.where(in_other, False,
+                                   jnp.where(diff_type, True, tag_rule)))
+
+    def ends(ptag, ptyp, t, ty):
+        # chunk containing position i-1 ends before i
+        p_other = ptyp == other
+        in_other = ty == other
+        diff_type = ty != ptyp
+        tag_rule = (((ptag == tb) & ((t == tb) | (t == ts))) |
+                    ((ptag == ti) & ((t == tb) | (t == ts))) |
+                    (ptag == te) | (ptag == ts))
+        return jnp.where(p_other, False,
+                         jnp.where(in_other, True,
+                                   jnp.where(diff_type, True, tag_rule)))
+
+    begin = begins(prev_tag, prev_typ, tag, typ) & seq_mask
+    # end[i] from the transition i -> i+1 (or sequence end)
+    next_tag = jnp.concatenate(
+        [tag[:, 1:], jnp.full_like(tag[:, :1], -1)], axis=1)
+    next_typ = jnp.concatenate(
+        [typ[:, 1:], jnp.full_like(typ[:, :1], other)], axis=1)
+    last = jnp.concatenate(
+        [seq_mask[:, 1:] == False, jnp.ones_like(seq_mask[:, :1])],  # noqa
+        axis=1) & seq_mask
+    in_chunk = (typ != other) & seq_mask
+    end = in_chunk & (last | ends(tag, typ, next_tag, next_typ))
+    return begin & in_chunk, end
+
+
+@register_op("chunk_eval", nondiff=("Inference", "Label", "SeqLength"),
+             differentiable=False)
+def _chunk_eval(ctx, ins, attrs):
+    inf = ins["Inference"][0]
+    lab = ins["Label"][0]
+    if inf.ndim > 2:
+        inf = inf.reshape(inf.shape[0], -1)
+        lab = lab.reshape(lab.shape[0], -1)
+    b, t = inf.shape
+    if ins.get("SeqLength"):
+        seq_len = ins["SeqLength"][0].reshape(-1)
+        seq_mask = jnp.arange(t)[None, :] < seq_len[:, None]
+    else:
+        seq_mask = jnp.ones((b, t), bool)
+    scheme = attrs.get("chunk_scheme", "IOB")
+    ntt, tb, ti, te, ts = _SCHEMES[scheme]
+    other = int(attrs["num_chunk_types"])
+    excluded = attrs.get("excluded_chunk_types") or []
+
+    def seg(x):
+        x = x.astype(jnp.int32)
+        tag = x % ntt
+        typ = x // ntt
+        begin, end = _chunk_begin_end(tag, typ, ntt, tb, ti, te, ts,
+                                      other, seq_mask)
+        # start position of the chunk containing i (valid at end positions)
+        pos = jnp.arange(t, dtype=jnp.int32)[None, :]
+        sidx = lax.cummax(jnp.where(begin, pos, -1), axis=1)
+        keep = jnp.ones_like(begin)
+        for e in excluded:
+            keep = keep & (typ != int(e))
+        return begin & keep, end & keep, sidx, typ
+
+    b_i, e_i, s_i, ty_i = seg(inf)
+    b_l, e_l, s_l, ty_l = seg(lab)
+    num_inf = jnp.sum(b_i)
+    num_lab = jnp.sum(b_l)
+    correct = jnp.sum(e_i & e_l & (s_i == s_l) & (ty_i == ty_l))
+    p = jnp.where(num_inf > 0, correct / jnp.maximum(num_inf, 1), 0.0)
+    r = jnp.where(num_lab > 0, correct / jnp.maximum(num_lab, 1), 0.0)
+    f1 = jnp.where(correct > 0, 2 * p * r / jnp.maximum(p + r, 1e-12), 0.0)
+    one = lambda v, dt: jnp.asarray(v, dt).reshape(1)  # noqa: E731
+    return {"Precision": one(p, jnp.float32),
+            "Recall": one(r, jnp.float32),
+            "F1-Score": one(f1, jnp.float32),
+            "NumInferChunks": one(num_inf, jnp.int32),
+            "NumLabelChunks": one(num_lab, jnp.int32),
+            "NumCorrectChunks": one(correct, jnp.int32)}
+
+
+# ---------------------------------------------------------------------------
+# data_norm / spectral_norm
+# ---------------------------------------------------------------------------
+
+@register_op("data_norm", nondiff=("BatchSize", "BatchSum", "BatchSquareSum"))
+def _data_norm(ctx, ins, attrs):
+    """Ref data_norm_op.cc: means = batch_sum / batch_size, scales =
+    sqrt(batch_size / batch_square_sum), y = (x - means) * scales. The
+    reference accumulates the running stats in its grad kernel; here the
+    forward emits the updated accumulators (batch_norm-style outputs)."""
+    x = ins["X"][0]                        # (N, C)
+    bsize = ins["BatchSize"][0]
+    bsum = ins["BatchSum"][0]
+    bsq = ins["BatchSquareSum"][0]
+    means = bsum / bsize
+    scales = jnp.sqrt(bsize / bsq)
+    y = (x - means[None, :]) * scales[None, :]
+    n = x.shape[0]
+    new_size = bsize + n
+    new_sum = bsum + jnp.sum(x, axis=0)
+    new_sq = bsq + jnp.sum(jnp.square(x - means[None, :]), axis=0)
+    return {"Y": y, "Means": means, "Scales": scales,
+            "BatchSizeOut": lax.stop_gradient(new_size),
+            "BatchSumOut": lax.stop_gradient(new_sum),
+            "BatchSquareSumOut": lax.stop_gradient(new_sq)}
+
+
+@register_op("spectral_norm", nondiff=("U", "V"))
+def _spectral_norm(ctx, ins, attrs):
+    """Ref spectral_norm_op.h: power iteration on W reshaped to (h, w)
+    with dim moved first; weight_out = W / sigma. U/V iterates are
+    treated as constants (stop_gradient), exactly like the reference."""
+    w = ins["Weight"][0]
+    u = ins["U"][0]                        # (h,)
+    v = ins["V"][0]                        # (w,)
+    dim = int(attrs.get("dim", 0))
+    power_iters = int(attrs.get("power_iters", 1))
+    eps = float(attrs.get("eps", 1e-12))
+    perm = [dim] + [i for i in range(w.ndim) if i != dim]
+    wm = jnp.transpose(w, perm)
+    h = wm.shape[0]
+    wmat = wm.reshape(h, -1)
+
+    def l2n(a):
+        return a / jnp.maximum(jnp.linalg.norm(a), eps)
+
+    for _ in range(power_iters):
+        v = l2n(wmat.T @ u)
+        u = l2n(wmat @ v)
+    u = lax.stop_gradient(u)
+    v = lax.stop_gradient(v)
+    sigma = u @ (wmat @ v)
+    out = wmat / sigma
+    inv = [perm.index(i) for i in range(w.ndim)]
+    out = jnp.transpose(out.reshape(wm.shape), inv)
+    return {"Out": out, "UOut": u, "VOut": v}

@@ -53,6 +53,28 @@ def _depthwise_conv2d(ctx, ins, attrs):
     return _conv2d(ctx, ins, attrs)
 
 
+def _conv_transpose_nd(x, w, strides, pads, dil, groups, dn):
+    """Fluid's conv_transpose IS the input-gradient of the forward conv
+    (ref conv_transpose_op.h computes it with col2im); building it as the
+    actual vjp of lax.conv_general_dilated is exact for every
+    stride/padding/dilation/groups combination and stays differentiable
+    (vjp-of-vjp). Filter layout: (in_c, out_c/g, *k)."""
+    k_sp = w.shape[2:]
+    out_sp = tuple(
+        (x.shape[2 + i] - 1) * strides[i] - 2 * pads[i] +
+        dil[i] * (k_sp[i] - 1) + 1 for i in range(len(k_sp)))
+    out_shape = (x.shape[0], w.shape[1] * groups) + out_sp
+
+    def fwd(y):
+        return lax.conv_general_dilated(
+            y, w, window_strides=strides,
+            padding=[(p, p) for p in pads], rhs_dilation=dil,
+            feature_group_count=groups, dimension_numbers=dn)
+
+    _, vjp = jax.vjp(fwd, jnp.zeros(out_shape, x.dtype))
+    return vjp(x)[0]
+
+
 @register_op("conv2d_transpose")
 def _conv2d_transpose(ctx, ins, attrs):
     x, w = ins["Input"][0], ins["Filter"][0]
@@ -60,14 +82,8 @@ def _conv2d_transpose(ctx, ins, attrs):
     pads = _pair(attrs.get("paddings", [0, 0]))
     dil = _pair(attrs.get("dilations", [1, 1]))
     groups = attrs.get("groups", 1) or 1
-    # filter layout for conv_transpose in fluid: (in_c, out_c/g, kh, kw)
-    out = lax.conv_transpose(
-        x, jnp.swapaxes(w, 0, 1) if groups == 1 else w,
-        strides=strides,
-        padding=[(pads[0], pads[0]), (pads[1], pads[1])],
-        rhs_dilation=dil,
-        dimension_numbers=("NCHW", "OIHW", "NCHW"),
-        transpose_kernel=True)
+    out = _conv_transpose_nd(x, w, strides, pads, dil, groups,
+                             ("NCHW", "OIHW", "NCHW"))
     return {"Output": out}
 
 

@@ -434,7 +434,14 @@ def flash_attention(q, k, v, mask=None, scale=1.0, causal=False,
         if env is not None:
             interpret = env not in ("0", "false", "")
         else:
-            interpret = jax.default_backend() not in ("tpu", "axon")
+            # Decide from the EFFECTIVE default device, not the process-wide
+            # backend list: jax.default_backend() reports "tpu" whenever a
+            # chip is attached, even while a jax.default_device(cpu) pin is
+            # routing every computation (including this one) to CPU.
+            pinned = getattr(jax.config, "jax_default_device", None)
+            platform = (pinned.platform if pinned is not None
+                        else jax.default_backend())
+            interpret = platform not in ("tpu", "axon")
     tq, tk = q.shape[2], k.shape[2]
     if causal and tq > tk:
         # rows i < tq - tk see no keys at all; only the XLA reference

@@ -350,15 +350,6 @@ def _sequence_mask(ctx, ins, attrs):
     return {"Y": mask.astype(to_jax_dtype(attrs.get("out_dtype", "int64")))}
 
 
-@register_op("unique_with_counts", nondiff=("X",))
-def _unique_with_counts(ctx, ins, attrs):
-    x = _x(ins)
-    u, idx, counts = jnp.unique(x, return_inverse=True, return_counts=True,
-                                size=x.size)
-    return {"Out": u, "Index": idx.astype(jnp.int32),
-            "Count": counts.astype(jnp.int32)}
-
-
 @register_op("take_along_axis", nondiff=("Index",))
 def _take_along_axis(ctx, ins, attrs):
     x, index = ins["Input"][0], ins["Index"][0]

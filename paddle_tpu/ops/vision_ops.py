@@ -1,0 +1,389 @@
+"""Spatial / vision op kernels: 3-D conv-pool family, sampling grids,
+deformable conv, im2col, ROI variants, video ops.
+
+Reference parity: paddle/fluid/operators/{conv_op (3d), conv_transpose_op,
+pool_op (3d), affine_grid_op, grid_sampler_op, pixel_shuffle_op, lrn_op,
+unfold_op, temporal_shift_op, row_conv_op, deformable_conv_op,
+psroi_pool_op, prroi_pool_op}. The reference dispatches to cuDNN/CUDA
+kernels; here everything is lax convolutions, reduce_windows and batched
+bilinear gathers that XLA tiles for the MXU, and every op is
+differentiable through the generic vjp pairing (framework/trace.py).
+"""
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op
+
+
+def _triple(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v),) * 3
+
+
+# ---------------------------------------------------------------------------
+# conv3d_transpose / pool3d (conv3d kernel lives in nn_ops.py)
+# ---------------------------------------------------------------------------
+
+@register_op("conv3d_transpose")
+def _conv3d_transpose(ctx, ins, attrs):
+    """Ref conv_transpose_op.cc (3-D): filter layout (in_c, out_c/g, kd,
+    kh, kw); computed as the exact vjp of the forward conv3d (see
+    nn_ops._conv_transpose_nd)."""
+    from .nn_ops import _conv_transpose_nd
+    x, w = ins["Input"][0], ins["Filter"][0]
+    strides = _triple(attrs.get("strides", [1, 1, 1]))
+    pads = _triple(attrs.get("paddings", [0, 0, 0]))
+    dil = _triple(attrs.get("dilations", [1, 1, 1]))
+    groups = attrs.get("groups", 1) or 1
+    out = _conv_transpose_nd(x, w, strides, pads, dil, groups,
+                             ("NCDHW", "OIDHW", "NCDHW"))
+    return {"Output": out}
+
+
+@register_op("pool3d")
+def _pool3d(ctx, ins, attrs):
+    """Ref pool_op.h 3-D path: max/avg over (kd, kh, kw) windows;
+    adaptive mode splits each spatial dim into equal cells (requires
+    divisibility — the XLA-static analogue of the reference's per-cell
+    floor/ceil bounds)."""
+    x = ins["X"][0]                       # (N, C, D, H, W)
+    ptype = attrs.get("pooling_type", "max")
+    if attrs.get("global_pooling", False):
+        fn = jnp.max if ptype == "max" else jnp.mean
+        return {"Out": fn(x, axis=(2, 3, 4), keepdims=True)}
+    ks = _triple(attrs.get("ksize", [2, 2, 2]))
+    if attrs.get("adaptive", False):
+        od, oh, ow = ks
+        n, c, d, h, w = x.shape
+        if d % od or h % oh or w % ow:
+            raise NotImplementedError(
+                "adaptive pool3d needs input divisible by output size "
+                "(got %sx%sx%s -> %sx%sx%s)" % (d, h, w, od, oh, ow))
+        x8 = x.reshape(n, c, od, d // od, oh, h // oh, ow, w // ow)
+        fn = jnp.max if ptype == "max" else jnp.mean
+        return {"Out": fn(x8, axis=(3, 5, 7))}
+    strides = _triple(attrs.get("strides", ks))
+    pads = _triple(attrs.get("paddings", [0, 0, 0]))
+    window = (1, 1) + ks
+    strides5 = (1, 1) + strides
+    padding = ((0, 0), (0, 0)) + tuple((p, p) for p in pads)
+    if ptype == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) \
+            else jnp.iinfo(x.dtype).min
+        out = lax.reduce_window(x, init, lax.max, window, strides5, padding)
+    else:
+        s = lax.reduce_window(x, 0.0, lax.add, window, strides5, padding)
+        if attrs.get("exclusive", True):
+            cnt = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add, window,
+                                    strides5, padding)
+            out = s / cnt
+        else:
+            out = s / (ks[0] * ks[1] * ks[2])
+    return {"Out": out}
+
+
+# ---------------------------------------------------------------------------
+# affine_grid / grid_sampler (ref affine_grid_op.h, grid_sampler_op.h —
+# both use align_corners semantics and zero padding outside the map)
+# ---------------------------------------------------------------------------
+
+@register_op("affine_grid", nondiff=("OutputShape",))
+def _affine_grid(ctx, ins, attrs):
+    theta = ins["Theta"][0]               # (N, 2, 3)
+    shape = attrs["output_shape"]         # [N, C, H, W]
+    h, w = int(shape[2]), int(shape[3])
+    ys = jnp.linspace(-1.0, 1.0, h)
+    xs = jnp.linspace(-1.0, 1.0, w)
+    gx, gy = jnp.meshgrid(xs, ys)         # (H, W)
+    base = jnp.stack([gx, gy, jnp.ones_like(gx)], axis=-1)   # (H, W, 3)
+    out = jnp.einsum("hwk,njk->nhwj", base.astype(theta.dtype), theta)
+    return {"Output": out}                # (N, H, W, 2)
+
+
+def _grid_sample_2d(x, gx, gy):
+    """Bilinear sample x (N,C,H,W) at pixel coords gx/gy (N,H',W');
+    out-of-range points contribute zero (ref GetGridPointValue)."""
+    n, c, h, w = x.shape
+    x0 = jnp.floor(gx)
+    y0 = jnp.floor(gy)
+    fx = gx - x0
+    fy = gy - y0
+    nidx = jnp.arange(n)[:, None, None]
+
+    def tap(yi, xi, wgt):
+        valid = (xi >= 0) & (xi < w) & (yi >= 0) & (yi < h)
+        v = x[nidx, :, jnp.clip(yi, 0, h - 1).astype(jnp.int32),
+              jnp.clip(xi, 0, w - 1).astype(jnp.int32)]   # (N,H',W',C)
+        return v * (wgt * valid)[..., None]
+
+    out = (tap(y0, x0, (1 - fy) * (1 - fx)) +
+           tap(y0, x0 + 1, (1 - fy) * fx) +
+           tap(y0 + 1, x0, fy * (1 - fx)) +
+           tap(y0 + 1, x0 + 1, fy * fx))
+    return out.transpose(0, 3, 1, 2)      # (N, C, H', W')
+
+
+@register_op("grid_sampler")
+def _grid_sampler(ctx, ins, attrs):
+    x, grid = ins["X"][0], ins["Grid"][0]   # grid (N, H', W', 2) in [-1,1]
+    h, w = x.shape[2], x.shape[3]
+    gx = (grid[..., 0] + 1.0) * 0.5 * (w - 1)
+    gy = (grid[..., 1] + 1.0) * 0.5 * (h - 1)
+    return {"Output": _grid_sample_2d(x, gx, gy)}
+
+
+# ---------------------------------------------------------------------------
+# pixel_shuffle / lrn / unfold / temporal_shift / row_conv
+# ---------------------------------------------------------------------------
+
+@register_op("pixel_shuffle")
+def _pixel_shuffle(ctx, ins, attrs):
+    x = ins["X"][0]                       # (N, C*r*r, H, W)
+    r = int(attrs["upscale_factor"])
+    n, c, h, w = x.shape
+    oc = c // (r * r)
+    y = x.reshape(n, oc, r, r, h, w)
+    y = y.transpose(0, 1, 4, 2, 5, 3)     # (N, OC, H, r, W, r)
+    return {"Out": y.reshape(n, oc, h * r, w * r)}
+
+
+@register_op("lrn")
+def _lrn(ctx, ins, attrs):
+    """Ref lrn_op.cc: mid = k + alpha * sum_{window n over C} x^2;
+    out = x * mid^-beta."""
+    x = ins["X"][0]                       # (N, C, H, W)
+    n_sz = int(attrs.get("n", 5))
+    k = float(attrs.get("k", 1.0))
+    alpha = float(attrs.get("alpha", 1e-4))
+    beta = float(attrs.get("beta", 0.75))
+    half = (n_sz - 1) // 2
+    sq = jnp.square(x)
+    acc = lax.reduce_window(
+        sq, 0.0, lax.add, (1, n_sz, 1, 1), (1, 1, 1, 1),
+        ((0, 0), (half, n_sz - 1 - half), (0, 0), (0, 0)))
+    mid = k + alpha * acc
+    return {"Out": x * jnp.power(mid, -beta), "MidOut": mid}
+
+
+@register_op("unfold")
+def _unfold(ctx, ins, attrs):
+    """im2col (ref unfold_op.h): (N,C,H,W) -> (N, C*kh*kw, L), patch
+    channel order (c, kh, kw) with c slowest — matches the reference's
+    Im2ColFunctor layout."""
+    x = ins["X"][0]
+    kh, kw = [int(v) for v in attrs["kernel_sizes"]]
+    sh, sw = [int(v) for v in attrs.get("strides", [1, 1])]
+    pads = [int(v) for v in attrs.get("paddings", [0, 0])]
+    if len(pads) == 4:        # [top, left, bottom, right]
+        pad_cfg = [(pads[0], pads[2]), (pads[1], pads[3])]
+    else:
+        pad_cfg = [(pads[0], pads[0]), (pads[1], pads[1])]
+    dh, dw = [int(v) for v in attrs.get("dilations", [1, 1])]
+    patches = lax.conv_general_dilated_patches(
+        x, (kh, kw), (sh, sw), pad_cfg,
+        rhs_dilation=(dh, dw),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    n = x.shape[0]
+    return {"Y": patches.reshape(n, patches.shape[1], -1)}
+
+
+@register_op("temporal_shift")
+def _temporal_shift(ctx, ins, attrs):
+    """Ref temporal_shift_op.h: x (N*T, C, H, W); first fold of channels
+    reads from t+1, second fold from t-1, rest unchanged; zero padded."""
+    x = ins["X"][0]
+    t = int(attrs["seg_num"])
+    ratio = float(attrs.get("shift_ratio", 0.25))
+    nt, c, h, w = x.shape
+    n = nt // t
+    c1 = int(c * ratio)
+    c2 = int(c * 2 * ratio)
+    xr = x.reshape(n, t, c, h, w)
+    zeros = jnp.zeros_like(xr[:, :1])
+    fwd = jnp.concatenate([xr[:, 1:], zeros], axis=1)    # reads t+1
+    bwd = jnp.concatenate([zeros, xr[:, :-1]], axis=1)   # reads t-1
+    out = jnp.concatenate([fwd[:, :, :c1], bwd[:, :, c1:c2], xr[:, :, c2:]],
+                          axis=2)
+    return {"Out": out.reshape(nt, c, h, w)}
+
+
+@register_op("row_conv")
+def _row_conv(ctx, ins, attrs):
+    """Ref row_conv_op.cc (lookahead convolution, dense batch form):
+    out[b,t,d] = sum_{i=0..k} x[b,t+i,d] * w[i,d]."""
+    x, w = ins["X"][0], ins["Filter"][0]   # (B,T,D), (k+1,D)
+    ctx_len = w.shape[0]
+    b, t, d = x.shape
+    pad = jnp.concatenate(
+        [x, jnp.zeros((b, ctx_len - 1, d), x.dtype)], axis=1)
+    out = jnp.zeros_like(x)
+    for i in range(ctx_len):               # static, small
+        out = out + pad[:, i:i + t, :] * w[i][None, None, :]
+    return {"Out": out}
+
+
+# ---------------------------------------------------------------------------
+# deformable conv (ref deformable_conv_op.cu / _v1): bilinear-sampled
+# im2col at learned offsets, then one big MXU matmul
+# ---------------------------------------------------------------------------
+
+@register_op("deformable_conv", nondiff=())
+def _deformable_conv(ctx, ins, attrs):
+    x = ins["Input"][0]                   # (N, C, H, W)
+    offset = ins["Offset"][0]             # (N, 2*dg*kh*kw, OH, OW), (y,x)
+    w = ins["Filter"][0]                  # (O, C/g, kh, kw)
+    mask = ins["Mask"][0] if ins.get("Mask") else None  # (N, dg*kh*kw,...)
+    strides = attrs.get("strides", [1, 1])
+    pads = attrs.get("paddings", [0, 0])
+    dil = attrs.get("dilations", [1, 1])
+    groups = attrs.get("groups", 1) or 1
+    dg = attrs.get("deformable_groups", 1) or 1
+    n, c, h, ww_ = x.shape
+    o, _, kh, kw = w.shape
+    oh = (h + 2 * pads[0] - (dil[0] * (kh - 1) + 1)) // strides[0] + 1
+    ow = (ww_ + 2 * pads[1] - (dil[1] * (kw - 1) + 1)) // strides[1] + 1
+    k = kh * kw
+
+    # base sampling positions per (kernel tap, output pixel)
+    oy = jnp.arange(oh) * strides[0] - pads[0]
+    ox = jnp.arange(ow) * strides[1] - pads[1]
+    ky = jnp.arange(kh) * dil[0]
+    kx = jnp.arange(kw) * dil[1]
+    base_y = oy[None, None, :, None] + ky[:, None, None, None]  # kh,1,OH,1
+    base_x = ox[None, None, None, :] + kx[None, :, None, None]  # 1,kw,1,OW
+    base_y = jnp.broadcast_to(base_y, (kh, kw, oh, ow)).reshape(k, oh, ow)
+    base_x = jnp.broadcast_to(base_x, (kh, kw, oh, ow)).reshape(k, oh, ow)
+
+    off = offset.reshape(n, dg, k, 2, oh, ow)
+    gy = base_y[None, None] + off[:, :, :, 0]     # (N, dg, K, OH, OW)
+    gx = base_x[None, None] + off[:, :, :, 1]
+    if mask is not None:
+        m = mask.reshape(n, dg, k, oh, ow)
+    else:
+        m = jnp.ones((n, dg, k, oh, ow), x.dtype)
+
+    # bilinear sample each deformable group's channels at its offsets
+    xg = x.reshape(n, dg, c // dg, h, ww_)
+    x0 = jnp.floor(gx)
+    y0 = jnp.floor(gy)
+    fx = gx - x0
+    fy = gy - y0
+    nidx = jnp.arange(n)[:, None, None, None, None]
+    didx = jnp.arange(dg)[None, :, None, None, None]
+
+    def tap(yi, xi, wgt):
+        valid = (xi >= 0) & (xi < ww_) & (yi >= 0) & (yi < h)
+        v = xg[nidx, didx, :, jnp.clip(yi, 0, h - 1).astype(jnp.int32),
+               jnp.clip(xi, 0, ww_ - 1).astype(jnp.int32)]
+        return v * (wgt * valid)[..., None]      # (N,dg,K,OH,OW,C/dg)
+
+    cols = (tap(y0, x0, (1 - fy) * (1 - fx)) +
+            tap(y0, x0 + 1, (1 - fy) * fx) +
+            tap(y0 + 1, x0, fy * (1 - fx)) +
+            tap(y0 + 1, x0 + 1, fy * fx))
+    cols = cols * m[..., None]
+    # (N, dg, K, OH, OW, C/dg) -> (N, C, K, OH, OW)
+    cols = cols.transpose(0, 1, 5, 2, 3, 4).reshape(n, c, k, oh, ow)
+    cg = c // groups
+    cols = cols.reshape(n, groups, cg, k, oh, ow)
+    wg = w.reshape(groups, o // groups, cg, k)
+    out = jnp.einsum("ngckhw,gock->ngohw",
+                     cols, wg).reshape(n, o, oh, ow)
+    return {"Output": out}
+
+
+# ---------------------------------------------------------------------------
+# position-sensitive / precise ROI pooling
+# ---------------------------------------------------------------------------
+
+def _roi_sample_bins(x_per_roi, rois, ph, pw, sr, h, w, spatial_scale,
+                     ch_index=None):
+    """Average of an sr x sr bilinear sample grid per output bin.
+    x_per_roi: (R, C, H, W) feature slices already gathered per roi."""
+    r = rois.shape[0]
+    x1 = rois[:, 0] * spatial_scale
+    y1 = rois[:, 1] * spatial_scale
+    rw = jnp.maximum(rois[:, 2] * spatial_scale - x1, 0.1)
+    rh = jnp.maximum(rois[:, 3] * spatial_scale - y1, 0.1)
+    iy = (jnp.arange(sr) + 0.5) / sr
+    gy = y1[:, None, None] + (jnp.arange(ph)[None, :, None] +
+                              iy[None, None, :]) * (rh / ph)[:, None, None]
+    gx = x1[:, None, None] + (jnp.arange(pw)[None, :, None] +
+                              iy[None, None, :]) * (rw / pw)[:, None, None]
+    gy = jnp.clip(gy.reshape(r, ph * sr), 0.0, h - 1.0)
+    gx = jnp.clip(gx.reshape(r, pw * sr), 0.0, w - 1.0)
+    y0 = jnp.floor(gy).astype(jnp.int32)
+    x0 = jnp.floor(gx).astype(jnp.int32)
+    y1i = jnp.minimum(y0 + 1, h - 1)
+    x1i = jnp.minimum(x0 + 1, w - 1)
+    fy = gy - y0
+    fx = gx - x0
+    ridx = jnp.arange(r)[:, None, None]
+    ya, yb = y0[:, :, None], y1i[:, :, None]
+    xa, xb = x0[:, None, :], x1i[:, None, :]
+    v00 = x_per_roi[ridx, :, ya, xa]      # (R, PH*S, PW*S, C)
+    v01 = x_per_roi[ridx, :, ya, xb]
+    v10 = x_per_roi[ridx, :, yb, xa]
+    v11 = x_per_roi[ridx, :, yb, xb]
+    fyb = fy[:, :, None, None]
+    fxb = fx[:, None, :, None]
+    vals = (v00 * (1 - fyb) * (1 - fxb) + v01 * (1 - fyb) * fxb +
+            v10 * fyb * (1 - fxb) + v11 * fyb * fxb)
+    c = x_per_roi.shape[1]
+    vals = vals.reshape(r, ph, sr, pw, sr, c).mean(axis=(2, 4))
+    return vals.transpose(0, 3, 1, 2)     # (R, C, PH, PW)
+
+
+@register_op("psroi_pool", nondiff=("ROIs", "RoisNum"))
+def _psroi_pool(ctx, ins, attrs):
+    """Position-sensitive ROI pooling (ref psroi_pool_op.h): bin (i,j) of
+    output channel c averages input channel c*ph*pw + i*pw + j over the
+    bin. The reference averages integer pixels; here each bin averages a
+    fixed bilinear sample grid — the static-shape TPU equivalent (same
+    estimator roi_align uses)."""
+    from .detection_ops import _roi_batch_index
+    x = ins["X"][0]
+    rois = ins["ROIs"][0]
+    n, c, h, w = x.shape
+    r = rois.shape[0]
+    oc = int(attrs["output_channels"])
+    ph = int(attrs.get("pooled_height", 1))
+    pw = int(attrs.get("pooled_width", 1))
+    scale = float(attrs.get("spatial_scale", 1.0))
+    if ins.get("RoisNum"):
+        bidx = _roi_batch_index(ins["RoisNum"][0], r, n)
+    else:
+        bidx = jnp.zeros((r,), jnp.int32)
+    # (R, oc, ph, pw, H, W): channel (o, i, j) = o*ph*pw + i*pw + j
+    xb = x[bidx].reshape(r, oc, ph, pw, h, w)
+    sampled = _roi_sample_bins(
+        xb.reshape(r, oc * ph * pw, h, w), rois, ph, pw, 2, h, w, scale)
+    sampled = sampled.reshape(r, oc, ph, pw, ph, pw)
+    ii = jnp.arange(ph)
+    jj = jnp.arange(pw)
+    out = sampled[:, :, ii[:, None], jj[None, :], ii[:, None], jj[None, :]]
+    return {"Out": out}
+
+
+@register_op("prroi_pool", nondiff=("ROIs", "BatchRoINums"))
+def _prroi_pool(ctx, ins, attrs):
+    """Precise ROI pooling (ref prroi_pool_op.h): exact integral of the
+    bilinearly-interpolated map over each bin, approximated with a dense
+    4x4 sample grid per bin (converges to the integral; fully
+    differentiable w.r.t. both features and coords)."""
+    from .detection_ops import _roi_batch_index
+    x = ins["X"][0]
+    rois = ins["ROIs"][0]
+    n, c, h, w = x.shape
+    r = rois.shape[0]
+    ph = int(attrs.get("pooled_height", 1))
+    pw = int(attrs.get("pooled_width", 1))
+    scale = float(attrs.get("spatial_scale", 1.0))
+    if ins.get("BatchRoINums"):
+        bidx = _roi_batch_index(ins["BatchRoINums"][0], r, n)
+    else:
+        bidx = jnp.zeros((r,), jnp.int32)
+    out = _roi_sample_bins(x[bidx], rois, ph, pw, 4, h, w, scale)
+    return {"Out": out}

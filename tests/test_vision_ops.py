@@ -1,0 +1,500 @@
+"""OpTest-style checks for the round-3 layer tail: 3-D conv/pool family,
+sampling grids, video ops, misc tensor layers, CRF wrappers (reference
+test model: tests/unittests/test_{conv3d,pool3d,affine_grid,grid_sampler,
+pixel_shuffle,lrn,unfold,temporal_shift,row_conv,multiplex,crop,cos_sim,
+bilinear_tensor_product,unique,mean_iou,chunk_eval,data_norm,
+spectral_norm}_op.py)."""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+
+
+def _run(build, feeds, n_fetch=1):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        fetch = build()
+        if not isinstance(fetch, (list, tuple)):
+            fetch = [fetch]
+    exe = pt.Executor()
+    exe.run(startup)
+    return exe.run(main, feed=feeds, fetch_list=list(fetch))
+
+
+def _grad_check(build, ref_fn, x_shape, rtol=1e-4, atol=1e-5, seed=0):
+    """Forward + d(sum(out^2))/dx vs jax oracle (matches test_op_grads)."""
+    rng = np.random.RandomState(seed)
+    xv = rng.randn(*x_shape).astype(np.float32)
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", list(x_shape), dtype="float32",
+                        append_batch_size=False)
+        x.stop_gradient = False
+        out = build(x)
+        loss = layers.reduce_sum(layers.square(out))
+        gx, = pt.gradients(loss, [x])
+    exe = pt.Executor()
+    exe.run(startup)
+    fwd, grad = exe.run(main, feed={"x": xv}, fetch_list=[out, gx])
+    ref = ref_fn(jnp.asarray(xv))
+    gref = jax.grad(lambda v: jnp.sum(ref_fn(v) ** 2))(jnp.asarray(xv))
+    np.testing.assert_allclose(fwd, np.asarray(ref), rtol=rtol, atol=atol)
+    np.testing.assert_allclose(grad, np.asarray(gref), rtol=rtol, atol=atol)
+
+
+# ---------------------------------------------------------------------------
+# conv3d / pool3d family
+# ---------------------------------------------------------------------------
+
+def test_conv3d_forward_shape_and_grad():
+    rng = np.random.RandomState(0)
+    xv = rng.randn(2, 3, 5, 6, 7).astype(np.float32)
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [2, 3, 5, 6, 7], dtype="float32",
+                        append_batch_size=False)
+        x.stop_gradient = False
+        out = layers.conv3d(x, num_filters=4, filter_size=3, padding=1,
+                            bias_attr=False)
+        loss = layers.reduce_sum(out)
+        gx, = pt.gradients(loss, [x])
+    exe = pt.Executor()
+    exe.run(startup)
+    o, g = exe.run(main, feed={"x": xv}, fetch_list=[out, gx])
+    assert o.shape == (2, 4, 5, 6, 7)
+    assert g.shape == xv.shape and np.isfinite(g).all()
+
+
+def test_conv3d_transpose_shape():
+    rng = np.random.RandomState(0)
+    xv = rng.randn(2, 3, 4, 4, 4).astype(np.float32)
+    o, = _run(lambda: layers.conv3d_transpose(
+        layers.data("x", [2, 3, 4, 4, 4], dtype="float32",
+                    append_batch_size=False),
+        num_filters=5, filter_size=2, stride=2, bias_attr=False),
+        {"x": xv})
+    assert o.shape == (2, 5, 8, 8, 8)
+
+
+@pytest.mark.parametrize("ptype", ["max", "avg"])
+def test_pool3d(ptype):
+    def ref(x):
+        from jax import lax
+        if ptype == "max":
+            return lax.reduce_window(x, -jnp.inf, lax.max, (1, 1, 2, 2, 2),
+                                     (1, 1, 2, 2, 2), "VALID")
+        s = lax.reduce_window(x, 0.0, lax.add, (1, 1, 2, 2, 2),
+                              (1, 1, 2, 2, 2), "VALID")
+        return s / 8.0
+    _grad_check(lambda x: layers.pool3d(x, pool_size=2, pool_type=ptype,
+                                        pool_stride=2),
+                ref, (2, 3, 4, 4, 4))
+
+
+def test_adaptive_pool3d():
+    _grad_check(
+        lambda x: layers.adaptive_pool3d(x, pool_size=2, pool_type="avg"),
+        lambda x: x.reshape(2, 2, 2, 2, 2, 2, 2, 2).mean(axis=(3, 5, 7)),
+        (2, 2, 4, 4, 4))
+
+
+def test_global_pool3d():
+    _grad_check(
+        lambda x: layers.pool3d(x, pool_type="avg", global_pooling=True),
+        lambda x: x.mean(axis=(2, 3, 4), keepdims=True), (2, 2, 3, 4, 5))
+
+
+# ---------------------------------------------------------------------------
+# affine_grid + grid_sampler
+# ---------------------------------------------------------------------------
+
+def test_affine_grid_identity():
+    # identity theta must produce the base grid
+    theta = np.tile(np.array([[[1., 0., 0.], [0., 1., 0.]]], np.float32),
+                    (2, 1, 1))
+    o, = _run(lambda: layers.affine_grid(
+        layers.data("t", [2, 2, 3], dtype="float32",
+                    append_batch_size=False), [2, 3, 4, 5]), {"t": theta})
+    assert o.shape == (2, 4, 5, 2)
+    np.testing.assert_allclose(o[0, 0, :, 0], np.linspace(-1, 1, 5),
+                               atol=1e-6)
+    np.testing.assert_allclose(o[0, :, 0, 1], np.linspace(-1, 1, 4),
+                               atol=1e-6)
+
+
+def test_grid_sampler_identity_roundtrip():
+    rng = np.random.RandomState(0)
+    xv = rng.randn(2, 3, 4, 5).astype(np.float32)
+    theta = np.tile(np.array([[[1., 0., 0.], [0., 1., 0.]]], np.float32),
+                    (2, 1, 1))
+
+    def build():
+        x = layers.data("x", [2, 3, 4, 5], dtype="float32",
+                        append_batch_size=False)
+        t = layers.data("t", [2, 2, 3], dtype="float32",
+                        append_batch_size=False)
+        grid = layers.affine_grid(t, [2, 3, 4, 5])
+        return layers.grid_sampler(x, grid)
+
+    o, = _run(build, {"x": xv, "t": theta})
+    np.testing.assert_allclose(o, xv, rtol=1e-4, atol=1e-5)
+
+
+def test_grid_sampler_out_of_range_zero():
+    xv = np.ones((1, 1, 4, 4), np.float32)
+    grid = np.full((1, 2, 2, 2), 5.0, np.float32)   # far outside [-1,1]
+
+    def build():
+        x = layers.data("x", [1, 1, 4, 4], dtype="float32",
+                        append_batch_size=False)
+        g = layers.data("g", [1, 2, 2, 2], dtype="float32",
+                        append_batch_size=False)
+        return layers.grid_sampler(x, g)
+
+    o, = _run(build, {"x": xv, "g": grid})
+    np.testing.assert_allclose(o, 0.0)
+
+
+# ---------------------------------------------------------------------------
+# pixel_shuffle / lrn / unfold / temporal_shift / row_conv
+# ---------------------------------------------------------------------------
+
+def test_pixel_shuffle():
+    def ref(x):
+        n, c, h, w = x.shape
+        y = x.reshape(n, c // 4, 2, 2, h, w)
+        return y.transpose(0, 1, 4, 2, 5, 3).reshape(n, c // 4, h * 2, w * 2)
+    _grad_check(lambda x: layers.pixel_shuffle(x, 2), ref, (2, 8, 3, 3))
+
+
+def test_lrn():
+    def ref(x):
+        sq = jnp.square(x)
+        pad = jnp.pad(sq, ((0, 0), (2, 2), (0, 0), (0, 0)))
+        acc = sum(pad[:, i:i + x.shape[1]] for i in range(5))
+        return x * jnp.power(1.0 + 1e-4 * acc, -0.75)
+    _grad_check(lambda x: layers.lrn(x, n=5), ref, (2, 6, 3, 3))
+
+
+def test_unfold_vs_manual_im2col():
+    rng = np.random.RandomState(0)
+    xv = rng.randn(2, 3, 5, 5).astype(np.float32)
+    o, = _run(lambda: layers.unfold(
+        layers.data("x", [2, 3, 5, 5], dtype="float32",
+                    append_batch_size=False), [2, 2], strides=1,
+        paddings=0), {"x": xv})
+    # manual im2col, channel order (c, kh, kw) with c slowest
+    cols = np.zeros((2, 3 * 2 * 2, 4 * 4), np.float32)
+    idx = 0
+    for c in range(3):
+        for i in range(2):
+            for j in range(2):
+                cols[:, idx] = xv[:, c, i:i + 4, j:j + 4].reshape(2, -1)
+                idx += 1
+    np.testing.assert_allclose(o, cols, rtol=1e-5, atol=1e-6)
+
+
+def test_temporal_shift():
+    rng = np.random.RandomState(0)
+    xv = rng.randn(4, 8, 2, 2).astype(np.float32)   # N=2, T=2
+    o, = _run(lambda: layers.temporal_shift(
+        layers.data("x", [4, 8, 2, 2], dtype="float32",
+                    append_batch_size=False), seg_num=2, shift_ratio=0.25),
+        {"x": xv})
+    xr = xv.reshape(2, 2, 8, 2, 2)
+    want = np.zeros_like(xr)
+    want[:, 0, :2] = xr[:, 1, :2]        # fwd fold reads t+1 (zero at end)
+    want[:, 1, 2:4] = xr[:, 0, 2:4]      # bwd fold reads t-1 (zero at start)
+    want[:, :, 4:] = xr[:, :, 4:]
+    np.testing.assert_allclose(o, want.reshape(4, 8, 2, 2))
+
+
+def test_row_conv():
+    rng = np.random.RandomState(0)
+    xv = rng.randn(2, 6, 4).astype(np.float32)
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [2, 6, 4], dtype="float32",
+                        append_batch_size=False)
+        out = layers.row_conv(x, future_context_size=2)
+    exe = pt.Executor()
+    exe.run(startup)
+    o, = exe.run(main, feed={"x": xv}, fetch_list=[out])
+    w = np.asarray(pt.global_scope().find_var(
+        main.global_block().all_parameters()[0].name))
+    want = np.zeros_like(xv)
+    pad = np.concatenate([xv, np.zeros((2, 2, 4), np.float32)], axis=1)
+    for i in range(3):
+        want += pad[:, i:i + 6] * w[i][None, None, :]
+    np.testing.assert_allclose(o, want, rtol=1e-4, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# deformable conv: zero offsets + ones mask == plain conv
+# ---------------------------------------------------------------------------
+
+def test_deformable_conv_zero_offset_matches_conv():
+    rng = np.random.RandomState(0)
+    xv = rng.randn(1, 4, 6, 6).astype(np.float32)
+    offs = np.zeros((1, 2 * 9, 6, 6), np.float32)
+    mask = np.ones((1, 9, 6, 6), np.float32)
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [1, 4, 6, 6], dtype="float32",
+                        append_batch_size=False)
+        off = layers.data("off", [1, 18, 6, 6], dtype="float32",
+                          append_batch_size=False)
+        m = layers.data("m", [1, 9, 6, 6], dtype="float32",
+                        append_batch_size=False)
+        out = layers.deformable_conv(x, off, m, num_filters=3,
+                                     filter_size=3, padding=1,
+                                     bias_attr=False)
+    exe = pt.Executor()
+    exe.run(startup)
+    o, = exe.run(main, feed={"x": xv, "off": offs, "m": mask},
+                 fetch_list=[out])
+    w = np.asarray(pt.global_scope().find_var(
+        main.global_block().all_parameters()[0].name))
+    from jax import lax
+    want = lax.conv_general_dilated(
+        jnp.asarray(xv), jnp.asarray(w), (1, 1), [(1, 1), (1, 1)],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    np.testing.assert_allclose(o, np.asarray(want), rtol=1e-3, atol=1e-4)
+
+
+def test_psroi_pool_shape():
+    rng = np.random.RandomState(0)
+    xv = rng.rand(1, 2 * 2 * 2, 8, 8).astype(np.float32)
+    rois = np.array([[0., 0., 7., 7.], [2., 2., 6., 6.]], np.float32)
+
+    def build():
+        x = layers.data("x", [1, 8, 8, 8], dtype="float32",
+                        append_batch_size=False)
+        r = layers.data("r", [2, 4], dtype="float32",
+                        append_batch_size=False)
+        return layers.psroi_pool(x, r, output_channels=2, spatial_scale=1.0,
+                                 pooled_height=2, pooled_width=2)
+
+    o, = _run(build, {"x": xv, "r": rois})
+    assert o.shape == (2, 2, 2, 2) and np.isfinite(o).all()
+
+
+def test_prroi_pool_constant_map():
+    # constant feature map -> every bin averages to the constant
+    xv = np.full((1, 3, 8, 8), 2.5, np.float32)
+    rois = np.array([[1., 1., 6., 6.]], np.float32)
+
+    def build():
+        x = layers.data("x", [1, 3, 8, 8], dtype="float32",
+                        append_batch_size=False)
+        r = layers.data("r", [1, 4], dtype="float32",
+                        append_batch_size=False)
+        return layers.prroi_pool(x, r, spatial_scale=1.0, pooled_height=2,
+                                 pooled_width=2)
+
+    o, = _run(build, {"x": xv, "r": rois})
+    np.testing.assert_allclose(o, 2.5, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# misc tensor layers
+# ---------------------------------------------------------------------------
+
+def test_multiplex():
+    rng = np.random.RandomState(0)
+    a = rng.randn(4, 3).astype(np.float32)
+    b = rng.randn(4, 3).astype(np.float32)
+    ids = np.array([[0], [1], [0], [1]], np.int32)
+
+    def build():
+        xa = layers.data("a", [4, 3], dtype="float32",
+                         append_batch_size=False)
+        xb = layers.data("b", [4, 3], dtype="float32",
+                         append_batch_size=False)
+        xi = layers.data("i", [4, 1], dtype="int32",
+                         append_batch_size=False)
+        return layers.multiplex([xa, xb], xi)
+
+    o, = _run(build, {"a": a, "b": b, "i": ids})
+    want = np.where(ids == 0, a, b)
+    np.testing.assert_allclose(o, want)
+
+
+def test_crop():
+    _grad_check(lambda x: layers.crop(x, shape=[2, 2], offsets=[1, 1]),
+                lambda x: x[1:3, 1:3], (4, 5))
+
+
+def test_cos_sim():
+    rng = np.random.RandomState(0)
+    xv = rng.randn(4, 6).astype(np.float32)
+    yv = rng.randn(4, 6).astype(np.float32)
+
+    def build():
+        x = layers.data("x", [4, 6], dtype="float32",
+                        append_batch_size=False)
+        y = layers.data("y", [4, 6], dtype="float32",
+                        append_batch_size=False)
+        return layers.cos_sim(x, y)
+
+    o, = _run(build, {"x": xv, "y": yv})
+    want = (xv * yv).sum(1) / (np.linalg.norm(xv, axis=1) *
+                               np.linalg.norm(yv, axis=1))
+    np.testing.assert_allclose(o[:, 0], want, rtol=1e-4, atol=1e-5)
+
+
+def test_bilinear_tensor_product():
+    rng = np.random.RandomState(0)
+    xv = rng.randn(3, 4).astype(np.float32)
+    yv = rng.randn(3, 5).astype(np.float32)
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [3, 4], dtype="float32",
+                        append_batch_size=False)
+        y = layers.data("y", [3, 5], dtype="float32",
+                        append_batch_size=False)
+        out = layers.bilinear_tensor_product(x, y, size=6)
+    exe = pt.Executor()
+    exe.run(startup)
+    o, = exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[out])
+    params = {p.name: np.asarray(pt.global_scope().find_var(p.name))
+              for p in main.global_block().all_parameters()}
+    w = next(v for v in params.values() if v.ndim == 3)
+    bias = next(v for v in params.values() if v.ndim == 2)
+    want = np.einsum("bm,imn,bn->bi", xv, w, yv) + bias
+    np.testing.assert_allclose(o, want, rtol=1e-3, atol=1e-4)
+
+
+def test_unique_padded():
+    xv = np.array([3, 1, 3, 2, 1, 7], np.int64)
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [6], dtype="int64", append_batch_size=False)
+        out, index, count = layers.unique(x)
+    exe = pt.Executor()
+    exe.run(startup)
+    o, idx, cnt = exe.run(main, feed={"x": xv},
+                          fetch_list=[out, index, count])
+    n = int(cnt)
+    assert n == 4
+    np.testing.assert_array_equal(np.sort(o[:n]), [1, 2, 3, 7])
+    np.testing.assert_array_equal(o[idx], xv)    # inverse mapping
+
+
+def test_mean_iou():
+    pred = np.array([0, 1, 1, 2], np.int32)
+    lab = np.array([0, 1, 2, 2], np.int32)
+
+    def build():
+        p = layers.data("p", [4], dtype="int32", append_batch_size=False)
+        l_ = layers.data("l", [4], dtype="int32", append_batch_size=False)
+        return layers.mean_iou(p, l_, 3)
+
+    miou, wrong, correct = _run(build, {"p": pred, "l": lab}, 3)
+    # class0: 1/1, class1: 1/2, class2: 1/2 -> mean 2/3
+    np.testing.assert_allclose(float(miou), (1 + 0.5 + 0.5) / 3, rtol=1e-5)
+    np.testing.assert_array_equal(correct, [1, 1, 1])
+
+
+def test_chunk_eval_iob():
+    # chunk types: 0=PER, 1=LOC; IOB labels: B-PER=0 I-PER=1 B-LOC=2
+    # I-LOC=3 O=4
+    inf = np.array([[0, 1, 4, 2, 3, 4]], np.int64)
+    lab = np.array([[0, 1, 4, 2, 2, 4]], np.int64)
+
+    def build():
+        i = layers.data("i", [1, 6], dtype="int64", append_batch_size=False)
+        l_ = layers.data("l", [1, 6], dtype="int64",
+                         append_batch_size=False)
+        return layers.chunk_eval(i, l_, "IOB", 2)
+
+    p, r, f1, ni, nl, nc = _run(build, {"i": inf, "l": lab}, 6)
+    # infer chunks: PER[0,1], LOC[3,4]; label: PER[0,1], LOC[3], LOC[4]
+    assert int(ni) == 2 and int(nl) == 3 and int(nc) == 1
+    np.testing.assert_allclose(float(p), 0.5, rtol=1e-5)
+    np.testing.assert_allclose(float(r), 1.0 / 3, rtol=1e-5)
+
+
+def test_chunk_eval_perfect_with_seq_length():
+    inf = np.array([[0, 1, 4, 4], [2, 4, 0, 0]], np.int64)
+    seq = np.array([3, 2], np.int64)
+
+    def build():
+        i = layers.data("i", [2, 4], dtype="int64", append_batch_size=False)
+        l_ = layers.data("l", [2, 4], dtype="int64",
+                         append_batch_size=False)
+        s = layers.data("s", [2], dtype="int64", append_batch_size=False)
+        return layers.chunk_eval(i, l_, "IOB", 2, seq_length=s)
+
+    p, r, f1, ni, nl, nc = _run(build, {"i": inf, "l": inf, "s": seq}, 6)
+    assert int(ni) == int(nl) == int(nc) == 2
+    np.testing.assert_allclose(float(f1), 1.0, rtol=1e-5)
+
+
+def test_data_norm_updates_stats():
+    rng = np.random.RandomState(0)
+    xv = rng.randn(8, 4).astype(np.float32)
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [8, 4], dtype="float32",
+                        append_batch_size=False)
+        out = layers.data_norm(x)
+    exe = pt.Executor()
+    exe.run(startup)
+    bsize_name = [n for n in pt.global_scope().keys()
+                  if "batch_size" in n][0]
+    before = np.asarray(pt.global_scope().find_var(bsize_name)).copy()
+    o, = exe.run(main, feed={"x": xv}, fetch_list=[out])
+    after = np.asarray(pt.global_scope().find_var(bsize_name))
+    # init: size=1e4, sum=0, sq=1e4 -> means=0, scales=1 -> y == x
+    np.testing.assert_allclose(o, xv, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(after, before + 8)
+
+
+def test_spectral_norm_sigma_one():
+    rng = np.random.RandomState(0)
+    wv = rng.randn(6, 4).astype(np.float32)
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        w = layers.data("w", [6, 4], dtype="float32",
+                        append_batch_size=False)
+        out = layers.spectral_norm(w, dim=0, power_iters=20)
+    exe = pt.Executor()
+    exe.run(startup)
+    o, = exe.run(main, feed={"w": wv}, fetch_list=[out])
+    # after normalization the top singular value must be ~1
+    s = np.linalg.svd(o, compute_uv=False)
+    np.testing.assert_allclose(s[0], 1.0, rtol=1e-3)
+
+
+# ---------------------------------------------------------------------------
+# CRF layer wrappers
+# ---------------------------------------------------------------------------
+
+def test_linear_chain_crf_and_decode_layers():
+    rng = np.random.RandomState(0)
+    em = rng.randn(2, 5, 3).astype(np.float32)
+    lab = rng.randint(0, 3, (2, 5, 1)).astype(np.int64)
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [2, 5, 3], dtype="float32",
+                        append_batch_size=False)
+        y = layers.data("y", [2, 5, 1], dtype="int64",
+                        append_batch_size=False)
+        ll = layers.linear_chain_crf(
+            x, y, param_attr=pt.ParamAttr(name="crf_w"))
+        path = layers.crf_decoding(
+            x, param_attr=pt.ParamAttr(name="crf_w"))
+        avg = layers.mean(ll)
+        gx, = pt.gradients(avg, [x])
+    exe = pt.Executor()
+    exe.run(startup)
+    llv, pv, gv = exe.run(main, feed={"x": em, "y": lab},
+                          fetch_list=[ll, path, gx])
+    assert llv.shape == (2, 1) and np.isfinite(llv).all()
+    assert pv.shape == (2, 5, 1)
+    assert (pv >= 0).all() and (pv < 3).all()
+    assert np.isfinite(gv).all() and np.abs(gv).sum() > 0
