@@ -14,4 +14,6 @@ from .nn import (Linear, Conv2D, BatchNorm, Embedding, LayerNorm, Dropout,
 from .checkpoint import save_dygraph, load_dygraph
 from .jit import TracedLayer, dygraph_to_static_graph
 from . import optimizers
+from . import grad_clip
+from .grad_clip import GradClipByValue, GradClipByNorm, GradClipByGlobalNorm
 from .parallel import DataParallel, ParallelEnv, prepare_context

@@ -1,0 +1,97 @@
+"""Dygraph gradient clipping strategies.
+
+Reference parity: python/paddle/fluid/dygraph_grad_clip.py
+(GradClipByValue:46, GradClipByNorm:120, GradClipByGlobalNorm:191). Each
+strategy is a callable over [(param, grad_array), ...] pairs returning the
+clipped pairs; optimizers apply it via ``minimize(..., grad_clip=clip)``.
+Math runs on device as plain jnp ops (fused by XLA when jitted).
+"""
+import jax.numpy as jnp
+
+__all__ = ["GradClipBase", "GradClipByValue", "GradClipByNorm",
+           "GradClipByGlobalNorm"]
+
+
+class GradClipBase(object):
+    def _clip(self, para_and_grad):
+        raise NotImplementedError
+
+    def __call__(self, para_and_grad):
+        return self._clip(para_and_grad)
+
+
+class GradClipByValue(GradClipBase):
+    """Clamp every gradient element to [min_value, max_value]."""
+
+    def __init__(self, min_value, max_value=None):
+        if max_value is None:
+            min_value, max_value = -abs(min_value), abs(min_value)
+        self.min_value = float(min_value)
+        self.max_value = float(max_value)
+
+    def __str__(self):
+        return "ClipByValue, min=%f, max=%f" % (self.min_value,
+                                                self.max_value)
+
+    def _clip(self, para_and_grad):
+        out = []
+        for p, g in para_and_grad:
+            if g is None:
+                out.append((p, g))
+                continue
+            out.append((p, jnp.clip(g, self.min_value, self.max_value)))
+        return out
+
+
+class GradClipByNorm(GradClipBase):
+    """Rescale each gradient whose own L2 norm exceeds clip_norm."""
+
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def __str__(self):
+        return "ClipByNorm, clip_norm=%f" % self.clip_norm
+
+    def _clip(self, para_and_grad):
+        out = []
+        for p, g in para_and_grad:
+            if g is None:
+                out.append((p, g))
+                continue
+            norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+            scale = jnp.where(norm > self.clip_norm,
+                              self.clip_norm / jnp.maximum(norm, 1e-12),
+                              jnp.ones_like(norm))
+            out.append((p, g * scale.astype(g.dtype)))
+        return out
+
+
+class GradClipByGlobalNorm(GradClipBase):
+    """Rescale ALL gradients jointly so their global L2 norm is at most
+    max_global_norm."""
+
+    def __init__(self, max_global_norm, dtype="float32"):
+        self.max_global_norm = float(max_global_norm)
+        self.dtype = dtype
+
+    def __str__(self):
+        return "ClipByGlobalNorm, max_global_norm=%f" % self.max_global_norm
+
+    def _clip(self, para_and_grad):
+        grads = [g for _, g in para_and_grad if g is not None]
+        if not grads:
+            return list(para_and_grad)
+        global_sq = sum(jnp.sum(jnp.square(g.astype(self.dtype)))
+                        for g in grads)
+        global_norm = jnp.sqrt(global_sq)
+        scale = jnp.where(
+            global_norm > self.max_global_norm,
+            self.max_global_norm / jnp.maximum(global_norm, 1e-12),
+            jnp.ones_like(global_norm))
+        out = []
+        for p, g in para_and_grad:
+            if g is None:
+                out.append((p, g))
+                continue
+            out.append((p, g * scale.astype(g.dtype)))
+        return out

@@ -41,13 +41,23 @@ class DygraphOptimizer(object):
         raise NotImplementedError
 
     def minimize(self, layer_or_loss=None, startup_program=None,
-                 parameter_list=None, no_grad_set=None, grads=None):
+                 parameter_list=None, no_grad_set=None, grads=None,
+                 grad_clip=None):
         """Positional layout follows fluid's dygraph signature
         minimize(loss, startup_program, parameter_list, no_grad_set):
         minimize(loss_var) after loss.backward() with parameter_list from
         the constructor or this call; minimize(layer) after
-        layer.loss_and_grad(...); or minimize(params, grads=grads_dict)."""
+        layer.loss_and_grad(...); or minimize(params, grads=grads_dict).
+        grad_clip: a dygraph.grad_clip.GradClipBase strategy applied to all
+        (param, grad) pairs before the update (ref optimizer.py minimize's
+        grad_clip argument in dygraph mode)."""
         from .base import EagerVariable
+        if isinstance(startup_program, dict):
+            # Old dygraph signature took grads positionally here; silently
+            # reading p._grad instead would skip updates without erroring.
+            raise TypeError(
+                "minimize() got a dict for startup_program — pass eager "
+                "gradients via the grads= keyword")
         if hasattr(layer_or_loss, "parameters"):
             params = layer_or_loss.parameters()
         elif isinstance(layer_or_loss, EagerVariable) or layer_or_loss is None:
@@ -60,8 +70,11 @@ class DygraphOptimizer(object):
         else:
             params = layer_or_loss
         kernel = get_op(self._op).fn
-        for p in params:
-            g = p._grad if grads is None else grads.get(id(p))
+        pairs = [(p, p._grad if grads is None else grads.get(id(p)))
+                 for p in params]
+        if grad_clip is not None:
+            pairs = grad_clip(pairs)
+        for p, g in pairs:
             if g is None:
                 continue
             slots = self._state.setdefault(id(p), self._slots(p))

@@ -57,7 +57,10 @@ def _current_expected_place():
     # dryrun never self-selects the attached TPU.
     pinned = getattr(jax.config, "jax_default_device", None)
     if pinned is not None:
-        if pinned.platform in ("tpu", "axon"):
+        # jax accepts a Device object or a platform string here.
+        platform = pinned if isinstance(pinned, str) \
+            else getattr(pinned, "platform", None)
+        if platform in ("tpu", "axon"):
             return TPUPlace(getattr(pinned, "id", 0))
         return CPUPlace()
     devs = jax.devices()

@@ -84,9 +84,13 @@ class Categorical(Distribution):
         return ops.sampling_id(probs, seed=seed)
 
     def log_prob(self, value):
-        from .nn import log_softmax, gather_nd
+        """log P(value) for integer class labels: one-hot select on the
+        log-softmax (reference distributions.py Categorical.log_prob)."""
+        from .nn import log_softmax, one_hot
         logp = log_softmax(self.logits)
-        raise NotImplementedError("compose with gather_nd on label indices")
+        depth = int(self.logits.shape[-1])
+        sel = one_hot(value, depth)
+        return reduce_sum(elementwise_mul(logp, sel), dim=-1)
 
     def entropy(self):
         from .nn import log_softmax

@@ -74,14 +74,27 @@ def _seq_op(op_type, inputs, n_out=1, dtypes=None, attrs=None, name=None):
     return outs
 
 
-def sequence_expand(x, y, ref_level=-1):
-    """Reference sequence_expand repeats row i of x by y's LoD count; the
-    dense equivalent for the uniform-count case (its dominant use — beam
-    search / NMT) is a tile along a new axis folded into batch."""
-    raise NotImplementedError(
-        "LoD sequence_expand: on TPU use layers.expand + reshape for "
-        "uniform repeat counts, or sequence_expand_as for per-row "
-        "time-broadcast")
+def sequence_expand(x, y, ref_level=-1, out_len=None, name=None):
+    """Repeat row i of x by a per-row count (reference sequence_expand,
+    layers/sequence_lod.py:596 + sequence_ops/sequence_expand_op.h).
+
+    Dense TPU encoding: ``y`` is the repeat-count int vector (N,) — the
+    dense stand-in for the reference's y-LoD at ref_level — and ``out_len``
+    is the STATIC row capacity of the output (>= the dynamic total; rows
+    past the total come back zeroed). Returns (out, out_length) where
+    out_length is the (1,) dynamic total, mirroring the repo-wide
+    ragged->dense+lengths design.
+    """
+    if out_len is None:
+        raise ValueError(
+            "sequence_expand on TPU needs a static out_len capacity "
+            "(XLA shapes are fixed at trace time); pass e.g. "
+            "N * max_repeat")
+    out, out_length = _seq_op(
+        "sequence_expand", {"X": [x], "RepeatCounts": [y]}, n_out=2,
+        dtypes=[x.dtype, "int32"],
+        attrs={"out_len": int(out_len), "ref_level": ref_level}, name=name)
+    return out, out_length
 
 
 def sequence_expand_as(x, y, lengths=None, name=None):

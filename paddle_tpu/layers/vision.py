@@ -20,10 +20,14 @@ def _triple(v):
     return [v, v, v] if isinstance(v, int) else list(v)
 
 
-def _conv3_out(i, k, p, s, d=1):
+def _conv3_out(i, k, p, s, d=1, ceil=False):
     if i in (None, -1):
         return -1
-    return (i + 2 * p - (d * (k - 1) + 1)) // s + 1
+    num = i + 2 * p - (d * (k - 1) + 1)
+    out = (-(-num // s) if ceil else num // s) + 1
+    if ceil and (out - 1) * s >= i + p:
+        out -= 1  # last window must start inside input+left-pad (ref/torch)
+    return out
 
 
 def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
@@ -66,27 +70,64 @@ def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
     dtype = helper.input_dtype()
     groups = groups or 1
     num_channels = input.shape[1]
-    filter_size = _triple(filter_size)
     stride = _triple(stride)
     padding = _triple(padding)
     dilation = _triple(dilation)
+    if output_size is not None:
+        output_size = _triple(output_size)
+    if filter_size is None:
+        # Reference conv_transpose derives the kernel from output_size:
+        # out = (in-1)*s - 2p + d*(k-1) + 1  =>  k.
+        if output_size is None:
+            raise ValueError(
+                "conv3d_transpose needs filter_size or output_size")
+        if any(input.shape[2 + i] in (None, -1) for i in range(3)):
+            raise ValueError(
+                "conv3d_transpose cannot derive filter_size from "
+                "output_size when input spatial dims are dynamic — pass "
+                "filter_size explicitly")
+        filter_size = [
+            (output_size[i] - (input.shape[2 + i] - 1) * stride[i] +
+             2 * padding[i] - 1) // dilation[i] + 1 for i in range(3)]
+        if any(k <= 0 for k in filter_size):
+            raise ValueError(
+                "conv3d_transpose: output_size %s too small for "
+                "input/stride/padding (derived filter_size %s)"
+                % (list(output_size), filter_size))
+    else:
+        filter_size = _triple(filter_size)
     filter_shape = [num_channels, num_filters // groups] + filter_size
     w = helper.create_parameter(helper.param_attr, shape=filter_shape,
                                 dtype=dtype)
     out_sp = []
     for i in range(3):
         s_in = input.shape[2 + i]
-        out_sp.append(-1 if s_in in (None, -1) else
-                      (s_in - 1) * stride[i] - 2 * padding[i] +
-                      dilation[i] * (filter_size[i] - 1) + 1)
+        derived = (-1 if s_in in (None, -1) else
+                   (s_in - 1) * stride[i] - 2 * padding[i] +
+                   dilation[i] * (filter_size[i] - 1) + 1)
+        if output_size is not None:
+            # Any size in [derived, derived + stride - 1] maps back to the
+            # same input extent (same check as ref conv_transpose_op.cc).
+            if derived != -1 and not (
+                    derived <= output_size[i] < derived + stride[i]):
+                raise ValueError(
+                    "conv3d_transpose output_size[%d]=%d incompatible with "
+                    "input/stride/padding (valid range [%d, %d))"
+                    % (i, output_size[i], derived, derived + stride[i]))
+            out_sp.append(output_size[i])
+        else:
+            out_sp.append(derived)
     pre_bias = helper.create_variable_for_type_inference(
         dtype, (input.shape[0], num_filters) + tuple(out_sp))
+    attrs = {"strides": stride, "paddings": padding, "dilations": dilation,
+             "groups": groups}
+    if output_size is not None:
+        attrs["output_size"] = list(output_size)
     helper.append_op(
         "conv3d_transpose",
         inputs={"Input": [input.name], "Filter": [w.name]},
         outputs={"Output": [pre_bias.name]},
-        attrs={"strides": stride, "paddings": padding, "dilations": dilation,
-               "groups": groups})
+        attrs=attrs)
     pre_act = helper.append_bias_op(pre_bias, dim_start=1, dim_end=2)
     return helper.append_activation(pre_act)
 
@@ -102,14 +143,15 @@ def pool3d(input, pool_size=-1, pool_type="max", pool_stride=1,
         shape = (input.shape[0], input.shape[1], 1, 1, 1)
     else:
         sp = [_conv3_out(input.shape[2 + i], pool_size[i], pool_padding[i],
-                         pool_stride[i]) for i in range(3)]
+                         pool_stride[i], ceil=ceil_mode) for i in range(3)]
         shape = (input.shape[0], input.shape[1]) + tuple(sp)
     out = helper.create_variable_for_type_inference(input.dtype, shape)
     helper.append_op(
         "pool3d", inputs={"X": [input.name]}, outputs={"Out": [out.name]},
         attrs={"pooling_type": pool_type, "ksize": pool_size,
                "strides": pool_stride, "paddings": pool_padding,
-               "global_pooling": global_pooling, "exclusive": exclusive})
+               "global_pooling": global_pooling, "exclusive": exclusive,
+               "ceil_mode": ceil_mode})
     return out
 
 
@@ -137,7 +179,11 @@ def adaptive_pool3d(input, pool_size, pool_type="max", require_index=False,
 def affine_grid(theta, out_shape, name=None):
     helper = LayerHelper("affine_grid", name=name)
     if not isinstance(out_shape, (list, tuple)):
-        out_shape = [int(s) for s in out_shape.shape]  # Variable: static only
+        raise ValueError(
+            "affine_grid on TPU needs out_shape as a static list/tuple "
+            "[N, C, H, W] — XLA shapes are fixed at trace time, so a "
+            "Variable out_shape (reference affine_grid_op OutputShape "
+            "input) cannot be read here")
     out = helper.create_variable_for_type_inference(
         theta.dtype, (theta.shape[0], out_shape[2], out_shape[3], 2))
     helper.append_op("affine_grid", inputs={"Theta": [theta.name]},

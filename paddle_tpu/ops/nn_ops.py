@@ -53,16 +53,19 @@ def _depthwise_conv2d(ctx, ins, attrs):
     return _conv2d(ctx, ins, attrs)
 
 
-def _conv_transpose_nd(x, w, strides, pads, dil, groups, dn):
+def _conv_transpose_nd(x, w, strides, pads, dil, groups, dn, out_sp=None):
     """Fluid's conv_transpose IS the input-gradient of the forward conv
     (ref conv_transpose_op.h computes it with col2im); building it as the
     actual vjp of lax.conv_general_dilated is exact for every
     stride/padding/dilation/groups combination and stays differentiable
-    (vjp-of-vjp). Filter layout: (in_c, out_c/g, *k)."""
+    (vjp-of-vjp). Filter layout: (in_c, out_c/g, *k). out_sp overrides the
+    derived spatial output size (ref output_size attr) — any size whose
+    forward conv maps back to x's extent is valid."""
     k_sp = w.shape[2:]
-    out_sp = tuple(
-        (x.shape[2 + i] - 1) * strides[i] - 2 * pads[i] +
-        dil[i] * (k_sp[i] - 1) + 1 for i in range(len(k_sp)))
+    if out_sp is None:
+        out_sp = tuple(
+            (x.shape[2 + i] - 1) * strides[i] - 2 * pads[i] +
+            dil[i] * (k_sp[i] - 1) + 1 for i in range(len(k_sp)))
     out_shape = (x.shape[0], w.shape[1] * groups) + out_sp
 
     def fwd(y):

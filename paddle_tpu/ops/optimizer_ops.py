@@ -215,3 +215,43 @@ def _dpsgd(ctx, ins, attrs):
     g = g * jnp.minimum(1.0, clip / jnp.maximum(gn, 1e-12))
     noise = sigma * clip * jax.random.normal(ctx.rng(), g.shape, g.dtype)
     return {"ParamOut": p - lr * (g + noise)}
+
+
+@register_op("average_accumulates", differentiable=False)
+def _average_accumulates(ctx, ins, attrs):
+    """Sliding-window parameter-sum accumulators for ModelAverage.
+
+    Reference parity: paddle/fluid/operators/average_accumulates_op.h.
+    All branching is jnp.where on scalar counters so the whole update stays
+    inside the fused jitted step (no host round-trip per step).
+    """
+    p = _p(ins, "param")
+    s1, s2, s3 = _p(ins, "in_sum_1"), _p(ins, "in_sum_2"), _p(ins, "in_sum_3")
+    num_acc = _p(ins, "in_num_accumulates")
+    old_acc = _p(ins, "in_old_num_accumulates")
+    num_upd = _p(ins, "in_num_updates")
+    rate = attrs["average_window"]
+    min_w = attrs["min_average_window"]
+    max_w = attrs["max_average_window"]
+    k_max = 16384  # spill sum_1 into sum_2 to bound accumulation error
+    num_upd = num_upd + 1
+    num_acc = num_acc + 1
+    s1 = s1 + p.astype(s1.dtype)
+    spill = (num_upd % k_max == 0).reshape(())
+    s2 = jnp.where(spill, s2 + s1, s2)
+    s1 = jnp.where(spill, jnp.zeros_like(s1), s1)
+    # reference truncates num_updates*average_window to integer before the
+    # comparison (average_accumulates_op.h std::min<int64_t>)
+    window = jnp.minimum(
+        jnp.int32(max_w),
+        (num_upd.astype(jnp.float32) * rate).astype(jnp.int32))
+    trigger = ((num_acc >= min_w) & (num_acc >= window)).reshape(())
+    s3 = jnp.where(trigger, s1 + s2, s3)
+    s1 = jnp.where(trigger, jnp.zeros_like(s1), s1)
+    s2 = jnp.where(trigger, jnp.zeros_like(s2), s2)
+    old_acc = jnp.where(trigger, num_acc, old_acc)
+    num_acc = jnp.where(trigger, jnp.zeros_like(num_acc), num_acc)
+    return {"out_sum_1": s1, "out_sum_2": s2, "out_sum_3": s3,
+            "out_num_accumulates": num_acc,
+            "out_old_num_accumulates": old_acc,
+            "out_num_updates": num_upd}

@@ -439,8 +439,12 @@ def flash_attention(q, k, v, mask=None, scale=1.0, causal=False,
             # chip is attached, even while a jax.default_device(cpu) pin is
             # routing every computation (including this one) to CPU.
             pinned = getattr(jax.config, "jax_default_device", None)
-            platform = (pinned.platform if pinned is not None
-                        else jax.default_backend())
+            if pinned is None:
+                platform = jax.default_backend()
+            elif isinstance(pinned, str):
+                platform = pinned
+            else:
+                platform = getattr(pinned, "platform", None)
             interpret = platform not in ("tpu", "axon")
     tq, tk = q.shape[2], k.shape[2]
     if causal and tq > tk:

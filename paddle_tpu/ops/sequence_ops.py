@@ -134,3 +134,25 @@ def _sequence_pad_dense(ctx, ins, attrs):
     out = jnp.where(mask.reshape(mask.shape + (1,) * (x.ndim - 2)), x,
                     jnp.asarray(pad_value, x.dtype))
     return {"Out": out, "Length": jnp.minimum(lens, t)}
+
+
+@register_op("sequence_expand", nondiff=("RepeatCounts",))
+def _sequence_expand(ctx, ins, attrs):
+    """Repeat row i of X RepeatCounts[i] times, packed from the top of a
+    static out_len-row buffer (reference sequence_ops/sequence_expand_op.h,
+    python/paddle/fluid/layers/sequence_lod.py:596 — LoD repeat counts
+    become a dense int vector; static capacity keeps XLA shapes fixed).
+    Rows past the dynamic total are zeroed. searchsorted over the count
+    cumsum maps output row -> source row without any host loop."""
+    x = ins["X"][0]
+    counts = ins["RepeatCounts"][0].reshape(-1).astype(jnp.int32)
+    out_len = int(attrs["out_len"])
+    cum = jnp.cumsum(counts)
+    total = jnp.minimum(cum[-1], out_len)  # never report past capacity
+    pos = jnp.arange(out_len, dtype=jnp.int32)
+    row = jnp.searchsorted(cum, pos, side="right").astype(jnp.int32)
+    row = jnp.clip(row, 0, x.shape[0] - 1)
+    out = jnp.take(x, row, axis=0)
+    mask = (pos < total).reshape((-1,) + (1,) * (out.ndim - 1))
+    out = out * mask.astype(out.dtype)
+    return {"Out": out, "OutLength": total.reshape(1)}

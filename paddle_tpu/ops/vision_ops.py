@@ -37,8 +37,10 @@ def _conv3d_transpose(ctx, ins, attrs):
     pads = _triple(attrs.get("paddings", [0, 0, 0]))
     dil = _triple(attrs.get("dilations", [1, 1, 1]))
     groups = attrs.get("groups", 1) or 1
+    out_sp = attrs.get("output_size")
     out = _conv_transpose_nd(x, w, strides, pads, dil, groups,
-                             ("NCDHW", "OIDHW", "NCDHW"))
+                             ("NCDHW", "OIDHW", "NCDHW"),
+                             out_sp=tuple(out_sp) if out_sp else None)
     return {"Output": out}
 
 
@@ -68,7 +70,20 @@ def _pool3d(ctx, ins, attrs):
     pads = _triple(attrs.get("paddings", [0, 0, 0]))
     window = (1, 1) + ks
     strides5 = (1, 1) + strides
-    padding = ((0, 0), (0, 0)) + tuple((p, p) for p in pads)
+    pads2 = [(p, p) for p in pads]
+    if attrs.get("ceil_mode", False):
+        # ceil-mode output needs extra high-side padding so the last
+        # (partial) window exists; the padded region never enters avg
+        # counts when exclusive (the ones-count reduce_window pads zeros)
+        # and is -inf for max.
+        for i in range(3):
+            i_sz, k, s, p = x.shape[2 + i], ks[i], strides[i], pads[i]
+            out_sz = -(-(i_sz + 2 * p - k) // s) + 1
+            if (out_sz - 1) * s >= i_sz + p:
+                out_sz -= 1  # last window must start inside input+left-pad
+            extra = (out_sz - 1) * s + k - (i_sz + 2 * p)
+            pads2[i] = (p, p + max(0, extra))
+    padding = ((0, 0), (0, 0)) + tuple(pads2)
     if ptype == "max":
         init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) \
             else jnp.iinfo(x.dtype).min

@@ -567,6 +567,108 @@ class LookaheadOptimizer(object):
         return ops, pgs
 
 
+class ModelAverage(object):
+    """Sliding-window parameter averaging.
+
+    Reference parity: python/paddle/fluid/optimizer.py:2721 (class
+    ModelAverage) + operators/average_accumulates_op.h. The accumulation op
+    is appended in-graph after the optimize ops, so it fuses into the same
+    jitted step (no per-step host work). ``apply()`` swaps scope params with
+    (sum_1+sum_2+sum_3)/(num_accumulates+old_num_accumulates);
+    ``restore()`` puts the trained params back.
+    """
+
+    def __init__(self, average_window_rate, min_average_window=10000,
+                 max_average_window=10000, regularization=None, name=None):
+        self._rate = float(average_window_rate)
+        self._min_w = int(min_average_window)
+        self._max_w = int(max_average_window)
+        self._name = name or "model_average"
+        self._accs = {}  # param name -> {slot: Variable}
+        program = default_main_program()
+        block = program.global_block()
+        helper = LayerHelper(self._name)
+        for param in program.all_parameters():
+            if not getattr(param, "trainable", True):
+                continue
+            accs = {}
+            for slot in ("sum_1", "sum_2", "sum_3"):
+                v = helper.create_global_variable(
+                    name=unique_name.generate(param.name + "." + slot),
+                    dtype=param.dtype, shape=param.shape, persistable=True)
+                helper.set_variable_initializer(v, ConstantInitializer(0.0))
+                accs[slot] = v
+            for slot in ("num_accumulates", "old_num_accumulates",
+                         "num_updates"):
+                v = helper.create_global_variable(
+                    name=unique_name.generate(param.name + "." + slot),
+                    dtype="int32", shape=[1], persistable=True)
+                helper.set_variable_initializer(v, ConstantInitializer(0))
+                accs[slot] = v
+            self._accs[param.name] = accs
+            block.append_op(
+                "average_accumulates",
+                inputs={"param": [param.name],
+                        "in_sum_1": [accs["sum_1"].name],
+                        "in_sum_2": [accs["sum_2"].name],
+                        "in_sum_3": [accs["sum_3"].name],
+                        "in_num_accumulates": [accs["num_accumulates"].name],
+                        "in_old_num_accumulates":
+                            [accs["old_num_accumulates"].name],
+                        "in_num_updates": [accs["num_updates"].name]},
+                outputs={"out_sum_1": [accs["sum_1"].name],
+                         "out_sum_2": [accs["sum_2"].name],
+                         "out_sum_3": [accs["sum_3"].name],
+                         "out_num_accumulates":
+                             [accs["num_accumulates"].name],
+                         "out_old_num_accumulates":
+                             [accs["old_num_accumulates"].name],
+                         "out_num_updates": [accs["num_updates"].name]},
+                attrs={"average_window": self._rate,
+                       "min_average_window": self._min_w,
+                       "max_average_window": self._max_w,
+                       "op_role": "optimize"})
+
+    def apply(self, executor=None, need_restore=True):
+        """Swap each param with its current window average (context
+        manager, mirroring the reference apply())."""
+        import contextlib
+        import jax.numpy as jnp
+        from .framework.scope import global_scope
+        scope = global_scope()
+        self._backup = {}
+        for pname, accs in self._accs.items():
+            pv = scope.find_var(pname)
+            s1 = scope.find_var(accs["sum_1"].name)
+            s2 = scope.find_var(accs["sum_2"].name)
+            s3 = scope.find_var(accs["sum_3"].name)
+            na = scope.find_var(accs["num_accumulates"].name)
+            no = scope.find_var(accs["old_num_accumulates"].name)
+            if pv is None or s1 is None or na is None:
+                continue
+            total = jnp.maximum((na + no).astype(jnp.float32), 1.0)
+            avg = ((s1.astype(jnp.float32) + s2 + s3) /
+                   total.reshape(())).astype(pv.dtype)
+            self._backup[pname] = pv
+            scope.set_var(pname, avg)
+
+        @contextlib.contextmanager
+        def guard():
+            try:
+                yield
+            finally:
+                if need_restore:
+                    self.restore(executor)
+        return guard()
+
+    def restore(self, executor=None):
+        from .framework.scope import global_scope
+        scope = global_scope()
+        for pname, val in getattr(self, "_backup", {}).items():
+            scope.set_var(pname, val)
+        self._backup = {}
+
+
 class RecomputeOptimizer(object):
     """Reference RecomputeOptimizer trades memory for compute by re-running
     checkpointed segments in backward. On TPU the equivalent lever is XLA

@@ -200,3 +200,53 @@ def test_tape_accumulates_until_clear():
                                    rtol=1e-6)
         net.clear_gradients()
         assert net.weight.gradient() is None
+
+
+def test_dygraph_grad_clip_by_value_and_norm():
+    from paddle_tpu.dygraph.grad_clip import (GradClipByValue,
+                                              GradClipByNorm,
+                                              GradClipByGlobalNorm)
+
+    class P:
+        def __init__(self, g):
+            self._grad = np.asarray(g, np.float32)
+
+    g = np.array([3.0, -4.0], np.float32)
+    pairs = [(P(g), g)]
+    (_, cv), = GradClipByValue(1.0)(pairs)
+    np.testing.assert_allclose(np.asarray(cv), [1.0, -1.0])
+    (_, cn), = GradClipByNorm(2.5)(pairs)   # |g|=5 -> scale 0.5
+    np.testing.assert_allclose(np.asarray(cn), [1.5, -2.0], rtol=1e-6)
+    g2 = np.array([0.0, 0.0], np.float32)
+    pairs2 = [(P(g), g), (P(g2), None)]
+    clipped = GradClipByGlobalNorm(2.5)(pairs2)
+    np.testing.assert_allclose(np.asarray(clipped[0][1]), [1.5, -2.0],
+                               rtol=1e-6)
+    assert clipped[1][1] is None
+    # norm below threshold: untouched
+    (_, cu), = GradClipByGlobalNorm(100.0)([(P(g), g)])
+    np.testing.assert_allclose(np.asarray(cu), g)
+
+
+def test_dygraph_minimize_grad_clip_and_legacy_grads_typeerror():
+    import pytest
+    from paddle_tpu.dygraph.grad_clip import GradClipByGlobalNorm
+    from paddle_tpu.dygraph import optimizers as dopt
+    with dygraph.guard():
+        layer = Linear(2, 1)
+        x = np.ones((4, 2), np.float32)
+
+        def loss_fn(out):
+            from paddle_tpu.dygraph.nn import run_op
+            return run_op("reduce_mean",
+                          {"X": [out]}, {"reduce_all": True})["Out"]
+
+        layer.loss_and_grad(loss_fn, x)
+        w_before = np.asarray(layer.weight._value).copy()
+        opt = dopt.SGD(learning_rate=1.0)
+        opt.minimize(layer, grad_clip=GradClipByGlobalNorm(1e-8))
+        # clipped to ~zero global norm: weights essentially unchanged
+        np.testing.assert_allclose(np.asarray(layer.weight._value),
+                                   w_before, atol=1e-6)
+        with pytest.raises(TypeError):
+            opt.minimize(layer, {"some": "grads"})

@@ -214,3 +214,28 @@ def test_warpctc_layer_builds_and_trains():
             "lb": np.array([[1, 2, 1], [3, 1, 2]], np.int32)}
     out, = exe.run(main, feed=feed, fetch_list=[avg])
     assert np.isfinite(out).all()
+
+
+def test_categorical_log_prob_and_entropy():
+    from paddle_tpu.layers.distributions import Categorical
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        logits = layers.data("cat_logits", (2, 3), "float32",
+                             append_batch_size=False)
+        labels = layers.data("cat_labels", (2,), "int32",
+                             append_batch_size=False)
+        dist = Categorical(logits)
+        lp = dist.log_prob(labels)
+        ent = dist.entropy()
+    exe = pt.Executor()
+    exe.run(startup)
+    lg = np.array([[0.5, 1.5, 0.1], [2.0, 0.0, -1.0]], np.float32)
+    lb = np.array([1, 0], np.int32)
+    lpv, entv = exe.run(main, feed={"cat_logits": lg, "cat_labels": lb},
+                        fetch_list=[lp, ent])
+    ref = lg - np.log(np.exp(lg).sum(-1, keepdims=True))
+    np.testing.assert_allclose(np.asarray(lpv),
+                               ref[np.arange(2), lb], rtol=1e-5)
+    p = np.exp(ref)
+    np.testing.assert_allclose(np.asarray(entv), -(p * ref).sum(-1),
+                               rtol=1e-5)

@@ -152,3 +152,50 @@ def test_ema():
         np.testing.assert_allclose(
             pt.global_scope().get_numpy("w_ema"), 0.75, rtol=1e-6)
     np.testing.assert_allclose(pt.global_scope().get_numpy("w_ema"), 1.0)
+
+
+def test_model_average_no_trigger():
+    """SGD lr=0.1 on loss=sum(w): w walks 1.0 -> 0.6 over 4 steps; the
+    window average of the visited points is 0.75 (min window not hit, so
+    no accumulator reset)."""
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        w = layers.create_parameter(
+            [1], "float32", name="w_ma",
+            default_initializer=pt.initializer.Constant(1.0))
+        loss = layers.reduce_sum(w)
+        optimizer.SGD(learning_rate=0.1).minimize(loss)
+        ma = optimizer.ModelAverage(0.15, min_average_window=10,
+                                    max_average_window=10)
+    exe = pt.Executor()
+    exe.run(startup)
+    for _ in range(4):
+        exe.run(main, feed={}, fetch_list=[loss])
+    with ma.apply(exe):
+        np.testing.assert_allclose(
+            pt.global_scope().get_numpy("w_ma"), 0.75, rtol=1e-5)
+    np.testing.assert_allclose(
+        pt.global_scope().get_numpy("w_ma"), 0.6, rtol=1e-5)
+
+
+def test_model_average_window_reset():
+    """min_average_window=1, max=2, rate=1.0: step1 triggers a reset
+    (old_num=1, sum_3=0.9); step2 accumulates 0.8 -> avg=(0.8+0.9)/2."""
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        w = layers.create_parameter(
+            [1], "float32", name="w_ma2",
+            default_initializer=pt.initializer.Constant(1.0))
+        loss = layers.reduce_sum(w)
+        optimizer.SGD(learning_rate=0.1).minimize(loss)
+        ma = optimizer.ModelAverage(1.0, min_average_window=1,
+                                    max_average_window=2)
+    exe = pt.Executor()
+    exe.run(startup)
+    exe.run(main, feed={}, fetch_list=[loss])
+    exe.run(main, feed={}, fetch_list=[loss])
+    with ma.apply(exe):
+        np.testing.assert_allclose(
+            pt.global_scope().get_numpy("w_ma2"), 0.85, rtol=1e-5)
+    np.testing.assert_allclose(
+        pt.global_scope().get_numpy("w_ma2"), 0.8, rtol=1e-5)

@@ -205,3 +205,50 @@ def test_sequence_erase_layer_roundtrip():
         "len": np.array([[6]], np.int32)}, fetch_list=[out, new_len])
     np.testing.assert_array_equal(o[0], [5, 4, 3, 2, 0, 0])
     np.testing.assert_array_equal(nl, [4])
+
+
+def test_sequence_expand_kernel():
+    x = np.array([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]], np.float32)
+    counts = np.array([2, 0, 3], np.int32)
+    r = _run("sequence_expand", {"X": [x], "RepeatCounts": [counts]},
+             {"out_len": 8})
+    out, total = np.asarray(r["Out"]), int(np.asarray(r["OutLength"])[0])
+    assert total == 5
+    np.testing.assert_allclose(
+        out[:5], [[1, 2], [1, 2], [5, 6], [5, 6], [5, 6]])
+    np.testing.assert_allclose(out[5:], 0.0)
+
+
+def test_sequence_expand_grad():
+    x = jnp.asarray(np.random.RandomState(0).rand(3, 2).astype(np.float32))
+    counts = jnp.asarray(np.array([1, 2, 1], np.int32))
+
+    def f(xv):
+        return jnp.sum(_run("sequence_expand",
+                            {"X": [xv], "RepeatCounts": [counts]},
+                            {"out_len": 6})["Out"] ** 2)
+
+    g = jax.grad(f)(x)
+    # d/dx_i of sum over repeats = count_i * 2 * x_i
+    np.testing.assert_allclose(
+        np.asarray(g), np.asarray(x) * 2 * np.array([[1], [2], [1]]),
+        rtol=1e-5)
+
+
+def test_sequence_expand_layer():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("se_x", shape=[3, 2], dtype="float32",
+                        append_batch_size=False)
+        y = layers.data("se_y", shape=[3], dtype="int32",
+                        append_batch_size=False)
+        out, total = seq.sequence_expand(x, y, out_len=7)
+    exe = pt.Executor()
+    exe.run(startup)
+    ov, tv = exe.run(main, feed={
+        "se_x": np.array([[1, 1], [2, 2], [3, 3]], np.float32),
+        "se_y": np.array([3, 1, 0], np.int32)},
+        fetch_list=[out, total])
+    assert int(tv[0]) == 4
+    np.testing.assert_allclose(
+        np.asarray(ov)[:4], [[1, 1], [1, 1], [1, 1], [2, 2]])

@@ -498,3 +498,84 @@ def test_linear_chain_crf_and_decode_layers():
     assert pv.shape == (2, 5, 1)
     assert (pv >= 0).all() and (pv < 3).all()
     assert np.isfinite(gv).all() and np.abs(gv).sum() > 0
+
+
+def test_conv3d_transpose_output_size_and_derived_filter():
+    import torch
+    import torch.nn.functional as F
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("c3t_x", (1, 2, 3, 3, 3), "float32",
+                        append_batch_size=False)
+        # filter_size derived from output_size: k = (7 - 2*2 + 0 - 1) + 1 = 3
+        out = layers.conv3d_transpose(x, 4, output_size=7, stride=2,
+                                      bias_attr=False)
+    assert tuple(out.shape[2:]) == (7, 7, 7)
+    exe = pt.Executor()
+    exe.run(startup)
+    xv = np.random.RandomState(0).randn(1, 2, 3, 3, 3).astype(np.float32)
+    ov, = exe.run(main, feed={"c3t_x": xv}, fetch_list=[out])
+    w = pt.global_scope().get_numpy(
+        [p.name for p in main.all_parameters()][0])
+    ref = F.conv_transpose3d(torch.tensor(xv), torch.tensor(w),
+                             stride=2, output_padding=0).numpy()
+    # output_size=7 over stride 2 from 3 == derived size (no extra pad)
+    np.testing.assert_allclose(np.asarray(ov), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_conv3d_transpose_output_size_extra_row():
+    # derived = (3-1)*2 + 3 = 7; output_size=8 exercises the in-range
+    # non-default branch (torch output_padding=1 equivalent)
+    import torch
+    import torch.nn.functional as F
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("c3t2_x", (1, 2, 3, 3, 3), "float32",
+                        append_batch_size=False)
+        out = layers.conv3d_transpose(x, 3, filter_size=3, output_size=8,
+                                      stride=2, bias_attr=False)
+    assert tuple(out.shape[2:]) == (8, 8, 8)
+    exe = pt.Executor()
+    exe.run(startup)
+    xv = np.random.RandomState(1).randn(1, 2, 3, 3, 3).astype(np.float32)
+    ov, = exe.run(main, feed={"c3t2_x": xv}, fetch_list=[out])
+    w = pt.global_scope().get_numpy(
+        [p.name for p in main.all_parameters()][0])
+    ref = F.conv_transpose3d(torch.tensor(xv), torch.tensor(w),
+                             stride=2, output_padding=1).numpy()
+    np.testing.assert_allclose(np.asarray(ov), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_pool3d_ceil_mode_matches_torch():
+    import torch
+    import torch.nn.functional as F
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("p3c_x", (1, 2, 6, 6, 6), "float32",
+                        append_batch_size=False)
+        om = layers.pool3d(x, pool_size=3, pool_type="max", pool_stride=2,
+                           ceil_mode=True)
+        oa = layers.pool3d(x, pool_size=3, pool_type="avg", pool_stride=2,
+                           ceil_mode=True)
+    assert tuple(om.shape[2:]) == (3, 3, 3)
+    exe = pt.Executor()
+    exe.run(startup)
+    xv = np.random.RandomState(2).randn(1, 2, 6, 6, 6).astype(np.float32)
+    mv, av = exe.run(main, feed={"p3c_x": xv}, fetch_list=[om, oa])
+    t = torch.tensor(xv)
+    refm = F.max_pool3d(t, 3, stride=2, ceil_mode=True).numpy()
+    refa = F.avg_pool3d(t, 3, stride=2, ceil_mode=True,
+                        count_include_pad=False).numpy()
+    np.testing.assert_allclose(np.asarray(mv), refm, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(av), refa, rtol=1e-5)
+
+
+def test_affine_grid_variable_out_shape_rejected():
+    import pytest
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        theta = layers.data("ag_t", (2, 2, 3), "float32",
+                            append_batch_size=False)
+        shp = layers.data("ag_s", (4,), "int32", append_batch_size=False)
+        with pytest.raises(ValueError):
+            layers.affine_grid(theta, shp)
