@@ -35,6 +35,7 @@ from . import inference
 from . import contrib
 from . import native
 from . import profiler
+from . import debugger
 from . import dataset
 from .dataset import DatasetFactory
 from .parallel_executor import ParallelExecutor

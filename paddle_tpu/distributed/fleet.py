@@ -84,4 +84,5 @@ def main_program_compiled(loss_program=None):
     strategy = _role.get("strategy", mesh_mod.DistributedStrategy())
     bs = BuildStrategy()
     bs.mesh_axes = dict(strategy.mesh_axes)
+    bs.collective_timeout_s = getattr(strategy, "collective_timeout_s", None)
     return CompiledProgram(program, bs)

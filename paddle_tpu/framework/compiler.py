@@ -25,6 +25,9 @@ class BuildStrategy(object):
         self.mesh_axes = None
         self.data_axis = "dp"
         self.check_numerics = False
+        # halt detection: bound each step's completion (None = no guard);
+        # consumed by the run_step watchdog (framework/watchdog.py)
+        self.collective_timeout_s = None
         # parity no-ops
         self.fuse_all_reduce_ops = True
         self.fuse_elewise_add_act_ops = True
@@ -87,7 +90,8 @@ class CompiledProgram(object):
     # ------------------------------------------------------------------
     def _cache_token(self):
         bs = self._build_strategy
-        return (tuple(sorted((bs.mesh_axes or {}).items())), bs.data_axis)
+        return (tuple(sorted((bs.mesh_axes or {}).items())), bs.data_axis,
+                getattr(bs, "collective_timeout_s", None))
 
     def _mesh_obj(self):
         if self._mesh is None:
@@ -124,8 +128,23 @@ class CompiledProgram(object):
             out_shardings=out_sh,
             donate_argnums=(0,))
 
+        timeout_s = getattr(self._build_strategy, "collective_timeout_s",
+                            None)
+        pending = []  # previous step's outputs (one-step-behind watchdog)
+
         def run_step(state_vals, feed_tuple):
             with mesh:
+                if timeout_s is not None and pending:
+                    # Bound-wait on the PREVIOUS step so async dispatch
+                    # (host stages batch N+1 while the chip runs batch N)
+                    # survives; a hung collective surfaces at the next
+                    # step's entry — same one-step-late semantics as the
+                    # reference's NCCL watchdog thread.
+                    from .watchdog import wait_with_timeout
+                    wait_with_timeout(
+                        pending.pop(), timeout_s,
+                        what="CompiledProgram step over mesh %r"
+                        % (tuple(mesh.axis_names),))
                 placed_state = tuple(
                     v if isinstance(v, jax.Array) and
                     getattr(v, "sharding", None) == s
@@ -134,5 +153,8 @@ class CompiledProgram(object):
                 placed_feed = tuple(
                     jax.device_put(v, s)
                     for v, s in zip(feed_tuple, feed_sh))
-                return jitted(placed_state, placed_feed)
+                out = jitted(placed_state, placed_feed)
+                if timeout_s is not None:
+                    pending.append(out)
+                return out
         return run_step

@@ -144,17 +144,7 @@ class Executor(object):
             return []
 
         # ---- prepare state ------------------------------------------------
-        persistable = _persistable_names(program)
-        state_names = sorted(n for n in persistable
-                             if scope.find_var(n) is not None
-                             and n not in feed)
-        uses_rng = _uses_rng(program)
-        if uses_rng:
-            if scope.find_var(STEP_VAR) is None:
-                scope.set_var(STEP_VAR, jnp.asarray(0, jnp.int32))
-            if STEP_VAR not in state_names:
-                state_names.append(STEP_VAR)
-
+        state_names, uses_rng = self._prepare_state(program, feed, scope)
         feed_vals = self._convert_feed(program, feed)
         check_numerics = bool(
             getattr(program, "_check_numerics", False) or
@@ -221,14 +211,31 @@ class Executor(object):
             out.update(zip(host, staged))
         return out
 
-    def _compile(self, program, feed_vals, fetch_names, state_names,
-                 uses_rng, strategy, check_numerics=False):
+    def _prepare_state(self, program, feed, scope):
+        """Select the persistable vars that form the step's carried state
+        (+ the implicit PRNG step counter when the program uses RNG)."""
+        persistable = _persistable_names(program)
+        state_names = sorted(n for n in persistable
+                             if scope.find_var(n) is not None
+                             and n not in feed)
+        uses_rng = _uses_rng(program)
+        if uses_rng:
+            if scope.find_var(STEP_VAR) is None:
+                scope.set_var(STEP_VAR, jnp.asarray(0, jnp.int32))
+            if STEP_VAR not in state_names:
+                state_names.append(STEP_VAR)
+        return state_names, uses_rng
+
+    def _make_step(self, program, feed_names_sorted, fetch_names,
+                   state_names, uses_rng, check_numerics=False):
+        """Build THE pure step function: forward + backward + optimizer ops
+        of `program` traced as one jax computation (what gets jitted)."""
         want_vjp = _want_vjp_set(program)
         seed = program.random_seed
 
         def step(state_tuple, feed_tuple):
             env = dict(zip(state_names, state_tuple))
-            env.update(zip(sorted(feed_vals), feed_tuple))
+            env.update(zip(feed_names_sorted, feed_tuple))
             if uses_rng:
                 step_no = env.get(STEP_VAR, jnp.asarray(0, jnp.int32))
                 base_key = jax.random.fold_in(jax.random.PRNGKey(seed),
@@ -250,6 +257,12 @@ class Executor(object):
                 return fetches, new_state, flag
             return fetches, new_state
 
+        return step
+
+    def _compile(self, program, feed_vals, fetch_names, state_names,
+                 uses_rng, strategy, check_numerics=False):
+        step = self._make_step(program, sorted(feed_vals), fetch_names,
+                               state_names, uses_rng, check_numerics)
         if strategy is not None:
             return strategy._build_step(self, step, program, state_names,
                                         sorted(feed_vals), feed_vals,
@@ -262,6 +275,62 @@ class Executor(object):
             with self._device_ctx():
                 return jitted(state_vals, feed_tuple)
         return run_step
+
+    # ------------------------------------------------------------------
+    def dump_hlo(self, program=None, feed=None, fetch_list=None,
+                 scope=None, include_compiled=True):
+        """Return the XLA text of the SINGLE jitted step for (program,
+        feed, fetch_list): {"lowered": StableHLO, "compiled": optimized
+        HLO}.
+
+        The TPU-native debugger (ref python/paddle/fluid/debugger.py
+        pprint_program / graphviz): one module containing forward, backward
+        and optimizer ops — the fused-step design stated in SURVEY §1 —
+        inspectable as text. Run the startup program first so parameters
+        exist in the scope. Accepts a CompiledProgram too, in which case
+        the module is lowered with the strategy's mesh shardings (the dump
+        then shows the partitioned program with its collectives).
+        """
+        from .compiler import CompiledProgram
+        strategy = None
+        if isinstance(program, CompiledProgram):
+            strategy = program
+            program = program._program
+        if program is None:
+            program = default_main_program()
+        scope = scope if scope is not None else global_scope()
+        feed = dict(feed or {})
+        fetch_list = list(fetch_list or [])
+        fetch_names = [f.name if hasattr(f, "name") else f
+                       for f in fetch_list]
+        state_names, uses_rng = self._prepare_state(program, feed, scope)
+        feed_vals = self._convert_feed(program, feed)
+        step = self._make_step(program, sorted(feed_vals), fetch_names,
+                               state_names, uses_rng)
+        state_vals = tuple(scope.find_var(n) for n in state_names)
+        feed_tuple = tuple(feed_vals[k] for k in sorted(feed_vals))
+        if strategy is not None:
+            mesh = strategy._mesh_obj()
+            state_sh = tuple(strategy._var_sharding(n, mesh)
+                             for n in state_names)
+            feed_sh = tuple(strategy._feed_sharding(n, mesh)
+                            for n in sorted(feed_vals))
+            jitted = jax.jit(step, in_shardings=(state_sh, feed_sh),
+                             out_shardings=(None, state_sh),
+                             donate_argnums=(0,))
+            with mesh:
+                lowered = jitted.lower(state_vals, feed_tuple)
+                out = {"lowered": lowered.as_text()}
+                if include_compiled:
+                    out["compiled"] = lowered.compile().as_text()
+            return out
+        with self._device_ctx():
+            lowered = jax.jit(step, donate_argnums=(0,)).lower(
+                state_vals, feed_tuple)
+            out = {"lowered": lowered.as_text()}
+            if include_compiled:
+                out["compiled"] = lowered.compile().as_text()
+        return out
 
     # ------------------------------------------------------------------
     def _run_eager(self, program, feed, scope):

@@ -1,0 +1,61 @@
+"""Collective/step timeout watchdog — halt & failure detection.
+
+Reference parity: the reference's collective ops carry a timeout and the
+trainer aborts on stuck NCCL rings (operators/collective/ +
+check_nan_inf-style failure hooks). Under XLA a hung ICI/DCN collective
+(straggler host, preempted chip) shows up as a step whose outputs never
+become ready, so the TPU-native guard is a watchdog around
+``block_until_ready``: the wait runs on a helper thread and a bounded join
+turns a silent hang into a diagnosable CollectiveTimeoutError.
+"""
+import threading
+
+import jax
+
+__all__ = ["CollectiveTimeoutError", "wait_with_timeout"]
+
+
+class CollectiveTimeoutError(RuntimeError):
+    """A jitted step (and therefore some collective in it) failed to
+    complete within the configured timeout."""
+
+
+def wait_with_timeout(outputs, timeout_s, what="jitted step"):
+    """Block until every array in ``outputs`` is ready, or raise
+    CollectiveTimeoutError after ``timeout_s`` seconds.
+
+    The computation itself cannot be cancelled (XLA owns the device), but
+    raising lets the trainer log, checkpoint-abort, or tear down the mesh
+    instead of hanging forever — the reference's collective-timeout
+    semantics. Returns ``outputs`` for call-through style.
+    """
+    if timeout_s is None:
+        return outputs
+    leaves = jax.tree_util.tree_leaves(outputs)
+    done = threading.Event()
+    errs = []
+
+    def _waiter():
+        try:
+            for leaf in leaves:
+                ready = getattr(leaf, "block_until_ready", None)
+                if ready is not None:
+                    ready()
+        except Exception as e:          # surface device errors to caller
+            errs.append(e)
+        finally:
+            done.set()
+
+    t = threading.Thread(target=_waiter, daemon=True,
+                         name="paddle_tpu-collective-watchdog")
+    t.start()
+    if not done.wait(float(timeout_s)):
+        raise CollectiveTimeoutError(
+            "%s did not complete within %.1fs (process %d/%d, %d local "
+            "devices) — likely a hung collective: straggler or failed "
+            "host, or a mismatched mesh/sharding across processes"
+            % (what, float(timeout_s), jax.process_index(),
+               jax.process_count(), jax.local_device_count()))
+    if errs:
+        raise errs[0]
+    return outputs
