@@ -9,8 +9,6 @@ bandwidth-optimal, memory O(T/n) per chip, exact (not approximate) attention.
 No reference counterpart (the reference caps at single-device attention);
 this is the capability the north star demands for pod-scale long sequences.
 """
-import functools
-
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -23,38 +21,54 @@ except Exception:  # pragma: no cover
     from jax.experimental.shard_map import shard_map  # type: ignore
 
 
-def _ring_attention_local(q, k, v, axis_name, causal, scale, q_offset_blocks):
-    """Per-shard body. q,k,v: (B, H, Tl, D) local shards."""
+def _vary(x, axis_name):
+    """Mark as device-varying for the shard_map carry type system."""
+    try:
+        return lax.pcast(x, (axis_name,), to="varying")
+    except (AttributeError, TypeError):  # older jax spellings
+        try:
+            return lax.pvary(x, (axis_name,))
+        except AttributeError:
+            return x
+
+
+def _ring_perm(n):
+    """Neighbor rotation i -> i+1; backward MUST replay the forward's exact
+    rotation order (both sides call this one factory)."""
+    return [(i, (i + 1) % n) for i in range(n)]
+
+
+def _block_logits(q, kk, my_idx, kv_idx, scale, causal):
+    """Scaled (and causally masked) logits of the local Q shard against a
+    visiting K block."""
+    tl = q.shape[2]
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, kk,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        q_pos = my_idx * tl + jnp.arange(tl)
+        k_pos = kv_idx * tl + jnp.arange(tl)
+        mask = q_pos[:, None] >= k_pos[None, :]
+        logits = jnp.where(mask[None, None], logits, -1e30)
+    return logits
+
+
+def _ring_forward(q, k, v, axis_name, causal, scale):
+    """Online-softmax ring pass. Returns (out, lse) where lse is the
+    per-row log-sum-exp — the only statistic backward needs."""
     n = lax.axis_size(axis_name)
     my_idx = lax.axis_index(axis_name)
     b, h, tl, d = q.shape
 
-    # online softmax accumulators (pvary: mark as device-varying for the
-    # shard_map carry type system)
-    def _vary(x):
-        try:
-            return lax.pcast(x, (axis_name,), to="varying")
-        except (AttributeError, TypeError):  # older jax spellings
-            try:
-                return lax.pvary(x, (axis_name,))
-            except AttributeError:
-                return x
-    acc = _vary(jnp.zeros((b, h, tl, d), jnp.float32))
-    row_max = _vary(jnp.full((b, h, tl), -jnp.inf, jnp.float32))
-    row_sum = _vary(jnp.zeros((b, h, tl), jnp.float32))
+    acc = _vary(jnp.zeros((b, h, tl, d), jnp.float32), axis_name)
+    row_max = _vary(jnp.full((b, h, tl), -jnp.inf, jnp.float32), axis_name)
+    row_sum = _vary(jnp.zeros((b, h, tl), jnp.float32), axis_name)
 
-    perm = [(i, (i + 1) % n) for i in range(n)]
+    perm = _ring_perm(n)
 
     def block(carry, step):
         acc, row_max, row_sum, kk, vv = carry
         kv_idx = (my_idx - step) % n
-        logits = jnp.einsum("bhqd,bhkd->bhqk", q, kk,
-                            preferred_element_type=jnp.float32) * scale
-        if causal:
-            q_pos = my_idx * tl + jnp.arange(tl)
-            k_pos = kv_idx * tl + jnp.arange(tl)
-            mask = q_pos[:, None] >= k_pos[None, :]
-            logits = jnp.where(mask[None, None], logits, -1e30)
+        logits = _block_logits(q, kk, my_idx, kv_idx, scale, causal)
         blk_max = jnp.max(logits, axis=-1)
         new_max = jnp.maximum(row_max, blk_max)
         correction = jnp.exp(row_max - new_max)
@@ -68,8 +82,66 @@ def _ring_attention_local(q, k, v, axis_name, causal, scale, q_offset_blocks):
 
     (acc, row_max, row_sum, _, _), _ = lax.scan(
         block, (acc, row_max, row_sum, k, v), jnp.arange(n))
-    out = acc / jnp.maximum(row_sum[..., None], 1e-30)
-    return out.astype(q.dtype)
+    safe_sum = jnp.maximum(row_sum, 1e-30)
+    out = acc / safe_sum[..., None]
+    lse = row_max + jnp.log(safe_sum)
+    return out.astype(q.dtype), lse
+
+
+def _make_local(axis_name, causal, scale):
+    """Per-shard ring attention with a custom vjp that REPLAYS the ring in
+    backward (flash-attention-style recompute): residuals are only
+    (q, k, v, out, lse) — O(T/n) per chip — never the n visiting K/V
+    blocks a plain autodiff-through-scan would stash. dK/dV accumulators
+    rotate around the ring in lockstep with their K/V blocks and arrive
+    home after n hops with every device's contribution."""
+
+    @jax.custom_vjp
+    def attn(q, k, v):
+        out, _ = _ring_forward(q, k, v, axis_name, causal, scale)
+        return out
+
+    def fwd(q, k, v):
+        out, lse = _ring_forward(q, k, v, axis_name, causal, scale)
+        return out, (q, k, v, out, lse)
+
+    def bwd(res, dout):
+        q, k, v, out, lse = res
+        n = lax.axis_size(axis_name)
+        my_idx = lax.axis_index(axis_name)
+        dout32 = dout.astype(jnp.float32)
+        # delta_i = sum_j dOut_ij * Out_ij (standard flash backward term)
+        delta = jnp.sum(dout32 * out.astype(jnp.float32), axis=-1)
+        dq0 = _vary(jnp.zeros(q.shape, jnp.float32), axis_name)
+        dk0 = _vary(jnp.zeros(k.shape, jnp.float32), axis_name)
+        dv0 = _vary(jnp.zeros(v.shape, jnp.float32), axis_name)
+        perm = _ring_perm(n)
+
+        def block(carry, step):
+            dq, kk, vv, dkk, dvv = carry
+            kv_idx = (my_idx - step) % n
+            logits = _block_logits(q, kk, my_idx, kv_idx, scale, causal)
+            p = jnp.exp(logits - lse[..., None])      # (B,H,Tq,Tk)
+            dvv = dvv + jnp.einsum("bhqk,bhqd->bhkd", p, dout32)
+            dp = jnp.einsum("bhqd,bhkd->bhqk", dout32,
+                            vv.astype(jnp.float32))
+            ds = p * (dp - delta[..., None]) * scale
+            dq = dq + jnp.einsum("bhqk,bhkd->bhqd", ds,
+                                 kk.astype(jnp.float32))
+            dkk = dkk + jnp.einsum("bhqk,bhqd->bhkd", ds,
+                                   q.astype(jnp.float32))
+            kk = lax.ppermute(kk, axis_name, perm)
+            vv = lax.ppermute(vv, axis_name, perm)
+            dkk = lax.ppermute(dkk, axis_name, perm)
+            dvv = lax.ppermute(dvv, axis_name, perm)
+            return (dq, kk, vv, dkk, dvv), None
+
+        (dq, _, _, dk, dv), _ = lax.scan(
+            block, (dq0, k, v, dk0, dv0), jnp.arange(n))
+        return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+    attn.defvjp(fwd, bwd)
+    return attn
 
 
 def ring_attention(q, k, v, mesh=None, axis_name="sp", causal=False,
@@ -85,7 +157,6 @@ def ring_attention(q, k, v, mesh=None, axis_name="sp", causal=False,
         scale = q.shape[-1] ** -0.5
     spec = P(None, None, axis_name, None)
     fn = shard_map(
-        functools.partial(_ring_attention_local, axis_name=axis_name,
-                          causal=causal, scale=scale, q_offset_blocks=0),
+        _make_local(axis_name, causal, scale),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
     return fn(q, k, v)

@@ -413,3 +413,70 @@ def test_lazy_adam_skips_untouched_rows():
     np.testing.assert_allclose(after[~touched], before[~touched])
     m1 = np.asarray(global_scope().find_var(wname + "_moment1_0"))
     assert np.all(m1[~touched] == 0) and not np.all(m1[touched] == 0)
+
+
+def _full_attention_ref(q, k, v, causal, scale):
+    import jax.numpy as jnp
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        t = q.shape[2]
+        mask = jnp.tril(jnp.ones((t, t), bool))
+        logits = jnp.where(mask, logits, -1e30)
+    p = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_backward_matches_full(causal):
+    """Custom ring-recompute vjp must give the exact dq/dk/dv of full
+    attention (VERDICT r2 weak #8)."""
+    from paddle_tpu.distributed import init_mesh
+    from paddle_tpu.distributed.ring_attention import ring_attention
+    mesh = init_mesh({"sp": 8})
+    rng = np.random.RandomState(5)
+    b, h, t, d = 2, 2, 32, 8
+    q = rng.randn(b, h, t, d).astype(np.float32)
+    k = rng.randn(b, h, t, d).astype(np.float32)
+    v = rng.randn(b, h, t, d).astype(np.float32)
+    w = rng.randn(b, h, t, d).astype(np.float32)  # cotangent seed
+    scale = d ** -0.5
+
+    def loss_ring(q, k, v):
+        import jax.numpy as jnp
+        return jnp.sum(ring_attention(q, k, v, mesh=mesh, axis_name="sp",
+                                      causal=causal) * w)
+
+    def loss_full(q, k, v):
+        import jax.numpy as jnp
+        return jnp.sum(_full_attention_ref(q, k, v, causal, scale) * w)
+
+    gq, gk, gv = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    rq, rk, rv = jax.grad(loss_full, argnums=(0, 1, 2))(q, k, v)
+    np.testing.assert_allclose(np.asarray(gq), np.asarray(rq),
+                               rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(gk), np.asarray(rk),
+                               rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(gv), np.asarray(rv),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_ring_attention_backward_no_stacked_kv_residuals():
+    """The vjp residuals must be O(T/n) per chip: the jaxpr of grad(ring)
+    must not stash an (n_steps, ...) stack of visiting K/V blocks the way
+    autodiff-through-scan would (VERDICT r2 weak #8 'done' criterion)."""
+    from paddle_tpu.distributed import init_mesh
+    from paddle_tpu.distributed.ring_attention import ring_attention
+    mesh = init_mesh({"sp": 8})
+    b, h, t, d = 1, 2, 32, 8
+    tl = t // 8
+
+    def loss(q, k, v):
+        import jax.numpy as jnp
+        return jnp.sum(ring_attention(q, k, v, mesh=mesh, axis_name="sp"))
+
+    x = np.zeros((b, h, t, d), np.float32)
+    jaxpr_text = str(jax.make_jaxpr(jax.grad(loss, argnums=(0, 1, 2)))
+                     (x, x, x))
+    # a stacked residual would appear as a (8, b, h, tl, d) float32 array
+    stacked = "f32[8,%d,%d,%d,%d]" % (b, h, tl, d)
+    assert stacked not in jaxpr_text.replace(" ", "")
