@@ -61,6 +61,8 @@ class DistributedOptimizer(object):
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None):
+        if getattr(self._strategy, "pipeline", False):
+            return self._minimize_pipeline(loss)
         ops, pgs = self._inner.minimize(loss, startup_program,
                                         parameter_list, no_grad_set)
         # ZeRO-1: shard optimizer moments over dp when requested
@@ -70,6 +72,33 @@ class DistributedOptimizer(object):
                 if var.shape and len(var.shape) >= 1 and var.shape[0] > 1:
                     var.sharding = ("dp",) + (None,) * (len(var.shape) - 1)
         return ops, pgs
+
+
+    def _minimize_pipeline(self, loss):
+        """Pipeline mode (ref fluid PipelineOptimizer): instead of
+        appending backward+update ops, partition the stage-stamped Program
+        (pipeline_program.extract_pipeline_plan) and install the plan +
+        optimizer on it; Executor.run then executes the GPipe/1F1B
+        shard_map schedule and the functional update twin of the inner
+        optimizer, all in one jitted step."""
+        from . import pipeline_program as ppp
+        strategy = self._strategy
+        program = loss.block.program
+        plan = ppp.extract_pipeline_plan(
+            program, loss.name,
+            schedule=getattr(strategy, "pp_schedule", "1f1b"),
+            n_micro=getattr(strategy, "pp_num_micro", 1))
+        # fail fast on unsupported optimizers, at minimize time not run time
+        ppp.make_update_fn(self._inner)
+        program._pp_plan = plan
+        program._pp_optimizer = self._inner
+        # a re-minimize must not reuse a step/optimizer-state compiled for
+        # the previous plan/optimizer
+        program._pp_step = None
+        program._pp_step_key = None
+        program._pp_opt_state = None
+        program._version += 1
+        return [], []
 
 
 def distributed_optimizer(optimizer, strategy=None):

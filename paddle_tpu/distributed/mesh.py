@@ -29,6 +29,10 @@ class DistributedStrategy(object):
         self.gradient_merge_steps = 1
         self.sharding_optimizer_state = False  # ZeRO-1 style
         self.collective_timeout_s = 600.0
+        # pipeline parallelism (fleet path; distributed/pipeline_program.py)
+        self.pipeline = False
+        self.pp_schedule = "1f1b"      # "1f1b" | "gpipe"
+        self.pp_num_micro = 1
 
 
 def init_mesh(mesh_axes=None, devices=None, multihost=False):

@@ -27,23 +27,41 @@ except Exception:  # pragma: no cover
     from jax.experimental.shard_map import shard_map  # type: ignore
 
 
-def _pvary(x, axis_name):
-    try:
-        return lax.pcast(x, (axis_name,), to="varying")
-    except (AttributeError, TypeError):
+def _pvary(x, axis_names):
+    if isinstance(axis_names, str):
+        axis_names = (axis_names,)
+    # per-axis so an already-varying axis (e.g. zeros_like of pp-sharded
+    # params) is simply skipped
+    for a in axis_names:
+        if not a:
+            continue
         try:
-            return lax.pvary(x, (axis_name,))
-        except AttributeError:
-            return x
+            x = lax.pcast(x, (a,), to="varying")
+        except ValueError:      # already varying on this axis
+            pass
+        except (AttributeError, TypeError):
+            try:
+                x = lax.pvary(x, (a,))
+            except (AttributeError, ValueError):
+                pass
+    return x
+
+
+def _data_spec(dp_axis):
+    """Spec for (n_micro, micro_batch, ...) data: micro dim replicated,
+    batch dim sharded over dp when a dp axis is in play."""
+    return P(None, dp_axis) if dp_axis else P()
 
 
 def pipeline_forward(stage_fn, params_stacked, x_micro, mesh,
-                     axis_name="pp"):
+                     axis_name="pp", dp_axis=None):
     """Run a GPipe forward over the pp ring.
 
     stage_fn(stage_params, h) -> h        (same signature every stage)
     params_stacked: pytree with leading dim n_stage (stage-sharded on pp)
     x_micro: (n_micro, micro_batch, ...) microbatched input
+    dp_axis: optional second mesh axis the micro-batch dim is sharded over
+    (dp x pp: params replicated over dp, XLA psums their grads there).
     Returns (n_micro, micro_batch, ...) outputs of the LAST stage.
     """
     n_stage = mesh.shape[axis_name]
@@ -51,15 +69,17 @@ def pipeline_forward(stage_fn, params_stacked, x_micro, mesh,
     ticks = n_micro + n_stage - 1
     perm = [(i, (i + 1) % n_stage) for i in range(n_stage)]
 
+    vary_axes = (axis_name, dp_axis)
+
     def local_fn(params_local, x_local):
         # params_local: this stage's params (leading dim 1) ; x_local: all
-        # microbatches (replicated input to stage 0)
+        # microbatches (replicated input to stage 0, dp-sharded batch dim)
         stage = lax.axis_index(axis_name)
         params_me = jax.tree.map(lambda p: p[0], params_local)
         h_shape = x_local.shape[1:]
-        carry_in = _pvary(jnp.zeros(h_shape, x_local.dtype), axis_name)
+        carry_in = _pvary(jnp.zeros(h_shape, x_local.dtype), vary_axes)
         outputs = _pvary(jnp.zeros((n_micro,) + h_shape, x_local.dtype),
-                         axis_name)
+                         vary_axes)
 
         def tick(state, t):
             carry, outputs = state
@@ -92,19 +112,21 @@ def pipeline_forward(stage_fn, params_stacked, x_micro, mesh,
     fn = shard_map(
         local_fn, mesh=mesh,
         in_specs=(jax.tree.map(lambda _: P(axis_name), params_stacked),
-                  P()),
-        out_specs=P())
+                  _data_spec(dp_axis)),
+        out_specs=_data_spec(dp_axis))
     return fn(params_stacked, x_micro)
 
 
 def pipeline_loss_and_grads(stage_fn, loss_fn, params_stacked, x_micro,
-                            y_micro, mesh, axis_name="pp"):
+                            y_micro, mesh, axis_name="pp", dp_axis=None):
     """Differentiable pipeline step: mean loss over microbatches and grads
-    for every stage's params (stage-sharded like the params)."""
+    for every stage's params (stage-sharded like the params). With dp_axis
+    the micro-batch dim is dp-sharded; AD's shard_map transpose inserts the
+    dp psum on parameter grads automatically."""
 
     def total_loss(params_stacked):
         out = pipeline_forward(stage_fn, params_stacked, x_micro, mesh,
-                               axis_name)
+                               axis_name, dp_axis=dp_axis)
         return loss_fn(out, y_micro)
 
     return jax.value_and_grad(total_loss)(params_stacked)
@@ -117,7 +139,7 @@ def stack_stage_params(per_stage_params):
 
 
 def pipeline_1f1b_step(stage_fn, loss_fn, params_stacked, x_micro, y_micro,
-                       mesh, axis_name="pp"):
+                       mesh, axis_name="pp", dp_axis=None):
     """1F1B pipeline schedule (reference PipelineOptimizer's successor
     schedule; fluid's section_worker runs plain GPipe).
 
@@ -147,6 +169,8 @@ def pipeline_1f1b_step(stage_fn, loss_fn, params_stacked, x_micro, y_micro,
     perm_fwd = [(i, (i + 1) % n_stage) for i in range(n_stage)]
     perm_bwd = [(i, (i - 1) % n_stage) for i in range(n_stage)]
 
+    vary_axes = (axis_name, dp_axis)
+
     def local_fn(params_local, x_local, y_local):
         stage = lax.axis_index(axis_name)
         params_me = jax.tree.map(lambda p: p[0], params_local)
@@ -157,13 +181,20 @@ def pipeline_1f1b_step(stage_fn, loss_fn, params_stacked, x_micro, y_micro,
         def fwd_of(h_in):
             return stage_fn(params_me, h_in)
 
+        # params_me is REPLICATED over dp, so a vjp against it would make
+        # shard_map's AD insert a param-sized dp psum EVERY tick. Marking
+        # the params dp-varying first keeps each tick's cotangent local;
+        # one psum after the scan does the whole reduction.
+        params_vjp = params_me if dp_axis is None else jax.tree.map(
+            lambda p: _pvary(p, (dp_axis,)), params_me)
+
         init = dict(
-            fwd_carry=_pvary(zero_h, axis_name),
-            bwd_carry=_pvary(zero_h, axis_name),
-            stash=_pvary(jnp.zeros((slots,) + h_shape, dtype), axis_name),
-            # params_me is pp-sharded, so its zeros are already "varying"
-            grad_acc=jax.tree.map(jnp.zeros_like, params_me),
-            loss_acc=_pvary(jnp.zeros((), jnp.float32), axis_name),
+            fwd_carry=_pvary(zero_h, vary_axes),
+            bwd_carry=_pvary(zero_h, vary_axes),
+            stash=_pvary(jnp.zeros((slots,) + h_shape, dtype), vary_axes),
+            grad_acc=jax.tree.map(
+                lambda p: _pvary(jnp.zeros_like(p), vary_axes), params_me),
+            loss_acc=_pvary(jnp.zeros((), jnp.float32), vary_axes),
         )
 
         def tick(state, k):
@@ -190,13 +221,14 @@ def pipeline_1f1b_step(stage_fn, loss_fn, params_stacked, x_micro, y_micro,
             loss_m, loss_vjp = jax.vjp(lambda h: loss_fn(h, y_m), h_out)
             is_last = stage == n_stage - 1
             loss_acc = state["loss_acc"] + jnp.where(
-                fwd_valid & is_last, loss_m.astype(jnp.float32), 0.0)
+                fwd_valid & is_last,
+                loss_m.astype(jnp.float32).reshape(()), 0.0)
             (g_seed,) = loss_vjp(jnp.ones_like(loss_m))
 
             # ---- backward micro-step (rematerialized vjp) --------------
             h_in_b = lax.dynamic_index_in_dim(stash, mb_c % slots, 0,
                                               keepdims=False)
-            _, stage_vjp = jax.vjp(stage_fn, params_me, h_in_b)
+            _, stage_vjp = jax.vjp(stage_fn, params_vjp, h_in_b)
             g_out = jnp.where(is_last, g_seed, state["bwd_carry"])
             dparams, dh_in = stage_vjp(g_out.astype(dtype))
             grad_acc = jax.tree.map(
@@ -212,12 +244,17 @@ def pipeline_1f1b_step(stage_fn, loss_fn, params_stacked, x_micro, y_micro,
 
         state, _ = lax.scan(tick, init, jnp.arange(ticks))
         loss = lax.psum(state["loss_acc"], axis_name) / n_micro
-        grads = jax.tree.map(lambda g: (g / n_micro)[None], state["grad_acc"])
+        grads = jax.tree.map(lambda g: g / n_micro, state["grad_acc"])
+        if dp_axis is not None:
+            # one batched dp reduction for the whole step (see params_vjp)
+            loss = lax.pmean(loss, dp_axis)
+            grads = jax.tree.map(lambda g: lax.pmean(g, dp_axis), grads)
+        grads = jax.tree.map(lambda g: g[None], grads)
         return loss, grads
 
     fn = shard_map(
         local_fn, mesh=mesh,
         in_specs=(jax.tree.map(lambda _: P(axis_name), params_stacked),
-                  P(), P()),
+                  _data_spec(dp_axis), _data_spec(dp_axis)),
         out_specs=(P(), jax.tree.map(lambda _: P(axis_name), params_stacked)))
     return fn(params_stacked, x_micro, y_micro)

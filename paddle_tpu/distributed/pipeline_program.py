@@ -1,0 +1,317 @@
+"""Static-Program pipeline partitioning — the fleet pp path.
+
+Reference parity: fluid PipelineOptimizer + section_worker
+(python/paddle/fluid/optimizer.py class PipelineOptimizer,
+paddle/fluid/framework/device_worker.cc SectionWorker): the reference cuts
+a Program into device-annotated "sections" run on different GPUs joined by
+queues. TPU-native: ops are stamped with a ``pp_stage`` attr (via
+``pp_stage_guard``, our device_guard), the stages are validated to be
+structurally identical, and ONE stage callable is traced from the stage-0
+template — the SPMD form distributed/pipeline.py's GPipe/1F1B schedules
+need. fleet.distributed_optimizer wires this plan into Executor.run.
+
+v1 contract (validated, with clear errors):
+  feed x -> [stage 0 | stage 1 | ... | stage n-1] -> loss section(h, y)
+  - every stage has the same op-type sequence and parameter shapes;
+  - each stage consumes exactly one non-parameter activation;
+  - the trailing (unstamped) loss section uses no parameters.
+"""
+import contextlib
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+@contextlib.contextmanager
+def pp_stage_guard(stage, program=None):
+    """Stamp every op appended inside with pp_stage=stage (device_guard
+    equivalent; ref fluid.device_guard usage in pipeline models)."""
+    from ..framework.program import default_main_program
+    program = program if program is not None else default_main_program()
+    old = getattr(program, "_pp_stage_ctx", None)
+    program._pp_stage_ctx = int(stage)
+    try:
+        yield
+    finally:
+        program._pp_stage_ctx = old
+
+
+class PipelinePlan(object):
+    """Everything Executor needs to run a stage-partitioned Program."""
+
+    __slots__ = ("n_stage", "template_ops", "tail_ops", "stage_params",
+                 "template_params", "stage_in", "stage_out", "x_feed",
+                 "y_feed", "loss_name", "schedule", "n_micro")
+
+    def __init__(self, **kw):
+        for k in self.__slots__:
+            setattr(self, k, kw.get(k))
+
+
+def _is_param(block, name):
+    from ..framework.program import Parameter
+    var = block._find_var_recursive(name)
+    return isinstance(var, Parameter)
+
+
+def _stage_signature(ops):
+    """Structural signature for homogeneity checks: op types + attrs
+    (minus the stage stamp) + slot arities."""
+    sig = []
+    for op in ops:
+        attrs = {k: v for k, v in op.attrs.items() if k != "pp_stage"}
+        sig.append((op.type, sorted((k, len(v)) for k, v in op.inputs.items()),
+                    sorted((k, len(v)) for k, v in op.outputs.items()),
+                    sorted((k, repr(v)) for k, v in attrs.items())))
+    return sig
+
+
+def _stage_io(block, ops):
+    """(params, external_input, output) of one stage's op list."""
+    produced = set()
+    params, external = [], []
+    for op in ops:
+        for name in op.input_names():
+            if name in produced or name in params or name in external:
+                continue
+            if _is_param(block, name):
+                params.append(name)
+            else:
+                external.append(name)
+        produced.update(op.output_names())
+    if len(external) != 1:
+        raise ValueError(
+            "pipeline stage must consume exactly one activation; got "
+            "external inputs %r (feed labels/aux inputs belong to the "
+            "unstamped loss section)" % (external,))
+    # stage output: last op's first output that leaves the stage is the
+    # conventional chain var; use the final op's first output slot.
+    out = ops[-1].output_names()[-1]
+    return params, external[0], out
+
+
+def extract_pipeline_plan(program, loss_name, schedule="1f1b", n_micro=1):
+    """Partition `program` into the homogeneous-stage pipeline plan."""
+    blk = program.global_block()
+    staged, tail, head = {}, [], []
+    for op in blk.ops:
+        s = op.attrs.get("pp_stage")
+        if s is None:
+            (tail if staged else head).append(op)
+        else:
+            staged.setdefault(int(s), []).append(op)
+    if not staged:
+        raise ValueError("no ops stamped with pp_stage — build the model "
+                         "inside pp_stage_guard(stage) sections")
+    if head:
+        raise ValueError(
+            "ops before the first pipeline stage are not supported (v1): "
+            "%r" % [op.type for op in head])
+    n_stage = len(staged)
+    if sorted(staged) != list(range(n_stage)):
+        raise ValueError("pp_stage stamps must be contiguous 0..n-1; got %r"
+                         % sorted(staged))
+    template = staged[0]
+    tsig = _stage_signature(template)
+    for s in range(1, n_stage):
+        if _stage_signature(staged[s]) != tsig:
+            raise ValueError(
+                "pipeline stages must be structurally identical (SPMD "
+                "GPipe/1F1B contract); stage %d differs from stage 0" % s)
+    per_stage_io = [_stage_io(blk, staged[s]) for s in range(n_stage)]
+    template_params, stage_in, stage_out = per_stage_io[0]
+    for s in range(n_stage):
+        ps, _, _ = per_stage_io[s]
+        for a, b in zip(template_params, ps):
+            va, vb = blk._find_var_recursive(a), blk._find_var_recursive(b)
+            if tuple(va.shape) != tuple(vb.shape):
+                raise ValueError(
+                    "stage %d param %s shape %s != stage 0 param %s shape "
+                    "%s" % (s, b, vb.shape, a, va.shape))
+    # chain check: stage s+1's input must be stage s's output
+    for s in range(1, n_stage):
+        if per_stage_io[s][1] != per_stage_io[s - 1][2]:
+            raise ValueError(
+                "stage %d consumes %r but stage %d produces %r — stages "
+                "must chain" % (s, per_stage_io[s][1], s - 1,
+                                per_stage_io[s - 1][2]))
+    # tail: loss section h, y -> loss
+    tail_params = set()
+    produced = set()
+    tail_external = []
+    for op in tail:
+        for name in op.input_names():
+            if name in produced or name in tail_external:
+                continue
+            if _is_param(blk, name):
+                tail_params.add(name)
+            elif name != per_stage_io[-1][2]:
+                tail_external.append(name)
+        produced.update(op.output_names())
+    if tail_params:
+        raise ValueError("loss section with parameters is not supported "
+                         "(v1): %r" % sorted(tail_params))
+    if len(tail_external) != 1:
+        raise ValueError(
+            "loss section must consume the last stage's output plus exactly "
+            "one label feed; got extra inputs %r" % (tail_external,))
+    if loss_name not in produced:
+        raise ValueError("loss %r is not produced by the unstamped tail "
+                         "section" % loss_name)
+    return PipelinePlan(
+        n_stage=n_stage, template_ops=template, tail_ops=tail,
+        stage_params=[per_stage_io[s][0] for s in range(n_stage)],
+        template_params=template_params, stage_in=stage_in,
+        stage_out=per_stage_io[-1][2], x_feed=stage_in,
+        y_feed=tail_external[0], loss_name=loss_name,
+        schedule=schedule, n_micro=int(n_micro))
+
+
+def make_stage_fn(program, plan):
+    """ONE SPMD stage callable traced from the stage-0 template:
+    stage_fn({template_param_name: value}, h) -> h_next."""
+    from ..framework.trace import TraceContext, trace_op
+
+    def stage_fn(params_me, h):
+        env = dict(params_me)
+        env[plan.stage_in] = h
+        ctx = TraceContext(program, jax.random.PRNGKey(program.random_seed))
+        for i, op in enumerate(plan.template_ops):
+            trace_op(op, env, ctx, rng_tag=7000003 + i)
+        return env[plan.template_ops[-1].output_names()[-1]]
+
+    return stage_fn
+
+
+def make_loss_fn(program, plan):
+    """loss_fn(h_last, y) -> scalar, traced from the unstamped tail."""
+    from ..framework.trace import TraceContext, trace_op
+    last_out = plan.stage_out
+
+    def loss_fn(h, y):
+        env = {last_out: h, plan.y_feed: y}
+        ctx = TraceContext(program, jax.random.PRNGKey(program.random_seed))
+        for i, op in enumerate(plan.tail_ops):
+            trace_op(op, env, ctx, rng_tag=9000003 + i)
+        return env[plan.loss_name]
+
+    return loss_fn
+
+
+def stack_params_from_scope(plan, scope):
+    """{template_param_name: (n_stage, ...) stacked values} from the
+    per-stage scope entries."""
+    stacked = {}
+    for j, tname in enumerate(plan.template_params):
+        vals = []
+        for s in range(plan.n_stage):
+            v = scope.find_var(plan.stage_params[s][j])
+            if v is None:
+                raise ValueError("pipeline param %r not initialized — run "
+                                 "the startup program first"
+                                 % plan.stage_params[s][j])
+            vals.append(v)
+        stacked[tname] = jnp.stack(vals)
+    return stacked
+
+
+def unstack_params_to_scope(plan, scope, stacked):
+    for j, tname in enumerate(plan.template_params):
+        arr = stacked[tname]
+        for s in range(plan.n_stage):
+            scope.set_var(plan.stage_params[s][j], arr[s])
+
+
+def microbatch(x, n_micro):
+    x = jnp.asarray(x)
+    if x.shape[0] % n_micro:
+        raise ValueError("batch %d not divisible by n_micro %d"
+                         % (x.shape[0], n_micro))
+    return x.reshape((n_micro, x.shape[0] // n_micro) + x.shape[1:])
+
+
+class _KernelCtx(object):
+    def rng(self):
+        return jax.random.PRNGKey(0)
+
+
+def make_update_fn(inner):
+    """Functional (jittable) twin of a graph optimizer for the pipeline
+    path, reusing the SAME ops/optimizer_ops kernels minimize() would
+    append. v1 supports SGD / Momentum / Adam (+AdamW); the kernels are
+    elementwise so they apply unchanged to (n_stage, ...) stacked params.
+
+    Returns (init_fn(params)->state, update_fn(params, grads, state)
+    -> (new_params, new_state)); params/grads/state are dicts of stacked
+    arrays keyed by template param name."""
+    from ..ops.registry import get_op
+    name = type(inner).__name__
+    lr = inner._learning_rate
+    if callable(lr):
+        raise ValueError("pipeline path needs a float learning rate (v1)")
+    lrv = jnp.asarray([float(lr)], jnp.float32)
+    ctx = _KernelCtx()
+
+    if name == "SGDOptimizer":
+        kern = get_op("sgd").fn
+
+        def init_fn(params):
+            return {}
+
+        def update_fn(params, grads, state):
+            new = {k: kern(ctx, {"Param": [p], "Grad": [grads[k]],
+                                 "LearningRate": [lrv]}, {})["ParamOut"]
+                   for k, p in params.items()}
+            return new, state
+    elif name == "MomentumOptimizer":
+        kern = get_op("momentum").fn
+        attrs = {"mu": inner._momentum,
+                 "use_nesterov": inner._use_nesterov}
+
+        def init_fn(params):
+            return {k: jnp.zeros_like(p) for k, p in params.items()}
+
+        def update_fn(params, grads, state):
+            new_p, new_s = {}, {}
+            for k, p in params.items():
+                outs = kern(ctx, {"Param": [p], "Grad": [grads[k]],
+                                  "Velocity": [state[k]],
+                                  "LearningRate": [lrv]}, attrs)
+                new_p[k] = outs["ParamOut"]
+                new_s[k] = outs["VelocityOut"]
+            return new_p, new_s
+    elif name in ("AdamOptimizer", "AdamWOptimizer"):
+        kern = get_op(inner._update_op).fn
+        attrs = {"beta1": inner._beta1, "beta2": inner._beta2,
+                 "epsilon": inner._epsilon, "lazy_mode": False}
+        if name == "AdamWOptimizer":
+            attrs.update(inner._extra_attrs())
+
+        def init_fn(params):
+            return {k: {"m1": jnp.zeros(p.shape, jnp.float32),
+                        "m2": jnp.zeros(p.shape, jnp.float32),
+                        "b1p": jnp.asarray([inner._beta1], jnp.float32),
+                        "b2p": jnp.asarray([inner._beta2], jnp.float32)}
+                    for k, p in params.items()}
+
+        def update_fn(params, grads, state):
+            new_p, new_s = {}, {}
+            for k, p in params.items():
+                s = state[k]
+                outs = kern(ctx, {"Param": [p], "Grad": [grads[k]],
+                                  "Moment1": [s["m1"]], "Moment2": [s["m2"]],
+                                  "Beta1Pow": [s["b1p"]],
+                                  "Beta2Pow": [s["b2p"]],
+                                  "LearningRate": [lrv]}, attrs)
+                new_p[k] = outs["ParamOut"]
+                new_s[k] = {"m1": outs["Moment1Out"],
+                            "m2": outs["Moment2Out"],
+                            "b1p": outs["Beta1PowOut"],
+                            "b2p": outs["Beta2PowOut"]}
+            return new_p, new_s
+    else:
+        raise ValueError(
+            "pipeline path supports SGD/Momentum/Adam/AdamW (v1); got %s"
+            % name)
+    return init_fn, update_fn

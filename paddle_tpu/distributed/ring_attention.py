@@ -21,15 +21,7 @@ except Exception:  # pragma: no cover
     from jax.experimental.shard_map import shard_map  # type: ignore
 
 
-def _vary(x, axis_name):
-    """Mark as device-varying for the shard_map carry type system."""
-    try:
-        return lax.pcast(x, (axis_name,), to="varying")
-    except (AttributeError, TypeError):  # older jax spellings
-        try:
-            return lax.pvary(x, (axis_name,))
-        except AttributeError:
-            return x
+from .pipeline import _pvary as _vary  # shared pcast/pvary compat shim
 
 
 def _ring_perm(n):

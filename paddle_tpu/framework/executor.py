@@ -143,6 +143,10 @@ class Executor(object):
             self._run_eager(program, feed, scope)
             return []
 
+        if getattr(program, "_pp_plan", None) is not None:
+            return self._run_pipeline(program, feed, fetch_names, scope,
+                                      return_numpy)
+
         # ---- prepare state ------------------------------------------------
         state_names, uses_rng = self._prepare_state(program, feed, scope)
         feed_vals = self._convert_feed(program, feed)
@@ -275,6 +279,79 @@ class Executor(object):
             with self._device_ctx():
                 return jitted(state_vals, feed_tuple)
         return run_step
+
+    # ------------------------------------------------------------------
+    def _run_pipeline(self, program, feed, fetch_names, scope,
+                      return_numpy):
+        """Execute a fleet-partitioned pipeline Program: one jitted step =
+        GPipe/1F1B schedule over the mesh's pp axis (x dp when present) +
+        the inner optimizer's functional update on the stacked stage
+        params (distributed/pipeline_program.py)."""
+        from ..distributed import pipeline_program as ppp
+        from ..distributed.pipeline import (pipeline_loss_and_grads,
+                                            pipeline_1f1b_step)
+        from ..distributed.mesh import get_mesh
+        plan = program._pp_plan
+        mesh = get_mesh()
+        if mesh is None or "pp" not in mesh.axis_names:
+            raise ValueError(
+                "pipeline program needs an installed mesh with a 'pp' "
+                "axis — call fleet.init with mesh_axes containing 'pp'")
+        if mesh.shape["pp"] != plan.n_stage:
+            raise ValueError(
+                "program has %d pipeline stages but the mesh 'pp' axis has "
+                "%d devices — they must match" % (plan.n_stage,
+                                                  mesh.shape["pp"]))
+        if list(fetch_names) != [plan.loss_name]:
+            raise ValueError(
+                "pipeline path fetches only the loss %r (v1); got %r"
+                % (plan.loss_name, list(fetch_names)))
+        params = ppp.stack_params_from_scope(plan, scope)
+        opt_state = getattr(program, "_pp_opt_state", None)
+        init_fn, update_fn = ppp.make_update_fn(program._pp_optimizer)
+        if opt_state is None:
+            opt_state = init_fn(params)
+        feed_vals = self._convert_feed(program, feed)
+        x = ppp.microbatch(feed_vals[plan.x_feed], plan.n_micro)
+        y = ppp.microbatch(feed_vals[plan.y_feed], plan.n_micro)
+        dp_axis = "dp" if ("dp" in mesh.axis_names and
+                           mesh.shape["dp"] > 1) else None
+        step_key = (plan.schedule, mesh, dp_axis,
+                    type(program._pp_optimizer).__name__)
+        step = getattr(program, "_pp_step", None)
+        if getattr(program, "_pp_step_key", None) != step_key:
+            step = None  # schedule/mesh/optimizer changed: rebuild
+        if step is None:
+            stage_fn = ppp.make_stage_fn(program, plan)
+            loss_fn = ppp.make_loss_fn(program, plan)
+            if plan.schedule == "gpipe":
+                def pipeline_call(params, x, y):
+                    def global_loss(out, ym):
+                        return jnp.mean(jax.vmap(loss_fn)(out, ym))
+                    return pipeline_loss_and_grads(
+                        stage_fn, global_loss, params, x, y, mesh,
+                        dp_axis=dp_axis)
+            elif plan.schedule == "1f1b":
+                def pipeline_call(params, x, y):
+                    return pipeline_1f1b_step(stage_fn, loss_fn, params,
+                                              x, y, mesh, dp_axis=dp_axis)
+            else:
+                raise ValueError("unknown pp_schedule %r" % plan.schedule)
+
+            def _step(params, opt_state, x, y):
+                loss, grads = pipeline_call(params, x, y)
+                params, opt_state = update_fn(params, grads, opt_state)
+                return loss, params, opt_state
+
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")  # CPU ignores donation
+                step = jax.jit(_step, donate_argnums=(0, 1))
+            program._pp_step = step
+            program._pp_step_key = step_key
+        loss, params, opt_state = step(params, opt_state, x, y)
+        ppp.unstack_params_to_scope(plan, scope, params)
+        program._pp_opt_state = opt_state
+        return [np.asarray(loss)] if return_numpy else [loss]
 
     # ------------------------------------------------------------------
     def dump_hlo(self, program=None, feed=None, fetch_list=None,

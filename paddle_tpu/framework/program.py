@@ -285,6 +285,12 @@ class Block(object):
 
     def append_op(self, type, inputs=None, outputs=None, attrs=None):
         op = Operator(self, type, inputs, outputs, attrs)
+        # inside a pp_stage_guard (distributed/pipeline_program.py) every
+        # appended op is stamped with its pipeline stage — the TPU-native
+        # analogue of the reference's device_guard sections
+        stage = getattr(self.program, "_pp_stage_ctx", None)
+        if stage is not None and "pp_stage" not in op.attrs:
+            op.attrs["pp_stage"] = int(stage)
         self.ops.append(op)
         self.program._version += 1
         return op

@@ -480,3 +480,74 @@ def test_ring_attention_backward_no_stacked_kv_residuals():
     # a stacked residual would appear as a (8, b, h, tl, d) float32 array
     stacked = "f32[8,%d,%d,%d,%d]" % (b, h, tl, d)
     assert stacked not in jaxpr_text.replace(" ", "")
+
+
+@pytest.mark.parametrize("schedule", ["1f1b", "gpipe"])
+def test_fleet_pipeline_dp_x_pp_matches_serial(schedule):
+    """fleet.distributed_optimizer(opt, strategy with pipeline=True) must
+    run GPipe/1F1B on a stage-partitioned Program over a dp x pp mesh and
+    match full-batch serial SGD training exactly (VERDICT r2 next #5)."""
+    import jax.numpy as jnp
+    from paddle_tpu.distributed import fleet, init_mesh, DistributedStrategy
+    from paddle_tpu.distributed.pipeline_program import pp_stage_guard
+
+    n_stage, dm, batch, lr = 4, 8, 8, 0.2
+    init_mesh({"dp": 2, "pp": n_stage})
+    strategy = DistributedStrategy()
+    strategy.mesh_axes = {"dp": 2, "pp": n_stage}
+    strategy.pipeline = True
+    strategy.pp_schedule = schedule
+    strategy.pp_num_micro = 4
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("pp_x", [batch, dm], "float32",
+                        append_batch_size=False)
+        h = x
+        for s in range(n_stage):
+            with pp_stage_guard(s):
+                h = layers.fc(h, size=dm, act="tanh")
+        y = layers.data("pp_y", [batch, dm], "float32",
+                        append_batch_size=False)
+        loss = layers.reduce_mean(layers.square(h - y))
+        opt = fleet.distributed_optimizer(optimizer.SGD(lr), strategy)
+        opt.minimize(loss)
+
+    exe = pt.Executor()
+    exe.run(startup)
+    # snapshot the initial stage params for the serial oracle
+    pnames = [p.name for p in main.all_parameters()]
+    init_params = {n: np.asarray(pt.global_scope().find_var(n))
+                   for n in pnames}
+
+    rng = np.random.RandomState(0)
+    xs = [rng.randn(batch, dm).astype(np.float32) for _ in range(3)]
+    ys = [rng.randn(batch, dm).astype(np.float32) for _ in range(3)]
+    losses = []
+    for xv, yv in zip(xs, ys):
+        lv, = exe.run(main, feed={"pp_x": xv, "pp_y": yv},
+                      fetch_list=[loss])
+        losses.append(float(np.asarray(lv).reshape(-1)[0]))
+
+    # serial full-batch oracle with identical init
+    ws = [jnp.asarray(init_params["fc_%d.w_0_0" % s]) for s in range(n_stage)]
+    bs = [jnp.asarray(init_params["fc_%d.b_0_0" % s]) for s in range(n_stage)]
+
+    def serial_loss(params, xv, yv):
+        hh = jnp.asarray(xv)
+        for W, b in zip(params[0], params[1]):
+            hh = jnp.tanh(hh @ W + b)
+        return jnp.mean((hh - jnp.asarray(yv)) ** 2)
+
+    params = (ws, bs)
+    for i, (xv, yv) in enumerate(zip(xs, ys)):
+        lv, grads = jax.value_and_grad(serial_loss)(params, xv, yv)
+        np.testing.assert_allclose(losses[i], float(lv), rtol=1e-4,
+                                   atol=1e-5)
+        params = jax.tree.map(lambda p, g: p - lr * g, params, grads)
+
+    # trained params written back per stage
+    for s in range(n_stage):
+        np.testing.assert_allclose(
+            np.asarray(pt.global_scope().find_var("fc_%d.w_0_0" % s)),
+            np.asarray(params[0][s]), rtol=1e-4, atol=1e-5)
