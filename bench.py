@@ -3,7 +3,9 @@
 
 BASELINE.json metric: "ERNIE-base pretrain samples/sec/chip". Runs the
 flagship MLM+NSP train step (bf16 activations, fp32 master math, Adam,
-fused attention) on the attached TPU chip and prints ONE JSON line.
+fused attention) on the attached TPU chip. Prints the secondary ResNet-50
+JSON line first, then the ERNIE headline JSON line LAST (the driver
+parses the final line; on recognized TPUs it carries an "mfu" field).
 
 vs_baseline: BASELINE.json carries no published numbers ("published": {}),
 so the denominator is the reference's public era figure for this config:
@@ -22,6 +24,40 @@ REFERENCE_SAMPLES_PER_SEC = 50.0
 # Secondary config (BASELINE metric string also names ResNet-50 images/sec):
 # reference-era fluid ResNet-50 on one V100 ~ 360 images/sec.
 REFERENCE_RESNET_IPS = 360.0
+
+# bf16 peak FLOP/s per chip by device kind (MFU denominator)
+_CHIP_PEAK_BF16 = {
+    "v4": 275e12,
+    "v5 lite": 197e12,   # v5e
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v6 lite": 918e12,   # trillium
+}
+
+
+def _chip_peak_flops():
+    """bf16 peak of the attached chip, or None when not a recognized TPU
+    (no fabricated MFU on CPU fallback / unknown accelerators)."""
+    import jax
+    kind = getattr(jax.devices()[0], "device_kind", "").lower()
+    for tag, peak in _CHIP_PEAK_BF16.items():
+        if tag in kind:
+            return peak
+    return None
+
+
+def bert_train_flops(cfg, batch, seq, preds):
+    """Analytic per-step training FLOPs of the MLM+NSP model (matmul terms;
+    fwd + ~2x for backward — the standard MFU accounting)."""
+    d, L, ff = cfg.hidden_size, cfg.num_layers, cfg.ff_size
+    tokens = batch * seq
+    proj = 8 * tokens * d * d           # Q,K,V,O projections
+    attn = 4 * batch * seq * seq * d    # scores + context matmuls
+    ffn = 4 * tokens * d * ff           # two FFN matmuls
+    fwd = L * (proj + attn + ffn)
+    fwd += 2 * batch * preds * d * cfg.vocab_size   # MLM vocab decode
+    fwd += 2 * batch * preds * d * d                # MLM transform
+    return 3 * fwd
 
 
 def _run_steps(exe, prog, feed, loss_var, steps, warmup):
@@ -119,6 +155,10 @@ def main():
         "unit": "samples/sec/chip",
         "vs_baseline": round(sps / REFERENCE_SAMPLES_PER_SEC, 3),
     }
+    peak = _chip_peak_flops()
+    if peak is not None:
+        mfu = bert_train_flops(cfg, batch, seq, preds) * steps / dt / peak
+        result["mfu"] = round(mfu, 4)
     print(json.dumps(result))
 
 
@@ -126,4 +166,10 @@ if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "resnet":
         bench_resnet()
     else:
+        # secondary config first so the driver's last-line parse still
+        # captures the ERNIE headline; never let it break the headline
+        try:
+            bench_resnet()
+        except Exception as e:  # pragma: no cover
+            print("resnet bench failed: %r" % (e,), file=sys.stderr)
         main()
