@@ -130,6 +130,7 @@ def _backward_from(root, retain_graph=False):
     ones, as the reference does for non-scalar backward)."""
     cot = {id(root): jnp.ones_like(root._value)}
     keep = {id(root): root}
+    claimed = {}  # var id -> (var, final cotangent) once a producer uses it
     for node in reversed(_tape):
         outs = [r() for r in node.out_refs]
         if not any(v is not None and id(v) in cot for v in outs):
@@ -138,6 +139,13 @@ def _backward_from(root, retain_graph=False):
             cot[id(v)] if (v is not None and id(v) in cot)
             else _zero_cot(shape, dtype)
             for v, (shape, dtype) in zip(outs, node.out_meta))
+        # The producing node CONSUMES its outputs' cotangents: all their
+        # consumers sit later in the tape and have already contributed, and
+        # popping here prevents double-counting when a variable is bound as
+        # the output of more than one node (in-place-style rebinding).
+        for v in outs:
+            if v is not None and id(v) in cot:
+                claimed[id(v)] = (v, cot.pop(id(v)))
         grads = node.vjp_fn(out_cots)
         for var, g in zip(node.in_vars, grads):
             if g is None or (hasattr(g, "dtype")
@@ -149,7 +157,9 @@ def _backward_from(root, retain_graph=False):
             cot[id(var)] = g if prev is None else prev + g
             keep[id(var)] = var
     for vid, var in keep.items():
-        g = cot[vid]
+        if vid in cot:
+            claimed[vid] = (var, cot[vid])
+    for vid, (var, g) in claimed.items():
         var._grad = g if var._grad is None else var._grad + g
     if not retain_graph:
         reset_tape()
@@ -165,6 +175,7 @@ def enable_dygraph(place=None):
 
 def disable_dygraph():
     _in_dygraph[0] = False
+    reset_tape()  # mirror guard()'s exit: drop recorded nodes/activations
 
 
 @contextlib.contextmanager

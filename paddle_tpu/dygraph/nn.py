@@ -35,7 +35,7 @@ def run_op(op_type, ins, attrs=None, ctx=None, out_binding=None):
     out_binding: {slot: [EagerVariable]} — bind results onto existing
     placeholder variables (the LayerHelper eager path) instead of
     allocating fresh ones."""
-    from .base import _should_record, _tape, _TapeNode
+    from .base import _should_record, record_node
     kernel = get_op(op_type)
     evs = {k: [v if isinstance(v, EagerVariable)
                else EagerVariable(v, stop_gradient=True) for v in vs]
@@ -95,7 +95,7 @@ def run_op(op_type, ins, attrs=None, ctx=None, out_binding=None):
         cot = {k: [next(it) for _ in range(_shapes[k])] for k in _keys}
         return vjp_fn(cot)
 
-    _tape.append(_TapeNode(dict_vjp, flat_vars, out_vars))
+    record_node(dict_vjp, flat_vars, out_vars)
     return wrapped
 
 

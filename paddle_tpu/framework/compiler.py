@@ -110,9 +110,17 @@ class CompiledProgram(object):
 
     def _feed_sharding(self, name, mesh):
         data_axis = self._build_strategy.data_axis
-        if data_axis in mesh.axis_names:
-            return NamedSharding(mesh, P(data_axis))
-        return NamedSharding(mesh, P())
+        if data_axis not in mesh.axis_names:
+            return NamedSharding(mesh, P())
+        # batch-shard feeds over the data axis — but config-like feeds
+        # (e.g. a (3,) task_weight schedule vector) whose leading dim can't
+        # split over dp stay replicated
+        var = self._program.global_block()._find_var_recursive(name)
+        if var is not None and var.shape:
+            d0 = var.shape[0]
+            if d0 not in (None, -1) and d0 % mesh.shape[data_axis] != 0:
+                return NamedSharding(mesh, P())
+        return NamedSharding(mesh, P(data_axis))
 
     def _build_step(self, executor, step, program, state_names, feed_names,
                     feed_vals, check_numerics=False):

@@ -136,6 +136,24 @@ class Executor(object):
             program = default_main_program()
         scope = scope if scope is not None else global_scope()
         feed = dict(feed or {})
+        # started py_readers supply their own variables' batches
+        # (reference create_py_reader_op: run-without-feed training loops);
+        # an exhausted reader raises layers.io.EOFException here. Two-phase
+        # so a sibling reader's EOF pushes already-dequeued batches back
+        # (no lost data), and user-fed names are never overwritten.
+        pulled = []
+        try:
+            for rdr in getattr(program, "_py_readers", ()):
+                if rdr._started and any(n not in feed
+                                        for n in rdr._names):
+                    pulled.append((rdr, rdr._next_feed()))
+        except Exception:
+            for rdr, batch in pulled:
+                rdr._push_back(batch)
+            raise
+        for rdr, batch in pulled:
+            for n, v in batch.items():
+                feed.setdefault(n, v)
         fetch_list = list(fetch_list or [])
         fetch_names = [f.name if hasattr(f, "name") else f for f in fetch_list]
 

@@ -18,3 +18,146 @@ def data(name, shape, dtype="float32", lod_level=0, append_batch_size=True,
                              is_data=True, stop_gradient=stop_gradient,
                              lod_level=lod_level)
     return var
+
+
+class EOFException(Exception):
+    """Raised by Executor.run when a started py_reader runs dry
+    (reference fluid.core.EOFException); catch it and reader.reset()."""
+
+
+class PyReader(object):
+    """In-graph reader queue (reference layers/io.py:547 py_reader +
+    operators/reader/create_py_reader_op). TPU-native: a background thread
+    prefetches decorated batches into a bounded queue (the double buffer);
+    Executor.run pulls the next batch for this reader's variables when the
+    caller does not feed them — the same run-without-feed training loop
+    fluid scripts use, minus the C++ blocking queue."""
+
+    def __init__(self, capacity, shapes, dtypes, lod_levels=None, name=None,
+                 use_double_buffer=True):
+        import queue as _queue
+        from ..framework import unique_name
+        base = name or unique_name.generate("py_reader")
+        self._names = ["%s_slot_%d" % (base, i) for i in range(len(shapes))]
+        self._vars = [data(n, list(s), dtype=d, append_batch_size=False)
+                      for n, s, d in zip(self._names, shapes, dtypes)]
+        # the host-side queue always honours the requested capacity;
+        # use_double_buffer in the reference only adds the device staging
+        # slot, which here is Executor._convert_feed's device_put
+        self._capacity = max(2, int(capacity))
+        self._queue = _queue.Queue(self._capacity)
+        self._pushback = []
+        self._generator = None
+        self._thread = None
+        self._started = False
+        prog = default_main_program()
+        if not hasattr(prog, "_py_readers"):
+            prog._py_readers = []
+        prog._py_readers.append(self)
+
+    # ---- decoration (reference decorate_* methods) -------------------
+    def decorate_paddle_reader(self, reader):
+        """reader() yields batches as lists of per-sample tuples."""
+        import numpy as np
+
+        def gen():
+            for samples in reader():
+                cols = list(zip(*samples))
+                yield tuple(np.stack([np.asarray(c) for c in col])
+                            for col in cols)
+        self._generator = gen
+        return self
+
+    decorate_sample_list_generator = decorate_paddle_reader
+
+    def decorate_tensor_provider(self, reader):
+        """reader() yields ready batch tuples of arrays."""
+        self._generator = reader
+        return self
+
+    decorate_batch_generator = decorate_tensor_provider
+
+    # ---- queue control ----------------------------------------------
+    def start(self):
+        import threading
+        if self._generator is None:
+            raise RuntimeError("py_reader.start(): decorate a reader first")
+        if self._started:
+            return
+        self._started = True
+        self._stop = False
+
+        def _fill():
+            try:
+                for batch in self._generator():
+                    if self._stop:
+                        return
+                    self._queue.put(tuple(batch))
+            finally:
+                self._queue.put(None)   # EOF sentinel
+
+        self._thread = threading.Thread(target=_fill, daemon=True)
+        self._thread.start()
+
+    def _drain(self):
+        import queue as _queue
+        try:
+            while True:
+                self._queue.get_nowait()
+        except _queue.Empty:
+            pass
+
+    def reset(self):
+        self._stop = True
+        if self._thread is not None:
+            # drain WHILE joining so a filler blocked on a full queue can
+            # finish its pending put (incl. the EOF sentinel) before we do
+            # the final drain — otherwise a stale batch/None survives into
+            # the next epoch
+            while self._thread.is_alive():
+                self._drain()
+                self._thread.join(timeout=0.1)
+            self._thread = None
+        self._drain()
+        self._pushback = []
+        self._started = False
+
+    def _push_back(self, feed_dict):
+        """Return an already-dequeued batch (used when a sibling reader
+        hits EOF in the same run, so no data is lost)."""
+        self._pushback.append(feed_dict)
+
+    def _next_feed(self):
+        if not self._started:
+            raise RuntimeError("py_reader: call start() before exe.run")
+        if self._pushback:
+            return self._pushback.pop()
+        batch = self._queue.get()
+        if batch is None:
+            self._started = False
+            raise EOFException("py_reader %s exhausted" % self._names[0])
+        if len(batch) != len(self._names):
+            raise ValueError("py_reader got %d arrays for %d slots"
+                             % (len(batch), len(self._names)))
+        return dict(zip(self._names, batch))
+
+
+def py_reader(capacity, shapes, dtypes, lod_levels=None, name=None,
+              use_double_buffer=True):
+    return PyReader(capacity, shapes, dtypes, lod_levels, name,
+                    use_double_buffer)
+
+
+def read_file(reader):
+    """Unpack a py_reader into its data Variables (reference read_file)."""
+    if len(reader._vars) == 1:
+        return reader._vars[0]
+    return list(reader._vars)
+
+
+def double_buffer(reader, place=None, name=None):
+    """Parity wrapper: PyReader already double-buffers host-side via its
+    bounded prefetch queue + JAX async dispatch (reference double_buffer
+    staged batches to GPU memory; device_put staging happens in
+    Executor._convert_feed)."""
+    return reader

@@ -884,3 +884,43 @@ def autoincreased_step_counter(counter_name=None, begin=1, step=1):
     counter.stop_gradient = True
     out.stop_gradient = True
     return out
+
+
+def py_func(func, x, out, backward_func=None,
+            skip_vars_in_backward_input=None, name=None):
+    """Host-python escape hatch (reference layers/nn.py:12369 py_func /
+    operators/py_func_op.cc). TPU-native: the call embeds in the jitted
+    step via jax.pure_callback; backward_func (contract:
+    backward_func(*inputs, *outputs, *out_grads) -> per-input grads,
+    None entries allowed) becomes a custom-vjp callback, so py_func ops
+    sit inside a differentiable program.
+
+    ``out`` must be pre-created Variables with static shapes (XLA needs
+    the callback's result shapes at trace time), exactly like the
+    reference requires create_variable'd outs. The function object lives
+    in a process-local registry — programs using py_func serialize
+    structurally but need the same process to run (same pickling caveat
+    as the reference).
+    """
+    from ..ops.misc_ops import register_py_func
+    if skip_vars_in_backward_input:
+        raise NotImplementedError(
+            "py_func skip_vars_in_backward_input is not supported — the "
+            "backward callback always receives (*inputs, *outputs, "
+            "*out_grads); drop the skip list and index accordingly")
+    helper = LayerHelper("py_func", name=name)
+    xs = list(x) if isinstance(x, (list, tuple)) else [x]
+    outs = list(out) if isinstance(out, (list, tuple)) else [out]
+    for o in outs:
+        if o.shape is None or any(s in (None, -1) for s in o.shape):
+            raise ValueError(
+                "py_func outputs need fully static shapes on TPU; got %r "
+                "for %s" % (o.shape, o.name))
+    fid = register_py_func(func, backward_func)
+    helper.append_op(
+        "py_func",
+        inputs={"X": [v.name for v in xs]},
+        outputs={"Out": [o.name for o in outs]},
+        attrs={"func_id": fid,
+               "out_meta": [[list(o.shape), str(o.dtype)] for o in outs]})
+    return out

@@ -256,15 +256,48 @@ ernie_pretrain_program = bert_pretrain_program
 # semantic-aware) trained jointly; losses summed with per-task weights.
 # ---------------------------------------------------------------------------
 
+def ernie2_large(**kw):
+    """ERNIE 2.0-large: BERT-large geometry + task-id embedding, the
+    BASELINE stretch config (ERNIE 2.0 paper, Table 1 'large'). tp=True
+    annotates mp shardings for pod-scale tensor parallelism."""
+    kw.setdefault("hidden_size", 1024)
+    kw.setdefault("num_layers", 24)
+    kw.setdefault("num_heads", 16)
+    kw.setdefault("ff_size", 4096)
+    kw.setdefault("tp", True)
+    return BertConfig(**kw)
+
+
+def ernie2_task_schedule(n_steps, weights=(1.0, 1.0, 1.0), seed=0):
+    """Per-step task sampling (ERNIE 2.0's sequential multi-task learning:
+    each step trains one task sampled proportionally to its weight, so
+    earlier tasks keep being revisited while new ones are introduced).
+    Yields (n_tasks,) float32 one-hot weight vectors to feed as
+    "task_weight" when the program is built with
+    dynamic_task_weights=True."""
+    import numpy as np
+    w = np.asarray(weights, np.float64)
+    p = w / w.sum()
+    rng = np.random.RandomState(seed)
+    for _ in range(int(n_steps)):
+        vec = np.zeros(len(weights), np.float32)
+        vec[rng.choice(len(weights), p=p)] = 1.0
+        yield vec
+
+
 def ernie2_multitask_program(cfg, batch_size, seq_len, max_preds_per_seq=20,
                              num_sent_classes=3, num_ir_classes=3,
                              task_weights=(1.0, 1.0, 1.0),
-                             optimizer_fn=None, is_test=False):
+                             optimizer_fn=None, is_test=False,
+                             dynamic_task_weights=False):
     """Three representative ERNIE-2.0 tasks on one shared encoder:
       1. masked LM (word-aware, knowledge masking comes from the data gen)
       2. sentence-reorder classification on [CLS] (structure-aware)
       3. IR relevance classification on [CLS] (semantic-aware)
     Feeds add task_ids (N,T,1) — the task-id embedding of ERNIE 2.0.
+    dynamic_task_weights=True adds a "task_weight" (3,) float32 feed (see
+    ernie2_task_schedule) so the task-sampling schedule drives per-step
+    loss mixing without recompiling.
     """
     main, startup = pt.Program(), pt.Program()
     with pt.program_guard(main, startup):
@@ -315,16 +348,29 @@ def ernie2_multitask_program(cfg, batch_size, seq_len, max_preds_per_seq=20,
                                  reorder_label)
         ir_loss = _cls_head("task_ir_fc", num_ir_classes, ir_label)
 
-        w = task_weights
-        loss = layers.scale(mlm_loss, scale=float(w[0]))
-        loss = layers.elementwise_add(
-            loss, layers.scale(reorder_loss, scale=float(w[1])))
-        loss = layers.elementwise_add(
-            loss, layers.scale(ir_loss, scale=float(w[2])))
+        if dynamic_task_weights:
+            tw = layers.data("task_weight", [3], dtype="float32",
+                             append_batch_size=False)
+            parts = []
+            for i, task_loss in enumerate((mlm_loss, reorder_loss,
+                                           ir_loss)):
+                wi = layers.slice(tw, axes=[0], starts=[i], ends=[i + 1])
+                parts.append(layers.elementwise_mul(task_loss, wi))
+            loss = layers.elementwise_add(
+                layers.elementwise_add(parts[0], parts[1]), parts[2])
+        else:
+            w = task_weights
+            loss = layers.scale(mlm_loss, scale=float(w[0]))
+            loss = layers.elementwise_add(
+                loss, layers.scale(reorder_loss, scale=float(w[1])))
+            loss = layers.elementwise_add(
+                loss, layers.scale(ir_loss, scale=float(w[2])))
         if optimizer_fn is not None:
             optimizer_fn(loss)
     feeds = ["src_ids", "pos_ids", "sent_ids", "task_ids", "input_mask",
              "mask_pos", "mask_label", "reorder_label", "ir_label"]
+    if dynamic_task_weights:
+        feeds.append("task_weight")
     fetch = {"loss": loss, "mlm_loss": mlm_loss,
              "reorder_loss": reorder_loss, "ir_loss": ir_loss}
     return main, startup, feeds, fetch

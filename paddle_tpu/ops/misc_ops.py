@@ -13,6 +13,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from .registry import register_op
+import numpy as np
 
 
 @register_op("multiplex", nondiff=("Ids",))
@@ -282,3 +283,97 @@ def _spectral_norm(ctx, ins, attrs):
     inv = [perm.index(i) for i in range(w.ndim)]
     out = jnp.transpose(out.reshape(wm.shape), inv)
     return {"Out": out, "UOut": u, "VOut": v}
+
+
+# ---------------------------------------------------------------------------
+# py_func — host-side escape hatch (reference python/paddle/fluid/layers/
+# nn.py:12369 py_func + operators/py_func_op.cc). TPU-native mapping:
+# jax.pure_callback embeds the host call in the jitted step; a registered
+# backward_func becomes a custom vjp whose rule is itself a callback.
+# ---------------------------------------------------------------------------
+
+_PY_FUNC_REGISTRY = {}
+
+
+def register_py_func(func, backward_func=None):
+    fid = len(_PY_FUNC_REGISTRY)
+    _PY_FUNC_REGISTRY[fid] = (func, backward_func)
+    return fid
+
+
+def _np_results(res, metas):
+    if not isinstance(res, (list, tuple)):
+        res = [res]
+    if len(res) != len(metas):
+        raise ValueError("py_func returned %d values, declared %d outputs"
+                         % (len(res), len(metas)))
+    return [np.asarray(r, dtype=m.dtype).reshape(m.shape)
+            for r, m in zip(res, metas)]
+
+
+@register_op("py_func")
+def _py_func(ctx, ins, attrs):
+    import jax
+    func, bwd = _PY_FUNC_REGISTRY[attrs["func_id"]]
+    out_meta = [jax.ShapeDtypeStruct(tuple(s), _dt(d))
+                for s, d in attrs["out_meta"]]
+    xs = tuple(ins["X"])
+
+    def call(*arrays):
+        return _np_results(func(*[np.asarray(a) for a in arrays]), out_meta)
+
+    if bwd is None:
+        outs = jax.pure_callback(call, out_meta, *xs)
+        # no registered backward: explicit stop_gradient, like the
+        # reference's non-differentiable py_func default
+        return {"Out": [jax.lax.stop_gradient(o) for o in outs]}
+
+    in_meta = [jax.ShapeDtypeStruct(x.shape, x.dtype) for x in xs]
+    # integer primals take float0 cotangents (jax.custom_vjp contract);
+    # the callback only carries grads for the inexact inputs
+    diff_idx = [i for i, x in enumerate(xs)
+                if jnp.issubdtype(x.dtype, jnp.inexact)]
+    diff_meta = [in_meta[i] for i in diff_idx]
+
+    @jax.custom_vjp
+    def fwd_fn(*xs):
+        return tuple(jax.pure_callback(call, out_meta, *xs))
+
+    def fwd(*xs):
+        outs = fwd_fn(*xs)
+        return outs, (xs, outs)
+
+    def bwd_rule(res, gouts):
+        xs, outs = res
+
+        def bcall(*arrays):
+            arrays = [np.asarray(a) for a in arrays]
+            n, m = len(xs), len(outs)
+            # contract: backward_func(*inputs, *outputs, *out_grads)
+            # -> per-input grads (None allowed -> zeros)
+            gs = bwd(*arrays[:n], *arrays[n:n + m], *arrays[n + m:])
+            if not isinstance(gs, (list, tuple)):
+                gs = [gs]
+            return [np.zeros(in_meta[i].shape, in_meta[i].dtype)
+                    if gs[i] is None
+                    else np.asarray(gs[i], dtype=in_meta[i].dtype)
+                    .reshape(in_meta[i].shape)
+                    for i in diff_idx]
+
+        gdiff = jax.pure_callback(bcall, diff_meta, *xs, *outs, *gouts)
+        gdiff = list(gdiff) if isinstance(gdiff, (list, tuple)) else [gdiff]
+        gins = []
+        for i, x in enumerate(xs):
+            if i in diff_idx:
+                gins.append(gdiff[diff_idx.index(i)])
+            else:
+                gins.append(np.zeros(x.shape, jax.dtypes.float0))
+        return tuple(gins)
+
+    fwd_fn.defvjp(fwd, bwd_rule)
+    return {"Out": list(fwd_fn(*xs))}
+
+
+def _dt(name):
+    from ..framework.dtypes import to_jax_dtype
+    return to_jax_dtype(name)

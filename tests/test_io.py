@@ -91,3 +91,178 @@ def test_program_clone_for_test_dropout_deterministic():
     a, = exe.run(test_prog, feed={"x": xv}, fetch_list=[out])
     b, = exe.run(test_prog, feed={"x": xv}, fetch_list=[out])
     np.testing.assert_allclose(a, b)  # no randomness in test mode
+
+
+def test_py_reader_train_loop_and_eof():
+    from paddle_tpu import layers, optimizer
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        reader = layers.py_reader(capacity=4,
+                                  shapes=[(8, 4), (8, 1)],
+                                  dtypes=["float32", "float32"])
+        x, y = layers.read_file(reader)
+        pred = layers.fc(x, size=1)
+        loss = layers.reduce_mean(layers.square(pred - y))
+        optimizer.SGD(0.1).minimize(loss)
+
+    rng = np.random.RandomState(0)
+    W = rng.randn(4, 1).astype(np.float32)
+
+    def batches():
+        for _ in range(12):
+            xv = rng.randn(8, 4).astype(np.float32)
+            yield xv, xv @ W
+
+    reader.decorate_tensor_provider(batches)
+    exe = pt.Executor()
+    exe.run(startup)
+    for epoch in range(2):
+        reader.start()
+        losses = []
+        while True:
+            try:
+                lv, = exe.run(main, fetch_list=[loss])
+            except layers.EOFException:
+                reader.reset()
+                break
+            losses.append(float(np.asarray(lv).reshape(-1)[0]))
+        assert len(losses) == 12
+    assert losses[-1] < losses[0]
+
+
+def test_py_reader_paddle_reader_decoration():
+    from paddle_tpu import layers
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        reader = layers.py_reader(capacity=2, shapes=[(4, 2)],
+                                  dtypes=["float32"])
+        x = layers.read_file(reader)
+        out = layers.scale(x, scale=2.0)
+
+    def sample_batches():
+        yield [(np.ones(2, np.float32) * i,) for i in range(4)]
+
+    reader.decorate_paddle_reader(sample_batches)
+    reader.start()
+    exe = pt.Executor()
+    exe.run(startup)
+    ov, = exe.run(main, fetch_list=[out])
+    np.testing.assert_allclose(np.asarray(ov)[:, 0], [0, 2, 4, 6])
+    reader.reset()
+
+
+def test_py_func_forward_and_backward():
+    from paddle_tpu import layers, optimizer
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("pf_x", [4, 3], "float32", append_batch_size=False)
+        w = layers.create_parameter(
+            [4, 3], "float32", name="pf_w",
+            default_initializer=pt.initializer.Constant(2.0))
+        xw = layers.elementwise_mul(x, w)
+        out = main.global_block().create_var(
+            name="pf_out", shape=(4, 3), dtype="float32")
+        layers.py_func(
+            func=lambda a: np.sin(a),
+            x=xw, out=out,
+            backward_func=lambda a, o, g: g * np.cos(a))
+        loss = layers.reduce_sum(out)
+        optimizer.SGD(0.0).minimize(loss)   # forces backward through py_func
+        grads = pt.gradients(loss, [w])
+    exe = pt.Executor()
+    exe.run(startup)
+    xv = np.random.RandomState(0).randn(4, 3).astype(np.float32)
+    ov, gv = exe.run(main, feed={"pf_x": xv}, fetch_list=[out, grads[0]])
+    np.testing.assert_allclose(np.asarray(ov), np.sin(xv * 2.0), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(gv), np.cos(xv * 2.0) * xv,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_py_func_no_backward_stops_gradient():
+    from paddle_tpu import layers
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("pf2_x", [2, 2], "float32", append_batch_size=False)
+        out = main.global_block().create_var(
+            name="pf2_out", shape=(2, 2), dtype="float32")
+        layers.py_func(func=lambda a: a * 3.0, x=x, out=out)
+    exe = pt.Executor()
+    exe.run(startup)
+    xv = np.ones((2, 2), np.float32)
+    ov, = exe.run(main, feed={"pf2_x": xv}, fetch_list=[out])
+    np.testing.assert_allclose(np.asarray(ov), xv * 3.0)
+
+
+def test_py_func_integer_input_float0_cotangent():
+    """Mixed float+int inputs: int primals must get float0 cotangents."""
+    from paddle_tpu import layers, optimizer
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("pfi_x", [2, 3], "float32", append_batch_size=False)
+        idx = layers.data("pfi_i", [2, 3], "int64", append_batch_size=False)
+        w = layers.create_parameter(
+            [2, 3], "float32", name="pfi_w",
+            default_initializer=pt.initializer.Constant(1.0))
+        xw = layers.elementwise_mul(x, w)
+        out = main.global_block().create_var(
+            name="pfi_out", shape=(2, 3), dtype="float32")
+        layers.py_func(
+            func=lambda a, i: a * (i + 1),
+            x=[xw, idx], out=out,
+            backward_func=lambda a, i, o, g: (g * (i + 1), None))
+        loss = layers.reduce_sum(out)
+        optimizer.SGD(0.0).minimize(loss)
+        grads = pt.gradients(loss, [w])
+    exe = pt.Executor()
+    exe.run(startup)
+    xv = np.ones((2, 3), np.float32) * 2
+    iv = np.arange(6, dtype=np.int64).reshape(2, 3)
+    ov, gv = exe.run(main, feed={"pfi_x": xv, "pfi_i": iv},
+                     fetch_list=[out, grads[0]])
+    np.testing.assert_allclose(np.asarray(ov), xv * (iv + 1))
+    np.testing.assert_allclose(np.asarray(gv), xv * (iv + 1))
+
+
+def test_py_reader_mid_epoch_reset_no_stale_batches():
+    """reset() while the filler thread is blocked must not leak a stale
+    batch or EOF sentinel into the next epoch."""
+    from paddle_tpu import layers
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        reader = layers.py_reader(capacity=2, shapes=[(2, 2)],
+                                  dtypes=["float32"])
+        x = layers.read_file(reader)
+        out = layers.scale(x, scale=1.0)
+
+    def epoch_batches(tag):
+        def gen():
+            for i in range(10):
+                yield (np.full((2, 2), tag * 100 + i, np.float32),)
+        return gen
+
+    exe = pt.Executor()
+    exe.run(startup)
+    reader.decorate_tensor_provider(epoch_batches(1))
+    reader.start()
+    ov, = exe.run(main, fetch_list=[out])   # consume one batch
+    assert float(np.asarray(ov)[0, 0]) == 100.0
+    reader.reset()                           # filler still mid-stream
+    reader.decorate_tensor_provider(epoch_batches(2))
+    reader.start()
+    ov, = exe.run(main, fetch_list=[out])
+    assert float(np.asarray(ov)[0, 0]) == 200.0  # fresh epoch, not stale
+    reader.reset()
+
+
+def test_py_func_skip_vars_rejected():
+    import pytest
+    from paddle_tpu import layers
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("pfs_x", [2, 2], "float32", append_batch_size=False)
+        out = main.global_block().create_var(
+            name="pfs_out", shape=(2, 2), dtype="float32")
+        with pytest.raises(NotImplementedError):
+            layers.py_func(func=lambda a: a, x=x, out=out,
+                           backward_func=lambda a, o, g: g,
+                           skip_vars_in_backward_input=[x])
