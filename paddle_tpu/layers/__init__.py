@@ -13,6 +13,7 @@ from .learning_rate_scheduler import (  # noqa: F401
     polynomial_decay, piecewise_decay, cosine_decay, linear_lr_warmup)
 from .sequence_lod import *  # noqa: F401,F403
 from .vision import *        # noqa: F401,F403
+from .extras import *        # noqa: F401,F403
 from .rnn import *           # noqa: F401,F403
 from .attention import *     # noqa: F401,F403
 from .collective import *    # noqa: F401,F403

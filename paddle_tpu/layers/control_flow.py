@@ -52,6 +52,10 @@ def logical_or(x, y, out=None, name=None):
     return _compare(x, y, "logical_or")
 
 
+def logical_xor(x, y, out=None, name=None):
+    return _compare(x, y, "logical_xor")
+
+
 def logical_not(x, out=None, name=None):
     helper = LayerHelper("logical_not")
     out = helper.create_variable_for_type_inference("bool", x.shape)

@@ -16,3 +16,4 @@ from . import sequence_ops  # noqa: F401
 from . import quant_ops     # noqa: F401
 from . import vision_ops    # noqa: F401
 from . import misc_ops      # noqa: F401
+from . import extras_ops    # noqa: F401

@@ -1,0 +1,293 @@
+"""Long-tail op kernels closing the reference layers/nn.py surface.
+
+Reference parity: paddle/fluid/operators/{scatter_nd_add_op (scatter_nd),
+gather_tree_op.h, hash_op.h, space_to_depth_op, shuffle_channel_op,
+similarity_focus_op, filter_by_instag_op, random_crop_op, ctc_align_op
+(ctc_greedy_decoder), interpolate_op (trilinear), cvm_op}. Kernels are
+pure JAX; sequential reference algorithms (similarity focus's greedy
+row/col elimination, gather_tree's back-trace) become lax.scan loops.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .registry import register_op
+
+
+def _x(ins, slot="X"):
+    return ins[slot][0]
+
+
+@register_op("scatter_nd", nondiff=("Index",))
+def _scatter_nd(ctx, ins, attrs):
+    """Out[shape]; Out[index[i]] += updates[i] (duplicates accumulate,
+    ref scatter_nd op)."""
+    index = ins["Index"][0]
+    updates = ins["Updates"][0]
+    shape = tuple(attrs["shape"])
+    zeros = jnp.zeros(shape, updates.dtype)
+    k = index.shape[-1]
+    idx = tuple(index[..., i] for i in range(k))
+    return {"Out": zeros.at[idx].add(updates)}
+
+
+@register_op("gather_tree", nondiff=("Ids", "Parents"), differentiable=False)
+def _gather_tree(ctx, ins, attrs):
+    """Beam-search back-trace (ref gather_tree_op.h): walk parents from the
+    last step to recover each beam's full token path."""
+    ids = ins["Ids"][0]          # (T, B, W)
+    parents = ins["Parents"][0]
+    t = ids.shape[0]
+    last = ids[t - 1]
+    parent0 = parents[t - 1]
+
+    def step(carry, inp):
+        parent = carry                     # (B, W) beam index per slot
+        ids_t, parents_t = inp             # step t's (B, W)
+        tok = jnp.take_along_axis(ids_t, parent, axis=1)
+        parent = jnp.take_along_axis(parents_t, parent, axis=1)
+        return parent, tok
+
+    _, toks = lax.scan(step, parent0, (ids[:t - 1], parents[:t - 1]),
+                       reverse=True)
+    return {"Out": jnp.concatenate([toks, last[None]], axis=0)}
+
+
+@register_op("hash", nondiff=("X",), differentiable=False)
+def _hash(ctx, ins, attrs):
+    """Deterministic multi-seed integer hash of each id row into
+    [0, mod_by) (ref hash_op.h uses xxhash; the hash family differs but
+    the contract — shape (*dims[:-1], num_hash, 1), bounded values,
+    per-seed independence — is the same)."""
+    x = _x(ins).astype(jnp.uint32)
+    mod_by = int(attrs["mod_by"])
+    num_hash = int(attrs.get("num_hash", 1))
+    # fold the last dim (the id tuple) with a different seed per hash
+    outs = []
+    for i in range(num_hash):
+        h = jnp.uint32(2166136261 ^ (i * 16777619))
+        for j in range(x.shape[-1]):
+            h = (h ^ x[..., j]) * jnp.uint32(16777619)
+        outs.append((h % jnp.uint32(mod_by)).astype(jnp.int64))
+    out = jnp.stack(outs, axis=-1)[..., None]
+    return {"Out": out}
+
+
+@register_op("space_to_depth")
+def _space_to_depth(ctx, ins, attrs):
+    x = _x(ins)                  # (N, C, H, W)
+    b = int(attrs["blocksize"])
+    n, c, h, w = x.shape
+    x = x.reshape(n, c, h // b, b, w // b, b)
+    x = x.transpose(0, 3, 5, 1, 2, 4)
+    return {"Out": x.reshape(n, c * b * b, h // b, w // b)}
+
+
+@register_op("shuffle_channel")
+def _shuffle_channel(ctx, ins, attrs):
+    x = _x(ins)                  # (N, C, H, W)
+    g = int(attrs["group"])
+    n, c, h, w = x.shape
+    return {"Out": x.reshape(n, g, c // g, h, w).transpose(0, 2, 1, 3, 4)
+            .reshape(n, c, h, w)}
+
+
+@register_op("similarity_focus", nondiff=("X",), differentiable=False)
+def _similarity_focus(ctx, ins, attrs):
+    """Greedy row/column-exclusive maxima mask (ref similarity_focus_op):
+    per selected channel slice (B_, C_) pick min(B_, C_) maxima such that
+    each row/column is used at most once; OR the masks over indexes."""
+    x = _x(ins)                  # (N, A, B_, C_) with axis=1, or axis=2
+    axis = int(attrs["axis"])
+    indexes = list(attrs["indexes"])
+    if axis != 1:
+        x = jnp.moveaxis(x, axis, 1)
+    n, a, b_, c_ = x.shape
+    npick = min(b_, c_)
+
+    def per_slice(t):            # (B_, C_) -> (B_, C_) 0/1 mask
+        def pick(carry, _):
+            mask, row_used, col_used = carry
+            neg = jnp.where(row_used[:, None] | col_used[None, :],
+                            -jnp.inf, t)
+            flat = jnp.argmax(neg.reshape(-1))
+            i, j = flat // c_, flat % c_
+            mask = mask.at[i, j].set(1.0)
+            return (mask, row_used.at[i].set(True),
+                    col_used.at[j].set(True)), None
+
+        (mask, _, _), _ = lax.scan(
+            pick, (jnp.zeros((b_, c_), x.dtype),
+                   jnp.zeros(b_, bool), jnp.zeros(c_, bool)),
+            None, length=npick)
+        return mask
+
+    masks = jnp.zeros((n, b_, c_), x.dtype)
+    for idx in indexes:
+        masks = jnp.maximum(masks, jax.vmap(per_slice)(x[:, idx]))
+    out = jnp.broadcast_to(masks[:, None], (n, a, b_, c_))
+    if axis != 1:
+        out = jnp.moveaxis(out, 1, axis)
+    return {"Out": out}
+
+
+@register_op("filter_by_instag", nondiff=("Ins", "Ins_tag", "Filter_tag"))
+def _filter_by_instag(ctx, ins, attrs):
+    """Keep rows whose tag set intersects the filter tags (ref
+    filter_by_instag_op). Dense/static form: kept rows are packed to the
+    top (order preserved), the rest zeroed; LossWeight is the keep mask
+    and IndexMap maps packed row -> original row."""
+    rows = ins["Ins"][0]                   # (N, D)
+    tags = ins["Ins_tag"][0]               # (N, K) int
+    filt = ins["Filter_tag"][0]            # (F,) int
+    keep = jnp.any(tags[..., None] == filt[None, None, :], axis=(1, 2))
+    n = rows.shape[0]
+    order = jnp.argsort(~keep, stable=True)    # kept rows first
+    packed = jnp.take(rows, order, axis=0)
+    kept_sorted = jnp.take(keep, order)
+    out = packed * kept_sorted[:, None].astype(rows.dtype)
+    return {"Out": out,
+            "LossWeight": kept_sorted.astype(rows.dtype).reshape(n, 1),
+            "IndexMap": jnp.stack([order.astype(jnp.int64),
+                                   jnp.arange(n, dtype=jnp.int64)], axis=1)}
+
+
+@register_op("random_crop", nondiff=("Seed",), uses_rng=True,
+             differentiable=False)
+def _random_crop(ctx, ins, attrs):
+    """Per-example random spatial crop to attrs['shape'] (ref
+    random_crop_op): offsets drawn from the op's deterministic PRNG."""
+    x = _x(ins)
+    out_shape = tuple(attrs["shape"])      # trailing dims to crop to
+    lead = x.ndim - len(out_shape)
+    key = ctx.rng()
+    starts = []
+    for i, os_ in enumerate(out_shape):
+        key, sub = jax.random.split(key)
+        hi = x.shape[lead + i] - os_ + 1
+        starts.append(jax.random.randint(sub, (), 0, hi))
+    idx = tuple([slice(None)] * lead)
+    out = lax.dynamic_slice(
+        x, [jnp.int32(0)] * lead + [s.astype(jnp.int32) for s in starts],
+        x.shape[:lead] + out_shape)
+    return {"Out": out}
+
+
+@register_op("ctc_greedy_decoder", nondiff=("Input", "Length"),
+             differentiable=False)
+def _ctc_greedy_decoder(ctx, ins, attrs):
+    """argmax per step -> collapse repeats -> drop blank (ref
+    ctc_align_op). Dense form: input (N, T, V) probs + optional lengths;
+    returns (N, T) decoded ids padded with -1 plus per-row lengths."""
+    probs = ins["Input"][0]
+    blank = int(attrs.get("blank", 0))
+    n, t, _ = probs.shape
+    ids = jnp.argmax(probs, axis=-1)       # (N, T)
+    if ins.get("Length"):
+        lens = ins["Length"][0].reshape(-1)
+        valid = jnp.arange(t)[None, :] < lens[:, None]
+    else:
+        valid = jnp.ones((n, t), bool)
+    prev = jnp.concatenate([jnp.full((n, 1), -1, ids.dtype), ids[:, :-1]],
+                           axis=1)
+    keep = (ids != blank) & (ids != prev) & valid
+    order = jnp.argsort(~keep, axis=1, stable=True)
+    packed = jnp.take_along_axis(ids, order, axis=1)
+    kept_sorted = jnp.take_along_axis(keep, order, axis=1)
+    pad = int(attrs.get("padding_value", -1))
+    out = jnp.where(kept_sorted, packed, pad)
+    return {"Out": out, "OutLength": jnp.sum(keep, axis=1)
+            .astype(jnp.int32)}
+
+
+@register_op("resize_trilinear", nondiff=("OutSize",))
+def _resize_trilinear(ctx, ins, attrs):
+    """3-D linear resize of (N, C, D, H, W) (ref interpolate_op trilinear
+    path) via jax.image.resize."""
+    x = _x(ins)
+    out_dhw = tuple(attrs["out_shape"])
+    shape = x.shape[:2] + out_dhw
+    return {"Out": jax.image.resize(x, shape, method="trilinear")
+            .astype(x.dtype)}
+
+
+@register_op("cvm")
+def _cvm(ctx, ins, attrs):
+    """Show/click handling for CTR embeddings (ref cvm_op): use_cvm keeps
+    D (first two dims replaced with log(show), log(click)); otherwise the
+    two leading dims are removed."""
+    x = _x(ins)                   # (N, D), D = 2 + emb
+    cvm = ins["CVM"][0]           # (N, 2) show, click
+    if attrs.get("use_cvm", True):
+        logs = jnp.log(jnp.maximum(cvm.astype(jnp.float32), 1e-20) + 1.0)
+        return {"Y": jnp.concatenate([logs.astype(x.dtype), x[:, 2:]],
+                                     axis=1)}
+    return {"Y": x[:, 2:]}
+
+
+@register_op("deformable_roi_pooling", nondiff=("ROIs",))
+def _deformable_roi_pooling(ctx, ins, attrs):
+    """Deformable (PS-)RoI pooling (ref deformable_psroi_pooling_op.h):
+    each pooled bin's sampling box is shifted by trans_std * Trans before
+    average pooling. Dense form: ROIs (R, 5) with batch index in col 0,
+    Trans (R, 2, PH, PW)."""
+    x = ins["Input"][0]                     # (N, C, H, W)
+    rois = ins["ROIs"][0]                   # (R, 5): n, x1, y1, x2, y2
+    trans = ins["Trans"][0]                 # (R, 2, PH, PW) offsets
+    ph = int(attrs.get("pooled_height", 1))
+    pw = int(attrs.get("pooled_width", 1))
+    ss = float(attrs.get("spatial_scale", 1.0))
+    tstd = float(attrs.get("trans_std", 0.1))
+    pos_sensitive = bool(attrs.get("position_sensitive", False))
+    n, c, h, w = x.shape
+    r = rois.shape[0]
+    batch_idx = rois[:, 0].astype(jnp.int32)
+    boxes = rois[:, 1:]
+    feats = jnp.take(x, batch_idx, axis=0)  # (R, C, H, W)
+
+    x1 = boxes[:, 0] * ss
+    y1 = boxes[:, 1] * ss
+    rw = jnp.maximum(boxes[:, 2] * ss - x1, 0.1)
+    rh = jnp.maximum(boxes[:, 3] * ss - y1, 0.1)
+    bw = (rw / pw)[:, None, None]
+    bh = (rh / ph)[:, None, None]
+    jj, ii = jnp.meshgrid(jnp.arange(pw), jnp.arange(ph))  # (PH, PW)
+    cx = x1[:, None, None] + (jj[None] + 0.5) * bw
+    cy = y1[:, None, None] + (ii[None] + 0.5) * bh
+    # deformation: per-bin (dy, dx) scaled by trans_std and roi size
+    cy = cy + trans[:, 0] * tstd * rh[:, None, None]
+    cx = cx + trans[:, 1] * tstd * rw[:, None, None]
+    cy = jnp.clip(cy, 0.0, h - 1.0)
+    cx = jnp.clip(cx, 0.0, w - 1.0)
+    y0 = jnp.floor(cy).astype(jnp.int32)
+    x0 = jnp.floor(cx).astype(jnp.int32)
+    y1i = jnp.minimum(y0 + 1, h - 1)
+    x1i = jnp.minimum(x0 + 1, w - 1)
+    fy = (cy - y0)[:, None]                 # (R, 1, PH, PW)
+    fx = (cx - x0)[:, None]
+
+    def gather(feat, yy, xx):
+        # feat (R, C, H, W); yy/xx (R, PH, PW) -> (R, C, PH, PW)
+        flat = feat.reshape(r, c, h * w)
+        idx = (yy * w + xx)[:, None].repeat(c, axis=1)
+        return jnp.take_along_axis(flat, idx.reshape(r, c, ph * pw),
+                                   axis=2).reshape(r, c, ph, pw)
+
+    v00 = gather(feats, y0, x0)
+    v01 = gather(feats, y0, x1i)
+    v10 = gather(feats, y1i, x0)
+    v11 = gather(feats, y1i, x1i)
+    out = (v00 * (1 - fy) * (1 - fx) + v01 * (1 - fy) * fx +
+           v10 * fy * (1 - fx) + v11 * fy * fx)
+    if pos_sensitive:
+        # channel block (i, j) feeds output channel slice for bin (i, j):
+        # out2[r, ch, i, j] = out[r, (i, j) block, ch, i, j]
+        co = c // (ph * pw)
+        out = out.reshape(r, ph, pw, co, ph, pw)
+        ii = jnp.arange(ph)[:, None]
+        jj = jnp.arange(pw)[None, :]
+        # advanced indices at axes 1,2,4,5 (non-adjacent to the slices) ->
+        # result (ph, pw, r, co); bring r, co back to the front
+        out = out[:, ii, jj, :, ii, jj].transpose(2, 3, 0, 1)
+    return {"Output": out}
