@@ -1,0 +1,223 @@
+"""Loss long-tail kernels: center/edit-distance/NCE/hsigmoid/sampled-CE.
+
+Reference parity: paddle/fluid/operators/{center_loss_op.h,
+edit_distance_op.h, nce_op.h, hierarchical_sigmoid_op.h,
+sample_logits_op (sampled_softmax_with_cross_entropy),
+teacher_student_sigmoid_loss_op.h}. Sampling ops draw from the op's
+deterministic PRNG (ctx.rng); the DP/tree recursions are lax.scan loops.
+"""
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op
+
+
+def _softplus(x):
+    # max(x,0) + log1p(exp(-|x|)) — the reference's stable spelling
+    return jnp.maximum(x, 0.0) + jnp.log1p(jnp.exp(-jnp.abs(x)))
+
+
+@register_op("teacher_student_sigmoid_loss", nondiff=("Label",))
+def _ts_sigmoid_loss(ctx, ins, attrs):
+    x = ins["X"][0].reshape(-1)
+    label = ins["Label"][0].reshape(-1).astype(jnp.float32)
+    # label < -1: teacher-absent, no click | [-1,0): teacher-absent, click
+    # [0,1): teacher z', no click          | >=1: teacher z'+1, click
+    base = _softplus(x)
+    case0 = base
+    case1 = base - x
+    case2 = base + base - x * label
+    case3 = base - x + base - x * (label - 1.0)
+    y = jnp.where(label < -1.0, case0,
+                  jnp.where(label < 0.0, case1,
+                            jnp.where(label < 1.0, case2, case3)))
+    return {"Y": y.reshape(-1, 1)}
+
+
+@register_op("center_loss", nondiff=("Label", "Centers", "CenterUpdateRate"))
+def _center_loss(ctx, ins, attrs):
+    """0.5*||x - center_{label}||^2; optionally update centers toward the
+    batch means (ref center_loss_op.h: delta averaged by class count)."""
+    x = ins["X"][0]
+    label = ins["Label"][0].reshape(-1)
+    centers = ins["Centers"][0]
+    alpha = ins["CenterUpdateRate"][0].reshape(())
+    picked = jnp.take(centers, label, axis=0)
+    diff = x.astype(jnp.float32) - picked.astype(jnp.float32)
+    loss = 0.5 * jnp.sum(jnp.square(diff), axis=1, keepdims=True)
+    if attrs.get("update_center", True):
+        counts = jnp.zeros((centers.shape[0],), jnp.float32) \
+            .at[label].add(1.0)
+        accum = jnp.zeros_like(centers, shape=centers.shape,
+                               dtype=jnp.float32).at[label].add(diff)
+        update = accum / (1.0 + counts)[:, None]
+        new_centers = centers + alpha.astype(centers.dtype) * \
+            update.astype(centers.dtype)
+    else:
+        new_centers = centers
+    return {"Loss": loss.astype(x.dtype),
+            "SampleCenterDiff": diff.astype(x.dtype),
+            "CentersOut": lax.stop_gradient(new_centers)}
+
+
+@register_op("edit_distance", nondiff=("Hyps", "Refs", "HypsLength",
+                                       "RefsLength"), differentiable=False)
+def _edit_distance(ctx, ins, attrs):
+    """Levenshtein distance per row (ref edit_distance_op.h), dense form:
+    Hyps (N, Th), Refs (N, Tr) int ids + optional lengths."""
+    hyps = ins["Hyps"][0]
+    refs = ins["Refs"][0]
+    n, th = hyps.shape
+    tr = refs.shape[1]
+    hl = ins["HypsLength"][0].reshape(-1) if ins.get("HypsLength") \
+        else jnp.full((n,), th, jnp.int32)
+    rl = ins["RefsLength"][0].reshape(-1) if ins.get("RefsLength") \
+        else jnp.full((n,), tr, jnp.int32)
+
+    def one(hyp, ref, m, r):
+        # DP rows over the reference; positions past lengths are inert
+        row0 = jnp.arange(tr + 1, dtype=jnp.float32)
+        row0 = jnp.minimum(row0, r.astype(jnp.float32))
+
+        def body(row, i):
+            # i indexes hyp (1-based row of the DP table)
+            valid_i = i < m
+
+            def cell(carry, j):
+                left = carry          # D[i][j-1]
+                up = row[j]           # D[i-1][j]
+                diag = row[j - 1]     # D[i-1][j-1]
+                sub = diag + jnp.where(hyp[i] == ref[j - 1], 0.0, 1.0)
+                val = jnp.minimum(jnp.minimum(up + 1.0, left + 1.0), sub)
+                val = jnp.where(j <= r, val, left)   # clamp past ref len
+                return val, val
+
+            first = jnp.where(valid_i, (i + 1).astype(jnp.float32), row[0])
+            _, rest = lax.scan(cell, first, jnp.arange(1, tr + 1))
+            new_row = jnp.concatenate([first[None], rest])
+            return jnp.where(valid_i, new_row, row), None
+
+        row, _ = lax.scan(body, row0, jnp.arange(th))
+        return row[jnp.minimum(r, tr)]
+
+    dist = jax.vmap(one)(hyps, refs, hl, rl)
+    if attrs.get("normalized", True):
+        dist = dist / jnp.maximum(rl.astype(jnp.float32), 1.0)
+    return {"Out": dist.reshape(n, 1),
+            "SequenceNum": jnp.asarray([n], jnp.int32)}
+
+
+def _sample_classes(key, num_total, num_samples, sampler):
+    if sampler == "log_uniform":
+        u = jax.random.uniform(key, (num_samples,))
+        s = (jnp.exp(u * math.log(num_total + 1.0)) - 1.0).astype(jnp.int32)
+        return jnp.clip(s, 0, num_total - 1)
+    return jax.random.randint(key, (num_samples,), 0, num_total)
+
+
+def _sampler_prob(classes, num_total, sampler):
+    if sampler == "log_uniform":
+        c = classes.astype(jnp.float32)
+        return jnp.log((c + 2.0) / (c + 1.0)) / math.log(num_total + 1.0)
+    return jnp.full(classes.shape, 1.0 / num_total)
+
+
+@register_op("nce", nondiff=("Label",), uses_rng=True)
+def _nce(ctx, ins, attrs):
+    """Noise-contrastive estimation (ref nce_op.h): binary logistic on the
+    true class vs num_neg sampled noise classes, scores corrected by
+    log(k*q(class))."""
+    x = ins["Input"][0]                       # (N, D)
+    label = ins["Label"][0].reshape(-1)       # (N,)
+    w = ins["Weight"][0]                      # (C, D)
+    b = ins["Bias"][0].reshape(-1) if ins.get("Bias") else None
+    num_total = int(attrs["num_total_classes"])
+    num_neg = int(attrs.get("num_neg_samples", 10))
+    sampler = attrs.get("sampler", "uniform")
+    neg = _sample_classes(ctx.rng(), num_total, num_neg, sampler)
+
+    def score(cls_rows):
+        s = jnp.einsum("nd,kd->nk", x.astype(jnp.float32),
+                       jnp.take(w, cls_rows, axis=0).astype(jnp.float32))
+        if b is not None:
+            s = s + jnp.take(b, cls_rows)[None, :]
+        return s
+
+    s_true = jnp.sum(x.astype(jnp.float32) *
+                     jnp.take(w, label, axis=0).astype(jnp.float32),
+                     axis=1)
+    if b is not None:
+        s_true = s_true + jnp.take(b, label)
+    logq_true = jnp.log(num_neg *
+                        _sampler_prob(label, num_total, sampler) + 1e-20)
+    logq_neg = jnp.log(num_neg *
+                       _sampler_prob(neg, num_total, sampler) + 1e-20)
+    s_neg = score(neg) - logq_neg[None, :]
+    s_pos = s_true - logq_true
+    loss = _softplus(-s_pos) + jnp.sum(_softplus(s_neg), axis=1)
+    return {"Cost": loss.reshape(-1, 1).astype(x.dtype)}
+
+
+@register_op("hierarchical_sigmoid", nondiff=("Label",))
+def _hsigmoid(ctx, ins, attrs):
+    """Default complete-binary-tree hierarchical sigmoid (ref
+    hierarchical_sigmoid_op.h SimpleCode): leaf code = label+C; path nodes
+    are the heap ancestors code>>k, their row index node-1; the bit stepped
+    through selects the sigmoid target."""
+    x = ins["X"][0]                           # (N, D)
+    label = ins["Label"][0].reshape(-1)       # (N,)
+    w = ins["W"][0]                           # (C-1, D) non-leaf weights
+    b = ins["Bias"][0] if ins.get("Bias") else None
+    num_classes = int(attrs["num_classes"])
+    depth = max(1, int(math.ceil(math.log2(num_classes))))
+    code = label + num_classes                # heap leaf id
+
+    # O(depth) per example: gather only the path nodes' weight rows and
+    # take batched dots — never the dense (N, C-1) logits matrix
+    xf = x.astype(jnp.float32)
+    loss = jnp.zeros(label.shape, jnp.float32)
+    path_scores = []
+    for k in range(1, depth + 1):
+        node = code >> k                      # ancestor at height k
+        valid = node >= 1
+        bit = ((code >> (k - 1)) & 1).astype(jnp.float32)
+        idx = jnp.clip(node - 1, 0, num_classes - 2)
+        wr = jnp.take(w, idx, axis=0).astype(jnp.float32)   # (N, D)
+        s = jnp.sum(xf * wr, axis=1)
+        if b is not None:
+            s = s + jnp.take(b.reshape(-1), idx)
+        path_scores.append(jnp.where(valid, s, 0.0))
+        # sigmoid CE with target = bit
+        term = _softplus(s) - s * bit
+        loss = loss + jnp.where(valid, term, 0.0)
+    return {"Out": loss.reshape(-1, 1).astype(x.dtype),
+            "PreOut": jnp.stack(path_scores, axis=1).astype(x.dtype)}
+
+
+@register_op("sampled_softmax_with_cross_entropy", nondiff=("Label",),
+             uses_rng=True)
+def _sampled_softmax_ce(ctx, ins, attrs):
+    """Softmax CE over the true class + num_samples sampled classes (ref
+    sample_logits_op): sampled logits corrected by log q, true class at
+    column 0."""
+    logits = ins["Logits"][0]                 # (N, C)
+    label = ins["Label"][0].reshape(-1)
+    num_total = logits.shape[-1]
+    num_samples = int(attrs.get("num_samples", 64))
+    use_q = bool(attrs.get("use_customized_samples", False))
+    del use_q  # custom sample feed not supported (documented)
+    sampler = "log_uniform"
+    neg = _sample_classes(ctx.rng(), num_total, num_samples, sampler)
+    lt = jnp.take_along_axis(logits, label[:, None], axis=1)  # (N,1)
+    ln = jnp.take(logits, neg, axis=1)                        # (N,S)
+    qn = jnp.log(_sampler_prob(neg, num_total, sampler) + 1e-20)
+    qt = jnp.log(_sampler_prob(label, num_total, sampler) + 1e-20)
+    # mask accidental hits of the true class among samples
+    hit = neg[None, :] == label[:, None]
+    ln = jnp.where(hit, -1e30, ln - qn[None, :])
+    z = jnp.concatenate([lt - qt[:, None], ln], axis=1)
+    logp = jax.nn.log_softmax(z, axis=1)
+    return {"Loss": (-logp[:, :1]).astype(logits.dtype)}

@@ -1,0 +1,223 @@
+"""Loss long-tail tests vs numpy/torch oracles (reference
+tests/unittests/test_{rank_loss,npair_loss,center_loss,edit_distance,
+nce,hsigmoid,sample_logits,teacher_student}_op.py)."""
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers, optimizer
+from paddle_tpu.ops.registry import get_op
+
+
+class _Ctx:
+    def rng(self):
+        return jax.random.PRNGKey(3)
+
+
+def _run(op, ins, attrs=None):
+    ins = {k: [jnp.asarray(v) for v in vs] for k, vs in ins.items()}
+    return get_op(op).fn(_Ctx(), ins, attrs or {})
+
+
+def _eval(build, feed):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        outs = build()
+    outs = outs if isinstance(outs, (list, tuple)) else [outs]
+    exe = pt.Executor()
+    exe.run(startup)
+    return exe.run(main, feed=feed, fetch_list=list(outs))
+
+
+def test_rank_loss_matches_formula():
+    left = np.array([[2.0], [0.5]], np.float32)
+    right = np.array([[1.0], [1.5]], np.float32)
+    label = np.array([[1.0], [0.0]], np.float32)
+    out, = _eval(lambda: layers.rank_loss(
+        layers.data("rl_l", [2, 1], "float32", append_batch_size=False),
+        layers.data("rl_a", [2, 1], "float32", append_batch_size=False),
+        layers.data("rl_b", [2, 1], "float32", append_batch_size=False)),
+        {"rl_l": label, "rl_a": left, "rl_b": right})
+    d = left - right
+    ref = np.maximum(d, 0) - d * label + np.log1p(np.exp(-np.abs(d)))
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5)
+
+
+def test_teacher_student_sigmoid_loss_cases():
+    x = np.array([[0.7], [-0.3], [1.2], [0.4]], np.float32)
+    lab = np.array([[-2.0], [-1.0], [0.6], [1.4]], np.float32)
+    out = np.asarray(_run("teacher_student_sigmoid_loss",
+                          {"X": [x], "Label": [lab]})["Y"])
+
+    def sp(v):
+        return max(v, 0) + math.log1p(math.exp(-abs(v)))
+    refs = [sp(0.7),
+            sp(-0.3) - (-0.3),
+            sp(1.2) + sp(1.2) - 1.2 * 0.6,
+            sp(0.4) - 0.4 + sp(0.4) - 0.4 * (1.4 - 1.0)]
+    np.testing.assert_allclose(out.reshape(-1), refs, rtol=1e-5)
+
+
+def test_center_loss_values_and_center_update():
+    x = np.array([[1.0, 0.0], [0.0, 2.0], [3.0, 0.0]], np.float32)
+    lab = np.array([[0], [1], [0]], np.int64)
+    r = _run("center_loss", {"X": [x], "Label": [lab],
+                             "Centers": [np.zeros((2, 2), np.float32)],
+                             "CenterUpdateRate":
+                                 [np.array([0.5], np.float32)]},
+             {"update_center": True})
+    loss = np.asarray(r["Loss"]).reshape(-1)
+    np.testing.assert_allclose(loss, [0.5, 2.0, 4.5])
+    centers = np.asarray(r["CentersOut"])
+    # class 0: diff sum (1,0)+(3,0)=(4,0), /(1+2) -> (4/3,0) * 0.5
+    np.testing.assert_allclose(centers[0], [2.0 / 3.0, 0.0], rtol=1e-5)
+    np.testing.assert_allclose(centers[1], [0.0, 0.5], rtol=1e-5)
+
+
+def test_center_loss_layer_trains():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("cl_x", [4, 3], "float32", append_batch_size=False)
+        lab = layers.data("cl_y", [4, 1], "int64", append_batch_size=False)
+        feat = layers.fc(x, size=3)
+        loss = layers.mean(layers.center_loss(feat, lab, num_classes=2,
+                                              alpha=0.1))
+        optimizer.SGD(0.1).minimize(loss)
+    exe = pt.Executor()
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    feed = {"cl_x": rng.rand(4, 3).astype(np.float32),
+            "cl_y": np.array([[0], [1], [0], [1]], np.int64)}
+    l1, = exe.run(main, feed=feed, fetch_list=[loss])
+    for _ in range(20):
+        l2, = exe.run(main, feed=feed, fetch_list=[loss])
+    assert float(l2[0]) < float(l1[0])
+
+
+def test_edit_distance():
+    hyps = np.array([[1, 2, 3, 0], [4, 4, 4, 4]], np.int64)
+    refs = np.array([[1, 3, 3, 0], [4, 4, 0, 0]], np.int64)
+    hl = np.array([3, 4], np.int32)
+    rl = np.array([3, 2], np.int32)
+    r = _run("edit_distance", {"Hyps": [hyps], "Refs": [refs],
+                               "HypsLength": [hl], "RefsLength": [rl]},
+             {"normalized": False})
+    out = np.asarray(r["Out"]).reshape(-1)
+    np.testing.assert_allclose(out, [1.0, 2.0])  # 1 sub; 2 deletions
+    rn = _run("edit_distance", {"Hyps": [hyps], "Refs": [refs],
+                                "HypsLength": [hl], "RefsLength": [rl]},
+              {"normalized": True})
+    np.testing.assert_allclose(np.asarray(rn["Out"]).reshape(-1),
+                               [1 / 3.0, 1.0], rtol=1e-6)
+
+
+def test_nce_layer_trains_and_separates():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("nce_x", [8, 6], "float32", append_batch_size=False)
+        y = layers.data("nce_y", [8, 1], "int64", append_batch_size=False)
+        cost = layers.nce(x, y, num_total_classes=20, num_neg_samples=5)
+        loss = layers.mean(cost)
+        optimizer.Adam(0.05).minimize(loss)
+    exe = pt.Executor()
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    protos = rng.randn(4, 6).astype(np.float32)
+    losses = []
+    for i in range(60):
+        ids = rng.randint(0, 4, 8)
+        feed = {"nce_x": protos[ids] + 0.05 *
+                rng.randn(8, 6).astype(np.float32),
+                "nce_y": ids.reshape(8, 1).astype(np.int64)}
+        lv, = exe.run(main, feed=feed, fetch_list=[loss])
+        losses.append(float(np.asarray(lv).reshape(-1)[0]))
+    assert losses[-1] < losses[0]
+
+
+def test_hsigmoid_trains_and_is_valid_loss():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("hs_x", [8, 5], "float32", append_batch_size=False)
+        y = layers.data("hs_y", [8, 1], "int64", append_batch_size=False)
+        cost = layers.hsigmoid(x, y, num_classes=6)
+        loss = layers.mean(cost)
+        optimizer.Adam(0.1).minimize(loss)
+    exe = pt.Executor()
+    exe.run(startup)
+    rng = np.random.RandomState(1)
+    protos = rng.randn(6, 5).astype(np.float32) * 2
+    losses = []
+    for i in range(60):
+        ids = rng.randint(0, 6, 8)
+        feed = {"hs_x": protos[ids].astype(np.float32),
+                "hs_y": ids.reshape(8, 1).astype(np.int64)}
+        lv, = exe.run(main, feed=feed, fetch_list=[loss])
+        losses.append(float(np.asarray(lv).reshape(-1)[0]))
+    assert losses[0] > 0  # softplus-form loss is positive
+    assert losses[-1] < losses[0] / 2
+
+
+def test_sampled_softmax_ce_discriminates_and_trains():
+    """Sampled softmax under-estimates the full partition by construction
+    (only drawn classes enter Z), so test the properties that matter:
+    correct examples get lower loss, and a linear model trains with it."""
+    rng = np.random.RandomState(0)
+    logits = rng.randn(16, 200).astype(np.float32) * 0.1
+    lab = rng.randint(0, 200, 16)
+    boosted = logits.copy()
+    boosted[np.arange(16), lab] += 4.0
+    r_good = _run("sampled_softmax_with_cross_entropy",
+                  {"Logits": [boosted], "Label": [lab.reshape(16, 1)]},
+                  {"num_samples": 100})
+    r_bad = _run("sampled_softmax_with_cross_entropy",
+                 {"Logits": [logits], "Label": [lab.reshape(16, 1)]},
+                 {"num_samples": 100})
+    good = np.asarray(r_good["Loss"]).mean()
+    bad = np.asarray(r_bad["Loss"]).mean()
+    assert np.isfinite(good) and np.isfinite(bad)
+    assert good < bad - 1.0
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("ss_x", [8, 6], "float32", append_batch_size=False)
+        y = layers.data("ss_y", [8, 1], "int64", append_batch_size=False)
+        lg = layers.fc(x, size=50)
+        loss = layers.mean(layers.sampled_softmax_with_cross_entropy(
+            lg, y, num_samples=20))
+        optimizer.Adam(0.05).minimize(loss)
+    exe = pt.Executor()
+    exe.run(startup)
+    protos = rng.randn(5, 6).astype(np.float32)
+    losses = []
+    for i in range(60):
+        ids = rng.randint(0, 5, 8)
+        lv, = exe.run(main, feed={"ss_x": protos[ids],
+                                  "ss_y": ids.reshape(8, 1)
+                                  .astype(np.int64)}, fetch_list=[loss])
+        losses.append(float(np.asarray(lv).reshape(-1)[0]))
+    assert losses[-1] < losses[0]
+
+
+def test_npair_loss_prefers_matching_pairs():
+    rng = np.random.RandomState(0)
+    emb = np.eye(4, dtype=np.float32)
+    labels = np.arange(4).astype(np.int64)
+
+    def build(name_a, name_p, name_l):
+        return layers.npair_loss(
+            layers.data(name_a, [4, 4], "float32", append_batch_size=False),
+            layers.data(name_p, [4, 4], "float32", append_batch_size=False),
+            layers.data(name_l, [4], "int64", append_batch_size=False),
+            l2_reg=0.0)
+
+    good, = _eval(lambda: build("np_a", "np_p", "np_l"),
+                  {"np_a": emb * 4, "np_p": emb * 4, "np_l": labels})
+    bad, = _eval(lambda: build("np_a2", "np_p2", "np_l2"),
+                 {"np_a2": emb * 4, "np_p2": np.roll(emb, 1, 0) * 4,
+                  "np_l2": labels})
+    assert float(np.asarray(good).reshape(-1)[0]) < \
+        float(np.asarray(bad).reshape(-1)[0])
