@@ -6,7 +6,7 @@ from .loss import *          # noqa: F401,F403
 from .control_flow import *  # noqa: F401,F403
 from .metric_op import accuracy, auc  # noqa: F401
 from .io import (data, py_reader, read_file, double_buffer,  # noqa: F401
-                 EOFException)
+                 EOFException, create_py_reader_by_data, load)
 from . import learning_rate_scheduler  # noqa: F401
 from .learning_rate_scheduler import (  # noqa: F401
     noam_decay, exponential_decay, natural_exp_decay, inverse_time_decay,

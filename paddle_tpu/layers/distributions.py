@@ -97,3 +97,50 @@ class Categorical(Distribution):
         p = softmax(self.logits)
         logp = log_softmax(self.logits)
         return reduce_sum(elementwise_mul(p, logp), dim=-1) * (-1.0)
+
+
+class MultivariateNormalDiag(Distribution):
+    """Multivariate normal with diagonal covariance (ref
+    distributions.py MultivariateNormalDiag: loc (D,), scale diag (D, D);
+    entropy and kl_divergence follow the reference formulas, which read
+    `scale` as the covariance matrix)."""
+
+    def __init__(self, loc, scale):
+        self.loc = loc
+        self.scale = scale          # (D, D) diagonal matrix
+
+    def _diag(self):
+        from .nn import reduce_sum, elementwise_mul
+        from . import tensor as TT
+        import numpy as np
+        d = int(self.scale.shape[-1])
+        eye = TT.assign(np.eye(d, dtype=np.float32))
+        return reduce_sum(elementwise_mul(self.scale, eye), dim=-1)
+
+    def entropy(self):
+        """0.5 (D (1 + log 2pi) + log|Sigma|)."""
+        from .nn import reduce_sum, scale as _sc
+        from .ops import log
+        d = int(self.scale.shape[-1])
+        logdet = reduce_sum(log(self._diag()), dim=-1)
+        half = float(0.5 * d * (1.0 + math.log(2.0 * math.pi)))
+        return _sc(logdet, scale=0.5, bias=half)
+
+    def kl_divergence(self, other):
+        """KL(self || other): the reference treats `scale` as the
+        COVARIANCE matrix — 0.5*(tr(S2^-1 S1) + (m2-m1)^T S2^-1 (m2-m1)
+        - k + ln det S2/det S1) on the diagonals."""
+        from .nn import (reduce_sum, elementwise_div, elementwise_sub,
+                         scale as _sc)
+        from .ops import log, square
+        d1 = self._diag()
+        d2 = other._diag()
+        k = int(self.scale.shape[-1])
+        tr = reduce_sum(elementwise_div(d1, d2), dim=-1)
+        quad = reduce_sum(elementwise_div(
+            square(elementwise_sub(other.loc, self.loc)), d2), dim=-1)
+        ln_cov = elementwise_sub(reduce_sum(log(d2), dim=-1),
+                                 reduce_sum(log(d1), dim=-1))
+        inner = elementwise_add(elementwise_add(tr, quad), ln_cov)
+        return _sc(inner, scale=0.5, bias=-0.5 * k)
+

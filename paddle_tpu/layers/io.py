@@ -33,14 +33,20 @@ class PyReader(object):
     caller does not feed them — the same run-without-feed training loop
     fluid scripts use, minus the C++ blocking queue."""
 
-    def __init__(self, capacity, shapes, dtypes, lod_levels=None, name=None,
-                 use_double_buffer=True):
+    def __init__(self, capacity, shapes=None, dtypes=None, lod_levels=None,
+                 name=None, use_double_buffer=True, feed_list=None):
         import queue as _queue
         from ..framework import unique_name
-        base = name or unique_name.generate("py_reader")
-        self._names = ["%s_slot_%d" % (base, i) for i in range(len(shapes))]
-        self._vars = [data(n, list(s), dtype=d, append_batch_size=False)
-                      for n, s, d in zip(self._names, shapes, dtypes)]
+        if feed_list is not None:       # wrap EXISTING data Variables
+            self._vars = list(feed_list)
+            self._names = [v.name for v in self._vars]
+        else:
+            base = name or unique_name.generate("py_reader")
+            self._names = ["%s_slot_%d" % (base, i)
+                           for i in range(len(shapes))]
+            self._vars = [data(n, list(s), dtype=d,
+                               append_batch_size=False)
+                          for n, s, d in zip(self._names, shapes, dtypes)]
         # the host-side queue always honours the requested capacity;
         # use_double_buffer in the reference only adds the device staging
         # slot, which here is Executor._convert_feed's device_put
@@ -161,3 +167,24 @@ def double_buffer(reader, place=None, name=None):
     staged batches to GPU memory; device_put staging happens in
     Executor._convert_feed)."""
     return reader
+
+
+def create_py_reader_by_data(capacity, feed_list, name=None,
+                             use_double_buffer=True):
+    """PyReader wired to EXISTING data Variables (ref layers/io.py
+    create_py_reader_by_data) — batches from the decorated reader feed
+    those variables by name."""
+    return PyReader(capacity, name=name, use_double_buffer=use_double_buffer,
+                    feed_list=feed_list)
+
+
+def load(out, file_path, load_as_fp16=False):
+    """Load one saved tensor into `out` (ref layers/io.py load / load_op).
+    Reads a .npy written by layers-level save or numpy."""
+    prog = default_main_program()
+    blk = prog.current_block()
+    blk.append_op("load_tensor", inputs={},
+                  outputs={"Out": [out.name]},
+                  attrs={"file_path": str(file_path),
+                         "load_as_fp16": bool(load_as_fp16)})
+    return out

@@ -18,6 +18,7 @@ __all__ = [
     "sequence_last_step", "sequence_reverse", "sequence_pad",
     "sequence_unpad", "sequence_erase", "sequence_enumerate",
     "sequence_slice", "sequence_reshape", "sequence_conv",
+    "sequence_scatter",
 ]
 
 
@@ -294,3 +295,16 @@ def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
 def _slice_time(x, start, end):
     from .nn import slice as slice_layer
     return slice_layer(x, axes=[1], starts=[start], ends=[end])
+
+
+def sequence_scatter(input, index, updates, lengths=None, name=None):
+    """Scatter per-row updates into per-row positions (ref
+    sequence_ops/sequence_scatter_op.h). Dense form: input (N, T),
+    index (N, K) positions, updates (N, K) values added at those
+    positions (duplicates accumulate, matching scatter-add); lengths
+    (N,) masks each row's padded tail of (index, updates) pairs."""
+    ins = {"X": [input], "Ids": [index], "Updates": [updates]}
+    if lengths is not None:
+        ins["Length"] = [lengths]
+    out, = _seq_op("sequence_scatter", ins, n_out=1, name=name)
+    return out

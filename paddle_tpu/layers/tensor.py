@@ -263,3 +263,20 @@ def isfinite(x):
     helper.append_op("isfinite", inputs={"X": [x.name]},
                      outputs={"Out": [out.name]})
     return out
+
+
+def tensor_array_to_tensor(input, axis=1, name=None, use_stack=False):
+    """Stack/concat a (build-time) TensorArray into one tensor + the
+    per-entry sizes (ref tensor.py tensor_array_to_tensor)."""
+    import numpy as np
+    from .nn import stack
+    entries = [v for v in input if v is not None]
+    if not entries:
+        raise ValueError("tensor_array_to_tensor: empty array")
+    if use_stack:
+        out = stack(entries, axis=axis)
+        sizes = [1] * len(entries)
+    else:
+        out = concat(entries, axis=axis)
+        sizes = [int(v.shape[axis]) for v in entries]
+    return out, assign(np.asarray(sizes, np.int32))

@@ -182,3 +182,15 @@ def _remat_block(ctx, ins, attrs):
 
     outs = jax.checkpoint(fn)(*vals)
     return {"Out": list(outs)}
+
+
+@register_op("print")
+def _print(ctx, ins, attrs):
+    """Identity with an in-step debug print (ref print_op.cc); gradients
+    pass straight through."""
+    import jax
+    x = ins["In"][0]
+    n = int(attrs.get("summarize", 20))
+    jax.debug.print(str(attrs.get("message", "")) + " {}",
+                    x.reshape(-1)[:n] if n > 0 else x)
+    return {"Out": x}

@@ -156,3 +156,31 @@ def _sequence_expand(ctx, ins, attrs):
     mask = (pos < total).reshape((-1,) + (1,) * (out.ndim - 1))
     out = out * mask.astype(out.dtype)
     return {"Out": out, "OutLength": total.reshape(1)}
+
+
+@register_op("sequence_scatter", nondiff=("Ids", "Length"))
+def _sequence_scatter(ctx, ins, attrs):
+    """x[n, ids[n, k]] += updates[n, k] for k < length[n] (ref
+    sequence_scatter_op.h on the dense per-row encoding; padded (id,
+    update) pairs past a row's length are masked out)."""
+    x = ins["X"][0]
+    ids = ins["Ids"][0].astype(jnp.int32)
+    upd = ins["Updates"][0]
+    n, k = ids.shape
+    if ins.get("Length"):
+        lens = ins["Length"][0].reshape(-1)
+        upd = upd * (jnp.arange(k)[None, :] < lens[:, None]).astype(
+            upd.dtype)
+    rows = jnp.arange(n)[:, None].repeat(k, axis=1)
+    return {"Out": x.at[rows.reshape(-1),
+                        ids.reshape(-1)].add(upd.reshape(-1))}
+
+
+@register_op("reorder_by_rank", nondiff=("RankTable",))
+def _reorder_by_rank(ctx, ins, attrs):
+    """Stable sort rows by descending length (ref
+    reorder_lod_tensor_by_rank_op.cc)."""
+    x = ins["X"][0]
+    lens = ins["RankTable"][0].reshape(-1)
+    order = jnp.argsort(-lens, stable=True)
+    return {"Out": jnp.take(x, order, axis=0)}

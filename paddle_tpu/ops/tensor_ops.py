@@ -72,7 +72,10 @@ def _shape(ctx, ins, attrs):
 
 @register_op("increment")
 def _increment(ctx, ins, attrs):
-    return {"Out": _x(ins) + attrs.get("step", 1.0)}
+    x = _x(ins)
+    # keep the counter's dtype: int counters + python-float step would
+    # weak-promote to float32 and break loop-carry type invariants
+    return {"Out": x + jnp.asarray(attrs.get("step", 1.0), x.dtype)}
 
 
 @register_op("reshape2")
@@ -369,3 +372,14 @@ def _coalesce_tensor(ctx, ins, attrs):
     # buffer management, so this is an identity pass-through.
     return {"Output": list(ins["Input"]), "FusedOutput":
             jnp.concatenate([x.reshape(-1) for x in ins["Input"]])}
+
+
+@register_op("load_tensor", differentiable=False)
+def _load_tensor(ctx, ins, attrs):
+    """Host-side tensor load at trace time (ref load_op.cc; used by
+    startup-style programs, so the file read happens once per compile)."""
+    import numpy as np
+    arr = np.load(attrs["file_path"])
+    if attrs.get("load_as_fp16"):
+        arr = arr.astype(np.float16)
+    return {"Out": jnp.asarray(arr)}

@@ -1,0 +1,336 @@
+"""fluid-style control-flow classes (While/Switch/StaticRNN/DynamicRNN/
+IfElse/Print/arrays) — reference tests/unittests/test_{while_op,switch,
+recurrent_op,dynrnn,...}.py on the dense design."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers, optimizer
+
+
+def test_while_class_accumulates():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        i = layers.fill_constant([1], "int64", 0)
+        n = layers.fill_constant([1], "int64", 5)
+        acc = layers.fill_constant([1], "float32", 0.0)
+        cond = layers.less_than(i, n)
+        w = layers.While(cond)
+        with w.block():
+            new_acc = layers.elementwise_add(
+                acc, layers.cast(i, "float32"))
+            layers.assign(new_acc, acc)
+            layers.increment(i, value=1)
+            layers.less_than(i, n, cond=cond)
+        total = layers.scale(acc, scale=1.0)
+    exe = pt.Executor()
+    exe.run(startup)
+    tv, = exe.run(main, feed={}, fetch_list=[total])
+    assert float(np.asarray(tv).reshape(-1)[0]) == 10.0  # 0+1+2+3+4
+
+
+def test_switch_class_first_match_wins():
+    def run(step_val):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            step = layers.fill_constant([1], "float32", step_val)
+            lr = layers.fill_constant([1], "float32", -1.0)
+            b1 = layers.fill_constant([1], "float32", 10.0)
+            b2 = layers.fill_constant([1], "float32", 20.0)
+            with layers.Switch() as switch:
+                with switch.case(layers.less_than(step, b1)):
+                    layers.assign(
+                        layers.fill_constant([1], "float32", 0.1), lr)
+                with switch.case(layers.less_than(step, b2)):
+                    layers.assign(
+                        layers.fill_constant([1], "float32", 0.01), lr)
+                with switch.default():
+                    layers.assign(
+                        layers.fill_constant([1], "float32", 0.001), lr)
+            out = layers.scale(lr, scale=1.0)
+        exe = pt.Executor()
+        exe.run(startup)
+        ov, = exe.run(main, feed={}, fetch_list=[out])
+        return float(np.asarray(ov).reshape(-1)[0])
+
+    assert run(5.0) == pytest.approx(0.1)
+    assert run(15.0) == pytest.approx(0.01)
+    assert run(50.0) == pytest.approx(0.001)
+
+
+def test_static_rnn_matches_manual_scan():
+    t, b, d = 5, 2, 3
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("srnn_x", [t, b, d], "float32",
+                        append_batch_size=False)
+        w = layers.create_parameter(
+            [d, d], "float32", name="srnn_w",
+            default_initializer=pt.initializer.Constant(0.3))
+        rnn = layers.StaticRNN()
+        with rnn.step():
+            x_t = rnn.step_input(x)
+            h_prev = rnn.memory(shape=[-1, d], batch_ref=x_t,
+                                init_value=0.0)
+            h = layers.tanh(layers.elementwise_add(
+                layers.matmul(x_t, w), h_prev))
+            rnn.update_memory(h_prev, h)
+            rnn.step_output(h)
+        out = rnn()
+        loss = layers.reduce_mean(out)
+        optimizer.SGD(0.0).minimize(loss)      # exercises the vjp
+        grads = pt.gradients(loss, [w])
+    exe = pt.Executor()
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    xv = rng.randn(t, b, d).astype(np.float32)
+    ov, gv = exe.run(main, feed={"srnn_x": xv},
+                     fetch_list=[out, grads[0]])
+
+    # numpy oracle
+    wv = np.full((d, d), 0.3, np.float32)
+    h = np.zeros((b, d), np.float32)
+    expect = []
+    for step in range(t):
+        h = np.tanh(xv[step] @ wv + h)
+        expect.append(h)
+    np.testing.assert_allclose(np.asarray(ov), np.stack(expect),
+                               rtol=1e-5, atol=1e-6)
+
+    def loss_np(wflat):
+        import jax.numpy as jnpp
+        wj = wflat.reshape(d, d)
+        hh = jnpp.zeros((b, d))
+        outs = []
+        for step in range(t):
+            hh = jnpp.tanh(xv[step] @ wj + hh)
+            outs.append(hh)
+        return jnpp.mean(jnpp.stack(outs))
+
+    gref = jax.grad(lambda wf: loss_np(wf))(wv.reshape(-1).astype(
+        np.float32))
+    np.testing.assert_allclose(np.asarray(gv).reshape(-1),
+                               np.asarray(gref), rtol=1e-4, atol=1e-5)
+
+
+def test_dynamic_rnn_respects_lengths():
+    b, t, d = 2, 4, 3
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("drnn_x", [b, t, d], "float32",
+                        append_batch_size=False)
+        lens = layers.data("drnn_l", [b], "int32",
+                           append_batch_size=False)
+        drnn = layers.DynamicRNN()
+        with drnn.block():
+            x_t = drnn.step_input(x, lengths=lens)
+            h_prev = drnn.memory(shape=[-1, d], batch_ref=x_t, value=0.0)
+            h = layers.tanh(layers.elementwise_add(x_t, h_prev))
+            drnn.update_memory(h_prev, h)
+            drnn.output(h)
+        out = drnn()
+    exe = pt.Executor()
+    exe.run(startup)
+    rng = np.random.RandomState(1)
+    xv = rng.randn(b, t, d).astype(np.float32)
+    lv = np.array([2, 4], np.int32)
+    ov, = exe.run(main, feed={"drnn_x": xv, "drnn_l": lv},
+                  fetch_list=[out])
+    ov = np.asarray(ov)
+    # steps past a row's length emit zeros; memory freezes there
+    assert np.allclose(ov[0, 2:], 0.0)
+    h = np.zeros(d, np.float32)
+    for step in range(2):
+        h = np.tanh(xv[0, step] + h)
+        np.testing.assert_allclose(ov[0, step], h, rtol=1e-5)
+    h = np.zeros(d, np.float32)
+    for step in range(4):
+        h = np.tanh(xv[1, step] + h)
+        np.testing.assert_allclose(ov[1, step], h, rtol=1e-5)
+
+
+def test_ifelse_rowwise_merge():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("ie_x", [4, 2], "float32", append_batch_size=False)
+        zero = layers.fill_constant([4, 1], "float32", 0.0)
+        first = layers.slice(x, axes=[1], starts=[0], ends=[1])
+        cond = layers.greater_than(first, zero)
+        ie = layers.IfElse(cond)
+        with ie.true_block():
+            xt = ie.input(x)
+            ie.output(layers.scale(xt, scale=2.0))
+        with ie.false_block():
+            xf = ie.input(x)
+            ie.output(layers.scale(xf, scale=-1.0))
+        merged, = ie()
+    exe = pt.Executor()
+    exe.run(startup)
+    xv = np.array([[1.0, 5.0], [-2.0, 3.0], [0.5, -1.0], [-0.1, 0.0]],
+                  np.float32)
+    ov, = exe.run(main, feed={"ie_x": xv}, fetch_list=[merged])
+    expect = np.where(xv[:, :1] > 0, xv * 2.0, xv * -1.0)
+    np.testing.assert_allclose(np.asarray(ov), expect, rtol=1e-6)
+
+
+def test_arrays_and_to_tensor():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        arr = layers.create_array("float32")
+        for k in range(3):
+            v = layers.fill_constant([2, 2], "float32", float(k))
+            layers.array_write(v, k, arr)
+        ln = layers.array_length(arr)
+        r1 = layers.array_read(arr, 1)
+        stacked, sizes = layers.tensor_array_to_tensor(arr, axis=0,
+                                                       use_stack=True)
+    exe = pt.Executor()
+    exe.run(startup)
+    lv, rv, sv = exe.run(main, feed={}, fetch_list=[ln, r1, stacked])
+    assert int(np.asarray(lv)[0]) == 3
+    np.testing.assert_allclose(np.asarray(rv), np.ones((2, 2)))
+    assert np.asarray(sv).shape == (3, 2, 2)
+    with pt.program_guard(pt.Program(), pt.Program()):
+        arr2 = layers.create_array("float32")
+        iv = layers.fill_constant([1], "int64", 0)
+        with pytest.raises(NotImplementedError):
+            layers.array_write(layers.fill_constant([1], "float32", 1.0),
+                               iv, arr2)
+
+
+def test_print_passthrough_and_is_empty():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("pr_x", [2, 2], "float32", append_batch_size=False)
+        y = layers.Print(x, message="dbg")
+        out = layers.scale(y, scale=3.0)
+        e = layers.is_empty(x)
+    exe = pt.Executor()
+    exe.run(startup)
+    xv = np.ones((2, 2), np.float32)
+    ov, ev = exe.run(main, feed={"pr_x": xv}, fetch_list=[out, e])
+    np.testing.assert_allclose(np.asarray(ov), xv * 3.0)
+    assert not bool(np.asarray(ev)[0])
+
+
+def test_sequence_scatter_and_reorder_by_rank():
+    from paddle_tpu.ops.registry import get_op
+
+    class _Ctx:
+        def rng(self):
+            return jax.random.PRNGKey(0)
+
+    x = np.zeros((2, 5), np.float32)
+    ids = np.array([[0, 2], [4, 4]], np.int64)
+    upd = np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)
+    r = get_op("sequence_scatter").fn(
+        _Ctx(), {"X": [jnp.asarray(x)], "Ids": [jnp.asarray(ids)],
+                 "Updates": [jnp.asarray(upd)]}, {})
+    out = np.asarray(r["Out"])
+    np.testing.assert_allclose(out[0], [1, 0, 2, 0, 0])
+    np.testing.assert_allclose(out[1], [0, 0, 0, 0, 7])  # dup accumulates
+
+    xr = np.arange(6, dtype=np.float32).reshape(3, 2)
+    lens = np.array([1, 3, 2], np.int32)
+    r2 = get_op("reorder_by_rank").fn(
+        _Ctx(), {"X": [jnp.asarray(xr)], "RankTable": [jnp.asarray(lens)]},
+        {})
+    np.testing.assert_allclose(np.asarray(r2["Out"]),
+                               xr[[1, 2, 0]])
+
+
+def test_mvn_diag_entropy_and_kl():
+    import math
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        from paddle_tpu.layers.distributions import MultivariateNormalDiag
+        loc1 = layers.data("m1", [2], "float32", append_batch_size=False)
+        sc1 = layers.data("s1", [2, 2], "float32", append_batch_size=False)
+        loc2 = layers.data("m2", [2], "float32", append_batch_size=False)
+        sc2 = layers.data("s2", [2, 2], "float32", append_batch_size=False)
+        d1 = MultivariateNormalDiag(loc1, sc1)
+        d2 = MultivariateNormalDiag(loc2, sc2)
+        ent = d1.entropy()
+        kl = d1.kl_divergence(d2)
+    exe = pt.Executor()
+    exe.run(startup)
+    s1 = np.diag([1.0, 2.0]).astype(np.float32)
+    s2 = np.diag([2.0, 2.0]).astype(np.float32)
+    ev, kv = exe.run(main, feed={
+        "m1": np.array([0.0, 0.0], np.float32), "s1": s1,
+        "m2": np.array([1.0, 0.0], np.float32), "s2": s2},
+        fetch_list=[ent, kl])
+    # reference reads `scale` as the covariance: log det = log(1*2)
+    ref_ent = 0.5 * (2 * (1 + math.log(2 * math.pi)) + math.log(2.0))
+    np.testing.assert_allclose(float(np.asarray(ev).reshape(-1)[0]),
+                               ref_ent, rtol=1e-5)
+    # reference formula (covariance semantics)
+    d1v, d2v = np.array([1.0, 2.0]), np.array([2.0, 2.0])
+    tr = np.sum(d1v / d2v)
+    quad = np.sum((np.array([1.0, 0.0]) ** 2) / d2v)
+    ref_kl = 0.5 * (tr + quad - 2 +
+                    np.sum(np.log(d2v)) - np.sum(np.log(d1v)))
+    np.testing.assert_allclose(float(np.asarray(kv).reshape(-1)[0]),
+                               ref_kl, rtol=1e-5)
+
+
+def test_switch_default_only():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        lr = layers.fill_constant([1], "float32", -1.0)
+        with layers.Switch() as sw:
+            with sw.default():
+                layers.assign(layers.fill_constant([1], "float32", 0.5),
+                              lr)
+        out = layers.scale(lr, scale=1.0)
+    exe = pt.Executor()
+    exe.run(startup)
+    ov, = exe.run(main, feed={}, fetch_list=[out])
+    assert float(np.asarray(ov)[0]) == pytest.approx(0.5)
+
+
+def test_tensor_array_to_tensor_sizes_is_variable():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        arr = layers.create_array("float32")
+        layers.array_write(layers.fill_constant([2, 3], "float32", 1.0),
+                           0, arr)
+        layers.array_write(layers.fill_constant([2, 2], "float32", 2.0),
+                           1, arr)
+        out, sizes = layers.tensor_array_to_tensor(arr, axis=1)
+        assert hasattr(sizes, "name")       # a Variable, not a tuple
+    exe = pt.Executor()
+    exe.run(startup)
+    ov, sv = exe.run(main, feed={}, fetch_list=[out, sizes])
+    assert np.asarray(ov).shape == (2, 5)
+    np.testing.assert_array_equal(np.asarray(sv), [3, 2])
+
+
+def test_sequence_scatter_lengths_mask():
+    from paddle_tpu.ops.registry import get_op
+
+    class _Ctx:
+        def rng(self):
+            return jax.random.PRNGKey(0)
+
+    x = np.zeros((2, 4), np.float32)
+    ids = np.array([[1, 0], [2, 0]], np.int64)
+    upd = np.ones((2, 2), np.float32)
+    lens = np.array([1, 2], np.int32)
+    r = get_op("sequence_scatter").fn(
+        _Ctx(), {"X": [jnp.asarray(x)], "Ids": [jnp.asarray(ids)],
+                 "Updates": [jnp.asarray(upd)],
+                 "Length": [jnp.asarray(lens)]}, {})
+    out = np.asarray(r["Out"])
+    np.testing.assert_allclose(out[0], [0, 1, 0, 0])  # padded pair masked
+    np.testing.assert_allclose(out[1], [1, 0, 1, 0])
+
+
+def test_is_empty_rejects_dynamic():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("iedyn", [3])        # (-1, 3) dynamic batch
+        with pytest.raises(ValueError):
+            layers.is_empty(x)
