@@ -40,6 +40,13 @@ from . import dataset
 from .dataset import DatasetFactory
 from .parallel_executor import ParallelExecutor
 from . import average
+from . import incubate
+from . import transpiler
+from .transpiler import (DistributeTranspiler, DistributeTranspilerConfig,
+                         memory_optimize, release_memory)
+from . import lod_tensor as lod_tensor_mod
+from .lod_tensor import (LoDTensor, create_lod_tensor,
+                         create_random_int_lodtensor)
 from .framework.compiler import make_mesh
 from .layers.io import data
 from .install_check import run_check

@@ -6,3 +6,10 @@ from . import mixed_precision
 from . import extend_optimizer
 from . import quantize
 from . import slim
+from . import layers
+from . import model_stat
+from . import memory_usage_calc
+from . import op_frequence
+from .memory_usage_calc import memory_usage
+from .model_stat import summary
+from .op_frequence import op_freq_statistic

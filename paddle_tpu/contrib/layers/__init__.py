@@ -1,0 +1,10 @@
+"""Contrib layers (ref python/paddle/fluid/contrib/layers/__init__.py)."""
+from .nn import *  # noqa: F401,F403
+from .rnn_impl import *  # noqa: F401,F403
+from .metric_op import *  # noqa: F401,F403
+
+from . import nn
+from . import rnn_impl
+from . import metric_op
+
+__all__ = nn.__all__ + rnn_impl.__all__ + metric_op.__all__

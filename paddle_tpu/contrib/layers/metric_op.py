@@ -1,0 +1,26 @@
+"""Contrib metric ops (ref python/paddle/fluid/contrib/layers/metric_op.py).
+
+``ctr_metric_bundle`` emits the same six CTR monitoring aggregates the
+reference computes with specialized ops; here they are ordinary graph
+ops fused by XLA into the step.
+"""
+from ... import layers
+
+__all__ = ['ctr_metric_bundle']
+
+
+def ctr_metric_bundle(input, label):
+    """For click-probability ``input`` and 0/1 ``label`` (both (N, 1)):
+    returns (squared_error_sum, abs_error_sum, prob_sum, q_sum(=prob_sum
+    of positive calibration), pos_count, total_count) — the running
+    numerators a CTR dashboard aggregates across batches
+    (ref metric_op.py:30)."""
+    diff = layers.elementwise_sub(input, layers.cast(label, input.dtype))
+    sqrerr = layers.reduce_sum(layers.square(diff))
+    abserr = layers.reduce_sum(layers.abs(diff))
+    prob = layers.reduce_sum(input)
+    q = layers.reduce_sum(layers.elementwise_mul(input, input))
+    pos = layers.reduce_sum(layers.cast(label, input.dtype))
+    total = layers.fill_constant([1], input.dtype,
+                                 float(input.shape[0]))
+    return sqrerr, abserr, prob, q, pos, total

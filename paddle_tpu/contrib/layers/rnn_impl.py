@@ -1,0 +1,250 @@
+"""Multi-layer/bidirectional RNN compositions
+(ref python/paddle/fluid/contrib/layers/rnn_impl.py).
+
+The reference builds these from per-step basic ops inside a StaticRNN;
+here each direction/layer is one ``lstm_seq``/``gru_seq`` op — a single
+lax.scan over time in the traced step, which XLA unrolls onto the MXU
+far better than op-per-timestep graphs.  Padding is handled the
+dense+lengths way: when ``sequence_length`` is given, post-step states
+are masked so each sequence's last *valid* state propagates (identical
+to the reference's mask/tril trick).
+
+Returns match the reference: basic_gru -> (rnn_out, last_hidden);
+basic_lstm -> (rnn_out, last_hidden, last_cell); last states have shape
+(num_layers * num_directions, batch, hidden).
+"""
+from ... import layers
+from ...dygraph.layers import Layer
+from ...dygraph.nn import run_op, apply_eager
+
+__all__ = ['BasicGRUUnit', 'basic_gru', 'BasicLSTMUnit', 'basic_lstm']
+
+
+class BasicGRUUnit(Layer):
+    """Single-step GRU cell for dygraph (ref rnn_impl.py:22).
+    forward(input (N, D), pre_hidden (N, H)) -> new_hidden."""
+
+    def __init__(self, name_scope, hidden_size, param_attr=None,
+                 bias_attr=None, gate_activation=None, activation=None,
+                 dtype='float32'):
+        super(BasicGRUUnit, self).__init__(dtype=dtype)
+        self._hidden_size = hidden_size
+        self._gate_act = gate_activation or "sigmoid"
+        self._act = activation or "tanh"
+        self._dtype = dtype
+        self._built = False
+
+    def _build_once(self, input):
+        d = input.shape()[-1] if callable(getattr(input, "shape", None)) \
+            else input.shape[-1]
+        h = self._hidden_size
+        self._gate_weight = self.add_parameter(
+            "gate_weight", self.create_parameter([d + h, 2 * h]))
+        self._candidate_weight = self.add_parameter(
+            "candidate_weight", self.create_parameter([d + h, h]))
+        self._gate_bias = self.add_parameter(
+            "gate_bias", self.create_parameter([2 * h], is_bias=True))
+        self._candidate_bias = self.add_parameter(
+            "candidate_bias", self.create_parameter([h], is_bias=True))
+        self._built = True
+
+    def forward(self, input, pre_hidden):
+        import jax.numpy as jnp
+        if not self._built:
+            self._build_once(input)
+        h = self._hidden_size
+
+        def step(x, hp, gw, gb, cw, cb):
+            concat = jnp.concatenate([x, hp], axis=-1)
+            gates = jnp.matmul(concat, gw) + gb
+            if self._gate_act == "sigmoid":
+                gates = 1.0 / (1.0 + jnp.exp(-gates))
+            else:
+                gates = jnp.tanh(gates)
+            u, r = gates[..., :h], gates[..., h:]
+            cand_in = jnp.concatenate([x, r * hp], axis=-1)
+            c = jnp.matmul(cand_in, cw) + cb
+            c = jnp.tanh(c) if self._act == "tanh" else \
+                1.0 / (1.0 + jnp.exp(-c))
+            return u * hp + (1.0 - u) * c
+
+        return apply_eager(step, input, pre_hidden, self._gate_weight,
+                           self._gate_bias, self._candidate_weight,
+                           self._candidate_bias)
+
+
+class BasicLSTMUnit(Layer):
+    """Single-step LSTM cell for dygraph (ref rnn_impl.py:632).
+    forward(input, pre_hidden, pre_cell) -> (new_hidden, new_cell)."""
+
+    def __init__(self, name_scope, hidden_size, param_attr=None,
+                 bias_attr=None, gate_activation=None, activation=None,
+                 forget_bias=1.0, dtype='float32'):
+        super(BasicLSTMUnit, self).__init__(dtype=dtype)
+        self._hidden_size = hidden_size
+        self._forget_bias = forget_bias
+        self._built = False
+
+    def _build_once(self, input):
+        d = input.shape()[-1] if callable(getattr(input, "shape", None)) \
+            else input.shape[-1]
+        h = self._hidden_size
+        self._weight = self.add_parameter(
+            "weight", self.create_parameter([d + h, 4 * h]))
+        self._bias = self.add_parameter(
+            "bias", self.create_parameter([4 * h], is_bias=True))
+        self._built = True
+
+    def forward(self, input, pre_hidden, pre_cell):
+        import jax.numpy as jnp
+        if not self._built:
+            self._build_once(input)
+        h = self._hidden_size
+        fb = self._forget_bias
+
+        def step(x, hp, cp, w, b):
+            gates = jnp.matmul(jnp.concatenate([x, hp], axis=-1), w) + b
+            i, f, c, o = (gates[..., :h], gates[..., h:2 * h],
+                          gates[..., 2 * h:3 * h], gates[..., 3 * h:])
+            sig = lambda v: 1.0 / (1.0 + jnp.exp(-v))
+            new_c = cp * sig(f + fb) + sig(i) * jnp.tanh(c)
+            new_h = jnp.tanh(new_c) * sig(o)
+            return new_h, new_c
+
+        return apply_eager(step, input, pre_hidden, pre_cell,
+                           self._weight, self._bias)
+
+
+def _slice_init(init, idx, batch, hidden):
+    """init: (L*Dirs, N, H) -> (N, H) slice for layer/direction idx."""
+    if init is None:
+        return None
+    s = layers.slice(init, axes=[0], starts=[idx], ends=[idx + 1])
+    return layers.reshape(s, [batch, hidden])
+
+
+def _gather_steps(seq_out, idx):
+    # one_hot over time then weighted sum — static-shape gather
+    t = seq_out.shape[1]
+    oh = layers.one_hot(layers.unsqueeze(idx, axes=[1]), t)  # (N,1,T)? ->
+    oh = layers.reshape(oh, [seq_out.shape[0], 1, t])
+    out = layers.matmul(oh, seq_out)  # (N, 1, H)
+    return out
+
+
+def _one_direction(x, init_h, init_c, hidden_size, is_reverse, cell_type,
+                   param_attr, bias_attr, dtype, sequence_length):
+    """x: (N, T, D) -> (out (N, T, H), last_h, last_c|None)."""
+    if cell_type == "gru":
+        proj = layers.fc(x, size=3 * hidden_size, num_flatten_dims=2,
+                         param_attr=param_attr, bias_attr=False)
+        out = layers.dynamic_gru(proj, hidden_size, param_attr=param_attr,
+                                 bias_attr=bias_attr,
+                                 is_reverse=is_reverse, h_0=init_h,
+                                 dtype=dtype)
+        cell_seq = None
+    else:
+        proj = layers.fc(x, size=4 * hidden_size, num_flatten_dims=2,
+                         param_attr=param_attr, bias_attr=False)
+        out, cell_seq = layers.dynamic_lstm(
+            proj, 4 * hidden_size, h_0=init_h, c_0=init_c,
+            param_attr=param_attr, bias_attr=bias_attr,
+            is_reverse=is_reverse, dtype=dtype)
+    if sequence_length is not None:
+        # zero padded steps so downstream pooling ignores them
+        mask = layers.cast(
+            layers.sequence_mask(sequence_length, maxlen=x.shape[1]),
+            dtype)
+        mask3 = layers.unsqueeze(mask, axes=[2])
+        out = layers.elementwise_mul(out, mask3)
+        if cell_seq is not None:
+            cell_seq = layers.elementwise_mul(cell_seq, mask3)
+    if is_reverse:
+        # last valid state of a reversed scan is step 0
+        last_h = layers.squeeze(
+            layers.slice(out, axes=[1], starts=[0], ends=[1]), axes=[1])
+        last_c = None if cell_seq is None else layers.squeeze(
+            layers.slice(cell_seq, axes=[1], starts=[0], ends=[1]),
+            axes=[1])
+    elif sequence_length is not None:
+        last_h = layers.squeeze(_gather_steps(
+            out, _len_minus_one(sequence_length)), axes=[1])
+        last_c = None if cell_seq is None else layers.squeeze(
+            _gather_steps(cell_seq, _len_minus_one(sequence_length)),
+            axes=[1])
+    else:
+        t = x.shape[1]
+        last_h = layers.squeeze(
+            layers.slice(out, axes=[1], starts=[t - 1], ends=[t]),
+            axes=[1])
+        last_c = None if cell_seq is None else layers.squeeze(
+            layers.slice(cell_seq, axes=[1], starts=[t - 1], ends=[t]),
+            axes=[1])
+    return out, last_h, last_c
+
+
+def _len_minus_one(sequence_length):
+    lengths = layers.cast(sequence_length, "int64")
+    return layers.elementwise_sub(
+        lengths, layers.fill_constant([1], "int64", 1))
+
+
+def _basic_rnn(cell_type, input, init_hidden, init_cell, hidden_size,
+               num_layers, sequence_length, dropout_prob, bidirectional,
+               batch_first, param_attr, bias_attr, dtype):
+    if not batch_first:
+        input = layers.transpose(input, perm=[1, 0, 2])
+    batch = input.shape[0]
+    dirs = 2 if bidirectional else 1
+    x = input
+    last_hs, last_cs = [], []
+    for layer in range(num_layers):
+        outs = []
+        for d in range(dirs):
+            idx = layer * dirs + d
+            ih = _slice_init(init_hidden, idx, batch, hidden_size)
+            ic = _slice_init(init_cell, idx, batch, hidden_size)
+            out, lh, lc = _one_direction(
+                x, ih, ic, hidden_size, is_reverse=(d == 1),
+                cell_type=cell_type, param_attr=param_attr,
+                bias_attr=bias_attr, dtype=dtype,
+                sequence_length=sequence_length)
+            outs.append(out)
+            last_hs.append(lh)
+            if lc is not None:
+                last_cs.append(lc)
+        x = outs[0] if dirs == 1 else layers.concat(outs, axis=2)
+        if dropout_prob > 0.0 and layer < num_layers - 1:
+            x = layers.dropout(x, dropout_prob=dropout_prob)
+    rnn_out = x if batch_first else layers.transpose(x, perm=[1, 0, 2])
+    last_hidden = layers.stack(last_hs, axis=0)
+    last_cell = layers.stack(last_cs, axis=0) if last_cs else None
+    return rnn_out, last_hidden, last_cell
+
+
+def basic_gru(input, init_hidden, hidden_size, num_layers=1,
+              sequence_length=None, dropout_prob=0.0, bidirectional=False,
+              batch_first=True, param_attr=None, bias_attr=None,
+              gate_activation=None, activation=None, dtype='float32',
+              name='basic_gru'):
+    """Multi-layer (bi)directional GRU (ref rnn_impl.py:139) ->
+    (rnn_out, last_hidden)."""
+    out, last_h, _ = _basic_rnn(
+        "gru", input, init_hidden, None, hidden_size, num_layers,
+        sequence_length, dropout_prob, bidirectional, batch_first,
+        param_attr, bias_attr, dtype)
+    return out, last_h
+
+
+def basic_lstm(input, init_hidden, init_cell, hidden_size, num_layers=1,
+               sequence_length=None, dropout_prob=0.0, bidirectional=False,
+               batch_first=True, param_attr=None, bias_attr=None,
+               gate_activation=None, activation=None, forget_bias=1.0,
+               dtype='float32', name='basic_lstm'):
+    """Multi-layer (bi)directional LSTM (ref rnn_impl.py:358) ->
+    (rnn_out, last_hidden, last_cell)."""
+    out, last_h, last_c = _basic_rnn(
+        "lstm", input, init_hidden, init_cell, hidden_size, num_layers,
+        sequence_length, dropout_prob, bidirectional, batch_first,
+        param_attr, bias_attr, dtype)
+    return out, last_h, last_c

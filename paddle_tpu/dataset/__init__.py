@@ -1,0 +1,35 @@
+"""Dataset package.
+
+Two reference namespaces merge here:
+  * corpus modules (ref python/paddle/dataset/__init__.py) — mnist,
+    cifar, imdb, … with deterministic synthetic payloads matching the
+    reference record schemas (air-gapped TPU pods; see common.py);
+  * the fluid Dataset API (ref python/paddle/fluid/dataset.py) —
+    DatasetFactory / InMemoryDataset / QueueDataset re-exported from
+    dataset_api.py, so ``paddle_tpu.dataset.DatasetFactory()`` keeps
+    working as before.
+"""
+from .dataset_api import (DatasetFactory, DatasetBase, QueueDataset,
+                          InMemoryDataset)
+from . import common
+from . import mnist
+from . import cifar
+from . import uci_housing
+from . import imdb
+from . import imikolov
+from . import movielens
+from . import sentiment
+from . import conll05
+from . import wmt14
+from . import wmt16
+from . import mq2007
+from . import flowers
+from . import voc2012
+from . import image
+
+__all__ = [
+    'mnist', 'imikolov', 'imdb', 'cifar', 'movielens', 'conll05',
+    'sentiment', 'uci_housing', 'wmt14', 'wmt16', 'mq2007', 'flowers',
+    'voc2012', 'image', 'common',
+    'DatasetFactory', 'DatasetBase', 'QueueDataset', 'InMemoryDataset',
+]

@@ -9,7 +9,7 @@ import random
 
 import numpy as np
 
-from .native.recordio import RecordReader
+from ..native.recordio import RecordReader
 
 
 class DatasetFactory(object):

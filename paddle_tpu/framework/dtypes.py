@@ -59,6 +59,14 @@ def to_jax_dtype(dtype):
     return _STR2DTYPE[normalize_dtype(dtype)]
 
 
+def dtype_size(dtype):
+    """Bytes per element of *dtype* (bfloat16 -> 2)."""
+    name = normalize_dtype(dtype)
+    if name == "bfloat16":
+        return 2
+    return np.dtype(name).itemsize
+
+
 def is_float(dtype):
     return normalize_dtype(dtype) in FLOAT_DTYPES
 

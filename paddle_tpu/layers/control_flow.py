@@ -395,6 +395,9 @@ class Switch(object):
 
     @contextlib.contextmanager
     def default(self):
+        if self._got_default:
+            raise ValueError("there can be at most one default() case "
+                             "in a Switch")
         program = default_main_program()
         blk = program._create_block()
         try:
@@ -508,7 +511,8 @@ class StaticRNN(object):
         return ph
 
     def memory(self, init=None, shape=None, batch_ref=None,
-               init_value=0.0, init_batch_dim_idx=0, ref_batch_dim_idx=1):
+               init_value=0.0, init_batch_dim_idx=0, ref_batch_dim_idx=1,
+               dtype=None):
         self._require_block()
         if init is not None:
             mshape, dtype = tuple(init.shape), init.dtype
@@ -517,7 +521,7 @@ class StaticRNN(object):
                 raise ValueError("memory() needs init= or shape=+batch_ref=")
             mshape = tuple(batch_ref.shape[0] if s in (None, -1) else s
                            for s in shape)
-            dtype = batch_ref.dtype
+            dtype = dtype or batch_ref.dtype
         ph = self._block.create_var(
             name=unique_name.generate("rnn_mem"), shape=mshape, dtype=dtype)
         self._mems.append({"ph": ph, "init": init, "shape": mshape,
@@ -593,6 +597,7 @@ class DynamicRNN(object):
         self._rnn = StaticRNN(name=name)
         self._lengths = None
         self._mask_ph = None
+        self._first_ph = None
         self._step_idx = 0
 
     def block(self):
@@ -621,28 +626,57 @@ class DynamicRNN(object):
         finally:
             program.current_block_idx = self._rnn._block.idx
         ph = self._rnn.step_input(tm)
+        if self._first_ph is None:
+            self._first_ph = ph
         if self._lengths is not None and self._mask_ph is None:
             self._mask_ph = self._rnn.step_input(self._mask)
         return ph
 
+    def _mask_for(self, value):
+        """Per-step keep-mask shaped/cast to broadcast against *value*:
+        mask_ph is (B, 1); values may be rank 1..N."""
+        from .nn import cast, unsqueeze, reshape
+        m = self._mask_ph
+        rank = len(value.shape or ())
+        if rank <= 1:
+            m = reshape(m, [-1])
+        elif rank > 2:
+            m = unsqueeze(m, list(range(2, rank)))
+        if value.dtype != m.dtype:
+            m = cast(m, value.dtype)
+        return m
+
     def memory(self, init=None, shape=None, value=0.0, need_reorder=False,
                dtype="float32", batch_ref=None):
-        return self._rnn.memory(init=init, shape=shape,
-                                batch_ref=batch_ref, init_value=value)
+        if init is None:
+            if shape is None:
+                raise ValueError("memory() needs init= or shape=")
+            if batch_ref is None:
+                if self._first_ph is None:
+                    raise ValueError(
+                        "DynamicRNN.memory(shape=...): call step_input() "
+                        "first so the batch size is known")
+                batch_ref = self._first_ph
+                # fluid semantics: shape is per-sample; batch prepended
+                shape = [-1] + list(shape)
+            return self._rnn.memory(shape=shape, batch_ref=batch_ref,
+                                    init_value=value, dtype=dtype)
+        return self._rnn.memory(init=init, init_value=value)
 
     def update_memory(self, ex_mem, new_mem):
         if self._mask_ph is not None:
             from .nn import elementwise_mul, elementwise_add, scale
-            keep = scale(self._mask_ph, scale=-1.0, bias=1.0)
-            new_mem = elementwise_add(elementwise_mul(new_mem,
-                                                      self._mask_ph),
+            m = self._mask_for(new_mem)
+            keep = scale(m, scale=-1.0, bias=1.0)
+            new_mem = elementwise_add(elementwise_mul(new_mem, m),
                                       elementwise_mul(ex_mem, keep))
         self._rnn.update_memory(ex_mem, new_mem)
 
     def output(self, *outputs):
         if self._mask_ph is not None:
             from .nn import elementwise_mul
-            outputs = [elementwise_mul(o, self._mask_ph) for o in outputs]
+            outputs = [elementwise_mul(o, self._mask_for(o))
+                       for o in outputs]
         self._rnn.output(*outputs)
 
     def __call__(self):

@@ -1,0 +1,170 @@
+"""Kernels for contrib layers (ref python/paddle/fluid/contrib/layers/nn.py
++ paddle/fluid/operators/{shuffle_batch,tree_conv,match_matrix_tensor,
+sequence_topk_avg_pooling,var_conv_2d}* ops).
+
+Dense TPU designs: ragged/LoD inputs become padded tensors + explicit
+length vectors (the package-wide convention from layers/sequence_lod.py),
+and tree structure becomes a dense adjacency matrix so patch extraction
+is matmuls on the MXU instead of per-node gathers.
+"""
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+
+@register_op("shuffle_batch", uses_rng=True, nondiff=("Seed",))
+def _shuffle_batch(ctx, ins, attrs):
+    """Random row permutation (ref operators/shuffle_batch_op.h): returns
+    the shuffled tensor and the permutation used (for unshuffling)."""
+    x = ins["X"][0]
+    seed = attrs.get("startup_seed", 0)
+    key = jax.random.PRNGKey(seed) if seed else ctx.rng()
+    perm = jax.random.permutation(key, x.shape[0])
+    return {"Out": jnp.take(x, perm, axis=0),
+            "ShuffleIdx": perm.astype(jnp.int64)}
+
+
+@register_op("match_matrix_tensor", nondiff=())
+def _match_matrix_tensor(ctx, ins, attrs):
+    """Bilinear match matrix (ref contrib nn.py:221): x (N, Tx, D1),
+    y (N, Ty, D2), W (D1, C, D2) -> out (N, C, Tx, Ty) where
+    out[n,c] = x[n] @ W[:,c,:] @ y[n]^T.  One einsum => two MXU matmuls."""
+    x, y, w = ins["X"][0], ins["Y"][0], ins["W"][0]
+    out = jnp.einsum("btd,dce,bse->bcts", x, w, y,
+                     preferred_element_type=jnp.float32)
+    return {"Out": out.astype(x.dtype)}
+
+
+@register_op("sequence_topk_avg_pooling", nondiff=("RowLen", "ColLen"))
+def _sequence_topk_avg_pooling(ctx, ins, attrs):
+    """Top-k average pooling over the column axis of a match matrix
+    (ref contrib nn.py:304).  x: (N, C, Tx, Ty); row_len/col_len: (N,)
+    valid extents.  For each k in topks, average of the k largest valid
+    column scores -> out (N, Tx, C * len(topks)), rows past row_len
+    zeroed."""
+    x = ins["X"][0]
+    row_len = ins["RowLen"][0].astype(jnp.int32)
+    col_len = ins["ColLen"][0].astype(jnp.int32)
+    topks = tuple(int(k) for k in attrs["topks"])
+    n, c, tx, ty = x.shape
+    col_mask = jnp.arange(ty)[None, None, None, :] < \
+        col_len[:, None, None, None]
+    neg = jnp.asarray(jnp.finfo(jnp.float32).min, x.dtype)
+    masked = jnp.where(col_mask, x, neg)
+    # descending sort once; every k reuses the prefix sums
+    srt = -jnp.sort(-masked, axis=-1)
+    valid = col_mask.astype(x.dtype)  # count of valid cols per row
+    n_valid = jnp.sum(valid, axis=-1, keepdims=True)  # (N,C,Tx,1)
+    csum = jnp.cumsum(jnp.where(srt <= neg / 2, 0.0, srt), axis=-1)
+    outs = []
+    for k in topks:
+        kk = jnp.minimum(jnp.asarray(float(k), x.dtype),
+                         jnp.maximum(n_valid[..., 0], 1.0))
+        idx = jnp.clip(kk.astype(jnp.int32) - 1, 0, ty - 1)
+        topsum = jnp.take_along_axis(csum, idx[..., None], axis=-1)[..., 0]
+        outs.append(topsum / jnp.asarray(float(k), x.dtype))
+    out = jnp.stack(outs, axis=-1)            # (N, C, Tx, K)
+    out = out.transpose(0, 2, 1, 3).reshape(n, tx, c * len(topks))
+    row_mask = (jnp.arange(tx)[None, :] < row_len[:, None])[..., None]
+    return {"Out": jnp.where(row_mask, out, 0.0).astype(x.dtype)}
+
+
+@register_op("var_conv_2d", nondiff=("RowLen", "ColLen"))
+def _var_conv_2d(ctx, ins, attrs):
+    """Variable-size conv2d (ref contrib nn.py:105): a dense conv over
+    the padded batch, with outputs beyond each sample's valid (row, col)
+    extent zeroed — numerically identical to per-sample convs for
+    'same'-style interiors and fully XLA-fusible."""
+    x, w = ins["X"][0], ins["W"][0]
+    row_len = ins["RowLen"][0].astype(jnp.int32)
+    col_len = ins["ColLen"][0].astype(jnp.int32)
+    stride = attrs.get("stride", [1, 1])
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=tuple(stride), padding="SAME",
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    h_out, w_out = out.shape[2], out.shape[3]
+    r = (row_len + stride[0] - 1) // stride[0]
+    c = (col_len + stride[1] - 1) // stride[1]
+    rmask = jnp.arange(h_out)[None, None, :, None] < r[:, None, None, None]
+    cmask = jnp.arange(w_out)[None, None, None, :] < c[:, None, None, None]
+    return {"Out": jnp.where(rmask & cmask, out, 0.0).astype(x.dtype)}
+
+
+def _tree_eta(depth, max_depth, pos, n_sib):
+    """Continuous-binary-tree interpolation weights (TBCNN, Mou et al.):
+    eta_t favors patch roots, eta_l/eta_r split by sibling position."""
+    d = depth.astype(jnp.float32)
+    eta_t = jnp.where(max_depth > 1, (max_depth - d) / max_depth, 1.0)
+    frac = jnp.where(n_sib > 1, (pos - 1.0) / jnp.maximum(n_sib - 1.0, 1.0),
+                     0.5)
+    eta_r = (1.0 - eta_t) * frac
+    eta_l = (1.0 - eta_t) * (1.0 - frac)
+    return eta_t, eta_l, eta_r
+
+
+@register_op("tree_conv", nondiff=("EdgeSet",))
+def _tree_conv(ctx, ins, attrs):
+    """Tree-based convolution (ref contrib nn.py:372,
+    operators/tree_conv_op.*): nodes (N, M, F), edge_set (N, E, 2) int
+    rows [parent, child] (negative = padding), filter (F, 3, H, K).
+
+    Dense design: one (M, M) descendant matrix per depth level, built by
+    repeated multiplication of the child adjacency — patch gathering
+    becomes batched matmuls.  Out: (N, M, H, K) with max-pooling over
+    patch members folded into the weighted sum per the TBCNN paper.
+    """
+    nodes, edges, filt = ins["NodesVector"][0], ins["EdgeSet"][0], \
+        ins["Filter"][0]
+    max_depth = int(attrs.get("max_depth", 2))
+    n, m, f = nodes.shape
+    _, _, h, k = filt.shape
+    e = edges.shape[1]
+
+    parent = edges[:, :, 0].astype(jnp.int32)
+    child = edges[:, :, 1].astype(jnp.int32)
+    valid = (parent >= 0) & (child >= 0)
+    p_safe = jnp.where(valid, parent, 0)
+    c_safe = jnp.where(valid, child, 0)
+    # child adjacency A[b, p, c] = 1, plus sibling position of c under p
+    oh_p = jax.nn.one_hot(p_safe, m, dtype=jnp.float32) * \
+        valid[..., None]
+    oh_c = jax.nn.one_hot(c_safe, m, dtype=jnp.float32) * \
+        valid[..., None]
+    adj = jnp.einsum("bep,bec->bpc", oh_p, oh_c)
+    # sibling order = edge order: position of each child among its
+    # parent's earlier edges
+    order = jnp.cumsum(oh_p, axis=1)  # (N, E, M) running count per parent
+    pos_e = jnp.einsum("bem,bem->be", order, oh_p)  # 1-based position
+    pos = jnp.einsum("be,bep,bec->bpc", pos_e, oh_p, oh_c)
+    n_sib = jnp.sum(adj, axis=2, keepdims=True)  # (N, M, 1)
+
+    wt, wl, wr = filt[:, 0], filt[:, 1], filt[:, 2]  # (F, H, K)
+    md = jnp.asarray(float(max_depth), jnp.float32)
+
+    def level_feature(level_adj, level_pos, depth):
+        eta_t, eta_l, eta_r = _tree_eta(
+            jnp.asarray(float(depth), jnp.float32), md, level_pos,
+            jnp.broadcast_to(n_sib, level_pos.shape))
+        mask = (level_adj > 0).astype(jnp.float32)
+        feats = []
+        for eta, w in ((eta_t, wt), (eta_l, wl), (eta_r, wr)):
+            gathered = jnp.einsum("bpc,bcf->bpf", eta * mask,
+                                  nodes.astype(jnp.float32))
+            feats.append(jnp.einsum("bpf,fhk->bphk", gathered, w))
+        return feats[0] + feats[1] + feats[2]
+
+    # depth 0: the node itself is the patch root (eta_t = 1)
+    out = jnp.einsum("bmf,fhk->bmhk", nodes.astype(jnp.float32), wt)
+    level_adj, level_pos = adj, pos
+    for depth in range(1, max_depth):
+        out = out + level_feature(level_adj, level_pos, depth)
+        if depth + 1 < max_depth:
+            # descendants one level deeper; positions propagate from the
+            # first hop (the sibling split happens at the top branching)
+            level_adj = jnp.einsum("bpc,bcd->bpd", level_adj, adj)
+            level_pos = jnp.einsum("bpc,bcd->bpd", pos, (adj > 0) *
+                                   jnp.float32(1.0)) + level_pos * 0.0
+            level_pos = jnp.where(level_adj > 0,
+                                  jnp.maximum(level_pos, 1.0), 0.0)
+    return {"Out": out.astype(nodes.dtype)}

@@ -191,6 +191,8 @@ def _print(ctx, ins, attrs):
     import jax
     x = ins["In"][0]
     n = int(attrs.get("summarize", 20))
-    jax.debug.print(str(attrs.get("message", "")) + " {}",
-                    x.reshape(-1)[:n] if n > 0 else x)
+    # message goes in as an argument, not part of the format string —
+    # user text may contain braces
+    jax.debug.print("{m} {v}", m=str(attrs.get("message", "")),
+                    v=x.reshape(-1)[:n] if n > 0 else x)
     return {"Out": x}

@@ -1,0 +1,22 @@
+"""Transpiler package (ref python/paddle/fluid/transpiler/__init__.py).
+
+On the reference, transpilers REWRITE the Program: DistributeTranspiler
+splits it into trainer/pserver halves wired with send/recv ops, and
+memory_optimization_transpiler renames vars to reuse buffers.  On TPU
+both jobs belong to the compiler stack — SPMD partitioning to pjit over
+the Mesh, buffer liveness to XLA — so this package keeps the fluid API
+as a thin, *honest* adapter: DistributeTranspiler configures the mesh
+data-parallel path and returns the same Program; memory_optimize is a
+documented no-op that records the request for the executor's donation /
+remat machinery.
+"""
+from .distribute_transpiler import (DistributeTranspiler,
+                                    DistributeTranspilerConfig)
+from .memory_optimization_transpiler import memory_optimize, release_memory
+from .ps_dispatcher import HashName, RoundRobin, PSDispatcher
+
+__all__ = [
+    "DistributeTranspiler", "DistributeTranspilerConfig",
+    "memory_optimize", "release_memory",
+    "HashName", "RoundRobin", "PSDispatcher",
+]

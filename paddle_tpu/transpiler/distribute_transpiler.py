@@ -1,0 +1,123 @@
+"""DistributeTranspiler
+(ref python/paddle/fluid/transpiler/distribute_transpiler.py).
+
+The reference rewrites a single-process Program into a trainer half
+(send/recv ops to pservers) or, in collective mode, inserts NCCL
+allreduce ops.  On a TPU pod the equivalent machinery is the Mesh +
+pjit path (distributed/mesh.py, framework/compiler.py): parameters get
+NamedShardings and XLA inserts the collectives over ICI.  This adapter
+keeps the fluid call sequence working:
+
+    t = DistributeTranspiler(config)
+    t.transpile(trainer_id, trainers=N, pservers=..., program=prog)
+    train_prog = t.get_trainer_program()     # mesh-annotated, same IR
+
+``get_pserver_program`` raises with guidance: there is no pserver
+process on a TPU pod; sparse tables live as row-sharded mesh state
+(distributed/sharded_embedding.py) — the documented design decision in
+SURVEY §2.7.
+"""
+from ..framework import program as program_mod
+from ..distributed import mesh as mesh_mod
+from .ps_dispatcher import RoundRobin
+
+__all__ = ["DistributeTranspiler", "DistributeTranspilerConfig"]
+
+
+class DistributeTranspilerConfig(object):
+    """Knobs of the reference transpiler (ref :134).  slice_var_up /
+    min_block_size governed pserver block slicing; on the mesh they map
+    to whether large embedding tables are row-sharded ("dp" rows)."""
+
+    slice_var_up = True
+    split_method = RoundRobin
+    min_block_size = 8192
+    enable_dc_asgd = False
+    mode = "collective"  # TPU default: collective data-parallel
+    print_log = False
+    wait_port = True
+
+    def __init__(self):
+        pass
+
+
+class DistributeTranspiler(object):
+    """Configure a Program for multi-device/multi-host execution
+    (ref :243).  ``transpile`` installs/validates the dp mesh and
+    annotates distributed lookup tables; the Program IR is unchanged —
+    partitioning happens at jit time in CompiledProgram."""
+
+    def __init__(self, config=None):
+        self.config = config or DistributeTranspilerConfig()
+        if self.config.split_method is None:
+            self.config.split_method = RoundRobin
+        assert self.config.min_block_size >= 8192
+        assert self.config.split_method.__name__ in ["RoundRobin",
+                                                     "HashName"]
+        self._transpiled = False
+
+    def transpile(self, trainer_id, program=None, pservers="127.0.0.1:6174",
+                  trainers=1, sync_mode=True, startup_program=None,
+                  current_endpoint="127.0.0.1:6174"):
+        """Record the job layout and install a dp mesh sized to
+        ``trainers`` when none is active (ref :522)."""
+        if program is None:
+            program = program_mod.default_main_program()
+        if not sync_mode:
+            raise NotImplementedError(
+                "async (pserver) mode is N/A on TPU pods: geo-async "
+                "rounds exist to hide commodity-network latency; over "
+                "ICI, synchronous dp with XLA collectives is strictly "
+                "faster (SURVEY design decisions)")
+        self.trainer_id = trainer_id
+        self.trainer_num = trainers
+        self.sync_mode = sync_mode
+        self.origin_program = program
+        self.startup_program = (startup_program or
+                                program_mod.default_startup_program())
+        self.pserver_endpoints = [e.strip() for e in pservers.split(",")
+                                  if e.strip()]
+        if mesh_mod.get_mesh() is None and trainers > 1:
+            import jax
+            if len(jax.devices()) >= trainers:
+                mesh_mod.init_mesh({"dp": trainers})
+            # else: single-process build of a multi-host job — the mesh
+            # is installed at launch time (distributed.launch / fleet.init)
+            # where all hosts' devices are visible
+        # annotate distributed lookup tables for row-sharding, the
+        # pserver-block equivalent (slice_var_up)
+        if self.config.slice_var_up:
+            for var in program.global_block().all_parameters():
+                if getattr(var, "is_distributed", False):
+                    var.sharding = ("dp",) + (None,) * (len(var.shape) - 1)
+        self._transpiled = True
+
+    def get_trainer_program(self, wait_port=True):
+        """The trainer-side Program (ref :961).  Same IR object —
+        sharding annotations are carried on its vars; run it through
+        CompiledProgram to execute SPMD."""
+        if not self._transpiled:
+            raise RuntimeError("call transpile() first")
+        return self.origin_program
+
+    def get_startup_program(self, endpoint=None, pserver_program=None,
+                            startup_program=None):
+        """Trainer startup Program (ref :1398)."""
+        if not self._transpiled:
+            raise RuntimeError("call transpile() first")
+        return self.startup_program
+
+    def _no_pserver(self):
+        raise NotImplementedError(
+            "no pserver process exists on a TPU pod: sparse tables are "
+            "row-sharded mesh state (paddle_tpu.distributed."
+            "sharded_embedding); dense sync happens inside the jitted "
+            "step via XLA collectives. Port pserver jobs by dropping "
+            "the pserver launch and running the trainer program under "
+            "CompiledProgram with a dp mesh.")
+
+    def get_pserver_program(self, endpoint):  # ref :1096
+        self._no_pserver()
+
+    def get_pserver_programs(self, endpoint):  # ref :1367
+        self._no_pserver()

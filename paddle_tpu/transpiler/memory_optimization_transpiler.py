@@ -1,0 +1,45 @@
+"""Memory-optimization transpiler
+(ref python/paddle/fluid/transpiler/memory_optimization_transpiler.py).
+
+The reference walks op liveness and renames dead vars so buffers get
+reused.  Under XLA that rewrite is actively harmful — the compiler's
+own buffer-assignment pass performs liveness-based reuse on the fused
+HLO, and donation (Executor's donate_argnums on parameters) already
+gives in-place updates.  These functions therefore validate their
+arguments, stamp the request on the Program (so BuildStrategy /
+CompiledProgram can surface it), and leave the graph byte-identical.
+"""
+from ..framework import program as program_mod
+
+__all__ = ["memory_optimize", "release_memory"]
+
+
+def memory_optimize(input_program, skip_opt_set=None, print_log=False,
+                    level=0, skip_grads=False):
+    """Record a buffer-reuse request on the Program (ref :18).
+
+    XLA's buffer assignment subsumes the reference's in-graph renaming;
+    the flag is kept so CompiledProgram can assert the memory strategy
+    was requested (parity with BuildStrategy.memory_optimize).
+    """
+    if level != 0 and level != 1:
+        raise ValueError("only level 0 and level 1 are supported")
+    if not isinstance(input_program, program_mod.Program):
+        raise TypeError("memory_optimize expects a Program, got %s" %
+                        type(input_program))
+    input_program._memory_optimize_requested = True
+    input_program._memory_optimize_skip = set(skip_opt_set or ())
+    if print_log:
+        print("memory_optimize: delegated to XLA buffer assignment "
+              "(donated params + liveness reuse inside the fused step)")
+    return input_program
+
+
+def release_memory(input_program, skip_opt_set=None):
+    """Early-delete pass (ref :42) — subsumed by XLA liveness; kept as a
+    validated no-op for script parity."""
+    if not isinstance(input_program, program_mod.Program):
+        raise TypeError("release_memory expects a Program, got %s" %
+                        type(input_program))
+    input_program._release_memory_requested = True
+    return input_program

@@ -1,0 +1,268 @@
+"""contrib.layers + contrib analysis tools + incubate.data_generator
+(ref python/paddle/fluid/contrib/{layers,model_stat,...},
+incubate/data_generator)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers, optimizer
+from paddle_tpu.contrib import layers as contrib_layers
+from paddle_tpu.framework.scope import Scope, scope_guard
+
+
+def run_prog(build, feed):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        fetches = build()
+    scope = Scope()
+    with scope_guard(scope):
+        exe = pt.Executor()
+        exe.run(startup)
+        outs = exe.run(main, feed=feed, fetch_list=list(fetches))
+    return [np.asarray(o) for o in outs]
+
+
+def test_fused_elemwise_activation():
+    x = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+    y = np.random.RandomState(1).randn(3, 4).astype(np.float32)
+
+    def build():
+        xv = layers.data("x", [3, 4], "float32", append_batch_size=False)
+        yv = layers.data("y", [3, 4], "float32", append_batch_size=False)
+        out, inter = contrib_layers.fused_elemwise_activation(
+            xv, yv, ["elementwise_add", "relu"])
+        return out, inter
+
+    out, inter = run_prog(build, {"x": x, "y": y})
+    np.testing.assert_allclose(inter, x + y, rtol=1e-6)
+    np.testing.assert_allclose(out, np.maximum(x + y, 0), rtol=1e-6)
+    with pytest.raises(ValueError):
+        contrib_layers.fused_elemwise_activation(None, None, ["relu"])
+
+
+def test_match_matrix_tensor_math():
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 5, 3).astype(np.float32)
+    y = rng.randn(2, 4, 3).astype(np.float32)
+
+    def build():
+        xv = layers.data("x", [2, 5, 3], "float32",
+                         append_batch_size=False)
+        yv = layers.data("y", [2, 4, 3], "float32",
+                         append_batch_size=False)
+        out, w = contrib_layers.match_matrix_tensor(xv, yv, channel_num=2)
+        return (out,)
+
+    out, = run_prog(build, {"x": x, "y": y})
+    assert out.shape == (2, 2, 5, 4)
+
+
+def test_sequence_topk_avg_pooling():
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 3, 4, 6).astype(np.float32)
+    row = np.array([4, 2], np.int64)
+    col = np.array([6, 3], np.int64)
+
+    def build():
+        xv = layers.data("x", [2, 3, 4, 6], "float32",
+                         append_batch_size=False)
+        rv = layers.data("row", [2], "int64", append_batch_size=False)
+        cv = layers.data("col", [2], "int64", append_batch_size=False)
+        out = contrib_layers.sequence_topk_avg_pooling(
+            xv, rv, cv, topks=[1, 3], channel_num=3)
+        return (out,)
+
+    out, = run_prog(build, {"x": x, "row": row, "col": col})
+    assert out.shape == (2, 4, 6)
+    # sample 0, channel 0, row 0: top-1 over all 6 cols
+    np.testing.assert_allclose(out[0, 0, 0], x[0, 0, 0].max(), rtol=1e-5)
+    # top-3 average over first 3 valid cols of sample 1
+    top3 = np.sort(x[1, 0, 1, :3])[::-1][:3].mean()
+    np.testing.assert_allclose(out[1, 1, 1], top3, rtol=1e-5)
+    # rows past row_len are zero
+    assert np.all(out[1, 2:] == 0)
+
+
+def test_var_conv_2d_masks_invalid_region():
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 1, 8, 8).astype(np.float32)
+    row = np.array([8, 4], np.int64)
+    col = np.array([8, 5], np.int64)
+
+    def build():
+        xv = layers.data("x", [2, 1, 8, 8], "float32",
+                         append_batch_size=False)
+        rv = layers.data("row", [2], "int64", append_batch_size=False)
+        cv = layers.data("col", [2], "int64", append_batch_size=False)
+        out = contrib_layers.var_conv_2d(xv, rv, cv, input_channel=1,
+                                         output_channel=2, filter_size=3)
+        return (out,)
+
+    out, = run_prog(build, {"x": x, "row": row, "col": col})
+    assert out.shape == (2, 2, 8, 8)
+    assert np.all(out[1, :, 4:, :] == 0) and np.all(out[1, :, :, 5:] == 0)
+    assert np.any(out[1, :, :4, :5] != 0)
+
+
+def test_tree_conv_shapes_and_root_term():
+    rng = np.random.RandomState(0)
+    nodes = rng.randn(1, 5, 3).astype(np.float32)
+    # chain: 0 -> 1 -> 2, 0 -> 3; node 4 isolated; pad with -1
+    edges = np.array([[[0, 1], [1, 2], [0, 3], [-1, -1]]], np.int64)
+
+    def build():
+        nv = layers.data("n", [1, 5, 3], "float32",
+                         append_batch_size=False)
+        ev = layers.data("e", [1, 4, 2], "int64", append_batch_size=False)
+        out = contrib_layers.tree_conv(nv, ev, output_size=6,
+                                       num_filters=2, max_depth=2,
+                                       act=None, bias_attr=False)
+        return (out,)
+
+    out, = run_prog(build, {"n": nodes, "e": edges})
+    assert out.shape == (1, 5, 6, 2)
+    # isolated node's output must be exactly its self-term (eta_t @ Wt)
+    assert np.any(out[0, 4] != 0)
+
+
+def test_fused_embedding_seq_pool():
+    ids = np.array([[1, 2, 0], [3, 3, 3]], np.int64)
+
+    def build():
+        iv = layers.data("ids", [2, 3], "int64", append_batch_size=False)
+        out = contrib_layers.fused_embedding_seq_pool(
+            iv, size=[10, 4], combiner="sum")
+        return (out,)
+
+    out, = run_prog(build, {"ids": ids})
+    assert out.shape == (2, 4)
+
+
+def test_shuffle_batch_is_permutation():
+    x = np.arange(20, dtype=np.float32).reshape(10, 2)
+
+    def build():
+        xv = layers.data("x", [10, 2], "float32", append_batch_size=False)
+        out = contrib_layers.shuffle_batch(xv)
+        return (out,)
+
+    out, = run_prog(build, {"x": x})
+    assert sorted(map(tuple, out)) == sorted(map(tuple, x))
+
+
+def test_basic_gru_and_lstm_static():
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 6, 3).astype(np.float32)
+    lens = np.array([6, 4], np.int64)
+
+    def build():
+        xv = layers.data("x", [2, 6, 3], "float32",
+                         append_batch_size=False)
+        lv = layers.data("lens", [2], "int64", append_batch_size=False)
+        gout, gh = contrib_layers.basic_gru(
+            xv, None, hidden_size=4, num_layers=2, bidirectional=True,
+            sequence_length=lv)
+        lout, lh, lc = contrib_layers.basic_lstm(
+            xv, None, None, hidden_size=4, num_layers=1)
+        return gout, gh, lout, lh, lc
+
+    gout, gh, lout, lh, lc = run_prog(build, {"x": x, "lens": lens})
+    assert gout.shape == (2, 6, 8)        # bi => 2*hidden
+    assert gh.shape == (4, 2, 4)          # num_layers*dirs, N, H
+    assert lout.shape == (2, 6, 4)
+    assert lh.shape == (1, 2, 4) and lc.shape == (1, 2, 4)
+    # padded steps are masked to zero in the output
+    assert np.all(gout[1, 4:] == 0)
+    # forward-direction last hidden of sample 1 equals step lens-1 output
+    np.testing.assert_allclose(lh[0], lout[:, -1], rtol=1e-5)
+
+
+def test_ctr_metric_bundle():
+    p = np.array([[0.2], [0.8], [0.5]], np.float32)
+    l = np.array([[0], [1], [1]], np.int64)
+
+    def build():
+        pv = layers.data("p", [3, 1], "float32", append_batch_size=False)
+        lv = layers.data("l", [3, 1], "int64", append_batch_size=False)
+        return contrib_layers.ctr_metric_bundle(pv, lv)
+
+    sqr, ab, prob, q, pos, total = run_prog(build, {"p": p, "l": l})
+    sc = lambda a: float(np.asarray(a).reshape(-1)[0])
+    np.testing.assert_allclose(sc(sqr), ((p - l) ** 2).sum(), rtol=1e-5)
+    np.testing.assert_allclose(sc(prob), p.sum(), rtol=1e-6)
+    assert sc(pos) == 2.0 and sc(total) == 3.0
+
+
+def test_model_stat_and_memory_and_freq():
+    from paddle_tpu.contrib import summary, memory_usage, op_freq_statistic
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        img = layers.data("img", [1, 8, 8], "float32")
+        c = layers.conv2d(img, num_filters=4, filter_size=3, act="relu")
+        p = layers.pool2d(c, pool_size=2, pool_type="max")
+        f = layers.fc(p, size=10)
+    rows, (params, flops) = summary(main)
+    types = [r["type"] for r in rows]
+    assert "conv2d" in types and "pool2d" in types
+    assert params > 0 and flops > 0
+    lo, hi = memory_usage(main, batch_size=32)
+    assert 0 < lo < hi
+    with pytest.raises(ValueError):
+        memory_usage(main, batch_size=0)
+    uni, adj = op_freq_statistic(main)
+    assert uni["conv2d"] == 1
+    assert any("->" in k for k in adj)
+
+
+def test_data_generator_slot_format():
+    import paddle_tpu.incubate.data_generator as dg
+
+    class MyData(dg.MultiSlotDataGenerator):
+        def generate_sample(self, line):
+            def local_iter():
+                yield ("words", [1, 2, 3]), ("label", [0])
+
+            return local_iter
+
+    out = []
+    md = MyData()
+    md.run_from_memory(write=out.append)
+    assert out[0] == "3 1 2 3 1 0\n"
+
+    class MyStr(dg.MultiSlotStringDataGenerator):
+        def generate_sample(self, line):
+            def local_iter():
+                yield ("q", ["a", "b"]),
+
+            return local_iter
+
+    out2 = []
+    MyStr().run_from_memory(write=out2.append)
+    assert out2[0] == "2 a b\n"
+
+    class Bad(dg.MultiSlotDataGenerator):
+        def generate_sample(self, line):
+            def local_iter():
+                yield ("words", "not-a-list"),
+
+            return local_iter
+
+    with pytest.raises(ValueError):
+        Bad().run_from_memory(write=lambda s: None)
+
+
+def test_basic_cells_dygraph():
+    from paddle_tpu import dygraph
+    from paddle_tpu.contrib.layers import BasicGRUUnit, BasicLSTMUnit
+    rng = np.random.RandomState(0)
+    with dygraph.guard():
+        x = dygraph.to_variable(rng.randn(2, 3).astype(np.float32))
+        h0 = dygraph.to_variable(np.zeros((2, 4), np.float32))
+        c0 = dygraph.to_variable(np.zeros((2, 4), np.float32))
+        gru = BasicGRUUnit("gru", 4)
+        h1 = gru(x, h0)
+        assert np.asarray(h1._value).shape == (2, 4)
+        lstm = BasicLSTMUnit("lstm", 4)
+        h2, c2 = lstm(x, h0, c0)
+        assert np.asarray(h2._value).shape == (2, 4)
+        assert np.isfinite(np.asarray(c2._value)).all()

@@ -1,0 +1,226 @@
+"""Tests for the paddle_tpu.dataset corpus package (ref
+python/paddle/dataset/tests/*): record schemas, determinism, and the
+reader-decorator interop the book chapters rely on."""
+import itertools
+
+import numpy as np
+import pytest
+
+from paddle_tpu import dataset
+from paddle_tpu.reader import decorator
+
+
+def take(reader, n):
+    return list(itertools.islice(reader(), n))
+
+
+def test_mnist_schema_and_determinism():
+    a = take(dataset.mnist.train(), 5)
+    b = take(dataset.mnist.train(), 5)
+    for (xa, ya), (xb, yb) in zip(a, b):
+        assert xa.shape == (784,) and xa.dtype == np.float32
+        assert xa.min() >= -1.0 and xa.max() <= 1.0
+        assert 0 <= ya < 10
+        np.testing.assert_array_equal(xa, xb)
+        assert ya == yb
+
+
+def test_mnist_classes_separable():
+    # class-conditional means must differ (the synthetic prototypes)
+    by_class = {}
+    for x, y in take(dataset.mnist.train(), 400):
+        by_class.setdefault(y, []).append(x)
+    means = {c: np.mean(v, 0) for c, v in by_class.items() if len(v) > 5}
+    cs = list(means)
+    gaps = [np.abs(means[c1] - means[c2]).max()
+            for c1, c2 in itertools.combinations(cs, 2)]
+    assert min(gaps) > 0.1
+
+
+def test_cifar_schema():
+    for x, y in take(dataset.cifar.train10(), 3):
+        assert x.shape == (3072,) and 0 <= y < 10
+    for x, y in take(dataset.cifar.test100(), 3):
+        assert x.shape == (3072,) and 0 <= y < 100
+
+
+def test_cifar_cycle():
+    r = dataset.cifar.train10(cycle=True)()
+    n = dataset.cifar.TRAIN_SIZE
+    first = next(r)
+    for _ in range(min(n, 50) - 1):
+        next(r)  # cycle reader keeps yielding past one epoch on small take
+    assert first[0].shape == (3072,)
+
+
+def test_uci_housing_linear_fit():
+    xs, ys = zip(*take(dataset.uci_housing.train(), 200))
+    X = np.stack(xs)
+    y = np.stack(ys)[:, 0]
+    w, res, _, _ = np.linalg.lstsq(
+        np.concatenate([X, np.ones((len(X), 1))], 1), y, rcond=None)
+    pred = np.concatenate([X, np.ones((len(X), 1))], 1) @ w
+    # synthetic truth is linear + unit noise: residual std must be ~1
+    assert np.std(pred - y) < 2.0
+
+
+def test_imdb_dict_and_polarity():
+    wd = dataset.imdb.word_dict()
+    assert '<unk>' in wd
+    samples = take(dataset.imdb.train(wd), 50)
+    labels = {l for _, l in samples}
+    assert labels == {0, 1}
+    for ids, _ in samples:
+        assert all(0 <= i < len(wd) for i in ids)
+
+
+def test_imikolov_ngram_and_seq():
+    d = dataset.imikolov.build_dict(5)
+    ng = take(dataset.imikolov.train(d, 5), 10)
+    assert all(len(t) == 5 for t in ng)
+    sq = take(dataset.imikolov.train(
+        d, 0, dataset.imikolov.DataType.SEQ), 5)
+    for src, trg in sq:
+        assert len(src) == len(trg)
+        assert src[0] == d['<s>'] and trg[-1] == d['<e>']
+
+
+def test_movielens_meta_and_samples():
+    s = next(dataset.movielens.train())
+    # [uid, gender, age_bucket, job, mid, [cats], [title], [rating]]
+    assert len(s) == 8
+    assert isinstance(s[5], list) and isinstance(s[6], list)
+    assert 1.0 <= s[7][0] <= 5.0
+    assert dataset.movielens.max_user_id() == 600
+    assert dataset.movielens.max_movie_id() == 400
+    assert len(dataset.movielens.movie_categories()) == 18
+    info = dataset.movielens.movie_info()[1]
+    assert "MovieInfo" in str(info)
+
+
+def test_conll05_alignment():
+    word_dict, verb_dict, label_dict = dataset.conll05.get_dict()
+    emb = dataset.conll05.get_embedding()
+    assert emb.shape == (len(word_dict), dataset.conll05.EMB_DIM)
+    for s in take(dataset.conll05.test(), 5):
+        assert len(s) == 9
+        T = len(s[0])
+        assert all(len(slot) == T for slot in s)
+        assert label_dict['B-V'] in s[8]  # every sample has a predicate
+
+
+def test_wmt14_teacher_forcing_triplet():
+    for src, trg, trg_next in take(dataset.wmt14.train(60), 10):
+        assert src[0] == 0 and src[-1] == 1  # <s> ... <e>
+        assert trg[0] == 0 and trg_next[-1] == 1
+        assert trg[1:] == trg_next[:-1]
+        assert max(src) < 60 and max(trg_next) < 60
+    sd, td = dataset.wmt14.get_dict(60, reverse=True)
+    assert sd[0] == "<s>" and td[1] == "<e>"
+
+
+def test_wmt16_splits_and_dicts():
+    with pytest.raises(ValueError):
+        dataset.wmt16.train(50, 50, src_lang="fr")
+    tr = take(dataset.wmt16.train(50, 50), 5)
+    va = take(dataset.wmt16.validation(50, 50), 5)
+    assert tr and va and tr[0] != va[0]
+    d = dataset.wmt16.get_dict("de", 50)
+    assert d["<unk>"] == 2 and len(d) == 50
+
+
+def test_mq2007_formats():
+    feats, score = next(dataset.mq2007.train(format="pointwise"))
+    assert feats.shape == (46,) and score in (0, 1, 2)
+    hi, lo = next(dataset.mq2007.train(format="pairwise"))
+    assert hi.shape == lo.shape == (46,)
+    scores, feats = next(dataset.mq2007.train(format="listwise"))
+    assert feats.shape == (len(scores), 46)
+
+
+def test_mq2007_pairwise_orders_by_truth():
+    # hi must outscore lo under the generating linear model
+    w = dataset.mq2007.synthetic.rng_for("mq2007", "w").normal(0, 1, 46)
+    better = 0
+    pairs = list(itertools.islice(
+        dataset.mq2007.train(format="pairwise"), 100))
+    for hi, lo in pairs:
+        better += float(hi @ w > lo @ w)
+    assert better / len(pairs) > 0.7
+
+
+def test_sentiment():
+    wd = dataset.sentiment.get_word_dict()
+    tr = take(dataset.sentiment.train(), 10)
+    assert all(l in (0, 1) for _, l in tr)
+    assert all(all(i < len(wd) for i in ids) for ids, _ in tr)
+
+
+def test_voc2012_masks():
+    img, lab = next(dataset.voc2012.train()())
+    assert img.dtype == np.uint8 and img.shape[0] == 3
+    assert lab.shape == img.shape[1:]
+    classes = set(np.unique(lab)) - {255}
+    assert classes <= set(range(21))
+
+
+def test_flowers():
+    img, lab = next(dataset.flowers.train(use_xmap=False)())
+    assert img.shape == (3 * 64 * 64,) and 0 <= lab < 102
+    img2, _ = next(dataset.flowers.valid(use_xmap=False)())
+    assert img2.shape == img.shape
+
+
+def test_image_transforms():
+    im = np.random.RandomState(0).randint(
+        0, 255, (80, 60, 3)).astype(np.uint8)
+    r = dataset.image.resize_short(im, 64)
+    assert min(r.shape[:2]) == 64
+    c = dataset.image.center_crop(r, 48)
+    assert c.shape[:2] == (48, 48)
+    f = dataset.image.left_right_flip(c)
+    np.testing.assert_array_equal(f[:, ::-1, :], c)
+    t = dataset.image.simple_transform(im, 70, 64, False,
+                                       mean=[1.0, 2.0, 3.0])
+    assert t.shape == (3, 64, 64) and t.dtype == np.float32
+
+
+def test_reader_decorator_interop():
+    wd = dataset.imdb.word_dict()
+    batched = decorator.batch(
+        decorator.shuffle(dataset.imdb.train(wd), buf_size=64),
+        batch_size=8)
+    b = next(batched())
+    assert len(b) == 8 and isinstance(b[0][0], list)
+
+
+def test_common_split_and_cluster_reader(tmp_path):
+    def reader():
+        for i in range(25):
+            yield (i, i * i)
+
+    suffix = str(tmp_path / "part-%05d.pickle")
+    dataset.common.split(reader, 10, suffix=suffix)
+    r0 = dataset.common.cluster_files_reader(
+        str(tmp_path / "part-*.pickle"), 2, 0)
+    r1 = dataset.common.cluster_files_reader(
+        str(tmp_path / "part-*.pickle"), 2, 1)
+    got = sorted(list(r0()) + list(r1()))
+    assert got == [(i, i * i) for i in range(25)]
+
+
+def test_common_download_offline(tmp_path, monkeypatch):
+    monkeypatch.setattr(dataset.common, "DATA_HOME", str(tmp_path))
+    with pytest.raises(RuntimeError, match="no network egress"):
+        dataset.common.download("http://x/y.tar", "mod", None)
+    d = tmp_path / "mod"
+    d.mkdir()
+    (d / "y.tar").write_bytes(b"abc")
+    assert dataset.common.download("http://x/y.tar", "mod", None) == \
+        str(d / "y.tar")
+
+
+def test_dataset_api_reexports():
+    # fluid Dataset API still reachable at the old import path
+    from paddle_tpu.dataset import DatasetFactory, InMemoryDataset
+    assert DatasetFactory().create_dataset("InMemoryDataset") is not None

@@ -1,0 +1,124 @@
+"""Transpiler + LoDTensor adapters (ref transpiler/*, lod_tensor.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers, optimizer
+from paddle_tpu.transpiler import (DistributeTranspiler,
+                                   DistributeTranspilerConfig, HashName,
+                                   RoundRobin, memory_optimize,
+                                   release_memory)
+
+
+class _V(object):
+    def __init__(self, n):
+        self._n = n
+
+    def name(self):
+        return self._n
+
+
+def test_ps_dispatchers():
+    eps = ["a:1", "b:2", "c:3"]
+    rr = RoundRobin(eps)
+    got = rr.dispatch([_V("x%d" % i) for i in range(7)])
+    assert got == ["a:1", "b:2", "c:3", "a:1", "b:2", "c:3", "a:1"]
+    rr.reset()
+    assert rr.dispatch([_V("y")]) == ["a:1"]
+    hn = HashName(eps)
+    one = hn.dispatch([_V("w"), _V("w")])
+    assert one[0] == one[1]  # deterministic per name
+    assert set(hn.dispatch([_V("v%d" % i) for i in range(64)])) <= set(eps)
+
+
+def _build_prog():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", shape=[4], dtype="float32")
+        y = layers.fc(x, size=2)
+        loss = layers.reduce_mean(y)
+        optimizer.SGD(0.1).minimize(loss)
+    return main, startup, loss
+
+
+def test_memory_optimize_noop_preserves_program():
+    main, startup, loss = _build_prog()
+    n_ops = len(main.global_block().ops)
+    out = memory_optimize(main, print_log=False)
+    assert out is main
+    assert len(main.global_block().ops) == n_ops
+    assert main._memory_optimize_requested
+    release_memory(main)
+    assert main._release_memory_requested
+    with pytest.raises(TypeError):
+        memory_optimize("not a program")
+    with pytest.raises(ValueError):
+        memory_optimize(main, level=3)
+
+
+def test_distribute_transpiler_collective_flow():
+    from paddle_tpu.distributed import mesh as mesh_mod
+    main, startup, loss = _build_prog()
+    cfg = DistributeTranspilerConfig()
+    t = DistributeTranspiler(cfg)
+    t.transpile(trainer_id=0, program=main, trainers=4,
+                pservers="h0:6174,h1:6174", startup_program=startup)
+    assert t.get_trainer_program() is main
+    assert t.get_startup_program() is startup
+    assert t.pserver_endpoints == ["h0:6174", "h1:6174"]
+    with pytest.raises(NotImplementedError, match="pserver"):
+        t.get_pserver_program("h0:6174")
+    # async mode is a documented design decision, not a silent skip
+    with pytest.raises(NotImplementedError, match="async"):
+        t.transpile(0, program=main, trainers=2, sync_mode=False)
+
+
+def test_distribute_transpiler_requires_transpile_first():
+    t = DistributeTranspiler()
+    with pytest.raises(RuntimeError):
+        t.get_trainer_program()
+
+
+def test_create_lod_tensor_from_list():
+    t = pt.create_lod_tensor([[1, 2, 3], [4, 5]], [[3, 2]], None)
+    assert t.data.shape == (2, 3, 1)
+    assert t.recursive_sequence_lengths() == [[3, 2]]
+    assert t.lod() == [[0, 3, 5]]
+    np.testing.assert_array_equal(t.data[:, :, 0],
+                                  [[1, 2, 3], [4, 5, 0]])
+
+
+def test_create_lod_tensor_from_ndarray_and_nested():
+    flat = np.arange(10, dtype=np.float32).reshape(5, 2)
+    t = pt.create_lod_tensor(flat, [[2, 3]], None)
+    assert t.data.shape == (2, 3, 2)
+    np.testing.assert_array_equal(t.data[1, :3], flat[2:])
+    # nested LoD flattens outer level to token totals
+    t2 = pt.create_lod_tensor(flat, [[1, 1], [2, 3]], None)
+    assert list(t2.lengths) == [2, 3]
+
+
+def test_create_random_int_lodtensor():
+    t = pt.create_random_int_lodtensor([[2, 4]], base_shape=[1], place=None,
+                                       low=0, high=7)
+    assert t.data.shape == (2, 4, 1)
+    assert t.data.max() <= 7
+    assert list(t.lengths) == [2, 4]
+
+
+def test_lod_tensor_feeds_sequence_ops():
+    """Dense+lengths from create_lod_tensor flows into sequence_pool."""
+    t = pt.create_lod_tensor(
+        [np.ones((3, 2), np.float32), 2 * np.ones((1, 2), np.float32)],
+        [[3, 1]], None)
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", shape=[t.data.shape[1], 2], dtype="float32")
+        ln = layers.data("ln", shape=[], dtype="int64")
+        pooled = layers.sequence_pool(x, "sum", lengths=ln)
+    exe = pt.Executor()
+    exe.run(startup)
+    out, = exe.run(main, feed={"x": t.data, "ln": t.lengths},
+                   fetch_list=[pooled])
+    np.testing.assert_allclose(np.asarray(out),
+                               [[3.0, 3.0], [2.0, 2.0]], rtol=1e-6)
