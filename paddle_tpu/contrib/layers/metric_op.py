@@ -21,6 +21,8 @@ def ctr_metric_bundle(input, label):
     prob = layers.reduce_sum(input)
     q = layers.reduce_sum(layers.elementwise_mul(input, input))
     pos = layers.reduce_sum(layers.cast(label, input.dtype))
-    total = layers.fill_constant([1], input.dtype,
-                                 float(input.shape[0]))
+    # runtime row count — static shape may be -1 (dynamic batch) and the
+    # final partial batch differs from the graph-time shape anyway
+    total = layers.reduce_sum(layers.fill_constant_batch_size_like(
+        input, shape=[-1, 1], dtype=input.dtype, value=1.0))
     return sqrerr, abserr, prob, q, pos, total

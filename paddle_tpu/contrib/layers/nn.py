@@ -38,12 +38,16 @@ def fused_elemwise_activation(x, y, functor_list, axis=-1, scale=0.0,
         return getattr(layers, name)(a)
 
     f1, f2 = functor_list
+    # fluid convention: functor_list[0] is the OUTER functor —
+    # [binary, unary] => binary(x, unary(y)); [unary, binary] =>
+    # unary(binary(x, y)) (ref fused_elemwise_activation_op.h
+    # BinaryCompound/UnaryCompound)
     if f1 in binary and f2 in unary:
-        intermediate = apply_one(f1, x, y)
-        out = apply_one(f2, intermediate)
+        intermediate = apply_one(f2, y)
+        out = apply_one(f1, x, intermediate)
     elif f1 in unary and f2 in binary:
-        intermediate = apply_one(f1, y)
-        out = apply_one(f2, x, intermediate)
+        intermediate = apply_one(f2, x, y)
+        out = apply_one(f1, intermediate)
     else:
         raise ValueError("functor_list must pair one binary elementwise "
                          "op with one unary activation, got %r" %
@@ -183,5 +187,6 @@ def shuffle_batch(x, seed=None):
         "shuffle_batch",
         inputs={"X": [x.name]},
         outputs={"Out": [out.name], "ShuffleIdx": [idx.name]},
-        attrs={"startup_seed": int(seed) if seed else 0})
+        # -1 = unseeded; seed=0 is a legal pinned seed
+        attrs={"startup_seed": -1 if seed is None else int(seed)})
     return out

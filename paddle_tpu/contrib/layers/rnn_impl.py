@@ -134,7 +134,18 @@ def _gather_steps(seq_out, idx):
 
 def _one_direction(x, init_h, init_c, hidden_size, is_reverse, cell_type,
                    param_attr, bias_attr, dtype, sequence_length):
-    """x: (N, T, D) -> (out (N, T, H), last_h, last_c|None)."""
+    """x: (N, T, D) -> (out (N, T, H), last_h, last_c|None).
+
+    Padded reverse direction: a plain is_reverse scan would consume the
+    PAD tail before the valid steps, contaminating every state.  With
+    sequence_length we instead reverse each VALID prefix
+    (sequence_reverse), run a forward scan, and un-reverse the outputs
+    — fluid's semantics, built on the length-aware reverse kernel."""
+    from ...layers.sequence_lod import sequence_reverse
+    length_aware_reverse = is_reverse and sequence_length is not None
+    if length_aware_reverse:
+        x = sequence_reverse(x, lengths=sequence_length)
+        is_reverse = False
     if cell_type == "gru":
         proj = layers.fc(x, size=3 * hidden_size, num_flatten_dims=2,
                          param_attr=param_attr, bias_attr=False)
@@ -160,13 +171,16 @@ def _one_direction(x, init_h, init_c, hidden_size, is_reverse, cell_type,
         if cell_seq is not None:
             cell_seq = layers.elementwise_mul(cell_seq, mask3)
     if is_reverse:
-        # last valid state of a reversed scan is step 0
+        # last valid state of a full-length reversed scan is step 0
         last_h = layers.squeeze(
             layers.slice(out, axes=[1], starts=[0], ends=[1]), axes=[1])
         last_c = None if cell_seq is None else layers.squeeze(
             layers.slice(cell_seq, axes=[1], starts=[0], ends=[1]),
             axes=[1])
     elif sequence_length is not None:
+        # covers the length-aware reverse too: the scan ran forward over
+        # the prefix-reversed input, so its len-1 step IS the reverse
+        # direction's final state
         last_h = layers.squeeze(_gather_steps(
             out, _len_minus_one(sequence_length)), axes=[1])
         last_c = None if cell_seq is None else layers.squeeze(
@@ -180,6 +194,11 @@ def _one_direction(x, init_h, init_c, hidden_size, is_reverse, cell_type,
         last_c = None if cell_seq is None else layers.squeeze(
             layers.slice(cell_seq, axes=[1], starts=[t - 1], ends=[t]),
             axes=[1])
+    if length_aware_reverse:
+        # put per-step outputs back in original time order
+        out = sequence_reverse(out, lengths=sequence_length)
+        if cell_seq is not None:
+            cell_seq = sequence_reverse(cell_seq, lengths=sequence_length)
     return out, last_h, last_c
 
 

@@ -55,9 +55,8 @@ def _summary_op(block, op):
         if len(w) != 2:
             return None
         k_in, k_out = w
-        params = k_in * k_out + 1
-        flops = 2 * _numel(outs[:-1]) * k_in * k_out // max(outs[-1], 1) \
-            if outs else 2 * k_in * k_out
+        # bias lives in a separate elementwise op in this IR
+        params = k_in * k_out
         flops = 2 * k_in * k_out * (_numel(ins) // max(k_in, 1))
     elif t in _ACTS:
         ins = _var_shape(block, op.input("X")[0])

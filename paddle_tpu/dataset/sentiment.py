@@ -28,21 +28,35 @@ def _words(i):
     return ["w%04d" % w for w in ids], label
 
 
+_WORD_DICT = None
+_DATA = None
+
+
 def get_word_dict():
-    """Frequency-sorted (word, id) over the whole corpus
+    """Frequency-sorted (word, id) over the whole corpus, cached at
+    module level like the reference's download cache
     (ref sentiment.py:70)."""
-    words_freq = {}
-    for i in range(NUM_TOTAL_INSTANCES):
-        for w in _words(i)[0]:
-            words_freq[w] = words_freq.get(w, 0) + 1
-    words_sort_list = sorted(words_freq.items(), key=lambda x: (-x[1], x[0]))
-    return dict((w, i) for i, (w, _) in enumerate(words_sort_list))
+    global _WORD_DICT
+    if _WORD_DICT is None:
+        words_freq = {}
+        for i in range(NUM_TOTAL_INSTANCES):
+            for w in _words(i)[0]:
+                words_freq[w] = words_freq.get(w, 0) + 1
+        words_sort_list = sorted(words_freq.items(),
+                                 key=lambda x: (-x[1], x[0]))
+        _WORD_DICT = dict(
+            (w, i) for i, (w, _) in enumerate(words_sort_list))
+    return _WORD_DICT
 
 
 def load_sentiment_data():
-    word_idx = get_word_dict()
-    return [([word_idx[w] for w in ws], lab)
-            for ws, lab in (_words(i) for i in range(NUM_TOTAL_INSTANCES))]
+    global _DATA
+    if _DATA is None:
+        word_idx = get_word_dict()
+        _DATA = [([word_idx[w] for w in ws], lab)
+                 for ws, lab in (_words(i)
+                                 for i in range(NUM_TOTAL_INSTANCES))]
+    return _DATA
 
 
 def reader_creator(data):

@@ -19,12 +19,6 @@ TEST_SIZE = 400
 VAL_SIZE = 400
 
 
-def __get_dict_size(src_dict_size, trg_dict_size, src_lang):
-    src_dict_size = min(src_dict_size, (TRAIN_SIZE if src_lang == "en"
-                                        else TRAIN_SIZE))
-    return src_dict_size, trg_dict_size
-
-
 def _lang_words(lang, n):
     return ["<s>", "<e>", "<unk>"] + \
         ["%s%05d" % (lang, i) for i in range(n - 3)]

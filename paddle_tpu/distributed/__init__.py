@@ -9,6 +9,8 @@ instead of NCCL/brpc.
 from .mesh import (init_mesh, get_mesh, mesh_axes, DistributedStrategy,
                    shard_parameter, column_parallel_attr, row_parallel_attr)
 from . import fleet
+from . import launch
+from .launch import init_on_pod
 from .ring_attention import ring_attention
 from .pipeline import (pipeline_forward, pipeline_loss_and_grads,
                        pipeline_1f1b_step, stack_stage_params)

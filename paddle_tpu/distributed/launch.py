@@ -1,0 +1,127 @@
+"""Multi-process / multi-host launcher
+(ref python/paddle/distributed/launch.py).
+
+The reference spawns one trainer process per GPU with
+PADDLE_TRAINER_ID/PADDLE_CURRENT_ENDPOINT env wiring.  On TPU pods the
+runtime model differs: ONE process per host drives all local chips and
+`jax.distributed.initialize` forms the job.  This module covers both
+worlds:
+
+* ``init_on_pod()`` — call at the top of a training script on every
+  host: reads the reference's env contract (PADDLE_TRAINERS_NUM,
+  PADDLE_TRAINER_ID, PADDLE_TRAINER_ENDPOINTS) or the TPU runtime's
+  own discovery, then calls ``jax.distributed.initialize`` so the
+  global mesh sees every host's chips.
+* ``python -m paddle_tpu.distributed.launch --nproc_per_node=N
+  script.py`` — local simulation: spawns N CPU processes with the env
+  contract set (each with a coordinator address), mirroring the
+  reference CLI for development boxes without a pod.
+"""
+import os
+import signal
+import subprocess
+import sys
+import time
+
+__all__ = ["init_on_pod", "get_cluster_env", "start_procs", "launch"]
+
+
+def get_cluster_env(env=None):
+    """Parse the fluid launcher env contract -> (num_hosts, host_id,
+    endpoints, coordinator)."""
+    env = env if env is not None else os.environ
+    num = int(env.get("PADDLE_TRAINERS_NUM", env.get("PADDLE_NUM_TRAINERS",
+                                                     "1")))
+    hid = int(env.get("PADDLE_TRAINER_ID", "0"))
+    eps = [e for e in env.get("PADDLE_TRAINER_ENDPOINTS", "").split(",")
+           if e]
+    coordinator = eps[0] if eps else env.get("PADDLE_COORDINATOR",
+                                             "127.0.0.1:8476")
+    return num, hid, eps, coordinator
+
+
+def init_on_pod(mesh_axes=None, env=None):
+    """Initialize multi-host JAX from the fluid env contract and install
+    the global mesh.  Idempotent; single-host jobs skip the distributed
+    handshake entirely."""
+    import jax
+    num, hid, _eps, coordinator = get_cluster_env(env)
+    if num > 1:
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coordinator, num_processes=num,
+                process_id=hid)
+        except (RuntimeError, ValueError) as e:  # already initialized
+            if "already" not in str(e):
+                raise
+    if mesh_axes:
+        from . import mesh as mesh_mod
+        mesh_mod.init_mesh(mesh_axes)
+    return jax.process_index(), jax.process_count()
+
+
+def start_procs(nproc, training_script, script_args=(), log_dir=None,
+                base_port=8476, env=None):
+    """Spawn *nproc* local worker processes with the env contract set
+    (ref launch.py:147).  Workers run on the CPU backend so a dev box
+    can exercise the multi-process path; returns the Popen list."""
+    base_env = dict(env if env is not None else os.environ)
+    eps = ",".join("127.0.0.1:%d" % (base_port + i) for i in range(nproc))
+    procs = []
+    if log_dir:
+        os.makedirs(log_dir, exist_ok=True)
+    for i in range(nproc):
+        cur = dict(base_env)
+        cur.update({
+            "PADDLE_TRAINERS_NUM": str(nproc),
+            "PADDLE_TRAINER_ID": str(i),
+            "PADDLE_TRAINER_ENDPOINTS": eps,
+            "PADDLE_CURRENT_ENDPOINT": "127.0.0.1:%d" % (base_port + i),
+            "JAX_PLATFORMS": "cpu",
+        })
+        cmd = [sys.executable, "-u", training_script] + list(script_args)
+        out = open(os.path.join(log_dir, "workerlog.%d" % i), "w") \
+            if log_dir else None
+        procs.append(subprocess.Popen(cmd, env=cur, stdout=out,
+                                      stderr=subprocess.STDOUT
+                                      if out else None))
+    return procs
+
+
+def terminate_procs(procs):
+    for p in procs:
+        if p.poll() is None:
+            p.send_signal(signal.SIGTERM)
+    time.sleep(1)
+    for p in procs:
+        if p.poll() is None:
+            p.kill()
+
+
+def launch(argv=None):
+    """CLI entry (ref launch.py:283): ``--nproc_per_node N script.py
+    [args...]``; waits for workers, propagates the first failure."""
+    import argparse
+    parser = argparse.ArgumentParser("paddle_tpu.distributed.launch")
+    parser.add_argument("--nproc_per_node", type=int, default=1)
+    parser.add_argument("--log_dir", type=str, default=None)
+    parser.add_argument("--started_port", type=int, default=8476)
+    parser.add_argument("training_script")
+    parser.add_argument("script_args", nargs=argparse.REMAINDER)
+    args = parser.parse_args(argv)
+    procs = start_procs(args.nproc_per_node, args.training_script,
+                        args.script_args, log_dir=args.log_dir,
+                        base_port=args.started_port)
+    rc = 0
+    try:
+        for p in procs:
+            p.wait()
+            rc = rc or p.returncode
+    finally:
+        terminate_procs(procs)
+    if rc:
+        sys.exit(rc)
+
+
+if __name__ == "__main__":
+    launch()

@@ -6,9 +6,17 @@ LARK/ERNIE repos, rebuilt on paddle_tpu layers).
 - transformer: Transformer-base NMT
 - deepfm: DeepFM CTR with high-dim sparse embeddings
 - simple: MLP/word2vec smoke models (book tests)
+- vision: MobileNet v1 / VGG-16 / SE-ResNeXt-50 classifiers
+- yolov3: YOLOv3 detection (train: yolov3_loss; infer: yolo_box+NMS)
+- sequence_labeling: BiGRU-CRF tagger (LAC/NER style)
+- ocr: CRNN-CTC text recognition
 """
 from . import bert
 from . import resnet
 from . import transformer
 from . import deepfm
 from . import simple
+from . import vision
+from . import yolov3
+from . import sequence_labeling
+from . import ocr

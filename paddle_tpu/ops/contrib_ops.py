@@ -18,8 +18,8 @@ def _shuffle_batch(ctx, ins, attrs):
     """Random row permutation (ref operators/shuffle_batch_op.h): returns
     the shuffled tensor and the permutation used (for unshuffling)."""
     x = ins["X"][0]
-    seed = attrs.get("startup_seed", 0)
-    key = jax.random.PRNGKey(seed) if seed else ctx.rng()
+    seed = attrs.get("startup_seed", -1)
+    key = jax.random.PRNGKey(seed) if seed >= 0 else ctx.rng()
     perm = jax.random.permutation(key, x.shape[0])
     return {"Out": jnp.take(x, perm, axis=0),
             "ShuffleIdx": perm.astype(jnp.int64)}

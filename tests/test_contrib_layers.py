@@ -29,13 +29,18 @@ def test_fused_elemwise_activation():
     def build():
         xv = layers.data("x", [3, 4], "float32", append_batch_size=False)
         yv = layers.data("y", [3, 4], "float32", append_batch_size=False)
+        # fluid order: functor_list[0] is the OUTER functor
         out, inter = contrib_layers.fused_elemwise_activation(
-            xv, yv, ["elementwise_add", "relu"])
-        return out, inter
+            xv, yv, ["relu", "elementwise_add"])       # relu(x + y)
+        out2, inter2 = contrib_layers.fused_elemwise_activation(
+            xv, yv, ["elementwise_add", "relu"])       # x + relu(y)
+        return out, inter, out2, inter2
 
-    out, inter = run_prog(build, {"x": x, "y": y})
+    out, inter, out2, inter2 = run_prog(build, {"x": x, "y": y})
     np.testing.assert_allclose(inter, x + y, rtol=1e-6)
     np.testing.assert_allclose(out, np.maximum(x + y, 0), rtol=1e-6)
+    np.testing.assert_allclose(inter2, np.maximum(y, 0), rtol=1e-6)
+    np.testing.assert_allclose(out2, x + np.maximum(y, 0), rtol=1e-6)
     with pytest.raises(ValueError):
         contrib_layers.fused_elemwise_activation(None, None, ["relu"])
 

@@ -1,0 +1,107 @@
+"""New model-zoo families (ref PaddlePaddle/models: image_classification,
+yolov3, LAC, ocr_recognition): one-train-step finiteness on every arch,
+train-down on the cheap ones, decode behavior checks."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import optimizer
+from paddle_tpu.framework.scope import Scope, scope_guard
+from paddle_tpu.models import vision, yolov3, sequence_labeling, ocr
+
+
+def _train(main, startup, feed, loss_var, steps):
+    scope = Scope()
+    with scope_guard(scope):
+        exe = pt.Executor()
+        exe.run(startup)
+        vals = []
+        for _ in range(steps):
+            lv, = exe.run(main, feed=feed, fetch_list=[loss_var])
+            vals.append(float(np.asarray(lv).reshape(-1)[0]))
+    return vals
+
+
+@pytest.mark.parametrize("arch", ["mobilenet", "vgg16", "se_resnext50"])
+def test_classifier_one_step(arch):
+    main, startup, feeds, fetches = vision.classification_train_program(
+        arch, class_dim=10, image_shape=(3, 32, 32),
+        optimizer_fn=lambda l: optimizer.Momentum(0.01, 0.9).minimize(l))
+    feed = vision.synthetic_image_batch(2, (3, 32, 32), 10)
+    vals = _train(main, startup, feed, fetches["loss"], 2)
+    assert all(np.isfinite(v) for v in vals)
+
+
+def test_yolov3_train_loss_decreases():
+    main, startup, feeds, fetches = yolov3.yolov3_train_program(
+        class_num=4, image_size=64, tiny=True,
+        optimizer_fn=lambda l: optimizer.Adam(1e-3).minimize(l))
+    feed = yolov3.synthetic_detection_batch(2, image_size=64)
+    vals = _train(main, startup, feed, fetches["loss"], 6)
+    assert all(np.isfinite(v) for v in vals)
+    assert vals[-1] < vals[0]
+
+
+def test_yolov3_infer_shapes():
+    main, startup, feeds, fetches = yolov3.yolov3_infer_program(
+        class_num=4, image_size=64, tiny=True)
+    rng = np.random.RandomState(0)
+    scope = Scope()
+    with scope_guard(scope):
+        exe = pt.Executor()
+        exe.run(startup)
+        pred, = exe.run(main, feed={
+            "image": rng.rand(2, 3, 64, 64).astype(np.float32),
+            "im_size": np.array([[64, 64], [64, 64]], np.int32)},
+            fetch_list=[fetches["pred"]])
+    pred = np.asarray(pred)
+    # (N, keep_top_k, 6): [label, score, x1, y1, x2, y2]
+    assert pred.ndim == 3 and pred.shape[2] == 6
+
+
+def test_bigru_crf_learns_mapping():
+    main, startup, feeds, fetches = sequence_labeling.bigru_crf_program(
+        vocab_size=50, num_labels=5, emb_dim=16, hidden=16, seq_len=12,
+        optimizer_fn=lambda l: optimizer.Adam(5e-3).minimize(l))
+    feed = sequence_labeling.synthetic_tagging_batch(
+        8, seq_len=12, vocab_size=50, num_labels=5)
+    scope = Scope()
+    with scope_guard(scope):
+        exe = pt.Executor()
+        exe.run(startup)
+        first = None
+        for i in range(60):
+            lv, = exe.run(main, feed=feed, fetch_list=[fetches["loss"]])
+            v = float(np.asarray(lv).reshape(-1)[0])
+            if first is None:
+                first = v
+        dec, = exe.run(main, feed=feed, fetch_list=[fetches["decode"]])
+    assert v < first * 0.7
+    dec = np.asarray(dec).reshape(8, 12)
+    tgt = feed["targets"]
+    lens = feed["lens"][:, 0]
+    valid = np.arange(12)[None, :] < lens[:, None]
+    acc = (dec == tgt)[valid].mean()
+    assert acc > 0.5, "CRF decode accuracy %.2f after fitting" % acc
+
+
+def test_crnn_ctc_trains_and_decodes():
+    main, startup, feeds, fetches = ocr.crnn_ctc_program(
+        num_classes=8, image_shape=(1, 16, 24), hidden=16, max_label=6,
+        optimizer_fn=lambda l: optimizer.Adam(2e-3).minimize(l))
+    feed = ocr.synthetic_ocr_batch(4, (1, 16, 24), num_classes=8,
+                                   max_label=6)
+    scope = Scope()
+    with scope_guard(scope):
+        exe = pt.Executor()
+        exe.run(startup)
+        vals = []
+        for _ in range(40):
+            lv, = exe.run(main, feed=feed, fetch_list=[fetches["loss"]])
+            vals.append(float(np.asarray(lv).reshape(-1)[0]))
+        logits, = exe.run(main, feed=feed,
+                          fetch_list=[fetches["logits"]])
+    assert all(np.isfinite(v) for v in vals)
+    assert vals[-1] < vals[0] * 0.8
+    decoded = ocr.ctc_greedy_decode(logits, blank=8)
+    assert len(decoded) == 4  # decode runs; content quality needs epochs
