@@ -7,6 +7,11 @@ from . import extend_optimizer
 from . import quantize
 from . import slim
 from . import layers
+from . import decoder
+from . import trainer
+from . import inferencer
+from .trainer import Trainer
+from .inferencer import Inferencer
 from . import model_stat
 from . import memory_usage_calc
 from . import op_frequence

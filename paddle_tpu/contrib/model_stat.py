@@ -58,6 +58,18 @@ def _summary_op(block, op):
         # bias lives in a separate elementwise op in this IR
         params = k_in * k_out
         flops = 2 * k_in * k_out * (_numel(ins) // max(k_in, 1))
+    elif t == "elementwise_add":
+        # fc/conv bias shows up as elementwise_add with a rank-1
+        # Parameter operand — attribute it here so PARAMs stay complete
+        yv = block._find_var_recursive(op.input("Y")[0]) if hasattr(
+            block, "_find_var_recursive") else None
+        if yv is None or not getattr(yv, "persistable", False) or \
+                len(yv.shape or ()) != 1:
+            return None
+        ins = _var_shape(block, op.input("X")[0])
+        outs = _var_shape(block, op.output("Out")[0])
+        params = yv.shape[0]
+        flops = _numel(outs)
     elif t in _ACTS:
         ins = _var_shape(block, op.input("X")[0])
         outs = _var_shape(block, op.output("Out")[0])

@@ -54,6 +54,20 @@ def init_on_pod(mesh_axes=None, env=None):
         except (RuntimeError, ValueError) as e:  # already initialized
             if "already" not in str(e):
                 raise
+    elif (env if env is not None else os.environ).get(
+            "PADDLE_TRAINERS_NUM") is None and \
+            jax.default_backend() == "tpu":
+        # no fluid env contract: fall back to the TPU runtime's own
+        # discovery (argless initialize reads the pod metadata; on a
+        # single host it degenerates to a 1-process job)
+        try:
+            jax.distributed.initialize()
+        except (RuntimeError, ValueError) as e:
+            if "already" not in str(e):
+                import warnings
+                warnings.warn(
+                    "jax.distributed.initialize() discovery failed "
+                    "(%s); continuing single-process" % (e,))
     if mesh_axes:
         from . import mesh as mesh_mod
         mesh_mod.init_mesh(mesh_axes)
@@ -80,11 +94,15 @@ def start_procs(nproc, training_script, script_args=(), log_dir=None,
             "JAX_PLATFORMS": "cpu",
         })
         cmd = [sys.executable, "-u", training_script] + list(script_args)
-        out = open(os.path.join(log_dir, "workerlog.%d" % i), "w") \
-            if log_dir else None
-        procs.append(subprocess.Popen(cmd, env=cur, stdout=out,
-                                      stderr=subprocess.STDOUT
-                                      if out else None))
+        if log_dir:
+            with open(os.path.join(log_dir, "workerlog.%d" % i),
+                      "w") as out:
+                # Popen dups the fd; closing the parent copy immediately
+                # avoids leaking one handle per spawned worker
+                procs.append(subprocess.Popen(
+                    cmd, env=cur, stdout=out, stderr=subprocess.STDOUT))
+        else:
+            procs.append(subprocess.Popen(cmd, env=cur))
     return procs
 
 

@@ -55,12 +55,20 @@ def yolov3_body(image, class_num=80, tiny=True, is_test=False):
         routes.append(y)
     heads = []
     # heads at stride 32, 16, 8 with top-down feature reuse
+    if image.shape[2] % 32 or image.shape[3] % 32:
+        raise ValueError(
+            "yolov3_body needs the image size divisible by 32 so the "
+            "top-down FPN upsample aligns across strides; got %r" %
+            (tuple(image.shape[2:]),))
     route = None
     for i, feat in enumerate(routes[::-1][:3]):
         if route is not None:
             route = layers.resize_nearest(route, scale=2.0)
-            if route.shape[2] == feat.shape[2]:
-                feat = layers.concat([route, feat], axis=1)
+            if route.shape[2] != feat.shape[2]:
+                raise ValueError(
+                    "FPN shape mismatch: upsampled route %r vs feature "
+                    "%r" % (tuple(route.shape), tuple(feat.shape)))
+            feat = layers.concat([route, feat], axis=1)
         ch = feat.shape[1]
         tip = layers.leaky_relu(_conv_bn(feat, ch, 3, is_test=is_test),
                                 0.1)
