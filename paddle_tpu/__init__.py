@@ -23,12 +23,14 @@ from . import optimizer
 from . import regularizer
 from . import clip
 from . import metrics
+from . import evaluator
 from . import io
 from .io import (save_params, save_persistables, load_params,
                  load_persistables, save_inference_model,
                  load_inference_model)
 from . import reader
 from .data_feeder import DataFeeder
+from .reader.decorator import batch  # paddle.batch parity
 from . import dygraph
 from . import distributed
 from . import inference

@@ -324,7 +324,12 @@ class BeamSearchDecoder(object):
         # While body creates each param once; here re-creation with the
         # same name resolves to the same Parameter)
         from ...param_attr import ParamAttr
-        uid = self._name or "beam_decoder"
+        from ...framework import unique_name
+        if self._name is None:
+            # unique per decoder: two anonymous decoders in one program
+            # must not silently share embedding/fc weights
+            self._name = unique_name.generate("beam_decoder")
+        uid = self._name
         emb_attr = ParamAttr(name=uid + "_emb_w")
         fc_w_attr = ParamAttr(name=uid + "_fc_w")
         fc_b_attr = ParamAttr(name=uid + "_fc_b")

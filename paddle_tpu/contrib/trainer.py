@@ -94,12 +94,21 @@ class Trainer(object):
             self.loss = outs[0]
             optimizer = optimizer_func()
             optimizer.minimize(self.loss)
+        # evaluation must not run the appended optimizer update ops —
+        # test() uses the pruned inference clone of the same graph
+        self.test_program = self.train_program.clone(for_test=True)
         self.exe = Executor(place)
         with scope_guard(self.scope):
             self.exe.run(self.startup_program)
             if param_path and os.path.isdir(param_path):
                 io_mod.load_persistables(self.exe, param_path,
                                          self.train_program)
+            cfg = self._checkpoint_cfg
+            if cfg and os.path.exists(os.path.join(cfg.checkpoint_dir,
+                                                   "latest")):
+                # crash-resume: restore the newest checkpoint's state
+                cfg.load_serial = io_mod.load_checkpoint(
+                    self.exe, cfg.checkpoint_dir, self.train_program)
 
     def stop(self):
         self.__stop = True
@@ -146,13 +155,14 @@ class Trainer(object):
         return DataFeeder(feed_list=feed_vars, program=self.train_program)
 
     def test(self, reader, feed_order):
-        """Mean metrics over a test reader (ref :407)."""
+        """Mean metrics over a test reader (ref :407) — on the for_test
+        clone, so no optimizer update ops run on test data."""
         feeder = self._make_feeder(feed_order)
         totals = None
         count = 0
         with scope_guard(self.scope):
             for data in reader():
-                outs = self.exe.run(self.train_program,
+                outs = self.exe.run(self.test_program,
                                     feed=feeder.feed(data),
                                     fetch_list=self.train_func_outputs)
                 vals = [float(np.asarray(o).reshape(-1)[0]) for o in outs]

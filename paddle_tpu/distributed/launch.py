@@ -54,20 +54,22 @@ def init_on_pod(mesh_axes=None, env=None):
         except (RuntimeError, ValueError) as e:  # already initialized
             if "already" not in str(e):
                 raise
-    elif (env if env is not None else os.environ).get(
-            "PADDLE_TRAINERS_NUM") is None and \
-            jax.default_backend() == "tpu":
+    else:
+        e = env if env is not None else os.environ
         # no fluid env contract: fall back to the TPU runtime's own
-        # discovery (argless initialize reads the pod metadata; on a
-        # single host it degenerates to a 1-process job)
-        try:
-            jax.distributed.initialize()
-        except (RuntimeError, ValueError) as e:
-            if "already" not in str(e):
-                import warnings
-                warnings.warn(
-                    "jax.distributed.initialize() discovery failed "
-                    "(%s); continuing single-process" % (e,))
+        # discovery.  The pod check must NOT touch jax.default_backend()
+        # (that would initialize the backend before
+        # jax.distributed.initialize, which must run first), so key off
+        # the TPU VM runtime's env instead.
+        on_pod = e.get("PADDLE_TRAINERS_NUM") is None and (
+            "TPU_WORKER_HOSTNAMES" in e or "MEGASCALE_COORDINATOR_ADDRESS"
+            in e)
+        if on_pod:
+            try:
+                jax.distributed.initialize()
+            except (RuntimeError, ValueError) as err:
+                if "already" not in str(err):
+                    raise
     if mesh_axes:
         from . import mesh as mesh_mod
         mesh_mod.init_mesh(mesh_axes)

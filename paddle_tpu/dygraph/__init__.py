@@ -17,3 +17,7 @@ from . import optimizers
 from . import grad_clip
 from .grad_clip import GradClipByValue, GradClipByNorm, GradClipByGlobalNorm
 from .parallel import DataParallel, ParallelEnv, prepare_context
+from . import learning_rate_scheduler
+from .learning_rate_scheduler import (PiecewiseDecay, NaturalExpDecay,
+    ExponentialDecay, InverseTimeDecay, PolynomialDecay, CosineDecay,
+    NoamDecay, LinearLrWarmup)

@@ -55,6 +55,24 @@ def make_mesh(mesh_axes, devices=None):
     return Mesh(dev_array, tuple(mesh_axes.keys()))
 
 
+def _place_feed(v, sharding):
+    """Stage one feed onto the mesh.
+
+    Single-host: a plain sharded device_put.  Multi-host (jax.distributed
+    initialized, mesh spanning several processes): each host passes only
+    its LOCAL batch rows and the global array is assembled from the
+    process-local shards — the TPU-native replacement for the reference's
+    per-trainer reader splits (trainer_id/num_trainers slicing in
+    distribute_transpiler).  Batch-split feeds use the local-shard path;
+    replicated feeds (P()) must carry identical data on every host.
+    """
+    if jax.process_count() > 1 and sharding.spec and \
+            sharding.spec[0] is not None:
+        return jax.make_array_from_process_local_data(
+            sharding, np.asarray(v))
+    return jax.device_put(v, sharding)
+
+
 class CompiledProgram(object):
     """fluid.CompiledProgram work-alike.
 
@@ -159,7 +177,7 @@ class CompiledProgram(object):
                     else jax.device_put(v, s)
                     for v, s in zip(state_vals, state_sh))
                 placed_feed = tuple(
-                    jax.device_put(v, s)
+                    _place_feed(v, s)
                     for v, s in zip(feed_tuple, feed_sh))
                 out = jitted(placed_state, placed_feed)
                 if timeout_s is not None:

@@ -392,9 +392,10 @@ class Program(object):
     def clone(self, for_test=False):
         """Deep-copy the program.
 
-        ``for_test=True`` marks the clone as inference-mode: ops check the
-        ``is_test`` attr (dropout becomes identity, batch_norm uses the
-        moving statistics), matching reference Program.clone(for_test=True).
+        ``for_test=True`` matches reference Program.clone(for_test=True):
+        backward/optimize-role ops are dropped (running the clone must not
+        update parameters) and remaining ops get ``is_test=True`` (dropout
+        becomes identity, batch_norm uses the moving statistics).
         """
         p = Program.__new__(Program)
         p.blocks = []
@@ -409,6 +410,9 @@ class Program(object):
                 nv.block = nb
                 nb.vars[nv.name] = nv
             for op in blk.ops:
+                if for_test and op.attrs.get("op_role") in ("backward",
+                                                            "optimize"):
+                    continue
                 nop = Operator(nb, op.type, op.inputs, op.outputs,
                                copy.deepcopy(op.attrs), desc_id=op.desc_id)
                 if for_test and "is_test" in nop.attrs:

@@ -269,3 +269,100 @@ def test_trainer_stop():
     trainer.train(num_epochs=1, event_handler=handler, reader=reader,
                   feed_order=['x'])
     assert len(steps) == 3
+
+
+def test_trainer_test_does_not_mutate_params(tmp_path):
+    from paddle_tpu.contrib import Trainer
+
+    def train_func():
+        x = layers.data('x', [3], 'float32')
+        y = layers.data('y', [1], 'float32')
+        pred = layers.fc(x, size=1, param_attr=pt.ParamAttr(name='w_tm'))
+        return [layers.reduce_mean(layers.square_error_cost(pred, y))]
+
+    trainer = Trainer(train_func, lambda: optimizer.SGD(0.5))
+
+    def reader():
+        r = np.random.RandomState(3)
+        for _ in range(4):
+            xs = r.randn(8, 3).astype(np.float32)
+            yield list(zip(xs, (xs.sum(1, keepdims=True)).astype(
+                np.float32)))
+
+    with scope_guard_of(trainer):
+        before = np.asarray(trainer.scope.find_var('w_tm')).copy()
+    trainer.test(reader, feed_order=['x', 'y'])
+    with scope_guard_of(trainer):
+        after = np.asarray(trainer.scope.find_var('w_tm'))
+    np.testing.assert_array_equal(before, after)
+
+
+def scope_guard_of(trainer):
+    from paddle_tpu.framework.scope import scope_guard
+    return scope_guard(trainer.scope)
+
+
+def test_trainer_checkpoint_resume(tmp_path):
+    from paddle_tpu.contrib import Trainer
+    from paddle_tpu.contrib.trainer import CheckpointConfig
+
+    def train_func():
+        x = layers.data('x', [2], 'float32')
+        pred = layers.fc(x, size=1, param_attr=pt.ParamAttr(name='w_ck'))
+        return [layers.reduce_mean(pred)]
+
+    cfg = CheckpointConfig(checkpoint_dir=str(tmp_path / "ckpt"),
+                           step_interval=1)
+
+    def reader():
+        for _ in range(2):
+            yield [(np.ones(2, np.float32),)]
+
+    t1 = Trainer(train_func, lambda: optimizer.SGD(0.1),
+                 checkpoint_config=cfg)
+    t1.train(1, lambda e: None, reader=reader, feed_order=['x'])
+    with scope_guard_of(t1):
+        trained = np.asarray(t1.scope.find_var('w_ck')).copy()
+
+    cfg2 = CheckpointConfig(checkpoint_dir=str(tmp_path / "ckpt"),
+                            step_interval=1)
+    t2 = Trainer(train_func, lambda: optimizer.SGD(0.1),
+                 checkpoint_config=cfg2)
+    with scope_guard_of(t2):
+        resumed = np.asarray(t2.scope.find_var('w_ck'))
+    np.testing.assert_array_equal(trained, resumed)
+    assert cfg2.load_serial is not None
+
+
+def test_two_anonymous_beam_decoders_have_distinct_params():
+    im, ist = pt.Program(), pt.Program()
+    with pt.program_guard(im, ist):
+        src = layers.data('s', [2, H], 'float32', append_batch_size=False)
+        ii = layers.data('ii', [2, 1], 'int64', append_batch_size=False)
+        isc = layers.data('is', [2, 1], 'float32',
+                          append_batch_size=False)
+
+        def cell_for(tag):
+            c = StateCell({'x': None}, {'h': InitState(init=src)}, 'h')
+
+            @c.state_updater
+            def up(cc):
+                cc.set_state('h', layers.fc(
+                    layers.concat([cc.get_input('x'),
+                                   cc.get_state('h')], axis=-1),
+                    size=H, act='tanh',
+                    param_attr=pt.ParamAttr(name='cell_' + tag),
+                    bias_attr=pt.ParamAttr(name='cellb_' + tag)))
+            return c
+
+        d1 = BeamSearchDecoder(cell_for('a'), ii, isc, target_dict_dim=V,
+                               word_dim=D, max_len=2, beam_size=2,
+                               end_id=1)
+        d1.decode()
+        d2 = BeamSearchDecoder(cell_for('b'), ii, isc, target_dict_dim=V,
+                               word_dim=D, max_len=2, beam_size=2,
+                               end_id=1)
+        d2.decode()
+        emb_params = [p.name for p in im.global_block().all_parameters()
+                      if p.name.endswith('_emb_w')]
+    assert len(set(emb_params)) == 2

@@ -271,3 +271,36 @@ def test_basic_cells_dygraph():
         h2, c2 = lstm(x, h0, c0)
         assert np.asarray(h2._value).shape == (2, 4)
         assert np.isfinite(np.asarray(c2._value)).all()
+
+
+def test_evaluators():
+    from paddle_tpu.evaluator import (ChunkEvaluator, EditDistance,
+                                      DetectionMAP)
+    ce = ChunkEvaluator()
+    ce.update(10, 8, 6)
+    ce.update(5, 7, 4)
+    p, r, f1 = ce.eval()
+    assert abs(p - 10.0 / 15) < 1e-9 and abs(r - 10.0 / 15) < 1e-9
+    assert abs(f1 - 10.0 / 15) < 1e-9
+
+    ed = EditDistance()
+    ed.update(np.array([0.0, 2.0, 1.0]))
+    avg, err = ed.eval()
+    assert abs(avg - 1.0) < 1e-9 and abs(err - 2.0 / 3) < 1e-9
+
+    # perfect detector -> mAP 1; detector hitting nothing -> mAP 0
+    m = DetectionMAP(class_num=3)
+    gt = np.array([[0.1, 0.1, 0.4, 0.4], [0.5, 0.5, 0.9, 0.9]])
+    labels = np.array([1, 2])
+    m.update(np.array([[1, 0.9, 0.1, 0.1, 0.4, 0.4],
+                       [2, 0.8, 0.5, 0.5, 0.9, 0.9]]), gt, labels)
+    assert abs(m.eval() - 1.0) < 1e-9
+    m2 = DetectionMAP(class_num=3)
+    m2.update(np.array([[1, 0.9, 0.6, 0.6, 0.7, 0.7]]), gt, labels)
+    assert m2.eval() == 0.0
+    # duplicate detections of one gt: second is a false positive
+    m3 = DetectionMAP(class_num=3, ap_version='11point')
+    m3.update(np.array([[1, 0.9, 0.1, 0.1, 0.4, 0.4],
+                        [1, 0.8, 0.1, 0.1, 0.4, 0.4]]),
+              gt[:1], labels[:1])
+    assert 0.9 < m3.eval() <= 1.0
