@@ -24,6 +24,7 @@ from . import regularizer
 from . import clip
 from . import metrics
 from . import evaluator
+from . import utils
 from . import io
 from .io import (save_params, save_persistables, load_params,
                  load_persistables, save_inference_model,

@@ -69,7 +69,15 @@ def init_on_pod(mesh_axes=None, env=None):
                 jax.distributed.initialize()
             except (RuntimeError, ValueError) as err:
                 if "already" not in str(err):
-                    raise
+                    # single-host TPU VMs also set the pod env vars;
+                    # a failed discovery there should degrade to a
+                    # working 1-process job, loudly
+                    import warnings
+                    warnings.warn(
+                        "jax.distributed.initialize() discovery failed "
+                        "(%s); continuing as a single-process job — on "
+                        "a real pod set the PADDLE_TRAINER_* env "
+                        "contract instead" % (err,))
     if mesh_axes:
         from . import mesh as mesh_mod
         mesh_mod.init_mesh(mesh_axes)

@@ -169,10 +169,12 @@ class LinearLrWarmup(LearningRateDecay):
         self.end_lr = end_lr
 
     def step(self):
+        base = self.learning_rate
+        # a wrapped decay advances EVERY step — including warmup — so the
+        # post-warmup schedule resumes at the right step_num (reference
+        # calls base_lr() unconditionally each iteration)
+        inner = base() if isinstance(base, LearningRateDecay) else base
         if self.step_num < self.warmup_steps:
             return self.start_lr + (self.end_lr - self.start_lr) * \
                 (self.step_num / float(self.warmup_steps))
-        base = self.learning_rate
-        if isinstance(base, LearningRateDecay):
-            return base()
-        return base
+        return inner

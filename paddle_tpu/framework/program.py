@@ -410,8 +410,10 @@ class Program(object):
                 nv.block = nb
                 nb.vars[nv.name] = nv
             for op in blk.ops:
-                if for_test and op.attrs.get("op_role") in ("backward",
-                                                            "optimize"):
+                # lr_sched covers the step-counter increment: evaluating
+                # the clone must not advance the training LR schedule
+                if for_test and op.attrs.get("op_role") in (
+                        "backward", "optimize", "lr_sched"):
                     continue
                 nop = Operator(nb, op.type, op.inputs, op.outputs,
                                copy.deepcopy(op.attrs), desc_id=op.desc_id)

@@ -878,7 +878,7 @@ def autoincreased_step_counter(counter_name=None, begin=1, step=1):
     out = helper.create_variable_for_type_inference("int64", (1,))
     helper.append_op("increment", inputs={"X": [counter.name]},
                      outputs={"Out": [counter.name]},
-                     attrs={"step": float(step)})
+                     attrs={"step": float(step), "op_role": "lr_sched"})
     helper.append_op("assign", inputs={"X": [counter.name]},
                      outputs={"Out": [out.name]})
     counter.stop_gradient = True

@@ -1,0 +1,7 @@
+"""Utility helpers (ref python/paddle/utils/__init__.py): training-curve
+plotting + legacy v1 image preprocessing."""
+from . import plot
+from . import image_util
+from .plot import Ploter, PlotData
+
+__all__ = ["plot", "image_util", "Ploter", "PlotData"]

@@ -1,0 +1,338 @@
+"""Book-chapter end-to-end tests (ref python/paddle/fluid/tests/book/*):
+each chapter builds its model from the public API, trains on the
+paddle_tpu.dataset corpus until the loss/metric clears a bar, and where
+the chapter does inference, round-trips a saved model.  Shapes are
+scaled down so every chapter runs in seconds on the CPU mesh.
+"""
+import itertools
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers, optimizer, dataset
+from paddle_tpu.framework.scope import Scope, scope_guard
+
+
+def take_batches(reader, batch_size, n):
+    batched = pt.batch(reader, batch_size=batch_size)
+    return list(itertools.islice(batched(), n))
+
+
+def test_book_fit_a_line(tmp_path):
+    """ref book/test_fit_a_line.py: linear regression on uci_housing,
+    train -> save_inference_model -> load -> predict."""
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data('x', [13], 'float32')
+        y = layers.data('y', [1], 'float32')
+        pred = layers.fc(x, size=1)
+        loss = layers.reduce_mean(layers.square_error_cost(pred, y))
+        optimizer.SGD(0.01).minimize(loss)
+    scope = Scope()
+    with scope_guard(scope):
+        exe = pt.Executor()
+        exe.run(startup)
+        batches = take_batches(dataset.uci_housing.train(), 64, 7)
+        first = last = None
+        for _ in range(15):
+            for b in batches:
+                xs = np.stack([r[0] for r in b])
+                ys = np.stack([r[1] for r in b])
+                lv, = exe.run(main, feed={'x': xs, 'y': ys},
+                              fetch_list=[loss])
+                last = float(np.asarray(lv).reshape(-1)[0])
+                if first is None:
+                    first = last
+        assert last < first * 0.2
+        from paddle_tpu import io
+        d = str(tmp_path / "fit_a_line")
+        io.save_inference_model(d, ['x'], [pred], exe, main_program=main)
+        prog, feeds, fetches = io.load_inference_model(d, exe)
+        out, = exe.run(prog, feed={feeds[0]: xs[:4]}, fetch_list=fetches)
+        assert np.asarray(out).shape == (4, 1)
+
+
+def test_book_recognize_digits_conv():
+    """ref book/test_recognize_digits.py (conv variant): LeNet-ish CNN
+    reaches high train accuracy on synthetic mnist."""
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        img = layers.data('img', [1, 28, 28], 'float32')
+        label = layers.data('label', [1], 'int64')
+        from paddle_tpu import nets
+        conv_pool = nets.simple_img_conv_pool(
+            img, filter_size=5, num_filters=8, pool_size=2,
+            pool_stride=2, act="relu")
+        logits = layers.fc(conv_pool, size=10)
+        prob = layers.softmax(logits)
+        loss = layers.reduce_mean(
+            layers.cross_entropy(prob, label))
+        acc = layers.accuracy(prob, label)
+        optimizer.Adam(1e-3).minimize(loss)
+    scope = Scope()
+    with scope_guard(scope):
+        exe = pt.Executor()
+        exe.run(startup)
+        batches = take_batches(dataset.mnist.train(), 64, 6)
+        accv = 0.0
+        for _ in range(6):
+            for b in batches:
+                xs = np.stack([r[0] for r in b]).reshape(-1, 1, 28, 28)
+                ys = np.array([[r[1]] for r in b], np.int64)
+                _, av = exe.run(main, feed={'img': xs, 'label': ys},
+                                fetch_list=[loss, acc])
+                accv = float(np.asarray(av).reshape(-1)[0])
+    assert accv > 0.9
+
+
+def test_book_word2vec():
+    """ref book/test_word2vec.py: N-gram LM on imikolov; perplexity
+    (exp of loss) must drop well below vocab-uniform."""
+    word_dict = dataset.imikolov.build_dict(min_word_freq=2)
+    dict_size = len(word_dict)
+    N, EMB = 5, 16
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        words = [layers.data('w%d' % i, [1], 'int64') for i in range(N)]
+        embs = [layers.embedding(
+            w, size=[dict_size, EMB],
+            param_attr=pt.ParamAttr(name='shared_emb'))
+            for w in words[:-1]]
+        concat = layers.concat([layers.reshape(e, [-1, EMB])
+                                for e in embs], axis=1)
+        hidden = layers.fc(concat, size=64, act='sigmoid')
+        prob = layers.fc(hidden, size=dict_size, act='softmax')
+        loss = layers.reduce_mean(
+            layers.cross_entropy(prob, words[-1]))
+        optimizer.Adam(5e-3).minimize(loss)
+    data = take_batches(dataset.imikolov.train(word_dict, N), 64, 8)
+    scope = Scope()
+    with scope_guard(scope):
+        exe = pt.Executor()
+        exe.run(startup)
+        first = last = None
+        for _ in range(10):
+            for b in data:
+                cols = list(zip(*b))
+                feed = {'w%d' % i: np.array(cols[i],
+                                            np.int64).reshape(-1, 1)
+                        for i in range(N)}
+                lv, = exe.run(main, feed=feed, fetch_list=[loss])
+                last = float(np.asarray(lv).reshape(-1)[0])
+                if first is None:
+                    first = last
+    assert last < first - 0.5  # > 0.5 nat improvement over init
+
+
+def test_book_understand_sentiment_conv():
+    """ref book/notest_understand_sentiment.py (conv net variant): text
+    CNN separates the synthetic polarity corpus."""
+    word_dict = dataset.imdb.word_dict()
+    T = 60
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        ids = layers.data('ids', [T], 'int64')
+        label = layers.data('label', [1], 'int64')
+        emb = layers.embedding(ids, size=[len(word_dict), 16])
+        from paddle_tpu import nets
+        conv3 = nets.sequence_conv_pool(emb, num_filters=16,
+                                        filter_size=3, act="tanh",
+                                        pool_type="max")
+        prob = layers.fc(conv3, size=2, act="softmax")
+        loss = layers.reduce_mean(layers.cross_entropy(prob, label))
+        acc = layers.accuracy(prob, label)
+        optimizer.Adam(2e-3).minimize(loss)
+    scope = Scope()
+    with scope_guard(scope):
+        exe = pt.Executor()
+        exe.run(startup)
+        raw = list(itertools.islice(
+            dataset.imdb.train(word_dict)(), 256))
+        ids_arr = np.zeros((256, T), np.int64)
+        for i, (seq, _) in enumerate(raw):
+            n = min(len(seq), T)
+            ids_arr[i, :n] = seq[:n]
+        labels = np.array([[l] for _, l in raw], np.int64)
+        accv = 0.0
+        for _ in range(12):
+            for s in range(0, 256, 64):
+                _, av = exe.run(
+                    main, feed={'ids': ids_arr[s:s + 64],
+                                'label': labels[s:s + 64]},
+                    fetch_list=[loss, acc])
+                accv = float(np.asarray(av).reshape(-1)[0])
+    assert accv > 0.85
+
+
+def test_book_recommender_system():
+    """ref book/test_recommender_system.py: dual-tower user/movie
+    factorization on movielens, cos_sim scoring, MSE drops."""
+    mlens = dataset.movielens
+    usr_count = mlens.max_user_id() + 1
+    mov_count = mlens.max_movie_id() + 1
+    job_count = mlens.max_job_id() + 1
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        uid = layers.data('uid', [1], 'int64')
+        gender = layers.data('gender', [1], 'int64')
+        age = layers.data('age', [1], 'int64')
+        job = layers.data('job', [1], 'int64')
+        mid = layers.data('mid', [1], 'int64')
+        score = layers.data('score', [1], 'float32')
+        usr_feats = []
+        for var, size in ((uid, usr_count), (gender, 2),
+                          (age, len(mlens.age_table)), (job, job_count)):
+            e = layers.embedding(var, size=[size, 16])
+            usr_feats.append(layers.reshape(e, [-1, 16]))
+        usr = layers.fc(layers.concat(usr_feats, axis=1), size=32,
+                        act="relu")
+        mov_e = layers.reshape(layers.embedding(mid, [mov_count, 16]),
+                               [-1, 16])
+        mov = layers.fc(mov_e, size=32, act="relu")
+        sim = layers.cos_sim(usr, mov)
+        pred = layers.scale(sim, scale=5.0)
+        loss = layers.reduce_mean(layers.square_error_cost(pred, score))
+        optimizer.Adam(5e-3).minimize(loss)
+    rows = list(itertools.islice(mlens.train(), 512))
+    feed = {
+        'uid': np.array([[r[0]] for r in rows], np.int64),
+        'gender': np.array([[r[1]] for r in rows], np.int64),
+        'age': np.array([[r[2]] for r in rows], np.int64),
+        'job': np.array([[r[3]] for r in rows], np.int64),
+        'mid': np.array([[r[4]] for r in rows], np.int64),
+        'score': np.array([r[7] for r in rows],
+                          np.float32).reshape(-1, 1),
+    }
+    scope = Scope()
+    with scope_guard(scope):
+        exe = pt.Executor()
+        exe.run(startup)
+        first = last = None
+        for _ in range(60):
+            lv, = exe.run(main, feed=feed, fetch_list=[loss])
+            last = float(np.asarray(lv).reshape(-1)[0])
+            if first is None:
+                first = last
+    assert last < first * 0.6
+
+
+def test_book_label_semantic_roles():
+    """ref book/test_label_semantic_roles.py: the conll05 SRL schema
+    flows through embedding+CRF training; loss decreases."""
+    word_dict, verb_dict, label_dict = dataset.conll05.get_dict()
+    T = 30
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        word = layers.data('word', [T], 'int64')
+        pred_v = layers.data('verb', [T], 'int64')
+        mark = layers.data('mark', [T], 'int64')
+        target = layers.data('target', [T], 'int64')
+        length = layers.data('length', [1], 'int64')
+        we = layers.embedding(word, size=[len(word_dict), 16])
+        ve = layers.embedding(pred_v, size=[len(verb_dict), 16])
+        me = layers.embedding(mark, size=[2, 8])
+        feat = layers.concat([we, ve, me], axis=2)
+        hidden = layers.fc(feat, size=32, act="tanh", num_flatten_dims=2)
+        emission = layers.fc(hidden, size=len(label_dict),
+                             num_flatten_dims=2)
+        ll = layers.linear_chain_crf(
+            emission, target, param_attr=pt.ParamAttr(name='crf_srl'),
+            length=layers.reshape(length, [-1]))
+        loss = layers.reduce_mean(layers.scale(ll, scale=-1.0))
+        optimizer.Adam(5e-3).minimize(loss)
+    samples = list(itertools.islice(dataset.conll05.test()(), 64))
+    n = len(samples)
+    feed = {k: np.zeros((n, T), np.int64)
+            for k in ('word', 'verb', 'mark', 'target')}
+    feed['length'] = np.zeros((n, 1), np.int64)
+    for i, s in enumerate(samples):
+        L = min(len(s[0]), T)
+        feed['word'][i, :L] = s[0][:L]
+        feed['verb'][i, :L] = s[6][:L]
+        feed['mark'][i, :L] = s[7][:L]
+        feed['target'][i, :L] = s[8][:L]
+        feed['length'][i, 0] = L
+    scope = Scope()
+    with scope_guard(scope):
+        exe = pt.Executor()
+        exe.run(startup)
+        first = last = None
+        for _ in range(30):
+            lv, = exe.run(main, feed=feed, fetch_list=[loss])
+            last = float(np.asarray(lv).reshape(-1)[0])
+            if first is None:
+                first = last
+    assert last < first * 0.8
+
+
+def test_book_machine_translation_data_flow():
+    """ref book/test_machine_translation.py: wmt14 triplets drive a
+    seq2seq train step (embedding + GRU encoder/decoder, CE loss)."""
+    DICT = 80
+    T = 16
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        src = layers.data('src', [T], 'int64')
+        trg = layers.data('trg', [T], 'int64')
+        nxt = layers.data('nxt', [T], 'int64')
+        semb = layers.embedding(src, size=[DICT, 16])
+        from paddle_tpu.contrib.layers import basic_gru
+        enc_out, enc_h = basic_gru(semb, None, hidden_size=24)
+        temb = layers.embedding(trg, size=[DICT, 16])
+        dec_out, _ = basic_gru(temb, enc_h, hidden_size=24)
+        logits = layers.fc(dec_out, size=DICT, num_flatten_dims=2)
+        loss = layers.reduce_mean(layers.softmax_with_cross_entropy(
+            logits, layers.unsqueeze(nxt, [2])))
+        optimizer.Adam(5e-3).minimize(loss)
+    rows = list(itertools.islice(dataset.wmt14.train(DICT)(), 128))
+    n = len(rows)
+    feed = {k: np.zeros((n, T), np.int64) for k in ('src', 'trg', 'nxt')}
+    for i, (s, t, tn) in enumerate(rows):
+        feed['src'][i, :min(len(s), T)] = s[:T]
+        feed['trg'][i, :min(len(t), T)] = t[:T]
+        feed['nxt'][i, :min(len(tn), T)] = tn[:T]
+    scope = Scope()
+    with scope_guard(scope):
+        exe = pt.Executor()
+        exe.run(startup)
+        first = last = None
+        for _ in range(25):
+            lv, = exe.run(main, feed=feed, fetch_list=[loss])
+            last = float(np.asarray(lv).reshape(-1)[0])
+            if first is None:
+                first = last
+    assert last < first * 0.7
+
+
+def test_utils_plot_and_image(capsys, tmp_path):
+    """ref python/paddle/utils/{plot,image_util}.py."""
+    from paddle_tpu.utils import Ploter
+    from paddle_tpu.utils import image_util
+    p = Ploter("train", "test")
+    p.append("train", 0, 1.0)
+    p.append("train", 1, 0.5)
+    p.append("test", 0, 1.1)
+    assert p.data["train"].value == [1.0, 0.5]
+    assert "train - step 1: 0.5" in capsys.readouterr().out
+    p.plot(str(tmp_path / "c.png"))  # matplotlib-or-noop
+    p.reset()
+    assert p.data["train"].value == []
+
+    im = np.random.RandomState(0).randint(
+        0, 255, (40, 50, 3)).astype(np.uint8)
+    r = image_util.resize_image(im, 32)
+    assert min(r.shape[:2]) == 32
+    f = image_util.flip(im)
+    np.testing.assert_array_equal(f[:, ::-1, :], im)
+    c = image_util.crop_img(r, 24, test=True)
+    assert c.shape[:2] == (24, 24)
+    v = image_util.preprocess_img(r, [1.0, 2.0, 3.0], 24, is_train=False)
+    assert v.shape == (3 * 24 * 24,)
+    o = image_util.oversample(im, (32, 32))
+    assert o.shape == (10, 32, 32, 3)
+    t = image_util.ImageTransformer(transpose=(2, 0, 1),
+                                    channel_swap=(2, 1, 0),
+                                    mean=[1, 2, 3])
+    out = t.transformer(im)
+    assert out.shape == (3, 40, 50)

@@ -366,3 +366,48 @@ def test_two_anonymous_beam_decoders_have_distinct_params():
         emb_params = [p.name for p in im.global_block().all_parameters()
                       if p.name.endswith('_emb_w')]
     assert len(set(emb_params)) == 2
+
+
+def test_trainer_test_does_not_advance_lr_counter():
+    """clone(for_test=True) must drop the lr_sched counter increment:
+    evaluating cannot decay the training LR (review regression)."""
+    from paddle_tpu.contrib import Trainer
+
+    def train_func():
+        x = layers.data('x', [2], 'float32')
+        pred = layers.fc(x, size=1)
+        return [layers.reduce_mean(pred)]
+
+    def optimizer_func():
+        from paddle_tpu.layers import learning_rate_scheduler as lrs
+        return optimizer.SGD(lrs.exponential_decay(0.1, 1, 0.5, True))
+
+    trainer = Trainer(train_func, optimizer_func)
+
+    def reader():
+        for _ in range(2):
+            yield [(np.ones(2, np.float32),)]
+
+    with scope_guard_of(trainer):
+        sc = trainer.scope
+        counters_before = {n: np.asarray(sc.find_var(n)).copy()
+                           for n in list(sc.keys() if hasattr(sc, 'keys')
+                                         else [])
+                           if 'COUNTER' in n.upper()}
+    trainer.test(reader, feed_order=['x'])
+    with scope_guard_of(trainer):
+        for n, v in counters_before.items():
+            np.testing.assert_array_equal(
+                np.asarray(trainer.scope.find_var(n)), v)
+
+
+def test_linear_warmup_advances_inner_schedule():
+    from paddle_tpu.dygraph import LinearLrWarmup, ExponentialDecay
+    inner = ExponentialDecay(0.1, decay_steps=1, decay_rate=0.5)
+    lw = LinearLrWarmup(inner, warmup_steps=4, start_lr=0.0, end_lr=0.1,
+                        begin=0)
+    for _ in range(4):
+        lw()
+    post = lw()   # first post-warmup value
+    # inner advanced during warmup: 0.1 * 0.5^4, not undecayed 0.1
+    assert abs(post - 0.1 * 0.5 ** 4) < 1e-9
