@@ -65,13 +65,23 @@ def init_on_pod(mesh_axes=None, env=None):
             "TPU_WORKER_HOSTNAMES" in e or "MEGASCALE_COORDINATOR_ADDRESS"
             in e)
         if on_pod:
+            hosts = [h for h in e.get("TPU_WORKER_HOSTNAMES",
+                                      "").split(",") if h]
+            multi_host = len(hosts) > 1 or \
+                "MEGASCALE_COORDINATOR_ADDRESS" in e
             try:
                 jax.distributed.initialize()
             except (RuntimeError, ValueError) as err:
-                if "already" not in str(err):
-                    # single-host TPU VMs also set the pod env vars;
-                    # a failed discovery there should degrade to a
-                    # working 1-process job, loudly
+                if "already" in str(err):
+                    pass
+                elif multi_host:
+                    # a genuine pod MUST form the job — N silent
+                    # single-process copies would train garbage
+                    raise
+                else:
+                    # single-host TPU VMs also set the pod env vars; a
+                    # failed discovery there degrades to a working
+                    # 1-process job, loudly
                     import warnings
                     warnings.warn(
                         "jax.distributed.initialize() discovery failed "

@@ -10,6 +10,9 @@ from .base import guard, enabled, to_variable, no_grad, enable_dygraph, \
 from .layers import Layer
 from .container import Sequential, LayerList, ParameterList
 from .nn import (Linear, Conv2D, BatchNorm, Embedding, LayerNorm, Dropout,
+                 FC, Conv2DTranspose, Conv3D, Conv3DTranspose, GroupNorm,
+                 SpectralNorm, PRelu, NCE, BilinearTensorProduct, RowConv,
+                 SequenceConv, TreeConv,
                  Pool2D, GRUUnit)
 from .checkpoint import save_dygraph, load_dygraph
 from .jit import TracedLayer, dygraph_to_static_graph

@@ -279,3 +279,417 @@ class GRUUnit(Layer):
                        "Weight": [self.weight], "Bias": [self.bias]},
                       self._attrs)
         return outs["Hidden"], outs["ResetHiddenPrev"], outs["Gate"]
+
+
+class FC(Layer):
+    """Multi-dim fc (ref dygraph/nn.py:960): flattens input from
+    num_flatten_dims on, like the static fc."""
+
+    def __init__(self, name_scope, size, num_flatten_dims=1,
+                 param_attr=None, bias_attr=None, act=None,
+                 dtype="float32"):
+        super(FC, self).__init__(dtype=dtype)
+        self._size = size
+        self._nfd = num_flatten_dims
+        self._param_attr = param_attr
+        self._bias_attr = bias_attr
+        self._act = act
+        self._built = False
+
+    def _build_once(self, shape):
+        d = int(np.prod(shape[self._nfd:]))
+        self.weight = self.add_parameter(
+            "weight", self.create_parameter([d, self._size],
+                                            attr=self._param_attr))
+        self.bias = self.add_parameter(
+            "bias", self.create_parameter([self._size], is_bias=True,
+                                          attr=self._bias_attr))
+        self._built = True
+
+    def forward(self, input):
+        shp = input.shape() if callable(getattr(input, "shape", None)) \
+            else input.shape
+        if not self._built:
+            self._build_once(tuple(shp))
+        nfd = self._nfd
+
+        def fc(x, w, b):
+            lead = x.shape[:nfd]
+            flat = x.reshape(lead + (-1,))
+            return jnp.matmul(flat, w) + b
+
+        out = apply_eager(fc, input, self.weight, self.bias)
+        if self._act:
+            out = run_op(self._act, {"X": [out]})["Out"]
+        return out
+
+
+class Conv2DTranspose(Layer):
+    """ref dygraph/nn.py:2282 — transposed conv via the graph kernel."""
+
+    def __init__(self, num_channels, num_filters, filter_size, stride=1,
+                 padding=0, dilation=1, groups=1, param_attr=None,
+                 bias_attr=None, act=None, dtype="float32"):
+        super(Conv2DTranspose, self).__init__(dtype=dtype)
+        fs = [filter_size] * 2 if isinstance(filter_size, int) \
+            else list(filter_size)
+        w = np.random.normal(
+            0, 0.02, [num_channels, num_filters // groups] + fs
+        ).astype(np.float32)
+        self.weight = self.add_parameter("weight", EagerVariable(w))
+        self.bias = self.add_parameter(
+            "bias", self.create_parameter([num_filters], is_bias=True))
+        self._attrs = {
+            "strides": [stride] * 2 if isinstance(stride, int)
+            else list(stride),
+            "paddings": [padding] * 2 if isinstance(padding, int)
+            else list(padding),
+            "dilations": [dilation] * 2 if isinstance(dilation, int)
+            else list(dilation),
+            "groups": groups}
+        self._act = act
+
+    def forward(self, input):
+        out = run_op("conv2d_transpose",
+                     {"Input": [input], "Filter": [self.weight]},
+                     self._attrs)["Output"]
+        out = apply_eager(lambda o, b: o + b.reshape(1, -1, 1, 1),
+                          out, self.bias)
+        if self._act:
+            out = run_op(self._act, {"X": [out]})["Out"]
+        return out
+
+
+class Conv3D(Layer):
+    """ref dygraph/nn.py:273."""
+
+    def __init__(self, num_channels, num_filters, filter_size, stride=1,
+                 padding=0, dilation=1, groups=1, param_attr=None,
+                 bias_attr=None, act=None, dtype="float32"):
+        super(Conv3D, self).__init__(dtype=dtype)
+        fs = [filter_size] * 3 if isinstance(filter_size, int) \
+            else list(filter_size)
+        w = np.random.normal(
+            0, 0.02, [num_filters, num_channels // groups] + fs
+        ).astype(np.float32)
+        self.weight = self.add_parameter("weight", EagerVariable(w))
+        self.bias = self.add_parameter(
+            "bias", self.create_parameter([num_filters], is_bias=True))
+        self._attrs = {
+            "strides": [stride] * 3 if isinstance(stride, int)
+            else list(stride),
+            "paddings": [padding] * 3 if isinstance(padding, int)
+            else list(padding),
+            "dilations": [dilation] * 3 if isinstance(dilation, int)
+            else list(dilation),
+            "groups": groups}
+        self._act = act
+
+    def forward(self, input):
+        out = run_op("conv3d",
+                     {"Input": [input], "Filter": [self.weight]},
+                     self._attrs)["Output"]
+        out = apply_eager(lambda o, b: o + b.reshape(1, -1, 1, 1, 1),
+                          out, self.bias)
+        if self._act:
+            out = run_op(self._act, {"X": [out]})["Out"]
+        return out
+
+
+class Conv3DTranspose(Layer):
+    """ref dygraph/nn.py:475."""
+
+    def __init__(self, num_channels, num_filters, filter_size, stride=1,
+                 padding=0, dilation=1, groups=1, param_attr=None,
+                 bias_attr=None, act=None, dtype="float32"):
+        super(Conv3DTranspose, self).__init__(dtype=dtype)
+        fs = [filter_size] * 3 if isinstance(filter_size, int) \
+            else list(filter_size)
+        w = np.random.normal(
+            0, 0.02, [num_channels, num_filters // groups] + fs
+        ).astype(np.float32)
+        self.weight = self.add_parameter("weight", EagerVariable(w))
+        self.bias = self.add_parameter(
+            "bias", self.create_parameter([num_filters], is_bias=True))
+        self._attrs = {
+            "strides": [stride] * 3 if isinstance(stride, int)
+            else list(stride),
+            "paddings": [padding] * 3 if isinstance(padding, int)
+            else list(padding),
+            "dilations": [dilation] * 3 if isinstance(dilation, int)
+            else list(dilation),
+            "groups": groups}
+        self._act = act
+
+    def forward(self, input):
+        out = run_op("conv3d_transpose",
+                     {"Input": [input], "Filter": [self.weight]},
+                     self._attrs)["Output"]
+        out = apply_eager(lambda o, b: o + b.reshape(1, -1, 1, 1, 1),
+                          out, self.bias)
+        if self._act:
+            out = run_op(self._act, {"X": [out]})["Out"]
+        return out
+
+
+class GroupNorm(Layer):
+    """ref dygraph/nn.py:2672."""
+
+    def __init__(self, channels, groups, epsilon=1e-5, param_attr=None,
+                 bias_attr=None, act=None, dtype="float32"):
+        super(GroupNorm, self).__init__(dtype=dtype)
+        self.weight = self.add_parameter(
+            "weight", EagerVariable(np.ones(channels, np.float32)))
+        self.bias = self.add_parameter(
+            "bias", EagerVariable(np.zeros(channels, np.float32)))
+        self._attrs = {"groups": groups, "epsilon": epsilon}
+        self._act = act
+
+    def forward(self, input):
+        out = run_op("group_norm",
+                     {"X": [input], "Scale": [self.weight],
+                      "Bias": [self.bias]}, self._attrs)["Y"]
+        if self._act:
+            out = run_op(self._act, {"X": [out]})["Out"]
+        return out
+
+
+class SpectralNorm(Layer):
+    """ref dygraph/nn.py:2772 — power-iteration U/V kept as buffers."""
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12,
+                 dtype="float32"):
+        super(SpectralNorm, self).__init__(dtype=dtype)
+        h = weight_shape[dim]
+        w = int(np.prod(weight_shape)) // h
+        self._u = EagerVariable(
+            np.random.normal(0, 1, h).astype(np.float32))
+        self._v = EagerVariable(
+            np.random.normal(0, 1, w).astype(np.float32))
+        self._attrs = {"dim": dim, "power_iters": power_iters, "eps": eps}
+
+    def forward(self, weight):
+        outs = run_op("spectral_norm",
+                      {"Weight": [weight], "U": [self._u],
+                       "V": [self._v]}, self._attrs)
+        # persist the power-iteration state so sigma converges across
+        # calls (the static path writes UOut/VOut back the same way)
+        self._u._value = outs["UOut"]._value \
+            if hasattr(outs["UOut"], "_value") else outs["UOut"]
+        self._v._value = outs["VOut"]._value \
+            if hasattr(outs["VOut"], "_value") else outs["VOut"]
+        return outs["Out"]
+
+
+class PRelu(Layer):
+    """ref dygraph/nn.py:2092 — mode in all/channel/element."""
+
+    def __init__(self, mode, input_shape=None, param_attr=None,
+                 dtype="float32"):
+        super(PRelu, self).__init__(dtype=dtype)
+        self._mode = mode
+        if mode == "all":
+            shape = [1]
+        elif mode == "channel":
+            assert input_shape is not None, \
+                "channel mode needs input_shape"
+            shape = [input_shape[1] if len(input_shape) > 1
+                     else input_shape[0]]
+        elif mode == "element":
+            assert input_shape is not None, \
+                "element mode needs input_shape"
+            shape = list(input_shape[1:])
+        else:
+            raise ValueError("mode must be all/channel/element")
+        self.weight = self.add_parameter(
+            "weight",
+            EagerVariable(np.full(shape, 0.25, np.float32)))
+        self._shape = shape
+
+    def forward(self, input):
+        mode = self._mode
+
+        def prelu(x, a):
+            if mode == "channel":
+                a = a.reshape((1, -1) + (1,) * (x.ndim - 2))
+            elif mode == "element":
+                a = a.reshape((1,) + a.shape)
+            return jnp.where(x > 0, x, a * x)
+
+        return apply_eager(prelu, input, self.weight)
+
+
+class NCE(Layer):
+    """ref dygraph/nn.py:1858 — NCE loss head over (input, label)."""
+
+    def __init__(self, num_total_classes, dim, sample_weight=None,
+                 param_attr=None, bias_attr=None, num_neg_samples=10,
+                 sampler="uniform", custom_dist=None, seed=0,
+                 is_sparse=False, dtype="float32"):
+        super(NCE, self).__init__(dtype=dtype)
+        if custom_dist is not None or sampler == "custom_dist":
+            raise NotImplementedError(
+                "NCE custom_dist sampling is not implemented; supported "
+                "samplers: uniform, log_uniform")
+        if sample_weight is not None:
+            raise NotImplementedError(
+                "NCE sample_weight is not implemented")
+        self.weight = self.add_parameter(
+            "weight", self.create_parameter([num_total_classes, dim]))
+        self.bias = self.add_parameter(
+            "bias", self.create_parameter([num_total_classes],
+                                          is_bias=True))
+        self._attrs = {"num_total_classes": num_total_classes,
+                       "num_neg_samples": num_neg_samples,
+                       "sampler": sampler}
+
+    def forward(self, input, label, sample_weight=None):
+        if sample_weight is not None:
+            raise NotImplementedError(
+                "NCE sample_weight is not implemented")
+        return run_op("nce",
+                      {"Input": [input], "Label": [label],
+                       "Weight": [self.weight], "Bias": [self.bias]},
+                      self._attrs)["Cost"]
+
+
+class BilinearTensorProduct(Layer):
+    """ref dygraph/nn.py:2174: out_i = x W_i y^T."""
+
+    def __init__(self, input1_dim, input2_dim, output_dim,
+                 param_attr=None, bias_attr=None, act=None,
+                 dtype="float32"):
+        super(BilinearTensorProduct, self).__init__(dtype=dtype)
+        self.weight = self.add_parameter(
+            "weight", self.create_parameter(
+                [output_dim, input1_dim, input2_dim]))
+        self.bias = self.add_parameter(
+            "bias", self.create_parameter([output_dim], is_bias=True))
+        self._act = act
+
+    def forward(self, x, y):
+        out = run_op("bilinear_tensor_product",
+                     {"X": [x], "Y": [y], "Weight": [self.weight],
+                      "Bias": [self.bias]})["Out"]
+        if self._act:
+            out = run_op(self._act, {"X": [out]})["Out"]
+        return out
+
+
+class RowConv(Layer):
+    """ref dygraph/nn.py:2593 — lookahead conv on (B, T, D)."""
+
+    def __init__(self, name_scope, future_context_size, param_attr=None,
+                 act=None, dtype="float32"):
+        super(RowConv, self).__init__(dtype=dtype)
+        self._k = future_context_size
+        self._act = act
+        self._built = False
+
+    def _build_once(self, d):
+        self.weight = self.add_parameter(
+            "weight", self.create_parameter([self._k + 1, d]))
+        self._built = True
+
+    def forward(self, input):
+        if not self._built:
+            shp = input.shape() if callable(getattr(input, "shape", None))\
+                else input.shape
+            self._build_once(shp[-1])
+        out = run_op("row_conv",
+                     {"X": [input], "Filter": [self.weight]})["Out"]
+        if self._act:
+            out = run_op(self._act, {"X": [out]})["Out"]
+        return out
+
+
+class SequenceConv(Layer):
+    """ref dygraph/nn.py:2499 — centered context-window conv over time:
+    im2col the +-window then one matmul (dense (B, T, D) batches)."""
+
+    def __init__(self, name_scope, num_filters, filter_size=3,
+                 filter_stride=1, padding=True, bias_attr=None,
+                 param_attr=None, act=None, dtype="float32"):
+        super(SequenceConv, self).__init__(dtype=dtype)
+        assert filter_stride == 1, "reference enforces stride 1"
+        self._num_filters = num_filters
+        self._filter_size = filter_size
+        self._act = act
+        self._built = False
+
+    def _build_once(self, d):
+        self.weight = self.add_parameter(
+            "weight",
+            self.create_parameter([self._filter_size * d,
+                                   self._num_filters]))
+        self.bias = self.add_parameter(
+            "bias", self.create_parameter([self._num_filters],
+                                          is_bias=True))
+        self._built = True
+
+    def forward(self, input):
+        if not self._built:
+            shp = input.shape() if callable(getattr(input, "shape", None))\
+                else input.shape
+            self._build_once(shp[-1])
+        fs = self._filter_size
+        start = -((fs - 1) // 2)
+
+        def seq_conv(x, w, b):
+            bsz, t, d = x.shape
+            cols = []
+            for k in range(fs):
+                off = start + k
+                if off < 0:
+                    sl = jnp.concatenate(
+                        [jnp.zeros((bsz, -off, d), x.dtype),
+                         x[:, :t + off]], axis=1)
+                elif off > 0:
+                    sl = jnp.concatenate(
+                        [x[:, off:], jnp.zeros((bsz, off, d), x.dtype)],
+                        axis=1)
+                else:
+                    sl = x
+                cols.append(sl)
+            windows = jnp.concatenate(cols, axis=2)   # (B, T, fs*D)
+            return jnp.matmul(windows, w) + b
+
+        out = apply_eager(seq_conv, input, self.weight, self.bias)
+        if self._act:
+            out = run_op(self._act, {"X": [out]})["Out"]
+        return out
+
+
+class TreeConv(Layer):
+    """ref dygraph/nn.py:2877 — TBCNN over (nodes, edge_set)."""
+
+    def __init__(self, name_scope, output_size, num_filters=1,
+                 max_depth=2, act="tanh", param_attr=None,
+                 bias_attr=None, dtype="float32"):
+        super(TreeConv, self).__init__(dtype=dtype)
+        self._output_size = output_size
+        self._num_filters = num_filters
+        self._max_depth = max_depth
+        self._act = act
+        self._built = False
+
+    def _build_once(self, f):
+        self.weight = self.add_parameter(
+            "weight", self.create_parameter(
+                [f, 3, self._output_size, self._num_filters]))
+        self._built = True
+
+    def forward(self, nodes_vector, edge_set):
+        if not self._built:
+            shp = nodes_vector.shape() if callable(
+                getattr(nodes_vector, "shape", None)) \
+                else nodes_vector.shape
+            self._build_once(shp[-1])
+        out = run_op("tree_conv",
+                     {"NodesVector": [nodes_vector],
+                      "EdgeSet": [edge_set],
+                      "Filter": [self.weight]},
+                     {"max_depth": self._max_depth})["Out"]
+        if self._act:
+            out = run_op(self._act, {"X": [out]})["Out"]
+        return out

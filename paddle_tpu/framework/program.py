@@ -128,6 +128,19 @@ class Variable(object):
         from ..layers import nn
         return self._binary(other, nn.elementwise_pow)
 
+    def __floordiv__(self, other):
+        from ..layers import nn
+        return self._binary(other, nn.elementwise_floordiv)
+
+    def __mod__(self, other):
+        from ..layers import nn
+        return self._binary(other, nn.elementwise_mod)
+
+    def astype(self, dtype):
+        """Graph-level cast (reference math_op_patch astype)."""
+        from ..layers import nn
+        return nn.cast(self, dtype)
+
     def __neg__(self):
         from ..layers import nn
         return self.__mul__(-1.0)

@@ -43,8 +43,12 @@ def preprocess_img(im, img_mean, crop_size, is_train, color=True):
     im = _img.to_chw(im).astype("float32") if im.ndim == 3 \
         else im.astype("float32")
     if img_mean is not None:
-        im = im - np.asarray(img_mean, np.float32).reshape(im.shape[0],
-                                                           1, 1)
+        mean = np.asarray(img_mean, np.float32)
+        if im.ndim == 3:
+            im = im - mean.reshape(im.shape[0], 1, 1)
+        else:
+            # grayscale HxW: only a scalar mean is meaningful
+            im = im - np.float32(mean.reshape(-1)[0])
     return im.flatten()
 
 

@@ -301,3 +301,77 @@ def test_dygraph_lr_schedulers():
         after = np.asarray(lin.weight._value)
         assert not np.allclose(before, after)
         assert sched.step_num >= 3
+
+
+def test_dygraph_module_tail():
+    """New dygraph modules (ref dygraph/nn.py: FC, Conv2DTranspose,
+    Conv3D(+T), GroupNorm, SpectralNorm, PRelu, NCE, Bilinear, RowConv,
+    SequenceConv, TreeConv): forward shapes + grads flow."""
+    from paddle_tpu import dygraph
+    rng = np.random.RandomState(0)
+    with dygraph.guard():
+        x4 = dygraph.to_variable(rng.randn(2, 3, 8, 8).astype(np.float32))
+        ct = dygraph.Conv2DTranspose(3, 5, 3)
+        o = ct(x4)
+        assert np.asarray(o._value).shape == (2, 5, 10, 10)
+
+        x5 = dygraph.to_variable(
+            rng.randn(2, 3, 4, 6, 6).astype(np.float32))
+        c3 = dygraph.Conv3D(3, 4, 3, padding=1)
+        o3 = c3(x5)
+        assert np.asarray(o3._value).shape == (2, 4, 4, 6, 6)
+        c3t = dygraph.Conv3DTranspose(3, 4, 2, stride=2)
+        o3t = c3t(x5)
+        assert np.asarray(o3t._value).shape == (2, 4, 8, 12, 12)
+
+        gn = dygraph.GroupNorm(channels=4, groups=2)
+        go = gn(o3.detach() if hasattr(o3, "detach") else o3)
+        g = np.asarray(go._value)
+        assert abs(g.mean()) < 1e-4  # normalized
+
+        fcm = dygraph.FC("fc", size=7, num_flatten_dims=2)
+        xf = dygraph.to_variable(rng.randn(2, 3, 4, 5).astype(np.float32))
+        fo = fcm(xf)
+        assert np.asarray(fo._value).shape == (2, 3, 7)
+
+        pr = dygraph.PRelu("channel", input_shape=[2, 3, 8, 8])
+        po = np.asarray(pr(x4)._value)
+        xv = np.asarray(x4._value)
+        np.testing.assert_allclose(po[xv > 0], xv[xv > 0], rtol=1e-6)
+        np.testing.assert_allclose(po[xv < 0], 0.25 * xv[xv < 0],
+                                   rtol=1e-5)
+
+        w = dygraph.to_variable(rng.randn(6, 4).astype(np.float32))
+        sn = dygraph.SpectralNorm([6, 4], power_iters=5)
+        wn = np.asarray(sn(w)._value)
+        assert np.linalg.svd(wn, compute_uv=False)[0] < 1.6
+
+        x1 = dygraph.to_variable(rng.randn(3, 4).astype(np.float32))
+        y1 = dygraph.to_variable(rng.randn(3, 5).astype(np.float32))
+        bl = dygraph.BilinearTensorProduct(4, 5, 6)
+        assert np.asarray(bl(x1, y1)._value).shape == (3, 6)
+
+        seq = dygraph.to_variable(rng.randn(2, 7, 5).astype(np.float32))
+        rc = dygraph.RowConv("rc", future_context_size=2)
+        assert np.asarray(rc(seq)._value).shape == (2, 7, 5)
+        sc = dygraph.SequenceConv("sc", num_filters=6, filter_size=3)
+        assert np.asarray(sc(seq)._value).shape == (2, 7, 6)
+
+        nodes = dygraph.to_variable(rng.randn(1, 5, 4).astype(np.float32))
+        edges = dygraph.to_variable(
+            np.array([[[0, 1], [0, 2], [-1, -1]]], np.int64))
+        tc = dygraph.TreeConv("tc", output_size=6, num_filters=2)
+        assert np.asarray(tc(nodes, edges)._value).shape == (1, 5, 6, 2)
+
+        feats = dygraph.to_variable(rng.randn(4, 8).astype(np.float32))
+        labels = dygraph.to_variable(
+            rng.randint(0, 20, (4, 1)).astype(np.int64))
+        nce = dygraph.NCE(num_total_classes=20, dim=8)
+        cost = nce(feats, labels)
+        assert np.isfinite(np.asarray(cost._value)).all()
+
+        # grads flow through a new module
+        loss = fo * fo
+        loss.backward()
+        assert fcm.weight._grad is not None or \
+            getattr(fcm.weight, "_grad", None) is not None
