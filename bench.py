@@ -146,11 +146,47 @@ def bench_ernie2():
         "vs_baseline": round(sps / REFERENCE_SAMPLES_PER_SEC, 3)}))
 
 
-def main():
+def _measure_ernie(batch, seq, preds, cfg, steps, warmup):
+    """samples/sec of the flagship step at one batch size; fresh state."""
     import jax
     import paddle_tpu as pt
     from paddle_tpu.models import bert
     from paddle_tpu import optimizer
+    from paddle_tpu.framework.scope import Scope, scope_guard
+
+    main_prog, startup, feeds, fetch = bert.bert_pretrain_program(
+        cfg, batch, seq, preds,
+        optimizer_fn=lambda loss: optimizer.Adam(1e-4).minimize(loss))
+    scope = Scope()
+    with scope_guard(scope):
+        exe = pt.Executor()
+        exe.run(startup)
+        feed = bert.synthetic_batch(cfg, batch, seq, preds)
+        feed = {k: jax.device_put(np.asarray(v)) for k, v in feed.items()}
+        for _ in range(warmup):
+            out = exe.run(main_prog, feed=feed,
+                          fetch_list=[fetch["loss"]])
+        np.asarray(out[0])  # sync
+        # steady state: JAX dispatch is async, so successive steps
+        # pipeline on the chip (each consumes the previous step's donated
+        # state); losses are device futures materialized once at the end
+        # — how a real training loop behaves, keeping host/tunnel latency
+        # off the critical path.
+        t0 = time.perf_counter()
+        losses = []
+        for _ in range(steps):
+            out = exe.run(main_prog, feed=feed,
+                          fetch_list=[fetch["loss"]], return_numpy=False)
+            losses.append(out[0])
+        loss_vals = [float(np.asarray(l).reshape(-1)[0]) for l in losses]
+        dt = time.perf_counter() - t0
+    assert np.isfinite(loss_vals[-1]), "non-finite loss in benchmark"
+    return batch * steps / dt, dt
+
+
+def main():
+    import jax
+    from paddle_tpu.models import bert
 
     on_tpu = any(d.platform in ("tpu", "axon") for d in jax.devices())
     # BERT/ERNIE-base, seq 128 — bf16 on TPU; tiny shapes on CPU fallback
@@ -165,43 +201,33 @@ def main():
                               max_position=128)
         steps, warmup = 5, 2
 
-    main_prog, startup, feeds, fetch = bert.bert_pretrain_program(
-        cfg, batch, seq, preds,
-        optimizer_fn=lambda loss: optimizer.Adam(1e-4).minimize(loss))
-    exe = pt.Executor()
-    exe.run(startup)
-    feed = bert.synthetic_batch(cfg, batch, seq, preds)
-    feed = {k: jax.device_put(np.asarray(v)) for k, v in feed.items()}
+    sps, dt = _measure_ernie(batch, seq, preds, cfg, steps, warmup)
+    best = (batch, sps, dt, steps)
+    if on_tpu:
+        # larger batches amortize per-step overhead and fill the MXU
+        # better; keep whichever config sustains more samples/sec.
+        # Guarded: an OOM/compile failure on 256 must not cost the
+        # already-measured 128 result.
+        try:
+            sps256, dt256 = _measure_ernie(256, seq, preds, cfg,
+                                           max(steps // 2, 8), warmup)
+            if sps256 > best[1]:
+                best = (256, sps256, dt256, max(steps // 2, 8))
+        except Exception as e:  # pragma: no cover
+            print("batch-256 attempt failed: %r" % (e,), file=sys.stderr)
 
-    for _ in range(warmup):
-        out = exe.run(main_prog, feed=feed, fetch_list=[fetch["loss"]])
-    np.asarray(out[0])  # sync
-
-    # steady state: JAX dispatch is async, so successive steps pipeline on
-    # the chip (each consumes the previous step's donated state); losses are
-    # device futures materialized once at the end — how a real training loop
-    # behaves, and it keeps host/tunnel latency off the critical path.
-    t0 = time.perf_counter()
-    losses = []
-    for _ in range(steps):
-        out = exe.run(main_prog, feed=feed, fetch_list=[fetch["loss"]],
-                      return_numpy=False)
-        losses.append(out[0])
-    loss_vals = [float(np.asarray(l).reshape(-1)[0]) for l in losses]
-    dt = time.perf_counter() - t0
-    loss = loss_vals[-1]
-
-    sps = batch * steps / dt
-    assert np.isfinite(loss), "non-finite loss in benchmark"
+    bbatch, sps, dt, bsteps = best
     result = {
         "metric": "ERNIE-base pretrain samples/sec/chip",
         "value": round(sps, 2),
         "unit": "samples/sec/chip",
         "vs_baseline": round(sps / REFERENCE_SAMPLES_PER_SEC, 3),
+        "batch": bbatch,
     }
     peak = _chip_peak_flops()
     if peak is not None:
-        mfu = bert_train_flops(cfg, batch, seq, preds) * steps / dt / peak
+        mfu = bert_train_flops(cfg, bbatch, seq, preds) * bsteps / dt / \
+            peak
         result["mfu"] = round(mfu, 4)
     print(json.dumps(result))
 
