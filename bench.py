@@ -61,6 +61,11 @@ def bert_train_flops(cfg, batch, seq, preds):
 
 
 def _run_steps(exe, prog, feed, loss_var, steps, warmup):
+    """Shared measurement loop: warmup + sync, then a timed window of
+    async-dispatched steps (each consumes the previous step's donated
+    state; losses are device futures materialized once at the end — how
+    a real training loop behaves, keeping host/tunnel latency off the
+    critical path)."""
     import numpy as np
     for _ in range(warmup):
         out = exe.run(prog, feed=feed, fetch_list=[loss_var])
@@ -70,7 +75,7 @@ def _run_steps(exe, prog, feed, loss_var, steps, warmup):
                       return_numpy=False)[0] for _ in range(steps)]
     vals = [float(np.asarray(l).reshape(-1)[0]) for l in losses]
     dt = time.perf_counter() - t0
-    assert np.isfinite(vals).all() if hasattr(np, "isfinite") else True
+    assert np.isfinite(vals).all()
     return dt, vals[-1]
 
 
@@ -83,19 +88,26 @@ def bench_resnet():
     batch = 128 if on_tpu else 4
     shape = (3, 224, 224) if on_tpu else (3, 32, 32)
     steps, warmup = (20, 3) if on_tpu else (3, 1)
+    from paddle_tpu.framework.scope import Scope, scope_guard
     main_prog, startup, feeds, fetch = resnet.resnet_train_program(
         depth=50, class_dim=1000, image_shape=shape,
         optimizer_fn=lambda l: optimizer.Momentum(0.1, 0.9).minimize(l))
-    exe = pt.Executor()
-    exe.run(startup)
-    rng = np.random.RandomState(0)
-    feed = {"image": rng.rand(batch, *shape).astype(np.float32),
-            "label": rng.randint(0, 1000, (batch, 1)).astype(np.int64)}
-    # pre-stage to device once — in production the DataLoader's background
-    # thread double-buffers batches to HBM ahead of compute (reader.py);
-    # re-transferring the same batch each step would only measure the link
-    feed = {k: jax.device_put(v) for k, v in feed.items()}
-    dt, loss = _run_steps(exe, main_prog, feed, fetch["loss"], steps, warmup)
+    # own scope: this model's params/optimizer state must not stay
+    # resident in HBM while the headline (and its batch-256 attempt) runs
+    with scope_guard(Scope()):
+        exe = pt.Executor()
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        feed = {"image": rng.rand(batch, *shape).astype(np.float32),
+                "label": rng.randint(0, 1000,
+                                     (batch, 1)).astype(np.int64)}
+        # pre-stage to device once — in production the DataLoader's
+        # background thread double-buffers batches to HBM ahead of
+        # compute (reader.py); re-transferring the same batch each step
+        # would only measure the link
+        feed = {k: jax.device_put(v) for k, v in feed.items()}
+        dt, loss = _run_steps(exe, main_prog, feed, fetch["loss"], steps,
+                              warmup)
     ips = batch * steps / dt
     print(json.dumps({"metric": "ResNet-50 train images/sec/chip",
                       "value": round(ips, 2), "unit": "images/sec/chip",
@@ -120,24 +132,30 @@ def bench_ernie2():
         cfg = bert.BertConfig(vocab_size=1024, hidden_size=64, num_layers=2,
                               num_heads=2, ff_size=128, max_position=64)
         steps, warmup = 3, 1
+    from paddle_tpu.framework.scope import Scope, scope_guard
     main_prog, startup, feeds, fetch = bert.ernie2_multitask_program(
         cfg, batch, seq, preds, dynamic_task_weights=True,
         optimizer_fn=lambda loss: optimizer.Adam(1e-4).minimize(loss))
-    exe = pt.Executor()
-    exe.run(startup)
-    feed = bert.ernie2_synthetic_batch(cfg, batch, seq, preds)
-    feed = {k: jax.device_put(np.asarray(v)) for k, v in feed.items()}
-    sched = list(bert.ernie2_task_schedule(steps + warmup, (1., 1., 1.)))
-    staged = [dict(feed, task_weight=jax.device_put(v)) for v in sched]
-    for i in range(warmup):
-        out = exe.run(main_prog, feed=staged[i], fetch_list=[fetch["loss"]])
-    np.asarray(out[0])
-    t0 = time.perf_counter()
-    ls = [exe.run(main_prog, feed=staged[warmup + i],
-                  fetch_list=[fetch["loss"]], return_numpy=False)[0]
-          for i in range(steps)]
-    vals = [float(np.asarray(l).reshape(-1)[0]) for l in ls]
-    dt = time.perf_counter() - t0
+    # own scope, like bench_resnet: free this state before the headline
+    with scope_guard(Scope()):
+        exe = pt.Executor()
+        exe.run(startup)
+        feed = bert.ernie2_synthetic_batch(cfg, batch, seq, preds)
+        feed = {k: jax.device_put(np.asarray(v)) for k, v in feed.items()}
+        sched = list(bert.ernie2_task_schedule(steps + warmup,
+                                               (1., 1., 1.)))
+        staged = [dict(feed, task_weight=jax.device_put(v))
+                  for v in sched]
+        for i in range(warmup):
+            out = exe.run(main_prog, feed=staged[i],
+                          fetch_list=[fetch["loss"]])
+        np.asarray(out[0])
+        t0 = time.perf_counter()
+        ls = [exe.run(main_prog, feed=staged[warmup + i],
+                      fetch_list=[fetch["loss"]], return_numpy=False)[0]
+              for i in range(steps)]
+        vals = [float(np.asarray(l).reshape(-1)[0]) for l in ls]
+        dt = time.perf_counter() - t0
     assert np.isfinite(vals).all()
     sps = batch * steps / dt
     print(json.dumps({
@@ -163,24 +181,9 @@ def _measure_ernie(batch, seq, preds, cfg, steps, warmup):
         exe.run(startup)
         feed = bert.synthetic_batch(cfg, batch, seq, preds)
         feed = {k: jax.device_put(np.asarray(v)) for k, v in feed.items()}
-        for _ in range(warmup):
-            out = exe.run(main_prog, feed=feed,
-                          fetch_list=[fetch["loss"]])
-        np.asarray(out[0])  # sync
-        # steady state: JAX dispatch is async, so successive steps
-        # pipeline on the chip (each consumes the previous step's donated
-        # state); losses are device futures materialized once at the end
-        # — how a real training loop behaves, keeping host/tunnel latency
-        # off the critical path.
-        t0 = time.perf_counter()
-        losses = []
-        for _ in range(steps):
-            out = exe.run(main_prog, feed=feed,
-                          fetch_list=[fetch["loss"]], return_numpy=False)
-            losses.append(out[0])
-        loss_vals = [float(np.asarray(l).reshape(-1)[0]) for l in losses]
-        dt = time.perf_counter() - t0
-    assert np.isfinite(loss_vals[-1]), "non-finite loss in benchmark"
+        dt, loss = _run_steps(exe, main_prog, feed, fetch["loss"], steps,
+                              warmup)
+    assert np.isfinite(loss), "non-finite loss in benchmark"
     return batch * steps / dt, dt
 
 
@@ -208,11 +211,12 @@ def main():
         # better; keep whichever config sustains more samples/sec.
         # Guarded: an OOM/compile failure on 256 must not cost the
         # already-measured 128 result.
+        steps256 = max(steps // 2, 8)
         try:
             sps256, dt256 = _measure_ernie(256, seq, preds, cfg,
-                                           max(steps // 2, 8), warmup)
+                                           steps256, warmup)
             if sps256 > best[1]:
-                best = (256, sps256, dt256, max(steps // 2, 8))
+                best = (256, sps256, dt256, steps256)
         except Exception as e:  # pragma: no cover
             print("batch-256 attempt failed: %r" % (e,), file=sys.stderr)
 
