@@ -65,11 +65,15 @@ class DistributedOptimizer(object):
             return self._minimize_pipeline(loss)
         ops, pgs = self._inner.minimize(loss, startup_program,
                                         parameter_list, no_grad_set)
-        # ZeRO-1: shard optimizer moments over dp when requested
+        # ZeRO-1: annotate optimizer moments for dp sharding when
+        # requested.  The annotation is nominal — CompiledProgram's
+        # _var_sharding checks it against the REAL mesh at compile time
+        # and keeps non-divisible dims (e.g. a 4-wide bias moment on
+        # dp=8) replicated.
         if self._strategy.sharding_optimizer_state:
             for (name, pname), var in getattr(self._inner, "_accumulators",
                                               {}).items():
-                if var.shape and len(var.shape) >= 1 and var.shape[0] > 1:
+                if var.shape and var.shape[0] > 1:
                     var.sharding = ("dp",) + (None,) * (len(var.shape) - 1)
         return ops, pgs
 

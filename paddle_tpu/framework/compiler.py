@@ -122,7 +122,21 @@ class CompiledProgram(object):
         var = blk._find_var_recursive(name)
         axes = set(mesh.axis_names)
         if var is not None and var.sharding:
-            spec = tuple(a if (a in axes) else None for a in var.sharding)
+            # every annotation site (fleet ZeRO, transpiler tables,
+            # tp attrs) meets the REAL mesh here: drop any axis the
+            # mesh doesn't have, and any axis whose dim doesn't divide
+            # the mesh size — those dims stay replicated instead of
+            # failing the jit with a non-divisible NamedSharding
+            spec = []
+            shape = var.shape or ()
+            for i, a in enumerate(var.sharding):
+                if a not in axes:
+                    spec.append(None)
+                elif i < len(shape) and shape[i] not in (None, -1) and \
+                        shape[i] % mesh.shape[a] != 0:
+                    spec.append(None)
+                else:
+                    spec.append(a)
             return NamedSharding(mesh, P(*spec))
         return NamedSharding(mesh, P())  # replicated
 

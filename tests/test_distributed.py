@@ -14,7 +14,7 @@ pytestmark = pytest.mark.skipif(len(jax.devices()) < 8,
                                 reason="needs 8 virtual devices")
 
 
-def _build_mlp_train(seed=0):
+def _build_mlp_train(seed=0, minimize_fn=None):
     main, startup = pt.Program(), pt.Program()
     main.random_seed = seed
     startup.random_seed = seed
@@ -27,7 +27,10 @@ def _build_mlp_train(seed=0):
         logits = layers.fc(h, size=4, param_attr=pt.ParamAttr(name="w2"),
                            bias_attr=pt.ParamAttr(name="b2"))
         loss = layers.mean(layers.softmax_with_cross_entropy(logits, y))
-        optimizer.SGD(0.1).minimize(loss)
+        if minimize_fn is None:
+            optimizer.SGD(0.1).minimize(loss)
+        else:
+            minimize_fn(loss)
     return main, startup, loss
 
 
@@ -619,3 +622,59 @@ def test_multiprocess_jax_distributed_e2e(tmp_path):
         for i in (0, 1))
     assert rcs == [0, 0], logs
     assert "OK 0" in logs and "OK 1" in logs
+
+
+def test_zero1_optimizer_state_sharding_matches_unsharded():
+    """fleet DistributedStrategy.sharding_optimizer_state (ZeRO-1):
+    Adam moments annotated for dp sharding must train identically to
+    the replicated run, and the moment arrays must actually land
+    dp-sharded on the mesh."""
+    from paddle_tpu.distributed import fleet, DistributedStrategy
+    from paddle_tpu.framework.scope import Scope, scope_guard
+
+    rng = np.random.RandomState(0)
+    xv = rng.rand(16, 16).astype(np.float32)
+    yv = rng.randint(0, 4, (16, 1)).astype(np.int64)
+
+    def build(sharded):
+        strategy = DistributedStrategy()
+        strategy.mesh_axes = {"dp": 8}
+        strategy.sharding_optimizer_state = sharded
+        main, startup, loss = _build_mlp_train(
+            minimize_fn=lambda l: fleet.distributed_optimizer(
+                optimizer.Adam(0.05), strategy).minimize(l))
+        return main, startup, loss, strategy
+
+    results = {}
+    for sharded in (False, True):
+        with scope_guard(Scope()):
+            main, startup, loss, strategy = build(sharded)
+            exe = pt.Executor()
+            exe.run(startup)
+            bs = BuildStrategy()
+            bs.mesh_axes = strategy.mesh_axes
+            compiled = CompiledProgram(main, bs)
+            losses = [float(np.asarray(
+                exe.run(compiled, feed={"x": xv, "y": yv},
+                        fetch_list=[loss])[0]).reshape(-1)[0])
+                for _ in range(4)]
+            w = pt.global_scope().get_numpy("w1")
+            if sharded:
+                # a (32,)-row moment of w1 must be split over dp
+                moments = [n for n in pt.global_scope().keys()
+                           if "w1" in n and ("moment" in n.lower()
+                                             or "_m" in n)]
+                assert moments, "no Adam moment vars found for w1"
+                arr = pt.global_scope().find_var(moments[0])
+                shard_axes = {
+                    a for axes in getattr(arr.sharding, "spec", [])
+                    or [] for a in (axes if isinstance(axes, tuple)
+                                    else [axes]) if a}
+                assert "dp" in shard_axes, (
+                    moments[0], getattr(arr, "sharding", None))
+            results[sharded] = (losses, w)
+
+    np.testing.assert_allclose(results[False][0], results[True][0],
+                               rtol=1e-4)
+    np.testing.assert_allclose(results[False][1], results[True][1],
+                               rtol=1e-4, atol=1e-6)
