@@ -17,6 +17,7 @@ reference's hand-written per-op grad kernels.
 from .dtypes import is_float
 from .program import Parameter, grad_var_name
 from .trace import EMPTY_VAR, GRAD_OP_TYPE
+from ..ops.registry import get_op as _registry_get_op
 
 _RENAME = "@RENAME@"
 
@@ -144,11 +145,22 @@ def calc_gradient_in_block(block, target, roots, no_grad_set,
         if not any_og:
             continue
 
+        # slots the kernel declares non-differentiable never receive a
+        # grad from the trace-time vjp — registering a name for them
+        # would leave a dangling @RENAME contribution that the sum op
+        # later fails to find (e.g. a connected var feeding
+        # fill_constant_batch_size_like's shape-only Input)
+        try:
+            nondiff_slots = set(_registry_get_op(op.type).nondiff)
+        except NotImplementedError:
+            # structural ops (feed/fetch-style) with no kernel entry
+            nondiff_slots = set()
         ig = {}
         for slot, names in op.inputs.items():
             lst = []
             for n in names:
-                if n in connected and n not in no_grad_set:
+                if slot not in nondiff_slots and n in connected and \
+                        n not in no_grad_set:
                     gname = acc.next_name(n)
                     _ensure_grad_var(block, n, gname)
                     lst.append(gname)

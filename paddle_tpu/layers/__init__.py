@@ -17,7 +17,8 @@ from .extras import *        # noqa: F401,F403
 from .rnn import *           # noqa: F401,F403
 from .attention import *     # noqa: F401,F403
 from .collective import *    # noqa: F401,F403
-from .distributions import Normal, Uniform, Categorical  # noqa: F401
+from .distributions import (Normal, Uniform, Categorical,  # noqa: F401
+                            MultivariateNormalDiag)
 from . import detection  # noqa: F401
 from .detection import (  # noqa: F401
     prior_box, density_prior_box, multi_box_head, anchor_generator,
@@ -26,3 +27,10 @@ from .detection import (  # noqa: F401
     yolov3_loss, yolo_box, box_clip, multiclass_nms,
     distribute_fpn_proposals, collect_fpn_proposals, box_decoder_and_assign,
     generate_proposals, roi_align, roi_pool)
+# NOTE: binding the `rnn` FUNCTION here shadows the layers.rnn submodule
+# attribute — fluid 1.6 has the same shadowing (layers.rnn is the scan
+# entry point; reach the legacy module via `from paddle_tpu.layers import
+# rnn as rnn_mod` / importlib if needed)
+from .rnn_api import (RNNCell, GRUCell, LSTMCell, rnn, lstm,  # noqa: F401
+                      dynamic_lstmp)
+from . import rnn_api  # noqa: F401

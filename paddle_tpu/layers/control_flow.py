@@ -693,6 +693,17 @@ class DynamicRNN(object):
             res.append(transpose(o, perm))   # back to batch-major
         return res[0] if single else res
 
+    def final_states(self):
+        """Final memory values after the scan, in memory() order.  With
+        lengths, update_memory freezes each row's carry past its valid
+        prefix, so these ARE the states at t = len-1 (used by
+        layers.rnn for its final_states return)."""
+        finals = getattr(self._rnn, "_finals", None)
+        if finals is None:
+            raise ValueError("final_states() is available after the "
+                             "DynamicRNN has been called")
+        return list(finals)
+
 
 def is_empty(x, cond=None):
     """Static element-count test (ref control_flow.py is_empty). Dynamic

@@ -1,0 +1,279 @@
+"""fluid 1.6 cell-based RNN API (ref python/paddle/fluid/layers/rnn.py:
+RNNCell/GRUCell/LSTMCell, rnn(), lstm(), dynamic_lstmp()).
+
+TPU design: ``rnn(cell, ...)`` records ONE step of the cell inside a
+DynamicRNN block and lowers to a single differentiable lax.scan
+(recurrent_scan op), with dense+lengths padding semantics: padded steps
+freeze the state carry and zero the outputs, so the returned final
+states are the states at each row's last valid step.  Cell parameters
+are created on first call with names pinned per cell instance, so one
+cell can be reused across unrolled decoders.
+"""
+import numpy as np
+
+from ..layer_helper import LayerHelper
+from ..framework import unique_name
+from . import nn as _nn
+from . import ops as _ops
+from . import tensor as _tensor
+from .control_flow import DynamicRNN
+
+__all__ = ["RNNCell", "GRUCell", "LSTMCell", "rnn", "lstm",
+           "dynamic_lstmp"]
+
+
+def _flatten(structure):
+    if isinstance(structure, (list, tuple)):
+        out = []
+        for s in structure:
+            out.extend(_flatten(s))
+        return out
+    return [structure]
+
+
+def _pack_as(structure, flat):
+    it = iter(flat)
+
+    def walk(s):
+        if isinstance(s, (list, tuple)):
+            return type(s)(walk(x) for x in s)
+        return next(it)
+
+    return walk(structure)
+
+
+class RNNCell(object):
+    """Base cell (ref rnn.py:48): ``call(inputs, states) -> (outputs,
+    new_states)``; ``get_initial_states`` builds zero states shaped per
+    ``state_shape`` with the batch dim taken from ``batch_ref``."""
+
+    def call(self, inputs, states, **kwargs):
+        raise NotImplementedError("RNNCell must implement call().")
+
+    def __call__(self, inputs, states, **kwargs):
+        return self.call(inputs, states, **kwargs)
+
+    @property
+    def state_shape(self):
+        raise NotImplementedError(
+            "cell has no state_shape; pass shape= to get_initial_states")
+
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0):
+        batch_ref = _flatten(batch_ref)[0]
+        shapes = self.state_shape if shape is None else shape
+        dtype = dtype or "float32"
+        nested = shapes if isinstance(shapes[0], (list, tuple)) \
+            else [shapes]
+        outs = []
+        for s in nested:
+            full = list(s) if s and s[0] == -1 else [-1] + list(s)
+            outs.append(_tensor.fill_constant_batch_size_like(
+                batch_ref, shape=full, dtype=dtype, value=init_value))
+        return outs[0] if len(outs) == 1 else outs
+
+
+class GRUCell(RNNCell):
+    """Single-step GRU (ref rnn.py GRUCell): state = hidden (B, H);
+    outputs = new hidden."""
+
+    def __init__(self, hidden_size, param_attr=None, bias_attr=None,
+                 gate_activation=None, activation=None, dtype="float32",
+                 name="gru_cell"):
+        self.hidden_size = hidden_size
+        self._uid = unique_name.generate(name)
+        self._param_attr = param_attr
+        self._bias_attr = bias_attr
+        self._gate_act = gate_activation or "sigmoid"
+        self._act = activation or "tanh"
+        self._dtype = dtype
+
+    def _attr(self, suffix, base):
+        """Pin a per-cell name; honor a user initializer if given."""
+        from ..param_attr import ParamAttr
+        attr = ParamAttr(name=self._uid + suffix)
+        if base is not None and getattr(base, "initializer", None):
+            attr.initializer = base.initializer
+        return attr
+
+    @property
+    def state_shape(self):
+        return [self.hidden_size]
+
+    def call(self, inputs, states):
+        h = self.hidden_size
+        gates = _nn.fc(
+            _tensor.concat([inputs, states], axis=-1), size=2 * h,
+            act=self._gate_act,
+            param_attr=self._attr("_gate_w", self._param_attr),
+            bias_attr=self._attr("_gate_b", self._bias_attr))
+        u = _nn.slice(gates, axes=[1], starts=[0], ends=[h])
+        r = _nn.slice(gates, axes=[1], starts=[h], ends=[2 * h])
+        cand = _nn.fc(
+            _tensor.concat([inputs, _nn.elementwise_mul(r, states)],
+                           axis=-1),
+            size=h, act=self._act,
+            param_attr=self._attr("_cand_w", self._param_attr),
+            bias_attr=self._attr("_cand_b", self._bias_attr))
+        ones = _nn.scale(u, scale=-1.0, bias=1.0)
+        new_h = _nn.elementwise_add(_nn.elementwise_mul(u, states),
+                                    _nn.elementwise_mul(ones, cand))
+        return new_h, new_h
+
+
+class LSTMCell(RNNCell):
+    """Single-step LSTM (ref rnn.py LSTMCell): states = [h, c];
+    outputs = new h."""
+
+    def __init__(self, hidden_size, param_attr=None, bias_attr=None,
+                 gate_activation=None, activation=None,
+                 forget_bias=1.0, dtype="float32", name="lstm_cell"):
+        self.hidden_size = hidden_size
+        self._uid = unique_name.generate(name)
+        self._param_attr = param_attr
+        self._bias_attr = bias_attr
+        self._gate_act = gate_activation or "sigmoid"
+        self._act = activation or "tanh"
+        self._forget_bias = forget_bias
+        self._dtype = dtype
+
+    _attr = GRUCell._attr
+
+    @property
+    def state_shape(self):
+        return [[self.hidden_size], [self.hidden_size]]
+
+    def call(self, inputs, states):
+        pre_h, pre_c = states
+        h = self.hidden_size
+        gact = getattr(_ops, self._gate_act)
+        act = getattr(_ops, self._act)
+        gates = _nn.fc(
+            _tensor.concat([inputs, pre_h], axis=-1), size=4 * h,
+            param_attr=self._attr("_w", self._param_attr),
+            bias_attr=self._attr("_b", self._bias_attr))
+        i = gact(_nn.slice(gates, axes=[1], starts=[0], ends=[h]))
+        f = gact(_nn.scale(
+            _nn.slice(gates, axes=[1], starts=[h], ends=[2 * h]),
+            bias=self._forget_bias))
+        c_t = act(_nn.slice(gates, axes=[1], starts=[2 * h],
+                            ends=[3 * h]))
+        o = gact(_nn.slice(gates, axes=[1], starts=[3 * h],
+                           ends=[4 * h]))
+        new_c = _nn.elementwise_add(_nn.elementwise_mul(f, pre_c),
+                                    _nn.elementwise_mul(i, c_t))
+        new_h = _nn.elementwise_mul(o, act(new_c))
+        return new_h, [new_h, new_c]
+
+
+def rnn(cell, inputs, initial_states=None, sequence_length=None,
+        time_major=False, is_reverse=False, **kwargs):
+    """Scan ``cell`` over time (ref rnn.py:363) -> (outputs,
+    final_states).  One lax.scan; padded steps (per sequence_length)
+    freeze the state and zero the outputs."""
+    from .sequence_lod import sequence_reverse
+    if time_major:
+        inputs = _nn.transpose(inputs, perm=[1, 0, 2])
+    if initial_states is None:
+        initial_states = cell.get_initial_states(inputs)
+    flat_init = _flatten(initial_states)
+    length_aware_reverse = is_reverse and sequence_length is not None
+    if length_aware_reverse:
+        inputs = sequence_reverse(inputs, lengths=sequence_length)
+    elif is_reverse:
+        from .tensor import reverse
+        inputs = reverse(inputs, axis=[1])
+
+    drnn = DynamicRNN()
+    with drnn.block():
+        x_t = drnn.step_input(inputs, lengths=sequence_length)
+        mems = [drnn.memory(init=s) for s in flat_init]
+        out, new_states = cell(x_t, _pack_as(initial_states, mems),
+                               **kwargs)
+        flat_new = _flatten(new_states)
+        for m, ns in zip(mems, flat_new):
+            drnn.update_memory(m, ns)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        drnn.output(*outs)
+    outputs = drnn()
+    final_states = _pack_as(initial_states, drnn.final_states())
+    seq_outs = outputs if isinstance(outputs, list) else [outputs]
+    if length_aware_reverse:
+        seq_outs = [sequence_reverse(o, lengths=sequence_length)
+                    for o in seq_outs]
+    elif is_reverse:
+        from .tensor import reverse
+        seq_outs = [reverse(o, axis=[1]) for o in seq_outs]
+    if time_major:
+        seq_outs = [_nn.transpose(o, perm=[1, 0, 2]) for o in seq_outs]
+    final_outputs = seq_outs[0] if not isinstance(out, (list, tuple)) \
+        else type(out)(seq_outs)
+    return final_outputs, final_states
+
+
+def lstm(input, init_h, init_c, max_len, hidden_size, num_layers,
+         dropout_prob=0.0, is_bidirec=False, is_test=False, name=None,
+         default_initializer=None, seed=-1):
+    """Multi-layer (bi)LSTM (ref rnn.py:1337, the cuDNN-LSTM wrapper):
+    input (B, T, D); init_h/init_c (num_layers*dirs, B, H).  Built on
+    contrib basic_lstm — one scan per layer/direction on TPU instead of
+    a monolithic cuDNN call.  Returns (rnn_out, last_h, last_c)."""
+    from ..contrib.layers import basic_lstm
+    out, last_h, last_c = basic_lstm(
+        input, init_h, init_c, hidden_size, num_layers=num_layers,
+        dropout_prob=0.0 if is_test else dropout_prob,
+        bidirectional=is_bidirec, batch_first=True, dtype=input.dtype)
+    return out, last_h, last_c
+
+
+def dynamic_lstmp(input, size, proj_size, param_attr=None,
+                  bias_attr=None, use_peepholes=False, is_reverse=False,
+                  gate_activation="sigmoid", cell_activation="tanh",
+                  candidate_activation="tanh",
+                  proj_activation="tanh", dtype="float32", name=None):
+    if use_peepholes:
+        raise NotImplementedError(
+            "dynamic_lstmp use_peepholes is not implemented in "
+            "paddle_tpu; pass use_peepholes=False")
+    """LSTM with recurrent projection (ref rnn.py:1512 / dynamic_lstmp
+    op): input (B, T, 4*H) pre-projected like dynamic_lstm; the hidden
+    state is projected to ``proj_size`` before recurrence.  Returns
+    (projection (B, T, P), cell (B, T, H))."""
+    from ..param_attr import ParamAttr
+    hidden = size // 4
+    uid = unique_name.generate(name or "lstmp")
+
+    class _LSTMPCell(RNNCell):
+        @property
+        def state_shape(self):
+            return [[proj_size], [hidden]]
+
+        def call(self, x_t, states):
+            pre_p, pre_c = states
+            gates = _nn.elementwise_add(
+                x_t, _nn.fc(pre_p, size=4 * hidden, bias_attr=False,
+                            param_attr=ParamAttr(name=uid + "_rw")))
+            gact = getattr(_ops, gate_activation)
+            cact = getattr(_ops, candidate_activation)
+            i = gact(_nn.slice(gates, axes=[1], starts=[0],
+                               ends=[hidden]))
+            f = gact(_nn.slice(gates, axes=[1], starts=[hidden],
+                               ends=[2 * hidden]))
+            c_t = cact(_nn.slice(gates, axes=[1],
+                                 starts=[2 * hidden],
+                                 ends=[3 * hidden]))
+            o = gact(_nn.slice(gates, axes=[1],
+                               starts=[3 * hidden],
+                               ends=[4 * hidden]))
+            new_c = _nn.elementwise_add(
+                _nn.elementwise_mul(f, pre_c),
+                _nn.elementwise_mul(i, c_t))
+            new_h = _nn.elementwise_mul(o, _ops.tanh(new_c))
+            proj = _nn.fc(new_h, size=proj_size, bias_attr=False,
+                          act=None if proj_activation == "identity"
+                          else proj_activation,
+                          param_attr=ParamAttr(name=uid + "_pw"))
+            return [proj, new_c], [proj, new_c]
+
+    outs, _finals = rnn(_LSTMPCell(), input, is_reverse=is_reverse)
+    return outs[0], outs[1]

@@ -1,0 +1,169 @@
+"""Cell-based RNN API (ref python/paddle/fluid/layers/rnn.py):
+RNNCell/GRUCell/LSTMCell + rnn()/lstm()/dynamic_lstmp()."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers, optimizer
+from paddle_tpu.framework.scope import Scope, scope_guard
+
+
+def run(build, feed, fetches_fn, steps=1):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        fetches = build()
+    scope = Scope()
+    with scope_guard(scope):
+        exe = pt.Executor()
+        exe.run(startup)
+        for _ in range(steps):
+            outs = exe.run(main, feed=feed, fetch_list=list(fetches))
+    return [np.asarray(o) for o in outs]
+
+
+def test_gru_cell_rnn_masking_and_finals():
+    rng = np.random.RandomState(0)
+    xv = rng.randn(2, 6, 3).astype(np.float32)
+    lens = np.array([6, 4], np.int64)
+
+    def build():
+        x = layers.data('x', [2, 6, 3], 'float32',
+                        append_batch_size=False)
+        l = layers.data('l', [2], 'int64', append_batch_size=False)
+        cell = layers.GRUCell(hidden_size=4)
+        out, final = layers.rnn(cell, x, sequence_length=l)
+        return out, final
+
+    o, f = run(build, {'x': xv, 'l': lens}, None)
+    assert o.shape == (2, 6, 4) and f.shape == (2, 4)
+    assert np.all(o[1, 4:] == 0)                 # padded steps zeroed
+    np.testing.assert_allclose(f[1], o[1, 3], rtol=1e-5)  # last valid
+    np.testing.assert_allclose(f[0], o[0, 5], rtol=1e-5)
+
+
+def test_gru_cell_matches_manual_recurrence():
+    """rnn(GRUCell) against a numpy replay of the same parameters."""
+    rng = np.random.RandomState(1)
+    xv = rng.randn(2, 4, 3).astype(np.float32)
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data('x', [2, 4, 3], 'float32',
+                        append_batch_size=False)
+        cell = layers.GRUCell(hidden_size=5, name="oracle_gru")
+        out, final = layers.rnn(cell, x)
+    scope = Scope()
+    with scope_guard(scope):
+        exe = pt.Executor()
+        exe.run(startup)
+        o, = exe.run(main, feed={'x': xv}, fetch_list=[out])
+        params = {n: np.asarray(scope.find_var(n))
+                  for n in scope.keys() if n.startswith("oracle_gru")}
+    o = np.asarray(o)
+    gw = next(v for k, v in params.items() if k.endswith("_gate_w"))
+    gb = next(v for k, v in params.items() if k.endswith("_gate_b"))
+    cw = next(v for k, v in params.items() if k.endswith("_cand_w"))
+    cb = next(v for k, v in params.items() if k.endswith("_cand_b"))
+    h = np.zeros((2, 5), np.float32)
+    sig = lambda v: 1.0 / (1.0 + np.exp(-v))
+    for t in range(4):
+        gates = sig(np.concatenate([xv[:, t], h], -1) @ gw + gb)
+        u, r = gates[:, :5], gates[:, 5:]
+        cand = np.tanh(np.concatenate([xv[:, t], r * h], -1) @ cw + cb)
+        h = u * h + (1 - u) * cand
+        np.testing.assert_allclose(o[:, t], h, rtol=1e-4, atol=1e-5)
+
+
+def test_lstm_cell_rnn_and_reverse():
+    rng = np.random.RandomState(2)
+    xv = rng.randn(2, 5, 3).astype(np.float32)
+
+    def build():
+        x = layers.data('x', [2, 5, 3], 'float32',
+                        append_batch_size=False)
+        cell = layers.LSTMCell(hidden_size=4)
+        out, (fh, fc) = layers.rnn(cell, x)
+        rcell = layers.LSTMCell(hidden_size=4)
+        rout, _ = layers.rnn(rcell, x, is_reverse=True)
+        tm_out, _ = layers.rnn(layers.GRUCell(hidden_size=4),
+                               layers.transpose(x, perm=[1, 0, 2]),
+                               time_major=True)
+        return out, fh, fc, rout, tm_out
+
+    o, fh, fc, ro, tmo = run(build, {'x': xv}, None)
+    assert o.shape == (2, 5, 4)
+    assert fh.shape == (2, 4) and fc.shape == (2, 4)
+    np.testing.assert_allclose(fh, o[:, -1], rtol=1e-5)
+    assert ro.shape == (2, 5, 4)
+    assert tmo.shape == (5, 2, 4)  # time-major in, time-major out
+
+
+def test_rnn_trains():
+    rng = np.random.RandomState(3)
+    xv = rng.randn(4, 6, 3).astype(np.float32)
+    yv = (xv.sum(axis=(1, 2), keepdims=False) > 0).astype(
+        np.int64).reshape(-1, 1)
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data('x', [4, 6, 3], 'float32',
+                        append_batch_size=False)
+        y = layers.data('y', [4, 1], 'int64', append_batch_size=False)
+        out, final = layers.rnn(layers.GRUCell(hidden_size=8), x)
+        logits = layers.fc(final, size=2)
+        loss = layers.reduce_mean(
+            layers.softmax_with_cross_entropy(logits, y))
+        optimizer.Adam(1e-2).minimize(loss)
+    scope = Scope()
+    with scope_guard(scope):
+        exe = pt.Executor()
+        exe.run(startup)
+        vals = [float(np.asarray(exe.run(main, feed={'x': xv, 'y': yv},
+                                         fetch_list=[loss])[0])
+                      .reshape(-1)[0]) for _ in range(30)]
+    assert vals[-1] < vals[0] * 0.5
+
+
+def test_lstm_wrapper_and_lstmp():
+    rng = np.random.RandomState(4)
+    xv = rng.randn(2, 6, 3).astype(np.float32)
+
+    def build():
+        x = layers.data('x', [2, 6, 3], 'float32',
+                        append_batch_size=False)
+        rout, lh, lc = layers.lstm(x, None, None, max_len=6,
+                                   hidden_size=4, num_layers=2,
+                                   is_bidirec=True)
+        proj = layers.fc(x, size=16, num_flatten_dims=2,
+                         bias_attr=False)
+        p_out, c_out = layers.dynamic_lstmp(proj, size=16, proj_size=3)
+        return rout, lh, lc, p_out, c_out
+
+    rout, lh, lc, p_out, c_out = run(build, {'x': xv}, None)
+    assert rout.shape == (2, 6, 8)          # bi => 2*hidden
+    assert lh.shape == (4, 2, 4)            # layers*dirs, B, H
+    assert p_out.shape == (2, 6, 3)         # projected
+    assert c_out.shape == (2, 6, 4)         # cell stays hidden-sized
+    assert np.isfinite(p_out).all() and np.isfinite(rout).all()
+
+
+def test_grad_through_nondiff_shape_ref():
+    """Regression (backward.py): a differentiable var feeding a
+    declared-nondiff slot (fill_constant_batch_size_like's Input) must
+    not register a dangling grad contribution."""
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data('x', [3, 4], 'float32', append_batch_size=False)
+        h = layers.fc(x, size=4)
+        zeros = layers.fill_constant_batch_size_like(
+            h, shape=[-1, 4], dtype='float32', value=0.0)
+        out = layers.elementwise_add(h, zeros)
+        loss = layers.reduce_sum(layers.square(out))
+        gx, = pt.gradients(loss, [x])
+    scope = Scope()
+    with scope_guard(scope):
+        exe = pt.Executor()
+        exe.run(startup)
+        g, = exe.run(main, feed={'x': np.ones((3, 4), np.float32)},
+                     fetch_list=[gx])
+    assert np.isfinite(np.asarray(g)).all()
