@@ -32,5 +32,9 @@ from .detection import (  # noqa: F401
 # entry point; reach the legacy module via `from paddle_tpu.layers import
 # rnn as rnn_mod` / importlib if needed)
 from .rnn_api import (RNNCell, GRUCell, LSTMCell, rnn, lstm,  # noqa: F401
-                      dynamic_lstmp)
+                      dynamic_lstmp, Decoder, BeamSearchDecoder,
+                      dynamic_decode, beam_search, beam_search_decode)
 from . import rnn_api  # noqa: F401
+from .layer_function_generator import (generate_layer_fn,  # noqa: F401
+    generate_activation_fn, deprecated, autodoc, templatedoc)
+from . import layer_function_generator  # noqa: F401

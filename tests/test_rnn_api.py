@@ -167,3 +167,134 @@ def test_grad_through_nondiff_shape_ref():
         g, = exe.run(main, feed={'x': np.ones((3, 4), np.float32)},
                      fetch_list=[gx])
     assert np.isfinite(np.asarray(g)).all()
+
+
+def test_dynamic_decode_beam_invariants():
+    V, D, H, B = 11, 6, 8, 3
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        enc = layers.data('enc', [B, H], 'float32',
+                          append_batch_size=False)
+        cell = layers.GRUCell(hidden_size=H, name='dd_cell')
+
+        def emb(ids):
+            return layers.reshape(layers.embedding(
+                ids, size=[V, D],
+                param_attr=pt.ParamAttr(name='dd_emb')), [-1, D])
+
+        def out_fn(h):
+            return layers.fc(h, size=V,
+                             param_attr=pt.ParamAttr(name='dd_fc_w'),
+                             bias_attr=pt.ParamAttr(name='dd_fc_b'))
+
+        bsd = layers.BeamSearchDecoder(cell, start_token=0, end_token=1,
+                                       beam_size=3, embedding_fn=emb,
+                                       output_fn=out_fn)
+        ids, final = layers.dynamic_decode(bsd, inits=enc,
+                                           max_step_num=4)
+    scope = Scope()
+    with scope_guard(scope):
+        exe = pt.Executor()
+        exe.run(startup)
+        rng = np.random.RandomState(5)
+        iv, = exe.run(main,
+                      feed={'enc': rng.randn(B, H).astype(np.float32)},
+                      fetch_list=[ids])
+    iv = np.asarray(iv)
+    assert iv.shape == (B, 3, 4)
+    assert iv.min() >= 0 and iv.max() < V
+    for n in range(B):
+        for bm in range(3):
+            seen = False
+            for t in range(4):
+                if seen:
+                    assert iv[n, bm, t] == 1
+                if iv[n, bm, t] == 1:
+                    seen = True
+    assert len({tuple(iv[0, b]) for b in range(3)}) == 3
+
+
+def test_beam_search_functional_step():
+    """beam_search one step: highest candidates win; frozen rows only
+    re-emit end_id at unchanged score."""
+    B, b, K, END = 2, 2, 3, 9
+    pre_ids = np.array([[3], [END], [4], [5]], np.int64)   # (B*b, 1)
+    pre_scores = np.array([[0.0], [-1.0], [-0.5], [-2.0]], np.float32)
+    cand_ids = np.tile(np.array([[5, 6, END]], np.int64), (B * b, 1))
+    cand_scores = np.array([
+        [-0.1, -2.0, -3.0],     # row 0 live: only -0.1 beats the
+        [-9.0, -9.0, -9.0],     # frozen row 1 (pre=END, score -1.0)
+        [-0.3, -0.9, -4.0],
+        [-0.4, -0.5, -5.0]], np.float32)
+
+    def build():
+        pi = layers.data('pi', [B * b, 1], 'int64',
+                         append_batch_size=False)
+        ps = layers.data('ps', [B * b, 1], 'float32',
+                         append_batch_size=False)
+        ci = layers.data('ci', [B * b, K], 'int64',
+                         append_batch_size=False)
+        cs = layers.data('cs', [B * b, K], 'float32',
+                         append_batch_size=False)
+        si, ss, parent = layers.beam_search(
+            pi, ps, ci, cs, beam_size=b, end_id=END,
+            return_parent_idx=True)
+        return si, ss, parent
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        fetches = build()
+    scope = Scope()
+    with scope_guard(scope):
+        exe = pt.Executor()
+        exe.run(startup)
+        si, ss, parent = exe.run(
+            main, feed={'pi': pre_ids, 'ps': pre_scores, 'ci': cand_ids,
+                        'cs': cand_scores}, fetch_list=list(fetches))
+    si = np.asarray(si).reshape(B, b)
+    ss = np.asarray(ss).reshape(B, b)
+    parent = np.asarray(parent).reshape(B, b)
+    # batch row 0: best is live beam0's -0.1 (id 5); second is frozen
+    # beam1 re-emitting END at its pre_score -1.0
+    assert si[0, 0] == 5 and abs(ss[0, 0] + 0.1) < 1e-5
+    assert si[0, 1] == END and abs(ss[0, 1] + 1.0) < 1e-5
+    assert parent[0, 0] == 0 and parent[0, 1] == 1
+    # batch row 1: -0.3 (beam0 id 5) then -0.4 (beam1 id 5)
+    assert si[1, 0] == 5 and abs(ss[1, 0] + 0.3) < 1e-5
+    assert si[1, 1] == 5 and abs(ss[1, 1] + 0.4) < 1e-5
+
+
+def test_beam_search_decode_backtrace():
+    """Two-step backtrace: step-2 winners descending from step-1 beam 1
+    must carry beam 1's prefix."""
+    b = 2
+    step1_ids = np.array([[7], [8], [5], [6]], np.int64)
+    step2_ids = np.array([[3], [4], [2], [1]], np.int64)
+    # every step-2 winner in row 0 descends from beam 1; row 1 from 0
+    step2_parents = np.array([1, 1, 0, 0], np.int64)
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        i1 = layers.data('i1', [4, 1], 'int64', append_batch_size=False)
+        i2 = layers.data('i2', [4, 1], 'int64', append_batch_size=False)
+        p2 = layers.data('p2', [4], 'int64', append_batch_size=False)
+        s1 = layers.data('s1', [4, 1], 'float32',
+                         append_batch_size=False)
+        seqs, fs = layers.beam_search_decode(
+            [i1, i2], [None, p2], beam_size=b, end_id=1,
+            scores=[s1, s1])
+    scope = Scope()
+    with scope_guard(scope):
+        exe = pt.Executor()
+        exe.run(startup)
+        sv, fsv = exe.run(main, feed={'i1': step1_ids,
+                                      'i2': step2_ids,
+                                      'p2': step2_parents,
+                                      's1': np.full((4, 1), -0.5,
+                                                    np.float32)},
+                          fetch_list=[seqs, fs])
+    sv = np.asarray(sv)
+    assert np.asarray(fsv).shape == (2, 2)
+    assert sv.shape == (2, 2, 2)
+    np.testing.assert_array_equal(sv[0], [[8, 3], [8, 4]])
+    np.testing.assert_array_equal(sv[1], [[5, 2], [5, 1]])
