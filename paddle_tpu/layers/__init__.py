@@ -26,7 +26,9 @@ from .detection import (  # noqa: F401
     sigmoid_focal_loss, iou_similarity, box_coder, polygon_box_transform,
     yolov3_loss, yolo_box, box_clip, multiclass_nms,
     distribute_fpn_proposals, collect_fpn_proposals, box_decoder_and_assign,
-    generate_proposals, roi_align, roi_pool)
+    generate_proposals, roi_align, roi_pool, rpn_target_assign,
+    retinanet_target_assign, generate_proposal_labels,
+    locality_aware_nms)
 # NOTE: binding the `rnn` FUNCTION here shadows the layers.rnn submodule
 # attribute — fluid 1.6 has the same shadowing (layers.rnn is the scan
 # entry point; reach the legacy module via `from paddle_tpu.layers import

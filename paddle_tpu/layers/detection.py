@@ -13,6 +13,8 @@ __all__ = [
     'polygon_box_transform', 'yolov3_loss', 'yolo_box', 'box_clip',
     'multiclass_nms', 'distribute_fpn_proposals', 'collect_fpn_proposals',
     'box_decoder_and_assign', 'generate_proposals', 'roi_align', 'roi_pool',
+    'rpn_target_assign', 'retinanet_target_assign',
+    'generate_proposal_labels', 'locality_aware_nms',
 ]
 
 
@@ -447,4 +449,151 @@ def roi_pool(input, rois, pooled_height=1, pooled_width=1, spatial_scale=1.0,
                      attrs={"pooled_height": pooled_height,
                             "pooled_width": pooled_width,
                             "spatial_scale": spatial_scale})
+    return out
+
+
+def rpn_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                      gt_boxes, is_crowd=None, im_info=None,
+                      rpn_batch_size_per_im=256, rpn_straddle_thresh=0.0,
+                      rpn_fg_fraction=0.5, rpn_positive_overlap=0.7,
+                      rpn_negative_overlap=0.3, use_random=True):
+    """RPN training targets (ref detection.py rpn_target_assign /
+    rpn_target_assign_op.cc).  Dense form: gt_boxes (B, G, 4)
+    zero-padded; returns per-anchor tensors instead of LoD-compacted
+    samples — (scores_pred, loc_pred, labels (B, A), bbox_targets
+    (B, A, 4), bbox_inside_weights); multiply losses by the weights /
+    mask on labels >= 0 to reproduce the sampled-minibatch loss."""
+    helper = LayerHelper("rpn_target_assign")
+    a = anchor_box.shape[0] if anchor_box.shape else None
+    b = gt_boxes.shape[0] if gt_boxes.shape else None
+    labels = helper.create_variable_for_type_inference("int32", (b, a))
+    tgt = helper.create_variable_for_type_inference("float32", (b, a, 4))
+    inw = helper.create_variable_for_type_inference("float32", (b, a, 4))
+    outw = helper.create_variable_for_type_inference("float32",
+                                                     (b, a, 4))
+    inputs = {"Anchor": [anchor_box.name], "AnchorVar": [anchor_var.name],
+              "GtBoxes": [gt_boxes.name]}
+    if is_crowd is not None:
+        inputs["IsCrowd"] = [is_crowd.name]
+    if im_info is not None:
+        inputs["ImInfo"] = [im_info.name]
+    helper.append_op(
+        "rpn_target_assign", inputs=inputs,
+        outputs={"Labels": [labels.name], "BBoxTargets": [tgt.name],
+                 "BBoxInsideWeights": [inw.name],
+                 "BBoxOutsideWeights": [outw.name]},
+        attrs={"rpn_batch_size_per_im": rpn_batch_size_per_im,
+               "rpn_straddle_thresh": rpn_straddle_thresh,
+               "rpn_fg_fraction": rpn_fg_fraction,
+               "rpn_positive_overlap": rpn_positive_overlap,
+               "rpn_negative_overlap": rpn_negative_overlap,
+               "use_random": use_random})
+    for v in (labels, tgt, inw, outw):
+        v.stop_gradient = True
+    return cls_logits, bbox_pred, labels, tgt, inw
+
+
+def retinanet_target_assign(bbox_pred, cls_logits, anchor_box,
+                            anchor_var, gt_boxes, gt_labels, is_crowd=None,
+                            im_info=None, num_classes=1,
+                            positive_overlap=0.5, negative_overlap=0.4):
+    """RetinaNet training targets (ref detection.py
+    retinanet_target_assign): labels carry the 1-based gt class; no
+    subsampling (focal loss owns the imbalance).  Returns
+    (cls_logits, bbox_pred, labels (B, A), bbox_targets, inside_w,
+    fg_num (B, 1))."""
+    helper = LayerHelper("retinanet_target_assign")
+    a = anchor_box.shape[0] if anchor_box.shape else None
+    b = gt_boxes.shape[0] if gt_boxes.shape else None
+    labels = helper.create_variable_for_type_inference("int32", (b, a))
+    tgt = helper.create_variable_for_type_inference("float32", (b, a, 4))
+    inw = helper.create_variable_for_type_inference("float32", (b, a, 4))
+    outw = helper.create_variable_for_type_inference("float32",
+                                                     (b, a, 4))
+    fg = helper.create_variable_for_type_inference("int32", (b, 1))
+    inputs = {"Anchor": [anchor_box.name], "AnchorVar": [anchor_var.name],
+              "GtBoxes": [gt_boxes.name], "GtLabels": [gt_labels.name]}
+    if is_crowd is not None:
+        inputs["IsCrowd"] = [is_crowd.name]
+    if im_info is not None:
+        inputs["ImInfo"] = [im_info.name]
+    helper.append_op(
+        "retinanet_target_assign", inputs=inputs,
+        outputs={"Labels": [labels.name], "BBoxTargets": [tgt.name],
+                 "BBoxInsideWeights": [inw.name],
+                 "BBoxOutsideWeights": [outw.name],
+                 "ForegroundNumber": [fg.name]},
+        attrs={"positive_overlap": positive_overlap,
+               "negative_overlap": negative_overlap})
+    for v in (labels, tgt, inw, outw, fg):
+        v.stop_gradient = True
+    return cls_logits, bbox_pred, labels, tgt, inw, fg
+
+
+def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes,
+                             im_info=None, batch_size_per_im=512,
+                             fg_fraction=0.25, fg_thresh=0.5,
+                             bg_thresh_hi=0.5, bg_thresh_lo=0.0,
+                             bbox_reg_weights=(0.1, 0.1, 0.2, 0.2),
+                             class_nums=None, use_random=True,
+                             is_cls_agnostic=False,
+                             is_cascade_rcnn=False):
+    """Second-stage RoI sampling (ref detection.py
+    generate_proposal_labels).  Dense form: rois (B, R, 4); returns
+    (rois, labels (B, R) {-1,0,class}, bbox_targets (B, R, 4),
+    inside_w, outside_w)."""
+    helper = LayerHelper("generate_proposal_labels")
+    b = rpn_rois.shape[0] if rpn_rois.shape else None
+    r = rpn_rois.shape[1] if rpn_rois.shape else None
+    rois = helper.create_variable_for_type_inference("float32",
+                                                     (b, r, 4))
+    labels = helper.create_variable_for_type_inference("int32", (b, r))
+    tgt = helper.create_variable_for_type_inference("float32", (b, r, 4))
+    inw = helper.create_variable_for_type_inference("float32", (b, r, 4))
+    outw = helper.create_variable_for_type_inference("float32",
+                                                     (b, r, 4))
+    inputs = {"RpnRois": [rpn_rois.name], "GtClasses": [gt_classes.name],
+              "GtBoxes": [gt_boxes.name]}
+    if is_crowd is not None:
+        inputs["IsCrowd"] = [is_crowd.name]
+    if im_info is not None:
+        inputs["ImInfo"] = [im_info.name]
+    helper.append_op(
+        "generate_proposal_labels", inputs=inputs,
+        outputs={"Rois": [rois.name], "Labels": [labels.name],
+                 "BBoxTargets": [tgt.name],
+                 "BBoxInsideWeights": [inw.name],
+                 "BBoxOutsideWeights": [outw.name]},
+        attrs={"batch_size_per_im": batch_size_per_im,
+               "fg_fraction": fg_fraction, "fg_thresh": fg_thresh,
+               "bg_thresh_hi": bg_thresh_hi,
+               "bg_thresh_lo": bg_thresh_lo,
+               "bbox_reg_weights": list(bbox_reg_weights),
+               "use_random": use_random})
+    for v in (rois, labels, tgt, inw, outw):
+        v.stop_gradient = True
+    return rois, labels, tgt, inw, outw
+
+
+def locality_aware_nms(bboxes, scores, score_threshold, nms_top_k,
+                       keep_top_k, nms_threshold=0.3, normalized=True,
+                       nms_eta=1.0, background_label=-1, name=None):
+    """EAST-style locality-aware NMS (ref detection.py
+    locality_aware_nms): score-weighted merge of consecutive
+    overlapping boxes, then standard NMS.  bboxes (N, M, 4), scores
+    (N, C, M) -> (N, keep_top_k, 6)."""
+    helper = LayerHelper("locality_aware_nms", name=name)
+    n = bboxes.shape[0] if bboxes.shape else None
+    out = helper.create_variable_for_type_inference(
+        "float32", (n, keep_top_k, 6))
+    helper.append_op(
+        "locality_aware_nms",
+        inputs={"BBoxes": [bboxes.name], "Scores": [scores.name]},
+        outputs={"Out": [out.name]},
+        attrs={"score_threshold": score_threshold,
+               "nms_top_k": nms_top_k, "keep_top_k": keep_top_k,
+               "nms_threshold": nms_threshold,
+               "normalized": normalized, "nms_eta": nms_eta,
+               "background_label": background_label})
+    out.stop_gradient = True
     return out

@@ -450,19 +450,10 @@ class BeamSearchDecoder(Decoder):
     def finalize(self, outputs, final_states, sequence_lengths):
         """Back-trace parent_ids into coherent sequences: returns
         (predicted_ids (B, beam, T), final_states)."""
-        preds, parents = outputs.predicted_ids, outputs.parent_ids
-        T = len(preds)
-        hist = None
-        for t in range(T):
-            new_ids = _nn.reshape(preds[t], [-1, 1])
-            if hist is None:
-                hist = new_ids
-            else:
-                hist = _tensor.concat(
-                    [self._gather_flat(hist, parents[t]), new_ids],
-                    axis=1)
-        b = self.beam_size
-        return _nn.reshape(hist, [-1, b, T]), final_states
+        seqs, _ = beam_search_decode(
+            outputs.predicted_ids, outputs.parent_ids,
+            beam_size=self.beam_size, end_id=self.end_token)
+        return seqs, final_states
 
 
 def _compare_eq(x, y):

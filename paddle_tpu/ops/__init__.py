@@ -19,3 +19,4 @@ from . import misc_ops      # noqa: F401
 from . import extras_ops    # noqa: F401
 from . import loss_extra_ops  # noqa: F401
 from . import contrib_ops   # noqa: F401
+from . import detection_train_ops  # noqa: F401

@@ -1,0 +1,342 @@
+"""Two-stage / RetinaNet training-side detection ops
+(ref paddle/fluid/operators/detection/{rpn_target_assign_op,
+retinanet_detection_output_op,generate_proposal_labels_op,
+locality_aware_nms_op}.cc + python/paddle/fluid/layers/detection.py).
+
+Dense TPU redesign: the reference emits LoD-compacted samples (a
+variable number of sampled anchors/rois per image); XLA wants static
+shapes, so these kernels return FULL per-anchor/per-roi tensors plus
+{-1, 0, 1} label masks and 0/1 weight tensors — the downstream losses
+multiply by the weights, which is numerically identical to gathering
+the sampled subset.  Sampling uses the deterministic per-op PRNG
+(ctx.rng) with score-jitter top-k instead of host-side shuffles.
+"""
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+
+def _pairwise_iou(a, b):
+    """a (A, 4), b (G, 4) xyxy -> (A, G)."""
+    ax1, ay1, ax2, ay2 = a[:, 0], a[:, 1], a[:, 2], a[:, 3]
+    bx1, by1, bx2, by2 = b[:, 0], b[:, 1], b[:, 2], b[:, 3]
+    ix = jnp.maximum(
+        0.0, jnp.minimum(ax2[:, None], bx2[None]) -
+        jnp.maximum(ax1[:, None], bx1[None]))
+    iy = jnp.maximum(
+        0.0, jnp.minimum(ay2[:, None], by2[None]) -
+        jnp.maximum(ay1[:, None], by1[None]))
+    inter = ix * iy
+    area_a = jnp.maximum(ax2 - ax1, 0.0) * jnp.maximum(ay2 - ay1, 0.0)
+    area_b = jnp.maximum(bx2 - bx1, 0.0) * jnp.maximum(by2 - by1, 0.0)
+    union = area_a[:, None] + area_b[None] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+def _encode_boxes(anchors, gts):
+    """Faster-RCNN box regression targets: anchors/gts (N, 4) xyxy ->
+    (N, 4) [dx, dy, dw, dh]."""
+    aw = jnp.maximum(anchors[:, 2] - anchors[:, 0], 1e-6)
+    ah = jnp.maximum(anchors[:, 3] - anchors[:, 1], 1e-6)
+    ax = anchors[:, 0] + 0.5 * aw
+    ay = anchors[:, 1] + 0.5 * ah
+    gw = jnp.maximum(gts[:, 2] - gts[:, 0], 1e-6)
+    gh = jnp.maximum(gts[:, 3] - gts[:, 1], 1e-6)
+    gx = gts[:, 0] + 0.5 * gw
+    gy = gts[:, 1] + 0.5 * gh
+    return jnp.stack([(gx - ax) / aw, (gy - ay) / ah,
+                      jnp.log(gw / aw), jnp.log(gh / ah)], axis=1)
+
+
+def _sample_mask(key, eligible, count):
+    """Pick <=count True positions of ``eligible`` uniformly: random
+    scores, keep the count highest among eligible."""
+    r = jax.random.uniform(key, eligible.shape)
+    scored = jnp.where(eligible, r, -1.0)
+    n_keep = jnp.minimum(count, jnp.sum(eligible))
+    thresh = -jnp.sort(-scored)[jnp.maximum(n_keep - 1, 0)]
+    picked = eligible & (scored >= thresh)
+    return picked
+
+
+def _crowd_ignore(anchors, gt, crowd_mask, thresh):
+    """Anchors overlapping a crowd gt above ``thresh`` are ignored."""
+    iou = _pairwise_iou(anchors, gt)
+    crowd_iou = jnp.max(jnp.where(crowd_mask[None, :], iou, 0.0), axis=1)
+    return crowd_iou >= thresh
+
+
+def _inside_image(anchors, im_hw, straddle):
+    """Reference straddle rule: with straddle >= 0, anchors poking more
+    than ``straddle`` pixels outside the image are disabled."""
+    h, w = im_hw[0], im_hw[1]
+    return ((anchors[:, 0] >= -straddle) & (anchors[:, 1] >= -straddle) &
+            (anchors[:, 2] < w + straddle) &
+            (anchors[:, 3] < h + straddle))
+
+
+def _assign_one(key, anchors, gt, gt_valid, pos_iou, neg_iou,
+                batch_per_im, fg_frac, use_random, ignore_mask):
+    """Per-image RPN assignment: labels (A,) in {-1,0,1}, matched gt
+    index (A,), bbox targets (A, 4)."""
+    iou = _pairwise_iou(anchors, gt)
+    iou = jnp.where(gt_valid[None, :], iou, -1.0)
+    best_gt = jnp.argmax(iou, axis=1)
+    best_iou = jnp.max(iou, axis=1)
+    labels = jnp.full(anchors.shape[0], -1, jnp.int32)
+    labels = jnp.where(best_iou < neg_iou, 0, labels)
+    labels = jnp.where(best_iou >= pos_iou, 1, labels)
+    # every valid gt gets its best anchor as positive (the reference's
+    # "force at least one anchor per gt" rule)
+    best_anchor = jnp.argmax(jnp.where(gt_valid[None, :], iou, -1.0),
+                             axis=0)
+    force = jnp.zeros(anchors.shape[0], bool).at[best_anchor].set(
+        gt_valid)
+    labels = jnp.where(force, 1, labels)
+    labels = jnp.where(ignore_mask, -1, labels)
+
+    n_fg = jnp.int32(batch_per_im * fg_frac)
+    k1, k2 = jax.random.split(key)
+    if use_random:
+        fg_pick = _sample_mask(k1, labels == 1, n_fg)
+    else:
+        idx = jnp.cumsum((labels == 1).astype(jnp.int32))
+        fg_pick = (labels == 1) & (idx <= n_fg)
+    n_bg = jnp.int32(batch_per_im) - jnp.sum(fg_pick)
+    if use_random:
+        bg_pick = _sample_mask(k2, labels == 0, n_bg)
+    else:
+        idxb = jnp.cumsum((labels == 0).astype(jnp.int32))
+        bg_pick = (labels == 0) & (idxb <= n_bg)
+    labels = jnp.where(fg_pick, 1, jnp.where(bg_pick, 0, -1))
+    tgt = _encode_boxes(anchors, gt[best_gt])
+    return labels, best_gt, tgt
+
+
+@register_op("rpn_target_assign",
+             nondiff=("Anchor", "AnchorVar", "GtBoxes", "IsCrowd",
+                      "ImInfo"), differentiable=False)
+def _rpn_target_assign(ctx, ins, attrs):
+    """Dense RPN targets (ref rpn_target_assign_op.cc): anchors (A, 4),
+    gt_boxes (B, G, 4) zero-padded.  Returns per-anchor tensors:
+    Labels (B, A) {-1 ignore, 0 bg, 1 fg}, BBoxTargets (B, A, 4),
+    InsideWeights/OutsideWeights (B, A, 4) 1 on sampled foreground."""
+    anchors = ins["Anchor"][0].reshape(-1, 4)
+    gt = ins["GtBoxes"][0]
+    b = gt.shape[0]
+    crowd = ins["IsCrowd"][0].reshape(b, -1).astype(bool) \
+        if ins.get("IsCrowd") else None
+    im_info = ins["ImInfo"][0] if ins.get("ImInfo") else None
+    straddle = attrs.get("rpn_straddle_thresh", 0.0)
+    gt_valid = jnp.any(gt != 0.0, axis=2)
+    if crowd is not None:
+        gt_valid = gt_valid & ~crowd
+    keys = jax.random.split(ctx.rng(), b)
+
+    def per_image(k, g, v, cm, hw):
+        ignore = _crowd_ignore(
+            anchors, g, cm, attrs.get("rpn_negative_overlap", 0.3))
+        if straddle >= 0:
+            ignore = ignore | ~_inside_image(anchors, hw, straddle)
+        return _assign_one(
+            k, anchors, g, v,
+            attrs.get("rpn_positive_overlap", 0.7),
+            attrs.get("rpn_negative_overlap", 0.3),
+            attrs.get("rpn_batch_size_per_im", 256),
+            attrs.get("rpn_fg_fraction", 0.5),
+            attrs.get("use_random", True), ignore)
+
+    labels, best_gt, tgt = jax.vmap(per_image)(
+        keys, gt, gt_valid,
+        crowd if crowd is not None else jnp.zeros(
+            (b, gt.shape[1]), bool),
+        im_info[:, :2] if im_info is not None else jnp.full(
+            (b, 2), jnp.inf))
+    fg = (labels == 1).astype(jnp.float32)[..., None]
+    return {"Labels": labels, "BBoxTargets": tgt * fg,
+            "BBoxInsideWeights": jnp.broadcast_to(fg, tgt.shape),
+            "BBoxOutsideWeights": jnp.broadcast_to(fg, tgt.shape)}
+
+
+@register_op("retinanet_target_assign",
+             nondiff=("Anchor", "AnchorVar", "GtBoxes", "GtLabels",
+                      "IsCrowd", "ImInfo"), differentiable=False)
+def _retinanet_target_assign(ctx, ins, attrs):
+    """RetinaNet targets (ref retinanet_target_assign): like RPN but
+    no sampling (focal loss handles imbalance); positives iou >= 0.5,
+    negatives < 0.4, rest ignored.  Labels carry the gt CLASS (1-based;
+    0 = background, -1 = ignore); also returns ForegroundNumber."""
+    anchors = ins["Anchor"][0].reshape(-1, 4)
+    gt = ins["GtBoxes"][0]
+    gt_labels = ins["GtLabels"][0]
+    if gt_labels.ndim == 3:
+        gt_labels = gt_labels[..., 0]
+    gt_valid = jnp.any(gt != 0.0, axis=2)
+    if ins.get("IsCrowd"):
+        gt_valid = gt_valid & ~ins["IsCrowd"][0].reshape(
+            gt_valid.shape).astype(bool)
+    pos = attrs.get("positive_overlap", 0.5)
+    neg = attrs.get("negative_overlap", 0.4)
+
+    def one(g, gl, v):
+        iou = jnp.where(v[None, :], _pairwise_iou(anchors, g), -1.0)
+        best_gt = jnp.argmax(iou, axis=1)
+        best_iou = jnp.max(iou, axis=1)
+        cls = gl[best_gt].astype(jnp.int32)
+        labels = jnp.full(anchors.shape[0], -1, jnp.int32)
+        labels = jnp.where(best_iou < neg, 0, labels)
+        labels = jnp.where(best_iou >= pos, cls, labels)
+        best_anchor = jnp.argmax(iou, axis=0)
+        labels = labels.at[best_anchor].set(
+            jnp.where(v, gl.astype(jnp.int32), labels[best_anchor]))
+        tgt = _encode_boxes(anchors, g[best_gt])
+        return labels, tgt
+
+    labels, tgt = jax.vmap(one)(gt, gt_labels, gt_valid)
+    fg = (labels >= 1).astype(jnp.float32)[..., None]
+    fg_num = jnp.maximum(jnp.sum(fg.reshape(labels.shape[0], -1),
+                                 axis=1), 1.0).astype(jnp.int32)
+    return {"Labels": labels, "BBoxTargets": tgt * fg,
+            "BBoxInsideWeights": jnp.broadcast_to(fg, tgt.shape),
+            "BBoxOutsideWeights": jnp.broadcast_to(fg, tgt.shape),
+            "ForegroundNumber": fg_num.reshape(-1, 1)}
+
+
+@register_op("generate_proposal_labels",
+             nondiff=("RpnRois", "GtClasses", "IsCrowd", "GtBoxes",
+                      "ImInfo"), differentiable=False)
+def _generate_proposal_labels(ctx, ins, attrs):
+    """Second-stage RoI sampling (ref generate_proposal_labels_op.cc),
+    dense form: rois (B, R, 4), gts (B, G, 4)+classes.  Returns all R
+    rois per image with Labels (B, R) {-1 ignore, 0 bg, class fg},
+    BBoxTargets (B, R, 4) and inside/outside weights."""
+    rois = ins["RpnRois"][0]
+    gt = ins["GtBoxes"][0]
+    classes = ins["GtClasses"][0]
+    if classes.ndim == 3:
+        classes = classes[..., 0]
+    b = rois.shape[0]
+    gt_valid = jnp.any(gt != 0.0, axis=2)
+    if ins.get("IsCrowd"):
+        gt_valid = gt_valid & ~ins["IsCrowd"][0].reshape(
+            gt_valid.shape).astype(bool)
+    fg_th = attrs.get("fg_thresh", 0.5)
+    bg_hi = attrs.get("bg_thresh_hi", 0.5)
+    bg_lo = attrs.get("bg_thresh_lo", 0.0)
+    batch = attrs.get("batch_size_per_im", 512)
+    fg_frac = attrs.get("fg_fraction", 0.25)
+    use_random = attrs.get("use_random", True)
+    reg_w = jnp.asarray(attrs.get("bbox_reg_weights",
+                                  [0.1, 0.1, 0.2, 0.2]), jnp.float32)
+    keys = jax.random.split(ctx.rng(), b)
+
+    def one(key, r, g, gl, v):
+        iou = jnp.where(v[None, :], _pairwise_iou(r, g), -1.0)
+        best_gt = jnp.argmax(iou, axis=1)
+        best_iou = jnp.max(iou, axis=1)
+        is_fg = best_iou >= fg_th
+        is_bg = (best_iou < bg_hi) & (best_iou >= bg_lo)
+        k1, k2 = jax.random.split(key)
+        n_fg = jnp.int32(batch * fg_frac)
+        if use_random:
+            fg_pick = _sample_mask(k1, is_fg, n_fg)
+            bg_pick = _sample_mask(
+                k2, is_bg, jnp.int32(batch) - jnp.sum(fg_pick))
+        else:
+            idx_fg = jnp.cumsum(is_fg.astype(jnp.int32))
+            fg_pick = is_fg & (idx_fg <= n_fg)
+            idx_bg = jnp.cumsum(is_bg.astype(jnp.int32))
+            bg_pick = is_bg & (idx_bg <= jnp.int32(batch) -
+                               jnp.sum(fg_pick))
+        cls = gl[best_gt].astype(jnp.int32)
+        labels = jnp.where(fg_pick, cls,
+                           jnp.where(bg_pick, 0, -1))
+        # fluid convention: targets divided by bbox_reg_weights
+        tgt = _encode_boxes(r, g[best_gt]) / reg_w[None, :]
+        return labels, tgt
+
+    labels, tgt = jax.vmap(one)(keys, rois, gt, classes, gt_valid)
+    fg = (labels >= 1).astype(jnp.float32)[..., None]
+    return {"Rois": rois, "Labels": labels, "BBoxTargets": tgt * fg,
+            "BBoxInsideWeights": jnp.broadcast_to(fg, tgt.shape),
+            "BBoxOutsideWeights": jnp.broadcast_to(fg, tgt.shape)}
+
+
+@register_op("locality_aware_nms", nondiff=("BBoxes", "Scores"),
+             differentiable=False)
+def _locality_aware_nms(ctx, ins, attrs):
+    """EAST-style locality-aware NMS (ref locality_aware_nms_op.cc):
+    consecutive boxes with IoU above the threshold are merged by
+    score-weighted averaging before standard class NMS.  Dense form:
+    boxes (N, M, 4), scores (N, C, M); output (N, keep_top_k, 6)
+    rows [label, score, x1, y1, x2, y2], -1-padded."""
+    from .detection_ops import _nms_alive
+    boxes = ins["BBoxes"][0]
+    scores = ins["Scores"][0]
+    iou_th = attrs.get("nms_threshold", 0.3)
+    score_th = attrs.get("score_threshold", 0.0)
+    keep_top_k = int(attrs.get("keep_top_k", 100))
+    nms_top_k = int(attrs.get("nms_top_k", -1))
+    normalized = attrs.get("normalized", True)
+    nms_eta = attrs.get("nms_eta", 1.0)
+    background = int(attrs.get("background_label", -1))
+    n, c, m = scores.shape
+
+    def merge_row(bx, sc):
+        # weighted-merge sweep: each box merges into its predecessor
+        # when IoU > threshold (locality assumption: boxes arrive in
+        # reading order)
+        iou_prev = jax.vmap(
+            lambda i: _pairwise_iou(bx[i][None], bx[i - 1][None])[0, 0]
+        )(jnp.arange(1, m))
+        merge = jnp.concatenate([jnp.zeros(1), iou_prev]) > iou_th
+        # segment ids: increase where not merging
+        seg = jnp.cumsum(~merge)
+        w = jnp.maximum(sc, 0.0)
+        seg_w = jax.ops.segment_sum(w, seg, num_segments=m + 1)
+        seg_box = jax.ops.segment_sum(bx * w[:, None], seg,
+                                      num_segments=m + 1)
+        seg_s = jax.ops.segment_sum(sc, seg, num_segments=m + 1) / \
+            jnp.maximum(jax.ops.segment_sum(jnp.ones_like(sc), seg,
+                                            num_segments=m + 1), 1.0)
+        merged_box = seg_box / jnp.maximum(seg_w[:, None], 1e-8)
+        # scatter back to first index of each segment
+        first = jnp.concatenate([jnp.ones(1, bool), ~merge[1:]]) \
+            if m > 1 else jnp.ones(1, bool)
+        out_b = jnp.where(first[:, None], merged_box[seg], 0.0)
+        out_s = jnp.where(first, seg_s[seg], -1.0)
+        return out_b, out_s
+
+    def per_image(bx, sc_all):
+        rows = []
+        for cls in range(c):
+            if cls == background:
+                continue
+            mb, ms = merge_row(bx, sc_all[cls])
+            if 0 < nms_top_k < m:
+                # pre-truncate to the nms_top_k best candidates
+                kth = -jnp.sort(-ms)[nms_top_k - 1]
+                ms = jnp.where(ms >= kth, ms, -1.0)
+            alive = _nms_alive(mb, ms, iou_th, score_th,
+                               normalized=normalized,
+                               nms_eta=nms_eta)
+            s = jnp.where(alive, ms, -1.0)
+            rows.append((s, mb, jnp.full(m, cls, jnp.float32)))
+        s = jnp.concatenate([r[0] for r in rows])
+        bb = jnp.concatenate([r[1] for r in rows])
+        lab = jnp.concatenate([r[2] for r in rows])
+        k = min(keep_top_k, int(s.shape[0]))
+        top_s, idx = jax.lax.top_k(s, k)
+        keep = top_s > score_th
+        out = jnp.concatenate(
+            [jnp.where(keep, lab[idx], -1.0)[:, None],
+             jnp.where(keep, top_s, -1.0)[:, None],
+             jnp.where(keep[:, None], bb[idx], 0.0)], axis=1)
+        if k < keep_top_k:
+            pad = jnp.full((keep_top_k - k, 6), -1.0, out.dtype)
+            pad = pad.at[:, 2:].set(0.0)
+            out = jnp.concatenate([out, pad], axis=0)
+        return out
+
+    return {"Out": jax.vmap(per_image)(boxes, scores)}

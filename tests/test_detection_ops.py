@@ -569,3 +569,128 @@ def test_roi_align_layer_trains():
     for _ in range(10):
         l1 = float(exe.run(main, feed=feed, fetch_list=[loss])[0])
     assert l1 < l0
+
+
+def test_rpn_target_assign_dense():
+    """RPN targets (ref rpn_target_assign_op): high-IoU anchors become
+    fg, far anchors bg, targets encode the matched gt."""
+    anchors = np.array([[0, 0, 10, 10], [0, 0, 9, 11], [50, 50, 60, 60],
+                        [100, 100, 120, 120]], np.float32)
+    gts = np.zeros((1, 3, 4), np.float32)
+    gts[0, 0] = [0, 0, 10, 10]            # matches anchors 0/1
+    gts[0, 1] = [101, 101, 119, 121]      # matches anchor 3
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        a = layers.data("a", [4, 4], "float32", append_batch_size=False)
+        av = layers.data("av", [4, 4], "float32",
+                         append_batch_size=False)
+        g = layers.data("g", [1, 3, 4], "float32",
+                        append_batch_size=False)
+        bp = layers.data("bp", [1, 4, 4], "float32",
+                         append_batch_size=False)
+        cl = layers.data("cl", [1, 4, 1], "float32",
+                         append_batch_size=False)
+        sp, lp, labels, tgt, inw = layers.rpn_target_assign(
+            bp, cl, a, av, g, use_random=False)
+    exe = pt.Executor()
+    exe.run(startup)
+    lab, t, w = exe.run(main, feed={
+        "a": anchors, "av": np.ones_like(anchors), "g": gts,
+        "bp": np.zeros((1, 4, 4), np.float32),
+        "cl": np.zeros((1, 4, 1), np.float32)},
+        fetch_list=[labels, tgt, inw])
+    lab = np.asarray(lab)[0]
+    t = np.asarray(t)[0]
+    w = np.asarray(w)[0]
+    assert lab[0] == 1 and lab[3] == 1          # matched anchors fg
+    assert lab[2] == 0                          # isolated anchor bg
+    assert np.all(w[lab == 1] == 1.0) and np.all(w[lab != 1] == 0.0)
+    # anchor 0 == its gt exactly: zero regression target
+    np.testing.assert_allclose(t[0], 0.0, atol=1e-5)
+    assert np.abs(t[3]).sum() > 0               # anchor 3 offset gt
+
+
+def test_retinanet_target_assign_classes_and_fg_num():
+    anchors = np.array([[0, 0, 10, 10], [40, 40, 50, 50],
+                        [200, 200, 210, 210]], np.float32)
+    gts = np.zeros((1, 2, 4), np.float32)
+    gts[0, 0] = [0, 0, 10, 10]
+    gts[0, 1] = [41, 41, 49, 49]
+    gl = np.array([[3, 7]], np.int64)
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        a = layers.data("a", [3, 4], "float32", append_batch_size=False)
+        av = layers.data("av", [3, 4], "float32",
+                         append_batch_size=False)
+        g = layers.data("g", [1, 2, 4], "float32",
+                        append_batch_size=False)
+        glv = layers.data("gl", [1, 2], "int64",
+                          append_batch_size=False)
+        bp = layers.data("bp", [1, 3, 4], "float32",
+                         append_batch_size=False)
+        cl = layers.data("cl", [1, 3, 1], "float32",
+                         append_batch_size=False)
+        _, _, labels, tgt, inw, fg = layers.retinanet_target_assign(
+            bp, cl, a, av, g, glv)
+    exe = pt.Executor()
+    exe.run(startup)
+    lab, fgn = exe.run(main, feed={
+        "a": anchors, "av": np.ones_like(anchors), "g": gts, "gl": gl,
+        "bp": np.zeros((1, 3, 4), np.float32),
+        "cl": np.zeros((1, 3, 1), np.float32)},
+        fetch_list=[labels, fg])
+    lab = np.asarray(lab)[0]
+    assert lab[0] == 3 and lab[1] == 7          # class-carrying labels
+    assert lab[2] == 0                          # background
+    assert int(np.asarray(fgn).reshape(-1)[0]) == 2
+
+
+def test_generate_proposal_labels_dense():
+    rois = np.zeros((1, 4, 4), np.float32)
+    rois[0] = [[0, 0, 10, 10], [1, 1, 11, 11], [60, 60, 70, 70],
+               [200, 200, 230, 230]]
+    gts = np.zeros((1, 1, 4), np.float32)
+    gts[0, 0] = [0, 0, 10, 10]
+    cls = np.array([[5]], np.int64)
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        r = layers.data("r", [1, 4, 4], "float32",
+                        append_batch_size=False)
+        g = layers.data("g", [1, 1, 4], "float32",
+                        append_batch_size=False)
+        c = layers.data("c", [1, 1], "int64", append_batch_size=False)
+        rois_o, labels, tgt, inw, outw = layers.generate_proposal_labels(
+            r, c, None, g, batch_size_per_im=4, fg_fraction=0.5)
+    exe = pt.Executor()
+    exe.run(startup)
+    lab, = exe.run(main, feed={"r": rois, "g": gts, "c": cls},
+                   fetch_list=[labels])
+    lab = np.asarray(lab)[0]
+    assert lab[0] == 5 and lab[1] == 5          # fg rois carry gt class
+    assert (lab[2] in (0, -1)) and (lab[3] in (0, -1))
+
+
+def test_locality_aware_nms_merges_neighbors():
+    # two heavily-overlapping consecutive boxes merge into one detection
+    boxes = np.array([[[0, 0, 10, 10], [0.5, 0, 10.5, 10],
+                       [50, 50, 60, 60]]], np.float32)
+    scores = np.array([[[0.9, 0.8, 0.7]]], np.float32)  # (1, C=1, 3)
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        b = layers.data("b", [1, 3, 4], "float32",
+                        append_batch_size=False)
+        s = layers.data("s", [1, 1, 3], "float32",
+                        append_batch_size=False)
+        out = layers.locality_aware_nms(b, s, score_threshold=0.1,
+                                        nms_top_k=10, keep_top_k=5,
+                                        nms_threshold=0.5)
+    exe = pt.Executor()
+    exe.run(startup)
+    o, = exe.run(main, feed={"b": boxes, "s": scores},
+                 fetch_list=[out])
+    o = np.asarray(o)[0]
+    kept = o[o[:, 1] > 0]
+    assert len(kept) == 2                       # merged pair + far box
+    # merged box x1 between the two originals, score = pair average
+    assert 0.0 < kept[0, 2] < 0.5
+    assert abs(kept[0, 1] - 0.85) < 1e-5
