@@ -28,7 +28,8 @@ from .detection import (  # noqa: F401
     distribute_fpn_proposals, collect_fpn_proposals, box_decoder_and_assign,
     generate_proposals, roi_align, roi_pool, rpn_target_assign,
     retinanet_target_assign, generate_proposal_labels,
-    locality_aware_nms)
+    locality_aware_nms, retinanet_detection_output,
+    roi_perspective_transform, generate_mask_labels)
 # NOTE: binding the `rnn` FUNCTION here shadows the layers.rnn submodule
 # attribute — fluid 1.6 has the same shadowing (layers.rnn is the scan
 # entry point; reach the legacy module via `from paddle_tpu.layers import

@@ -15,6 +15,8 @@ __all__ = [
     'box_decoder_and_assign', 'generate_proposals', 'roi_align', 'roi_pool',
     'rpn_target_assign', 'retinanet_target_assign',
     'generate_proposal_labels', 'locality_aware_nms',
+    'retinanet_detection_output', 'roi_perspective_transform',
+    'generate_mask_labels',
 ]
 
 
@@ -542,6 +544,11 @@ def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes,
     generate_proposal_labels).  Dense form: rois (B, R, 4); returns
     (rois, labels (B, R) {-1,0,class}, bbox_targets (B, R, 4),
     inside_w, outside_w)."""
+    if is_cls_agnostic or is_cascade_rcnn:
+        raise NotImplementedError(
+            "generate_proposal_labels: is_cls_agnostic / "
+            "is_cascade_rcnn modes are not implemented in the dense "
+            "redesign")
     helper = LayerHelper("generate_proposal_labels")
     b = rpn_rois.shape[0] if rpn_rois.shape else None
     r = rpn_rois.shape[1] if rpn_rois.shape else None
@@ -597,3 +604,86 @@ def locality_aware_nms(bboxes, scores, score_threshold, nms_top_k,
                "background_label": background_label})
     out.stop_gradient = True
     return out
+
+
+def retinanet_detection_output(bboxes, scores, anchors, im_info,
+                               score_threshold=0.05, nms_top_k=1000,
+                               keep_top_k=100, nms_threshold=0.3,
+                               nms_eta=1.0):
+    """RetinaNet inference head (ref detection.py
+    retinanet_detection_output): per-FPN-level deltas/scores/anchors
+    lists; decode + clip + class NMS -> (B, keep_top_k, 6)."""
+    helper = LayerHelper("retinanet_detection_output")
+    b = bboxes[0].shape[0] if bboxes[0].shape else None
+    out = helper.create_variable_for_type_inference(
+        "float32", (b, keep_top_k, 6))
+    helper.append_op(
+        "retinanet_detection_output",
+        inputs={"BBoxes": [v.name for v in bboxes],
+                "Scores": [v.name for v in scores],
+                "Anchors": [v.name for v in anchors],
+                "ImInfo": [im_info.name]},
+        outputs={"Out": [out.name]},
+        attrs={"score_threshold": score_threshold,
+               "nms_top_k": nms_top_k, "keep_top_k": keep_top_k,
+               "nms_threshold": nms_threshold, "nms_eta": nms_eta})
+    out.stop_gradient = True
+    return out
+
+
+def roi_perspective_transform(input, rois, transformed_height,
+                              transformed_width, spatial_scale=1.0):
+    """Perspective roi crops for rotated-text heads (ref detection.py
+    roi_perspective_transform).  Dense form: rois (B, R, 8) quads ->
+    (B, R, C, out_h, out_w)."""
+    helper = LayerHelper("roi_perspective_transform")
+    b = input.shape[0] if input.shape else None
+    r = rois.shape[1] if rois.shape else None
+    c = input.shape[1] if input.shape else None
+    out = helper.create_variable_for_type_inference(
+        input.dtype, (b, r, c, transformed_height, transformed_width))
+    helper.append_op(
+        "roi_perspective_transform",
+        inputs={"X": [input.name], "ROIs": [rois.name]},
+        outputs={"Out": [out.name]},
+        attrs={"transformed_height": transformed_height,
+               "transformed_width": transformed_width,
+               "spatial_scale": spatial_scale})
+    return out
+
+
+def generate_mask_labels(im_info, gt_classes, is_crowd, gt_segms, rois,
+                         labels_int32, num_classes, resolution,
+                         gt_boxes=None):
+    """Mask-RCNN mask targets (ref detection.py generate_mask_labels).
+    Dense contract: gt_segms (B, G, S, S) bitmaps registered to
+    gt_boxes (B, G, 4); rois (B, R, 4); labels_int32 (B, R) from
+    generate_proposal_labels.  Returns (mask_rois, roi_has_mask_int32,
+    mask_int32 (B, R, num_classes*res*res), -1 = ignore)."""
+    if gt_boxes is None:
+        raise ValueError(
+            "dense generate_mask_labels needs gt_boxes (B, G, 4): the "
+            "bitmaps in gt_segms are registered to them")
+    helper = LayerHelper("generate_mask_labels")
+    b = rois.shape[0] if rois.shape else None
+    r = rois.shape[1] if rois.shape else None
+    mask_rois = helper.create_variable_for_type_inference(
+        "float32", (b, r, 4))
+    has_mask = helper.create_variable_for_type_inference("int32", (b, r))
+    mask = helper.create_variable_for_type_inference(
+        "int32", (b, r, num_classes * resolution * resolution))
+    inputs = {"ImInfo": [im_info.name], "GtClasses": [gt_classes.name],
+              "GtSegms": [gt_segms.name], "Rois": [rois.name],
+              "LabelsInt32": [labels_int32.name],
+              "GtBoxes": [gt_boxes.name]}
+    if is_crowd is not None:
+        inputs["IsCrowd"] = [is_crowd.name]
+    helper.append_op(
+        "generate_mask_labels", inputs=inputs,
+        outputs={"MaskRois": [mask_rois.name],
+                 "RoiHasMaskInt32": [has_mask.name],
+                 "MaskInt32": [mask.name]},
+        attrs={"num_classes": num_classes, "resolution": resolution})
+    for v in (mask_rois, has_mask, mask):
+        v.stop_gradient = True
+    return mask_rois, has_mask, mask

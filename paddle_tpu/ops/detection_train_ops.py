@@ -56,8 +56,9 @@ def _sample_mask(key, eligible, count):
     scored = jnp.where(eligible, r, -1.0)
     n_keep = jnp.minimum(count, jnp.sum(eligible))
     thresh = -jnp.sort(-scored)[jnp.maximum(n_keep - 1, 0)]
-    picked = eligible & (scored >= thresh)
-    return picked
+    # n_keep == 0 would otherwise degrade thresh to the max score and
+    # still pick one element
+    return eligible & (scored >= thresh) & (n_keep > 0)
 
 
 def _crowd_ignore(anchors, gt, crowd_mask, thresh):
@@ -91,8 +92,11 @@ def _assign_one(key, anchors, gt, gt_valid, pos_iou, neg_iou,
     # "force at least one anchor per gt" rule)
     best_anchor = jnp.argmax(jnp.where(gt_valid[None, :], iou, -1.0),
                              axis=0)
-    force = jnp.zeros(anchors.shape[0], bool).at[best_anchor].set(
-        gt_valid)
+    # duplicate indices (every padded gt argmaxes to anchor 0) must not
+    # clobber a valid gt's write — route invalid gts out of bounds
+    safe_anchor = jnp.where(gt_valid, best_anchor, anchors.shape[0])
+    force = jnp.zeros(anchors.shape[0], bool).at[safe_anchor].set(
+        True, mode="drop")
     labels = jnp.where(force, 1, labels)
     labels = jnp.where(ignore_mask, -1, labels)
 
@@ -187,9 +191,10 @@ def _retinanet_target_assign(ctx, ins, attrs):
         labels = jnp.full(anchors.shape[0], -1, jnp.int32)
         labels = jnp.where(best_iou < neg, 0, labels)
         labels = jnp.where(best_iou >= pos, cls, labels)
-        best_anchor = jnp.argmax(iou, axis=0)
-        labels = labels.at[best_anchor].set(
-            jnp.where(v, gl.astype(jnp.int32), labels[best_anchor]))
+        best_anchor = jnp.where(v, jnp.argmax(iou, axis=0),
+                                anchors.shape[0])
+        labels = labels.at[best_anchor].set(gl.astype(jnp.int32),
+                                            mode="drop")
         tgt = _encode_boxes(anchors, g[best_gt])
         return labels, tgt
 
@@ -340,3 +345,215 @@ def _locality_aware_nms(ctx, ins, attrs):
         return out
 
     return {"Out": jax.vmap(per_image)(boxes, scores)}
+
+
+def _decode_boxes(anchors, deltas, variance=None):
+    """Inverse of _encode_boxes: anchors (A, 4) + deltas (A, 4) -> boxes
+    (A, 4) xyxy."""
+    if variance is not None:
+        deltas = deltas * jnp.asarray(variance, deltas.dtype)[None, :]
+    aw = jnp.maximum(anchors[:, 2] - anchors[:, 0], 1e-6)
+    ah = jnp.maximum(anchors[:, 3] - anchors[:, 1], 1e-6)
+    ax = anchors[:, 0] + 0.5 * aw
+    ay = anchors[:, 1] + 0.5 * ah
+    cx = deltas[:, 0] * aw + ax
+    cy = deltas[:, 1] * ah + ay
+    w = jnp.exp(jnp.minimum(deltas[:, 2], 10.0)) * aw
+    h = jnp.exp(jnp.minimum(deltas[:, 3], 10.0)) * ah
+    return jnp.stack([cx - 0.5 * w, cy - 0.5 * h,
+                      cx + 0.5 * w, cy + 0.5 * h], axis=1)
+
+
+@register_op("retinanet_detection_output",
+             nondiff=("BBoxes", "Scores", "Anchors", "ImInfo"),
+             differentiable=False)
+def _retinanet_detection_output(ctx, ins, attrs):
+    """RetinaNet inference head (ref retinanet_detection_output_op.cc):
+    per-level box deltas (B, A_l, 4) + sigmoid scores (B, A_l, C) +
+    anchors (A_l, 4), decoded, clipped to im_info, then per-class NMS.
+    Out (B, keep_top_k, 6) rows [label, score, x1, y1, x2, y2]."""
+    from .detection_ops import _nms_alive
+    deltas_list = ins["BBoxes"]
+    scores_list = ins["Scores"]
+    anchors_list = ins["Anchors"]
+    im_info = ins["ImInfo"][0]
+    score_th = attrs.get("score_threshold", 0.05)
+    nms_th = attrs.get("nms_threshold", 0.3)
+    nms_eta = attrs.get("nms_eta", 1.0)
+    nms_top_k = int(attrs.get("nms_top_k", 1000))
+    keep_top_k = int(attrs.get("keep_top_k", 100))
+
+    def per_image(deltas_i, scores_i, hw):
+        boxes, scores = [], []
+        for d, s, a in zip(deltas_i, scores_i, anchors_list):
+            dec = _decode_boxes(a.reshape(-1, 4), d.reshape(-1, 4))
+            dec = jnp.stack([
+                jnp.clip(dec[:, 0], 0, hw[1] - 1),
+                jnp.clip(dec[:, 1], 0, hw[0] - 1),
+                jnp.clip(dec[:, 2], 0, hw[1] - 1),
+                jnp.clip(dec[:, 3], 0, hw[0] - 1)], axis=1)
+            boxes.append(dec)
+            scores.append(s.reshape(dec.shape[0], -1))
+        boxes = jnp.concatenate(boxes)          # (A, 4)
+        scores = jnp.concatenate(scores)        # (A, C)
+        a_tot, c = scores.shape
+        outs = []
+        for cls in range(c):
+            sc = scores[:, cls]
+            if 0 < nms_top_k < a_tot:
+                kth = -jnp.sort(-sc)[nms_top_k - 1]
+                sc = jnp.where(sc >= kth, sc, -1.0)
+            alive = _nms_alive(boxes, sc, nms_th, score_th,
+                               nms_eta=nms_eta)
+            outs.append((jnp.where(alive, sc, -1.0), boxes,
+                         jnp.full(a_tot, cls + 1, jnp.float32)))
+        s = jnp.concatenate([o[0] for o in outs])
+        bb = jnp.concatenate([o[1] for o in outs])
+        lab = jnp.concatenate([o[2] for o in outs])
+        k = min(keep_top_k, int(s.shape[0]))
+        top_s, idx = jax.lax.top_k(s, k)
+        keep = top_s > score_th
+        out = jnp.concatenate(
+            [jnp.where(keep, lab[idx], -1.0)[:, None],
+             jnp.where(keep, top_s, -1.0)[:, None],
+             jnp.where(keep[:, None], bb[idx], 0.0)], axis=1)
+        if k < keep_top_k:
+            pad = jnp.full((keep_top_k - k, 6), -1.0, out.dtype)
+            out = jnp.concatenate([out, pad.at[:, 2:].set(0.0)], axis=0)
+        return out
+
+    out = jax.vmap(lambda ds, ss, hw: per_image(list(ds), list(ss),
+                                                hw))(
+        tuple(deltas_list), tuple(scores_list), im_info[:, :2])
+    return {"Out": out}
+
+
+@register_op("roi_perspective_transform", nondiff=("ROIs",))
+def _roi_perspective_transform(ctx, ins, attrs):
+    """Perspective-warp roi crops (ref roi_perspective_transform_op.cc):
+    input (N, C, H, W); rois (N, R, 8) quads [x1 y1 ... x4 y4] in
+    clockwise order (image coordinates x spatial_scale); output
+    (N, R, C, out_h, out_w) bilinear-sampled through the homography
+    mapping the output grid onto each quad."""
+    x = ins["X"][0]
+    rois = ins["ROIs"][0]
+    out_h = int(attrs.get("transformed_height", 8))
+    out_w = int(attrs.get("transformed_width", 8))
+    scale = attrs.get("spatial_scale", 1.0)
+    n, c, h, w = x.shape
+
+    def solve_h(quad):
+        """Homography sending (0,0),(w-1,0),(w-1,h-1),(0,h-1) of the
+        OUTPUT grid to the quad's 4 corners (8-dof DLT solve)."""
+        src = jnp.asarray(
+            [[0, 0], [out_w - 1, 0], [out_w - 1, out_h - 1],
+             [0, out_h - 1]], jnp.float32)
+        dst = quad.reshape(4, 2) * scale
+        rows = []
+        for i in range(4):
+            sx, sy = src[i, 0], src[i, 1]
+            dx, dy = dst[i, 0], dst[i, 1]
+            rows.append(jnp.asarray(
+                [sx, sy, 1, 0, 0, 0, 0, 0], jnp.float32
+            ).at[6].set(-dx * sx).at[7].set(-dx * sy))
+            rows.append(jnp.asarray(
+                [0, 0, 0, sx, sy, 1, 0, 0], jnp.float32
+            ).at[6].set(-dy * sx).at[7].set(-dy * sy))
+        A = jnp.stack(rows)
+        bvec = dst.reshape(-1)
+        sol = jnp.linalg.solve(
+            A + 1e-6 * jnp.eye(8, dtype=jnp.float32), bvec)
+        return jnp.concatenate([sol, jnp.ones(1, jnp.float32)]
+                               ).reshape(3, 3)
+
+    yy, xx = jnp.meshgrid(jnp.arange(out_h, dtype=jnp.float32),
+                          jnp.arange(out_w, dtype=jnp.float32),
+                          indexing="ij")
+    grid = jnp.stack([xx.reshape(-1), yy.reshape(-1),
+                      jnp.ones(out_h * out_w, jnp.float32)])  # (3, P)
+
+    def sample_one(img, quad):
+        H = solve_h(quad)
+        pts = H @ grid
+        px = pts[0] / jnp.maximum(pts[2], 1e-6)
+        py = pts[1] / jnp.maximum(pts[2], 1e-6)
+        x0 = jnp.floor(px); y0 = jnp.floor(py)
+        fx = px - x0; fy = py - y0
+        def at(ix, iy):
+            ix = jnp.clip(ix, 0, w - 1).astype(jnp.int32)
+            iy = jnp.clip(iy, 0, h - 1).astype(jnp.int32)
+            return img[:, iy, ix]                 # (C, P)
+        val = (at(x0, y0) * (1 - fx) * (1 - fy) +
+               at(x0 + 1, y0) * fx * (1 - fy) +
+               at(x0, y0 + 1) * (1 - fx) * fy +
+               at(x0 + 1, y0 + 1) * fx * fy)
+        # points mapping outside the image are zeroed (reference rule)
+        inside = ((px >= 0) & (px <= w - 1) & (py >= 0) & (py <= h - 1))
+        return (val * inside[None, :]).reshape(c, out_h, out_w)
+
+    out = jax.vmap(lambda img, qs: jax.vmap(
+        lambda q: sample_one(img, q))(qs))(x, rois)
+    return {"Out": out}
+
+
+@register_op("generate_mask_labels",
+             nondiff=("ImInfo", "GtClasses", "IsCrowd", "GtSegms",
+                      "Rois", "LabelsInt32"), differentiable=False)
+def _generate_mask_labels(ctx, ins, attrs):
+    """Mask-RCNN mask targets (ref generate_mask_labels_op.cc), dense
+    redesign: the reference takes polygon LoD; here GtSegms is a dense
+    bitmap (B, G, S, S) registered to each gt box.  For every fg roi
+    (label > 0) the matched gt's bitmap is warped into the roi window
+    and resized to resolution^2.  MaskInt32 (B, R, num_classes * res *
+    res) carries {0,1} targets in the roi's class slot and -1
+    elsewhere/for non-fg rois (the reference's ignore convention)."""
+    gt = ins["GtSegms"][0]
+    rois = ins["Rois"][0]
+    labels = ins["LabelsInt32"][0]
+    gt_boxes = ins["GtBoxes"][0]   # bitmaps are registered to these
+    gt_valid = jnp.any(gt_boxes != 0.0, axis=2)
+    if ins.get("IsCrowd"):
+        gt_valid = gt_valid & ~ins["IsCrowd"][0].reshape(
+            gt_valid.shape).astype(bool)
+    res = int(attrs.get("resolution", 14))
+    num_classes = int(attrs.get("num_classes", 81))
+    b, r = labels.shape
+    g = gt.shape[1]
+    s = gt.shape[-1]
+
+    def one(roi_b, lab_b, gt_b, seg_b, v_b):
+        iou = jnp.where(v_b[None, :], _pairwise_iou(roi_b, gt_b), -1.0)
+        best = jnp.argmax(iou, axis=1)                    # (R,)
+
+        def roi_mask(roi, gidx):
+            box = gt_b[gidx]
+            seg = seg_b[gidx]                             # (S, S)
+            # sample the roi window out of the gt-registered bitmap
+            ys = jnp.linspace(0.0, 1.0, res)
+            xs = jnp.linspace(0.0, 1.0, res)
+            ry = roi[1] + (roi[3] - roi[1]) * ys          # abs coords
+            rx = roi[0] + (roi[2] - roi[0]) * xs
+            gy = (ry - box[1]) / jnp.maximum(box[3] - box[1], 1e-6)
+            gx = (rx - box[0]) / jnp.maximum(box[2] - box[0], 1e-6)
+            iy = jnp.clip(jnp.round(gy * (s - 1)), 0, s - 1).astype(
+                jnp.int32)
+            ix = jnp.clip(jnp.round(gx * (s - 1)), 0, s - 1).astype(
+                jnp.int32)
+            inside = ((gy >= 0) & (gy <= 1))[:, None] & \
+                ((gx >= 0) & (gx <= 1))[None, :]
+            return jnp.where(inside, seg[iy[:, None], ix[None, :]],
+                             0.0)
+
+        masks = jax.vmap(roi_mask)(roi_b, best)           # (R, res, res)
+        out = jnp.full((r, num_classes, res * res), -1.0)
+        flat = masks.reshape(r, res * res)
+        cls = jnp.clip(lab_b, 0, num_classes - 1)
+        out = out.at[jnp.arange(r), cls].set(flat)
+        fg = (lab_b > 0)[:, None, None]
+        out = jnp.where(fg, out, -1.0)
+        return out.reshape(r, num_classes * res * res)
+
+    mask = jax.vmap(one)(rois, labels, gt_boxes, gt, gt_valid)
+    has_mask = (labels > 0).astype(jnp.int32)
+    return {"MaskRois": rois, "RoiHasMaskInt32": has_mask,
+            "MaskInt32": mask.astype(jnp.int32)}

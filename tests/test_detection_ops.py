@@ -694,3 +694,145 @@ def test_locality_aware_nms_merges_neighbors():
     # merged box x1 between the two originals, score = pair average
     assert 0.0 < kept[0, 2] < 0.5
     assert abs(kept[0, 1] - 0.85) < 1e-5
+
+
+def test_retinanet_detection_output():
+    anchors = np.array([[0, 0, 10, 10], [20, 20, 34, 34]], np.float32)
+    deltas = np.zeros((1, 2, 4), np.float32)       # no offset: boxes = anchors
+    scores = np.array([[[0.9, 0.01], [0.02, 0.8]]], np.float32)
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        d = layers.data("d", [1, 2, 4], "float32",
+                        append_batch_size=False)
+        s = layers.data("s", [1, 2, 2], "float32",
+                        append_batch_size=False)
+        a = layers.data("a", [2, 4], "float32", append_batch_size=False)
+        ii = layers.data("ii", [1, 3], "float32",
+                         append_batch_size=False)
+        out = layers.retinanet_detection_output(
+            [d], [s], [a], ii, score_threshold=0.1, keep_top_k=4)
+    exe = pt.Executor()
+    exe.run(startup)
+    o, = exe.run(main, feed={"d": deltas, "s": scores, "a": anchors,
+                             "ii": np.array([[64, 64, 1.0]],
+                                            np.float32)},
+                 fetch_list=[out])
+    o = np.asarray(o)[0]
+    kept = o[o[:, 1] > 0]
+    assert len(kept) == 2
+    # class labels are 1-based; best detection is class 1 @ 0.9
+    assert kept[0, 0] == 1 and abs(kept[0, 1] - 0.9) < 1e-6
+    np.testing.assert_allclose(kept[0, 2:], [0, 0, 10, 10], atol=1e-4)
+    assert kept[1, 0] == 2 and abs(kept[1, 1] - 0.8) < 1e-6
+
+
+def test_roi_perspective_transform_identity_quad():
+    """An axis-aligned quad covering a known patch reproduces it."""
+    img = np.arange(36, dtype=np.float32).reshape(1, 1, 6, 6)
+    # quad == the exact 4x4 patch corners (clockwise from top-left)
+    rois = np.array([[[1, 1, 4, 1, 4, 4, 1, 4]]], np.float32)
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [1, 1, 6, 6], "float32",
+                        append_batch_size=False)
+        r = layers.data("r", [1, 1, 8], "float32",
+                        append_batch_size=False)
+        out = layers.roi_perspective_transform(x, r, 4, 4)
+    exe = pt.Executor()
+    exe.run(startup)
+    o, = exe.run(main, feed={"x": img, "r": rois}, fetch_list=[out])
+    o = np.asarray(o)[0, 0, 0]
+    np.testing.assert_allclose(o, img[0, 0, 1:5, 1:5], atol=1e-3)
+
+
+def test_generate_mask_labels_dense():
+    B, G, S, R, NC, RES = 1, 1, 8, 2, 3, 4
+    gt_boxes = np.array([[[0, 0, 8, 8]]], np.float32)
+    # gt mask: left half on
+    seg = np.zeros((B, G, S, S), np.float32)
+    seg[0, 0, :, :4] = 1.0
+    rois = np.array([[[0, 0, 8, 8], [100, 100, 110, 110]]], np.float32)
+    labels = np.array([[2, 0]], np.int32)     # roi0 fg class 2, roi1 bg
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        ii = layers.data("ii", [B, 3], "float32",
+                         append_batch_size=False)
+        gc = layers.data("gc", [B, G], "int64", append_batch_size=False)
+        gs = layers.data("gs", [B, G, S, S], "float32",
+                         append_batch_size=False)
+        rr = layers.data("rr", [B, R, 4], "float32",
+                         append_batch_size=False)
+        lb = layers.data("lb", [B, R], "int32", append_batch_size=False)
+        gb = layers.data("gb", [B, G, 4], "float32",
+                         append_batch_size=False)
+        mrois, has, mask = layers.generate_mask_labels(
+            ii, gc, None, gs, rr, lb, num_classes=NC, resolution=RES,
+            gt_boxes=gb)
+    exe = pt.Executor()
+    exe.run(startup)
+    hv, mv = exe.run(main, feed={
+        "ii": np.array([[64, 64, 1.0]], np.float32),
+        "gc": np.array([[2]], np.int64), "gs": seg, "rr": rois,
+        "lb": labels, "gb": gt_boxes}, fetch_list=[has, mask])
+    hv = np.asarray(hv)[0]
+    mv = np.asarray(mv)[0].reshape(R, NC, RES, RES)
+    assert hv.tolist() == [1, 0]
+    # fg roi: class-2 slot has the left-half pattern, others ignored
+    assert np.all(mv[0, 2, :, :2] == 1) and np.all(mv[0, 2, :, 2:] == 0)
+    assert np.all(mv[0, 1] == -1)
+    assert np.all(mv[1] == -1)                # bg roi fully ignored
+
+
+def test_force_positive_survives_gt_padding():
+    """Review regression: a valid gt whose best anchor is index 0 must
+    get its forced positive even when padded gt rows also argmax to
+    anchor 0 (duplicate-index scatter)."""
+    anchors = np.array([[0, 0, 10, 10], [50, 50, 60, 60]], np.float32)
+    gts = np.zeros((1, 2, 4), np.float32)
+    gts[0, 0] = [12, 0, 22, 10]          # IoU < thresholds, best anchor 0
+    gl = np.array([[5, 0]], np.int64)
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        a = layers.data("a", [2, 4], "float32", append_batch_size=False)
+        av = layers.data("av", [2, 4], "float32",
+                         append_batch_size=False)
+        g = layers.data("g", [1, 2, 4], "float32",
+                        append_batch_size=False)
+        glv = layers.data("gl", [1, 2], "int64",
+                          append_batch_size=False)
+        bp = layers.data("bp", [1, 2, 4], "float32",
+                         append_batch_size=False)
+        cl = layers.data("cl", [1, 2, 1], "float32",
+                         append_batch_size=False)
+        _, _, rl, _, _ = layers.rpn_target_assign(
+            bp, cl, a, av, g, use_random=False, rpn_straddle_thresh=-1)
+        _, _, tl, _, _, _ = layers.retinanet_target_assign(
+            bp, cl, a, av, g, glv)
+    exe = pt.Executor()
+    exe.run(startup)
+    feed = {"a": anchors, "av": np.ones_like(anchors), "g": gts,
+            "gl": gl, "bp": np.zeros((1, 2, 4), np.float32),
+            "cl": np.zeros((1, 2, 1), np.float32)}
+    rlv, tlv = exe.run(main, feed=feed, fetch_list=[rl, tl])
+    assert np.asarray(rlv)[0, 0] == 1
+    assert np.asarray(tlv)[0, 0] == 5
+
+
+def test_fg_fraction_zero_samples_nothing():
+    rois = np.array([[[0, 0, 10, 10], [1, 1, 11, 11]]], np.float32)
+    gts = np.array([[[0, 0, 10, 10]]], np.float32)
+    cls = np.array([[4]], np.int64)
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        r = layers.data("r", [1, 2, 4], "float32",
+                        append_batch_size=False)
+        g = layers.data("g", [1, 1, 4], "float32",
+                        append_batch_size=False)
+        c = layers.data("c", [1, 1], "int64", append_batch_size=False)
+        _, labels, _, _, _ = layers.generate_proposal_labels(
+            r, c, None, g, batch_size_per_im=2, fg_fraction=0.0)
+    exe = pt.Executor()
+    exe.run(startup)
+    lab, = exe.run(main, feed={"r": rois, "g": gts, "c": cls},
+                   fetch_list=[labels])
+    assert not np.any(np.asarray(lab) > 0)   # no stray fg sample
