@@ -34,3 +34,64 @@ def reset_profiler():
 def annotate(name):
     with jax.profiler.TraceAnnotation(name):
         yield
+
+
+def profile_program(program, feed, scope=None, repeat=3, sorted_key="total",
+                    top_k=30, print_table=True):
+    """Per-op time attribution (the reference profiler's sorted op table,
+    ref python/paddle/fluid/profiler.py stop_profiler output).
+
+    The production Executor fuses the whole Program into ONE XLA
+    computation, so per-op times don't exist there; this runs the
+    program OP-BY-OP eagerly (like the reference's per-kernel timers),
+    blocking after each op.  Absolute times are therefore pessimistic —
+    use the table for *attribution* (which ops dominate), and the fused
+    step for real throughput.  Returns rows of
+    (op_type, calls, total_s, avg_s) sorted by ``sorted_key``
+    ("total" | "calls" | "ave").
+    """
+    import time
+    from collections import defaultdict
+
+    import numpy as np
+
+    from .framework.executor import _persistable_names, _want_vjp_set
+    from .framework.trace import TraceContext, trace_op, _rng_tag
+    from .framework.scope import global_scope
+
+    scope = scope or global_scope()
+    totals = defaultdict(float)
+    calls = defaultdict(int)
+    for rep in range(repeat):
+        env = {}
+        for n in _persistable_names(program):
+            v = scope.find_var(n)
+            if v is not None:
+                env[n] = v
+        for k, v in (feed or {}).items():
+            env[k] = jax.numpy.asarray(v)
+        ctx = TraceContext(program, jax.random.PRNGKey(rep),
+                           _want_vjp_set(program))
+        block = program.global_block()
+        for i, op in enumerate(block.ops):
+            t0 = time.perf_counter()
+            trace_op(op, env, ctx, _rng_tag(block, i))
+            for out_name in op.output_names():
+                v = env.get(out_name)
+                if hasattr(v, "block_until_ready"):
+                    v.block_until_ready()
+            dt = time.perf_counter() - t0
+            if rep > 0:  # first pass pays compilation; attribute after
+                totals[op.type] += dt
+                calls[op.type] += 1
+    rows = [(t, calls[t], totals[t], totals[t] / max(calls[t], 1))
+            for t in totals]
+    key_idx = {"total": 2, "calls": 1, "ave": 3}[sorted_key]
+    rows.sort(key=lambda r: -r[key_idx])
+    rows = rows[:top_k]
+    if print_table:
+        print("%-28s %8s %12s %12s" % ("Op", "Calls", "Total(s)",
+                                       "Avg(s)"))
+        for t, c, tot, avg in rows:
+            print("%-28s %8d %12.6f %12.6f" % (t, c, tot, avg))
+    return rows

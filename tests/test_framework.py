@@ -113,3 +113,32 @@ def test_prune():
     pruned = main._prune(["x"], [y.name])
     types = [op.type for op in pruned.global_block().ops]
     assert "softmax" in types and "scale" not in types
+
+
+def test_profile_program_op_table():
+    """profiler.profile_program: per-op attribution table (the
+    reference profiler's sorted op-time print, eager re-run design)."""
+    import paddle_tpu as pt
+    from paddle_tpu import layers, optimizer, profiler
+    from paddle_tpu.framework.scope import Scope, scope_guard
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data('px', [8], 'float32')
+        h = layers.fc(x, size=16, act='relu')
+        loss = layers.reduce_mean(layers.square(h))
+        optimizer.SGD(0.1).minimize(loss)
+    sc = Scope()
+    with scope_guard(sc):
+        exe = pt.Executor()
+        exe.run(startup)
+        rows = profiler.profile_program(
+            main, {'px': np.ones((4, 8), np.float32)}, scope=sc,
+            repeat=2, print_table=False)
+    types = [r[0] for r in rows]
+    assert "mul" in types and "grad_of" in types
+    # sorted by total descending
+    tot = [r[2] for r in rows]
+    assert tot == sorted(tot, reverse=True)
+    # avg * calls == total
+    for t, c, total, avg in rows:
+        assert abs(avg * c - total) < 1e-9
