@@ -298,3 +298,32 @@ def test_beam_search_decode_backtrace():
     assert sv.shape == (2, 2, 2)
     np.testing.assert_array_equal(sv[0], [[8, 3], [8, 4]])
     np.testing.assert_array_equal(sv[1], [[5, 2], [5, 1]])
+
+
+def test_dynamic_decode_output_time_major():
+    V, D, H, B = 7, 4, 6, 2
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        enc = layers.data('enc', [B, H], 'float32',
+                          append_batch_size=False)
+        cell = layers.GRUCell(hidden_size=H, name='tm_cell')
+
+        def emb(ids):
+            return layers.reshape(layers.embedding(
+                ids, size=[V, D],
+                param_attr=pt.ParamAttr(name='tm_emb')), [-1, D])
+
+        bsd = layers.BeamSearchDecoder(
+            cell, start_token=0, end_token=1, beam_size=2,
+            embedding_fn=emb,
+            output_fn=lambda h: layers.fc(
+                h, size=V, param_attr=pt.ParamAttr(name='tm_fc')))
+        ids_tm, _ = layers.dynamic_decode(bsd, inits=enc, max_step_num=3,
+                                          output_time_major=True)
+    scope = Scope()
+    with scope_guard(scope):
+        exe = pt.Executor()
+        exe.run(startup)
+        iv, = exe.run(main, feed={'enc': np.zeros((B, H), np.float32)},
+                      fetch_list=[ids_tm])
+    assert np.asarray(iv).shape == (3, B, 2)   # (T, batch, beam)
