@@ -74,3 +74,45 @@ def tpu_places(device_ids=None):
 
 def cpu_places(device_count=None):
     return [CPUPlace()]
+
+
+def in_dygraph_mode():
+    """ref framework.in_dygraph_mode."""
+    return dygraph.enabled()
+
+
+def is_compiled_with_cuda():
+    """ref framework.is_compiled_with_cuda — always False: the
+    accelerator is TPU (see tpu_places)."""
+    return False
+
+
+def cuda_pinned_places(device_count=None):
+    """ref framework.cuda_pinned_places — host staging on TPU is plain
+    host memory; returns CPU places."""
+    return [CPUPlace()] * (device_count or 1)
+
+
+def require_version(min_version, max_version=None):
+    """ref framework.require_version, against paddle_tpu's version."""
+    def parse(v):
+        return [int(x) for x in str(v).split(".") if x.isdigit()]
+    cur = parse(__version__)
+    if parse(min_version) > cur:
+        raise Exception(
+            "paddle_tpu version %s is below required %s" %
+            (__version__, min_version))
+    if max_version is not None and parse(max_version) < cur:
+        raise Exception(
+            "paddle_tpu version %s is above allowed %s" %
+            (__version__, max_version))
+
+
+def load_op_library(lib_path):
+    """ref framework.load_op_library (custom C++/CUDA op .so).  Custom
+    ops here are pure JAX kernels: register with
+    paddle_tpu.ops.registry.register_op instead."""
+    raise NotImplementedError(
+        "load_op_library loads CUDA kernels; on paddle_tpu register a "
+        "JAX kernel via paddle_tpu.ops.registry.register_op (see "
+        "ops/registry.py docstring)")

@@ -151,3 +151,27 @@ def _global_weight_initializer():
 
 def _global_bias_initializer():
     return ConstantInitializer(0.0)
+
+
+import contextlib as _contextlib
+
+_force_cpu_init = [False]
+
+
+def force_init_on_cpu():
+    """ref initializer.py force_init_on_cpu — whether the init_on_cpu
+    guard is active.  On TPU initializers run inside the jitted startup
+    step; the flag is tracked for parity and ignored by design (there
+    is no separate CPU init path to route to)."""
+    return _force_cpu_init[0]
+
+
+@_contextlib.contextmanager
+def init_on_cpu():
+    """ref initializer.py init_on_cpu context guard (parity no-op on
+    TPU; see force_init_on_cpu)."""
+    _force_cpu_init[0] = True
+    try:
+        yield
+    finally:
+        _force_cpu_init[0] = False

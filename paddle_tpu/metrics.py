@@ -131,3 +131,7 @@ class Auc(MetricBase):
         fp_next = np.append(fp[1:], 0.0)
         area = np.sum((fp - fp_next) * (tp + tp_next) / 2.0)
         return float(area / (tot_pos * tot_neg))
+
+
+# evaluator-class aliases (ref fluid/metrics.py exposes these names)
+from .evaluator import ChunkEvaluator, EditDistance, DetectionMAP  # noqa: E402,F401

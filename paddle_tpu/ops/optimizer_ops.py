@@ -255,3 +255,19 @@ def _average_accumulates(ctx, ins, attrs):
             "out_num_accumulates": num_acc,
             "out_old_num_accumulates": old_acc,
             "out_num_updates": num_upd}
+
+
+@register_op("adadelta")
+def _adadelta(ctx, ins, attrs):
+    """Ref adadelta_op.cc: accumulate squared grads and squared updates
+    with decay rho; step = -sqrt(E[dx^2]+eps)/sqrt(E[g^2]+eps) * g."""
+    p, g = _p(ins, "Param"), _p(ins, "Grad")
+    eg = _p(ins, "AvgSquaredGrad")
+    ex = _p(ins, "AvgSquaredUpdate")
+    rho = attrs.get("rho", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    eg_new = rho * eg + (1 - rho) * g * g
+    update = -jnp.sqrt(ex + eps) / jnp.sqrt(eg_new + eps) * g
+    ex_new = rho * ex + (1 - rho) * update * update
+    return {"ParamOut": p + update, "AvgSquaredGradOut": eg_new,
+            "AvgSquaredUpdateOut": ex_new}

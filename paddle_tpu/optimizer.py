@@ -214,6 +214,58 @@ class AdagradOptimizer(Optimizer):
             attrs={"epsilon": self._epsilon, "op_role": "optimize"})
 
 
+class AdadeltaOptimizer(Optimizer):
+    """Adadelta (ref fluid optimizer.py AdadeltaOptimizer /
+    adadelta_op.cc): rho-decayed accumulators of squared gradients and
+    squared updates; learning_rate is accepted for API parity but the
+    classic update is scale-free."""
+
+    def __init__(self, learning_rate=1.0, epsilon=1e-6, rho=0.95, **kw):
+        super(AdadeltaOptimizer, self).__init__(learning_rate, **kw)
+        self._epsilon = epsilon
+        self._rho = rho
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("avg_squared_grad", p)
+            self._add_accumulator("avg_squared_update", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        param, grad = param_and_grad
+        eg = self._get_accumulator("avg_squared_grad", param)
+        ex = self._get_accumulator("avg_squared_update", param)
+        block.append_op(
+            "adadelta",
+            inputs={"Param": [param.name], "Grad": [grad.name],
+                    "AvgSquaredGrad": [eg.name],
+                    "AvgSquaredUpdate": [ex.name]},
+            outputs={"ParamOut": [param.name],
+                     "AvgSquaredGradOut": [eg.name],
+                     "AvgSquaredUpdateOut": [ex.name]},
+            attrs={"epsilon": self._epsilon, "rho": self._rho,
+                   "op_role": "optimize"})
+
+
+class DGCMomentumOptimizer(MomentumOptimizer):
+    """API-parity Momentum (ref optimizer.py DGCMomentumOptimizer).
+
+    The reference adds Deep Gradient Compression — top-k sparsified
+    allreduce to survive commodity-network bandwidth. Over ICI a dense
+    XLA allreduce is faster than compression + sparsity bookkeeping, so
+    this runs EXACT (uncompressed) momentum: strictly more accurate
+    than DGC, same optimizer semantics. Compression knobs are accepted
+    and recorded but intentionally unused."""
+
+    def __init__(self, learning_rate, momentum, rampup_begin_step=0,
+                 rampup_step=1, sparsity=(0.999,), use_nesterov=False,
+                 local_grad_clip_norm=None, num_trainers=None, **kw):
+        super(DGCMomentumOptimizer, self).__init__(
+            learning_rate, momentum, use_nesterov=use_nesterov, **kw)
+        self._dgc_ignored = {"rampup_begin_step": rampup_begin_step,
+                             "rampup_step": rampup_step,
+                             "sparsity": tuple(sparsity)}
+
+
 class DecayedAdagradOptimizer(Optimizer):
     def __init__(self, learning_rate, decay=0.95, epsilon=1e-6, **kw):
         super(DecayedAdagradOptimizer, self).__init__(learning_rate, **kw)
@@ -694,6 +746,8 @@ class RecomputeOptimizer(object):
 
 
 # fluid-style aliases
+from .contrib.extend_optimizer import PipelineOptimizer  # noqa: E402,F401
+Adadelta = AdadeltaOptimizer
 SGD = SGDOptimizer
 Momentum = MomentumOptimizer
 Adagrad = AdagradOptimizer

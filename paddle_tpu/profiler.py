@@ -95,3 +95,17 @@ def profile_program(program, feed, scope=None, repeat=3, sorted_key="total",
         for t, c, tot, avg in rows:
             print("%-28s %8d %12.6f %12.6f" % (t, c, tot, avg))
     return rows
+
+
+@contextlib.contextmanager
+def cuda_profiler(output_file=None, output_mode=None, config=None):
+    """ref profiler.cuda_profiler — no CUDA here; delegates to the XLA
+    trace so existing scripts still produce a usable profile."""
+    import warnings
+    warnings.warn("cuda_profiler on paddle_tpu records a jax.profiler "
+                  "trace instead of a CUDA profile")
+    jax.profiler.start_trace(output_file or "/tmp/paddle_tpu_profile")
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -199,3 +199,56 @@ def test_model_average_window_reset():
             pt.global_scope().get_numpy("w_ma2"), 0.85, rtol=1e-5)
     np.testing.assert_allclose(
         pt.global_scope().get_numpy("w_ma2"), 0.8, rtol=1e-5)
+
+
+def test_adadelta_converges():
+    """AdadeltaOptimizer (ref adadelta_op.cc) on a quadratic bowl."""
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data('x', [4], 'float32')
+        w = layers.fc(x, size=1,
+                      param_attr=pt.ParamAttr(name='w_adlt'),
+                      bias_attr=False)
+        loss = layers.reduce_mean(layers.square(w))
+        optimizer.Adadelta(1.0, rho=0.9).minimize(loss)
+    from paddle_tpu.framework.scope import Scope, scope_guard
+    sc = Scope()
+    with scope_guard(sc):
+        exe = pt.Executor()
+        exe.run(startup)
+        feed = {'x': np.ones((8, 4), np.float32)}
+        vals = [float(np.asarray(
+            exe.run(main, feed=feed, fetch_list=[loss])[0])
+            .reshape(-1)[0]) for _ in range(200)]
+    assert vals[-1] < vals[0] * 0.1
+
+
+def test_dgc_momentum_is_exact_momentum():
+    """DGCMomentumOptimizer must update exactly like Momentum (the
+    compression knobs are recorded but unused by design over ICI)."""
+    results = {}
+    for cls, kwargs in ((optimizer.Momentum, {}),
+                        (optimizer.DGCMomentumOptimizer,
+                         {"rampup_begin_step": 0,
+                          "sparsity": (0.9,)})):
+        main, startup = pt.Program(), pt.Program()
+        main.random_seed = startup.random_seed = 7
+        with pt.program_guard(main, startup):
+            x = layers.data('x', [3], 'float32')
+            w = layers.fc(x, size=1,
+                          param_attr=pt.ParamAttr(name='w_dgc'),
+                          bias_attr=False)
+            loss = layers.reduce_mean(layers.square(w))
+            cls(0.1, 0.9, **kwargs).minimize(loss)
+        from paddle_tpu.framework.scope import Scope, scope_guard
+        sc = Scope()
+        with scope_guard(sc):
+            exe = pt.Executor()
+            exe.run(startup)
+            feed = {'x': np.ones((4, 3), np.float32)}
+            for _ in range(5):
+                exe.run(main, feed=feed, fetch_list=[loss])
+            results[cls.__name__] = np.asarray(sc.find_var('w_dgc'))
+    np.testing.assert_allclose(results["MomentumOptimizer"],
+                               results["DGCMomentumOptimizer"],
+                               rtol=1e-6)
