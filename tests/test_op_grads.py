@@ -333,3 +333,149 @@ def test_sequence_topk_avg_pooling_grad_flows_to_valid_only():
     assert (np.count_nonzero(g[0, 0, 0]) == 2 and
             np.count_nonzero(g[0, 0, 1]) == 2)
     assert np.all(g[0, 0, :, 3:] == 0)  # invalid cols: no grad
+
+
+# ---- round-3 widening: conv/pool/norm/gather/scatter family ----------
+
+def test_conv2d_transpose_grad_vs_oracle():
+    import jax.lax as lax
+    rng = np.random.RandomState(0)
+    wv = rng.randn(3, 2, 3, 3).astype(np.float32) * 0.1
+
+    def build(x):
+        return layers.conv2d_transpose(
+            x, num_filters=2, filter_size=3, padding=1,
+            param_attr=pt.ParamAttr(
+                initializer=pt.initializer.NumpyArrayInitializer(wv)),
+            bias_attr=False)
+
+    def ref(x):
+        # definitional oracle: conv_transpose == adjoint of the forward
+        # conv with the same (in_c, out_c, kh, kw) weight
+        def fwd(z):
+            return lax.conv_general_dilated(
+                z, jnp.asarray(wv), (1, 1), [(1, 1), (1, 1)],
+                dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        zeros = jnp.zeros((x.shape[0], 2, x.shape[2], x.shape[3]),
+                          x.dtype)
+        _, vjp = jax.vjp(fwd, zeros)
+        return vjp(x)[0]
+
+    _check(build, ref, (2, 3, 6, 6), rtol=1e-3, atol=1e-4)
+
+
+def test_pool2d_avg_exclusive_grad():
+    def build(x):
+        return layers.pool2d(x, pool_size=2, pool_stride=2,
+                             pool_type="avg")
+
+    def ref(x):
+        n, c, h, w = x.shape
+        return x.reshape(n, c, h // 2, 2, w // 2, 2).mean(axis=(3, 5))
+
+    _check(build, ref, (2, 3, 8, 8))
+
+
+def test_group_norm_grad_vs_manual():
+    def build(x):
+        return layers.group_norm(
+            x, groups=2,
+            param_attr=pt.ParamAttr(
+                initializer=pt.initializer.Constant(1.0)),
+            bias_attr=pt.ParamAttr(
+                initializer=pt.initializer.Constant(0.0)))
+
+    def ref(x):
+        n, c, h, w = x.shape
+        g = x.reshape(n, 2, c // 2, h, w)
+        m = g.mean(axis=(2, 3, 4), keepdims=True)
+        v = ((g - m) ** 2).mean(axis=(2, 3, 4), keepdims=True)
+        return ((g - m) / jnp.sqrt(v + 1e-5)).reshape(n, c, h, w)
+
+    _check(build, ref, (2, 4, 5, 5), rtol=1e-3, atol=1e-4)
+
+
+def test_gather_nd_grad():
+    idx = np.array([[0, 1], [1, 2]], np.int64)
+
+    def build(x):
+        from paddle_tpu.layers import tensor as T
+        iv = T.assign(np.asarray(idx)) if hasattr(T, "assign") else None
+        # feed-free constant index via fill+cast is awkward; use the
+        # layer with a data var instead
+        return None
+
+    # direct kernel check
+    from paddle_tpu.ops.registry import get_op
+    op = get_op("gather_nd")
+    x = jnp.asarray(np.random.RandomState(0).randn(3, 4).astype(
+        np.float32))
+
+    def f(v):
+        return op.fn(None, {"X": [v], "Index": [jnp.asarray(idx)]},
+                     {})["Out"]
+
+    def ref(v):
+        return v[idx[:, 0], idx[:, 1]]
+
+    np.testing.assert_allclose(np.asarray(f(x)), np.asarray(ref(x)))
+    g1 = jax.grad(lambda v: jnp.sum(f(v) ** 2))(x)
+    g2 = jax.grad(lambda v: jnp.sum(ref(v) ** 2))(x)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2))
+
+
+def test_scatter_nd_add_grad():
+    from paddle_tpu.ops.registry import get_op
+    op = get_op("scatter_nd_add")
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(4, 3).astype(np.float32))
+    upd = jnp.asarray(rng.randn(2, 3).astype(np.float32))
+    idx = jnp.asarray(np.array([[1], [3]], np.int64))
+
+    def f(v, u):
+        return op.fn(None, {"X": [v], "Index": [idx],
+                            "Updates": [u]}, {})["Out"]
+
+    def ref(v, u):
+        return v.at[jnp.array([1, 3])].add(u)
+
+    np.testing.assert_allclose(np.asarray(f(x, upd)),
+                               np.asarray(ref(x, upd)), rtol=1e-6)
+    for argn in (0, 1):
+        g1 = jax.grad(lambda *a: jnp.sum(f(*a) ** 2), argnums=argn)(
+            x, upd)
+        g2 = jax.grad(lambda *a: jnp.sum(ref(*a) ** 2), argnums=argn)(
+            x, upd)
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                                   rtol=1e-5)
+
+
+def test_label_smooth_grad():
+    def build(x):
+        return layers.label_smooth(x, epsilon=0.1)
+
+    def ref(x):
+        return 0.9 * x + 0.1 / x.shape[-1]
+
+    _check(build, ref, (4, 6))
+
+
+def test_strided_slice_grad():
+    def build(x):
+        return layers.strided_slice(x, axes=[0, 1], starts=[0, 1],
+                                    ends=[4, 5], strides=[2, 2])
+
+    def ref(x):
+        return x[0:4:2, 1:5:2]
+
+    _check(build, ref, (4, 6))
+
+
+def test_resize_nearest_grad():
+    def build(x):
+        return layers.resize_nearest(x, scale=2.0)
+
+    def ref(x):
+        return jnp.repeat(jnp.repeat(x, 2, axis=2), 2, axis=3)
+
+    _check(build, ref, (1, 2, 3, 3))
