@@ -13,3 +13,4 @@ from .prune import (Pruner, MagnitudePruner, StructurePruner, PruneHelper,
 from .distill import (soft_label_loss, l2_distill_loss, fsp_matrix,
                       fsp_loss, merge)
 from .qat import quant_aware, convert, QUANTIZABLE
+from .core import Compressor  # noqa: F401

@@ -1,0 +1,135 @@
+"""Compression pipeline driver
+(ref python/paddle/fluid/contrib/slim/core/compressor.py Compressor).
+
+The reference Compressor reads a YAML config and drives pruning /
+distillation / quantization strategies across training epochs with
+periodic eval and checkpointing.  This build keeps the same run-loop
+contract programmatically: strategies are objects exposing any of
+``on_compression_begin/on_epoch_begin/on_epoch_end/
+on_compression_end(context)``; the Context carries the executor, the
+train/eval programs and readers, and an eval-history the strategies
+(and eval_converged) can consult.  The package's strategy
+implementations live in prune.py / distill.py / qat.py.
+"""
+import numpy as np
+
+__all__ = ["Context", "Compressor"]
+
+
+class Context(object):
+    """Run-loop state handed to every strategy hook (ref :77)."""
+
+    def __init__(self, place=None, scope=None, train_graph=None,
+                 eval_graph=None, executor=None):
+        self.place = place
+        self.scope = scope
+        self.train_graph = train_graph
+        self.eval_graph = eval_graph
+        self.executor = executor
+        self.epoch_id = 0
+        self.eval_results = {}
+
+    def eval_converged(self, metric_name, delta=0.001):
+        """True when the last two evals of ``metric_name`` moved by less
+        than ``delta`` (ref :153)."""
+        hist = self.eval_results.get(metric_name, [])
+        if len(hist) < 2:
+            return False
+        return abs(hist[-1] - hist[-2]) < delta
+
+
+class Compressor(object):
+    """Drive train/eval epochs through a list of strategies (ref :238).
+
+    train_fn(exe) runs one training epoch; eval_fn(exe) returns
+    {metric_name: value}.  Both run under the caller's scope.
+    """
+
+    def __init__(self, place, scope, train_program, train_reader=None,
+                 train_feed_list=None, train_fetch_list=None,
+                 eval_program=None, eval_reader=None, eval_feed_list=None,
+                 eval_fetch_list=None, epoch=1, strategies=None,
+                 train_fn=None, eval_fn=None, checkpoint_path=None):
+        from ...framework.executor import Executor
+        self.place = place
+        self.scope = scope
+        self.train_program = train_program
+        self.eval_program = eval_program or train_program
+        self.epoch = int(epoch)
+        self.strategies = list(strategies or [])
+        self.checkpoint_path = checkpoint_path
+        self._exe = Executor(place)
+        self._train_reader = train_reader
+        self._train_feeds = train_feed_list or []
+        self._train_fetch = train_fetch_list or []
+        self._eval_reader = eval_reader
+        self._eval_feeds = eval_feed_list or []
+        self._eval_fetch = eval_fetch_list or []
+        self._train_fn = train_fn
+        self._eval_fn = eval_fn
+
+    def _dispatch(self, hook, context):
+        for s in self.strategies:
+            fn = getattr(s, hook, None)
+            if fn is not None:
+                fn(context)
+
+    def _default_train_epoch(self):
+        for data in self._train_reader():
+            feed = dict(zip([getattr(v, "name", v)
+                             for v in self._train_feeds],
+                            map(np.asarray, zip(*data)))) \
+                if self._train_feeds else data
+            self._exe.run(self.train_program, feed=feed,
+                          fetch_list=self._train_fetch)
+
+    def _default_eval(self):
+        totals, count = None, 0
+        for data in self._eval_reader():
+            feed = dict(zip([getattr(v, "name", v)
+                             for v in self._eval_feeds],
+                            map(np.asarray, zip(*data)))) \
+                if self._eval_feeds else data
+            outs = self._exe.run(self.eval_program, feed=feed,
+                                 fetch_list=self._eval_fetch)
+            vals = [float(np.asarray(o).reshape(-1)[0]) for o in outs]
+            totals = vals if totals is None else \
+                [t + v for t, v in zip(totals, vals)]
+            count += 1
+        names = [getattr(v, "name", str(i))
+                 for i, v in enumerate(self._eval_fetch)]
+        return {n: t / max(count, 1)
+                for n, t in zip(names, totals or [])}
+
+    def run(self):
+        """The reference run loop (ref :520): compression_begin ->
+        per-epoch (epoch_begin, train, eval, epoch_end) ->
+        compression_end; returns the context for inspection."""
+        from ...framework.scope import scope_guard
+        context = Context(place=self.place, scope=self.scope,
+                          train_graph=self.train_program,
+                          eval_graph=self.eval_program,
+                          executor=self._exe)
+        with scope_guard(self.scope):
+            self._dispatch("on_compression_begin", context)
+            for epoch_id in range(self.epoch):
+                context.epoch_id = epoch_id
+                self._dispatch("on_epoch_begin", context)
+                if self._train_fn is not None:
+                    self._train_fn(self._exe)
+                elif self._train_reader is not None:
+                    self._default_train_epoch()
+                results = self._eval_fn(self._exe) \
+                    if self._eval_fn is not None else (
+                    self._default_eval()
+                    if self._eval_reader is not None else {})
+                for k, v in (results or {}).items():
+                    context.eval_results.setdefault(k, []).append(v)
+                self._dispatch("on_epoch_end", context)
+                if self.checkpoint_path:
+                    from ... import io as io_mod
+                    io_mod.save_checkpoint(
+                        self._exe, self.checkpoint_path,
+                        self.train_program, step=epoch_id)
+            self._dispatch("on_compression_end", context)
+        return context

@@ -5,5 +5,6 @@ Python reader decorators here; the native C++ prefetch ring buffer lives in
 paddle_tpu/native (SURVEY §2.9) with this module as its fallback.
 """
 from .decorator import (batch, shuffle, buffered, chain, compose, firstn,
+                        ComposeNotAligned,
                         map_readers, xmap_readers, cache, multiprocess_reader)
 from .dataloader import DataLoader  # noqa

