@@ -10,6 +10,8 @@ from . import layers
 from . import decoder
 from . import trainer
 from . import inferencer
+from . import reader
+from .reader import distributed_batch_reader
 from .trainer import Trainer
 from .inferencer import Inferencer
 from . import model_stat

@@ -1,0 +1,29 @@
+"""Multi-process reader decoration
+(ref python/paddle/fluid/contrib/reader/distributed_reader.py).
+
+Round-robin batch sharding for data-parallel trainers driven by the
+PADDLE_TRAINER env contract (distributed/launch.py sets it): trainer i
+of n consumes every n-th batch.  On TPU this pairs with the host-local
+feed path (CompiledProgram assembles global arrays from per-process
+shards), giving each host distinct data without a central dispatcher.
+"""
+import os
+
+__all__ = ["distributed_batch_reader"]
+
+
+def distributed_batch_reader(batch_reader):
+    """Shard a batch reader across PADDLE_TRAINERS_NUM processes
+    (ref :21): trainer ``i`` yields batches ``i, i+n, i+2n, ...``."""
+    trainers_num = int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+    trainer_id = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+    assert trainer_id < trainers_num, \
+        "PADDLE_TRAINER_ID %d out of range for %d trainers" % (
+            trainer_id, trainers_num)
+
+    def decorated():
+        for batch_id, data in enumerate(batch_reader()):
+            if batch_id % trainers_num == trainer_id:
+                yield data
+
+    return decorated

@@ -304,3 +304,25 @@ def test_evaluators():
                         [1, 0.8, 0.1, 0.1, 0.4, 0.4]]),
               gt[:1], labels[:1])
     assert 0.9 < m3.eval() <= 1.0
+
+
+def test_distributed_batch_reader(monkeypatch):
+    """contrib.reader.distributed_batch_reader (ref contrib/reader/
+    distributed_reader.py): round-robin batch sharding by trainer id;
+    the union of all trainers' batches is the full stream, disjoint."""
+    from paddle_tpu.contrib.reader import distributed_batch_reader
+
+    def batches():
+        for i in range(7):
+            yield [i]
+
+    monkeypatch.setenv("PADDLE_TRAINERS_NUM", "2")
+    seen = {}
+    for tid in ("0", "1"):
+        monkeypatch.setenv("PADDLE_TRAINER_ID", tid)
+        seen[tid] = [b[0] for b in
+                     distributed_batch_reader(batches)()]
+    assert seen["0"] == [0, 2, 4, 6] and seen["1"] == [1, 3, 5]
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "5")
+    with pytest.raises(AssertionError):
+        distributed_batch_reader(batches)
