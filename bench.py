@@ -3,9 +3,20 @@
 
 BASELINE.json metric: "ERNIE-base pretrain samples/sec/chip". Runs the
 flagship MLM+NSP train step (bf16 activations, fp32 master math, Adam,
-fused attention) on the attached TPU chip. Prints the secondary ResNet-50
-JSON line first, then the ERNIE headline JSON line LAST (the driver
-parses the final line; on recognized TPUs it carries an "mfu" field).
+fused attention) on the attached TPU chip.
+
+Output contract: the driver parses the LAST stdout line as the headline
+JSON. Ordering/robustness design (round-3 postmortem):
+  * ONE bounded backend probe up front (watchdog thread). If the fabric
+    hangs or the plugin fails, print the headline with an "error" field
+    and exit inside ~2 minutes instead of burning the driver's timeout.
+  * The ERNIE headline is MEASURED first so no secondary failure/hang can
+    starve it; secondary lines are buffered and PRINTED first so the
+    headline still lands last.
+  * A global deadline thread force-prints whatever has been measured (and
+    an error headline if the headline hasn't landed) then exits.
+  * pallas_check line: flash-attention fwd+bwd Pallas-vs-XLA oracle run
+    on the real chip — the only place the Mosaic path gets coverage.
 
 vs_baseline: BASELINE.json carries no published numbers ("published": {}),
 so the denominator is the reference's public era figure for this config:
@@ -16,6 +27,7 @@ PaddlePaddle fluid BERT-base seq128 pretraining throughput on one V100
 import json
 import os
 import sys
+import threading
 import time
 
 import numpy as np
@@ -24,6 +36,8 @@ REFERENCE_SAMPLES_PER_SEC = 50.0
 # Secondary config (BASELINE metric string also names ResNet-50 images/sec):
 # reference-era fluid ResNet-50 on one V100 ~ 360 images/sec.
 REFERENCE_RESNET_IPS = 360.0
+
+HEADLINE_METRIC = "ERNIE-base pretrain samples/sec/chip"
 
 # bf16 peak FLOP/s per chip by device kind (MFU denominator)
 _CHIP_PEAK_BF16 = {
@@ -34,16 +48,70 @@ _CHIP_PEAK_BF16 = {
     "v6 lite": 918e12,   # trillium
 }
 
+_PROBE_TIMEOUT_S = float(os.environ.get("PADDLE_TPU_BENCH_PROBE_S", 90))
+_DEADLINE_S = float(os.environ.get("PADDLE_TPU_BENCH_DEADLINE_S", 1500))
 
-def _chip_peak_flops():
-    """bf16 peak of the attached chip, or None when not a recognized TPU
-    (no fabricated MFU on CPU fallback / unknown accelerators)."""
+# Buffered secondary lines + progress marker, shared with the watchdog.
+_STATE = {"lines": [], "stage": "start", "headline": None}
+
+
+def _error_headline(msg):
+    return json.dumps({
+        "metric": HEADLINE_METRIC, "value": 0.0,
+        "unit": "samples/sec/chip", "vs_baseline": 0.0,
+        "error": "%s (stage=%s)" % (msg, _STATE["stage"])})
+
+
+def _flush_and_exit(code):
+    """Print buffered secondaries, then the headline LAST, and hard-exit.
+    os._exit: a wedged backend thread or a jax atexit hook touching the
+    fabric must not be able to hang the interpreter shutdown."""
+    for ln in _STATE["lines"]:
+        print(ln)
+    print(_STATE["headline"] if _STATE["headline"] is not None
+          else _error_headline("no headline measured"))
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(code)
+
+
+def _arm_deadline():
+    def fire():
+        sys.stderr.write("bench deadline %.0fs exceeded at stage %s\n"
+                         % (_DEADLINE_S, _STATE["stage"]))
+        _flush_and_exit(3)
+    t = threading.Timer(_DEADLINE_S, fire)
+    t.daemon = True
+    t.start()
+    return t
+
+
+def _probe_backend(timeout=_PROBE_TIMEOUT_S):
+    """Bounded backend discovery in a watchdog thread. Returns
+    (platforms, error): platforms is the set of device platform strings
+    when init succeeded within the budget, else None with an error."""
+    box = {}
+
+    def probe():
+        try:
+            import jax
+            box["platforms"] = sorted({d.platform for d in jax.devices()})
+        except Exception as e:  # pragma: no cover - fabric dependent
+            box["error"] = repr(e)
+
+    th = threading.Thread(target=probe, daemon=True)
+    th.start()
+    th.join(timeout)
+    if th.is_alive():
+        return None, "backend init exceeded %.0fs (fabric hang)" % timeout
+    if "error" in box:
+        return None, "backend init failed: %s" % box["error"]
+    return box["platforms"], None
+
+
+def _on_tpu():
     import jax
-    kind = getattr(jax.devices()[0], "device_kind", "").lower()
-    for tag, peak in _CHIP_PEAK_BF16.items():
-        if tag in kind:
-            return peak
-    return None
+    return any(d.platform in ("tpu", "axon") for d in jax.devices())
 
 
 def bert_train_flops(cfg, batch, seq, preds):
@@ -60,13 +128,23 @@ def bert_train_flops(cfg, batch, seq, preds):
     return 3 * fwd
 
 
+def _chip_peak_flops():
+    """bf16 peak of the attached chip, or None when not a recognized TPU
+    (no fabricated MFU on CPU fallback / unknown accelerators)."""
+    import jax
+    kind = getattr(jax.devices()[0], "device_kind", "").lower()
+    for tag, peak in _CHIP_PEAK_BF16.items():
+        if tag in kind:
+            return peak
+    return None
+
+
 def _run_steps(exe, prog, feed, loss_var, steps, warmup):
     """Shared measurement loop: warmup + sync, then a timed window of
     async-dispatched steps (each consumes the previous step's donated
     state; losses are device futures materialized once at the end — how
     a real training loop behaves, keeping host/tunnel latency off the
     critical path)."""
-    import numpy as np
     for _ in range(warmup):
         out = exe.run(prog, feed=feed, fetch_list=[loss_var])
     np.asarray(out[0])
@@ -77,91 +155,6 @@ def _run_steps(exe, prog, feed, loss_var, steps, warmup):
     dt = time.perf_counter() - t0
     assert np.isfinite(vals).all()
     return dt, vals[-1]
-
-
-def bench_resnet():
-    import jax
-    import paddle_tpu as pt
-    from paddle_tpu.models import resnet
-    from paddle_tpu import optimizer
-    on_tpu = any(d.platform in ("tpu", "axon") for d in jax.devices())
-    batch = 128 if on_tpu else 4
-    shape = (3, 224, 224) if on_tpu else (3, 32, 32)
-    steps, warmup = (20, 3) if on_tpu else (3, 1)
-    from paddle_tpu.framework.scope import Scope, scope_guard
-    main_prog, startup, feeds, fetch = resnet.resnet_train_program(
-        depth=50, class_dim=1000, image_shape=shape,
-        optimizer_fn=lambda l: optimizer.Momentum(0.1, 0.9).minimize(l))
-    # own scope: this model's params/optimizer state must not stay
-    # resident in HBM while the headline (and its batch-256 attempt) runs
-    with scope_guard(Scope()):
-        exe = pt.Executor()
-        exe.run(startup)
-        rng = np.random.RandomState(0)
-        feed = {"image": rng.rand(batch, *shape).astype(np.float32),
-                "label": rng.randint(0, 1000,
-                                     (batch, 1)).astype(np.int64)}
-        # pre-stage to device once — in production the DataLoader's
-        # background thread double-buffers batches to HBM ahead of
-        # compute (reader.py); re-transferring the same batch each step
-        # would only measure the link
-        feed = {k: jax.device_put(v) for k, v in feed.items()}
-        dt, loss = _run_steps(exe, main_prog, feed, fetch["loss"], steps,
-                              warmup)
-    ips = batch * steps / dt
-    print(json.dumps({"metric": "ResNet-50 train images/sec/chip",
-                      "value": round(ips, 2), "unit": "images/sec/chip",
-                      "vs_baseline": round(ips / REFERENCE_RESNET_IPS, 3)}))
-
-
-def bench_ernie2():
-    """ERNIE 2.0 multi-task pretrain (task-sampling schedule, base
-    geometry; the large config is pod-scale and exceeds one chip's HBM
-    with Adam state)."""
-    import jax
-    import paddle_tpu as pt
-    from paddle_tpu.models import bert
-    from paddle_tpu import optimizer
-    on_tpu = any(d.platform in ("tpu", "axon") for d in jax.devices())
-    if on_tpu:
-        batch, seq, preds = 128, 128, 20
-        cfg = bert.bert_base(dtype="bfloat16")
-        steps, warmup = 15, 3
-    else:
-        batch, seq, preds = 4, 32, 4
-        cfg = bert.BertConfig(vocab_size=1024, hidden_size=64, num_layers=2,
-                              num_heads=2, ff_size=128, max_position=64)
-        steps, warmup = 3, 1
-    from paddle_tpu.framework.scope import Scope, scope_guard
-    main_prog, startup, feeds, fetch = bert.ernie2_multitask_program(
-        cfg, batch, seq, preds, dynamic_task_weights=True,
-        optimizer_fn=lambda loss: optimizer.Adam(1e-4).minimize(loss))
-    # own scope, like bench_resnet: free this state before the headline
-    with scope_guard(Scope()):
-        exe = pt.Executor()
-        exe.run(startup)
-        feed = bert.ernie2_synthetic_batch(cfg, batch, seq, preds)
-        feed = {k: jax.device_put(np.asarray(v)) for k, v in feed.items()}
-        sched = list(bert.ernie2_task_schedule(steps + warmup,
-                                               (1., 1., 1.)))
-        staged = [dict(feed, task_weight=jax.device_put(v))
-                  for v in sched]
-        for i in range(warmup):
-            out = exe.run(main_prog, feed=staged[i],
-                          fetch_list=[fetch["loss"]])
-        np.asarray(out[0])
-        t0 = time.perf_counter()
-        ls = [exe.run(main_prog, feed=staged[warmup + i],
-                      fetch_list=[fetch["loss"]], return_numpy=False)[0]
-              for i in range(steps)]
-        vals = [float(np.asarray(l).reshape(-1)[0]) for l in ls]
-        dt = time.perf_counter() - t0
-    assert np.isfinite(vals).all()
-    sps = batch * steps / dt
-    print(json.dumps({
-        "metric": "ERNIE-2.0 multitask pretrain samples/sec/chip",
-        "value": round(sps, 2), "unit": "samples/sec/chip",
-        "vs_baseline": round(sps / REFERENCE_SAMPLES_PER_SEC, 3)}))
 
 
 def _measure_ernie(batch, seq, preds, cfg, steps, warmup):
@@ -187,11 +180,11 @@ def _measure_ernie(batch, seq, preds, cfg, steps, warmup):
     return batch * steps / dt, dt
 
 
-def main():
-    import jax
+def measure_headline():
+    """Measure the flagship number FIRST; returns the headline JSON str."""
     from paddle_tpu.models import bert
 
-    on_tpu = any(d.platform in ("tpu", "axon") for d in jax.devices())
+    on_tpu = _on_tpu()
     # BERT/ERNIE-base, seq 128 — bf16 on TPU; tiny shapes on CPU fallback
     if on_tpu:
         batch, seq, preds = 128, 128, 20
@@ -206,11 +199,32 @@ def main():
 
     sps, dt = _measure_ernie(batch, seq, preds, cfg, steps, warmup)
     best = (batch, sps, dt, steps)
+
+    def headline_json(b):
+        bbatch, sps_, dt_, bsteps = b
+        result = {
+            "metric": HEADLINE_METRIC,
+            "value": round(sps_, 2),
+            "unit": "samples/sec/chip",
+            "vs_baseline": round(sps_ / REFERENCE_SAMPLES_PER_SEC, 3),
+            "batch": bbatch,
+        }
+        peak = _chip_peak_flops()
+        if peak is not None:
+            result["mfu"] = round(
+                bert_train_flops(cfg, bbatch, seq, preds) * bsteps / dt_ /
+                peak, 4)
+        return json.dumps(result)
+
+    # bank the measured number NOW: if the batch-256 attempt below wedges
+    # the fabric, the deadline watchdog still ships this headline
+    _STATE["headline"] = headline_json(best)
     if on_tpu:
         # larger batches amortize per-step overhead and fill the MXU
         # better; keep whichever config sustains more samples/sec.
         # Guarded: an OOM/compile failure on 256 must not cost the
         # already-measured 128 result.
+        _STATE["stage"] = "headline-batch256"
         steps256 = max(steps // 2, 8)
         try:
             sps256, dt256 = _measure_ernie(256, seq, preds, cfg,
@@ -220,33 +234,208 @@ def main():
         except Exception as e:  # pragma: no cover
             print("batch-256 attempt failed: %r" % (e,), file=sys.stderr)
 
-    bbatch, sps, dt, bsteps = best
-    result = {
-        "metric": "ERNIE-base pretrain samples/sec/chip",
-        "value": round(sps, 2),
-        "unit": "samples/sec/chip",
-        "vs_baseline": round(sps / REFERENCE_SAMPLES_PER_SEC, 3),
-        "batch": bbatch,
-    }
-    peak = _chip_peak_flops()
-    if peak is not None:
-        mfu = bert_train_flops(cfg, bbatch, seq, preds) * bsteps / dt / \
-            peak
-        result["mfu"] = round(mfu, 4)
-    print(json.dumps(result))
+    return headline_json(best)
+
+
+def bench_resnet():
+    import jax
+    import paddle_tpu as pt
+    from paddle_tpu.models import resnet
+    from paddle_tpu import optimizer
+    on_tpu = _on_tpu()
+    batch = 128 if on_tpu else 4
+    shape = (3, 224, 224) if on_tpu else (3, 32, 32)
+    steps, warmup = (20, 3) if on_tpu else (3, 1)
+    from paddle_tpu.framework.scope import Scope, scope_guard
+    main_prog, startup, feeds, fetch = resnet.resnet_train_program(
+        depth=50, class_dim=1000, image_shape=shape,
+        optimizer_fn=lambda l: optimizer.Momentum(0.1, 0.9).minimize(l))
+    # own scope: this model's params/optimizer state must not stay
+    # resident in HBM after the section finishes
+    with scope_guard(Scope()):
+        exe = pt.Executor()
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        feed = {"image": rng.rand(batch, *shape).astype(np.float32),
+                "label": rng.randint(0, 1000,
+                                     (batch, 1)).astype(np.int64)}
+        # pre-stage to device once — in production the DataLoader's
+        # background thread double-buffers batches to HBM ahead of
+        # compute (reader.py); re-transferring the same batch each step
+        # would only measure the link
+        feed = {k: jax.device_put(v) for k, v in feed.items()}
+        dt, loss = _run_steps(exe, main_prog, feed, fetch["loss"], steps,
+                              warmup)
+    ips = batch * steps / dt
+    return json.dumps({"metric": "ResNet-50 train images/sec/chip",
+                       "value": round(ips, 2), "unit": "images/sec/chip",
+                       "vs_baseline": round(ips / REFERENCE_RESNET_IPS, 3)})
+
+
+def bench_ernie2():
+    """ERNIE 2.0 multi-task pretrain (task-sampling schedule, base
+    geometry; the large config is pod-scale and exceeds one chip's HBM
+    with Adam state)."""
+    import jax
+    import paddle_tpu as pt
+    from paddle_tpu.models import bert
+    from paddle_tpu import optimizer
+    on_tpu = _on_tpu()
+    if on_tpu:
+        batch, seq, preds = 128, 128, 20
+        cfg = bert.bert_base(dtype="bfloat16")
+        steps, warmup = 15, 3
+    else:
+        batch, seq, preds = 4, 32, 4
+        cfg = bert.BertConfig(vocab_size=1024, hidden_size=64, num_layers=2,
+                              num_heads=2, ff_size=128, max_position=64)
+        steps, warmup = 3, 1
+    from paddle_tpu.framework.scope import Scope, scope_guard
+    main_prog, startup, feeds, fetch = bert.ernie2_multitask_program(
+        cfg, batch, seq, preds, dynamic_task_weights=True,
+        optimizer_fn=lambda loss: optimizer.Adam(1e-4).minimize(loss))
+    with scope_guard(Scope()):
+        exe = pt.Executor()
+        exe.run(startup)
+        feed = bert.ernie2_synthetic_batch(cfg, batch, seq, preds)
+        feed = {k: jax.device_put(np.asarray(v)) for k, v in feed.items()}
+        sched = list(bert.ernie2_task_schedule(steps + warmup,
+                                               (1., 1., 1.)))
+        staged = [dict(feed, task_weight=jax.device_put(v))
+                  for v in sched]
+        for i in range(warmup):
+            out = exe.run(main_prog, feed=staged[i],
+                          fetch_list=[fetch["loss"]])
+        np.asarray(out[0])
+        t0 = time.perf_counter()
+        ls = [exe.run(main_prog, feed=staged[warmup + i],
+                      fetch_list=[fetch["loss"]], return_numpy=False)[0]
+              for i in range(steps)]
+        vals = [float(np.asarray(l).reshape(-1)[0]) for l in ls]
+        dt = time.perf_counter() - t0
+    assert np.isfinite(vals).all()
+    sps = batch * steps / dt
+    return json.dumps({
+        "metric": "ERNIE-2.0 multitask pretrain samples/sec/chip",
+        "value": round(sps, 2), "unit": "samples/sec/chip",
+        "vs_baseline": round(sps / REFERENCE_SAMPLES_PER_SEC, 3)})
+
+
+def pallas_selfcheck():
+    """Flash-attention Pallas-vs-XLA oracle ON THE REAL CHIP — the only
+    coverage of the compiled Mosaic kernels (CPU tests run interpret mode
+    and the <128-block guard routes small shapes to XLA). Exercises fwd +
+    backward in both mask modes (causal, additive padding mask) at
+    T=128/256, f32 and bf16. Closes SURVEY §5 / round-3 Weak #5."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.pallas import flash_attention as fa
+
+    # PADDLE_TPU_BENCH_SELFCHECK_INTERPRET=1: run the same check in
+    # interpret mode off-TPU so the check logic itself is testable on CPU.
+    interp = os.environ.get(
+        "PADDLE_TPU_BENCH_SELFCHECK_INTERPRET") == "1"
+    if not interp and not _on_tpu():
+        return json.dumps({"metric": "pallas_check", "skipped": "no TPU"})
+
+    rng = np.random.RandomState(0)
+    worst = {}
+    for dtype, tol in ((jnp.float32, 1e-5), (jnp.bfloat16, 1e-2)):
+        for t in (128, 256):
+            b, h, d = 2, 4, 64
+            q = jnp.asarray(rng.randn(b, h, t, d), dtype)
+            k = jnp.asarray(rng.randn(b, h, t, d), dtype)
+            v = jnp.asarray(rng.randn(b, h, t, d), dtype)
+            scale = 1.0 / np.sqrt(d)
+            # additive padding mask: last quarter of keys masked out
+            pad = np.zeros((b, 1, 1, t), np.float32)
+            pad[..., 3 * t // 4:] = -1e9
+            # fixed random cotangent shared by both implementations
+            w = jnp.asarray(rng.randn(b, h, t, d).astype(np.float32))
+            for mode, mask, causal in (("causal", None, True),
+                                       ("padmask", jnp.asarray(pad, dtype),
+                                        False)):
+                def pallas_loss(q, k, v, mask=mask, causal=causal):
+                    o = fa.flash_attention(q, k, v, mask=mask, scale=scale,
+                                           causal=causal, interpret=interp)
+                    return jnp.sum(o.astype(jnp.float32) * w)
+
+                def xla_loss(q, k, v, mask=mask, causal=causal):
+                    o = fa._xla_attention(q, k, v, mask, scale, causal)
+                    return jnp.sum(o.astype(jnp.float32) * w)
+
+                grads_p = jax.jit(jax.grad(pallas_loss,
+                                           argnums=(0, 1, 2)))(q, k, v)
+                grads_x = jax.jit(jax.grad(xla_loss,
+                                           argnums=(0, 1, 2)))(q, k, v)
+                o_p = fa.flash_attention(q, k, v, mask=mask, scale=scale,
+                                         causal=causal, interpret=interp)
+                o_x = fa._xla_attention(q, k, v, mask, scale, causal)
+                abs_errs, rel_errs = [], []
+                for a, b_ in [(o_p, o_x)] + list(zip(grads_p, grads_x)):
+                    diff = float(jnp.max(jnp.abs(
+                        a.astype(jnp.float32) - b_.astype(jnp.float32))))
+                    mag = float(jnp.max(jnp.abs(b_.astype(jnp.float32))))
+                    abs_errs.append(diff)
+                    # normalize by the oracle's dynamic range: a bf16
+                    # result is only representable to ~0.4% of its
+                    # magnitude, so absolute error alone would flag
+                    # 1-ulp differences on large-magnitude grads
+                    rel_errs.append(diff / max(mag, 1.0))
+                key = "%s_T%d_%s" % (np.dtype(dtype).name, t, mode)
+                worst[key] = {"max_abs_err": round(max(abs_errs), 8),
+                              "max_rel_err": round(max(rel_errs), 8),
+                              "tol": tol, "ok": max(rel_errs) < tol}
+    return json.dumps({"metric": "pallas_check", "checks": worst,
+                       "ok": all(c["ok"] for c in worst.values())})
+
+
+def run_all():
+    deadline = _arm_deadline()
+    _STATE["stage"] = "backend-probe"
+    platforms, err = _probe_backend()
+    if err is not None:
+        _STATE["headline"] = _error_headline(err)
+        _flush_and_exit(0)
+    sys.stderr.write("backend: %s\n" % ",".join(platforms))
+
+    # 1) headline FIRST — nothing may starve it
+    _STATE["stage"] = "headline"
+    try:
+        _STATE["headline"] = measure_headline()
+    except Exception as e:
+        _STATE["headline"] = _error_headline("headline failed: %r" % (e,))
+        _flush_and_exit(0)
+
+    # 2) secondaries — buffered, each fenced
+    for name, fn in (("resnet", bench_resnet), ("ernie2", bench_ernie2),
+                     ("pallas_check", pallas_selfcheck)):
+        _STATE["stage"] = name
+        try:
+            line = fn()
+            _STATE["lines"].append(line)
+            if name == "pallas_check":
+                # a kernel-correctness regression must be visible in the
+                # ONE line the driver parses, not only in a buffered
+                # secondary
+                parsed = json.loads(line)
+                if "ok" in parsed:
+                    head = json.loads(_STATE["headline"])
+                    head["pallas_check_ok"] = parsed["ok"]
+                    _STATE["headline"] = json.dumps(head)
+        except Exception as e:  # pragma: no cover
+            print("%s failed: %r" % (name, e), file=sys.stderr)
+
+    deadline.cancel()
+    _flush_and_exit(0)
 
 
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "resnet":
-        bench_resnet()
+        print(bench_resnet())
     elif len(sys.argv) > 1 and sys.argv[1] == "ernie2":
-        bench_ernie2()
+        print(bench_ernie2())
+    elif len(sys.argv) > 1 and sys.argv[1] == "pallas":
+        print(pallas_selfcheck())
     else:
-        # secondary configs first so the driver's last-line parse still
-        # captures the ERNIE headline; never let them break the headline
-        for fn in (bench_resnet, bench_ernie2):
-            try:
-                fn()
-            except Exception as e:  # pragma: no cover
-                print("%s failed: %r" % (fn.__name__, e), file=sys.stderr)
-        main()
+        run_all()
