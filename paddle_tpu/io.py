@@ -44,6 +44,20 @@ def _atomic_savez(dirname, filename, arrays):
             os.unlink(tmp)
 
 
+def _atomic_write(path, text):
+    d = os.path.dirname(path)
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+    os.close(fd)
+    try:
+        with open(tmp, "w") as f:
+            f.write(text)
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
 def save_params(executor, dirname, main_program=None, filename=None):
     program = main_program or default_main_program()
     arrays = _collect(program, global_scope(),
@@ -88,6 +102,15 @@ def load_persistables(executor, dirname, main_program=None, filename=None):
             scope.set_var(v.name, jnp.asarray(arrays[v.name]))
 
 
+# Inference artifact format history (reference analogue: the predictor
+# config/version machinery in
+# paddle/fluid/inference/api/analysis_predictor.h:47):
+#   v1 (implicit — no "format_version" key): program + feeds/fetches only.
+#   v2: + "format_version", + "param_manifest" {name: {shape, dtype}}
+#       validated against params.npz at load with named errors.
+INFERENCE_FORMAT_VERSION = 2
+
+
 def save_inference_model(dirname, feeded_var_names, target_vars, executor,
                          main_program=None, model_filename=None,
                          params_filename=None, export_for_deployment=True,
@@ -98,16 +121,21 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
     target_names = [v.name for v in target_vars]
     pruned = test_prog._prune(list(feeded_var_names), target_names)
     os.makedirs(dirname, exist_ok=True)
-    meta = {"program": pruned.to_dict(),
-            "feed_var_names": list(feeded_var_names),
-            "fetch_var_names": target_names}
-    fd, tmp = tempfile.mkstemp(dir=dirname, suffix=".tmp")
-    os.close(fd)
-    with open(tmp, "w") as f:
-        json.dump(meta, f)
-    os.replace(tmp, os.path.join(dirname, model_filename or MODEL_FILE))
+    arrays = None
+    manifest = {}
     if not program_only:
         arrays = _collect(pruned, global_scope(), lambda v: v.persistable)
+        manifest = {name: {"shape": list(arr.shape),
+                           "dtype": arr.dtype.name}
+                    for name, arr in arrays.items()}
+    meta = {"format_version": INFERENCE_FORMAT_VERSION,
+            "program": pruned.to_dict(),
+            "feed_var_names": list(feeded_var_names),
+            "fetch_var_names": target_names,
+            "param_manifest": manifest}
+    _atomic_write(os.path.join(dirname, model_filename or MODEL_FILE),
+                  json.dumps(meta))
+    if arrays is not None:
         _atomic_savez(dirname, params_filename or PARAMS_FILE, arrays)
     return target_names
 
@@ -115,10 +143,39 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
 def load_inference_model(dirname, executor, model_filename=None,
                          params_filename=None):
     import jax.numpy as jnp
-    with open(os.path.join(dirname, model_filename or MODEL_FILE)) as f:
+    model_path = os.path.join(dirname, model_filename or MODEL_FILE)
+    if not os.path.exists(model_path):
+        raise ValueError("inference model file %r does not exist"
+                         % model_path)
+    with open(model_path) as f:
         meta = json.load(f)
+    version = meta.get("format_version", 1)   # v1 artifacts predate the key
+    if version > INFERENCE_FORMAT_VERSION:
+        raise ValueError(
+            "inference model %s has format_version %d, newer than this "
+            "library's %d — upgrade paddle_tpu to load it"
+            % (dirname, version, INFERENCE_FORMAT_VERSION))
     program = Program.from_dict(meta["program"])
     arrays = _load_arrays(dirname, params_filename)
+    manifest = meta.get("param_manifest") or {}
+    if manifest:
+        missing = sorted(set(manifest) - set(arrays))
+        if missing:
+            raise ValueError(
+                "inference model %s: params file is missing variables %s "
+                "declared in the manifest" % (dirname, missing))
+        for name, spec in manifest.items():
+            arr = arrays[name]
+            if list(arr.shape) != list(spec["shape"]):
+                raise ValueError(
+                    "inference model %s: variable %r has shape %s on disk "
+                    "but the manifest declares %s"
+                    % (dirname, name, list(arr.shape), spec["shape"]))
+            if arr.dtype.name != spec["dtype"]:
+                raise ValueError(
+                    "inference model %s: variable %r has dtype %s on disk "
+                    "but the manifest declares %s"
+                    % (dirname, name, arr.dtype.name, spec["dtype"]))
     scope = global_scope()
     for name, arr in arrays.items():
         scope.set_var(name, jnp.asarray(arr))
@@ -128,35 +185,221 @@ def load_inference_model(dirname, executor, model_filename=None,
 # ---------------------------------------------------------------------------
 # training checkpoint/resume (reference: fluid.io.save/load_checkpoint era
 # APIs + incubate checkpoint): params + optimizer state + counters.
+#
+# Sharded, multi-host-safe format (reference analogue:
+# fluid.io._save_distributed_persistables, python/paddle/fluid/io.py:347 —
+# each pserver saves the vars IT owns; here each jax process saves the
+# array shards IT holds):
+#   <dir>/step_N/shards_p{process}.npz   per-process shard payloads
+#   <dir>/step_N/manifest.json           written LAST by process 0 — the
+#                                        commit point: format version, step,
+#                                        per-var {shape, dtype, shards:
+#                                        [{offsets, file, key}]}
+# Restore stitches by offsets, so the saving and restoring meshes may have
+# DIFFERENT topologies (dp2xmp2 -> dp4xmp2 resharding is just slicing).
 # ---------------------------------------------------------------------------
+
+CKPT_FORMAT_VERSION = 1
+MANIFEST_FILE = "manifest.json"
+
+
+def _offset_list(idx, shape):
+    """Normalize a devices_indices_map entry to [[start, stop], ...]."""
+    out = []
+    for s, dim in zip(idx, shape):
+        start = 0 if s.start is None else int(s.start)
+        stop = dim if s.stop is None else int(s.stop)
+        out.append([start, stop])
+    return out
+
+
+def _shard_plan(val):
+    """Distinct shard extents of a jax.Array -> owning device.
+
+    Replicas (several devices holding the same index) dedupe to the
+    lowest device id, so every byte is written exactly once across all
+    processes."""
+    shape = val.shape
+    plan = {}
+    for dev, idx in val.sharding.devices_indices_map(shape).items():
+        key = tuple(tuple(p) for p in _offset_list(idx, shape))
+        if key not in plan or dev.id < plan[key].id:
+            plan[key] = dev
+    return plan
+
 
 def save_checkpoint(executor, dirname, main_program=None, step=None,
                     keep_last=3):
-    program = main_program or default_main_program()
+    """Sharded checkpoint of the whole training scope.
+
+    Multi-host semantics: every process calls this with the same args;
+    each writes only its addressable (deduped) shards, all processes
+    barrier, then process 0 alone commits manifest.json + "latest" and
+    prunes old step dirs.  A crash before the manifest leaves the
+    previous checkpoint as "latest" — restores never see a torn save.
+    """
+    import jax
     scope = global_scope()
-    arrays = {}
-    for name, val in scope.items():
+    pid = jax.process_index()
+    step_no = int(step if step is not None else 0)
+    step_dir = "step_%d" % step_no
+    full_dir = os.path.join(dirname, step_dir)
+
+    own, manifest_vars = {}, {}
+    for name, val in sorted(scope.items()):
         if val is None:
             continue
-        arrays[name.replace("@", "__AT__")] = np.asarray(val)
-    step_dir = "step_%d" % (step if step is not None else 0)
-    _atomic_savez(os.path.join(dirname, step_dir), PARAMS_FILE, arrays)
-    with open(os.path.join(dirname, "latest"), "w") as f:
-        f.write(step_dir)
-    # prune old checkpoints
-    kids = sorted([d for d in os.listdir(dirname) if d.startswith("step_")],
-                  key=lambda d: int(d.split("_")[1]))
-    for d in kids[:-keep_last]:
-        import shutil
-        shutil.rmtree(os.path.join(dirname, d), ignore_errors=True)
+        # shard keys are derived from the VAR NAME (sanitized for npz/zip
+        # member names), never a global counter: if the scopes of two
+        # processes ever diverge, the manifest's key is absent from the
+        # divergent process's npz and the load fails HARD (KeyError)
+        # instead of silently restoring the wrong tensor.
+        safe = name.replace("/", "#SL#")
+        if isinstance(val, jax.Array) and not val.is_fully_replicated:
+            shape, dtype = val.shape, np.dtype(val.dtype)
+            local = {tuple(tuple(p) for p in _offset_list(s.index, shape)):
+                     s for s in val.addressable_shards}
+            shards = []
+            for j, (offs, dev) in enumerate(
+                    sorted(_shard_plan(val).items(), key=lambda kv: kv[0])):
+                key = "%s##%d" % (safe, j)
+                shards.append({"offsets": [list(p) for p in offs],
+                               "file": "shards_p%d.npz" % dev.process_index,
+                               "key": key})
+                if dev.process_index == pid:
+                    own[key] = np.asarray(local[offs].data)
+            manifest_vars[name] = {"shape": list(shape),
+                                   "dtype": dtype.name, "shards": shards}
+        else:
+            # replicated/host value: only process 0 transfers + writes it;
+            # other processes record metadata without touching the bytes
+            shape = tuple(getattr(val, "shape", ()) or ())
+            dtype = np.dtype(getattr(val, "dtype", None) or
+                             np.asarray(val).dtype)
+            key = "%s##full" % safe
+            if pid == 0:
+                arr = np.asarray(val)
+                shape, dtype = arr.shape, arr.dtype
+                own[key] = arr
+            manifest_vars[name] = {
+                "shape": list(shape), "dtype": dtype.name,
+                "shards": [{"offsets": [[0, d] for d in shape],
+                            "file": "shards_p0.npz", "key": key}]}
+
+    _atomic_savez(full_dir, "shards_p%d.npz" % pid, own)
+    multihost = jax.process_count() > 1
+    if multihost:  # pragma: no cover - needs real multihost
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices("ckpt_shards_%s" % step_dir)
+    if pid == 0:
+        manifest = {"format_version": CKPT_FORMAT_VERSION, "step": step_no,
+                    "process_count": jax.process_count(),
+                    "vars": manifest_vars}
+        _atomic_write(os.path.join(full_dir, MANIFEST_FILE),
+                      json.dumps(manifest))
+        _atomic_write(os.path.join(dirname, "latest"), step_dir)
+        kids = sorted([d for d in os.listdir(dirname)
+                       if d.startswith("step_")],
+                      key=lambda d: int(d.split("_")[1]))
+        for d in kids[:-keep_last]:
+            import shutil
+            shutil.rmtree(os.path.join(dirname, d), ignore_errors=True)
+    if multihost:  # pragma: no cover - needs real multihost
+        # hold every process until the manifest commit is durable — a
+        # worker returning (and its orchestrator tearing the job down)
+        # while process 0 is still writing must not lose the checkpoint
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices("ckpt_commit_%s" % step_dir)
 
 
-def load_checkpoint(executor, dirname, main_program=None):
+def _stitch(meta, req, readers, dtype, name="<var>"):
+    """Assemble the requested [[start, stop], ...] extent of one var from
+    its stored shards (which may tile it differently — resharding).
+    Raises if the stored tiles do not cover the whole extent — a torn or
+    truncated manifest must be a hard error, never silent garbage."""
+    out = np.empty([b - a for a, b in req], dtype)
+    want = int(np.prod([b - a for a, b in req])) if req else 1
+    covered = 0
+    for sh in meta["shards"]:
+        offs = sh["offsets"]
+        inter = [(max(a, ra), min(b, rb))
+                 for (a, b), (ra, rb) in zip(offs, req)]
+        if any(a >= b for a, b in inter):
+            continue
+        data = readers(sh["file"], sh["key"])
+        src = tuple(slice(a - oa, b - oa)
+                    for (a, b), (oa, _ob) in zip(inter, offs))
+        dst = tuple(slice(a - ra, b - ra)
+                    for (a, b), (ra, _rb) in zip(inter, req))
+        out[dst] = data[src]
+        covered += int(np.prod([b - a for a, b in inter])) if inter else 1
+    if covered < want:
+        raise ValueError(
+            "checkpoint shards for %r cover only %d of %d elements of "
+            "extent %r — manifest is torn or truncated" %
+            (name, covered, want, req))
+    return out
+
+
+def load_checkpoint(executor, dirname, main_program=None, shardings=None):
+    """Restore the latest checkpoint into the global scope.
+
+    shardings: optional {var_name: jax.sharding.Sharding} — vars listed
+    are materialized straight onto the CURRENT mesh via
+    jax.make_array_from_callback (each process reads only the slices its
+    devices need; works when the restore topology differs from the save
+    topology).  Unlisted vars load as host arrays and are placed by the
+    next CompiledProgram/Executor run, exactly like a cold start.
+    """
+    import jax
     import jax.numpy as jnp
     with open(os.path.join(dirname, "latest")) as f:
         step_dir = f.read().strip()
-    arrays = _load_arrays(os.path.join(dirname, step_dir), PARAMS_FILE)
+    full_dir = os.path.join(dirname, step_dir)
+    manifest_path = os.path.join(full_dir, MANIFEST_FILE)
     scope = global_scope()
-    for name, arr in arrays.items():
-        scope.set_var(name.replace("__AT__", "@"), jnp.asarray(arr))
-    return int(step_dir.split("_")[1])
+    if not os.path.exists(manifest_path):
+        # legacy (format 0) host-gather npz checkpoint
+        arrays = _load_arrays(full_dir, PARAMS_FILE)
+        for name, arr in arrays.items():
+            scope.set_var(name.replace("__AT__", "@"), jnp.asarray(arr))
+        return int(step_dir.split("_")[1])
+
+    with open(manifest_path) as f:
+        manifest = json.load(f)
+    if manifest.get("format_version", 0) > CKPT_FORMAT_VERSION:
+        raise ValueError(
+            "checkpoint %s has format_version %s, newer than this "
+            "library's %d" % (full_dir, manifest.get("format_version"),
+                              CKPT_FORMAT_VERSION))
+    handles, arrays_cache = {}, {}
+
+    def readers(fname, key):
+        # cache decoded ARRAYS, not just npz handles: with shardings=,
+        # _stitch runs once per local device shard and NpzFile.__getitem__
+        # re-decompresses the member on every access
+        if (fname, key) not in arrays_cache:
+            if fname not in handles:
+                handles[fname] = np.load(os.path.join(full_dir, fname),
+                                         allow_pickle=False)
+            arrays_cache[(fname, key)] = handles[fname][key]
+        return arrays_cache[(fname, key)]
+
+    shardings = shardings or {}
+    for name, meta in manifest["vars"].items():
+        shape = tuple(meta["shape"])
+        dtype = np.dtype(meta["dtype"])
+        target = shardings.get(name)
+        if target is not None:
+            arr = jax.make_array_from_callback(
+                shape, target,
+                lambda idx, meta=meta, shape=shape, dtype=dtype, name=name:
+                _stitch(meta, _offset_list(idx, shape), readers, dtype,
+                        name))
+        else:
+            arr = _stitch(meta, [[0, d] for d in shape], readers, dtype,
+                          name)
+        scope.set_var(name, arr)
+    for h in handles.values():
+        h.close()
+    return int(manifest["step"])
