@@ -1,0 +1,180 @@
+"""Level-2 (nested) LoD parity audit — oracle tests encoding the
+reference's documented 2-level semantics against the dense+lengths
+design, per the contracts in PORTING.md "LoD level-2 semantics".
+
+References:
+  - beam_search_decode backtrace: paddle/fluid/operators/
+    beam_search_decode_op.h:143 (Backtrace walks steps last->first,
+    following each step's prefix index)
+  - sequence_expand: python/paddle/fluid/layers/sequence_lod.py:596
+    (Case 1: 1-level x + ref_level=0 of a 2-level y; Case 2: plain x
+    with zero-repeat rows)
+  - create_lod_tensor nested lod: python/paddle/fluid/lod_tensor.py
+"""
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+
+
+def _run(main, feed, fetch):
+    exe = pt.Executor()
+    return exe.run(main, feed=feed, fetch_list=fetch)
+
+
+def _ref_backtrace(ids, parents, batch, beam):
+    """Numpy transcription of the C++ Backtrace recurrence
+    (beam_search_decode_op.h:143): for each final beam slot, walk the
+    steps backward following the step's prefix (parent) index."""
+    T = len(ids)
+    out = np.zeros((batch, beam, T), np.int64)
+    for s in range(batch):
+        for k in range(beam):
+            slot = k
+            for t in range(T - 1, -1, -1):
+                out[s, k, t] = ids[t][s * beam + slot, 0]
+                if t > 0:
+                    slot = parents[t][s * beam + slot]
+    return out
+
+
+def test_beam_search_decode_backtrace_matches_reference():
+    batch, beam, T = 2, 2, 3
+    rng = np.random.RandomState(3)
+    ids_np = [rng.randint(1, 50, (batch * beam, 1)).astype(np.int64)
+              for _ in range(T)]
+    # parent indices are LOCAL beam slots (0..beam-1) per source
+    par_np = [rng.randint(0, beam, (batch * beam,)).astype(np.int64)
+              for _ in range(T)]
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        id_vars = [layers.data("bsd_id%d" % t, [batch * beam, 1], "int64",
+                               append_batch_size=False) for t in range(T)]
+        par_vars = [None] + [
+            layers.data("bsd_par%d" % t, [batch * beam], "int64",
+                        append_batch_size=False) for t in range(1, T)]
+        sent_ids, sent_scores = layers.beam_search_decode(
+            id_vars, par_vars, beam_size=beam, end_id=0)
+    feed = {"bsd_id%d" % t: ids_np[t] for t in range(T)}
+    feed.update({"bsd_par%d" % t: par_np[t] for t in range(1, T)})
+    exe = pt.Executor()
+    exe.run(startup)
+    got, = exe.run(main, feed=feed, fetch_list=[sent_ids])
+    want = _ref_backtrace(ids_np, par_np, batch, beam)
+    np.testing.assert_array_equal(np.asarray(got), want)
+
+
+def test_beam_search_decode_end_id_padding_contract():
+    """Documented deviation (PORTING.md): the reference PRUNES a
+    hypothesis after its end token (variable-length level-2 LoD rows);
+    the dense output keeps emitting end_id to fixed length T.  Mapping
+    rule under test: truncating each row at the first end_id recovers
+    the reference's sequence."""
+    batch, beam, T, end_id = 1, 2, 4, 0
+    # beam 0 finishes at t=1 (emits end); finished beams re-select
+    # themselves (parent=self) and re-emit end_id, like the framework's
+    # beam_search masks do
+    ids_np = [np.array([[7], [9]]), np.array([[end_id], [3]]),
+              np.array([[end_id], [5]]), np.array([[end_id], [2]])]
+    ids_np = [a.astype(np.int64) for a in ids_np]
+    par_np = [np.array([0, 1], np.int64) for _ in range(T)]
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        id_vars = [layers.data("pe_id%d" % t, [batch * beam, 1], "int64",
+                               append_batch_size=False) for t in range(T)]
+        par_vars = [None] + [
+            layers.data("pe_par%d" % t, [batch * beam], "int64",
+                        append_batch_size=False) for t in range(1, T)]
+        sent_ids, _ = layers.beam_search_decode(
+            id_vars, par_vars, beam_size=beam, end_id=end_id)
+    feed = {"pe_id%d" % t: ids_np[t] for t in range(T)}
+    feed.update({"pe_par%d" % t: par_np[t] for t in range(1, T)})
+    exe = pt.Executor()
+    exe.run(startup)
+    got, = exe.run(main, feed=feed, fetch_list=[sent_ids])
+    got = np.asarray(got)[0]
+
+    def truncate(row):
+        hit = np.where(row == end_id)[0]
+        return list(row[:hit[0]]) if len(hit) else list(row)
+
+    assert truncate(got[0]) == [7]          # pruned at end -> just [7]
+    assert truncate(got[1]) == [9, 3, 5, 2]  # never finished: full row
+
+
+def test_sequence_expand_reference_case1_two_level_y():
+    """Reference Case 1: x 1-level ([a,b],[c,d]), y 2-level with
+    ref_level=0 lod [2,2] -> [ab][ab][cd][cd].  Dense mapping: x rows =
+    padded sub-sequences; counts = y's ref_level lengths."""
+    x_dense = pt.create_lod_tensor(
+        np.array([[1.], [2.], [3.], [4.]], np.float32), [[2, 2]])
+    counts = np.array([2, 2], np.int64)
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        xv = layers.data("se_x", list(x_dense.data.shape), "float32",
+                         append_batch_size=False)
+        cv = layers.data("se_c", [2], "int64", append_batch_size=False)
+        out, out_len = layers.sequence_expand(xv, cv, ref_level=0,
+                                              out_len=8)
+    exe = pt.Executor()
+    exe.run(startup)
+    ov, ol = exe.run(main, feed={"se_x": x_dense.data, "se_c": counts},
+                     fetch_list=[out, out_len])
+    ov, n = np.asarray(ov), int(np.asarray(ol).reshape(-1)[0])
+    assert n == 4          # 4 expanded sub-sequences
+    # flatten rows through their lengths -> reference flat data
+    lens = np.repeat(x_dense.lengths, counts)
+    flat = np.concatenate([ov[i, :l, 0] for i, l in enumerate(lens)])
+    np.testing.assert_allclose(flat, [1, 2, 1, 2, 3, 4, 3, 4])
+
+
+def test_sequence_expand_reference_case2_zero_counts():
+    """Reference Case 2: x rows [a],[b],[c], counts [2,0,3] ->
+    [a,a,c,c,c] (zero-count rows dropped)."""
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        xv = layers.data("se2_x", [3, 1], "float32",
+                         append_batch_size=False)
+        cv = layers.data("se2_c", [3], "int64", append_batch_size=False)
+        out, out_len = layers.sequence_expand(xv, cv, ref_level=-1,
+                                              out_len=6)
+    exe = pt.Executor()
+    exe.run(startup)
+    ov, ol = exe.run(main, feed={
+        "se2_x": np.array([[1.], [2.], [3.]], np.float32),
+        "se2_c": np.array([2, 0, 3], np.int64)}, fetch_list=[out, out_len])
+    ov, n = np.asarray(ov), int(np.asarray(ol).reshape(-1)[0])
+    assert n == 5
+    np.testing.assert_allclose(ov[:5, 0], [1, 1, 3, 3, 3])
+    np.testing.assert_allclose(ov[5:, 0], 0)   # capacity tail zeroed
+
+
+def test_create_lod_tensor_nested_two_level():
+    """Nested [[2, 2], [3, 3, 1, 1]] flattens to outer token totals
+    [6, 2] (ref lod_tensor.py: a 2-level LoD's outer level groups
+    sub-sequences; dense design stores tokens per outer sequence)."""
+    data = np.arange(8, dtype=np.float32)[:, None]
+    t = pt.create_lod_tensor(data, [[2, 2], [3, 3, 1, 1]])
+    assert list(t.lengths) == [6, 2]
+    assert t.lod() == [[0, 6, 8]]
+    assert t.recursive_sequence_lengths() == [[6, 2]]
+    np.testing.assert_allclose(t.data[0, :6, 0], np.arange(6))
+    np.testing.assert_allclose(t.data[1, :2, 0], [6, 7])
+
+
+def test_lod_reset_and_append_are_data_identity():
+    """Contract (PORTING.md): LoD travels as external lengths, so
+    lod_reset/lod_append return x unchanged and the NEW lengths are
+    passed alongside to the consuming sequence op."""
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("lr_x", [2, 3], "float32", append_batch_size=False)
+        r = layers.lod_reset(x, target_lod=[1, 2])
+        a = layers.lod_append(r, [1, 1])
+    exe = pt.Executor()
+    exe.run(startup)
+    xv = np.random.RandomState(0).rand(2, 3).astype(np.float32)
+    rv, av = exe.run(main, feed={"lr_x": xv}, fetch_list=[r, a])
+    np.testing.assert_allclose(np.asarray(rv), xv)
+    np.testing.assert_allclose(np.asarray(av), xv)
