@@ -401,7 +401,11 @@ elementwise_mod = _elementwise_layer("elementwise_mod")
 elementwise_floordiv = _elementwise_layer("elementwise_floordiv")
 
 
-def matmul(x, y, transpose_x=False, transpose_y=False, alpha=1.0, name=None):
+def matmul(x, y, transpose_x=False, transpose_y=False, alpha=1.0, name=None,
+           out_dtype=None):
+    """out_dtype (TPU extension): accumulate in a wider dtype than the
+    inputs (e.g. bf16 operands -> float32 output in one MXU pass) —
+    the mixed-precision recipe for vocab-scale projections."""
     helper = LayerHelper("matmul", name=name)
     shape = None
     if x.shape is not None and y.shape is not None:
@@ -412,11 +416,14 @@ def matmul(x, y, transpose_x=False, transpose_y=False, alpha=1.0, name=None):
             n = ys[-2] if transpose_y else ys[-1]
             shape = tuple(xs[:-2]) + (m, n) if len(xs) >= len(ys) \
                 else tuple(ys[:-2]) + (m, n)
-    out = helper.create_variable_for_type_inference(x.dtype, shape)
+    out = helper.create_variable_for_type_inference(out_dtype or x.dtype,
+                                                    shape)
+    attrs = {"transpose_X": transpose_x, "transpose_Y": transpose_y,
+             "alpha": alpha}
+    if out_dtype:
+        attrs["out_dtype"] = out_dtype
     helper.append_op("matmul", inputs={"X": [x.name], "Y": [y.name]},
-                     outputs={"Out": [out.name]},
-                     attrs={"transpose_X": transpose_x,
-                            "transpose_Y": transpose_y, "alpha": alpha})
+                     outputs={"Out": [out.name]}, attrs=attrs)
     return out
 
 

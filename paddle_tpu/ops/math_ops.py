@@ -199,7 +199,15 @@ def _matmul(ctx, ins, attrs):
         x = jnp.swapaxes(x, -1, -2)
     if ty:
         y = jnp.swapaxes(y, -1, -2)
-    out = jnp.matmul(x, y)
+    # out_dtype: accumulate on the MXU in a wider type than the inputs
+    # (bf16 x bf16 -> f32 logits in ONE pass — the mixed-precision path
+    # for vocab-scale projections; maps to XLA preferred_element_type)
+    out_dt = attrs.get("out_dtype")
+    if out_dt:
+        from ..framework.dtypes import to_jax_dtype
+        out = jnp.matmul(x, y, preferred_element_type=to_jax_dtype(out_dt))
+    else:
+        out = jnp.matmul(x, y)
     if alpha != 1.0:
         out = out * alpha
     return {"Out": out}

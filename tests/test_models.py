@@ -30,6 +30,21 @@ def test_bert_tiny_pretrain():
     _train(main, startup, fetch, batch)
 
 
+def test_bert_tiny_pretrain_bf16_mixed_precision_decode():
+    """bf16 config: encoder + tied-vocab MLM decode run bf16 (the decode
+    matmul accumulates straight to f32 logits via out_dtype) and the
+    model still trains down."""
+    from paddle_tpu.models import bert
+    cfg = bert.BertConfig(vocab_size=256, hidden_size=32, num_layers=2,
+                          num_heads=2, ff_size=64, max_position=32,
+                          dtype="bfloat16")
+    main, startup, feeds, fetch = bert.bert_pretrain_program(
+        cfg, 2, 16, 4,
+        optimizer_fn=lambda l: optimizer.Adam(1e-3).minimize(l))
+    batch = bert.synthetic_batch(cfg, 2, 16, 4)
+    _train(main, startup, fetch, batch)
+
+
 def test_resnet18_tiny():
     from paddle_tpu.models import resnet
     main, startup, feeds, fetch = resnet.resnet_train_program(

@@ -239,3 +239,35 @@ def test_categorical_log_prob_and_entropy():
     p = np.exp(ref)
     np.testing.assert_allclose(np.asarray(entv), -(p * ref).sum(-1),
                                rtol=1e-5)
+
+
+def test_matmul_out_dtype_bf16_accumulates_f32():
+    """matmul out_dtype: bf16 operands produce float32 output in one op
+    (preferred_element_type), matching a float32 matmul of the rounded
+    operands; gradients flow back to a trainable bf16 operand."""
+    from paddle_tpu import optimizer
+    import jax.numpy as jnp
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("mmod_x", (4, 8), "float32",
+                        append_batch_size=False)
+        w = layers.create_parameter(
+            [6, 8], "float32", name="mmod_w",
+            default_initializer=pt.initializer.Constant(0.5))
+        out = layers.matmul(layers.cast(x, "bfloat16"),
+                            layers.cast(w, "bfloat16"),
+                            transpose_y=True, out_dtype="float32")
+        loss = layers.reduce_mean(layers.square(out))
+        optimizer.SGD(0.1).minimize(loss)
+        grads = pt.gradients(loss, [w])
+    exe = pt.Executor()
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    xv = rng.randn(4, 8).astype(np.float32)
+    ov, gv = exe.run(main, feed={"mmod_x": xv}, fetch_list=[out, grads[0]])
+    ov = np.asarray(ov)
+    assert ov.dtype == np.float32
+    ref = np.asarray(jnp.asarray(xv, jnp.bfloat16), np.float32) @ \
+        np.full((8, 6), 0.5, np.float32)
+    np.testing.assert_allclose(ov, ref, rtol=1e-6, atol=1e-6)
+    assert np.isfinite(np.asarray(gv)).all()
