@@ -62,13 +62,16 @@ def test_ernie2_large_per_chip_state_fits_v5e():
     cfg = bert.ernie2_large(recompute=True)   # tp=True: mp shardings on
     main, _startup, _feeds, _fetch = _build(cfg, 8, 16, 2)
 
+    from paddle_tpu.framework.dtypes import dtype_size
+
     param_b = opt_b = repl_b = 0
     for var in main.list_vars():
         if not var.persistable or var.name.startswith("@"):
             continue
         b = _per_chip_bytes(var, MESH_AXES)
         shape = [d for d in (var.shape or ()) if d not in (None, -1)]
-        repl_b += (int(np.prod(shape)) if shape else 1) * 4
+        repl_b += (int(np.prod(shape)) if shape else 1) * \
+            dtype_size(var.dtype)
         if isinstance(var, Parameter):
             param_b += b
         else:
