@@ -12,6 +12,7 @@ from . import fleet
 from . import launch
 from .launch import init_on_pod
 from .ring_attention import ring_attention
+from .ulysses_attention import ulysses_attention
 from .pipeline import (pipeline_forward, pipeline_loss_and_grads,
                        pipeline_1f1b_step, stack_stage_params)
 from .sharded_embedding import (sharded_embedding_lookup, ShardedEmbedding,
