@@ -220,12 +220,20 @@ class Executor(object):
                 arr = arr.astype(dtype)
             if var is not None and var.shape is not None:
                 want = var.shape
-                if len(want) == arr.ndim:
-                    for w, g in zip(want, arr.shape):
-                        if w not in (-1, g):
-                            raise ValueError(
-                                "feed %r shape %s incompatible with declared "
-                                "%s" % (name, arr.shape, want))
+                if len(want) != arr.ndim:
+                    # named error at the feed boundary (reference parity:
+                    # DataFeeder's check), instead of a jax shape error
+                    # deep inside the trace
+                    raise ValueError(
+                        "feed %r has rank %d (shape %s) but the program "
+                        "declares rank %d (shape %s)"
+                        % (name, arr.ndim, tuple(arr.shape), len(want),
+                           tuple(want)))
+                for w, g in zip(want, arr.shape):
+                    if w not in (-1, g):
+                        raise ValueError(
+                            "feed %r shape %s incompatible with declared "
+                            "%s" % (name, arr.shape, want))
             out[name] = arr
         host = [k for k, v in out.items() if not isinstance(v, jax.Array)]
         if host:

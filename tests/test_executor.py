@@ -49,3 +49,20 @@ def test_prefetch_iterator_propagates_errors():
     with pytest.raises(RuntimeError):
         for _ in it:
             pass
+
+
+def test_wrong_rank_feed_named_error():
+    """A wrong-rank feed must fail at the feed boundary with the var's
+    name, not as a jax shape error deep inside the trace."""
+    import pytest
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("rank_x", [4], dtype="float32")
+        y = layers.scale(x, scale=2.0)
+    exe = pt.Executor()
+    exe.run(startup)
+    with pytest.raises(ValueError, match="rank_x.*rank"):
+        exe.run(main, feed={"rank_x": np.ones(4, np.float32)},  # rank 1
+                fetch_list=[y])                                 # wants 2
