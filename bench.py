@@ -392,6 +392,16 @@ def pallas_selfcheck():
 
 def run_all():
     deadline = _arm_deadline()
+    try:
+        # persistent compile cache: if a previous bench attempt died
+        # mid-compile (driver timeout, fabric blip), the retry skips the
+        # compiles it already paid for
+        import jax
+        jax.config.update("jax_compilation_cache_dir",
+                          os.environ.get("PADDLE_TPU_COMPILE_CACHE",
+                                         "/tmp/paddle_tpu_jax_cache"))
+    except Exception:  # pragma: no cover
+        pass
     _STATE["stage"] = "backend-probe"
     platforms, err = _probe_backend()
     if err is not None:
