@@ -228,8 +228,41 @@ def _shard_plan(val):
     return plan
 
 
+class AsyncCheckpoint(object):
+    """Handle for a save_checkpoint(..., blocking=False) in flight.
+    result() joins the writer thread and re-raises any commit failure."""
+
+    def __init__(self, thread, box):
+        self._thread = thread
+        self._box = box
+
+    def done(self):
+        return not self._thread.is_alive()
+
+    def result(self, timeout=None):
+        self._thread.join(timeout)
+        if self._thread.is_alive():
+            raise TimeoutError("checkpoint commit still in flight")
+        if self._box.get("error") is not None:
+            raise self._box["error"]
+
+
+_pending_save = [None]   # at most one async commit in flight per process
+_atexit_registered = False
+
+
+def wait_for_pending_saves():
+    """Block until a previous blocking=False checkpoint has committed."""
+    h = _pending_save[0]
+    if h is not None:
+        # clear the slot FIRST: a failed commit must raise once, not
+        # poison every later save/load with the same stale error
+        _pending_save[0] = None
+        h.result()
+
+
 def save_checkpoint(executor, dirname, main_program=None, step=None,
-                    keep_last=3):
+                    keep_last=3, blocking=True):
     """Sharded checkpoint of the whole training scope.
 
     Multi-host semantics: every process calls this with the same args;
@@ -237,6 +270,14 @@ def save_checkpoint(executor, dirname, main_program=None, step=None,
     barrier, then process 0 alone commits manifest.json + "latest" and
     prunes old step dirs.  A crash before the manifest leaves the
     previous checkpoint as "latest" — restores never see a torn save.
+
+    blocking=False (single-host only): device->host materialization
+    still happens synchronously — the step's donation invalidates device
+    buffers, so the bytes must leave the chip before returning — but the
+    file writing + manifest commit move to a background thread and an
+    AsyncCheckpoint handle is returned. Training resumes immediately;
+    the next save (or load, or wait_for_pending_saves) joins the
+    previous commit first.
     """
     import jax
     scope = global_scope()
@@ -244,6 +285,7 @@ def save_checkpoint(executor, dirname, main_program=None, step=None,
     step_no = int(step if step is not None else 0)
     step_dir = "step_%d" % step_no
     full_dir = os.path.join(dirname, step_dir)
+    wait_for_pending_saves()
 
     own, manifest_vars = {}, {}
     for name, val in sorted(scope.items()):
@@ -286,30 +328,72 @@ def save_checkpoint(executor, dirname, main_program=None, step=None,
                 "shards": [{"offsets": [[0, d] for d in shape],
                             "file": "shards_p0.npz", "key": key}]}
 
-    _atomic_savez(full_dir, "shards_p%d.npz" % pid, own)
     multihost = jax.process_count() > 1
-    if multihost:  # pragma: no cover - needs real multihost
-        from jax.experimental import multihost_utils
-        multihost_utils.sync_global_devices("ckpt_shards_%s" % step_dir)
-    if pid == 0:
-        manifest = {"format_version": CKPT_FORMAT_VERSION, "step": step_no,
-                    "process_count": jax.process_count(),
-                    "vars": manifest_vars}
-        _atomic_write(os.path.join(full_dir, MANIFEST_FILE),
-                      json.dumps(manifest))
-        _atomic_write(os.path.join(dirname, "latest"), step_dir)
-        kids = sorted([d for d in os.listdir(dirname)
-                       if d.startswith("step_")],
-                      key=lambda d: int(d.split("_")[1]))
-        for d in kids[:-keep_last]:
-            import shutil
-            shutil.rmtree(os.path.join(dirname, d), ignore_errors=True)
-    if multihost:  # pragma: no cover - needs real multihost
-        # hold every process until the manifest commit is durable — a
-        # worker returning (and its orchestrator tearing the job down)
-        # while process 0 is still writing must not lose the checkpoint
-        from jax.experimental import multihost_utils
-        multihost_utils.sync_global_devices("ckpt_commit_%s" % step_dir)
+    n_proc = jax.process_count()
+
+    def commit():
+        _atomic_savez(full_dir, "shards_p%d.npz" % pid, own)
+        if multihost:  # pragma: no cover - needs real multihost
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices("ckpt_shards_%s" % step_dir)
+        if pid == 0:
+            manifest = {"format_version": CKPT_FORMAT_VERSION,
+                        "step": step_no, "process_count": n_proc,
+                        "vars": manifest_vars}
+            _atomic_write(os.path.join(full_dir, MANIFEST_FILE),
+                          json.dumps(manifest))
+            _atomic_write(os.path.join(dirname, "latest"), step_dir)
+            kids = sorted([d for d in os.listdir(dirname)
+                           if d.startswith("step_")],
+                          key=lambda d: int(d.split("_")[1]))
+            for d in kids[:-keep_last]:
+                import shutil
+                shutil.rmtree(os.path.join(dirname, d),
+                              ignore_errors=True)
+        if multihost:  # pragma: no cover - needs real multihost
+            # hold every process until the manifest commit is durable — a
+            # worker returning (and its orchestrator tearing the job
+            # down) while process 0 is still writing must not lose the
+            # checkpoint
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices("ckpt_commit_%s" % step_dir)
+
+    import threading
+
+    if blocking or multihost:
+        # multihost stays synchronous: barriers from a daemon thread
+        # would deadlock against the main thread's collectives. Return
+        # an already-completed handle when the caller asked for async so
+        # `h.result()` code works unchanged on both topologies.
+        commit()
+        if blocking:
+            return None
+        done = threading.Thread(target=lambda: None)
+        done.start()
+        done.join()
+        return AsyncCheckpoint(done, {"error": None})
+
+    box = {"error": None}
+
+    def runner():
+        try:
+            commit()
+        except BaseException as e:  # pragma: no cover - disk dependent
+            box["error"] = e
+
+    # joined via atexit (orbax-style): the run's LAST async checkpoint
+    # must not be killed mid-write at interpreter shutdown
+    global _atexit_registered
+    if not _atexit_registered:
+        import atexit
+        atexit.register(wait_for_pending_saves)
+        _atexit_registered = True
+    th = threading.Thread(target=runner, name="ckpt-commit-%d" % step_no,
+                          daemon=True)
+    th.start()
+    handle = AsyncCheckpoint(th, box)
+    _pending_save[0] = handle
+    return handle
 
 
 def _stitch(meta, req, readers, dtype, name="<var>"):
@@ -353,6 +437,7 @@ def load_checkpoint(executor, dirname, main_program=None, shardings=None):
     """
     import jax
     import jax.numpy as jnp
+    wait_for_pending_saves()   # an in-flight async commit must land first
     with open(os.path.join(dirname, "latest")) as f:
         step_dir = f.read().strip()
     full_dir = os.path.join(dirname, step_dir)

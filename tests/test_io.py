@@ -206,6 +206,41 @@ def test_sharded_checkpoint_reshard_dp2mp2_to_dp4mp2(tmp_path):
     np.testing.assert_allclose(got, ref, rtol=1e-5)
 
 
+def test_async_checkpoint_commit(tmp_path):
+    """blocking=False: device->host copy is synchronous (donation
+    safety) but the file commit happens on a background thread; the
+    handle, a follow-up save, and load_checkpoint all join it."""
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from paddle_tpu.io import save_checkpoint, load_checkpoint, \
+        wait_for_pending_saves
+    from paddle_tpu.framework.scope import Scope, scope_guard
+
+    mesh = Mesh(np.array(jax.devices()[:4]), ("dp",))
+    sh = NamedSharding(mesh, P("dp"))
+    w1 = jax.device_put(np.arange(16, dtype=np.float32).reshape(4, 4), sh)
+    sc = Scope()
+    with scope_guard(sc):
+        sc.set_var("w_async", w1)
+        h = save_checkpoint(None, str(tmp_path), step=1, blocking=False)
+        assert h is not None
+        h.result(timeout=30)
+        assert h.done()
+        # second async save while nothing pending; then mutate state and
+        # save step 3 — load must see the LATEST committed step
+        sc.set_var("w_async", jax.device_put(
+            np.arange(16, dtype=np.float32).reshape(4, 4) * 2, sh))
+        save_checkpoint(None, str(tmp_path), step=3, blocking=False)
+    sc2 = Scope()
+    with scope_guard(sc2):
+        step = load_checkpoint(None, str(tmp_path))   # joins the commit
+        assert step == 3
+        np.testing.assert_allclose(
+            np.asarray(sc2.find_var("w_async")),
+            np.arange(16, dtype=np.float32).reshape(4, 4) * 2)
+    wait_for_pending_saves()
+
+
 def test_sharded_checkpoint_torn_manifest_hard_error(tmp_path):
     """A manifest whose shard list no longer tiles a var must raise, not
     restore uninitialized memory."""
