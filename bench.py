@@ -237,39 +237,48 @@ def measure_headline():
     return headline_json(best)
 
 
-def bench_resnet():
+def _bench_section(build_fn, feed, items_per_step, metric, unit,
+                   ref=None, steps=20, warmup=3):
+    """Shared secondary-section scaffold: own scope (state must not stay
+    resident in HBM after the section), one pre-staged device_put of the
+    batch (production DataLoader double-buffers to HBM ahead of compute;
+    re-transferring each step would only measure the link), timed window
+    via _run_steps."""
     import jax
     import paddle_tpu as pt
+    from paddle_tpu.framework.scope import Scope, scope_guard
+    main_prog, startup, _feeds, fetch = build_fn()
+    with scope_guard(Scope()):
+        exe = pt.Executor()
+        exe.run(startup)
+        feed = {k: jax.device_put(np.asarray(v)) for k, v in feed.items()}
+        dt, _ = _run_steps(exe, main_prog, feed, fetch["loss"], steps,
+                           warmup)
+    rate = items_per_step * steps / dt
+    line = {"metric": metric, "value": round(rate, 2), "unit": unit}
+    if ref is not None:
+        line["vs_baseline"] = round(rate / ref, 3)
+    return json.dumps(line)
+
+
+
+def bench_resnet():
     from paddle_tpu.models import resnet
     from paddle_tpu import optimizer
     on_tpu = _on_tpu()
     batch = 128 if on_tpu else 4
     shape = (3, 224, 224) if on_tpu else (3, 32, 32)
     steps, warmup = (20, 3) if on_tpu else (3, 1)
-    from paddle_tpu.framework.scope import Scope, scope_guard
-    main_prog, startup, feeds, fetch = resnet.resnet_train_program(
-        depth=50, class_dim=1000, image_shape=shape,
-        optimizer_fn=lambda l: optimizer.Momentum(0.1, 0.9).minimize(l))
-    # own scope: this model's params/optimizer state must not stay
-    # resident in HBM after the section finishes
-    with scope_guard(Scope()):
-        exe = pt.Executor()
-        exe.run(startup)
-        rng = np.random.RandomState(0)
-        feed = {"image": rng.rand(batch, *shape).astype(np.float32),
-                "label": rng.randint(0, 1000,
-                                     (batch, 1)).astype(np.int64)}
-        # pre-stage to device once — in production the DataLoader's
-        # background thread double-buffers batches to HBM ahead of
-        # compute (reader.py); re-transferring the same batch each step
-        # would only measure the link
-        feed = {k: jax.device_put(v) for k, v in feed.items()}
-        dt, loss = _run_steps(exe, main_prog, feed, fetch["loss"], steps,
-                              warmup)
-    ips = batch * steps / dt
-    return json.dumps({"metric": "ResNet-50 train images/sec/chip",
-                       "value": round(ips, 2), "unit": "images/sec/chip",
-                       "vs_baseline": round(ips / REFERENCE_RESNET_IPS, 3)})
+    rng = np.random.RandomState(0)
+    feed = {"image": rng.rand(batch, *shape).astype(np.float32),
+            "label": rng.randint(0, 1000, (batch, 1)).astype(np.int64)}
+    return _bench_section(
+        lambda: resnet.resnet_train_program(
+            depth=50, class_dim=1000, image_shape=shape,
+            optimizer_fn=lambda l: optimizer.Momentum(0.1, 0.9)
+            .minimize(l)),
+        feed, batch, "ResNet-50 train images/sec/chip", "images/sec/chip",
+        ref=REFERENCE_RESNET_IPS, steps=steps, warmup=warmup)
 
 
 def bench_ernie2():
@@ -319,6 +328,48 @@ def bench_ernie2():
         "metric": "ERNIE-2.0 multitask pretrain samples/sec/chip",
         "value": round(sps, 2), "unit": "samples/sec/chip",
         "vs_baseline": round(sps / REFERENCE_SAMPLES_PER_SEC, 3)})
+
+
+def bench_transformer():
+    """Transformer-base NMT (BASELINE configs[1]): WMT en-de geometry,
+    label-smoothed CE, Adam."""
+    from paddle_tpu.models import transformer as tr
+    from paddle_tpu import optimizer
+    on_tpu = _on_tpu()
+    if on_tpu:
+        cfg = tr.TransformerConfig()          # base: d512/ff2048/6L/8H
+        batch, src_len, trg_len = 64, 64, 64
+        steps, warmup = 15, 3
+    else:
+        cfg = tr.TransformerConfig(src_vocab=512, trg_vocab=512,
+                                   d_model=64, d_inner=128, n_head=2,
+                                   n_layer=2)
+        batch, src_len, trg_len = 4, 16, 16
+        steps, warmup = 3, 1
+    return _bench_section(
+        lambda: tr.transformer_train_program(
+            cfg, src_len, trg_len,
+            optimizer_fn=lambda l: optimizer.Adam(1e-4).minimize(l)),
+        tr.synthetic_batch(cfg, batch, src_len, trg_len),
+        batch * trg_len, "Transformer-base NMT train tokens/sec/chip",
+        "tokens/sec/chip", steps=steps, warmup=warmup)
+
+
+def bench_deepfm():
+    """DeepFM CTR (BASELINE configs[3]): high-dim sparse embedding."""
+    from paddle_tpu.models import deepfm
+    from paddle_tpu import optimizer
+    on_tpu = _on_tpu()
+    feature_dim = 1000000 if on_tpu else 5000
+    batch = 2048 if on_tpu else 64
+    steps, warmup = (20, 3) if on_tpu else (3, 1)
+    return _bench_section(
+        lambda: deepfm.deepfm_train_program(
+            feature_dim=feature_dim,
+            optimizer_fn=lambda l: optimizer.Adam(1e-3).minimize(l)),
+        deepfm.synthetic_batch(batch, feature_dim=feature_dim),
+        batch, "DeepFM CTR train examples/sec/chip", "examples/sec/chip",
+        steps=steps, warmup=warmup)
 
 
 def pallas_selfcheck():
@@ -418,8 +469,13 @@ def run_all():
         _flush_and_exit(0)
 
     # 2) secondaries — buffered, each fenced
+    # pallas_check (kernel correctness, merged into the headline) runs
+    # BEFORE the optional throughput extras, so a deadline firing during
+    # transformer/deepfm can only drop optional lines
     for name, fn in (("resnet", bench_resnet), ("ernie2", bench_ernie2),
-                     ("pallas_check", pallas_selfcheck)):
+                     ("pallas_check", pallas_selfcheck),
+                     ("transformer", bench_transformer),
+                     ("deepfm", bench_deepfm)):
         _STATE["stage"] = name
         try:
             line = fn()
@@ -487,6 +543,10 @@ if __name__ == "__main__":
         print(bench_ernie2())
     elif len(sys.argv) > 1 and sys.argv[1] == "pallas":
         print(pallas_selfcheck())
+    elif len(sys.argv) > 1 and sys.argv[1] == "transformer":
+        print(bench_transformer())
+    elif len(sys.argv) > 1 and sys.argv[1] == "deepfm":
+        print(bench_deepfm())
     elif len(sys.argv) > 1 and sys.argv[1] == "profile":
         profile_headline()
     else:
