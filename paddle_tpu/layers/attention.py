@@ -34,9 +34,14 @@ def scaled_dot_product_attention(queries, keys, values, num_heads=1,
 
 
 def fused_attention(q, k, v, mask=None, scale=None, causal=False,
-                    impl="auto", name=None):
+                    impl="auto", sp_axis="sp", name=None):
     """q,k,v: (B, H, T, Dh) — one fused op; Pallas flash path when available.
-    Reference composes this from matmul+softmax+matmul ops."""
+    Reference composes this from matmul+softmax+matmul ops.
+
+    impl: "auto" | "xla" | "flash" | "ring" | "ulysses" — the last two
+    run sequence-parallel attention over the installed mesh's `sp_axis`
+    (causal masking only): ring rotates K/V blocks via ppermute; ulysses
+    re-shards heads via all_to_all."""
     helper = LayerHelper("fused_attention", name=name)
     out = helper.create_variable_for_type_inference(q.dtype, q.shape)
     inputs = {"Q": [q.name], "K": [k.name], "V": [v.name]}
@@ -44,7 +49,8 @@ def fused_attention(q, k, v, mask=None, scale=None, causal=False,
         inputs["Mask"] = [mask.name]
     helper.append_op("scaled_dot_product_attention", inputs=inputs,
                      outputs={"Out": [out.name]},
-                     attrs={"scale": scale, "causal": causal, "impl": impl})
+                     attrs={"scale": scale, "causal": causal, "impl": impl,
+                            "sp_axis": sp_axis})
     return out
 
 

@@ -40,13 +40,49 @@ def _sdpa(ctx, ins, attrs):
     causal = attrs.get("causal", False)
     impl = attrs.get("impl", "auto")
     if impl == "auto":
-        # perf escape hatch: force the XLA or Pallas path fleet-wide
-        impl = os.environ.get("PADDLE_TPU_ATTN_IMPL", "auto")
+        # perf escape hatch: force a path fleet-wide. For ring/ulysses
+        # the env value is a HINT, not a hard override — ops that can't
+        # run sequence-parallel (additive mask, no sp mesh installed)
+        # keep their auto fallback instead of raising.
+        env_impl = os.environ.get("PADDLE_TPU_ATTN_IMPL", "auto")
+        if env_impl in ("ring", "ulysses"):
+            from ..distributed.mesh import get_mesh
+            m = get_mesh()
+            if mask is None and m is not None and \
+                    attrs.get("sp_axis", "sp") in m.axis_names:
+                impl = env_impl
+        else:
+            impl = env_impl
     if impl == "auto" and q.shape[-2] * k.shape[-2] <= 256 * 256:
         # short sequences: XLA's fused attention beats the tiled kernel
         # (measured 1026 vs 912 samples/s on BERT-base seq128, v5e) — the
         # (T,T) tile only pays for itself once it stops fitting in VMEM
         impl = "xla"
+    if impl in ("ring", "ulysses"):
+        # sequence-parallel attention over the installed mesh's sp axis —
+        # the declarative (static-graph) route to the long-context paths
+        # in distributed/{ring,ulysses}_attention.py
+        from ..distributed.mesh import get_mesh
+        axis = attrs.get("sp_axis", "sp")
+        mesh = get_mesh()
+        if mesh is None or axis not in mesh.axis_names:
+            raise ValueError(
+                "fused_attention(impl=%r) needs init_mesh/fleet.init with "
+                "a %r mesh axis" % (impl, axis))
+        if mask is not None:
+            raise ValueError(
+                "fused_attention(impl=%r) supports causal masking only; "
+                "additive masks don't survive the sequence re-sharding"
+                % impl)
+        if impl == "ring":
+            from ..distributed.ring_attention import ring_attention
+            return {"Out": ring_attention(q, k, v, mesh=mesh,
+                                          axis_name=axis, causal=causal,
+                                          scale=scale)}
+        from ..distributed.ulysses_attention import ulysses_attention
+        return {"Out": ulysses_attention(q, k, v, mesh=mesh,
+                                         axis_name=axis, causal=causal,
+                                         scale=scale)}
     if impl in ("auto", "flash"):
         try:
             from .pallas.flash_attention import flash_attention

@@ -794,3 +794,36 @@ def test_ulysses_attention_head_divisibility_error():
     q = np.zeros((1, 6, 16, 8), np.float32)   # 6 heads, sp=8
     with _pytest.raises(ValueError, match="num_heads"):
         ulysses_attention(q, q, q, mesh=mesh, axis_name="sp")
+
+
+@pytest.mark.parametrize("impl", ["ring", "ulysses"])
+def test_fused_attention_sequence_parallel_impls(impl):
+    """Static-graph route: layers.fused_attention(impl="ring"/"ulysses")
+    runs the sequence-parallel paths inside an Executor-traced program
+    and matches the XLA implementation exactly."""
+    from paddle_tpu.distributed import init_mesh
+    from paddle_tpu.layers.attention import fused_attention
+
+    init_mesh({"sp": 8})
+    b, h, t, d = 2, 8, 64, 16
+    rng = np.random.RandomState(11)
+    qv = rng.randn(b, h, t, d).astype(np.float32)
+    kv = rng.randn(b, h, t, d).astype(np.float32)
+    vv = rng.randn(b, h, t, d).astype(np.float32)
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        q = layers.data("fa_q", [b, h, t, d], "float32",
+                        append_batch_size=False)
+        k = layers.data("fa_k", [b, h, t, d], "float32",
+                        append_batch_size=False)
+        v = layers.data("fa_v", [b, h, t, d], "float32",
+                        append_batch_size=False)
+        o_sp = fused_attention(q, k, v, causal=True, impl=impl)
+        o_ref = fused_attention(q, k, v, causal=True, impl="xla")
+    exe = pt.Executor()
+    exe.run(startup)
+    feed = {"fa_q": qv, "fa_k": kv, "fa_v": vv}
+    got, ref = exe.run(main, feed=feed, fetch_list=[o_sp, o_ref])
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=3e-4, atol=3e-5)
