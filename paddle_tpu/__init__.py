@@ -52,6 +52,9 @@ from .lod_tensor import (LoDTensor, create_lod_tensor,
                          create_random_int_lodtensor)
 from .framework.compiler import make_mesh
 from .layers.io import data
+from .data_feed_desc import DataFeedDesc
+from .input import one_hot, embedding
+from .core import CUDAPlace, CUDAPinnedPlace
 from .install_check import run_check
 
 __version__ = "0.1.0"

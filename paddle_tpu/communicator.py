@@ -1,0 +1,18 @@
+"""fluid.communicator (ref python/paddle/fluid/communicator.py).
+
+The reference Communicator drives ASYNC parameter-server sends — a
+mechanism that exists to hide commodity-network latency. On a TPU pod,
+synchronous data parallelism over ICI is strictly faster and simpler
+(see PORTING.md capability table), so constructing a Communicator
+raises with that guidance instead of silently doing nothing.
+"""
+
+__all__ = ["Communicator"]
+
+
+class Communicator(object):
+    def __init__(self, program=None):
+        raise NotImplementedError(
+            "Async communicator modes are N/A on TPU pods: synchronous "
+            "dp over ICI (CompiledProgram/fleet with a mesh) replaces "
+            "GEO/async-SGD. See PORTING.md 'Capability substitutions'.")

@@ -1,0 +1,6 @@
+"""Module-path alias for fluid.compiler (ref
+python/paddle/fluid/compiler.py)."""
+from .framework.compiler import CompiledProgram, BuildStrategy, \
+    ExecutionStrategy  # noqa: F401
+
+__all__ = ["CompiledProgram", "BuildStrategy", "ExecutionStrategy"]

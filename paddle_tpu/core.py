@@ -1,0 +1,31 @@
+"""Pragmatic stand-in for fluid.core (the reference's C++ pybind module,
+ref paddle/fluid/pybind/pybind.cc). Scripts that reach into core for
+places or scopes port unchanged; kernel-level internals have no TPU
+counterpart (XLA owns them)."""
+from .framework.place import CPUPlace, TPUPlace  # noqa: F401
+from .framework.scope import Scope  # noqa: F401
+from .lod_tensor import LoDTensor  # noqa: F401
+
+
+class LoDTensorArray(list):
+    """reference core.LoDTensorArray: a growable vector of LoDTensors."""
+    def append(self, t):
+        list.append(self, t)
+
+# scripts written for the reference name CUDA places; on TPU they map to
+# the accelerator place (matching paddle_tpu.cuda_places() behavior)
+CUDAPlace = TPUPlace
+CUDAPinnedPlace = CPUPlace
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+def get_cuda_device_count():
+    return 0
+
+
+__all__ = ["CPUPlace", "TPUPlace", "CUDAPlace", "CUDAPinnedPlace",
+           "Scope", "LoDTensor", "LoDTensorArray",
+           "is_compiled_with_cuda", "get_cuda_device_count"]

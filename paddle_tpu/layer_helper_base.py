@@ -1,0 +1,6 @@
+"""Module-path alias for fluid.layer_helper_base (ref
+python/paddle/fluid/layer_helper_base.py). The static/dygraph split the
+reference needed collapses here: one LayerHelper serves both modes."""
+from .layer_helper import LayerHelper as LayerHelperBase  # noqa: F401
+
+__all__ = ["LayerHelperBase"]

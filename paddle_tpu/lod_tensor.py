@@ -19,9 +19,51 @@ class LoDTensor(object):
     reference's multi-level nesting flattens into repeated expansion —
     sequence_expand covers that path)."""
 
-    def __init__(self, data, lengths):
-        self.data = np.asarray(data)
+    def __init__(self, data=None, lengths=None):
+        # no-arg form matches fluid.core.LoDTensor(): build empty, then
+        # .set(array, place) / .set_recursive_sequence_lengths(lens)
+        self.data = np.asarray(data) if data is not None \
+            else np.zeros((0,), np.float32)
+        if lengths is None:
+            lengths = self._dense_lengths()
         self.lengths = np.asarray(lengths, dtype=np.int64)
+
+    def _dense_lengths(self):
+        # dense tensor without ragged structure: every row full length
+        if self.data.ndim >= 2:
+            return [self.data.shape[1]] * self.data.shape[0]
+        return []
+
+    def set(self, array, place=None):
+        """fluid.core.LoDTensor().set(np_array, place) parity; place is
+        ignored — feeds are staged by the Executor."""
+        self.data = np.asarray(array)
+        if self.lengths.size == 0:
+            self.lengths = np.asarray(self._dense_lengths(), np.int64)
+        return self
+
+    def set_recursive_sequence_lengths(self, lens):
+        """Length-style LoD; nested levels flatten to tokens-per-outer
+        sequence, the same rule as create_lod_tensor."""
+        if lens and isinstance(lens[0], (list, tuple)):
+            if len(lens) > 1:
+                flat, outer, merged, i = lens[-1], lens[0], [], 0
+                for n in outer:
+                    merged.append(int(np.sum(flat[i:i + n])))
+                    i += n
+                lens = merged
+            else:
+                lens = lens[0]
+        self.lengths = np.asarray(lens, np.int64)
+        return self
+
+    def set_lod(self, lod):
+        """Offset-style LoD -> lengths (nested levels flatten like
+        set_recursive_sequence_lengths)."""
+        nested = lod and isinstance(lod[0], (list, tuple))
+        levels = [list(np.diff(np.asarray(l, np.int64)))
+                  for l in (lod if nested else [lod])]
+        return self.set_recursive_sequence_lengths(levels)
 
     def recursive_sequence_lengths(self):
         return [list(self.lengths)]

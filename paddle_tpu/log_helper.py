@@ -1,0 +1,16 @@
+"""fluid.log_helper parity (ref python/paddle/fluid/log_helper.py)."""
+import logging
+
+__all__ = ["get_logger"]
+
+
+def get_logger(name, level, fmt=None):
+    logger = logging.getLogger(name)
+    logger.setLevel(level)
+    if not logger.handlers:
+        handler = logging.StreamHandler()
+        if fmt:
+            handler.setFormatter(logging.Formatter(fmt=fmt))
+        logger.addHandler(handler)
+    logger.propagate = False
+    return logger

@@ -1,0 +1,13 @@
+"""fluid.net_drawer parity (ref python/paddle/fluid/net_drawer.py):
+renders the MAIN program's graph via the debugger's DOT writer."""
+from .debugger import draw_block_graphviz  # noqa: F401
+from .debugger import draw_program as _draw_program
+
+__all__ = ["draw_graph"]
+
+
+def draw_graph(startup_program, main_program, **kwargs):
+    """Reference signature (net_drawer.py:103): draws the main program;
+    graph_path/filename kwargs name the output DOT file."""
+    path = kwargs.get("graph_path") or kwargs.get("filename")
+    return _draw_program(main_program, path=path)
