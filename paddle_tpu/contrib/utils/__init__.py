@@ -1,0 +1,6 @@
+"""fluid.contrib.utils parity (ref contrib/utils/: hdfs_utils +
+lookup_table_utils)."""
+from . import hdfs_utils  # noqa: F401
+from .hdfs_utils import HDFSClient, multi_download, multi_upload  # noqa: F401
+
+__all__ = ["HDFSClient", "multi_download", "multi_upload"]

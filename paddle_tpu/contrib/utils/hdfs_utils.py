@@ -1,0 +1,27 @@
+"""fluid.contrib.utils.hdfs_utils (ref contrib/utils/hdfs_utils.py:35).
+
+The reference shells out to a Hadoop CLI for distributed-FS staging.
+Zero-egress TPU pods stage checkpoints/data via mounted storage (any
+POSIX-visible path works with save/load as-is — see PORTING.md
+"Capability substitutions"), so these raise with that guidance rather
+than half-working.
+"""
+
+__all__ = ["HDFSClient", "multi_download", "multi_upload"]
+
+_MSG = ("HDFS staging is N/A in paddle_tpu: TPU pods mount storage as a "
+        "POSIX path — point save/load/Dataset APIs at that path directly "
+        "(PORTING.md 'Capability substitutions').")
+
+
+class HDFSClient(object):
+    def __init__(self, hadoop_home=None, configs=None):
+        raise NotImplementedError(_MSG)
+
+
+def multi_download(*args, **kwargs):
+    raise NotImplementedError(_MSG)
+
+
+def multi_upload(*args, **kwargs):
+    raise NotImplementedError(_MSG)

@@ -1,0 +1,10 @@
+"""fluid.dygraph.BackwardStrategy parity (ref dygraph/backward_strategy
+via core.BackwardStrategy): config holder; the tape always sums
+gradients deterministically here, so sort_sum_gradient is recorded but
+moot."""
+__all__ = ["BackwardStrategy"]
+
+
+class BackwardStrategy(object):
+    def __init__(self):
+        self.sort_sum_gradient = False

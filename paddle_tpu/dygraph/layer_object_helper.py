@@ -1,0 +1,5 @@
+"""fluid.dygraph.layer_object_helper parity: one LayerHelper serves
+both modes here."""
+from ..layer_helper import LayerHelper as LayerObjectHelper  # noqa: F401
+
+__all__ = ["LayerObjectHelper"]

@@ -1,0 +1,5 @@
+"""fluid.dygraph.math_op_patch parity — see layers/math_op_patch.py."""
+from ..layers.math_op_patch import monkey_patch_variable \
+    as monkey_patch_math_varbase  # noqa: F401
+
+__all__ = ["monkey_patch_math_varbase"]

@@ -1,0 +1,13 @@
+"""fluid.dygraph.parallel_helper parity (internal env helpers)."""
+import os
+
+__all__ = ["_is_data_parallel_mode", "_is_parallel_ctx_initialized"]
+
+
+def _is_data_parallel_mode():
+    return int(os.getenv("PADDLE_TRAINERS_NUM", "1")) > 1
+
+
+def _is_parallel_ctx_initialized():
+    import jax
+    return jax.process_count() > 1

@@ -1,0 +1,5 @@
+"""Module-path alias for fluid.dygraph.profiler."""
+from ..profiler import *  # noqa: F401,F403
+from .. import profiler as _p
+
+__all__ = list(getattr(_p, "__all__", []))

@@ -1,0 +1,17 @@
+"""fluid.dygraph.tracer parity: the eager tape that records ops for
+backward lives in dygraph/base.py; Tracer exposes its handle."""
+from . import base as _base
+
+__all__ = ["Tracer"]
+
+
+class Tracer(object):
+    """Reference Tracer wraps the C++ imperative tracer; here the tape
+    (dygraph/base.py) is the recording machinery."""
+
+    def __init__(self, block=None):
+        self._block = block
+
+    @property
+    def tape(self):
+        return _base._tape

@@ -1,0 +1,8 @@
+"""fluid.dygraph.varbase_patch_methods parity — VarBase conveniences
+(numpy()/backward()/gradient()) are defined directly on the eager
+Variable type here; patching is a verified no-op."""
+__all__ = ["monkey_patch_varbase"]
+
+
+def monkey_patch_varbase():
+    pass

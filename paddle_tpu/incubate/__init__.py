@@ -1,7 +1,7 @@
 """Incubating APIs (ref python/paddle/fluid/incubate/__init__.py):
-fleet lives in paddle_tpu.distributed.fleet (re-exported here for the
-reference import path ``incubate.fleet``), plus data_generator."""
+the fleet subpackage mirrors the reference layout (base/collective/
+parameter_server) over paddle_tpu.distributed.fleet, plus data_generator."""
 from . import data_generator
-from ..distributed import fleet
+from . import fleet
 
 __all__ = ["data_generator", "fleet"]

@@ -1,0 +1,12 @@
+"""fluid.incubate.fleet parity (ref incubate/fleet/): the collective
+fleet API lives in distributed/fleet.py; base/collective/
+parameter_server mirror the reference package layout."""
+from ...distributed import fleet as _fleet_mod
+from ...distributed.fleet import (init, worker_index, worker_num,  # noqa: F401
+                                  is_first_worker, distributed_optimizer,
+                                  DistributedOptimizer,
+                                  PaddleCloudRoleMaker,
+                                  main_program_compiled)
+
+# module alias: `from paddle_tpu.incubate import fleet; fleet.init(...)`
+fleet = _fleet_mod

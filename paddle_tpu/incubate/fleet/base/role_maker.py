@@ -1,0 +1,27 @@
+"""fluid.incubate.fleet.base.role_maker parity (ref
+incubate/fleet/base/role_maker.py): rank/size discovery under
+jax.distributed."""
+from ....distributed.fleet import PaddleCloudRoleMaker  # noqa: F401
+
+__all__ = ["PaddleCloudRoleMaker", "UserDefinedRoleMaker"]
+
+
+class UserDefinedRoleMaker(PaddleCloudRoleMaker):
+    """Explicit rank/size (reference UserDefinedRoleMaker) — on TPU the
+    runtime already knows both; arguments are validated and recorded."""
+
+    def __init__(self, current_id=0, role=None, worker_num=0,
+                 server_endpoints=None):
+        super(UserDefinedRoleMaker, self).__init__(is_collective=True)
+        self._current_id = int(current_id)
+        self._worker_num = int(worker_num)
+
+    def worker_index(self):
+        return self._current_id
+
+    def worker_num(self):
+        return self._worker_num or super(UserDefinedRoleMaker,
+                                         self).worker_num()
+
+    def is_first_worker(self):
+        return self.worker_index() == 0

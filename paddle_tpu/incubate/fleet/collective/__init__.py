@@ -1,0 +1,7 @@
+"""fluid.incubate.fleet.collective parity (ref
+incubate/fleet/collective/__init__.py): `fleet` object + strategy."""
+from ....distributed import fleet  # noqa: F401
+from ....distributed.mesh import DistributedStrategy  # noqa: F401
+from ....distributed.fleet import DistributedOptimizer  # noqa: F401
+
+__all__ = ["fleet", "DistributedStrategy", "DistributedOptimizer"]

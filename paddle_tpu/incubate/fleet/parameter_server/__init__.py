@@ -1,0 +1,5 @@
+"""fluid.incubate.fleet.parameter_server (ref
+incubate/fleet/parameter_server/): pserver processes are N/A on TPU —
+sparse tables are row-sharded mesh state (distributed/
+sharded_embedding.py, PORTING.md 'Capability substitutions')."""
+from . import pslib  # noqa: F401

@@ -1,0 +1,19 @@
+"""PSLib downpour surface (ref
+incubate/fleet/parameter_server/pslib/__init__.py:28): configures
+Baidu's proprietary parameter-server binary. N/A here; the capability
+(huge sparse tables, async updates) maps to row-sharded embeddings over
+the mesh."""
+
+__all__ = ["fleet"]
+
+_MSG = ("PSLib/Downpour is N/A on TPU: use layers.embedding("
+        "is_distributed=True) / distributed.sharded_embedding for "
+        "row-sharded tables over the mesh (PORTING.md).")
+
+
+class _PSLibStub(object):
+    def __getattr__(self, name):
+        raise NotImplementedError(_MSG)
+
+
+fleet = _PSLibStub()

@@ -1,0 +1,15 @@
+"""fluid.layers.device parity (ref python/paddle/fluid/layers/device.py:
+get_places, deprecated even in the reference)."""
+from ..annotations import deprecated
+
+__all__ = ["get_places"]
+
+
+@deprecated(since="0.15.0", instead="ParallelExecutor / CompiledProgram")
+def get_places(device_count=None, device_type=None):
+    import jax
+    devs = jax.devices() if device_type is None else \
+        [d for d in jax.devices() if d.platform == device_type]
+    if device_count:
+        devs = devs[:device_count]
+    return devs

@@ -1,0 +1,11 @@
+"""fluid.layers.math_op_patch parity (ref layers/math_op_patch.py).
+The reference monkey-patches Variable with arithmetic dunders at import
+time; here they are defined directly on framework.program.Variable, so
+monkey_patch_variable is a verified no-op."""
+from ..framework.program import Variable
+
+__all__ = ["monkey_patch_variable"]
+
+
+def monkey_patch_variable():
+    assert hasattr(Variable, "__add__") and hasattr(Variable, "__mul__")

@@ -1,0 +1,38 @@
+"""fluid.transpiler.collective parity (ref transpiler/collective.py:
+Collective/GradAllReduce/LocalSGD rewrite programs to insert NCCL
+allreduce). TPU-native: XLA inserts the collectives from mesh
+shardings, so transpile() installs the mesh and leaves the program
+whole — run it under CompiledProgram/fleet as usual."""
+from ..distributed import mesh as _mesh_mod
+
+__all__ = ["Collective", "GradAllReduce", "LocalSGD"]
+
+
+class Collective(object):
+    def __init__(self, nrings=1):
+        self.nrings = nrings
+
+    def transpile(self, startup_program=None, main_program=None, rank=0,
+                  endpoints="127.0.0.1:6174", current_endpoint=None,
+                  wait_port=True):
+        if isinstance(endpoints, str):
+            endpoints = endpoints.split(",")
+        self.nranks = len(endpoints)
+        self.rank = rank
+        if _mesh_mod.get_mesh() is None:
+            # the standard data-parallel mesh over ALL devices — the
+            # same global mesh on every process (endpoint count is a
+            # process-topology detail NCCL needed; XLA's mesh spans the
+            # whole job)
+            import jax
+            _mesh_mod.init_mesh({"dp": len(jax.devices())})
+
+
+class GradAllReduce(Collective):
+    """Dense allreduce of gradients — what pjit emits from dp shardings."""
+
+
+class LocalSGD(Collective):
+    """Reference LocalSGD averages params every k steps to cut comms; on
+    ICI the dense allreduce is cheap enough that per-step sync dp is the
+    installed behavior (documented substitution)."""

@@ -1,0 +1,13 @@
+"""fluid.transpiler.geo_sgd_transpiler (ref
+transpiler/geo_sgd_transpiler.py): GEO async-SGD exists to hide slow
+networks; N/A on ICI (see PORTING.md). Raises with guidance."""
+
+__all__ = ["GeoSgdTranspiler"]
+
+
+class GeoSgdTranspiler(object):
+    def __init__(self, config=None):
+        raise NotImplementedError(
+            "GEO async-SGD is N/A on TPU pods: synchronous dp over ICI "
+            "(CompiledProgram/fleet with a mesh) replaces it. See "
+            "PORTING.md 'Capability substitutions'.")

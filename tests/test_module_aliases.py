@@ -141,3 +141,96 @@ def test_find_distributed_lookup_table():
                                param_attr=pt.ParamAttr(name="dlt_w"))
     assert find_distributed_lookup_table(main) == "dlt_w"
     assert find_distributed_lookup_table_inputs(main, "dlt_w")
+
+
+SECOND_LEVEL_MODULES = [
+    "contrib.utils", "contrib.utils.hdfs_utils",
+    "incubate.fleet", "incubate.fleet.base",
+    "incubate.fleet.base.role_maker", "incubate.fleet.collective",
+    "incubate.fleet.parameter_server",
+    "incubate.fleet.parameter_server.pslib",
+    "transpiler.collective", "transpiler.geo_sgd_transpiler",
+    "transpiler.details", "dygraph.backward_strategy",
+    "dygraph.dygraph_utils", "dygraph.layer_object_helper",
+    "dygraph.math_op_patch", "dygraph.parallel_helper",
+    "dygraph.profiler", "dygraph.tracer",
+    "dygraph.varbase_patch_methods", "layers.device",
+    "layers.math_op_patch", "layers.utils",
+]
+
+
+@pytest.mark.parametrize("name", SECOND_LEVEL_MODULES)
+def test_second_level_module_path_resolves(name):
+    importlib.import_module("paddle_tpu." + name)
+
+
+def test_incubate_fleet_collective_api():
+    from paddle_tpu.incubate.fleet.collective import fleet, \
+        DistributedStrategy
+    assert callable(fleet.init) and callable(fleet.distributed_optimizer)
+    s = DistributedStrategy()
+    assert hasattr(s, "sharding_optimizer_state")
+
+
+def test_layers_utils_nest_functions():
+    from paddle_tpu.layers import utils
+    nest = {"b": [1, 2], "a": (3, {"x": 4})}
+    flat = utils.flatten(nest)
+    assert flat == [3, 4, 1, 2]       # dicts iterate key-sorted
+    packed = utils.pack_sequence_as(nest, [10 * f for f in flat])
+    assert packed == {"a": (30, {"x": 40}), "b": [10, 20]}
+    doubled = utils.map_structure(lambda x: x * 2, nest)
+    assert doubled["b"] == [2, 4]
+    utils.assert_same_structure(nest, doubled)
+    with pytest.raises(ValueError):
+        utils.assert_same_structure(nest, [1, 2, 3])
+    assert utils.convert_to_list(3, 2, "k") == [3, 3]
+    with pytest.raises(ValueError):
+        utils.convert_to_list([1, 2, 3], 2, "k")
+    # 1-tuple of an iterable must NOT be flattened by the namedtuple path
+    assert utils.pack_sequence_as(([1, 2],), [10, 20]) == ([10, 20],)
+    import collections as _c
+    Point = _c.namedtuple("Point", ["x", "y"])
+    assert utils.pack_sequence_as(Point(1, 2), [7, 8]) == Point(7, 8)
+    # check_types: list vs tuple is a structural mismatch (reference
+    # nest semantics); check_types=False relaxes it
+    with pytest.raises(ValueError):
+        utils.assert_same_structure([1, 2], (1, 2))
+    utils.assert_same_structure([1, 2], (1, 2), check_types=False)
+
+
+def test_user_defined_role_maker_rank_consistency():
+    from paddle_tpu.incubate.fleet.base.role_maker import \
+        UserDefinedRoleMaker
+    rm = UserDefinedRoleMaker(current_id=3, worker_num=4)
+    assert rm.worker_index() == 3
+    assert rm.worker_num() == 4
+    assert rm.is_first_worker() is False
+    assert UserDefinedRoleMaker(current_id=0,
+                                worker_num=4).is_first_worker() is True
+
+
+def test_transpiler_details_program_edit():
+    from paddle_tpu.transpiler.details import delete_ops, \
+        find_op_by_input_arg, find_op_by_output_arg
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("td_x", [4], dtype="float32")
+        h = layers.scale(x, scale=2.0)
+        out = layers.scale(h, scale=3.0)
+    blk = main.global_block()
+    i = find_op_by_input_arg(blk, h.name)
+    assert i == 1   # exact index: -1 (not found) must not pass by accident
+    assert find_op_by_output_arg(blk, out.name) == len(blk.ops) - 1
+    n = len(blk.ops)
+    delete_ops(blk, [blk.ops[-1]])
+    assert len(blk.ops) == n - 1
+
+
+def test_hdfs_and_geo_sgd_raise_with_guidance():
+    from paddle_tpu.contrib.utils import HDFSClient
+    with pytest.raises(NotImplementedError, match="POSIX"):
+        HDFSClient()
+    from paddle_tpu.transpiler.geo_sgd_transpiler import GeoSgdTranspiler
+    with pytest.raises(NotImplementedError, match="ICI"):
+        GeoSgdTranspiler()
