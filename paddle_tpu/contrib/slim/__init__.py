@@ -2,11 +2,14 @@
 
 - prune: mask-based magnitude/structured pruning + sensitivity sweeps
 - distill: soft-label / L2 / FSP distillation losses + teacher merge
+  (module-path alias: slim.distillation)
 - qat: quantization-aware training program pass (sim-quant with STE)
+  (module-path alias: slim.quantization)
+- graph: GraphWrapper program introspection for the passes
+- searcher/nas: SAController simulated annealing + LightNASStrategy
+  search loop (the reference's socketed controller-server tier is N/A:
+  a pod evaluates candidates under its own mesh, in process)
 - post-training int8 lives in paddle_tpu.contrib.quantize
-
-The reference's NAS (light_nas) searcher is a training-loop driver with no
-TPU-specific kernel surface; it is intentionally out of scope here.
 """
 from .prune import (Pruner, MagnitudePruner, StructurePruner, PruneHelper,
                     sensitivity)

@@ -10,6 +10,10 @@ from ... import layers
 from ...framework.program import Parameter, default_main_program
 
 
+__all__ = ["soft_label_loss", "l2_distill_loss", "fsp_matrix",
+           "fsp_loss", "merge"]
+
+
 def soft_label_loss(student_logits, teacher_logits,
                     student_temperature=1.0, teacher_temperature=1.0):
     """Cross-entropy between temperature-softened distributions (reference

@@ -1,0 +1,6 @@
+"""Module-path alias for slim.distillation (ref
+contrib/slim/distillation/); kernels live in distill.py."""
+from .distill import *  # noqa: F401,F403
+from . import distill as _d
+
+__all__ = list(getattr(_d, "__all__", []))

@@ -1,0 +1,4 @@
+"""slim.graph (ref contrib/slim/graph/): program graph introspection."""
+from .graph_wrapper import GraphWrapper, VarWrapper, OpWrapper  # noqa: F401
+
+__all__ = ["GraphWrapper", "VarWrapper", "OpWrapper"]

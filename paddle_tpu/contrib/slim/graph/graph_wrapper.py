@@ -1,0 +1,88 @@
+"""ref contrib/slim/graph/graph_wrapper.py: the slim passes (prune/NAS/
+quant) inspect models through this wrapper instead of raw IR."""
+
+__all__ = ["GraphWrapper", "VarWrapper", "OpWrapper"]
+
+
+class VarWrapper(object):
+    def __init__(self, var, graph):
+        self._var = var
+        self._graph = graph
+
+    def name(self):
+        return self._var.name
+
+    def shape(self):
+        return self._var.shape
+
+    def is_parameter(self):
+        from ....framework.program import Parameter
+        return isinstance(self._var, Parameter)
+
+    def inputs(self):
+        """Ops producing this var."""
+        return [op for op in self._graph.ops()
+                if self.name() in op._op.output_names()]
+
+    def outputs(self):
+        """Ops consuming this var."""
+        return [op for op in self._graph.ops()
+                if self.name() in op._op.input_names()]
+
+
+class OpWrapper(object):
+    def __init__(self, op, graph):
+        self._op = op
+        self._graph = graph
+
+    def type(self):
+        return self._op.type
+
+    def attr(self, name):
+        return self._op.attr(name)
+
+    def all_inputs(self):
+        return [self._graph.var(n) for n in self._op.input_names()
+                if self._graph.has_var(n)]
+
+    def all_outputs(self):
+        return [self._graph.var(n) for n in self._op.output_names()
+                if self._graph.has_var(n)]
+
+
+class GraphWrapper(object):
+    def __init__(self, program=None, in_nodes=None, out_nodes=None):
+        from ....framework.program import default_main_program
+        self.program = program or default_main_program()
+        self.in_nodes = dict(in_nodes or {})
+        self.out_nodes = dict(out_nodes or {})
+
+    def has_var(self, name):
+        return self.program.global_block()._find_var_recursive(name) \
+            is not None
+
+    def var(self, name):
+        v = self.program.global_block()._find_var_recursive(name)
+        if v is None:
+            raise ValueError("variable %r not in graph" % name)
+        return VarWrapper(v, self)
+
+    def vars(self):
+        return [VarWrapper(v, self) for v in self.program.list_vars()]
+
+    def all_parameters(self):
+        return [v for v in self.vars() if v.is_parameter()]
+
+    def ops(self):
+        # every block, not just block 0 — control-flow sub-block ops
+        # must be visible to prune/quant passes
+        return [OpWrapper(op, self)
+                for blk in self.program.blocks for op in blk.ops]
+
+    def numel_params(self):
+        import numpy as np
+        total = 0
+        for p in self.all_parameters():
+            shape = [d for d in (p.shape() or ()) if d not in (None, -1)]
+            total += int(np.prod(shape)) if shape else 1
+        return total

@@ -1,0 +1,8 @@
+"""slim.nas (ref contrib/slim/nas/): LightNAS search loop. The
+reference splits controller-server/search-agent across sockets for
+cluster search; here the loop runs in-process (a pod evaluates
+candidates under its own mesh — no socket tier needed)."""
+from .search_space import SearchSpace  # noqa: F401
+from .light_nas_strategy import LightNASStrategy  # noqa: F401
+
+__all__ = ["SearchSpace", "LightNASStrategy"]

@@ -20,6 +20,9 @@ _W_SLOTS = {"conv2d": "Filter", "depthwise_conv2d": "Filter",
             "mul": "Y", "matmul": "Y"}
 
 
+__all__ = ["quant_aware", "convert", "QUANTIZABLE"]
+
+
 def quant_aware(program, weight_bits=8, activation_bits=8,
                 quantizable_op_types=QUANTIZABLE, moving_rate=0.9,
                 skip_pattern="skip_quant", scope=None):

@@ -1,0 +1,6 @@
+"""Module-path alias for slim.quantization (ref
+contrib/slim/quantization/); QAT passes live in qat.py."""
+from .qat import *  # noqa: F401,F403
+from . import qat as _q
+
+__all__ = list(getattr(_q, "__all__", []))

@@ -1,0 +1,5 @@
+"""slim.searcher (ref contrib/slim/searcher/): evolutionary token
+search controllers."""
+from .controller import EvolutionaryController, SAController  # noqa: F401
+
+__all__ = ["EvolutionaryController", "SAController"]

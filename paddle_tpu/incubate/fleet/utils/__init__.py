@@ -1,0 +1,2 @@
+"""ref incubate/fleet/utils/."""
+from . import fleet_util, fleet_barrier_util, hdfs  # noqa: F401

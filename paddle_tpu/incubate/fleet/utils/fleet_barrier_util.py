@@ -1,0 +1,14 @@
+"""ref incubate/fleet/utils/fleet_barrier_util.py: check_all_trainers_
+ready barriers the job (pserver table tricks in the reference; a device
+barrier here)."""
+
+__all__ = ["check_all_trainers_ready"]
+
+
+def check_all_trainers_ready(input_var_name=None, timeout=None):
+    import jax
+    if jax.process_count() == 1:
+        return
+    from jax.experimental import multihost_utils  # pragma: no cover
+    multihost_utils.sync_global_devices(  # pragma: no cover
+        "fleet_barrier_%s" % (input_var_name or "default"))

@@ -1,0 +1,29 @@
+"""ref incubate/fleet/utils/fleet_util.py: rank helpers + all-reduce of
+host metrics (the reference goes through the pserver barrier; here XLA
+collectives / multihost utils)."""
+import numpy as np
+
+__all__ = ["FleetUtil"]
+
+
+class FleetUtil(object):
+    def rank0_print(self, s):
+        import jax
+        if jax.process_index() == 0:
+            print(s, flush=True)
+
+    def all_reduce(self, value, op="sum"):
+        """Reduce a host scalar/array across processes."""
+        import jax
+        arr = np.asarray(value, np.float64)
+        if jax.process_count() == 1:
+            return arr
+        from jax.experimental import multihost_utils  # pragma: no cover
+        out = multihost_utils.process_allgather(arr)  # pragma: no cover
+        if op == "sum":  # pragma: no cover
+            return out.sum(axis=0)
+        if op == "max":  # pragma: no cover
+            return out.max(axis=0)
+        if op == "min":  # pragma: no cover
+            return out.min(axis=0)
+        raise ValueError("unsupported op %r" % op)  # pragma: no cover

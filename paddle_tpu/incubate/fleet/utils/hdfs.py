@@ -1,0 +1,6 @@
+"""ref incubate/fleet/utils/hdfs.py — same N/A story as
+contrib.utils.hdfs_utils (POSIX-visible mounts replace HDFS staging)."""
+from ....contrib.utils.hdfs_utils import HDFSClient, multi_download, \
+    multi_upload  # noqa: F401
+
+__all__ = ["HDFSClient", "multi_download", "multi_upload"]
