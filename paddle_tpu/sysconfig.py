@@ -1,0 +1,19 @@
+"""paddle.sysconfig parity (ref python/paddle/sysconfig.py)."""
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+
+def get_include():
+    """Directory of this package's headers/sources (the reference points
+    at its C++ headers; the native data plane's sources live here)."""
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "native")
+
+
+def get_lib():
+    """Directory containing the built native libraries: the dataplane
+    .so lands in the build cache (native/build.py _cache_dir), not the
+    source tree."""
+    from .native.build import _cache_dir
+    return _cache_dir()

@@ -2,6 +2,7 @@
 import must resolve under paddle_tpu (ref python/paddle/fluid/*.py).
 Round-3 closed the export surfaces; these pin the import paths."""
 import importlib
+import os
 
 import numpy as np
 import pytest
@@ -234,3 +235,19 @@ def test_hdfs_and_geo_sgd_raise_with_guidance():
     from paddle_tpu.transpiler.geo_sgd_transpiler import GeoSgdTranspiler
     with pytest.raises(NotImplementedError, match="ICI"):
         GeoSgdTranspiler()
+
+
+def test_compat_and_sysconfig():
+    from paddle_tpu import compat, sysconfig
+    assert compat.to_text(b"abc") == "abc"
+    assert compat.to_bytes("abc") == b"abc"
+    assert compat.to_text([b"a", b"b"]) == ["a", "b"]
+    assert compat.to_text({b"k": b"v"}) == {"k": "v"}
+    assert compat.to_text(1.5) == 1.5 and compat.to_text(True) is True
+    assert compat.long_type is int
+    assert compat.round(2.5) == 3.0      # py2 half-away-from-zero
+    assert compat.round(-2.5) == -3.0
+    assert compat.round(0.0) == 0.0
+    assert compat.floor_division(7, 2) == 3
+    assert "boom" in compat.get_exception_message(ValueError("boom"))
+    assert os.path.isdir(sysconfig.get_include())
