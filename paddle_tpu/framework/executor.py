@@ -202,10 +202,117 @@ class Executor(object):
         return list(fetches)
 
     # ------------------------------------------------------------------
-    def _convert_feed(self, program, feed):
+    def run_steps(self, program=None, feed=None, fetch_list=None,
+                  scope=None, return_numpy=True, use_program_cache=True):
+        """Run N consecutive steps as ONE device program (lax.scan).
+
+        ``feed`` maps each feed name to an array with a leading steps
+        axis: step i consumes ``feed[name][i]``. The traced step function
+        is scanned over the stacked feeds with the persistable state as
+        the carry, so parameters/optimizer moments/PRNG counter thread
+        through on-device and the host dispatches ONE computation for the
+        whole window. This is the reference's C++ trainer loop
+        (`framework/trainer.cc` runs many steps without returning to
+        Python) done the XLA way — and it takes per-step host/link
+        latency (significant over remote TPU tunnels) off the critical
+        path entirely.
+
+        Returns the fetches of every step, stacked on a leading axis of
+        length N. Per-step semantics (dropout PRNG folding, state
+        updates) are identical to N sequential ``run`` calls — pinned by
+        tests/test_executor_scan.py.
+        """
+        from .compiler import CompiledProgram
+        if isinstance(program, CompiledProgram):
+            raise ValueError(
+                "run_steps takes a plain Program; for sharded multi-step "
+                "execution jit the CompiledProgram step inside your own "
+                "scan (v1 limitation)")
+        if program is None:
+            program = default_main_program()
+        if getattr(program, "_pp_plan", None) is not None:
+            raise ValueError("run_steps does not support fleet pipeline "
+                             "programs (their step is already fused)")
+        if any(r._started for r in getattr(program, "_py_readers", ())):
+            raise ValueError("run_steps needs explicit stacked feeds, not "
+                             "started py_readers")
+        scope = scope if scope is not None else global_scope()
+        feed = dict(feed or {})
+        fetch_list = list(fetch_list or [])
+        fetch_names = [f.name if hasattr(f, "name") else f
+                       for f in fetch_list]
+        if not feed or not fetch_names:
+            raise ValueError("run_steps requires stacked feeds and a "
+                             "fetch_list")
+        # .shape/np.shape never sync a device array to host
+        lens = {k: (np.shape(v)[0] if np.ndim(v) else None)
+                for k, v in feed.items()}
+        if None in lens.values() or len(set(lens.values())) != 1:
+            raise ValueError(
+                "every run_steps feed needs the same leading steps axis; "
+                "got %r" % lens)
+        n_steps = next(iter(lens.values()))
+        if n_steps == 0:
+            raise ValueError("run_steps needs at least one step; the "
+                             "stacked feeds have a leading axis of 0")
+        staged = self._convert_feed(program, feed, steps_axis=True)
+
+        check_numerics = bool(getattr(program, "_check_numerics", False))
+        state_names, uses_rng = self._prepare_state(program, staged, scope)
+        key = (id(program), program._version,
+               _feed_signature(staged), tuple(fetch_names),
+               tuple(state_names), check_numerics, "scan")
+        fn = self._cache.get(key) if use_program_cache else None
+        if fn is None:
+            base_step = self._make_step(program, sorted(staged),
+                                        fetch_names, state_names, uses_rng,
+                                        check_numerics)
+
+            def multi(state_tuple, feed_stack_tuple):
+                def body(carry, xs):
+                    out = base_step(carry, xs)
+                    # (fetches[, finite_flag]) stacked per step
+                    return out[1], (out[0],) + out[2:]
+                final_state, ys = jax.lax.scan(
+                    body, state_tuple, feed_stack_tuple)
+                return ys, final_state
+
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")  # CPU ignores donation
+                jitted = jax.jit(multi, donate_argnums=(0,))
+
+            def fn(state_vals, feed_tuple):
+                with self._device_ctx():
+                    return jitted(state_vals, feed_tuple)
+            if use_program_cache:
+                self._cache[key] = fn
+        state_vals = tuple(scope.find_var(n) for n in state_names)
+        feed_tuple = tuple(staged[k] for k in sorted(staged))
+        ys, new_state = fn(state_vals, feed_tuple)
+        for n, v in zip(state_names, new_state):
+            scope.set_var(n, v)
+        if check_numerics:
+            finite = np.asarray(ys[1])
+            if not finite.all():
+                # unlike run(), detection lands after the scanned window
+                # completes (a scan cannot abort mid-flight) — the step
+                # index still names the first offender
+                raise FloatingPointError(
+                    "check_numerics: non-finite value (NaN/Inf) first "
+                    "detected at step %d of this run_steps window"
+                    % int(np.argmin(finite)))
+        stacked = ys[0]
+        if return_numpy:
+            return [np.asarray(f) for f in stacked]
+        return list(stacked)
+
+    # ------------------------------------------------------------------
+    def _convert_feed(self, program, feed, steps_axis=False):
         """Host-side dtype normalization + ONE batched device_put for all
         feeds (a single transfer keeps per-array latency — significant over
-        remote/tunneled TPU links — off the step critical path)."""
+        remote/tunneled TPU links — off the step critical path).
+        steps_axis=True (run_steps): each array carries a leading steps
+        axis; shape validation applies to the per-step remainder."""
         out = {}
         blk = program.global_block()
         for name, val in feed.items():
@@ -220,20 +327,22 @@ class Executor(object):
                 arr = arr.astype(dtype)
             if var is not None and var.shape is not None:
                 want = var.shape
-                if len(want) != arr.ndim:
+                got = arr.shape[1:] if steps_axis else arr.shape
+                kind = "per-step " if steps_axis else ""
+                if len(want) != len(got):
                     # named error at the feed boundary (reference parity:
                     # DataFeeder's check), instead of a jax shape error
                     # deep inside the trace
                     raise ValueError(
-                        "feed %r has rank %d (shape %s) but the program "
+                        "feed %r has %srank %d (shape %s) but the program "
                         "declares rank %d (shape %s)"
-                        % (name, arr.ndim, tuple(arr.shape), len(want),
+                        % (name, kind, len(got), tuple(got), len(want),
                            tuple(want)))
-                for w, g in zip(want, arr.shape):
+                for w, g in zip(want, got):
                     if w not in (-1, g):
                         raise ValueError(
-                            "feed %r shape %s incompatible with declared "
-                            "%s" % (name, arr.shape, want))
+                            "feed %r %sshape %s incompatible with declared "
+                            "%s" % (name, kind, got, want))
             out[name] = arr
         host = [k for k, v in out.items() if not isinstance(v, jax.Array)]
         if host:

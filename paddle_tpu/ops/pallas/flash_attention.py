@@ -34,6 +34,20 @@ except Exception:  # pragma: no cover
 _NEG_INF = -1e30
 
 
+def _dot_precision(dtype):
+    """MXU precision for kernel matmuls given the user-facing dtype.
+
+    f32 (and fp16: 10 mantissa bits > bf16's 7) inputs at DEFAULT
+    precision run a single bf16 pass on the MXU (~1e-3 relative error) —
+    a user asking for f32/fp16 attention gets full-precision math
+    (HIGHEST = multi-pass), matching the reference's true-precision CUDA
+    kernels. bf16 inputs stay on the fast path: their products are exact
+    in the f32 accumulator, so DEFAULT already matches the oracle."""
+    return (jax.lax.Precision.DEFAULT
+            if jnp.dtype(dtype) == jnp.bfloat16 else
+            jax.lax.Precision.HIGHEST)
+
+
 def _causal_keep(qi, kj, causal_offset, block_q, block_k):
     """Bool (BQ, BK) tile of the bottom-right-aligned causal mask
     (query i sees keys j <= i + causal_offset) — shared by all kernels."""
@@ -46,7 +60,7 @@ def _causal_keep(qi, kj, causal_offset, block_q, block_k):
 
 def _bwd_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
               qi, kj, *, scale, causal, causal_offset, block_q, block_k,
-              mask_mode):
+              mask_mode, precision):
     """Recompute the probability tile p = exp(s - lse) and the logit
     cotangent ds = p * (dO V^T - delta) from the forward residuals —
     the shared core of both backward kernels."""
@@ -58,7 +72,8 @@ def _bwd_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
     delta = delta_ref[0, 0].astype(jnp.float32)
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32) * scale  # (BQ, BK)
+        preferred_element_type=jnp.float32,
+        precision=precision) * scale  # (BQ, BK)
     if mask_mode == "qk":
         s = s + mask_ref[0, 0].astype(jnp.float32)
     elif mask_mode == "k":
@@ -69,14 +84,15 @@ def _bwd_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
                                    block_k), p, 0.0)
     dp = jax.lax.dot_general(
         do, v, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32)     # (BQ, BK)
+        preferred_element_type=jnp.float32,
+        precision=precision)                    # (BQ, BK)
     ds = p * (dp - delta[:, None])
     return q, k, do, p, ds
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, acc_ref,
                 m_ref, l_ref, *, scale, causal, causal_offset, block_q,
-                block_k, mask_mode):
+                block_k, mask_mode, precision):
     qi = pl.program_id(1)
     kj = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -92,7 +108,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, acc_ref,
         k = k_ref[0].astype(jnp.float32)          # (BK, D)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale  # (BQ, BK)
+            preferred_element_type=jnp.float32,
+            precision=precision) * scale  # (BQ, BK)
         if mask_mode == "qk":
             s = s + mask_ref[0, 0].astype(jnp.float32)
         elif mask_mode == "k":
@@ -112,7 +129,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, acc_ref,
         v = v_ref[0].astype(jnp.float32)
         pv = jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)    # (BQ, D)
+            preferred_element_type=jnp.float32,
+            precision=precision)                   # (BQ, D)
         acc_ref[:] = acc_ref[:] * corr + pv
         m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
         l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
@@ -193,7 +211,8 @@ def _pallas_forward(q, k, v, mask, scale, causal, block_q, block_k,
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, causal=causal,
                           causal_offset=tk - tq, block_q=block_q,
-                          block_k=block_k, mask_mode=mask_mode),
+                          block_k=block_k, mask_mode=mask_mode,
+                          precision=_dot_precision(q.dtype)),
         grid=grid,
         in_specs=in_specs,
         out_specs=[
@@ -213,7 +232,7 @@ def _pallas_forward(q, k, v, mask, scale, causal, block_q, block_k,
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     mask_ref, dk_ref, dv_ref, dk_acc, dv_acc,
                     *, scale, causal, causal_offset, block_q, block_k,
-                    mask_mode):
+                    mask_mode, precision):
     """dK/dV for one k-block, accumulating over q-blocks (innermost grid
     dim). Recomputes p = exp(s - lse) from residuals — no (T,T) in HBM."""
     kj = pl.program_id(1)
@@ -230,14 +249,14 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
             qi, kj, scale=scale, causal=causal,
             causal_offset=causal_offset, block_q=block_q,
-            block_k=block_k, mask_mode=mask_mode)
+            block_k=block_k, mask_mode=mask_mode, precision=precision)
         # dv += p^T dO ; dk += scale * ds^T q
         dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+            preferred_element_type=jnp.float32, precision=precision)
         dk_acc[:] = dk_acc[:] + scale * jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+            preferred_element_type=jnp.float32, precision=precision)
 
     if causal:
         @pl.when(qi * block_q + (block_q - 1) + causal_offset >=
@@ -255,7 +274,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                    mask_ref, dq_ref, dq_acc, *, scale, causal,
-                   causal_offset, block_q, block_k, mask_mode):
+                   causal_offset, block_q, block_k, mask_mode, precision):
     qi = pl.program_id(1)
     kj = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -269,10 +288,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
             qi, kj, scale=scale, causal=causal,
             causal_offset=causal_offset, block_q=block_q,
-            block_k=block_k, mask_mode=mask_mode)
+            block_k=block_k, mask_mode=mask_mode, precision=precision)
         dq_acc[:] = dq_acc[:] + scale * jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+            preferred_element_type=jnp.float32, precision=precision)
 
     if causal:
         @pl.when(kj * block_k <= qi * block_q + (block_q - 1) +
@@ -306,7 +325,8 @@ def _pallas_backward(q, k, v, mask, out, lse, g, scale, causal, block_q,
     mask_mode, mask_in, dkv_mask_spec = _mask_spec(
         mask, h, q.dtype, block_q, block_k, kj_innermost=False)
     common = dict(scale=scale, causal=causal, causal_offset=tk - tq,
-                  block_q=block_q, block_k=block_k, mask_mode=mask_mode)
+                  block_q=block_q, block_k=block_k, mask_mode=mask_mode,
+                  precision=_dot_precision(q.dtype))
     dkv_specs = [
         pl.BlockSpec((1, block_q, d), lambda bb, j, i: (bb, i, 0)),   # q
         pl.BlockSpec((1, block_k, d), lambda bb, j, i: (bb, j, 0)),   # k
@@ -361,8 +381,10 @@ def _pallas_backward(q, k, v, mask, out, lse, g, scale, causal, block_q,
 
 
 def _xla_attention(q, k, v, mask, scale, causal):
+    prec = _dot_precision(q.dtype)
     logits = jnp.einsum("bhqd,bhkd->bhqk", q, k,
-                        preferred_element_type=jnp.float32) * scale
+                        preferred_element_type=jnp.float32,
+                        precision=prec) * scale
     if mask is not None:
         logits = logits + mask.astype(jnp.float32)
     if causal:
@@ -370,7 +392,8 @@ def _xla_attention(q, k, v, mask, scale, causal):
         cm = jnp.tril(jnp.ones((tq, tk), bool), tk - tq)
         logits = jnp.where(cm, logits, _NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1)
-    return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v,
+                      precision=prec)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
@@ -391,8 +414,9 @@ def _xla_dmask(q, k, v, mask, out, lse, g, scale, causal):
     materialize (B,H,Tq,Tk) — but it is emitted as a standalone expression,
     so when the mask grad is unused (padding masks, the BERT/ERNIE case)
     XLA dead-code-eliminates it and only the Pallas kernels remain."""
+    prec = _dot_precision(q.dtype)
     s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
-                   k.astype(jnp.float32)) * scale
+                   k.astype(jnp.float32), precision=prec) * scale
     s = s + mask.astype(jnp.float32)
     p = jnp.exp(s - lse[..., None])
     if causal:
@@ -401,7 +425,7 @@ def _xla_dmask(q, k, v, mask, out, lse, g, scale, causal):
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1)
     dp = jnp.einsum("bhqd,bhkd->bhqk", g.astype(jnp.float32),
-                    v.astype(jnp.float32))
+                    v.astype(jnp.float32), precision=prec)
     ds = p * (dp - delta[..., None])
     reduce_axes = tuple(ax for ax in range(4)
                         if mask.shape[ax] == 1 and ds.shape[ax] > 1)

@@ -1,0 +1,135 @@
+"""Executor.run_steps: N steps fused into one lax.scan device program.
+
+Contract: identical per-step semantics to N sequential Executor.run calls —
+same losses, same final parameter/optimizer/PRNG state — with ONE host
+dispatch. (Reference analogue: framework/trainer.cc's in-C++ training loop.)
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers, optimizer
+from paddle_tpu.framework.scope import Scope, scope_guard
+
+
+def _mlp_program(with_dropout):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [8, 4], "float32", append_batch_size=False)
+        y = layers.data("y", [8, 1], "float32", append_batch_size=False)
+        h = layers.fc(x, 16, act="relu")
+        if with_dropout:
+            h = layers.dropout(h, 0.3)
+        out = layers.fc(h, 1)
+        loss = layers.reduce_mean(layers.square(out - y))
+        optimizer.Adam(1e-2).minimize(loss)
+    return main, startup, loss
+
+
+def _batches(n, seed=0):
+    rng = np.random.RandomState(seed)
+    xs = rng.randn(n, 8, 4).astype(np.float32)
+    ys = (xs.sum(axis=2, keepdims=True) > 0).astype(np.float32)
+    return xs, ys
+
+
+@pytest.mark.parametrize("with_dropout", [False, True])
+def test_run_steps_matches_sequential_runs(with_dropout):
+    n = 5
+    xs, ys = _batches(n)
+    main, startup, loss = _mlp_program(with_dropout)
+
+    # sequential oracle
+    seq_scope = Scope()
+    with scope_guard(seq_scope):
+        exe = pt.Executor()
+        exe.run(startup)
+        seq_losses = [
+            float(exe.run(main, feed={"x": xs[i], "y": ys[i]},
+                          fetch_list=[loss])[0]) for i in range(n)]
+        seq_state = {nm: np.asarray(v)
+                     for nm, v in seq_scope.items() if v is not None}
+
+    # one fused scan window
+    scan_scope = Scope()
+    with scope_guard(scan_scope):
+        exe = pt.Executor()
+        exe.run(startup)
+        stacked, = exe.run_steps(main, feed={"x": xs, "y": ys},
+                                 fetch_list=[loss])
+        scan_losses = [float(v) for v in np.asarray(stacked).reshape(-1)]
+        for nm, ref in seq_state.items():
+            got = scan_scope.find_var(nm)
+            if got is None or np.asarray(got).dtype.kind not in "fiu":
+                continue
+            np.testing.assert_allclose(
+                np.asarray(got), ref, rtol=1e-6, atol=1e-6,
+                err_msg="state %r diverged between run_steps and "
+                        "sequential runs" % nm)
+
+    np.testing.assert_allclose(scan_losses, seq_losses, rtol=1e-6,
+                               atol=1e-6)
+
+
+def test_run_steps_validates_stacking():
+    main, startup, loss = _mlp_program(False)
+    xs, ys = _batches(3)
+    with scope_guard(Scope()):
+        exe = pt.Executor()
+        exe.run(startup)
+        with pytest.raises(ValueError, match="leading steps axis"):
+            exe.run_steps(main, feed={"x": xs, "y": ys[:2]},
+                          fetch_list=[loss])
+        with pytest.raises(ValueError, match="rank"):
+            exe.run_steps(main, feed={"x": xs[:, 0], "y": ys},
+                          fetch_list=[loss])
+
+
+def test_run_steps_check_numerics_names_first_bad_step():
+    main, startup, loss = _mlp_program(False)
+    main._check_numerics = True
+    xs, ys = _batches(4)
+    xs[2] = np.nan
+    with scope_guard(Scope()):
+        exe = pt.Executor()
+        exe.run(startup)
+        with pytest.raises(FloatingPointError, match="step 2"):
+            exe.run_steps(main, feed={"x": xs, "y": ys},
+                          fetch_list=[loss])
+
+
+def test_run_steps_rejects_empty_window():
+    main, startup, loss = _mlp_program(False)
+    xs, ys = _batches(1)
+    with scope_guard(Scope()):
+        exe = pt.Executor()
+        exe.run(startup)
+        with pytest.raises(ValueError, match="at least one step"):
+            exe.run_steps(main, feed={"x": xs[:0], "y": ys[:0]},
+                          fetch_list=[loss])
+
+
+def test_run_steps_continues_prng_stream():
+    """A run() after run_steps() must see the advanced dropout counter —
+    the scan carries STEP_VAR exactly like sequential runs."""
+    n = 3
+    xs, ys = _batches(n + 1, seed=7)
+    main, startup, loss = _mlp_program(True)
+
+    s1, s2 = Scope(), Scope()
+    with scope_guard(s1):
+        exe = pt.Executor()
+        exe.run(startup)
+        for i in range(n):
+            exe.run(main, feed={"x": xs[i], "y": ys[i]},
+                    fetch_list=[loss])
+        ref = float(exe.run(main, feed={"x": xs[n], "y": ys[n]},
+                            fetch_list=[loss])[0])
+    with scope_guard(s2):
+        exe = pt.Executor()
+        exe.run(startup)
+        exe.run_steps(main, feed={"x": xs[:n], "y": ys[:n]},
+                      fetch_list=[loss])
+        got = float(exe.run(main, feed={"x": xs[n], "y": ys[n]},
+                            fetch_list=[loss])[0])
+    np.testing.assert_allclose(got, ref, rtol=1e-6, atol=1e-6)
