@@ -157,8 +157,16 @@ def _run_steps(exe, prog, feed, loss_var, steps, warmup):
     return dt, vals[-1]
 
 
-def _measure_ernie(batch, seq, preds, cfg, steps, warmup):
-    """samples/sec of the flagship step at one batch size; fresh state."""
+def _measure_ernie(batch, seq, preds, cfg, steps, warmup,
+                   scan_window=None):
+    """samples/sec of the flagship step at one batch size; fresh state.
+
+    Returns (samples_per_sec, dt, info): the dispatch-loop number, plus —
+    when scan_window is set — a fused Executor.run_steps window (ONE
+    device program scanning `scan_window` distinct batches: the
+    production training-loop shape, host/tunnel dispatch off the
+    critical path). The better of the two is the reported throughput;
+    info records both for the headline JSON."""
     import jax
     import paddle_tpu as pt
     from paddle_tpu.models import bert
@@ -169,6 +177,7 @@ def _measure_ernie(batch, seq, preds, cfg, steps, warmup):
         cfg, batch, seq, preds,
         optimizer_fn=lambda loss: optimizer.Adam(1e-4).minimize(loss))
     scope = Scope()
+    info = {}
     with scope_guard(scope):
         exe = pt.Executor()
         exe.run(startup)
@@ -176,8 +185,31 @@ def _measure_ernie(batch, seq, preds, cfg, steps, warmup):
         feed = {k: jax.device_put(np.asarray(v)) for k, v in feed.items()}
         dt, loss = _run_steps(exe, main_prog, feed, fetch["loss"], steps,
                               warmup)
-    assert np.isfinite(loss), "non-finite loss in benchmark"
-    return batch * steps / dt, dt
+        assert np.isfinite(loss), "non-finite loss in benchmark"
+        sps = batch * steps / dt
+        info["dispatch_loop_sps"] = round(sps, 2)
+        if scan_window:
+            from paddle_tpu.models import bert as bert_mod
+            # pre-staged on device like the dispatch loop's feed — the
+            # timed window must measure the fused program, not the link
+            stacked = {
+                k: jax.device_put(np.stack([bert_mod.synthetic_batch(
+                    cfg, batch, seq, preds, seed=i)[k]
+                    for i in range(scan_window)]))
+                for k in feed}
+            loss_var = fetch["loss"]
+            out = exe.run_steps(main_prog, feed=stacked,
+                                fetch_list=[loss_var])   # compile+warm
+            t0 = time.perf_counter()
+            out = exe.run_steps(main_prog, feed=stacked,
+                                fetch_list=[loss_var])
+            dts = time.perf_counter() - t0
+            assert np.isfinite(np.asarray(out[0])).all()
+            scan_sps = batch * scan_window / dts
+            info["scan_window_sps"] = round(scan_sps, 2)
+            if scan_sps > sps:
+                sps, dt, steps = scan_sps, dts, scan_window
+    return sps, dt, steps, info
 
 
 def measure_headline():
@@ -189,19 +221,20 @@ def measure_headline():
     if on_tpu:
         batch, seq, preds = 128, 128, 20
         cfg = bert.bert_base(dtype="bfloat16")
-        steps, warmup = 20, 3
+        steps, warmup, window = 10, 3, 20
     else:
         batch, seq, preds = 8, 64, 8
         cfg = bert.BertConfig(vocab_size=8192, hidden_size=256,
                               num_layers=4, num_heads=4, ff_size=1024,
                               max_position=128)
-        steps, warmup = 5, 2
+        steps, warmup, window = 5, 2, 5
 
-    sps, dt = _measure_ernie(batch, seq, preds, cfg, steps, warmup)
-    best = (batch, sps, dt, steps)
+    sps, dt, nsteps, info = _measure_ernie(batch, seq, preds, cfg, steps,
+                                           warmup, scan_window=window)
+    best = (batch, sps, dt, nsteps, info)
 
     def headline_json(b):
-        bbatch, sps_, dt_, bsteps = b
+        bbatch, sps_, dt_, bsteps, binfo = b
         result = {
             "metric": HEADLINE_METRIC,
             "value": round(sps_, 2),
@@ -209,6 +242,7 @@ def measure_headline():
             "vs_baseline": round(sps_ / REFERENCE_SAMPLES_PER_SEC, 3),
             "batch": bbatch,
         }
+        result.update(binfo)
         peak = _chip_peak_flops()
         if peak is not None:
             result["mfu"] = round(
@@ -225,12 +259,13 @@ def measure_headline():
         # Guarded: an OOM/compile failure on 256 must not cost the
         # already-measured 128 result.
         _STATE["stage"] = "headline-batch256"
-        steps256 = max(steps // 2, 8)
         try:
-            sps256, dt256 = _measure_ernie(256, seq, preds, cfg,
-                                           steps256, warmup)
-            if sps256 > best[1]:
-                best = (256, sps256, dt256, steps256)
+            s256, d256, n256, i256 = _measure_ernie(
+                256, seq, preds, cfg, max(steps // 2, 5), warmup,
+                scan_window=10)
+            if s256 > best[1]:
+                best = (256, s256, d256, n256, i256)
+                _STATE["headline"] = headline_json(best)
         except Exception as e:  # pragma: no cover
             print("batch-256 attempt failed: %r" % (e,), file=sys.stderr)
 

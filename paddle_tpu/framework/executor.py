@@ -52,6 +52,10 @@ def _want_vjp_set(program):
     return frozenset(want)
 
 
+def _fetch_names(fetch_list):
+    return [f.name if hasattr(f, "name") else f for f in fetch_list]
+
+
 def _persistable_names(program):
     names = set()
     for blk in program.blocks:
@@ -154,8 +158,7 @@ class Executor(object):
         for rdr, batch in pulled:
             for n, v in batch.items():
                 feed.setdefault(n, v)
-        fetch_list = list(fetch_list or [])
-        fetch_names = [f.name if hasattr(f, "name") else f for f in fetch_list]
+        fetch_names = _fetch_names(fetch_list or [])
 
         if not fetch_names:
             self._run_eager(program, feed, scope)
@@ -195,6 +198,13 @@ class Executor(object):
                     "parity: check_nan_inf)")
         else:
             fetches, new_state = step_fn(state_vals, feed_tuple)
+        return self._writeback(scope, state_names, new_state, fetches,
+                               return_numpy)
+
+    @staticmethod
+    def _writeback(scope, state_names, new_state, fetches, return_numpy):
+        """Shared run()/run_steps() tail: persist the new state, convert
+        fetches."""
         for n, v in zip(state_names, new_state):
             scope.set_var(n, v)
         if return_numpy:
@@ -238,9 +248,7 @@ class Executor(object):
                              "started py_readers")
         scope = scope if scope is not None else global_scope()
         feed = dict(feed or {})
-        fetch_list = list(fetch_list or [])
-        fetch_names = [f.name if hasattr(f, "name") else f
-                       for f in fetch_list]
+        fetch_names = _fetch_names(fetch_list or [])
         if not feed or not fetch_names:
             raise ValueError("run_steps requires stacked feeds and a "
                              "fetch_list")
@@ -289,22 +297,23 @@ class Executor(object):
         state_vals = tuple(scope.find_var(n) for n in state_names)
         feed_tuple = tuple(staged[k] for k in sorted(staged))
         ys, new_state = fn(state_vals, feed_tuple)
-        for n, v in zip(state_names, new_state):
-            scope.set_var(n, v)
         if check_numerics:
             finite = np.asarray(ys[1])
             if not finite.all():
-                # unlike run(), detection lands after the scanned window
-                # completes (a scan cannot abort mid-flight) — the step
-                # index still names the first offender
+                # write the post-window state back first — the input
+                # buffers were donated, so leaving the scope pointing at
+                # them would poison every later run. Unlike run(),
+                # detection lands after the scanned window completes (a
+                # scan cannot abort mid-flight) — the step index still
+                # names the first offender
+                self._writeback(scope, state_names, new_state, (),
+                                False)
                 raise FloatingPointError(
                     "check_numerics: non-finite value (NaN/Inf) first "
                     "detected at step %d of this run_steps window"
                     % int(np.argmin(finite)))
-        stacked = ys[0]
-        if return_numpy:
-            return [np.asarray(f) for f in stacked]
-        return list(stacked)
+        return self._writeback(scope, state_names, new_state, ys[0],
+                               return_numpy)
 
     # ------------------------------------------------------------------
     def _convert_feed(self, program, feed, steps_axis=False):
