@@ -161,7 +161,8 @@ def _measure_ernie(batch, seq, preds, cfg, steps, warmup,
                    scan_window=None):
     """samples/sec of the flagship step at one batch size; fresh state.
 
-    Returns (samples_per_sec, dt, info): the dispatch-loop number, plus —
+    Returns (samples_per_sec, dt, steps, info): the dispatch-loop number
+    (steps = the step count behind dt, for FLOP accounting), plus —
     when scan_window is set — a fused Executor.run_steps window (ONE
     device program scanning `scan_window` distinct batches: the
     production training-loop shape, host/tunnel dispatch off the
@@ -192,11 +193,11 @@ def _measure_ernie(batch, seq, preds, cfg, steps, warmup,
             from paddle_tpu.models import bert as bert_mod
             # pre-staged on device like the dispatch loop's feed — the
             # timed window must measure the fused program, not the link
-            stacked = {
-                k: jax.device_put(np.stack([bert_mod.synthetic_batch(
-                    cfg, batch, seq, preds, seed=i)[k]
-                    for i in range(scan_window)]))
-                for k in feed}
+            batches = [bert_mod.synthetic_batch(cfg, batch, seq, preds,
+                                                seed=i)
+                       for i in range(scan_window)]
+            stacked = {k: jax.device_put(np.stack([b[k] for b in batches]))
+                       for k in feed}
             loss_var = fetch["loss"]
             out = exe.run_steps(main_prog, feed=stacked,
                                 fetch_list=[loss_var])   # compile+warm

@@ -99,11 +99,15 @@ class Executor(object):
     # ------------------------------------------------------------------
     def train_from_dataset(self, program=None, dataset=None, scope=None,
                            thread=0, debug=False, fetch_list=None,
-                           fetch_info=None, print_period=100):
+                           fetch_info=None, print_period=100,
+                           steps_per_dispatch=1):
         """Run the whole dataset through the jitted train step (reference
         executor.py train_from_dataset / MultiTrainer). The device_worker
         thread pool maps to background batch prefetch + JAX async
         dispatch: the host stages batch N+1 while the chip runs batch N.
+        steps_per_dispatch=W batches W steps into one fused lax.scan
+        device program (run_steps) — the reference's in-C++ trainer loop,
+        recommended over remote/tunneled TPU links.
         Returns (steps_run, last_fetch_values)."""
         from ..trainer_factory import TrainerFactory
         if dataset is None:
@@ -115,7 +119,8 @@ class Executor(object):
         return trainer.run(dataset, fetch_list=fetch_list,
                            fetch_info=fetch_info,
                            print_period=print_period, debug=debug,
-                           scope=scope)
+                           scope=scope,
+                           steps_per_dispatch=steps_per_dispatch)
 
     def infer_from_dataset(self, program=None, dataset=None, scope=None,
                            thread=0, debug=False, fetch_list=None,
@@ -192,6 +197,10 @@ class Executor(object):
         if check_numerics:
             fetches, new_state, finite = step_fn(state_vals, feed_tuple)
             if not bool(np.asarray(finite)):
+                # write the new state back first: the inputs were donated,
+                # so leaving the scope pointing at them would poison every
+                # later run for callers that catch this to inspect/resume
+                self._writeback(scope, state_names, new_state, (), False)
                 raise FloatingPointError(
                     "check_numerics: non-finite value (NaN/Inf) detected "
                     "in fetches or updated state of this step (reference "
@@ -521,9 +530,7 @@ class Executor(object):
             program = default_main_program()
         scope = scope if scope is not None else global_scope()
         feed = dict(feed or {})
-        fetch_list = list(fetch_list or [])
-        fetch_names = [f.name if hasattr(f, "name") else f
-                       for f in fetch_list]
+        fetch_names = _fetch_names(fetch_list or [])
         state_names, uses_rng = self._prepare_state(program, feed, scope)
         feed_vals = self._convert_feed(program, feed)
         step = self._make_step(program, sorted(feed_vals), fetch_names,

@@ -14,6 +14,17 @@ import threading
 _STOP = object()
 
 
+def _uniform_shapes(batches):
+    """True when every batch has the same keys and per-key shapes (the
+    static-shape requirement of a fused scan window)."""
+    import numpy as np
+    first = batches[0]
+    keys = set(first)
+    return all(set(b) == keys for b in batches[1:]) and all(
+        np.shape(b[k]) == np.shape(first[k])
+        for b in batches[1:] for k in keys)
+
+
 class PrefetchIterator(object):
     """Background-thread batch pump: the device_worker's data queue.
     Wraps any iterable of feed dicts; keeps up to `capacity` batches
@@ -109,28 +120,81 @@ class MultiTrainer(object):
         self._worker._set_program(program)
 
     def run(self, dataset, fetch_list=None, fetch_info=None,
-            print_period=100, debug=False, scope=None):
+            print_period=100, debug=False, scope=None,
+            steps_per_dispatch=1):
         import numpy as np
         fetch_list = list(fetch_list or [])
         fetch_info = list(fetch_info or
                           [getattr(f, "name", str(f)) for f in fetch_list])
         step = 0
         last = []
+        # steps_per_dispatch > 1: gather W batches and run them as ONE
+        # fused device program (Executor.run_steps lax.scan window) —
+        # host/link dispatch latency amortizes W-fold. Needs fetches (the
+        # scan's per-step outputs) and a plain Program; short tails fall
+        # back to the per-step loop below.
+        window = max(int(steps_per_dispatch), 1)
+        if window > 1 and not self._can_window(fetch_list):
+            window = 1
+        buf = []
+
+        def emit(vals, every_multiple=False):
+            due = (step % print_period == 0 if every_multiple
+                   else step % print_period < window)
+            if debug and fetch_list and due:
+                print("step %d: %s" % (step, ", ".join(
+                    "%s=%s" % (info, np.asarray(v).ravel()[:4])
+                    for info, v in zip(fetch_info, vals))))
+
+        def run_one(batch):
+            nonlocal step, last
+            last = self._exe.run(self._program, feed=batch,
+                                 fetch_list=fetch_list, scope=scope)
+            step += 1
+            # formatting syncs the async fetch values — the only
+            # host/device sync point in the loop
+            emit(last, every_multiple=True)
+
         it = PrefetchIterator(iter(dataset))
         try:
             for batch in it:
-                last = self._exe.run(self._program, feed=batch,
-                                     fetch_list=fetch_list, scope=scope)
-                step += 1
-                if debug and fetch_list and step % print_period == 0:
-                    # formatting syncs the async fetch values — the only
-                    # host/device sync point in the loop
-                    print("step %d: %s" % (step, ", ".join(
-                        "%s=%s" % (info, np.asarray(v).ravel()[:4])
-                        for info, v in zip(fetch_info, last))))
+                if window == 1:
+                    run_one(batch)
+                    continue
+                buf.append(batch)
+                if len(buf) < window:
+                    continue
+                if not _uniform_shapes(buf):
+                    # ragged window (bucketed lengths, remainder batch):
+                    # a scan needs one static shape — run these per-step
+                    for b in buf:
+                        run_one(b)
+                    buf = []
+                    continue
+                stacked = {k: np.stack([np.asarray(b[k]) for b in buf])
+                           for k in buf[0]}
+                buf = []
+                outs = self._exe.run_steps(
+                    self._program, feed=stacked,
+                    fetch_list=fetch_list, scope=scope)
+                step += window
+                last = [o[-1] for o in outs]
+                emit(last)
+            for batch in buf:      # tail shorter than the window
+                run_one(batch)
         finally:
             it.close()
         return step, last
+
+    def _can_window(self, fetch_list):
+        """run_steps preconditions — anything else silently degrades to
+        the per-step loop instead of crashing mid-epoch."""
+        from paddle_tpu.framework.compiler import CompiledProgram
+        return bool(fetch_list) \
+            and not isinstance(self._program, CompiledProgram) \
+            and getattr(self._program, "_pp_plan", None) is None \
+            and not any(r._started for r in
+                        getattr(self._program, "_py_readers", ()))
 
 
 class DistMultiTrainer(MultiTrainer):

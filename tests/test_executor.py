@@ -36,6 +36,76 @@ def test_train_from_dataset_runs_all_batches():
     assert np.isfinite(l_again).all()
 
 
+def test_train_from_dataset_windowed_matches_per_step():
+    """steps_per_dispatch=3: same dataset pass (windows + tail) produces
+    the same final parameters as the per-step loop."""
+    import paddle_tpu as pt
+    from paddle_tpu import layers, optimizer
+    from paddle_tpu.framework.scope import Scope, scope_guard
+
+    def build():
+        main, startup = pt.Program(), pt.Program()
+        with pt.unique_name.guard(), pt.program_guard(main, startup):
+            x = layers.data("x", [4], "float32")
+            y = layers.fc(x, size=1, name="wfc")
+            lbl = layers.data("y", [1], "float32")
+            loss = layers.reduce_mean(layers.square_error_cost(y, lbl))
+            optimizer.SGD(0.1).minimize(loss)
+        return main, startup, loss
+
+    rng = np.random.RandomState(1)
+    batches = [{"x": rng.rand(8, 4).astype(np.float32),
+                "y": rng.rand(8, 1).astype(np.float32)} for _ in range(7)]
+
+    results = []
+    for w in (1, 3):
+        main, startup, loss = build()
+        sc = Scope()
+        with scope_guard(sc):
+            exe = pt.Executor()
+            exe.run(startup)
+            steps, last = exe.train_from_dataset(
+                main, batches, fetch_list=[loss], steps_per_dispatch=w)
+            assert steps == 7
+            results.append({n: np.asarray(v) for n, v in sc.items()
+                            if v is not None and
+                            np.asarray(v).dtype.kind == "f"})
+    for n, ref in results[0].items():
+        np.testing.assert_allclose(results[1][n], ref, rtol=1e-6,
+                                   atol=1e-6, err_msg=n)
+
+
+def test_train_from_dataset_windowed_handles_ragged_batches():
+    """A ragged batch (remainder / bucketed length) inside a window must
+    degrade to per-step execution, not crash the epoch."""
+    import paddle_tpu as pt
+    from paddle_tpu import layers, optimizer
+    from paddle_tpu.framework.scope import Scope, scope_guard
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.unique_name.guard(), pt.program_guard(main, startup):
+        x = layers.data("x", [4], "float32")
+        y = layers.fc(x, size=1)
+        lbl = layers.data("y", [1], "float32")
+        loss = layers.reduce_mean(layers.square_error_cost(y, lbl))
+        optimizer.SGD(0.1).minimize(loss)
+
+    rng = np.random.RandomState(2)
+
+    def mk(n):
+        return {"x": rng.rand(n, 4).astype(np.float32),
+                "y": rng.rand(n, 1).astype(np.float32)}
+
+    batches = [mk(8), mk(8), mk(4), mk(8), mk(8)]   # ragged mid-window
+    with scope_guard(Scope()):
+        exe = pt.Executor()
+        exe.run(startup)
+        steps, last = exe.train_from_dataset(
+            main, batches, fetch_list=[loss], steps_per_dispatch=3)
+    assert steps == 5
+    assert np.isfinite(np.asarray(last[0])).all()
+
+
 def test_prefetch_iterator_propagates_errors():
     from paddle_tpu.trainer_factory import PrefetchIterator
 
