@@ -67,7 +67,7 @@ def _place_feed(v, sharding):
     replicated feeds (P()) must carry identical data on every host.
     """
     if jax.process_count() > 1 and sharding.spec and \
-            sharding.spec[0] is not None:
+            any(a is not None for a in sharding.spec):
         return jax.make_array_from_process_local_data(
             sharding, np.asarray(v))
     return jax.device_put(v, sharding)
@@ -154,32 +154,47 @@ class CompiledProgram(object):
                 return NamedSharding(mesh, P())
         return NamedSharding(mesh, P(data_axis))
 
+    def _build_multi_step(self, multi, state_names, feed_names):
+        """Sharded scan window (Executor.run_steps on a CompiledProgram):
+        `multi` is the executor-built scan over stacked feeds with the
+        state as donated carry. Feed shardings get a replicated leading
+        steps axis prepended; collectives inside the step ride ICI once
+        per scanned step with zero host round-trips."""
+        mesh = self._mesh_obj()
+        state_sh = tuple(self._var_sharding(n, mesh) for n in state_names)
+        feed_sh = tuple(
+            NamedSharding(mesh, P(*((None,) + tuple(s.spec))))
+            for s in (self._feed_sharding(n, mesh) for n in feed_names))
+        return self._wrap_sharded(multi, mesh, state_sh, feed_sh,
+                                  (None, state_sh))
+
     def _build_step(self, executor, step, program, state_names, feed_names,
                     feed_vals, check_numerics=False):
         mesh = self._mesh_obj()
         state_sh = tuple(self._var_sharding(n, mesh) for n in state_names)
         feed_sh = tuple(self._feed_sharding(n, mesh) for n in feed_names)
-
         out_sh = (None, state_sh, None) if check_numerics \
             else (None, state_sh)
-        jitted = jax.jit(
-            step,
-            in_shardings=(state_sh, feed_sh),
-            out_shardings=out_sh,
-            donate_argnums=(0,))
+        return self._wrap_sharded(step, mesh, state_sh, feed_sh, out_sh)
 
+    def _wrap_sharded(self, fn, mesh, state_sh, feed_sh, out_sh):
+        """Shared step/window machinery: jit over the mesh, stage inputs
+        onto their shardings, and arm the one-behind collective-timeout
+        watchdog."""
+        jitted = jax.jit(fn, in_shardings=(state_sh, feed_sh),
+                         out_shardings=out_sh, donate_argnums=(0,))
         timeout_s = getattr(self._build_strategy, "collective_timeout_s",
                             None)
-        pending = []  # previous step's outputs (one-step-behind watchdog)
+        pending = []  # previous call's outputs (one-behind watchdog)
 
         def run_step(state_vals, feed_tuple):
             with mesh:
                 if timeout_s is not None and pending:
-                    # Bound-wait on the PREVIOUS step so async dispatch
-                    # (host stages batch N+1 while the chip runs batch N)
-                    # survives; a hung collective surfaces at the next
-                    # step's entry — same one-step-late semantics as the
-                    # reference's NCCL watchdog thread.
+                    # Bound-wait on the PREVIOUS dispatch so async
+                    # dispatch (host stages batch N+1 while the chip runs
+                    # batch N) survives; a hung collective surfaces at
+                    # the next call's entry — same one-step-late
+                    # semantics as the reference's NCCL watchdog thread.
                     from .watchdog import wait_with_timeout
                     wait_with_timeout(
                         pending.pop(), timeout_s,

@@ -239,14 +239,16 @@ class Executor(object):
         Returns the fetches of every step, stacked on a leading axis of
         length N. Per-step semantics (dropout PRNG folding, state
         updates) are identical to N sequential ``run`` calls — pinned by
-        tests/test_executor_scan.py.
+        tests/test_executor_scan.py. Accepts a CompiledProgram: the scan
+        is then jitted over the strategy's mesh with the same state/feed
+        shardings as run() (stacked feeds gain a replicated steps axis).
         """
         from .compiler import CompiledProgram
+        strategy = None
         if isinstance(program, CompiledProgram):
-            raise ValueError(
-                "run_steps takes a plain Program; for sharded multi-step "
-                "execution jit the CompiledProgram step inside your own "
-                "scan (v1 limitation)")
+            # sharded window: same scan, jitted over the strategy's mesh
+            strategy = program
+            program = program._program
         if program is None:
             program = default_main_program()
         if getattr(program, "_pp_plan", None) is not None:
@@ -274,11 +276,15 @@ class Executor(object):
                              "stacked feeds have a leading axis of 0")
         staged = self._convert_feed(program, feed, steps_axis=True)
 
-        check_numerics = bool(getattr(program, "_check_numerics", False))
+        check_numerics = bool(
+            getattr(program, "_check_numerics", False) or
+            (strategy is not None and
+             getattr(strategy._build_strategy, "check_numerics", False)))
         state_names, uses_rng = self._prepare_state(program, staged, scope)
         key = (id(program), program._version,
                _feed_signature(staged), tuple(fetch_names),
-               tuple(state_names), check_numerics, "scan")
+               tuple(state_names), check_numerics, "scan",
+               None if strategy is None else strategy._cache_token())
         fn = self._cache.get(key) if use_program_cache else None
         if fn is None:
             base_step = self._make_step(program, sorted(staged),
@@ -294,13 +300,17 @@ class Executor(object):
                     body, state_tuple, feed_stack_tuple)
                 return ys, final_state
 
-            with warnings.catch_warnings():
-                warnings.simplefilter("ignore")  # CPU ignores donation
-                jitted = jax.jit(multi, donate_argnums=(0,))
+            if strategy is not None:
+                fn = strategy._build_multi_step(multi, state_names,
+                                                sorted(staged))
+            else:
+                with warnings.catch_warnings():
+                    warnings.simplefilter("ignore")  # CPU: no donation
+                    jitted = jax.jit(multi, donate_argnums=(0,))
 
-            def fn(state_vals, feed_tuple):
-                with self._device_ctx():
-                    return jitted(state_vals, feed_tuple)
+                def fn(state_vals, feed_tuple):
+                    with self._device_ctx():
+                        return jitted(state_vals, feed_tuple)
             if use_program_cache:
                 self._cache[key] = fn
         state_vals = tuple(scope.find_var(n) for n in state_names)

@@ -109,6 +109,65 @@ def test_run_steps_rejects_empty_window():
                           fetch_list=[loss])
 
 
+def test_run_steps_sharded_matches_sequential_compiled():
+    """CompiledProgram scan window on the dp2 x mp4 mesh: same losses and
+    final state as sequential compiled run() calls."""
+    from paddle_tpu.framework.compiler import BuildStrategy, \
+        CompiledProgram
+    from paddle_tpu.distributed import column_parallel_attr, \
+        row_parallel_attr
+
+    def build():
+        main, startup = pt.Program(), pt.Program()
+        with pt.unique_name.guard(), pt.program_guard(main, startup):
+            x = layers.data("x", [16], dtype="float32")
+            y = layers.data("y", [1], dtype="int64")
+            h = layers.fc(x, size=32, act="gelu",
+                          param_attr=column_parallel_attr(name="sw1"))
+            h2 = layers.fc(h, size=16,
+                           param_attr=row_parallel_attr(name="sw2"))
+            logits = layers.fc(h2, size=4)
+            loss = layers.mean(
+                layers.softmax_with_cross_entropy(logits, y))
+            optimizer.Adam(1e-3).minimize(loss)
+        return main, startup, loss
+
+    n = 4
+    rng = np.random.RandomState(3)
+    xs = rng.rand(n, 8, 16).astype(np.float32)
+    ys = rng.randint(0, 4, (n, 8, 1)).astype(np.int64)
+
+    results = []
+    for mode in ("seq", "scan"):
+        main, startup, loss = build()
+        bs = BuildStrategy()
+        bs.mesh_axes = {"dp": 2, "mp": 4}
+        compiled = CompiledProgram(main, bs)
+        sc = Scope()
+        with scope_guard(sc):
+            exe = pt.Executor()
+            exe.run(startup)
+            if mode == "seq":
+                losses = [float(exe.run(
+                    compiled, feed={"x": xs[i], "y": ys[i]},
+                    fetch_list=[loss])[0].reshape(-1)[0])
+                    for i in range(n)]
+            else:
+                out, = exe.run_steps(compiled, feed={"x": xs, "y": ys},
+                                     fetch_list=[loss])
+                losses = [float(v) for v in np.asarray(out).reshape(-1)]
+            state = {nm: np.asarray(v) for nm, v in sc.items()
+                     if v is not None and
+                     np.asarray(v).dtype.kind == "f"}
+        results.append((losses, state))
+
+    np.testing.assert_allclose(results[1][0], results[0][0], rtol=1e-5,
+                               atol=1e-6)
+    for nm, ref in results[0][1].items():
+        np.testing.assert_allclose(results[1][1][nm], ref, rtol=1e-5,
+                                   atol=1e-6, err_msg=nm)
+
+
 def test_run_steps_continues_prng_stream():
     """A run() after run_steps() must see the advanced dropout counter —
     the scan carries STEP_VAR exactly like sequential runs."""
