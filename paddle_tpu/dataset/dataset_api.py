@@ -4,12 +4,19 @@ Reference parity: python/paddle/fluid/dataset.py (DatasetFactory,
 InMemoryDataset, QueueDataset) + framework/data_set.cc. Backed by the
 native C++ record plane (paddle_tpu/native): InMemoryDataset loads + global
 shuffles in host RAM; QueueDataset streams through the C++ ring buffer.
+Two on-disk formats, auto-detected per file:
+  * ptrec binary records (native/dataplane.cc ring-buffer reader)
+  * MultiSlot text (native ms_parse_file — the reference
+    MultiSlotDataFeed format that incubate.data_generator emits)
 """
 import random
 
 import numpy as np
 
 from ..native.recordio import RecordReader
+from ..native.multislot import MultiSlotTextReader
+
+_PTREC_MAGIC = b"crtp"  # u32 0x70747263 little-endian on disk
 
 
 class DatasetFactory(object):
@@ -24,7 +31,9 @@ class DatasetBase(object):
         self._paths = []
         self._batch_size = 1
         self._use_vars = []
+        self._slot_dtypes = []
         self._thread = 2
+        self._format = "auto"
 
     def set_filelist(self, filelist):
         self._paths = list(filelist)
@@ -38,26 +47,100 @@ class DatasetBase(object):
     def set_use_var(self, var_list):
         self._use_vars = [v.name if hasattr(v, "name") else v
                           for v in var_list]
+        # plain string names carry no dtype — leave None so the multislot
+        # path (which must know int vs float per slot) raises instead of
+        # silently mis-parsing id slots as floats
+        self._slot_dtypes = [getattr(v, "dtype", None) for v in var_list]
 
-    def _collate(self, samples):
-        cols = list(zip(*samples))
-        return {n: np.stack(c)
-                for n, c in zip(self._use_vars, cols)}
+    def set_data_format(self, fmt):
+        """"ptrec" | "multislot_text" | "auto" (default: sniff each
+        file's leading magic bytes)."""
+        if fmt not in ("ptrec", "multislot_text", "auto"):
+            raise ValueError("unknown data format %r" % (fmt,))
+        self._format = fmt
 
+    @staticmethod
+    def _detect_format(path):
+        try:
+            with open(path, "rb") as f:
+                magic = f.read(4)
+        except OSError:
+            return "ptrec"
+        return "ptrec" if magic == _PTREC_MAGIC else "multislot_text"
 
-class QueueDataset(DatasetBase):
-    """Streaming dataset: C++ threaded readers + ring buffer."""
+    def _multislot_slots(self):
+        slots = list(zip(self._use_vars, self._slot_dtypes))
+        if not slots or any(d is None for _, d in slots):
+            raise ValueError(
+                "multislot text needs set_use_var(...) with Variable "
+                "objects (or anything carrying .name/.dtype) to declare "
+                "the slot order and int/float dtypes")
+        return slots
 
-    def __iter__(self):
-        reader = RecordReader(self._paths, num_threads=self._thread)
+    def _sample_iter(self):
+        """Per-file format detection; consecutive same-format files are
+        grouped so ptrec runs keep their threaded ring-buffer reads."""
+        if self._format == "auto":
+            fmts = [self._detect_format(p) for p in self._paths]
+        else:
+            fmts = [self._format] * len(self._paths)
+        runs = []
+        for p, f in zip(self._paths, fmts):
+            if runs and runs[-1][0] == f:
+                runs[-1][1].append(p)
+            else:
+                runs.append([f, [p]])
+        for fmt, paths in runs:
+            if fmt == "multislot_text":
+                for s in MultiSlotTextReader(
+                        paths, self._multislot_slots()).samples():
+                    yield s
+            else:
+                for s in RecordReader(
+                        paths, num_threads=self._thread).samples():
+                    yield s
+
+    def _batches(self, sample_iter):
         buf = []
-        for sample in reader.samples():
+        for sample in sample_iter:
             buf.append(sample)
             if len(buf) == self._batch_size:
                 yield self._collate(buf)
                 buf = []
         if buf:
             yield self._collate(buf)
+
+    def _collate(self, samples):
+        """Stack a batch; ragged slots (variable-length MultiSlot values)
+        are padded to the batch max and a "<name>__lens" int64 vector is
+        added — the dense+lengths encoding of the reference's LoD batch
+        (PORTING.md difference #1)."""
+        if isinstance(samples[0], dict):
+            out = {}
+            for n in samples[0]:
+                cols = [np.asarray(s[n]) for s in samples]
+                lens = [c.shape[0] for c in cols]
+                if len(set(lens)) == 1:
+                    out[n] = np.stack(cols)
+                    continue
+                width = max(lens)
+                padded = np.zeros((len(cols), width), cols[0].dtype)
+                for i, c in enumerate(cols):
+                    padded[i, :c.shape[0]] = c
+                out[n] = padded
+                out[n + "__lens"] = np.asarray(lens, np.int64)
+            return out
+        cols = list(zip(*samples))
+        return {n: np.stack(c)
+                for n, c in zip(self._use_vars, cols)}
+
+
+class QueueDataset(DatasetBase):
+    """Streaming dataset: C++ threaded readers + ring buffer (ptrec) or
+    the native MultiSlot text parser."""
+
+    def __iter__(self):
+        return self._batches(self._sample_iter())
 
 
 class InMemoryDataset(DatasetBase):
@@ -70,8 +153,7 @@ class InMemoryDataset(DatasetBase):
         self._seed = 0
 
     def load_into_memory(self):
-        reader = RecordReader(self._paths, num_threads=self._thread)
-        self._samples = list(reader.samples())
+        self._samples = list(self._sample_iter())
 
     def local_shuffle(self):
         random.Random(self._seed).shuffle(self._samples)
@@ -90,11 +172,4 @@ class InMemoryDataset(DatasetBase):
         self._samples = []
 
     def __iter__(self):
-        buf = []
-        for sample in self._samples:
-            buf.append(sample)
-            if len(buf) == self._batch_size:
-                yield self._collate(buf)
-                buf = []
-        if buf:
-            yield self._collate(buf)
+        return self._batches(iter(self._samples))

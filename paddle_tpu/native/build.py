@@ -45,6 +45,11 @@ def _build():
     lib.dp_writer_write.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                     ctypes.c_int64]
     lib.dp_writer_close.argtypes = [ctypes.c_void_p]
+    lib.ms_parse_file.restype = ctypes.POINTER(ctypes.c_char)
+    lib.ms_parse_file.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.POINTER(ctypes.c_ubyte),
+        ctypes.POINTER(ctypes.c_uint64)]
+    lib.ms_last_error.restype = ctypes.c_char_p
     return lib
 
 

@@ -185,6 +185,98 @@ struct Writer {
   FILE* f;
 };
 
+// ---------------------------------------------------------------------------
+// MultiSlot text parser (reference framework/data_feed.cc MultiSlotDataFeed).
+// One sample per line; per slot: "<count> v0 v1 ... v(count-1)", groups
+// space-separated in slot order — the exact text format our
+// incubate.data_generator emits and the reference's pipe_command feeds.
+// Packed output layout (host-endian):
+//   u64 n_samples
+//   per sample, per slot: u32 count; count values (f32 if is_float else i64)
+// ---------------------------------------------------------------------------
+
+thread_local std::string g_ms_error;
+
+// malloc-backed growable buffer: the parse result is handed to the caller
+// as-is (ownership transfer, freed via dp_free) — no final copy.
+struct Buf {
+  char* p = nullptr;
+  size_t len = 0, cap = 0;
+
+  bool Append(const void* src, size_t n) {
+    if (len + n > cap) {
+      size_t want = cap ? cap * 2 : 4096;
+      while (want < len + n) want *= 2;
+      char* np = static_cast<char*>(std::realloc(p, want));
+      if (!np) return false;
+      p = np;
+      cap = want;
+    }
+    std::memcpy(p + len, src, n);
+    len += n;
+    return true;
+  }
+};
+
+template <typename T>
+bool AppendRaw(Buf* out, T v) {
+  return out->Append(&v, sizeof(T));
+}
+
+bool ParseLine(char* line, size_t line_no, const char* path, int n_slots,
+               const unsigned char* is_float, Buf* out) {
+  char* p = line;
+  for (int s = 0; s < n_slots; ++s) {
+    char* endp = nullptr;
+    long long count = std::strtoll(p, &endp, 10);
+    if (endp == p || count < 0 || count > (1ll << 31)) {
+      g_ms_error = std::string(path) + ":" + std::to_string(line_no) +
+                   ": bad slot count for slot " + std::to_string(s);
+      return false;
+    }
+    p = endp;
+    if (!AppendRaw(out, static_cast<uint32_t>(count))) {
+      g_ms_error = "out of memory";
+      return false;
+    }
+    for (long long i = 0; i < count; ++i) {
+      if (is_float[s]) {
+        float v = std::strtof(p, &endp);
+        if (endp == p) {
+          g_ms_error = std::string(path) + ":" + std::to_string(line_no) +
+                       ": slot " + std::to_string(s) + " expects " +
+                       std::to_string(count) + " float values";
+          return false;
+        }
+        if (!AppendRaw(out, v)) {
+          g_ms_error = "out of memory";
+          return false;
+        }
+      } else {
+        long long v = std::strtoll(p, &endp, 10);
+        if (endp == p) {
+          g_ms_error = std::string(path) + ":" + std::to_string(line_no) +
+                       ": slot " + std::to_string(s) + " expects " +
+                       std::to_string(count) + " int values";
+          return false;
+        }
+        if (!AppendRaw(out, static_cast<int64_t>(v))) {
+          g_ms_error = "out of memory";
+          return false;
+        }
+      }
+      p = endp;
+    }
+  }
+  while (*p == ' ' || *p == '\t' || *p == '\r') ++p;
+  if (*p != '\0') {
+    g_ms_error = std::string(path) + ":" + std::to_string(line_no) +
+                 ": trailing data after the last slot";
+    return false;
+  }
+  return true;
+}
+
 }  // namespace
 
 extern "C" {
@@ -229,5 +321,64 @@ void dp_writer_close(void* vw) {
   std::fclose(w->f);
   delete w;
 }
+
+char* ms_parse_file(const char* path, int n_slots,
+                    const unsigned char* is_float, uint64_t* out_len) {
+  g_ms_error.clear();
+  FILE* f = std::fopen(path, "rb");
+  if (!f) {
+    g_ms_error = std::string("cannot open ") + path;
+    return nullptr;
+  }
+  // seekable regular files only: pipes/FIFOs report ftell failure
+  long sz = -1;
+  if (std::fseek(f, 0, SEEK_END) == 0) sz = std::ftell(f);
+  if (sz < 0 || std::fseek(f, 0, SEEK_SET) != 0) {
+    std::fclose(f);
+    g_ms_error = std::string(path) +
+                 ": not a seekable regular file (pipe/FIFO?)";
+    return nullptr;
+  }
+  std::vector<char> text(static_cast<size_t>(sz) + 1);
+  if (sz > 0 && std::fread(text.data(), 1, sz, f) != static_cast<size_t>(sz)) {
+    std::fclose(f);
+    g_ms_error = std::string("short read on ") + path;
+    return nullptr;
+  }
+  std::fclose(f);
+  text[sz] = '\0';
+
+  Buf out;
+  uint64_t n_samples = 0;
+  if (!out.Append(&n_samples, 8)) {  // patched at the end
+    g_ms_error = "out of memory";
+    return nullptr;
+  }
+  char* p = text.data();
+  char* end = text.data() + sz;
+  size_t line_no = 0;
+  while (p < end) {
+    char* nl = static_cast<char*>(std::memchr(p, '\n', end - p));
+    char* line_end = nl ? nl : end;
+    *line_end = '\0';
+    ++line_no;
+    bool blank = true;
+    for (char* q = p; *q; ++q)
+      if (*q != ' ' && *q != '\t' && *q != '\r') { blank = false; break; }
+    if (!blank) {
+      if (!ParseLine(p, line_no, path, n_slots, is_float, &out)) {
+        std::free(out.p);
+        return nullptr;
+      }
+      ++n_samples;
+    }
+    p = line_end + 1;
+  }
+  std::memcpy(out.p, &n_samples, 8);
+  *out_len = out.len;
+  return out.p;  // ownership transfers to the caller (dp_free)
+}
+
+const char* ms_last_error() { return g_ms_error.c_str(); }
 
 }  // extern "C"
