@@ -1,0 +1,22 @@
+"""paddle.batch parity (ref python/paddle/batch.py).
+
+The implementation lives in reader.decorator; this module re-exports it
+under the reference's module path. Because `import paddle_tpu.batch`
+rebinds the package attribute `paddle_tpu.batch` from the function to
+this module (the same footgun the reference had), the module itself is
+made callable and delegates to the function — both spellings work.
+"""
+import sys
+import types
+
+from .reader.decorator import batch
+
+__all__ = ["batch"]
+
+
+class _CallableModule(types.ModuleType):
+    def __call__(self, *args, **kwargs):
+        return batch(*args, **kwargs)
+
+
+sys.modules[__name__].__class__ = _CallableModule

@@ -1,0 +1,18 @@
+"""paddle.check_import_scipy parity (ref python/paddle/
+check_import_scipy.py): Windows-only scipy DLL sanity probe."""
+
+__all__ = ["check_import_scipy"]
+
+
+def check_import_scipy(OsName):
+    """On Windows ('nt') verify scipy.io imports, surfacing the usual
+    missing-VC++-runtime cause; a no-op elsewhere (TPU hosts are Linux)."""
+    if OsName != "nt":
+        return
+    try:
+        import scipy.io  # noqa: F401
+    except ImportError as e:
+        raise ImportError(
+            str(e) + "\nscipy.io failed to import on Windows — usually a "
+            "missing Visual C++ runtime; install the MSVC redistributable "
+            "and retry")

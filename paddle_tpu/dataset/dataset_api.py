@@ -98,6 +98,11 @@ class DatasetBase(object):
             else:
                 for s in RecordReader(
                         paths, num_threads=self._thread).samples():
+                    # normalize to dicts when slot names are declared so
+                    # a batch spanning a ptrec/text boundary collates
+                    # uniformly
+                    if self._use_vars and not isinstance(s, dict):
+                        s = dict(zip(self._use_vars, s))
                     yield s
 
     def _batches(self, sample_iter):

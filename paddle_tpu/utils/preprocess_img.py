@@ -1,0 +1,64 @@
+"""Image-classification corpus builder.
+
+Reference parity: python/paddle/utils/preprocess_img.py — resize images,
+walk a class-per-directory corpus, and emit the block files
+preprocess_util's DataBatcher defines.
+"""
+import os
+
+import numpy as np
+
+from . import preprocess_util
+from .preprocess_util import Dataset, list_images
+
+__all__ = ["resize_image", "DiskImage", "ImageClassificationDatasetCreater"]
+
+
+def resize_image(img, target_size):
+    """Resize a PIL image so its SHORT side equals target_size (aspect
+    preserved) — the classification-pipeline convention."""
+    w, h = img.size
+    if w < h:
+        nw, nh = target_size, max(1, int(round(h * target_size / w)))
+    else:
+        nw, nh = max(1, int(round(w * target_size / h))), target_size
+    return img.resize((nw, nh))
+
+
+class DiskImage(object):
+    """A lazily-loaded image file + its label."""
+
+    def __init__(self, path, target_size):
+        self.path = path
+        self.target_size = target_size
+
+    def read_image(self):
+        from PIL import Image
+        with Image.open(self.path) as img:
+            img = img.convert("RGB")
+            img = resize_image(img, self.target_size)
+            return np.asarray(img, np.uint8)
+
+
+class ImageClassificationDatasetCreater(preprocess_util.DatasetCreater):
+    """Build block files from train/ and test/ class-per-subdir trees of
+    images (each sample = (HWC uint8 array, int label))."""
+
+    def __init__(self, data_path, target_size=32, color=True):
+        super(ImageClassificationDatasetCreater, self).__init__(data_path)
+        self.target_size = target_size
+        self.color = color
+        self.keys = ["image", "label"]
+
+    def create_dataset_from_dir(self, path):
+        labels = preprocess_util.get_label_set_from_dir(path)
+        data = []
+        for cls, label in sorted(labels.items()):
+            cls_dir = os.path.join(path, cls)
+            for fname in list_images(cls_dir):
+                img = DiskImage(os.path.join(cls_dir, fname),
+                                self.target_size).read_image()
+                if not self.color:
+                    img = img.mean(axis=2).astype(np.uint8)
+                data.append((img, label))
+        return Dataset(data, self.keys)

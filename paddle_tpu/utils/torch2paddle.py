@@ -1,0 +1,81 @@
+"""Import torch model weights into paddle_tpu parameters.
+
+Reference parity: python/paddle/utils/torch2paddle.py — the reference
+converted (lua-)torch model files into Paddle parameter files. The
+capability, modernized: map a pytorch ``state_dict`` onto the parameters
+of a Program's scope, with the layout transposes the two conventions
+need (torch nn.Linear stores (out, in); fluid fc stores (in, out)).
+"""
+import numpy as np
+
+__all__ = ["torch_state_dict_to_numpy", "load_torch_parameters",
+           "save_net_parameters"]
+
+
+def torch_state_dict_to_numpy(state_dict):
+    """{name: np.ndarray} from a pytorch state_dict (tensors detached
+    and moved to host)."""
+    out = {}
+    for k, v in state_dict.items():
+        if hasattr(v, "detach"):
+            v = v.detach().cpu().numpy()
+        out[k] = np.asarray(v)
+    return out
+
+
+def load_torch_parameters(scope, state_dict, name_map,
+                          transpose_linear=True, transpose_names=None):
+    """Copy torch weights into ``scope``.
+
+    name_map: {torch_param_name: paddle_var_name}. Rectangular linear/fc
+    weights are transposed automatically ((out,in) -> (in,out)) when
+    that is what makes the shapes agree; conv weights share the OIHW
+    layout and pass through. SQUARE 2-D weights are ambiguous — both
+    orientations fit — so they must be named in ``transpose_names``
+    (transpose) or omitted from it (copy as-is) explicitly, otherwise
+    this raises rather than guess. Returns the paddle names written.
+    """
+    arrays = torch_state_dict_to_numpy(state_dict)
+    transpose_names = set(transpose_names or ())
+    written = []
+    for tname, pname in name_map.items():
+        if tname not in arrays:
+            raise KeyError("torch state_dict has no %r (have: %s...)"
+                           % (tname, ", ".join(list(arrays)[:5])))
+        arr = arrays[tname]
+        existing = scope.find_var(pname)
+        if arr.ndim == 2:
+            square = arr.shape[0] == arr.shape[1]
+            if tname in transpose_names:
+                arr = arr.T
+            elif square and transpose_linear and existing is not None \
+                    and tuple(np.shape(existing)) == arr.shape:
+                raise ValueError(
+                    "square weight %r -> %r is orientation-ambiguous: "
+                    "list it in transpose_names to transpose (torch "
+                    "nn.Linear) or pass transpose_linear=False to copy "
+                    "as-is (embeddings etc.)" % (tname, pname))
+            elif transpose_linear and existing is not None \
+                    and tuple(np.shape(existing)) == arr.T.shape \
+                    and tuple(np.shape(existing)) != arr.shape:
+                arr = arr.T
+        if existing is not None and tuple(np.shape(existing)) != arr.shape:
+            raise ValueError(
+                "shape mismatch importing %r -> %r: torch %s vs paddle %s"
+                % (tname, pname, arr.shape, tuple(np.shape(existing))))
+        scope.set_var(pname, arr)
+        written.append(pname)
+    return written
+
+
+def save_net_parameters(state_dict, name_map, output_path):
+    """Convert a torch state_dict straight to a saved parameter dir
+    loadable by paddle_tpu.io.load_params (ref save_net_parameters)."""
+    arrays = torch_state_dict_to_numpy(state_dict)
+    missing = [t for t in name_map if t not in arrays]
+    if missing:
+        raise KeyError("torch state_dict has no %r" % (missing[0],))
+    np.savez(output_path if output_path.endswith(".npz")
+             else output_path + ".npz",
+             **{p: arrays[t] for t, p in name_map.items()})
+    return sorted(name_map.values())

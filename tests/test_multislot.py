@@ -118,6 +118,12 @@ def test_dataset_mixed_format_filelist(tmp_path):
     np.testing.assert_array_equal(batches[0]["ids"], [[1, 2]])
     np.testing.assert_array_equal(batches[1]["ids"], [[3, 4]])
 
+    # one batch SPANNING the ptrec/text boundary must collate uniformly
+    ds.set_batch_size(2)
+    batch, = list(iter(ds))
+    np.testing.assert_array_equal(batch["ids"], [[1, 2], [3, 4]])
+    np.testing.assert_array_equal(batch["label"], [[7], [8]])
+
 
 def test_dataset_multislot_requires_dtypes(tmp_path):
     path = _write(tmp_path, "x.txt", ["1 5 1 1"])

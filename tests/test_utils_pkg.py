@@ -1,0 +1,141 @@
+"""paddle.utils tail parity: batch, preprocess_util/_img, plotcurve,
+show_pb, torch2paddle, check_import_scipy."""
+import io as _io
+import json
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+def test_batch_decorator():
+    import paddle_tpu.batch   # rebinds pt.batch to the module...
+    from paddle_tpu.batch import batch
+
+    def reader():
+        return iter(range(7))
+
+    assert [len(b) for b in batch(reader, 3)()] == [3, 3, 1]
+    assert [len(b) for b in batch(reader, 3, drop_last=True)()] == [3, 3]
+    # ...but the module is callable, so the paddle.batch(...) spelling
+    # keeps working after the submodule import
+    assert [len(b) for b in pt.batch(reader, 4)()] == [4, 3]
+
+
+def test_check_import_scipy_noop_on_posix():
+    from paddle_tpu.check_import_scipy import check_import_scipy
+    check_import_scipy("posix")    # must not raise
+
+
+def test_preprocess_util_corpus(tmp_path):
+    from paddle_tpu.utils import preprocess_util as pu
+    for split in ("train", "test"):
+        for cls in ("cat", "dog"):
+            d = tmp_path / split / cls
+            d.mkdir(parents=True)
+            for i in range(3):
+                (d / ("img%d.txt" % i)).write_text("x")
+    labels = pu.get_label_set_from_dir(str(tmp_path / "train"))
+    assert labels == {"cat": 0, "dog": 1}
+    assert pu.list_files(str(tmp_path / "train" / "cat")) == [
+        "img0.txt", "img1.txt", "img2.txt"]
+
+    ds = pu.Dataset([("a", 0), ("b", 1), ("c", 0)], ["data", "label"])
+    assert ds.check_valid() and len(ds) == 3
+    ds.permute(seed=1)
+
+    class Creater(pu.DatasetCreater):
+        def create_dataset_from_dir(self, path):
+            samples = [(f, lbl)
+                       for cls, lbl in pu.get_label_set_from_dir(
+                           path).items()
+                       for f in pu.list_files(
+                           path + "/" + cls)]
+            return pu.Dataset(samples, ["file", "label"])
+
+    c = Creater(str(tmp_path))
+    out = c.create_batches()
+    import os
+    assert os.path.exists(os.path.join(out, "train.list"))
+    assert os.path.exists(os.path.join(out, "labels.pkl"))
+
+
+def test_preprocess_img_resize():
+    PIL = pytest.importorskip("PIL")
+    from PIL import Image
+    from paddle_tpu.utils.preprocess_img import resize_image
+    img = Image.new("RGB", (100, 50))
+    out = resize_image(img, 32)
+    assert out.size == (64, 32)      # short side = 32, aspect kept
+
+
+def test_plotcurve_extract():
+    from paddle_tpu.utils.plotcurve import extract_curve
+    log = [
+        "step 10: loss=[0.9] acc=[0.4]",
+        "step 20: loss=[0.5] acc=[0.6]",
+        "AvgCost=0.33",
+    ]
+    curves = extract_curve(["loss", "AvgCost"], log)
+    assert curves["loss"] == [0.9, 0.5]
+    assert curves["AvgCost"] == [0.33]
+
+
+def test_show_pb_summarizes_program(tmp_path, capsys):
+    from paddle_tpu.utils import show_pb
+    from paddle_tpu import layers
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [4], "float32")
+        layers.fc(x, size=2)
+    p = tmp_path / "prog.json"
+    p.write_text(main.to_json())
+    buf = _io.StringIO()
+    show_pb.show(str(p), out=buf)
+    text = buf.getvalue()
+    assert "Program:" in text and "fc" in text or "mul" in text
+    with pytest.raises(NotImplementedError, match="JSON"):
+        show_pb.read_proto(None)
+
+
+def test_torch2paddle_linear_roundtrip():
+    torch = pytest.importorskip("torch")
+    from paddle_tpu.utils.torch2paddle import load_torch_parameters
+    from paddle_tpu.framework.scope import Scope, scope_guard
+    from paddle_tpu import layers, optimizer
+
+    tlin = torch.nn.Linear(4, 3)
+    main, startup = pt.Program(), pt.Program()
+    with pt.unique_name.guard(), pt.program_guard(main, startup):
+        x = layers.data("x", [4], "float32")
+        y = layers.fc(x, size=3, param_attr=pt.ParamAttr(name="fc_w"),
+                      bias_attr=pt.ParamAttr(name="fc_b"))
+    sc = Scope()
+    with scope_guard(sc):
+        exe = pt.Executor()
+        exe.run(startup)
+        load_torch_parameters(
+            sc, tlin.state_dict(),
+            {"weight": "fc_w", "bias": "fc_b"})
+        xv = np.random.RandomState(0).randn(2, 4).astype(np.float32)
+        got, = exe.run(main, feed={"x": xv}, fetch_list=[y])
+    want = tlin(torch.from_numpy(xv)).detach().numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_torch2paddle_square_weight_requires_explicit_choice():
+    torch = pytest.importorskip("torch")
+    from paddle_tpu.utils.torch2paddle import load_torch_parameters
+    from paddle_tpu.framework.scope import Scope
+
+    tlin = torch.nn.Linear(3, 3)
+    sc = Scope()
+    sc.set_var("w", np.zeros((3, 3), np.float32))
+    with pytest.raises(ValueError, match="ambiguous"):
+        load_torch_parameters(sc, tlin.state_dict(), {"weight": "w"})
+    load_torch_parameters(sc, tlin.state_dict(), {"weight": "w"},
+                          transpose_names={"weight"})
+    np.testing.assert_allclose(
+        np.asarray(sc.find_var("w")),
+        tlin.weight.detach().numpy().T)
