@@ -477,6 +477,59 @@ def pallas_selfcheck():
                        "ok": all(c["ok"] for c in worst.values())})
 
 
+def bench_longseq_attention():
+    """Long-context attention throughput: the Pallas flash kernel vs the
+    XLA fused reference at T=4096 bf16, fwd+bwd (grad wrt q,k,v). The
+    flash path never materializes the (T,T) scores in HBM — this section
+    is the single-chip evidence for the long-sequence story (SURVEY
+    §2.7's ring/Ulysses paths shard the same kernel over a mesh)."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.pallas import flash_attention as fa
+
+    on_tpu = _on_tpu()
+    if on_tpu:
+        b, h, t, d, steps = 4, 12, 4096, 64, 8
+    else:
+        b, h, t, d, steps = 1, 2, 256, 32, 2
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(b, h, t, d), jnp.bfloat16)
+    k = jnp.asarray(rng.randn(b, h, t, d), jnp.bfloat16)
+    v = jnp.asarray(rng.randn(b, h, t, d), jnp.bfloat16)
+    scale = 1.0 / np.sqrt(d)
+    interp = not on_tpu
+
+    def timed(loss_fn):
+        g = jax.jit(jax.grad(loss_fn, argnums=(0, 1, 2)))
+        out = g(q, k, v)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            out = g(q, k, v)
+        jax.block_until_ready(out)
+        return b * t * steps / (time.perf_counter() - t0)
+
+    def flash_loss(q, k, v):
+        o = fa.flash_attention(q, k, v, scale=scale, causal=True,
+                               interpret=interp)
+        return jnp.sum(o.astype(jnp.float32))
+
+    def xla_loss(q, k, v):
+        o = fa._xla_attention(q, k, v, None, scale, True)
+        return jnp.sum(o.astype(jnp.float32))
+
+    line = {"metric": "flash-attention T=%d bf16 fwd+bwd tokens/sec" % t,
+            "unit": "tokens/sec/chip"}
+    line["value"] = round(timed(flash_loss), 1)
+    try:
+        xla_tps = timed(xla_loss)
+        line["xla_tokens_per_sec"] = round(xla_tps, 1)
+        line["speedup_vs_xla"] = round(line["value"] / xla_tps, 3)
+    except Exception as e:  # XLA OOMs on the (T,T) buffers first
+        line["xla_tokens_per_sec"] = "failed: %r" % (e,)
+    return json.dumps(line)
+
+
 def run_all():
     deadline = _arm_deadline()
     try:
@@ -510,6 +563,7 @@ def run_all():
     # transformer/deepfm can only drop optional lines
     for name, fn in (("resnet", bench_resnet), ("ernie2", bench_ernie2),
                      ("pallas_check", pallas_selfcheck),
+                     ("longseq", bench_longseq_attention),
                      ("transformer", bench_transformer),
                      ("deepfm", bench_deepfm)):
         _STATE["stage"] = name
@@ -579,6 +633,8 @@ if __name__ == "__main__":
         print(bench_ernie2())
     elif len(sys.argv) > 1 and sys.argv[1] == "pallas":
         print(pallas_selfcheck())
+    elif len(sys.argv) > 1 and sys.argv[1] == "longseq":
+        print(bench_longseq_attention())
     elif len(sys.argv) > 1 and sys.argv[1] == "transformer":
         print(bench_transformer())
     elif len(sys.argv) > 1 and sys.argv[1] == "deepfm":

@@ -9,6 +9,7 @@ import os
 import numpy as np
 
 from . import preprocess_util
+from .image_util import resize_image as _resize_short_np
 from .preprocess_util import Dataset, list_images
 
 __all__ = ["resize_image", "DiskImage", "ImageClassificationDatasetCreater"]
@@ -16,13 +17,10 @@ __all__ = ["resize_image", "DiskImage", "ImageClassificationDatasetCreater"]
 
 def resize_image(img, target_size):
     """Resize a PIL image so its SHORT side equals target_size (aspect
-    preserved) — the classification-pipeline convention."""
-    w, h = img.size
-    if w < h:
-        nw, nh = target_size, max(1, int(round(h * target_size / w)))
-    else:
-        nw, nh = max(1, int(round(w * target_size / h))), target_size
-    return img.resize((nw, nh))
+    preserved). One implementation package-wide: delegates to
+    image_util.resize_image / dataset.image.resize_short."""
+    from PIL import Image
+    return Image.fromarray(_resize_short_np(img, target_size))
 
 
 class DiskImage(object):
@@ -36,8 +34,8 @@ class DiskImage(object):
         from PIL import Image
         with Image.open(self.path) as img:
             img = img.convert("RGB")
-            img = resize_image(img, self.target_size)
-            return np.asarray(img, np.uint8)
+            return np.asarray(_resize_short_np(img, self.target_size),
+                              np.uint8)
 
 
 class ImageClassificationDatasetCreater(preprocess_util.DatasetCreater):
@@ -50,15 +48,23 @@ class ImageClassificationDatasetCreater(preprocess_util.DatasetCreater):
         self.color = color
         self.keys = ["image", "label"]
 
-    def create_dataset_from_dir(self, path):
-        labels = preprocess_util.get_label_set_from_dir(path)
+    def create_dataset_from_dir(self, path, label_set=None):
+        # label_set comes from the TRAIN split (DatasetCreater.
+        # create_batches) so test labels can't silently renumber when a
+        # class is missing from test/
+        labels = (label_set if label_set is not None
+                  else preprocess_util.get_label_set_from_dir(path))
         data = []
-        for cls, label in sorted(labels.items()):
+        for cls in preprocess_util.list_dirs(path):
+            if cls not in labels:
+                raise ValueError(
+                    "class directory %r in %s is absent from the train "
+                    "label set %r" % (cls, path, sorted(labels)))
             cls_dir = os.path.join(path, cls)
             for fname in list_images(cls_dir):
                 img = DiskImage(os.path.join(cls_dir, fname),
                                 self.target_size).read_image()
                 if not self.color:
                     img = img.mean(axis=2).astype(np.uint8)
-                data.append((img, label))
+                data.append((img, labels[cls]))
         return Dataset(data, self.keys)

@@ -155,7 +155,10 @@ class DatasetCreater(object):
         self.num_per_batch = 1024
         self.overwrite = False
 
-    def create_dataset_from_dir(self, path):
+    def create_dataset_from_dir(self, path, label_set=None):
+        """Build a Dataset from one split directory. ``label_set`` is
+        the train-split {class: label} mapping — use it (when given) so
+        every split numbers classes identically."""
         raise NotImplementedError(
             "subclass DatasetCreater and build a Dataset from %r" % path)
 
@@ -166,9 +169,9 @@ class DatasetCreater(object):
         if os.path.exists(out_path) and not self.overwrite:
             return out_path
         os.makedirs(out_path, exist_ok=True)
-        train = self.create_dataset_from_dir(train_path)
-        test = self.create_dataset_from_dir(test_path)
         label_set = get_label_set_from_dir(train_path)
+        train = self.create_dataset_from_dir(train_path, label_set)
+        test = self.create_dataset_from_dir(test_path, label_set)
         batcher = DataBatcher(train, test, label_set)
         batcher.num_per_batch = self.num_per_batch
         batcher.create_batches_and_list(out_path, self.train_list_name,

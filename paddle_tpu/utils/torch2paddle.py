@@ -44,22 +44,27 @@ def load_torch_parameters(scope, state_dict, name_map,
                            % (tname, ", ".join(list(arrays)[:5])))
         arr = arrays[tname]
         existing = scope.find_var(pname)
+        if existing is None:
+            raise KeyError(
+                "scope has no variable %r to receive %r — run the "
+                "startup program (parameter init) first so shapes are "
+                "known for orientation checks" % (pname, tname))
         if arr.ndim == 2:
             square = arr.shape[0] == arr.shape[1]
             if tname in transpose_names:
                 arr = arr.T
-            elif square and transpose_linear and existing is not None \
+            elif square and transpose_linear \
                     and tuple(np.shape(existing)) == arr.shape:
                 raise ValueError(
                     "square weight %r -> %r is orientation-ambiguous: "
                     "list it in transpose_names to transpose (torch "
                     "nn.Linear) or pass transpose_linear=False to copy "
                     "as-is (embeddings etc.)" % (tname, pname))
-            elif transpose_linear and existing is not None \
+            elif transpose_linear \
                     and tuple(np.shape(existing)) == arr.T.shape \
                     and tuple(np.shape(existing)) != arr.shape:
                 arr = arr.T
-        if existing is not None and tuple(np.shape(existing)) != arr.shape:
+        if tuple(np.shape(existing)) != arr.shape:
             raise ValueError(
                 "shape mismatch importing %r -> %r: torch %s vs paddle %s"
                 % (tname, pname, arr.shape, tuple(np.shape(existing))))
@@ -68,14 +73,24 @@ def load_torch_parameters(scope, state_dict, name_map,
     return written
 
 
-def save_net_parameters(state_dict, name_map, output_path):
-    """Convert a torch state_dict straight to a saved parameter dir
-    loadable by paddle_tpu.io.load_params (ref save_net_parameters)."""
+def save_net_parameters(state_dict, name_map, output_dir,
+                        transpose_names=None):
+    """Convert a torch state_dict to a parameter DIRECTORY loadable by
+    ``paddle_tpu.io.load_params(exe, output_dir)`` (ref
+    save_net_parameters): writes ``<output_dir>/params.npz``. 2-D
+    weights named in ``transpose_names`` are transposed ((out,in) ->
+    (in,out) for torch nn.Linear); with no target shapes available at
+    save time the transpose set must be explicit."""
+    import os
     arrays = torch_state_dict_to_numpy(state_dict)
     missing = [t for t in name_map if t not in arrays]
     if missing:
         raise KeyError("torch state_dict has no %r" % (missing[0],))
-    np.savez(output_path if output_path.endswith(".npz")
-             else output_path + ".npz",
-             **{p: arrays[t] for t, p in name_map.items()})
+    transpose_names = set(transpose_names or ())
+    out = {}
+    for t, p in name_map.items():
+        arr = arrays[t]
+        out[p] = arr.T if t in transpose_names and arr.ndim == 2 else arr
+    os.makedirs(output_dir, exist_ok=True)
+    np.savez(os.path.join(output_dir, "params.npz"), **out)
     return sorted(name_map.values())
