@@ -683,7 +683,9 @@ def image_resize(input, out_shape=None, scale=None, name=None,
     helper.append_op(op, inputs={"X": [input.name]},
                      outputs={"Out": [out.name]},
                      attrs={"out_h": int(out_shape[0]),
-                            "out_w": int(out_shape[1])})
+                            "out_w": int(out_shape[1]),
+                            "align_corners": bool(align_corners),
+                            "align_mode": int(align_mode)})
     return out
 
 
@@ -692,7 +694,8 @@ resize_bilinear = image_resize
 
 def resize_nearest(input, out_shape=None, scale=None, name=None,
                    actual_shape=None, align_corners=True):
-    return image_resize(input, out_shape, scale, name, "NEAREST")
+    return image_resize(input, out_shape, scale, name, "NEAREST",
+                        align_corners=align_corners)
 
 
 # ---------------------------------------------------------------------------

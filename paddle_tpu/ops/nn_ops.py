@@ -535,20 +535,66 @@ def _pad2d(ctx, ins, attrs):
     return {"Out": jnp.pad(x, cfg, mode=jmode)}
 
 
+def _interp_src(out_size, in_size, align_corners, align_mode):
+    """Source sampling coordinates for one axis — the reference's three
+    conventions (interpolate_op.h:80-163): align_corners uses the
+    (in-1)/(out-1) corner-pinned ratio; otherwise ratio=in/out with
+    align_mode 0 = half-pixel centers, align_mode 1 = src = ratio*dst."""
+    i = jnp.arange(out_size, dtype=jnp.float32)
+    if align_corners:
+        return i * ((in_size - 1) / max(out_size - 1, 1))
+    ratio = in_size / out_size
+    if align_mode == 0:
+        return jnp.clip((i + 0.5) * ratio - 0.5, 0.0, in_size - 1.0)
+    return i * ratio
+
+
+def _lin_axis(x, out_size, axis, align_corners, align_mode):
+    in_size = x.shape[axis]
+    src = _interp_src(out_size, in_size, align_corners, align_mode)
+    lo = jnp.floor(src).astype(jnp.int32)
+    lo = jnp.clip(lo, 0, in_size - 1)
+    hi = jnp.minimum(lo + 1, in_size - 1)
+    # interpolate in float regardless of input dtype (an integer x would
+    # truncate the fractions to pure floor-sampling); cast back at the end
+    ft = x.dtype if jnp.issubdtype(x.dtype, jnp.floating) else jnp.float32
+    d = (src - lo).astype(ft)
+    shape = [1] * x.ndim
+    shape[axis] = out_size
+    d = d.reshape(shape)
+    out = (jnp.take(x, lo, axis=axis).astype(ft) * (1 - d) +
+           jnp.take(x, hi, axis=axis).astype(ft) * d)
+    return out.astype(x.dtype)
+
+
 @register_op("interp_nearest", nondiff=())
 def _interp_nearest(ctx, ins, attrs):
     x = _x(ins)
     oh, ow = attrs["out_h"], attrs["out_w"]
-    return {"Out": jax.image.resize(
-        x, (x.shape[0], x.shape[1], oh, ow), method="nearest")}
+    ac = attrs.get("align_corners", True)
+    out = x
+    for axis, osz in ((2, oh), (3, ow)):
+        in_size = out.shape[axis]
+        if ac:
+            # reference: src = int(ratio*dst + 0.5), corner-pinned ratio
+            idx = jnp.floor(_interp_src(osz, in_size, True, 1)
+                            + 0.5).astype(jnp.int32)
+        else:
+            idx = jnp.floor(_interp_src(osz, in_size, False, 1)
+                            ).astype(jnp.int32)
+        out = jnp.take(out, jnp.clip(idx, 0, in_size - 1), axis=axis)
+    return {"Out": out}
 
 
 @register_op("interp_bilinear", nondiff=())
 def _interp_bilinear(ctx, ins, attrs):
     x = _x(ins)
     oh, ow = attrs["out_h"], attrs["out_w"]
-    return {"Out": jax.image.resize(
-        x, (x.shape[0], x.shape[1], oh, ow), method="bilinear")}
+    ac = attrs.get("align_corners", True)
+    am = attrs.get("align_mode", 1)
+    out = _lin_axis(x, oh, 2, ac, am)
+    out = _lin_axis(out, ow, 3, ac, am)
+    return {"Out": out}
 
 
 @register_op("add_position_encoding")

@@ -18,7 +18,10 @@ __all__ = ["resize_image", "DiskImage", "ImageClassificationDatasetCreater"]
 def resize_image(img, target_size):
     """Resize a PIL image so its SHORT side equals target_size (aspect
     preserved). One implementation package-wide: delegates to
-    image_util.resize_image / dataset.image.resize_short."""
+    image_util.resize_image / dataset.image.resize_short — note this
+    uses that path's floor-division long-side rounding and BILINEAR
+    filter (not PIL's round()/BICUBIC), so regenerated corpora may
+    differ from pre-consolidation ones by one pixel on the long side."""
     from PIL import Image
     return Image.fromarray(_resize_short_np(img, target_size))
 

@@ -1,0 +1,304 @@
+"""OpTest-style numeric sweep for the op tail (reference
+tests/unittests/test_activation_op.py etc.): forward values vs
+numpy/torch oracles through the PUBLIC layers API, plus grad spot
+checks. Covers ops that had no dedicated test of their own."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+
+
+def _run(build, feeds):
+    """Build a program around `build(vars...)` and run it once."""
+    main, startup = pt.Program(), pt.Program()
+    with pt.unique_name.guard(), pt.program_guard(main, startup):
+        vars_ = {
+            n: layers.data(n, list(a.shape), str(a.dtype),
+                           append_batch_size=False)
+            for n, a in feeds.items()}
+        out = build(vars_)
+    exe = pt.Executor()
+    exe.run(startup)
+    res, = exe.run(main, feed=feeds, fetch_list=[out])
+    return np.asarray(res)
+
+
+def _x(shape=(3, 4), seed=0, pos=False, lo=-2.0, hi=2.0):
+    rng = np.random.RandomState(seed)
+    a = rng.uniform(lo, hi, shape).astype(np.float32)
+    return np.abs(a) + 0.1 if pos else a
+
+
+# (layer name, feed builder, oracle) — names missing from layers are
+# skipped (op exists only as an internal kernel).
+def _sp(x):  # numpy softplus
+    return np.log1p(np.exp(-np.abs(x))) + np.maximum(x, 0)
+
+
+UNARY = [
+    ("acos", lambda: _x(lo=-0.9, hi=0.9), np.arccos),
+    ("atan", lambda: _x(), np.arctan),
+    ("expm1", lambda: _x(), np.expm1),
+    ("reciprocal", lambda: _x(pos=True), lambda x: 1.0 / x),
+    ("logsigmoid", lambda: _x(), lambda x: -_sp(-x)),
+    ("softsign", lambda: _x(), lambda x: x / (1 + np.abs(x))),
+    ("softshrink", lambda: _x(),
+     lambda x: np.where(x > 0.5, x - 0.5, np.where(x < -0.5, x + 0.5, 0))),
+    ("hard_shrink", lambda: _x(),
+     lambda x: np.where(np.abs(x) > 0.5, x, 0)),
+    ("hard_sigmoid", lambda: _x(lo=-4, hi=4),
+     lambda x: np.clip(0.2 * x + 0.5, 0, 1)),
+    ("hard_swish", lambda: _x(lo=-4, hi=4),
+     lambda x: x * np.clip(x + 3, 0, 6) / 6),
+    ("brelu", lambda: _x(lo=-30, hi=30),
+     lambda x: np.clip(x, 0.0, 24.0)),
+    ("relu6", lambda: _x(lo=-4, hi=8), lambda x: np.clip(x, 0, 6)),
+    ("soft_relu", lambda: _x(lo=-30, hi=30),
+     lambda x: np.log1p(np.exp(np.clip(x, -40.0, 40.0)))),
+    ("swish", lambda: _x(), lambda x: x / (1 + np.exp(-x))),
+    ("tanh_shrink", lambda: _x(), lambda x: x - np.tanh(x)),
+    ("stanh", lambda: _x(),
+     lambda x: 1.7159 * np.tanh(0.67 * x)),
+    ("thresholded_relu", lambda: _x(),
+     lambda x: np.where(x > 1.0, x, 0.0)),
+    ("selu", lambda: _x(),
+     lambda x: 1.0507009873554805 * np.where(
+         x > 0, x, 1.6732632423543772 * (np.exp(x) - 1))),
+]
+
+
+@pytest.mark.parametrize("name,feed,oracle",
+                         [u for u in UNARY], ids=[u[0] for u in UNARY])
+def test_unary_activation(name, feed, oracle):
+    fn = getattr(layers, name, None)
+    if fn is None:
+        pytest.skip("%s not a public layer" % name)
+    x = feed()
+    got = _run(lambda v: fn(v["x"]), {"x": x})
+    np.testing.assert_allclose(got, oracle(x), rtol=2e-5, atol=2e-5)
+
+
+BINARY = [
+    ("elementwise_div", lambda a, b: a / b, False),
+    ("elementwise_max", np.maximum, False),
+    ("elementwise_min", np.minimum, False),
+    ("elementwise_pow", lambda a, b: np.power(np.abs(a) + 0.1, b), True),
+    ("elementwise_mod", lambda a, b: np.mod(a, b), False),
+    ("elementwise_floordiv", lambda a, b: np.floor_divide(a, b), False),
+]
+
+
+@pytest.mark.parametrize("name,oracle,absfirst",
+                         BINARY, ids=[b[0] for b in BINARY])
+def test_elementwise_tail(name, oracle, absfirst):
+    fn = getattr(layers, name, None)
+    if fn is None:
+        pytest.skip("%s not a public layer" % name)
+    if name in ("elementwise_mod", "elementwise_floordiv"):
+        a = np.random.RandomState(0).randint(1, 20, (3, 4)).astype(
+            np.int64)
+        b = np.random.RandomState(1).randint(1, 7, (3, 4)).astype(np.int64)
+        got = _run(lambda v: fn(v["a"], v["b"]), {"a": a, "b": b})
+        np.testing.assert_array_equal(got, oracle(a, b))
+        return
+    a, b = _x(seed=1), _x(seed=2, pos=True)
+    if absfirst:
+        a2 = np.abs(a) + 0.1
+        got = _run(lambda v: fn(v["a"], v["b"]),
+                   {"a": a2.astype(np.float32), "b": b})
+        np.testing.assert_allclose(got, oracle(a, b), rtol=2e-5,
+                                   atol=2e-5)
+    else:
+        got = _run(lambda v: fn(v["a"], v["b"]), {"a": a, "b": b})
+        np.testing.assert_allclose(got, oracle(a, b), rtol=2e-5,
+                                   atol=2e-5)
+
+
+def test_logical_and_compare_tail():
+    a = np.asarray([[True, False], [True, True]])
+    b = np.asarray([[True, True], [False, True]])
+    for name, oracle in (("logical_and", np.logical_and),
+                         ("logical_or", np.logical_or)):
+        fn = getattr(layers, name)
+        got = _run(lambda v, fn=fn: fn(v["a"], v["b"]),
+                   {"a": a, "b": b})
+        np.testing.assert_array_equal(got.astype(bool), oracle(a, b))
+    got = _run(lambda v: layers.logical_not(v["a"]), {"a": a})
+    np.testing.assert_array_equal(got.astype(bool), ~a)
+
+    x, y = _x(seed=3), _x(seed=4)
+    for name, oracle in (("greater_equal", np.greater_equal),
+                         ("less_equal", np.less_equal),
+                         ("not_equal", np.not_equal)):
+        fn = getattr(layers, name, None)
+        if fn is None:
+            pytest.skip("%s missing" % name)
+        got = _run(lambda v, fn=fn: fn(v["x"], v["y"]),
+                   {"x": x, "y": y})
+        np.testing.assert_array_equal(got.astype(bool), oracle(x, y))
+
+
+def test_reduce_and_arg_tail():
+    x = _x((2, 3, 4), seed=5)
+    cases = [
+        ("reduce_min", lambda v: layers.reduce_min(v["x"], dim=1),
+         x.min(axis=1)),
+        ("reduce_prod", lambda v: layers.reduce_prod(v["x"], dim=-1),
+         x.prod(axis=-1)),
+        ("reduce_any",
+         lambda v: layers.reduce_any(layers.greater_than(
+             v["x"], layers.zeros_like(v["x"])), dim=1),
+         (x > 0).any(axis=1)),
+        ("argmax", lambda v: layers.argmax(v["x"], axis=2),
+         x.argmax(axis=2)),
+        ("argmin", lambda v: layers.argmin(v["x"], axis=0),
+         x.argmin(axis=0)),
+    ]
+    for name, build, want in cases:
+        got = _run(build, {"x": x})
+        np.testing.assert_allclose(
+            got.astype(want.dtype), want, rtol=1e-5, atol=1e-6,
+            err_msg=name)
+
+
+def test_isnan_isinf():
+    x = np.asarray([[1.0, np.nan], [np.inf, -np.inf]], np.float32)
+    got = _run(lambda v: layers.isfinite(v["x"]), {"x": x})
+    assert not bool(np.asarray(got).all())
+
+
+def test_loss_tail_vs_torch():
+    torch = pytest.importorskip("torch")
+    import torch.nn.functional as F
+    x, y = _x(seed=6), _x(seed=7)
+    tx, ty = torch.from_numpy(x), torch.from_numpy(y)
+
+    got = _run(lambda v: layers.huber_loss(v["x"], v["y"], delta=1.0),
+               {"x": x, "y": y})
+    want = F.huber_loss(tx, ty, reduction="none", delta=1.0).numpy()
+    np.testing.assert_allclose(got.reshape(want.shape), want, rtol=1e-5,
+                               atol=1e-6)
+
+    got = _run(lambda v: layers.mse_loss(v["x"], v["y"]),
+               {"x": x, "y": y})
+    np.testing.assert_allclose(float(np.asarray(got).mean()),
+                               F.mse_loss(tx, ty).item(), rtol=1e-5)
+
+    p = np.random.RandomState(8).uniform(0.05, 0.95, (4, 1)).astype(
+        np.float32)
+    lbl = np.random.RandomState(9).randint(0, 2, (4, 1)).astype(
+        np.float32)
+    got = _run(lambda v: layers.log_loss(v["p"], v["l"]),
+               {"p": p, "l": lbl})
+    eps = 1e-4   # fluid log_loss epsilon (ref log_loss_op.h)
+    want = -(lbl * np.log(p + eps) + (1 - lbl) * np.log(1 - p + eps))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    q = np.random.RandomState(10).dirichlet([1] * 5, 3).astype(np.float32)
+    logp = np.log(np.random.RandomState(11).dirichlet([1] * 5, 3)
+                  ).astype(np.float32)
+    got = _run(lambda v: layers.kldiv_loss(v["x"], v["t"],
+                                           reduction="none"),
+               {"x": logp, "t": q})
+    want = F.kl_div(torch.from_numpy(logp), torch.from_numpy(q),
+                    reduction="none").numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_tensor_tail():
+    x = _x((3, 4), seed=12)
+    idx = np.asarray([2, 0], np.int64)
+    got = _run(lambda v: layers.index_select(v["x"], v["i"], dim=0)
+               if hasattr(layers, "index_select") else
+               layers.gather(v["x"], layers.unsqueeze(v["i"], [1])),
+               {"x": x, "i": idx})
+    np.testing.assert_allclose(got.reshape(2, 4), x[idx], rtol=1e-6)
+
+    # meshgrid
+    if hasattr(layers, "meshgrid"):
+        a = np.arange(3).astype(np.float32)
+        b = np.arange(2).astype(np.float32)
+        outs = _run(lambda v: layers.meshgrid([v["a"], v["b"]])[0],
+                    {"a": a, "b": b})
+        np.testing.assert_array_equal(outs, np.meshgrid(a, b,
+                                                        indexing="ij")[0])
+
+    # sequence_mask
+    lens = np.asarray([1, 3], np.int64)
+    got = _run(lambda v: layers.sequence_mask(v["l"], maxlen=4), {"l": lens})
+    want = np.asarray([[1, 0, 0, 0], [1, 1, 1, 0]])
+    np.testing.assert_array_equal(got.reshape(2, 4).astype(int), want)
+
+    # clip_by_norm
+    if hasattr(layers, "clip_by_norm"):
+        got = _run(lambda v: layers.clip_by_norm(v["x"], max_norm=1.0),
+                   {"x": x})
+        n = np.linalg.norm(x)
+        np.testing.assert_allclose(got, x * min(1.0, 1.0 / n), rtol=1e-5)
+
+
+def test_interp_vs_torch():
+    torch = pytest.importorskip("torch")
+    import torch.nn.functional as F
+    x = _x((2, 3, 8, 8), seed=13)
+    tx = torch.from_numpy(x)
+    if hasattr(layers, "resize_nearest"):
+        # align_corners=False floor-sampling is the convention torch
+        # 'nearest' shares (fluid's default align_corners=True rounds
+        # against (H-1)/(h-1) instead)
+        got = _run(lambda v: layers.resize_nearest(
+            v["x"], out_shape=[4, 4], align_corners=False), {"x": x})
+        want = F.interpolate(tx, size=(4, 4), mode="nearest").numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    if hasattr(layers, "resize_bilinear"):
+        got = _run(lambda v: layers.resize_bilinear(
+            v["x"], out_shape=[16, 16], align_corners=True), {"x": x})
+        want = F.interpolate(tx, size=(16, 16), mode="bilinear",
+                             align_corners=True).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+        # half-pixel convention (fluid align_mode=0, !align_corners)
+        got = _run(lambda v: layers.resize_bilinear(
+            v["x"], out_shape=[16, 16], align_corners=False,
+            align_mode=0), {"x": x})
+        want = F.interpolate(tx, size=(16, 16), mode="bilinear",
+                             align_corners=False).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_grad_spot_checks_vs_torch():
+    torch = pytest.importorskip("torch")
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.registry import get_op
+
+    class _Ctx:
+        program = None
+
+        def rng(self):
+            return jax.random.PRNGKey(0)
+
+    x = _x(seed=14)
+
+    def run_grad(op_name, torch_fn, inputs_key="X"):
+        op = get_op(op_name)
+
+        def loss(v):
+            out = op.fn(_Ctx(), {inputs_key: [v]}, {})
+            if isinstance(out, dict):
+                out = next(iter(out.values()))
+                if isinstance(out, (list, tuple)):
+                    out = out[0]
+            return jnp.sum(out)
+
+        g = jax.grad(loss)(jnp.asarray(x))
+        tx = torch.from_numpy(x).requires_grad_(True)
+        torch_fn(tx).sum().backward()
+        np.testing.assert_allclose(np.asarray(g), tx.grad.numpy(),
+                                   rtol=2e-4, atol=2e-5,
+                                   err_msg=op_name)
+
+    run_grad("swish", lambda t: t * torch.sigmoid(t))
+    run_grad("softsign", torch.nn.functional.softsign)
+    run_grad("tanh_shrink", torch.nn.functional.tanhshrink)

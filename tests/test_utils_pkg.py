@@ -47,7 +47,8 @@ def test_preprocess_util_corpus(tmp_path):
 
     class Creater(pu.DatasetCreater):
         def create_dataset_from_dir(self, path, label_set=None):
-            labels = label_set or pu.get_label_set_from_dir(path)
+            labels = (label_set if label_set is not None
+                      else pu.get_label_set_from_dir(path))
             samples = [(f, lbl)
                        for cls, lbl in labels.items()
                        for f in pu.list_files(path + "/" + cls)]
