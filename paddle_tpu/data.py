@@ -1,0 +1,19 @@
+"""fluid.data parity (ref python/paddle/fluid/data.py).
+
+Unlike ``layers.data`` (which prepends an implicit -1 batch dimension),
+``fluid.data`` declares the FULL shape; ``None`` dims mean any size.
+Feeds are shape/dtype-checked at run time by the Executor's feed
+boundary (executor.py _convert_feed's named errors — the behavior this
+API was introduced for).
+"""
+from .layers import io as _io
+
+__all__ = ["data"]
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    var = _io.data(name, [(-1 if s is None else int(s)) for s in shape],
+                   dtype=dtype, append_batch_size=False,
+                   lod_level=lod_level)
+    var.stop_gradient = True
+    return var

@@ -1,0 +1,24 @@
+"""fluid.data parity: full-shape declaration + run-time feed checking."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.data import data
+
+
+def test_fluid_data_full_shape_and_feed_check():
+    main, startup = pt.Program(), pt.Program()
+    with pt.unique_name.guard(), pt.program_guard(main, startup):
+        v = data("fd_x", [None, 4], "float32")
+        assert v.shape == (-1, 4)
+        assert v.stop_gradient
+        out = pt.layers.scale(v, scale=3.0)
+    exe = pt.Executor()
+    exe.run(startup)
+    r, = exe.run(main, feed={"fd_x": np.ones((2, 4), np.float32)},
+                 fetch_list=[out])
+    assert float(np.asarray(r).sum()) == 24.0
+    # run-time shape check: wrong non-batch dim is a named error
+    with pytest.raises(ValueError, match="fd_x"):
+        exe.run(main, feed={"fd_x": np.ones((2, 5), np.float32)},
+                fetch_list=[out])
