@@ -51,7 +51,7 @@ from . import lod_tensor as lod_tensor_mod
 from .lod_tensor import (LoDTensor, create_lod_tensor,
                          create_random_int_lodtensor)
 from .framework.compiler import make_mesh
-from .layers.io import data
+from .data import data  # fluid.data: full-shape, None dims (ref fluid/data.py)
 from .data_feed_desc import DataFeedDesc
 from .input import one_hot, embedding
 from .core import CUDAPlace, CUDAPinnedPlace
@@ -119,3 +119,13 @@ def load_op_library(lib_path):
         "load_op_library loads CUDA kernels; on paddle_tpu register a "
         "JAX kernel via paddle_tpu.ops.registry.register_op (see "
         "ops/registry.py docstring)")
+
+
+# `import paddle_tpu; paddle_tpu.fluid.layers...` — the reference's
+# paddle.fluid spelling, aliased onto this package (fluid/__init__.py)
+from . import fluid  # noqa: E402
+
+# deep reference module paths (slim/prune/pruner.py-style packages that
+# are flat modules here) registered as virtual re-export modules
+from . import _compat_submodules  # noqa: E402
+_compat_submodules.install()

@@ -1,0 +1,28 @@
+"""SlimGraphExecutor (ref slim/graph/executor.py): run a GraphWrapper's
+underlying Program through the ordinary Executor."""
+import numpy as np
+
+__all__ = ["SlimGraphExecutor"]
+
+
+class SlimGraphExecutor(object):
+    def __init__(self, place=None):
+        from .... import Executor
+        self.exe = Executor(place)
+        self.place = place
+
+    def run(self, graph, scope=None, data=None):
+        """Execute ``graph`` (a GraphWrapper or Program) and return its
+        declared out_nodes' values."""
+        program = getattr(graph, "program", graph)
+        fetch_list = list(getattr(graph, "out_nodes", {}).values())
+        feed = None
+        if data is not None:
+            in_nodes = getattr(graph, "in_nodes", {})
+            if isinstance(data, dict):
+                feed = data
+            else:
+                feed = {name: np.asarray(col) for name, col in
+                        zip(in_nodes, map(list, zip(*data)))}
+        return self.exe.run(program, feed=feed, scope=scope,
+                            fetch_list=fetch_list)

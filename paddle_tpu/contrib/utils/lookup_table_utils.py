@@ -1,0 +1,36 @@
+"""Lookup-table checkpoint conversion (ref contrib/utils/
+lookup_table_utils.py): the reference converted pserver-distributed
+lookup-table checkpoints into inference programs. TPU sparse tables are
+row-sharded mesh arrays checkpointed by io.save_checkpoint, so the
+conversion collapses to ordinary save/load — these entry points keep
+the names and point at the working path."""
+
+__all__ = ["convert_dist_to_sparse_program",
+           "load_persistables_for_increment",
+           "load_persistables_for_inference"]
+
+_GUIDANCE = (
+    "pserver lookup-table checkpoints do not exist on paddle_tpu: "
+    "distributed embeddings are row-sharded mesh arrays "
+    "(distributed/sharded_embedding.py) saved by io.save_checkpoint / "
+    "io.save_persistables; load them with io.load_checkpoint / "
+    "io.load_persistables and export with io.save_inference_model")
+
+
+def convert_dist_to_sparse_program(program):
+    """The dense->sparse program rewrite is unnecessary here: embedding
+    with is_distributed=True already row-shards over the mesh."""
+    return program
+
+
+def load_persistables_for_increment(dirname, executor, program,
+                                    lookup_table_var=None,
+                                    lookup_table_var_path=None):
+    from ... import io
+    io.load_persistables(executor, dirname, main_program=program)
+
+
+def load_persistables_for_inference(dirname, executor, program,
+                                    lookup_table_var_name=None):
+    from ... import io
+    io.load_persistables(executor, dirname, main_program=program)

@@ -12,8 +12,7 @@ __all__ = ["data"]
 
 
 def data(name, shape, dtype="float32", lod_level=0):
-    var = _io.data(name, [(-1 if s is None else int(s)) for s in shape],
-                   dtype=dtype, append_batch_size=False,
-                   lod_level=lod_level)
-    var.stop_gradient = True
-    return var
+    # layers.io.data defaults stop_gradient=True (feed vars)
+    return _io.data(name, [(-1 if s is None else int(s)) for s in shape],
+                    dtype=dtype, append_batch_size=False,
+                    lod_level=lod_level)

@@ -1,0 +1,22 @@
+"""Downpour-SGD surface (ref fluid/distributed/downpour.py).
+
+The reference's DownpourSGD configured Baidu's async parameter-server
+tables. On TPU pods the capability (huge sparse tables + distributed
+updates) is row-sharded mesh state with synchronous XLA collectives —
+see distributed/sharded_embedding.py and PORTING.md "Capability
+substitutions". The class is kept so ported configs fail loudly AT THE
+RIGHT LINE with the working alternative named.
+"""
+
+__all__ = ["DownpourSGD"]
+
+_GUIDANCE = (
+    "DownpourSGD configures the reference's async pserver tables, which "
+    "do not exist on TPU; use embedding(..., is_distributed=True) for "
+    "row-sharded tables and a lazy-mode Adam/SGD from paddle_tpu."
+    "optimizer — sync dp over ICI replaces async push/pull")
+
+
+class DownpourSGD(object):
+    def __init__(self, learning_rate=0.001, window=1):
+        raise NotImplementedError(_GUIDANCE)

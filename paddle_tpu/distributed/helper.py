@@ -1,0 +1,24 @@
+"""MPI helper surface (ref fluid/distributed/helper.py). The reference
+wrapped mpi4py for pserver jobs; multi-host coordination here is
+jax.distributed (distributed/launch.py init_on_pod), so the helper
+exposes the same small API over the live runtime."""
+
+__all__ = ["MPIHelper"]
+
+
+class MPIHelper(object):
+    def get_rank(self):
+        import jax
+        return jax.process_index()
+
+    def get_size(self):
+        import jax
+        return jax.process_count()
+
+    def get_ip(self):
+        import socket
+        return socket.gethostbyname(socket.gethostname())
+
+    def get_hostname(self):
+        import socket
+        return socket.gethostname()

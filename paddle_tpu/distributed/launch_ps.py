@@ -1,0 +1,20 @@
+"""Parameter-server job launcher (ref python/paddle/distributed/
+launch_ps.py). TPU pods have no pserver/trainer split — every host runs
+the same SPMD program — so this entry point delegates to the collective
+launcher and says so."""
+import sys
+
+__all__ = ["main"]
+
+
+def main(args=None):
+    sys.stderr.write(
+        "launch_ps starts pserver+trainer process groups, which do not "
+        "exist on TPU; launching the collective SPMD job via "
+        "paddle_tpu.distributed.launch instead\n")
+    from . import launch
+    return launch.launch(args)
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

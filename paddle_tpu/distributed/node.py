@@ -1,0 +1,22 @@
+"""Downpour node descriptors (ref fluid/distributed/node.py): Server/
+Worker table configs for the pserver binary. N/A on TPU — tables are
+row-sharded mesh arrays (distributed/sharded_embedding.py); the classes
+raise with that pointer so ported configs fail at the right line."""
+
+__all__ = ["DownpourServer", "DownpourWorker"]
+
+_GUIDANCE = (
+    "Downpour server/worker table configs target the reference's "
+    "pserver binary; on paddle_tpu use embedding(..., "
+    "is_distributed=True) row-sharded tables (PORTING.md 'Capability "
+    "substitutions')")
+
+
+class DownpourServer(object):
+    def __init__(self):
+        raise NotImplementedError(_GUIDANCE)
+
+
+class DownpourWorker(object):
+    def __init__(self, window=1):
+        raise NotImplementedError(_GUIDANCE)

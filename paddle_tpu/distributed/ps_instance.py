@@ -1,0 +1,34 @@
+"""PaddlePS process-instance helper (ref fluid/distributed/
+ps_instance.py): MPI-rank bookkeeping for pserver/trainer roles. TPU
+jobs have one role (every host runs the same SPMD program under
+jax.distributed), so the instance degenerates to process-index
+accessors over the live runtime."""
+
+__all__ = ["PaddlePSInstance"]
+
+
+class PaddlePSInstance(object):
+    def __init__(self, server_worker_mode=1, proc_per_node=1):
+        import jax
+        self._rank = jax.process_index()
+        self._nodes = jax.process_count()
+
+    def get_worker_index(self):
+        return self._rank
+
+    def get_node_cnt(self):
+        return self._nodes
+
+    def is_worker(self):
+        return True           # every TPU host is a worker
+
+    def is_server(self):
+        return False          # no pserver tier on TPU (PORTING.md)
+
+    def is_first_worker(self):
+        return self._rank == 0
+
+    def barrier_all(self):
+        if self._nodes > 1:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices("paddle_tpu_ps_barrier")

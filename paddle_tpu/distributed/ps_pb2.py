@@ -1,0 +1,17 @@
+"""Placeholder for the reference's generated pserver protobuf module
+(ref fluid/distributed/ps_pb2.py, generated from ps.proto). There is no
+pserver wire protocol on TPU; anything touching it raises with the
+working alternative named."""
+
+__all__ = []
+
+_GUIDANCE = (
+    "ps_pb2 is the reference pserver's wire protocol; paddle_tpu has no "
+    "pserver tier — sparse state is row-sharded mesh arrays "
+    "(distributed/sharded_embedding.py)")
+
+
+def __getattr__(name):
+    if name.startswith("__"):        # import-machinery dunder probes
+        raise AttributeError(name)
+    raise NotImplementedError(_GUIDANCE)

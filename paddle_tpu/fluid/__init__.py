@@ -1,0 +1,73 @@
+"""``paddle.fluid`` alias package.
+
+Reference scripts spell imports ``import paddle.fluid as fluid`` /
+``from paddle.fluid.layers import nn``; this framework's modules live at
+``paddle_tpu.X``. A meta-path finder (registered first, so the normal
+path machinery never double-loads anything) resolves every
+``paddle_tpu.fluid.X`` to a lightweight PROXY module whose attribute
+access forwards to the already-imported ``paddle_tpu.X`` — one copy of
+all module state, and ported fluid scripts only rewrite the root
+package name. Attribute access on ``paddle_tpu.fluid`` itself proxies
+the top-level package the same way.
+"""
+import importlib
+import importlib.abc
+import importlib.util
+import sys
+import types
+
+import paddle_tpu as _pt
+
+_PREFIX = __name__ + "."
+
+
+def __getattr__(name):
+    return getattr(_pt, name)
+
+
+def __dir__():
+    return sorted(set(dir(_pt)) | set(globals()))
+
+
+def _is_importable(name):
+    if name in sys.modules:
+        return True
+    try:
+        return importlib.util.find_spec(name) is not None
+    except (ImportError, ValueError):
+        return False
+
+
+class _AliasLoader(importlib.abc.Loader):
+    def __init__(self, real_name):
+        self._real_name = real_name
+
+    def create_module(self, spec):
+        real = importlib.import_module(self._real_name)
+        proxy = types.ModuleType(spec.name, real.__doc__)
+        proxy.__getattr__ = lambda name, _r=real: getattr(_r, name)
+        proxy.__dir__ = lambda _r=real: dir(_r)
+        return proxy
+
+    def exec_module(self, module):
+        pass
+
+
+class _AliasFinder(importlib.abc.MetaPathFinder):
+    def find_spec(self, fullname, path=None, target=None):
+        if not fullname.startswith(_PREFIX):
+            return None
+        real = "paddle_tpu." + fullname[len(_PREFIX):]
+        if not _is_importable(real):
+            return None
+        spec = importlib.util.spec_from_loader(fullname,
+                                               _AliasLoader(real))
+        # every alias is marked package-like with an EMPTY search path:
+        # descendants must come back through this finder (a real path
+        # here would let PathFinder double-load the underlying files)
+        spec.submodule_search_locations = []
+        return spec
+
+
+if not any(isinstance(f, _AliasFinder) for f in sys.meta_path):
+    sys.meta_path.insert(0, _AliasFinder())

@@ -1,0 +1,62 @@
+"""Fleet abstract base (ref incubate/fleet/base/fleet_base.py): the
+interface both the collective fleet (our mesh-backed
+distributed/fleet.py singleton) and the pserver fleet implement."""
+import abc
+
+from ....distributed import fleet as _impl
+from ....distributed.fleet import DistributedOptimizer  # noqa: F401
+
+__all__ = ["Mode", "Fleet", "DistributedOptimizer", "fleet"]
+
+
+class Mode(object):
+    """Training-architecture constants (ref fleet_base.Mode)."""
+    TRANSPILER = 1
+    PSLIB = 2
+    COLLECTIVE = 3
+
+
+class Fleet(object, metaclass=abc.ABCMeta):
+    """Abstract fleet interface. The concrete TPU implementation is the
+    collective fleet in distributed/fleet.py — a mesh + XLA collectives
+    (Mode.COLLECTIVE); pserver modes are N/A on TPU (PORTING.md)."""
+
+    def __init__(self, mode=Mode.COLLECTIVE):
+        self._mode = mode
+
+    def is_first_worker(self):
+        return _impl.is_first_worker()
+
+    def worker_index(self):
+        return _impl.worker_index()
+
+    def worker_num(self):
+        return _impl.worker_num()
+
+    @abc.abstractmethod
+    def init_worker(self):
+        raise NotImplementedError
+
+    @abc.abstractmethod
+    def run_worker(self, main_programs=None, scopes=None):
+        raise NotImplementedError
+
+    @abc.abstractmethod
+    def init_server(self, model_dir=None):
+        raise NotImplementedError
+
+    @abc.abstractmethod
+    def run_server(self):
+        raise NotImplementedError
+
+    @abc.abstractmethod
+    def stop_worker(self):
+        raise NotImplementedError
+
+    @abc.abstractmethod
+    def distributed_optimizer(self, optimizer, strategy=None):
+        raise NotImplementedError
+
+
+# the working singleton users actually call (collective mode)
+fleet = _impl

@@ -1,0 +1,105 @@
+"""paddle.fluid import-path closure: every module path under the
+reference's python/paddle tree resolves on paddle_tpu (the fluid alias
+finder + virtual deep submodules), and the aliases share state with the
+real modules."""
+import os
+
+import pytest
+
+REF = "/root/reference/python/paddle"
+
+
+def _reference_module_paths():
+    mods = []
+    for root, dirs, files in os.walk(REF):
+        dirs[:] = [d for d in dirs
+                   if d not in ("tests", "__pycache__", "libs", "proto")]
+        for f in files:
+            if not f.endswith(".py"):
+                continue
+            rel = os.path.relpath(os.path.join(root, f), REF)
+            mod = rel[:-3].replace(os.sep, ".")
+            if mod.endswith(".__init__"):
+                mod = mod[:-9]
+            if mod and mod != "__init__":
+                mods.append(mod)
+    return sorted(mods)
+
+
+@pytest.mark.skipif(not os.path.isdir(REF), reason="reference not present")
+def test_every_reference_module_path_resolves():
+    import importlib
+    failed = []
+    for mod in _reference_module_paths():
+        try:
+            importlib.import_module("paddle_tpu." + mod)
+        except Exception as e:
+            failed.append("%s (%r)" % (mod, e))
+    assert not failed, "unresolved reference module paths:\n" + \
+        "\n".join(failed)
+
+
+def test_fluid_alias_shares_state():
+    import paddle_tpu as pt
+    import paddle_tpu.fluid as fluid
+    import paddle_tpu.fluid.layers as FL
+    import paddle_tpu.layers
+
+    assert fluid.Program is pt.Program
+    assert fluid.Executor is pt.Executor
+    assert FL.fc is paddle_tpu.layers.fc
+    # deep chain: attribute objects are the real ones (no double import)
+    from paddle_tpu.fluid.layers.nn import fc as fc2
+    assert fc2 is paddle_tpu.layers.nn.fc
+
+    # default-program state is SHARED between the spellings
+    with pt.program_guard(pt.Program(), pt.Program()):
+        x = FL.data("alias_x", [4], "float32")
+        assert pt.default_main_program().global_block().var(
+            "alias_x") is x
+
+
+def test_virtual_deep_submodules_reexport_real_objects():
+    from paddle_tpu.contrib.slim import prune as flat
+    from paddle_tpu.contrib.slim.prune.pruner import MagnitudePruner
+    from paddle_tpu.fluid.contrib.slim.prune.pruner import \
+        MagnitudePruner as via_fluid
+    assert MagnitudePruner is flat.MagnitudePruner
+    assert via_fluid is flat.MagnitudePruner
+
+    from paddle_tpu.contrib.mixed_precision import decorate as flat_dec
+    from paddle_tpu.fluid.contrib.mixed_precision.decorator import \
+        decorate
+    assert decorate is flat_dec
+
+    import pytest as _pytest
+    import paddle_tpu.incubate.fleet.parameter_server.pslib.node as node
+    with _pytest.raises(NotImplementedError, match="row-sharded"):
+        node.DownpourServer
+
+
+def test_nas_controller_server_roundtrip():
+    from paddle_tpu.contrib.slim.nas.controller_server import \
+        ControllerServer
+    from paddle_tpu.contrib.slim.nas.search_agent import SearchAgent
+
+    class Ctl(object):
+        def __init__(self):
+            self.seen = []
+
+        def next_tokens(self):
+            return [1, 2, 3]
+
+        def update(self, tokens, reward):
+            self.seen.append((tuple(tokens), reward))
+
+    ctl = Ctl()
+    server = ControllerServer(ctl, address=("127.0.0.1", 0))
+    ip, port = server.start()
+    try:
+        agent = SearchAgent("127.0.0.1", port)
+        assert agent.next_tokens() == [1, 2, 3]
+        agent.update([4, 5], 0.75)
+        assert ctl.seen == [((4, 5), 0.75)]
+    finally:
+        server.close()
