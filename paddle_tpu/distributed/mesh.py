@@ -52,6 +52,12 @@ def init_mesh(mesh_axes=None, devices=None, multihost=False):
     return _mesh
 
 
+def reset_mesh():
+    """Uninstall the global mesh (tests / reconfiguration)."""
+    global _mesh
+    _mesh = None
+
+
 def get_mesh():
     return _mesh
 

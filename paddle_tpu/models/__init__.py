@@ -20,3 +20,4 @@ from . import vision
 from . import yolov3
 from . import sequence_labeling
 from . import ocr
+from . import gpt
