@@ -145,13 +145,27 @@ def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
     filter_shape = [num_channels, num_filters // groups] + list(filter_size)
     w = helper.create_parameter(helper.param_attr, shape=filter_shape,
                                 dtype=dtype)
-    pre_bias = helper.create_variable_for_type_inference(dtype)
+    out_shape = None
+    osz = None
+    if output_size is not None:
+        osz = [output_size, output_size] if isinstance(output_size, int) \
+            else list(output_size)
+        out_shape = (input.shape[0], num_filters, osz[0], osz[1])
+    elif input.shape is not None and filter_size is not None and \
+            None not in input.shape[2:]:
+        spatial = [
+            (input.shape[2 + i] - 1) * stride[i] - 2 * padding[i] +
+            dilation[i] * (filter_size[i] - 1) + 1
+            if input.shape[2 + i] != -1 else -1
+            for i in range(2)]
+        out_shape = (input.shape[0], num_filters) + tuple(spatial)
+    pre_bias = helper.create_variable_for_type_inference(dtype, out_shape)
     helper.append_op(
         "conv2d_transpose",
         inputs={"Input": [input.name], "Filter": [w.name]},
         outputs={"Output": [pre_bias.name]},
         attrs={"strides": stride, "paddings": padding, "dilations": dilation,
-               "groups": groups})
+               "groups": groups, "output_size": osz})
     pre_act = helper.append_bias_op(pre_bias, dim_start=1, dim_end=2)
     return helper.append_activation(pre_act)
 

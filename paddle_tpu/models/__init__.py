@@ -21,3 +21,4 @@ from . import yolov3
 from . import sequence_labeling
 from . import ocr
 from . import gpt
+from . import dcgan

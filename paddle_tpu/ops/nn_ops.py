@@ -87,8 +87,11 @@ def _conv2d_transpose(ctx, ins, attrs):
     pads = _pair(attrs.get("paddings", [0, 0]))
     dil = _pair(attrs.get("dilations", [1, 1]))
     groups = attrs.get("groups", 1) or 1
+    out_sp = attrs.get("output_size") or None
     out = _conv_transpose_nd(x, w, strides, pads, dil, groups,
-                             ("NCHW", "OIHW", "NCHW"))
+                             ("NCHW", "OIHW", "NCHW"),
+                             out_sp=None if out_sp is None
+                             else tuple(out_sp))
     return {"Output": out}
 
 

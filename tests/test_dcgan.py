@@ -1,0 +1,85 @@
+"""DCGAN: one fused adversarial step; optimizer scoping via
+parameter_list keeps G fixed under d_loss and D fixed under g_loss."""
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu.framework.scope import Scope, scope_guard
+from paddle_tpu.models import dcgan
+
+
+def test_dcgan_adversarial_step_trains():
+    cfg = dcgan.DCGANConfig(noise_dim=16, base_channels=8, image_size=16)
+    with pt.unique_name.guard():
+        main, startup, feeds, fetch = dcgan.dcgan_train_program(cfg)
+    batch = dcgan.synthetic_batch(cfg, batch_size=8)
+    with scope_guard(Scope()):
+        exe = pt.Executor()
+        exe.run(startup)
+        losses = []
+        for i in range(8):
+            d, g = exe.run(main, feed=batch,
+                           fetch_list=[fetch["d_loss"], fetch["g_loss"]])
+            losses.append((float(np.asarray(d).reshape(-1)[0]),
+                           float(np.asarray(g).reshape(-1)[0])))
+        assert all(np.isfinite(v) for pair in losses for v in pair)
+        # the discriminator learns to separate real from (early) fakes
+        assert losses[-1][0] < losses[0][0]
+
+
+def test_dcgan_parameter_list_scoping():
+    """minimize(parameter_list=D) must leave generator WEIGHTS
+    bit-identical: a program containing ONLY the d optimizer."""
+    from paddle_tpu import layers, optimizer
+    cfg = dcgan.DCGANConfig(noise_dim=8, base_channels=4, image_size=8)
+    with pt.unique_name.guard():
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            real = layers.data("real", [1, 8, 8], dtype="float32")
+            noise = layers.data("noise", [8], dtype="float32")
+            fake = dcgan.generator(noise, cfg, is_test=True)
+            d_real = dcgan.discriminator(real, cfg)
+            d_fake = dcgan.discriminator(fake, cfg)
+            d_loss = layers.elementwise_add(
+                dcgan._bce_logits(d_real, 1.0),
+                dcgan._bce_logits(d_fake, 0.0))
+            d_params = [p for p in main.global_block().all_parameters()
+                        if p.name.startswith("disc_")]
+            optimizer.Adam(2e-3).minimize(d_loss,
+                                          parameter_list=d_params)
+    batch = dcgan.synthetic_batch(cfg, batch_size=4, seed=1)
+    sc = Scope()
+    with scope_guard(sc):
+        exe = pt.Executor()
+        exe.run(startup)
+        before = {p.name: np.asarray(sc.find_var(p.name)).copy()
+                  for p in main.global_block().all_parameters()}
+        exe.run(main, feed=batch, fetch_list=[d_loss])
+        moved = {n for n, v in before.items()
+                 if not np.array_equal(v, np.asarray(sc.find_var(n)))}
+    assert any(n.startswith("disc_") for n in moved)
+    # the generator's trainable weights must be untouched (the d step
+    # backprops THROUGH G but must not update it)
+    gen_weights = {n for n in before if n.startswith("gen_")}
+    assert gen_weights and not (moved & gen_weights), moved & gen_weights
+
+
+def test_conv2d_transpose_output_size_honored():
+    """output_size attr reaches the kernel: runtime tensor matches the
+    requested (valid-range) size, not only the declared shape."""
+    from paddle_tpu import layers
+    with pt.unique_name.guard():
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("ct_x", [2, 16, 16], dtype="float32",
+                            append_batch_size=False)
+            x4 = layers.reshape(x, [1, 2, 16, 16])
+            y = layers.conv2d_transpose(x4, 3, filter_size=3, stride=2,
+                                        padding=1, output_size=32)
+            assert tuple(y.shape[2:]) == (32, 32)
+    with scope_guard(Scope()):
+        exe = pt.Executor()
+        exe.run(startup)
+        out, = exe.run(main, feed={
+            "ct_x": np.random.RandomState(0).rand(2, 16, 16).astype(
+                np.float32)}, fetch_list=[y])
+    assert np.asarray(out).shape == (1, 3, 32, 32)
