@@ -151,12 +151,7 @@ def gpt_pretrain_program(cfg, batch_size, seq_len, optimizer_fn=None,
         lmask = layers.data("loss_mask", [seq_len, 1], dtype="float32")
 
         h = gpt_decoder(tok, pos, cfg, is_test=is_test)  # cfg.dtype
-        emb = main.global_block().var("gpt_word_embedding")
-        if cfg.dtype == "bfloat16":
-            logits = layers.matmul(h, layers.cast(emb, "bfloat16"),
-                                   transpose_y=True, out_dtype="float32")
-        else:
-            logits = layers.matmul(h, emb, transpose_y=True)
+        logits = _tied_logits(cfg, h, main)
         flat_logits = layers.reshape(logits, [-1, cfg.vocab_size])
         flat_lbl = layers.reshape(lbl, [-1, 1])
         ce = layers.softmax_with_cross_entropy(flat_logits, flat_lbl)
@@ -170,6 +165,71 @@ def gpt_pretrain_program(cfg, batch_size, seq_len, optimizer_fn=None,
             optimizer_fn(loss)
     feeds = ["token_ids", "pos_ids", "labels", "loss_mask"]
     return main, startup, feeds, {"loss": loss}
+
+
+def _tied_logits(cfg, h, main):
+    """Tied-embedding vocab projection, shared by the train and decode
+    programs (their parity is what makes a trained scope decodable)."""
+    emb = main.global_block().var("gpt_word_embedding")
+    if cfg.dtype == "bfloat16":
+        return layers.matmul(h, layers.cast(emb, "bfloat16"),
+                             transpose_y=True, out_dtype="float32")
+    return layers.matmul(h, emb, transpose_y=True)
+
+
+def gpt_logits_program(cfg, seq_len):
+    """Inference program: token_ids/pos_ids -> (N,T,vocab) f32 logits
+    (shared parameter names with gpt_pretrain_program, so a trained
+    scope serves decode directly)."""
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        tok = layers.data("token_ids", [seq_len, 1], dtype="int64")
+        pos = layers.data("pos_ids", [seq_len, 1], dtype="int64")
+        h = gpt_decoder(tok, pos, cfg, is_test=True)
+        logits = _tied_logits(cfg, h, main)
+    return main, startup, ["token_ids", "pos_ids"], {"logits": logits}
+
+
+def greedy_generate(exe, cfg, prompt_tokens, max_new_tokens,
+                    logits_program=None, temperature=0.0, seed=0):
+    """Autoregressive decode: full-prefix forward per new token at ONE
+    static length (prompt+max_new, so a single compiled program serves
+    every step — the static-shape idiom; causal masking makes the
+    padding positions irrelevant). temperature=0 -> greedy argmax.
+    prompt_tokens: (N, P) int. Returns (N, P+max_new) int tokens."""
+    import numpy as np
+    prompt = np.asarray(prompt_tokens, np.int64)
+    n, p = prompt.shape
+    total = p + max_new_tokens
+    if total > cfg.max_position:
+        # the position table would silently clamp past its last row
+        raise ValueError(
+            "prompt (%d) + max_new_tokens (%d) exceeds cfg.max_position "
+            "(%d)" % (p, max_new_tokens, cfg.max_position))
+    if logits_program is None:
+        logits_program = gpt_logits_program(cfg, total)
+    main, startup, feeds, fetch = logits_program
+    toks = np.zeros((n, total), np.int64)
+    toks[:, :p] = prompt
+    pos = np.tile(np.arange(total).reshape(1, total, 1),
+                  (n, 1, 1)).astype(np.int64)
+    rng = np.random.RandomState(seed)
+    for cur in range(p, total):
+        out, = exe.run(main, feed={"token_ids": toks[:, :, None],
+                                   "pos_ids": pos},
+                       fetch_list=[fetch["logits"]],
+                       return_numpy=True)
+        step_logits = np.asarray(out)[:, cur - 1, :]
+        if temperature and temperature > 0:
+            z = step_logits / temperature
+            z = z - z.max(axis=-1, keepdims=True)
+            probs = np.exp(z) / np.exp(z).sum(axis=-1, keepdims=True)
+            nxt = np.array([rng.choice(cfg.vocab_size, p=probs[i])
+                            for i in range(n)])
+        else:
+            nxt = step_logits.argmax(axis=-1)
+        toks[:, cur] = nxt
+    return toks
 
 
 def synthetic_batch(cfg, batch_size, seq_len, seed=0):

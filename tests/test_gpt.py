@@ -45,6 +45,36 @@ def test_gpt_trains_down(extra):
     assert last < first / 3, (first, last)
 
 
+def test_gpt_generate_continues_learned_pattern():
+    """Train on a period-4 token stream, then greedy_generate must
+    reproduce the continuation exactly (decode shares the trained scope
+    via parameter names)."""
+    from paddle_tpu import optimizer
+    cfg = _tiny(vocab_size=32, max_position=24)
+    with pt.unique_name.guard():
+        main, startup, feeds, fetch = gpt.gpt_pretrain_program(
+            cfg, batch_size=8, seq_len=16,
+            optimizer_fn=lambda l: optimizer.Adam(5e-3).minimize(l))
+        logits_prog = gpt.gpt_logits_program(cfg, 16)
+    rng = np.random.RandomState(0)
+    period = rng.randint(0, 32, (8, 4))
+    stream = np.tile(period, (1, 5))          # (8, 20)
+    batch = {"token_ids": stream[:, :16, None].astype(np.int64),
+             "pos_ids": np.tile(np.arange(16).reshape(1, 16, 1),
+                                (8, 1, 1)).astype(np.int64),
+             "labels": stream[:, 1:17, None].astype(np.int64),
+             "loss_mask": np.ones((8, 16, 1), np.float32)}
+    with scope_guard(Scope()):
+        exe = pt.Executor()
+        exe.run(startup)
+        for _ in range(150):
+            l, = exe.run(main, feed=batch, fetch_list=[fetch["loss"]])
+        assert float(np.asarray(l).reshape(-1)[0]) < 0.1
+        out = gpt.greedy_generate(exe, cfg, stream[:, :8], 8,
+                                  logits_program=logits_prog)
+    np.testing.assert_array_equal(out[:, 8:16], stream[:, 8:16])
+
+
 def test_gpt_causality():
     """Changing a future token must not change earlier positions'
     logits (loss computed on a prefix mask is invariant)."""

@@ -10,7 +10,7 @@ import paddle_tpu as pt
 from paddle_tpu import layers
 
 
-def _run(build, feeds, n_out=1):
+def _run(build, feeds):
     main, startup = pt.Program(), pt.Program()
     with pt.unique_name.guard(), pt.program_guard(main, startup):
         vars_ = {
@@ -75,21 +75,41 @@ def test_unique_with_counts():
     x = np.asarray([2, 5, 2, 7, 5, 2], np.int64)
     outs = _run(lambda v: list(layers.unique_with_counts(v["x"])),
                 {"x": x})
-    uniq = outs[0]
-    # dense contract: unique values present, counts match numpy's
     ref_vals, ref_counts = np.unique(x, return_counts=True)
-    got = {int(u): None for u in uniq.ravel()}
-    for u, c in zip(ref_vals, ref_counts):
-        assert int(u) in got
+    ref = dict(zip(ref_vals.tolist(), ref_counts.tolist()))
+    uniq = outs[0].ravel().tolist()
+    counts = outs[-1].ravel().tolist()
+    got = {}
+    for u, c in zip(uniq, counts):
+        if int(c) > 0:          # dense contract pads with zero counts
+            got[int(u)] = got.get(int(u), 0) + int(c)
+    assert got == ref, (got, ref)
 
 
 def test_random_ops_statistics():
-    """bernoulli / sampling_id / randperm: shapes + distribution."""
-    p = np.full((400,), 0.3, np.float32)
-    got, = _run(lambda v: layers.bernoulli(v["p"])
-                if hasattr(layers, "bernoulli") else v["p"], {"p": p})
-    if got.shape == p.shape and set(np.unique(got)) <= {0.0, 1.0}:
-        assert 0.15 < got.mean() < 0.45
+    """bernoulli / sampling_id / randperm kernels: shapes, support and
+    distribution (driven through the op registry — bernoulli/randperm
+    have no layer wrapper)."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.registry import get_op
+
+    class _Ctx:
+        program = None
+
+        def rng(self):
+            return jax.random.PRNGKey(7)
+
+    p = np.full((2000,), 0.3, np.float32)
+    out = get_op("bernoulli").fn(_Ctx(), {"X": [jnp.asarray(p)]}, {})
+    draw = np.asarray(out["Out"] if isinstance(out, dict) else out)
+    assert draw.shape == p.shape
+    assert set(np.unique(draw)).issubset({0.0, 1.0})
+    assert 0.25 < draw.mean() < 0.35
+
+    out = get_op("randperm").fn(_Ctx(), {}, {"n": 16})
+    perm = np.asarray(out["Out"] if isinstance(out, dict) else out)
+    assert sorted(perm.ravel().astype(int).tolist()) == list(range(16))
 
     # sampling_id: samples category indices from per-row softmax probs
     if hasattr(layers, "sampling_id"):
@@ -97,11 +117,6 @@ def test_random_ops_statistics():
         probs[:, 2] = 1.0               # degenerate: always category 2
         sid, = _run(lambda v: layers.sampling_id(v["pr"]), {"pr": probs})
         assert set(np.asarray(sid).ravel().astype(int)) == {2}
-
-    if hasattr(layers, "randperm"):
-        perm, = _run(lambda v: layers.randperm(16), {"p": p})
-        assert sorted(np.asarray(perm).ravel().astype(int).tolist()) == \
-            list(range(16))
 
 
 def test_depthwise_conv2d_vs_torch():
@@ -145,8 +160,7 @@ def test_pad2d_modes_vs_numpy():
 
 
 def test_gru_unit_step():
-    """gru_unit: one recurrent step — output shapes + convex-combination
-    property (new hidden between reset-candidate and old hidden)."""
+    """gru_unit: one recurrent step — output shape + finiteness."""
     if not hasattr(layers, "gru_unit"):
         pytest.skip("gru_unit not exposed")
     b, d = 3, 4
