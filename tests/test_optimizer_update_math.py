@@ -1,0 +1,176 @@
+"""Optimizer update-rule EXACTNESS vs the reference formulas.
+
+Convergence tests can't catch epsilon placement or bias-correction
+deviations; these oracles replay the reference's documented update rules
+(fluid optimizer.py docstrings / operators/optimizers/*.h) in numpy on a
+program whose gradient is a known constant, and require our fused-step
+updates to match to float32 tolerance.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers, optimizer
+from paddle_tpu.framework.scope import Scope, scope_guard
+
+N_STEPS = 5
+LR = 0.1
+
+
+def _run_optimizer(make_opt, seed=0):
+    """Build loss = sum(w * g_const): grad(w) == g_const every step.
+    Returns (w0, g_const, [w after each step])."""
+    rng = np.random.RandomState(seed)
+    g_const = rng.randn(4, 3).astype(np.float32)
+    main, startup = pt.Program(), pt.Program()
+    with pt.unique_name.guard(), pt.program_guard(main, startup):
+        w = layers.create_parameter(
+            [4, 3], "float32", name="om_w",
+            default_initializer=pt.initializer.NumpyArrayInitializer(
+                rng.randn(4, 3).astype(np.float32)))
+        gc = layers.data("gc", [4, 3], "float32",
+                         append_batch_size=False)
+        loss = layers.reduce_sum(layers.elementwise_mul(w, gc))
+        make_opt().minimize(loss)
+    sc = Scope()
+    traj = []
+    with scope_guard(sc):
+        exe = pt.Executor()
+        exe.run(startup)
+        w0 = np.asarray(sc.find_var("om_w")).copy()
+        for _ in range(N_STEPS):
+            exe.run(main, feed={"gc": g_const}, fetch_list=[loss])
+            traj.append(np.asarray(sc.find_var("om_w")).copy())
+    return w0, g_const, traj
+
+
+def _check(traj, ref_traj, rtol=2e-5, atol=2e-6):
+    # tolerances sized for f32 XLA-vs-numpy rounding over N_STEPS; a
+    # genuine formula deviation (eps placement, bias correction) shows
+    # at 1e-3+ relative and still fails
+    for i, (got, want) in enumerate(zip(traj, ref_traj)):
+        np.testing.assert_allclose(
+            got, want, rtol=rtol, atol=atol,
+            err_msg="step %d diverged from the reference formula" % i)
+
+
+def test_sgd_exact():
+    w, g, traj = _run_optimizer(lambda: optimizer.SGD(LR))
+    ref = []
+    for _ in range(N_STEPS):
+        w = w - LR * g
+        ref.append(w)
+    _check(traj, ref)
+
+
+def test_momentum_exact():
+    mu = 0.9
+    w, g, traj = _run_optimizer(lambda: optimizer.Momentum(LR, mu))
+    v = np.zeros_like(w)
+    ref = []
+    for _ in range(N_STEPS):
+        # ref momentum_op.h: velocity = mu*velocity + grad;
+        # param -= lr * velocity
+        v = mu * v + g
+        w = w - LR * v
+        ref.append(w)
+    _check(traj, ref)
+
+
+def test_momentum_nesterov_exact():
+    mu = 0.9
+    w, g, traj = _run_optimizer(
+        lambda: optimizer.Momentum(LR, mu, use_nesterov=True))
+    v = np.zeros_like(w)
+    ref = []
+    for _ in range(N_STEPS):
+        # ref momentum_op.h nesterov: param -= grad*lr + velocity*mu*lr
+        v = mu * v + g
+        w = w - (g * LR + v * mu * LR)
+        ref.append(w)
+    _check(traj, ref)
+
+
+def test_adam_exact():
+    b1, b2, eps = 0.9, 0.999, 1e-8
+    w, g, traj = _run_optimizer(
+        lambda: optimizer.Adam(LR, beta1=b1, beta2=b2, epsilon=eps))
+    m = np.zeros_like(w)
+    v = np.zeros_like(w)
+    ref = []
+    for t in range(1, N_STEPS + 1):
+        # ref adam_op.h: lr_t = lr*sqrt(1-b2^t)/(1-b1^t);
+        # p -= lr_t * m/(sqrt(v) + eps)   [eps NOT bias-corrected]
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * g * g
+        lr_t = LR * np.sqrt(1 - b2 ** t) / (1 - b1 ** t)
+        w = w - lr_t * m / (np.sqrt(v) + eps)
+        ref.append(w)
+    _check(traj, ref, rtol=5e-4, atol=1e-5)
+
+
+def test_adagrad_exact():
+    eps = 1e-6
+    w, g, traj = _run_optimizer(
+        lambda: optimizer.Adagrad(LR, epsilon=eps))
+    mom = np.zeros_like(w)
+    ref = []
+    for _ in range(N_STEPS):
+        # ref adagrad_op.h: moment += g^2; p -= lr*g/(sqrt(moment)+eps)
+        mom = mom + g * g
+        w = w - LR * g / (np.sqrt(mom) + eps)
+        ref.append(w)
+    _check(traj, ref)
+
+
+def test_rmsprop_exact():
+    rho, eps, mu = 0.95, 1e-6, 0.0
+    w, g, traj = _run_optimizer(
+        lambda: optimizer.RMSProp(LR, rho=rho, epsilon=eps,
+                                  momentum=mu))
+    ms = np.zeros_like(w)
+    mom = np.zeros_like(w)
+    ref = []
+    for _ in range(N_STEPS):
+        # ref rmsprop_op.h (non-centered):
+        # ms = rho*ms + (1-rho)*g^2;
+        # mom = mu*mom + lr*g/sqrt(ms+eps); p -= mom
+        ms = rho * ms + (1 - rho) * g * g
+        mom = mu * mom + LR * g / np.sqrt(ms + eps)
+        w = w - mom
+        ref.append(w)
+    _check(traj, ref)
+
+
+def test_adamax_exact():
+    b1, b2, eps = 0.9, 0.999, 1e-8
+    w, g, traj = _run_optimizer(
+        lambda: optimizer.Adamax(LR, beta1=b1, beta2=b2, epsilon=eps))
+    m = np.zeros_like(w)
+    inf_norm = np.zeros_like(w)
+    ref = []
+    for t in range(1, N_STEPS + 1):
+        # ref adamax_op.h: m = b1*m+(1-b1)*g;
+        # inf_norm = max(b2*inf_norm, |g|);
+        # lr_t = lr/(1-b1^t); p -= lr_t * m/(inf_norm + eps)
+        m = b1 * m + (1 - b1) * g
+        inf_norm = np.maximum(b2 * inf_norm, np.abs(g))
+        lr_t = LR / (1 - b1 ** t)
+        w = w - lr_t * m / (inf_norm + eps)
+        ref.append(w)
+    _check(traj, ref)
+
+
+def test_decayed_adagrad_exact():
+    decay, eps = 0.95, 1e-6
+    w, g, traj = _run_optimizer(
+        lambda: optimizer.DecayedAdagrad(LR, decay=decay, epsilon=eps))
+    mom = np.zeros_like(w)
+    ref = []
+    for _ in range(N_STEPS):
+        # ref decayed_adagrad_op.h: moment = decay*moment+(1-decay)*g^2;
+        # p -= lr*g/(sqrt(moment)+eps)
+        mom = decay * mom + (1 - decay) * g * g
+        w = w - LR * g / (np.sqrt(mom) + eps)
+        ref.append(w)
+    _check(traj, ref)
