@@ -186,6 +186,37 @@ def _mean(ctx, ins, attrs):
 # matmul / mul (reference: matmul_op.cc, mul_op.cc — MXU territory)
 # ---------------------------------------------------------------------------
 
+import functools as _functools
+
+
+@_functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _matmul_widen(x, y, out_dt):
+    return jnp.matmul(x, y, preferred_element_type=out_dt)
+
+
+def _matmul_widen_fwd(x, y, out_dt):
+    return _matmul_widen(x, y, out_dt), (x, y)
+
+
+def _matmul_widen_bwd(out_dt, res, g):
+    x, y = res
+    gx = g.astype(x.dtype)
+    gy = g.astype(y.dtype)
+    dx = jnp.matmul(gx, jnp.swapaxes(y, -1, -2),
+                    preferred_element_type=out_dt).astype(x.dtype)
+    dy = jnp.matmul(jnp.swapaxes(x, -1, -2), gy,
+                    preferred_element_type=out_dt).astype(y.dtype)
+    # broadcasting batch dims: sum grads back to the operand shapes
+    while dx.ndim > x.ndim:
+        dx = dx.sum(axis=0)
+    while dy.ndim > y.ndim:
+        dy = dy.sum(axis=0)
+    return dx, dy
+
+
+_matmul_widen.defvjp(_matmul_widen_fwd, _matmul_widen_bwd)
+
+
 @register_op("matmul")
 def _matmul(ctx, ins, attrs):
     x, y = ins["X"][0], ins["Y"][0]
@@ -201,11 +232,16 @@ def _matmul(ctx, ins, attrs):
         y = jnp.swapaxes(y, -1, -2)
     # out_dtype: accumulate on the MXU in a wider type than the inputs
     # (bf16 x bf16 -> f32 logits in ONE pass — the mixed-precision path
-    # for vocab-scale projections; maps to XLA preferred_element_type)
+    # for vocab-scale projections; maps to XLA preferred_element_type).
+    # The BACKWARD casts the f32 cotangent down to the input dtype before
+    # the grad matmuls — without this, jax's default vjp runs both
+    # vocab-width grad dots at f32 (half MXU rate); standard
+    # mixed-precision practice, grads re-accumulate in f32 inside the
+    # optimizer anyway.
     out_dt = attrs.get("out_dtype")
     if out_dt:
         from ..framework.dtypes import to_jax_dtype
-        out = jnp.matmul(x, y, preferred_element_type=to_jax_dtype(out_dt))
+        out = _matmul_widen(x, y, to_jax_dtype(out_dt))
     else:
         out = jnp.matmul(x, y)
     if alpha != 1.0:

@@ -159,6 +159,50 @@ def test_pad2d_modes_vs_numpy():
         np.testing.assert_array_equal(got, want)
 
 
+def test_matmul_out_dtype_grads_match_plain():
+    """matmul(out_dtype=f32) on bf16 inputs: forward is the one-pass
+    widened accumulate, and the custom backward (cotangent cast to bf16
+    before the grad dots) stays within bf16 tolerance of a plain f32
+    matmul's grads."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.registry import get_op
+
+    class _Ctx:
+        program = None
+
+        def rng(self):
+            return jax.random.PRNGKey(0)
+
+    rng = np.random.RandomState(0)
+    xf = rng.randn(6, 8).astype(np.float32)
+    yf = rng.randn(8, 12).astype(np.float32)
+    x16 = jnp.asarray(xf, jnp.bfloat16)
+    y16 = jnp.asarray(yf, jnp.bfloat16)
+    op = get_op("matmul")
+
+    def loss_wide(x, y):
+        out = op.fn(_Ctx(), {"X": [x], "Y": [y]},
+                    {"out_dtype": "float32"})["Out"]
+        return jnp.sum(out * out)
+
+    def loss_plain(x, y):
+        return jnp.sum(jnp.square(jnp.matmul(
+            x.astype(jnp.float32), y.astype(jnp.float32))))
+
+    out = op.fn(_Ctx(), {"X": [x16], "Y": [y16]},
+                {"out_dtype": "float32"})["Out"]
+    assert out.dtype == jnp.float32
+    gx, gy = jax.grad(loss_wide, argnums=(0, 1))(x16, y16)
+    rx, ry = jax.grad(loss_plain, argnums=(0, 1))(
+        jnp.asarray(xf), jnp.asarray(yf))
+    assert gx.dtype == jnp.bfloat16 and gy.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(gx, np.float32),
+                               np.asarray(rx), rtol=0.06, atol=0.3)
+    np.testing.assert_allclose(np.asarray(gy, np.float32),
+                               np.asarray(ry), rtol=0.06, atol=0.3)
+
+
 def test_gru_unit_step():
     """gru_unit: one recurrent step — output shape + finiteness."""
     if not hasattr(layers, "gru_unit"):
