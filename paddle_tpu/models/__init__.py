@@ -10,6 +10,9 @@ LARK/ERNIE repos, rebuilt on paddle_tpu layers).
 - yolov3: YOLOv3 detection (train: yolov3_loss; infer: yolo_box+NMS)
 - sequence_labeling: BiGRU-CRF tagger (LAC/NER style)
 - ocr: CRNN-CTC text recognition
+- gpt: GPT-style causal LM (long-context flagship: flash/ring/ulysses
+  attention, greedy_generate decode)
+- dcgan: DCGAN adversarial training as one fused two-optimizer step
 """
 from . import bert
 from . import resnet
