@@ -202,6 +202,33 @@ def test_windowed_trainer_over_compiled_program():
     assert final < first / 10, (first, final)
 
 
+def test_sharded_window_with_collective_watchdog_armed():
+    """collective_timeout_s flows through _wrap_sharded for scan windows
+    too: the one-behind bound wait must not false-positive on healthy
+    steps."""
+    from paddle_tpu.framework.compiler import BuildStrategy, \
+        CompiledProgram
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.unique_name.guard(), pt.program_guard(main, startup):
+        x = layers.data("x", [8, 4], "float32", append_batch_size=False)
+        y = layers.data("y", [8, 1], "float32", append_batch_size=False)
+        loss = layers.reduce_mean(layers.square(layers.fc(x, 1) - y))
+        optimizer.SGD(0.1).minimize(loss)
+    bs = BuildStrategy()
+    bs.mesh_axes = {"dp": 8}
+    bs.collective_timeout_s = 60.0
+    compiled = CompiledProgram(main, bs)
+    xs, ys = _batches(3, seed=9)
+    with scope_guard(Scope()):
+        exe = pt.Executor()
+        exe.run(startup)
+        for _ in range(3):   # watchdog waits on the previous window
+            out, = exe.run_steps(compiled, feed={"x": xs, "y": ys},
+                                 fetch_list=[loss])
+        assert np.isfinite(np.asarray(out)).all()
+
+
 def test_run_steps_continues_prng_stream():
     """A run() after run_steps() must see the advanced dropout counter —
     the scan carries STEP_VAR exactly like sequential runs."""
