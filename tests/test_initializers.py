@@ -1,0 +1,85 @@
+"""Initializer statistics vs the reference definitions
+(python/paddle/fluid/initializer.py): fan math, bounds, and the
+bilinear upsampling kernel's interpolation property."""
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import initializer, layers
+from paddle_tpu.framework.scope import Scope, scope_guard
+
+
+def _materialize(init, shape):
+    main, startup = pt.Program(), pt.Program()
+    with pt.unique_name.guard(), pt.program_guard(main, startup):
+        layers.create_parameter(shape, "float32", name="init_w",
+                                default_initializer=init)
+    sc = Scope()
+    with scope_guard(sc):
+        exe = pt.Executor()
+        exe.run(startup)
+        return np.asarray(sc.find_var("init_w"))
+
+
+def test_xavier_uniform_bound():
+    fan_in, fan_out = 64, 256
+    w = _materialize(initializer.XavierInitializer(uniform=True),
+                     [fan_in, fan_out])
+    limit = np.sqrt(6.0 / (fan_in + fan_out))
+    assert np.abs(w).max() <= limit + 1e-6
+    # fills a decent fraction of the range (not degenerate)
+    assert np.abs(w).max() > 0.8 * limit
+    assert abs(w.mean()) < 0.05 * limit
+
+
+def test_msra_normal_std():
+    fan_in = 512
+    w = _materialize(initializer.MSRAInitializer(uniform=False),
+                     [fan_in, 256])
+    want_std = np.sqrt(2.0 / fan_in)
+    assert 0.9 * want_std < w.std() < 1.1 * want_std
+
+
+def test_truncated_normal_bounds():
+    scale = 0.02
+    w = _materialize(
+        initializer.TruncatedNormalInitializer(scale=scale), [64, 64])
+    assert np.abs(w).max() <= 2.0 * scale + 1e-6
+    assert w.std() > 0.5 * scale
+
+
+def test_bilinear_kernel_interpolates():
+    """The bilinear conv_transpose kernel must upsample a constant map
+    to a constant map (interior) — the defining property the reference
+    docstring demonstrates."""
+    import paddle_tpu
+    from paddle_tpu.framework.scope import Scope, scope_guard
+    factor = 2
+    ks = 2 * factor - factor % 2
+    main, startup = pt.Program(), pt.Program()
+    with pt.unique_name.guard(), pt.program_guard(main, startup):
+        x = layers.data("bx", [1, 1, 4, 4], "float32",
+                        append_batch_size=False)
+        y = layers.conv2d_transpose(
+            x, 1, filter_size=ks, stride=factor,
+            padding=int(np.ceil((factor - 1) / 2.0)),
+            param_attr=pt.ParamAttr(
+                name="bil_w",
+                initializer=initializer.BilinearInitializer()),
+            bias_attr=False)
+    with scope_guard(Scope()):
+        exe = pt.Executor()
+        exe.run(startup)
+        out, = exe.run(main, feed={
+            "bx": np.ones((1, 1, 4, 4), np.float32)},
+            fetch_list=[y])
+    out = np.asarray(out)[0, 0]
+    # interior of the upsampled constant image stays 1.0
+    np.testing.assert_allclose(out[1:-1, 1:-1], 1.0, rtol=1e-5)
+
+
+def test_constant_and_numpy_array():
+    w = _materialize(initializer.ConstantInitializer(2.5), [3, 3])
+    np.testing.assert_allclose(w, 2.5)
+    arr = np.arange(6, dtype=np.float32).reshape(2, 3)
+    w = _materialize(initializer.NumpyArrayInitializer(arr), [2, 3])
+    np.testing.assert_allclose(w, arr)
