@@ -125,9 +125,24 @@ class Executor(object):
     def infer_from_dataset(self, program=None, dataset=None, scope=None,
                            thread=0, debug=False, fetch_list=None,
                            fetch_info=None, print_period=100):
-        """Same loop with no parameter updates expected in `program`
-        (reference infer_from_dataset disables gradient push; here the
-        program simply contains no optimizer ops)."""
+        """Same loop, but the program must be inference-only. The reference
+        disables gradient push (python/paddle/fluid/executor.py:1061); a
+        jitted step has no push to disable, so the equivalent safety is
+        rejecting programs that would update parameters — otherwise
+        "inference" on a training program silently trains."""
+        program = program if program is not None else default_main_program()
+        # lr_sched ops mutate persistable schedule counters — the same
+        # "inference advances training state" trap clone(for_test=True)
+        # strips them for (program.py clone).
+        update_ops = sorted({
+            op.type for blk in program.blocks for op in blk.ops
+            if op.attrs.get("op_role") in ("optimize", "lr_sched")})
+        if update_ops:
+            raise ValueError(
+                "infer_from_dataset got a program containing parameter-"
+                "update ops %s; pass the inference program (e.g. "
+                "program.clone(for_test=True) taken BEFORE minimize(), or "
+                "use train_from_dataset to train)" % (update_ops,))
         return self.train_from_dataset(program, dataset, scope, thread,
                                        debug, fetch_list, fetch_info,
                                        print_period)

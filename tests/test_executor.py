@@ -136,3 +136,40 @@ def test_wrong_rank_feed_named_error():
     with pytest.raises(ValueError, match="rank_x.*rank"):
         exe.run(main, feed={"rank_x": np.ones(4, np.float32)},  # rank 1
                 fetch_list=[y])                                 # wants 2
+
+
+def test_infer_from_dataset_rejects_training_program():
+    """infer_from_dataset must refuse a program with parameter-update ops
+    (reference executor.py:1061 disables gradient push; ours validates) —
+    and accept the for_test clone of the same model."""
+    import pytest
+    import paddle_tpu as pt
+    from paddle_tpu import layers, optimizer
+
+    class ListDataset(object):
+        def __init__(self, batches):
+            self._batches = batches
+
+        def __iter__(self):
+            return iter(self._batches)
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [4], "float32")
+        y = layers.fc(x, size=1)
+        lbl = layers.data("y", [1], "float32")
+        loss = layers.reduce_mean(layers.square_error_cost(y, lbl))
+        test_prog = main.clone(for_test=True)
+        optimizer.SGD(0.1).minimize(loss)
+    exe = pt.Executor()
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    batches = [{"x": rng.rand(8, 4).astype(np.float32),
+                "y": rng.rand(8, 1).astype(np.float32)} for _ in range(3)]
+    with pytest.raises(ValueError, match="parameter-update ops"):
+        exe.infer_from_dataset(main, ListDataset(batches),
+                               fetch_list=[loss])
+    steps, last = exe.infer_from_dataset(test_prog, ListDataset(batches),
+                                         fetch_list=[loss])
+    assert steps == 3
+    assert np.isfinite(np.asarray(last[0])).all()
