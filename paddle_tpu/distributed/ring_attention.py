@@ -30,9 +30,10 @@ def _ring_perm(n):
     return [(i, (i + 1) % n) for i in range(n)]
 
 
-def _block_logits(q, kk, my_idx, kv_idx, scale, causal):
+def _block_logits(q, kk, my_idx, kv_idx, scale, causal, mm=None):
     """Scaled (and causally masked) logits of the local Q shard against a
-    visiting K block."""
+    visiting K block. `mm` is the visiting ADDITIVE key-padding mask block
+    (..., 1, Tk_block) riding the ring with its K/V block."""
     tl = q.shape[2]
     logits = jnp.einsum("bhqd,bhkd->bhqk", q, kk,
                         preferred_element_type=jnp.float32) * scale
@@ -41,12 +42,16 @@ def _block_logits(q, kk, my_idx, kv_idx, scale, causal):
         k_pos = kv_idx * tl + jnp.arange(tl)
         mask = q_pos[:, None] >= k_pos[None, :]
         logits = jnp.where(mask[None, None], logits, -1e30)
+    if mm is not None:
+        logits = logits + mm.astype(jnp.float32)
     return logits
 
 
-def _ring_forward(q, k, v, axis_name, causal, scale):
+def _ring_forward(q, k, v, axis_name, causal, scale, mask=None):
     """Online-softmax ring pass. Returns (out, lse) where lse is the
-    per-row log-sum-exp — the only statistic backward needs."""
+    per-row log-sum-exp — the only statistic backward needs. `mask` is
+    this shard's additive key-padding block (..., 1, Tk_local); it rides
+    the ring with its K/V block."""
     n = lax.axis_size(axis_name)
     my_idx = lax.axis_index(axis_name)
     b, h, tl, d = q.shape
@@ -56,11 +61,16 @@ def _ring_forward(q, k, v, axis_name, causal, scale):
     row_sum = _vary(jnp.zeros((b, h, tl), jnp.float32), axis_name)
 
     perm = _ring_perm(n)
+    has_mask = mask is not None
 
     def block(carry, step):
-        acc, row_max, row_sum, kk, vv = carry
+        if has_mask:
+            acc, row_max, row_sum, kk, vv, mm = carry
+        else:
+            acc, row_max, row_sum, kk, vv = carry
+            mm = None
         kv_idx = (my_idx - step) % n
-        logits = _block_logits(q, kk, my_idx, kv_idx, scale, causal)
+        logits = _block_logits(q, kk, my_idx, kv_idx, scale, causal, mm)
         blk_max = jnp.max(logits, axis=-1)
         new_max = jnp.maximum(row_max, blk_max)
         correction = jnp.exp(row_max - new_max)
@@ -70,10 +80,16 @@ def _ring_forward(q, k, v, axis_name, causal, scale):
         row_sum = row_sum * correction + jnp.sum(p, axis=-1)
         kk = lax.ppermute(kk, axis_name, perm)
         vv = lax.ppermute(vv, axis_name, perm)
+        if has_mask:
+            mm = lax.ppermute(mm, axis_name, perm)
+            return (acc, new_max, row_sum, kk, vv, mm), None
         return (acc, new_max, row_sum, kk, vv), None
 
-    (acc, row_max, row_sum, _, _), _ = lax.scan(
-        block, (acc, row_max, row_sum, k, v), jnp.arange(n))
+    carry0 = (acc, row_max, row_sum, k, v)
+    if has_mask:
+        carry0 = carry0 + (mask,)
+    carry, _ = lax.scan(block, carry0, jnp.arange(n))
+    acc, row_max, row_sum = carry[0], carry[1], carry[2]
     safe_sum = jnp.maximum(row_sum, 1e-30)
     out = acc / safe_sum[..., None]
     lse = row_max + jnp.log(safe_sum)
@@ -88,17 +104,9 @@ def _make_local(axis_name, causal, scale):
     rotate around the ring in lockstep with their K/V blocks and arrive
     home after n hops with every device's contribution."""
 
-    @jax.custom_vjp
-    def attn(q, k, v):
-        out, _ = _ring_forward(q, k, v, axis_name, causal, scale)
-        return out
-
-    def fwd(q, k, v):
-        out, lse = _ring_forward(q, k, v, axis_name, causal, scale)
-        return out, (q, k, v, out, lse)
-
-    def bwd(res, dout):
-        q, k, v, out, lse = res
+    def _bwd_ring(q, k, v, mask, out, lse, dout):
+        """Shared ring-replay backward; mask (or None) rides the ring in
+        lockstep with its K/V block exactly as in forward."""
         n = lax.axis_size(axis_name)
         my_idx = lax.axis_index(axis_name)
         dout32 = dout.astype(jnp.float32)
@@ -108,11 +116,17 @@ def _make_local(axis_name, causal, scale):
         dk0 = _vary(jnp.zeros(k.shape, jnp.float32), axis_name)
         dv0 = _vary(jnp.zeros(v.shape, jnp.float32), axis_name)
         perm = _ring_perm(n)
+        has_mask = mask is not None
 
         def block(carry, step):
-            dq, kk, vv, dkk, dvv = carry
+            if has_mask:
+                dq, kk, vv, dkk, dvv, mm = carry
+            else:
+                dq, kk, vv, dkk, dvv = carry
+                mm = None
             kv_idx = (my_idx - step) % n
-            logits = _block_logits(q, kk, my_idx, kv_idx, scale, causal)
+            logits = _block_logits(q, kk, my_idx, kv_idx, scale, causal,
+                                   mm)
             p = jnp.exp(logits - lse[..., None])      # (B,H,Tq,Tk)
             dvv = dvv + jnp.einsum("bhqk,bhqd->bhkd", p, dout32)
             dp = jnp.einsum("bhqd,bhkd->bhqk", dout32,
@@ -126,20 +140,62 @@ def _make_local(axis_name, causal, scale):
             vv = lax.ppermute(vv, axis_name, perm)
             dkk = lax.ppermute(dkk, axis_name, perm)
             dvv = lax.ppermute(dvv, axis_name, perm)
+            if has_mask:
+                mm = lax.ppermute(mm, axis_name, perm)
+                return (dq, kk, vv, dkk, dvv, mm), None
             return (dq, kk, vv, dkk, dvv), None
 
-        (dq, _, _, dk, dv), _ = lax.scan(
-            block, (dq0, k, v, dk0, dv0), jnp.arange(n))
+        carry0 = (dq0, k, v, dk0, dv0)
+        if has_mask:
+            carry0 = carry0 + (mask,)
+        carry, _ = lax.scan(block, carry0, jnp.arange(n))
+        dq, dk, dv = carry[0], carry[3], carry[4]
         return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
+    @jax.custom_vjp
+    def attn(q, k, v):
+        out, _ = _ring_forward(q, k, v, axis_name, causal, scale)
+        return out
+
+    def fwd(q, k, v):
+        out, lse = _ring_forward(q, k, v, axis_name, causal, scale)
+        return out, (q, k, v, out, lse)
+
+    def bwd(res, dout):
+        q, k, v, out, lse = res
+        return _bwd_ring(q, k, v, None, out, lse, dout)
+
     attn.defvjp(fwd, bwd)
-    return attn
+
+    @jax.custom_vjp
+    def attn_masked(q, k, v, mask):
+        out, _ = _ring_forward(q, k, v, axis_name, causal, scale, mask)
+        return out
+
+    def fwd_m(q, k, v, mask):
+        out, lse = _ring_forward(q, k, v, axis_name, causal, scale, mask)
+        return out, (q, k, v, mask, out, lse)
+
+    def bwd_m(res, dout):
+        q, k, v, mask, out, lse = res
+        dq, dk, dv = _bwd_ring(q, k, v, mask, out, lse, dout)
+        # additive key-padding masks come from stop_gradient feeds; a
+        # symbolic-zero cotangent keeps the vjp total
+        return dq, dk, dv, jnp.zeros_like(mask)
+
+    attn_masked.defvjp(fwd_m, bwd_m)
+    return attn, attn_masked
 
 
-def ring_attention(q, k, v, mesh=None, axis_name="sp", causal=False,
-                   scale=None):
+def ring_attention(q, k, v, mask=None, mesh=None, axis_name="sp",
+                   causal=False, scale=None):
     """q,k,v: (B, H, T, D) arrays (or sharded jax.Arrays); T sharded on
-    `axis_name`. Returns attention output with the same sharding."""
+    `axis_name`. `mask` is an optional ADDITIVE key-padding mask
+    broadcastable as (..., 1, T) — e.g. BERT's (B, 1, 1, T) attn bias;
+    its key axis is sharded over the ring and each block travels with
+    its K/V block. Per-query masks (Tq > 1 in dim -2) can't ride the
+    ring (the query shard stays home) — use ulysses_attention for those.
+    Returns attention output with the same sharding as q."""
     from .mesh import get_mesh
     mesh = mesh or get_mesh()
     if mesh is None or axis_name not in mesh.axis_names:
@@ -148,7 +204,17 @@ def ring_attention(q, k, v, mesh=None, axis_name="sp", causal=False,
     if scale is None:
         scale = q.shape[-1] ** -0.5
     spec = P(None, None, axis_name, None)
-    fn = shard_map(
-        _make_local(axis_name, causal, scale),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
-    return fn(q, k, v)
+    attn, attn_masked = _make_local(axis_name, causal, scale)
+    if mask is None:
+        fn = shard_map(attn, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec)
+        return fn(q, k, v)
+    if mask.ndim < 2 or mask.shape[-2] != 1:
+        raise ValueError(
+            "ring_attention mask must be a key-padding mask broadcastable "
+            "as (..., 1, T); got shape %r — per-query masks need "
+            "ulysses_attention" % (tuple(mask.shape),))
+    mspec = P(*([None] * (mask.ndim - 1) + [axis_name]))
+    fn = shard_map(attn_masked, mesh=mesh,
+                   in_specs=(spec, spec, spec, mspec), out_specs=spec)
+    return fn(q, k, v, mask)

@@ -28,17 +28,17 @@ except Exception:  # pragma: no cover
     from jax.experimental.shard_map import shard_map  # type: ignore
 
 
-def _local_attention(q, k, v, scale, causal):
+def _local_attention(q, k, v, scale, causal, mask=None):
     """Exact attention on the local head group over the FULL sequence —
     through the Pallas flash kernel (O(T) memory, VMEM-tiled online
     softmax; falls back to fused XLA attention off-TPU / for small
     tiles), so long sequences never materialize (T, T) scores."""
     from ..ops.pallas.flash_attention import flash_attention
-    return flash_attention(q, k, v, scale=scale, causal=causal)
+    return flash_attention(q, k, v, mask=mask, scale=scale, causal=causal)
 
 
-def _make_local(axis_name, causal, scale):
-    def local(q, k, v):
+def _make_local(axis_name, causal, scale, mask_gather_axis=None):
+    def local(q, k, v, *mask_arg):
         # (B, H, T/n, D) local -> all_to_all -> (B, H/n, T, D) local:
         # split the head axis across the group, concatenate the seq axis
         qh = lax.all_to_all(q, axis_name, split_axis=1, concat_axis=2,
@@ -47,18 +47,30 @@ def _make_local(axis_name, causal, scale):
                             tiled=True)
         vh = lax.all_to_all(v, axis_name, split_axis=1, concat_axis=2,
                             tiled=True)
-        out = _local_attention(qh, kh, vh, scale, causal)
+        mask = None
+        if mask_arg:
+            # additive masks have no head axis to exchange (dim 1 is
+            # broadcast): gather the full sequence axis instead — each
+            # device now sees the full sequence for its head group, so
+            # any mask shape works unchanged
+            mask = lax.all_gather(mask_arg[0], axis_name,
+                                  axis=mask_gather_axis, tiled=True)
+        out = _local_attention(qh, kh, vh, scale, causal, mask)
         # inverse exchange: heads back together, sequence re-sharded
         return lax.all_to_all(out, axis_name, split_axis=2, concat_axis=1,
                               tiled=True)
     return local
 
 
-def ulysses_attention(q, k, v, mesh=None, axis_name="sp", causal=False,
-                      scale=None):
+def ulysses_attention(q, k, v, mask=None, mesh=None, axis_name="sp",
+                      causal=False, scale=None):
     """q,k,v: (B, H, T, D) arrays (or sharded jax.Arrays); T sharded on
-    `axis_name`. num_heads must divide by the axis size. Returns
-    attention output with the same sharding as the inputs."""
+    `axis_name`. num_heads must divide by the axis size. `mask` is an
+    optional ADDITIVE attention mask: key-padding (..., 1, T) masks are
+    sharded on their key axis, per-query (..., Tq, Tk) masks on their
+    query axis; either is all-gathered inside the shard (each device
+    sees the full sequence for its head group, so any mask works).
+    Returns attention output with the same sharding as the inputs."""
     from .mesh import get_mesh
     mesh = mesh or get_mesh()
     if mesh is None or axis_name not in mesh.axis_names:
@@ -73,13 +85,29 @@ def ulysses_attention(q, k, v, mesh=None, axis_name="sp", causal=False,
     if scale is None:
         scale = q.shape[-1] ** -0.5
     spec = P(None, None, axis_name, None)
-    local = _make_local(axis_name, causal, scale)
+    in_specs = (spec, spec, spec)
+    args = (q, k, v)
+    gather_axis = None
+    if mask is not None:
+        # shard the mask on its sequence axis: key axis for key-padding
+        # masks (dim -2 == 1), query axis for per-query masks
+        gather_axis = mask.ndim - 1 if mask.shape[-2] == 1 else mask.ndim - 2
+        if mask.shape[gather_axis] % n:
+            raise ValueError(
+                "ulysses_attention: mask axis %d (size %d) must divide "
+                "the %r axis size (%d)"
+                % (gather_axis, mask.shape[gather_axis], axis_name, n))
+        mspec = [None] * mask.ndim
+        mspec[gather_axis] = axis_name
+        in_specs = in_specs + (P(*mspec),)
+        args = args + (mask,)
+    local = _make_local(axis_name, causal, scale, gather_axis)
     try:
         # the flash pallas_call's output avals carry no vma annotation,
         # so varying-mode checking must be off inside this body
-        fn = shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+        fn = shard_map(local, mesh=mesh, in_specs=in_specs,
                        out_specs=spec, check_vma=False)
     except TypeError:  # pragma: no cover - older jax: check_rep
-        fn = shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+        fn = shard_map(local, mesh=mesh, in_specs=in_specs,
                        out_specs=spec, check_rep=False)
-    return fn(q, k, v)
+    return fn(*args)

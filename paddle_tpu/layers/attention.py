@@ -39,9 +39,10 @@ def fused_attention(q, k, v, mask=None, scale=None, causal=False,
     Reference composes this from matmul+softmax+matmul ops.
 
     impl: "auto" | "xla" | "flash" | "ring" | "ulysses" — the last two
-    run sequence-parallel attention over the installed mesh's `sp_axis`
-    (causal masking only): ring rotates K/V blocks via ppermute; ulysses
-    re-shards heads via all_to_all."""
+    run sequence-parallel attention over the installed mesh's `sp_axis`:
+    ring rotates K/V blocks via ppermute and accepts additive
+    key-padding masks (..., 1, T) riding the ring; ulysses re-shards
+    heads via all_to_all and accepts any additive mask."""
     helper = LayerHelper("fused_attention", name=name)
     out = helper.create_variable_for_type_inference(q.dtype, q.shape)
     inputs = {"Q": [q.name], "K": [k.name], "V": [v.name]}
@@ -81,9 +82,12 @@ def mha_kv_projection(keys, values, d_key, d_value, n_head,
 def multi_head_attention(queries, keys, values, attn_bias, d_key, d_value,
                          d_model, n_head=1, dropout_rate=0.0, cache=None,
                          param_initializer=None, name="multi_head_att",
-                         is_test=False, causal=False):
+                         is_test=False, causal=False, attn_impl="auto"):
     """The transformer MHA block used by ERNIE/BERT/Transformer models
-    (mirrors PaddlePaddle/models transformer.multi_head_attention)."""
+    (mirrors PaddlePaddle/models transformer.multi_head_attention).
+    attn_impl routes the fused attention op ("auto" | "xla" | "flash" |
+    "ring" | "ulysses") — the sequence-parallel paths accept attn_bias
+    key-padding masks (BERT's (N,1,1,T) bias rides the ring with K/V)."""
     keys = queries if keys is None else keys
     values = keys if values is None else values
 
@@ -117,7 +121,8 @@ def multi_head_attention(queries, keys, values, attn_bias, d_key, d_value,
             if queries.shape[1] == 1:
                 causal = False    # single newest query sees the whole cache
     ctx = fused_attention(qh, kh, vh, mask=attn_bias,
-                          scale=d_key ** -0.5, causal=causal)
+                          scale=d_key ** -0.5, causal=causal,
+                          impl=attn_impl)
     ctx = transpose(ctx, [0, 2, 1, 3])
     ctx = reshape(ctx, [0, -1 if queries.shape[1] == -1 else queries.shape[1],
                         d_value * n_head])

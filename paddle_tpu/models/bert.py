@@ -24,7 +24,7 @@ class BertConfig(object):
                  num_heads=12, ff_size=3072, max_position=512,
                  type_vocab_size=2, hidden_dropout=0.1, attn_dropout=0.1,
                  initializer_range=0.02, dtype="float32", tp=False,
-                 recompute=False):
+                 recompute=False, attn_impl="auto"):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -37,6 +37,10 @@ class BertConfig(object):
         self.initializer_range = initializer_range
         self.dtype = dtype
         self.tp = tp
+        # "ring"/"ulysses" shard the sequence over the mesh's sp axis;
+        # the (N,1,1,T) padding bias rides along (key-padding masks are
+        # first-class in both sequence-parallel paths)
+        self.attn_impl = attn_impl
         # rematerialize each encoder layer (jax.checkpoint): ~T*H HBM per
         # layer traded for one extra forward in backward — how long-context
         # / large-batch configs fit on a chip
@@ -71,7 +75,7 @@ def encoder_layer(x, attn_bias, cfg, name, is_test=False):
         x, None, None, attn_bias, d // cfg.num_heads, d // cfg.num_heads,
         d, n_head=cfg.num_heads, dropout_rate=cfg.attn_dropout,
         param_initializer=_init(cfg), name=name + "_multi_head_att",
-        is_test=is_test)
+        is_test=is_test, attn_impl=getattr(cfg, "attn_impl", "auto"))
     if cfg.hidden_dropout:
         attn = layers.dropout(attn, cfg.hidden_dropout, is_test=is_test,
                               dropout_implementation="upscale_in_train")

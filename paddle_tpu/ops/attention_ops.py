@@ -30,6 +30,24 @@ def _sdpa_xla(q, k, v, mask, scale, causal):
     return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v)
 
 
+def _sp_routable(impl, q, k, mask, n):
+    """Whether this call CAN run sequence-parallel over an n-way axis —
+    the env hint must stay a hint: shapes that don't shard keep their
+    auto fallback instead of raising inside shard_map."""
+    if q.shape[-2] % n or k.shape[-2] % n or q.shape[-2] != k.shape[-2]:
+        return False
+    if impl == "ulysses":
+        if q.shape[1] % n:
+            return False
+        if mask is not None:
+            ax = mask.ndim - 1 if mask.shape[-2] == 1 else mask.ndim - 2
+            return mask.shape[ax] % n == 0
+        return True
+    if mask is not None:
+        return mask.shape[-2] == 1 and mask.shape[-1] % n == 0
+    return True
+
+
 @register_op("scaled_dot_product_attention")
 def _sdpa(ctx, ins, attrs):
     q, k, v = ins["Q"][0], ins["K"][0], ins["V"][0]
@@ -48,9 +66,10 @@ def _sdpa(ctx, ins, attrs):
         if env_impl in ("ring", "ulysses"):
             from ..distributed.mesh import get_mesh
             m = get_mesh()
-            if mask is None and m is not None and \
-                    attrs.get("sp_axis", "sp") in m.axis_names:
-                impl = env_impl
+            if m is not None and attrs.get("sp_axis", "sp") in m.axis_names:
+                n = m.shape[attrs.get("sp_axis", "sp")]
+                if _sp_routable(env_impl, q, k, mask, n):
+                    impl = env_impl
         else:
             impl = env_impl
     if impl == "auto" and q.shape[-2] * k.shape[-2] <= 256 * 256:
@@ -69,18 +88,19 @@ def _sdpa(ctx, ins, attrs):
             raise ValueError(
                 "fused_attention(impl=%r) needs init_mesh/fleet.init with "
                 "a %r mesh axis" % (impl, axis))
-        if mask is not None:
-            raise ValueError(
-                "fused_attention(impl=%r) supports causal masking only; "
-                "additive masks don't survive the sequence re-sharding"
-                % impl)
         if impl == "ring":
+            if mask is not None and mask.shape[-2] != 1:
+                raise ValueError(
+                    "fused_attention(impl='ring') supports key-padding "
+                    "masks (..., 1, T) only — the mask's key axis rides "
+                    "the ring with K/V; per-query masks need "
+                    "impl='ulysses'")
             from ..distributed.ring_attention import ring_attention
-            return {"Out": ring_attention(q, k, v, mesh=mesh,
+            return {"Out": ring_attention(q, k, v, mask=mask, mesh=mesh,
                                           axis_name=axis, causal=causal,
                                           scale=scale)}
         from ..distributed.ulysses_attention import ulysses_attention
-        return {"Out": ulysses_attention(q, k, v, mesh=mesh,
+        return {"Out": ulysses_attention(q, k, v, mask=mask, mesh=mesh,
                                          axis_name=axis, causal=causal,
                                          scale=scale)}
     if impl in ("auto", "flash"):
