@@ -114,8 +114,21 @@ INFERENCE_FORMAT_VERSION = 2
 def save_inference_model(dirname, feeded_var_names, target_vars, executor,
                          main_program=None, model_filename=None,
                          params_filename=None, export_for_deployment=True,
-                         program_only=False):
-    """Freeze: clone for_test, prune to feeds/targets, save IR + params."""
+                         program_only=False, format="default",
+                         batch_sizes=(1, 8, 32)):
+    """Freeze: clone for_test, prune to feeds/targets, save IR + params.
+
+    format="stablehlo" additionally writes a deployable serving artifact
+    under dirname/serving/ — serialized jax.export blobs plus StableHLO
+    MLIR text a C++ PjRt service can compile without Python (the
+    reference's C++ PaddlePredictor capability, paddle_api.h:148); load
+    with paddle_tpu.serving.load_serving_artifact. batch_sizes are the
+    exported batch buckets (XLA artifacts are static-shape)."""
+    if format not in ("default", "stablehlo"):
+        # validate BEFORE writing anything: a typo'd format must not
+        # leave a half-configured artifact directory behind
+        raise ValueError("save_inference_model format must be 'default' "
+                         "or 'stablehlo', got %r" % (format,))
     program = main_program or default_main_program()
     test_prog = program.clone(for_test=True)
     target_names = [v.name for v in target_vars]
@@ -137,6 +150,11 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
                   json.dumps(meta))
     if arrays is not None:
         _atomic_savez(dirname, params_filename or PARAMS_FILE, arrays)
+    if format == "stablehlo":
+        from .serving import export_serving_artifact
+        export_serving_artifact(dirname, feeded_var_names, target_vars,
+                                executor, batch_sizes=batch_sizes,
+                                pruned_program=pruned)
     return target_names
 
 

@@ -1,0 +1,215 @@
+"""Deployable serving artifacts — "train here, serve anywhere".
+
+Reference parity: the C++ predictor API
+(/root/reference/paddle/fluid/inference/api/paddle_api.h:148
+PaddlePredictor/ZeroCopyTensor and analysis_predictor.h:47) lets trained
+models serve from non-Python daemons. The TPU-native equivalent is a
+serialized StableHLO artifact via jax.export: the pruned inference
+Program is traced ONCE into a single XLA computation with the trained
+weights baked in as constants, then serialized to
+
+  serving/meta.json          feed/fetch names, shapes, dtypes, buckets
+  serving/export_b{N}.bin    jax.export bytes (deserialize + call)
+  serving/module_b{N}.mlir   StableHLO text — a C++ PjRt client can
+                             compile this module directly, no Python
+
+One export per batch bucket (XLA computations are static-shape; the
+loader pads requests up to the nearest bucket, same policy as
+inference.Predictor's compile cache).
+"""
+import json
+import os
+
+import numpy as np
+
+MODULE_SUBDIR = "serving"
+SERVING_FORMAT_VERSION = 1
+
+
+def _infer_fn(program, feed_names, fetch_names, scope):
+    """Close the trained weights over a pure (feeds) -> fetches function.
+
+    jax.export turns closure arrays into embedded constants, which is
+    exactly the frozen-artifact contract: the .bin is self-contained."""
+    import jax
+    from .framework import executor as ex_mod
+    from .framework.trace import TraceContext, trace_block
+
+    persistable = ex_mod._persistable_names(program)
+    state = {n: scope.find_var(n) for n in sorted(persistable)
+             if scope.find_var(n) is not None}
+
+    def fn(*feeds):
+        env = dict(state)
+        env.update(zip(feed_names, feeds))
+        ctx = TraceContext(program, jax.random.PRNGKey(0), frozenset())
+        trace_block(program.global_block(), env, ctx)
+        return tuple(env[n] for n in fetch_names)
+
+    return fn
+
+
+def _feed_avals(program, feed_names, batch):
+    """ShapeDtypeStructs for the feeds at one bucket size; a leading -1
+    (append_batch_size) dim becomes the bucket batch. Returns
+    (avals, batch_dyn) where batch_dyn[i] says feed i's dim 0 is the
+    request batch — the loader pads ONLY those feeds."""
+    import jax
+    from .framework.dtypes import to_jax_dtype
+    blk = program.global_block()
+    avals, batch_dyn = [], []
+    for name in feed_names:
+        var = blk.var(name)
+        shape = list(var.shape)
+        dyn = bool(shape) and shape[0] == -1
+        if dyn:
+            shape[0] = batch
+        batch_dyn.append(dyn)
+        if any(s is None or s < 0 for s in shape):
+            raise ValueError(
+                "serving export: feed %r has non-batch dynamic dims %s — "
+                "XLA serving artifacts are static-shape" % (name, shape))
+        avals.append(jax.ShapeDtypeStruct(tuple(shape),
+                                          to_jax_dtype(var.dtype)))
+    return avals, batch_dyn
+
+
+def export_serving_artifact(dirname, feeded_var_names, target_vars,
+                            executor=None, main_program=None,
+                            batch_sizes=(1, 8, 32), scope=None,
+                            pruned_program=None):
+    """Freeze + export the inference program as StableHLO.
+
+    Writes under dirname/serving/. target_vars may be Variables or names.
+    pruned_program skips the clone+prune when the caller (e.g.
+    save_inference_model) already froze the program. Returns the list of
+    written export paths."""
+    import jax
+    from jax import export as jax_export
+    from .framework.program import default_main_program
+    from .framework.scope import global_scope
+
+    scope = scope or global_scope()
+    target_names = [getattr(v, "name", v) for v in target_vars]
+    if pruned_program is not None:
+        pruned = pruned_program
+    else:
+        program = main_program or default_main_program()
+        test_prog = program.clone(for_test=True)
+        pruned = test_prog._prune(list(feeded_var_names), target_names)
+
+    out_dir = os.path.join(dirname, MODULE_SUBDIR)
+    os.makedirs(out_dir, exist_ok=True)
+    fn = _infer_fn(pruned, list(feeded_var_names), target_names, scope)
+
+    _, batch_dyn = _feed_avals(pruned, feeded_var_names, batch_sizes[0])
+    dynamic = any(batch_dyn)
+    buckets = sorted(set(batch_sizes)) if dynamic else [0]
+
+    written, bucket_meta = [], {}
+    for b in buckets:
+        avals, _ = _feed_avals(pruned, feeded_var_names, b or 1)
+        exported = jax_export.export(jax.jit(fn))(*avals)
+        blob = exported.serialize()
+        bin_path = os.path.join(out_dir, "export_b%d.bin" % b)
+        with open(bin_path, "wb") as f:
+            f.write(blob)
+        with open(os.path.join(out_dir, "module_b%d.mlir" % b), "w") as f:
+            f.write(exported.mlir_module())
+        written.append(bin_path)
+        bucket_meta[str(b)] = {
+            "feeds": [{"name": n, "shape": list(a.shape),
+                       "dtype": np.dtype(a.dtype).name}
+                      for n, a in zip(feeded_var_names, avals)]}
+
+    meta = {"format_version": SERVING_FORMAT_VERSION,
+            "feed_var_names": list(feeded_var_names),
+            "fetch_var_names": target_names,
+            "dynamic_batch": dynamic,
+            "feed_batch_dynamic": batch_dyn,
+            "buckets": bucket_meta}
+    with open(os.path.join(out_dir, "meta.json"), "w") as f:
+        json.dump(meta, f, indent=1)
+    return written
+
+
+class ServingPredictor(object):
+    """Thin loader for the StableHLO artifact: deserialize + call.
+
+    Python twin of the C++ load path (a non-Python service compiles
+    module_b{N}.mlir with PjRt instead). Pads requests up to the nearest
+    exported bucket and slices results back — the inference.Predictor
+    contract."""
+
+    def __init__(self, dirname):
+        from jax import export as jax_export
+        out_dir = os.path.join(dirname, MODULE_SUBDIR)
+        with open(os.path.join(out_dir, "meta.json")) as f:
+            self._meta = json.load(f)
+        if self._meta["format_version"] > SERVING_FORMAT_VERSION:
+            raise ValueError(
+                "serving artifact %s has format_version %d, newer than "
+                "this library's %d"
+                % (dirname, self._meta["format_version"],
+                   SERVING_FORMAT_VERSION))
+        self._feed_names = self._meta["feed_var_names"]
+        self._fetch_names = self._meta["fetch_var_names"]
+        self._fns = {}
+        for key in self._meta["buckets"]:
+            with open(os.path.join(out_dir, "export_b%s.bin" % key),
+                      "rb") as f:
+                self._fns[int(key)] = jax_export.deserialize(f.read())
+
+    def get_input_names(self):
+        return list(self._feed_names)
+
+    def get_output_names(self):
+        return list(self._fetch_names)
+
+    def _bucket(self, n):
+        for b in sorted(self._fns):
+            if n <= b:
+                return b
+        raise ValueError(
+            "request batch %d exceeds the largest exported bucket %d — "
+            "re-export with a larger batch_sizes entry"
+            % (n, max(self._fns)))
+
+    def run(self, inputs):
+        """inputs: dict name -> array (or list aligned with feed names).
+        Returns list of np arrays aligned with fetch names."""
+        if isinstance(inputs, (list, tuple)):
+            inputs = dict(zip(self._feed_names, inputs))
+        if not self._meta["dynamic_batch"]:
+            outs = self._fns[0].call(
+                *[np.asarray(inputs[n]) for n in self._feed_names])
+            return [np.asarray(o) for o in outs]
+        # the request batch comes from a feed whose exported dim 0 IS the
+        # batch (feed_batch_dynamic from export) — never from dict order
+        batch_dyn = self._meta["feed_batch_dynamic"]
+        n = None
+        for name, dyn in zip(self._feed_names, batch_dyn):
+            if dyn:
+                n = np.asarray(inputs[name]).shape[0]
+                break
+        b = self._bucket(n)
+        feeds = []
+        for name, dyn in zip(self._feed_names, batch_dyn):
+            arr = np.asarray(inputs[name])
+            if dyn and arr.shape[0] != b:
+                if arr.shape[0] > b:
+                    raise ValueError(
+                        "feed %r has batch %d but batch was inferred as "
+                        "%d (bucket %d) — batch-dynamic feeds must agree"
+                        % (name, arr.shape[0], n, b))
+                pad = [(0, b - arr.shape[0])] + [(0, 0)] * (arr.ndim - 1)
+                arr = np.pad(arr, pad)
+            feeds.append(arr)
+        outs = self._fns[b].call(*feeds)
+        return [np.asarray(o)[:n]
+                if np.ndim(o) > 0 and np.shape(o)[0] == b else np.asarray(o)
+                for o in outs]
+
+
+def load_serving_artifact(dirname):
+    return ServingPredictor(dirname)

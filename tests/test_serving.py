@@ -1,0 +1,94 @@
+"""StableHLO serving artifact: export -> load -> predict parity
+(reference capability: C++ PaddlePredictor, paddle_api.h:148)."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+
+
+def _build_and_train():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [6], dtype="float32")
+        h = layers.fc(x, 8, act="relu")
+        y = layers.softmax(layers.fc(h, 3))
+    exe = pt.Executor()
+    exe.run(startup)
+    return main, exe, y
+
+
+def test_stablehlo_export_roundtrip_matches_predictor(tmp_path):
+    """export -> load_serving_artifact -> run must match BOTH the live
+    Executor and the in-process Predictor bit-for-bit-ish (VERDICT r4
+    next #6 'done' criterion)."""
+    main, exe, y = _build_and_train()
+    xv = np.random.RandomState(0).rand(5, 6).astype(np.float32)
+    ref, = exe.run(main, feed={"x": xv}, fetch_list=[y])
+
+    pt.save_inference_model(str(tmp_path), ["x"], [y], exe,
+                            main_program=main, format="stablehlo",
+                            batch_sizes=(1, 8))
+    # artifact files exist: serialized export + MLIR text per bucket
+    sdir = os.path.join(str(tmp_path), "serving")
+    meta = json.load(open(os.path.join(sdir, "meta.json")))
+    assert meta["dynamic_batch"] is True
+    for b in (1, 8):
+        assert os.path.exists(os.path.join(sdir, "export_b%d.bin" % b))
+        mlir = open(os.path.join(sdir, "module_b%d.mlir" % b)).read()
+        assert "stablehlo" in mlir or "func.func" in mlir
+
+    from paddle_tpu.serving import load_serving_artifact
+    pred = load_serving_artifact(str(tmp_path))
+    assert pred.get_input_names() == ["x"]
+    out, = pred.run({"x": xv})          # batch 5 -> bucket 8, sliced back
+    assert out.shape == (5, 3)
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+    # parity with the in-process Predictor path on the same artifact dir
+    from paddle_tpu.inference import Config, create_predictor
+    inproc = create_predictor(Config(str(tmp_path)))
+    out2, = inproc.run({"x": xv})
+    np.testing.assert_allclose(out, out2, rtol=1e-5, atol=1e-6)
+
+    # batch larger than every exported bucket: named error
+    with pytest.raises(ValueError, match="largest exported bucket"):
+        pred.run({"x": np.zeros((9, 6), np.float32)})
+
+
+def test_stablehlo_export_weights_are_frozen(tmp_path):
+    """The artifact must bake the weights at export time: training the
+    live model afterwards must NOT change the artifact's predictions."""
+    from paddle_tpu import optimizer
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [4], dtype="float32")
+        y = layers.fc(x, 2)
+        test_prog = main.clone(for_test=True)
+        lbl = layers.data("lbl", [2], dtype="float32")
+        loss = layers.reduce_mean(layers.square_error_cost(y, lbl))
+        optimizer.SGD(0.5).minimize(loss)
+    exe = pt.Executor()
+    exe.run(startup)
+    xv = np.random.RandomState(1).rand(2, 4).astype(np.float32)
+
+    pt.save_inference_model(str(tmp_path), ["x"], [y], exe,
+                            main_program=test_prog, format="stablehlo",
+                            batch_sizes=(2,))
+    from paddle_tpu.serving import load_serving_artifact
+    pred = load_serving_artifact(str(tmp_path))
+    before, = pred.run({"x": xv})
+    ref, = exe.run(test_prog, feed={"x": xv}, fetch_list=[y])
+    np.testing.assert_allclose(before, ref, rtol=1e-5, atol=1e-6)
+
+    for _ in range(3):
+        exe.run(main, feed={"x": xv,
+                            "lbl": np.ones((2, 2), np.float32)},
+                fetch_list=[loss])
+    after_live, = exe.run(test_prog, feed={"x": xv}, fetch_list=[y])
+    assert not np.allclose(after_live, ref)     # live model moved
+    again, = pred.run({"x": xv})
+    np.testing.assert_allclose(again, before)   # artifact frozen
