@@ -129,6 +129,9 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
         # leave a half-configured artifact directory behind
         raise ValueError("save_inference_model format must be 'default' "
                          "or 'stablehlo', got %r" % (format,))
+    if format == "stablehlo" and not batch_sizes:
+        raise ValueError("format='stablehlo' needs at least one "
+                         "batch_sizes entry")
     program = main_program or default_main_program()
     test_prog = program.clone(for_test=True)
     target_names = [v.name for v in target_vars]

@@ -89,6 +89,8 @@ def export_serving_artifact(dirname, feeded_var_names, target_vars,
     from .framework.program import default_main_program
     from .framework.scope import global_scope
 
+    if not batch_sizes:
+        raise ValueError("serving export needs at least one batch size")
     scope = scope or global_scope()
     target_names = [getattr(v, "name", v) for v in target_vars]
     if pruned_program is not None:
@@ -98,8 +100,15 @@ def export_serving_artifact(dirname, feeded_var_names, target_vars,
         test_prog = program.clone(for_test=True)
         pruned = test_prog._prune(list(feeded_var_names), target_names)
 
-    out_dir = os.path.join(dirname, MODULE_SUBDIR)
-    os.makedirs(out_dir, exist_ok=True)
+    # build the whole artifact in a temp dir and swap it in at the end:
+    # an interrupted re-export must never leave a loadable mix of old and
+    # new exports (same commit-point discipline as io._atomic_write)
+    final_dir = os.path.join(dirname, MODULE_SUBDIR)
+    out_dir = final_dir + ".tmp.%d" % os.getpid()
+    if os.path.exists(out_dir):
+        import shutil
+        shutil.rmtree(out_dir)
+    os.makedirs(out_dir)
     fn = _infer_fn(pruned, list(feeded_var_names), target_names, scope)
 
     _, batch_dyn = _feed_avals(pruned, feeded_var_names, batch_sizes[0])
@@ -130,7 +139,11 @@ def export_serving_artifact(dirname, feeded_var_names, target_vars,
             "buckets": bucket_meta}
     with open(os.path.join(out_dir, "meta.json"), "w") as f:
         json.dump(meta, f, indent=1)
-    return written
+    import shutil
+    if os.path.exists(final_dir):
+        shutil.rmtree(final_dir)
+    os.rename(out_dir, final_dir)
+    return [p.replace(out_dir, final_dir) for p in written]
 
 
 class ServingPredictor(object):
@@ -190,18 +203,19 @@ class ServingPredictor(object):
         n = None
         for name, dyn in zip(self._feed_names, batch_dyn):
             if dyn:
-                n = np.asarray(inputs[name]).shape[0]
-                break
+                got = np.asarray(inputs[name]).shape[0]
+                if n is None:
+                    n = got
+                elif got != n:
+                    raise ValueError(
+                        "batch-dynamic feeds disagree on batch size: "
+                        "feed %r has %d rows, earlier feeds have %d"
+                        % (name, got, n))
         b = self._bucket(n)
         feeds = []
         for name, dyn in zip(self._feed_names, batch_dyn):
             arr = np.asarray(inputs[name])
             if dyn and arr.shape[0] != b:
-                if arr.shape[0] > b:
-                    raise ValueError(
-                        "feed %r has batch %d but batch was inferred as "
-                        "%d (bucket %d) — batch-dynamic feeds must agree"
-                        % (name, arr.shape[0], n, b))
                 pad = [(0, b - arr.shape[0])] + [(0, 0)] * (arr.ndim - 1)
                 arr = np.pad(arr, pad)
             feeds.append(arr)
