@@ -52,6 +52,32 @@ class DatasetBase(object):
         # silently mis-parsing id slots as floats
         self._slot_dtypes = [getattr(v, "dtype", None) for v in var_list]
 
+    def set_length_buckets(self, buckets, by, pad_slots=None):
+        """Length-bucketed batching for ragged data.
+
+        Samples are grouped by the length of slot `by` into the smallest
+        bucket that fits; each batch's ragged slots pad to the BUCKET
+        width, not the global max. Two wins on TPU: one stable shape per
+        bucket means one Executor compile-cache entry per bucket, and no
+        MXU work is wasted padding every batch to max_len — the
+        dense+lengths answer to the reference's zero-padding LoD kernels
+        (sequence_ops/sequence_pool_op.h:29, which walk ragged offsets).
+
+        buckets: ascending capacities, e.g. (32, 64, 128, 256). A sample
+        longer than the largest bucket raises a named error.
+        by: name of the slot whose length assigns the bucket.
+        pad_slots: slots padded to the bucket width (default: [by]);
+        each gets a "<name>__lens" int64 vector alongside."""
+        bl = sorted(int(b) for b in buckets)
+        if not bl:
+            raise ValueError("set_length_buckets needs at least one bucket")
+        self._buckets = bl
+        self._bucket_by = by
+        self._bucket_pad = list(pad_slots) if pad_slots is not None \
+            else [by]
+        if by not in self._bucket_pad:
+            self._bucket_pad.append(by)
+
     def set_data_format(self, fmt):
         """"ptrec" | "multislot_text" | "auto" (default: sniff each
         file's leading magic bytes)."""
@@ -106,6 +132,11 @@ class DatasetBase(object):
                     yield s
 
     def _batches(self, sample_iter):
+        if getattr(self, "_buckets", None):
+            return self._bucketed_batches(sample_iter)
+        return self._plain_batches(sample_iter)
+
+    def _plain_batches(self, sample_iter):
         buf = []
         for sample in sample_iter:
             buf.append(sample)
@@ -115,26 +146,71 @@ class DatasetBase(object):
         if buf:
             yield self._collate(buf)
 
-    def _collate(self, samples):
+    def _as_dict(self, sample):
+        if isinstance(sample, dict):
+            return sample
+        if not self._use_vars:
+            raise ValueError(
+                "length bucketing needs dict samples or set_use_var(...) "
+                "to name tuple slots")
+        return dict(zip(self._use_vars, sample))
+
+    def _bucketed_batches(self, sample_iter):
+        bufs = {b: [] for b in self._buckets}
+        by = self._bucket_by
+        for sample in sample_iter:
+            sample = self._as_dict(sample)
+            # every pad slot must fit the assigned bucket: the bucket is
+            # picked by the longest one, with a named error past the cap
+            ln = max(int(np.asarray(sample[s]).shape[0])
+                     for s in self._bucket_pad)
+            for b in self._buckets:
+                if ln <= b:
+                    break
+            else:
+                longest = max(self._bucket_pad,
+                              key=lambda s: np.asarray(sample[s]).shape[0])
+                raise ValueError(
+                    "sample slot %r has length %d, longer than the "
+                    "largest bucket %d"
+                    % (longest, np.asarray(sample[longest]).shape[0],
+                       self._buckets[-1]))
+            bufs[b].append(sample)
+            if len(bufs[b]) == self._batch_size:
+                yield self._collate(bufs[b], width=b)
+                bufs[b] = []
+        for b in self._buckets:
+            if bufs[b]:
+                yield self._collate(bufs[b], width=b)
+
+    def _collate(self, samples, width=None):
         """Stack a batch; ragged slots (variable-length MultiSlot values)
         are padded to the batch max and a "<name>__lens" int64 vector is
         added — the dense+lengths encoding of the reference's LoD batch
-        (PORTING.md difference #1)."""
+        (PORTING.md difference #1). With length bucketing, `width` pins
+        the designated pad_slots to the bucket capacity so every batch
+        from one bucket has the same shape (one compile per bucket)."""
         if isinstance(samples[0], dict):
             out = {}
+            pad_slots = self._bucket_pad if width is not None else ()
             for n in samples[0]:
                 cols = [np.asarray(s[n]) for s in samples]
                 lens = [c.shape[0] for c in cols]
-                if len(set(lens)) == 1:
+                pinned = n in pad_slots
+                if not pinned and len(set(lens)) == 1:
                     out[n] = np.stack(cols)
                     continue
-                width = max(lens)
-                padded = np.zeros((len(cols), width), cols[0].dtype)
+                w = width if pinned else max(lens)
+                padded = np.zeros((len(cols), w) + cols[0].shape[1:],
+                                  cols[0].dtype)
                 for i, c in enumerate(cols):
                     padded[i, :c.shape[0]] = c
                 out[n] = padded
                 out[n + "__lens"] = np.asarray(lens, np.int64)
             return out
+        if width is not None:
+            return self._collate([self._as_dict(s) for s in samples],
+                                 width=width)
         cols = list(zip(*samples))
         return {n: np.stack(c)
                 for n, c in zip(self._use_vars, cols)}

@@ -98,8 +98,7 @@ class DistributedOptimizer(object):
         program._pp_optimizer = self._inner
         # a re-minimize must not reuse a step/optimizer-state compiled for
         # the previous plan/optimizer
-        program._pp_step = None
-        program._pp_step_key = None
+        program._pp_step_cache = {}
         program._pp_opt_state = None
         program._version += 1
         return [], []

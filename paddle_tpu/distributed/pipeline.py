@@ -217,7 +217,11 @@ def pipeline_1f1b_step(stage_fn, loss_fn, params_stacked, x_micro, y_micro,
                 state["stash"])
 
             # last stage: per-micro loss + gradient seed, both this tick
-            y_m = lax.dynamic_index_in_dim(y_local, mf_c, 0, keepdims=False)
+            # (y may be a pytree of several label/aux feeds — tree.map
+            # also handles the single-array case)
+            y_m = jax.tree.map(
+                lambda y: lax.dynamic_index_in_dim(y, mf_c, 0,
+                                                   keepdims=False), y_local)
             loss_m, loss_vjp = jax.vjp(lambda h: loss_fn(h, y_m), h_out)
             is_last = stage == n_stage - 1
             loss_acc = state["loss_acc"] + jnp.where(
@@ -255,6 +259,7 @@ def pipeline_1f1b_step(stage_fn, loss_fn, params_stacked, x_micro, y_micro,
     fn = shard_map(
         local_fn, mesh=mesh,
         in_specs=(jax.tree.map(lambda _: P(axis_name), params_stacked),
-                  _data_spec(dp_axis), _data_spec(dp_axis)),
+                  _data_spec(dp_axis),
+                  jax.tree.map(lambda _: _data_spec(dp_axis), y_micro)),
         out_specs=(P(), jax.tree.map(lambda _: P(axis_name), params_stacked)))
     return fn(params_stacked, x_micro, y_micro)

@@ -42,7 +42,7 @@ class PipelinePlan(object):
 
     __slots__ = ("n_stage", "template_ops", "tail_ops", "stage_params",
                  "template_params", "stage_in", "stage_out", "x_feed",
-                 "y_feed", "loss_name", "schedule", "n_micro")
+                 "y_feed", "y_feeds", "loss_name", "schedule", "n_micro")
 
     def __init__(self, **kw):
         for k in self.__slots__:
@@ -136,7 +136,11 @@ def extract_pipeline_plan(program, loss_name, schedule="1f1b", n_micro=1):
                 "stage %d consumes %r but stage %d produces %r — stages "
                 "must chain" % (s, per_stage_io[s][1], s - 1,
                                 per_stage_io[s - 1][2]))
-    # tail: loss section h, y -> loss
+    # tail: loss section (h, label/aux feeds...) -> loss
+    staged_produced = set()
+    for s in range(n_stage):
+        for op in staged[s]:
+            staged_produced.update(op.output_names())
     tail_params = set()
     produced = set()
     tail_external = []
@@ -147,15 +151,21 @@ def extract_pipeline_plan(program, loss_name, schedule="1f1b", n_micro=1):
             if _is_param(blk, name):
                 tail_params.add(name)
             elif name != per_stage_io[-1][2]:
+                if name in staged_produced:
+                    # stage-internal activations stay sharded on the pp
+                    # ring — the loss section may only read the chain
+                    # output; catch it HERE with a named error instead of
+                    # a KeyError at run time
+                    raise ValueError(
+                        "loss section reads %r, an activation produced "
+                        "inside a pipeline stage — only the last stage's "
+                        "chain output %r and data feeds may enter the "
+                        "loss section" % (name, per_stage_io[-1][2]))
                 tail_external.append(name)
         produced.update(op.output_names())
     if tail_params:
         raise ValueError("loss section with parameters is not supported "
                          "(v1): %r" % sorted(tail_params))
-    if len(tail_external) != 1:
-        raise ValueError(
-            "loss section must consume the last stage's output plus exactly "
-            "one label feed; got extra inputs %r" % (tail_external,))
     if loss_name not in produced:
         raise ValueError("loss %r is not produced by the unstamped tail "
                          "section" % loss_name)
@@ -164,7 +174,8 @@ def extract_pipeline_plan(program, loss_name, schedule="1f1b", n_micro=1):
         stage_params=[per_stage_io[s][0] for s in range(n_stage)],
         template_params=template_params, stage_in=stage_in,
         stage_out=per_stage_io[-1][2], x_feed=stage_in,
-        y_feed=tail_external[0], loss_name=loss_name,
+        y_feed=tail_external[0] if tail_external else None,
+        y_feeds=list(tail_external), loss_name=loss_name,
         schedule=schedule, n_micro=int(n_micro))
 
 
@@ -185,18 +196,33 @@ def make_stage_fn(program, plan):
 
 
 def make_loss_fn(program, plan):
-    """loss_fn(h_last, y) -> scalar, traced from the unstamped tail."""
-    from ..framework.trace import TraceContext, trace_op
-    last_out = plan.stage_out
+    """loss_fn(h_last, ys) -> scalar, traced from the unstamped tail.
+    `ys` is a tuple aligned with plan.y_feeds (any number of label/aux
+    feeds the loss section consumes)."""
+    tail_fn = make_tail_fn(program, plan, (plan.loss_name,))
 
-    def loss_fn(h, y):
-        env = {last_out: h, plan.y_feed: y}
+    def loss_fn(h, ys):
+        return tail_fn(h, ys)[0]
+
+    return loss_fn
+
+
+def make_tail_fn(program, plan, out_names):
+    """tail_fn(h_last_full, ys_full) -> tuple of `out_names` values: the
+    whole unstamped loss section traced on the UN-microbatched batch —
+    how arbitrary fetch_list entries (metrics, logits, ...) are computed
+    with exactly the serial program's semantics."""
+    from ..framework.trace import TraceContext, trace_op
+
+    def tail_fn(h, ys):
+        env = {plan.stage_out: h}
+        env.update(zip(plan.y_feeds, ys))
         ctx = TraceContext(program, jax.random.PRNGKey(program.random_seed))
         for i, op in enumerate(plan.tail_ops):
             trace_op(op, env, ctx, rng_tag=9000003 + i)
-        return env[plan.loss_name]
+        return tuple(env[n] for n in out_names)
 
-    return loss_fn
+    return tail_fn
 
 
 def stack_params_from_scope(plan, scope):

@@ -266,9 +266,6 @@ class Executor(object):
             program = program._program
         if program is None:
             program = default_main_program()
-        if getattr(program, "_pp_plan", None) is not None:
-            raise ValueError("run_steps does not support fleet pipeline "
-                             "programs (their step is already fused)")
         if any(r._started for r in getattr(program, "_py_readers", ())):
             raise ValueError("run_steps needs explicit stacked feeds, not "
                              "started py_readers")
@@ -289,6 +286,9 @@ class Executor(object):
         if n_steps == 0:
             raise ValueError("run_steps needs at least one step; the "
                              "stacked feeds have a leading axis of 0")
+        if getattr(program, "_pp_plan", None) is not None:
+            return self._run_pipeline_steps(program, feed, fetch_names,
+                                            scope, return_numpy, n_steps)
         staged = self._convert_feed(program, feed, steps_axis=True)
 
         check_numerics = bool(
@@ -459,15 +459,24 @@ class Executor(object):
         return run_step
 
     # ------------------------------------------------------------------
-    def _run_pipeline(self, program, feed, fetch_names, scope,
-                      return_numpy):
-        """Execute a fleet-partitioned pipeline Program: one jitted step =
-        GPipe/1F1B schedule over the mesh's pp axis (x dp when present) +
-        the inner optimizer's functional update on the stacked stage
-        params (distributed/pipeline_program.py)."""
+    def _pipeline_build(self, program, fetch_names, windowed=False):
+        """Build (or fetch the program-cached) fused pipeline step.
+
+        Returns (plan, init_fn, fn) where fn is jitted:
+          windowed=False: fn(params, opt_state, x_micro, ys_micro,
+              ys_full) -> (fetch_tuple, params, opt_state)
+          windowed=True:  same signature with a leading steps axis on the
+              data args, scanned on-device (run_steps for pipelines).
+
+        Fetches may be the loss (from the schedule) and/or any var the
+        unstamped loss section computes — those are evaluated by one
+        extra pipeline forward + the traced tail on the UN-microbatched
+        batch with the PRE-update params, which is exactly what a serial
+        Executor.run of the unpartitioned program fetches."""
         from ..distributed import pipeline_program as ppp
         from ..distributed.pipeline import (pipeline_loss_and_grads,
-                                            pipeline_1f1b_step)
+                                            pipeline_1f1b_step,
+                                            pipeline_forward)
         from ..distributed.mesh import get_mesh
         plan = program._pp_plan
         mesh = get_mesh()
@@ -480,56 +489,150 @@ class Executor(object):
                 "program has %d pipeline stages but the mesh 'pp' axis has "
                 "%d devices — they must match" % (plan.n_stage,
                                                   mesh.shape["pp"]))
-        if list(fetch_names) != [plan.loss_name]:
+        tail_produced = set()
+        for op in plan.tail_ops:
+            tail_produced.update(op.output_names())
+        aux_names = [n for n in fetch_names if n != plan.loss_name]
+        unknown = [n for n in aux_names if n not in tail_produced]
+        if unknown:
             raise ValueError(
-                "pipeline path fetches only the loss %r (v1); got %r"
-                % (plan.loss_name, list(fetch_names)))
+                "pipeline fetch_list entries must be the loss or vars "
+                "computed by the unstamped loss section; %r are not "
+                "(stage outputs stay sharded on the pp ring)" % (unknown,))
+        init_fn, update_fn = ppp.make_update_fn(program._pp_optimizer)
+        dp_axis = "dp" if ("dp" in mesh.axis_names and
+                           mesh.shape["dp"] > 1) else None
+        step_key = (plan.schedule, mesh, dp_axis, tuple(fetch_names),
+                    windowed, type(program._pp_optimizer).__name__)
+        cache = getattr(program, "_pp_step_cache", None)
+        if cache is None:
+            cache = program._pp_step_cache = {}
+        fn = cache.get(step_key)
+        if fn is None:
+            stage_fn = ppp.make_stage_fn(program, plan)
+            loss_fn = ppp.make_loss_fn(program, plan)
+            tail_fn = ppp.make_tail_fn(program, plan, aux_names) \
+                if aux_names else None
+            if plan.schedule == "gpipe":
+                def pipeline_call(params, x, ys):
+                    def global_loss(out, ym):
+                        return jnp.mean(jax.vmap(loss_fn)(out, ym))
+                    return pipeline_loss_and_grads(
+                        stage_fn, global_loss, params, x, ys, mesh,
+                        dp_axis=dp_axis)
+            elif plan.schedule == "1f1b":
+                def pipeline_call(params, x, ys):
+                    return pipeline_1f1b_step(stage_fn, loss_fn, params,
+                                              x, ys, mesh, dp_axis=dp_axis)
+            else:
+                raise ValueError("unknown pp_schedule %r" % plan.schedule)
+
+            def _step(params, opt_state, x, ys, ys_full):
+                loss, grads = pipeline_call(params, x, ys)
+                aux = ()
+                if tail_fn is not None:
+                    h = pipeline_forward(stage_fn, params, x, mesh,
+                                         dp_axis=dp_axis)
+                    h_full = h.reshape((h.shape[0] * h.shape[1],)
+                                       + h.shape[2:])
+                    aux = tail_fn(h_full, ys_full)
+                params, opt_state = update_fn(params, grads, opt_state)
+                fetches = tuple(
+                    loss if n == plan.loss_name
+                    else aux[aux_names.index(n)] for n in fetch_names)
+                return fetches, params, opt_state
+
+            if windowed:
+                def _multi(params, opt_state, xs, yss, ys_fulls):
+                    def body(carry, data):
+                        p, s = carry
+                        fetches, p, s = _step(p, s, *data)
+                        return (p, s), fetches
+                    (params, opt_state), stacked = jax.lax.scan(
+                        body, (params, opt_state), (xs, yss, ys_fulls))
+                    return stacked, params, opt_state
+                target = _multi
+            else:
+                target = _step
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")  # CPU ignores donation
+                fn = jax.jit(target, donate_argnums=(0, 1))
+            cache[step_key] = fn
+        return plan, init_fn, fn
+
+    def _run_pipeline(self, program, feed, fetch_names, scope,
+                      return_numpy):
+        """Execute a fleet-partitioned pipeline Program: one jitted step =
+        GPipe/1F1B schedule over the mesh's pp axis (x dp when present) +
+        the inner optimizer's functional update on the stacked stage
+        params (distributed/pipeline_program.py)."""
+        from ..distributed import pipeline_program as ppp
+        plan, init_fn, step = self._pipeline_build(program,
+                                                   tuple(fetch_names))
         params = ppp.stack_params_from_scope(plan, scope)
         opt_state = getattr(program, "_pp_opt_state", None)
-        init_fn, update_fn = ppp.make_update_fn(program._pp_optimizer)
         if opt_state is None:
             opt_state = init_fn(params)
         feed_vals = self._convert_feed(program, feed)
         x = ppp.microbatch(feed_vals[plan.x_feed], plan.n_micro)
-        y = ppp.microbatch(feed_vals[plan.y_feed], plan.n_micro)
-        dp_axis = "dp" if ("dp" in mesh.axis_names and
-                           mesh.shape["dp"] > 1) else None
-        step_key = (plan.schedule, mesh, dp_axis,
-                    type(program._pp_optimizer).__name__)
-        step = getattr(program, "_pp_step", None)
-        if getattr(program, "_pp_step_key", None) != step_key:
-            step = None  # schedule/mesh/optimizer changed: rebuild
-        if step is None:
-            stage_fn = ppp.make_stage_fn(program, plan)
-            loss_fn = ppp.make_loss_fn(program, plan)
-            if plan.schedule == "gpipe":
-                def pipeline_call(params, x, y):
-                    def global_loss(out, ym):
-                        return jnp.mean(jax.vmap(loss_fn)(out, ym))
-                    return pipeline_loss_and_grads(
-                        stage_fn, global_loss, params, x, y, mesh,
-                        dp_axis=dp_axis)
-            elif plan.schedule == "1f1b":
-                def pipeline_call(params, x, y):
-                    return pipeline_1f1b_step(stage_fn, loss_fn, params,
-                                              x, y, mesh, dp_axis=dp_axis)
-            else:
-                raise ValueError("unknown pp_schedule %r" % plan.schedule)
-
-            def _step(params, opt_state, x, y):
-                loss, grads = pipeline_call(params, x, y)
-                params, opt_state = update_fn(params, grads, opt_state)
-                return loss, params, opt_state
-
-            with warnings.catch_warnings():
-                warnings.simplefilter("ignore")  # CPU ignores donation
-                step = jax.jit(_step, donate_argnums=(0, 1))
-            program._pp_step = step
-            program._pp_step_key = step_key
-        loss, params, opt_state = step(params, opt_state, x, y)
+        ys = tuple(ppp.microbatch(feed_vals[n], plan.n_micro)
+                   for n in plan.y_feeds)
+        ys_full = tuple(feed_vals[n] for n in plan.y_feeds)
+        fetches, params, opt_state = step(params, opt_state, x, ys,
+                                          ys_full)
         ppp.unstack_params_to_scope(plan, scope, params)
         program._pp_opt_state = opt_state
-        return [np.asarray(loss)] if return_numpy else [loss]
+        if return_numpy:
+            return [np.asarray(f) for f in fetches]
+        return list(fetches)
+
+    def _run_pipeline_steps(self, program, feed, fetch_names, scope,
+                            return_numpy, n_steps):
+        """run_steps for pipeline programs: the whole W-step window is
+        ONE device program — lax.scan over the fused GPipe/1F1B step with
+        (params, opt_state) as carry."""
+        from ..distributed import pipeline_program as ppp
+        plan, init_fn, fn = self._pipeline_build(program,
+                                                 tuple(fetch_names),
+                                                 windowed=True)
+        params = ppp.stack_params_from_scope(plan, scope)
+        opt_state = getattr(program, "_pp_opt_state", None)
+        if opt_state is None:
+            opt_state = init_fn(params)
+        feed_vals = self._convert_feed(program, feed, steps_axis=True)
+
+        def micro_steps(name):
+            arr = jnp.asarray(feed_vals[name])
+            if arr.shape[1] % plan.n_micro:
+                raise ValueError(
+                    "per-step batch %d not divisible by n_micro %d"
+                    % (arr.shape[1], plan.n_micro))
+            return arr.reshape((arr.shape[0], plan.n_micro,
+                                arr.shape[1] // plan.n_micro)
+                               + arr.shape[2:])
+
+        xs = micro_steps(plan.x_feed)
+        yss = tuple(micro_steps(n) for n in plan.y_feeds)
+        ys_fulls = tuple(jnp.asarray(feed_vals[n]) for n in plan.y_feeds)
+        stacked, params, opt_state = fn(params, opt_state, xs, yss,
+                                        ys_fulls)
+        ppp.unstack_params_to_scope(plan, scope, params)
+        program._pp_opt_state = opt_state
+        if getattr(program, "_check_numerics", False):
+            # the scan cannot abort mid-window; detect afterwards and
+            # name the first offending step (loss is always fetched or
+            # fetchable — check every fetched output)
+            for name, arr in zip(fetch_names, stacked):
+                bad = ~np.isfinite(np.asarray(arr))
+                if bad.any():
+                    step_idx = int(np.argwhere(
+                        bad.reshape(bad.shape[0], -1).any(1))[0][0])
+                    raise FloatingPointError(
+                        "non-finite value in pipeline run_steps fetch %r "
+                        "at window step %d" % (name, step_idx))
+        if return_numpy:
+            return [np.asarray(f) for f in stacked]
+        return list(stacked)
 
     # ------------------------------------------------------------------
     def dump_hlo(self, program=None, feed=None, fetch_list=None,

@@ -18,7 +18,23 @@ Kernel signature::
     keys) — everything is traced under jax.jit.
 """
 
+import os
+
 _REGISTRY = {}
+
+# PADDLE_TPU_OP_COVERAGE=<path>: append the op type of every kernel
+# invocation to <path> — tools/op_coverage.py runs the suite with this to
+# report registered-but-never-exercised kernels (the numeric-oracle-tail
+# audit; zero overhead when unset).
+_COVERAGE_PATH = os.environ.get("PADDLE_TPU_OP_COVERAGE")
+_COVERAGE_SEEN = set()
+
+
+def _track(op_type):
+    if op_type not in _COVERAGE_SEEN:
+        _COVERAGE_SEEN.add(op_type)
+        with open(_COVERAGE_PATH, "a") as f:
+            f.write(op_type + "\n")
 
 
 class OpDef(object):
@@ -42,6 +58,14 @@ def register_op(type, nondiff=(), uses_rng=False, uses_subblock=False,
     def deco(fn):
         if type in _REGISTRY:
             raise ValueError("op %r already registered" % type)
+        if _COVERAGE_PATH:
+            import functools
+            inner = fn
+
+            @functools.wraps(inner)
+            def fn(*a, **kw):
+                _track(type)
+                return inner(*a, **kw)
         _REGISTRY[type] = OpDef(type, fn, nondiff, uses_rng, uses_subblock,
                                 differentiable)
         return fn

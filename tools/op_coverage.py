@@ -1,0 +1,34 @@
+#!/usr/bin/env python
+"""Kernel-coverage audit: which registered ops does the test suite never
+invoke? (VERDICT r4 next #9 — the distance between "every name resolves"
+and "every kernel is oracle-checked".)
+
+Usage:
+  PADDLE_TPU_OP_COVERAGE=/tmp/opcov.txt python -m pytest tests/ -q
+  python tools/op_coverage.py /tmp/opcov.txt
+"""
+import sys
+
+
+def main(path):
+    import paddle_tpu  # noqa: F401 - populate the registry
+    from paddle_tpu.ops.registry import registered_ops
+    exercised = set()
+    try:
+        with open(path) as f:
+            exercised = {ln.strip() for ln in f if ln.strip()}
+    except OSError:
+        print("coverage file %s missing — run the suite with "
+              "PADDLE_TPU_OP_COVERAGE=%s first" % (path, path))
+        return 2
+    registered = set(registered_ops())
+    uncovered = sorted(registered - exercised)
+    print("registered: %d  exercised: %d  uncovered: %d"
+          % (len(registered), len(exercised), len(uncovered)))
+    for n in uncovered:
+        print("  " + n)
+    return 0 if not uncovered else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1] if len(sys.argv) > 1 else "/tmp/opcov.txt"))
