@@ -463,6 +463,54 @@ def bench_deepfm():
         steps=steps, warmup=warmup)
 
 
+def bench_beam_decode():
+    """Transformer-NMT beam-search decode tokens/sec (VERDICT r4 next
+    #10; reference treats decode as first-class: beam_search_op.cc).
+    Measures the cached path: per-step KV caches, beams as a flattened
+    static (N*B) batch, topk+gather frontier."""
+    import jax
+    import paddle_tpu as pt
+    from paddle_tpu.models import transformer as tr
+    from paddle_tpu.framework.scope import Scope, scope_guard
+
+    on_tpu = _on_tpu()
+    if on_tpu:
+        cfg = tr.TransformerConfig()          # base geometry
+        # t_max bounds the unrolled per-step graph: 32 keeps trace+compile
+        # inside the bench's deadline reserve (the section runs after the
+        # banked headline, so a blowout only costs this optional line)
+        batch, src_len, t_max, beam, steps = 16, 64, 32, 4, 6
+    else:
+        cfg = tr.TransformerConfig(src_vocab=512, trg_vocab=512,
+                                   d_model=64, d_inner=128, n_head=2,
+                                   n_layer=2)
+        batch, src_len, t_max, beam, steps = 2, 16, 8, 2, 2
+    main, startup, feeds, fetch = tr.beam_search_decode_program(
+        cfg, src_len, t_max, beam_size=beam)
+    rng = np.random.RandomState(0)
+    feed = {"src_ids": rng.randint(
+                0, cfg.src_vocab, (batch, src_len, 1)).astype(np.int64),
+            "src_mask": np.ones((batch, src_len, 1), np.float32)}
+    feed = {k: jax.device_put(v) for k, v in feed.items()}
+    with scope_guard(Scope()):
+        exe = pt.Executor()
+        exe.run(startup)
+        fetch_list = [fetch["out_ids"], fetch["scores"]]
+        out = exe.run(main, feed=feed, fetch_list=fetch_list)  # compile
+        assert np.isfinite(np.asarray(out[1])).all()
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            out = exe.run(main, feed=feed, fetch_list=fetch_list,
+                          return_numpy=False)
+        np.asarray(out[1])
+        dt = time.perf_counter() - t0
+    tps = batch * t_max * steps / dt
+    return json.dumps({
+        "metric": "Transformer-NMT beam-search decode tokens/sec/chip",
+        "value": round(tps, 1), "unit": "tokens/sec/chip",
+        "beam": beam, "batch": batch, "out_len": t_max})
+
+
 def bench_bucketed_training():
     """Length-bucketed training vs max-len padding on a skewed length
     distribution (VERDICT r4 next #4): same samples, same model; the
@@ -680,10 +728,7 @@ def run_all():
         jax.config.update("jax_compilation_cache_dir",
                           os.environ.get("PADDLE_TPU_COMPILE_CACHE",
                                          "/tmp/paddle_tpu_jax_cache"))
-        # honor an explicit JAX_PLATFORMS override (the axon sitecustomize
-        # forces jax_platforms at import time, shadowing the env var)
-        if os.environ.get("JAX_PLATFORMS"):
-            jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+        _apply_platform_override()
     except Exception:  # pragma: no cover
         pass
 
@@ -704,6 +749,7 @@ def run_all():
                      ("longseq", bench_longseq_attention),
                      ("bucketed", bench_bucketed_training),
                      ("transformer", bench_transformer),
+                     ("beam_decode", bench_beam_decode),
                      ("deepfm", bench_deepfm)):
         _STATE["stage"] = name
         try:
@@ -766,15 +812,12 @@ def profile_headline():
 
 
 def _apply_platform_override():
-    """Section mode bypasses run_all: honor JAX_PLATFORMS here too (the
-    axon sitecustomize shadows the env var at import)."""
+    """Honor an explicit JAX_PLATFORMS env override — the axon
+    sitecustomize forces jax_platforms at import time, shadowing the env
+    var. Shared by run_all and the section-mode CLI."""
     if os.environ.get("JAX_PLATFORMS"):
-        try:
-            import jax
-            jax.config.update("jax_platforms",
-                              os.environ["JAX_PLATFORMS"])
-        except Exception:  # pragma: no cover
-            pass
+        import jax
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
 
 if __name__ == "__main__":
@@ -790,6 +833,8 @@ if __name__ == "__main__":
         print(bench_longseq_attention())
     elif len(sys.argv) > 1 and sys.argv[1] == "bucketed":
         print(bench_bucketed_training())
+    elif len(sys.argv) > 1 and sys.argv[1] == "beam":
+        print(bench_beam_decode())
     elif len(sys.argv) > 1 and sys.argv[1] == "transformer":
         print(bench_transformer())
     elif len(sys.argv) > 1 and sys.argv[1] == "deepfm":

@@ -42,7 +42,7 @@ class PipelinePlan(object):
 
     __slots__ = ("n_stage", "template_ops", "tail_ops", "stage_params",
                  "template_params", "stage_in", "stage_out", "x_feed",
-                 "y_feed", "y_feeds", "loss_name", "schedule", "n_micro")
+                 "y_feeds", "loss_name", "schedule", "n_micro")
 
     def __init__(self, **kw):
         for k in self.__slots__:
@@ -174,7 +174,6 @@ def extract_pipeline_plan(program, loss_name, schedule="1f1b", n_micro=1):
         stage_params=[per_stage_io[s][0] for s in range(n_stage)],
         template_params=template_params, stage_in=stage_in,
         stage_out=per_stage_io[-1][2], x_feed=stage_in,
-        y_feed=tail_external[0] if tail_external else None,
         y_feeds=list(tail_external), loss_name=loss_name,
         schedule=schedule, n_micro=int(n_micro))
 

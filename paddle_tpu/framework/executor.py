@@ -527,15 +527,19 @@ class Executor(object):
             else:
                 raise ValueError("unknown pp_schedule %r" % plan.schedule)
 
-            def _step(params, opt_state, x, ys, ys_full):
+            def _unmicro(a):
+                # microbatch() is a plain reshape, so merging the first
+                # two dims recovers the original batch order
+                return a.reshape((a.shape[0] * a.shape[1],) + a.shape[2:])
+
+            def _step(params, opt_state, x, ys):
                 loss, grads = pipeline_call(params, x, ys)
                 aux = ()
                 if tail_fn is not None:
                     h = pipeline_forward(stage_fn, params, x, mesh,
                                          dp_axis=dp_axis)
-                    h_full = h.reshape((h.shape[0] * h.shape[1],)
-                                       + h.shape[2:])
-                    aux = tail_fn(h_full, ys_full)
+                    aux = tail_fn(_unmicro(h),
+                                  tuple(_unmicro(y) for y in ys))
                 params, opt_state = update_fn(params, grads, opt_state)
                 fetches = tuple(
                     loss if n == plan.loss_name
@@ -543,13 +547,13 @@ class Executor(object):
                 return fetches, params, opt_state
 
             if windowed:
-                def _multi(params, opt_state, xs, yss, ys_fulls):
+                def _multi(params, opt_state, xs, yss):
                     def body(carry, data):
                         p, s = carry
                         fetches, p, s = _step(p, s, *data)
                         return (p, s), fetches
                     (params, opt_state), stacked = jax.lax.scan(
-                        body, (params, opt_state), (xs, yss, ys_fulls))
+                        body, (params, opt_state), (xs, yss))
                     return stacked, params, opt_state
                 target = _multi
             else:
@@ -577,11 +581,16 @@ class Executor(object):
         x = ppp.microbatch(feed_vals[plan.x_feed], plan.n_micro)
         ys = tuple(ppp.microbatch(feed_vals[n], plan.n_micro)
                    for n in plan.y_feeds)
-        ys_full = tuple(feed_vals[n] for n in plan.y_feeds)
-        fetches, params, opt_state = step(params, opt_state, x, ys,
-                                          ys_full)
+        fetches, params, opt_state = step(params, opt_state, x, ys)
         ppp.unstack_params_to_scope(plan, scope, params)
         program._pp_opt_state = opt_state
+        if getattr(program, "_check_numerics", False):
+            # parity with run(): a non-finite fetch raises instead of
+            # silently training on
+            for name, arr in zip(fetch_names, fetches):
+                if not np.isfinite(np.asarray(arr)).all():
+                    raise FloatingPointError(
+                        "non-finite value in pipeline fetch %r" % (name,))
         if return_numpy:
             return [np.asarray(f) for f in fetches]
         return list(fetches)
@@ -613,9 +622,7 @@ class Executor(object):
 
         xs = micro_steps(plan.x_feed)
         yss = tuple(micro_steps(n) for n in plan.y_feeds)
-        ys_fulls = tuple(jnp.asarray(feed_vals[n]) for n in plan.y_feeds)
-        stacked, params, opt_state = fn(params, opt_state, xs, yss,
-                                        ys_fulls)
+        stacked, params, opt_state = fn(params, opt_state, xs, yss)
         ppp.unstack_params_to_scope(plan, scope, params)
         program._pp_opt_state = opt_state
         if getattr(program, "_check_numerics", False):

@@ -383,11 +383,18 @@ def _kldiv(ctx, ins, attrs):
 
 @register_op("bpr_loss", nondiff=("Label",))
 def _bpr_loss(ctx, ins, attrs):
+    """loss_i = -(1/(C-1)) * sum_{j != label_i} log sigmoid(x_pos - x_j)
+    (ref bpr_loss_op.h:63-77: the positive item's logit minus each
+    NEGATIVE's, label column excluded from the sum). The round-5 oracle
+    sweep caught this kernel with the sigmoid argument flipped and the
+    label term included at 1/C weight."""
     x, label = ins["X"][0], ins["Label"][0]
-    lbl = label.reshape(label.shape[0]).astype(jnp.int32)
+    n, c = x.shape
+    lbl = label.reshape(n).astype(jnp.int32)
     pos = jnp.take_along_axis(x, lbl[:, None], axis=1)
-    diff = -(x - pos)
-    loss = -jnp.mean(jax.nn.log_sigmoid(-diff), axis=1, keepdims=True)
+    logsig = jax.nn.log_sigmoid(pos - x)          # (N, C)
+    neg_mask = 1.0 - jax.nn.one_hot(lbl, c, dtype=x.dtype)
+    loss = -jnp.sum(logsig * neg_mask, axis=1, keepdims=True) / (c - 1)
     return {"Y": loss}
 
 

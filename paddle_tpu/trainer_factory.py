@@ -189,13 +189,13 @@ class MultiTrainer(object):
     def _can_window(self, fetch_list):
         """run_steps preconditions — anything else silently degrades to
         the per-step loop instead of crashing mid-epoch. (CompiledProgram
-        is fine: run_steps shards the scan over its mesh.)"""
+        is fine: run_steps shards the scan over its mesh; pipeline
+        programs window through Executor._run_pipeline_steps.)"""
         from paddle_tpu.framework.compiler import CompiledProgram
         prog = self._program
         if isinstance(prog, CompiledProgram):
             prog = prog._program
         return bool(fetch_list) \
-            and getattr(prog, "_pp_plan", None) is None \
             and not any(r._started for r in
                         getattr(prog, "_py_readers", ()))
 
