@@ -463,6 +463,63 @@ def bench_deepfm():
         steps=steps, warmup=warmup)
 
 
+def _timed_attn_tokens(loss_fn, q, k, v, b, t, steps):
+    """Shared fwd+bwd attention timing harness (longseq + flashtune):
+    warm compile, then `steps` grad evaluations; returns tokens/sec."""
+    import jax
+    g = jax.jit(jax.grad(loss_fn, argnums=(0, 1, 2)))
+    jax.block_until_ready(g(q, k, v))
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = g(q, k, v)
+    jax.block_until_ready(out)
+    return b * t * steps / (time.perf_counter() - t0)
+
+
+def bench_flashtune():
+    """Flash-attention block-size sweep at the long-context shape
+    (T=4096 bf16 fwd+bwd): reports tokens/sec per (block_q, block_k) and
+    the winner — apply fleet-wide via PADDLE_TPU_FLASH_BLOCK_Q/_K."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.pallas import flash_attention as fa
+
+    on_tpu = _on_tpu()
+    if on_tpu:
+        b, h, t, d, steps = 4, 12, 4096, 64, 6
+        grid = [(128, 128), (128, 256), (256, 128), (256, 256),
+                (128, 512), (512, 128), (512, 512)]
+    else:
+        b, h, t, d, steps = 1, 2, 256, 32, 2
+        grid = [(128, 128), (128, 256)]
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(b, h, t, d), jnp.bfloat16)
+    k = jnp.asarray(rng.randn(b, h, t, d), jnp.bfloat16)
+    v = jnp.asarray(rng.randn(b, h, t, d), jnp.bfloat16)
+    scale = 1.0 / np.sqrt(d)
+    interp = not on_tpu
+
+    results = {}
+    for bq, bk in grid:
+        def loss(q, k, v, bq=bq, bk=bk):
+            o = fa.flash_attention(q, k, v, scale=scale, causal=True,
+                                   block_q=bq, block_k=bk,
+                                   interpret=interp)
+            return jnp.sum(o.astype(jnp.float32))
+        try:
+            results["%dx%d" % (bq, bk)] = round(
+                _timed_attn_tokens(loss, q, k, v, b, t, steps), 1)
+        except Exception as e:  # VMEM overflow at big tiles etc.
+            results["%dx%d" % (bq, bk)] = "failed: %r" % (e,)
+    numeric = {kk: vv for kk, vv in results.items()
+               if isinstance(vv, float)}
+    best = max(numeric, key=numeric.get) if numeric else None
+    return json.dumps({"metric": "flash-attention block tuning T=%d" % t,
+                       "unit": "tokens/sec/chip", "results": results,
+                       "best": best,
+                       "value": numeric.get(best, 0.0)})
+
+
 def bench_beam_decode():
     """Transformer-NMT beam-search decode tokens/sec (VERDICT r4 next
     #10; reference treats decode as first-class: beam_search_op.cc).
@@ -679,14 +736,7 @@ def bench_longseq_attention():
     interp = not on_tpu
 
     def timed(loss_fn):
-        g = jax.jit(jax.grad(loss_fn, argnums=(0, 1, 2)))
-        out = g(q, k, v)
-        jax.block_until_ready(out)
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            out = g(q, k, v)
-        jax.block_until_ready(out)
-        return b * t * steps / (time.perf_counter() - t0)
+        return _timed_attn_tokens(loss_fn, q, k, v, b, t, steps)
 
     def flash_loss(q, k, v):
         o = fa.flash_attention(q, k, v, scale=scale, causal=True,
@@ -750,7 +800,8 @@ def run_all():
                      ("bucketed", bench_bucketed_training),
                      ("transformer", bench_transformer),
                      ("beam_decode", bench_beam_decode),
-                     ("deepfm", bench_deepfm)):
+                     ("deepfm", bench_deepfm),
+                     ("flashtune", bench_flashtune)):
         _STATE["stage"] = name
         try:
             line = fn()
@@ -835,6 +886,8 @@ if __name__ == "__main__":
         print(bench_bucketed_training())
     elif len(sys.argv) > 1 and sys.argv[1] == "beam":
         print(bench_beam_decode())
+    elif len(sys.argv) > 1 and sys.argv[1] == "flashtune":
+        print(bench_flashtune())
     elif len(sys.argv) > 1 and sys.argv[1] == "transformer":
         print(bench_transformer())
     elif len(sys.argv) > 1 and sys.argv[1] == "deepfm":

@@ -448,11 +448,38 @@ def _flash_bwd(scale, causal, block_q, block_k, interpret, res, g):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+def _env_block(name, default=128):
+    """Parse a block-size override; '' counts as unset (same contract as
+    PADDLE_TPU_PALLAS_INTERPRET) and junk/too-small values fall back to
+    the default LOUDLY — a bad tuning knob must not silently route every
+    attention call to the XLA fallback via the auto-path try/except."""
+    raw = os.environ.get(name, "")
+    if not raw:
+        return default
+    try:
+        val = int(raw)
+    except ValueError:
+        val = -1
+    if val < 8:
+        import warnings
+        warnings.warn("%s=%r is not a usable block size; using %d"
+                      % (name, raw, default))
+        return default
+    return val
+
+
 def flash_attention(q, k, v, mask=None, scale=1.0, causal=False,
-                    block_q=128, block_k=128, interpret=None):
+                    block_q=None, block_k=None, interpret=None):
     """Flash attention entry. q,k,v: (B,H,T,D). Falls back to interpret
     mode off-TPU so tests exercise the same kernel, and to plain fused XLA
-    attention when shapes are too small to tile."""
+    attention when shapes are too small to tile.
+
+    Block sizes default to 128x128; PADDLE_TPU_FLASH_BLOCK_Q/_K override
+    fleet-wide (apply the winner of `bench.py flashtune`)."""
+    if block_q is None:
+        block_q = _env_block("PADDLE_TPU_FLASH_BLOCK_Q")
+    if block_k is None:
+        block_k = _env_block("PADDLE_TPU_FLASH_BLOCK_K")
     if interpret is None:
         env = os.environ.get("PADDLE_TPU_PALLAS_INTERPRET")
         if env is not None:
