@@ -460,10 +460,13 @@ def _env_block(name, default=128):
         val = int(raw)
     except ValueError:
         val = -1
-    if val < 8:
+    # must be a power of two >= 128: anything else either trips Mosaic's
+    # 128-lane block alignment or gets halved down by the divisibility
+    # loop until the size guards route EVERY call to the XLA fallback
+    if val < 128 or val & (val - 1):
         import warnings
-        warnings.warn("%s=%r is not a usable block size; using %d"
-                      % (name, raw, default))
+        warnings.warn("%s=%r is not a power-of-two block size >= 128; "
+                      "using %d" % (name, raw, default))
         return default
     return val
 
