@@ -173,3 +173,64 @@ def test_infer_from_dataset_rejects_training_program():
                                          fetch_list=[loss])
     assert steps == 3
     assert np.isfinite(np.asarray(last[0])).all()
+
+
+def test_train_from_dataset_windows_pipeline_program():
+    """steps_per_dispatch on a fleet pipeline program routes through
+    Executor._run_pipeline_steps (one fused scan per window) and matches
+    the per-step loop exactly."""
+    import paddle_tpu as pt
+    from paddle_tpu import layers, optimizer
+    from paddle_tpu.distributed import fleet, init_mesh, DistributedStrategy
+    from paddle_tpu.distributed.pipeline_program import pp_stage_guard
+    from paddle_tpu.framework.scope import Scope, scope_guard
+
+    class ListDataset(object):
+        def __init__(self, batches):
+            self._batches = batches
+
+        def __iter__(self):
+            return iter(self._batches)
+
+    n_stage, dm, batch, W = 2, 8, 8, 4
+    rng = np.random.RandomState(3)
+    batches = [{"pp_x": rng.randn(batch, dm).astype(np.float32),
+                "pp_y": rng.randn(batch, dm).astype(np.float32)}
+               for _ in range(W)]
+
+    def build():
+        init_mesh({"dp": 2, "pp": n_stage})
+        strategy = DistributedStrategy()
+        strategy.mesh_axes = {"dp": 2, "pp": n_stage}
+        strategy.pipeline = True
+        strategy.pp_schedule = "1f1b"
+        strategy.pp_num_micro = 2
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("pp_x", [batch, dm], "float32",
+                            append_batch_size=False)
+            h = x
+            for s in range(n_stage):
+                with pp_stage_guard(s):
+                    h = layers.fc(h, size=dm, act="tanh")
+            y = layers.data("pp_y", [batch, dm], "float32",
+                            append_batch_size=False)
+            loss = layers.reduce_mean(layers.square(h - y))
+            fleet.distributed_optimizer(optimizer.SGD(0.1),
+                                        strategy).minimize(loss)
+        return main, startup, loss
+
+    def run(steps_per_dispatch):
+        main, startup, loss = build()
+        with scope_guard(Scope()):
+            exe = pt.Executor()
+            exe.run(startup)
+            steps, last = exe.train_from_dataset(
+                main, ListDataset(batches), fetch_list=[loss],
+                steps_per_dispatch=steps_per_dispatch)
+        return steps, float(np.asarray(last[0]).reshape(-1)[-1])
+
+    s1, l1 = run(1)
+    s2, l2 = run(2)
+    assert s1 == s2 == W
+    np.testing.assert_allclose(l1, l2, rtol=1e-5, atol=1e-6)
