@@ -177,6 +177,19 @@ def bert_train_flops(cfg, batch, seq, preds):
     return 3 * fwd
 
 
+def gpt_train_flops(cfg, batch, seq):
+    """Analytic per-step training FLOPs of the causal LM (matmul terms;
+    causal attention counts the lower triangle only — half the (T,T)
+    matrix; same 3x fwd+bwd convention as bert_train_flops)."""
+    d, L, ff = cfg.hidden_size, cfg.num_layers, cfg.ff_size
+    tokens = batch * seq
+    proj = 8 * tokens * d * d
+    attn = 4 * batch * seq * seq * d // 2
+    ffn = 4 * tokens * d * ff
+    fwd = L * (proj + attn + ffn) + 2 * tokens * d * cfg.vocab_size
+    return 3 * fwd
+
+
 def _chip_peak_flops():
     """bf16 peak of the attached chip, or None when not a recognized TPU
     (no fabricated MFU on CPU fallback / unknown accelerators)."""
@@ -461,6 +474,51 @@ def bench_deepfm():
         deepfm.synthetic_batch(batch, feature_dim=feature_dim),
         batch, "DeepFM CTR train examples/sec/chip", "examples/sec/chip",
         steps=steps, warmup=warmup)
+
+
+def bench_gpt_longctx():
+    """End-to-end long-context training: GPT causal LM at T=4096 bf16
+    through the Pallas flash kernel with rematerialized blocks — the
+    single-chip e2e evidence for the long-sequence story (the ring/
+    Ulysses paths shard this same model over an sp mesh). Reports
+    tokens/sec and MFU."""
+    import jax
+    import paddle_tpu as pt
+    from paddle_tpu.models import gpt
+    from paddle_tpu import optimizer
+    from paddle_tpu.framework.scope import Scope, scope_guard
+
+    on_tpu = _on_tpu()
+    if on_tpu:
+        cfg = gpt.GPTConfig(vocab_size=32000, hidden_size=768,
+                            num_layers=12, num_heads=12, ff_size=3072,
+                            max_position=4096, dropout=0.0,
+                            dtype="bfloat16", attn_impl="flash",
+                            recompute=True)
+        batch, seq, steps, warmup = 2, 4096, 6, 2
+    else:
+        cfg = gpt.GPTConfig(vocab_size=512, hidden_size=64, num_layers=2,
+                            num_heads=2, ff_size=128, max_position=256,
+                            dropout=0.0)
+        batch, seq, steps, warmup = 1, 128, 2, 1
+    main, startup, feeds, fetch = gpt.gpt_pretrain_program(
+        cfg, batch, seq,
+        optimizer_fn=lambda l: optimizer.Adam(1e-4).minimize(l))
+    feed = gpt.synthetic_batch(cfg, batch, seq)
+    with scope_guard(Scope()):
+        exe = pt.Executor()
+        exe.run(startup)
+        feed = {k: jax.device_put(np.asarray(v)) for k, v in feed.items()}
+        dt, loss = _run_steps(exe, main, feed, fetch["loss"], steps,
+                              warmup)
+    tps = batch * seq * steps / dt
+    line = {"metric": "GPT long-context train tokens/sec/chip (T=%d)"
+            % seq, "value": round(tps, 1), "unit": "tokens/sec/chip"}
+    peak = _chip_peak_flops()
+    if peak is not None:
+        line["mfu"] = round(
+            gpt_train_flops(cfg, batch, seq) * steps / dt / peak, 4)
+    return json.dumps(line)
 
 
 def _timed_attn_tokens(loss_fn, q, k, v, b, t, steps):
@@ -798,6 +856,7 @@ def run_all():
                      ("pallas_check", pallas_selfcheck),
                      ("longseq", bench_longseq_attention),
                      ("bucketed", bench_bucketed_training),
+                     ("gpt_longctx", bench_gpt_longctx),
                      ("transformer", bench_transformer),
                      ("beam_decode", bench_beam_decode),
                      ("deepfm", bench_deepfm),
@@ -946,6 +1005,8 @@ if __name__ == "__main__":
         print(bench_beam_decode())
     elif len(sys.argv) > 1 and sys.argv[1] == "flashtune":
         print(bench_flashtune())
+    elif len(sys.argv) > 1 and sys.argv[1] == "gpt":
+        print(bench_gpt_longctx())
     elif len(sys.argv) > 1 and sys.argv[1] == "transformer":
         print(bench_transformer())
     elif len(sys.argv) > 1 and sys.argv[1] == "deepfm":
