@@ -115,7 +115,7 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
                          main_program=None, model_filename=None,
                          params_filename=None, export_for_deployment=True,
                          program_only=False, format="default",
-                         batch_sizes=(1, 8, 32)):
+                         batch_sizes=(1, 8, 32), example_feed=None):
     """Freeze: clone for_test, prune to feeds/targets, save IR + params.
 
     format="stablehlo" additionally writes a deployable serving artifact
@@ -157,7 +157,8 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
         from .serving import export_serving_artifact
         export_serving_artifact(dirname, feeded_var_names, target_vars,
                                 executor, batch_sizes=batch_sizes,
-                                pruned_program=pruned)
+                                pruned_program=pruned,
+                                example_feed=example_feed)
     return target_names
 
 

@@ -23,7 +23,9 @@ import os
 import numpy as np
 
 MODULE_SUBDIR = "serving"
-SERVING_FORMAT_VERSION = 1
+# v1: feed_batch_dynamic (bool per feed). v2: feed_batch_factor /
+# fetch_batch_factor (ints; dim0 = factor * batch, 0 = static).
+SERVING_FORMAT_VERSION = 2
 
 
 def _infer_fn(program, feed_names, fetch_names, scope):
@@ -49,41 +51,80 @@ def _infer_fn(program, feed_names, fetch_names, scope):
     return fn
 
 
-def _feed_avals(program, feed_names, batch):
+def _feed_factors(program, feed_names, example_feed, overrides=None):
+    """Per-feed batch factors: feed i's leading dim is factor[i] *
+    request_batch (0 = static feed). Factor 1 is the default for
+    batch-dynamic feeds; an example feed dict refines it for feeds whose
+    leading dim scales as a MULTIPLE of the batch (e.g. BERT's flat
+    mask_pos with dim0 = batch * max_preds) — inference takes the
+    SMALLEST dynamic leading dim as the batch, so at least one dynamic
+    feed must carry dim0 == batch; if none does, pass explicit factors
+    via `overrides` ({feed_name: factor})."""
+    blk = program.global_block()
+    dyn = []
+    for name in feed_names:
+        shape = list(blk.var(name).shape)
+        dyn.append(bool(shape) and shape[0] == -1)
+    if not any(dyn):
+        return [0] * len(feed_names)
+    overrides = overrides or {}
+    if example_feed is None:
+        return [overrides.get(n, 1) if d else 0
+                for n, d in zip(feed_names, dyn)]
+    base = min(np.asarray(example_feed[n]).shape[0]
+               for n, d in zip(feed_names, dyn) if d)
+    factors = []
+    for name, d in zip(feed_names, dyn):
+        if not d:
+            factors.append(0)
+            continue
+        if name in overrides:
+            factors.append(int(overrides[name]))
+            continue
+        n0 = np.asarray(example_feed[name]).shape[0]
+        if n0 % base:
+            raise ValueError(
+                "serving export: feed %r leading dim %d is not a "
+                "multiple of the inferred batch %d" % (name, n0, base))
+        factors.append(n0 // base)
+    return factors
+
+
+def _feed_avals(program, feed_names, batch, factors):
     """ShapeDtypeStructs for the feeds at one bucket size; a leading -1
-    (append_batch_size) dim becomes the bucket batch. Returns
-    (avals, batch_dyn) where batch_dyn[i] says feed i's dim 0 is the
-    request batch — the loader pads ONLY those feeds."""
+    (append_batch_size) dim becomes factor * bucket batch."""
     import jax
     from .framework.dtypes import to_jax_dtype
     blk = program.global_block()
-    avals, batch_dyn = [], []
-    for name in feed_names:
+    avals = []
+    for name, factor in zip(feed_names, factors):
         var = blk.var(name)
         shape = list(var.shape)
-        dyn = bool(shape) and shape[0] == -1
-        if dyn:
-            shape[0] = batch
-        batch_dyn.append(dyn)
+        if factor:
+            shape[0] = batch * factor
         if any(s is None or s < 0 for s in shape):
             raise ValueError(
                 "serving export: feed %r has non-batch dynamic dims %s — "
                 "XLA serving artifacts are static-shape" % (name, shape))
         avals.append(jax.ShapeDtypeStruct(tuple(shape),
                                           to_jax_dtype(var.dtype)))
-    return avals, batch_dyn
+    return avals
 
 
 def export_serving_artifact(dirname, feeded_var_names, target_vars,
                             executor=None, main_program=None,
                             batch_sizes=(1, 8, 32), scope=None,
-                            pruned_program=None):
+                            pruned_program=None, example_feed=None,
+                            feed_batch_factors=None):
     """Freeze + export the inference program as StableHLO.
 
     Writes under dirname/serving/. target_vars may be Variables or names.
     pruned_program skips the clone+prune when the caller (e.g.
-    save_inference_model) already froze the program. Returns the list of
-    written export paths."""
+    save_inference_model) already froze the program. example_feed (one
+    representative feed dict) teaches the export which batch-dynamic
+    feeds scale as a MULTIPLE of the request batch (BERT's flat mask_pos
+    = batch * max_preds); without it every dynamic feed is assumed
+    factor 1. Returns the list of written export paths."""
     import jax
     from jax import export as jax_export
     from .framework.program import default_main_program
@@ -111,13 +152,29 @@ def export_serving_artifact(dirname, feeded_var_names, target_vars,
     os.makedirs(out_dir)
     fn = _infer_fn(pruned, list(feeded_var_names), target_names, scope)
 
-    _, batch_dyn = _feed_avals(pruned, feeded_var_names, batch_sizes[0])
-    dynamic = any(batch_dyn)
+    factors = _feed_factors(pruned, feeded_var_names, example_feed,
+                            overrides=feed_batch_factors)
+    dynamic = any(factors)
     buckets = sorted(set(batch_sizes)) if dynamic else [0]
+
+    # which OUTPUTS scale with the batch, and by what factor: compare
+    # abstract output shapes at two batch sizes (jax.eval_shape — no
+    # compile). Recorded at export so the loader never guesses from
+    # runtime shapes (a static dim that happens to equal batch*f must
+    # not get sliced).
+    fetch_factors = [0] * len(target_names)
+    if dynamic:
+        o1 = jax.eval_shape(fn, *_feed_avals(pruned, feeded_var_names, 1,
+                                             factors))
+        o2 = jax.eval_shape(fn, *_feed_avals(pruned, feeded_var_names, 2,
+                                             factors))
+        for i, (s1, s2) in enumerate(zip(o1, o2)):
+            if s1.shape and s2.shape and s2.shape[0] != s1.shape[0]:
+                fetch_factors[i] = s2.shape[0] - s1.shape[0]
 
     written, bucket_meta = [], {}
     for b in buckets:
-        avals, _ = _feed_avals(pruned, feeded_var_names, b or 1)
+        avals = _feed_avals(pruned, feeded_var_names, b or 1, factors)
         exported = jax_export.export(jax.jit(fn))(*avals)
         blob = exported.serialize()
         bin_path = os.path.join(out_dir, "export_b%d.bin" % b)
@@ -135,7 +192,8 @@ def export_serving_artifact(dirname, feeded_var_names, target_vars,
             "feed_var_names": list(feeded_var_names),
             "fetch_var_names": target_names,
             "dynamic_batch": dynamic,
-            "feed_batch_dynamic": batch_dyn,
+            "feed_batch_factor": factors,
+            "fetch_batch_factor": fetch_factors,
             "buckets": bucket_meta}
     with open(os.path.join(out_dir, "meta.json"), "w") as f:
         json.dump(meta, f, indent=1)
@@ -165,6 +223,13 @@ class ServingPredictor(object):
                 "this library's %d"
                 % (dirname, self._meta["format_version"],
                    SERVING_FORMAT_VERSION))
+        if "feed_batch_factor" not in self._meta:
+            # v1 artifacts: booleans, factor 1 semantics; outputs were
+            # sliced when dim0 == bucket (factor 1)
+            dyn = self._meta.get("feed_batch_dynamic", [])
+            self._meta["feed_batch_factor"] = [1 if d else 0 for d in dyn]
+            self._meta["fetch_batch_factor"] = [
+                1] * len(self._meta["fetch_var_names"])
         self._feed_names = self._meta["feed_var_names"]
         self._fetch_names = self._meta["fetch_var_names"]
         self._fns = {}
@@ -197,32 +262,45 @@ class ServingPredictor(object):
             outs = self._fns[0].call(
                 *[np.asarray(inputs[n]) for n in self._feed_names])
             return [np.asarray(o) for o in outs]
-        # the request batch comes from a feed whose exported dim 0 IS the
-        # batch (feed_batch_dynamic from export) — never from dict order
-        batch_dyn = self._meta["feed_batch_dynamic"]
+        # the request batch comes from the feeds' recorded batch factors
+        # (feed i's dim0 = factor_i * batch) — never from dict order
+        factors = self._meta["feed_batch_factor"]
         n = None
-        for name, dyn in zip(self._feed_names, batch_dyn):
-            if dyn:
+        for name, f in zip(self._feed_names, factors):
+            if f:
                 got = np.asarray(inputs[name]).shape[0]
+                if got % f:
+                    raise ValueError(
+                        "feed %r has %d rows, not a multiple of its "
+                        "batch factor %d" % (name, got, f))
                 if n is None:
-                    n = got
-                elif got != n:
+                    n = got // f
+                elif got // f != n:
                     raise ValueError(
                         "batch-dynamic feeds disagree on batch size: "
-                        "feed %r has %d rows, earlier feeds have %d"
-                        % (name, got, n))
+                        "feed %r implies batch %d, earlier feeds %d"
+                        % (name, got // f, n))
         b = self._bucket(n)
         feeds = []
-        for name, dyn in zip(self._feed_names, batch_dyn):
+        for name, f in zip(self._feed_names, factors):
             arr = np.asarray(inputs[name])
-            if dyn and arr.shape[0] != b:
-                pad = [(0, b - arr.shape[0])] + [(0, 0)] * (arr.ndim - 1)
+            if f and arr.shape[0] != b * f:
+                pad = [(0, b * f - arr.shape[0])] + \
+                    [(0, 0)] * (arr.ndim - 1)
                 arr = np.pad(arr, pad)
             feeds.append(arr)
         outs = self._fns[b].call(*feeds)
-        return [np.asarray(o)[:n]
-                if np.ndim(o) > 0 and np.shape(o)[0] == b else np.asarray(o)
-                for o in outs]
+        # slice batch-scaled outputs per the EXPORT-time factors — never
+        # guessed from runtime shapes (a static dim that happens to
+        # equal b*f must not be truncated)
+        fetch_factors = self._meta["fetch_batch_factor"]
+        sliced = []
+        for o, f in zip(outs, fetch_factors):
+            o = np.asarray(o)
+            if f and np.ndim(o) > 0 and o.shape[0] == b * f:
+                o = o[:n * f]
+            sliced.append(o)
+        return sliced
 
 
 def load_serving_artifact(dirname):

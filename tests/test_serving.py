@@ -92,3 +92,35 @@ def test_stablehlo_export_weights_are_frozen(tmp_path):
     assert not np.allclose(after_live, ref)     # live model moved
     again, = pred.run({"x": xv})
     np.testing.assert_allclose(again, before)   # artifact frozen
+
+
+def test_stablehlo_export_batch_factor_feeds(tmp_path):
+    """Feeds whose leading dim is a MULTIPLE of the batch (BERT's flat
+    mask_pos = batch * max_preds) export and reload correctly when an
+    example_feed teaches the factors."""
+    from paddle_tpu.models import bert
+    from paddle_tpu.framework.scope import Scope, scope_guard
+
+    cfg = bert.BertConfig(vocab_size=128, hidden_size=32, num_layers=1,
+                          num_heads=2, ff_size=64, max_position=32)
+    batch, seq, preds = 4, 16, 4
+    main, startup, feeds, fetch = bert.bert_pretrain_program(
+        cfg, batch, seq, preds, optimizer_fn=None, is_test=True)
+    with scope_guard(Scope()):
+        exe = pt.Executor()
+        exe.run(startup)
+        feed = bert.synthetic_batch(cfg, batch, seq, preds)
+        ref, = exe.run(main, feed=feed, fetch_list=[fetch["loss"]])
+        pt.save_inference_model(str(tmp_path), list(feed.keys()),
+                                [fetch["loss"]], exe, main_program=main,
+                                format="stablehlo", batch_sizes=(batch,),
+                                example_feed=feed)
+    from paddle_tpu.serving import load_serving_artifact
+    pred = load_serving_artifact(str(tmp_path))
+    meta = pred._meta
+    factors = dict(zip(meta["feed_var_names"], meta["feed_batch_factor"]))
+    assert factors["mask_pos"] == preds         # batch*preds leading dim
+    assert factors["src_ids"] == 1
+    out, = pred.run({k: np.asarray(v) for k, v in feed.items()})
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)
