@@ -24,6 +24,9 @@ class Config(object):
         self.model_dir = model_dir
         self.batch_buckets = (1, 2, 4, 8, 16, 32, 64)
         self.place = None
+        # {feed_name: batch_factor} — needed only when NO dynamic feed
+        # carries dim0 == batch (see serving.infer_batch_factors)
+        self.feed_batch_factors = None
 
     def enable_memory_optim(self):
         pass  # XLA plans buffers itself; parity no-op
@@ -40,6 +43,20 @@ class Predictor(object):
             self._program, self._feed_names, self._fetch_names = \
                 load_inference_model(config.model_dir, self._exe)
         self._buckets = sorted(config.batch_buckets)
+        self._factor_overrides = dict(
+            getattr(config, "feed_batch_factors", None) or {})
+        # static per program: which feeds/fetches are declared
+        # batch-dynamic (leading -1)
+        blk = self._program.global_block()
+
+        def _dyn(name):
+            var = blk._find_var_recursive(name)
+            shape = list(var.shape) if var is not None and \
+                var.shape is not None else [-1]
+            return bool(shape) and shape[0] == -1
+
+        self._dyn_feeds = {n: _dyn(n) for n in self._feed_names}
+        self._dyn_fetches = [_dyn(n) for n in self._fetch_names]
 
     def get_input_names(self):
         return list(self._feed_names)
@@ -56,23 +73,50 @@ class Predictor(object):
     def run(self, inputs):
         """inputs: dict name -> np array (or list aligned with feed names).
         Returns list of np arrays aligned with fetch names. Batches are
-        padded up to the bucket size and results sliced back."""
+        padded up to the bucket size and results sliced back; feeds whose
+        leading dim is a multiple of the batch (BERT's flat mask_pos =
+        batch * max_preds) pad to bucket * factor — same contract as the
+        v2 serving artifact (Config.feed_batch_factors overrides the
+        inference when no feed carries dim0 == batch)."""
+        from .serving import infer_batch_factors
         if isinstance(inputs, (list, tuple)):
             inputs = dict(zip(self._feed_names, inputs))
-        n = next(iter(inputs.values())).shape[0]
-        b = self._bucket(n)
+        dyn_dims = [(name, np.asarray(inputs[name]).shape[0])
+                    for name in self._feed_names
+                    if self._dyn_feeds[name]]
+        factors, n = infer_batch_factors(dyn_dims,
+                                         self._factor_overrides)
+        if n is None:   # fully static program: run as-is
+            with scope_guard(self._scope):
+                return self._exe.run(self._program, feed=dict(inputs),
+                                     fetch_list=self._fetch_names)
+        b = self._bucket(max(n, 1))
         feed = {}
         for name, arr in inputs.items():
             arr = np.asarray(arr)
-            if arr.shape[0] != b:
-                pad = [(0, b - arr.shape[0])] + [(0, 0)] * (arr.ndim - 1)
+            f = factors.get(name, 0)
+            if f and arr.shape[0] != b * f:
+                pad = [(0, b * f - arr.shape[0])] + \
+                    [(0, 0)] * (arr.ndim - 1)
                 arr = np.pad(arr, pad)
             feed[name] = arr
         with scope_guard(self._scope):
             outs = self._exe.run(self._program, feed=feed,
                                  fetch_list=self._fetch_names)
-        return [o[:n] if hasattr(o, "__getitem__") and
-                np.ndim(o) > 0 and o.shape[0] == b else o for o in outs]
+        # slice ONLY fetches declared batch-dynamic in the program — a
+        # static output dim that happens to equal bucket*factor is never
+        # truncated
+        out_factors = sorted({f for f in factors.values() if f},
+                             reverse=True)
+        sliced = []
+        for o, dyn in zip(outs, self._dyn_fetches):
+            if dyn and hasattr(o, "__getitem__") and np.ndim(o) > 0:
+                for f in out_factors:
+                    if o.shape[0] == b * f:
+                        o = o[:n * f]
+                        break
+            sliced.append(o)
+        return sliced
 
 
 def create_predictor(config):

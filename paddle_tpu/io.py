@@ -115,7 +115,8 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
                          main_program=None, model_filename=None,
                          params_filename=None, export_for_deployment=True,
                          program_only=False, format="default",
-                         batch_sizes=(1, 8, 32), example_feed=None):
+                         batch_sizes=(1, 8, 32), example_feed=None,
+                         feed_batch_factors=None):
     """Freeze: clone for_test, prune to feeds/targets, save IR + params.
 
     format="stablehlo" additionally writes a deployable serving artifact
@@ -158,7 +159,8 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
         export_serving_artifact(dirname, feeded_var_names, target_vars,
                                 executor, batch_sizes=batch_sizes,
                                 pruned_program=pruned,
-                                example_feed=example_feed)
+                                example_feed=example_feed,
+                                feed_batch_factors=feed_batch_factors)
     return target_names
 
 

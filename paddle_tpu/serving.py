@@ -51,6 +51,49 @@ def _infer_fn(program, feed_names, fetch_names, scope):
     return fn
 
 
+def infer_batch_factors(dyn_dims, overrides=None):
+    """Shared batch-factor inference (serving export AND the in-process
+    Predictor): `dyn_dims` is [(name, dim0)] for the batch-dynamic
+    feeds. A feed's dim0 = factor * batch; the smallest dim0 is taken as
+    the batch unless `overrides` ({name: factor}) pins a feed — then the
+    batch derives from the overridden feeds (they must agree). Returns
+    ({name: factor}, batch). batch 0 (empty request) gives factor 1 to
+    every non-overridden feed."""
+    overrides = overrides or {}
+    if not dyn_dims:
+        return {}, None
+    base = None
+    for name, d0 in dyn_dims:
+        if name in overrides:
+            f = int(overrides[name])
+            if f <= 0 or d0 % f:
+                raise ValueError(
+                    "feed %r dim0 %d is not a multiple of its declared "
+                    "batch factor %r" % (name, d0, overrides[name]))
+            b2 = d0 // f
+            if base is None:
+                base = b2
+            elif b2 != base:
+                raise ValueError(
+                    "overridden feeds disagree on the batch: %r implies "
+                    "%d, earlier feeds %d" % (name, b2, base))
+    if base is None:
+        base = min(d0 for _, d0 in dyn_dims)
+    factors = {}
+    for name, d0 in dyn_dims:
+        if name in overrides:
+            factors[name] = int(overrides[name])
+        elif base == 0:
+            factors[name] = 1
+        else:
+            if d0 % base:
+                raise ValueError(
+                    "feed %r leading dim %d is not a multiple of the "
+                    "batch %d" % (name, d0, base))
+            factors[name] = d0 // base
+    return factors, base
+
+
 def _feed_factors(program, feed_names, example_feed, overrides=None):
     """Per-feed batch factors: feed i's leading dim is factor[i] *
     request_batch (0 = static feed). Factor 1 is the default for
@@ -71,23 +114,10 @@ def _feed_factors(program, feed_names, example_feed, overrides=None):
     if example_feed is None:
         return [overrides.get(n, 1) if d else 0
                 for n, d in zip(feed_names, dyn)]
-    base = min(np.asarray(example_feed[n]).shape[0]
-               for n, d in zip(feed_names, dyn) if d)
-    factors = []
-    for name, d in zip(feed_names, dyn):
-        if not d:
-            factors.append(0)
-            continue
-        if name in overrides:
-            factors.append(int(overrides[name]))
-            continue
-        n0 = np.asarray(example_feed[name]).shape[0]
-        if n0 % base:
-            raise ValueError(
-                "serving export: feed %r leading dim %d is not a "
-                "multiple of the inferred batch %d" % (name, n0, base))
-        factors.append(n0 // base)
-    return factors
+    dyn_dims = [(n, np.asarray(example_feed[n]).shape[0])
+                for n, d in zip(feed_names, dyn) if d]
+    fmap, _ = infer_batch_factors(dyn_dims, overrides)
+    return [fmap[n] if d else 0 for n, d in zip(feed_names, dyn)]
 
 
 def _feed_avals(program, feed_names, batch, factors):

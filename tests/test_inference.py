@@ -52,3 +52,31 @@ def test_dygraph_adam_converges():
             opt.minimize(layer)
             losses.append(float(loss.numpy()))
     assert losses[-1] < 0.05 * losses[0], losses[::8]
+
+
+def test_predictor_batch_factor_feeds(tmp_path):
+    """The in-process Predictor handles feeds whose leading dim is a
+    MULTIPLE of the batch (BERT-style flat mask_pos) — same contract as
+    the v2 serving artifact."""
+    from paddle_tpu.models import bert
+    from paddle_tpu.framework.scope import Scope, scope_guard
+
+    cfg = bert.BertConfig(vocab_size=128, hidden_size=32, num_layers=1,
+                          num_heads=2, ff_size=64, max_position=32)
+    batch, seq, preds = 4, 16, 4
+    main, startup, feeds, fetch = bert.bert_pretrain_program(
+        cfg, batch, seq, preds, optimizer_fn=None, is_test=True)
+    with scope_guard(Scope()):
+        exe = pt.Executor()
+        exe.run(startup)
+        feed = bert.synthetic_batch(cfg, batch, seq, preds)
+        ref, = exe.run(main, feed=feed, fetch_list=[fetch["loss"]])
+        pt.save_inference_model(str(tmp_path), list(feed.keys()),
+                                [fetch["loss"]], exe, main_program=main)
+    from paddle_tpu.inference import Config, create_predictor
+    cfg2 = Config(str(tmp_path))
+    cfg2.batch_buckets = (batch,)    # exact bucket: parity with ref run
+    pred = create_predictor(cfg2)
+    out, = pred.run({k: np.asarray(v) for k, v in feed.items()})
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)
