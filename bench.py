@@ -686,12 +686,15 @@ def bench_bucketed_training():
             exe = pt.Executor()
             exe.run(startup)
             exe.train_from_dataset(main, ds, fetch_list=[loss])  # compile
-            t0 = time.perf_counter()
-            steps, last = exe.train_from_dataset(main, ds,
-                                                 fetch_list=[loss])
-            dt = time.perf_counter() - t0
-            assert np.isfinite(np.asarray(last[0])).all()
-        return len(samples) / dt
+            best_dt = None
+            for _ in range(2):   # best-of-2: host contention insurance
+                t0 = time.perf_counter()
+                steps, last = exe.train_from_dataset(main, ds,
+                                                     fetch_list=[loss])
+                dt = time.perf_counter() - t0
+                assert np.isfinite(np.asarray(last[0])).all()
+                best_dt = dt if best_dt is None else min(best_dt, dt)
+        return len(samples) / best_dt
 
     bucketed_sps = run_pass(buckets)
     maxlen_sps = run_pass((max_len,))   # every batch padded to max_len
