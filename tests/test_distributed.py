@@ -1139,7 +1139,8 @@ def test_fleet_pipeline_run_steps_matches_per_step(schedule):
 
 def test_ring_attention_padding_mask_bf16():
     """The flagship's dtype: masked ring attention in bf16 agrees with
-    the dense bf16 oracle (logits accumulate f32 in both)."""
+    the dense bf16 oracle (the ring accumulates logits in f32; the
+    oracle's einsum rounds through bf16, hence the loose tolerance)."""
     import jax.numpy as jnp
     from paddle_tpu.distributed import init_mesh
     from paddle_tpu.distributed.ring_attention import ring_attention
