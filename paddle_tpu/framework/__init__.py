@@ -8,3 +8,10 @@ from .executor import Executor  # noqa
 from .backward import append_backward, gradients  # noqa
 from .compiler import CompiledProgram, BuildStrategy, ExecutionStrategy  # noqa
 from . import unique_name  # noqa
+from . import watchdog  # noqa
+from . import resilience  # noqa
+from .watchdog import CollectiveTimeoutError, wait_with_timeout  # noqa
+from .resilience import (FaultInjector, RetryPolicy,  # noqa
+                         ResilientTrainer, SimulatedPreemptionError,
+                         ServerOverloadedError, DeadlineExceededError,
+                         RestartBudgetExceededError)

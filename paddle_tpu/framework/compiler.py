@@ -7,10 +7,28 @@ pjit over a jax.sharding.Mesh: parameters/feeds get NamedShardings, XLA
 partitions the single fused HLO and inserts ICI collectives (AllReduce/
 AllGather/ReduceScatter) automatically — the north-star design.
 """
+import os
+
 import numpy as np
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _env_timeout_default():
+    """Fleet-wide watchdog arming without code changes: BuildStrategy's
+    collective_timeout_s defaults to PADDLE_TPU_COLLECTIVE_TIMEOUT_S
+    (seconds; unset/empty = no guard)."""
+    raw = os.environ.get("PADDLE_TPU_COLLECTIVE_TIMEOUT_S", "").strip()
+    if not raw:
+        return None
+    try:
+        return float(raw)
+    except ValueError:
+        raise ValueError(
+            "PADDLE_TPU_COLLECTIVE_TIMEOUT_S=%r is not a number of "
+            "seconds (use e.g. '30' or '12.5', or unset for no guard)"
+            % raw)
 
 
 class BuildStrategy(object):
@@ -27,7 +45,7 @@ class BuildStrategy(object):
         self.check_numerics = False
         # halt detection: bound each step's completion (None = no guard);
         # consumed by the run_step watchdog (framework/watchdog.py)
-        self.collective_timeout_s = None
+        self.collective_timeout_s = _env_timeout_default()
         # parity no-ops
         self.fuse_all_reduce_ops = True
         self.fuse_elewise_add_act_ops = True

@@ -24,6 +24,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from . import resilience
 from . import trace as trace_mod
 from .dtypes import to_jax_dtype
 from .place import CPUPlace, TPUPlace, _current_expected_place  # noqa: F401
@@ -184,6 +185,11 @@ class Executor(object):
             self._run_eager(program, feed, scope)
             return []
 
+        # chaos-harness injection point: one fire per jitted-step dispatch
+        # (startup/eager programs don't count). A no-op unless a
+        # FaultInjector is installed (resilience.inject / PADDLE_TPU_FAULTS).
+        resilience.fire("step", what="Executor.run")
+
         if getattr(program, "_pp_plan", None) is not None:
             return self._run_pipeline(program, feed, fetch_names, scope,
                                       return_numpy)
@@ -286,6 +292,9 @@ class Executor(object):
         if n_steps == 0:
             raise ValueError("run_steps needs at least one step; the "
                              "stacked feeds have a leading axis of 0")
+        # one fire per scanned WINDOW (a window is one device dispatch —
+        # the granularity at which a real preemption would kill the step)
+        resilience.fire("step", what="Executor.run_steps")
         if getattr(program, "_pp_plan", None) is not None:
             return self._run_pipeline_steps(program, feed, fetch_names,
                                             scope, return_numpy, n_steps)

@@ -1,0 +1,565 @@
+"""Resilience subsystem — fault injection, retry/backoff, auto-recovery.
+
+Reference parity: the reference stack survives real fleets through three
+mechanisms — collective ops carry timeouts (operators/collective/),
+the transpiler emits ``checkpoint_notify`` so trainers snapshot around
+faults, and pserver trainers reconnect after transient RPC failures.
+This module is the TPU-native port of that recovery story, closing the
+detect -> recover loop that watchdog.py (detect a hung step) and
+io.save_checkpoint (crash-consistent snapshots) leave open:
+
+  * :class:`FaultInjector` — a deterministic, seeded chaos harness with
+    named injection points (``step``, ``ckpt_write``, ``serve``) so every
+    recovery path is exercised by fast CPU-backend tests, not hope.
+  * :class:`RetryPolicy` — exponential backoff with jitter plus a
+    transient/fatal classifier (CollectiveTimeoutError and injected
+    preemptions are retryable; shape/sharding errors are not).
+  * :class:`ResilientTrainer` — drives Executor.run / run_steps; on a
+    retryable step failure it restores the latest VALID checkpoint,
+    rewinds the step counter and resumes, under a bounded restart
+    budget.
+  * :func:`run_with_deadline` — per-request deadline used by
+    ServingPredictor for graceful degradation (load shedding +
+    warm-bucket fallback live in serving.py).
+  * a structured event log (:func:`events`) recording every fault,
+    retry, restore, shed and degradation for observability.
+
+Env knobs (read once; ``reload_env()`` re-reads):
+  PADDLE_TPU_FAULTS       fault spec string, e.g.
+                          ``step:preempt@5;serve:slow=2.0@3``
+  PADDLE_TPU_FAULT_SEED   seed for probabilistic (``~p``) specs
+"""
+import collections
+import contextlib
+import logging
+import os
+import random
+import threading
+import time
+
+from .watchdog import CollectiveTimeoutError, bounded_call
+
+__all__ = [
+    "FaultSpec", "FaultInjector", "RetryPolicy", "ResilientTrainer",
+    "SimulatedPreemptionError", "ServerOverloadedError",
+    "DeadlineExceededError", "RestartBudgetExceededError",
+    "fire", "inject", "install", "current_injector", "reload_env",
+    "events", "record_event", "clear_events", "classify",
+    "run_with_deadline", "INJECTION_POINTS",
+]
+
+INJECTION_POINTS = ("step", "ckpt_write", "serve")
+
+
+def _logger():
+    from ..log_helper import get_logger
+    return get_logger("paddle_tpu.resilience", logging.WARNING,
+                      fmt="%(asctime)s-%(levelname)s: %(message)s")
+
+
+# ---------------------------------------------------------------------------
+# errors
+# ---------------------------------------------------------------------------
+
+class SimulatedPreemptionError(RuntimeError):
+    """Injected stand-in for a preempted/evicted host: the step dies the
+    way a real preemption surfaces (an exception out of the dispatch),
+    and recovery must restore + replay."""
+
+
+class ServerOverloadedError(RuntimeError):
+    """Load shedding: the serving in-flight cap is full. Clients should
+    back off and retry — the deliberate alternative to queue collapse."""
+
+
+class DeadlineExceededError(CollectiveTimeoutError):
+    """A per-request serving deadline expired. Subclasses
+    CollectiveTimeoutError so existing timeout handling (and the
+    transient classifier) treat it uniformly."""
+
+
+class RestartBudgetExceededError(RuntimeError):
+    """ResilientTrainer exhausted its restart budget — the fault is not
+    transient at this rate; escalate to the orchestrator."""
+
+
+# ---------------------------------------------------------------------------
+# structured event log
+# ---------------------------------------------------------------------------
+
+class EventLog(object):
+    """Bounded, thread-safe, append-only record of resilience activity.
+
+    Each event is a plain dict with at least ``kind`` and ``time`` —
+    cheap to export to any metrics pipe later."""
+
+    def __init__(self, capacity=4096):
+        self._events = collections.deque(maxlen=capacity)
+        self._lock = threading.Lock()
+
+    def record(self, kind, **fields):
+        event = dict(fields, kind=kind, time=time.time())
+        with self._lock:
+            self._events.append(event)
+        return event
+
+    def events(self, kind=None):
+        with self._lock:
+            evs = list(self._events)
+        if kind is None:
+            return evs
+        return [e for e in evs if e["kind"] == kind]
+
+    def clear(self):
+        with self._lock:
+            self._events.clear()
+
+
+_LOG = EventLog()
+
+
+def events(kind=None):
+    """All recorded resilience events (optionally filtered by kind)."""
+    return _LOG.events(kind)
+
+
+def record_event(kind, **fields):
+    return _LOG.record(kind, **fields)
+
+
+def clear_events():
+    _LOG.clear()
+
+
+# ---------------------------------------------------------------------------
+# fault injection
+# ---------------------------------------------------------------------------
+
+# point -> kinds it accepts (parse-time validation: a typo'd chaos spec
+# must fail loudly at configure time, not silently never fire)
+_POINT_KINDS = {
+    "step": ("preempt", "collective_timeout", "nan"),
+    "ckpt_write": ("io_error",),
+    "serve": ("slow", "error"),
+}
+
+
+class FaultSpec(object):
+    """One parsed fault: ``point:kind[=arg][@N | ~p]``.
+
+    ``@N``  fire exactly at the N-th call of the point (1-based, default 1)
+    ``~p``  fire each call with probability p (seeded — deterministic)
+    ``=arg`` float argument (e.g. ``serve:slow=2.0`` sleeps 2 seconds)
+    """
+
+    def __init__(self, point, kind, at=None, prob=None, arg=None):
+        if point not in _POINT_KINDS:
+            raise ValueError("unknown injection point %r (have %s)"
+                             % (point, sorted(_POINT_KINDS)))
+        if kind not in _POINT_KINDS[point]:
+            raise ValueError("injection point %r has no fault kind %r "
+                             "(have %s)" % (point, kind,
+                                            _POINT_KINDS[point]))
+        self.point, self.kind, self.arg = point, kind, arg
+        self.at = at if prob is not None or at is not None else 1
+        self.prob = prob
+
+    @classmethod
+    def parse(cls, text):
+        text = text.strip()
+        if ":" not in text:
+            raise ValueError("fault spec %r needs the form "
+                             "point:kind[=arg][@N|~p]" % text)
+        point, rest = text.split(":", 1)
+        at = prob = arg = None
+        if "@" in rest:
+            rest, n = rest.rsplit("@", 1)
+            at = int(n)
+        elif "~" in rest:
+            rest, p = rest.rsplit("~", 1)
+            prob = float(p)
+        if "=" in rest:
+            rest, a = rest.split("=", 1)
+            arg = float(a)
+        return cls(point.strip(), rest.strip(), at=at, prob=prob, arg=arg)
+
+    def __repr__(self):
+        tail = "@%d" % self.at if self.prob is None else "~%g" % self.prob
+        arg = "" if self.arg is None else "=%g" % self.arg
+        return "FaultSpec(%s:%s%s%s)" % (self.point, self.kind, arg, tail)
+
+
+class FaultInjector(object):
+    """Deterministic chaos harness.
+
+    Configure with a spec string (``;`` or ``,`` separated FaultSpecs) or
+    a list of FaultSpec objects, plus a seed for probabilistic specs.
+    Production code calls :func:`fire` at its injection points; with no
+    injector installed that is a near-free no-op."""
+
+    def __init__(self, specs="", seed=0):
+        if isinstance(specs, str):
+            parts = [s for chunk in specs.split(";")
+                     for s in chunk.split(",") if s.strip()]
+            self.specs = [FaultSpec.parse(s) for s in parts]
+        else:
+            self.specs = list(specs)
+        self.seed = seed
+        self._rng = random.Random(seed)
+        self._counts = {}
+        self._lock = threading.Lock()
+
+    def counts(self):
+        """{point: number of fire() calls seen} — test introspection."""
+        with self._lock:
+            return dict(self._counts)
+
+    def fire(self, point, what=""):
+        """Evaluate the specs for ``point`` at this call.
+
+        Raises the fault's error for raising kinds; returns an action
+        dict (e.g. ``{"slow_s": 2.0}``) for behavioral kinds."""
+        with self._lock:
+            n = self._counts.get(point, 0) + 1
+            self._counts[point] = n
+            hits = []
+            for spec in self.specs:
+                if spec.point != point:
+                    continue
+                if spec.prob is not None:
+                    if self._rng.random() >= spec.prob:
+                        continue
+                elif spec.at != n:
+                    continue
+                hits.append(spec)
+        actions = {}
+        for spec in hits:
+            record_event("fault", point=point, fault=spec.kind, call=n,
+                         what=what)
+            if spec.kind == "preempt":
+                raise SimulatedPreemptionError(
+                    "injected preemption at %s call %d%s"
+                    % (point, n, (" (%s)" % what) if what else ""))
+            if spec.kind == "collective_timeout":
+                raise CollectiveTimeoutError(
+                    "injected collective timeout at %s call %d" % (point, n))
+            if spec.kind == "nan":
+                raise FloatingPointError(
+                    "injected NaN blowup at %s call %d" % (point, n))
+            if spec.kind == "io_error":
+                raise OSError(
+                    "injected checkpoint I/O error at %s call %d"
+                    % (point, n))
+            if spec.kind == "error":
+                raise RuntimeError(
+                    "injected serving failure at %s call %d" % (point, n))
+            if spec.kind == "slow":
+                actions["slow_s"] = spec.arg if spec.arg is not None else 1.0
+        return actions
+
+
+_state = {"injector": None, "env_loaded": False}
+
+
+def install(injector):
+    """Install an injector globally (None uninstalls). Returns it."""
+    _state["injector"] = injector
+    _state["env_loaded"] = True   # explicit install wins over env
+    return injector
+
+
+def current_injector():
+    if _state["injector"] is None and not _state["env_loaded"]:
+        _state["env_loaded"] = True
+        spec = os.environ.get("PADDLE_TPU_FAULTS", "")
+        if spec:
+            seed = int(os.environ.get("PADDLE_TPU_FAULT_SEED", "0") or 0)
+            _state["injector"] = FaultInjector(spec, seed=seed)
+    return _state["injector"]
+
+
+def reload_env():
+    """Drop the cached env injector and re-read PADDLE_TPU_FAULTS."""
+    _state["injector"] = None
+    _state["env_loaded"] = False
+    return current_injector()
+
+
+@contextlib.contextmanager
+def inject(specs, seed=0):
+    """Context manager: install a FaultInjector for the enclosed block."""
+    inj = specs if isinstance(specs, FaultInjector) \
+        else FaultInjector(specs, seed=seed)
+    old_inj, old_env = _state["injector"], _state["env_loaded"]
+    _state["injector"], _state["env_loaded"] = inj, True
+    try:
+        yield inj
+    finally:
+        _state["injector"], _state["env_loaded"] = old_inj, old_env
+
+
+def fire(point, what=""):
+    """Production injection hook — a no-op unless an injector is
+    installed (or PADDLE_TPU_FAULTS is set)."""
+    inj = current_injector()
+    if inj is None:
+        return {}
+    return inj.fire(point, what=what)
+
+
+# ---------------------------------------------------------------------------
+# retry policy
+# ---------------------------------------------------------------------------
+
+# Transient: the operation may succeed on replay from a clean state —
+# hung/injected collectives, preemptions, torn I/O, NaN blowups (restore
+# rewinds past the poisoned state; a deterministic NaN re-fires and the
+# restart budget converts it to a hard failure).
+_TRANSIENT_TYPES = (CollectiveTimeoutError, SimulatedPreemptionError,
+                    ServerOverloadedError, OSError, TimeoutError,
+                    ConnectionError, FloatingPointError)
+# Fatal: program-shape bugs — shape/sharding/dtype mismatches replay
+# identically, so retrying only burns the budget.
+_FATAL_TYPES = (ValueError, TypeError, KeyError, IndexError,
+                NotImplementedError, AssertionError)
+
+
+def classify(err):
+    """'transient' (worth a retry/restore) or 'fatal' (re-raise now)."""
+    if isinstance(err, _FATAL_TYPES):
+        return "fatal"
+    if isinstance(err, _TRANSIENT_TYPES):
+        return "transient"
+    return "fatal"
+
+
+class RetryPolicy(object):
+    """Exponential backoff with (seeded, deterministic) jitter.
+
+    delay(attempt) = min(base * multiplier**attempt, max) * U[1-jitter, 1]
+    """
+
+    def __init__(self, max_attempts=4, base_delay_s=0.05, max_delay_s=5.0,
+                 multiplier=2.0, jitter=0.5, seed=0, sleep=time.sleep,
+                 classify=classify):
+        if max_attempts < 1:
+            raise ValueError("max_attempts must be >= 1")
+        if not 0.0 <= jitter <= 1.0:
+            raise ValueError("jitter must be in [0, 1]")
+        self.max_attempts = int(max_attempts)
+        self.base_delay_s = float(base_delay_s)
+        self.max_delay_s = float(max_delay_s)
+        self.multiplier = float(multiplier)
+        self.jitter = float(jitter)
+        self.sleep = sleep
+        self._classify = classify
+        self._rng = random.Random(seed)
+
+    def is_transient(self, err):
+        return self._classify(err) == "transient"
+
+    def delay_s(self, attempt):
+        """Backoff before retry number ``attempt`` (0-based)."""
+        d = min(self.base_delay_s * self.multiplier ** attempt,
+                self.max_delay_s)
+        if self.jitter:
+            d *= 1.0 - self.jitter * self._rng.random()
+        return d
+
+    def call(self, fn, *args, **kwargs):
+        """Run ``fn`` with transient-retry; fatal errors raise through.
+        ``what=`` names the operation in events."""
+        what = kwargs.pop("what", getattr(fn, "__name__", "operation"))
+        last = None
+        for attempt in range(self.max_attempts):
+            try:
+                return fn(*args, **kwargs)
+            except Exception as e:
+                last = e
+                if not self.is_transient(e) \
+                        or attempt + 1 >= self.max_attempts:
+                    raise
+                d = self.delay_s(attempt)
+                record_event("retry", what=what, attempt=attempt + 1,
+                             error=type(e).__name__, backoff_s=d)
+                self.sleep(d)
+        raise last   # pragma: no cover - loop always returns or raises
+
+
+# ---------------------------------------------------------------------------
+# deadline helper (serving)
+# ---------------------------------------------------------------------------
+
+def run_with_deadline(fn, deadline_s, what="request"):
+    """Run ``fn()`` with a wall-clock bound.
+
+    Shares watchdog.bounded_call with wait_with_timeout — the same
+    detect-the-hang mechanism, lifted from device waits to arbitrary
+    host work (injected slowness, cold-bucket compiles). The work
+    itself cannot be cancelled; the CALLER gets
+    control back with a DeadlineExceededError and the orphaned thread
+    finishes (and warms any compile cache) in the background."""
+    if deadline_s is None:
+        return fn()
+    done, value, err = bounded_call(fn, deadline_s,
+                                    name="paddle_tpu-deadline")
+    if not done:
+        record_event("deadline", what=what, deadline_s=float(deadline_s))
+        raise DeadlineExceededError(
+            "%s did not complete within its %.2fs deadline"
+            % (what, float(deadline_s)))
+    if err is not None:
+        raise err
+    return value
+
+
+# ---------------------------------------------------------------------------
+# resilient training
+# ---------------------------------------------------------------------------
+
+def _stack_feeds(feed_dicts):
+    """[{name: per-step array}] -> {name: stacked (steps, ...) array} for
+    Executor.run_steps."""
+    import numpy as np
+    keys = set(feed_dicts[0])
+    for f in feed_dicts[1:]:
+        if set(f) != keys:
+            raise ValueError("all feeds in a run_steps window need the "
+                             "same keys; got %s vs %s"
+                             % (sorted(keys), sorted(f)))
+    return {k: np.stack([np.asarray(f[k]) for f in feed_dicts])
+            for k in keys}
+
+
+class ResilientTrainer(object):
+    """Auto-recovering training driver.
+
+    Wraps Executor.run / run_steps (plain Program OR CompiledProgram —
+    the latter's collective-timeout watchdog raises into the same
+    handler): steps run in dispatch windows, the whole scope is
+    checkpointed every ``checkpoint_every`` steps, and a transient
+    failure (see :func:`classify`) triggers backoff -> restore of the
+    latest VALID checkpoint (io.load_checkpoint quarantines corrupt step
+    dirs) -> step-counter rewind -> replay. Because a checkpoint carries
+    params, optimizer moments AND the PRNG step counter, the replayed
+    trajectory is numerically identical to an uninterrupted run.
+
+    The restart budget bounds total recoveries per run() call; a fault
+    that keeps re-firing becomes RestartBudgetExceededError.
+    """
+
+    def __init__(self, executor, program, ckpt_dir, fetch_list=None,
+                 checkpoint_every=10, max_restarts=3, retry_policy=None,
+                 steps_per_dispatch=1, keep_last=3):
+        from .compiler import CompiledProgram
+        if checkpoint_every < 1:
+            raise ValueError("checkpoint_every must be >= 1")
+        if steps_per_dispatch < 1:
+            raise ValueError("steps_per_dispatch must be >= 1")
+        self._executor = executor
+        self._target = program   # what executor.run receives
+        self._program = program._program \
+            if isinstance(program, CompiledProgram) else program
+        self._ckpt_dir = ckpt_dir
+        self._fetch_list = fetch_list
+        self._checkpoint_every = int(checkpoint_every)
+        self._max_restarts = int(max_restarts)
+        self._policy = retry_policy or RetryPolicy()
+        self._steps_per_dispatch = int(steps_per_dispatch)
+        self._keep_last = int(keep_last)
+
+    # -- events convenience ------------------------------------------------
+    @staticmethod
+    def events(kind=None):
+        return events(kind)
+
+    def _save(self, step):
+        from .. import io as io_mod
+        io_mod.save_checkpoint(self._executor, self._ckpt_dir,
+                               self._program, step=step,
+                               keep_last=self._keep_last)
+        record_event("ckpt", step=step)
+
+    def _restore(self):
+        from .. import io as io_mod
+        step = int(io_mod.load_checkpoint(self._executor, self._ckpt_dir,
+                                          self._program))
+        record_event("restore", step=step)
+        return step
+
+    def _dispatch(self, feeds, step, w, fetch_list):
+        import numpy as np
+        if w == 1:
+            return [self._executor.run(self._target, feed=feeds[step],
+                                       fetch_list=fetch_list)]
+        stacked = _stack_feeds(feeds[step:step + w])
+        outs = self._executor.run_steps(self._target, feed=stacked,
+                                        fetch_list=fetch_list)
+        return [[np.asarray(o)[i] for o in outs] for i in range(w)]
+
+    def run(self, feeds, fetch_list=None):
+        """Run one step per feed dict in ``feeds``, recovering from
+        transient faults. Returns the per-step fetch lists (replayed
+        steps report their replayed — identical — values)."""
+        feeds = list(feeds)
+        n = len(feeds)
+        fetch_list = fetch_list if fetch_list is not None \
+            else self._fetch_list
+        if not fetch_list:
+            raise ValueError(
+                "ResilientTrainer.run needs a fetch_list — an empty one "
+                "would fall into Executor.run's eager path")
+        if n == 0:
+            return []
+        all_fetches = [None] * n
+        # refuse a pre-populated ckpt_dir: this run's step_0 baseline
+        # sorts OLDER than a previous run's step_48, so keep_last would
+        # prune it the moment it is written and the first restore would
+        # silently rewind into the previous run's stale trajectory
+        if os.path.isdir(self._ckpt_dir):
+            stale = sorted(d for d in os.listdir(self._ckpt_dir)
+                           if d.startswith("step_")
+                           and d.split("_", 1)[1].isdigit())
+            if stale:
+                raise ValueError(
+                    "ckpt_dir %r already holds checkpoints (%s) — "
+                    "ResilientTrainer.run starts a fresh trajectory at "
+                    "step 0; give each run a clean directory"
+                    % (self._ckpt_dir, ", ".join(stale)))
+        # baseline snapshot: a fault before the first periodic save must
+        # still have something valid to restore
+        self._save(0)
+        step, restarts = 0, 0
+        while step < n:
+            until_ckpt = self._checkpoint_every \
+                - (step % self._checkpoint_every)
+            w = min(self._steps_per_dispatch, n - step, until_ckpt)
+            try:
+                outs = self._dispatch(feeds, step, w, fetch_list)
+                for i in range(w):
+                    all_fetches[step + i] = outs[i]
+                step += w
+                if step % self._checkpoint_every == 0 or step == n:
+                    self._save(step)
+            except Exception as e:
+                if not self._policy.is_transient(e):
+                    record_event("fatal", step=step,
+                                 error=type(e).__name__)
+                    raise
+                restarts += 1
+                if restarts > self._max_restarts:
+                    record_event("giveup", step=step, restarts=restarts,
+                                 error=type(e).__name__)
+                    raise RestartBudgetExceededError(
+                        "restart budget (%d) exhausted at step %d; last "
+                        "error: %r" % (self._max_restarts, step, e))
+                delay = self._policy.delay_s(restarts - 1)
+                record_event("restart", step=step, restarts=restarts,
+                             error=type(e).__name__, backoff_s=delay)
+                _logger().warning(
+                    "step %d failed (%s: %s) — restart %d/%d after %.2fs",
+                    step, type(e).__name__, e, restarts,
+                    self._max_restarts, delay)
+                self._policy.sleep(delay)
+                step = self._restore()
+        return all_fetches

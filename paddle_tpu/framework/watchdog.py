@@ -12,12 +12,37 @@ import threading
 
 import jax
 
-__all__ = ["CollectiveTimeoutError", "wait_with_timeout"]
+__all__ = ["CollectiveTimeoutError", "wait_with_timeout", "bounded_call"]
 
 
 class CollectiveTimeoutError(RuntimeError):
     """A jitted step (and therefore some collective in it) failed to
     complete within the configured timeout."""
+
+
+def bounded_call(fn, timeout_s, name="paddle_tpu-bounded-call"):
+    """Run ``fn()`` on a daemon helper thread with a bounded join.
+
+    Returns ``(done, value, error)``; ``done`` False means the join
+    timed out and the orphaned thread keeps running in the background.
+    The one detect-the-hang mechanism shared by wait_with_timeout and
+    resilience.run_with_deadline."""
+    box = {}
+    done = threading.Event()
+
+    def _worker():
+        try:
+            box["value"] = fn()
+        except BaseException as e:      # surface errors to the caller
+            box["error"] = e
+        finally:
+            done.set()
+
+    t = threading.Thread(target=_worker, daemon=True, name=name)
+    t.start()
+    if not done.wait(float(timeout_s)):
+        return False, None, None
+    return True, box.get("value"), box.get("error")
 
 
 def wait_with_timeout(outputs, timeout_s, what="jitted step"):
@@ -32,30 +57,27 @@ def wait_with_timeout(outputs, timeout_s, what="jitted step"):
     if timeout_s is None:
         return outputs
     leaves = jax.tree_util.tree_leaves(outputs)
-    done = threading.Event()
-    errs = []
 
-    def _waiter():
-        try:
-            for leaf in leaves:
-                ready = getattr(leaf, "block_until_ready", None)
-                if ready is not None:
-                    ready()
-        except Exception as e:          # surface device errors to caller
-            errs.append(e)
-        finally:
-            done.set()
+    def _wait_all():
+        for leaf in leaves:
+            ready = getattr(leaf, "block_until_ready", None)
+            if ready is not None:
+                ready()
 
-    t = threading.Thread(target=_waiter, daemon=True,
-                         name="paddle_tpu-collective-watchdog")
-    t.start()
-    if not done.wait(float(timeout_s)):
+    done, _, err = bounded_call(_wait_all, timeout_s,
+                                name="paddle_tpu-collective-watchdog")
+    if not done:
+        # observability: every watchdog trip lands in the resilience
+        # event log (lazy import — resilience imports this module)
+        from . import resilience
+        resilience.record_event("watchdog_timeout", what=what,
+                                timeout_s=float(timeout_s))
         raise CollectiveTimeoutError(
             "%s did not complete within %.1fs (process %d/%d, %d local "
             "devices) — likely a hung collective: straggler or failed "
             "host, or a mismatched mesh/sharding across processes"
             % (what, float(timeout_s), jax.process_index(),
                jax.process_count(), jax.local_device_count()))
-    if errs:
-        raise errs[0]
+    if err is not None:
+        raise err
     return outputs

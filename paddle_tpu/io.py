@@ -224,6 +224,13 @@ def load_inference_model(dirname, executor, model_filename=None,
 # ---------------------------------------------------------------------------
 
 CKPT_FORMAT_VERSION = 1
+
+
+class CheckpointFormatError(RuntimeError):
+    """The checkpoint on disk is VALID but written by a newer library.
+    Deliberately not an OSError/ValueError: load_checkpoint's corruption
+    fallback must never quarantine (rename) a healthy too-new
+    checkpoint — upgrade the library instead."""
 MANIFEST_FILE = "manifest.json"
 
 
@@ -357,6 +364,11 @@ def save_checkpoint(executor, dirname, main_program=None, step=None,
 
     def commit():
         _atomic_savez(full_dir, "shards_p%d.npz" % pid, own)
+        # chaos injection point: an I/O fault HERE (shards written,
+        # manifest not) models a mid-commit crash — the step dir is torn
+        # and load_checkpoint must quarantine it, never restore from it
+        from .framework import resilience
+        resilience.fire("ckpt_write", what=step_dir)
         if multihost:  # pragma: no cover - needs real multihost
             from jax.experimental import multihost_utils
             multihost_utils.sync_global_devices("ckpt_shards_%s" % step_dir)
@@ -367,8 +379,11 @@ def save_checkpoint(executor, dirname, main_program=None, step=None,
             _atomic_write(os.path.join(full_dir, MANIFEST_FILE),
                           json.dumps(manifest))
             _atomic_write(os.path.join(dirname, "latest"), step_dir)
+            # prune only VALID step dirs — quarantined step_N.corrupt
+            # dirs are kept for forensics and must not break the sort
             kids = sorted([d for d in os.listdir(dirname)
-                           if d.startswith("step_")],
+                           if d.startswith("step_")
+                           and d.split("_", 1)[1].isdigit()],
                           key=lambda d: int(d.split("_")[1]))
             for d in kids[:-keep_last]:
                 import shutil
@@ -449,35 +464,95 @@ def _stitch(meta, req, readers, dtype, name="<var>"):
     return out
 
 
-def load_checkpoint(executor, dirname, main_program=None, shardings=None):
-    """Restore the latest checkpoint into the global scope.
+def _ckpt_logger():
+    import logging
+    from .log_helper import get_logger
+    return get_logger("paddle_tpu.io", logging.WARNING,
+                      fmt="%(asctime)s-%(levelname)s: %(message)s")
 
-    shardings: optional {var_name: jax.sharding.Sharding} — vars listed
-    are materialized straight onto the CURRENT mesh via
-    jax.make_array_from_callback (each process reads only the slices its
-    devices need; works when the restore topology differs from the save
-    topology).  Unlisted vars load as host arrays and are placed by the
-    next CompiledProgram/Executor run, exactly like a cold start.
-    """
-    import jax
-    import jax.numpy as jnp
-    wait_for_pending_saves()   # an in-flight async commit must land first
-    with open(os.path.join(dirname, "latest")) as f:
-        step_dir = f.read().strip()
+
+def _scrub_step_dir(dirname, step_dir):
+    """Return a corruption description if the step dir is damaged ON
+    DISK (torn/unparsable manifest, missing shard files or npz keys),
+    else None.
+
+    load_checkpoint quarantines only on a positive scrub: a load that
+    failed for a caller-side reason (e.g. a bad ``shardings`` entry)
+    must re-raise, not destroy the whole valid checkpoint history one
+    rename at a time."""
     full_dir = os.path.join(dirname, step_dir)
     manifest_path = os.path.join(full_dir, MANIFEST_FILE)
-    scope = global_scope()
+    if not os.path.exists(manifest_path):
+        try:   # legacy (format 0) layout: one host-gather npz
+            _load_arrays(full_dir, PARAMS_FILE)
+            return None
+        except Exception as e:
+            return "unreadable legacy params file: %s" % e
+    try:
+        with open(manifest_path) as f:
+            manifest = json.load(f)
+        var_metas = manifest["vars"]
+        needed = {}
+        for meta in var_metas.values():
+            for sh in meta["shards"]:
+                needed.setdefault(sh["file"], set()).add(sh["key"])
+    except (OSError, ValueError, KeyError, TypeError) as e:
+        return "torn or malformed manifest: %s" % e
+    for fname, keys in needed.items():
+        try:
+            with np.load(os.path.join(full_dir, fname)) as z:
+                missing = keys.difference(z.files)
+        except Exception as e:
+            return "unreadable shard file %s: %s" % (fname, e)
+        if missing:
+            return "shard file %s is missing keys %s" % (
+                fname, sorted(missing))
+    return None
+
+
+def _quarantine_step_dir(dirname, step_dir, reason):
+    """Rename a corrupt step dir to step_N.corrupt (first free suffix) so
+    it is never picked again but stays on disk for forensics."""
+    import jax
+    if jax.process_index() != 0:  # pragma: no cover - needs multihost
+        return
+    src = os.path.join(dirname, step_dir)
+    dst = src + ".corrupt"
+    i = 0
+    while os.path.exists(dst):
+        i += 1
+        dst = "%s.corrupt.%d" % (src, i)
+    try:
+        os.rename(src, dst)
+    except OSError:  # already gone / racing restore — nothing to keep
+        return
+    _ckpt_logger().warning(
+        "checkpoint %s is corrupt (%s) — quarantined as %s",
+        src, reason, os.path.basename(dst))
+    from .framework import resilience
+    resilience.record_event("ckpt_quarantine", step_dir=step_dir,
+                            reason=str(reason))
+
+
+def _load_step_dir(dirname, step_dir, shardings):
+    """Load one step dir; returns (step, {name: array}) or raises on any
+    corruption (missing/torn manifest, missing shard files or keys).
+    Nothing is written to the scope here — a partial load must not
+    poison live training state."""
+    import jax
+    full_dir = os.path.join(dirname, step_dir)
+    manifest_path = os.path.join(full_dir, MANIFEST_FILE)
     if not os.path.exists(manifest_path):
         # legacy (format 0) host-gather npz checkpoint
         arrays = _load_arrays(full_dir, PARAMS_FILE)
-        for name, arr in arrays.items():
-            scope.set_var(name.replace("__AT__", "@"), jnp.asarray(arr))
-        return int(step_dir.split("_")[1])
+        out = {name.replace("__AT__", "@"): np.asarray(arr)
+               for name, arr in arrays.items()}
+        return int(step_dir.split("_")[1]), out
 
     with open(manifest_path) as f:
         manifest = json.load(f)
     if manifest.get("format_version", 0) > CKPT_FORMAT_VERSION:
-        raise ValueError(
+        raise CheckpointFormatError(
             "checkpoint %s has format_version %s, newer than this "
             "library's %d" % (full_dir, manifest.get("format_version"),
                               CKPT_FORMAT_VERSION))
@@ -494,21 +569,93 @@ def load_checkpoint(executor, dirname, main_program=None, shardings=None):
             arrays_cache[(fname, key)] = handles[fname][key]
         return arrays_cache[(fname, key)]
 
-    shardings = shardings or {}
-    for name, meta in manifest["vars"].items():
-        shape = tuple(meta["shape"])
-        dtype = np.dtype(meta["dtype"])
-        target = shardings.get(name)
-        if target is not None:
-            arr = jax.make_array_from_callback(
-                shape, target,
-                lambda idx, meta=meta, shape=shape, dtype=dtype, name=name:
-                _stitch(meta, _offset_list(idx, shape), readers, dtype,
-                        name))
-        else:
-            arr = _stitch(meta, [[0, d] for d in shape], readers, dtype,
-                          name)
-        scope.set_var(name, arr)
-    for h in handles.values():
-        h.close()
-    return int(manifest["step"])
+    try:
+        out = {}
+        for name, meta in manifest["vars"].items():
+            shape = tuple(meta["shape"])
+            dtype = np.dtype(meta["dtype"])
+            target = shardings.get(name)
+            if target is not None:
+                arr = jax.make_array_from_callback(
+                    shape, target,
+                    lambda idx, meta=meta, shape=shape, dtype=dtype,
+                    name=name:
+                    _stitch(meta, _offset_list(idx, shape), readers, dtype,
+                            name))
+            else:
+                arr = _stitch(meta, [[0, d] for d in shape], readers,
+                              dtype, name)
+            out[name] = arr
+    finally:
+        for h in handles.values():
+            h.close()
+    return int(manifest["step"]), out
+
+
+def _step_no(step_dir):
+    return int(step_dir.split("_")[1])
+
+
+def load_checkpoint(executor, dirname, main_program=None, shardings=None):
+    """Restore the latest VALID checkpoint into the global scope.
+
+    shardings: optional {var_name: jax.sharding.Sharding} — vars listed
+    are materialized straight onto the CURRENT mesh via
+    jax.make_array_from_callback (each process reads only the slices its
+    devices need; works when the restore topology differs from the save
+    topology).  Unlisted vars load as host arrays and are placed by the
+    next CompiledProgram/Executor run, exactly like a cold start.
+
+    Resilience semantics: a corrupt/missing ``latest`` pointer or a step
+    dir with a torn manifest / missing shards does NOT fail the restore.
+    The bad step dir is quarantined (renamed ``step_N.corrupt``) and the
+    newest previous valid checkpoint is used instead; only when NO valid
+    checkpoint remains does the original error surface.
+    """
+    import jax
+    wait_for_pending_saves()   # an in-flight async commit must land first
+    scope = global_scope()
+    latest = None
+    try:
+        with open(os.path.join(dirname, "latest")) as f:
+            latest = f.read().strip() or None
+    except OSError:
+        _ckpt_logger().warning(
+            "checkpoint dir %s has no readable 'latest' pointer — "
+            "falling back to the newest step dir", dirname)
+    others = sorted(
+        (d for d in os.listdir(dirname)
+         if d.startswith("step_") and d != latest
+         and d.split("_", 1)[1].isdigit()),
+        key=_step_no, reverse=True)
+    candidates = ([latest] if latest is not None else []) + others
+    if latest is not None and not os.path.isdir(
+            os.path.join(dirname, latest)):
+        _ckpt_logger().warning(
+            "'latest' names missing checkpoint %s/%s — falling back",
+            dirname, latest)
+        candidates = others
+
+    first_err = None
+    for step_dir in candidates:
+        try:
+            step, out = _load_step_dir(dirname, step_dir, shardings or {})
+        except (OSError, ValueError, KeyError, IndexError) as e:
+            reason = _scrub_step_dir(dirname, step_dir)
+            if reason is None:
+                # healthy on disk: the failure is caller-side (e.g. bad
+                # shardings) — quarantining would eat valid history
+                raise
+            if first_err is None:
+                first_err = e
+            _quarantine_step_dir(dirname, step_dir, reason)
+            continue
+        for name, arr in out.items():
+            scope.set_var(name, arr)
+        if step_dir != latest and jax.process_index() == 0:
+            # repair the pointer so later saves/loads agree on history
+            _atomic_write(os.path.join(dirname, "latest"), step_dir)
+        return step
+    if first_err is not None:
+        raise first_err
+    raise FileNotFoundError("no checkpoint found under %s" % dirname)

@@ -240,11 +240,31 @@ class ServingPredictor(object):
     Python twin of the C++ load path (a non-Python service compiles
     module_b{N}.mlir with PjRt instead). Pads requests up to the nearest
     exported bucket and slices results back — the inference.Predictor
-    contract."""
+    contract.
 
-    def __init__(self, dirname):
+    Resilience (framework/resilience.py):
+      * ``run(..., deadline_s=)`` bounds each request wall-clock —
+        host-side slowness, cold-bucket compiles and device waits alike
+        — via resilience.run_with_deadline -> DeadlineExceededError.
+      * ``max_in_flight`` load-sheds excess concurrency with
+        ServerOverloadedError instead of queue collapse.
+      * degraded mode: when a COLD bucket (first request compiles it)
+        blows the deadline and a warm larger bucket exists, the request
+        is padded up and served from the warm bucket while the abandoned
+        compile finishes in the background. The fallback is new backend
+        work and claims its own in-flight slot — under cap pressure it
+        sheds rather than exceed the cap.
+    """
+
+    def __init__(self, dirname, max_in_flight=None, deadline_s=None):
+        import threading
         from jax import export as jax_export
         out_dir = os.path.join(dirname, MODULE_SUBDIR)
+        self._max_in_flight = max_in_flight
+        self._deadline_s = deadline_s
+        self._in_flight = 0
+        self._lock = threading.Lock()
+        self._warm = set()   # buckets that served (=> compiled) already
         with open(os.path.join(out_dir, "meta.json")) as f:
             self._meta = json.load(f)
         if self._meta["format_version"] > SERVING_FORMAT_VERSION:
@@ -283,17 +303,46 @@ class ServingPredictor(object):
             "re-export with a larger batch_sizes entry"
             % (n, max(self._fns)))
 
-    def run(self, inputs):
-        """inputs: dict name -> array (or list aligned with feed names).
-        Returns list of np arrays aligned with fetch names."""
-        if isinstance(inputs, (list, tuple)):
-            inputs = dict(zip(self._feed_names, inputs))
-        if not self._meta["dynamic_batch"]:
-            outs = self._fns[0].call(
-                *[np.asarray(inputs[n]) for n in self._feed_names])
-            return [np.asarray(o) for o in outs]
-        # the request batch comes from the feeds' recorded batch factors
-        # (feed i's dim0 = factor_i * batch) — never from dict order
+    # -- admission control ------------------------------------------------
+    @property
+    def in_flight(self):
+        """LIVE backend work, not callers inside run(): a request whose
+        deadline expired still occupies its slot until the orphaned
+        worker actually finishes — a timeout/retry storm must not stack
+        unbounded concurrent device work behind a cap reading 0."""
+        return self._in_flight
+
+    def _acquire_slot(self):
+        """Claim an in-flight slot (ServerOverloadedError when full).
+        Returns an idempotent release callable; the RUNNING work calls
+        it on completion, so abandoned deadline workers keep their slot
+        until they exit."""
+        from .framework import resilience
+        if self._max_in_flight is None:
+            return lambda: None
+        with self._lock:
+            if self._in_flight >= self._max_in_flight:
+                resilience.record_event(
+                    "shed", in_flight=self._in_flight,
+                    cap=self._max_in_flight)
+                raise resilience.ServerOverloadedError(
+                    "serving predictor is at its in-flight cap "
+                    "(%d) — shedding load; retry with backoff"
+                    % self._max_in_flight)
+            self._in_flight += 1
+        released = []
+
+        def release():
+            with self._lock:
+                if not released:
+                    released.append(True)
+                    self._in_flight -= 1
+        return release
+
+    # -- request batch / bucket handling ----------------------------------
+    def _request_batch(self, inputs):
+        """Request batch from the feeds' recorded batch factors (feed i's
+        dim0 = factor_i * batch) — never from dict order."""
         factors = self._meta["feed_batch_factor"]
         n = None
         for name, f in zip(self._feed_names, factors):
@@ -310,7 +359,49 @@ class ServingPredictor(object):
                         "batch-dynamic feeds disagree on batch size: "
                         "feed %r implies batch %d, earlier feeds %d"
                         % (name, got // f, n))
-        b = self._bucket(n)
+        return n
+
+    def warmup(self, buckets=None):
+        """Compile (and mark warm) the given buckets — all by default.
+        Run at deploy time so live traffic never eats a cold compile."""
+        for b in sorted(self._fns) if buckets is None else buckets:
+            spec = self._meta["buckets"][str(b)]["feeds"]
+            feeds = [np.zeros(f["shape"], dtype=np.dtype(f["dtype"]))
+                     for f in spec]
+            for o in self._fns[b].call(*feeds):
+                np.asarray(o)
+            self._mark_warm(b)
+
+    def _mark_warm(self, b):
+        # orphaned deadline workers finish compiles in the background and
+        # land here concurrently with caller-thread reads — lock both
+        with self._lock:
+            self._warm.add(b)
+
+    def _warm_fallback_bucket(self, n):
+        """Smallest WARM bucket that fits a batch-n request, or None."""
+        with self._lock:
+            warm = sorted(self._warm)
+        fits = [b for b in warm if b >= (n or 0)]
+        return fits[0] if fits else None
+
+    def _run_impl(self, inputs, force_bucket=None):
+        from .framework.resilience import fire
+        # injection point: a chaos 'slow' fault sleeps INSIDE the
+        # deadline-bounded region; 'error' raises like a dying backend
+        actions = fire("serve", what="ServingPredictor.run")
+        if actions.get("slow_s"):
+            import time
+            time.sleep(actions["slow_s"])
+        if not self._meta["dynamic_batch"]:
+            outs = self._fns[0].call(
+                *[np.asarray(inputs[n]) for n in self._feed_names])
+            outs = [np.asarray(o) for o in outs]
+            self._mark_warm(0)
+            return outs
+        factors = self._meta["feed_batch_factor"]
+        n = self._request_batch(inputs)
+        b = self._bucket(n) if force_bucket is None else force_bucket
         feeds = []
         for name, f in zip(self._feed_names, factors):
             arr = np.asarray(inputs[name])
@@ -320,6 +411,7 @@ class ServingPredictor(object):
                 arr = np.pad(arr, pad)
             feeds.append(arr)
         outs = self._fns[b].call(*feeds)
+        self._mark_warm(b)
         # slice batch-scaled outputs per the EXPORT-time factors — never
         # guessed from runtime shapes (a static dim that happens to
         # equal b*f must not be truncated)
@@ -332,6 +424,46 @@ class ServingPredictor(object):
             sliced.append(o)
         return sliced
 
+    def run(self, inputs, deadline_s=None, degraded_ok=True):
+        """inputs: dict name -> array (or list aligned with feed names).
+        Returns list of np arrays aligned with fetch names.
 
-def load_serving_artifact(dirname):
-    return ServingPredictor(dirname)
+        deadline_s (defaults to the constructor's): wall-clock budget for
+        THIS request; DeadlineExceededError past it. degraded_ok: a
+        deadline miss on a cold bucket falls back to a warm larger
+        bucket when one exists (recorded as a 'degraded' event)."""
+        from .framework import resilience
+        if isinstance(inputs, (list, tuple)):
+            inputs = dict(zip(self._feed_names, inputs))
+        deadline = deadline_s if deadline_s is not None \
+            else self._deadline_s
+        def bounded(what, **impl_kw):
+            # the slot is released by the WORK when it finishes — on a
+            # deadline miss the orphaned worker keeps it until then
+            release = self._acquire_slot()
+
+            def body():
+                try:
+                    return self._run_impl(inputs, **impl_kw)
+                finally:
+                    release()
+            return resilience.run_with_deadline(body, deadline, what=what)
+
+        try:
+            return bounded("serving request")
+        except resilience.DeadlineExceededError:
+            if not degraded_ok or not self._meta["dynamic_batch"]:
+                raise
+            n = self._request_batch(inputs)
+            natural = self._bucket(n)
+            fb = self._warm_fallback_bucket(n)
+            if natural in self._warm or fb is None:
+                raise   # the slot itself is slow, not a cold compile
+            resilience.record_event("degraded", batch=n,
+                                    cold_bucket=natural, warm_bucket=fb)
+            return bounded("degraded serving request", force_bucket=fb)
+
+
+def load_serving_artifact(dirname, max_in_flight=None, deadline_s=None):
+    return ServingPredictor(dirname, max_in_flight=max_in_flight,
+                            deadline_s=deadline_s)

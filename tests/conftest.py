@@ -31,6 +31,16 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    # registered markers so tier-1 (-m 'not slow') runs warning-free:
+    # fast chaos tests carry `faultinject`; long soaks hide behind `slow`
+    config.addinivalue_line(
+        "markers", "slow: long soak/perf tests excluded from tier-1 runs")
+    config.addinivalue_line(
+        "markers",
+        "faultinject: fast chaos tests driven by framework.resilience")
+
+
 @pytest.fixture(autouse=True)
 def fresh_programs():
     """Give every test fresh default programs + scope + name generator."""

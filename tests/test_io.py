@@ -3,6 +3,7 @@ tests/unittests/test_io_save_load*, test_inference_model_io)."""
 import os
 
 import numpy as np
+import pytest
 
 import paddle_tpu as pt
 from paddle_tpu import layers, optimizer
@@ -481,6 +482,182 @@ def test_py_reader_mid_epoch_reset_no_stale_batches():
     ov, = exe.run(main, fetch_list=[out])
     assert float(np.asarray(ov)[0, 0]) == 200.0  # fresh epoch, not stale
     reader.reset()
+
+
+def _two_step_ckpt_dir(tmp_path):
+    """Scope with one var checkpointed at steps 1 (value 1s) and 2
+    (value 2s); returns the dir. 'latest' points at step_2."""
+    import jax.numpy as jnp
+    from paddle_tpu.io import save_checkpoint
+    from paddle_tpu.framework.scope import Scope, scope_guard
+    sc = Scope()
+    with scope_guard(sc):
+        sc.set_var("w_q", jnp.ones(4, jnp.float32))
+        save_checkpoint(None, str(tmp_path), step=1)
+        sc.set_var("w_q", jnp.ones(4, jnp.float32) * 2)
+        save_checkpoint(None, str(tmp_path), step=2)
+    return str(tmp_path)
+
+
+@pytest.mark.faultinject
+def test_load_checkpoint_quarantines_corrupt_manifest(tmp_path):
+    """A torn/corrupt manifest must not fail the restore: the bad step
+    dir is renamed step_N.corrupt and the previous valid checkpoint
+    loads instead (satellite of the resilience PR)."""
+    from paddle_tpu.framework import resilience
+    from paddle_tpu.io import load_checkpoint
+    from paddle_tpu.framework.scope import Scope, scope_guard
+    d = _two_step_ckpt_dir(tmp_path)
+    resilience.clear_events()
+    with open(os.path.join(d, "step_2", "manifest.json"), "w") as f:
+        f.write("{ not json")
+    sc = Scope()
+    with scope_guard(sc):
+        assert load_checkpoint(None, d) == 1
+        np.testing.assert_allclose(np.asarray(sc.find_var("w_q")),
+                                   np.ones(4))
+    assert os.path.isdir(os.path.join(d, "step_2.corrupt"))
+    assert not os.path.exists(os.path.join(d, "step_2"))
+    # the pointer was repaired to the checkpoint actually restored
+    with open(os.path.join(d, "latest")) as f:
+        assert f.read().strip() == "step_1"
+    assert resilience.events("ckpt_quarantine")
+
+
+@pytest.mark.faultinject
+def test_load_checkpoint_quarantines_missing_shards(tmp_path):
+    from paddle_tpu.io import load_checkpoint
+    from paddle_tpu.framework.scope import Scope, scope_guard
+    d = _two_step_ckpt_dir(tmp_path)
+    os.unlink(os.path.join(d, "step_2", "shards_p0.npz"))
+    sc = Scope()
+    with scope_guard(sc):
+        assert load_checkpoint(None, d) == 1
+        np.testing.assert_allclose(np.asarray(sc.find_var("w_q")),
+                                   np.ones(4))
+    assert os.path.isdir(os.path.join(d, "step_2.corrupt"))
+
+
+def test_load_checkpoint_missing_latest_pointer(tmp_path):
+    from paddle_tpu.io import load_checkpoint
+    from paddle_tpu.framework.scope import Scope, scope_guard
+    d = _two_step_ckpt_dir(tmp_path)
+    os.unlink(os.path.join(d, "latest"))
+    sc = Scope()
+    with scope_guard(sc):
+        assert load_checkpoint(None, d) == 2   # newest valid step dir
+        np.testing.assert_allclose(np.asarray(sc.find_var("w_q")),
+                                   np.ones(4) * 2)
+
+
+def test_load_checkpoint_stale_latest_pointer(tmp_path):
+    from paddle_tpu.io import _atomic_write, load_checkpoint
+    from paddle_tpu.framework.scope import Scope, scope_guard
+    d = _two_step_ckpt_dir(tmp_path)
+    _atomic_write(os.path.join(d, "latest"), "step_99")   # never written
+    with scope_guard(Scope()):
+        assert load_checkpoint(None, d) == 2
+
+
+def test_load_checkpoint_all_corrupt_raises_first_error(tmp_path):
+    from paddle_tpu.io import load_checkpoint
+    from paddle_tpu.framework.scope import Scope, scope_guard
+    d = _two_step_ckpt_dir(tmp_path)
+    for s in ("step_1", "step_2"):
+        os.unlink(os.path.join(d, s, "shards_p0.npz"))
+    with scope_guard(Scope()):
+        with pytest.raises(OSError):
+            load_checkpoint(None, d)
+    # nothing valid left, both quarantined for forensics
+    assert os.path.isdir(os.path.join(d, "step_1.corrupt"))
+    assert os.path.isdir(os.path.join(d, "step_2.corrupt"))
+
+
+def test_save_checkpoint_prunes_past_quarantined_dirs(tmp_path):
+    """keep_last pruning must skip step_N.corrupt dirs: the first save
+    after a quarantine used to die on int('2.corrupt')."""
+    import jax.numpy as jnp
+    from paddle_tpu.io import load_checkpoint, save_checkpoint
+    from paddle_tpu.framework.scope import Scope, scope_guard
+    d = _two_step_ckpt_dir(tmp_path)
+    os.unlink(os.path.join(d, "step_2", "shards_p0.npz"))
+    sc = Scope()
+    with scope_guard(sc):
+        assert load_checkpoint(None, d) == 1   # quarantines step_2
+        sc.set_var("w_q", jnp.ones(4, jnp.float32) * 3)
+        save_checkpoint(None, d, step=3, keep_last=1)
+    assert os.path.isdir(os.path.join(d, "step_3"))
+    assert not os.path.exists(os.path.join(d, "step_1"))   # pruned
+    # forensics dir survives keep_last
+    assert os.path.isdir(os.path.join(d, "step_2.corrupt"))
+
+
+def test_load_checkpoint_caller_side_error_not_quarantined(tmp_path,
+                                                           monkeypatch):
+    """A restore that fails for a CALLER-side reason (e.g. a bad
+    shardings entry) while the step dir is healthy on disk must
+    re-raise — not rename valid history one .corrupt at a time."""
+    import paddle_tpu.io as io_mod
+    from paddle_tpu.framework.scope import Scope, scope_guard
+    d = _two_step_ckpt_dir(tmp_path)
+
+    def boom(*a, **k):
+        raise ValueError("caller-side restore bug")
+    monkeypatch.setattr(io_mod, "_stitch", boom)
+    with scope_guard(Scope()):
+        with pytest.raises(ValueError, match="caller-side"):
+            io_mod.load_checkpoint(None, d)
+    assert os.path.isdir(os.path.join(d, "step_2"))
+    assert not os.path.exists(os.path.join(d, "step_2.corrupt"))
+    assert os.path.isdir(os.path.join(d, "step_1"))
+
+
+def test_load_checkpoint_newer_format_is_not_quarantined(tmp_path):
+    """A checkpoint written by a NEWER library is healthy, not corrupt:
+    it must surface CheckpointFormatError and keep its step dir."""
+    import json as json_mod
+    from paddle_tpu.io import CheckpointFormatError, load_checkpoint
+    from paddle_tpu.framework.scope import Scope, scope_guard
+    d = _two_step_ckpt_dir(tmp_path)
+    mpath = os.path.join(d, "step_2", "manifest.json")
+    with open(mpath) as f:
+        manifest = json_mod.load(f)
+    manifest["format_version"] = 999
+    with open(mpath, "w") as f:
+        json_mod.dump(manifest, f)
+    with scope_guard(Scope()):
+        with pytest.raises(CheckpointFormatError, match="newer"):
+            load_checkpoint(None, d)
+    assert os.path.isdir(os.path.join(d, "step_2"))   # NOT renamed
+
+
+@pytest.mark.faultinject
+def test_async_checkpoint_failure_raises_exactly_once(tmp_path):
+    """Satellite: a failed blocking=False commit surfaces exactly once
+    from wait_for_pending_saves() and does not poison the next save."""
+    import jax.numpy as jnp
+    from paddle_tpu.framework import resilience
+    from paddle_tpu.io import (load_checkpoint, save_checkpoint,
+                               wait_for_pending_saves)
+    from paddle_tpu.framework.scope import Scope, scope_guard
+    sc = Scope()
+    with scope_guard(sc):
+        sc.set_var("w_once", jnp.arange(4, dtype=jnp.float32))
+        with resilience.inject("ckpt_write:io_error@1"):
+            h = save_checkpoint(None, str(tmp_path), step=1,
+                                blocking=False)
+            assert h is not None
+            with pytest.raises(OSError, match="injected checkpoint"):
+                wait_for_pending_saves()
+            wait_for_pending_saves()       # second join: clean no-op
+            # next save commits fine (fault was one-shot @1)
+            sc.set_var("w_once", jnp.arange(4, dtype=jnp.float32) * 3)
+            save_checkpoint(None, str(tmp_path), step=2, blocking=False)
+    sc2 = Scope()
+    with scope_guard(sc2):
+        assert load_checkpoint(None, str(tmp_path)) == 2
+        np.testing.assert_allclose(np.asarray(sc2.find_var("w_once")),
+                                   np.arange(4, dtype=np.float32) * 3)
 
 
 def test_py_func_skip_vars_rejected():

@@ -1,0 +1,595 @@
+"""Resilience subsystem battery: deterministic fault injection, retry
+policy, auto-recovering training (the detect -> recover loop), and
+serving graceful degradation. All chaos runs on the CPU backend with a
+seeded FaultInjector — deterministic, not flaky."""
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers, optimizer
+from paddle_tpu.framework import resilience
+from paddle_tpu.framework.resilience import (
+    DeadlineExceededError, FaultInjector, FaultSpec, ResilientTrainer,
+    RestartBudgetExceededError, RetryPolicy, ServerOverloadedError,
+    SimulatedPreemptionError)
+from paddle_tpu.framework.scope import Scope, scope_guard
+from paddle_tpu.framework.watchdog import CollectiveTimeoutError
+
+pytestmark = pytest.mark.faultinject
+
+
+@pytest.fixture(autouse=True)
+def _clean_resilience():
+    """Isolate injector + event log per test (both are process-global)."""
+    resilience.install(None)
+    resilience.clear_events()
+    yield
+    resilience.install(None)
+    resilience.clear_events()
+
+
+def _fast_policy(**kw):
+    """Backoff with zero real sleeping — chaos tests must stay fast."""
+    kw.setdefault("base_delay_s", 0.0)
+    kw.setdefault("jitter", 0.0)
+    kw.setdefault("sleep", lambda s: None)
+    return RetryPolicy(**kw)
+
+
+# ---------------------------------------------------------------------------
+# FaultSpec / FaultInjector
+# ---------------------------------------------------------------------------
+
+def test_fault_spec_parsing():
+    s = FaultSpec.parse("step:preempt@5")
+    assert (s.point, s.kind, s.at, s.prob) == ("step", "preempt", 5, None)
+    s = FaultSpec.parse("serve:slow=2.5@3")
+    assert (s.kind, s.arg, s.at) == ("slow", 2.5, 3)
+    s = FaultSpec.parse("step:nan~0.25")
+    assert (s.kind, s.at, s.prob) == ("nan", None, 0.25)
+    assert FaultSpec.parse("ckpt_write:io_error").at == 1   # default @1
+    with pytest.raises(ValueError, match="unknown injection point"):
+        FaultSpec.parse("warp_core:breach@1")
+    with pytest.raises(ValueError, match="no fault kind"):
+        FaultSpec.parse("step:io_error@1")
+    with pytest.raises(ValueError, match="point:kind"):
+        FaultSpec.parse("just-garbage")
+
+
+def test_injector_fires_at_exact_call():
+    inj = FaultInjector("step:preempt@3")
+    inj.fire("step")
+    inj.fire("step")
+    with pytest.raises(SimulatedPreemptionError, match="call 3"):
+        inj.fire("step")
+    inj.fire("step")                      # one-shot: call 4 is clean
+    assert inj.counts() == {"step": 4}
+    # other points don't consume the step counter
+    inj2 = FaultInjector("step:preempt@2")
+    inj2.fire("ckpt_write")
+    inj2.fire("serve")
+    inj2.fire("step")
+    with pytest.raises(SimulatedPreemptionError):
+        inj2.fire("step")
+
+
+def test_injector_kinds_raise_named_errors():
+    with pytest.raises(CollectiveTimeoutError, match="injected"):
+        FaultInjector("step:collective_timeout@1").fire("step")
+    with pytest.raises(FloatingPointError, match="NaN"):
+        FaultInjector("step:nan@1").fire("step")
+    with pytest.raises(OSError, match="I/O"):
+        FaultInjector("ckpt_write:io_error@1").fire("ckpt_write")
+    with pytest.raises(RuntimeError, match="serving failure"):
+        FaultInjector("serve:error@1").fire("serve")
+    assert FaultInjector("serve:slow=0.5@1").fire("serve") == \
+        {"slow_s": 0.5}
+
+
+def test_probabilistic_faults_are_seed_deterministic():
+    def trace(seed):
+        inj = FaultInjector("step:preempt~0.3", seed=seed)
+        hits = []
+        for i in range(200):
+            try:
+                inj.fire("step")
+                hits.append(0)
+            except SimulatedPreemptionError:
+                hits.append(1)
+        return hits
+
+    a, b = trace(7), trace(7)
+    assert a == b                      # same seed -> same chaos
+    assert 20 < sum(a) < 120           # roughly the asked-for rate
+    assert trace(8) != a               # different seed -> different run
+
+
+def test_env_configured_injector(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_FAULTS", "step:preempt@1")
+    monkeypatch.setenv("PADDLE_TPU_FAULT_SEED", "3")
+    inj = resilience.reload_env()
+    assert inj is not None and inj.seed == 3
+    with pytest.raises(SimulatedPreemptionError):
+        resilience.fire("step")
+    monkeypatch.delenv("PADDLE_TPU_FAULTS")
+    assert resilience.reload_env() is None
+
+
+def test_fire_is_noop_without_injector():
+    resilience.install(None)
+    assert resilience.fire("step") == {}
+    assert resilience.fire("serve") == {}
+
+
+# ---------------------------------------------------------------------------
+# event log
+# ---------------------------------------------------------------------------
+
+def test_event_log_bounded_filtered_cleared():
+    log = resilience.EventLog(capacity=3)
+    for i in range(5):
+        log.record("tick", i=i)
+    log.record("tock")
+    evs = log.events()
+    assert len(evs) == 3                       # bounded
+    assert [e["kind"] for e in evs] == ["tick", "tick", "tock"]
+    assert [e["i"] for e in log.events("tick")] == [3, 4]
+    assert all("time" in e for e in evs)
+    log.clear()
+    assert log.events() == []
+
+
+# ---------------------------------------------------------------------------
+# RetryPolicy
+# ---------------------------------------------------------------------------
+
+def test_classifier_transient_vs_fatal():
+    assert resilience.classify(CollectiveTimeoutError("hang")) == "transient"
+    assert resilience.classify(SimulatedPreemptionError("bye")) == "transient"
+    assert resilience.classify(DeadlineExceededError("late")) == "transient"
+    assert resilience.classify(ServerOverloadedError("full")) == "transient"
+    assert resilience.classify(OSError("torn write")) == "transient"
+    assert resilience.classify(FloatingPointError("NaN")) == "transient"
+    # shape/sharding/program bugs replay identically: never retry
+    assert resilience.classify(ValueError("bad shape")) == "fatal"
+    assert resilience.classify(TypeError("bad dtype")) == "fatal"
+    assert resilience.classify(KeyError("missing var")) == "fatal"
+    assert resilience.classify(Exception("unknown")) == "fatal"
+
+
+def test_backoff_exponential_capped_jittered_deterministic():
+    p = RetryPolicy(base_delay_s=1.0, multiplier=2.0, max_delay_s=5.0,
+                    jitter=0.0)
+    assert [p.delay_s(a) for a in range(4)] == [1.0, 2.0, 4.0, 5.0]
+    j1 = RetryPolicy(base_delay_s=1.0, jitter=0.5, seed=11)
+    j2 = RetryPolicy(base_delay_s=1.0, jitter=0.5, seed=11)
+    d1 = [j1.delay_s(0) for _ in range(5)]
+    assert d1 == [j2.delay_s(0) for _ in range(5)]   # seeded jitter
+    assert all(0.5 <= d <= 1.0 for d in d1)
+
+
+def test_retry_call_recovers_from_transient():
+    slept, calls = [], []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("transient blip")
+        return "ok"
+
+    p = RetryPolicy(max_attempts=5, base_delay_s=0.01, jitter=0.0,
+                    sleep=slept.append)
+    assert p.call(flaky, what="flaky-op") == "ok"
+    assert len(calls) == 3 and len(slept) == 2
+    assert slept == [0.01, 0.02]
+    retries = resilience.events("retry")
+    assert len(retries) == 2 and retries[0]["what"] == "flaky-op"
+
+
+def test_retry_call_fatal_raises_immediately():
+    calls = []
+
+    def broken():
+        calls.append(1)
+        raise ValueError("shape mismatch")
+
+    with pytest.raises(ValueError):
+        _fast_policy(max_attempts=5).call(broken)
+    assert len(calls) == 1
+
+
+def test_retry_call_exhausts_attempts():
+    calls = []
+
+    def always_down():
+        calls.append(1)
+        raise OSError("still down")
+
+    with pytest.raises(OSError, match="still down"):
+        _fast_policy(max_attempts=3).call(always_down)
+    assert len(calls) == 3
+
+
+# ---------------------------------------------------------------------------
+# run_with_deadline
+# ---------------------------------------------------------------------------
+
+def test_run_with_deadline_value_error_and_timeout():
+    assert resilience.run_with_deadline(lambda: 41 + 1, 5.0) == 42
+    assert resilience.run_with_deadline(lambda: "no bound", None) == \
+        "no bound"
+
+    def boom():
+        raise RuntimeError("inner error")
+    with pytest.raises(RuntimeError, match="inner error"):
+        resilience.run_with_deadline(boom, 5.0)
+
+    t0 = time.time()
+    with pytest.raises(DeadlineExceededError, match="deadline"):
+        resilience.run_with_deadline(lambda: time.sleep(1.0), 0.05,
+                                     what="slow body")
+    assert time.time() - t0 < 0.9
+    assert resilience.events("deadline")[-1]["what"] == "slow body"
+
+
+# ---------------------------------------------------------------------------
+# ResilientTrainer: deterministic recovery
+# ---------------------------------------------------------------------------
+
+def _toy_program():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [4], dtype="float32")
+        y = layers.data("y", [1], dtype="float32")
+        pred = layers.fc(x, size=1, param_attr=pt.ParamAttr(name="res_w"),
+                         bias_attr=pt.ParamAttr(name="res_b"))
+        loss = layers.reduce_mean(layers.square(pred - y))
+        optimizer.Adam(0.05).minimize(loss)
+    return main, startup, loss
+
+
+def _toy_feeds(n, batch=4):
+    rng = np.random.RandomState(0)
+    w = rng.randn(4, 1).astype(np.float32)
+    out = []
+    for _ in range(n):
+        xv = rng.randn(batch, 4).astype(np.float32)
+        out.append({"x": xv, "y": (xv @ w).astype(np.float32)})
+    return out
+
+
+def _train(exe, startup, target, ckpt_dir, feeds, loss, **kw):
+    kw.setdefault("checkpoint_every", 3)
+    kw.setdefault("retry_policy", _fast_policy())
+    with scope_guard(Scope()):
+        exe.run(startup)
+        trainer = ResilientTrainer(exe, target, ckpt_dir,
+                                   fetch_list=[loss], **kw)
+        fetches = trainer.run(feeds)
+        final_w = pt.global_scope().get_numpy("res_w").copy()
+    return fetches, final_w
+
+
+@pytest.mark.parametrize("spec", ["step:preempt@6",
+                                  "step:collective_timeout@6",
+                                  "step:nan@6"])
+def test_injected_step_fault_recovers_bitwise_identical(tmp_path, spec):
+    """Acceptance: preemption/timeout/NaN at step k auto-restores from
+    the checkpoint, rewinds, and finishes with final parameters
+    numerically IDENTICAL to an uninterrupted run."""
+    main, startup, loss = _toy_program()
+    feeds = _toy_feeds(8)
+    exe = pt.Executor()
+    ref_fetches, ref_w = _train(exe, startup, main,
+                                str(tmp_path / "ref"), feeds, loss)
+    with resilience.inject(spec):
+        got_fetches, got_w = _train(exe, startup, main,
+                                    str(tmp_path / "chaos"), feeds, loss)
+    np.testing.assert_array_equal(got_w, ref_w)
+    np.testing.assert_array_equal(np.asarray(got_fetches),
+                                  np.asarray(ref_fetches))
+    # the loop actually recovered (one fault, one restart, one restore
+    # back to the step-3 checkpoint)
+    assert len(resilience.events("fault")) == 1
+    assert len(resilience.events("restart")) == 1
+    assert resilience.events("restore")[-1]["step"] == 3
+
+
+def test_recovery_through_run_steps_windows(tmp_path):
+    """Same contract with multi-step scan windows (Executor.run_steps):
+    a window-level fault rewinds to the last checkpoint and replays."""
+    main, startup, loss = _toy_program()
+    feeds = _toy_feeds(8)
+    exe = pt.Executor()
+    kw = dict(steps_per_dispatch=2, checkpoint_every=2)
+    ref_fetches, ref_w = _train(exe, startup, main, str(tmp_path / "ref"),
+                                feeds, loss, **kw)
+    with resilience.inject("step:preempt@3"):   # third dispatched window
+        got_fetches, got_w = _train(exe, startup, main,
+                                    str(tmp_path / "chaos"), feeds, loss,
+                                    **kw)
+    np.testing.assert_array_equal(got_w, ref_w)
+    np.testing.assert_array_equal(np.asarray(got_fetches),
+                                  np.asarray(ref_fetches))
+    assert resilience.events("restore")[-1]["step"] == 4
+
+
+def test_recovery_on_compiled_program_mesh(tmp_path):
+    """CompiledProgram path: the injected CollectiveTimeoutError (the
+    same error CompiledProgram's wait_with_timeout watchdog raises)
+    triggers restore + replay over the dp mesh."""
+    from paddle_tpu.framework.compiler import BuildStrategy, \
+        CompiledProgram
+    main, startup, loss = _toy_program()
+    feeds = _toy_feeds(6)
+    exe = pt.Executor()
+
+    def compiled():
+        bs = BuildStrategy()
+        bs.mesh_axes = {"dp": 2}
+        bs.collective_timeout_s = 120.0     # armed, never trips on CPU
+        return CompiledProgram(main, bs)
+
+    ref_fetches, ref_w = _train(exe, startup, compiled(),
+                                str(tmp_path / "ref"), feeds, loss,
+                                checkpoint_every=2)
+    with resilience.inject("step:collective_timeout@4"):
+        got_fetches, got_w = _train(exe, startup, compiled(),
+                                    str(tmp_path / "chaos"), feeds, loss,
+                                    checkpoint_every=2)
+    np.testing.assert_array_equal(got_w, ref_w)
+    np.testing.assert_array_equal(np.asarray(got_fetches),
+                                  np.asarray(ref_fetches))
+    assert resilience.events("restore")[-1]["step"] == 2
+
+
+def test_restart_budget_exhaustion(tmp_path):
+    main, startup, loss = _toy_program()
+    exe = pt.Executor()
+    with resilience.inject("step:preempt~1.0"):   # every dispatch dies
+        with scope_guard(Scope()):
+            exe.run(startup)
+            trainer = ResilientTrainer(exe, main, str(tmp_path),
+                                       fetch_list=[loss], max_restarts=2,
+                                       retry_policy=_fast_policy())
+            with pytest.raises(RestartBudgetExceededError,
+                               match="restart budget"):
+                trainer.run(_toy_feeds(4))
+    assert len(resilience.events("restart")) == 2
+    assert len(resilience.events("giveup")) == 1
+
+
+def test_fatal_error_is_not_retried(tmp_path):
+    main, startup, loss = _toy_program()
+    exe = pt.Executor()
+    feeds = _toy_feeds(4)
+    feeds[2]["x"] = np.zeros((4, 4, 9), np.float32)   # wrong rank: a bug
+    with scope_guard(Scope()):
+        exe.run(startup)
+        trainer = ResilientTrainer(exe, main, str(tmp_path),
+                                   fetch_list=[loss],
+                                   retry_policy=_fast_policy())
+        with pytest.raises(ValueError, match="rank"):
+            trainer.run(feeds)
+    assert resilience.events("restart") == []
+    assert len(resilience.events("fatal")) == 1
+
+
+def test_torn_checkpoint_write_recovers(tmp_path):
+    """An injected I/O fault mid-commit (shards on disk, no manifest)
+    must roll the trainer back to the previous valid checkpoint and
+    converge to the uninterrupted result — the torn dir is never
+    restored from (the manifest is the commit point)."""
+    main, startup, loss = _toy_program()
+    feeds = _toy_feeds(6)
+    exe = pt.Executor()
+    ref_fetches, ref_w = _train(exe, startup, main, str(tmp_path / "ref"),
+                                feeds, loss, checkpoint_every=3)
+    # ckpt_write call 1 = the step-0 baseline; call 2 = the step-3 save
+    with resilience.inject("ckpt_write:io_error@2"):
+        got_fetches, got_w = _train(exe, startup, main,
+                                    str(tmp_path / "chaos"), feeds, loss,
+                                    checkpoint_every=3)
+    np.testing.assert_array_equal(got_w, ref_w)
+    np.testing.assert_array_equal(np.asarray(got_fetches),
+                                  np.asarray(ref_fetches))
+    assert resilience.events("restore")[-1]["step"] == 0
+
+
+def test_startup_program_does_not_consume_step_counter(tmp_path):
+    main, startup, loss = _toy_program()
+    exe = pt.Executor()
+    feeds = _toy_feeds(1)
+    with resilience.inject("step:preempt@1"):
+        with scope_guard(Scope()):
+            exe.run(startup)          # eager path: NOT a step dispatch
+            with pytest.raises(SimulatedPreemptionError):
+                exe.run(main, feed=feeds[0], fetch_list=[loss])
+
+
+def test_trainer_rejects_prepopulated_ckpt_dir(tmp_path):
+    """A reused ckpt_dir would let keep_last prune this run's step_0
+    baseline immediately (step_0 sorts older than a previous run's
+    step_48) and a restore would rewind into the stale trajectory —
+    refuse loudly instead."""
+    main, startup, loss = _toy_program()
+    exe = pt.Executor()
+    with scope_guard(Scope()):
+        exe.run(startup)
+        trainer = ResilientTrainer(exe, main, str(tmp_path),
+                                   fetch_list=[loss],
+                                   retry_policy=_fast_policy())
+        trainer.run(_toy_feeds(2))
+        with pytest.raises(ValueError, match="already holds checkpoints"):
+            trainer.run(_toy_feeds(2))
+
+
+def test_trainer_requires_fetch_list(tmp_path):
+    main, startup, loss = _toy_program()
+    exe = pt.Executor()
+    trainer = ResilientTrainer(exe, main, str(tmp_path))
+    with pytest.raises(ValueError, match="fetch_list"):
+        trainer.run(_toy_feeds(2))
+
+
+def test_build_strategy_collective_timeout_env_default(monkeypatch):
+    from paddle_tpu.framework.compiler import BuildStrategy
+    monkeypatch.setenv("PADDLE_TPU_COLLECTIVE_TIMEOUT_S", "12.5")
+    assert BuildStrategy().collective_timeout_s == 12.5
+    monkeypatch.setenv("PADDLE_TPU_COLLECTIVE_TIMEOUT_S", "")
+    assert BuildStrategy().collective_timeout_s is None
+    # a malformed fleet-wide knob must name itself in the error
+    monkeypatch.setenv("PADDLE_TPU_COLLECTIVE_TIMEOUT_S", "30s")
+    with pytest.raises(ValueError,
+                       match="PADDLE_TPU_COLLECTIVE_TIMEOUT_S"):
+        BuildStrategy()
+
+
+@pytest.mark.slow
+def test_soak_probabilistic_preemptions_converge(tmp_path):
+    """Soak: random preemptions at a 15% dispatch rate for 30 steps still
+    produce the exact uninterrupted trajectory (restore + replay is
+    idempotent under repeated chaos)."""
+    main, startup, loss = _toy_program()
+    feeds = _toy_feeds(30)
+    exe = pt.Executor()
+    ref_fetches, ref_w = _train(exe, startup, main, str(tmp_path / "ref"),
+                                feeds, loss, checkpoint_every=5)
+    with resilience.inject("step:preempt~0.15", seed=123):
+        got_fetches, got_w = _train(exe, startup, main,
+                                    str(tmp_path / "chaos"), feeds, loss,
+                                    checkpoint_every=5, max_restarts=50)
+    np.testing.assert_array_equal(got_w, ref_w)
+    np.testing.assert_array_equal(np.asarray(got_fetches),
+                                  np.asarray(ref_fetches))
+    assert resilience.events("restart")    # chaos actually happened
+
+
+# ---------------------------------------------------------------------------
+# serving graceful degradation
+# ---------------------------------------------------------------------------
+
+def _export_predictor(tmp_path, batch_sizes=(1, 4), **kw):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [6], dtype="float32")
+        y = layers.softmax(layers.fc(x, 3))
+    exe = pt.Executor()
+    exe.run(startup)
+    xv = np.random.RandomState(0).rand(4, 6).astype(np.float32)
+    ref, = exe.run(main, feed={"x": xv}, fetch_list=[y])
+    pt.save_inference_model(str(tmp_path), ["x"], [y], exe,
+                            main_program=main, format="stablehlo",
+                            batch_sizes=batch_sizes)
+    from paddle_tpu.serving import load_serving_artifact
+    return load_serving_artifact(str(tmp_path), **kw), xv, np.asarray(ref)
+
+
+def test_serving_deadline_raises_within_budget(tmp_path):
+    """Acceptance: an injected slow request raises a deadline error well
+    inside the fault's duration; the next request succeeds."""
+    pred, xv, ref = _export_predictor(tmp_path)
+    pred.warmup()
+    with resilience.inject("serve:slow=3.0@1"):
+        t0 = time.time()
+        with pytest.raises(DeadlineExceededError):
+            pred.run({"x": xv}, deadline_s=0.3)
+        assert time.time() - t0 < 2.0
+    out, = pred.run({"x": xv}, deadline_s=30.0)
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+    assert resilience.events("deadline")
+
+
+def test_serving_constructor_deadline_default(tmp_path):
+    pred, xv, _ = _export_predictor(tmp_path, deadline_s=0.3)
+    pred.warmup()
+    with resilience.inject("serve:slow=3.0@1"):
+        with pytest.raises(DeadlineExceededError):
+            pred.run({"x": xv})
+
+
+def test_serving_inflight_cap_sheds_load(tmp_path):
+    """Acceptance: beyond the in-flight cap requests get
+    ServerOverloadedError while the in-budget request still succeeds."""
+    pred, xv, ref = _export_predictor(tmp_path, max_in_flight=1)
+    pred.warmup()
+    results = {}
+    with resilience.inject("serve:slow=1.5@1"):
+        def slow_request():
+            try:
+                results["out"] = pred.run({"x": xv}, deadline_s=30.0)
+            except Exception as e:   # pragma: no cover - debug aid
+                results["err"] = e
+
+        t = threading.Thread(target=slow_request)
+        t.start()
+        for _ in range(500):         # wait for admission
+            if pred.in_flight >= 1:
+                break
+            time.sleep(0.01)
+        assert pred.in_flight == 1
+        with pytest.raises(ServerOverloadedError, match="in-flight cap"):
+            pred.run({"x": xv})
+        t.join(timeout=30)
+    assert "err" not in results, results.get("err")
+    np.testing.assert_allclose(results["out"][0], ref, rtol=1e-5,
+                               atol=1e-6)
+    out, = pred.run({"x": xv})       # capacity freed: back to normal
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+    assert resilience.events("shed")
+
+
+def test_serving_degraded_mode_serves_from_warm_bucket(tmp_path):
+    """Acceptance: when the natural (cold) bucket blows the deadline and
+    a larger bucket is already warm, the request is padded up and served
+    from the warm bucket instead of failing."""
+    pred, xv, ref = _export_predictor(tmp_path, batch_sizes=(1, 4))
+    pred.warmup([4])                  # bucket 1 stays cold
+    x1 = xv[:1]
+    with resilience.inject("serve:slow=2.0@1"):
+        out, = pred.run({"x": x1}, deadline_s=0.5)
+    np.testing.assert_allclose(out, ref[:1], rtol=1e-5, atol=1e-6)
+    evs = resilience.events("degraded")
+    assert evs and evs[-1]["cold_bucket"] == 1 and \
+        evs[-1]["warm_bucket"] == 4
+    # without a warm fallback the deadline error surfaces instead
+    pred2, xv2, _ = _export_predictor(tmp_path / "p2", batch_sizes=(1, 4))
+    pred2.warmup()                    # natural bucket warm -> no fallback
+    with resilience.inject("serve:slow=2.0@1"):
+        with pytest.raises(DeadlineExceededError):
+            pred2.run({"x": xv2[:1]}, deadline_s=0.4)
+
+
+def test_serving_deadline_orphan_holds_slot_until_done(tmp_path):
+    """in_flight counts LIVE work: a request whose deadline expired
+    keeps its slot until the orphaned worker finishes, so a timeout
+    storm cannot stack unbounded concurrent backend work."""
+    pred, xv, ref = _export_predictor(tmp_path, max_in_flight=1)
+    pred.warmup()
+    with resilience.inject("serve:slow=1.0@1"):
+        with pytest.raises(DeadlineExceededError):
+            pred.run({"x": xv}, deadline_s=0.1, degraded_ok=False)
+        assert pred.in_flight == 1        # the orphan still owns it
+        with pytest.raises(ServerOverloadedError):
+            pred.run({"x": xv})
+    for _ in range(500):                  # orphan drains its slot
+        if pred.in_flight == 0:
+            break
+        time.sleep(0.01)
+    assert pred.in_flight == 0
+    out, = pred.run({"x": xv})
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_serving_injected_hard_error_propagates(tmp_path):
+    pred, xv, ref = _export_predictor(tmp_path)
+    pred.warmup()
+    with resilience.inject("serve:error@1"):
+        with pytest.raises(RuntimeError, match="injected serving failure"):
+            pred.run({"x": xv})
+    out, = pred.run({"x": xv})
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)

@@ -1,0 +1,67 @@
+"""watchdog.wait_with_timeout coverage (resilience PR satellite):
+timeout path, device-error propagation, timeout_s=None passthrough, and
+pytree (non-array leaf) inputs."""
+import time
+
+import pytest
+
+import jax.numpy as jnp
+
+from paddle_tpu.framework import resilience
+from paddle_tpu.framework.watchdog import (CollectiveTimeoutError,
+                                           wait_with_timeout)
+
+
+class _SlowLeaf(object):
+    """Array stand-in whose readiness wait hangs (a stuck collective)."""
+
+    def __init__(self, delay_s):
+        self._delay_s = delay_s
+
+    def block_until_ready(self):
+        time.sleep(self._delay_s)
+
+
+class _FailingLeaf(object):
+    """Array stand-in whose wait dies like a device error."""
+
+    def block_until_ready(self):
+        raise RuntimeError("device says no")
+
+
+def test_timeout_raises_and_logs_event():
+    resilience.clear_events()
+    t0 = time.time()
+    with pytest.raises(CollectiveTimeoutError, match="did not complete"):
+        wait_with_timeout([_SlowLeaf(1.0)], 0.05, what="unit-test step")
+    assert time.time() - t0 < 0.9   # raised at the timeout, not the hang
+    evs = resilience.events("watchdog_timeout")
+    assert evs and evs[-1]["what"] == "unit-test step"
+
+
+def test_device_error_propagates_not_timeout():
+    # the waiter thread's exception reaches the caller (bounded_call
+    # hands it back), not a timeout
+    with pytest.raises(RuntimeError, match="device says no"):
+        wait_with_timeout([_FailingLeaf()], 5.0)
+
+
+def test_none_timeout_is_passthrough():
+    # no watchdog thread, no wait — even a would-hang leaf returns now
+    outputs = {"a": _SlowLeaf(60.0)}
+    t0 = time.time()
+    assert wait_with_timeout(outputs, None) is outputs
+    assert time.time() - t0 < 0.5
+
+
+def test_pytree_with_non_array_leaves():
+    # ints/strings have no block_until_ready and must be skipped; None
+    # is not a pytree leaf; jnp arrays are genuinely waited on
+    tree = {"arr": jnp.arange(3), "n": 3,
+            "nested": [None, "tag", jnp.ones(2)]}
+    assert wait_with_timeout(tree, 5.0, what="pytree wait") is tree
+
+
+def test_returns_outputs_for_call_through_style():
+    x = jnp.arange(4) * 2
+    assert wait_with_timeout(x, 1.0) is x
