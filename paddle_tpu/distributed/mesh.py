@@ -16,6 +16,8 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 _mesh = None
+_mesh_axes = None      # last init_mesh axes — what a re-init rebuilds from
+_reinit_hooks = []     # fns(lost_hosts, live_hosts, mesh) run after re-init
 
 
 class DistributedStrategy(object):
@@ -37,7 +39,7 @@ class DistributedStrategy(object):
 
 def init_mesh(mesh_axes=None, devices=None, multihost=False):
     """Create and install the global mesh. mesh_axes e.g. {"dp":2,"mp":4}."""
-    global _mesh
+    global _mesh, _mesh_axes
     if multihost and jax.process_count() == 1:
         try:
             jax.distributed.initialize()
@@ -49,13 +51,60 @@ def init_mesh(mesh_axes=None, devices=None, multihost=False):
     n = int(np.prod(sizes))
     dev = np.array(devices[:n]).reshape(sizes)
     _mesh = Mesh(dev, tuple(mesh_axes.keys()))
+    _mesh_axes = dict(mesh_axes)
     return _mesh
 
 
 def reset_mesh():
     """Uninstall the global mesh (tests / reconfiguration)."""
-    global _mesh
+    global _mesh, _mesh_axes
     _mesh = None
+    _mesh_axes = None
+
+
+def add_reinit_hook(fn):
+    """Register ``fn(lost_hosts, live_hosts, mesh)`` to run after the
+    mesh is rebuilt on a host loss (recompile caches, re-place state,
+    notify data loaders). Returns fn for decorator use."""
+    _reinit_hooks.append(fn)
+    return fn
+
+
+def clear_reinit_hooks():
+    del _reinit_hooks[:]
+
+
+def handle_host_loss(lost_hosts, live_hosts):
+    """Coordinator host-loss hook: rebuild the global mesh over the
+    surviving topology and fan out to :func:`add_reinit_hook` hooks.
+
+    The reference restarts NCCL rings (gen_nccl_id + c_comm_init) when a
+    trainer drops; the XLA equivalent is re-making the Mesh so the next
+    jit re-partitions over the survivors. Data-parallel capacity shrinks
+    with the hosts, so the ``dp`` axis is scaled by the survivor
+    fraction (model axes describe the MODEL — they must survive intact
+    or the job cannot run at all and a NoQuorum/cold-start escalation is
+    the right move). On a real pod, jax.distributed re-initialization
+    (coordinator-led) replaces the device list; in the single-process
+    simulation the visible devices are unchanged and only the shape
+    scales. Returns the new mesh (or None when none was installed)."""
+    global _mesh, _mesh_axes
+    from ..framework import resilience
+    lost, live = sorted(lost_hosts), sorted(live_hosts)
+    resilience.record_event("mesh_reinit", lost=lost, live=live)
+    if _mesh is not None and _mesh_axes:
+        # scale from the ORIGINAL axes: lost_hosts is cumulative, so a
+        # second loss must not compound a shrink already applied
+        base = dict(_mesh_axes)
+        axes = dict(base)
+        total = len(lost) + len(live)
+        if lost and total and "dp" in axes and axes["dp"] > 1:
+            axes["dp"] = max(1, axes["dp"] * len(live) // total)
+        init_mesh(axes)
+        _mesh_axes = base
+    for fn in list(_reinit_hooks):
+        fn(lost, live, _mesh)
+    return _mesh
 
 
 def get_mesh():

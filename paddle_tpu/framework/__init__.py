@@ -10,8 +10,14 @@ from .compiler import CompiledProgram, BuildStrategy, ExecutionStrategy  # noqa
 from . import unique_name  # noqa
 from . import watchdog  # noqa
 from . import resilience  # noqa
-from .watchdog import CollectiveTimeoutError, wait_with_timeout  # noqa
+from . import coordination  # noqa
+from .watchdog import (CollectiveTimeoutError, wait_with_timeout,  # noqa
+                       StragglerDetector)
 from .resilience import (FaultInjector, RetryPolicy,  # noqa
                          ResilientTrainer, SimulatedPreemptionError,
                          ServerOverloadedError, DeadlineExceededError,
                          RestartBudgetExceededError)
+from .coordination import (Coordinator, LocalCoordinator,  # noqa
+                           FileCoordinator, PodResilientTrainer,
+                           CoordinationError, HostLostError,
+                           NoQuorumError)

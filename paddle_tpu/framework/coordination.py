@@ -1,0 +1,634 @@
+"""Pod recovery control plane — agreed restores for multi-host training.
+
+Reference parity: the reference stack recovers pserver fleets as a UNIT
+(`operators/distributed` + fleet roles: trainers reconnect, pservers
+re-serve tables, the whole job restarts from one snapshot). On TPU there
+is no pserver tier — the ICI collectives that replace the RPC layer
+(psum/all_gather inside the jitted step) deadlock if any host resumes at
+a different step than its peers, so recovery must be AGREED: either
+every host rewinds to one quorum-validated checkpoint step, or none
+does. framework/resilience.py closes the detect->recover loop for ONE
+process; this module is the pod half:
+
+  * :class:`Coordinator` — the contract: ``barrier`` / ``all_gather`` /
+    ``elect_restore_step`` (consensus = max step for which a
+    scrub-validated checkpoint exists on every live host), plus
+    host-loss detection that fires mesh re-initialization hooks
+    (distributed/mesh.py) so survivors rebuild collectives without the
+    dead host.
+  * :class:`LocalCoordinator` — in-process, thread-based. Drives tier-1
+    tests and single-process simulations of an N-host pod (the ``pod``
+    pytest marker).
+  * :class:`FileCoordinator` — file-based, for real multi-process pods
+    sharing a filesystem. Every contribution is an atomic file write;
+    no shared memory, so N processes each owning one FileCoordinator
+    object agree through the directory alone.
+  * :class:`PodResilientTrainer` — wraps N per-host
+    :class:`~.resilience.ResilientTrainer` s. Every dispatch window ends
+    in a status exchange; if ANY host saw a transient fault, every host
+    scrubs its checkpoint dir (``io.scrub_checkpoint`` — manifest +
+    shard headers, never array payloads), the coordinator elects the
+    consensus step, and ALL hosts restore it and replay. The replayed
+    trajectory is bitwise-identical to a fault-free run, and the
+    restart budget is shared: rewinds are pod-wide, so every host's
+    budget counter advances in lockstep.
+"""
+import collections
+import threading
+import time
+
+from .resilience import RestartBudgetExceededError, record_event
+
+__all__ = [
+    "CoordinationError", "HostLostError", "BarrierTimeoutError",
+    "NoQuorumError", "Coordinator", "LocalCoordinator",
+    "FileCoordinator", "PodResilientTrainer",
+]
+
+
+class CoordinationError(RuntimeError):
+    """A pod-level coordination failure (peer fatal, protocol misuse)."""
+
+
+class HostLostError(CoordinationError):
+    """This host was marked lost (fenced): it missed a barrier or was
+    declared dead. A fenced host must NOT keep training — rejoin via the
+    orchestrator as a fresh participant instead of split-braining."""
+
+
+class BarrierTimeoutError(CoordinationError):
+    """A collective did not complete in time and loss detection was
+    disabled, so nobody was marked lost — the caller decides."""
+
+
+class NoQuorumError(CoordinationError):
+    """No checkpoint step is valid on enough live hosts to restore —
+    escalate to the orchestrator (cold start or manual repair)."""
+
+
+# ---------------------------------------------------------------------------
+# coordinator contract + shared consensus logic
+# ---------------------------------------------------------------------------
+
+class Coordinator(object):
+    """Base contract. Subclasses implement :meth:`all_gather` plus the
+    live/lost bookkeeping; everything else (barrier, consensus election,
+    host-loss hook fan-out) is shared.
+
+    Host-loss semantics: when a collective times out, the hosts that
+    never arrived are marked LOST (``detect_loss=True``), the remaining
+    values are returned to the survivors, and the loss hooks fire —
+    including mesh re-initialization (``distributed.mesh
+    .handle_host_loss``) so the survivors' collectives are rebuilt
+    without the dead host. A lost host that later calls in gets
+    :class:`HostLostError` (fencing: it must rejoin, not resume).
+    """
+
+    def __init__(self, n_hosts, timeout_s=30.0, detect_loss=True,
+                 mesh_reinit=True):
+        if n_hosts < 1:
+            raise ValueError("n_hosts must be >= 1")
+        self.n_hosts = int(n_hosts)
+        self.timeout_s = float(timeout_s)
+        self.detect_loss = bool(detect_loss)
+        self._mesh_reinit = bool(mesh_reinit)
+        self._loss_hooks = []
+
+    # -- subclass surface --------------------------------------------------
+    def all_gather(self, name, host_id, value=None, timeout_s=None):
+        """Collective: every live host contributes ``value`` under the
+        (round-unique) ``name``; returns {host_id: value} of the live
+        participants. Blocks until all live hosts arrive or the timeout
+        handles the missing ones (see class docstring)."""
+        raise NotImplementedError
+
+    def live_hosts(self):
+        raise NotImplementedError
+
+    def lost_hosts(self):
+        """{host_id: reason} of every host marked lost so far."""
+        raise NotImplementedError
+
+    def mark_lost(self, host_id, reason="declared lost"):
+        raise NotImplementedError
+
+    # -- shared machinery --------------------------------------------------
+    def add_host_loss_hook(self, fn):
+        """Register ``fn(lost_ids, live_ids)`` to run on host loss (after
+        the built-in mesh re-init). Returns fn for decorator use."""
+        self._loss_hooks.append(fn)
+        return fn
+
+    def barrier(self, name, host_id, timeout_s=None):
+        """Block until every live host reaches the same ``name``;
+        returns the sorted ids that arrived."""
+        got = self.all_gather("barrier:%s" % name, host_id,
+                              timeout_s=timeout_s)
+        return sorted(got)
+
+    def elect_restore_step(self, host_id, valid_steps, name="elect",
+                           quorum=None, timeout_s=None):
+        """Consensus restore step for the whole pod.
+
+        Every live host contributes the steps its checkpoint scrub
+        validated (``io.scrub_checkpoint(dir)["valid_steps"]``); the
+        consensus is the MAX step reported by at least ``quorum`` live
+        hosts — default ALL of them, because with per-host checkpoint
+        dirs every host must hold the step it is told to restore. On a
+        shared filesystem (one dir scrubbed by everyone) a smaller
+        quorum tolerates scrub-time races. Deterministic: every host
+        computes the same answer from the same gathered sets.
+
+        Raises :class:`NoQuorumError` when no step qualifies."""
+        got = self.all_gather("elect:%s" % name, host_id,
+                              sorted(int(s) for s in set(valid_steps)),
+                              timeout_s=timeout_s)
+        counts = collections.Counter(
+            s for steps in got.values() for s in steps)
+        need = len(got) if quorum is None else min(int(quorum), len(got))
+        eligible = [s for s, c in counts.items() if c >= need]
+        if not eligible:
+            raise NoQuorumError(
+                "no checkpoint step is valid on %d/%d live hosts "
+                "(reported: %s) — nothing the pod can agree to restore"
+                % (need, len(got),
+                   {h: list(v) for h, v in sorted(got.items())}))
+        step = max(eligible)
+        record_event("consensus", step=step, hosts=len(got),
+                     quorum=need)
+        return step
+
+    def _on_loss(self, newly_lost):
+        """Fan out a host-loss: resilience event, mesh re-init, hooks."""
+        if not newly_lost:
+            return
+        live = self.live_hosts()
+        record_event("host_lost", hosts=sorted(newly_lost),
+                     live=list(live))
+        if self._mesh_reinit:
+            from ..distributed import mesh as mesh_mod
+            mesh_mod.handle_host_loss(sorted(self.lost_hosts()), live)
+        for fn in list(self._loss_hooks):
+            fn(sorted(newly_lost), live)
+
+
+# ---------------------------------------------------------------------------
+# in-process (threaded) coordinator
+# ---------------------------------------------------------------------------
+
+class LocalCoordinator(Coordinator):
+    """Thread-based coordinator: N logical hosts in one process.
+
+    This is the tier-1 test vehicle — it runs the exact consensus and
+    fencing logic of the pod control plane with no processes, sockets or
+    real TPUs, which is how the chaos battery stays fast and
+    deterministic."""
+
+    def __init__(self, n_hosts, timeout_s=30.0, detect_loss=True,
+                 mesh_reinit=True):
+        super(LocalCoordinator, self).__init__(
+            n_hosts, timeout_s=timeout_s, detect_loss=detect_loss,
+            mesh_reinit=mesh_reinit)
+        self._cond = threading.Condition()
+        self._lost = {}
+        self._rounds = {}   # name -> {"values": {hid: v}, "exits": int}
+
+    def live_hosts(self):
+        with self._cond:
+            return [i for i in range(self.n_hosts) if i not in self._lost]
+
+    def lost_hosts(self):
+        with self._cond:
+            return dict(self._lost)
+
+    def mark_lost(self, host_id, reason="declared lost"):
+        with self._cond:
+            if host_id in self._lost:
+                return
+            self._lost[host_id] = reason
+            self._cond.notify_all()
+        self._on_loss([host_id])
+
+    def all_gather(self, name, host_id, value=None, timeout_s=None):
+        deadline = time.monotonic() + (self.timeout_s if timeout_s is None
+                                       else float(timeout_s))
+        newly_lost = []
+        with self._cond:
+            if host_id in self._lost:
+                raise HostLostError(
+                    "host %d is fenced (%s) — rejoin, don't resume"
+                    % (host_id, self._lost[host_id]))
+            r = self._rounds.setdefault(name, {"values": {}, "exits": 0})
+            if host_id in r["values"]:
+                raise CoordinationError(
+                    "host %d already contributed to round %r — collective "
+                    "names must be unique per round" % (host_id, name))
+            r["values"][host_id] = value
+            self._cond.notify_all()
+            while True:
+                waiting_for = [i for i in range(self.n_hosts)
+                               if i not in self._lost
+                               and i not in r["values"]]
+                if not waiting_for:
+                    break
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    if not self.detect_loss:
+                        raise BarrierTimeoutError(
+                            "round %r timed out waiting for hosts %s"
+                            % (name, waiting_for))
+                    for i in waiting_for:
+                        self._lost[i] = "missed round %r" % name
+                        newly_lost.append(i)
+                    self._cond.notify_all()
+                    continue
+                self._cond.wait(remaining)
+            if host_id in self._lost:
+                # marked lost while blocked in this very round: fence
+                raise HostLostError(
+                    "host %d is fenced (%s) — rejoin, don't resume"
+                    % (host_id, self._lost[host_id]))
+            result = {i: v for i, v in r["values"].items()
+                      if i not in self._lost}
+            r["exits"] += 1
+            if r["exits"] >= len(result):
+                self._rounds.pop(name, None)   # last one out cleans up
+        # hooks run OUTSIDE the lock: mesh re-init is arbitrary user code
+        self._on_loss(newly_lost)
+        return result
+
+
+# ---------------------------------------------------------------------------
+# file-based coordinator (multi-process pods on a shared filesystem)
+# ---------------------------------------------------------------------------
+
+class FileCoordinator(Coordinator):
+    """Coordinator over a shared directory — one object per PROCESS.
+
+    All state flows through atomically-committed files (io._atomic_write
+    discipline: temp file + os.replace), so N processes that share only
+    a filesystem agree exactly like LocalCoordinator's threads:
+
+        <root>/lost/host_<i>              tombstone (fence), reason text
+        <root>/rounds/<name>/host_<i>.json   one contribution per round
+
+    Polling (``poll_s``) replaces condition variables; round names must
+    be unique per live round exactly as with LocalCoordinator
+    (PodResilientTrainer namespaces every round by a per-run counter).
+    The last host to read a completed round removes its directory, so
+    the rounds dir stays bounded over a long job. A RESTARTED process
+    must rejoin on a fresh coordinator root as a new participant — its
+    old incarnation is fenced, and replaying old round names against a
+    stale root would read stale contributions."""
+
+    def __init__(self, root, n_hosts, timeout_s=30.0, poll_s=0.01,
+                 detect_loss=True, mesh_reinit=True):
+        super(FileCoordinator, self).__init__(
+            n_hosts, timeout_s=timeout_s, detect_loss=detect_loss,
+            mesh_reinit=mesh_reinit)
+        import os
+        self._root = root
+        self._lost_dir = os.path.join(root, "lost")
+        self._rounds_dir = os.path.join(root, "rounds")
+        self.poll_s = float(poll_s)
+        # per-PROCESS loss knowledge: tombstones written by peers must
+        # fire THIS process's _on_loss (mesh re-init is per-process
+        # state) exactly once, whoever won the race to write them
+        self._known_lost = set()
+        os.makedirs(self._lost_dir, exist_ok=True)
+        os.makedirs(self._rounds_dir, exist_ok=True)
+
+    @staticmethod
+    def _safe(name):
+        return "".join(c if (c.isalnum() or c in "._-") else "_"
+                       for c in name)
+
+    def lost_hosts(self):
+        import os
+        out = {}
+        for f in os.listdir(self._lost_dir):
+            if f.startswith("host_"):
+                try:
+                    with open(os.path.join(self._lost_dir, f)) as fh:
+                        out[int(f[5:])] = fh.read().strip()
+                except (OSError, ValueError):   # pragma: no cover - race
+                    continue
+        return out
+
+    def live_hosts(self):
+        lost = self.lost_hosts()
+        return [i for i in range(self.n_hosts) if i not in lost]
+
+    def mark_lost(self, host_id, reason="declared lost"):
+        import os
+        from ..io import _atomic_write
+        if host_id in self.lost_hosts():
+            return
+        _atomic_write(os.path.join(self._lost_dir, "host_%d" % host_id),
+                      reason)
+        self._known_lost.add(host_id)
+        self._on_loss([host_id])
+
+    def all_gather(self, name, host_id, value=None, timeout_s=None):
+        import json
+        import os
+        from ..io import _atomic_write
+        deadline = time.monotonic() + (self.timeout_s if timeout_s is None
+                                       else float(timeout_s))
+        rd = os.path.join(self._rounds_dir, self._safe(name))
+        os.makedirs(rd, exist_ok=True)
+        lost = self.lost_hosts()
+        if host_id in lost:
+            raise HostLostError(
+                "host %d is fenced (%s) — rejoin, don't resume"
+                % (host_id, lost[host_id]))
+        mine = os.path.join(rd, "host_%d.json" % host_id)
+        if os.path.exists(mine):
+            # same split-brain guard as LocalCoordinator: never let an
+            # imposter (or a replayed round name) overwrite a live value
+            raise CoordinationError(
+                "host %d already contributed to round %r — collective "
+                "names must be unique per round" % (host_id, name))
+        _atomic_write(mine, json.dumps({"value": value}))
+        while True:
+            lost = self.lost_hosts()
+            present = {int(f[5:-5]) for f in os.listdir(rd)
+                       if f.startswith("host_") and f.endswith(".json")}
+            waiting_for = [i for i in range(self.n_hosts)
+                           if i not in lost and i not in present]
+            if not waiting_for:
+                break
+            if time.monotonic() >= deadline:
+                if not self.detect_loss:
+                    raise BarrierTimeoutError(
+                        "round %r timed out waiting for hosts %s"
+                        % (name, waiting_for))
+                for i in waiting_for:
+                    # first tombstone wins; duplicates are idempotent —
+                    # _on_loss firing is keyed on _known_lost below, so
+                    # losing this race still re-inits OUR mesh
+                    if i not in self.lost_hosts():
+                        _atomic_write(
+                            os.path.join(self._lost_dir, "host_%d" % i),
+                            "missed round %r" % name)
+                continue
+            time.sleep(self.poll_s)
+        lost = self.lost_hosts()
+        if host_id in lost:
+            raise HostLostError(
+                "host %d is fenced (%s) — rejoin, don't resume"
+                % (host_id, lost[host_id]))
+        result = {}
+        for i in sorted(present - set(lost)):
+            with open(os.path.join(rd, "host_%d.json" % i)) as fh:
+                result[i] = json.load(fh)["value"]
+        # last one out cleans up (LocalCoordinator parity): every value
+        # is written before any ack, and removal needs every reader's
+        # ack — so nobody can lose a file they still need. Lost hosts
+        # never ack; their rounds leak, bounded by the loss count.
+        _atomic_write(os.path.join(rd, "ack_%d" % host_id), "")
+        try:
+            acked = {int(f[4:]) for f in os.listdir(rd)
+                     if f.startswith("ack_")}
+            if acked >= set(result):
+                import shutil
+                shutil.rmtree(rd, ignore_errors=True)
+        except (OSError, ValueError):   # pragma: no cover - lost race
+            pass
+        # fire for every loss THIS process has not yet reacted to —
+        # including tombstones another process won the race to write:
+        # mesh re-init is per-process state, so a survivor that merely
+        # OBSERVES a loss must still rebuild its collectives
+        newly_observed = sorted(set(lost) - self._known_lost)
+        self._known_lost.update(lost)
+        self._on_loss(newly_observed)
+        return result
+
+
+# ---------------------------------------------------------------------------
+# pod-level resilient training
+# ---------------------------------------------------------------------------
+
+class PodResilientTrainer(object):
+    """Coordinated auto-recovery across an N-host pod.
+
+    Wraps N per-host :class:`~.resilience.ResilientTrainer` s — each
+    with its own executor, Scope and checkpoint dir. In production every
+    host process builds exactly one trainer and they meet on a
+    :class:`FileCoordinator`; in tests all N live in one process on a
+    :class:`LocalCoordinator` (threads), which exercises the identical
+    consensus protocol.
+
+    Protocol, per dispatch window:
+
+      1. every host dispatches its window and (at a checkpoint boundary)
+         saves its shards;
+      2. status exchange (all_gather): ok / transient / fatal;
+      3. all ok -> commit and continue. Any fatal -> the whole pod
+         aborts (a shape bug replays identically — retrying burns the
+         budget on every host). Any transient -> pod-wide recovery:
+         every host scrubs its checkpoint dir WITHOUT loading payloads
+         (io.scrub_checkpoint), the coordinator elects the max step
+         validated on every live host, and every host restores exactly
+         that step (io.load_checkpoint(step=...): no silent fallback —
+         a mismatched restore would deadlock the collectives).
+
+    Because each host's checkpoint carries params, optimizer moments AND
+    the PRNG step counter, the replayed pod trajectory is bitwise
+    identical to a fault-free run. The restart budget is SHARED: rewinds
+    are pod-wide, so every host's counter advances in lockstep and the
+    pod gives up together with RestartBudgetExceededError.
+    """
+
+    def __init__(self, trainers, coordinator=None, max_restarts=3,
+                 host_id=None):
+        """``host_id=None`` (simulation): ``trainers`` holds ALL N hosts
+        and run() drives them on N threads. ``host_id=i`` (production,
+        one process per host): ``trainers`` holds exactly THIS host's
+        trainer, ``coordinator`` is the shared rendezvous (e.g. a
+        FileCoordinator over a common root with ``n_hosts`` = pod size),
+        and run() drives the single host loop in the calling thread —
+        its peers are other processes, not threads."""
+        if not trainers:
+            raise ValueError("PodResilientTrainer needs >= 1 trainer")
+        self._trainers = list(trainers)
+        every = {t._checkpoint_every for t in self._trainers}
+        window = {t._steps_per_dispatch for t in self._trainers}
+        keep = {t._keep_last for t in self._trainers}
+        if len(every) != 1 or len(window) != 1 or len(keep) != 1:
+            # the recovery protocol assumes identical control flow on
+            # every host: same windows, same checkpoint boundaries,
+            # same pruning horizon
+            raise ValueError(
+                "all pod trainers must agree on checkpoint_every, "
+                "steps_per_dispatch and keep_last (got %s / %s / %s)"
+                % (sorted(every), sorted(window), sorted(keep)))
+        if min(keep) < 2:
+            # a host that faulted BEFORE the window's save holds one
+            # fewer checkpoint than its ok peers; keep_last=1 would let
+            # the peers prune the last step everyone shares, turning a
+            # recoverable transient into a NoQuorumError cold start
+            raise ValueError(
+                "pod trainers need keep_last >= 2: the consensus "
+                "election requires the previous common checkpoint to "
+                "survive the ok hosts' pruning")
+        self._coordinator = coordinator or LocalCoordinator(
+            len(self._trainers))
+        self._host_id = None if host_id is None else int(host_id)
+        if self._host_id is None:
+            if self._coordinator.n_hosts != len(self._trainers):
+                raise ValueError(
+                    "coordinator expects %d hosts but %d trainers were "
+                    "given" % (self._coordinator.n_hosts,
+                               len(self._trainers)))
+        else:
+            if len(self._trainers) != 1:
+                raise ValueError(
+                    "host_id mode is one-process-per-host: pass exactly "
+                    "this host's trainer (got %d)" % len(self._trainers))
+            if not 0 <= self._host_id < self._coordinator.n_hosts:
+                raise ValueError(
+                    "host_id %d out of range for a %d-host coordinator"
+                    % (self._host_id, self._coordinator.n_hosts))
+        self._max_restarts = int(max_restarts)
+        # advances once per run() on EVERY host (runs are lockstep like
+        # everything else), namespacing round names so a second run()
+        # on the same coordinator never collides with the first's rounds
+        self._run_seq = 0
+
+    @property
+    def coordinator(self):
+        return self._coordinator
+
+    def run(self, feeds, fetch_list=None):
+        """Run the pod to completion, recovering from transient faults.
+
+        ``feeds``: either ONE list of per-step feed dicts (replicated to
+        every host — the data-parallel-replica shape) or a list of N
+        per-host feed lists of EQUAL length (each host trains its own
+        stream). Returns the per-host fetch lists ``[n_hosts][n_steps]``.
+
+        In ``host_id`` mode feeds is THIS host's list of per-step feed
+        dicts and the return value is its fetch list ``[n_steps]`` —
+        the peers run the same call in their own processes.
+        """
+        from . import resilience
+        if self._host_id is not None:
+            self._run_seq += 1
+            with resilience.context(host=self._host_id):
+                return self._host_loop(self._host_id,
+                                       "r%d." % self._run_seq,
+                                       list(feeds), fetch_list)
+        n_hosts = len(self._trainers)
+        if not feeds or isinstance(feeds[0], dict):
+            per_host = [list(feeds)] * n_hosts
+        else:
+            per_host = [list(f) for f in feeds]
+            if len(per_host) != n_hosts:
+                raise ValueError(
+                    "per-host feeds: expected %d lists, got %d"
+                    % (n_hosts, len(per_host)))
+        if len({len(f) for f in per_host}) > 1:
+            raise ValueError("every host needs the same number of steps "
+                             "(lockstep collectives)")
+        results = [None] * n_hosts
+        errors = [None] * n_hosts
+        self._run_seq += 1
+        run_tag = "r%d." % self._run_seq
+
+        def host_main(hid):
+            from . import resilience
+            try:
+                with resilience.context(host=hid):
+                    results[hid] = self._host_loop(hid, run_tag,
+                                                   per_host[hid],
+                                                   fetch_list)
+            except BaseException as e:   # surfaced after join
+                errors[hid] = e
+
+        threads = [threading.Thread(target=host_main, args=(hid,),
+                                    name="pod-host-%d" % hid)
+                   for hid in range(n_hosts)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        real = [e for e in errors
+                if e is not None and not isinstance(e, CoordinationError)]
+        if real:
+            raise real[0]
+        coord = [e for e in errors if e is not None]
+        if coord:
+            raise coord[0]
+        return results
+
+    def _host_loop(self, hid, run_tag, feeds, fetch_list):
+        # host_id mode holds only THIS host's trainer; simulation mode
+        # holds all of them, indexed by the logical host id
+        trainer = self._trainers[0] if self._host_id is not None \
+            else self._trainers[hid]
+        co = self._coordinator
+        fetch_list = trainer._resolved_fetch_list(fetch_list)
+        n = len(feeds)
+        trainer._require_fresh_dir()
+        trainer._save(0)
+        co.barrier(run_tag + "pod_start", hid)
+        if n == 0:
+            co.barrier(run_tag + "pod_end", hid)
+            return []
+        all_fetches = [None] * n
+        ckpt_every = trainer._checkpoint_every
+        step, restarts, rnd = 0, 0, 0
+        while step < n:
+            rnd += 1   # advances identically on every host: round names
+            #            line up without any out-of-band numbering
+            until_ckpt = ckpt_every - (step % ckpt_every)
+            w = min(trainer._steps_per_dispatch, n - step, until_ckpt)
+            status, err, outs = "ok", None, None
+            try:
+                outs = trainer._dispatch(feeds, step, w, fetch_list)
+                if (step + w) % ckpt_every == 0 or step + w == n:
+                    trainer._save(step + w)
+            except Exception as e:
+                err = e
+                status = "transient" if trainer._policy.is_transient(e) \
+                    else "fatal"
+            verdicts = co.all_gather("%sw%d" % (run_tag, rnd), hid,
+                                     status)
+            if any(v == "fatal" for v in verdicts.values()):
+                record_event("fatal", step=step,
+                             error=type(err).__name__ if err else None)
+                if err is not None and status == "fatal":
+                    raise err
+                bad = sorted(h for h, v in verdicts.items()
+                             if v == "fatal")
+                raise CoordinationError(
+                    "pod aborted: host(s) %s hit a fatal error at step %d"
+                    % (bad, step))
+            if all(v == "ok" for v in verdicts.values()):
+                for i in range(w):
+                    all_fetches[step + i] = outs[i]
+                step += w
+                continue
+            # -- pod-wide recovery ------------------------------------
+            restarts += 1   # lockstep on every host: the SHARED budget
+            if restarts > self._max_restarts:
+                record_event("giveup", step=step, restarts=restarts)
+                raise RestartBudgetExceededError(
+                    "pod restart budget (%d) exhausted at step %d; "
+                    "last local error: %r" % (self._max_restarts, step,
+                                              err))
+            delay = trainer._policy.delay_s(restarts - 1)
+            record_event("pod_restart", step=step, restarts=restarts,
+                         error=type(err).__name__ if err else None,
+                         backoff_s=delay)
+            trainer._policy.sleep(delay)
+            from .. import io as io_mod
+            report = io_mod.scrub_checkpoint(trainer._ckpt_dir)
+            agreed = co.elect_restore_step(hid, report["valid_steps"],
+                                           name="%se%d" % (run_tag, rnd))
+            got = trainer._restore(step=agreed)
+            record_event("pod_restore", step=got)
+            step = got
+        co.barrier(run_tag + "pod_end", hid)
+        return all_fetches

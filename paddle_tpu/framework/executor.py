@@ -18,6 +18,7 @@ initializers don't deserve a compile.
 """
 import contextlib
 import logging
+import time
 import warnings
 
 import numpy as np
@@ -26,6 +27,7 @@ import jax.numpy as jnp
 
 from . import resilience
 from . import trace as trace_mod
+from . import watchdog
 from .dtypes import to_jax_dtype
 from .place import CPUPlace, TPUPlace, _current_expected_place  # noqa: F401
 from .program import Program, default_main_program
@@ -189,10 +191,18 @@ class Executor(object):
         # (startup/eager programs don't count). A no-op unless a
         # FaultInjector is installed (resilience.inject / PADDLE_TPU_FAULTS).
         resilience.fire("step", what="Executor.run")
+        # straggler wiring: when detection is armed, the whole dispatch+
+        # writeback (return_numpy syncs the fetches) is the step latency
+        det_t0 = time.perf_counter() \
+            if watchdog.straggler_detector() is not None else None
 
         if getattr(program, "_pp_plan", None) is not None:
-            return self._run_pipeline(program, feed, fetch_names, scope,
-                                      return_numpy)
+            out = self._run_pipeline(program, feed, fetch_names, scope,
+                                     return_numpy)
+            if det_t0 is not None:
+                watchdog.observe_step_latency(time.perf_counter() - det_t0,
+                                              what="Executor.run")
+            return out
 
         # ---- prepare state ------------------------------------------------
         state_names, uses_rng = self._prepare_state(program, feed, scope)
@@ -228,8 +238,12 @@ class Executor(object):
                     "parity: check_nan_inf)")
         else:
             fetches, new_state = step_fn(state_vals, feed_tuple)
-        return self._writeback(scope, state_names, new_state, fetches,
-                               return_numpy)
+        out = self._writeback(scope, state_names, new_state, fetches,
+                              return_numpy)
+        if det_t0 is not None:
+            watchdog.observe_step_latency(time.perf_counter() - det_t0,
+                                          what="Executor.run")
+        return out
 
     @staticmethod
     def _writeback(scope, state_names, new_state, fetches, return_numpy):
@@ -295,9 +309,19 @@ class Executor(object):
         # one fire per scanned WINDOW (a window is one device dispatch —
         # the granularity at which a real preemption would kill the step)
         resilience.fire("step", what="Executor.run_steps")
+        # per-step straggler latency = window wall-clock / window length
+        det_t0 = time.perf_counter() \
+            if watchdog.straggler_detector() is not None else None
+
+        def _observe(result):
+            if det_t0 is not None:
+                watchdog.observe_step_latency(
+                    (time.perf_counter() - det_t0) / n_steps,
+                    what="Executor.run_steps")
+            return result
         if getattr(program, "_pp_plan", None) is not None:
-            return self._run_pipeline_steps(program, feed, fetch_names,
-                                            scope, return_numpy, n_steps)
+            return _observe(self._run_pipeline_steps(
+                program, feed, fetch_names, scope, return_numpy, n_steps))
         staged = self._convert_feed(program, feed, steps_axis=True)
 
         check_numerics = bool(
@@ -355,8 +379,8 @@ class Executor(object):
                     "check_numerics: non-finite value (NaN/Inf) first "
                     "detected at step %d of this run_steps window"
                     % int(np.argmin(finite)))
-        return self._writeback(scope, state_names, new_state, ys[0],
-                               return_numpy)
+        return _observe(self._writeback(scope, state_names, new_state,
+                                        ys[0], return_numpy))
 
     # ------------------------------------------------------------------
     def _convert_feed(self, program, feed, steps_axis=False):

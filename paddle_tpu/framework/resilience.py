@@ -45,7 +45,8 @@ __all__ = [
     "DeadlineExceededError", "RestartBudgetExceededError",
     "fire", "inject", "install", "current_injector", "reload_env",
     "events", "record_event", "clear_events", "classify",
-    "run_with_deadline", "INJECTION_POINTS",
+    "run_with_deadline", "INJECTION_POINTS", "context",
+    "metrics", "metrics_text", "parse_metrics_text",
 ]
 
 INJECTION_POINTS = ("step", "ckpt_write", "serve")
@@ -87,6 +88,26 @@ class RestartBudgetExceededError(RuntimeError):
 # structured event log
 # ---------------------------------------------------------------------------
 
+_tls = threading.local()
+
+
+@contextlib.contextmanager
+def context(**tags):
+    """Attach tags to every event THIS thread records inside the block.
+
+    PodResilientTrainer wraps each simulated host's loop in
+    ``context(host=i)`` so one process-global event log still tells the
+    hosts apart — the same shape a real pod gets from per-process logs."""
+    old = getattr(_tls, "tags", None)
+    merged = dict(old or {})
+    merged.update(tags)
+    _tls.tags = merged
+    try:
+        yield
+    finally:
+        _tls.tags = old
+
+
 class EventLog(object):
     """Bounded, thread-safe, append-only record of resilience activity.
 
@@ -98,7 +119,11 @@ class EventLog(object):
         self._lock = threading.Lock()
 
     def record(self, kind, **fields):
-        event = dict(fields, kind=kind, time=time.time())
+        tags = getattr(_tls, "tags", None)
+        event = dict(tags) if tags else {}
+        event.update(fields)
+        event["kind"] = kind
+        event["time"] = time.time()
         with self._lock:
             self._events.append(event)
         return event
@@ -129,6 +154,123 @@ def record_event(kind, **fields):
 
 def clear_events():
     _LOG.clear()
+
+
+# ---------------------------------------------------------------------------
+# metrics export (Prometheus-style aggregation of the event log)
+# ---------------------------------------------------------------------------
+
+METRIC_PREFIX = "paddle_tpu_resilience"
+# restore latencies span "local disk, small model" (~ms) to "multi-host
+# resharded restore" (~minutes)
+RESTORE_LATENCY_BUCKETS = (0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0, 120.0)
+
+
+def _histogram(name, values, buckets, labels=None):
+    values = [float(v) for v in values]
+    cum, total = [], 0
+    for le in buckets:
+        total = sum(1 for v in values if v <= le)
+        cum.append(["%g" % le, total])
+    cum.append(["+Inf", len(values)])
+    return {"name": name, "labels": dict(labels or {}),
+            "buckets": cum, "sum": float(sum(values)),
+            "count": len(values)}
+
+
+def metrics(event_list=None):
+    """Aggregate the bounded event log into Prometheus-style counters and
+    histograms.
+
+    Returns a JSON-ready dict ``{"counters": [...], "histograms": [...]}``
+    where each counter is ``{"name", "labels", "value"}`` and each
+    histogram carries cumulative ``buckets`` ([le, count] pairs ending at
+    "+Inf"), ``sum`` and ``count``. Series:
+
+      <prefix>_events_total{kind=...}        every event kind (faults,
+                                             retries, restarts, sheds,
+                                             restores, stragglers, ...)
+      <prefix>_faults_total{point=,fault=}   injected/observed faults by
+                                             injection point and kind
+      <prefix>_restore_latency_seconds       checkpoint-restore wall time
+                                             (from restore events'
+                                             latency_s)
+
+    ``metrics_text()`` renders the exposition format; a scraper
+    sidecar/pushgateway can serve it as-is. Pass ``event_list`` to
+    aggregate a snapshot instead of the live log."""
+    evs = _LOG.events() if event_list is None else list(event_list)
+    kind_counts = collections.Counter(e["kind"] for e in evs)
+    fault_counts = collections.Counter(
+        (e.get("point", "?"), e.get("fault", "?"))
+        for e in evs if e["kind"] == "fault")
+    counters = [
+        {"name": METRIC_PREFIX + "_events_total",
+         "labels": {"kind": kind}, "value": n}
+        for kind, n in sorted(kind_counts.items())]
+    counters += [
+        {"name": METRIC_PREFIX + "_faults_total",
+         "labels": {"point": p, "fault": f}, "value": n}
+        for (p, f), n in sorted(fault_counts.items())]
+    restore_lat = [e["latency_s"] for e in evs
+                   if e["kind"] == "restore" and "latency_s" in e]
+    histograms = [_histogram(METRIC_PREFIX + "_restore_latency_seconds",
+                             restore_lat, RESTORE_LATENCY_BUCKETS)]
+    return {"counters": counters, "histograms": histograms}
+
+
+def _fmt_labels(labels):
+    if not labels:
+        return ""
+    return "{%s}" % ",".join('%s="%s"' % (k, v)
+                             for k, v in sorted(labels.items()))
+
+
+def metrics_text(m=None):
+    """Render :func:`metrics` in the Prometheus text exposition format."""
+    m = m if m is not None else metrics()
+    lines = []
+    seen_type = set()
+    for c in m["counters"]:
+        if c["name"] not in seen_type:
+            seen_type.add(c["name"])
+            lines.append("# TYPE %s counter" % c["name"])
+        lines.append("%s%s %g" % (c["name"], _fmt_labels(c["labels"]),
+                                  c["value"]))
+    for h in m["histograms"]:
+        lines.append("# TYPE %s histogram" % h["name"])
+        for le, n in h["buckets"]:
+            labels = dict(h["labels"], le=le)
+            lines.append("%s_bucket%s %d" % (h["name"],
+                                             _fmt_labels(labels), n))
+        lines.append("%s_sum%s %g" % (h["name"], _fmt_labels(h["labels"]),
+                                      h["sum"]))
+        lines.append("%s_count%s %d" % (h["name"],
+                                        _fmt_labels(h["labels"]),
+                                        h["count"]))
+    return "\n".join(lines) + "\n"
+
+
+def parse_metrics_text(text):
+    """Parse a text exposition back into ``[(name, labels, value)]`` —
+    the round-trip half used by tests and by scrapers that want the
+    samples without a Prometheus client library."""
+    import re
+    samples = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = re.match(r'^([A-Za-z_:][\w:]*)(\{[^}]*\})?\s+(\S+)$', line)
+        if not m:
+            raise ValueError("unparsable metrics line: %r" % line)
+        name, labelblob, value = m.groups()
+        labels = {}
+        if labelblob:
+            for part in re.findall(r'(\w+)="([^"]*)"', labelblob):
+                labels[part[0]] = part[1]
+        samples.append((name, labels, float(value)))
+    return samples
 
 
 # ---------------------------------------------------------------------------
@@ -450,7 +592,8 @@ class ResilientTrainer(object):
 
     def __init__(self, executor, program, ckpt_dir, fetch_list=None,
                  checkpoint_every=10, max_restarts=3, retry_policy=None,
-                 steps_per_dispatch=1, keep_last=3):
+                 steps_per_dispatch=1, keep_last=3, scope=None,
+                 async_checkpoints=False):
         from .compiler import CompiledProgram
         if checkpoint_every < 1:
             raise ValueError("checkpoint_every must be >= 1")
@@ -467,6 +610,13 @@ class ResilientTrainer(object):
         self._policy = retry_policy or RetryPolicy()
         self._steps_per_dispatch = int(steps_per_dispatch)
         self._keep_last = int(keep_last)
+        # explicit scope: what lets a PodResilientTrainer give each
+        # simulated host disjoint state in ONE process (None = the
+        # process-global scope, the single-host default)
+        self._scope = scope
+        # async_checkpoints=True moves the file commit off the step path
+        # (io.save_checkpoint blocking=False; single-host only)
+        self._async_ckpt = bool(async_checkpoints)
 
     # -- events convenience ------------------------------------------------
     @staticmethod
@@ -477,45 +627,48 @@ class ResilientTrainer(object):
         from .. import io as io_mod
         io_mod.save_checkpoint(self._executor, self._ckpt_dir,
                                self._program, step=step,
-                               keep_last=self._keep_last)
+                               keep_last=self._keep_last,
+                               blocking=not self._async_ckpt,
+                               scope=self._scope)
         record_event("ckpt", step=step)
 
-    def _restore(self):
+    def _restore(self, step=None):
+        """Restore ``step`` (pod-consensus path) or the latest valid
+        checkpoint. Always joins an in-flight async commit FIRST: a
+        blocking=False save still writing while we pick the restore
+        point could otherwise tear the very dir we are about to read. A
+        FAILED async commit is recorded, not raised — its torn step dir
+        is exactly what the load's scrub/quarantine fallback handles."""
         from .. import io as io_mod
-        step = int(io_mod.load_checkpoint(self._executor, self._ckpt_dir,
-                                          self._program))
-        record_event("restore", step=step)
-        return step
+        t0 = time.perf_counter()
+        try:
+            io_mod.wait_for_pending_saves()
+        except Exception as e:
+            record_event("ckpt_async_error", error=type(e).__name__)
+        got = int(io_mod.load_checkpoint(self._executor, self._ckpt_dir,
+                                         self._program, step=step,
+                                         scope=self._scope))
+        record_event("restore", step=got,
+                     latency_s=time.perf_counter() - t0)
+        return got
 
     def _dispatch(self, feeds, step, w, fetch_list):
         import numpy as np
         if w == 1:
             return [self._executor.run(self._target, feed=feeds[step],
-                                       fetch_list=fetch_list)]
+                                       fetch_list=fetch_list,
+                                       scope=self._scope)]
         stacked = _stack_feeds(feeds[step:step + w])
         outs = self._executor.run_steps(self._target, feed=stacked,
-                                        fetch_list=fetch_list)
+                                        fetch_list=fetch_list,
+                                        scope=self._scope)
         return [[np.asarray(o)[i] for o in outs] for i in range(w)]
 
-    def run(self, feeds, fetch_list=None):
-        """Run one step per feed dict in ``feeds``, recovering from
-        transient faults. Returns the per-step fetch lists (replayed
-        steps report their replayed — identical — values)."""
-        feeds = list(feeds)
-        n = len(feeds)
-        fetch_list = fetch_list if fetch_list is not None \
-            else self._fetch_list
-        if not fetch_list:
-            raise ValueError(
-                "ResilientTrainer.run needs a fetch_list — an empty one "
-                "would fall into Executor.run's eager path")
-        if n == 0:
-            return []
-        all_fetches = [None] * n
-        # refuse a pre-populated ckpt_dir: this run's step_0 baseline
-        # sorts OLDER than a previous run's step_48, so keep_last would
-        # prune it the moment it is written and the first restore would
-        # silently rewind into the previous run's stale trajectory
+    def _require_fresh_dir(self):
+        """Refuse a pre-populated ckpt_dir: this run's step_0 baseline
+        sorts OLDER than a previous run's step_48, so keep_last would
+        prune it the moment it is written and the first restore would
+        silently rewind into the previous run's stale trajectory."""
         if os.path.isdir(self._ckpt_dir):
             stale = sorted(d for d in os.listdir(self._ckpt_dir)
                            if d.startswith("step_")
@@ -526,6 +679,27 @@ class ResilientTrainer(object):
                     "ResilientTrainer.run starts a fresh trajectory at "
                     "step 0; give each run a clean directory"
                     % (self._ckpt_dir, ", ".join(stale)))
+
+    def _resolved_fetch_list(self, fetch_list):
+        fetch_list = fetch_list if fetch_list is not None \
+            else self._fetch_list
+        if not fetch_list:
+            raise ValueError(
+                "ResilientTrainer.run needs a fetch_list — an empty one "
+                "would fall into Executor.run's eager path")
+        return fetch_list
+
+    def run(self, feeds, fetch_list=None):
+        """Run one step per feed dict in ``feeds``, recovering from
+        transient faults. Returns the per-step fetch lists (replayed
+        steps report their replayed — identical — values)."""
+        feeds = list(feeds)
+        n = len(feeds)
+        fetch_list = self._resolved_fetch_list(fetch_list)
+        if n == 0:
+            return []
+        all_fetches = [None] * n
+        self._require_fresh_dir()
         # baseline snapshot: a fault before the first periodic save must
         # still have something valid to restore
         self._save(0)

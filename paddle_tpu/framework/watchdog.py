@@ -12,12 +12,104 @@ import threading
 
 import jax
 
-__all__ = ["CollectiveTimeoutError", "wait_with_timeout", "bounded_call"]
+__all__ = ["CollectiveTimeoutError", "wait_with_timeout", "bounded_call",
+           "StragglerDetector", "enable_straggler_detection",
+           "disable_straggler_detection", "straggler_detector",
+           "observe_step_latency"]
 
 
 class CollectiveTimeoutError(RuntimeError):
     """A jitted step (and therefore some collective in it) failed to
     complete within the configured timeout."""
+
+
+class StragglerDetector(object):
+    """Per-step latency EWMA — flag a slow host BEFORE it hangs.
+
+    The watchdog only knows "done within timeout_s"; a straggling host
+    (thermal throttle, noisy neighbor, degrading ICI link) serves k
+    warnings before it becomes a hard CollectiveTimeoutError. Each
+    ``observe(seconds)`` updates ``ewma = alpha*x + (1-alpha)*ewma`` and
+    records a ``straggler`` resilience event when a step exceeds
+    ``k × ewma`` (after ``warmup`` samples, and only past
+    ``min_latency_s`` so microsecond jitter never pages anyone).
+
+    Straggler samples still update the EWMA: a PERSISTENT slowdown
+    recalibrates the baseline instead of flagging every step forever —
+    the signal is the transition, which is when rebalancing helps.
+    """
+
+    def __init__(self, alpha=0.2, k=3.0, warmup=5, min_latency_s=0.0):
+        if not 0.0 < alpha <= 1.0:
+            raise ValueError("alpha must be in (0, 1]")
+        if k <= 1.0:
+            raise ValueError("k must be > 1 (k*ewma is the flag line)")
+        self.alpha = float(alpha)
+        self.k = float(k)
+        self.warmup = int(warmup)
+        self.min_latency_s = float(min_latency_s)
+        self._ewma = None
+        self._n = 0
+        self._lock = threading.Lock()
+
+    @property
+    def ewma_s(self):
+        return self._ewma
+
+    @property
+    def count(self):
+        return self._n
+
+    def observe(self, seconds, what="step"):
+        """Feed one step latency; True if it was flagged as a straggler."""
+        seconds = float(seconds)
+        with self._lock:
+            # ewma > 0: a zero baseline has no meaningful ratio (and
+            # would flag every positive sample forever)
+            flagged = (self._n >= self.warmup and self._ewma is not None
+                       and self._ewma > 0.0
+                       and seconds > self.k * self._ewma
+                       and seconds > self.min_latency_s)
+            ewma = self._ewma
+            self._ewma = seconds if self._ewma is None else (
+                self.alpha * seconds + (1.0 - self.alpha) * self._ewma)
+            self._n += 1
+        if flagged:
+            from . import resilience
+            resilience.record_event("straggler", what=what,
+                                    latency_s=seconds, ewma_s=ewma,
+                                    ratio=seconds / ewma)
+        return flagged
+
+
+# opt-in global detector: armed by ResilientTrainer/operators that want
+# early warning; a no-op by default so unrelated runs never pay for it
+_detector = [None]
+
+
+def enable_straggler_detection(alpha=0.2, k=3.0, warmup=5,
+                               min_latency_s=0.0):
+    """Install (and return) the process-global StragglerDetector fed by
+    Executor.run/run_steps and armed wait_with_timeout calls."""
+    _detector[0] = StragglerDetector(alpha=alpha, k=k, warmup=warmup,
+                                     min_latency_s=min_latency_s)
+    return _detector[0]
+
+
+def disable_straggler_detection():
+    _detector[0] = None
+
+
+def straggler_detector():
+    return _detector[0]
+
+
+def observe_step_latency(seconds, what="step"):
+    """Feed the global detector (no-op when detection is disabled)."""
+    det = _detector[0]
+    if det is None:
+        return False
+    return det.observe(seconds, what=what)
 
 
 def bounded_call(fn, timeout_s, name="paddle_tpu-bounded-call"):
@@ -66,6 +158,10 @@ def wait_with_timeout(outputs, timeout_s, what="jitted step"):
 
     done, _, err = bounded_call(_wait_all, timeout_s,
                                 name="paddle_tpu-collective-watchdog")
+    # NOTE: an armed wait does NOT feed the straggler detector —
+    # Executor.run/run_steps already observe the full dispatch latency,
+    # and the compiled path's one-behind wait is near-zero when fetches
+    # were synced, which would halve the EWMA baseline (double-count).
     if not done:
         # observability: every watchdog trip lands in the resilience
         # event log (lazy import — resilience imports this module)

@@ -293,7 +293,7 @@ def wait_for_pending_saves():
 
 
 def save_checkpoint(executor, dirname, main_program=None, step=None,
-                    keep_last=3, blocking=True):
+                    keep_last=3, blocking=True, scope=None):
     """Sharded checkpoint of the whole training scope.
 
     Multi-host semantics: every process calls this with the same args;
@@ -301,6 +301,10 @@ def save_checkpoint(executor, dirname, main_program=None, step=None,
     barrier, then process 0 alone commits manifest.json + "latest" and
     prunes old step dirs.  A crash before the manifest leaves the
     previous checkpoint as "latest" — restores never see a torn save.
+
+    scope: the Scope to snapshot (default the global scope). An explicit
+    scope is what lets N simulated pod hosts in ONE process (coordination
+    .PodResilientTrainer) checkpoint disjoint state.
 
     blocking=False (single-host only): device->host materialization
     still happens synchronously — the step's donation invalidates device
@@ -311,7 +315,7 @@ def save_checkpoint(executor, dirname, main_program=None, step=None,
     previous commit first.
     """
     import jax
-    scope = global_scope()
+    scope = scope if scope is not None else global_scope()
     pid = jax.process_index()
     step_no = int(step if step is not None else 0)
     step_dir = "step_%d" % step_no
@@ -471,6 +475,73 @@ def _ckpt_logger():
                       fmt="%(asctime)s-%(levelname)s: %(message)s")
 
 
+def _classify_step_dir(dirname, step_dir):
+    """Classify one step dir as ``("valid"|"corrupt"|"incomplete",
+    reason)`` WITHOUT reading any shard array payload.
+
+    Only manifest JSON and npz/zip central directories (member name
+    lists) are touched — cheap enough for a supervisor to scrub a whole
+    checkpoint history before tearing down training state. Statuses:
+
+      valid       manifest committed and every referenced shard file
+                  holds every referenced key (a healthy-but-NEWER
+                  format is also "valid": never a quarantine candidate)
+      incomplete  the commit point (manifest) never landed — an
+                  in-flight or torn save; restorable data may exist in
+                  an older step dir, never here
+      corrupt     the manifest committed but is unparsable, or shard
+                  files/keys it references are damaged or missing
+    """
+    full_dir = os.path.join(dirname, step_dir)
+    manifest_path = os.path.join(full_dir, MANIFEST_FILE)
+    if not os.path.isdir(full_dir):
+        return "incomplete", "step dir is missing"
+    if not os.path.exists(manifest_path):
+        legacy = os.path.join(full_dir, PARAMS_FILE)
+        if os.path.exists(legacy):
+            try:   # legacy (format 0) layout: one host-gather npz —
+                   # opening the handle reads only the zip directory
+                with np.load(legacy, allow_pickle=False) as z:
+                    z.files
+                return "valid", None
+            except Exception as e:
+                return "corrupt", "unreadable legacy params file: %s" % e
+        try:
+            kids = os.listdir(full_dir)
+        except OSError as e:   # pragma: no cover - permission damage
+            return "corrupt", "unreadable step dir: %s" % e
+        if any(k.startswith("shards_p") for k in kids):
+            return ("incomplete", "shard files present but no manifest "
+                    "— the commit never landed")
+        return "incomplete", "no manifest or shard files"
+    try:
+        with open(manifest_path) as f:
+            manifest = json.load(f)
+        if manifest.get("format_version", 0) > CKPT_FORMAT_VERSION:
+            # healthy, just newer than this library — load_checkpoint
+            # surfaces CheckpointFormatError and must NOT quarantine
+            return "valid", ("format_version %s newer than supported %d"
+                             % (manifest.get("format_version"),
+                                CKPT_FORMAT_VERSION))
+        needed = {}
+        for meta in manifest["vars"].values():
+            for sh in meta["shards"]:
+                needed.setdefault(sh["file"], set()).add(sh["key"])
+    except (OSError, ValueError, KeyError, TypeError) as e:
+        return "corrupt", "torn or malformed manifest: %s" % e
+    for fname, keys in needed.items():
+        try:
+            with np.load(os.path.join(full_dir, fname),
+                         allow_pickle=False) as z:
+                missing = keys.difference(z.files)
+        except Exception as e:
+            return "corrupt", "unreadable shard file %s: %s" % (fname, e)
+        if missing:
+            return "corrupt", "shard file %s is missing keys %s" % (
+                fname, sorted(missing))
+    return "valid", None
+
+
 def _scrub_step_dir(dirname, step_dir):
     """Return a corruption description if the step dir is damaged ON
     DISK (torn/unparsable manifest, missing shard files or npz keys),
@@ -480,34 +551,70 @@ def _scrub_step_dir(dirname, step_dir):
     failed for a caller-side reason (e.g. a bad ``shardings`` entry)
     must re-raise, not destroy the whole valid checkpoint history one
     rename at a time."""
-    full_dir = os.path.join(dirname, step_dir)
-    manifest_path = os.path.join(full_dir, MANIFEST_FILE)
-    if not os.path.exists(manifest_path):
-        try:   # legacy (format 0) layout: one host-gather npz
-            _load_arrays(full_dir, PARAMS_FILE)
-            return None
-        except Exception as e:
-            return "unreadable legacy params file: %s" % e
+    status, reason = _classify_step_dir(dirname, step_dir)
+    if status == "valid":
+        return None
+    return reason or status
+
+
+def scrub_checkpoint(dirname):
+    """Cheap supervisor-side scrub of a whole checkpoint directory.
+
+    Classifies every ``step_N`` dir as valid / corrupt / incomplete
+    WITHOUT loading shard array payloads (manifest JSON + npz member
+    lists only), so a pod supervisor can pick the restore point BEFORE
+    tearing down training state. Read-only: never renames or
+    quarantines — validity agrees with ``load_checkpoint``'s quarantine
+    logic because both run the same classifier (_classify_step_dir).
+
+    Returns a report dict::
+
+        {"dirname":   the scrubbed directory,
+         "latest":    the 'latest' pointer's target (or None),
+         "steps":     {step_no: {"dir", "status", "reason"}},
+         "valid_steps":  sorted [int] this library could restore,
+         "quarantined":  ["step_N.corrupt", ...] kept for forensics}
+
+    ``valid_steps`` is what feeds
+    ``coordination.Coordinator.elect_restore_step`` — the pod consensus
+    is the max step every live host reports here.
+    """
+    report = {"dirname": dirname, "latest": None, "steps": {},
+              "valid_steps": [], "quarantined": []}
     try:
-        with open(manifest_path) as f:
-            manifest = json.load(f)
-        var_metas = manifest["vars"]
-        needed = {}
-        for meta in var_metas.values():
-            for sh in meta["shards"]:
-                needed.setdefault(sh["file"], set()).add(sh["key"])
-    except (OSError, ValueError, KeyError, TypeError) as e:
-        return "torn or malformed manifest: %s" % e
-    for fname, keys in needed.items():
-        try:
-            with np.load(os.path.join(full_dir, fname)) as z:
-                missing = keys.difference(z.files)
-        except Exception as e:
-            return "unreadable shard file %s: %s" % (fname, e)
-        if missing:
-            return "shard file %s is missing keys %s" % (
-                fname, sorted(missing))
-    return None
+        kids = sorted(os.listdir(dirname))
+    except OSError:
+        return report          # no checkpoint dir yet — nothing valid
+    try:
+        with open(os.path.join(dirname, "latest")) as f:
+            report["latest"] = f.read().strip() or None
+    except OSError:
+        pass
+    counts = {"valid": 0, "corrupt": 0, "incomplete": 0}
+    for d in kids:
+        if not d.startswith("step_"):
+            continue
+        if ".corrupt" in d:
+            report["quarantined"].append(d)
+            continue
+        if not d.split("_", 1)[1].isdigit():
+            continue
+        status, reason = _classify_step_dir(dirname, d)
+        counts[status] += 1
+        step_no = _step_no(d)
+        report["steps"][step_no] = {"dir": d, "status": status,
+                                    "reason": reason}
+        if status == "valid" and reason is None:
+            # reason != None on a valid dir means "newer format" —
+            # intact, but THIS library cannot restore it
+            report["valid_steps"].append(step_no)
+    report["valid_steps"].sort()
+    from .framework import resilience
+    resilience.record_event("scrub", dirname=dirname,
+                            valid=counts["valid"],
+                            corrupt=counts["corrupt"],
+                            incomplete=counts["incomplete"])
+    return report
 
 
 def _quarantine_step_dir(dirname, step_dir, reason):
@@ -596,7 +703,8 @@ def _step_no(step_dir):
     return int(step_dir.split("_")[1])
 
 
-def load_checkpoint(executor, dirname, main_program=None, shardings=None):
+def load_checkpoint(executor, dirname, main_program=None, shardings=None,
+                    step=None, scope=None):
     """Restore the latest VALID checkpoint into the global scope.
 
     shardings: optional {var_name: jax.sharding.Sharding} — vars listed
@@ -606,15 +714,29 @@ def load_checkpoint(executor, dirname, main_program=None, shardings=None):
     topology).  Unlisted vars load as host arrays and are placed by the
     next CompiledProgram/Executor run, exactly like a cold start.
 
-    Resilience semantics: a corrupt/missing ``latest`` pointer or a step
-    dir with a torn manifest / missing shards does NOT fail the restore.
-    The bad step dir is quarantined (renamed ``step_N.corrupt``) and the
-    newest previous valid checkpoint is used instead; only when NO valid
-    checkpoint remains does the original error surface.
+    step: restore EXACTLY this step (the pod-consensus path — every host
+    must land on the quorum-elected step, so there is no fallback: any
+    failure raises instead of silently restoring a different step, which
+    would deadlock the pod's collectives on mismatched trajectories).
+
+    scope: destination Scope (default the global scope).
+
+    Resilience semantics (step=None): a corrupt/missing ``latest``
+    pointer or a step dir with a torn manifest / missing shards does NOT
+    fail the restore. The bad step dir is quarantined (renamed
+    ``step_N.corrupt``) and the newest previous valid checkpoint is used
+    instead; only when NO valid checkpoint remains does the original
+    error surface.
     """
     import jax
     wait_for_pending_saves()   # an in-flight async commit must land first
-    scope = global_scope()
+    scope = scope if scope is not None else global_scope()
+    if step is not None:
+        got, out = _load_step_dir(dirname, "step_%d" % int(step),
+                                  shardings or {})
+        for name, arr in out.items():
+            scope.set_var(name, arr)
+        return got
     latest = None
     try:
         with open(os.path.join(dirname, "latest")) as f:

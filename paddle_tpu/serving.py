@@ -265,6 +265,10 @@ class ServingPredictor(object):
         self._in_flight = 0
         self._lock = threading.Lock()
         self._warm = set()   # buckets that served (=> compiled) already
+        # per-replica health counters (the orchestrator-facing twin of
+        # the process-global resilience event log)
+        self._stats = {"requests": 0, "deadline_misses": 0, "sheds": 0,
+                       "degraded_serves": 0, "errors": 0}
         with open(os.path.join(out_dir, "meta.json")) as f:
             self._meta = json.load(f)
         if self._meta["format_version"] > SERVING_FORMAT_VERSION:
@@ -294,6 +298,47 @@ class ServingPredictor(object):
     def get_output_names(self):
         return list(self._fetch_names)
 
+    def _bump(self, key):
+        with self._lock:
+            self._stats[key] += 1
+
+    def health(self):
+        """Readiness/liveness snapshot for orchestrator probes.
+
+        JSON-ready dict (tools/serving_probe.py serves it on the command
+        line). ``ready`` is the rotation signal: True only while the
+        replica can take traffic at full quality NOW — every exported
+        bucket warm (a cold bucket means live traffic eats a compile)
+        and the in-flight cap not saturated. ``status`` explains why
+        not: "cold" (warm it up), "saturated" (scale out / back off),
+        "degraded" (serving, but deadline misses, warm-bucket fallbacks
+        or hard errors happened — rotate when persistent), else "ok". The
+        counters are cumulative for THIS replica's lifetime."""
+        with self._lock:
+            warm = sorted(self._warm)
+            stats = dict(self._stats)
+            in_flight = self._in_flight
+        buckets = sorted(self._fns)
+        cold = [b for b in buckets if b not in warm]
+        saturated = self._max_in_flight is not None \
+            and in_flight >= self._max_in_flight
+        if saturated:
+            status = "saturated"
+        elif cold:
+            status = "cold"
+        elif stats["degraded_serves"] or stats["deadline_misses"] \
+                or stats["errors"]:
+            status = "degraded"
+        else:
+            status = "ok"
+        snapshot = {"live": True, "ready": not saturated and not cold,
+                    "status": status, "in_flight": in_flight,
+                    "max_in_flight": self._max_in_flight,
+                    "buckets": buckets, "warm_buckets": warm,
+                    "cold_buckets": cold}
+        snapshot.update(stats)
+        return snapshot
+
     def _bucket(self, n):
         for b in sorted(self._fns):
             if n <= b:
@@ -322,6 +367,7 @@ class ServingPredictor(object):
             return lambda: None
         with self._lock:
             if self._in_flight >= self._max_in_flight:
+                self._stats["sheds"] += 1
                 resilience.record_event(
                     "shed", in_flight=self._in_flight,
                     cap=self._max_in_flight)
@@ -437,6 +483,8 @@ class ServingPredictor(object):
             inputs = dict(zip(self._feed_names, inputs))
         deadline = deadline_s if deadline_s is not None \
             else self._deadline_s
+        self._bump("requests")
+
         def bounded(what, **impl_kw):
             # the slot is released by the WORK when it finishes — on a
             # deadline miss the orphaned worker keeps it until then
@@ -452,6 +500,7 @@ class ServingPredictor(object):
         try:
             return bounded("serving request")
         except resilience.DeadlineExceededError:
+            self._bump("deadline_misses")
             if not degraded_ok or not self._meta["dynamic_batch"]:
                 raise
             n = self._request_batch(inputs)
@@ -461,7 +510,24 @@ class ServingPredictor(object):
                 raise   # the slot itself is slow, not a cold compile
             resilience.record_event("degraded", batch=n,
                                     cold_bucket=natural, warm_bucket=fb)
-            return bounded("degraded serving request", force_bucket=fb)
+            try:
+                out = bounded("degraded serving request", force_bucket=fb)
+            except resilience.DeadlineExceededError:
+                self._bump("deadline_misses")
+                raise
+            except Exception:
+                # the outer except Exception never sees failures raised
+                # INSIDE this handler — count them here or health()
+                # undercounts degraded-path hard errors
+                self._bump("errors")
+                raise
+            self._bump("degraded_serves")
+            return out
+        except resilience.ServerOverloadedError:
+            raise                     # counted where the slot was denied
+        except Exception:
+            self._bump("errors")
+            raise
 
 
 def load_serving_artifact(dirname, max_in_flight=None, deadline_s=None):

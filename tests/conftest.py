@@ -15,17 +15,23 @@ import jax  # noqa: E402
 # override via config so tests always get the 8-device virtual CPU mesh.
 jax.config.update("jax_platforms", "cpu")
 
-# persistent XLA compile cache: the suite's wall time is dominated by
-# CPU compiles on this 1-core box; repeat runs (driver gate, judge
-# re-run) hit the cache instead of recompiling every step function.
-try:
-    jax.config.update(
-        "jax_compilation_cache_dir",
-        os.environ.get("PADDLE_TPU_TEST_COMPILE_CACHE",
-                       "/tmp/paddle_tpu_test_jax_cache"))
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-except Exception:  # pragma: no cover - older jax without the knob
-    pass
+# NO persistent XLA compile cache. It looks like an easy wall-time win
+# (this box recompiles every step function each run), but on jax 0.4.37
+# a DESERIALIZED multi-device CPU executable is broken: a cache hit on
+# any of the 8-virtual-device SPMD step functions returns garbage
+# fetches and then segfaults the interpreter at materialization,
+# killing the rest of the suite (reproduce: populate a cache dir with
+# jax_persistent_cache_min_compile_time_secs=0, run any dp/mp test
+# twice). Correctness of the gate beats repeat-run speed; re-enable
+# only behind a jax version check once serialized CPU collectives work.
+if os.environ.get("PADDLE_TPU_TEST_COMPILE_CACHE"):   # opt-in escape hatch
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          os.environ["PADDLE_TPU_TEST_COMPILE_CACHE"])
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          0.5)
+    except Exception:  # pragma: no cover - older jax without the knob
+        pass
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
@@ -39,6 +45,10 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers",
         "faultinject: fast chaos tests driven by framework.resilience")
+    config.addinivalue_line(
+        "markers",
+        "pod: pod-level coordinated-recovery tests (threaded "
+        "LocalCoordinator only — tier-1-safe)")
 
 
 @pytest.fixture(autouse=True)

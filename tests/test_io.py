@@ -660,6 +660,132 @@ def test_async_checkpoint_failure_raises_exactly_once(tmp_path):
                                    np.arange(4, dtype=np.float32) * 3)
 
 
+# ---------------------------------------------------------------------------
+# scrub_checkpoint: cheap supervisor-side validation (pod-recovery PR)
+# ---------------------------------------------------------------------------
+
+def _forbid_payload_reads(monkeypatch):
+    """Any NpzFile payload read during the block under test is a hard
+    failure: the scrub must classify from manifest JSON and npz member
+    lists (the zip central directory) alone."""
+    def boom(self, key):
+        raise AssertionError(
+            "scrub_checkpoint read shard payload %r — it must stay on "
+            "manifests and npz member lists" % key)
+    monkeypatch.setattr(np.lib.npyio.NpzFile, "__getitem__", boom)
+
+
+def test_scrub_checkpoint_classifies_without_payload_reads(tmp_path,
+                                                           monkeypatch):
+    """Acceptance: every step dir is classified valid / corrupt /
+    incomplete with zero shard-payload loads, and valid_steps agrees
+    with what load_checkpoint could actually restore."""
+    import shutil
+    from paddle_tpu.io import scrub_checkpoint
+    d = _two_step_ckpt_dir(tmp_path)           # step_1, step_2: valid
+    # step_3: shards landed, manifest never did — a torn/in-flight save
+    os.makedirs(os.path.join(d, "step_3"))
+    shutil.copy(os.path.join(d, "step_1", "shards_p0.npz"),
+                os.path.join(d, "step_3", "shards_p0.npz"))
+    # step_4: manifest committed but its shard file is gone — corrupt
+    shutil.copytree(os.path.join(d, "step_2"), os.path.join(d, "step_4"))
+    os.unlink(os.path.join(d, "step_4", "shards_p0.npz"))
+    # step_5: an empty dir — the save died before any bytes
+    os.makedirs(os.path.join(d, "step_5"))
+    # a previously-quarantined dir is reported, never reclassified
+    shutil.copytree(os.path.join(d, "step_2"),
+                    os.path.join(d, "step_9.corrupt"))
+
+    _forbid_payload_reads(monkeypatch)
+    report = scrub_checkpoint(d)
+    assert report["dirname"] == d
+    assert report["latest"] == "step_2"
+    assert report["valid_steps"] == [1, 2]
+    statuses = {s: v["status"] for s, v in report["steps"].items()}
+    assert statuses == {1: "valid", 2: "valid", 3: "incomplete",
+                        4: "corrupt", 5: "incomplete"}
+    assert "no manifest" in report["steps"][3]["reason"]
+    assert "shard file" in report["steps"][4]["reason"]
+    assert report["quarantined"] == ["step_9.corrupt"]
+    # read-only: the scrub never renamed/quarantined anything itself
+    assert sorted(x for x in os.listdir(d) if x.startswith("step_")) == [
+        "step_1", "step_2", "step_3", "step_4", "step_5",
+        "step_9.corrupt"]
+    # observability: one structured event with the tallies
+    from paddle_tpu.framework import resilience
+    ev = resilience.events("scrub")[-1]
+    assert (ev["valid"], ev["corrupt"], ev["incomplete"]) == (2, 1, 2)
+
+
+def test_scrub_checkpoint_corrupt_manifest_and_missing_keys(tmp_path,
+                                                            monkeypatch):
+    import json as json_mod
+    import shutil
+    from paddle_tpu.io import scrub_checkpoint
+    d = _two_step_ckpt_dir(tmp_path)
+    # torn manifest (truncated JSON)
+    with open(os.path.join(d, "step_1", "manifest.json"), "w") as f:
+        f.write('{"vars": {"w_q"')
+    # manifest references a key the shard npz does not hold
+    mpath = os.path.join(d, "step_2", "manifest.json")
+    with open(mpath) as f:
+        manifest = json_mod.load(f)
+    next(iter(manifest["vars"].values()))["shards"][0]["key"] = "ghost"
+    with open(mpath, "w") as f:
+        json_mod.dump(manifest, f)
+    _forbid_payload_reads(monkeypatch)
+    report = scrub_checkpoint(d)
+    assert report["valid_steps"] == []
+    assert report["steps"][1]["status"] == "corrupt"
+    assert "manifest" in report["steps"][1]["reason"]
+    assert report["steps"][2]["status"] == "corrupt"
+    assert "missing keys" in report["steps"][2]["reason"]
+
+
+def test_scrub_checkpoint_newer_format_is_valid_but_not_restorable(
+        tmp_path, monkeypatch):
+    """A healthy checkpoint from a NEWER library is 'valid' (never a
+    quarantine candidate) but excluded from valid_steps — THIS library
+    cannot restore it, so the pod must not elect it."""
+    import json as json_mod
+    from paddle_tpu.io import scrub_checkpoint
+    d = _two_step_ckpt_dir(tmp_path)
+    mpath = os.path.join(d, "step_2", "manifest.json")
+    with open(mpath) as f:
+        manifest = json_mod.load(f)
+    manifest["format_version"] = 999
+    with open(mpath, "w") as f:
+        json_mod.dump(manifest, f)
+    _forbid_payload_reads(monkeypatch)
+    report = scrub_checkpoint(d)
+    assert report["steps"][2]["status"] == "valid"
+    assert "newer" in report["steps"][2]["reason"]
+    assert report["valid_steps"] == [1]
+
+
+def test_scrub_checkpoint_missing_dir_is_empty_report(tmp_path):
+    from paddle_tpu.io import scrub_checkpoint
+    report = scrub_checkpoint(str(tmp_path / "never_written"))
+    assert report["valid_steps"] == [] and report["steps"] == {}
+    assert report["latest"] is None
+
+
+def test_scrub_agrees_with_load_checkpoint_quarantine(tmp_path):
+    """The supervisor's scrub and load_checkpoint's quarantine run the
+    SAME classifier: what the scrub calls restorable, the load restores;
+    what it flags, the load quarantines."""
+    from paddle_tpu.framework.scope import Scope, scope_guard
+    from paddle_tpu.io import load_checkpoint, scrub_checkpoint
+    d = _two_step_ckpt_dir(tmp_path)
+    os.unlink(os.path.join(d, "step_2", "shards_p0.npz"))
+    report = scrub_checkpoint(d)
+    assert report["valid_steps"] == [1]
+    assert report["steps"][2]["status"] == "corrupt"
+    with scope_guard(Scope()):
+        assert load_checkpoint(None, d) == max(report["valid_steps"])
+    assert os.path.isdir(os.path.join(d, "step_2.corrupt"))
+
+
 def test_py_func_skip_vars_rejected():
     import pytest
     from paddle_tpu import layers

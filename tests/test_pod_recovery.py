@@ -1,0 +1,556 @@
+"""Pod recovery chaos battery: consensus restores across N simulated
+hosts (framework/coordination.py).
+
+All hosts live in ONE process on a LocalCoordinator (threads) — the
+exact consensus/fencing protocol of the file-based multi-process
+coordinator, minus the processes — so the battery is tier-1 fast and
+deterministic. The acceptance scenario: kill 1 of 4 hosts mid-step and
+the pod rewinds to the quorum-elected step and replays to a trajectory
+bitwise-identical to a fault-free run."""
+import contextlib
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.io as io_mod
+from paddle_tpu import layers, optimizer
+from paddle_tpu.framework import resilience
+from paddle_tpu.framework.coordination import (
+    BarrierTimeoutError, CoordinationError, FileCoordinator,
+    HostLostError, LocalCoordinator, NoQuorumError, PodResilientTrainer)
+from paddle_tpu.framework.resilience import (ResilientTrainer,
+                                             RestartBudgetExceededError,
+                                             RetryPolicy)
+from paddle_tpu.framework.scope import Scope, scope_guard
+
+pytestmark = [pytest.mark.faultinject, pytest.mark.pod]
+
+# generous collective timeout: first windows carry jit compiles on a
+# slow CI box; loss detection is tested with explicit tiny timeouts
+POD_TIMEOUT_S = 300.0
+
+
+@pytest.fixture(autouse=True)
+def _clean_resilience():
+    resilience.install(None)
+    resilience.clear_events()
+    yield
+    resilience.install(None)
+    resilience.clear_events()
+
+
+def _fast_policy(**kw):
+    kw.setdefault("base_delay_s", 0.0)
+    kw.setdefault("jitter", 0.0)
+    kw.setdefault("sleep", lambda s: None)
+    return RetryPolicy(**kw)
+
+
+# ---------------------------------------------------------------------------
+# coordinator unit battery (no jax involved)
+# ---------------------------------------------------------------------------
+
+def _run_hosts(fn, n):
+    """Run fn(host_id) on n threads; returns ({hid: result}, {hid: exc})."""
+    out, errs = {}, {}
+
+    def worker(hid):
+        try:
+            out[hid] = fn(hid)
+        except Exception as e:
+            errs[hid] = e
+
+    ts = [threading.Thread(target=worker, args=(i,)) for i in range(n)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    return out, errs
+
+
+def test_local_coordinator_gather_barrier_and_round_cleanup():
+    co = LocalCoordinator(3, timeout_s=5.0)
+    out, errs = _run_hosts(lambda h: co.all_gather("g1", h, h * 10), 3)
+    assert not errs
+    assert out[0] == out[1] == out[2] == {0: 0, 1: 10, 2: 20}
+    assert co._rounds == {}          # last one out cleaned the round
+    out, errs = _run_hosts(lambda h: co.barrier("b1", h), 3)
+    assert not errs and out[0] == [0, 1, 2]
+    assert co.live_hosts() == [0, 1, 2] and co.lost_hosts() == {}
+
+
+def test_local_coordinator_elect_consensus_and_quorum():
+    co = LocalCoordinator(3, timeout_s=5.0, mesh_reinit=False)
+    valid = {0: [0, 3, 6], 1: [0, 3], 2: [0, 3, 6]}
+    out, errs = _run_hosts(
+        lambda h: co.elect_restore_step(h, valid[h], name="r1"), 3)
+    assert not errs
+    # step 6 is missing on host 1: the pod can only agree on 3
+    assert out == {0: 3, 1: 3, 2: 3}
+    # relaxed quorum (shared-filesystem mode): 2 of 3 suffices for 6
+    out, errs = _run_hosts(
+        lambda h: co.elect_restore_step(h, valid[h], name="r2",
+                                        quorum=2), 3)
+    assert not errs and out == {0: 6, 1: 6, 2: 6}
+    assert resilience.events("consensus")
+    # nothing in common -> NoQuorumError everywhere
+    disjoint = {0: [1], 1: [2], 2: []}
+    out, errs = _run_hosts(
+        lambda h: co.elect_restore_step(h, disjoint[h], name="r3"), 3)
+    assert len(errs) == 3
+    assert all(isinstance(e, NoQuorumError) for e in errs.values())
+
+
+def test_local_coordinator_detects_lost_host_and_reinits_mesh():
+    """A host that never reaches the barrier is marked LOST at the
+    timeout: survivors get the partial gather, the mesh is rebuilt over
+    the surviving fraction, reinit hooks fire, and the lost host is
+    fenced (HostLostError) if it ever calls back in."""
+    from paddle_tpu.distributed import mesh as mesh_mod
+    mesh_mod.init_mesh({"dp": 4})
+    old_mesh = mesh_mod.get_mesh()
+    hook_calls = []
+    try:
+        mesh_mod.add_reinit_hook(
+            lambda lost, live, mesh: hook_calls.append((lost, live)))
+        co = LocalCoordinator(3, timeout_s=0.3)
+        # hosts 0 and 1 show up; host 2 is dead
+        out, errs = _run_hosts(
+            lambda h: co.all_gather("g", h, h) if h < 2 else None, 3)
+        assert not errs
+        assert out[0] == out[1] == {0: 0, 1: 1}
+        assert co.lost_hosts() == {2: "missed round 'g'"}
+        assert co.live_hosts() == [0, 1]
+        lost_ev = resilience.events("host_lost")
+        assert lost_ev and lost_ev[-1]["hosts"] == [2]
+        # mesh rebuilt over the survivor fraction: dp 4 -> 4*2//3 = 2
+        assert resilience.events("mesh_reinit")
+        new_mesh = mesh_mod.get_mesh()
+        assert new_mesh is not old_mesh and new_mesh.shape["dp"] == 2
+        assert hook_calls == [([2], [0, 1])]
+        # fencing: the lost host must rejoin, not resume
+        with pytest.raises(HostLostError, match="fenced"):
+            co.all_gather("g2", 2, None)
+        # survivors carry on without it
+        out, errs = _run_hosts(
+            lambda h: co.barrier("after", h) if h < 2 else None, 3)
+        assert not errs and out[0] == [0, 1]
+    finally:
+        mesh_mod.clear_reinit_hooks()
+        mesh_mod.reset_mesh()
+
+
+def test_mesh_sequential_host_losses_do_not_compound():
+    """lost_hosts is cumulative: the dp axis must scale from the
+    ORIGINAL topology each time, not shrink the already-shrunk axes
+    (4 hosts losing 2 one at a time must land on dp=2, not dp=1)."""
+    from paddle_tpu.distributed import mesh as mesh_mod
+    mesh_mod.init_mesh({"dp": 4})
+    try:
+        mesh_mod.handle_host_loss([0], [1, 2, 3])
+        assert mesh_mod.get_mesh().shape["dp"] == 3
+        mesh_mod.handle_host_loss([0, 1], [2, 3])
+        assert mesh_mod.get_mesh().shape["dp"] == 2
+    finally:
+        mesh_mod.reset_mesh()
+
+
+def test_local_coordinator_timeout_without_detection_raises():
+    co = LocalCoordinator(2, timeout_s=0.2, detect_loss=False)
+    with pytest.raises(BarrierTimeoutError, match="timed out"):
+        co.all_gather("never", 0, None)
+    assert co.lost_hosts() == {}       # nobody was fenced
+
+
+def test_local_coordinator_duplicate_contribution_rejected():
+    """Two participants claiming the same host id in one live round is a
+    protocol bug (split brain) — fail loudly, don't overwrite."""
+    co = LocalCoordinator(2, timeout_s=10.0)
+    box = {}
+    t = threading.Thread(
+        target=lambda: box.update(got=co.all_gather("r", 0, "first")))
+    t.start()
+    for _ in range(500):                    # wait for host 0's arrival
+        if co._rounds.get("r", {}).get("values"):
+            break
+        time.sleep(0.005)
+    with pytest.raises(CoordinationError, match="already contributed"):
+        co.all_gather("r", 0, "imposter")
+    co.all_gather("r", 1, "second")         # completes the round
+    t.join(timeout=10)
+    assert box["got"] == {0: "first", 1: "second"}
+
+
+def test_file_coordinator_multi_object_round_trip(tmp_path):
+    """One FileCoordinator object per simulated PROCESS — no shared
+    python state; agreement flows through atomically-written files."""
+    root = str(tmp_path / "pod")
+    cos = [FileCoordinator(root, 3, timeout_s=10.0, poll_s=0.002,
+                           mesh_reinit=False) for _ in range(3)]
+    out, errs = _run_hosts(
+        lambda h: cos[h].all_gather("g1", h, {"host": h}), 3)
+    assert not errs
+    assert out[0] == out[1] == out[2] == {0: {"host": 0}, 1: {"host": 1},
+                                          2: {"host": 2}}
+    valid = {0: [0, 3, 6], 1: [0, 3], 2: [0, 3, 6]}
+    out, errs = _run_hosts(
+        lambda h: cos[h].elect_restore_step(h, valid[h], name="e1"), 3)
+    assert not errs and out == {0: 3, 1: 3, 2: 3}
+
+
+def test_file_coordinator_cleans_rounds_and_rejects_duplicates(tmp_path):
+    """The last reader removes a completed round dir (bounded disk over
+    a long job) — and a second contribution under a LIVE round name is
+    the same split-brain protocol error LocalCoordinator raises."""
+    root = str(tmp_path / "pod")
+    cos = [FileCoordinator(root, 2, timeout_s=10.0, poll_s=0.002,
+                           mesh_reinit=False) for _ in range(2)]
+    out, errs = _run_hosts(lambda h: cos[h].all_gather("g", h, h), 2)
+    assert not errs
+    rounds_dir = os.path.join(root, "rounds")
+    assert os.listdir(rounds_dir) == []      # last one out cleaned up
+    # a cleaned-up name is reusable (the PodResilientTrainer run_tag
+    # namespacing makes this moot in practice, but the invariant is
+    # "unique per LIVE round", not unique forever)
+    out, errs = _run_hosts(lambda h: cos[h].all_gather("g", h, 10 + h), 2)
+    assert not errs and out[0] == {0: 10, 1: 11}
+    # duplicate contribution to a live round: loud failure, no overwrite
+    box = {}
+    t = threading.Thread(
+        target=lambda: box.update(got=cos[0].all_gather("dup", 0, "real")))
+    t.start()
+    rd = os.path.join(rounds_dir, "dup")
+    for _ in range(500):
+        if os.path.exists(os.path.join(rd, "host_0.json")):
+            break
+        time.sleep(0.005)
+    with pytest.raises(CoordinationError, match="already contributed"):
+        cos[0].all_gather("dup", 0, "imposter")
+    cos[1].all_gather("dup", 1, "second")
+    t.join(timeout=10)
+    assert box["got"] == {0: "real", 1: "second"}
+
+
+def test_file_coordinator_detects_lost_host_via_tombstone(tmp_path):
+    root = str(tmp_path / "pod")
+    cos = [FileCoordinator(root, 3, timeout_s=0.4, poll_s=0.002,
+                           mesh_reinit=False) for _ in range(3)]
+    hook_fired = {0: [], 1: [], 2: []}
+    for h, co in enumerate(cos):
+        co.add_host_loss_hook(
+            lambda lost, live, h=h: hook_fired[h].append(lost))
+    out, errs = _run_hosts(
+        lambda h: cos[h].all_gather("g", h, h) if h < 2 else None, 3)
+    assert not errs
+    assert out[0] == out[1] == {0: 0, 1: 1}
+    # the tombstone is visible to EVERY process-coordinator object
+    for co in cos:
+        assert 2 in co.lost_hosts()
+    # and BOTH survivors reacted — whichever one won the race to write
+    # the tombstone, the other must still fire its own loss hooks
+    # (mesh re-init is per-process state), exactly once each
+    assert hook_fired[0] == [[2]] and hook_fired[1] == [[2]]
+    with pytest.raises(HostLostError, match="fenced"):
+        cos[2].all_gather("g2", 2, None)
+    # later rounds don't re-fire for an already-known loss
+    out, errs = _run_hosts(
+        lambda h: cos[h].all_gather("g3", h, h) if h < 2 else None, 3)
+    assert not errs
+    assert hook_fired[0] == [[2]] and hook_fired[1] == [[2]]
+
+
+def test_pod_host_id_mode_single_trainer_per_coordinator(tmp_path):
+    """Production shape: one PodResilientTrainer per 'process', each
+    holding only ITS host's trainer + host_id, meeting on a shared
+    FileCoordinator. A preemption on either host still rewinds BOTH to
+    the consensus step and the pod converges bitwise to the fault-free
+    run."""
+    main, startup, loss = _toy_program()
+    feeds = _toy_feeds(6)
+
+    def one_host(tag, coordinator, hid):
+        sc, exe = Scope(), pt.Executor()
+        with scope_guard(sc):
+            exe.run(startup)
+        trainer = ResilientTrainer(
+            exe, main, str(tmp_path / tag / ("h%d" % hid)),
+            fetch_list=[loss], checkpoint_every=3, scope=sc,
+            retry_policy=_fast_policy())
+        pod = PodResilientTrainer([trainer], coordinator, host_id=hid)
+        return pod, trainer
+
+    def run_pod(tag, inject_spec=None):
+        root = str(tmp_path / tag / "coord")
+        cos = [FileCoordinator(root, 2, timeout_s=POD_TIMEOUT_S,
+                               poll_s=0.002, mesh_reinit=False)
+               for _ in range(2)]
+        pods = [one_host(tag, cos[h], h) for h in range(2)]
+        ctx = resilience.inject(inject_spec) if inject_spec \
+            else contextlib.nullcontext()
+        with ctx:
+            out, errs = _run_hosts(
+                lambda h: pods[h][0].run(feeds), 2)
+        assert not errs, errs
+        return out, [p[1]._scope.get_numpy("pod_w").copy() for p in pods]
+
+    ref_out, ref_w = run_pod("ref")
+    got_out, got_w = run_pod("chaos", "step:preempt@5")
+    for a, b in zip(ref_w, got_w):
+        np.testing.assert_array_equal(a, b)
+    np.testing.assert_array_equal(np.asarray([ref_out[0], ref_out[1]]),
+                                  np.asarray([got_out[0], got_out[1]]))
+    assert resilience.events("pod_restore")   # a real rewind happened
+
+    # misuse is loud: host_id mode takes exactly one trainer, in range
+    co = LocalCoordinator(2)
+    t = one_host("misuse", FileCoordinator(
+        str(tmp_path / "m"), 2, mesh_reinit=False), 0)[1]
+    with pytest.raises(ValueError, match="out of range"):
+        PodResilientTrainer([t], co, host_id=5)
+
+
+def test_pod_rejects_keep_last_below_two(tmp_path):
+    """keep_last=1 lets the ok hosts prune the last checkpoint every
+    live host shares, turning a recoverable transient into a NoQuorum
+    cold start — the pod refuses the configuration up front."""
+    main, startup, loss = _toy_program()
+    sc, exe = Scope(), pt.Executor()
+    with scope_guard(sc):
+        exe.run(startup)
+    t = ResilientTrainer(exe, main, str(tmp_path / "h0"),
+                         fetch_list=[loss], scope=sc, keep_last=1)
+    with pytest.raises(ValueError, match="keep_last >= 2"):
+        PodResilientTrainer([t], LocalCoordinator(1))
+
+
+# ---------------------------------------------------------------------------
+# pod training chaos battery
+# ---------------------------------------------------------------------------
+
+def _toy_program():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [4], dtype="float32")
+        y = layers.data("y", [1], dtype="float32")
+        pred = layers.fc(x, size=1, param_attr=pt.ParamAttr(name="pod_w"),
+                         bias_attr=pt.ParamAttr(name="pod_b"))
+        loss = layers.reduce_mean(layers.square(pred - y))
+        optimizer.Adam(0.05).minimize(loss)
+    return main, startup, loss
+
+
+def _toy_feeds(n, seed=0, batch=4):
+    rng = np.random.RandomState(seed)
+    w = rng.randn(4, 1).astype(np.float32)
+    out = []
+    for _ in range(n):
+        xv = rng.randn(batch, 4).astype(np.float32)
+        out.append({"x": xv, "y": (xv @ w).astype(np.float32)})
+    return out
+
+
+def _make_pod(tmp_path, tag, n_hosts=4, checkpoint_every=3, **trainer_kw):
+    """N simulated hosts: same program, per-host Scope/Executor/ckpt dir
+    (initialized identically — the replicated-data-parallel shape)."""
+    main, startup, loss = _toy_program()
+    trainers = []
+    for h in range(n_hosts):
+        sc, exe = Scope(), pt.Executor()
+        with scope_guard(sc):
+            exe.run(startup)
+        trainers.append(ResilientTrainer(
+            exe, main, str(tmp_path / tag / ("h%d" % h)),
+            fetch_list=[loss], checkpoint_every=checkpoint_every,
+            scope=sc, retry_policy=_fast_policy(), **trainer_kw))
+    pod = PodResilientTrainer(
+        trainers, LocalCoordinator(n_hosts, timeout_s=POD_TIMEOUT_S))
+    return pod, trainers, loss
+
+
+def _pod_params(trainers, name="pod_w"):
+    return [t._scope.get_numpy(name).copy() for t in trainers]
+
+
+class _ScrubPayloadGuard(object):
+    """Test instrumentation: while ANY thread is inside
+    io.scrub_checkpoint, a shard-payload read (NpzFile.__getitem__) is a
+    violation — the scrub must classify from manifests and npz member
+    lists alone."""
+
+    def __init__(self, monkeypatch):
+        self.inside = 0
+        self.violations = []
+        self.scrubs = 0
+        self._lock = threading.Lock()
+        real_scrub = io_mod.scrub_checkpoint
+        real_getitem = np.lib.npyio.NpzFile.__getitem__
+        guard = self
+
+        def counted_scrub(dirname):
+            with guard._lock:
+                guard.inside += 1
+                guard.scrubs += 1
+            try:
+                return real_scrub(dirname)
+            finally:
+                with guard._lock:
+                    guard.inside -= 1
+
+        def guarded_getitem(npz_self, key):
+            if guard.inside:
+                guard.violations.append(key)
+            return real_getitem(npz_self, key)
+
+        monkeypatch.setattr(io_mod, "scrub_checkpoint", counted_scrub)
+        monkeypatch.setattr(np.lib.npyio.NpzFile, "__getitem__",
+                            guarded_getitem)
+
+
+def test_pod_preempt_consensus_restore_bitwise_identical(tmp_path,
+                                                         monkeypatch):
+    """THE acceptance scenario: inject('step:preempt@7') kills one of 4
+    simulated hosts mid-step; the pod elects the quorum-validated step,
+    EVERY host restores it, and the final parameters are bitwise
+    identical to a fault-free run — with zero shard-payload loads during
+    the scrub phase."""
+    ref_pod, ref_trainers, _ = _make_pod(tmp_path, "ref")
+    feeds = _toy_feeds(12)
+    ref_fetches = ref_pod.run(feeds)
+    ref_w = _pod_params(ref_trainers)
+
+    guard = _ScrubPayloadGuard(monkeypatch)
+    chaos_pod, chaos_trainers, _ = _make_pod(tmp_path, "chaos")
+    with resilience.inject("step:preempt@7"):
+        got_fetches = chaos_pod.run(feeds)
+    got_w = _pod_params(chaos_trainers)
+
+    for a, b in zip(ref_w, got_w):
+        np.testing.assert_array_equal(a, b)
+    np.testing.assert_array_equal(np.asarray(ref_fetches),
+                                  np.asarray(got_fetches))
+    # exactly one injected fault; every host restored the SAME
+    # quorum-elected step (fire 7 lands in window 2, before the first
+    # periodic checkpoint at step 3 -> the agreed step is the baseline 0)
+    assert len(resilience.events("fault")) == 1
+    restores = resilience.events("pod_restore")
+    assert sorted(e["host"] for e in restores) == [0, 1, 2, 3]
+    assert {e["step"] for e in restores} == {0}
+    consensus = resilience.events("consensus")
+    assert consensus and {e["step"] for e in consensus} == {0}
+    # scrub phase ran on every host and never touched a shard payload
+    assert guard.scrubs == 4
+    assert guard.violations == []
+
+
+def test_pod_late_fault_restores_latest_common_checkpoint(tmp_path):
+    """A fault after the step-3 checkpoints elects 3, not 0 — the
+    consensus really is the max common validated step."""
+    ref_pod, ref_trainers, _ = _make_pod(tmp_path, "ref")
+    feeds = _toy_feeds(9)
+    ref_fetches = ref_pod.run(feeds)
+    ref_w = _pod_params(ref_trainers)
+
+    chaos_pod, chaos_trainers, _ = _make_pod(tmp_path, "chaos")
+    # 4 hosts x windows of 1 step: fires 13..16 are window 4 (steps 3->4)
+    with resilience.inject("step:preempt@14"):
+        got_fetches = chaos_pod.run(feeds)
+    for a, b in zip(ref_w, _pod_params(chaos_trainers)):
+        np.testing.assert_array_equal(a, b)
+    np.testing.assert_array_equal(np.asarray(ref_fetches),
+                                  np.asarray(got_fetches))
+    assert {e["step"] for e in resilience.events("pod_restore")} == {3}
+
+
+def test_pod_torn_checkpoint_lowers_consensus(tmp_path):
+    """An injected I/O fault tears ONE host's step-3 save (shards on
+    disk, no manifest). Its scrub reports the dir incomplete, so the pod
+    can only agree on step 0 — and still converges bitwise."""
+    ref_pod, ref_trainers, _ = _make_pod(tmp_path, "ref")
+    feeds = _toy_feeds(6)
+    ref_fetches = ref_pod.run(feeds)
+    ref_w = _pod_params(ref_trainers)
+
+    chaos_pod, chaos_trainers, _ = _make_pod(tmp_path, "chaos")
+    # ckpt_write fires 1-4 are the per-host step-0 baselines; 5-8 the
+    # step-3 saves -> @6 tears the second host to reach its save
+    with resilience.inject("ckpt_write:io_error@6"):
+        got_fetches = chaos_pod.run(feeds)
+    for a, b in zip(ref_w, _pod_params(chaos_trainers)):
+        np.testing.assert_array_equal(a, b)
+    np.testing.assert_array_equal(np.asarray(ref_fetches),
+                                  np.asarray(got_fetches))
+    assert {e["step"] for e in resilience.events("pod_restore")} == {0}
+    assert {e["step"] for e in resilience.events("consensus")} == {0}
+
+
+def test_pod_per_host_feeds_diverge_and_recover(tmp_path):
+    """Per-host data streams (the non-replicated shape): hosts end with
+    DIFFERENT params, and a fault still replays each host bitwise."""
+    n_hosts, feeds = 2, [_toy_feeds(6, seed=s) for s in (1, 2)]
+    ref_pod, ref_trainers, _ = _make_pod(tmp_path, "ref",
+                                         n_hosts=n_hosts)
+    ref_pod.run(feeds)
+    ref_w = _pod_params(ref_trainers)
+    assert not np.array_equal(ref_w[0], ref_w[1])
+
+    chaos_pod, chaos_trainers, _ = _make_pod(tmp_path, "chaos",
+                                             n_hosts=n_hosts)
+    with resilience.inject("step:preempt@5"):
+        chaos_pod.run(feeds)
+    for a, b in zip(ref_w, _pod_params(chaos_trainers)):
+        np.testing.assert_array_equal(a, b)
+    assert resilience.events("pod_restore")
+
+
+def test_pod_fatal_error_aborts_every_host(tmp_path):
+    """A program-shape bug on ONE host replays identically — the whole
+    pod must abort (fatal), never burn the shared restart budget."""
+    n_hosts = 2
+    feeds = [_toy_feeds(4), _toy_feeds(4)]
+    feeds[1][2]["x"] = np.zeros((4, 4, 9), np.float32)   # wrong rank
+    pod, trainers, _ = _make_pod(tmp_path, "fatal", n_hosts=n_hosts)
+    with pytest.raises(ValueError, match="rank"):
+        pod.run(feeds)
+    assert resilience.events("pod_restore") == []
+    assert resilience.events("fatal")
+
+
+def test_pod_shared_restart_budget_exhausts_together(tmp_path):
+    """Chaos on every dispatch: the SHARED budget runs out and the whole
+    pod raises RestartBudgetExceededError in the same round."""
+    pod, trainers, _ = _make_pod(tmp_path, "budget", n_hosts=2)
+    pod._max_restarts = 2
+    with resilience.inject("step:preempt~1.0"):
+        with pytest.raises(RestartBudgetExceededError,
+                           match="pod restart budget"):
+            pod.run(_toy_feeds(4))
+    # budget counters advanced in lockstep: 2 pod_restart rounds x 2 hosts
+    assert len(resilience.events("pod_restart")) == 4
+    assert len(resilience.events("giveup")) == 2
+
+
+def test_pod_empty_feeds_returns_empty_per_host(tmp_path):
+    """run([]) mirrors ResilientTrainer.run([]) — empty per-host fetch
+    lists, not a misleading per-host-feeds shape error."""
+    pod, trainers, _ = _make_pod(tmp_path, "empty", n_hosts=2)
+    assert pod.run([]) == [[], []]
+
+
+def test_pod_rejects_mismatched_trainer_config(tmp_path):
+    main, startup, loss = _toy_program()
+    trainers = []
+    for h, every in enumerate((2, 3)):
+        sc, exe = Scope(), pt.Executor()
+        with scope_guard(sc):
+            exe.run(startup)
+        trainers.append(ResilientTrainer(
+            exe, main, str(tmp_path / ("h%d" % h)), fetch_list=[loss],
+            checkpoint_every=every, scope=sc))
+    with pytest.raises(ValueError, match="checkpoint_every"):
+        PodResilientTrainer(trainers)
+    with pytest.raises(ValueError, match="expects 2 hosts"):
+        PodResilientTrainer([trainers[0]], LocalCoordinator(2))

@@ -144,6 +144,83 @@ def test_event_log_bounded_filtered_cleared():
 
 
 # ---------------------------------------------------------------------------
+# metrics export (pod-recovery PR satellite)
+# ---------------------------------------------------------------------------
+
+def test_metrics_counters_and_histogram_round_trip():
+    """Acceptance: the event log aggregates into Prometheus-style
+    counters + histograms, renders to the text exposition format, and
+    parses back to the same samples."""
+    resilience.record_event("fault", point="step", fault="preempt")
+    resilience.record_event("fault", point="step", fault="preempt")
+    resilience.record_event("fault", point="serve", fault="slow")
+    resilience.record_event("shed", in_flight=4, cap=4)
+    resilience.record_event("restore", step=3, latency_s=0.2)
+    resilience.record_event("restore", step=6, latency_s=40.0)
+
+    m = resilience.metrics()
+    c = {(s["name"], tuple(sorted(s["labels"].items()))): s["value"]
+         for s in m["counters"]}
+    pre = resilience.METRIC_PREFIX
+    assert c[(pre + "_events_total", (("kind", "fault"),))] == 3
+    assert c[(pre + "_events_total", (("kind", "shed"),))] == 1
+    assert c[(pre + "_events_total", (("kind", "restore"),))] == 2
+    assert c[(pre + "_faults_total",
+              (("fault", "preempt"), ("point", "step")))] == 2
+    assert c[(pre + "_faults_total",
+              (("fault", "slow"), ("point", "serve")))] == 1
+    (h,) = m["histograms"]
+    assert h["name"] == pre + "_restore_latency_seconds"
+    assert h["count"] == 2
+    assert h["sum"] == pytest.approx(40.2)
+    buckets = dict(h["buckets"])                    # cumulative
+    assert buckets["0.1"] == 0      # nothing restored under 100ms
+    assert buckets["0.5"] == 1      # the 0.2s restore
+    assert buckets["120"] == 2      # the 40s restore too
+    assert buckets["+Inf"] == 2
+
+    text = resilience.metrics_text(m)
+    assert "# TYPE %s_events_total counter" % pre in text
+    assert "# TYPE %s_restore_latency_seconds histogram" % pre in text
+    parsed = {(n, tuple(sorted(l.items()))): v
+              for n, l, v in resilience.parse_metrics_text(text)}
+    # every counter survives the text round trip...
+    for s in m["counters"]:
+        key = (s["name"], tuple(sorted(s["labels"].items())))
+        assert parsed[key] == float(s["value"])
+    # ...and so do the histogram's buckets, sum and count
+    for le, cnt in h["buckets"]:
+        assert parsed[(h["name"] + "_bucket", (("le", le),))] == cnt
+    assert parsed[(h["name"] + "_sum", ())] == pytest.approx(h["sum"])
+    assert parsed[(h["name"] + "_count", ())] == h["count"]
+    assert len(parsed) == len(m["counters"]) + len(h["buckets"]) + 2
+
+
+def test_metrics_aggregate_live_injected_faults():
+    """End to end: a REAL injected fault lands in the exposition with
+    its point/kind labels — what a scraper sidecar would serve."""
+    with resilience.inject("step:preempt@1"):
+        with pytest.raises(SimulatedPreemptionError):
+            resilience.fire("step")
+    samples = resilience.parse_metrics_text(resilience.metrics_text())
+    pre = resilience.METRIC_PREFIX
+    assert (pre + "_faults_total",
+            {"point": "step", "fault": "preempt"}, 1.0) in samples
+    assert (pre + "_events_total", {"kind": "fault"}, 1.0) in samples
+
+
+def test_metrics_on_snapshot_and_empty_log():
+    m = resilience.metrics([])                      # explicit snapshot
+    assert m["counters"] == []
+    (h,) = m["histograms"]
+    assert h["count"] == 0 and h["sum"] == 0.0
+    assert dict(h["buckets"])["+Inf"] == 0
+    resilience.parse_metrics_text(resilience.metrics_text(m))
+    with pytest.raises(ValueError, match="unparsable"):
+        resilience.parse_metrics_text("what even is this line")
+
+
+# ---------------------------------------------------------------------------
 # RetryPolicy
 # ---------------------------------------------------------------------------
 
@@ -400,6 +477,63 @@ def test_torn_checkpoint_write_recovers(tmp_path):
     assert resilience.events("restore")[-1]["step"] == 0
 
 
+def test_restore_joins_pending_async_saves_first(tmp_path, monkeypatch):
+    """Satellite bugfix regression: _restore must join an in-flight
+    blocking=False checkpoint commit BEFORE reading the directory — a
+    commit still writing while the restore picks its step could tear
+    the very dir being read."""
+    import paddle_tpu.io as io_mod
+    main, startup, loss = _toy_program()
+    exe = pt.Executor()
+    order = []
+    real_wait = io_mod.wait_for_pending_saves
+    real_load = io_mod.load_checkpoint
+    monkeypatch.setattr(io_mod, "wait_for_pending_saves",
+                        lambda: (order.append("wait"), real_wait())[1])
+    monkeypatch.setattr(
+        io_mod, "load_checkpoint",
+        lambda *a, **k: (order.append("load"), real_load(*a, **k))[1])
+    with scope_guard(Scope()):
+        exe.run(startup)
+        trainer = ResilientTrainer(exe, main, str(tmp_path),
+                                   fetch_list=[loss],
+                                   retry_policy=_fast_policy(),
+                                   async_checkpoints=True)
+        trainer.run(_toy_feeds(2))
+        del order[:]
+        assert trainer._restore() == 2
+    assert order[0] == "wait"          # joined before the load began
+    assert "load" in order and order.index("load") > 0
+
+
+def test_failed_async_commit_does_not_break_recovery(tmp_path):
+    """The async step-3 commit fails (torn: shards written, no
+    manifest) and a preemption hits BEFORE anything joins it. _restore
+    must swallow the stale commit error (recording ckpt_async_error),
+    fall back to the last durable checkpoint, and still replay to the
+    fault-free trajectory."""
+    main, startup, loss = _toy_program()
+    feeds = _toy_feeds(6)
+    exe = pt.Executor()
+    ref_fetches, ref_w = _train(exe, startup, main, str(tmp_path / "ref"),
+                                feeds, loss, checkpoint_every=3)
+    # ckpt_write fire 1 = the step-0 baseline; fire 2 = the async step-3
+    # commit. step fire 5 = step index 4: after the torn save launched,
+    # before any later save would have joined (and raised) it.
+    with resilience.inject("ckpt_write:io_error@2;step:preempt@5"):
+        got_fetches, got_w = _train(exe, startup, main,
+                                    str(tmp_path / "chaos"), feeds, loss,
+                                    checkpoint_every=3,
+                                    async_checkpoints=True)
+    np.testing.assert_array_equal(got_w, ref_w)
+    np.testing.assert_array_equal(np.asarray(got_fetches),
+                                  np.asarray(ref_fetches))
+    # the stale commit failure was recorded, not raised — and the
+    # restore fell back to the step-0 baseline (step_3 never committed)
+    assert resilience.events("ckpt_async_error")
+    assert resilience.events("restore")[-1]["step"] == 0
+
+
 def test_startup_program_does_not_consume_step_counter(tmp_path):
     main, startup, loss = _toy_program()
     exe = pt.Executor()
@@ -593,3 +727,121 @@ def test_serving_injected_hard_error_propagates(tmp_path):
             pred.run({"x": xv})
     out, = pred.run({"x": xv})
     np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# serving health snapshot + probe (pod-recovery PR satellites)
+# ---------------------------------------------------------------------------
+
+def test_serving_health_lifecycle_cold_to_ok(tmp_path):
+    """Acceptance: health() round-trips through its dict/JSON form and
+    tracks the replica lifecycle — cold (not ready) -> warm (ready) ->
+    counters advance with traffic."""
+    import json
+    pred, xv, ref = _export_predictor(tmp_path, batch_sizes=(1, 4),
+                                      max_in_flight=2)
+    h = pred.health()
+    assert h["live"] is True and h["ready"] is False
+    assert h["status"] == "cold"
+    assert h["buckets"] == [1, 4] and h["cold_buckets"] == [1, 4]
+    assert h["warm_buckets"] == []
+    assert (h["in_flight"], h["max_in_flight"]) == (0, 2)
+    assert h["requests"] == 0 and h["deadline_misses"] == 0
+    assert h == json.loads(json.dumps(h))      # JSON round trip, exact
+
+    pred.warmup()
+    h = pred.health()
+    assert h["ready"] is True and h["status"] == "ok"
+    assert h["warm_buckets"] == [1, 4] and h["cold_buckets"] == []
+
+    pred.run({"x": xv})
+    pred.run({"x": xv[:1]})
+    h = pred.health()
+    assert h["requests"] == 2
+    assert h["status"] == "ok" and h["errors"] == 0
+
+
+def test_serving_health_counts_degradation_and_misses(tmp_path):
+    """Deadline misses and warm-bucket fallbacks mark the replica
+    'degraded' (still ready — the rotation signal is the counters)."""
+    pred, xv, _ = _export_predictor(tmp_path, batch_sizes=(1, 4))
+    pred.warmup([4])                       # bucket 1 stays cold
+    with resilience.inject("serve:slow=2.0@1"):
+        pred.run({"x": xv[:1]}, deadline_s=0.5)   # degraded serve
+    h = pred.health()
+    assert h["deadline_misses"] == 1 and h["degraded_serves"] == 1
+    # bucket 1 is STILL cold (it was served from the warm 4-bucket)
+    assert h["status"] == "cold" and h["cold_buckets"] == [1]
+    pred.warmup()
+    h = pred.health()
+    assert h["status"] == "degraded" and h["ready"] is True
+
+
+def test_serving_health_counts_sheds_and_errors(tmp_path):
+    pred, xv, _ = _export_predictor(tmp_path, max_in_flight=1)
+    pred.warmup()
+    with resilience.inject("serve:slow=1.5@1"):
+        done = {}
+        t = threading.Thread(
+            target=lambda: done.update(out=pred.run({"x": xv},
+                                                    deadline_s=30.0)))
+        t.start()
+        for _ in range(500):
+            if pred.in_flight >= 1:
+                break
+            time.sleep(0.01)
+        with pytest.raises(ServerOverloadedError):
+            pred.run({"x": xv})
+        h = pred.health()
+        assert h["sheds"] == 1
+        assert h["status"] == "saturated" and h["ready"] is False
+        t.join(timeout=30)
+    assert "out" in done
+    with resilience.inject("serve:error@1"):
+        with pytest.raises(RuntimeError):
+            pred.run({"x": xv})
+    h = pred.health()
+    assert h["errors"] == 1 and h["status"] == "degraded"
+
+
+def _probe_module():
+    import importlib.util
+    path = os.path.join(os.path.dirname(__file__), os.pardir, "tools",
+                        "serving_probe.py")
+    spec = importlib.util.spec_from_file_location("serving_probe", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_serving_probe_tool_ready_and_broken(tmp_path, capsys):
+    """tools/serving_probe.py: exit 0 + health JSON on a ready replica,
+    exit 1 when not ready (cold buckets), exit 2 on a broken artifact."""
+    import json
+    _export_predictor(tmp_path)            # leaves the artifact on disk
+    probe = _probe_module()
+    assert probe.main([str(tmp_path), "--warmup"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["ready"] is True and out["status"] == "ok"
+    assert out["requests"] == 1            # the synthetic probe request
+
+    # without warmup the probe request only warms ONE bucket: not ready
+    assert probe.main([str(tmp_path)]) == 1
+    out = json.loads(capsys.readouterr().out)
+    assert out["status"] == "cold" and out["cold_buckets"]
+
+    assert probe.main([str(tmp_path / "nope")]) == 2
+    out = json.loads(capsys.readouterr().out)
+    assert out["live"] is False and out["status"] == "broken"
+
+    # --strict: ready-but-degraded fails (exit 1) where lax passes —
+    # the health snapshot is stubbed because reaching 'degraded' without
+    # raising needs a cold-bucket/warm-fallback race; the contract under
+    # test is the exit-code mapping
+    degraded = {"live": True, "ready": True, "status": "degraded"}
+    with pytest.MonkeyPatch.context() as mp:
+        mp.setattr(probe, "probe", lambda *a, **k: degraded)
+        assert probe.main(["whatever"]) == 0
+        capsys.readouterr()
+        assert probe.main(["whatever", "--strict"]) == 1
+        capsys.readouterr()

@@ -1,14 +1,21 @@
 """watchdog.wait_with_timeout coverage (resilience PR satellite):
 timeout path, device-error propagation, timeout_s=None passthrough, and
-pytree (non-array leaf) inputs."""
+pytree (non-array leaf) inputs — plus the straggler-detection EWMA
+(pod-recovery PR satellite): flag a slow step BEFORE it becomes a hard
+CollectiveTimeoutError."""
 import time
 
 import pytest
 
 import jax.numpy as jnp
 
-from paddle_tpu.framework import resilience
+from paddle_tpu.framework import resilience, watchdog
 from paddle_tpu.framework.watchdog import (CollectiveTimeoutError,
+                                           StragglerDetector,
+                                           disable_straggler_detection,
+                                           enable_straggler_detection,
+                                           observe_step_latency,
+                                           straggler_detector,
                                            wait_with_timeout)
 
 
@@ -65,3 +72,124 @@ def test_pytree_with_non_array_leaves():
 def test_returns_outputs_for_call_through_style():
     x = jnp.arange(4) * 2
     assert wait_with_timeout(x, 1.0) is x
+
+
+# ---------------------------------------------------------------------------
+# straggler detection (per-step latency EWMA)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(autouse=True)
+def _clean_straggler_state():
+    """The detector and the event log are process-global: isolate."""
+    disable_straggler_detection()
+    resilience.clear_events()
+    yield
+    disable_straggler_detection()
+    resilience.clear_events()
+
+
+def test_straggler_flagged_after_warmup_with_event():
+    det = StragglerDetector(alpha=0.5, k=3.0, warmup=3)
+    # warmup samples establish the baseline without ever flagging
+    for _ in range(3):
+        assert not det.observe(0.1)
+    assert det.count == 3 and det.ewma_s == pytest.approx(0.1)
+    # 10x the EWMA: well past k=3 — flagged, and the event carries the
+    # diagnosis (latency, baseline, ratio)
+    assert det.observe(1.0, what="unit step")
+    evs = resilience.events("straggler")
+    assert len(evs) == 1
+    ev = evs[-1]
+    assert ev["what"] == "unit step"
+    assert ev["latency_s"] == pytest.approx(1.0)
+    assert ev["ewma_s"] == pytest.approx(0.1)
+    assert ev["ratio"] == pytest.approx(10.0)
+
+
+def test_straggler_persistent_slowdown_recalibrates():
+    """Straggler samples still feed the EWMA: a host that becomes slow
+    and STAYS slow flags the transition, then stops paging — the new
+    latency is the new baseline."""
+    det = StragglerDetector(alpha=0.5, k=3.0, warmup=2)
+    for _ in range(4):
+        det.observe(0.1)
+    flags = [det.observe(1.0) for _ in range(6)]
+    assert flags[0] is True          # the transition
+    assert flags[-1] is False        # recalibrated: no flag storm
+    assert not any(flags[3:])
+
+
+def test_straggler_min_latency_floor_and_warmup_gate():
+    # microsecond jitter below the floor never flags, whatever the ratio
+    det = StragglerDetector(alpha=0.5, k=2.0, warmup=1,
+                            min_latency_s=0.5)
+    det.observe(1e-5)
+    assert not det.observe(1e-3)     # 100x the EWMA but under the floor
+    assert det.observe(1.0)          # past the floor AND past k*ewma
+    # warmup: the first sample can never flag (no baseline yet)
+    det2 = StragglerDetector(warmup=0)
+    assert not det2.observe(5.0)
+
+
+def test_straggler_constructor_validation():
+    with pytest.raises(ValueError, match="alpha"):
+        StragglerDetector(alpha=0.0)
+    with pytest.raises(ValueError, match="k must be > 1"):
+        StragglerDetector(k=1.0)
+
+
+def test_global_detector_enable_disable_and_observe():
+    assert straggler_detector() is None
+    assert observe_step_latency(99.0) is False     # disabled: no-op
+    det = enable_straggler_detection(alpha=0.5, k=3.0, warmup=1)
+    assert straggler_detector() is det
+    observe_step_latency(0.1)
+    assert observe_step_latency(5.0) is True
+    disable_straggler_detection()
+    assert straggler_detector() is None
+
+
+def test_executor_feeds_global_detector():
+    """Executor.run / run_steps report their dispatch latency to the
+    armed detector (the wiring, not the flagging, is under test)."""
+    import numpy as np
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("sd_x", [3], dtype="float32")
+        y = layers.fc(x, size=2)
+    exe = pt.Executor()
+    exe.run(startup)
+    det = enable_straggler_detection(warmup=1000)   # observe-only
+    xv = np.ones((2, 3), np.float32)
+    exe.run(main, feed={"sd_x": xv}, fetch_list=[y])
+    assert det.count == 1
+    stacked = {"sd_x": np.ones((4, 2, 3), np.float32)}
+    exe.run_steps(main, feed=stacked, fetch_list=[y])
+    assert det.count == 2
+
+
+def test_armed_wait_does_not_double_feed_detector():
+    """The compiled path's one-behind wait must NOT feed the detector:
+    Executor.run/run_steps already observe the full dispatch latency,
+    and the wait's near-zero sample would halve the EWMA baseline."""
+    det = enable_straggler_detection(warmup=1000)
+    wait_with_timeout([_SlowLeaf(0.01)], 5.0, what="armed wait")
+    with pytest.raises(CollectiveTimeoutError):
+        wait_with_timeout([_SlowLeaf(1.0)], 0.05)
+    assert det.count == 0
+
+
+def test_straggler_zero_baseline_never_flags_or_crashes():
+    """An all-zero warmup (clock granularity) must not make every later
+    positive sample a straggler — and must never divide by the zero
+    EWMA when recording the event."""
+    det = StragglerDetector(alpha=0.5, k=3.0, warmup=1)
+    det.observe(0.0)
+    det.observe(0.0)
+    assert not det.observe(0.1)      # no baseline ratio: not flagged
+    assert resilience.events("straggler") == []
+    for _ in range(8):               # a real baseline forms...
+        det.observe(0.1)
+    assert det.observe(10.0)         # ...and flagging works again
