@@ -30,6 +30,12 @@ def _ring_perm(n):
     return [(i, (i + 1) % n) for i in range(n)]
 
 
+# canonical jax-version compat shim (0.4.x has no lax.axis_size) lives
+# beside the collective kernels; ops never imports distributed at module
+# level, so this direction is cycle-free
+from ..ops.collective_ops import _axis_size  # noqa: E402
+
+
 def _block_logits(q, kk, my_idx, kv_idx, scale, causal, mm=None):
     """Scaled (and causally masked) logits of the local Q shard against a
     visiting K block. `mm` is the visiting ADDITIVE key-padding mask block
@@ -52,7 +58,7 @@ def _ring_forward(q, k, v, axis_name, causal, scale, mask=None):
     per-row log-sum-exp — the only statistic backward needs. `mask` is
     this shard's additive key-padding block (..., 1, Tk_local); it rides
     the ring with its K/V block."""
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     my_idx = lax.axis_index(axis_name)
     b, h, tl, d = q.shape
 
@@ -107,7 +113,7 @@ def _make_local(axis_name, causal, scale):
     def _bwd_ring(q, k, v, mask, out, lse, dout):
         """Shared ring-replay backward; mask (or None) rides the ring in
         lockstep with its K/V block exactly as in forward."""
-        n = lax.axis_size(axis_name)
+        n = _axis_size(axis_name)
         my_idx = lax.axis_index(axis_name)
         dout32 = dout.astype(jnp.float32)
         # delta_i = sum_j dOut_ij * Out_ij (standard flash backward term)

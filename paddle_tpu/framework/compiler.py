@@ -123,6 +123,20 @@ class CompiledProgram(object):
         self._devices = devices
         return self
 
+    def set_mesh_axes(self, mesh_axes, devices=None):
+        """Re-target onto a new mesh topology (elastic shrink/grow).
+
+        Drops the cached Mesh so the next run builds one over the new
+        axes. The Executor's step cache is keyed by the axes
+        (:meth:`_cache_token`), so returning to a previously-seen
+        topology — shrink -> grow -> shrink — re-uses that topology's
+        compiled executable instead of recompiling."""
+        self._build_strategy.mesh_axes = dict(mesh_axes)
+        if devices is not None:
+            self._devices = devices
+        self._mesh = None
+        return self
+
     # ------------------------------------------------------------------
     def _cache_token(self):
         bs = self._build_strategy

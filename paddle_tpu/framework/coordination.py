@@ -42,7 +42,7 @@ from .resilience import RestartBudgetExceededError, record_event
 __all__ = [
     "CoordinationError", "HostLostError", "BarrierTimeoutError",
     "NoQuorumError", "Coordinator", "LocalCoordinator",
-    "FileCoordinator", "PodResilientTrainer",
+    "FileCoordinator", "PodResilientTrainer", "ElasticTrainer",
 ]
 
 
@@ -93,6 +93,13 @@ class Coordinator(object):
         self.detect_loss = bool(detect_loss)
         self._mesh_reinit = bool(mesh_reinit)
         self._loss_hooks = []
+        self._join_hooks = []
+        # admissions THIS object already reacted to: LocalCoordinator is
+        # shared by every simulated host, so the mesh re-grows once; a
+        # FileCoordinator is per-process, so every process re-grows its
+        # own mesh — same guard, right semantics in both topologies
+        self._absorbed = set()
+        self._absorb_lock = threading.Lock()
 
     # -- subclass surface --------------------------------------------------
     def all_gather(self, name, host_id, value=None, timeout_s=None):
@@ -112,12 +119,110 @@ class Coordinator(object):
     def mark_lost(self, host_id, reason="declared lost"):
         raise NotImplementedError
 
+    def announce_join(self, host_id, nonce):
+        """A FENCED host announces it wants back in. ``nonce`` is the
+        host's rejoin-attempt counter — it namespaces the admission
+        round so the same host can rejoin repeatedly. Raises
+        CoordinationError for a host that is not fenced (a live host
+        has nothing to rejoin)."""
+        raise NotImplementedError
+
+    def pending_joins(self):
+        """{host_id: nonce} of fenced hosts waiting for admission."""
+        raise NotImplementedError
+
+    def unfence(self, host_id):
+        """Clear ``host_id``'s tombstone and join request (idempotent).
+        Only the admission path may call this — un-fencing a host that
+        did not go through :meth:`admit`/:meth:`join` recreates exactly
+        the split brain fencing exists to prevent."""
+        raise NotImplementedError
+
     # -- shared machinery --------------------------------------------------
     def add_host_loss_hook(self, fn):
         """Register ``fn(lost_ids, live_ids)`` to run on host loss (after
         the built-in mesh re-init). Returns fn for decorator use."""
         self._loss_hooks.append(fn)
         return fn
+
+    def add_host_join_hook(self, fn):
+        """Register ``fn(joined_ids, live_ids)`` to run when a host is
+        re-absorbed (after the built-in mesh re-grow). Returns fn."""
+        self._join_hooks.append(fn)
+        return fn
+
+    def admit(self, host_id, joined, nonce, value, name="join",
+              timeout_s=None):
+        """Survivor half of the rejoin protocol.
+
+        Every SURVIVOR calls this in the same window (the pending-join
+        set must be agreed out of band — ElasticTrainer rides it on the
+        window status exchange, so all hosts compute the same admission
+        deterministically). It un-fences ``joined`` (idempotent across
+        survivors), then meets the joiner on the admission barrier,
+        contributing ``value`` — the survivor's sync coordinates (step
+        counter etc.); the joiner contributes None and adopts the max.
+        After the barrier the mesh re-absorbs the host
+        (:func:`distributed.mesh.absorb_hosts`) and join hooks fire.
+
+        Returns the agreed sync value, or None when the joiner died
+        between announcing and the barrier (it is re-fenced by the
+        barrier timeout and the admission is abandoned)."""
+        self.unfence(joined)
+        round_name = "%s:h%d:n%d" % (name, joined, nonce)
+        got = self.all_gather(round_name, host_id, value,
+                              timeout_s=timeout_s)
+        if joined not in got:
+            record_event("join_abort", host=joined, nonce=nonce)
+            return None
+        sync = max(v for v in got.values() if v is not None)
+        self._on_join([joined], nonce, sync)
+        return sync
+
+    def join(self, host_id, nonce, name="join", timeout_s=None,
+             poll_s=0.01):
+        """Joiner half: after :meth:`announce_join`, block until the
+        survivors un-fence this host, then meet the admission barrier.
+        Returns the survivors' agreed sync value. Raises
+        BarrierTimeoutError when no admission lands in time (the host
+        stays fenced — escalate to the orchestrator)."""
+        deadline = time.monotonic() + (self.timeout_s if timeout_s is None
+                                       else float(timeout_s))
+        while host_id in self.lost_hosts():
+            if time.monotonic() >= deadline:
+                raise BarrierTimeoutError(
+                    "host %d announced a rejoin but was not admitted in "
+                    "time — survivors may be mid-recovery or gone"
+                    % host_id)
+            time.sleep(poll_s)
+        round_name = "%s:h%d:n%d" % (name, host_id, nonce)
+        got = self.all_gather(round_name, host_id, None,
+                              timeout_s=timeout_s)
+        values = [v for v in got.values() if v is not None]
+        if not values:
+            raise CoordinationError(
+                "admission round %r carried no sync value from any "
+                "survivor" % round_name)
+        sync = max(values)
+        self._on_join([host_id], nonce, sync)
+        return sync
+
+    def _on_join(self, joined, nonce, sync):
+        """Fan out an admission exactly once per coordinator object:
+        resilience event, mesh re-grow, join hooks."""
+        key = (tuple(joined), int(nonce))
+        with self._absorb_lock:
+            if key in self._absorbed:
+                return
+            self._absorbed.add(key)
+        live = self.live_hosts()
+        record_event("host_join", hosts=sorted(joined), live=list(live),
+                     sync=sync)
+        if self._mesh_reinit:
+            from ..distributed import mesh as mesh_mod
+            mesh_mod.absorb_hosts(sorted(joined), live)
+        for fn in list(self._join_hooks):
+            fn(sorted(joined), live)
 
     def barrier(self, name, host_id, timeout_s=None):
         """Block until every live host reaches the same ``name``;
@@ -191,6 +296,7 @@ class LocalCoordinator(Coordinator):
             mesh_reinit=mesh_reinit)
         self._cond = threading.Condition()
         self._lost = {}
+        self._joins = {}    # host_id -> nonce (fenced hosts asking back)
         self._rounds = {}   # name -> {"values": {hid: v}, "exits": int}
 
     def live_hosts(self):
@@ -208,6 +314,25 @@ class LocalCoordinator(Coordinator):
             self._lost[host_id] = reason
             self._cond.notify_all()
         self._on_loss([host_id])
+
+    def announce_join(self, host_id, nonce):
+        with self._cond:
+            if host_id not in self._lost:
+                raise CoordinationError(
+                    "host %d is not fenced — only a lost host announces "
+                    "a rejoin" % host_id)
+            self._joins[host_id] = int(nonce)
+            self._cond.notify_all()
+
+    def pending_joins(self):
+        with self._cond:
+            return dict(self._joins)
+
+    def unfence(self, host_id):
+        with self._cond:
+            self._lost.pop(host_id, None)
+            self._joins.pop(host_id, None)
+            self._cond.notify_all()
 
     def all_gather(self, name, host_id, value=None, timeout_s=None):
         deadline = time.monotonic() + (self.timeout_s if timeout_s is None
@@ -290,6 +415,7 @@ class FileCoordinator(Coordinator):
         self._root = root
         self._lost_dir = os.path.join(root, "lost")
         self._rounds_dir = os.path.join(root, "rounds")
+        self._join_dir = os.path.join(root, "joins")
         self.poll_s = float(poll_s)
         # per-PROCESS loss knowledge: tombstones written by peers must
         # fire THIS process's _on_loss (mesh re-init is per-process
@@ -297,6 +423,7 @@ class FileCoordinator(Coordinator):
         self._known_lost = set()
         os.makedirs(self._lost_dir, exist_ok=True)
         os.makedirs(self._rounds_dir, exist_ok=True)
+        os.makedirs(self._join_dir, exist_ok=True)
 
     @staticmethod
     def _safe(name):
@@ -328,6 +455,38 @@ class FileCoordinator(Coordinator):
                       reason)
         self._known_lost.add(host_id)
         self._on_loss([host_id])
+
+    def announce_join(self, host_id, nonce):
+        import os
+        from ..io import _atomic_write
+        if host_id not in self.lost_hosts():
+            raise CoordinationError(
+                "host %d is not fenced — only a lost host announces a "
+                "rejoin" % host_id)
+        _atomic_write(os.path.join(self._join_dir, "host_%d" % host_id),
+                      str(int(nonce)))
+
+    def pending_joins(self):
+        import os
+        out = {}
+        for f in os.listdir(self._join_dir):
+            if f.startswith("host_"):
+                try:
+                    with open(os.path.join(self._join_dir, f)) as fh:
+                        out[int(f[5:])] = int(fh.read().strip())
+                except (OSError, ValueError):  # pragma: no cover - race
+                    continue
+        return out
+
+    def unfence(self, host_id):
+        import os
+        for d in (self._lost_dir, self._join_dir):
+            try:
+                os.unlink(os.path.join(d, "host_%d" % host_id))
+            except OSError:   # peer already removed it — idempotent
+                pass
+        # a future re-loss of this host must re-fire _on_loss here
+        self._known_lost.discard(host_id)
 
     def all_gather(self, name, host_id, value=None, timeout_s=None):
         import json
@@ -632,3 +791,422 @@ class PodResilientTrainer(object):
             step = got
         co.barrier(run_tag + "pod_end", hid)
         return all_fetches
+
+
+# ---------------------------------------------------------------------------
+# elastic training: continue on the survivors, re-absorb on rejoin
+# ---------------------------------------------------------------------------
+
+class ElasticTrainer(PodResilientTrainer):
+    """Elastic continue: survivors keep training when a host drops.
+
+    :class:`PodResilientTrainer` answers every fault with pod-wide
+    rewind-and-replay. ElasticTrainer upgrades MEMBERSHIP changes to
+    elastic semantics while keeping the rewind for poisoned state:
+
+      * **Shrink.** A lost host is fenced exactly as before (no split
+        brain), but the survivors do NOT rewind to a checkpoint: they
+        complete the in-flight window, re-shard every NamedSharding-
+        annotated param/optimizer leaf onto the capacity-scaled mesh
+        (:func:`distributed.mesh.reshard_state` — a ``dp``-axis resize
+        is one sharded device_put per leaf; gather-then-reshard is the
+        general fallback), re-target their CompiledProgram
+        (``set_mesh_axes``) and continue from the in-flight step at
+        reduced capacity. The Executor's step cache is keyed by the
+        mesh axes, so shrink -> grow -> shrink re-uses executables.
+        Because the feed batch is sharded over ``dp``, each surviving
+        slice automatically takes a LARGER share of the same global
+        batch — global batch semantics (and therefore the LR schedule)
+        are preserved without touching the optimizer.
+      * **Grow.** A fenced host that comes back announces itself
+        (``Coordinator.announce_join``); every survivor observes the
+        pending set on the window status exchange, so all of them admit
+        the same joiner in the same window (``Coordinator.admit`` /
+        ``join``: un-fence, barrier, elect the sync step). The live
+        state is then shipped to the joiner — directly between scopes
+        in the threaded simulation, or through a scrub-validated sync
+        checkpoint in ``sync_dir`` (required for ``host_id`` mode,
+        where peers are other processes) — and the mesh re-absorbs the
+        host (:func:`distributed.mesh.absorb_hosts`). Step counter and
+        global batch math line up with an uninterrupted run.
+      * **Transient compute faults** (preemptions, NaN blowups, torn
+        checkpoints) still take the parent's pod-wide consensus rewind
+        — elasticity is for membership, not for poisoned state. The
+        restore re-shards onto the CURRENT mesh (``shardings=``), so a
+        checkpoint written at full capacity restores onto a shrunk pod.
+
+    Feeds must be the replicated shape (one list of per-step feed
+    dicts): every host carries the full global batch and the mesh
+    decides each host's share, which is what makes capacity changes a
+    pure re-partitioning. Per-host feed streams would need a data-plane
+    re-balancer to preserve the global batch — out of scope here.
+
+    Events: ``elastic_shrink`` / ``elastic_grow`` with ``capacity``
+    labels (plus the mesh/reshard events) land in the resilience log
+    and therefore in ``resilience.metrics()``.
+    """
+
+    def __init__(self, trainers, coordinator=None, max_restarts=3,
+                 host_id=None, rejoin=True, sync_dir=None):
+        super(ElasticTrainer, self).__init__(
+            trainers, coordinator=coordinator, max_restarts=max_restarts,
+            host_id=host_id)
+        self._rejoin = bool(rejoin)
+        self._sync_dir = sync_dir
+        self._nonces = {}
+        self._nonce_lock = threading.Lock()
+        # the FULL topology per trainer, frozen at first use:
+        # set_mesh_axes mutates the strategy, so re-reading it on a
+        # later run() after a run that ended shrunk would compound the
+        # capacity scaling (dp = shrunk*live//total)
+        self._frozen_axes = {}
+        if host_id is not None and rejoin and sync_dir is None:
+            raise ValueError(
+                "host_id mode cannot ship rejoin state between process "
+                "scopes — pass sync_dir= (a shared directory the "
+                "survivors write the sync checkpoint to)")
+
+    def run(self, feeds, fetch_list=None):
+        feeds = list(feeds)
+        if self._host_id is None and feeds \
+                and not isinstance(feeds[0], dict):
+            raise ValueError(
+                "ElasticTrainer needs the replicated feed shape (ONE "
+                "list of per-step feed dicts): every host carries the "
+                "full global batch and the dp mesh assigns each host "
+                "its share, which is what makes a capacity change a "
+                "pure re-partitioning. Per-host streams would silently "
+                "lose the dead host's data on a shrink — re-balance "
+                "them upstream instead")
+        return super(ElasticTrainer, self).run(feeds, fetch_list)
+
+    # -- topology helpers --------------------------------------------------
+    @staticmethod
+    def _target_strategy(trainer):
+        from .compiler import CompiledProgram
+        t = trainer._target
+        return t if isinstance(t, CompiledProgram) else None
+
+    @staticmethod
+    def _scope_of(trainer):
+        from .scope import global_scope
+        return trainer._scope if trainer._scope is not None \
+            else global_scope()
+
+    def _current_shardings(self, trainer):
+        """{var: NamedSharding} of every scope var over the trainer's
+        CURRENT mesh — what re-shards an exact-step restore (or a
+        shipped sync checkpoint) straight onto a resized topology."""
+        strategy = self._target_strategy(trainer)
+        if strategy is None:
+            return None
+        mesh = strategy._mesh_obj()
+        sc = self._scope_of(trainer)
+        return {name: strategy._var_sharding(name, mesh)
+                for name in list(sc.keys())}
+
+    def _next_nonce(self, hid):
+        with self._nonce_lock:
+            self._nonces[hid] = self._nonces.get(hid, 0) + 1
+            return self._nonces[hid]
+
+    def _retarget(self, trainer, base_axes, live, kind, **fields):
+        """Re-shard this host's live state onto the capacity-scaled mesh
+        and record the elastic event. base_axes is the FULL topology —
+        scaling is always from it, never compounded."""
+        from ..distributed import mesh as mesh_mod
+        n_total = self._coordinator.n_hosts
+        capacity = "%d/%d" % (len(live), n_total)
+        strategy = self._target_strategy(trainer)
+        if strategy is None or not base_axes:
+            record_event(kind, capacity=capacity, resharded=0, **fields)
+            return
+        axes = dict(base_axes)
+        if "dp" in axes and axes["dp"] > 1 and len(live) < n_total:
+            axes["dp"] = max(1, axes["dp"] * len(live) // n_total)
+        old_mesh = strategy._mesh_obj()
+        strategy.set_mesh_axes(axes)
+        new_mesh = strategy._mesh_obj()
+        moved = 0
+        if new_mesh != old_mesh:
+            sc = self._scope_of(trainer)
+            new_state = mesh_mod.reshard_state(dict(sc.items()),
+                                               old_mesh, new_mesh)
+            for name, val in new_state.items():
+                if val is not sc.find_var(name):
+                    sc.set_var(name, val)
+                    moved += 1
+        record_event(kind, capacity=capacity,
+                     mesh={a: int(s) for a, s in new_mesh.shape.items()},
+                     resharded=moved, **fields)
+
+    # -- state shipping ----------------------------------------------------
+    def _ship_state(self, hid, trainer, live, joined, sync_step):
+        """Donor half: make the live state reachable by the joiner. In
+        sync_dir mode the LOWEST surviving host writes a checkpoint at
+        the sync step; in the threaded simulation the joiner reads the
+        donor's scope directly, so there is nothing to do here."""
+        if self._sync_dir is None:
+            return
+        donors = [h for h in live if h != joined]
+        if hid != min(donors):
+            return
+        from .. import io as io_mod
+        io_mod.save_checkpoint(trainer._executor, self._sync_dir,
+                               trainer._program, step=sync_step,
+                               keep_last=2, scope=self._scope_of(trainer))
+        record_event("sync_ship", step=sync_step)
+
+    def _receive_state(self, hid, trainer, live, sync_step):
+        """Joiner half: adopt the pod's CURRENT state (scrub-validated
+        when it travels via sync_dir)."""
+        import numpy as np
+        import jax
+        sc = self._scope_of(trainer)
+        if self._sync_dir is not None:
+            from .. import io as io_mod
+            report = io_mod.scrub_checkpoint(self._sync_dir)
+            if sync_step not in report["valid_steps"]:
+                raise CoordinationError(
+                    "sync checkpoint for step %d is not scrub-valid in "
+                    "%s (valid: %s) — refusing to rejoin from damaged "
+                    "state" % (sync_step, self._sync_dir,
+                               report["valid_steps"]))
+            io_mod.load_checkpoint(
+                trainer._executor, self._sync_dir, trainer._program,
+                step=sync_step, scope=sc,
+                shardings=self._current_shardings(trainer))
+            return
+        donor = self._trainers[min(h for h in live if h != hid)]
+        for name, val in dict(self._scope_of(donor).items()).items():
+            if isinstance(val, jax.Array):
+                # fresh buffers, same layout: sharing the donor's arrays
+                # would die the moment its next step DONATES them
+                sc.set_var(name, jax.device_put(np.asarray(val),
+                                                val.sharding))
+            else:
+                sc.set_var(name, val)
+
+    # -- the elastic host loop ---------------------------------------------
+    def _host_loop(self, hid, run_tag, feeds, fetch_list):
+        from . import resilience, watchdog
+        trainer = self._trainers[0] if self._host_id is not None \
+            else self._trainers[hid]
+        co = self._coordinator
+        fetch_list = trainer._resolved_fetch_list(fetch_list)
+        n = len(feeds)
+        strategy = self._target_strategy(trainer)
+        key = 0 if self._host_id is not None else hid
+        if key not in self._frozen_axes:
+            self._frozen_axes[key] = dict(
+                strategy._build_strategy.mesh_axes or {}) \
+                if strategy is not None else {}
+        base_axes = self._frozen_axes[key]
+        trainer._require_fresh_dir()
+        trainer._save(0)
+        co.barrier(run_tag + "pod_start", hid)
+        if n == 0:
+            co.barrier(run_tag + "pod_end", hid)
+            return []
+        all_fetches = [None] * n
+        ckpt_every = trainer._checkpoint_every
+        step, restarts, rnd = 0, 0, 0
+        known_live = sorted(co.live_hosts())
+        while step < n:
+            rnd += 1
+            until_ckpt = ckpt_every - (step % ckpt_every)
+            w = min(trainer._steps_per_dispatch, n - step, until_ckpt)
+            status, err, outs = "ok", None, None
+            try:
+                outs = trainer._dispatch(feeds, step, w, fetch_list)
+                if (step + w) % ckpt_every == 0 or step + w == n:
+                    trainer._save(step + w)
+            except resilience.SimulatedHostDeathError as e:
+                # THIS host is going away (eviction notice). Fence
+                # ourselves so the survivors' next gather continues
+                # without waiting out the timeout, then rejoin (or bow
+                # out). An abrupt death skips even this: the gather
+                # timeout fences us identically, just slower.
+                record_event("host_death", step=step,
+                             error=type(e).__name__)
+                co.mark_lost(hid, "died at step %d: %s"
+                             % (step, type(e).__name__))
+                got = self._rejoin_or_exit(hid, run_tag, trainer,
+                                           base_axes, step)
+                if got is None:
+                    return all_fetches          # fenced exit (partial)
+                step, rnd, restarts = got
+                known_live = sorted(co.live_hosts())
+                continue
+            except Exception as e:
+                err = e
+                status = "transient" if trainer._policy.is_transient(e) \
+                    else "fatal"
+            pending = sorted([int(h), int(nc)] for h, nc in
+                             co.pending_joins().items())
+            try:
+                verdicts = co.all_gather("%sw%d" % (run_tag, rnd), hid,
+                                         [status, pending])
+            except HostLostError:
+                # a peer's timeout fenced US (e.g. this host straggled
+                # past the collective deadline): stop competing
+                record_event("host_fenced", step=step)
+                got = self._rejoin_or_exit(hid, run_tag, trainer,
+                                           base_axes, step)
+                if got is None:
+                    return all_fetches
+                step, rnd, restarts = got
+                known_live = sorted(co.live_hosts())
+                continue
+            live = sorted(verdicts)
+            lost = sorted(set(known_live) - set(live))
+            if lost:
+                # ELASTIC SHRINK: no rewind — re-shard and continue
+                self._retarget(trainer, base_axes, live,
+                               "elastic_shrink", lost=lost, step=step)
+                known_live = live
+            statuses = {h: v[0] for h, v in verdicts.items()}
+            if any(v == "fatal" for v in statuses.values()):
+                record_event("fatal", step=step,
+                             error=type(err).__name__ if err else None)
+                if err is not None and status == "fatal":
+                    raise err
+                bad = sorted(h for h, v in statuses.items()
+                             if v == "fatal")
+                raise CoordinationError(
+                    "pod aborted: host(s) %s hit a fatal error at step %d"
+                    % (bad, step))
+            if all(v == "ok" for v in statuses.values()):
+                for i in range(w):
+                    all_fetches[step + i] = outs[i]
+                step += w
+                if watchdog.straggler_action_due() \
+                        and step % ckpt_every != 0 and step != n:
+                    trainer._save(step)
+                    record_event("straggler_ckpt", step=step)
+                # admission rides the window boundary: every live host
+                # saw the same gathered pending sets, so they all admit
+                # the same joiner (lowest id fully-observed) together
+                agreed = None
+                for pair in (verdicts[live[0]][1] if live else []):
+                    if all(pair in v[1] for v in verdicts.values()):
+                        agreed = pair
+                        break
+                if agreed is not None:
+                    jhid, nonce = agreed
+                    try:
+                        sync = co.admit(hid, jhid, nonce,
+                                        [step, rnd, restarts],
+                                        name=run_tag + "join")
+                        if sync is not None:
+                            live = sorted(co.live_hosts())
+                            self._retarget(trainer, base_axes, live,
+                                           "elastic_grow",
+                                           joined=[jhid], step=step)
+                            known_live = live
+                            tag = "%s_h%d_n%d" % (run_tag, jhid, nonce)
+                            co.barrier("ship" + tag, hid)
+                            self._ship_state(hid, trainer, live, jhid,
+                                             step)
+                            co.barrier("shipped" + tag, hid)
+                            # joiner copies between these two barriers:
+                            # our scope must not advance under its reads
+                            co.barrier("done" + tag, hid)
+                            # the admission is a checkpointable event:
+                            # the joiner's dir is missing every boundary
+                            # saved while it was fenced, so WITHOUT a
+                            # fresh common step a later transient
+                            # fault's consensus (quorum = all live
+                            # hosts) would rewind to the pre-death
+                            # history — or NoQuorumError once pruning
+                            # evicts it. Boundary steps were already
+                            # saved by this window's normal save.
+                            if step % ckpt_every != 0 and step != n:
+                                trainer._save(step)
+                    except HostLostError:
+                        # WE were fenced mid-admission (e.g. our ship
+                        # write outlasted a barrier timeout): the same
+                        # stop-competing path as a fence during the
+                        # window gather — the remaining survivors
+                        # carry on without us
+                        record_event("host_fenced", step=step)
+                        got = self._rejoin_or_exit(hid, run_tag,
+                                                   trainer, base_axes,
+                                                   step)
+                        if got is None:
+                            return all_fetches
+                        step, rnd, restarts = got
+                        known_live = sorted(co.live_hosts())
+                continue
+            # -- transient: pod-wide consensus rewind (parent semantics,
+            #    restored straight onto the CURRENT — possibly shrunk —
+            #    mesh) --------------------------------------------------
+            restarts += 1
+            if restarts > self._max_restarts:
+                record_event("giveup", step=step, restarts=restarts)
+                raise RestartBudgetExceededError(
+                    "pod restart budget (%d) exhausted at step %d; "
+                    "last local error: %r" % (self._max_restarts, step,
+                                              err))
+            delay = trainer._policy.delay_s(restarts - 1)
+            record_event("pod_restart", step=step, restarts=restarts,
+                         error=type(err).__name__ if err else None,
+                         backoff_s=delay)
+            trainer._policy.sleep(delay)
+            from .. import io as io_mod
+            report = io_mod.scrub_checkpoint(trainer._ckpt_dir)
+            agreed_step = co.elect_restore_step(
+                hid, report["valid_steps"],
+                name="%se%d" % (run_tag, rnd))
+            got = trainer._restore(
+                step=agreed_step,
+                shardings=self._current_shardings(trainer))
+            record_event("pod_restore", step=got)
+            step = got
+        co.barrier(run_tag + "pod_end", hid)
+        return all_fetches
+
+    def _rejoin_or_exit(self, hid, run_tag, trainer, base_axes, step):
+        """Fenced-host tail: announce a rejoin and wait for admission.
+        Returns the adopted (step, rnd, restarts) on success, None when
+        this host stays out (rejoin disabled or not admitted in time —
+        the survivors carry on either way)."""
+        co = self._coordinator
+        if not self._rejoin:
+            record_event("host_exit", step=step)
+            return None
+        nonce = self._next_nonce(hid)
+        try:
+            co.announce_join(hid, nonce)
+            record_event("join_announce", nonce=nonce, step=step)
+            sync = co.join(hid, nonce, name=run_tag + "join")
+        except CoordinationError as e:
+            # not admitted (survivors finished, or a recovery storm):
+            # stay out — a fenced host must never force its way back
+            record_event("rejoin_failed", error=type(e).__name__,
+                         nonce=nonce)
+            return None
+        new_step, new_rnd, new_restarts = sync
+        try:
+            live = sorted(co.live_hosts())
+            self._retarget(trainer, base_axes, live, "elastic_grow",
+                           joined=[hid], step=new_step)
+            tag = "%s_h%d_n%d" % (run_tag, hid, nonce)
+            co.barrier("ship" + tag, hid)
+            co.barrier("shipped" + tag, hid)
+            self._receive_state(hid, trainer, live, new_step)
+            co.barrier("done" + tag, hid)
+            # persist the adopted state: this host missed every
+            # boundary saved while it was fenced, and the pod's
+            # consensus election needs a step valid on ALL live hosts —
+            # the sync step becomes that common point (survivors write
+            # it too when it is not already a boundary they saved)
+            trainer._save(new_step)
+        except HostLostError:
+            # fenced AGAIN mid-admission (we were too slow to meet a
+            # ship barrier): the survivors already moved on — stay out
+            record_event("rejoin_failed", error="HostLostError",
+                         nonce=nonce)
+            return None
+        record_event("rejoin", step=new_step, nonce=nonce)
+        return int(new_step), int(new_rnd), int(new_restarts)

@@ -37,16 +37,19 @@ import random
 import threading
 import time
 
+from . import watchdog
 from .watchdog import CollectiveTimeoutError, bounded_call
 
 __all__ = [
     "FaultSpec", "FaultInjector", "RetryPolicy", "ResilientTrainer",
-    "SimulatedPreemptionError", "ServerOverloadedError",
+    "SimulatedPreemptionError", "SimulatedHostDeathError",
+    "ServerOverloadedError",
     "DeadlineExceededError", "RestartBudgetExceededError",
     "fire", "inject", "install", "current_injector", "reload_env",
     "events", "record_event", "clear_events", "classify",
     "run_with_deadline", "INJECTION_POINTS", "context",
     "metrics", "metrics_text", "parse_metrics_text",
+    "serve_metrics", "MetricsServer", "ElasticTrainer",
 ]
 
 INJECTION_POINTS = ("step", "ckpt_write", "serve")
@@ -66,6 +69,18 @@ class SimulatedPreemptionError(RuntimeError):
     """Injected stand-in for a preempted/evicted host: the step dies the
     way a real preemption surfaces (an exception out of the dispatch),
     and recovery must restore + replay."""
+
+
+class SimulatedHostDeathError(RuntimeError):
+    """Injected stand-in for a host LEAVING the pod (eviction notice,
+    node reclaim): unlike a transient preemption the process is going
+    away, so the local trainer cannot retry. Only
+    coordination.ElasticTrainer handles the raised error (fence self,
+    survivors continue elastically); everywhere else it classifies
+    FATAL — a plain (Pod)ResilientTrainer cannot outlive its own host.
+    A real ABRUPT death needs no exception at all: the survivors'
+    gather timeout fences the silent host and the pod rewinds without
+    it."""
 
 
 class ServerOverloadedError(RuntimeError):
@@ -178,7 +193,7 @@ def _histogram(name, values, buckets, labels=None):
             "count": len(values)}
 
 
-def metrics(event_list=None):
+def metrics(event_list=None, by_host=False):
     """Aggregate the bounded event log into Prometheus-style counters and
     histograms.
 
@@ -197,17 +212,33 @@ def metrics(event_list=None):
                                              latency_s)
 
     ``metrics_text()`` renders the exposition format; a scraper
-    sidecar/pushgateway can serve it as-is. Pass ``event_list`` to
-    aggregate a snapshot instead of the live log."""
+    sidecar/pushgateway can serve it as-is (or pull it live from
+    :func:`serve_metrics`). Pass ``event_list`` to aggregate a snapshot
+    instead of the live log. ``by_host=True`` additionally labels the
+    event counters with the per-host tags :func:`context` attached
+    (``{kind=...,host=...}``) so one pod-wide scrape still tells the
+    hosts apart; events recorded outside a host context keep the plain
+    ``{kind=...}`` series."""
     evs = _LOG.events() if event_list is None else list(event_list)
-    kind_counts = collections.Counter(e["kind"] for e in evs)
+    if by_host:
+        kind_counts = collections.Counter(
+            (e["kind"], e.get("host")) for e in evs)
+        counters = [
+            {"name": METRIC_PREFIX + "_events_total",
+             "labels": {"kind": kind} if host is None
+             else {"kind": kind, "host": str(host)}, "value": n}
+            for (kind, host), n in sorted(
+                kind_counts.items(),
+                key=lambda kv: (kv[0][0], str(kv[0][1])))]
+    else:
+        kind_counts = collections.Counter(e["kind"] for e in evs)
+        counters = [
+            {"name": METRIC_PREFIX + "_events_total",
+             "labels": {"kind": kind}, "value": n}
+            for kind, n in sorted(kind_counts.items())]
     fault_counts = collections.Counter(
         (e.get("point", "?"), e.get("fault", "?"))
         for e in evs if e["kind"] == "fault")
-    counters = [
-        {"name": METRIC_PREFIX + "_events_total",
-         "labels": {"kind": kind}, "value": n}
-        for kind, n in sorted(kind_counts.items())]
     counters += [
         {"name": METRIC_PREFIX + "_faults_total",
          "labels": {"point": p, "fault": f}, "value": n}
@@ -273,6 +304,73 @@ def parse_metrics_text(text):
     return samples
 
 
+class MetricsServer(object):
+    """A tiny stdlib HTTP listener serving the live metrics exposition.
+
+    ``GET /metrics`` renders ``metrics_text(metrics(by_host=True))`` at
+    request time — per-host labels ride the :func:`context` tags — and
+    ``GET /healthz`` answers 200 (liveness). Runs on a daemon thread;
+    :meth:`close` shuts it down. Start one via :func:`serve_metrics`.
+    """
+
+    def __init__(self, port=0, host="127.0.0.1"):
+        import http.server
+
+        class _Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):   # noqa: N802 - stdlib naming
+                path = self.path.split("?", 1)[0]
+                if path == "/metrics":
+                    body = metrics_text(metrics(by_host=True)).encode()
+                    ctype = "text/plain; version=0.0.4; charset=utf-8"
+                elif path == "/healthz":
+                    body, ctype = b"ok\n", "text/plain"
+                else:
+                    self.send_error(404, "try /metrics")
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):   # scrapes are not log lines
+                pass
+
+        self._server = http.server.ThreadingHTTPServer((host, port),
+                                                       _Handler)
+        self.host, self.port = self._server.server_address[:2]
+        self.url = "http://%s:%d/metrics" % (self.host, self.port)
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="paddle_tpu-metrics-%d" % self.port)
+        self._thread.start()
+
+    def close(self):
+        self._server.shutdown()
+        self._server.server_close()
+        self._thread.join(timeout=5.0)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def serve_metrics(port=0, host="127.0.0.1"):
+    """Start the metrics pull endpoint (Prometheus text exposition at
+    ``/metrics``, per-host labels from :func:`context` tags).
+
+    ``port=0`` binds an ephemeral port — read it back from the returned
+    server's ``.port``/``.url``. The listener renders the live event
+    log on every scrape, so there is nothing to push and nothing goes
+    stale; ``tools/serving_probe.py --metrics-url`` knows how to scrape
+    it. Call ``.close()`` (or use as a context manager) to stop."""
+    server = MetricsServer(port=port, host=host)
+    record_event("metrics_serve", url=server.url)
+    return server
+
+
 # ---------------------------------------------------------------------------
 # fault injection
 # ---------------------------------------------------------------------------
@@ -280,7 +378,7 @@ def parse_metrics_text(text):
 # point -> kinds it accepts (parse-time validation: a typo'd chaos spec
 # must fail loudly at configure time, not silently never fire)
 _POINT_KINDS = {
-    "step": ("preempt", "collective_timeout", "nan"),
+    "step": ("preempt", "collective_timeout", "nan", "die"),
     "ckpt_write": ("io_error",),
     "serve": ("slow", "error"),
 }
@@ -381,6 +479,10 @@ class FaultInjector(object):
             if spec.kind == "preempt":
                 raise SimulatedPreemptionError(
                     "injected preemption at %s call %d%s"
+                    % (point, n, (" (%s)" % what) if what else ""))
+            if spec.kind == "die":
+                raise SimulatedHostDeathError(
+                    "injected host death at %s call %d%s"
                     % (point, n, (" (%s)" % what) if what else ""))
             if spec.kind == "collective_timeout":
                 raise CollectiveTimeoutError(
@@ -632,13 +734,18 @@ class ResilientTrainer(object):
                                scope=self._scope)
         record_event("ckpt", step=step)
 
-    def _restore(self, step=None):
+    def _restore(self, step=None, shardings=None):
         """Restore ``step`` (pod-consensus path) or the latest valid
         checkpoint. Always joins an in-flight async commit FIRST: a
         blocking=False save still writing while we pick the restore
         point could otherwise tear the very dir we are about to read. A
         FAILED async commit is recorded, not raised — its torn step dir
-        is exactly what the load's scrub/quarantine fallback handles."""
+        is exactly what the load's scrub/quarantine fallback handles.
+
+        shardings: optional {var: jax.sharding.Sharding} passed through
+        to io.load_checkpoint so the restore materializes straight onto
+        the CURRENT mesh — what lets a checkpoint written at 8 hosts
+        restore onto an elastically-shrunk 6-host topology."""
         from .. import io as io_mod
         t0 = time.perf_counter()
         try:
@@ -647,7 +754,8 @@ class ResilientTrainer(object):
             record_event("ckpt_async_error", error=type(e).__name__)
         got = int(io_mod.load_checkpoint(self._executor, self._ckpt_dir,
                                          self._program, step=step,
-                                         scope=self._scope))
+                                         scope=self._scope,
+                                         shardings=shardings))
         record_event("restore", step=got,
                      latency_s=time.perf_counter() - t0)
         return got
@@ -713,8 +821,17 @@ class ResilientTrainer(object):
                 for i in range(w):
                     all_fetches[step + i] = outs[i]
                 step += w
-                if step % self._checkpoint_every == 0 or step == n:
+                at_boundary = step % self._checkpoint_every == 0 \
+                    or step == n
+                if at_boundary:
                     self._save(step)
+                if watchdog.straggler_action_due() and not at_boundary:
+                    # straggler MITIGATION: the detector saw a step past
+                    # its critical threshold — snapshot NOW so the hang
+                    # this straggler is about to become costs at most
+                    # one step of replay
+                    self._save(step)
+                    record_event("straggler_ckpt", step=step)
             except Exception as e:
                 if not self._policy.is_transient(e):
                     record_event("fatal", step=step,
@@ -737,3 +854,15 @@ class ResilientTrainer(object):
                 self._policy.sleep(delay)
                 step = self._restore()
         return all_fetches
+
+
+def __getattr__(name):
+    # ElasticTrainer LIVES in coordination.py (it extends
+    # PodResilientTrainer, and coordination imports this module at its
+    # top, so a top-level import here would be circular) but is part of
+    # the resilience API surface: resolve it lazily (PEP 562).
+    if name == "ElasticTrainer":
+        from .coordination import ElasticTrainer
+        return ElasticTrainer
+    raise AttributeError("module %r has no attribute %r"
+                         % (__name__, name))

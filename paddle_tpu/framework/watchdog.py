@@ -15,7 +15,7 @@ import jax
 __all__ = ["CollectiveTimeoutError", "wait_with_timeout", "bounded_call",
            "StragglerDetector", "enable_straggler_detection",
            "disable_straggler_detection", "straggler_detector",
-           "observe_step_latency"]
+           "observe_step_latency", "straggler_action_due"]
 
 
 class CollectiveTimeoutError(RuntimeError):
@@ -37,17 +37,32 @@ class StragglerDetector(object):
     Straggler samples still update the EWMA: a PERSISTENT slowdown
     recalibrates the baseline instead of flagging every step forever —
     the signal is the transition, which is when rebalancing helps.
+
+    MITIGATION, not just detection: ``action_k`` (> k) arms a second,
+    critical threshold. A step past ``action_k × ewma`` is a host that
+    is very probably about to become a hard CollectiveTimeoutError, so
+    the detector latches an action flag (``straggler_critical`` event);
+    the training loop polls :func:`straggler_action_due` at the next
+    step boundary and takes a pre-emptive checkpoint (``straggler_ckpt``
+    event) — the eventual hang then costs at most one step of replay.
     """
 
-    def __init__(self, alpha=0.2, k=3.0, warmup=5, min_latency_s=0.0):
+    def __init__(self, alpha=0.2, k=3.0, warmup=5, min_latency_s=0.0,
+                 action_k=None):
         if not 0.0 < alpha <= 1.0:
             raise ValueError("alpha must be in (0, 1]")
         if k <= 1.0:
             raise ValueError("k must be > 1 (k*ewma is the flag line)")
+        if action_k is not None and action_k < k:
+            raise ValueError("action_k is the SECOND threshold — it must "
+                             "be >= k (got action_k=%g < k=%g)"
+                             % (action_k, k))
         self.alpha = float(alpha)
         self.k = float(k)
         self.warmup = int(warmup)
         self.min_latency_s = float(min_latency_s)
+        self.action_k = None if action_k is None else float(action_k)
+        self._action_due = False
         self._ewma = None
         self._n = 0
         self._lock = threading.Lock()
@@ -70,6 +85,10 @@ class StragglerDetector(object):
                        and self._ewma > 0.0
                        and seconds > self.k * self._ewma
                        and seconds > self.min_latency_s)
+            critical = (flagged and self.action_k is not None
+                        and seconds > self.action_k * self._ewma)
+            if critical:
+                self._action_due = True
             ewma = self._ewma
             self._ewma = seconds if self._ewma is None else (
                 self.alpha * seconds + (1.0 - self.alpha) * self._ewma)
@@ -79,7 +98,21 @@ class StragglerDetector(object):
             resilience.record_event("straggler", what=what,
                                     latency_s=seconds, ewma_s=ewma,
                                     ratio=seconds / ewma)
+        if critical:
+            from . import resilience
+            resilience.record_event("straggler_critical", what=what,
+                                    latency_s=seconds, ewma_s=ewma,
+                                    ratio=seconds / ewma)
         return flagged
+
+    def action_due(self):
+        """Consume the latched critical flag: True once per critical
+        straggler, then False until the next one. The trainer that polls
+        this takes the pre-emptive checkpoint."""
+        with self._lock:
+            due = self._action_due
+            self._action_due = False
+            return due
 
 
 # opt-in global detector: armed by ResilientTrainer/operators that want
@@ -88,11 +121,14 @@ _detector = [None]
 
 
 def enable_straggler_detection(alpha=0.2, k=3.0, warmup=5,
-                               min_latency_s=0.0):
+                               min_latency_s=0.0, action_k=None):
     """Install (and return) the process-global StragglerDetector fed by
-    Executor.run/run_steps and armed wait_with_timeout calls."""
+    Executor.run/run_steps and armed wait_with_timeout calls.
+    ``action_k`` arms the second (mitigation) threshold — see
+    StragglerDetector."""
     _detector[0] = StragglerDetector(alpha=alpha, k=k, warmup=warmup,
-                                     min_latency_s=min_latency_s)
+                                     min_latency_s=min_latency_s,
+                                     action_k=action_k)
     return _detector[0]
 
 
@@ -110,6 +146,16 @@ def observe_step_latency(seconds, what="step"):
     if det is None:
         return False
     return det.observe(seconds, what=what)
+
+
+def straggler_action_due():
+    """Consume the global detector's critical-straggler flag (False when
+    detection is disabled or no critical straggler was seen). Trainers
+    poll this at step boundaries to take the pre-emptive checkpoint."""
+    det = _detector[0]
+    if det is None:
+        return False
+    return det.action_due()
 
 
 def bounded_call(fn, timeout_s, name="paddle_tpu-bounded-call"):

@@ -383,16 +383,7 @@ def save_checkpoint(executor, dirname, main_program=None, step=None,
             _atomic_write(os.path.join(full_dir, MANIFEST_FILE),
                           json.dumps(manifest))
             _atomic_write(os.path.join(dirname, "latest"), step_dir)
-            # prune only VALID step dirs — quarantined step_N.corrupt
-            # dirs are kept for forensics and must not break the sort
-            kids = sorted([d for d in os.listdir(dirname)
-                           if d.startswith("step_")
-                           and d.split("_", 1)[1].isdigit()],
-                          key=lambda d: int(d.split("_")[1]))
-            for d in kids[:-keep_last]:
-                import shutil
-                shutil.rmtree(os.path.join(dirname, d),
-                              ignore_errors=True)
+            _prune_step_dirs(dirname, keep_last)
         if multihost:  # pragma: no cover - needs real multihost
             # hold every process until the manifest commit is durable — a
             # worker returning (and its orchestrator tearing the job
@@ -437,6 +428,42 @@ def save_checkpoint(executor, dirname, main_program=None, step=None,
     handle = AsyncCheckpoint(th, box)
     _pending_save[0] = handle
     return handle
+
+
+def _prune_step_dirs(dirname, keep_last):
+    """Scrub-aware retention: keep the newest ``keep_last`` scrub-VALID
+    step dirs; everything older than the keep_last-th valid one is
+    pruned.
+
+    Torn/incomplete dirs (a burst of mid-commit crashes) do NOT consume
+    retention slots — under the old count-all-dirs rule a burst of torn
+    saves could evict every restorable checkpoint while keeping only
+    wreckage. Invalid dirs NEWER than the retention cutoff are kept (an
+    in-flight async commit looks exactly like a torn save until its
+    manifest lands — deleting it would corrupt a healthy checkpoint);
+    once they age past the cutoff they are pruned with everything else.
+    Quarantined ``step_N.corrupt`` dirs never match the pattern and stay
+    for forensics, as before. Validity comes from _classify_step_dir —
+    the same classifier scrub and load-quarantine use — and only the
+    newest ~keep_last dirs are classified (manifest JSON + npz member
+    lists, never payloads), so the cost per save stays O(keep_last).
+    keep_last <= 0 prunes nothing (the historical behavior — it must
+    never delete the checkpoint that was just committed)."""
+    import shutil
+    if keep_last <= 0:
+        return
+    kids = sorted([d for d in os.listdir(dirname)
+                   if d.startswith("step_")
+                   and d.split("_", 1)[1].isdigit()],
+                  key=lambda d: int(d.split("_")[1]), reverse=True)
+    seen_valid = 0
+    for d in kids:
+        if seen_valid >= keep_last:
+            shutil.rmtree(os.path.join(dirname, d), ignore_errors=True)
+            continue
+        status, _reason = _classify_step_dir(dirname, d)
+        if status == "valid":
+            seen_valid += 1
 
 
 def _stitch(meta, req, readers, dtype, name="<var>"):

@@ -23,6 +23,15 @@ def _axis(ctx, attrs):
     return name if name in bound else None
 
 
+def _axis_size(axis_name):
+    """lax.axis_size compat: jax 0.4.x has no lax.axis_size, but psum of
+    a literal 1 constant-folds to the static axis size at trace time."""
+    size = getattr(lax, "axis_size", None)
+    if size is not None:
+        return size(axis_name)
+    return lax.psum(1, axis_name)
+
+
 def _make_allreduce(op_name, reduce_fn):
     @register_op(op_name, differentiable=True)
     def _kernel(ctx, ins, attrs, _fn=reduce_fn):
@@ -91,7 +100,7 @@ def _ppermute(ctx, ins, attrs):
     ax = _axis(ctx, attrs)
     if ax is None:
         return {"Out": x}
-    n = lax.axis_size(ax)
+    n = _axis_size(ax)
     shift = attrs.get("shift", 1)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return {"Out": lax.ppermute(x, ax, perm)}

