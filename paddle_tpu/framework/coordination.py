@@ -343,7 +343,8 @@ class LocalCoordinator(Coordinator):
                 raise HostLostError(
                     "host %d is fenced (%s) — rejoin, don't resume"
                     % (host_id, self._lost[host_id]))
-            r = self._rounds.setdefault(name, {"values": {}, "exits": 0})
+            r = self._rounds.setdefault(name, {"values": {}, "exits": 0,
+                                               "result": None})
             if host_id in r["values"]:
                 raise CoordinationError(
                     "host %d already contributed to round %r — collective "
@@ -351,10 +352,22 @@ class LocalCoordinator(Coordinator):
             r["values"][host_id] = value
             self._cond.notify_all()
             while True:
+                # completion is STICKY: the first host to see the round
+                # complete freezes the result for everyone. Without it,
+                # a fast peer can exit, enter the admission path and
+                # UN-FENCE the joiner while we are still blocked here —
+                # recomputing membership would then add the joiner to
+                # waiting_for and wedge this round forever (the joiner
+                # is already in the admission round, not this one).
+                if r["result"] is not None:
+                    break
                 waiting_for = [i for i in range(self.n_hosts)
                                if i not in self._lost
                                and i not in r["values"]]
                 if not waiting_for:
+                    r["result"] = {i: v for i, v in r["values"].items()
+                                   if i not in self._lost}
+                    self._cond.notify_all()
                     break
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
@@ -368,16 +381,22 @@ class LocalCoordinator(Coordinator):
                     self._cond.notify_all()
                     continue
                 self._cond.wait(remaining)
+            # every participant returns the SAME frozen snapshot — the
+            # protocol's "identical verdicts on every host" assumption
+            # holds even when membership changes mid-exit
+            result = dict(r["result"])
+            # exit accounting BEFORE the fence check: a host fenced
+            # between the freeze and its exit still leaves the round,
+            # otherwise the entry (and its gathered payloads) would
+            # leak forever — exits could never reach len(result)
+            r["exits"] += 1
+            if r["exits"] >= len(result):
+                self._rounds.pop(name, None)   # last one out cleans up
             if host_id in self._lost:
                 # marked lost while blocked in this very round: fence
                 raise HostLostError(
                     "host %d is fenced (%s) — rejoin, don't resume"
                     % (host_id, self._lost[host_id]))
-            result = {i: v for i, v in r["values"].items()
-                      if i not in self._lost}
-            r["exits"] += 1
-            if r["exits"] >= len(result):
-                self._rounds.pop(name, None)   # last one out cleans up
         # hooks run OUTSIDE the lock: mesh re-init is arbitrary user code
         self._on_loss(newly_lost)
         return result
@@ -509,14 +528,45 @@ class FileCoordinator(Coordinator):
                 "host %d already contributed to round %r — collective "
                 "names must be unique per round" % (host_id, name))
         _atomic_write(mine, json.dumps({"value": value}))
+        done_path = os.path.join(rd, "_done.json")
         while True:
+            # completion is STICKY (LocalCoordinator parity): the first
+            # process to see every live host present freezes the member
+            # snapshot in _done.json. Without it, a fast peer can exit
+            # and un-fence a rejoining host while we are still polling
+            # — recomputing membership would add the joiner to
+            # waiting_for and wedge this round forever.
+            if os.path.exists(done_path):
+                try:
+                    with open(done_path) as fh:
+                        members = json.load(fh)
+                    break
+                except (OSError, ValueError):  # pragma: no cover - race
+                    pass    # mid-replace glimpse: poll again
             lost = self.lost_hosts()
             present = {int(f[5:-5]) for f in os.listdir(rd)
                        if f.startswith("host_") and f.endswith(".json")}
             waiting_for = [i for i in range(self.n_hosts)
                            if i not in lost and i not in present]
             if not waiting_for:
-                break
+                # claim the freeze atomically: hard-link of a complete
+                # temp file, so the FIRST freezer wins outright and no
+                # reader ever sees a partial or second snapshot (two
+                # hosts with divergent lost views must not freeze
+                # different member sets). Loop back to read the
+                # canonical file — even the winner re-reads it.
+                import tempfile
+                fd, tmp = tempfile.mkstemp(dir=rd, suffix=".tmp")
+                try:
+                    with os.fdopen(fd, "w") as fh:
+                        fh.write(json.dumps(sorted(present - set(lost))))
+                    try:
+                        os.link(tmp, done_path)
+                    except OSError:     # a peer froze first — use theirs
+                        pass
+                finally:
+                    os.unlink(tmp)
+                continue
             if time.monotonic() >= deadline:
                 if not self.detect_loss:
                     raise BarrierTimeoutError(
@@ -538,7 +588,7 @@ class FileCoordinator(Coordinator):
                 "host %d is fenced (%s) — rejoin, don't resume"
                 % (host_id, lost[host_id]))
         result = {}
-        for i in sorted(present - set(lost)):
+        for i in members:
             with open(os.path.join(rd, "host_%d.json" % i)) as fh:
                 result[i] = json.load(fh)["value"]
         # last one out cleans up (LocalCoordinator parity): every value
@@ -631,6 +681,13 @@ class PodResilientTrainer(object):
                 "pod trainers need keep_last >= 2: the consensus "
                 "election requires the previous common checkpoint to "
                 "survive the ok hosts' pruning")
+        if len({t._feed is not None for t in self._trainers}) != 1:
+            # feed-driven and list-driven hosts cannot mix: the window
+            # protocol (cursor exchange, drain consensus) must be
+            # uniform across the pod
+            raise ValueError(
+                "either every pod trainer has a ShardedFeed attached "
+                "(feed=) or none does")
         self._coordinator = coordinator or LocalCoordinator(
             len(self._trainers))
         self._host_id = None if host_id is None else int(host_id)
@@ -650,6 +707,24 @@ class PodResilientTrainer(object):
                     "host_id %d out of range for a %d-host coordinator"
                     % (self._host_id, self._coordinator.n_hosts))
         self._max_restarts = int(max_restarts)
+        # feed topology must match the pod, and each feed must sit in
+        # its trainer's host slot: a copy-pasted host_id would silently
+        # train one host's lanes N times and never read the rest
+        for i, t in enumerate(self._trainers):
+            if t._feed is None:
+                continue
+            want_hid = i if self._host_id is None else self._host_id
+            if t._feed.n_hosts != self._coordinator.n_hosts:
+                raise ValueError(
+                    "trainer %d's ShardedFeed was built for %d hosts "
+                    "but the pod has %d — lane partitioning would not "
+                    "cover the dataset" % (i, t._feed.n_hosts,
+                                           self._coordinator.n_hosts))
+            if t._feed._host_id != want_hid:
+                raise ValueError(
+                    "trainer %d's ShardedFeed carries host_id %d but "
+                    "occupies host slot %d — every host would read the "
+                    "wrong lanes" % (i, t._feed._host_id, want_hid))
         # advances once per run() on EVERY host (runs are lockstep like
         # everything else), namespacing round names so a second run()
         # on the same coordinator never collides with the first's rounds
@@ -659,7 +734,7 @@ class PodResilientTrainer(object):
     def coordinator(self):
         return self._coordinator
 
-    def run(self, feeds, fetch_list=None):
+    def run(self, feeds, fetch_list=None, steps=None):
         """Run the pod to completion, recovering from transient faults.
 
         ``feeds``: either ONE list of per-step feed dicts (replicated to
@@ -667,29 +742,52 @@ class PodResilientTrainer(object):
         per-host feed lists of EQUAL length (each host trains its own
         stream). Returns the per-host fetch lists ``[n_hosts][n_steps]``.
 
+        ``feeds=None`` switches to the elastic data plane: every
+        trainer's attached :class:`~..reader.ShardedFeed` supplies its
+        windows (``steps`` bounds the committed BATCHES per host, in
+        dispatch-window increments; the run ends early
+        once every live host's feed drains), the window exchange carries
+        each host's cursor, and checkpoints persist the agreed pod-wide
+        cursor map so a rewind replays the exact batch sequence. Each
+        host's result is its flat list of committed per-batch fetches.
+
         In ``host_id`` mode feeds is THIS host's list of per-step feed
         dicts and the return value is its fetch list ``[n_steps]`` —
         the peers run the same call in their own processes.
         """
         from . import resilience
+        if feeds is None:
+            if self._trainers[0]._feed is None:
+                raise ValueError(
+                    "run(feeds=None) pulls from ShardedFeeds — attach "
+                    "one to every trainer (feed=) or pass feeds")
+            if steps is None or int(steps) < 1:
+                raise ValueError(
+                    "feed-driven pod runs need steps= >= 1 (a lockstep "
+                    "window bound; draining feeds end the run early)")
         if self._host_id is not None:
             self._run_seq += 1
             with resilience.context(host=self._host_id):
                 return self._host_loop(self._host_id,
                                        "r%d." % self._run_seq,
-                                       list(feeds), fetch_list)
+                                       None if feeds is None
+                                       else list(feeds),
+                                       fetch_list, steps=steps)
         n_hosts = len(self._trainers)
-        if not feeds or isinstance(feeds[0], dict):
-            per_host = [list(feeds)] * n_hosts
+        if feeds is None:
+            per_host = [None] * n_hosts
         else:
-            per_host = [list(f) for f in feeds]
-            if len(per_host) != n_hosts:
-                raise ValueError(
-                    "per-host feeds: expected %d lists, got %d"
-                    % (n_hosts, len(per_host)))
-        if len({len(f) for f in per_host}) > 1:
-            raise ValueError("every host needs the same number of steps "
-                             "(lockstep collectives)")
+            if not feeds or isinstance(feeds[0], dict):
+                per_host = [list(feeds)] * n_hosts
+            else:
+                per_host = [list(f) for f in feeds]
+                if len(per_host) != n_hosts:
+                    raise ValueError(
+                        "per-host feeds: expected %d lists, got %d"
+                        % (n_hosts, len(per_host)))
+            if len({len(f) for f in per_host}) > 1:
+                raise ValueError("every host needs the same number of "
+                                 "steps (lockstep collectives)")
         results = [None] * n_hosts
         errors = [None] * n_hosts
         self._run_seq += 1
@@ -701,7 +799,8 @@ class PodResilientTrainer(object):
                 with resilience.context(host=hid):
                     results[hid] = self._host_loop(hid, run_tag,
                                                    per_host[hid],
-                                                   fetch_list)
+                                                   fetch_list,
+                                                   steps=steps)
             except BaseException as e:   # surfaced after join
                 errors[hid] = e
 
@@ -721,14 +820,15 @@ class PodResilientTrainer(object):
             raise coord[0]
         return results
 
-    def _host_loop(self, hid, run_tag, feeds, fetch_list):
+    def _host_loop(self, hid, run_tag, feeds, fetch_list, steps=None):
         # host_id mode holds only THIS host's trainer; simulation mode
         # holds all of them, indexed by the logical host id
         trainer = self._trainers[0] if self._host_id is not None \
             else self._trainers[hid]
+        feed = trainer._feed if feeds is None else None
         co = self._coordinator
         fetch_list = trainer._resolved_fetch_list(fetch_list)
-        n = len(feeds)
+        n = int(steps) if feed is not None else len(feeds)
         trainer._require_fresh_dir()
         trainer._save(0)
         co.barrier(run_tag + "pod_start", hid)
@@ -745,29 +845,52 @@ class PodResilientTrainer(object):
             w = min(trainer._steps_per_dispatch, n - step, until_ckpt)
             status, err, outs = "ok", None, None
             try:
-                outs = trainer._dispatch(feeds, step, w, fetch_list)
-                if (step + w) % ckpt_every == 0 or step + w == n:
-                    trainer._save(step + w)
+                if feed is not None:
+                    # per-host stream: ≤ w batches (fewer at the drain
+                    # tail); the window COUNT still advances by w on
+                    # every host, so checkpoint boundaries stay lockstep
+                    outs = trainer._dispatch_batches(feed.draw(w),
+                                                     fetch_list)
+                else:
+                    outs = trainer._dispatch(feeds, step, w, fetch_list)
+                    if (step + w) % ckpt_every == 0 or step + w == n:
+                        trainer._save(step + w)
             except Exception as e:
                 err = e
                 status = "transient" if trainer._policy.is_transient(e) \
                     else "fatal"
+            payload = status if feed is None \
+                else [status, bool(feed.drained)]
             verdicts = co.all_gather("%sw%d" % (run_tag, rnd), hid,
-                                     status)
-            if any(v == "fatal" for v in verdicts.values()):
+                                     payload)
+            statuses = {h: v if isinstance(v, str) else v[0]
+                        for h, v in verdicts.items()}
+            if any(v == "fatal" for v in statuses.values()):
                 record_event("fatal", step=step,
                              error=type(err).__name__ if err else None)
                 if err is not None and status == "fatal":
                     raise err
-                bad = sorted(h for h, v in verdicts.items()
+                bad = sorted(h for h, v in statuses.items()
                              if v == "fatal")
                 raise CoordinationError(
                     "pod aborted: host(s) %s hit a fatal error at step %d"
                     % (bad, step))
-            if all(v == "ok" for v in verdicts.values()):
-                for i in range(w):
+            if all(v == "ok" for v in statuses.values()):
+                for i in range(len(outs) if feed is not None else w):
                     all_fetches[step + i] = outs[i]
                 step += w
+                if feed is not None:
+                    # the cursor commits only with the pod's agreement,
+                    # and the checkpoint lands AFTER it so the saved
+                    # cursor matches the saved params exactly
+                    feed.commit()
+                    drained = all(isinstance(v, list) and v[1]
+                                  for v in verdicts.values())
+                    if step % ckpt_every == 0 or step == n or drained:
+                        trainer._save(step)
+                        feed.record_metrics()
+                    if drained:
+                        break          # every host's feed is drained
                 continue
             # -- pod-wide recovery ------------------------------------
             restarts += 1   # lockstep on every host: the SHARED budget
@@ -790,12 +913,33 @@ class PodResilientTrainer(object):
             record_event("pod_restore", step=got)
             step = got
         co.barrier(run_tag + "pod_end", hid)
+        if feed is not None:
+            # committed per-batch fetches, drain-tail holes removed
+            return [o for o in all_fetches if o is not None]
         return all_fetches
 
 
 # ---------------------------------------------------------------------------
 # elastic training: continue on the survivors, re-absorb on rejoin
 # ---------------------------------------------------------------------------
+
+def _default_lr_rescale(trainer, scale_by, scope):
+    """Default lr_rescale hook: multiply every optimizer learning-rate
+    variable in the scope (the ``learning_rate*`` globals the Optimizer
+    base creates) by ``scale_by``. Replace via
+    ``ElasticTrainer(lr_rescale_hook=...)`` for schedules that live
+    elsewhere (e.g. a host-side scheduler object)."""
+    import numpy as np
+    for name in list(scope.keys()):
+        if "learning_rate" not in name:
+            continue
+        val = scope.find_var(name)
+        if val is None:
+            continue
+        arr = np.asarray(val)
+        if not np.issubdtype(arr.dtype, np.floating):
+            continue
+        scope.set_var(name, (arr * arr.dtype.type(scale_by)))
 
 class ElasticTrainer(PodResilientTrainer):
     """Elastic continue: survivors keep training when a host drops.
@@ -846,13 +990,33 @@ class ElasticTrainer(PodResilientTrainer):
     and therefore in ``resilience.metrics()``.
     """
 
+    # checkpointed marker var: the LR-rescale factor currently applied
+    # to the scope's learning rates. It travels WITH the state (saved by
+    # save_checkpoint, shipped on rejoin), so a restore of a checkpoint
+    # taken under a different capacity can reconcile exactly.
+    LR_SCALE_VAR = "@lr_rescale_factor"
+
     def __init__(self, trainers, coordinator=None, max_restarts=3,
-                 host_id=None, rejoin=True, sync_dir=None):
+                 host_id=None, rejoin=True, sync_dir=None,
+                 lr_rescale=False, grad_merge_steps=1,
+                 lr_rescale_hook=None):
         super(ElasticTrainer, self).__init__(
             trainers, coordinator=coordinator, max_restarts=max_restarts,
             host_id=host_id)
         self._rejoin = bool(rejoin)
         self._sync_dir = sync_dir
+        # lr_rescale=True: the FIXED-PER-HOST-BATCH regime (per-host
+        # feed streams — the global batch shrinks with the dp axis), so
+        # capacity changes linearly rescale the learning rate,
+        # gradient-merge-aware: grad_merge_steps may be an int or a
+        # callable live_hosts -> k for schedules that re-grow the
+        # global batch by accumulating more micro-batches per update.
+        # The default False is the replicated-feed regime, where a
+        # capacity change re-partitions the SAME global batch and the
+        # LR schedule must not move.
+        self._lr_rescale = bool(lr_rescale)
+        self._grad_merge_steps = grad_merge_steps
+        self._lr_rescale_hook = lr_rescale_hook
         self._nonces = {}
         self._nonce_lock = threading.Lock()
         # the FULL topology per trainer, frozen at first use:
@@ -866,19 +1030,23 @@ class ElasticTrainer(PodResilientTrainer):
                 "scopes — pass sync_dir= (a shared directory the "
                 "survivors write the sync checkpoint to)")
 
-    def run(self, feeds, fetch_list=None):
-        feeds = list(feeds)
-        if self._host_id is None and feeds \
-                and not isinstance(feeds[0], dict):
-            raise ValueError(
-                "ElasticTrainer needs the replicated feed shape (ONE "
-                "list of per-step feed dicts): every host carries the "
-                "full global batch and the dp mesh assigns each host "
-                "its share, which is what makes a capacity change a "
-                "pure re-partitioning. Per-host streams would silently "
-                "lose the dead host's data on a shrink — re-balance "
-                "them upstream instead")
-        return super(ElasticTrainer, self).run(feeds, fetch_list)
+    def run(self, feeds, fetch_list=None, steps=None):
+        if feeds is not None:
+            feeds = list(feeds)
+            if self._host_id is None and feeds \
+                    and not isinstance(feeds[0], dict):
+                raise ValueError(
+                    "ElasticTrainer needs the replicated feed shape "
+                    "(ONE list of per-step feed dicts): every host "
+                    "carries the full global batch and the dp mesh "
+                    "assigns each host its share, which is what makes "
+                    "a capacity change a pure re-partitioning. For "
+                    "per-host streams attach a reader.ShardedFeed to "
+                    "every trainer and call run(feeds=None, steps=N) — "
+                    "the coordinator re-balances the streams on every "
+                    "membership change")
+        return super(ElasticTrainer, self).run(feeds, fetch_list,
+                                               steps=steps)
 
     # -- topology helpers --------------------------------------------------
     @staticmethod
@@ -910,6 +1078,50 @@ class ElasticTrainer(PodResilientTrainer):
             self._nonces[hid] = self._nonces.get(hid, 0) + 1
             return self._nonces[hid]
 
+    # -- gradient-merge-aware LR rescale (fixed-per-host-batch regime) ----
+    def _grad_merge_k(self, n_live):
+        k = self._grad_merge_steps
+        return int(k(n_live)) if callable(k) else int(k)
+
+    def _lr_target_factor(self, n_live):
+        """Linear-scaling target: effective global batch is per-host
+        batch x live hosts x gradient-merge steps; the factor is its
+        ratio to the full-capacity global batch. An operator who bumps
+        grad_merge_steps to re-fill the global batch on a shrink
+        (callable k) gets factor 1.0 — no LR move — automatically."""
+        n_total = self._coordinator.n_hosts
+        k_live = self._grad_merge_k(n_live)
+        k_full = self._grad_merge_k(n_total)
+        return (n_live * k_live) / float(n_total * k_full), k_live
+
+    def _apply_lr_scale(self, trainer, live):
+        """Reconcile the scope's learning rates with the CURRENT
+        capacity. Idempotent and restore-safe: the applied factor lives
+        in a checkpointed scope var, so a rewind that restores an LR
+        saved under different capacity is re-scaled by exactly the
+        missing ratio."""
+        if not self._lr_rescale:
+            return
+        import numpy as np
+        sc = self._scope_of(trainer)
+        cur = sc.find_var(self.LR_SCALE_VAR)
+        cur = 1.0 if cur is None else float(np.asarray(cur))
+        target, k_live = self._lr_target_factor(len(live))
+        if abs(target - cur) < 1e-9:
+            return
+        rel = target / cur
+        hook = self._lr_rescale_hook or _default_lr_rescale
+        hook(trainer, rel, sc)
+        # float64: a float32 marker would round non-dyadic ratios
+        # (e.g. 5/6) past the tolerance and re-trigger a tiny spurious
+        # rescale on every later retarget/restore
+        sc.set_var(self.LR_SCALE_VAR, np.float64(target))
+        record_event("lr_rescale",
+                     capacity="%d/%d" % (len(live),
+                                         self._coordinator.n_hosts),
+                     factor=round(target, 6), rel=round(rel, 6),
+                     grad_merge=k_live)
+
     def _retarget(self, trainer, base_axes, live, kind, **fields):
         """Re-shard this host's live state onto the capacity-scaled mesh
         and record the elastic event. base_axes is the FULL topology —
@@ -920,6 +1132,7 @@ class ElasticTrainer(PodResilientTrainer):
         strategy = self._target_strategy(trainer)
         if strategy is None or not base_axes:
             record_event(kind, capacity=capacity, resharded=0, **fields)
+            self._apply_lr_scale(trainer, live)
             return
         axes = dict(base_axes)
         if "dp" in axes and axes["dp"] > 1 and len(live) < n_total:
@@ -939,6 +1152,7 @@ class ElasticTrainer(PodResilientTrainer):
         record_event(kind, capacity=capacity,
                      mesh={a: int(s) for a, s in new_mesh.shape.items()},
                      resharded=moved, **fields)
+        self._apply_lr_scale(trainer, live)
 
     # -- state shipping ----------------------------------------------------
     def _ship_state(self, hid, trainer, live, joined, sync_step):
@@ -952,17 +1166,24 @@ class ElasticTrainer(PodResilientTrainer):
         if hid != min(donors):
             return
         from .. import io as io_mod
+        feed_state = None if trainer._feed is None \
+            else trainer._feed.global_state()
         io_mod.save_checkpoint(trainer._executor, self._sync_dir,
                                trainer._program, step=sync_step,
-                               keep_last=2, scope=self._scope_of(trainer))
+                               keep_last=2, scope=self._scope_of(trainer),
+                               feed_state=feed_state)
         record_event("sync_ship", step=sync_step)
 
     def _receive_state(self, hid, trainer, live, sync_step):
         """Joiner half: adopt the pod's CURRENT state (scrub-validated
-        when it travels via sync_dir)."""
+        when it travels via sync_dir). With a feed attached, the agreed
+        pod-wide cursor map comes along on the same barrier — the
+        admitted host takes its stream lanes back from the survivors at
+        the exact committed positions."""
         import numpy as np
         import jax
         sc = self._scope_of(trainer)
+        feed = trainer._feed
         if self._sync_dir is not None:
             from .. import io as io_mod
             report = io_mod.scrub_checkpoint(self._sync_dir)
@@ -972,12 +1193,24 @@ class ElasticTrainer(PodResilientTrainer):
                     "%s (valid: %s) — refusing to rejoin from damaged "
                     "state" % (sync_step, self._sync_dir,
                                report["valid_steps"]))
-            io_mod.load_checkpoint(
+            got = io_mod.load_checkpoint(
                 trainer._executor, self._sync_dir, trainer._program,
                 step=sync_step, scope=sc,
-                shardings=self._current_shardings(trainer))
+                shardings=self._current_shardings(trainer),
+                with_feed_state=feed is not None)
+            if feed is not None:
+                _step, feed_state = got
+                if feed_state is None:
+                    raise CoordinationError(
+                        "sync checkpoint for step %d in %s carries no "
+                        "feed cursor — the donor must ship the data "
+                        "position with the params" % (sync_step,
+                                                      self._sync_dir))
+                feed.restore(feed_state, live=sorted(live))
             return
         donor = self._trainers[min(h for h in live if h != hid)]
+        if feed is not None:
+            feed.restore(donor._feed.global_state(), live=sorted(live))
         for name, val in dict(self._scope_of(donor).items()).items():
             if isinstance(val, jax.Array):
                 # fresh buffers, same layout: sharing the donor's arrays
@@ -988,13 +1221,14 @@ class ElasticTrainer(PodResilientTrainer):
                 sc.set_var(name, val)
 
     # -- the elastic host loop ---------------------------------------------
-    def _host_loop(self, hid, run_tag, feeds, fetch_list):
+    def _host_loop(self, hid, run_tag, feeds, fetch_list, steps=None):
         from . import resilience, watchdog
         trainer = self._trainers[0] if self._host_id is not None \
             else self._trainers[hid]
+        feed = trainer._feed if feeds is None else None
         co = self._coordinator
         fetch_list = trainer._resolved_fetch_list(fetch_list)
-        n = len(feeds)
+        n = int(steps) if feed is not None else len(feeds)
         strategy = self._target_strategy(trainer)
         key = 0 if self._host_id is not None else hid
         if key not in self._frozen_axes:
@@ -1009,6 +1243,14 @@ class ElasticTrainer(PodResilientTrainer):
             co.barrier(run_tag + "pod_end", hid)
             return []
         all_fetches = [None] * n
+
+        def result():
+            if feed is not None:
+                # committed per-batch fetches in window order (holes
+                # are windows this host missed while fenced or drained)
+                return [o for o in all_fetches if o is not None]
+            return all_fetches
+
         ckpt_every = trainer._checkpoint_every
         step, restarts, rnd = 0, 0, 0
         known_live = sorted(co.live_hosts())
@@ -1018,9 +1260,17 @@ class ElasticTrainer(PodResilientTrainer):
             w = min(trainer._steps_per_dispatch, n - step, until_ckpt)
             status, err, outs = "ok", None, None
             try:
-                outs = trainer._dispatch(feeds, step, w, fetch_list)
-                if (step + w) % ckpt_every == 0 or step + w == n:
-                    trainer._save(step + w)
+                if feed is not None:
+                    # the boundary save moves AFTER the status exchange:
+                    # the checkpoint must carry the agreed cursor map at
+                    # this exact boundary, which only exists once every
+                    # live host's window cursor has been gathered
+                    outs = trainer._dispatch_batches(feed.draw(w),
+                                                     fetch_list)
+                else:
+                    outs = trainer._dispatch(feeds, step, w, fetch_list)
+                    if (step + w) % ckpt_every == 0 or step + w == n:
+                        trainer._save(step + w)
             except resilience.SimulatedHostDeathError as e:
                 # THIS host is going away (eviction notice). Fence
                 # ourselves so the survivors' next gather continues
@@ -1034,7 +1284,7 @@ class ElasticTrainer(PodResilientTrainer):
                 got = self._rejoin_or_exit(hid, run_tag, trainer,
                                            base_axes, step)
                 if got is None:
-                    return all_fetches          # fenced exit (partial)
+                    return result()             # fenced exit (partial)
                 step, rnd, restarts = got
                 known_live = sorted(co.live_hosts())
                 continue
@@ -1044,9 +1294,15 @@ class ElasticTrainer(PodResilientTrainer):
                     else "fatal"
             pending = sorted([int(h), int(nc)] for h, nc in
                              co.pending_joins().items())
+            # the cursor rides the status exchange: every host's
+            # TENTATIVE post-window position, published to peers only
+            # if the window commits (observe below) — a dead host's
+            # uncommitted draws are invisible, so its lanes re-home at
+            # the last agreed position: nothing lost, nothing doubled
+            exch = None if feed is None else feed.exchange_state()
             try:
                 verdicts = co.all_gather("%sw%d" % (run_tag, rnd), hid,
-                                         [status, pending])
+                                         [status, pending, exch])
             except HostLostError:
                 # a peer's timeout fenced US (e.g. this host straggled
                 # past the collective deadline): stop competing
@@ -1054,7 +1310,7 @@ class ElasticTrainer(PodResilientTrainer):
                 got = self._rejoin_or_exit(hid, run_tag, trainer,
                                            base_axes, step)
                 if got is None:
-                    return all_fetches
+                    return result()
                 step, rnd, restarts = got
                 known_live = sorted(co.live_hosts())
                 continue
@@ -1077,9 +1333,27 @@ class ElasticTrainer(PodResilientTrainer):
                     "pod aborted: host(s) %s hit a fatal error at step %d"
                     % (bad, step))
             if all(v == "ok" for v in statuses.values()):
-                for i in range(w):
+                for i in range(len(outs) if feed is not None else w):
                     all_fetches[step + i] = outs[i]
                 step += w
+                if feed is not None:
+                    # the pod agreed: publish this window's cursor,
+                    # adopt the peers' (they committed the same way),
+                    # then — on a shrink — deterministically re-home
+                    # the lost host's lanes across the survivors
+                    feed.commit()
+                    for h, v in verdicts.items():
+                        if h != hid:
+                            feed.observe(v[2])
+                    if lost:
+                        feed.rebalance(live)
+                    if step % ckpt_every == 0 or step == n \
+                            or feed.all_drained():
+                        # all_drained: the break below must leave the
+                        # final committed batches checkpointed, not
+                        # trailing the returned results
+                        trainer._save(step)
+                        feed.record_metrics()
                 if watchdog.straggler_action_due() \
                         and step % ckpt_every != 0 and step != n:
                     trainer._save(step)
@@ -1104,6 +1378,10 @@ class ElasticTrainer(PodResilientTrainer):
                                            "elastic_grow",
                                            joined=[jhid], step=step)
                             known_live = live
+                            if feed is not None:
+                                # give the joiner its stream lanes back
+                                # at the same barrier that ships state
+                                feed.rebalance(live)
                             tag = "%s_h%d_n%d" % (run_tag, jhid, nonce)
                             co.barrier("ship" + tag, hid)
                             self._ship_state(hid, trainer, live, jhid,
@@ -1134,9 +1412,14 @@ class ElasticTrainer(PodResilientTrainer):
                                                    trainer, base_axes,
                                                    step)
                         if got is None:
-                            return all_fetches
+                            return result()
                         step, rnd, restarts = got
                         known_live = sorted(co.live_hosts())
+                if feed is not None and feed.all_drained():
+                    # decided from the agreed cursor map (identical on
+                    # every live host after observe/rebalance), never
+                    # from per-host views — all hosts break together
+                    break
                 continue
             # -- transient: pod-wide consensus rewind (parent semantics,
             #    restored straight onto the CURRENT — possibly shrunk —
@@ -1158,13 +1441,21 @@ class ElasticTrainer(PodResilientTrainer):
             agreed_step = co.elect_restore_step(
                 hid, report["valid_steps"],
                 name="%se%d" % (run_tag, rnd))
+            if feed is not None and lost:
+                # a shrink and a transient fault in the SAME window:
+                # re-home the dead host's lanes first so the cursor
+                # restore maps lane ownership onto the surviving set
+                feed.rebalance(live)
             got = trainer._restore(
                 step=agreed_step,
                 shardings=self._current_shardings(trainer))
+            # the restored scope carries the LR (and applied-factor
+            # marker) from save time — reconcile with CURRENT capacity
+            self._apply_lr_scale(trainer, live)
             record_event("pod_restore", step=got)
             step = got
         co.barrier(run_tag + "pod_end", hid)
-        return all_fetches
+        return result()
 
     def _rejoin_or_exit(self, hid, run_tag, trainer, base_axes, step):
         """Fenced-host tail: announce a rejoin and wait for admission.

@@ -207,9 +207,20 @@ def metrics(event_list=None, by_host=False):
                                              restores, stragglers, ...)
       <prefix>_faults_total{point=,fault=}   injected/observed faults by
                                              injection point and kind
+      <prefix>_feed_rebalance_total          data-plane lane re-maps on
+                                             membership change (emitted
+                                             only once any occurred)
+      <prefix>_feed_epoch{host=}             gauge: slowest owned feed
+                                             lane's epoch per host
+      <prefix>_feed_stream_lag{host=}        gauge: committed samples a
+                                             host's feed streams trail
+                                             the most-advanced host
       <prefix>_restore_latency_seconds       checkpoint-restore wall time
                                              (from restore events'
                                              latency_s)
+
+    The result dict also carries a ``gauges`` list (same shape as
+    counters) for the feed-plane last-value series.
 
     ``metrics_text()`` renders the exposition format; a scraper
     sidecar/pushgateway can serve it as-is (or pull it live from
@@ -243,11 +254,32 @@ def metrics(event_list=None, by_host=False):
         {"name": METRIC_PREFIX + "_faults_total",
          "labels": {"point": p, "fault": f}, "value": n}
         for (p, f), n in sorted(fault_counts.items())]
+    # feed-plane series (elastic data plane): emitted only when the
+    # corresponding events exist, so feed-less jobs export nothing new
+    n_rebalance = sum(1 for e in evs if e["kind"] == "feed_rebalance")
+    if n_rebalance:
+        counters.append({"name": METRIC_PREFIX + "_feed_rebalance_total",
+                         "labels": {}, "value": n_rebalance})
+    last_epoch, last_lag = {}, {}
+    for e in evs:
+        if e["kind"] == "feed_epoch":
+            last_epoch[e.get("host")] = e.get("epoch", 0)
+        elif e["kind"] == "feed_lag":
+            last_lag[e.get("host")] = e.get("lag", 0)
+    gauges = []
+    for name, series in ((METRIC_PREFIX + "_feed_epoch", last_epoch),
+                         (METRIC_PREFIX + "_feed_stream_lag", last_lag)):
+        gauges += [{"name": name,
+                    "labels": {} if h is None else {"host": str(h)},
+                    "value": v}
+                   for h, v in sorted(series.items(),
+                                      key=lambda kv: str(kv[0]))]
     restore_lat = [e["latency_s"] for e in evs
                    if e["kind"] == "restore" and "latency_s" in e]
     histograms = [_histogram(METRIC_PREFIX + "_restore_latency_seconds",
                              restore_lat, RESTORE_LATENCY_BUCKETS)]
-    return {"counters": counters, "histograms": histograms}
+    return {"counters": counters, "gauges": gauges,
+            "histograms": histograms}
 
 
 def _fmt_labels(labels):
@@ -268,6 +300,12 @@ def metrics_text(m=None):
             lines.append("# TYPE %s counter" % c["name"])
         lines.append("%s%s %g" % (c["name"], _fmt_labels(c["labels"]),
                                   c["value"]))
+    for g in m.get("gauges", ()):
+        if g["name"] not in seen_type:
+            seen_type.add(g["name"])
+            lines.append("# TYPE %s gauge" % g["name"])
+        lines.append("%s%s %g" % (g["name"], _fmt_labels(g["labels"]),
+                                  g["value"]))
     for h in m["histograms"]:
         lines.append("# TYPE %s histogram" % h["name"])
         for le, n in h["buckets"]:
@@ -695,7 +733,7 @@ class ResilientTrainer(object):
     def __init__(self, executor, program, ckpt_dir, fetch_list=None,
                  checkpoint_every=10, max_restarts=3, retry_policy=None,
                  steps_per_dispatch=1, keep_last=3, scope=None,
-                 async_checkpoints=False):
+                 async_checkpoints=False, feed=None):
         from .compiler import CompiledProgram
         if checkpoint_every < 1:
             raise ValueError("checkpoint_every must be >= 1")
@@ -719,6 +757,11 @@ class ResilientTrainer(object):
         # async_checkpoints=True moves the file commit off the step path
         # (io.save_checkpoint blocking=False; single-host only)
         self._async_ckpt = bool(async_checkpoints)
+        # feed: an attached reader.ShardedFeed — the trainer pulls its
+        # windows from it (run(feeds=None, steps=N)), checkpoints carry
+        # the feed cursor, and a restore rewinds the DATA position too,
+        # so replay re-reads the exact batch sequence
+        self._feed = feed
 
     # -- events convenience ------------------------------------------------
     @staticmethod
@@ -727,11 +770,13 @@ class ResilientTrainer(object):
 
     def _save(self, step):
         from .. import io as io_mod
+        feed_state = None if self._feed is None \
+            else self._feed.global_state()
         io_mod.save_checkpoint(self._executor, self._ckpt_dir,
                                self._program, step=step,
                                keep_last=self._keep_last,
                                blocking=not self._async_ckpt,
-                               scope=self._scope)
+                               scope=self._scope, feed_state=feed_state)
         record_event("ckpt", step=step)
 
     def _restore(self, step=None, shardings=None):
@@ -745,32 +790,62 @@ class ResilientTrainer(object):
         shardings: optional {var: jax.sharding.Sharding} passed through
         to io.load_checkpoint so the restore materializes straight onto
         the CURRENT mesh — what lets a checkpoint written at 8 hosts
-        restore onto an elastically-shrunk 6-host topology."""
+        restore onto an elastically-shrunk 6-host topology.
+
+        With a feed attached, the checkpoint's dataset cursor is
+        restored into it at the same time (ownership re-mapped onto the
+        feed's current live set), so the replay re-reads the exact batch
+        sequence; a feed-mode checkpoint that carries no cursor is a
+        FATAL FeedStateError — replaying from a wrong data position
+        would silently break exactly-once."""
         from .. import io as io_mod
         t0 = time.perf_counter()
         try:
             io_mod.wait_for_pending_saves()
         except Exception as e:
             record_event("ckpt_async_error", error=type(e).__name__)
-        got = int(io_mod.load_checkpoint(self._executor, self._ckpt_dir,
+        if self._feed is not None:
+            got, feed_state = io_mod.load_checkpoint(
+                self._executor, self._ckpt_dir, self._program, step=step,
+                scope=self._scope, shardings=shardings,
+                with_feed_state=True)
+            if feed_state is None:
+                from ..reader.sharded_feed import FeedStateError
+                raise FeedStateError(
+                    "checkpoint step %s in %s carries no feed cursor but "
+                    "a ShardedFeed is attached — restoring params without "
+                    "the data position would re-read or skip samples"
+                    % (got, self._ckpt_dir))
+            self._feed.restore(feed_state)
+        else:
+            got = io_mod.load_checkpoint(self._executor, self._ckpt_dir,
                                          self._program, step=step,
                                          scope=self._scope,
-                                         shardings=shardings))
+                                         shardings=shardings)
+        got = int(got)
         record_event("restore", step=got,
                      latency_s=time.perf_counter() - t0)
         return got
 
     def _dispatch(self, feeds, step, w, fetch_list):
+        return self._dispatch_batches(feeds[step:step + w], fetch_list)
+
+    def _dispatch_batches(self, batches, fetch_list):
+        """Run one window of batch feed dicts; returns the per-batch
+        fetch lists (shared by the list-driven and ShardedFeed paths)."""
         import numpy as np
-        if w == 1:
-            return [self._executor.run(self._target, feed=feeds[step],
+        if not batches:
+            return []
+        if len(batches) == 1:
+            return [self._executor.run(self._target, feed=batches[0],
                                        fetch_list=fetch_list,
                                        scope=self._scope)]
-        stacked = _stack_feeds(feeds[step:step + w])
+        stacked = _stack_feeds(list(batches))
         outs = self._executor.run_steps(self._target, feed=stacked,
                                         fetch_list=fetch_list,
                                         scope=self._scope)
-        return [[np.asarray(o)[i] for o in outs] for i in range(w)]
+        return [[np.asarray(o)[i] for o in outs]
+                for i in range(len(batches))]
 
     def _require_fresh_dir(self):
         """Refuse a pre-populated ckpt_dir: this run's step_0 baseline
@@ -797,10 +872,19 @@ class ResilientTrainer(object):
                 "would fall into Executor.run's eager path")
         return fetch_list
 
-    def run(self, feeds, fetch_list=None):
+    def run(self, feeds=None, fetch_list=None, steps=None):
         """Run one step per feed dict in ``feeds``, recovering from
         transient faults. Returns the per-step fetch lists (replayed
-        steps report their replayed — identical — values)."""
+        steps report their replayed — identical — values).
+
+        ``feeds=None`` switches to the attached :class:`ShardedFeed`
+        (``feed=`` at construction): up to ``steps`` dispatch windows
+        pull their batches from the feed, the cursor rides every
+        checkpoint, and a restore rewinds the data position with the
+        params — exact-batch resume. The run ends early when the feed
+        drains (``epochs=`` bound)."""
+        if feeds is None:
+            return self._run_feed(fetch_list, steps)
         feeds = list(feeds)
         n = len(feeds)
         fetch_list = self._resolved_fetch_list(fetch_list)
@@ -833,27 +917,80 @@ class ResilientTrainer(object):
                     self._save(step)
                     record_event("straggler_ckpt", step=step)
             except Exception as e:
-                if not self._policy.is_transient(e):
-                    record_event("fatal", step=step,
-                                 error=type(e).__name__)
-                    raise
-                restarts += 1
-                if restarts > self._max_restarts:
-                    record_event("giveup", step=step, restarts=restarts,
-                                 error=type(e).__name__)
-                    raise RestartBudgetExceededError(
-                        "restart budget (%d) exhausted at step %d; last "
-                        "error: %r" % (self._max_restarts, step, e))
-                delay = self._policy.delay_s(restarts - 1)
-                record_event("restart", step=step, restarts=restarts,
-                             error=type(e).__name__, backoff_s=delay)
-                _logger().warning(
-                    "step %d failed (%s: %s) — restart %d/%d after %.2fs",
-                    step, type(e).__name__, e, restarts,
-                    self._max_restarts, delay)
-                self._policy.sleep(delay)
-                step = self._restore()
+                step, restarts = self._recover(e, step, restarts)
         return all_fetches
+
+    def _recover(self, e, step, restarts):
+        """Shared single-host fault tail for run()/_run_feed(): classify,
+        spend restart budget, back off, restore (params + any attached
+        feed cursor). Returns the rewound (step, restarts); re-raises
+        fatal errors and budget exhaustion."""
+        if not self._policy.is_transient(e):
+            record_event("fatal", step=step, error=type(e).__name__)
+            raise e
+        restarts += 1
+        if restarts > self._max_restarts:
+            record_event("giveup", step=step, restarts=restarts,
+                         error=type(e).__name__)
+            raise RestartBudgetExceededError(
+                "restart budget (%d) exhausted at step %d; last "
+                "error: %r" % (self._max_restarts, step, e))
+        delay = self._policy.delay_s(restarts - 1)
+        record_event("restart", step=step, restarts=restarts,
+                     error=type(e).__name__, backoff_s=delay)
+        _logger().warning(
+            "step %d failed (%s: %s) — restart %d/%d after %.2fs",
+            step, type(e).__name__, e, restarts,
+            self._max_restarts, delay)
+        self._policy.sleep(delay)
+        return self._restore(), restarts
+
+    def _run_feed(self, fetch_list, steps):
+        """Feed-driven loop: windows pull from the attached ShardedFeed,
+        ``step`` counts committed batches, every checkpoint carries the
+        cursor, every restore rewinds it. Ends at ``steps`` batches or
+        when the feed drains, whichever is first."""
+        if self._feed is None:
+            raise ValueError(
+                "run(feeds=None) pulls from an attached ShardedFeed — "
+                "pass feed= at construction (or pass feeds explicitly)")
+        if steps is None or int(steps) < 1:
+            raise ValueError("feed-driven run needs steps= >= 1 (an "
+                             "upper bound; the feed draining ends the "
+                             "run early)")
+        n = int(steps)
+        fetch_list = self._resolved_fetch_list(fetch_list)
+        all_fetches = [None] * n
+        self._require_fresh_dir()
+        self._save(0)
+        step, restarts = 0, 0
+        while step < n:
+            until_ckpt = self._checkpoint_every \
+                - (step % self._checkpoint_every)
+            w = min(self._steps_per_dispatch, n - step, until_ckpt)
+            try:
+                batches = self._feed.draw(w)
+                outs = self._dispatch_batches(batches, fetch_list)
+                # the window ran: publish the cursor — a later fault
+                # rewinds it to the last checkpoint with the params
+                self._feed.commit()
+                for i in range(len(outs)):
+                    all_fetches[step + i] = outs[i]
+                step += len(batches)
+                drained = self._feed.drained
+                at_boundary = step % self._checkpoint_every == 0 \
+                    or step == n or drained
+                if at_boundary:
+                    self._save(step)
+                    self._feed.record_metrics()
+                elif watchdog.straggler_action_due():
+                    self._save(step)
+                    record_event("straggler_ckpt", step=step)
+                if drained:
+                    break
+            except Exception as e:
+                step, restarts = self._recover(e, step, restarts)
+        return all_fetches[:step]
 
 
 def __getattr__(name):

@@ -293,8 +293,16 @@ def wait_for_pending_saves():
 
 
 def save_checkpoint(executor, dirname, main_program=None, step=None,
-                    keep_last=3, blocking=True, scope=None):
+                    keep_last=3, blocking=True, scope=None,
+                    feed_state=None):
     """Sharded checkpoint of the whole training scope.
+
+    feed_state: optional JSON-serializable dataset cursor (e.g.
+    ``reader.ShardedFeed.global_state()``) persisted in the manifest's
+    ``feed_state`` field, next to the params. It carries its own
+    ``version`` key (reader.FEED_STATE_VERSION); scrub classification is
+    untouched by its presence or absence — the field rides the manifest
+    JSON that scrub already reads, and no payload bytes are added.
 
     Multi-host semantics: every process calls this with the same args;
     each writes only its addressable (deduped) shards, all processes
@@ -380,6 +388,8 @@ def save_checkpoint(executor, dirname, main_program=None, step=None,
             manifest = {"format_version": CKPT_FORMAT_VERSION,
                         "step": step_no, "process_count": n_proc,
                         "vars": manifest_vars}
+            if feed_state is not None:
+                manifest["feed_state"] = feed_state
             _atomic_write(os.path.join(full_dir, MANIFEST_FILE),
                           json.dumps(manifest))
             _atomic_write(os.path.join(dirname, "latest"), step_dir)
@@ -669,10 +679,12 @@ def _quarantine_step_dir(dirname, step_dir, reason):
 
 
 def _load_step_dir(dirname, step_dir, shardings):
-    """Load one step dir; returns (step, {name: array}) or raises on any
-    corruption (missing/torn manifest, missing shard files or keys).
-    Nothing is written to the scope here — a partial load must not
-    poison live training state."""
+    """Load one step dir; returns (step, {name: array}, feed_state) or
+    raises on any corruption (missing/torn manifest, missing shard files
+    or keys). Nothing is written to the scope here — a partial load must
+    not poison live training state. feed_state is the manifest's
+    dataset cursor (None when the save carried none, and always None
+    for legacy format-0 dirs)."""
     import jax
     full_dir = os.path.join(dirname, step_dir)
     manifest_path = os.path.join(full_dir, MANIFEST_FILE)
@@ -681,7 +693,7 @@ def _load_step_dir(dirname, step_dir, shardings):
         arrays = _load_arrays(full_dir, PARAMS_FILE)
         out = {name.replace("__AT__", "@"): np.asarray(arr)
                for name, arr in arrays.items()}
-        return int(step_dir.split("_")[1]), out
+        return int(step_dir.split("_")[1]), out, None
 
     with open(manifest_path) as f:
         manifest = json.load(f)
@@ -723,7 +735,7 @@ def _load_step_dir(dirname, step_dir, shardings):
     finally:
         for h in handles.values():
             h.close()
-    return int(manifest["step"]), out
+    return int(manifest["step"]), out, manifest.get("feed_state")
 
 
 def _step_no(step_dir):
@@ -731,8 +743,13 @@ def _step_no(step_dir):
 
 
 def load_checkpoint(executor, dirname, main_program=None, shardings=None,
-                    step=None, scope=None):
+                    step=None, scope=None, with_feed_state=False):
     """Restore the latest VALID checkpoint into the global scope.
+
+    with_feed_state: when True, return ``(step, feed_state)`` instead of
+    the bare step — feed_state is the dataset cursor the save persisted
+    (see ``save_checkpoint(feed_state=)``), or None when the manifest
+    carries none (pre-cursor and legacy checkpoints load unchanged).
 
     shardings: optional {var_name: jax.sharding.Sharding} — vars listed
     are materialized straight onto the CURRENT mesh via
@@ -759,11 +776,11 @@ def load_checkpoint(executor, dirname, main_program=None, shardings=None,
     wait_for_pending_saves()   # an in-flight async commit must land first
     scope = scope if scope is not None else global_scope()
     if step is not None:
-        got, out = _load_step_dir(dirname, "step_%d" % int(step),
-                                  shardings or {})
+        got, out, fs = _load_step_dir(dirname, "step_%d" % int(step),
+                                      shardings or {})
         for name, arr in out.items():
             scope.set_var(name, arr)
-        return got
+        return (got, fs) if with_feed_state else got
     latest = None
     try:
         with open(os.path.join(dirname, "latest")) as f:
@@ -788,7 +805,8 @@ def load_checkpoint(executor, dirname, main_program=None, shardings=None,
     first_err = None
     for step_dir in candidates:
         try:
-            step, out = _load_step_dir(dirname, step_dir, shardings or {})
+            step, out, fs = _load_step_dir(dirname, step_dir,
+                                           shardings or {})
         except (OSError, ValueError, KeyError, IndexError) as e:
             reason = _scrub_step_dir(dirname, step_dir)
             if reason is None:
@@ -804,7 +822,7 @@ def load_checkpoint(executor, dirname, main_program=None, shardings=None,
         if step_dir != latest and jax.process_index() == 0:
             # repair the pointer so later saves/loads agree on history
             _atomic_write(os.path.join(dirname, "latest"), step_dir)
-        return step
+        return (step, fs) if with_feed_state else step
     if first_err is not None:
         raise first_err
     raise FileNotFoundError("no checkpoint found under %s" % dirname)

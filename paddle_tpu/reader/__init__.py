@@ -8,3 +8,5 @@ from .decorator import (batch, shuffle, buffered, chain, compose, firstn,
                         ComposeNotAligned,
                         map_readers, xmap_readers, cache, multiprocess_reader)
 from .dataloader import DataLoader  # noqa
+from .sharded_feed import (ShardedFeed, FeedStateError,  # noqa
+                           FEED_STATE_VERSION)

@@ -24,20 +24,38 @@ def batch(reader, batch_size, drop_last=False):
     return batch_reader
 
 
-def shuffle(reader, buf_size):
+def shuffle(reader, buf_size, seed=None):
+    """Buffered shuffle. ``seed=None`` keeps the legacy behavior (the
+    global ``random`` module — nondeterministic under concurrency).
+    With a seed, each epoch (= each call of the returned reader) uses a
+    fresh local ``random.Random`` derived from ``(seed, epoch)``:
+    different epochs shuffle differently, but a rewind-and-replay that
+    rebuilds the pipeline reproduces the exact sample order — the data
+    half of the resilience stack's bitwise-identical replay. The string
+    seeding goes through hashlib, so the order is stable across
+    processes (no PYTHONHASHSEED exposure)."""
+    epoch_box = [0]
+
     def shuffle_reader():
+        if seed is None:
+            rng = random
+        else:
+            e = epoch_box[0]
+            epoch_box[0] = e + 1
+            rng = random.Random("paddle_tpu.shuffle:%d:%d"
+                                % (int(seed), e))
         buf = []
-        for e in reader():
-            buf.append(e)
+        for x in reader():
+            buf.append(x)
             if len(buf) >= buf_size:
-                random.shuffle(buf)
-                for x in buf:
-                    yield x
+                rng.shuffle(buf)
+                for b in buf:
+                    yield b
                 buf = []
         if buf:
-            random.shuffle(buf)
-            for x in buf:
-                yield x
+            rng.shuffle(buf)
+            for b in buf:
+                yield b
     return shuffle_reader
 
 

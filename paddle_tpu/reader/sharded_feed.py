@@ -1,0 +1,443 @@
+"""Elastic, checkpointable sharded data feeds.
+
+Reference parity: the reference fleet re-splits dataset file lists when
+trainer membership changes (``distributed/fleet.py`` re-assigns filelists
+per trainer; ``incubate/data_generator`` streams per-worker shards). This
+module ports that pattern onto the TPU pod's coordinator so the *data*
+side of recovery is as exact as the parameter side:
+
+  * the sample space is partitioned into ``n_hosts`` **lanes** — lane
+    ``l``'s share of epoch ``e`` is ``file_perm(seed, e)[l::n_hosts]``,
+    a *splittable* derivation: any host can compute any lane's file and
+    sample order from ``(seed, epoch, file_id)`` alone, so moving a lane
+    between hosts moves only a tiny cursor, never data or RNG objects;
+  * every cursor is ``{"epoch", "pos", "offset"}`` — epoch counter,
+    index into the lane's file share, sample offset inside the (seeded,
+    per-epoch shuffled) file — and the feed exposes the full pod map via
+    :meth:`global_state` / :meth:`restore` so checkpoints carry the
+    exact data position (``io.save_checkpoint(feed_state=...)``);
+  * reads are transactional: :meth:`next_batch`/:meth:`draw` advance a
+    *tentative* cursor, :meth:`commit` publishes it and
+    :meth:`rollback`/:meth:`restore` discard it — the trainer commits
+    only windows the whole pod agreed on, which is what makes the
+    "every sample exactly once" census hold across faults;
+  * :meth:`rebalance` re-maps lanes onto a new live-host set
+    (``lane l -> live[l % len(live)]`` — deterministic, identity at full
+    membership) so a dead host's unconsumed ranges flow to survivors and
+    flow back on rejoin, all from the agreed cursor map.
+
+The coordinator half lives in ``framework/coordination.py``: the window
+status exchange carries each host's tentative cursor, so every host
+always holds an agreed, committed view of every lane (``observe``).
+"""
+import copy
+import random
+
+import numpy as np
+
+__all__ = ["ShardedFeed", "FeedStateError", "FEED_STATE_VERSION"]
+
+FEED_STATE_VERSION = 1
+
+
+class FeedStateError(ValueError):
+    """A feed cursor is missing, malformed, from a newer library, or
+    describes a different dataset/config than this feed was built with.
+    Deliberately a ValueError: the resilience classifier treats it as
+    FATAL — replaying from a wrong data position would silently corrupt
+    the 'exactly once' guarantee, so it must never be retried away."""
+
+
+def _default_collate(samples):
+    """Stack a list of samples into one batch feed.
+
+    dict samples -> {key: stacked array}; array-likes -> stacked array."""
+    first = samples[0]
+    if isinstance(first, dict):
+        return {k: np.stack([np.asarray(s[k]) for s in samples])
+                for k in first}
+    return np.stack([np.asarray(s) for s in samples])
+
+
+class ShardedFeed(object):
+    """Fault-tolerant sharded feed over a list of sample files.
+
+    ``files``: list of indexable sample containers (or zero-arg callables
+    returning one — materialized lazily, cached). Samples are whatever
+    the collate function understands; the default stacks dict-of-array
+    samples into a feed dict. ``n_hosts`` is the FULL pod topology (the
+    lane count — frozen for the feed's lifetime; membership changes
+    re-map lanes, never re-cut them). ``epochs=None`` streams forever;
+    an integer bounds the feed and :attr:`drained` turns True when every
+    owned lane has served its last epoch.
+
+    Determinism: with the same ``(files, n_hosts, seed)`` every
+    permutation is derived from string-seeded ``random.Random`` (stable
+    across processes and runs — no PYTHONHASHSEED exposure), so a
+    restored cursor resumes the *exact* sample sequence, per lane,
+    regardless of which host now owns the lane.
+    """
+
+    def __init__(self, files, n_hosts, host_id, seed=0, batch_size=None,
+                 shuffle=True, epochs=None, collate=None):
+        if n_hosts < 1:
+            raise ValueError("n_hosts must be >= 1")
+        if not 0 <= int(host_id) < int(n_hosts):
+            raise ValueError("host_id %r out of range for %d hosts"
+                             % (host_id, n_hosts))
+        self._files = list(files)
+        self.n_lanes = int(n_hosts)
+        self.n_hosts = int(n_hosts)
+        self._host_id = int(host_id)
+        self.seed = int(seed)
+        self.batch_size = None if batch_size is None else int(batch_size)
+        self.shuffle = bool(shuffle)
+        self.epochs = None if epochs is None else int(epochs)
+        if self.epochs is not None and self.epochs < 1:
+            raise ValueError("epochs must be >= 1 (or None for unbounded)")
+        self._collate = collate or _default_collate
+        if len(self._files) < self.n_lanes:
+            raise ValueError(
+                "ShardedFeed needs at least as many files as hosts "
+                "(%d files < %d hosts): every lane must have a non-empty "
+                "share each epoch" % (len(self._files), self.n_lanes))
+        # empty files are rejected loudly: an all-empty lane share under
+        # shuffle=False would otherwise spin _draw_from_lane through
+        # epochs forever. Sequences are len()-probed here (free);
+        # callables stay LAZY and are validated on first materialization
+        for fid, f in enumerate(self._files):
+            if not callable(f) and len(f) == 0:
+                raise ValueError(
+                    "ShardedFeed file %d is empty — every file must "
+                    "hold at least one sample" % fid)
+        self._materialized = {}
+        self._lens = {}
+        # caches for the splittable derivations (bounded: see _cache_put)
+        self._file_perms = {}
+        self._sample_perms = {}
+        self._share_counts = {}
+        # (lane, epoch) -> samples served in all epochs BEFORE epoch.
+        # Unbounded on purpose: one int per lane-epoch, and keeping it
+        # makes _consumed O(1) instead of O(epoch) per candidate lane
+        # on every next_batch draw of a long run
+        self._epoch_prefix = {}
+        # committed view of EVERY lane (the agreed pod map) ...
+        fresh = {"epoch": 0, "pos": 0, "offset": 0}
+        self._known = {l: dict(fresh) for l in range(self.n_lanes)}
+        self._live = list(range(self.n_lanes))
+        # ... and this host's owned slice: committed + tentative cursors
+        self._own = self._owned_lanes(self._live)
+        self._lanes = {l: dict(fresh) for l in self._own}
+        self._pending = {l: dict(fresh) for l in self._own}
+        self._last_epoch_event = None
+
+    # -- dataset access ----------------------------------------------------
+    def _file(self, fid):
+        f = self._files[fid]
+        if callable(f):
+            if fid not in self._materialized:
+                data = list(f())
+                if not data:
+                    raise ValueError(
+                        "ShardedFeed file %d (callable) produced no "
+                        "samples — every file must hold at least one"
+                        % fid)
+                self._materialized[fid] = data
+            return self._materialized[fid]
+        return f
+
+    def _file_len(self, fid):
+        if fid not in self._lens:
+            self._lens[fid] = len(self._file(fid))
+        return self._lens[fid]
+
+    @property
+    def samples_per_epoch(self):
+        return sum(self._file_len(f) for f in range(len(self._files)))
+
+    # -- splittable RNG derivations ----------------------------------------
+    # string-seeded random.Random uses the hashlib path internally:
+    # deterministic across processes, unaffected by PYTHONHASHSEED.
+    def _rng(self, *key):
+        return random.Random("paddle_tpu.feed:" +
+                             ":".join(str(k) for k in key))
+
+    def _file_perm(self, epoch):
+        if epoch not in self._file_perms:
+            perm = list(range(len(self._files)))
+            if self.shuffle:
+                self._rng(self.seed, epoch).shuffle(perm)
+            self._cache_put(self._file_perms, epoch, perm)
+        return self._file_perms[epoch]
+
+    def _sample_perm(self, epoch, fid):
+        key = (epoch, fid)
+        if key not in self._sample_perms:
+            perm = list(range(self._file_len(fid)))
+            if self.shuffle:
+                self._rng(self.seed, epoch, fid).shuffle(perm)
+            self._cache_put(self._sample_perms, key, perm)
+        return self._sample_perms[key]
+
+    @staticmethod
+    def _cache_put(cache, key, value, cap=256):
+        if len(cache) >= cap:   # epochs advance monotonically: dropping
+            cache.clear()       # everything is a rare, cheap full miss
+        cache[key] = value
+
+    def _share(self, lane, epoch):
+        return self._file_perm(epoch)[lane::self.n_lanes]
+
+    def _share_count(self, lane, epoch):
+        key = (lane, epoch)
+        if key not in self._share_counts:
+            n = sum(self._file_len(f) for f in self._share(lane, epoch))
+            self._cache_put(self._share_counts, key, n)
+        return self._share_counts[key]
+
+    # -- cursor math -------------------------------------------------------
+    def _exhausted(self, cur):
+        return self.epochs is not None and cur["epoch"] >= self.epochs
+
+    def _consumed_epochs(self, lane, epoch):
+        """Samples lane ``lane`` serves across epochs [0, epoch) —
+        extends the nearest cached prefix, so the steady state (epoch
+        advancing one at a time) costs O(1) per draw."""
+        if (lane, epoch) not in self._epoch_prefix:
+            e = epoch
+            while e > 0 and (lane, e) not in self._epoch_prefix:
+                e -= 1
+            total = self._epoch_prefix.get((lane, e), 0)
+            while e < epoch:
+                total += self._share_count(lane, e)
+                e += 1
+            self._epoch_prefix[(lane, epoch)] = total
+        return self._epoch_prefix[(lane, epoch)]
+
+    def _consumed(self, lane, cur):
+        """Total samples this lane has served up to ``cur``."""
+        total = self._consumed_epochs(lane, cur["epoch"])
+        if not self._exhausted(cur):
+            share = self._share(lane, cur["epoch"])
+            total += sum(self._file_len(f) for f in share[:cur["pos"]])
+            total += cur["offset"]
+        return total
+
+    def _draw_from_lane(self, lane, cur, k):
+        """Advance ``cur`` by up to ``k`` samples of lane ``lane``;
+        returns the samples (shorter at the lane's final-epoch tail)."""
+        out = []
+        while len(out) < k and not self._exhausted(cur):
+            share = self._share(lane, cur["epoch"])
+            if cur["pos"] >= len(share):
+                cur["epoch"] += 1
+                cur["pos"] = 0
+                cur["offset"] = 0
+                continue
+            fid = share[cur["pos"]]
+            order = self._sample_perm(cur["epoch"], fid)
+            if cur["offset"] >= len(order):
+                cur["pos"] += 1
+                cur["offset"] = 0
+                continue
+            out.append(self._file(fid)[order[cur["offset"]]])
+            cur["offset"] += 1
+        return out
+
+    # -- reading -----------------------------------------------------------
+    def next_batch(self):
+        """Draw one batch from the least-consumed owned lane (tentative —
+        call :meth:`commit` once the step using it is agreed). Batches
+        never span lanes, so re-partitioning lanes re-partitions the
+        batch stream exactly. Returns None when every owned lane has
+        served its ``epochs`` quota (see :attr:`drained`)."""
+        while True:
+            cands = [l for l in self._own
+                     if not self._exhausted(self._pending[l])]
+            if not cands:
+                return None
+            # least-consumed first (ties -> lowest lane id): derived
+            # purely from the cursors, so a restore replays the same
+            # lane interleave with no extra state
+            lane = min(cands, key=lambda l:
+                       (self._consumed(l, self._pending[l]), l))
+            samples = self._draw_from_lane(lane, self._pending[lane],
+                                           self.batch_size or 1)
+            if not samples:      # cursor sat exactly on the lane's end
+                continue
+            if self.batch_size is None:
+                return samples[0]
+            return self._collate(samples)
+
+    def draw(self, k):
+        """Up to ``k`` batches (one dispatch window's worth)."""
+        out = []
+        for _ in range(int(k)):
+            b = self.next_batch()
+            if b is None:
+                break
+            out.append(b)
+        return out
+
+    @property
+    def drained(self):
+        """True when every owned lane has served its ``epochs`` quota
+        (tentative view — matches what the next draw would see)."""
+        return all(self._exhausted(self._pending[l]) for l in self._own)
+
+    def all_drained(self):
+        """True when EVERY lane in the agreed pod map has served its
+        ``epochs`` quota. Because the map is identical on every live
+        host after a committed exchange, all hosts answer the same —
+        the pod's drain consensus is computed, never voted."""
+        return self.epochs is not None and all(
+            self._exhausted(c) for c in self._known.values())
+
+    @property
+    def epoch(self):
+        """Progress marker: the slowest owned lane's epoch (the quota
+        when drained or nothing is owned)."""
+        if not self._own:
+            return 0 if self.epochs is None else self.epochs
+        return min(self._pending[l]["epoch"] for l in self._own)
+
+    # -- transactions ------------------------------------------------------
+    def commit(self):
+        """Publish the tentative cursors: the window they fed was agreed
+        by the pod. Mirrors the owned slice into the pod map."""
+        self._lanes = copy.deepcopy(self._pending)
+        for l, cur in self._lanes.items():
+            self._known[l] = dict(cur)
+
+    def rollback(self):
+        """Discard tentative reads (an un-agreed window re-draws them)."""
+        self._pending = copy.deepcopy(self._lanes)
+
+    # -- pod map exchange --------------------------------------------------
+    def exchange_state(self):
+        """This host's contribution to the window status exchange: its
+        owned lanes' TENTATIVE cursors plus the drained flag. Peers
+        observe it only after the window commits."""
+        return {"lanes": {str(l): dict(c)
+                          for l, c in self._pending.items()},
+                "drained": self.drained}
+
+    def observe(self, peer_state):
+        """Fold a peer's (just-committed) exchange contribution into the
+        pod map. Lanes this host currently owns are never overwritten —
+        the local committed value is at least as fresh."""
+        if not peer_state:
+            return
+        for l_str, cur in (peer_state.get("lanes") or {}).items():
+            l = int(l_str)
+            if l not in self._lanes and 0 <= l < self.n_lanes:
+                self._known[l] = {"epoch": int(cur["epoch"]),
+                                  "pos": int(cur["pos"]),
+                                  "offset": int(cur["offset"])}
+
+    def global_state(self):
+        """The agreed, committed cursor of EVERY lane — what checkpoints
+        persist (``io.save_checkpoint(feed_state=...)``) and what a
+        rejoining host adopts. JSON-serializable and topology-free:
+        restoring onto a different live set just re-maps lane ownership.
+        """
+        return {"version": FEED_STATE_VERSION, "seed": self.seed,
+                "n_files": len(self._files), "n_lanes": self.n_lanes,
+                "epochs": self.epochs,
+                "lanes": {str(l): dict(c)
+                          for l, c in self._known.items()}}
+
+    # ``state()`` is the single-host-friendly alias
+    state = global_state
+
+    def restore(self, state, live=None):
+        """Adopt a :meth:`global_state` snapshot (from a checkpoint or a
+        rejoin sync). ``live`` re-maps lane ownership at the same time —
+        an 8-host cursor restored onto 6 live hosts resumes the exact
+        global batch sequence with the 2 lost lanes re-homed."""
+        if not isinstance(state, dict) or "lanes" not in state:
+            raise FeedStateError("feed cursor is missing or malformed: %r"
+                                 % (state,))
+        version = int(state.get("version", 0))
+        if version > FEED_STATE_VERSION:
+            raise FeedStateError(
+                "feed cursor version %d is newer than this library's %d"
+                % (version, FEED_STATE_VERSION))
+        for key, mine in (("seed", self.seed),
+                          ("n_files", len(self._files)),
+                          ("n_lanes", self.n_lanes),
+                          ("epochs", self.epochs)):
+            theirs = state.get(key, mine)
+            if theirs != mine:
+                raise FeedStateError(
+                    "feed cursor %s=%r does not match this feed's %r — "
+                    "the cursor describes a different dataset or config"
+                    % (key, theirs, mine))
+        lanes = state["lanes"]
+        missing = [l for l in range(self.n_lanes) if str(l) not in lanes]
+        if missing:
+            raise FeedStateError("feed cursor is missing lanes %s"
+                                 % missing)
+        self._known = {l: {"epoch": int(lanes[str(l)]["epoch"]),
+                           "pos": int(lanes[str(l)]["pos"]),
+                           "offset": int(lanes[str(l)]["offset"])}
+                       for l in range(self.n_lanes)}
+        self._remap(self._live if live is None else live)
+
+    # -- membership --------------------------------------------------------
+    def _owned_lanes(self, live):
+        if self._host_id not in live:
+            return []
+        return [l for l in range(self.n_lanes)
+                if live[l % len(live)] == self._host_id]
+
+    def _remap(self, live):
+        self._live = sorted(int(h) for h in live)
+        self._own = self._owned_lanes(self._live)
+        self._lanes = {l: dict(self._known[l]) for l in self._own}
+        self._pending = copy.deepcopy(self._lanes)
+
+    def rebalance(self, live):
+        """Deterministically re-map lanes onto the new live set
+        (``lane l -> live[l % len(live)]``; the identity map at full
+        membership, so a full-mesh rejoin restores the original split).
+        Resumes every lane from the agreed committed cursor, so the dead
+        host's unconsumed ranges move wholesale to survivors — no sample
+        lost, none duplicated. Also the grow half: the re-admitted host
+        takes its lanes back at the admission barrier."""
+        old = set(self._own)
+        self._remap(live)
+        new = set(self._own)
+        from ..framework.resilience import record_event
+        record_event("feed_rebalance",
+                     capacity="%d/%d" % (len(self._live), self.n_lanes),
+                     gained=sorted(new - old), dropped=sorted(old - new))
+
+    # -- observability -----------------------------------------------------
+    def totals(self):
+        """{host: committed samples served by its current lanes} from
+        the agreed pod map — the per-host stream progress."""
+        out = {}
+        for l in range(self.n_lanes):
+            owner = self._live[l % len(self._live)] if self._live else None
+            if owner is None:
+                continue
+            out[owner] = out.get(owner, 0) \
+                + self._consumed(l, self._known[l])
+        return out
+
+    def record_metrics(self):
+        """Emit the feed-plane gauges into the resilience event log:
+        ``feed_epoch`` (slowest owned lane, on change) and ``feed_lag``
+        (samples behind the most-advanced host). The trainer calls this
+        at checkpoint boundaries, keeping the bounded log quiet."""
+        from ..framework.resilience import record_event
+        ep = self.epoch
+        if ep != self._last_epoch_event:
+            self._last_epoch_event = ep
+            record_event("feed_epoch", epoch=int(ep))
+        totals = self.totals()
+        if totals:
+            mine = totals.get(self._host_id, 0)
+            record_event("feed_lag",
+                         lag=int(max(totals.values()) - mine))

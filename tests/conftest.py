@@ -49,6 +49,10 @@ def pytest_configure(config):
         "markers",
         "pod: pod-level coordinated-recovery tests (threaded "
         "LocalCoordinator only — tier-1-safe)")
+    config.addinivalue_line(
+        "markers",
+        "data: elastic data plane tests (ShardedFeed cursors, "
+        "membership re-balancing, exact-batch resume)")
 
 
 @pytest.fixture(autouse=True)

@@ -1,0 +1,364 @@
+"""Elastic data plane, trainer level: ShardedFeed-driven training with
+checkpointed cursors, membership-aware stream re-balancing and
+exact-batch resume (framework/coordination.ElasticTrainer feed mode +
+resilience.ResilientTrainer feed mode).
+
+tests/test_elastic.py proves the PARAMETER side of elastic recovery;
+this battery proves the data side finally matches it: a host death
+mid-epoch re-homes its stream ranges onto the survivors with a
+full-epoch census of exactly-once consumption, and a consensus rewind
+restores the dataset cursor with the params so the replayed batch
+sequence is identical — including when the restoring topology differs
+from the saving one."""
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers, optimizer
+from paddle_tpu.framework import resilience
+from paddle_tpu.framework.coordination import (ElasticTrainer,
+                                               LocalCoordinator,
+                                               PodResilientTrainer)
+from paddle_tpu.framework.resilience import ResilientTrainer, RetryPolicy
+from paddle_tpu.framework.scope import Scope, scope_guard
+from paddle_tpu.reader import ShardedFeed
+
+pytestmark = [pytest.mark.faultinject, pytest.mark.pod, pytest.mark.data]
+
+POD_TIMEOUT_S = 300.0
+FEATURES = 6
+
+
+@pytest.fixture(autouse=True)
+def _clean_resilience():
+    resilience.install(None)
+    resilience.clear_events()
+    yield
+    resilience.install(None)
+    resilience.clear_events()
+
+
+def _fast_policy():
+    return RetryPolicy(base_delay_s=0.0, jitter=0.0, sleep=lambda s: None)
+
+
+def _data_program():
+    """Plain Program (replicated math — elasticity is pure control/data
+    plane): fc regression + a sample-id passthrough fetch for the
+    census."""
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [FEATURES], dtype="float32")
+        y = layers.data("y", [1], dtype="float32")
+        sid = layers.data("sid", [1], dtype="float32")
+        pred = layers.fc(x, size=1,
+                         param_attr=pt.ParamAttr(name="ed_w"),
+                         bias_attr=pt.ParamAttr(name="ed_b"))
+        loss = layers.reduce_mean(layers.square(pred - y))
+        optimizer.Adam(0.05).minimize(loss)
+    return main, startup, loss, sid
+
+
+def _sample_files(n_files, per_file, seed=0):
+    """Files of dict samples with globally unique ids riding along."""
+    rng = np.random.RandomState(seed)
+    w = rng.randn(FEATURES, 1).astype(np.float32)
+    files = []
+    for f in range(n_files):
+        rows = []
+        for i in range(per_file):
+            xv = rng.randn(FEATURES).astype(np.float32)
+            rows.append({"x": xv, "y": (xv @ w).astype(np.float32),
+                         "sid": np.float32([f * per_file + i])})
+        files.append(rows)
+    return files
+
+
+def _make_feed_pod(tmp_path, tag, files, n_hosts, batch=2, epochs=1,
+                   checkpoint_every=2, rejoin=True, seed=5, **elastic_kw):
+    main, startup, loss, sid = _data_program()
+    trainers = []
+    for h in range(n_hosts):
+        sc, exe = Scope(), pt.Executor()
+        with scope_guard(sc):
+            exe.run(startup)
+        feed = ShardedFeed(files, n_hosts, h, seed=seed,
+                           batch_size=batch, epochs=epochs)
+        trainers.append(ResilientTrainer(
+            exe, main, str(tmp_path / tag / ("h%d" % h)),
+            fetch_list=[loss, sid], checkpoint_every=checkpoint_every,
+            scope=sc, retry_policy=_fast_policy(), feed=feed))
+    pod = ElasticTrainer(
+        trainers, LocalCoordinator(n_hosts, timeout_s=POD_TIMEOUT_S),
+        rejoin=rejoin, **elastic_kw)
+    return pod, trainers, loss
+
+
+def _census(outs_by_host):
+    ids = []
+    for outs in outs_by_host:
+        if outs is None:
+            continue
+        for o in outs:
+            ids.extend(int(s) for s in np.asarray(o[1]).ravel())
+    return sorted(ids)
+
+
+def _losses(outs):
+    return np.asarray([float(np.asarray(o[0]).ravel()[0]) for o in outs])
+
+
+# ---------------------------------------------------------------------------
+# single host: cursor through save/restore (resilience.ResilientTrainer)
+# ---------------------------------------------------------------------------
+
+def test_single_host_feed_exact_resume(tmp_path):
+    """A preemption mid-epoch restores params AND cursor: the committed
+    batch stream is identical to the uninterrupted run, sample for
+    sample and loss for loss."""
+    files = _sample_files(4, 6)
+
+    def run_one(tag, spec=None):
+        main, startup, loss, sid = _data_program()
+        sc, exe = Scope(), pt.Executor()
+        with scope_guard(sc):
+            exe.run(startup)
+        feed = ShardedFeed(files, 1, 0, seed=3, batch_size=3, epochs=1)
+        tr = ResilientTrainer(exe, main, str(tmp_path / tag),
+                              fetch_list=[loss, sid],
+                              checkpoint_every=2, scope=sc,
+                              retry_policy=_fast_policy(), feed=feed)
+        if spec:
+            with resilience.inject(spec):
+                return tr.run(steps=50)
+        return tr.run(steps=50)
+
+    ref = run_one("ref")
+    assert len(ref) == 8                       # 24 samples / batch 3
+    resilience.clear_events()
+    got = run_one("chaos", spec="step:preempt@4")
+    assert resilience.events("restore")        # a real rewind happened
+    np.testing.assert_array_equal(_losses(got), _losses(ref))
+    assert _census([got]) == _census([ref]) == list(range(24))
+
+
+def test_feed_mode_validation(tmp_path):
+    main, startup, loss, _sid = _data_program()
+    sc, exe = Scope(), pt.Executor()
+    with scope_guard(sc):
+        exe.run(startup)
+    tr = ResilientTrainer(exe, main, str(tmp_path / "v"),
+                          fetch_list=[loss], scope=sc)
+    with pytest.raises(ValueError, match="attached ShardedFeed"):
+        tr.run(steps=4)
+    feed = ShardedFeed(_sample_files(2, 2), 1, 0)
+    tr2 = ResilientTrainer(exe, main, str(tmp_path / "v2"),
+                           fetch_list=[loss], scope=sc, feed=feed)
+    with pytest.raises(ValueError, match="steps"):
+        tr2.run()
+    # pods refuse mixed feed/feed-less trainers
+    with pytest.raises(ValueError, match="ShardedFeed attached"):
+        PodResilientTrainer([tr, tr2], LocalCoordinator(2))
+    pod, _, _ = _make_feed_pod(tmp_path, "v3", _sample_files(4, 2), 2)
+    with pytest.raises(ValueError, match="steps"):
+        pod.run(None)
+    # mismatched feed topology / copy-pasted host slots are loud
+    files = _sample_files(4, 2)
+
+    def pod_with_feeds(tag, feeds):
+        trainers = []
+        for h, fd in enumerate(feeds):
+            sc2, exe2 = Scope(), pt.Executor()
+            trainers.append(ResilientTrainer(
+                exe2, main, str(tmp_path / tag / str(h)),
+                fetch_list=[loss], scope=sc2, feed=fd))
+        return PodResilientTrainer(trainers, LocalCoordinator(2))
+
+    with pytest.raises(ValueError, match="built for 4 hosts"):
+        pod_with_feeds("v4", [ShardedFeed(files, 4, h)
+                              for h in range(2)])
+    with pytest.raises(ValueError, match="host slot"):
+        pod_with_feeds("v5", [ShardedFeed(files, 2, 0),
+                              ShardedFeed(files, 2, 0)])
+
+
+# ---------------------------------------------------------------------------
+# pod: consensus rewind replays the identical batch sequence
+# ---------------------------------------------------------------------------
+
+def test_pod_rewind_replays_identical_batches(tmp_path):
+    """ACCEPTANCE (exact resume): kill + consensus rewind with cursor
+    restore replays the identical batch sequence — per-step loss
+    equality against the uninterrupted run, on every host."""
+    files = _sample_files(6, 4)
+    ref_pod, _, _ = _make_feed_pod(tmp_path, "ref", files, 2, batch=3)
+    ref = ref_pod.run(None, steps=50)
+
+    resilience.clear_events()
+    pod, _, _ = _make_feed_pod(tmp_path, "chaos", files, 2, batch=3)
+    with resilience.inject("step:preempt@5"):
+        out = pod.run(None, steps=50)
+    assert resilience.events("pod_restore")
+    assert not resilience.events("elastic_shrink")
+    for h in range(2):
+        np.testing.assert_array_equal(_losses(out[h]), _losses(ref[h]))
+        assert _census([out[h]]) == _census([ref[h]])
+    assert _census(out) == list(range(24))
+
+
+def test_plain_pod_feed_rewind(tmp_path):
+    """The non-elastic PodResilientTrainer threads the cursor through
+    its rewind too (feed-driven windows, drain consensus)."""
+    files = _sample_files(4, 4)
+    main, startup, loss, sid = _data_program()
+
+    def mk(tag):
+        trainers = []
+        for h in range(2):
+            sc, exe = Scope(), pt.Executor()
+            with scope_guard(sc):
+                exe.run(startup)
+            feed = ShardedFeed(files, 2, h, seed=5, batch_size=2,
+                               epochs=1)
+            trainers.append(ResilientTrainer(
+                exe, main, str(tmp_path / tag / ("h%d" % h)),
+                fetch_list=[loss, sid], checkpoint_every=2, scope=sc,
+                retry_policy=_fast_policy(), feed=feed))
+        return PodResilientTrainer(
+            trainers, LocalCoordinator(2, timeout_s=POD_TIMEOUT_S))
+
+    ref = mk("ref").run(None, steps=50)
+    resilience.clear_events()
+    with resilience.inject("step:preempt@3"):
+        out = mk("chaos").run(None, steps=50)
+    assert resilience.events("pod_restore")
+    for h in range(2):
+        np.testing.assert_array_equal(_losses(out[h]), _losses(ref[h]))
+    assert _census(out) == list(range(16))
+
+
+# ---------------------------------------------------------------------------
+# the chaos acceptance: die mid-epoch -> shrink -> rejoin -> census
+# ---------------------------------------------------------------------------
+
+def test_elastic_census_die_shrink_rejoin_full_mesh(tmp_path):
+    """ACCEPTANCE (census): a host dies mid-epoch; survivors absorb its
+    stream ranges and keep training (no rewind); the host rejoins and
+    takes its lanes back; the full-epoch census shows every sample
+    consumed exactly once across shrink -> rejoin -> full mesh."""
+    files = _sample_files(8, 4)                # 32 samples
+    pod, trainers, _ = _make_feed_pod(tmp_path, "census", files, 4)
+    with resilience.inject("step:die@10"):     # ~window 3 of 4-host run
+        out = pod.run(None, steps=40)
+
+    kinds = [e["kind"] for e in resilience.events()]
+    assert "pod_restore" not in kinds and "restore" not in kinds
+    assert resilience.events("elastic_shrink")
+    grow = resilience.events("elastic_grow")
+    assert grow and grow[-1]["capacity"] == "4/4"
+    assert resilience.events("rejoin")
+    rebalances = resilience.events("feed_rebalance")
+    assert {e["capacity"] for e in rebalances} >= {"3/4", "4/4"}
+    died = {e["host"] for e in resilience.events("host_death")}
+    assert len(died) == 1
+    # EVERY sample exactly once, across the whole membership story —
+    # the dead host's pre-death committed batches plus the re-homed
+    # remainder on the survivors plus the joiner's post-rejoin batches
+    assert _census(out) == list(range(32))
+    # lanes returned home: at full membership the map is the identity
+    for h, t in enumerate(trainers):
+        assert t._feed._own == [h]
+    # feed gauges surfaced through the boundary metrics hook
+    m = resilience.metrics()
+    names = {c["name"] for c in m["counters"]}
+    assert "paddle_tpu_resilience_feed_rebalance_total" in names
+    assert any(g["name"] == "paddle_tpu_resilience_feed_epoch"
+               for g in m["gauges"])
+
+
+def test_topology_change_resume_census(tmp_path):
+    """Exact resume ACROSS a topology change: the pod shrinks 3 -> 2
+    mid-epoch (no rejoin), then a transient fault rewinds the survivors
+    to the step-0 checkpoint — whose cursor map was written at FULL
+    topology. The restore re-maps the 3-lane cursor onto the 2
+    survivors, and their replayed epoch serves every sample exactly
+    once (the fenced host's pre-rewind output is retroactively
+    superseded)."""
+    files = _sample_files(6, 4)                # 24 samples
+    # checkpoint_every huge: the only common checkpoint is step 0, so
+    # the rewind MUST cross the membership change
+    pod, trainers, _ = _make_feed_pod(tmp_path, "topo", files, 3,
+                                      checkpoint_every=100,
+                                      rejoin=False)
+    with resilience.inject("step:die@7;step:preempt@12"):
+        out = pod.run(None, steps=60)
+    assert resilience.events("elastic_shrink")
+    restores = resilience.events("pod_restore")
+    assert restores and restores[-1]["step"] == 0
+    died = {e["host"] for e in resilience.events("host_death")}
+    assert len(died) == 1
+    survivors = [out[h] for h in range(3) if h not in died]
+    assert _census(survivors) == list(range(24))
+
+
+# ---------------------------------------------------------------------------
+# LR rescale on capacity change (satellite)
+# ---------------------------------------------------------------------------
+
+def _lr_value(trainer):
+    sc = trainer._scope
+    names = [n for n in sc.keys() if "learning_rate" in n]
+    assert names, "optimizer learning-rate var not found"
+    return float(np.asarray(sc.find_var(names[0])).ravel()[0])
+
+
+def test_lr_rescale_on_shrink(tmp_path):
+    """Fixed-per-host-batch regime: losing 1 of 3 hosts shrinks the
+    global batch by 1/3, so lr_rescale=True scales the LR to 2/3 — with
+    the capacity-labelled lr_rescale event."""
+    files = _sample_files(6, 4)
+    pod, trainers, _ = _make_feed_pod(tmp_path, "lr", files, 3,
+                                      rejoin=False, lr_rescale=True)
+    with resilience.inject("step:die@7"):
+        pod.run(None, steps=60)
+    died = {e["host"] for e in resilience.events("host_death")}
+    ev = resilience.events("lr_rescale")
+    assert ev and ev[-1]["capacity"] == "2/3"
+    assert abs(ev[-1]["factor"] - 2.0 / 3.0) < 1e-6
+    for h in range(3):
+        if h not in died:
+            assert abs(_lr_value(trainers[h]) - 0.05 * 2 / 3) < 1e-6
+
+
+def test_lr_rescale_returns_to_one_on_rejoin(tmp_path):
+    """Shrink scales down, the rejoin's grow scales back: after the full
+    mesh is restored every host (including the re-absorbed one) runs at
+    the original LR."""
+    files = _sample_files(8, 4)
+    pod, trainers, _ = _make_feed_pod(tmp_path, "lr2", files, 4,
+                                      lr_rescale=True)
+    with resilience.inject("step:die@10"):
+        pod.run(None, steps=40)
+    caps = [e["capacity"] for e in resilience.events("lr_rescale")]
+    assert "3/4" in caps and "4/4" in caps
+    for t in trainers:
+        assert abs(_lr_value(t) - 0.05) < 1e-6
+
+
+def test_lr_rescale_gradient_merge_compensation(tmp_path):
+    """Gradient-merge-aware: an operator who doubles the accumulation
+    steps when capacity halves keeps the effective global batch — and
+    the LR must NOT move (factor 1.0, no event)."""
+    files = _sample_files(4, 4)
+    pod, trainers, _ = _make_feed_pod(
+        tmp_path, "lr3", files, 2, rejoin=False, lr_rescale=True,
+        grad_merge_steps=lambda live: 2 // live)
+    with resilience.inject("step:die@3"):
+        pod.run(None, steps=40)
+    assert not resilience.events("lr_rescale")
+    died = {e["host"] for e in resilience.events("host_death")}
+    for h in range(2):
+        if h not in died:
+            assert abs(_lr_value(trainers[h]) - 0.05) < 1e-9
