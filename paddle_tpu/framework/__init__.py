@@ -11,6 +11,7 @@ from . import unique_name  # noqa
 from . import watchdog  # noqa
 from . import resilience  # noqa
 from . import coordination  # noqa
+from . import transport  # noqa
 from .watchdog import (CollectiveTimeoutError, wait_with_timeout,  # noqa
                        StragglerDetector)
 from .resilience import (FaultInjector, RetryPolicy,  # noqa
@@ -18,6 +19,8 @@ from .resilience import (FaultInjector, RetryPolicy,  # noqa
                          ServerOverloadedError, DeadlineExceededError,
                          RestartBudgetExceededError)
 from .coordination import (Coordinator, LocalCoordinator,  # noqa
-                           FileCoordinator, PodResilientTrainer,
+                           FileCoordinator, SocketCoordinator,
+                           PodResilientTrainer,
                            CoordinationError, HostLostError,
                            NoQuorumError)
+from .transport import CoordServer, CoordClient, TransportError  # noqa

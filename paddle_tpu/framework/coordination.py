@@ -23,6 +23,12 @@ process; this module is the pod half:
     sharing a filesystem. Every contribution is an atomic file write;
     no shared memory, so N processes each owning one FileCoordinator
     object agree through the directory alone.
+  * :class:`SocketCoordinator` — network-based, for real multi-process
+    pods WITHOUT shared storage (the reference's pserver/brpc shape).
+    The coordination KV state lives in a stdlib-TCP rendezvous service
+    (framework/transport.py, deployable via ``tools/coordsvc.py``);
+    liveness is real — clients heartbeat the server and a missed
+    deadline tombstones the host, no declaration needed.
   * :class:`PodResilientTrainer` — wraps N per-host
     :class:`~.resilience.ResilientTrainer` s. Every dispatch window ends
     in a status exchange; if ANY host saw a transient fault, every host
@@ -42,7 +48,8 @@ from .resilience import RestartBudgetExceededError, record_event
 __all__ = [
     "CoordinationError", "HostLostError", "BarrierTimeoutError",
     "NoQuorumError", "Coordinator", "LocalCoordinator",
-    "FileCoordinator", "PodResilientTrainer", "ElasticTrainer",
+    "FileCoordinator", "SocketCoordinator", "PodResilientTrainer",
+    "ElasticTrainer",
 ]
 
 
@@ -415,18 +422,38 @@ class FileCoordinator(Coordinator):
 
         <root>/lost/host_<i>              tombstone (fence), reason text
         <root>/rounds/<name>/host_<i>.json   one contribution per round
+        <root>/hb/hb_<i>.json             heartbeat (liveness lease)
 
-    Polling (``poll_s``) replaces condition variables; round names must
+    Polling (``poll_s``) replaces condition variables, backing off
+    exponentially up to ``poll_max_s`` so a long barrier does not spin
+    the filesystem at 100 Hz per host; round names must
     be unique per live round exactly as with LocalCoordinator
     (PodResilientTrainer namespaces every round by a per-run counter).
     The last host to read a completed round removes its directory, so
     the rounds dir stays bounded over a long job. A RESTARTED process
     must rejoin on a fresh coordinator root as a new participant — its
     old incarnation is fenced, and replaying old round names against a
-    stale root would read stale contributions."""
+    stale root would read stale contributions.
+
+    ``hb_deadline_s`` arms heartbeat liveness (SocketCoordinator
+    parity): every host touches ``hb/hb_<i>.json`` on each gather poll,
+    and any host whose heartbeat file goes stale past the deadline is
+    auto-tombstoned by whichever peer notices first — declared-loss-only
+    detection stops being a FileCoordinator quirk. A host with NO
+    heartbeat file is never auto-fenced (it may not have started; the
+    gather deadline still covers it), and the deadline must exceed the
+    longest stretch a healthy host computes between gathers (the
+    dispatch window), since hosts only heartbeat while polling.
+    Staleness compares the scanner's wall clock against the heartbeat
+    file's mtime, which on a shared mount is stamped by the WRITER (or
+    the NFS server): size ``hb_deadline_s`` to absorb the pod's worst
+    cross-host clock skew plus the mount's attribute-cache lag, or
+    healthy hosts will be fenced spuriously. (SocketCoordinator has no
+    such bound — its ages live on one clock, the server's.)"""
 
     def __init__(self, root, n_hosts, timeout_s=30.0, poll_s=0.01,
-                 detect_loss=True, mesh_reinit=True):
+                 detect_loss=True, mesh_reinit=True, poll_max_s=0.25,
+                 hb_deadline_s=None):
         super(FileCoordinator, self).__init__(
             n_hosts, timeout_s=timeout_s, detect_loss=detect_loss,
             mesh_reinit=mesh_reinit)
@@ -435,14 +462,33 @@ class FileCoordinator(Coordinator):
         self._lost_dir = os.path.join(root, "lost")
         self._rounds_dir = os.path.join(root, "rounds")
         self._join_dir = os.path.join(root, "joins")
+        self._hb_dir = os.path.join(root, "hb")
         self.poll_s = float(poll_s)
+        self.poll_max_s = max(self.poll_s, float(poll_max_s))
+        self.hb_deadline_s = None if hb_deadline_s is None \
+            else float(hb_deadline_s)
+        if self.hb_deadline_s is not None:
+            # a host only touches its heartbeat between poll sleeps, so
+            # the backoff cap must sit well inside the deadline — at or
+            # past it, a healthy host mid-sleep looks stale and a peer
+            # fences it spuriously
+            if self.poll_s * 4.0 > self.hb_deadline_s:
+                raise ValueError(
+                    "hb_deadline_s=%g is too tight for poll_s=%g: a "
+                    "healthy host's heartbeat legitimately ages one "
+                    "poll interval between touches" %
+                    (self.hb_deadline_s, self.poll_s))
+            self.poll_max_s = min(self.poll_max_s,
+                                  self.hb_deadline_s / 4.0)
         # per-PROCESS loss knowledge: tombstones written by peers must
         # fire THIS process's _on_loss (mesh re-init is per-process
         # state) exactly once, whoever won the race to write them
         self._known_lost = set()
+        self._last_hb_scan = 0.0
         os.makedirs(self._lost_dir, exist_ok=True)
         os.makedirs(self._rounds_dir, exist_ok=True)
         os.makedirs(self._join_dir, exist_ok=True)
+        os.makedirs(self._hb_dir, exist_ok=True)
 
     @staticmethod
     def _safe(name):
@@ -507,6 +553,56 @@ class FileCoordinator(Coordinator):
         # a future re-loss of this host must re-fire _on_loss here
         self._known_lost.discard(host_id)
 
+    def _touch_hb(self, host_id):
+        """Refresh this host's liveness lease (no-op unless armed)."""
+        if self.hb_deadline_s is None:
+            return
+        import os
+        from ..io import _atomic_write
+        _atomic_write(os.path.join(self._hb_dir, "hb_%d.json" % host_id),
+                      '{"t": %r}' % time.time())
+
+    def _scan_heartbeats(self, lost):
+        """Tombstone every un-fenced host whose heartbeat file went
+        stale past the deadline; returns the (possibly updated) lost
+        map so the caller's poll iteration needs no second lost-dir
+        listing. Scans are THROTTLED to ~deadline/4 — stating N
+        heartbeat files on every poll tick would be exactly the
+        filesystem spin the backoff exists to cool. First tombstone
+        wins (atomic-write parity with the gather-timeout path); the
+        regular newly-observed machinery fires the loss hooks."""
+        if self.hb_deadline_s is None:
+            return lost
+        import os
+        from ..io import _atomic_write
+        now = time.time()
+        if now - self._last_hb_scan < self.hb_deadline_s / 4.0:
+            return lost
+        self._last_hb_scan = now
+        lost = dict(lost)
+        for f in os.listdir(self._hb_dir):
+            if not f.startswith("hb_"):
+                continue
+            try:
+                hid = int(f[3:].split(".", 1)[0])
+            except ValueError:    # pragma: no cover - foreign file
+                continue
+            if hid in lost or hid >= self.n_hosts:
+                continue
+            try:
+                age = now - os.stat(os.path.join(self._hb_dir,
+                                                 f)).st_mtime
+            except OSError:       # pragma: no cover - peer mid-replace
+                continue
+            if age > self.hb_deadline_s:
+                reason = ("missed heartbeat (%.2fs > %.2fs)"
+                          % (age, self.hb_deadline_s))
+                _atomic_write(
+                    os.path.join(self._lost_dir, "host_%d" % hid),
+                    reason)
+                lost[hid] = reason
+        return lost
+
     def all_gather(self, name, host_id, value=None, timeout_s=None):
         import json
         import os
@@ -529,6 +625,8 @@ class FileCoordinator(Coordinator):
                 "names must be unique per round" % (host_id, name))
         _atomic_write(mine, json.dumps({"value": value}))
         done_path = os.path.join(rd, "_done.json")
+        self._touch_hb(host_id)
+        sleep_s = self.poll_s
         while True:
             # completion is STICKY (LocalCoordinator parity): the first
             # process to see every live host present freezes the member
@@ -543,9 +641,32 @@ class FileCoordinator(Coordinator):
                     break
                 except (OSError, ValueError):  # pragma: no cover - race
                     pass    # mid-replace glimpse: poll again
-            lost = self.lost_hosts()
-            present = {int(f[5:-5]) for f in os.listdir(rd)
-                       if f.startswith("host_") and f.endswith(".json")}
+            self._touch_hb(host_id)
+            lost = self._scan_heartbeats(self.lost_hosts())
+            if host_id in lost:
+                # fenced while polling: stop competing NOW. Also load-
+                # bearing for cleanup: the frozen member set excludes
+                # us, so once every member acks, the round dir is
+                # removed under our feet — without this check the
+                # listdir below would crash instead of fencing
+                raise HostLostError(
+                    "host %d is fenced (%s) — rejoin, don't resume"
+                    % (host_id, lost[host_id]))
+            try:
+                present = {int(f[5:-5]) for f in os.listdir(rd)
+                           if f.startswith("host_")
+                           and f.endswith(".json")}
+            except OSError:
+                # the members finished and removed the round dir in the
+                # window since the fence check — the next iteration's
+                # check raises the HostLostError (deadline-bounded so a
+                # filesystem anomaly can never spin forever)
+                if time.monotonic() >= deadline:
+                    raise BarrierTimeoutError(
+                        "round %r directory vanished and host %d was "
+                        "never fenced" % (name, host_id))
+                time.sleep(self.poll_s)
+                continue
             waiting_for = [i for i in range(self.n_hosts)
                            if i not in lost and i not in present]
             if not waiting_for:
@@ -581,7 +702,12 @@ class FileCoordinator(Coordinator):
                             os.path.join(self._lost_dir, "host_%d" % i),
                             "missed round %r" % name)
                 continue
-            time.sleep(self.poll_s)
+            # exponential backoff from poll_s up to poll_max_s (clamped
+            # to the remaining deadline): a long barrier idles at a few
+            # Hz instead of hammering the filesystem at 1/poll_s
+            time.sleep(min(sleep_s,
+                           max(0.0, deadline - time.monotonic())))
+            sleep_s = min(sleep_s * 2.0, self.poll_max_s)
         lost = self.lost_hosts()
         if host_id in lost:
             raise HostLostError(
@@ -612,6 +738,199 @@ class FileCoordinator(Coordinator):
         self._known_lost.update(lost)
         self._on_loss(newly_observed)
         return result
+
+
+# ---------------------------------------------------------------------------
+# socket-backed coordinator (multi-process pods WITHOUT shared storage)
+# ---------------------------------------------------------------------------
+
+class SocketCoordinator(Coordinator):
+    """Coordinator over a TCP rendezvous service — one object per
+    PROCESS, no shared filesystem anywhere.
+
+    The full protocol of Local/FileCoordinator (sticky round
+    completion, tombstone fencing, join announcements, consensus
+    elections) lives server-side in :class:`~.transport.CoordServer`
+    (in-process for tests, standalone via ``tools/coordsvc.py``); this
+    client implements the :class:`Coordinator` contract over it, so
+    :class:`PodResilientTrainer`/:class:`ElasticTrainer` run unmodified.
+
+    What the network transport adds over FileCoordinator:
+
+      * **Real liveness.** A daemon thread heartbeats the server every
+        ``hb_interval_s``; the server tombstones any host whose
+        heartbeat goes stale past its ``hb_deadline_s`` — a
+        ``kill -9`` is detected by the DEADLINE, not by a peer calling
+        :meth:`mark_lost` or waiting out a gather timeout. Every
+        response carries the server's lost map, so survivors fire their
+        loss hooks (mesh re-init) even with no gather in flight.
+      * **Transient-fault tolerance.** Socket errors reconnect and
+        re-send through the shared :class:`~.resilience.RetryPolicy`;
+        round contributions are idempotent server-side (keyed by
+        ``(name, host_id)`` plus a per-call token), so a replay after a
+        broken pipe never double-counts — and an imposter with a
+        different token still gets the split-brain
+        :class:`CoordinationError`.
+      * **Observability.** ``transport_reconnects_total`` and the
+        per-host ``transport_heartbeat_lag`` gauge ride
+        ``resilience.metrics()``.
+
+    ``host_id`` binds the object to its host (the heartbeat identity);
+    the per-call ``host_id`` arguments of the contract remain and must
+    match in a real deployment. ``heartbeat=False`` builds a passive
+    client (observers, tests driving liveness by hand)."""
+
+    def __init__(self, address, n_hosts, host_id, timeout_s=30.0,
+                 poll_s=0.01, poll_max_s=0.25, detect_loss=True,
+                 mesh_reinit=True, heartbeat=True, hb_interval_s=0.5,
+                 retry_policy=None):
+        super(SocketCoordinator, self).__init__(
+            n_hosts, timeout_s=timeout_s, detect_loss=detect_loss,
+            mesh_reinit=mesh_reinit)
+        from .transport import CoordClient
+        self.host_id = int(host_id)
+        self.poll_s = float(poll_s)
+        self.poll_max_s = max(self.poll_s, float(poll_max_s))
+        self._known_lost = set()
+        self._known_lock = threading.Lock()
+        self._lost_seen_v = -1
+        self._token_seq = 0
+        # per-INCARNATION token base: a reconnect replay from this
+        # process matches its own token (idempotent), while a duplicate
+        # process launched with the same host_id generates a different
+        # base and still gets the split-brain CoordinationError
+        import os as _os
+        import random as _random
+        self._token_base = "%d.%08x" % (_os.getpid(),
+                                        _random.getrandbits(32))
+        self._client = CoordClient(address, host_id=self.host_id,
+                                   retry_policy=retry_policy)
+        # hello validates the pod size before anything else rides the
+        # connection; the heartbeat (when armed) then takes the lease
+        self._call("hello", n_hosts=self.n_hosts)
+        if heartbeat:
+            self._client.start_heartbeat(interval_s=hb_interval_s,
+                                         on_lost=self._observe_lost)
+        else:
+            self._client._lost_cb = self._observe_lost
+
+    # -- loss observation (runs on gather AND heartbeat threads) ----------
+    def _observe_lost(self, lost, version=None):
+        """Fire _on_loss exactly once per process per tombstone —
+        including ones the server's heartbeat monitor wrote. The update
+        of _known_lost happens BEFORE the hooks so the nested
+        live_hosts() calls inside _on_loss cannot re-enter. ``version``
+        is the server's lost_v: a delayed delivery older than one we
+        already processed is DROPPED, so a pre-unfence map can never
+        re-fire hooks for a host this coordinator just readmitted (or
+        poison _known_lost into suppressing its next real loss)."""
+        with self._known_lock:
+            if version is not None:
+                if version < self._lost_seen_v:
+                    return
+                self._lost_seen_v = version
+            newly = sorted(set(lost) - self._known_lost
+                           - {self.host_id})
+            self._known_lost.update(lost)
+        if newly:
+            self._on_loss(newly)
+
+    def _call(self, cmd, **fields):
+        """call() with server errors mapped onto the Coordinator error
+        taxonomy (transport errors — ConnectionError — raise through
+        as transients for the caller's policy)."""
+        try:
+            return self._client.call(cmd, **fields)
+        except CoordinationError:
+            raise
+        except RuntimeError as e:
+            raise CoordinationError(str(e))
+
+    # -- contract ----------------------------------------------------------
+    def lost_hosts(self):
+        self._call("lost")            # call() refreshed last_lost
+        return dict(self._client.last_lost)
+
+    def live_hosts(self):
+        lost = self.lost_hosts()
+        return [i for i in range(self.n_hosts) if i not in lost]
+
+    def mark_lost(self, host_id, reason="declared lost"):
+        # the response's lost map runs through _observe_lost, which
+        # fires _on_loss for the newly tombstoned host
+        self._call("mark_lost", host=int(host_id), reason=reason)
+
+    def announce_join(self, host_id, nonce):
+        self._call("announce_join", host=int(host_id), nonce=int(nonce))
+
+    def pending_joins(self):
+        joins = self._call("pending_joins").get("joins", {})
+        return {int(h): int(n) for h, n in joins.items()}
+
+    def unfence(self, host_id):
+        self._call("unfence", host=int(host_id))
+        with self._known_lock:
+            # a future re-loss of this host must re-fire _on_loss here
+            self._known_lost.discard(int(host_id))
+
+    def all_gather(self, name, host_id, value=None, timeout_s=None):
+        deadline = time.monotonic() + (self.timeout_s if timeout_s is None
+                                       else float(timeout_s))
+        with self._known_lock:
+            self._token_seq += 1
+            token = "h%d.%s.%d" % (self.host_id, self._token_base,
+                                   self._token_seq)
+        resp = self._call("put", name=name, host=host_id, value=value,
+                          token=token)
+        if "fenced" in resp:
+            raise HostLostError(
+                "host %d is fenced (%s) — rejoin, don't resume"
+                % (host_id, resp["fenced"]))
+        sleep_s = self.poll_s
+        while True:
+            resp = self._call("poll", name=name, host=host_id)
+            if "fenced" in resp:
+                raise HostLostError(
+                    "host %d is fenced (%s) — rejoin, don't resume"
+                    % (host_id, resp["fenced"]))
+            if "done" in resp:
+                break
+            if time.monotonic() >= deadline:
+                waiting = resp.get("waiting", [])
+                if not self.detect_loss:
+                    raise BarrierTimeoutError(
+                        "round %r timed out waiting for hosts %s"
+                        % (name, waiting))
+                for i in waiting:
+                    # client-driven fencing at the gather deadline —
+                    # the slow path; the server's heartbeat monitor
+                    # usually tombstones a dead host long before this
+                    self._call("mark_lost", host=i,
+                               reason="missed round %r" % name)
+                continue
+            time.sleep(min(sleep_s,
+                           max(0.0, deadline - time.monotonic())))
+            sleep_s = min(sleep_s * 2.0, self.poll_max_s)
+        result = {int(h): v for h, v in resp["values"].items()}
+        if host_id in self._client.last_lost:
+            # fenced between the freeze and our exit (File/Local
+            # parity): the snapshot exists for the survivors; we fence
+            raise HostLostError(
+                "host %d is fenced (%s) — rejoin, don't resume"
+                % (host_id, self._client.last_lost[host_id]))
+        # last one out cleans up server-side; fenced hosts never ack —
+        # their rounds leak server-side, bounded by the loss count
+        self._call("ack", name=name, host=host_id)
+        return result
+
+    def close(self):
+        self._client.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
 
 
 # ---------------------------------------------------------------------------
@@ -985,9 +1304,18 @@ class ElasticTrainer(PodResilientTrainer):
     pure re-partitioning. Per-host feed streams would need a data-plane
     re-balancer to preserve the global batch — out of scope here.
 
-    Events: ``elastic_shrink`` / ``elastic_grow`` with ``capacity``
-    labels (plus the mesh/reshard events) land in the resilience log
-    and therefore in ``resilience.metrics()``.
+    Events: ``elastic_shrink`` / ``elastic_grow`` / ``elastic_drain``
+    with ``capacity`` labels (plus the mesh/reshard events) land in the
+    resilience log and therefore in ``resilience.metrics()``.
+
+    ``drain_after=k`` arms the PROACTIVE straggler drain: each host's
+    critical-straggler latch (``StragglerDetector(action_k=)``) rides
+    the window status exchange, and a host the pod saw flagged for k
+    consecutive windows is admitted as a PLANNED loss at the next
+    window boundary — the rejoin barriers in reverse: agree the drain
+    from the frozen verdicts, the straggler fences itself, the
+    survivors shrink — instead of every host stalling until the
+    straggler becomes a hard ``CollectiveTimeoutError``.
     """
 
     # checkpointed marker var: the LR-rescale factor currently applied
@@ -999,12 +1327,25 @@ class ElasticTrainer(PodResilientTrainer):
     def __init__(self, trainers, coordinator=None, max_restarts=3,
                  host_id=None, rejoin=True, sync_dir=None,
                  lr_rescale=False, grad_merge_steps=1,
-                 lr_rescale_hook=None):
+                 lr_rescale_hook=None, drain_after=None):
         super(ElasticTrainer, self).__init__(
             trainers, coordinator=coordinator, max_restarts=max_restarts,
             host_id=host_id)
         self._rejoin = bool(rejoin)
         self._sync_dir = sync_dir
+        # drain_after=k arms the PROACTIVE straggler drain: each host's
+        # critical-straggler latch (StragglerDetector action_k) rides
+        # the window status exchange; a host flagged for k CONSECUTIVE
+        # windows is drained at the next window boundary — the pod
+        # agrees the drain from the same frozen verdicts, the straggler
+        # fences itself (a planned loss), and the survivors take the
+        # ordinary elastic-shrink path instead of stalling until the
+        # straggler becomes a CollectiveTimeoutError. None disables.
+        if drain_after is not None and int(drain_after) < 1:
+            raise ValueError("drain_after must be >= 1 consecutive "
+                             "critical-straggler windows (or None)")
+        self._drain_after = None if drain_after is None \
+            else int(drain_after)
         # lr_rescale=True: the FIXED-PER-HOST-BATCH regime (per-host
         # feed streams — the global batch shrinks with the dp axis), so
         # capacity changes linearly rescale the learning rate,
@@ -1077,6 +1418,16 @@ class ElasticTrainer(PodResilientTrainer):
         with self._nonce_lock:
             self._nonces[hid] = self._nonces.get(hid, 0) + 1
             return self._nonces[hid]
+
+    def _straggler_flag(self, hid):
+        """This host's critical-straggler latch for the window status
+        exchange (and the pre-emptive straggler_ckpt). In production
+        there is one process-global detector per real host; the
+        threaded simulation SHARES the latch between simulated hosts,
+        so tests that need deterministic attribution override this
+        seam."""
+        from . import watchdog
+        return watchdog.straggler_action_due()
 
     # -- gradient-merge-aware LR rescale (fixed-per-host-batch regime) ----
     def _grad_merge_k(self, n_live):
@@ -1254,6 +1605,10 @@ class ElasticTrainer(PodResilientTrainer):
         ckpt_every = trainer._checkpoint_every
         step, restarts, rnd = 0, 0, 0
         known_live = sorted(co.live_hosts())
+        # proactive-drain accounting: per-host consecutive windows the
+        # critical-straggler flag was up (local to this host's loop —
+        # every host computes it from the same frozen verdicts)
+        strag_counts = {}
         while step < n:
             rnd += 1
             until_ckpt = ckpt_every - (step % ckpt_every)
@@ -1300,9 +1655,13 @@ class ElasticTrainer(PodResilientTrainer):
             # uncommitted draws are invisible, so its lanes re-home at
             # the last agreed position: nothing lost, nothing doubled
             exch = None if feed is None else feed.exchange_state()
+            # this host's critical-straggler latch rides the exchange:
+            # the pod-agreed view is what the proactive drain (and the
+            # pre-emptive straggler_ckpt below) acts on
+            strag = bool(self._straggler_flag(hid))
             try:
                 verdicts = co.all_gather("%sw%d" % (run_tag, rnd), hid,
-                                         [status, pending, exch])
+                                         [status, pending, exch, strag])
             except HostLostError:
                 # a peer's timeout fenced US (e.g. this host straggled
                 # past the collective deadline): stop competing
@@ -1354,8 +1713,7 @@ class ElasticTrainer(PodResilientTrainer):
                         # trailing the returned results
                         trainer._save(step)
                         feed.record_metrics()
-                if watchdog.straggler_action_due() \
-                        and step % ckpt_every != 0 and step != n:
+                if strag and step % ckpt_every != 0 and step != n:
                     trainer._save(step)
                     record_event("straggler_ckpt", step=step)
                 # admission rides the window boundary: every live host
@@ -1415,6 +1773,63 @@ class ElasticTrainer(PodResilientTrainer):
                             return result()
                         step, rnd, restarts = got
                         known_live = sorted(co.live_hosts())
+                        # same stop-competing pattern as the other
+                        # fence handlers: restart the window loop on
+                        # the adopted position instead of falling
+                        # through to drain/drain checks computed from
+                        # this round's now-stale verdicts
+                        continue
+                if self._drain_after:
+                    # membership for the drain decision is the FROZEN
+                    # round snapshot — a live co.live_hosts() query
+                    # here could differ between hosts mid-tombstone
+                    # and diverge the agreement
+                    frozen_live = sorted(verdicts)
+                    # PROACTIVE DRAIN: the rejoin barriers in reverse —
+                    # agree the drain (same frozen verdicts on every
+                    # host), fence at the boundary, shrink next window
+                    flags = {h: bool(v[3]) if len(v) > 3 else False
+                             for h, v in verdicts.items()}
+                    for h in list(strag_counts):
+                        if h not in flags:
+                            strag_counts.pop(h)
+                    for h, f in flags.items():
+                        strag_counts[h] = strag_counts.get(h, 0) + 1 \
+                            if f else 0
+                    due = [h for h in frozen_live
+                           if strag_counts.get(h, 0) >= self._drain_after]
+                    # a straggler signature is ASYMMETRIC: when every
+                    # live host latched (a systemic slowdown, or the
+                    # collective wait inflating everyone's latency),
+                    # there is no victim to drain — draining min(due)
+                    # would fence a healthy host and cascade
+                    if due and len(due) < len(frozen_live) \
+                            and len(frozen_live) > 1:
+                        drained = min(due)
+                        # full hysteresis: EVERY count resets, so the
+                        # post-shrink pod re-observes before it may
+                        # drain again (never one host per window)
+                        strag_counts.clear()
+                        record_event(
+                            "elastic_drain", drained=drained, step=step,
+                            capacity="%d/%d"
+                            % (len(frozen_live) - 1,
+                               self._coordinator.n_hosts),
+                            windows=self._drain_after)
+                        if drained == hid:
+                            # a PLANNED loss: fence ourselves at the
+                            # window boundary so the survivors' next
+                            # gather shrinks immediately instead of
+                            # stalling until this straggler becomes a
+                            # CollectiveTimeoutError. The orchestrator
+                            # restarts us; a healthy incarnation
+                            # rejoins through the normal admission.
+                            co.mark_lost(
+                                hid, "drained: critical straggler for "
+                                "%d consecutive windows"
+                                % self._drain_after)
+                            record_event("host_exit", step=step)
+                            return result()
                 if feed is not None and feed.all_drained():
                     # decided from the agreed cursor map (identical on
                     # every live host after observe/rebalance), never

@@ -215,6 +215,13 @@ def metrics(event_list=None, by_host=False):
       <prefix>_feed_stream_lag{host=}        gauge: committed samples a
                                              host's feed streams trail
                                              the most-advanced host
+      <prefix>_transport_reconnects_total    socket-coordinator client
+                                             reconnects (emitted only
+                                             once any occurred)
+      <prefix>_transport_heartbeat_lag{host=}  gauge: seconds a host's
+                                             liveness heartbeat cadence
+                                             is running behind (0 when
+                                             healthy)
       <prefix>_restore_latency_seconds       checkpoint-restore wall time
                                              (from restore events'
                                              latency_s)
@@ -260,15 +267,27 @@ def metrics(event_list=None, by_host=False):
     if n_rebalance:
         counters.append({"name": METRIC_PREFIX + "_feed_rebalance_total",
                          "labels": {}, "value": n_rebalance})
-    last_epoch, last_lag = {}, {}
+    # transport series (socket coordinator): reconnect attempts are a
+    # counter; the heartbeat cadence lag is a per-host last-value gauge
+    n_reconnect = sum(1 for e in evs
+                      if e["kind"] == "transport_reconnect")
+    if n_reconnect:
+        counters.append(
+            {"name": METRIC_PREFIX + "_transport_reconnects_total",
+             "labels": {}, "value": n_reconnect})
+    last_epoch, last_lag, last_hb = {}, {}, {}
     for e in evs:
         if e["kind"] == "feed_epoch":
             last_epoch[e.get("host")] = e.get("epoch", 0)
         elif e["kind"] == "feed_lag":
             last_lag[e.get("host")] = e.get("lag", 0)
+        elif e["kind"] == "transport_hb_lag":
+            last_hb[e.get("host")] = e.get("lag_s", 0.0)
     gauges = []
     for name, series in ((METRIC_PREFIX + "_feed_epoch", last_epoch),
-                         (METRIC_PREFIX + "_feed_stream_lag", last_lag)):
+                         (METRIC_PREFIX + "_feed_stream_lag", last_lag),
+                         (METRIC_PREFIX + "_transport_heartbeat_lag",
+                          last_hb)):
         gauges += [{"name": name,
                     "labels": {} if h is None else {"host": str(h)},
                     "value": v}

@@ -1,0 +1,555 @@
+"""Socket-backed pod rendezvous — the network transport under
+:class:`~.coordination.SocketCoordinator`.
+
+Reference parity: the reference pod coordinates over the network (the
+pserver/brpc RPC tier — trainers and pservers share no filesystem, only
+sockets). FileCoordinator ports the *protocol* but not the transport: it
+assumes a shared directory, and it only learns a host died when someone
+*declares* it. This module supplies the real thing with nothing but the
+stdlib:
+
+  * :class:`CoordServer` — one small TCP service holding the
+    coordination KV state: gather rounds (with the STICKY completion
+    semantics of Local/FileCoordinator: the first completion freezes the
+    member snapshot for every participant), tombstones (fencing), join
+    announcements, and per-host heartbeats. A background monitor
+    tombstones any registered host whose heartbeat goes stale past
+    ``hb_deadline_s`` — liveness becomes a property of the transport,
+    not of someone calling ``mark_lost``. Runnable in-process for tests
+    (``CoordServer(n).start()``) or standalone via ``tools/coordsvc.py``.
+  * :class:`CoordClient` — a tiny request/response client. Transient
+    socket errors are retried through the shared
+    :class:`~.resilience.RetryPolicy` (reconnect, then re-send — every
+    server op is idempotent, round contributions keyed by
+    ``(name, host_id)`` plus a client token so a replay after a broken
+    pipe never double-counts and an imposter never overwrites). A
+    daemon heartbeat thread keeps this host live and feeds the
+    observability gauges.
+
+Wire protocol: newline-delimited JSON, one request object per line, one
+response object per line, connections long-lived. Values are anything
+JSON encodes — the same envelope FileCoordinator already writes to its
+round files.
+
+Observability (rides ``resilience.metrics()``):
+  transport_reconnects_total   counter — client reconnect attempts
+  transport_heartbeat_lag      per-host gauge — seconds a host's
+                               heartbeat cadence is running behind
+                               (0 when healthy; grows during stalls)
+"""
+import collections
+import json
+import socket
+import socketserver
+import threading
+import time
+
+from .resilience import RetryPolicy, record_event
+
+__all__ = ["TransportError", "CoordServer", "CoordClient"]
+
+_DEFAULT_HB_INTERVAL_S = 0.5
+
+
+class TransportError(ConnectionError):
+    """The coordination service could not be reached (after retries).
+    Subclasses ConnectionError so resilience.classify treats it as
+    transient — the caller's RetryPolicy decides when to give up."""
+
+
+# ---------------------------------------------------------------------------
+# server
+# ---------------------------------------------------------------------------
+
+class _PodState(object):
+    """The coordination KV state, guarded by one lock.
+
+    Mirrors FileCoordinator's directory layout in memory:
+      lost:   {host_id: reason}           tombstones (fencing)
+      joins:  {host_id: nonce}            fenced hosts asking back in
+      rounds: {name: {"values", "tokens", "done", "acks"}}
+      hb:     {host_id: last monotonic}   heartbeats (hello/hb)
+    ``completed`` keeps the most recent frozen round names (bounded
+    deque — a long-running service must not grow by one string per
+    round forever) for test and tooling introspection.
+    """
+
+    def __init__(self, n_hosts, hb_deadline_s=None):
+        self.n_hosts = int(n_hosts)
+        self.hb_deadline_s = None if hb_deadline_s is None \
+            else float(hb_deadline_s)
+        self.lock = threading.Lock()
+        self.lost = {}
+        # bumped on EVERY membership mutation (tombstone and unfence):
+        # clients order the lost maps they observe by it, so a stale
+        # response processed late can never resurrect a cleared
+        # tombstone (or re-fire loss hooks for a readmitted host)
+        self.lost_version = 0
+        self.joins = {}
+        self.rounds = {}
+        self.hb = {}
+        self.completed = collections.deque(maxlen=2048)
+
+    # -- callers hold self.lock ------------------------------------------
+    def _mark_lost(self, host_id, reason):
+        if host_id in self.lost:
+            return False
+        self.lost[host_id] = str(reason)
+        self.lost_version += 1
+        self.joins.pop(host_id, None)
+        return True
+
+    def _scan_heartbeats(self, now):
+        """Tombstone every registered, un-fenced host whose heartbeat is
+        older than the deadline. Returns the newly lost ids."""
+        if self.hb_deadline_s is None:
+            return []
+        newly = []
+        for hid, last in list(self.hb.items()):
+            if hid in self.lost:
+                continue
+            age = now - last
+            if age > self.hb_deadline_s:
+                if self._mark_lost(hid, "missed heartbeat (%.2fs > %.2fs)"
+                                   % (age, self.hb_deadline_s)):
+                    newly.append(hid)
+        return newly
+
+    def _freeze_if_complete(self, name):
+        """STICKY completion (Local/FileCoordinator parity): the first
+        observation of every live host present freezes the member
+        snapshot; later membership changes cannot re-open the round."""
+        r = self.rounds.get(name)
+        if r is None or r["done"] is not None:
+            return
+        present = set(r["values"])
+        waiting = [i for i in range(self.n_hosts)
+                   if i not in self.lost and i not in present]
+        if waiting:
+            return
+        r["done"] = sorted(present - set(self.lost))
+        self.completed.append(name)
+
+
+class CoordServer(object):
+    """The rendezvous service: TCP + threads, stdlib only.
+
+    One per pod. Start in-process (tests, or the host-0 sidecar
+    pattern) or standalone through ``tools/coordsvc.py``. ``port=0``
+    binds an ephemeral port — read it back from :attr:`address`.
+
+    ``hb_deadline_s`` arms heartbeat liveness: any host that ever said
+    hello and then goes silent past the deadline is tombstoned by the
+    monitor thread, exactly as if a peer had declared it lost — clients
+    observe the tombstone on their next heartbeat/poll and fire their
+    loss hooks. ``None`` disables the monitor (losses then come only
+    from explicit ``mark_lost`` / gather deadlines, the FileCoordinator
+    default)."""
+
+    def __init__(self, n_hosts, port=0, host="127.0.0.1",
+                 hb_deadline_s=None):
+        self._state = _PodState(n_hosts, hb_deadline_s=hb_deadline_s)
+        state = self._state
+
+        class _Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                while True:
+                    line = self.rfile.readline()
+                    if not line:
+                        return
+                    try:
+                        req = json.loads(line)
+                        resp = _serve(state, req)
+                    except Exception as e:   # malformed request
+                        resp = {"error": "%s: %s" % (type(e).__name__, e)}
+                    self.wfile.write(json.dumps(resp).encode() + b"\n")
+                    self.wfile.flush()
+
+        class _Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = _Server((host, port), _Handler)
+        self.address = "%s:%d" % self._server.server_address[:2]
+        self._threads = []
+        self._closed = threading.Event()
+
+    @property
+    def state(self):
+        """The live :class:`_PodState` — in-process introspection for
+        tests and the host-0 sidecar (read under ``state.lock``)."""
+        return self._state
+
+    def start(self):
+        t = threading.Thread(target=self._server.serve_forever,
+                             daemon=True, name="paddle_tpu-coordsvc")
+        t.start()
+        self._threads.append(t)
+        if self._state.hb_deadline_s is not None:
+            m = threading.Thread(target=self._monitor, daemon=True,
+                                 name="paddle_tpu-coordsvc-hb")
+            m.start()
+            self._threads.append(m)
+        return self
+
+    def _monitor(self):
+        period = max(0.01, self._state.hb_deadline_s / 4.0)
+        while not self._closed.wait(period):
+            with self._state.lock:
+                newly = self._state._scan_heartbeats(time.monotonic())
+            for hid in newly:
+                record_event("hb_lost", host_lost=hid)
+
+    def close(self):
+        self._closed.set()
+        self._server.shutdown()
+        self._server.server_close()
+        for t in self._threads:
+            t.join(timeout=5.0)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def _serve(state, req):
+    """Dispatch one request against the pod state. Every op is
+    idempotent so a client may blindly re-send after a reconnect."""
+    cmd = req.get("cmd")
+    hid = req.get("host")
+    hid = None if hid is None else int(hid)
+    if hid is not None and not 0 <= hid < state.n_hosts:
+        # an off-by-one host id must fail loudly, not land phantom
+        # contributions in rounds or phantom tombstones in lost maps
+        return {"error": "host id %d out of range for a %d-host pod"
+                % (hid, state.n_hosts)}
+    now = time.monotonic()
+    with state.lock:
+        # the heartbeat monitor owns proactive scans, but piggybacking
+        # one on every request keeps detection sharp under load (and
+        # makes the deadline hold even on a paused monitor thread)
+        state._scan_heartbeats(now)
+        resp = _dispatch(state, cmd, hid, req, now)
+        if "lost" in resp:
+            # every lost map ships with its version: the client drops
+            # any map older than one it already applied, so a response
+            # processed late cannot resurrect a cleared tombstone
+            resp["lost_v"] = state.lost_version
+        return resp
+
+
+def _dispatch(state, cmd, hid, req, now):
+    """The op table — caller holds ``state.lock``."""
+    if cmd == "hello":
+        if int(req.get("n_hosts", state.n_hosts)) != state.n_hosts:
+            return {"error": "pod size mismatch: server has %d "
+                    "hosts, client expects %s"
+                    % (state.n_hosts, req.get("n_hosts"))}
+        if hid is not None and req.get("lease"):
+            # only heartbeating clients take a liveness lease: a
+            # passive observer (heartbeat=False) that registered
+            # one would be tombstoned the moment it went stale
+            state.hb[hid] = now
+        return {"ok": True, "n_hosts": state.n_hosts,
+                "lost": dict(state.lost)}
+    if cmd == "hb":
+        if hid is not None:
+            state.hb[hid] = now
+        return {"ok": True, "lost": dict(state.lost)}
+    if cmd == "lost":
+        return {"lost": dict(state.lost)}
+    if cmd == "mark_lost":
+        state._mark_lost(hid, req.get("reason", "declared lost"))
+        return {"ok": True, "lost": dict(state.lost)}
+    if cmd == "announce_join":
+        if hid not in state.lost:
+            return {"error": "host %d is not fenced — only a lost "
+                    "host announces a rejoin" % hid}
+        state.joins[hid] = int(req.get("nonce", 0))
+        return {"ok": True}
+    if cmd == "pending_joins":
+        return {"joins": dict(state.joins)}
+    if cmd == "unfence":
+        if state.lost.pop(hid, None) is not None:
+            state.lost_version += 1
+        state.joins.pop(hid, None)
+        # the un-fenced host re-enters liveness with a fresh lease —
+        # without this its pre-fence stale heartbeat would re-fence
+        # it on the very next monitor scan
+        if hid in state.hb:
+            state.hb[hid] = now
+        # the response CARRIES the post-unfence lost map: the caller's
+        # client applies its (bumped) version before the coordinator
+        # forgets the host, so any straggling pre-unfence callback is
+        # dropped by the version guard instead of resurrecting the loss
+        return {"ok": True, "lost": dict(state.lost)}
+    if cmd == "put":
+        name = req["name"]
+        if hid in state.lost:
+            return {"fenced": state.lost[hid], "lost": dict(state.lost)}
+        r = state.rounds.setdefault(
+            name, {"values": {}, "tokens": {}, "done": None,
+                   "acks": set()})
+        token = req.get("token")
+        if hid in r["values"]:
+            if r["tokens"].get(hid) == token and token is not None:
+                # the same client re-sending after a reconnect:
+                # idempotent, keyed by (name, host_id, token)
+                return {"ok": True, "resent": True}
+            return {"error": "host %d already contributed to round "
+                    "%r — collective names must be unique per round"
+                    % (hid, name)}
+        if r["done"] is not None:
+            # frozen without us: we were fenced when the snapshot
+            # was taken — arriving now must not mutate it
+            return {"fenced": state.lost.get(
+                hid, "round %r froze without host %d" % (name, hid)),
+                "lost": dict(state.lost)}
+        r["values"][hid] = req.get("value")
+        r["tokens"][hid] = token
+        state._freeze_if_complete(name)
+        return {"ok": True}
+    if cmd == "poll":
+        name = req["name"]
+        r = state.rounds.get(name)
+        if hid in state.lost and (r is None or r["done"] is None
+                                  or hid not in r["done"]):
+            return {"fenced": state.lost[hid], "lost": dict(state.lost)}
+        if r is None:
+            return {"error": "round %r unknown — poll follows put"
+                    % name}
+        state._freeze_if_complete(name)
+        if r["done"] is None:
+            waiting = [i for i in range(state.n_hosts)
+                       if i not in state.lost
+                       and i not in r["values"]]
+            return {"waiting": waiting, "lost": dict(state.lost)}
+        return {"done": r["done"],
+                "values": {str(i): r["values"][i] for i in r["done"]},
+                "lost": dict(state.lost)}
+    if cmd == "ack":
+        name = req["name"]
+        r = state.rounds.get(name)
+        if r is not None and r["done"] is not None:
+            r["acks"].add(hid)
+            if r["acks"] >= set(r["done"]):
+                # last one out cleans up (File/LocalCoordinator
+                # parity) — the rounds table stays bounded
+                state.rounds.pop(name, None)
+        return {"ok": True}
+    return {"error": "unknown cmd %r" % cmd}
+
+
+# ---------------------------------------------------------------------------
+# client
+# ---------------------------------------------------------------------------
+
+class CoordClient(object):
+    """Request/response client with transparent reconnect.
+
+    One per (process, host_id). All requests serialize on one socket
+    under a lock — the heartbeat thread shares it, so ordering is
+    strict and the server never sees interleaved lines. A send/recv
+    failure tears the socket down and retries through ``retry_policy``
+    (connect + re-send; server ops are idempotent), recording a
+    ``transport_reconnect`` event per re-dial so
+    ``transport_reconnects_total`` counts real network pain.
+
+    ``hb_interval_s`` starts the daemon heartbeat on :meth:`start_heartbeat`
+    callers; each beat refreshes this host's liveness lease and records
+    the ``transport_hb_lag`` gauge — seconds the cadence is running
+    late (0 when healthy). The latest ``lost`` map from any response is
+    kept on :attr:`last_lost` for the owner to diff against."""
+
+    def __init__(self, address, host_id=None, retry_policy=None,
+                 connect_timeout_s=5.0, io_timeout_s=30.0):
+        if isinstance(address, (tuple, list)):
+            self._addr = (address[0], int(address[1]))
+        else:
+            host, _, port = address.rpartition(":")
+            self._addr = (host or "127.0.0.1", int(port))
+        self.host_id = None if host_id is None else int(host_id)
+        # the default budget rides out a SUPERVISED RESTART of the
+        # rendezvous service (~5-10s of backoff), not just a dropped
+        # connection — the documented "coordinator death is a transient
+        # outage" promise holds only as long as this budget; pass a
+        # bigger retry_policy for slower orchestrators
+        self._policy = retry_policy or RetryPolicy(
+            max_attempts=9, base_delay_s=0.1, max_delay_s=2.0)
+        self._connect_timeout_s = float(connect_timeout_s)
+        # every server op answers immediately (no server-side blocking),
+        # so a bounded read is purely a hang guard: a wedged service
+        # must not pin the request lock — and with it the heartbeat AND
+        # gather threads — forever
+        self._io_timeout_s = None if io_timeout_s is None \
+            else float(io_timeout_s)
+        self._lock = threading.Lock()
+        self._sock = None
+        self._rfile = None
+        self._closed = False
+        self._hb_thread = None
+        self._hb_stop = threading.Event()
+        self.last_lost = {}
+        self._lost_cb = None
+        # ordering guard for the lost map: responses finish their
+        # roundtrip under _lock but are PROCESSED after releasing it,
+        # so a slow thread could apply a stale map after a newer one
+        # (resurrecting a cleared tombstone). The server versions every
+        # map; we only ever apply forward.
+        self._lost_lock = threading.Lock()
+        self._lost_v = -1
+        # instantaneous heartbeat-cadence lag, updated every beat (the
+        # recorded gauge EVENTS are throttled — see _hb_loop)
+        self.hb_lag_s = 0.0
+
+    # -- wire --------------------------------------------------------------
+    def _connect_locked(self):
+        sock = socket.create_connection(
+            self._addr, timeout=self._connect_timeout_s)
+        sock.settimeout(self._io_timeout_s)
+        self._sock = sock
+        self._rfile = sock.makefile("rb")
+
+    def _teardown_locked(self):
+        for closer in (self._rfile, self._sock):
+            try:
+                if closer is not None:
+                    closer.close()
+            except OSError:
+                pass
+        self._sock = self._rfile = None
+
+    def _roundtrip_locked(self, payload):
+        if self._sock is None:
+            self._connect_locked()
+        self._sock.sendall(payload)
+        line = self._rfile.readline()
+        if not line:
+            raise ConnectionError("coordination service closed the "
+                                  "connection")
+        return json.loads(line)
+
+    def request(self, req):
+        """One request/response round trip; reconnects and re-sends on
+        transient socket failure (requests are idempotent server-side).
+        Raises :class:`TransportError` once the retry budget is spent."""
+        payload = json.dumps(req).encode() + b"\n"
+        last = None
+        for attempt in range(self._policy.max_attempts):
+            with self._lock:
+                if self._closed:
+                    raise TransportError("client is closed")
+                try:
+                    return self._roundtrip_locked(payload)
+                except (OSError, ValueError) as e:
+                    # ValueError: a torn JSON line from a half-closed
+                    # socket — same remedy as any socket error
+                    last = e
+                    self._teardown_locked()
+            if attempt + 1 >= self._policy.max_attempts:
+                break
+            delay = self._policy.delay_s(attempt)
+            record_event("transport_reconnect", attempt=attempt + 1,
+                         error=type(last).__name__, backoff_s=delay,
+                         host=self.host_id)
+            self._policy.sleep(delay)
+        raise TransportError(
+            "coordination service at %s:%d unreachable after %d "
+            "attempts; last error: %r"
+            % (self._addr[0], self._addr[1], self._policy.max_attempts,
+               last))
+
+    def call(self, cmd, **fields):
+        """request() + server-error unwrapping. Returns the response
+        dict; a server-side ``error`` raises RuntimeError (the caller
+        maps it onto the Coordinator error taxonomy). Tracks the most
+        recent ``lost`` map for the owner's loss observation."""
+        req = dict(fields, cmd=cmd)
+        if self.host_id is not None and "host" not in req:
+            req["host"] = self.host_id
+        resp = self.request(req)
+        if "lost" in resp:
+            parsed = {int(k): v for k, v in resp["lost"].items()}
+            version = int(resp.get("lost_v", 0))
+            with self._lost_lock:
+                if version >= self._lost_v:
+                    self._lost_v = version
+                    self.last_lost = parsed
+                # the callback always sees the NEWEST map known to this
+                # client (never a stale response's own) AND its version
+                # — the consumer re-checks it under ITS lock, because
+                # this invocation happens outside ours and a delayed
+                # thread could otherwise deliver a pre-unfence map
+                # after the owner already readmitted the host
+                current = dict(self.last_lost)
+                current_v = self._lost_v
+            cb = self._lost_cb
+            if cb is not None:
+                cb(current, current_v)
+        if "error" in resp:
+            raise RuntimeError(resp["error"])
+        return resp
+
+    # -- heartbeat ---------------------------------------------------------
+    def start_heartbeat(self, interval_s=_DEFAULT_HB_INTERVAL_S,
+                        on_lost=None):
+        """Say hello (registers this host's liveness lease) and start
+        the daemon heartbeat. ``on_lost(lost_map)`` fires on every
+        response that carries a lost map — the SocketCoordinator hangs
+        its loss observation here so tombstones written by the server's
+        deadline monitor reach the survivors' hooks without any gather
+        in flight."""
+        self._lost_cb = on_lost
+        self._hb_interval_s = float(interval_s)
+        self.call("hello", lease=True)
+        t = threading.Thread(target=self._hb_loop, daemon=True,
+                             name="paddle_tpu-hb-%s" % self.host_id)
+        self._hb_thread = t
+        t.start()
+        return self
+
+    def _hb_loop(self):
+        last_beat = time.monotonic()
+        last_recorded = 0.0
+        beats = 0
+        while not self._hb_stop.wait(self._hb_interval_s):
+            try:
+                self.call("hb")
+            except (TransportError, RuntimeError):
+                # the reconnect events already counted the pain; the
+                # lease simply ages until the server or network heals
+                continue
+            now = time.monotonic()
+            lag = max(0.0, (now - last_beat) - self._hb_interval_s)
+            last_beat = now
+            self.hb_lag_s = lag
+            beats += 1
+            # the gauge event is THROTTLED: the event log is a bounded
+            # deque shared with the recovery history, and an unthrotted
+            # 2 Hz stream would evict everything else within the hour.
+            # Record when the cadence actually slipped (the signal) or
+            # every ~60s as a keepalive so the gauge stays fresh; the
+            # instantaneous value is always on .hb_lag_s.
+            keepalive = max(1, int(60.0 / max(self._hb_interval_s,
+                                              1e-3)))
+            if lag > self._hb_interval_s or lag > last_recorded * 2 \
+                    or beats % keepalive == 0:
+                last_recorded = lag
+                record_event("transport_hb_lag", host=self.host_id,
+                             lag_s=lag)
+
+    def close(self):
+        self._hb_stop.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=2.0)
+        with self._lock:
+            self._closed = True
+            self._teardown_locked()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -53,6 +53,10 @@ def pytest_configure(config):
         "markers",
         "data: elastic data plane tests (ShardedFeed cursors, "
         "membership re-balancing, exact-batch resume)")
+    config.addinivalue_line(
+        "markers",
+        "procpod: REAL-process pod-transport tests (subprocesses over "
+        "SocketCoordinator, SIGKILL chaos) — wall-bounded, tier-1-safe")
 
 
 @pytest.fixture(autouse=True)

@@ -606,10 +606,14 @@ def _run_workers(tmp_path, script, base_port, n=2, extra_env=None):
 
 @pytest.mark.xfail(
     reason="jax 0.4.37 CPU backend: 'Multiprocess computations aren't "
-    "implemented on the CPU backend' — the jitted collective inside the "
-    "2-process job cannot execute without a real TPU/GPU runtime. The "
-    "launch/env-contract half is covered by test_pod_config; re-enable "
-    "on accelerator CI or a jax with multiprocess CPU collectives.",
+    "implemented on the CPU backend' — ONLY the XLA-compute leg (the "
+    "jitted collective) needs a real TPU/GPU runtime. The launch/env "
+    "contract is covered by test_pod_config, and the cross-process "
+    "COORDINATION leg now runs for real over SocketCoordinator in "
+    "test_pod_transport.py (procpod battery: TCP rendezvous, gathers, "
+    "SIGKILL chaos — actual OS processes, no accelerator needed). "
+    "Re-enable on accelerator CI or a jax with multiprocess CPU "
+    "collectives.",
     strict=False)
 def test_multiprocess_jax_distributed_e2e(tmp_path):
     """REAL multi-host validation: 2 OS processes form a jax.distributed
@@ -640,9 +644,13 @@ def test_multiprocess_jax_distributed_e2e(tmp_path):
 
 @pytest.mark.xfail(
     reason="jax 0.4.37 CPU backend: 'Multiprocess computations aren't "
-    "implemented on the CPU backend' — the cross-process sharded save "
-    "needs a real multi-host runtime. The sharded save/stitch/reshard "
-    "logic itself is covered single-process by test_io; re-enable on "
+    "implemented on the CPU backend' — ONLY the XLA-compute leg (the "
+    "cross-process sharded array) needs a real multi-host runtime. The "
+    "sharded save/stitch/reshard logic is covered single-process by "
+    "test_io, and the cross-process agreement (who writes, who "
+    "commits, who restores what step) now runs for real over "
+    "SocketCoordinator in test_pod_transport.py (procpod battery: "
+    "elect_restore_step across actual OS processes). Re-enable on "
     "accelerator CI or a jax with multiprocess CPU collectives.",
     strict=False)
 def test_multiprocess_sharded_checkpoint_e2e(tmp_path):

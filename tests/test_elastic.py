@@ -462,6 +462,83 @@ def test_elastic_rejoin_ships_state_via_sync_dir(tmp_path):
         ElasticTrainer([trainers[0]], LocalCoordinator(2), host_id=0)
 
 
+def test_elastic_proactive_straggler_drain(tmp_path):
+    """drain_after=k: a host whose critical-straggler flag rides the
+    status exchange for k consecutive windows is admitted as a PLANNED
+    loss at the next window boundary — the pod agrees the drain from
+    the same frozen verdicts, the straggler fences itself, and the
+    survivors take the ordinary elastic-shrink path with NO
+    CollectiveTimeoutError stall and NO rewind. Survivor math is
+    untouched (plain replicated dp): bitwise the reference's."""
+    n = 6
+    feeds = _elastic_feeds(n)
+    ref_pod, ref_trainers, _ = _make_elastic_pod(
+        tmp_path, "ref", n_hosts=3, rejoin=False, compiled=False)
+    ref_out = ref_pod.run(feeds)
+
+    resilience.clear_events()
+    main, startup, loss = _elastic_program()
+    trainers = []
+    for h in range(3):
+        sc, exe = Scope(), pt.Executor()
+        with scope_guard(sc):
+            exe.run(startup)
+        trainers.append(ResilientTrainer(
+            exe, main, str(tmp_path / "drain" / ("h%d" % h)),
+            fetch_list=[loss], checkpoint_every=3, scope=sc,
+            retry_policy=_fast_policy()))
+    pod = ElasticTrainer(
+        trainers, LocalCoordinator(3, timeout_s=POD_TIMEOUT_S),
+        rejoin=False, drain_after=2)
+    # deterministic attribution: the production path consumes the
+    # process-global StragglerDetector latch, which the threaded
+    # simulation SHARES between hosts — override the seam. Windows 1-2
+    # flag EVERY host (a systemic slowdown: the collective wait
+    # inflates everyone's latency), which must NOT drain anyone; from
+    # window 3 only host 2 stays flagged (the asymmetric straggler
+    # signature) and IS drained.
+    calls = {0: 0, 1: 0, 2: 0}
+
+    def fake_flag(hid):
+        calls[hid] += 1
+        w = calls[hid]
+        if w <= 2:
+            return True
+        return hid == 2 and w <= 5
+
+    pod._straggler_flag = fake_flag
+    out = pod.run(feeds)
+
+    drains = resilience.events("elastic_drain")
+    # every host agreed the SAME drain in the same window — and none
+    # fired during the systemic phase (step 3 = first asymmetric window)
+    assert drains and {e["drained"] for e in drains} == {2}
+    assert {e["step"] for e in drains} == {3}
+    assert {e["capacity"] for e in drains} == {"2/3"}
+    assert sorted(e["host"] for e in drains) == [0, 1, 2]
+    # the planned loss took the elastic path: shrink, no timeout fence,
+    # no rewind — and the drained host exited cleanly at the boundary
+    shrink = resilience.events("elastic_shrink")
+    assert shrink and {e["capacity"] for e in shrink} == {"2/3"}
+    assert not resilience.events("pod_restore")
+    assert not resilience.events("watchdog_timeout")
+    assert resilience.events("host_exit")
+    lost = pod.coordinator.lost_hosts()
+    assert 2 in lost and "drained" in lost[2]
+    # host 2 fenced at a boundary: it has partial results; survivors
+    # completed every step bitwise equal to the reference
+    assert any(o is None for o in out[2])
+    for h in (0, 1):
+        assert [i for i, o in enumerate(out[h]) if o is None] == []
+        np.testing.assert_array_equal(
+            np.asarray([o[0] for o in out[h]]),
+            np.asarray([o[0] for o in ref_out[h]]))
+
+    # misuse is loud
+    with pytest.raises(ValueError, match="drain_after"):
+        ElasticTrainer(trainers, LocalCoordinator(3), drain_after=0)
+
+
 def test_elastic_transient_fault_still_rewinds(tmp_path):
     """A transient compute fault (preemption) on a full pod is NOT a
     membership change: ElasticTrainer falls back to the parent's

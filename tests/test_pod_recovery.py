@@ -263,6 +263,77 @@ def test_file_coordinator_detects_lost_host_via_tombstone(tmp_path):
     assert hook_fired[0] == [[2]] and hook_fired[1] == [[2]]
 
 
+def test_file_coordinator_heartbeat_deadline_auto_tombstones(tmp_path):
+    """hb_deadline_s armed: every gather poll touches hb_<host>.json,
+    and a host whose heartbeat goes STALE is auto-tombstoned by
+    whichever peer notices — no mark_lost, no waiting out the gather
+    timeout. A host that never heartbeated is NOT auto-fenced (it may
+    not have started; the gather deadline still covers it)."""
+    root = str(tmp_path / "pod")
+    # poll_max_s well under the deadline: a live host's OWN heartbeat
+    # gap (one poll sleep) must never look stale under CI load
+    cos = [FileCoordinator(root, 3, timeout_s=30.0, poll_s=0.002,
+                           poll_max_s=0.05, mesh_reinit=False,
+                           hb_deadline_s=0.5)
+           for _ in range(3)]
+    hook_fired = {0: [], 1: []}
+    for h in (0, 1):
+        cos[h].add_host_loss_hook(
+            lambda lost, live, h=h: hook_fired[h].append(lost))
+    # host 2 WAS alive (it holds a heartbeat lease), then went silent
+    cos[2]._touch_hb(2)
+    t0 = time.monotonic()
+    out, errs = _run_hosts(
+        lambda h: cos[h].all_gather("g", h, h) if h < 2 else None, 3)
+    elapsed = time.monotonic() - t0
+    assert not errs
+    assert out[0] == out[1] == {0: 0, 1: 1}
+    # detected by the heartbeat DEADLINE, far inside the 30s gather
+    # timeout, and the reason says so
+    assert elapsed < 10.0, elapsed
+    lost = cos[0].lost_hosts()
+    assert 2 in lost and "missed heartbeat" in lost[2], lost
+    assert hook_fired[0] == [[2]] and hook_fired[1] == [[2]]
+    assert os.path.exists(os.path.join(root, "hb", "hb_0.json"))
+    # never-started hosts are exempt: nothing fences host 1 of a fresh
+    # pod just because it has no heartbeat file yet
+    root2 = str(tmp_path / "pod2")
+    co = FileCoordinator(root2, 2, timeout_s=0.3, poll_s=0.002,
+                         mesh_reinit=False, detect_loss=False,
+                         hb_deadline_s=0.05)
+    with pytest.raises(BarrierTimeoutError):
+        co.all_gather("alone", 0, None)
+    assert co.lost_hosts() == {}        # the deadline, not a heartbeat
+
+
+def test_file_coordinator_poll_backoff_caps_filesystem_spin(tmp_path):
+    """The fixed-interval busy poll is gone: waiting for a slow peer
+    backs off exponentially from poll_s up to poll_max_s, so a long
+    barrier idles at a few Hz instead of 1/poll_s."""
+    import paddle_tpu.framework.coordination as coordination_mod
+    co = FileCoordinator(str(tmp_path / "pod"), 2, timeout_s=0.5,
+                         poll_s=0.01, poll_max_s=0.08,
+                         detect_loss=False, mesh_reinit=False)
+    sleeps = []
+    real_sleep = time.sleep
+
+    def recording_sleep(s):
+        sleeps.append(s)
+        real_sleep(min(s, 0.01))       # keep the test fast
+
+    orig = coordination_mod.time.sleep
+    coordination_mod.time.sleep = recording_sleep
+    try:
+        with pytest.raises(BarrierTimeoutError):
+            co.all_gather("never", 0, None)
+    finally:
+        coordination_mod.time.sleep = orig
+    # doubled each iteration, capped at poll_max_s (the tail may clamp
+    # to the remaining deadline)
+    np.testing.assert_allclose(sleeps[:4], [0.01, 0.02, 0.04, 0.08])
+    assert max(sleeps) <= 0.08 + 1e-9
+
+
 def test_pod_host_id_mode_single_trainer_per_coordinator(tmp_path):
     """Production shape: one PodResilientTrainer per 'process', each
     holding only ITS host's trainer + host_id, meeting on a shared

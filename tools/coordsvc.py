@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""Standalone pod rendezvous service — orchestrator glue.
+
+Runs a :class:`paddle_tpu.framework.transport.CoordServer`: the
+stdlib-TCP service holding the pod's coordination KV state (gather
+rounds with sticky completion, tombstones, join announcements,
+heartbeats). Deploy ONE per pod — as a sidecar on host 0, a k8s
+Service, or anywhere every host can reach over TCP — and point each
+host's ``SocketCoordinator(address, n_hosts, host_id)`` at it. No
+shared filesystem is needed anywhere.
+
+Liveness: with ``--hb-deadline-s`` armed (the default), any host whose
+heartbeat goes stale past the deadline is tombstoned by the server's
+monitor — survivors observe the tombstone on their next heartbeat or
+gather poll and fire their loss hooks (mesh re-init), and the fenced
+host must rejoin through the admission protocol, never resume.
+
+The service holds no MODEL state, so losing it never loses training
+progress — but it does hold the coordination state (in-flight rounds,
+tombstones) in memory. Two distinct failure grades:
+
+  * a dropped CONNECTION (network blip, proxy restart) is fully
+    transparent: clients reconnect/retry through their RetryPolicy
+    (~5-10s budget by default; pass `retry_policy=` for more) and
+    re-send idempotently against the intact state;
+  * a service RESTART starts from empty state: hosts blocked in a
+    round surface CoordinationError and the job restarts from its
+    checkpoints (the resilience layer's ordinary recovery) — state
+    snapshot/replay for seamless restarts is a ROADMAP follow-on.
+
+Run it under a supervisor either way.
+
+Usage:
+  python tools/coordsvc.py --n-hosts N [--port P] [--host ADDR]
+                           [--hb-deadline-s S]
+
+Prints one JSON line ``{"address": "host:port", "n_hosts": N}`` once
+listening (orchestrators parse it to template the worker env), then
+serves until SIGTERM/SIGINT.
+"""
+import argparse
+import json
+import signal
+import socket
+import sys
+import threading
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--n-hosts", type=int, required=True,
+                    help="pod size (host ids 0..N-1)")
+    ap.add_argument("--port", type=int, default=0,
+                    help="TCP port (0 = ephemeral, printed on stdout)")
+    ap.add_argument("--host", default="0.0.0.0",
+                    help="bind address (default: all interfaces)")
+    ap.add_argument("--advertise-host", default=None,
+                    help="hostname/IP printed in the address workers "
+                         "dial (default: the bind address, or this "
+                         "machine's hostname when binding 0.0.0.0 — "
+                         "a wildcard bind address is not dialable)")
+    ap.add_argument("--hb-deadline-s", type=float, default=10.0,
+                    help="heartbeat staleness deadline; a host silent "
+                         "past it is tombstoned (<= 0 disables the "
+                         "monitor — losses then need mark_lost or a "
+                         "gather deadline)")
+    args = ap.parse_args(argv)
+    from paddle_tpu.framework.transport import CoordServer
+    hb = args.hb_deadline_s if args.hb_deadline_s > 0 else None
+    server = CoordServer(args.n_hosts, port=args.port, host=args.host,
+                         hb_deadline_s=hb).start()
+    # the printed address is what orchestrators template into every
+    # worker's SocketCoordinator — it must be DIALABLE from remote
+    # hosts, and a wildcard bind address is not
+    bind_host, port = server.address.rsplit(":", 1)
+    adv = args.advertise_host
+    if adv is None:
+        adv = socket.gethostname() \
+            if bind_host in ("0.0.0.0", "::", "") else bind_host
+    print(json.dumps({"address": "%s:%s" % (adv, port),
+                      "bind": server.address,
+                      "n_hosts": args.n_hosts,
+                      "hb_deadline_s": hb}), flush=True)
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: stop.set())
+    stop.wait()
+    server.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

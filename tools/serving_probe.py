@@ -69,30 +69,36 @@ def scrape_metrics(url, timeout_s=5.0):
     """Scrape a resilience.serve_metrics endpoint; returns a summary
     dict {"url", "samples", "events_total": {kind[/host]: n}} — plus a
     "feed" section with the elastic-data-plane series
-    (feed_rebalance_total, feed_epoch/feed_stream_lag per host) when
-    the replica exports any — or raises (caller folds failures into the
-    health report)."""
+    (feed_rebalance_total, feed_epoch/feed_stream_lag per host) and a
+    "transport" section with the pod-transport series
+    (transport_reconnects_total, transport_heartbeat_lag per host)
+    when the replica exports any — or raises (caller folds failures
+    into the health report)."""
     import urllib.request
     from paddle_tpu.framework.resilience import (METRIC_PREFIX,
                                                  parse_metrics_text)
     with urllib.request.urlopen(url, timeout=timeout_s) as resp:
         text = resp.read().decode("utf-8")
     samples = parse_metrics_text(text)
-    events, feed = {}, {}
+    events, feed, transport = {}, {}, {}
     for name, labels, value in samples:
         if name == METRIC_PREFIX + "_events_total":
             key = labels.get("kind", "?")
             if "host" in labels:
                 key += "/host" + labels["host"]
             events[key] = value
-        elif name.startswith(METRIC_PREFIX + "_feed_"):
+        elif name.startswith(METRIC_PREFIX + "_feed_") \
+                or name.startswith(METRIC_PREFIX + "_transport_"):
             key = name[len(METRIC_PREFIX) + 1:]
             if "host" in labels:
                 key += "/host" + labels["host"]
-            feed[key] = value
+            section = feed if key.startswith("feed_") else transport
+            section[key] = value
     out = {"url": url, "samples": len(samples), "events_total": events}
     if feed:
         out["feed"] = feed
+    if transport:
+        out["transport"] = transport
     return out
 
 
