@@ -163,6 +163,38 @@ def _on_tpu():
     return any(d.platform in ("tpu", "axon") for d in jax.devices())
 
 
+def _micro_enabled():
+    """--micro (or PADDLE_TPU_BENCH_MICRO=1): when the chip probe fails,
+    fall back to the CPU microbench suite (bench_micro.py) so the round
+    still ships a perf signal instead of only an error headline."""
+    return "--micro" in sys.argv[1:] or \
+        os.environ.get("PADDLE_TPU_BENCH_MICRO") == "1"
+
+
+def _run_micro_fallback(timeout=420):
+    """Run bench_micro.py in a FRESH subprocess pinned to CPU (this
+    process's jax may be wedged or deliberately un-imported after a
+    probe failure — the same isolation rule as the probe itself).
+    Returns its JSON report line, or None."""
+    import subprocess
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "bench_micro.py")
+    try:
+        proc = subprocess.run([sys.executable, script], text=True,
+                              timeout=timeout, stdout=subprocess.PIPE,
+                              stderr=subprocess.DEVNULL, env=env)
+    except Exception as e:
+        sys.stderr.write("micro fallback failed: %r\n" % (e,))
+        return None
+    for ln in reversed(proc.stdout.splitlines()):
+        if ln.startswith("{"):
+            return ln
+    sys.stderr.write("micro fallback produced no JSON (rc=%d)\n"
+                     % proc.returncode)
+    return None
+
+
 def bert_train_flops(cfg, batch, seq, preds):
     """Analytic per-step training FLOPs of the MLM+NSP model (matmul terms;
     fwd + ~2x for backward — the standard MFU accounting)."""
@@ -828,7 +860,19 @@ def run_all():
     _STATE["stage"] = "backend-probe"
     platforms, err = _probe_backend()
     if err is not None:
-        _STATE["headline"] = _error_headline(err)
+        # never again a zero-signal round: with --micro the CPU
+        # microbench suite still ships a perf verdict as a secondary
+        # line, and the (error) headline says it is there
+        micro_ok = False
+        if _micro_enabled():
+            _STATE["stage"] = "micro-fallback"
+            line = _run_micro_fallback()
+            if line is not None:
+                _STATE["lines"].append(line)
+                micro_ok = True
+        head = json.loads(_error_headline(err))
+        head["micro_fallback"] = micro_ok
+        _STATE["headline"] = json.dumps(head)
         _flush_and_exit(0)
     sys.stderr.write("backend: %s\n" % ",".join(platforms))
     try:
@@ -1014,6 +1058,12 @@ if __name__ == "__main__":
         print(bench_transformer())
     elif len(sys.argv) > 1 and sys.argv[1] == "deepfm":
         print(bench_deepfm())
+    elif len(sys.argv) > 1 and sys.argv[1] == "micro":
+        # section mode: run the CPU microbench suite directly (the same
+        # suite run_all falls back to when the chip probe fails under
+        # --micro / PADDLE_TPU_BENCH_MICRO=1)
+        import bench_micro
+        sys.exit(bench_micro.main())
     elif len(sys.argv) > 1 and sys.argv[1] == "profile":
         profile_headline()
     else:

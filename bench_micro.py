@@ -1,0 +1,245 @@
+#!/usr/bin/env python
+"""CPU-measurable perf gates: the tier-1-safe microbench suite.
+
+BENCH_r03-r05 postmortem: three bench rounds produced zero perf signal
+because the TPU fabric hung at backend init. Perf must not be hostage to
+one flaky chip attach — this suite measures the paddle_tpu host/compiler
+surfaces that move on every PR, on JAX_PLATFORMS=cpu, in seconds:
+
+  * trace_lower_s          — Program -> StableHLO trace+lower wall time
+                             of a small train step (the compile-path
+                             regression canary)
+  * cache_hit_rate         — Executor step-cache hit rate over a steady
+                             dispatch loop (a drop means a cache key
+                             churn bug: every step recompiles)
+  * exact_step_s /         — per-step wall time of a dp-sharded
+    quant_step_s             CompiledProgram window, full-width vs
+                             quantize_collectives
+  * collective_wire_ratio  — wire/raw bytes of the quantized gradient
+                             all-reduce (resilience bytes counters —
+                             the EQuARX-style bandwidth win, asserted
+                             not hand-waved)
+  * feed_samples_per_s     — ShardedFeed draw+commit throughput
+                             (the data-plane hot loop)
+
+Output contract: ONE JSON line (dict with "metric": "bench_micro" and a
+"metrics" sub-dict). tests/test_bench_micro.py re-runs the suite
+in-process and checks every metric against the REGRESSION BUDGETS below,
+so every PR gets a perf verdict even when bench.py's chip probe fails
+(bench.py --micro falls back to this suite).
+
+Budgets are deliberately loose upper bounds for shared-CI noise: they
+catch order-of-magnitude regressions (a trace blowup, a cache-key bug, a
+codec that stopped compressing), not single-digit-percent drift.
+"""
+import json
+import os
+import sys
+import time
+
+
+def _force_cpu():
+    """Standalone entry: pin the CPU backend with 8 virtual devices
+    BEFORE jax import (same shape as tests/conftest.py). A no-op when
+    jax is already imported/configured (pytest in-process use)."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:  # pragma: no cover - older jax
+        pass
+
+
+# metric -> ("max"|"min", budget). Checked by check_budgets(); loose on
+# purpose (shared CI boxes) — they exist to catch step changes.
+BUDGETS = {
+    "trace_lower_s": ("max", 60.0),
+    "cache_hit_rate": ("min", 0.85),
+    "exact_step_s": ("max", 20.0),
+    "quant_step_s": ("max", 20.0),
+    "collective_wire_ratio": ("max", 0.30),
+    "feed_samples_per_s": ("min", 1000.0),
+}
+
+
+def check_budgets(metrics):
+    """Return a list of human-readable budget violations (empty = pass)."""
+    bad = []
+    for name, (kind, budget) in BUDGETS.items():
+        if name not in metrics:
+            bad.append("metric %r missing from the report" % name)
+            continue
+        v = metrics[name]
+        if not isinstance(v, (int, float)):
+            bad.append("metric %r is not numeric: %r" % (name, v))
+        elif kind == "max" and v > budget:
+            bad.append("%s=%.4g exceeds budget %.4g" % (name, v, budget))
+        elif kind == "min" and v < budget:
+            bad.append("%s=%.4g below budget %.4g" % (name, v, budget))
+    return bad
+
+
+def _build_train(hidden=128, in_dim=64, classes=8):
+    import paddle_tpu as pt
+    from paddle_tpu import layers, optimizer
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [in_dim], dtype="float32")
+        y = layers.data("y", [1], dtype="int64")
+        h = layers.fc(x, size=hidden, act="relu")
+        logits = layers.fc(h, size=classes)
+        loss = layers.mean(layers.softmax_with_cross_entropy(logits, y))
+        optimizer.SGD(0.1).minimize(loss)
+    return main, startup, loss
+
+
+def _batch(rng, n=16, in_dim=64, classes=8):
+    import numpy as np
+    return {"x": rng.rand(n, in_dim).astype(np.float32),
+            "y": rng.randint(0, classes, (n, 1)).astype(np.int64)}
+
+
+def bench_trace_lower():
+    """Program -> StableHLO wall time of the small train step."""
+    import numpy as np
+    import paddle_tpu as pt
+    from paddle_tpu.framework.scope import Scope, scope_guard
+    with scope_guard(Scope()):
+        main, startup, loss = _build_train()
+        exe = pt.Executor()
+        exe.run(startup)
+        feed = _batch(np.random.RandomState(0))
+        t0 = time.perf_counter()
+        exe.dump_hlo(main, feed=feed, fetch_list=[loss],
+                     include_compiled=False)
+        dt = time.perf_counter() - t0
+    return {"trace_lower_s": round(dt, 4)}
+
+
+def bench_cache_hit(steps=12):
+    """Step-cache hit rate of a steady single-program dispatch loop."""
+    import numpy as np
+    import paddle_tpu as pt
+    from paddle_tpu.framework.scope import Scope, scope_guard
+    with scope_guard(Scope()):
+        main, startup, loss = _build_train()
+        exe = pt.Executor()
+        exe.run(startup)
+        feed = _batch(np.random.RandomState(0))
+        for _ in range(steps):
+            exe.run(main, feed=feed, fetch_list=[loss])
+        total = exe.cache_hits + exe.cache_misses
+        rate = exe.cache_hits / float(total) if total else 0.0
+    return {"cache_hit_rate": round(rate, 4),
+            "cache_compiles": exe.cache_misses}
+
+
+def bench_quantized_step(steps=6):
+    """dp-sharded CompiledProgram step wall time, exact vs quantized,
+    plus the quantized path's wire/raw byte ratio."""
+    import numpy as np
+    import jax
+    import paddle_tpu as pt
+    from paddle_tpu.framework.compiler import CompiledProgram, \
+        BuildStrategy
+    from paddle_tpu.framework.scope import Scope, scope_guard
+    from paddle_tpu.framework import resilience
+    n_dev = min(8, len(jax.devices()))
+    feed = _batch(np.random.RandomState(0), n=2 * n_dev)
+    out = {}
+    for tag, quant in (("exact", False), ("quant", True)):
+        with scope_guard(Scope()):
+            main, startup, loss = _build_train()
+            exe = pt.Executor()
+            exe.run(startup)
+            bs = BuildStrategy()
+            bs.mesh_axes = {"dp": n_dev}
+            bs.quantize_collectives = quant
+            comp = CompiledProgram(main, bs)
+            if quant:
+                resilience.clear_bytes()
+            exe.run(comp, feed=feed, fetch_list=[loss])  # compile + warm
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                vals = exe.run(comp, feed=feed, fetch_list=[loss])
+            dt = (time.perf_counter() - t0) / steps
+            assert np.isfinite(np.asarray(vals[0])).all()
+            out["%s_step_s" % tag] = round(dt, 5)
+            if quant:
+                tot = resilience.bytes_totals().get(
+                    "collective", {"raw": 0, "wire": 0})
+                ratio = tot["wire"] / float(tot["raw"]) if tot["raw"] \
+                    else 1.0
+                out["collective_wire_ratio"] = round(ratio, 4)
+                out["collective_raw_bytes"] = tot["raw"]
+                out["collective_wire_bytes"] = tot["wire"]
+    return out
+
+
+def bench_feed(n_files=16, per_file=64, batches=200, batch_size=8):
+    """ShardedFeed draw+commit throughput (samples/sec, one host)."""
+    import numpy as np
+    from paddle_tpu.reader.sharded_feed import ShardedFeed
+    rng = np.random.RandomState(0)
+    files = [[{"x": rng.rand(4).astype(np.float32)}
+              for _ in range(per_file)] for _ in range(n_files)]
+    feed = ShardedFeed(files, n_hosts=1, host_id=0, seed=3,
+                       batch_size=batch_size)
+    served = 0
+    t0 = time.perf_counter()
+    for _ in range(batches):
+        b = feed.next_batch()
+        if b is None:
+            break
+        served += len(b["x"])
+        feed.commit()
+    dt = time.perf_counter() - t0
+    return {"feed_samples_per_s": round(served / dt, 1),
+            "feed_batches": batches}
+
+
+def run_all():
+    """Run every section; returns the report dict (never raises — a
+    broken section lands as an "error" entry so the JSON line and the
+    other sections still ship)."""
+    metrics, errors = {}, {}
+    for name, fn in (("trace_lower", bench_trace_lower),
+                     ("cache_hit", bench_cache_hit),
+                     ("quantized_step", bench_quantized_step),
+                     ("feed", bench_feed)):
+        t0 = time.perf_counter()
+        try:
+            metrics.update(fn())
+        except Exception as e:  # pragma: no cover - section crash
+            errors[name] = "%s: %s" % (type(e).__name__, e)
+        metrics["%s_section_s" % name] = round(
+            time.perf_counter() - t0, 3)
+    report = {"metric": "bench_micro", "unit": "mixed",
+              "platform": _platform(), "metrics": metrics}
+    violations = check_budgets(metrics)
+    report["budgets_ok"] = not violations and not errors
+    if violations:
+        report["budget_violations"] = violations
+    if errors:
+        report["errors"] = errors
+    return report
+
+
+def _platform():
+    import jax
+    return sorted({d.platform for d in jax.devices()})
+
+
+def main(argv=None):
+    _force_cpu()
+    report = run_all()
+    print(json.dumps(report))
+    return 0 if report["budgets_ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -46,6 +46,22 @@ class BuildStrategy(object):
         # halt detection: bound each step's completion (None = no guard);
         # consumed by the run_step watchdog (framework/watchdog.py)
         self.collective_timeout_s = _env_timeout_default()
+        # block-quantized data-parallel gradient sync (EQuARX, PAPERS.md):
+        # the step is lowered through shard_map over data_axis and every
+        # parameter gradient is synced quantize -> psum -> dequantize
+        # (int8 payload + per-block fp32 scale) instead of riding pjit's
+        # implicit full-width psum. Gradient-merge-aware: accumulation
+        # buffers add the already-synced fp32 value, so only the
+        # cross-host sync is quantized. Pure-dp meshes only (every other
+        # axis must have size 1); fetches are dp-averaged (float) /
+        # AND-ed (bool flags). Wire accounting lands in
+        # resilience.metrics() as collective_bytes_total{kind=raw|wire}.
+        self.quantize_collectives = False
+        self.quantize_block_size = 256
+        self.quantize_bits = 8
+        # gradients below this element count ride the exact full-width
+        # sync (sub-block payloads cost MORE quantized); None = one block
+        self.quantize_min_size = None
         # parity no-ops
         self.fuse_all_reduce_ops = True
         self.fuse_elewise_add_act_ops = True
@@ -141,7 +157,11 @@ class CompiledProgram(object):
     def _cache_token(self):
         bs = self._build_strategy
         return (tuple(sorted((bs.mesh_axes or {}).items())), bs.data_axis,
-                getattr(bs, "collective_timeout_s", None))
+                getattr(bs, "collective_timeout_s", None),
+                (getattr(bs, "quantize_collectives", False),
+                 getattr(bs, "quantize_block_size", 256),
+                 getattr(bs, "quantize_bits", 8),
+                 getattr(bs, "quantize_min_size", None)))
 
     def _mesh_obj(self):
         if self._mesh is None:
@@ -198,7 +218,7 @@ class CompiledProgram(object):
             NamedSharding(mesh, P(*((None,) + tuple(s.spec))))
             for s in (self._feed_sharding(n, mesh) for n in feed_names))
         return self._wrap_sharded(multi, mesh, state_sh, feed_sh,
-                                  (None, state_sh))
+                                  (None, state_sh), window=True)
 
     def _build_step(self, executor, step, program, state_names, feed_names,
                     feed_vals, check_numerics=False):
@@ -209,10 +229,89 @@ class CompiledProgram(object):
             else (None, state_sh)
         return self._wrap_sharded(step, mesh, state_sh, feed_sh, out_sh)
 
-    def _wrap_sharded(self, fn, mesh, state_sh, feed_sh, out_sh):
+    # -- quantized collectives --------------------------------------------
+    def _quantize_ctx(self, mesh):
+        """Build the per-compile QuantizedSyncContext, or None when the
+        quantized path does not apply (option off / no data axis)."""
+        bs = self._build_strategy
+        if not getattr(bs, "quantize_collectives", False):
+            return None
+        if bs.data_axis not in mesh.axis_names:
+            return None
+        bad = {a: int(s) for a, s in mesh.shape.items()
+               if a != bs.data_axis and int(s) > 1}
+        if bad:
+            raise ValueError(
+                "quantize_collectives lowers the step through shard_map "
+                "over the %r axis with LOCAL per-shard semantics, so it "
+                "supports pure data-parallel meshes only; model axes %r "
+                "would lose their XLA-inserted collectives. Drop the "
+                "option or the model axes." % (bs.data_axis, bad))
+        from ..ops.collective_ops import QuantizedSyncContext
+        return QuantizedSyncContext(
+            bs.data_axis,
+            block_size=int(getattr(bs, "quantize_block_size", 256)),
+            bits=int(getattr(bs, "quantize_bits", 8)),
+            min_size=getattr(bs, "quantize_min_size", None))
+
+    def _quantized_fn(self, fn, mesh, state_sh, feed_sh, out_sh, qctx):
+        """shard_map the step over the data axis with explicit quantized
+        gradient sync (the trace hook fires inside the scope) and
+        replicated-consistent outputs: float fetches are dp-averaged
+        (local-mean loss -> global-mean loss), bool flags (check_numerics)
+        are AND-ed across shards, state passes through untouched — it is
+        replicated by construction because every shard applies the same
+        synced gradients."""
+        from ..ops import collective_ops as cops
+        try:
+            from jax import shard_map as _sm_mod
+            shard_map = _sm_mod
+        except ImportError:
+            from jax.experimental.shard_map import shard_map
+        axis = self._build_strategy.data_axis
+
+        def _spec_of(s):
+            return P() if s is None else s.spec
+
+        in_specs = (tuple(s.spec for s in state_sh),
+                    tuple(s.spec for s in feed_sh))
+        out_specs = jax.tree_util.tree_map(
+            _spec_of, out_sh,
+            is_leaf=lambda s: s is None or isinstance(s, NamedSharding))
+
+        def _sync_leaf(v):
+            if jnp.issubdtype(jnp.result_type(v), jnp.bool_):
+                return jnp.all(jax.lax.all_gather(v, axis), axis=0)
+            if jnp.issubdtype(jnp.result_type(v), jnp.inexact):
+                return jax.lax.pmean(v, axis)
+            return v
+
+        def quant_step(state_tuple, feed_tuple):
+            with cops.grad_sync_scope(qctx):
+                out = fn(state_tuple, feed_tuple)
+            head = jax.tree_util.tree_map(_sync_leaf, out[0])
+            tail = jax.tree_util.tree_map(_sync_leaf, out[2:])
+            return (head, out[1]) + tail
+
+        try:
+            return shard_map(quant_step, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_rep=False)
+        except TypeError:   # newer jax dropped check_rep
+            return shard_map(quant_step, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs)
+
+    def _wrap_sharded(self, fn, mesh, state_sh, feed_sh, out_sh,
+                      window=False):
         """Shared step/window machinery: jit over the mesh, stage inputs
         onto their shardings, and arm the one-behind collective-timeout
-        watchdog."""
+        watchdog. With quantize_collectives on, the fn is first lowered
+        through shard_map with quantized gradient sync; the per-step wire
+        accounting (static, accumulated at trace time) is recorded per
+        dispatch (x window length for run_steps windows)."""
+        qctx = self._quantize_ctx(mesh)
+        if qctx is not None:
+            fn = self._quantized_fn(fn, mesh, state_sh, feed_sh, out_sh,
+                                    qctx)
         jitted = jax.jit(fn, in_shardings=(state_sh, feed_sh),
                          out_shardings=out_sh, donate_argnums=(0,))
         timeout_s = getattr(self._build_strategy, "collective_timeout_s",
@@ -243,5 +342,15 @@ class CompiledProgram(object):
                 out = jitted(placed_state, placed_feed)
                 if timeout_s is not None:
                     pending.append(out)
+                if qctx is not None and qctx.raw_bytes:
+                    # static per-step totals (populated by the first
+                    # call's trace), multiplied by the window length:
+                    # one record per dispatch, zero device syncs
+                    from . import resilience
+                    n = int(np.shape(feed_tuple[0])[0]) \
+                        if window and feed_tuple else 1
+                    resilience.record_bytes("collective",
+                                            qctx.raw_bytes * n,
+                                            qctx.wire_bytes * n)
                 return out
         return run_step

@@ -43,6 +43,7 @@ import collections
 import threading
 import time
 
+from . import resilience
 from .resilience import RestartBudgetExceededError, record_event
 
 __all__ = [
@@ -1327,12 +1328,25 @@ class ElasticTrainer(PodResilientTrainer):
     def __init__(self, trainers, coordinator=None, max_restarts=3,
                  host_id=None, rejoin=True, sync_dir=None,
                  lr_rescale=False, grad_merge_steps=1,
-                 lr_rescale_hook=None, drain_after=None):
+                 lr_rescale_hook=None, drain_after=None,
+                 ship_compress="zlib"):
         super(ElasticTrainer, self).__init__(
             trainers, coordinator=coordinator, max_restarts=max_restarts,
             host_id=host_id)
         self._rejoin = bool(rejoin)
         self._sync_dir = sync_dir
+        # ship_compress: codec for the rejoin state ship (ops/quant_ops
+        # host codec in the threaded simulation, io.save_checkpoint
+        # compress= in sync_dir mode). "zlib" (default) is LOSSLESS —
+        # the joiner's state stays bitwise the donors', which the
+        # pod-parity guarantees rely on; "q8" is the lossy block codec
+        # for operators who accept its error envelope on rejoin; None
+        # ships full-width. Either way the raw-vs-wire pair lands in
+        # resilience.bytes_totals()["stateship"].
+        if ship_compress not in (None, "zlib", "q8"):
+            raise ValueError("ship_compress must be None, 'zlib' or "
+                             "'q8', got %r" % (ship_compress,))
+        self._ship_compress = ship_compress
         # drain_after=k arms the PROACTIVE straggler drain: each host's
         # critical-straggler latch (StragglerDetector action_k) rides
         # the window status exchange; a host flagged for k CONSECUTIVE
@@ -1522,7 +1536,14 @@ class ElasticTrainer(PodResilientTrainer):
         io_mod.save_checkpoint(trainer._executor, self._sync_dir,
                                trainer._program, step=sync_step,
                                keep_last=2, scope=self._scope_of(trainer),
-                               feed_state=feed_state)
+                               feed_state=feed_state,
+                               compress=self._ship_compress)
+        try:
+            raw, wire = io_mod.checkpoint_dir_bytes(self._sync_dir,
+                                                    sync_step)
+            resilience.record_bytes("stateship", raw, wire)
+        except (OSError, ValueError, KeyError):  # pragma: no cover
+            pass   # accounting must never fail a rejoin
         record_event("sync_ship", step=sync_step)
 
     def _receive_state(self, hid, trainer, live, sync_step):
@@ -1558,18 +1579,38 @@ class ElasticTrainer(PodResilientTrainer):
                         "position with the params" % (sync_step,
                                                       self._sync_dir))
                 feed.restore(feed_state, live=sorted(live))
+            try:
+                raw, wire = io_mod.checkpoint_dir_bytes(self._sync_dir,
+                                                        sync_step)
+                resilience.record_bytes("stateship", raw, wire)
+            except (OSError, ValueError, KeyError):  # pragma: no cover
+                pass
             return
         donor = self._trainers[min(h for h in live if h != hid)]
         if feed is not None:
             feed.restore(donor._feed.global_state(), live=sorted(live))
+        # threaded simulation: the donor's leaves cross "the wire"
+        # through the ops/quant_ops host codec (zlib = lossless deflate,
+        # q8 = lossy block codec) so the byte accounting — and, for q8,
+        # the accuracy envelope — matches what a real transport would see
+        from ..ops import quant_ops
+        raw_total, wire_total = 0, 0
         for name, val in dict(self._scope_of(donor).items()).items():
             if isinstance(val, jax.Array):
                 # fresh buffers, same layout: sharing the donor's arrays
                 # would die the moment its next step DONATES them
-                sc.set_var(name, jax.device_put(np.asarray(val),
-                                                val.sharding))
+                host = np.asarray(val)
+                if self._ship_compress is not None:
+                    enc = quant_ops.encode_array(host,
+                                                 self._ship_compress)
+                    raw_total += enc["raw_bytes"]
+                    wire_total += enc["wire_bytes"]
+                    host = quant_ops.decode_array(enc)
+                sc.set_var(name, jax.device_put(host, val.sharding))
             else:
                 sc.set_var(name, val)
+        if wire_total:
+            resilience.record_bytes("stateship", raw_total, wire_total)
 
     # -- the elastic host loop ---------------------------------------------
     def _host_loop(self, hid, run_tag, feeds, fetch_list, steps=None):

@@ -88,6 +88,11 @@ class Executor(object):
         self._explicit_place = place is not None
         self.place = place if place is not None else _current_expected_place()
         self._cache = {}
+        # step-cache accounting (bench_micro's executor-cache-hit-rate
+        # metric): a miss is a fresh trace+compile, a hit re-dispatches
+        # the cached executable
+        self.cache_hits = 0
+        self.cache_misses = 0
 
     def _device_ctx(self):
         """default_device context for execution: pin only when the user
@@ -216,11 +221,14 @@ class Executor(object):
                None if strategy is None else strategy._cache_token())
         entry = self._cache.get(key) if use_program_cache else None
         if entry is None:
+            self.cache_misses += 1
             entry = self._compile(program, feed_vals, fetch_names,
                                   state_names, uses_rng, strategy,
                                   check_numerics)
             if use_program_cache:
                 self._cache[key] = entry
+        else:
+            self.cache_hits += 1
         step_fn = entry
 
         state_vals = tuple(scope.find_var(n) for n in state_names)
@@ -334,7 +342,10 @@ class Executor(object):
                tuple(state_names), check_numerics, "scan",
                None if strategy is None else strategy._cache_token())
         fn = self._cache.get(key) if use_program_cache else None
-        if fn is None:
+        if fn is not None:
+            self.cache_hits += 1
+        else:
+            self.cache_misses += 1
             base_step = self._make_step(program, sorted(staged),
                                         fetch_names, state_names, uses_rng,
                                         check_numerics)

@@ -50,6 +50,7 @@ __all__ = [
     "run_with_deadline", "INJECTION_POINTS", "context",
     "metrics", "metrics_text", "parse_metrics_text",
     "serve_metrics", "MetricsServer", "ElasticTrainer",
+    "record_bytes", "bytes_totals", "clear_bytes",
 ]
 
 INJECTION_POINTS = ("step", "ckpt_write", "serve")
@@ -168,7 +169,12 @@ def record_event(kind, **fields):
 
 
 def clear_events():
+    """Reset the observability surface: the bounded event log AND the
+    cumulative byte counters (a cleared log exporting stale byte series
+    would break the 'empty log -> empty metrics' contract tests and
+    scrapers rely on)."""
     _LOG.clear()
+    clear_bytes()
 
 
 # ---------------------------------------------------------------------------
@@ -179,6 +185,38 @@ METRIC_PREFIX = "paddle_tpu_resilience"
 # restore latencies span "local disk, small model" (~ms) to "multi-host
 # resharded restore" (~minutes)
 RESTORE_LATENCY_BUCKETS = (0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0, 120.0)
+
+# Wire-byte accounting of the compressed movement paths (quantized
+# collectives / elastic state ship / checkpoint payloads). Cumulative
+# process-global counters OUTSIDE the bounded event log: per-step
+# increments at dispatch rate would evict the whole log within minutes,
+# and counters must never wrap anyway. Channel -> {"raw", "wire"}.
+_BYTES = {}
+_BYTES_LOCK = threading.Lock()
+BYTES_CHANNELS = ("collective", "stateship", "ckpt")
+
+
+def record_bytes(channel, raw, wire):
+    """Accumulate one transfer's byte accounting: ``raw`` is what the
+    uncompressed path would have moved, ``wire`` what actually crossed
+    the wire/disk. Exported by :func:`metrics` as the counter pair
+    ``<prefix>_<channel>_bytes_total{kind="raw"|"wire"}``."""
+    with _BYTES_LOCK:
+        c = _BYTES.setdefault(str(channel), {"raw": 0, "wire": 0})
+        c["raw"] += int(raw)
+        c["wire"] += int(wire)
+
+
+def bytes_totals():
+    """Snapshot of the cumulative byte counters:
+    ``{channel: {"raw": n, "wire": n}}``."""
+    with _BYTES_LOCK:
+        return {ch: dict(c) for ch, c in _BYTES.items()}
+
+
+def clear_bytes():
+    with _BYTES_LOCK:
+        _BYTES.clear()
 
 
 def _histogram(name, values, buckets, labels=None):
@@ -222,6 +260,17 @@ def metrics(event_list=None, by_host=False):
                                              liveness heartbeat cadence
                                              is running behind (0 when
                                              healthy)
+      <prefix>_collective_bytes_total{kind=} raw-vs-wire bytes of the
+      <prefix>_stateship_bytes_total{kind=}  block-quantized gradient
+      <prefix>_ckpt_bytes_total{kind=}       all-reduce / elastic state
+                                             ship / checkpoint payloads
+                                             (kind="raw" is what the
+                                             uncompressed path would
+                                             move; kind="wire" what
+                                             actually moved — the pair
+                                             makes compression ratios
+                                             assertable, see
+                                             record_bytes)
       <prefix>_restore_latency_seconds       checkpoint-restore wall time
                                              (from restore events'
                                              latency_s)
@@ -275,6 +324,17 @@ def metrics(event_list=None, by_host=False):
         counters.append(
             {"name": METRIC_PREFIX + "_transport_reconnects_total",
              "labels": {}, "value": n_reconnect})
+    # compressed-movement byte accounting (quantized collectives, elastic
+    # state ship, checkpoint payloads): raw-vs-wire counter pairs from the
+    # cumulative process counters — emitted only for channels that moved
+    # bytes, so jobs without the compression paths export nothing new.
+    # NB: these ride the live counters even for event_list snapshots
+    # (they are not events — snapshotting them is bytes_totals()).
+    for ch, tot in sorted(bytes_totals().items()):
+        for kind in ("raw", "wire"):
+            counters.append(
+                {"name": "%s_%s_bytes_total" % (METRIC_PREFIX, ch),
+                 "labels": {"kind": kind}, "value": tot[kind]})
     last_epoch, last_lag, last_hb = {}, {}, {}
     for e in evs:
         if e["kind"] == "feed_epoch":
@@ -752,7 +812,7 @@ class ResilientTrainer(object):
     def __init__(self, executor, program, ckpt_dir, fetch_list=None,
                  checkpoint_every=10, max_restarts=3, retry_policy=None,
                  steps_per_dispatch=1, keep_last=3, scope=None,
-                 async_checkpoints=False, feed=None):
+                 async_checkpoints=False, feed=None, ckpt_compress=None):
         from .compiler import CompiledProgram
         if checkpoint_every < 1:
             raise ValueError("checkpoint_every must be >= 1")
@@ -781,6 +841,10 @@ class ResilientTrainer(object):
         # the feed cursor, and a restore rewinds the DATA position too,
         # so replay re-reads the exact batch sequence
         self._feed = feed
+        # ckpt_compress: io.save_checkpoint(compress=) for every periodic
+        # snapshot ("zlib" = lossless deflate, "q8" = lossy block codec —
+        # see io.save_checkpoint; restores are transparent either way)
+        self._ckpt_compress = ckpt_compress
 
     # -- events convenience ------------------------------------------------
     @staticmethod
@@ -795,7 +859,8 @@ class ResilientTrainer(object):
                                self._program, step=step,
                                keep_last=self._keep_last,
                                blocking=not self._async_ckpt,
-                               scope=self._scope, feed_state=feed_state)
+                               scope=self._scope, feed_state=feed_state,
+                               compress=self._ckpt_compress)
         record_event("ckpt", step=step)
 
     def _restore(self, step=None, shardings=None):

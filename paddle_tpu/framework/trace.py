@@ -50,6 +50,17 @@ class TraceContext(object):
         self._op_key = base_key
         self._op_rng_count = 0
         self.outer_env = None  # set while tracing a uses_subblock op
+        # quantized data-parallel gradient sync: when the compiler traces
+        # the step inside a shard_map with quantize_collectives on, every
+        # parameter gradient is synced (quantize -> psum -> dequantize)
+        # the moment it is produced — see _maybe_sync_param_grads. The
+        # scope also binds the sync axis so program-level collective ops
+        # (c_allreduce_*) are live inside the quantized step.
+        from ..ops import collective_ops as _cops
+        self.grad_sync = _cops.current_grad_sync()
+        self.synced_grads = set()
+        self.bound_axes = () if self.grad_sync is None \
+            else (self.grad_sync.axis_name,)
 
     def begin_op(self, rng_tag):
         """rng_tag is the op's structural position (block, index) hash —
@@ -101,6 +112,38 @@ def _rng_tag(block, idx):
     return (block.idx + 1) * 1000003 + idx
 
 
+GRAD_SUFFIX = "@GRAD"
+
+
+def _maybe_sync_param_grads(op, env, ctx):
+    """Quantized data-parallel gradient sync (ctx.grad_sync, installed by
+    CompiledProgram under BuildStrategy.quantize_collectives).
+
+    Fires on the FINAL binding of a persistable var's gradient — either
+    the grad op binding ``w@GRAD`` directly, or the ``sum`` op merging
+    ``w@GRAD@RENAME@k`` contributions — and replaces it in env with the
+    synced value. Every consumer (grad clip, regularizer, gradient-merge
+    accumulation, optimizer) then sees the globally-synced gradient,
+    matching pjit's implicit-psum semantics; gradient-merge buffers
+    accumulate the already-synced fp32 value, so accumulation stays
+    exact and only the cross-host sync is quantized. Once per grad name
+    per trace (ctx.synced_grads)."""
+    sync = ctx.grad_sync
+    if sync is None:
+        return
+    blk = ctx.program.global_block()
+    for names in op.outputs.values():
+        for n in names:
+            if not n.endswith(GRAD_SUFFIX) or n in ctx.synced_grads \
+                    or n not in env:
+                continue
+            var = blk._find_var_recursive(n[:-len(GRAD_SUFFIX)])
+            if var is None or not getattr(var, "persistable", False):
+                continue
+            ctx.synced_grads.add(n)
+            env[n] = sync.sync(n, env[n])
+
+
 def trace_block(block, env, ctx):
     for i, op in enumerate(block.ops):
         trace_op(op, env, ctx, _rng_tag(block, i))
@@ -125,6 +168,7 @@ def trace_op(op, env, ctx, rng_tag=0):
     finally:
         ctx.outer_env = prev_outer
     _bind_outputs(op, outs, env)
+    _maybe_sync_param_grads(op, env, ctx)
 
 
 def _split_diff(opdef, ins):
@@ -210,3 +254,4 @@ def _trace_grad_op(op, env, ctx):
                     "is the input non-differentiable?" %
                     (op.attrs["fwd_type"], names[i], slot))
     _bind_outputs(op, result, env)
+    _maybe_sync_param_grads(op, env, ctx)

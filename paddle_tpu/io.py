@@ -31,13 +31,13 @@ def _collect(program, scope, predicate):
     return out
 
 
-def _atomic_savez(dirname, filename, arrays):
+def _atomic_savez(dirname, filename, arrays, compressed=False):
     os.makedirs(dirname, exist_ok=True)
     fd, tmp = tempfile.mkstemp(dir=dirname, suffix=".tmp")
     os.close(fd)
     try:
         with open(tmp, "wb") as f:
-            np.savez(f, **arrays)
+            (np.savez_compressed if compressed else np.savez)(f, **arrays)
         os.replace(tmp, os.path.join(dirname, filename))
     finally:
         if os.path.exists(tmp):
@@ -223,7 +223,57 @@ def load_inference_model(dirname, executor, model_filename=None,
 # DIFFERENT topologies (dp2xmp2 -> dp4xmp2 resharding is just slicing).
 # ---------------------------------------------------------------------------
 
-CKPT_FORMAT_VERSION = 1
+# v1: plain npz shard payloads. v2: adds compressed payloads — "zlib"
+# (np.savez_compressed; npz layout unchanged, np.load reads it
+# transparently, so v2-zlib dirs are still WRITTEN as version 1) and
+# "q8" (block-quantized int8 members + ##q8* companions — LOSSY, so q8
+# dirs are stamped version 2: an older library refuses them with
+# CheckpointFormatError instead of restoring int8 garbage).
+CKPT_FORMAT_VERSION = 2
+
+# block-quantized payload companions (member-name suffixes next to the
+# main shard key; scrub's needed-key check only ever looks at main keys,
+# so verdicts are identical with or without them)
+_Q8_SCALE = "##q8s"
+_Q8_SHAPE = "##q8n"
+_Q8_DTYPE = "##q8t"
+
+
+def _encode_payload(own, compress, block_size=256):
+    """Encode a {key: array} shard payload for ``compress`` mode. Only
+    "q8" transforms anything: float32/float64 arrays of at least one
+    block become int8 blocks + fp32 scales + shape/dtype companions;
+    everything else (ints, tiny floats, exotic dtypes) stays raw so it
+    round-trips exactly."""
+    if compress != "q8":
+        return own
+    from .ops import quant_ops
+    out = {}
+    for key, arr in own.items():
+        if arr.dtype in (np.float32, np.float64) \
+                and arr.size >= block_size:
+            q, scale = quant_ops.np_block_quantize(arr, block_size)
+            out[key] = q
+            out[key + _Q8_SCALE] = scale
+            out[key + _Q8_SHAPE] = np.asarray(arr.shape, np.int64)
+            out[key + _Q8_DTYPE] = np.asarray(arr.dtype.str)
+        else:
+            out[key] = arr
+    return out
+
+
+def _decode_member(z, key):
+    """Read one npz member, transparently dequantizing a q8-encoded one
+    (its ##q8s companion is the marker). Plain members — every pre-v2
+    checkpoint — pass straight through."""
+    arr = z[key]
+    if key + _Q8_SCALE in z.files:
+        from .ops import quant_ops
+        return quant_ops.np_block_dequantize(
+            arr, z[key + _Q8_SCALE],
+            tuple(int(d) for d in z[key + _Q8_SHAPE]),
+            np.dtype(str(z[key + _Q8_DTYPE])))
+    return arr
 
 
 class CheckpointFormatError(RuntimeError):
@@ -294,8 +344,30 @@ def wait_for_pending_saves():
 
 def save_checkpoint(executor, dirname, main_program=None, step=None,
                     keep_last=3, blocking=True, scope=None,
-                    feed_state=None):
+                    feed_state=None, compress=None):
     """Sharded checkpoint of the whole training scope.
+
+    compress: payload compression for the shard npz files.
+
+      None    (default) plain npz — byte-identical to the historical
+              format.
+      "zlib"  LOSSLESS deflate (np.savez_compressed). Same members, same
+              manifest, still written as format_version 1 — any library
+              version reads it transparently. The safe default for sync/
+              state-ship checkpoints: restores stay bitwise.
+      "q8"    block-quantized int8 payloads + per-block fp32 scales
+              (ops/quant_ops codec) for float32/float64 arrays of at
+              least one block; LOSSY (per-block abs-max error envelope).
+              Stamped format_version 2 so an older library refuses it
+              instead of restoring int8 garbage; this library's
+              load_checkpoint dequantizes transparently. scrub verdicts
+              are unchanged either way (companions are extra members the
+              needed-key check never looks at).
+
+    Every commit records the raw-vs-wire byte pair under the ``ckpt``
+    channel of ``resilience.bytes_totals()`` (raw = array bytes as
+    collected, wire = npz bytes on disk), so compression ratios are
+    assertable from ``resilience.metrics()``.
 
     feed_state: optional JSON-serializable dataset cursor (e.g.
     ``reader.ShardedFeed.global_state()``) persisted in the manifest's
@@ -323,6 +395,9 @@ def save_checkpoint(executor, dirname, main_program=None, step=None,
     previous commit first.
     """
     import jax
+    if compress not in (None, "zlib", "q8"):
+        raise ValueError("save_checkpoint compress must be None, 'zlib' "
+                         "or 'q8', got %r" % (compress,))
     scope = scope if scope is not None else global_scope()
     pid = jax.process_index()
     step_no = int(step if step is not None else 0)
@@ -375,19 +450,34 @@ def save_checkpoint(executor, dirname, main_program=None, step=None,
     n_proc = jax.process_count()
 
     def commit():
-        _atomic_savez(full_dir, "shards_p%d.npz" % pid, own)
+        raw_bytes = sum(int(a.nbytes) for a in own.values())
+        shard_file = "shards_p%d.npz" % pid
+        _atomic_savez(full_dir, shard_file,
+                      _encode_payload(own, compress),
+                      compressed=compress is not None)
+        from .framework import resilience
+        try:
+            resilience.record_bytes(
+                "ckpt", raw_bytes,
+                os.path.getsize(os.path.join(full_dir, shard_file)))
+        except OSError:  # pragma: no cover - racing cleanup
+            pass
         # chaos injection point: an I/O fault HERE (shards written,
         # manifest not) models a mid-commit crash — the step dir is torn
         # and load_checkpoint must quarantine it, never restore from it
-        from .framework import resilience
         resilience.fire("ckpt_write", what=step_dir)
         if multihost:  # pragma: no cover - needs real multihost
             from jax.experimental import multihost_utils
             multihost_utils.sync_global_devices("ckpt_shards_%s" % step_dir)
         if pid == 0:
-            manifest = {"format_version": CKPT_FORMAT_VERSION,
+            # only the LOSSY q8 layout needs the version fence; zlib npz
+            # is transparently readable by every library version
+            version = 2 if compress == "q8" else 1
+            manifest = {"format_version": version,
                         "step": step_no, "process_count": n_proc,
                         "vars": manifest_vars}
+            if compress is not None:
+                manifest["compress"] = compress
             if feed_state is not None:
                 manifest["feed_state"] = feed_state
             _atomic_write(os.path.join(full_dir, MANIFEST_FILE),
@@ -707,12 +797,14 @@ def _load_step_dir(dirname, step_dir, shardings):
     def readers(fname, key):
         # cache decoded ARRAYS, not just npz handles: with shardings=,
         # _stitch runs once per local device shard and NpzFile.__getitem__
-        # re-decompresses the member on every access
+        # re-decompresses the member on every access. _decode_member
+        # transparently dequantizes q8-compressed payloads.
         if (fname, key) not in arrays_cache:
             if fname not in handles:
                 handles[fname] = np.load(os.path.join(full_dir, fname),
                                          allow_pickle=False)
-            arrays_cache[(fname, key)] = handles[fname][key]
+            arrays_cache[(fname, key)] = _decode_member(handles[fname],
+                                                        key)
         return arrays_cache[(fname, key)]
 
     try:
@@ -736,6 +828,26 @@ def _load_step_dir(dirname, step_dir, shardings):
         for h in handles.values():
             h.close()
     return int(manifest["step"]), out, manifest.get("feed_state")
+
+
+def checkpoint_dir_bytes(dirname, step):
+    """(raw, wire) byte accounting of one committed step dir: ``raw``
+    summed from the manifest's declared shapes/dtypes (what an
+    uncompressed payload would hold), ``wire`` from the npz file sizes
+    on disk. Cheap — manifest JSON + stat, no payload reads. Feeds the
+    ``stateship`` byte counters when a sync checkpoint ships rejoin
+    state. Raises on a missing/torn manifest (callers ship only
+    scrub-valid dirs)."""
+    full_dir = os.path.join(dirname, "step_%d" % int(step))
+    with open(os.path.join(full_dir, MANIFEST_FILE)) as f:
+        manifest = json.load(f)
+    raw = 0
+    for meta in manifest["vars"].values():
+        size = int(np.prod(meta["shape"])) if meta["shape"] else 1
+        raw += size * np.dtype(meta["dtype"]).itemsize
+    wire = sum(os.path.getsize(os.path.join(full_dir, k))
+               for k in os.listdir(full_dir) if k.endswith(".npz"))
+    return raw, wire
 
 
 def _step_no(step_dir):

@@ -24,6 +24,15 @@ def c_allreduce_sum(x, axis_name="dp"):
     return _collective("c_allreduce_sum", x, {"axis_name": axis_name})
 
 
+def c_allreduce_sum_quant(x, axis_name="dp", block_size=256, bits=8):
+    """Block-quantized allreduce (EQuARX): the wire carries int8 blocks
+    + per-block fp32 scales instead of full-width values. Same identity-
+    outside-shard_map contract as c_allreduce_sum."""
+    return _collective("c_allreduce_sum_quant", x,
+                       {"axis_name": axis_name,
+                        "block_size": int(block_size), "bits": int(bits)})
+
+
 def c_allgather(x, nranks=None, axis_name="dp"):
     shape = None
     if x.shape is not None and nranks:

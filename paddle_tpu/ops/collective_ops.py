@@ -9,11 +9,16 @@ axis (paddle_tpu.distributed). Single-device traces degrade to identity, so
 the same program runs anywhere — mirroring the reference where ring_id 0 on
 one rank is a no-op.
 """
+import contextlib
+import threading
+
+import numpy as np
 import jax
 import jax.numpy as jnp
 from jax import lax
 
 from .registry import register_op
+from . import quant_ops
 
 
 def _axis(ctx, attrs):
@@ -104,3 +109,127 @@ def _ppermute(ctx, ins, attrs):
     shift = attrs.get("shift", 1)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return {"Out": lax.ppermute(x, ax, perm)}
+
+
+# ---------------------------------------------------------------------------
+# block-quantized all-reduce (EQuARX, PAPERS.md)
+# ---------------------------------------------------------------------------
+
+def quantized_psum(x, axis_name, block_size=quant_ops.DEFAULT_BLOCK_SIZE,
+                   bits=quant_ops.DEFAULT_BITS, mean=False):
+    """Quantize -> sum-over-axis -> dequantize, wire-honest: each member
+    quantizes its LOCAL contribution (int8 payload + per-block fp32
+    scale), the int8 blocks + scales are what cross the axis
+    (lax.all_gather of int8), and every member dequantizes + sums the
+    gathered contributions in fp32. Deterministic and bitwise-identical
+    on every member (the gather axis fixes the summation order), so
+    replicated state updated from the result stays replicated.
+
+    ``mean=True`` divides by the axis size — the data-parallel gradient
+    sync (global grad = mean over shards of local grads of local-mean
+    losses). Accuracy model matches EQuARX: one quantization per
+    contribution, exact fp32 accumulation of the dequantized values.
+    """
+    q, scale = quant_ops.block_quantize(x, block_size, bits)
+    gq = lax.all_gather(q, axis_name)          # (n, n_blocks, block) int8
+    gs = lax.all_gather(scale, axis_name)      # (n, n_blocks) fp32
+    qmax = 2.0 ** (int(bits) - 1) - 1
+    deq = gq.astype(jnp.float32) \
+        * (jnp.maximum(gs, 1e-12) / qmax)[..., None]
+    tot = jnp.sum(deq, axis=0)
+    if mean:
+        tot = tot / _axis_size(axis_name)
+    size = int(np.prod(x.shape)) if x.shape else 1
+    return tot.reshape(-1)[:size].reshape(x.shape).astype(x.dtype)
+
+
+@register_op("c_allreduce_sum_quant")
+def _c_allreduce_sum_quant(ctx, ins, attrs):
+    """Block-quantized c_allreduce_sum: same contract as c_allreduce_sum
+    (identity outside shard_map) but the wire carries int8 blocks + fp32
+    scales instead of full-width values. attrs: block_size, bits."""
+    x = ins["X"][0]
+    ax = _axis(ctx, attrs)
+    if ax is None:
+        return {"Out": x}
+    return {"Out": quantized_psum(
+        x, ax, block_size=int(attrs.get("block_size",
+                                        quant_ops.DEFAULT_BLOCK_SIZE)),
+        bits=int(attrs.get("bits", quant_ops.DEFAULT_BITS)))}
+
+
+# ---------------------------------------------------------------------------
+# gradient-sync scope: how the compiler's quantize_collectives option
+# reaches the trace engine
+# ---------------------------------------------------------------------------
+
+class QuantizedSyncContext(object):
+    """Per-compile gradient-sync policy + static byte accounting.
+
+    Installed around the step trace by CompiledProgram when
+    ``BuildStrategy.quantize_collectives`` is on; framework/trace.py
+    consults :func:`current_grad_sync` and calls :meth:`sync` once per
+    parameter gradient as it is produced, so every downstream consumer
+    (grad clip, regularizer, gradient-merge ACCUMULATION, optimizer)
+    sees the synced value — the same semantics pjit's implicit psum
+    gives, with fp32 accumulation staying exact because only the
+    cross-host sync is quantized.
+
+    ``raw_bytes``/``wire_bytes`` accumulate at TRACE time (shapes are
+    static), i.e. exactly once per compiled step; the dispatch wrapper
+    multiplies by the window length and feeds
+    ``resilience.record_bytes("collective", ...)`` per dispatch.
+    """
+
+    def __init__(self, axis_name, block_size=quant_ops.DEFAULT_BLOCK_SIZE,
+                 bits=quant_ops.DEFAULT_BITS, mean=True, min_size=None):
+        self.axis_name = axis_name
+        self.block_size = int(block_size)
+        self.bits = int(bits)
+        self.mean = bool(mean)
+        # tensors below one block ride the EXACT full-width sync: a
+        # sub-block payload (biases, LayerNorm scales) costs MORE on the
+        # wire quantized (block padding + scale) than raw, and its
+        # accuracy is the cheapest to keep
+        self.min_size = self.block_size if min_size is None \
+            else int(min_size)
+        self.raw_bytes = 0
+        self.wire_bytes = 0
+        self.synced = []      # grad var names, in trace order
+        self.synced_exact = []
+
+    def sync(self, name, g):
+        size = int(np.prod(g.shape)) if g.shape else 1
+        itemsize = jnp.dtype(g.dtype).itemsize
+        if size < self.min_size:
+            self.raw_bytes += size * itemsize
+            self.wire_bytes += size * itemsize
+            self.synced_exact.append(name)
+            red = lax.pmean if self.mean else lax.psum
+            return red(g, self.axis_name)
+        raw, wire = quant_ops.quantized_wire_bytes(
+            size, itemsize, self.block_size, self.bits)
+        self.raw_bytes += raw
+        self.wire_bytes += wire
+        self.synced.append(name)
+        return quantized_psum(g, self.axis_name, self.block_size,
+                              self.bits, mean=self.mean)
+
+
+_sync_tls = threading.local()
+
+
+@contextlib.contextmanager
+def grad_sync_scope(sync_ctx):
+    """Install ``sync_ctx`` for traces started on this thread (jit traces
+    run synchronously in the caller, so a thread-local is exact)."""
+    prev = getattr(_sync_tls, "ctx", None)
+    _sync_tls.ctx = sync_ctx
+    try:
+        yield sync_ctx
+    finally:
+        _sync_tls.ctx = prev
+
+
+def current_grad_sync():
+    return getattr(_sync_tls, "ctx", None)

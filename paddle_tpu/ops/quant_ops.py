@@ -1,15 +1,44 @@
-"""Quantization op kernels.
+"""Quantization op kernels + the block-quantization codec.
 
 Reference parity: paddle/fluid/operators/fake_quantize_op.cc + the
 contrib/slim quantization passes. Simulated quantization: values are
 quantized->dequantized in fp so XLA still runs bf16/fp32 matmuls; gradients
 pass straight through (STE), expressed exactly as
 x + stop_gradient(qdq(x) - x).
+
+Block codec (EQuARX, PAPERS.md): the bandwidth-bound paths — data-parallel
+gradient all-reduce (ops/collective_ops.quantized_psum), elastic rejoin
+state shipping (coordination.ElasticTrainer) and checkpoint payloads
+(io.save_checkpoint(compress=)) — move int8 payloads with one fp32 scale
+per ``block_size`` values instead of full-width floats:
+
+  * :func:`block_quantize` / :func:`block_dequantize` — the traced (jnp)
+    halves, static shapes, jit/shard_map-safe. Per-block abs-max scaling:
+    the max-magnitude element of every block round-trips exactly, every
+    other element is within ``absmax_block / qmax / 2`` of its value, and
+    any non-finite input poisons its whole block to NaN (so check_numerics
+    still fires instead of silently training on garbage).
+  * :func:`encode_array` / :func:`decode_array` — the host (numpy) codec
+    for state movement. mode="zlib" is LOSSLESS (bitwise round-trip; the
+    default for param/optimizer state, whose exactness guarantees must
+    survive the wire); mode="q8" is the lossy block codec (same error
+    envelope as the collective path).
+  * :func:`quantized_wire_bytes` — the raw-vs-wire byte accounting behind
+    the ``*_bytes_total`` counters in ``resilience.metrics()``.
 """
+import zlib
+
+import numpy as np
 import jax
 import jax.numpy as jnp
 
 from .registry import register_op
+
+# codec defaults shared by collectives, state-ship and checkpoints
+DEFAULT_BLOCK_SIZE = 256
+DEFAULT_BITS = 8
+SCALE_BYTES = 4          # one fp32 scale per block
+_SCALE_FLOOR = 1e-12     # all-zero blocks: avoid 0/0 without moving values
 
 
 def _qdq_abs_max(x, bits, scale=None):
@@ -51,6 +80,133 @@ def _fake_qdq_moving_avg(ctx, ins, attrs):
     out = x + jax.lax.stop_gradient(qdq - x)
     return {"Out": out, "OutScale": scale.reshape(1),
             "OutState": new_state, "OutAccum": new_accum}
+
+
+# ---------------------------------------------------------------------------
+# block codec — traced (jnp) halves
+# ---------------------------------------------------------------------------
+
+def _qmax(bits):
+    return 2.0 ** (int(bits) - 1) - 1
+
+
+def block_quantize(x, block_size=DEFAULT_BLOCK_SIZE, bits=DEFAULT_BITS):
+    """Quantize ``x`` into int8 blocks with per-block fp32 abs-max scales.
+
+    Returns ``(q, scale)`` where ``q`` is ``(n_blocks, block_size)`` int8
+    (the flattened input zero-padded to a whole number of blocks) and
+    ``scale`` is ``(n_blocks,)`` float32. Static shapes — safe inside
+    jit/shard_map/scan. A non-finite element makes its block's scale
+    non-finite, which :func:`block_dequantize` turns into an all-NaN
+    block: poison is preserved, never silently clipped to finite values.
+    """
+    qmax = _qmax(bits)
+    flat = jnp.ravel(x)
+    n = flat.shape[0]
+    pad = (-n) % int(block_size)
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    blocks = flat.reshape(-1, int(block_size)).astype(jnp.float32)
+    scale = jnp.max(jnp.abs(blocks), axis=1)
+    safe = jnp.maximum(scale, _SCALE_FLOOR)
+    q = jnp.clip(jnp.round(blocks / safe[:, None] * qmax), -qmax, qmax)
+    return q.astype(jnp.int8), scale
+
+
+def block_dequantize(q, scale, shape, dtype, bits=DEFAULT_BITS):
+    """Inverse of :func:`block_quantize`: rebuild an array of
+    ``shape``/``dtype`` from int8 blocks + fp32 scales."""
+    qmax = _qmax(bits)
+    safe = jnp.maximum(scale, _SCALE_FLOOR)
+    blocks = q.astype(jnp.float32) * (safe / qmax)[:, None]
+    size = int(np.prod(shape)) if shape else 1
+    return blocks.reshape(-1)[:size].reshape(shape).astype(dtype)
+
+
+def quantized_wire_bytes(size, itemsize, block_size=DEFAULT_BLOCK_SIZE,
+                         bits=DEFAULT_BITS):
+    """(raw, wire) byte accounting of one quantized transfer: ``raw`` is
+    what the full-width collective/copy would move, ``wire`` the int8
+    payload plus one fp32 scale per block."""
+    size = int(size)
+    n_blocks = -(-size // int(block_size)) if size else 0
+    payload = n_blocks * int(block_size) * (int(bits) // 8)
+    return size * int(itemsize), payload + n_blocks * SCALE_BYTES
+
+
+# ---------------------------------------------------------------------------
+# host codec — state movement (numpy; in-process metadata, never pickled)
+# ---------------------------------------------------------------------------
+
+def np_block_quantize(arr, block_size=DEFAULT_BLOCK_SIZE,
+                      bits=DEFAULT_BITS):
+    """Numpy mirror of :func:`block_quantize` (checkpoint payloads and
+    host-side state shipping)."""
+    qmax = _qmax(bits)
+    flat = np.asarray(arr, np.float32).reshape(-1)
+    pad = (-flat.size) % int(block_size)
+    if pad:
+        flat = np.concatenate([flat, np.zeros(pad, flat.dtype)])
+    blocks = flat.reshape(-1, int(block_size))
+    scale = np.max(np.abs(blocks), axis=1).astype(np.float32)
+    safe = np.maximum(scale, _SCALE_FLOOR)
+    with np.errstate(invalid="ignore", over="ignore"):
+        q = np.clip(np.round(blocks / safe[:, None] * qmax), -qmax, qmax)
+    # int8-cast of NaN is undefined in C; force 0 — the non-finite SCALE
+    # still poisons the block to NaN on dequantize
+    q = np.where(np.isfinite(q), q, 0.0).astype(np.int8)
+    return q, scale
+
+
+def np_block_dequantize(q, scale, shape, dtype, bits=DEFAULT_BITS):
+    qmax = _qmax(bits)
+    safe = np.maximum(scale.astype(np.float32), _SCALE_FLOOR)
+    with np.errstate(invalid="ignore"):
+        blocks = q.astype(np.float32) * (safe / qmax)[:, None]
+    size = int(np.prod(shape)) if len(shape) else 1
+    return blocks.reshape(-1)[:size].reshape(shape).astype(dtype)
+
+
+def encode_array(arr, mode="zlib", block_size=DEFAULT_BLOCK_SIZE,
+                 bits=DEFAULT_BITS):
+    """Encode one host array for the wire. Returns a dict holding the
+    payload plus ``raw_bytes``/``wire_bytes`` accounting. ``mode``:
+
+      "zlib"  lossless deflate of the raw bytes (bitwise round-trip —
+              safe for params/optimizer state whose exactness guarantees
+              must survive shipping)
+      "q8"    the lossy block codec (float32/float64 arrays only; other
+              dtypes fall back to zlib so integer counters and exotic
+              dtypes always round-trip exactly)
+
+    The returned dict carries the numpy dtype OBJECT (in-process use by
+    the elastic state ship); it is not a serialization format — disk
+    payloads go through io.save_checkpoint's npz layout instead."""
+    arr = np.ascontiguousarray(arr)
+    enc = {"shape": arr.shape, "dtype": arr.dtype,
+           "raw_bytes": int(arr.nbytes)}
+    if mode == "q8" and arr.dtype in (np.float32, np.float64):
+        q, scale = np_block_quantize(arr, block_size, bits)
+        enc.update(mode="q8", q=q, scale=scale, block_size=int(block_size),
+                   bits=int(bits),
+                   wire_bytes=int(q.nbytes + scale.nbytes))
+        return enc
+    if mode not in ("zlib", "q8"):
+        raise ValueError("encode_array mode must be 'zlib' or 'q8', got %r"
+                         % (mode,))
+    payload = zlib.compress(arr.tobytes(), 1)
+    enc.update(mode="zlib", data=payload, wire_bytes=int(len(payload)))
+    return enc
+
+
+def decode_array(enc):
+    """Inverse of :func:`encode_array`."""
+    if enc["mode"] == "q8":
+        return np_block_dequantize(enc["q"], enc["scale"], enc["shape"],
+                                   enc["dtype"], enc["bits"])
+    raw = zlib.decompress(enc["data"])
+    return np.frombuffer(raw, dtype=enc["dtype"]).reshape(
+        enc["shape"]).copy()
 
 
 @register_op("fake_channel_wise_quantize_dequantize_abs_max")

@@ -79,7 +79,8 @@ class ShardedFeed(object):
     """
 
     def __init__(self, files, n_hosts, host_id, seed=0, batch_size=None,
-                 shuffle=True, epochs=None, collate=None):
+                 shuffle=True, epochs=None, collate=None,
+                 weighted_rebalance=False):
         if n_hosts < 1:
             raise ValueError("n_hosts must be >= 1")
         if not 0 <= int(host_id) < int(n_hosts):
@@ -121,10 +122,22 @@ class ShardedFeed(object):
         # makes _consumed O(1) instead of O(epoch) per candidate lane
         # on every next_batch draw of a long run
         self._epoch_prefix = {}
+        # weighted_rebalance=True: lanes ORPHANED by a membership change
+        # (committed owner no longer live) are placed by the per-host
+        # feed_stream_lag gauge — least-lagged survivors first — instead
+        # of the round-robin formula; non-orphaned lanes keep following
+        # round-robin, so full-membership identity (and the rejoin
+        # hand-back) is unchanged. Falls back to round-robin whenever no
+        # gauges are available. See rebalance() for the agreement caveat.
+        self.weighted_rebalance = bool(weighted_rebalance)
         # committed view of EVERY lane (the agreed pod map) ...
         fresh = {"epoch": 0, "pos": 0, "offset": 0}
         self._known = {l: dict(fresh) for l in range(self.n_lanes)}
         self._live = list(range(self.n_lanes))
+        # lane -> owning host (the round-robin identity at full
+        # membership); kept explicit so weighted re-homing has a
+        # committed owner to compare against
+        self._owner = {l: l % self.n_lanes for l in range(self.n_lanes)}
         # ... and this host's owned slice: committed + tentative cursors
         self._own = self._owned_lanes(self._live)
         self._lanes = {l: dict(fresh) for l in self._own}
@@ -345,16 +358,31 @@ class ShardedFeed(object):
                 "n_files": len(self._files), "n_lanes": self.n_lanes,
                 "epochs": self.epochs,
                 "lanes": {str(l): dict(c)
-                          for l, c in self._known.items()}}
+                          for l, c in self._known.items()},
+                # the committed owner map rides the cursor (additive —
+                # pre-existing cursors without it restore unchanged): a
+                # weighted_rebalance joiner must run its orphan
+                # detection against the POD's agreed map, not the stale
+                # one it held when it was fenced
+                "owners": {str(l): int(h)
+                           for l, h in self._owner.items()}}
 
     # ``state()`` is the single-host-friendly alias
     state = global_state
 
-    def restore(self, state, live=None):
+    def restore(self, state, live=None, lags=None):
         """Adopt a :meth:`global_state` snapshot (from a checkpoint or a
         rejoin sync). ``live`` re-maps lane ownership at the same time —
         an 8-host cursor restored onto 6 live hosts resumes the exact
-        global batch sequence with the 2 lost lanes re-homed."""
+        global batch sequence with the 2 lost lanes re-homed.
+
+        Weighted mode: the snapshot's ``owners`` map (when present) is
+        adopted as the committed baseline BEFORE re-mapping, so this
+        host's orphan detection agrees with the pod that produced the
+        snapshot even if it missed intermediate re-balances while
+        fenced; ``lags`` feeds the weighted placement exactly like
+        :meth:`rebalance` (defaulting to the local event-log gauges —
+        same agreement caveat)."""
         if not isinstance(state, dict) or "lanes" not in state:
             raise FeedStateError("feed cursor is missing or malformed: %r"
                                  % (state,))
@@ -382,31 +410,94 @@ class ShardedFeed(object):
                            "pos": int(lanes[str(l)]["pos"]),
                            "offset": int(lanes[str(l)]["offset"])}
                        for l in range(self.n_lanes)}
-        self._remap(self._live if live is None else live)
+        owners = state.get("owners")
+        if owners:
+            self._owner = {int(l): int(h) for l, h in owners.items()}
+        if lags is None and self.weighted_rebalance:
+            lags = self._host_lags()
+        self._remap(self._live if live is None else live, lags=lags)
 
     # -- membership --------------------------------------------------------
+    def _lane_owners(self, live, lags=None):
+        """lane -> owner over ``live``. Round-robin
+        (``live[l % len(live)]``) by default; with weighted_rebalance
+        and lag gauges, ORPHANED lanes (committed owner not in live) are
+        instead distributed over hosts in ascending-lag order — the
+        least-lagged survivors absorb the dead host's streams first.
+        Deterministic for a given (live, lags): ties break on host id,
+        orphans are assigned in lane order."""
+        if not live:
+            return {}
+        rr = {l: live[l % len(live)] for l in range(self.n_lanes)}
+        if not self.weighted_rebalance or not lags:
+            return rr
+        owners, orphans = {}, []
+        for l in range(self.n_lanes):
+            cur = self._owner.get(l)
+            if cur is not None and cur not in live:
+                orphans.append(l)
+            else:
+                owners[l] = rr[l]
+        if orphans:
+            order = sorted(live,
+                           key=lambda h: (float(lags.get(h, 0.0)), h))
+            for i, l in enumerate(orphans):
+                owners[l] = order[i % len(order)]
+        return owners
+
     def _owned_lanes(self, live):
         if self._host_id not in live:
             return []
+        owners = self._lane_owners(live)
         return [l for l in range(self.n_lanes)
-                if live[l % len(live)] == self._host_id]
+                if owners[l] == self._host_id]
 
-    def _remap(self, live):
+    def _remap(self, live, lags=None):
         self._live = sorted(int(h) for h in live)
-        self._own = self._owned_lanes(self._live)
+        self._owner = self._lane_owners(self._live, lags)
+        self._own = [] if self._host_id not in self._live else \
+            [l for l in range(self.n_lanes)
+             if self._owner.get(l) == self._host_id]
         self._lanes = {l: dict(self._known[l]) for l in self._own}
         self._pending = copy.deepcopy(self._lanes)
 
-    def rebalance(self, live):
-        """Deterministically re-map lanes onto the new live set
-        (``lane l -> live[l % len(live)]``; the identity map at full
-        membership, so a full-mesh rejoin restores the original split).
+    def _host_lags(self):
+        """Last feed_stream_lag gauge per host from the resilience event
+        log (the same aggregation resilience.metrics() exports), or None
+        when no per-host gauges exist."""
+        from ..framework.resilience import events
+        lags = {}
+        for e in events("feed_lag"):
+            h = e.get("host")
+            if h is not None:
+                lags[int(h)] = float(e.get("lag", 0.0))
+        return lags or None
+
+    def rebalance(self, live, lags=None):
+        """Deterministically re-map lanes onto the new live set. Default
+        mapping is ``lane l -> live[l % len(live)]`` — the identity map
+        at full membership, so a full-mesh rejoin restores the original
+        split. With ``weighted_rebalance=True``, lanes orphaned by the
+        change are instead placed by the per-host ``feed_stream_lag``
+        gauge (``lags={host: lag}``, defaulting to the gauges in the
+        local resilience event log), least-lagged survivors first;
+        without any gauges the round-robin fallback applies unchanged.
+
+        AGREEMENT CAVEAT (weighted mode): every live host must compute
+        the SAME mapping, so the lag inputs must be agreed — the shared
+        event log of the threaded simulation qualifies; separate
+        processes (SocketCoordinator pods) must pass an agreed ``lags``
+        snapshot (e.g. carried on the window status exchange) rather
+        than rely on their local, possibly divergent gauges.
+
         Resumes every lane from the agreed committed cursor, so the dead
         host's unconsumed ranges move wholesale to survivors — no sample
         lost, none duplicated. Also the grow half: the re-admitted host
         takes its lanes back at the admission barrier."""
         old = set(self._own)
-        self._remap(live)
+        if lags is None and self.weighted_rebalance:
+            lags = self._host_lags()
+        self._remap(live, lags=lags)
         new = set(self._own)
         from ..framework.resilience import record_event
         record_event("feed_rebalance",
@@ -419,7 +510,7 @@ class ShardedFeed(object):
         the agreed pod map — the per-host stream progress."""
         out = {}
         for l in range(self.n_lanes):
-            owner = self._live[l % len(self._live)] if self._live else None
+            owner = self._owner.get(l)
             if owner is None:
                 continue
             out[owner] = out.get(owner, 0) \

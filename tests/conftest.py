@@ -57,6 +57,11 @@ def pytest_configure(config):
         "markers",
         "procpod: REAL-process pod-transport tests (subprocesses over "
         "SocketCoordinator, SIGKILL chaos) — wall-bounded, tier-1-safe")
+    config.addinivalue_line(
+        "markers",
+        "quant: quantized-collective / compressed-state-movement tests "
+        "(block codec, quantize_collectives guardrails, compressed "
+        "checkpoints, bench_micro perf gates)")
 
 
 @pytest.fixture(autouse=True)

@@ -1,0 +1,81 @@
+"""bench_micro perf gates: the CPU-measurable perf verdict every PR
+gets regardless of TPU fabric health (ROADMAP item 5, scoped slice).
+
+Runs the microbench suite in-process and checks every metric against
+the per-metric regression budgets declared in bench_micro.BUDGETS —
+an order-of-magnitude regression (trace blowup, cache-key churn, a
+codec that stopped compressing, a feed hot-loop slowdown) fails tier-1
+instead of waiting for a healthy chip attach."""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+import bench_micro  # noqa: E402
+
+pytestmark = pytest.mark.quant
+
+
+def test_run_all_meets_regression_budgets():
+    report = bench_micro.run_all()
+    # the output contract: one JSON-serializable dict, headline fields
+    line = json.dumps(report)
+    parsed = json.loads(line)
+    assert parsed["metric"] == "bench_micro"
+    assert parsed["platform"] == ["cpu"]
+    m = parsed["metrics"]
+    for key in bench_micro.BUDGETS:
+        assert key in m, "missing metric %r" % key
+    assert report.get("errors") is None or not report["errors"], \
+        report.get("errors")
+    assert report["budgets_ok"], report.get("budget_violations")
+    # the headline compression assertion, independent of the budget
+    # table: quantized collectives move <= 30% of the raw bytes
+    assert m["collective_wire_ratio"] <= 0.30
+    assert m["collective_wire_bytes"] < m["collective_raw_bytes"]
+
+
+def test_check_budgets_flags_violations():
+    good = {name: (budget if kind == "max" else budget)
+            for name, (kind, budget) in bench_micro.BUDGETS.items()}
+    assert bench_micro.check_budgets(good) == []
+    bad = dict(good)
+    bad["trace_lower_s"] = 1e9            # max exceeded
+    bad["cache_hit_rate"] = 0.0           # min violated
+    bad.pop("feed_samples_per_s")         # missing metric
+    bad["collective_wire_ratio"] = "nope"  # non-numeric
+    violations = bench_micro.check_budgets(bad)
+    assert len(violations) == 4
+    joined = "\n".join(violations)
+    for frag in ("trace_lower_s", "cache_hit_rate", "feed_samples_per_s",
+                 "collective_wire_ratio"):
+        assert frag in joined
+
+
+def test_budget_table_covers_the_contract():
+    """The ISSUE-6 contract metrics are all gated: trace+lower, cache
+    hit rate, quantized-vs-exact step wall time, byte ratio, feed
+    throughput."""
+    assert set(bench_micro.BUDGETS) == {
+        "trace_lower_s", "cache_hit_rate", "exact_step_s",
+        "quant_step_s", "collective_wire_ratio", "feed_samples_per_s"}
+
+
+@pytest.mark.slow
+def test_bench_micro_cli_emits_json():
+    """End-to-end: `python bench_micro.py` (what bench.py --micro falls
+    back to) prints one JSON line and exits 0. Subprocess = a fresh jax
+    import, so this rides the slow marker."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(root, "bench_micro.py")],
+        text=True, timeout=420, stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert proc.returncode == 0, proc.stdout[-500:]
+    report = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert report["metric"] == "bench_micro" and report["budgets_ok"]

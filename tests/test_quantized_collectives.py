@@ -1,0 +1,424 @@
+"""Quantized data-parallel collectives: the accuracy guardrail, byte
+accounting and grad-merge exactness of BuildStrategy.quantize_collectives
+(the EQuARX-style tentpole), plus the compressed state-movement paths it
+shares the codec with (io.save_checkpoint(compress=), elastic ship).
+
+The contract being pinned:
+
+  * a quantized dp training run stays inside a tight envelope of the
+    exact run (loss curve AND final weights);
+  * wire bytes <= 30% of raw bytes, asserted from the
+    collective_bytes_total counter pair — measured, not hand-waved;
+  * gradient-merge accumulation is EXACT fp32 on the synced gradients
+    (only the cross-host sync is quantized) — pinned bitwise;
+  * compressed checkpoints scrub identically to uncompressed ones and
+    pre-existing uncompressed checkpoints load and scrub unchanged.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.io as io_mod
+from paddle_tpu import layers, optimizer
+from paddle_tpu.framework import resilience
+from paddle_tpu.framework.compiler import BuildStrategy, CompiledProgram
+from paddle_tpu.framework.scope import Scope, scope_guard
+
+pytestmark = pytest.mark.quant
+
+
+@pytest.fixture(autouse=True)
+def _clean_metrics():
+    resilience.clear_events()
+    yield
+    resilience.clear_events()
+
+
+def _mlp_program(in_dim=64, hidden=128, classes=8, lr=0.1, opt=None):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [in_dim], dtype="float32")
+        y = layers.data("y", [1], dtype="int64")
+        h = layers.fc(x, size=hidden, act="relu",
+                      param_attr=pt.ParamAttr(name="q_w1"),
+                      bias_attr=pt.ParamAttr(name="q_b1"))
+        logits = layers.fc(h, size=classes,
+                           param_attr=pt.ParamAttr(name="q_w2"),
+                           bias_attr=pt.ParamAttr(name="q_b2"))
+        loss = layers.mean(layers.softmax_with_cross_entropy(logits, y))
+        (opt or optimizer.SGD(lr)).minimize(loss)
+    return main, startup, loss
+
+
+def _compiled(main, quant, n_dev=8, **bs_kw):
+    bs = BuildStrategy()
+    bs.mesh_axes = {"dp": n_dev}
+    bs.quantize_collectives = quant
+    for k, v in bs_kw.items():
+        setattr(bs, k, v)
+    return CompiledProgram(main, bs)
+
+
+def _train(quant, steps=10, seed=0, opt=None, fetch_losses=True,
+           **bs_kw):
+    rng = np.random.RandomState(seed)
+    xv = rng.rand(16, 64).astype(np.float32)
+    yv = rng.randint(0, 8, (16, 1)).astype(np.int64)
+    with scope_guard(Scope()):
+        main, startup, loss = _mlp_program(opt=opt)
+        exe = pt.Executor()
+        exe.run(startup)
+        comp = _compiled(main, quant, **bs_kw)
+        losses = [float(exe.run(comp, feed={"x": xv, "y": yv},
+                                fetch_list=[loss])[0][0])
+                  for _ in range(steps)]
+        w1 = pt.global_scope().get_numpy("q_w1").copy()
+        w2 = pt.global_scope().get_numpy("q_w2").copy()
+    return losses, w1, w2
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance guardrail
+# ---------------------------------------------------------------------------
+
+def test_quantized_dp_training_guardrail_and_wire_ratio():
+    """quantize_collectives on an 8-way CPU dp mesh: the loss curve and
+    final weights stay within the envelope of the exact run, and the
+    collective wire bytes are <= 30% of raw — from the counters."""
+    exact_losses, ew1, ew2 = _train(False)
+    resilience.clear_bytes()
+    q_losses, qw1, qw2 = _train(True)
+    # the curves track: per-step relative error well under 1%
+    np.testing.assert_allclose(q_losses, exact_losses, rtol=5e-3)
+    # the quantized run actually LEARNS (not just tracks step 0)
+    assert q_losses[-1] < q_losses[0] * 0.95
+    np.testing.assert_allclose(qw1, ew1, atol=5e-3)
+    np.testing.assert_allclose(qw2, ew2, atol=5e-3)
+    tot = resilience.bytes_totals()["collective"]
+    assert tot["raw"] > 0
+    assert tot["wire"] <= 0.30 * tot["raw"], tot
+    # the counter pair is exported by metrics()/metrics_text
+    names = {(c["name"], c["labels"].get("kind"))
+             for c in resilience.metrics()["counters"]}
+    pref = resilience.METRIC_PREFIX
+    assert (pref + "_collective_bytes_total", "raw") in names
+    assert (pref + "_collective_bytes_total", "wire") in names
+    samples = resilience.parse_metrics_text(resilience.metrics_text())
+    got = {lbl["kind"]: v for n, lbl, v in samples
+           if n == pref + "_collective_bytes_total"}
+    assert got == {"raw": float(tot["raw"]), "wire": float(tot["wire"])}
+
+
+def test_quantized_run_steps_window_matches_sequential():
+    """The scanned window path (run_steps on a CompiledProgram) goes
+    through the same quantized sync: fetches match the sequential
+    quantized dispatch step for step, and the window multiplies the
+    byte accounting by its length."""
+    rng = np.random.RandomState(1)
+    feeds = [{"x": rng.rand(16, 64).astype(np.float32),
+              "y": rng.randint(0, 8, (16, 1)).astype(np.int64)}
+             for _ in range(4)]
+
+    def run(windowed):
+        with scope_guard(Scope()):
+            main, startup, loss = _mlp_program()
+            exe = pt.Executor()
+            exe.run(startup)
+            comp = _compiled(main, True)
+            resilience.clear_bytes()
+            if windowed:
+                stacked = {k: np.stack([f[k] for f in feeds])
+                           for k in feeds[0]}
+                outs = exe.run_steps(comp, feed=stacked,
+                                     fetch_list=[loss])
+                vals = [float(v) for v in np.asarray(outs[0]).reshape(-1)]
+            else:
+                vals = [float(exe.run(comp, feed=f,
+                                      fetch_list=[loss])[0][0])
+                        for f in feeds]
+            return vals, resilience.bytes_totals()["collective"]
+
+    seq, seq_bytes = run(False)
+    win, win_bytes = run(True)
+    np.testing.assert_allclose(win, seq, rtol=1e-6)
+    assert win_bytes == seq_bytes   # 4 steps either way
+
+
+def test_gradient_merge_accumulation_is_exact_fp32():
+    """grad-merge-aware: the accumulator adds the already-synced fp32
+    gradient. With k=3 and the SAME batch twice, acc(2 steps) must be
+    BITWISE 2 * acc(1 step) — fp doubling is exact, so any
+    re-quantization or drift inside the accumulation would break
+    equality. Params must not move before the apply step."""
+    from paddle_tpu.contrib.extend_optimizer import GradientMergeOptimizer
+    rng = np.random.RandomState(2)
+    xv = rng.rand(16, 64).astype(np.float32)
+    yv = rng.randint(0, 8, (16, 1)).astype(np.int64)
+
+    def run(n_steps):
+        with scope_guard(Scope()):
+            main, startup, loss = _mlp_program(
+                opt=GradientMergeOptimizer(optimizer.SGD(0.1), k_steps=3))
+            exe = pt.Executor()
+            exe.run(startup)
+            comp = _compiled(main, True)
+            w0 = pt.global_scope().get_numpy("q_w1").copy()
+            for _ in range(n_steps):
+                exe.run(comp, feed={"x": xv, "y": yv}, fetch_list=[loss])
+            sc = pt.global_scope()
+            acc_names = [n for n in sc.keys() if ".grad_acc" in n]
+            assert acc_names, "gradient-merge accumulators not found"
+            # key by the PARAM the accumulator serves: the generated
+            # suffix differs between program builds
+            accs = {n.split(".grad_acc")[0]: sc.get_numpy(n).copy()
+                    for n in acc_names}
+            w1 = sc.get_numpy("q_w1").copy()
+        return accs, w0, w1
+
+    accs1, w0, w1_after1 = run(1)
+    accs2, _, w1_after2 = run(2)
+    assert set(accs1) == set(accs2)
+    # accumulation is exact fp32 on the synced grads: bitwise doubling
+    for name in accs1:
+        np.testing.assert_array_equal(accs2[name], 2.0 * accs1[name])
+        assert np.abs(accs1[name]).max() > 0
+    # no apply before step 3: params bitwise untouched
+    np.testing.assert_array_equal(w0, w1_after1)
+    np.testing.assert_array_equal(w0, w1_after2)
+
+
+def test_quantize_rejects_model_parallel_mesh():
+    main, startup, loss = _mlp_program()
+    exe = pt.Executor()
+    exe.run(startup)
+    bs = BuildStrategy()
+    bs.mesh_axes = {"dp": 2, "mp": 4}
+    bs.quantize_collectives = True
+    comp = CompiledProgram(main, bs)
+    with pytest.raises(ValueError, match="pure data-parallel"):
+        exe.run(comp, feed={"x": np.zeros((8, 64), np.float32),
+                            "y": np.zeros((8, 1), np.int64)},
+                fetch_list=[loss])
+
+
+def test_quantize_toggle_is_a_distinct_compile_cache_entry():
+    """Flipping quantize_collectives must recompile (the cache token
+    carries it) — a stale exact executable silently serving the
+    quantized strategy would fake the bandwidth win."""
+    rng = np.random.RandomState(3)
+    feed = {"x": rng.rand(16, 64).astype(np.float32),
+            "y": rng.randint(0, 8, (16, 1)).astype(np.int64)}
+    with scope_guard(Scope()):
+        main, startup, loss = _mlp_program()
+        exe = pt.Executor()
+        exe.run(startup)
+        bs = BuildStrategy()
+        bs.mesh_axes = {"dp": 8}
+        comp = CompiledProgram(main, bs)
+        exe.run(comp, feed=feed, fetch_list=[loss])
+        n0 = len(exe._cache)
+        bs.quantize_collectives = True
+        exe.run(comp, feed=feed, fetch_list=[loss])
+        assert len(exe._cache) == n0 + 1
+        bs.quantize_collectives = False
+        exe.run(comp, feed=feed, fetch_list=[loss])
+        assert len(exe._cache) == n0 + 1   # exact entry re-used
+
+
+def test_quantized_check_numerics_still_fires():
+    """The finite flag is AND-ed across shards under the quantized
+    shard_map lowering: a poisoned feed still raises."""
+    with scope_guard(Scope()):
+        main, startup, loss = _mlp_program()
+        main._check_numerics = True
+        exe = pt.Executor()
+        exe.run(startup)
+        comp = _compiled(main, True)
+        bad = {"x": np.full((16, 64), np.nan, np.float32),
+               "y": np.zeros((16, 1), np.int64)}
+        with pytest.raises(FloatingPointError):
+            exe.run(comp, feed=bad, fetch_list=[loss])
+
+
+# ---------------------------------------------------------------------------
+# compressed checkpoints: scrub neutrality + backward compat
+# ---------------------------------------------------------------------------
+
+def _snapshot_scope(rng):
+    import jax.numpy as jnp
+    sc = Scope()
+    sc.set_var("w", jnp.asarray(rng.randn(512, 64).astype(np.float32)))
+    sc.set_var("m1", jnp.asarray(rng.randn(3000).astype(np.float32)))
+    sc.set_var("ctr", jnp.asarray(41, jnp.int32))
+    sc.set_var("tiny", jnp.asarray(rng.randn(5).astype(np.float32)))
+    return sc
+
+
+@pytest.mark.parametrize("mode", ["zlib", "q8"])
+def test_compressed_checkpoint_roundtrip_and_scrub(tmp_path, mode):
+    rng = np.random.RandomState(4)
+    sc = _snapshot_scope(rng)
+    d = str(tmp_path / mode)
+    resilience.clear_bytes()
+    io_mod.save_checkpoint(None, d, step=5, scope=sc, compress=mode)
+    report = io_mod.scrub_checkpoint(d)
+    assert report["valid_steps"] == [5]
+    assert report["steps"][5]["status"] == "valid"
+    sc2 = Scope()
+    got = io_mod.load_checkpoint(None, d, scope=sc2)
+    assert got == 5
+    w, w2 = np.asarray(sc.find_var("w")), np.asarray(sc2.find_var("w"))
+    if mode == "zlib":
+        np.testing.assert_array_equal(w, w2)    # lossless
+    else:
+        assert np.max(np.abs(w - w2)) <= np.abs(w).max() / 127.0
+        tot = resilience.bytes_totals()["ckpt"]
+        assert tot["wire"] <= 0.30 * tot["raw"], tot
+    # exact round-trip for counters and sub-block floats in BOTH modes
+    assert int(np.asarray(sc2.find_var("ctr"))) == 41
+    np.testing.assert_array_equal(np.asarray(sc.find_var("tiny")),
+                                  np.asarray(sc2.find_var("tiny")))
+
+
+def test_q8_checkpoint_version_fences_old_libraries(tmp_path, monkeypatch):
+    """q8 payloads are stamped format_version 2: a library that only
+    knows v1 must refuse (CheckpointFormatError) — and scrub must call
+    the dir valid-but-newer, never quarantine it."""
+    rng = np.random.RandomState(5)
+    sc = _snapshot_scope(rng)
+    d = str(tmp_path / "v2")
+    io_mod.save_checkpoint(None, d, step=1, scope=sc, compress="q8")
+    monkeypatch.setattr(io_mod, "CKPT_FORMAT_VERSION", 1)
+    report = io_mod.scrub_checkpoint(d)
+    assert report["steps"][1]["status"] == "valid"
+    assert report["valid_steps"] == []          # intact but unloadable
+    with pytest.raises(io_mod.CheckpointFormatError):
+        io_mod.load_checkpoint(None, d, scope=Scope(), step=1)
+    assert not report["quarantined"]
+
+
+def test_uncompressed_checkpoints_unchanged_and_backward_compatible(
+        tmp_path):
+    """compress=None writes the HISTORICAL format: format_version 1, no
+    compress field, plain npz — and a pre-existing uncompressed
+    checkpoint loads and scrubs identically after this change."""
+    import json
+    import os
+    rng = np.random.RandomState(6)
+    sc = _snapshot_scope(rng)
+    d = str(tmp_path / "plain")
+    io_mod.save_checkpoint(None, d, step=2, scope=sc)
+    with open(os.path.join(d, "step_2", "manifest.json")) as f:
+        manifest = json.load(f)
+    assert manifest["format_version"] == 1
+    assert "compress" not in manifest
+    report = io_mod.scrub_checkpoint(d)
+    assert report["valid_steps"] == [2]
+    sc2 = Scope()
+    assert io_mod.load_checkpoint(None, d, scope=sc2) == 2
+    np.testing.assert_array_equal(np.asarray(sc.find_var("w")),
+                                  np.asarray(sc2.find_var("w")))
+
+
+def test_scrub_verdicts_identical_compressed_vs_not(tmp_path):
+    """Same scope saved three ways: the classifier's verdicts (and a
+    torn-manifest corruption verdict) are identical across modes."""
+    import os
+    rng = np.random.RandomState(7)
+    for mode in (None, "zlib", "q8"):
+        sc = _snapshot_scope(np.random.RandomState(7))
+        d = str(tmp_path / ("m_%s" % mode))
+        io_mod.save_checkpoint(None, d, step=1, scope=sc, compress=mode)
+        io_mod.save_checkpoint(None, d, step=2, scope=sc, compress=mode)
+        # tear step 2's manifest
+        with open(os.path.join(d, "step_2", "manifest.json"), "w") as f:
+            f.write('{"torn":')
+        report = io_mod.scrub_checkpoint(d)
+        assert report["valid_steps"] == [1], mode
+        assert report["steps"][2]["status"] == "corrupt", mode
+
+
+def test_stateship_counters_on_elastic_rejoin(tmp_path):
+    """The elastic rejoin ships codec-compressed leaves: after a
+    die -> shrink -> rejoin run, the stateship raw/wire counter pair is
+    populated and survivors' math is untouched (zlib ship = bitwise)."""
+    from paddle_tpu.framework.coordination import (ElasticTrainer,
+                                                   LocalCoordinator)
+    from paddle_tpu.framework.resilience import (ResilientTrainer,
+                                                 RetryPolicy)
+    rng = np.random.RandomState(8)
+    feeds = [{"x": rng.rand(8, 64).astype(np.float32),
+              "y": rng.randint(0, 8, (8, 1)).astype(np.int64)}
+             for _ in range(6)]
+    pol = RetryPolicy(base_delay_s=0.0, jitter=0.0, sleep=lambda s: None)
+    # ONE shared program: pod hosts must agree on var names for the
+    # shipped state to land (same shape as the test_elastic batteries)
+    main, startup, loss = _mlp_program()
+    trainers = []
+    for h in range(2):
+        sc, exe = Scope(), pt.Executor()
+        with scope_guard(sc):
+            exe.run(startup)
+        trainers.append(ResilientTrainer(
+            exe, main, str(tmp_path / ("h%d" % h)), fetch_list=[loss],
+            checkpoint_every=3, scope=sc, retry_policy=pol))
+    pod = ElasticTrainer(trainers, LocalCoordinator(2, timeout_s=300.0))
+    resilience.clear_bytes()
+    with resilience.inject("step:die@3"):
+        pod.run(feeds)
+    assert resilience.events("rejoin")
+    tot = resilience.bytes_totals().get("stateship")
+    assert tot and tot["raw"] > 0 and 0 < tot["wire"] <= tot["raw"]
+    # zlib ship is lossless: both hosts end bitwise identical
+    np.testing.assert_array_equal(trainers[0]._scope.get_numpy("q_w1"),
+                                  trainers[1]._scope.get_numpy("q_w1"))
+
+
+def test_probe_folds_bytes_series(tmp_path):
+    """tools/serving_probe.scrape_metrics groups the *_bytes_total
+    counter pairs under "bytes" — one scrape answers what every
+    compressed path moved."""
+    import os
+    import sys
+    resilience.record_bytes("collective", 1000, 260)
+    resilience.record_bytes("ckpt", 4000, 1100)
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tools"))
+    try:
+        import serving_probe
+    finally:
+        sys.path.pop(0)
+    with resilience.serve_metrics(port=0) as srv:
+        report = serving_probe.scrape_metrics(srv.url)
+    assert report["bytes"] == {
+        "collective_bytes_total/raw": 1000.0,
+        "collective_bytes_total/wire": 260.0,
+        "ckpt_bytes_total/raw": 4000.0,
+        "ckpt_bytes_total/wire": 1100.0}
+
+
+def test_quantize_min_size_is_in_the_compile_cache_token():
+    """Changing quantize_min_size re-routes grads between the exact and
+    quantized sync — it must recompile, never re-dispatch the stale
+    executable (whose byte accounting and routing reflect the old
+    setting)."""
+    rng = np.random.RandomState(9)
+    feed = {"x": rng.rand(16, 64).astype(np.float32),
+            "y": rng.randint(0, 8, (16, 1)).astype(np.int64)}
+    with scope_guard(Scope()):
+        main, startup, loss = _mlp_program()
+        exe = pt.Executor()
+        exe.run(startup)
+        comp = _compiled(main, True)
+        resilience.clear_bytes()
+        exe.run(comp, feed=feed, fetch_list=[loss])
+        n0 = len(exe._cache)
+        quantized = resilience.bytes_totals()["collective"]
+        assert quantized["wire"] < quantized["raw"]
+        # force EVERY grad onto the exact path
+        comp._build_strategy.quantize_min_size = 10 ** 9
+        resilience.clear_bytes()
+        exe.run(comp, feed=feed, fetch_list=[loss])
+        assert len(exe._cache) == n0 + 1
+        exact = resilience.bytes_totals()["collective"]
+        assert exact["wire"] == exact["raw"]

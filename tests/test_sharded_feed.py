@@ -335,3 +335,121 @@ def test_feed_metrics_gauges_and_probe_scrape():
         report = serving_probe.scrape_metrics(srv.url)
     assert report["feed"]["feed_rebalance_total"] == 1
     assert any(k.startswith("feed_epoch/host") for k in report["feed"])
+
+# ---------------------------------------------------------------------------
+# weighted lane re-balancing (feed_stream_lag-aware placement)
+# ---------------------------------------------------------------------------
+
+def test_weighted_rebalance_places_orphans_by_lag():
+    """weighted_rebalance=True: the dead host's lanes go to the
+    LEAST-lagged survivors (ascending-lag round-robin), non-orphaned
+    lanes keep following the round-robin formula, and the census stays
+    exactly-once."""
+    feeds = [ShardedFeed(_files(8, 5), 4, h, seed=9, batch_size=2,
+                         epochs=1, weighted_rebalance=True)
+             for h in range(4)]
+    got = {}
+    _drive(feeds, [0, 1, 2, 3], windows=2, collect=got)
+    # host 3 is far behind, host 0 the most advanced
+    lags = {0: 0.0, 1: 5.0, 3: 40.0}
+    live = [0, 1, 3]
+    for h in live:
+        feeds[h].rebalance(live, lags=lags)
+    # lane 2 (owner 2 died) is the only orphan -> least-lagged host 0;
+    # every host computed the same owner map
+    for h in live:
+        assert feeds[h]._owner[2] == 0, feeds[h]._owner
+    assert 2 in feeds[0]._own
+    # non-orphans follow round-robin over [0, 1, 3]
+    assert feeds[1]._owner[0] == 0 and feeds[1]._owner[1] == 1 \
+        and feeds[1]._owner[3] == 0
+    _drive(feeds, live, windows=4, collect=got)
+    # rejoin at full membership: identity map restored (orphans gone)
+    feeds[2].restore(feeds[0].global_state(), live=[0, 1, 2, 3])
+    for h in live:
+        feeds[h].rebalance([0, 1, 2, 3], lags=lags)
+    assert all(feeds[h]._own == [h] for h in range(4))
+    _drive(feeds, [0, 1, 2, 3], collect=got)
+    assert sorted(i for h in got for i in _ids(got[h])) == list(range(40))
+
+
+def test_weighted_rebalance_spreads_multiple_orphans():
+    """Two dead hosts' lanes spread over survivors in ascending-lag
+    order (round-robin over the sorted hosts), not all onto one."""
+    feeds = [ShardedFeed(_files(8, 5), 4, h, seed=9, batch_size=2,
+                         weighted_rebalance=True) for h in range(4)]
+    lags = {0: 10.0, 1: 0.0}
+    for h in (0, 1):
+        feeds[h].rebalance([0, 1], lags=lags)
+    # orphans are lanes 2 and 3 (lane order) -> hosts [1, 0] by lag
+    assert feeds[0]._owner[2] == 1 and feeds[0]._owner[3] == 0
+    assert feeds[0]._owner == feeds[1]._owner
+
+
+def test_weighted_rebalance_falls_back_to_round_robin():
+    """No gauges anywhere -> the legacy live[l % len(live)] map, bit for
+    bit (determinism parity with the default mode)."""
+    legacy = ShardedFeed(_files(8, 5), 4, 0, seed=9, batch_size=2)
+    weighted = ShardedFeed(_files(8, 5), 4, 0, seed=9, batch_size=2,
+                           weighted_rebalance=True)
+    for live in ([0, 1, 3], [0, 3], [0, 1, 2, 3]):
+        legacy.rebalance(live)
+        weighted.rebalance(live)      # event log holds no feed_lag
+        assert legacy._owner == weighted._owner
+        assert legacy._own == weighted._own
+
+
+def test_weighted_rebalance_pulls_gauges_from_event_log():
+    """With no explicit lags=, the per-host feed_stream_lag gauges in
+    the (shared) resilience event log drive the placement."""
+    feeds = [ShardedFeed(_files(8, 5), 4, h, seed=9, batch_size=2,
+                         weighted_rebalance=True) for h in range(4)]
+    for h, lag in ((0, 30.0), (1, 0.0), (3, 12.0)):
+        with resilience.context(host=h):
+            resilience.record_event("feed_lag", lag=lag)
+    live = [0, 1, 3]
+    for h in live:
+        feeds[h].rebalance(live)
+    # orphan lane 2 -> host 1 (lowest gauge)
+    for h in live:
+        assert feeds[h]._owner[2] == 1
+
+
+def test_weighted_restore_adopts_agreed_owner_map():
+    """A rejoining host restores the POD's committed owner map from the
+    cursor snapshot (it missed re-balances while fenced) and accepts the
+    same lags= input as rebalance — so its orphan detection agrees with
+    the survivors' instead of running on its stale pre-fence map."""
+    feeds = [ShardedFeed(_files(8, 5), 4, h, seed=11, batch_size=2,
+                         weighted_rebalance=True) for h in range(4)]
+    # host 0 dies: its lane 0 is weight-placed onto host 2 (least lag);
+    # the rest follow round-robin over [1, 2, 3]
+    lags = {1: 5.0, 2: 0.0, 3: 9.0}
+    for h in (1, 2, 3):
+        feeds[h].rebalance([1, 2, 3], lags=lags)
+    assert feeds[1]._owner == {0: 2, 1: 2, 2: 3, 3: 1}
+    # host 2 dies as host 0 rejoins: survivors rebalance, the joiner
+    # restores the agreed snapshot with the SAME lags — its own stale
+    # map (the full-membership identity) would call lane 1 non-orphaned
+    lags2 = {0: 30.0, 1: 0.0, 3: 10.0}
+    snap = feeds[1].global_state()
+    assert snap["owners"]["0"] == 2          # the map rides the cursor
+    for h in (1, 3):
+        feeds[h].rebalance([0, 1, 3], lags=lags2)
+    feeds[0].restore(snap, live=[0, 1, 3], lags=lags2)
+    # every live host computed the identical owner map: host 2's lanes
+    # {0, 1} are the orphans, spread over ascending-lag hosts [1, 3];
+    # non-orphans follow round-robin over [0, 1, 3]
+    want = {0: 1, 1: 3, 2: 3, 3: 0}
+    assert feeds[0]._owner == feeds[1]._owner == feeds[3]._owner == want
+
+
+def test_restore_without_owner_map_is_backward_compatible():
+    """Pre-existing cursors (no "owners" key) restore exactly as
+    before."""
+    feed = ShardedFeed(_files(8, 5), 4, 0, seed=11, batch_size=2)
+    snap = feed.global_state()
+    snap.pop("owners")
+    feed2 = ShardedFeed(_files(8, 5), 4, 0, seed=11, batch_size=2)
+    feed2.restore(snap, live=[0, 1, 2])
+    assert feed2._owner == {l: [0, 1, 2][l % 3] for l in range(4)}

@@ -69,24 +69,31 @@ def scrape_metrics(url, timeout_s=5.0):
     """Scrape a resilience.serve_metrics endpoint; returns a summary
     dict {"url", "samples", "events_total": {kind[/host]: n}} — plus a
     "feed" section with the elastic-data-plane series
-    (feed_rebalance_total, feed_epoch/feed_stream_lag per host) and a
+    (feed_rebalance_total, feed_epoch/feed_stream_lag per host), a
     "transport" section with the pod-transport series
-    (transport_reconnects_total, transport_heartbeat_lag per host)
-    when the replica exports any — or raises (caller folds failures
-    into the health report)."""
+    (transport_reconnects_total, transport_heartbeat_lag per host) and
+    a "bytes" section with the compressed-movement raw-vs-wire pairs
+    (collective/stateship/ckpt _bytes_total{kind=}) when the replica
+    exports any — or raises (caller folds failures into the health
+    report)."""
     import urllib.request
     from paddle_tpu.framework.resilience import (METRIC_PREFIX,
                                                  parse_metrics_text)
     with urllib.request.urlopen(url, timeout=timeout_s) as resp:
         text = resp.read().decode("utf-8")
     samples = parse_metrics_text(text)
-    events, feed, transport = {}, {}, {}
+    events, feed, transport, bytes_sec = {}, {}, {}, {}
     for name, labels, value in samples:
         if name == METRIC_PREFIX + "_events_total":
             key = labels.get("kind", "?")
             if "host" in labels:
                 key += "/host" + labels["host"]
             events[key] = value
+        elif name.startswith(METRIC_PREFIX) \
+                and name.endswith("_bytes_total"):
+            key = name[len(METRIC_PREFIX) + 1:]
+            key += "/" + labels.get("kind", "?")
+            bytes_sec[key] = value
         elif name.startswith(METRIC_PREFIX + "_feed_") \
                 or name.startswith(METRIC_PREFIX + "_transport_"):
             key = name[len(METRIC_PREFIX) + 1:]
@@ -99,6 +106,8 @@ def scrape_metrics(url, timeout_s=5.0):
         out["feed"] = feed
     if transport:
         out["transport"] = transport
+    if bytes_sec:
+        out["bytes"] = bytes_sec
     return out
 
 
