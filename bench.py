@@ -738,11 +738,13 @@ def bench_bucketed_training():
 
 
 def pallas_selfcheck():
-    """Flash-attention Pallas-vs-XLA oracle ON THE REAL CHIP — the only
-    coverage of the compiled Mosaic kernels (CPU tests run interpret mode
-    and the <128-block guard routes small shapes to XLA). Exercises fwd +
+    """Pallas-vs-XLA oracle ON THE REAL CHIP — the only coverage of the
+    compiled Mosaic kernels (CPU tests run interpret mode and the
+    <128-block guards route small shapes to XLA). Flash attention: fwd +
     backward in both mask modes (causal, additive padding mask) at
-    T=128/256, f32 and bf16. Closes SURVEY §5 / round-3 Weak #5."""
+    T=128/256, f32 and bf16 (SURVEY §5 / round-3 Weak #5). Plus the
+    PR-7 kernel library: blockwise CE, the fused MLM head, fused Adam
+    and fused LayerNorm, each fwd+bwd against its pure-JAX reference."""
     import jax
     import jax.numpy as jnp
     from paddle_tpu.ops.pallas import flash_attention as fa
@@ -802,6 +804,96 @@ def pallas_selfcheck():
                 worst[key] = {"max_abs_err": round(max(abs_errs), 8),
                               "max_rel_err": round(max(rel_errs), 8),
                               "tol": tol, "ok": max(rel_errs) < tol}
+
+    def _cmp(key, pairs, tol):
+        abs_errs, rel_errs = [], []
+        for a, b_ in pairs:
+            a = jnp.asarray(a, jnp.float32)
+            b_ = jnp.asarray(b_, jnp.float32)
+            diff = float(jnp.max(jnp.abs(a - b_)))
+            abs_errs.append(diff)
+            rel_errs.append(diff / max(float(jnp.max(jnp.abs(b_))), 1.0))
+        worst[key] = {"max_abs_err": round(max(abs_errs), 8),
+                      "max_rel_err": round(max(rel_errs), 8),
+                      "tol": tol, "ok": max(rel_errs) < tol}
+
+    # ---- PR-7 kernel library: CE / fused head / adam / layernorm ----
+    from paddle_tpu.ops.pallas.blockwise_ce import (
+        blockwise_softmax_cross_entropy, fused_mlm_head_loss)
+    from paddle_tpu.ops.pallas.fused_adam import fused_adam
+    from paddle_tpu.ops.pallas.layer_norm import fused_layer_norm
+
+    t, v, d = 256, 1024, 256
+    labels = jnp.asarray(rng.randint(0, v, (t,)), jnp.int32)
+    cot = jnp.asarray(rng.randn(t).astype(np.float32))
+    for dtype, tol in ((jnp.float32, 1e-5), (jnp.bfloat16, 1e-2)):
+        logits = jnp.asarray(rng.randn(t, v), dtype)
+
+        def ce_ref(lg):
+            logp = jax.nn.log_softmax(lg.astype(jnp.float32), axis=-1)
+            return -jnp.take_along_axis(logp, labels[:, None],
+                                        axis=1)[:, 0]
+
+        def ce_pal(lg):
+            return blockwise_softmax_cross_entropy(lg, labels,
+                                                   interpret=interp)
+        gp = jax.jit(jax.grad(lambda lg: jnp.sum(ce_pal(lg) * cot)))
+        gx = jax.jit(jax.grad(lambda lg: jnp.sum(ce_ref(lg) * cot)))
+        _cmp("ce_%s" % np.dtype(dtype).name,
+             [(ce_pal(logits), ce_ref(logits)), (gp(logits), gx(logits))],
+             tol)
+
+        hid = jnp.asarray(rng.randn(t, d) * 0.2, dtype)
+        w_ = jnp.asarray(rng.randn(d, v) * 0.1, dtype)
+
+        def head_ref(h, w):
+            return ce_ref((h.astype(jnp.float32) @
+                           w.astype(jnp.float32)).astype(dtype))
+
+        def head_pal(h, w):
+            return fused_mlm_head_loss(h, w, labels, interpret=interp)
+        hp = jax.jit(jax.grad(
+            lambda h, w: jnp.sum(head_pal(h, w) * cot), argnums=(0, 1)))
+        hx = jax.jit(jax.grad(
+            lambda h, w: jnp.sum(head_ref(h, w) * cot), argnums=(0, 1)))
+        _cmp("mlm_head_%s" % np.dtype(dtype).name,
+             [(head_pal(hid, w_), head_ref(hid, w_))] +
+             list(zip(hp(hid, w_), hx(hid, w_))), tol)
+
+    n = 65536
+    p_ = jnp.asarray(rng.randn(n).astype(np.float32))
+    g_ = jnp.asarray(rng.randn(n).astype(np.float32))
+    m1 = jnp.asarray(np.abs(rng.randn(n)).astype(np.float32) * 0.1)
+    m2 = jnp.asarray(np.abs(rng.randn(n)).astype(np.float32) * 0.1)
+    lr_t = jnp.float32(0.01)
+    pal = jax.jit(lambda: fused_adam(p_, g_, m1, m2, lr_t,
+                                     interpret=interp))()
+    m1r = 0.9 * m1 + 0.1 * g_
+    m2r = 0.999 * m2 + 0.001 * g_ * g_
+    ref = (p_ - lr_t * m1r / (jnp.sqrt(m2r) + 1e-8), m1r, m2r)
+    _cmp("adam_f32", list(zip(pal, ref)), 1e-5)
+
+    r, c = 256, 512
+    x_ = jnp.asarray(rng.randn(r, c).astype(np.float32))
+    sc = jnp.asarray(rng.randn(c).astype(np.float32))
+    bi = jnp.asarray(rng.randn(c).astype(np.float32))
+    wln = jnp.asarray(rng.randn(r, c).astype(np.float32))
+
+    def ln_ref(x, sc, bi):
+        m = jnp.mean(x, -1, keepdims=True)
+        vv = jnp.var(x, -1, keepdims=True)
+        return (x - m) * jax.lax.rsqrt(vv + 1e-5) * sc[None, :] + bi
+
+    def ln_pal(x, sc, bi):
+        return fused_layer_norm(x, sc, bi, interpret=interp)
+    lp = jax.jit(jax.grad(lambda *a: jnp.sum(ln_pal(*a) * wln),
+                          argnums=(0, 1, 2)))
+    lx = jax.jit(jax.grad(lambda *a: jnp.sum(ln_ref(*a) * wln),
+                          argnums=(0, 1, 2)))
+    _cmp("layer_norm_f32",
+         [(ln_pal(x_, sc, bi), ln_ref(x_, sc, bi))] +
+         list(zip(lp(x_, sc, bi), lx(x_, sc, bi))), 1e-5)
+
     return json.dumps({"metric": "pallas_check", "checks": worst,
                        "ok": all(c["ok"] for c in worst.values())})
 
@@ -1063,7 +1155,8 @@ if __name__ == "__main__":
         # suite run_all falls back to when the chip probe fails under
         # --micro / PADDLE_TPU_BENCH_MICRO=1)
         import bench_micro
-        sys.exit(bench_micro.main())
+        # empty argv: bench_micro.main must not see our "micro" token
+        sys.exit(bench_micro.main(sys.argv[2:]))
     elif len(sys.argv) > 1 and sys.argv[1] == "profile":
         profile_headline()
     else:

@@ -21,6 +21,12 @@ surfaces that move on every PR, on JAX_PLATFORMS=cpu, in seconds:
                              not hand-waved)
   * feed_samples_per_s     — ShardedFeed draw+commit throughput
                              (the data-plane hot loop)
+  * pallas_*               — the Pallas kernel library vs its XLA
+                             references in interpret mode (blockwise
+                             CE / fused MLM head, fused Adam, fused
+                             LayerNorm): fwd+bwd step wall + max abs
+                             error per kernel — the kernels' tier-1
+                             perf-and-parity canary
 
 Output contract: ONE JSON line (dict with "metric": "bench_micro" and a
 "metrics" sub-dict). tests/test_bench_micro.py re-runs the suite
@@ -31,7 +37,16 @@ so every PR gets a perf verdict even when bench.py's chip probe fails
 Budgets are deliberately loose upper bounds for shared-CI noise: they
 catch order-of-magnitude regressions (a trace blowup, a cache-key bug, a
 codec that stopped compressing), not single-digit-percent drift.
+
+Trend tracking (ROADMAP item 4, remaining slice): pass --rounds-dir (or
+set PADDLE_TPU_MICRO_ROUNDS_DIR) to persist each run's report under the
+rounds dir and to compare the current metrics against the median of the
+previous rounds — DRIFT (a metric worsening by more than DRIFT_FACTOR
+vs its own history) is flagged in the report even while it is still
+inside the absolute budget. Drift is informational by default
+(budgets_ok stays the gate); --fail-on-drift makes it exit non-zero.
 """
+import glob
 import json
 import os
 import sys
@@ -63,7 +78,21 @@ BUDGETS = {
     "quant_step_s": ("max", 20.0),
     "collective_wire_ratio": ("max", 0.30),
     "feed_samples_per_s": ("min", 1000.0),
+    # Pallas kernels, interpret mode on tiny shapes: wall budgets catch
+    # an interpreter-path blowup, error budgets catch a numerics break
+    # (the oracle batteries assert tighter bounds; these gate the bench)
+    "pallas_ce_step_s": ("max", 30.0),
+    "pallas_adam_step_s": ("max", 15.0),
+    "pallas_ln_step_s": ("max", 15.0),
+    "pallas_ce_err": ("max", 1e-4),
+    "pallas_adam_err": ("max", 1e-5),
+    "pallas_ln_err": ("max", 1e-4),
 }
+
+# metric -> worsening factor vs the rounds-history median that counts as
+# drift. Looser than 2x for wall times (shared CI boxes), tight for
+# error metrics (numerics should be bit-stable across rounds).
+DRIFT_FACTOR = 2.5
 
 
 def check_budgets(metrics):
@@ -202,15 +231,179 @@ def bench_feed(n_files=16, per_file=64, batches=200, batch_size=8):
             "feed_batches": batches}
 
 
-def run_all():
+def bench_pallas(steps=2):
+    """Pallas kernel library vs the XLA references, interpret mode on
+    tiny shapes: per-kernel fwd+bwd step wall (jitted, best-of) + max
+    abs error. The same kernels the use_pallas dispatch routes to —
+    this is their always-on perf-and-parity canary."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.pallas.blockwise_ce import \
+        blockwise_softmax_cross_entropy
+    from paddle_tpu.ops.pallas.fused_adam import fused_adam
+    from paddle_tpu.ops.pallas.layer_norm import fused_layer_norm
+
+    rng = np.random.RandomState(0)
+    out = {}
+
+    def best_of(fn):
+        jax.block_until_ready(fn())      # compile + warm
+        best = None
+        for _ in range(steps):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn())
+            dt = time.perf_counter() - t0
+            best = dt if best is None else min(best, dt)
+        return best
+
+    # blockwise CE: fwd+bwd vs log_softmax reference, (32, 256)
+    t, v = 32, 256
+    logits = jnp.asarray(rng.randn(t, v).astype(np.float32))
+    labels = jnp.asarray(rng.randint(0, v, (t,)).astype(np.int32))
+
+    def ce_ref(lg):
+        logp = jax.nn.log_softmax(lg, axis=-1)
+        return -jnp.take_along_axis(logp, labels[:, None], axis=1)[:, 0]
+
+    def ce_pallas(lg):
+        return blockwise_softmax_cross_entropy(
+            lg, labels, block_t=8, block_v=64, interpret=True)
+
+    g_p = jax.jit(jax.grad(lambda lg: jnp.sum(ce_pallas(lg))))
+    g_r = jax.jit(jax.grad(lambda lg: jnp.sum(ce_ref(lg))))
+    out["pallas_ce_step_s"] = round(best_of(lambda: g_p(logits)), 5)
+    out["pallas_ce_err"] = float(max(
+        jnp.max(jnp.abs(ce_pallas(logits) - ce_ref(logits))),
+        jnp.max(jnp.abs(g_p(logits) - g_r(logits)))))
+
+    # fused adam: one update vs the elementwise chain, 4096 elements
+    n = 4096
+    p = jnp.asarray(rng.randn(n).astype(np.float32))
+    gr = jnp.asarray(rng.randn(n).astype(np.float32))
+    m1 = jnp.zeros((n,), jnp.float32)
+    m2 = jnp.zeros((n,), jnp.float32)
+    lr_t = jnp.float32(0.01)
+
+    def adam_pallas(p, gr, m1, m2):
+        return fused_adam(p, gr, m1, m2, lr_t, block_rows=16,
+                          interpret=True)
+
+    def adam_ref(p, gr, m1, m2):
+        m1n = 0.9 * m1 + 0.1 * gr
+        m2n = 0.999 * m2 + 0.001 * gr * gr
+        return p - lr_t * m1n / (jnp.sqrt(m2n) + 1e-8), m1n, m2n
+
+    jp, jr = jax.jit(adam_pallas), jax.jit(adam_ref)
+    out["pallas_adam_step_s"] = round(
+        best_of(lambda: jp(p, gr, m1, m2)), 5)
+    out["pallas_adam_err"] = float(max(
+        jnp.max(jnp.abs(a - b))
+        for a, b in zip(jp(p, gr, m1, m2), jr(p, gr, m1, m2))))
+
+    # fused layernorm: fwd+bwd vs jnp reference, (32, 128)
+    r, c = 32, 128
+    x = jnp.asarray(rng.randn(r, c).astype(np.float32))
+    sc = jnp.asarray(rng.randn(c).astype(np.float32))
+    bi = jnp.asarray(rng.randn(c).astype(np.float32))
+
+    def ln_ref(x, sc, bi):
+        m = jnp.mean(x, -1, keepdims=True)
+        vv = jnp.var(x, -1, keepdims=True)
+        return (x - m) * jax.lax.rsqrt(vv + 1e-5) * sc[None, :] + bi
+
+    def ln_pallas(x, sc, bi):
+        return fused_layer_norm(x, sc, bi, block_rows=8, interpret=True)
+
+    lg_p = jax.jit(jax.grad(
+        lambda *a: jnp.sum(ln_pallas(*a) ** 2), argnums=(0, 1, 2)))
+    lg_r = jax.jit(jax.grad(
+        lambda *a: jnp.sum(ln_ref(*a) ** 2), argnums=(0, 1, 2)))
+    out["pallas_ln_step_s"] = round(best_of(lambda: lg_p(x, sc, bi)), 5)
+    out["pallas_ln_err"] = float(max(
+        [jnp.max(jnp.abs(ln_pallas(x, sc, bi) - ln_ref(x, sc, bi)))] +
+        [jnp.max(jnp.abs(a - b))
+         for a, b in zip(lg_p(x, sc, bi), lg_r(x, sc, bi))]))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# round trend tracking
+# ---------------------------------------------------------------------------
+
+def _round_files(rounds_dir):
+    return sorted(glob.glob(os.path.join(rounds_dir, "round_*.json")))
+
+
+def save_round(report, rounds_dir):
+    """Persist this run's report as the next round_NNNN.json."""
+    os.makedirs(rounds_dir, exist_ok=True)
+    existing = _round_files(rounds_dir)
+    nxt = 1
+    if existing:
+        tail = os.path.basename(existing[-1])[len("round_"):-len(".json")]
+        try:
+            nxt = int(tail) + 1
+        except ValueError:
+            nxt = len(existing) + 1
+    path = os.path.join(rounds_dir, "round_%04d.json" % nxt)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(report, f, indent=1, sort_keys=True)
+    os.replace(tmp, path)
+    return path
+
+
+def check_drift(metrics, rounds_dir, window=8, factor=DRIFT_FACTOR):
+    """Compare current metrics against the median of the last `window`
+    persisted rounds; return human-readable drift flags (empty = ok).
+
+    This catches the slide the absolute budgets are too loose to see: a
+    metric can stay under its order-of-magnitude budget while quietly
+    worsening round over round. "max" metrics drift when current >
+    factor * median(history); "min" metrics when current < median /
+    factor. Fewer than 2 historical rounds = nothing to compare."""
+    history = {}
+    for path in _round_files(rounds_dir)[-window:]:
+        try:
+            with open(path) as f:
+                past = json.load(f).get("metrics", {})
+        except (OSError, ValueError):
+            continue
+        for k, v in past.items():
+            if isinstance(v, (int, float)):
+                history.setdefault(k, []).append(float(v))
+    flags = []
+    for name, (kind, _budget) in BUDGETS.items():
+        vals = history.get(name, [])
+        cur = metrics.get(name)
+        if len(vals) < 2 or not isinstance(cur, (int, float)):
+            continue
+        vals = sorted(vals)
+        med = vals[len(vals) // 2]
+        if kind == "max" and med > 0 and cur > factor * med:
+            flags.append("%s=%.4g drifted above %.1fx its %d-round "
+                         "median %.4g" % (name, cur, factor, len(vals),
+                                          med))
+        elif kind == "min" and med > 0 and cur < med / factor:
+            flags.append("%s=%.4g drifted below 1/%.1fx its %d-round "
+                         "median %.4g" % (name, cur, factor, len(vals),
+                                          med))
+    return flags
+
+
+def run_all(rounds_dir=None):
     """Run every section; returns the report dict (never raises — a
     broken section lands as an "error" entry so the JSON line and the
-    other sections still ship)."""
+    other sections still ship). With rounds_dir, the report is checked
+    for drift against the persisted history and then saved as the next
+    round."""
     metrics, errors = {}, {}
     for name, fn in (("trace_lower", bench_trace_lower),
                      ("cache_hit", bench_cache_hit),
                      ("quantized_step", bench_quantized_step),
-                     ("feed", bench_feed)):
+                     ("feed", bench_feed),
+                     ("pallas", bench_pallas)):
         t0 = time.perf_counter()
         try:
             metrics.update(fn())
@@ -226,6 +419,12 @@ def run_all():
         report["budget_violations"] = violations
     if errors:
         report["errors"] = errors
+    if rounds_dir:
+        flags = check_drift(metrics, rounds_dir)
+        report["drift_ok"] = not flags
+        if flags:
+            report["drift_flags"] = flags
+        report["round_file"] = save_round(report, rounds_dir)
     return report
 
 
@@ -235,10 +434,27 @@ def _platform():
 
 
 def main(argv=None):
+    argv = sys.argv[1:] if argv is None else list(argv)
+    rounds_dir = os.environ.get("PADDLE_TPU_MICRO_ROUNDS_DIR") or None
+    fail_on_drift = False
+    i = 0
+    while i < len(argv):
+        if argv[i] == "--rounds-dir" and i + 1 < len(argv):
+            rounds_dir = argv[i + 1]
+            i += 2
+        elif argv[i] == "--fail-on-drift":
+            fail_on_drift = True
+            i += 1
+        else:
+            print("usage: bench_micro.py [--rounds-dir DIR] "
+                  "[--fail-on-drift]", file=sys.stderr)
+            return 2
     _force_cpu()
-    report = run_all()
+    report = run_all(rounds_dir=rounds_dir)
     print(json.dumps(report))
-    return 0 if report["budgets_ok"] else 1
+    ok = report["budgets_ok"] and \
+        (report.get("drift_ok", True) or not fail_on_drift)
+    return 0 if ok else 1
 
 
 if __name__ == "__main__":

@@ -62,6 +62,16 @@ class BuildStrategy(object):
         # gradients below this element count ride the exact full-width
         # sync (sub-block payloads cost MORE quantized); None = one block
         self.quantize_min_size = None
+        # Pallas kernel dispatch (ops/pallas): ops named here trace
+        # through the fused Pallas kernels — e.g. use_pallas =
+        # {"softmax_with_cross_entropy", "adam", "layer_norm"} — with
+        # per-shape XLA fallback when a shape cannot tile. Part of the
+        # compile-cache token: toggling re-lowers the step.
+        self.use_pallas = frozenset()
+        # autotune-cache source for the Pallas block configs: a JSON
+        # path or an ops.pallas.autotune.AutotuneCache (tools/autotune.py
+        # writes it). None = kernel-default block sizes everywhere.
+        self.pallas_tune_cache = None
         # parity no-ops
         self.fuse_all_reduce_ops = True
         self.fuse_elewise_add_act_ops = True
@@ -156,12 +166,30 @@ class CompiledProgram(object):
     # ------------------------------------------------------------------
     def _cache_token(self):
         bs = self._build_strategy
+        tune = getattr(bs, "pallas_tune_cache", None)
+        if tune is not None:
+            # identity = path + file stat: re-running tools/autotune.py
+            # into the same file must re-lower in a live process (a
+            # stale executable would keep the old block configs)
+            path = str(getattr(tune, "path", tune))
+            try:
+                st = os.stat(path)
+                tune_tok = (path, st.st_mtime_ns, st.st_size)
+            except OSError:
+                tune_tok = (path, None, None)
+        else:
+            tune_tok = None
         return (tuple(sorted((bs.mesh_axes or {}).items())), bs.data_axis,
                 getattr(bs, "collective_timeout_s", None),
                 (getattr(bs, "quantize_collectives", False),
                  getattr(bs, "quantize_block_size", 256),
                  getattr(bs, "quantize_bits", 8),
-                 getattr(bs, "quantize_min_size", None)))
+                 getattr(bs, "quantize_min_size", None)),
+                # Pallas dispatch is baked into the traced step: both the
+                # op set and the tuning-cache identity must key the
+                # executable
+                (tuple(sorted(getattr(bs, "use_pallas", ()) or ())),
+                 tune_tok))
 
     def _mesh_obj(self):
         if self._mesh is None:
@@ -300,6 +328,28 @@ class CompiledProgram(object):
             return shard_map(quant_step, mesh=mesh, in_specs=in_specs,
                              out_specs=out_specs)
 
+    # -- Pallas kernel dispatch -------------------------------------------
+    def _pallas_ctx(self, mesh):
+        """Build the per-compile PallasConfig, or None when use_pallas
+        is empty. The config carries the mesh axes + backend so the
+        autotune cache is consulted under the same key the sweep wrote."""
+        bs = self._build_strategy
+        ops = getattr(bs, "use_pallas", None)
+        if not ops:
+            return None
+        from ..ops import pallas_dispatch as pd
+        tune = getattr(bs, "pallas_tune_cache", None)
+        if tune is not None and not hasattr(tune, "lookup"):
+            from ..ops.pallas.autotune import AutotuneCache
+            tune = AutotuneCache(str(tune))
+        try:
+            backend = next(iter(mesh.devices.flat)).platform
+        except Exception:  # pragma: no cover - exotic mesh
+            backend = jax.default_backend()
+        return pd.PallasConfig(ops, tuning=tune,
+                               mesh_axes=dict(bs.mesh_axes or {}),
+                               backend=backend)
+
     def _wrap_sharded(self, fn, mesh, state_sh, feed_sh, out_sh,
                       window=False):
         """Shared step/window machinery: jit over the mesh, stage inputs
@@ -307,11 +357,23 @@ class CompiledProgram(object):
         watchdog. With quantize_collectives on, the fn is first lowered
         through shard_map with quantized gradient sync; the per-step wire
         accounting (static, accumulated at trace time) is recorded per
-        dispatch (x window length for run_steps windows)."""
+        dispatch (x window length for run_steps windows). With use_pallas
+        set, the trace runs inside the Pallas dispatch scope so the wired
+        op kernels route to their fused implementations."""
         qctx = self._quantize_ctx(mesh)
         if qctx is not None:
             fn = self._quantized_fn(fn, mesh, state_sh, feed_sh, out_sh,
                                     qctx)
+        pctx = self._pallas_ctx(mesh)
+        if pctx is not None:
+            from ..ops import pallas_dispatch as pd
+            inner = fn
+
+            def fn(state_tuple, feed_tuple, _inner=inner):
+                # the scope only matters while jit TRACES _inner; entering
+                # it per call is a few thread-local writes
+                with pd.scope(pctx):
+                    return _inner(state_tuple, feed_tuple)
         jitted = jax.jit(fn, in_shardings=(state_sh, feed_sh),
                          out_shardings=out_sh, donate_argnums=(0,))
         timeout_s = getattr(self._build_strategy, "collective_timeout_s",

@@ -15,6 +15,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from .registry import register_op
+from . import pallas_dispatch as _pd
 from ..framework.dtypes import to_jax_dtype
 
 
@@ -195,11 +196,43 @@ def _batch_norm(ctx, ins, attrs):
             "SavedVariance": lax.stop_gradient(saved_var)}
 
 
+def _pallas_layer_norm(x, ins, eps, begin, cfg):
+    """BuildStrategy.use_pallas={"layer_norm"}: fused one-pass Pallas
+    fwd+bwd over the collapsed (rows, cols) problem. Returns the op's
+    output dict, or None when the autotune cache routed this shape back
+    to XLA / the shape cannot tile — caller keeps the XLA lowering.
+    Mean/Variance are emitted as a standalone (cheap, per-row) XLA
+    expression that DCEs away when unused, exactly like the XLA path's
+    values."""
+    from .pallas.layer_norm import fused_layer_norm
+    rows = int(np.prod(x.shape[:begin], dtype=np.int64)) if begin else 1
+    cols = int(np.prod(x.shape[begin:], dtype=np.int64))
+    x2 = x.reshape(rows, cols)
+    impl, tuned = _pd.choose(cfg, "layer_norm", x2.shape, x2.dtype)
+    if impl == "xla":
+        return None
+    y = fused_layer_norm(
+        x2, ins["Scale"][0].reshape(cols), ins["Bias"][0].reshape(cols),
+        eps=eps, interpret=cfg.interpret, **(tuned or {}))
+    if y is None:
+        return None
+    xf = x.astype(jnp.float32)
+    axes = tuple(range(begin, x.ndim))
+    return {"Y": y.reshape(x.shape).astype(x.dtype),
+            "Mean": jnp.mean(xf, axis=axes),
+            "Variance": jnp.var(xf, axis=axes)}
+
+
 @register_op("layer_norm")
 def _layer_norm(ctx, ins, attrs):
     x = _x(ins)
     eps = attrs.get("epsilon", 1e-5)
     begin = attrs.get("begin_norm_axis", 1)
+    cfg = _pd.enabled("layer_norm")
+    if cfg is not None and ins.get("Scale") and ins.get("Bias"):
+        out = _pallas_layer_norm(x, ins, eps, begin, cfg)
+        if out is not None:
+            return out
     axes = tuple(range(begin, x.ndim))
     xf = x.astype(jnp.float32)
     mean = jnp.mean(xf, axis=axes, keepdims=True)
@@ -291,10 +324,52 @@ def _cross_entropy(ctx, ins, attrs):
     return {"Y": loss}
 
 
+def _pallas_softmax_ce(logits, lbl, attrs, cfg):
+    """BuildStrategy.use_pallas={"softmax_with_cross_entropy"}: the loss
+    streams over vocab blocks (ops/pallas/blockwise_ce) — no
+    [tokens, vocab] log-softmax/softmax intermediate in fwd or bwd.
+    Returns the per-token loss (lbl.shape + (1,), f32), or None when
+    the autotune cache routed this shape to XLA / it cannot tile."""
+    from .pallas.blockwise_ce import blockwise_softmax_cross_entropy
+    v = logits.shape[-1]
+    l2 = logits.reshape(-1, v)
+    impl, tuned = _pd.choose(cfg, "softmax_with_cross_entropy",
+                             l2.shape, l2.dtype)
+    if impl == "xla":
+        return None
+    loss = blockwise_softmax_cross_entropy(
+        l2, lbl.reshape(-1).astype(jnp.int32), interpret=cfg.interpret,
+        **(tuned or {}))
+    if loss is None:
+        return None
+    loss = loss.reshape(lbl.shape)[..., None]
+    ignore = attrs.get("ignore_index", -100)
+    return jnp.where(lbl[..., None] == ignore, 0.0, loss)
+
+
 @register_op("softmax_with_cross_entropy", nondiff=("Label",))
 def _softmax_with_cross_entropy(ctx, ins, attrs):
     logits, label = ins["Logits"][0], ins["Label"][0]
     axis = attrs.get("axis", -1)
+    if not attrs.get("soft_label", False):
+        lbl = label
+        squeeze = lbl.ndim == logits.ndim and lbl.shape[axis] == 1
+        if squeeze:
+            lbl = jnp.squeeze(lbl, axis=axis)
+        cfg = _pd.enabled("softmax_with_cross_entropy")
+        if cfg is not None and logits.ndim >= 2 and \
+                axis in (-1, logits.ndim - 1) and \
+                lbl.ndim == logits.ndim - 1:
+            loss = _pallas_softmax_ce(logits, lbl, attrs, cfg)
+            if loss is not None:
+                # Softmax is a STANDALONE XLA expression: when the
+                # output is unused (the MLM-loss case) XLA DCEs it and
+                # only the blockwise kernels remain — same pattern as
+                # the flash-attention mask cotangent
+                logp = jax.nn.log_softmax(
+                    logits.astype(jnp.float32), axis=axis)
+                return {"Softmax": jnp.exp(logp).astype(logits.dtype),
+                        "Loss": loss.astype(logits.dtype)}
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=axis)
     if attrs.get("soft_label", False):
         loss = -jnp.sum(label * logp, axis=axis, keepdims=True)

@@ -1,0 +1,300 @@
+"""Persistent autotuning harness for the Pallas kernel library.
+
+TVM-style per-shape tuning (PAPERS.md): a hand-fused blockwise kernel
+only wins when its block/layout config matches the (op, shape, dtype,
+topology, backend) it runs on, so the sweep and the kernels ship
+together. For each kernel this module:
+
+  1. enumerates its candidate block configs (``CANDIDATES``),
+  2. times fwd+bwd of each candidate with the bounded-probe discipline
+     bench.py uses (compile once, best-of-k timed calls, a per-candidate
+     wall deadline so one pathological config can't eat the sweep),
+  3. times the pure-XLA baseline the op registry would otherwise lower,
+  4. persists the winner in a JSON cache keyed like the executor's step
+     cache (op | shape | dtype | mesh axes | backend —
+     ``pallas_dispatch.cache_key``). When the best Pallas candidate
+     LOSES to XLA the entry records ``impl: "xla"`` and trace-time
+     dispatch routes the op back to the XLA lowering.
+
+At trace time `CompiledProgram` loads the cache (``BuildStrategy.
+pallas_tune_cache``) into the dispatch scope; kernels consult it via
+``pallas_dispatch.choose``. `tools/autotune.py` is the CLI; its
+``--dry-run`` sweeps tiny shapes in interpret mode on CPU so tier-1
+exercises the harness itself.
+
+jax imports stay inside functions: loading the cache API must not drag
+the kernel modules in.
+"""
+import json
+import os
+import time
+
+from .. import pallas_dispatch as pd
+
+DEFAULT_CACHE_ENV = "PADDLE_TPU_PALLAS_TUNE_CACHE"
+
+#: candidate block configs per op — kwargs of the kernel entry points
+CANDIDATES = {
+    "softmax_with_cross_entropy": [
+        {"block_t": bt, "block_v": bv}
+        for bt in (128, 256) for bv in (256, 512, 1024)],
+    "adam": [{"block_rows": r} for r in (64, 128, 256, 512)],
+    # >= 128 rows per tile: the (8, block_rows) residual layout puts
+    # block_rows on the lane dim, and compiled Mosaic wants it aligned
+    "layer_norm": [{"block_rows": r} for r in (128, 256, 512)],
+}
+
+#: interpret-mode candidates for --dry-run / tier-1 (tiny tiles)
+DRY_CANDIDATES = {
+    "softmax_with_cross_entropy": [
+        {"block_t": 8, "block_v": 64}, {"block_t": 16, "block_v": 128}],
+    "adam": [{"block_rows": 8}, {"block_rows": 16}],
+    "layer_norm": [{"block_rows": 8}, {"block_rows": 16}],
+}
+
+DRY_SHAPES = {
+    "softmax_with_cross_entropy": (32, 128),
+    "adam": (2048,),
+    "layer_norm": (32, 128),
+}
+
+#: real-chip default sweep shapes (the ERNIE-base headline geometry)
+DEFAULT_SHAPES = {
+    "softmax_with_cross_entropy": (2560, 32768),
+    "adam": (1024 * 1024,),
+    "layer_norm": (16384, 768),
+}
+
+
+def default_cache_path():
+    env = os.environ.get(DEFAULT_CACHE_ENV)
+    if env:
+        return env
+    return os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu",
+                        "pallas_autotune.json")
+
+
+class AutotuneCache(object):
+    """JSON-file persistence of sweep winners. Schema: one top-level
+    dict ``{key: entry}`` where key is ``pallas_dispatch.cache_key`` and
+    entry is ``{"impl": "pallas"|"xla", "config": {...}, "pallas_s":
+    float, "xla_s": float, ...}``. Loads lazily, writes atomically
+    (tmp + rename), tolerates a missing/corrupt file (treated empty —
+    a torn write must not brick trace time)."""
+
+    def __init__(self, path=None):
+        self.path = path or default_cache_path()
+        self._data = None
+        self._dirty = False
+        self._loaded_stat = None
+
+    def _stat(self):
+        try:
+            st = os.stat(self.path)
+            return (st.st_mtime_ns, st.st_size)
+        except OSError:
+            return None
+
+    def load(self):
+        """Parsed cache contents, re-read when the file changed on disk
+        (a re-run of tools/autotune.py must be visible to a live
+        process) — unless this object holds unsaved put()s."""
+        st = self._stat()
+        if self._data is None or (not self._dirty and
+                                  st != self._loaded_stat):
+            try:
+                with open(self.path) as f:
+                    data = json.load(f)
+                self._data = data if isinstance(data, dict) else {}
+            except (OSError, ValueError):
+                self._data = {}
+            self._loaded_stat = st
+        return self._data
+
+    def lookup(self, key):
+        return self.load().get(key)
+
+    def put(self, key, entry):
+        self.load()[key] = entry
+        self._dirty = True
+
+    def save(self):
+        data = self.load()
+        d = os.path.dirname(os.path.abspath(self.path))
+        if d and not os.path.isdir(d):
+            os.makedirs(d, exist_ok=True)
+        tmp = self.path + ".tmp.%d" % os.getpid()
+        with open(tmp, "w") as f:
+            json.dump(data, f, indent=1, sort_keys=True)
+        os.replace(tmp, self.path)
+        self._dirty = False
+        self._loaded_stat = self._stat()
+        return self.path
+
+    def __len__(self):
+        return len(self.load())
+
+
+# ---------------------------------------------------------------------------
+# bounded-probe timing (bench.py discipline)
+# ---------------------------------------------------------------------------
+
+def _time_fn(fn, probes, deadline_s):
+    """Best-of-`probes` wall time of fn() (block_until_ready'd), after
+    one untimed warmup call that pays the compile. Returns None when the
+    candidate exceeds its wall deadline or fails to run."""
+    import jax
+    t_start = time.perf_counter()
+    try:
+        jax.block_until_ready(fn())      # compile + warm
+    except Exception:
+        return None
+    best = None
+    for _ in range(max(1, probes)):
+        if time.perf_counter() - t_start > deadline_s:
+            break
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn())
+        dt = time.perf_counter() - t0
+        best = dt if best is None else min(best, dt)
+    return best
+
+
+def _workloads(op, shape, dtype, interpret):
+    """(pallas_fn(config) -> closure, xla_closure) for one op/shape: the
+    timed unit is one fwd+bwd (fwd-only for adam — it has no vjp) jitted
+    step, matching what the op contributes to the train step."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    rng = np.random.RandomState(0)
+    if op == "softmax_with_cross_entropy":
+        from .blockwise_ce import blockwise_softmax_cross_entropy
+        t, v = shape
+        logits = jnp.asarray(rng.randn(t, v), dtype)
+        labels = jnp.asarray(rng.randint(0, v, (t,)), jnp.int32)
+
+        def ref_loss(lg):
+            logp = jax.nn.log_softmax(lg.astype(jnp.float32), axis=-1)
+            picked = jnp.take_along_axis(logp, labels[:, None], axis=1)
+            return jnp.sum(-picked)
+
+        def make(config):
+            cfg = dict(config or {})
+
+            def loss(lg):
+                out = blockwise_softmax_cross_entropy(
+                    lg, labels, interpret=interpret, **cfg)
+                if out is None:
+                    raise ValueError("shape does not tile under %r" % cfg)
+                return jnp.sum(out)
+            g = jax.jit(jax.grad(loss))
+            return lambda: g(logits)
+        xla_g = jax.jit(jax.grad(ref_loss))
+        return make, lambda: xla_g(logits)
+
+    if op == "adam":
+        from .fused_adam import fused_adam
+        n = int(np.prod(shape))
+        p = jnp.asarray(rng.randn(n), dtype)
+        g = jnp.asarray(rng.randn(n), jnp.float32)
+        m1 = jnp.zeros((n,), jnp.float32)
+        m2 = jnp.zeros((n,), jnp.float32)
+        lr_t = jnp.float32(0.01)
+
+        def make(config):
+            cfg = dict(config or {})
+
+            def step(p, g, m1, m2):
+                out = fused_adam(p, g, m1, m2, lr_t,
+                                 interpret=interpret, **cfg)
+                if out is None:
+                    raise ValueError("shape does not tile under %r" % cfg)
+                return out
+            j = jax.jit(step)
+            return lambda: j(p, g, m1, m2)
+
+        def xla_step(p, g, m1, m2):
+            m1n = 0.9 * m1 + 0.1 * g
+            m2n = 0.999 * m2 + 0.001 * g * g
+            return (p - lr_t * m1n / (jnp.sqrt(m2n) + 1e-8), m1n, m2n)
+        xj = jax.jit(xla_step)
+        return make, lambda: xj(p, g, m1, m2)
+
+    if op == "layer_norm":
+        from .layer_norm import fused_layer_norm
+        r, c = shape
+        x = jnp.asarray(rng.randn(r, c), dtype)
+        sc = jnp.asarray(rng.randn(c), jnp.float32)
+        bi = jnp.asarray(rng.randn(c), jnp.float32)
+
+        def ref(x, sc, bi):
+            m = jnp.mean(x.astype(jnp.float32), -1, keepdims=True)
+            v = jnp.var(x.astype(jnp.float32), -1, keepdims=True)
+            y = (x - m) * jax.lax.rsqrt(v + 1e-5) * sc[None, :] + bi
+            return jnp.sum(y)
+
+        def make(config):
+            cfg = dict(config or {})
+
+            def loss(x, sc, bi):
+                y = fused_layer_norm(x, sc, bi,
+                                     interpret=interpret, **cfg)
+                if y is None:
+                    raise ValueError("shape does not tile under %r" % cfg)
+                return jnp.sum(y)
+            g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+            return lambda: g(x, sc, bi)
+        xg = jax.jit(jax.grad(ref, argnums=(0, 1, 2)))
+        return make, lambda: xg(x, sc, bi)
+
+    raise ValueError("no autotune workload for op %r" % op)
+
+
+def autotune_op(op, shape, dtype="float32", probes=3, interpret=None,
+                cache=None, candidates=None, mesh_axes=None,
+                backend=None, candidate_deadline_s=120.0):
+    """Sweep one (op, shape, dtype): time every candidate and the XLA
+    baseline, persist the winner (or the XLA fallback verdict) under
+    the executor-style cache key, and return the summary dict."""
+    import jax
+    if interpret is None:
+        interpret = pd.default_interpret()
+    if backend is None:
+        backend = jax.default_backend()
+    if cache is None:
+        cache = AutotuneCache()
+    if candidates is None:
+        candidates = (DRY_CANDIDATES if interpret else CANDIDATES)[op]
+    make, xla_fn = _workloads(op, tuple(shape), dtype, interpret)
+    results = {}
+    best_cfg, best_s = None, None
+    for config in candidates:
+        tag = ",".join("%s=%s" % kv for kv in sorted(config.items()))
+        dt = _time_fn(make(config), probes, candidate_deadline_s)
+        results[tag] = round(dt, 6) if dt is not None else "failed"
+        if dt is not None and (best_s is None or dt < best_s):
+            best_cfg, best_s = dict(config), dt
+    xla_s = _time_fn(xla_fn, probes, candidate_deadline_s)
+    # Fall back to XLA when the best Pallas candidate loses (or none
+    # ran). Interpret-mode sweeps NEVER conclude "xla" — not even when
+    # every candidate failed: the interpreter's wall time says nothing
+    # about Mosaic, so off-chip runs only pick among Pallas configs (a
+    # config-less "pallas" entry means kernel defaults, whose own size
+    # guards still fall back dynamically at trace time).
+    pallas_wins = interpret or (best_s is not None and
+                                (xla_s is None or best_s <= xla_s))
+    key = pd.cache_key(op, shape, dtype, mesh_axes, backend)
+    entry = {
+        "impl": "pallas" if pallas_wins else "xla",
+        "config": best_cfg if pallas_wins else None,
+        "pallas_s": round(best_s, 6) if best_s is not None else None,
+        "xla_s": round(xla_s, 6) if xla_s is not None else None,
+        "probes": probes,
+        "interpret": bool(interpret),
+        "backend": backend,
+    }
+    cache.put(key, entry)
+    cache.save()
+    return {"op": op, "key": key, "entry": entry, "results": results,
+            "cache": cache.path}

@@ -1,0 +1,134 @@
+"""Pallas kernel dispatch scope — the light half of the Pallas library.
+
+`BuildStrategy.use_pallas={"softmax_with_cross_entropy","adam","layer_norm"}`
+makes `CompiledProgram` trace the step inside :func:`scope`; the op kernels
+in nn_ops/optimizer_ops consult :func:`enabled` at trace time and route to
+the fused Pallas implementation (``ops/pallas/``), falling back to their
+XLA lowering otherwise. The same thread-local pattern as
+``collective_ops.grad_sync_scope``: the scope is entered around the
+function jax.jit traces, so the decision is baked into the compiled
+executable — which is why the option must participate in the executor's
+compile-cache token.
+
+This module deliberately imports NEITHER jax.experimental.pallas nor the
+kernel modules: every softmax_with_cross_entropy/adam/layer_norm trace
+pays one thread-local read when Pallas is off. The heavy kernels load
+lazily inside the enabled branch.
+
+Autotuning: a :class:`PallasConfig` may carry a tuning cache (any object
+with ``lookup(key) -> entry-dict-or-None``, normally
+``ops.pallas.autotune.AutotuneCache``). :func:`choose` resolves the
+per-(op, shape, dtype, mesh, backend) verdict at trace time: a cached
+entry either overrides the kernel's default block sizes or routes the op
+back to XLA when the sweep found Pallas losing.
+"""
+import contextlib
+import os
+import threading
+
+#: ops with a Pallas lowering behind this dispatch scope (flash attention
+#: has its own auto-engaging entry in layers.attention and is not listed)
+PALLAS_OPS = ("softmax_with_cross_entropy", "adam", "layer_norm")
+
+_local = threading.local()
+
+
+class PallasConfig(object):
+    """Per-compile Pallas dispatch state.
+
+    ops:       iterable of op-type names to route through Pallas
+    interpret: None = decide per kernel call from the effective default
+               device (CPU -> interpret mode, same contract as
+               flash_attention); True/False forces it
+    tuning:    autotune cache (``lookup(key)``) or None for defaults
+    mesh_axes: dict axis->size of the compile's mesh (cache-key part)
+    backend:   platform string the executable targets (cache-key part)
+    """
+
+    def __init__(self, ops, interpret=None, tuning=None, mesh_axes=None,
+                 backend=None):
+        unknown = sorted(set(ops) - set(PALLAS_OPS))
+        if unknown:
+            raise ValueError(
+                "use_pallas names ops with no Pallas lowering: %r "
+                "(available: %r)" % (unknown, list(PALLAS_OPS)))
+        self.ops = frozenset(ops)
+        self.interpret = interpret
+        self.tuning = tuning
+        self.mesh_axes = dict(mesh_axes or {})
+        self.backend = backend
+
+
+@contextlib.contextmanager
+def scope(config):
+    """Install `config` for the current thread (the jit trace runs under
+    it). Nesting restores the outer config on exit."""
+    prev = getattr(_local, "config", None)
+    _local.config = config
+    try:
+        yield config
+    finally:
+        _local.config = prev
+
+
+def active():
+    return getattr(_local, "config", None)
+
+
+def enabled(op_type):
+    """The active PallasConfig if `op_type` is routed to Pallas, else
+    None — the one-line check every wired kernel starts with."""
+    cfg = getattr(_local, "config", None)
+    if cfg is not None and op_type in cfg.ops:
+        return cfg
+    return None
+
+
+def cache_key(op, shape, dtype, mesh_axes=None, backend=None):
+    """Autotune cache key — same ingredients as the executor's step
+    cache: problem shape + mesh axes + backend. One winning config per
+    (op, shape, dtype, topology, platform)."""
+    axes = ",".join("%s=%d" % (a, int(s))
+                    for a, s in sorted((mesh_axes or {}).items()))
+    return "%s|%s|%s|%s|%s" % (
+        op, "x".join(str(int(d)) for d in shape), str(dtype),
+        axes or "-", backend or "-")
+
+
+def choose(cfg, op, shape, dtype):
+    """Resolve (impl, tuned_kwargs) for one kernel call at trace time.
+
+    impl "pallas" with tuned_kwargs=None means "Pallas at default block
+    sizes"; a dict carries the sweep winner's blocks; impl "xla" means
+    the autotuner measured Pallas losing to the XLA lowering for this
+    key — the caller must take its XLA branch."""
+    if cfg is None or cfg.tuning is None:
+        return "pallas", None
+    entry = cfg.tuning.lookup(
+        cache_key(op, shape, dtype, cfg.mesh_axes, cfg.backend))
+    if not entry:
+        return "pallas", None
+    if entry.get("impl") == "xla":
+        return "xla", None
+    config = entry.get("config")
+    return "pallas", (dict(config) if config else None)
+
+
+def default_interpret():
+    """interpret-mode default shared by every kernel entry: honor
+    PADDLE_TPU_PALLAS_INTERPRET, else interpret off-TPU — decided from
+    the EFFECTIVE default device, not the process backend list (a
+    jax.default_device(cpu) pin routes this computation to CPU even when
+    a chip is attached). Mirrors flash_attention's contract."""
+    env = os.environ.get("PADDLE_TPU_PALLAS_INTERPRET")
+    if env is not None:
+        return env not in ("0", "false", "")
+    import jax
+    pinned = getattr(jax.config, "jax_default_device", None)
+    if pinned is None:
+        platform = jax.default_backend()
+    elif isinstance(pinned, str):
+        platform = pinned
+    else:
+        platform = getattr(pinned, "platform", None)
+    return platform not in ("tpu", "axon")

@@ -62,6 +62,11 @@ def pytest_configure(config):
         "quant: quantized-collective / compressed-state-movement tests "
         "(block codec, quantize_collectives guardrails, compressed "
         "checkpoints, bench_micro perf gates)")
+    config.addinivalue_line(
+        "markers",
+        "pallas: Pallas kernel-library oracle batteries (blockwise CE / "
+        "fused MLM head, fused Adam, fused LayerNorm, autotune cache, "
+        "use_pallas dispatch) — interpret mode on CPU, tier-1-safe")
 
 
 @pytest.fixture(autouse=True)

@@ -57,12 +57,76 @@ def test_check_budgets_flags_violations():
 
 
 def test_budget_table_covers_the_contract():
-    """The ISSUE-6 contract metrics are all gated: trace+lower, cache
+    """The ISSUE-6 contract metrics are all gated (trace+lower, cache
     hit rate, quantized-vs-exact step wall time, byte ratio, feed
-    throughput."""
+    throughput) plus the ISSUE-7 pallas section (per-kernel step wall +
+    max abs error)."""
     assert set(bench_micro.BUDGETS) == {
         "trace_lower_s", "cache_hit_rate", "exact_step_s",
-        "quant_step_s", "collective_wire_ratio", "feed_samples_per_s"}
+        "quant_step_s", "collective_wire_ratio", "feed_samples_per_s",
+        "pallas_ce_step_s", "pallas_adam_step_s", "pallas_ln_step_s",
+        "pallas_ce_err", "pallas_adam_err", "pallas_ln_err"}
+
+
+def test_pallas_section_measures_all_three_kernels():
+    m = bench_micro.bench_pallas()
+    for kernel in ("ce", "adam", "ln"):
+        assert m["pallas_%s_step_s" % kernel] > 0
+        assert 0 <= m["pallas_%s_err" % kernel] < 1e-4
+
+
+def _fake_round(rounds_dir, idx, metrics):
+    import json
+    os.makedirs(rounds_dir, exist_ok=True)
+    with open(os.path.join(rounds_dir, "round_%04d.json" % idx),
+              "w") as f:
+        json.dump({"metric": "bench_micro", "metrics": metrics}, f)
+
+
+def _good_metrics():
+    return {name: budget for name, (kind, budget)
+            in bench_micro.BUDGETS.items()}
+
+
+def test_drift_flags_metric_slide_within_budget(tmp_path):
+    """A metric can be well inside its loose absolute budget and still
+    have drifted vs its own history — that is exactly what the rounds
+    comparison exists to flag."""
+    rd = str(tmp_path / "rounds")
+    hist = _good_metrics()
+    hist["trace_lower_s"] = 2.0          # history: ~2s (budget is 60)
+    hist["feed_samples_per_s"] = 9000.0
+    for i in (1, 2, 3):
+        _fake_round(rd, i, hist)
+    current = dict(hist)
+    current["trace_lower_s"] = 10.0      # 5x the median, still < 60
+    current["feed_samples_per_s"] = 2000.0   # 4.5x slower, still > 1000
+    assert bench_micro.check_budgets(current) == []
+    flags = bench_micro.check_drift(current, rd)
+    joined = "\n".join(flags)
+    assert "trace_lower_s" in joined and "feed_samples_per_s" in joined
+    # an in-family round raises no flags
+    assert bench_micro.check_drift(dict(hist), rd) == []
+    # <2 rounds of history: nothing to compare
+    assert bench_micro.check_drift(current, str(tmp_path / "empty")) == []
+
+
+def test_save_round_numbers_sequentially(tmp_path):
+    rd = str(tmp_path / "rounds")
+    p1 = bench_micro.save_round({"metrics": {}}, rd)
+    p2 = bench_micro.save_round({"metrics": {}}, rd)
+    assert os.path.basename(p1) == "round_0001.json"
+    assert os.path.basename(p2) == "round_0002.json"
+
+
+def test_run_all_with_rounds_dir_persists_and_reports(tmp_path):
+    rd = str(tmp_path / "rounds")
+    for i in (1, 2):
+        _fake_round(rd, i, _good_metrics())
+    report = bench_micro.run_all(rounds_dir=rd)
+    assert "drift_ok" in report
+    assert os.path.basename(report["round_file"]) == "round_0003.json"
+    assert len(os.listdir(rd)) == 3
 
 
 @pytest.mark.slow

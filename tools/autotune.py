@@ -1,0 +1,121 @@
+#!/usr/bin/env python
+"""Pallas kernel autotuner CLI.
+
+Sweeps each kernel's block configs per (op, shape, dtype, topology,
+backend) with bounded probes, persists the winners (or the XLA-fallback
+verdict) in the JSON cache `CompiledProgram` loads at trace time via
+``BuildStrategy.pallas_tune_cache``, and prints ONE JSON summary line.
+
+Usage:
+  python tools/autotune.py                       # all ops, chip shapes
+  python tools/autotune.py --ops adam,layer_norm
+  python tools/autotune.py --shape adam=1048576 \\
+      --shape layer_norm=16384x768               # override sweep shapes
+  python tools/autotune.py --cache /path/tune.json --probes 5
+  python tools/autotune.py --dry-run             # tiny shapes, interpret
+                                                 # mode, CPU — the tier-1
+                                                 # smoke of the harness
+
+--dry-run never concludes "xla" (interpreter wall time says nothing
+about Mosaic) and defaults its cache to a throwaway file so a CI run
+cannot poison the real fleet cache.
+"""
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _parse_shape(text):
+    return tuple(int(d) for d in text.lower().split("x"))
+
+
+def main(argv=None):
+    from paddle_tpu.ops.pallas import autotune as at
+    from paddle_tpu.ops import pallas_dispatch as pd
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--ops", default=",".join(pd.PALLAS_OPS),
+                    help="comma-separated op names to sweep")
+    ap.add_argument("--shape", action="append", default=[],
+                    metavar="OP=DIMxDIM",
+                    help="sweep shape override, e.g. layer_norm=4096x768"
+                         " (repeatable)")
+    ap.add_argument("--dtype", default="float32")
+    ap.add_argument("--mesh-axes", default=None, metavar="AXIS=N,...",
+                    help="mesh axes of the compile the cache will serve, "
+                         "e.g. dp=8 — must match BuildStrategy.mesh_axes "
+                         "or the trace-time lookup misses (default: no "
+                         "mesh in the key)")
+    ap.add_argument("--probes", type=int, default=3,
+                    help="timed calls per candidate (best-of)")
+    ap.add_argument("--cache", default=None,
+                    help="cache JSON path (default: %s or ~/.cache/"
+                         "paddle_tpu/pallas_autotune.json)"
+                         % at.DEFAULT_CACHE_ENV)
+    ap.add_argument("--candidate-deadline-s", type=float, default=120.0,
+                    help="wall budget per candidate incl. compile")
+    ap.add_argument("--dry-run", action="store_true",
+                    help="tiny shapes + interpret mode + 1 probe: "
+                         "exercises the sweep harness itself on CPU")
+    args = ap.parse_args(argv)
+
+    ops = [o.strip() for o in args.ops.split(",") if o.strip()]
+    unknown = sorted(set(ops) - set(pd.PALLAS_OPS))
+    if unknown:
+        ap.error("unknown ops %r (available: %s)"
+                 % (unknown, ",".join(pd.PALLAS_OPS)))
+    mesh_axes = None
+    if args.mesh_axes:
+        try:
+            mesh_axes = {a: int(n) for a, n in
+                         (item.split("=") for item in
+                          args.mesh_axes.split(","))}
+        except ValueError:
+            ap.error("bad --mesh-axes %r (want AXIS=N,...)"
+                     % args.mesh_axes)
+    shapes = dict(at.DRY_SHAPES if args.dry_run else at.DEFAULT_SHAPES)
+    for item in args.shape:
+        op, _, dims = item.partition("=")
+        if op not in shapes or not dims:
+            ap.error("bad --shape %r (want OP=DIMxDIM)" % item)
+        shapes[op] = _parse_shape(dims)
+
+    cache_path = args.cache
+    if cache_path is None and args.dry_run:
+        fd, cache_path = tempfile.mkstemp(prefix="pallas_autotune_dry_",
+                                          suffix=".json")
+        os.close(fd)
+    cache = at.AutotuneCache(cache_path)
+
+    probes = 1 if args.dry_run else args.probes
+    interpret = True if args.dry_run else None
+    summaries = {}
+    ok = True
+    for op in ops:
+        try:
+            summaries[op] = at.autotune_op(
+                op, shapes[op], dtype=args.dtype, probes=probes,
+                interpret=interpret, cache=cache, mesh_axes=mesh_axes,
+                candidate_deadline_s=args.candidate_deadline_s)
+        except Exception as e:  # one broken sweep must not eat the rest
+            summaries[op] = {"op": op, "error": "%s: %s"
+                             % (type(e).__name__, e)}
+            ok = False
+    print(json.dumps({
+        "metric": "pallas_autotune",
+        "dry_run": bool(args.dry_run),
+        "cache": cache.path,
+        "entries": len(cache),
+        "ok": ok and all("entry" in s for s in summaries.values()),
+        "sweeps": summaries,
+    }))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
