@@ -27,6 +27,16 @@ surfaces that move on every PR, on JAX_PLATFORMS=cpu, in seconds:
                              LayerNorm): fwd+bwd step wall + max abs
                              error per kernel — the kernels' tier-1
                              perf-and-parity canary
+  * transport_*            — coordination-plane latency over an
+                             in-process CoordServer: single
+                             request/response round trip and a 2-host
+                             all_gather round (the per-window cost
+                             every pod/fleet protocol pays)
+  * serving_*              — fleet router p50/p99 request latency +
+                             shed rate under synthetic concurrent
+                             load (2 in-process replicas, continuous
+                             micro-batching) — the serving-path
+                             regression canary
 
 Output contract: ONE JSON line (dict with "metric": "bench_micro" and a
 "metrics" sub-dict). tests/test_bench_micro.py re-runs the suite
@@ -87,6 +97,22 @@ BUDGETS = {
     "pallas_ce_err": ("max", 1e-4),
     "pallas_adam_err": ("max", 1e-5),
     "pallas_ln_err": ("max", 1e-4),
+    # coordination-plane latency (in-process CoordServer over loopback
+    # TCP): a round trip is ~100us healthy; a 2-host gather round adds
+    # the poll cadence. Budgets catch a protocol/serialization blowup.
+    "transport_roundtrip_ms": ("max", 25.0),
+    "transport_gather_ms": ("max", 250.0),
+    # serving fleet under synthetic load (2 in-process replicas +
+    # micro-batching router, tiny model): p50/p99 wall per request and
+    # the shed rate. Sized for shared-CI noise — they catch a batching
+    # stall or a dispatch-path regression, not single-digit drift.
+    "serving_p50_ms": ("max", 250.0),
+    "serving_p99_ms": ("max", 2000.0),
+    "serving_shed_rate": ("max", 0.2),
+    # p50/p99 are computed over SUCCESSFUL requests only — without an
+    # error-rate gate a broken dispatch path (mass 502s) would leave
+    # the latency numbers green on the few requests that survived
+    "serving_error_rate": ("max", 0.05),
 }
 
 # metric -> worsening factor vs the rounds-history median that counts as
@@ -327,6 +353,146 @@ def bench_pallas(steps=2):
     return out
 
 
+def bench_transport(roundtrips=200, gathers=20):
+    """Coordination-plane latency over an in-process CoordServer:
+    mean single round trip (the heartbeat/poll cost) and mean 2-host
+    all_gather round wall (put + sticky freeze + poll + ack — what a
+    pod window or a fleet control round pays)."""
+    import threading
+    from paddle_tpu.framework.coordination import SocketCoordinator
+    from paddle_tpu.framework.transport import CoordServer
+    out = {}
+    with CoordServer(2) as srv:
+        srv.start()
+        cos = [SocketCoordinator(srv.address, 2, h, mesh_reinit=False,
+                                 heartbeat=False, poll_s=0.001)
+               for h in range(2)]
+        try:
+            cos[0].lost_hosts()              # warm the connection
+            t0 = time.perf_counter()
+            for _ in range(roundtrips):
+                cos[0].lost_hosts()
+            dt = time.perf_counter() - t0
+            out["transport_roundtrip_ms"] = round(
+                dt / roundtrips * 1e3, 4)
+
+            def party(h, r):
+                cos[h].all_gather("bench_g%d" % r, h, h)
+
+            t0 = time.perf_counter()
+            for r in range(gathers):
+                ts = [threading.Thread(target=party, args=(h, r))
+                      for h in range(2)]
+                for t in ts:
+                    t.start()
+                for t in ts:
+                    t.join()
+            dt = time.perf_counter() - t0
+            out["transport_gather_ms"] = round(dt / gathers * 1e3, 4)
+        finally:
+            for co in cos:
+                co.close()
+    return out
+
+
+def bench_serving(n_replicas=2, clients=4, requests_per_client=30):
+    """Fleet router p50/p99 + shed rate under synthetic load: export a
+    tiny artifact, run 2 in-process replicas + the micro-batching
+    router on the coordination plane, and drive concurrent clients
+    through POST /infer."""
+    import shutil
+    import tempfile
+    import threading
+    import numpy as np
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    from paddle_tpu.framework.scope import Scope, scope_guard
+    from paddle_tpu.framework.transport import CoordServer
+    from paddle_tpu.serving_fleet import (FleetRouter, ReplicaMember,
+                                          http_json)
+    tmp = tempfile.mkdtemp(prefix="paddle_tpu_bench_serving_")
+    members = []
+    try:
+        with scope_guard(Scope()):
+            main, startup = pt.Program(), pt.Program()
+            with pt.program_guard(main, startup):
+                x = layers.data("x", [8], dtype="float32")
+                y = layers.softmax(layers.fc(x, 4))
+            exe = pt.Executor()
+            exe.run(startup)
+            pt.save_inference_model(tmp, ["x"], [y], exe,
+                                    main_program=main,
+                                    format="stablehlo",
+                                    batch_sizes=(8,))
+        srv = CoordServer(n_replicas + 1, hb_deadline_s=5.0).start()
+        members.append(srv)
+        # register each member the moment it starts: a later start()
+        # raising must not leak the earlier ones past the finally
+        for i in range(n_replicas):
+            members.append(ReplicaMember(tmp, srv.address, n_replicas,
+                                         i, ctl_interval_s=0.25,
+                                         hb_interval_s=0.25).start())
+        router = FleetRouter(srv.address, n_replicas, max_batch=8,
+                             batch_deadline_s=0.002, ctl_interval_s=0.25,
+                             hb_interval_s=0.25,
+                             poll_interval_s=0.05).start()
+        members.append(router)
+        deadline = time.monotonic() + 10.0
+        while len(router.routable()) < n_replicas \
+                and time.monotonic() < deadline:
+            time.sleep(0.02)
+        rng = np.random.RandomState(0)
+        xv = rng.rand(2, 8).astype(np.float32).tolist()
+        lat, shed, errs = [], [0], [0]
+        lock = threading.Lock()
+
+        def client():
+            for _ in range(requests_per_client):
+                t0 = time.perf_counter()
+                try:
+                    status, _ = http_json(
+                        "POST", router.url + "/infer",
+                        {"feeds": {"x": xv}}, timeout_s=10.0)
+                except (OSError, ValueError):
+                    status = -1
+                dt = time.perf_counter() - t0
+                with lock:
+                    if status == 200:
+                        lat.append(dt)
+                    elif status == 503:
+                        shed[0] += 1
+                    else:
+                        errs[0] += 1
+
+        ts = [threading.Thread(target=client) for _ in range(clients)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        total = len(lat) + shed[0] + errs[0]
+        lat.sort()
+        # no successful request: a finite budget-busting sentinel, not
+        # inf — json.dumps(inf) emits non-RFC "Infinity" and breaks
+        # every non-Python consumer of the bench line, and a -1 would
+        # silently PASS the "max" budgets
+        fail_ms = 1e9
+        p50 = lat[len(lat) // 2] * 1e3 if lat else fail_ms
+        p99 = lat[min(len(lat) - 1, int(len(lat) * 0.99))] * 1e3 \
+            if lat else fail_ms
+        return {"serving_p50_ms": round(p50, 3),
+                "serving_p99_ms": round(p99, 3),
+                "serving_shed_rate": round(shed[0] / float(total), 4)
+                if total else 1.0,
+                "serving_error_rate": round(errs[0] / float(total), 4)
+                if total else 1.0,
+                "serving_errors": errs[0],
+                "serving_requests": total}
+    finally:
+        for m in reversed(members):
+            m.close()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 # ---------------------------------------------------------------------------
 # round trend tracking
 # ---------------------------------------------------------------------------
@@ -403,7 +569,9 @@ def run_all(rounds_dir=None):
                      ("cache_hit", bench_cache_hit),
                      ("quantized_step", bench_quantized_step),
                      ("feed", bench_feed),
-                     ("pallas", bench_pallas)):
+                     ("pallas", bench_pallas),
+                     ("transport", bench_transport),
+                     ("serving", bench_serving)):
         t0 = time.perf_counter()
         try:
             metrics.update(fn())

@@ -50,8 +50,27 @@ __all__ = [
     "CoordinationError", "HostLostError", "BarrierTimeoutError",
     "NoQuorumError", "Coordinator", "LocalCoordinator",
     "FileCoordinator", "SocketCoordinator", "PodResilientTrainer",
-    "ElasticTrainer",
+    "ElasticTrainer", "agreed_pending",
 ]
+
+
+def agreed_pending(verdicts, idx=1):
+    """The admission ``[host, nonce]`` pair EVERY participant of a
+    frozen gather observed — the first such pair in the lowest live
+    host's ordering, or None. Each verdict's ``idx`` element is that
+    host's sorted view of the pending-join set.
+
+    This is the agreement invariant that makes the join barrier
+    complete: because it is computed from the same frozen verdicts on
+    every host, all of them admit the SAME joiner together. Shared by
+    :class:`ElasticTrainer`'s window-boundary admission and the
+    serving fleet's control rounds — it must have exactly one
+    definition."""
+    live = sorted(verdicts)
+    for pair in (verdicts[live[0]][idx] if live else []):
+        if all(pair in v[idx] for v in verdicts.values()):
+            return pair
+    return None
 
 
 class CoordinationError(RuntimeError):
@@ -868,6 +887,32 @@ class SocketCoordinator(Coordinator):
         joins = self._call("pending_joins").get("joins", {})
         return {int(h): int(n) for h, n in joins.items()}
 
+    # -- member registry (serving fleet) -----------------------------------
+    def put_info(self, info):
+        """Publish this host's JSON blob to the server's member
+        registry (last write wins). The serving fleet advertises each
+        replica's HTTP address + artifact generation here so the
+        router needs no static fleet configuration."""
+        self._call("put_info", info=info)
+
+    def members(self):
+        """One snapshot of the whole membership picture:
+        ``{"n_hosts", "hb_deadline_s", "hb_age": {host: seconds},
+        "info": {host: blob}, "lost": {host: reason}}`` — host keys as
+        ints. The routing table is derived from exactly this (live =
+        registered, not fenced), and ``hb_deadline_s`` lets a client
+        judge a lease live-looking by the same bound the server's
+        monitor fences by."""
+        resp = self._call("members")
+        return {"n_hosts": resp.get("n_hosts"),
+                "hb_deadline_s": resp.get("hb_deadline_s"),
+                "hb_age": {int(h): float(v)
+                           for h, v in resp.get("hb_age", {}).items()},
+                "info": {int(h): v
+                         for h, v in resp.get("info", {}).items()},
+                "lost": {int(h): v
+                         for h, v in resp.get("lost", {}).items()}}
+
     def unfence(self, host_id):
         self._call("unfence", host=int(host_id))
         with self._known_lock:
@@ -1443,6 +1488,23 @@ class ElasticTrainer(PodResilientTrainer):
         from . import watchdog
         return watchdog.straggler_action_due()
 
+    @staticmethod
+    def _agreed_lags(verdicts):
+        """Per-host stream-lag snapshot assembled from the FROZEN
+        window verdicts (each host's ``exchange_state()["lag"]``).
+        Every live host computes this from the same frozen round, so
+        the map is identical pod-wide — the agreed input that makes
+        ``ShardedFeed(weighted_rebalance=True)`` safe on socket pods
+        with divergent local event logs. None when the exchange
+        carried no lags (pre-upgrade peers): rebalance then falls back
+        to its local-gauge default."""
+        lags = {}
+        for h, v in verdicts.items():
+            exch = v[2] if len(v) > 2 else None
+            if isinstance(exch, dict) and "lag" in exch:
+                lags[h] = float(exch["lag"])
+        return lags or None
+
     # -- gradient-merge-aware LR rescale (fixed-per-host-batch regime) ----
     def _grad_merge_k(self, n_live):
         k = self._grad_merge_steps
@@ -1746,7 +1808,11 @@ class ElasticTrainer(PodResilientTrainer):
                         if h != hid:
                             feed.observe(v[2])
                     if lost:
-                        feed.rebalance(live)
+                        # weighted placement reads the AGREED lag map
+                        # carried on this very exchange, never the
+                        # host-local gauges (socket pods diverge)
+                        feed.rebalance(live,
+                                       lags=self._agreed_lags(verdicts))
                     if step % ckpt_every == 0 or step == n \
                             or feed.all_drained():
                         # all_drained: the break below must leave the
@@ -1760,11 +1826,7 @@ class ElasticTrainer(PodResilientTrainer):
                 # admission rides the window boundary: every live host
                 # saw the same gathered pending sets, so they all admit
                 # the same joiner (lowest id fully-observed) together
-                agreed = None
-                for pair in (verdicts[live[0]][1] if live else []):
-                    if all(pair in v[1] for v in verdicts.values()):
-                        agreed = pair
-                        break
+                agreed = agreed_pending(verdicts)
                 if agreed is not None:
                     jhid, nonce = agreed
                     try:
@@ -1780,7 +1842,9 @@ class ElasticTrainer(PodResilientTrainer):
                             if feed is not None:
                                 # give the joiner its stream lanes back
                                 # at the same barrier that ships state
-                                feed.rebalance(live)
+                                feed.rebalance(
+                                    live,
+                                    lags=self._agreed_lags(verdicts))
                             tag = "%s_h%d_n%d" % (run_tag, jhid, nonce)
                             co.barrier("ship" + tag, hid)
                             self._ship_state(hid, trainer, live, jhid,
@@ -1901,10 +1965,16 @@ class ElasticTrainer(PodResilientTrainer):
                 # a shrink and a transient fault in the SAME window:
                 # re-home the dead host's lanes first so the cursor
                 # restore maps lane ownership onto the surviving set
-                feed.rebalance(live)
+                feed.rebalance(live, lags=self._agreed_lags(verdicts))
             got = trainer._restore(
                 step=agreed_step,
-                shardings=self._current_shardings(trainer))
+                shardings=self._current_shardings(trainer),
+                # the checkpoint's owner map may predate this window's
+                # membership — any orphan re-placement inside the
+                # cursor restore must use the AGREED lag snapshot, not
+                # each process's local gauges
+                feed_lags=None if feed is None
+                else self._agreed_lags(verdicts))
             # the restored scope carries the LR (and applied-factor
             # marker) from save time — reconcile with CURRENT capacity
             self._apply_lr_scale(trainer, live)

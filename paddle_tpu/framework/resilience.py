@@ -51,6 +51,10 @@ __all__ = [
     "metrics", "metrics_text", "parse_metrics_text",
     "serve_metrics", "MetricsServer", "ElasticTrainer",
     "record_bytes", "bytes_totals", "clear_bytes",
+    "record_router_request", "record_router_retry",
+    "observe_router_batch",
+    "set_router_queue_depth", "set_router_inflight",
+    "router_totals", "clear_router",
 ]
 
 INJECTION_POINTS = ("step", "ckpt_write", "serve")
@@ -170,11 +174,12 @@ def record_event(kind, **fields):
 
 def clear_events():
     """Reset the observability surface: the bounded event log AND the
-    cumulative byte counters (a cleared log exporting stale byte series
-    would break the 'empty log -> empty metrics' contract tests and
-    scrapers rely on)."""
+    cumulative byte/router counters (a cleared log exporting stale
+    series would break the 'empty log -> empty metrics' contract tests
+    and scrapers rely on)."""
     _LOG.clear()
     clear_bytes()
+    clear_router()
 
 
 # ---------------------------------------------------------------------------
@@ -219,16 +224,128 @@ def clear_bytes():
         _BYTES.clear()
 
 
+# Serving-fleet router accounting (serving_fleet.FleetRouter). Same
+# design pressure as the byte counters: the router serves at request
+# rate, and one event per request would evict the whole bounded log in
+# minutes — so these are cumulative process-global counters/gauges
+# OUTSIDE the event log, folded into metrics() only once any activity
+# exists (router-less jobs export nothing new). Rare router events
+# (a replica dispatch failing over, a rolling-deploy step) still ride
+# the ordinary event log.
+_ROUTER_LOCK = threading.Lock()
+ROUTER_BATCH_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128)
+
+
+def _fresh_router_state():
+    return {"requests": {},                   # outcome -> count
+            "batch_counts": [0] * (len(ROUTER_BATCH_BUCKETS) + 1),
+            "batch_sum": 0.0, "batch_count": 0,
+            "queue_depth": None,              # gauge (None = never set)
+            "inflight": {},                   # replica -> gauge
+            "retries": {}}                    # replica -> count
+
+
+_ROUTER = _fresh_router_state()
+
+
+def record_router_request(outcome):
+    """Count one routed request's terminal outcome ("ok", "shed",
+    "deadline", "error", ...). Exported as
+    ``<prefix>_router_requests_total{outcome=}``."""
+    with _ROUTER_LOCK:
+        r = _ROUTER["requests"]
+        r[str(outcome)] = r.get(str(outcome), 0) + 1
+
+
+def record_router_retry(replica):
+    """Count one failed dispatch attempt that was retried on a
+    sibling. A cumulative counter, NOT an event: under a shed storm
+    retries run at request rate and would evict the bounded event log
+    (the router still records an event for the RARE connection-level
+    failures — a replica death — just not for load-driven 5xx)."""
+    with _ROUTER_LOCK:
+        r = _ROUTER["retries"]
+        r[int(replica)] = r.get(int(replica), 0) + 1
+
+
+def observe_router_batch(size):
+    """Record one dispatched micro-batch's coalesced request count in
+    the ``<prefix>_router_batch_size`` histogram."""
+    size = float(size)
+    with _ROUTER_LOCK:
+        for i, le in enumerate(ROUTER_BATCH_BUCKETS):
+            if size <= le:
+                _ROUTER["batch_counts"][i] += 1
+                break
+        else:
+            _ROUTER["batch_counts"][-1] += 1
+        _ROUTER["batch_sum"] += size
+        _ROUTER["batch_count"] += 1
+
+
+def set_router_queue_depth(depth):
+    """Update the ``<prefix>_router_queue_depth`` gauge (requests
+    waiting to be coalesced into a batch)."""
+    with _ROUTER_LOCK:
+        _ROUTER["queue_depth"] = float(depth)
+
+
+def set_router_inflight(replica, n):
+    """Update the per-replica ``<prefix>_router_replica_inflight``
+    gauge (batches the router currently has dispatched to it)."""
+    with _ROUTER_LOCK:
+        _ROUTER["inflight"][int(replica)] = float(n)
+
+
+def router_totals():
+    """One consistent snapshot of the router accounting (also what
+    :func:`metrics` exports from): ``{"requests": {outcome: n},
+    "batch_counts" (per-bucket, non-cumulative), "batch_count",
+    "batch_sum", "queue_depth", "inflight": {replica: n}}``. Taken
+    under ONE lock acquisition so the histogram's bucket counts can
+    never run ahead of its total (a non-monotonic histogram is
+    invalid to Prometheus consumers)."""
+    with _ROUTER_LOCK:
+        return {"requests": dict(_ROUTER["requests"]),
+                "batch_counts": list(_ROUTER["batch_counts"]),
+                "batch_count": _ROUTER["batch_count"],
+                "batch_sum": _ROUTER["batch_sum"],
+                "queue_depth": _ROUTER["queue_depth"],
+                "inflight": dict(_ROUTER["inflight"]),
+                "retries": dict(_ROUTER["retries"])}
+
+
+def clear_router():
+    with _ROUTER_LOCK:
+        global _ROUTER
+        _ROUTER = _fresh_router_state()
+
+
+def _counts_histogram(name, buckets, counts, total, hsum,
+                      labels=None):
+    """Prometheus histogram dict from PRE-BUCKETED per-bucket counts.
+    The single home of the cumulative encoding (bucket counts must
+    never run ahead of the +Inf total, or consumers reject the
+    series) — _histogram and the router batch histogram both ride it."""
+    cum, running = [], 0
+    for le, n in zip(buckets, counts):
+        running += int(n)
+        cum.append(["%g" % le, running])
+    cum.append(["+Inf", int(total)])
+    return {"name": name, "labels": dict(labels or {}),
+            "buckets": cum, "sum": float(hsum), "count": int(total)}
+
+
 def _histogram(name, values, buckets, labels=None):
     values = [float(v) for v in values]
-    cum, total = [], 0
+    counts = []
+    prev = None
     for le in buckets:
-        total = sum(1 for v in values if v <= le)
-        cum.append(["%g" % le, total])
-    cum.append(["+Inf", len(values)])
-    return {"name": name, "labels": dict(labels or {}),
-            "buckets": cum, "sum": float(sum(values)),
-            "count": len(values)}
+        counts.append(sum(1 for v in values
+                          if v <= le and (prev is None or v > prev)))
+        prev = le
+    return _counts_histogram(name, buckets, counts, len(values),
+                             sum(values), labels=labels)
 
 
 def metrics(event_list=None, by_host=False):
@@ -271,6 +388,27 @@ def metrics(event_list=None, by_host=False):
                                              makes compression ratios
                                              assertable, see
                                              record_bytes)
+      <prefix>_router_requests_total{outcome=}  serving-fleet router
+                                             requests by terminal
+                                             outcome (ok/shed/deadline/
+                                             error — cumulative process
+                                             counters, see
+                                             record_router_request)
+      <prefix>_router_retries_total{replica=}  failed dispatch attempts
+                                             retried on a sibling
+                                             (cumulative — load-driven
+                                             5xx retries run at request
+                                             rate and must not ride the
+                                             bounded event log)
+      <prefix>_router_queue_depth            gauge: requests waiting in
+                                             the router's coalescing
+                                             queue
+      <prefix>_router_replica_inflight{replica=}  gauge: batches the
+                                             router has in flight at
+                                             each replica
+      <prefix>_router_batch_size             histogram: requests
+                                             coalesced per dispatched
+                                             micro-batch
       <prefix>_restore_latency_seconds       checkpoint-restore wall time
                                              (from restore events'
                                              latency_s)
@@ -335,6 +473,20 @@ def metrics(event_list=None, by_host=False):
             counters.append(
                 {"name": "%s_%s_bytes_total" % (METRIC_PREFIX, ch),
                  "labels": {"kind": kind}, "value": tot[kind]})
+    # serving-fleet router series (cumulative process counters like the
+    # byte pairs — NOT events; see record_router_request): emitted only
+    # once the router did anything, so router-less jobs export nothing
+    # new. Counter: requests by terminal outcome. Gauges: queue depth +
+    # per-replica in-flight. Histogram: coalesced batch size.
+    rt = router_totals()
+    counters += [
+        {"name": METRIC_PREFIX + "_router_requests_total",
+         "labels": {"outcome": outcome}, "value": n}
+        for outcome, n in sorted(rt["requests"].items())]
+    counters += [
+        {"name": METRIC_PREFIX + "_router_retries_total",
+         "labels": {"replica": str(r)}, "value": n}
+        for r, n in sorted(rt["retries"].items())]
     last_epoch, last_lag, last_hb = {}, {}, {}
     for e in evs:
         if e["kind"] == "feed_epoch":
@@ -353,10 +505,20 @@ def metrics(event_list=None, by_host=False):
                     "value": v}
                    for h, v in sorted(series.items(),
                                       key=lambda kv: str(kv[0]))]
+    if rt["queue_depth"] is not None:
+        gauges.append({"name": METRIC_PREFIX + "_router_queue_depth",
+                       "labels": {}, "value": rt["queue_depth"]})
+    gauges += [{"name": METRIC_PREFIX + "_router_replica_inflight",
+                "labels": {"replica": str(r)}, "value": v}
+               for r, v in sorted(rt["inflight"].items())]
     restore_lat = [e["latency_s"] for e in evs
                    if e["kind"] == "restore" and "latency_s" in e]
     histograms = [_histogram(METRIC_PREFIX + "_restore_latency_seconds",
                              restore_lat, RESTORE_LATENCY_BUCKETS)]
+    if rt["batch_count"]:
+        histograms.append(_counts_histogram(
+            METRIC_PREFIX + "_router_batch_size", ROUTER_BATCH_BUCKETS,
+            rt["batch_counts"], rt["batch_count"], rt["batch_sum"]))
     return {"counters": counters, "gauges": gauges,
             "histograms": histograms}
 
@@ -863,7 +1025,7 @@ class ResilientTrainer(object):
                                compress=self._ckpt_compress)
         record_event("ckpt", step=step)
 
-    def _restore(self, step=None, shardings=None):
+    def _restore(self, step=None, shardings=None, feed_lags=None):
         """Restore ``step`` (pod-consensus path) or the latest valid
         checkpoint. Always joins an in-flight async commit FIRST: a
         blocking=False save still writing while we pick the restore
@@ -875,6 +1037,12 @@ class ResilientTrainer(object):
         to io.load_checkpoint so the restore materializes straight onto
         the CURRENT mesh — what lets a checkpoint written at 8 hosts
         restore onto an elastically-shrunk 6-host topology.
+
+        feed_lags: the pod-AGREED {host: stream lag} snapshot for the
+        cursor restore's lane re-mapping (ElasticTrainer assembles it
+        from the frozen window verdicts). Without it a
+        weighted-rebalance feed would re-place any orphaned lanes from
+        each process's LOCAL gauges — divergent maps on a socket pod.
 
         With a feed attached, the checkpoint's dataset cursor is
         restored into it at the same time (ownership re-mapped onto the
@@ -900,7 +1068,7 @@ class ResilientTrainer(object):
                     "a ShardedFeed is attached — restoring params without "
                     "the data position would re-read or skip samples"
                     % (got, self._ckpt_dir))
-            self._feed.restore(feed_state)
+            self._feed.restore(feed_state, lags=feed_lags)
         else:
             got = io_mod.load_checkpoint(self._executor, self._ckpt_dir,
                                          self._program, step=step,

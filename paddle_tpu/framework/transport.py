@@ -69,13 +69,22 @@ class _PodState(object):
       joins:  {host_id: nonce}            fenced hosts asking back in
       rounds: {name: {"values", "tokens", "done", "acks"}}
       hb:     {host_id: last monotonic}   heartbeats (hello/hb)
+      info:   {host_id: blob}             member-published JSON blobs
+                                          (serving address, generation —
+                                          see ``put_info``/``members``)
     ``completed`` keeps the most recent frozen round names (bounded
     deque — a long-running service must not grow by one string per
     round forever) for test and tooling introspection.
+
+    ``n_hosts=None`` starts the service in AUTO-SIZE mode: the pod size
+    is learned from the first ``hello`` that carries ``n_hosts`` (every
+    SocketCoordinator sends it), and every later hello must agree.
+    Until then only ``hello`` is served — any other op would need the
+    size for range checks and round completion.
     """
 
     def __init__(self, n_hosts, hb_deadline_s=None):
-        self.n_hosts = int(n_hosts)
+        self.n_hosts = None if n_hosts is None else int(n_hosts)
         self.hb_deadline_s = None if hb_deadline_s is None \
             else float(hb_deadline_s)
         self.lock = threading.Lock()
@@ -88,6 +97,7 @@ class _PodState(object):
         self.joins = {}
         self.rounds = {}
         self.hb = {}
+        self.info = {}
         self.completed = collections.deque(maxlen=2048)
 
     # -- callers hold self.lock ------------------------------------------
@@ -137,6 +147,9 @@ class CoordServer(object):
     One per pod. Start in-process (tests, or the host-0 sidecar
     pattern) or standalone through ``tools/coordsvc.py``. ``port=0``
     binds an ephemeral port — read it back from :attr:`address`.
+    ``n_hosts=None`` starts in auto-size mode: the pod size is learned
+    from the first hello that carries one (``tools/coordsvc.py
+    --n-hosts auto``) — elastic group sizes without up-front config.
 
     ``hb_deadline_s`` arms heartbeat liveness: any host that ever said
     hello and then goes silent past the deadline is tombstoned by the
@@ -220,13 +233,23 @@ def _serve(state, req):
     cmd = req.get("cmd")
     hid = req.get("host")
     hid = None if hid is None else int(hid)
-    if hid is not None and not 0 <= hid < state.n_hosts:
-        # an off-by-one host id must fail loudly, not land phantom
-        # contributions in rounds or phantom tombstones in lost maps
-        return {"error": "host id %d out of range for a %d-host pod"
-                % (hid, state.n_hosts)}
     now = time.monotonic()
     with state.lock:
+        # both guards read state.n_hosts INSIDE the lock: in auto-size
+        # mode a non-hello op racing the first sized hello must see
+        # one consistent value — a torn read could skip the range
+        # check and land exactly the phantom state it exists to block
+        if hid is not None and state.n_hosts is not None \
+                and not 0 <= hid < state.n_hosts:
+            # an off-by-one host id must fail loudly, not land phantom
+            # contributions in rounds or phantom tombstones
+            return {"error": "host id %d out of range for a %d-host "
+                    "pod" % (hid, state.n_hosts)}
+        if state.n_hosts is None and cmd != "hello":
+            # auto-size mode before the first sized hello: nothing
+            # else can be range-checked or frozen yet
+            return {"error": "pod size not learned yet — the first "
+                    "hello must carry n_hosts (auto-size mode)"}
         # the heartbeat monitor owns proactive scans, but piggybacking
         # one on every request keeps detection sharp under load (and
         # makes the deadline hold even on a paused monitor thread)
@@ -243,6 +266,21 @@ def _serve(state, req):
 def _dispatch(state, cmd, hid, req, now):
     """The op table — caller holds ``state.lock``."""
     if cmd == "hello":
+        if state.n_hosts is None:
+            # auto-size: the first sized hello fixes the pod size for
+            # the service's lifetime; later hellos must agree. The
+            # validation runs BEFORE the commit — an error return must
+            # not have the side effect of pinning a bogus size
+            if req.get("n_hosts") is None:
+                return {"error": "pod size not learned yet — this "
+                        "hello must carry n_hosts (auto-size mode)"}
+            want = int(req["n_hosts"])
+            if want < 1:
+                return {"error": "n_hosts must be >= 1, got %d" % want}
+            if hid is not None and not 0 <= hid < want:
+                return {"error": "host id %d out of range for a "
+                        "%d-host pod" % (hid, want)}
+            state.n_hosts = want
         if int(req.get("n_hosts", state.n_hosts)) != state.n_hosts:
             return {"error": "pod size mismatch: server has %d "
                     "hosts, client expects %s"
@@ -339,6 +377,26 @@ def _dispatch(state, cmd, hid, req, now):
                 # parity) — the rounds table stays bounded
                 state.rounds.pop(name, None)
         return {"ok": True}
+    if cmd == "put_info":
+        # member-published blob (last write wins, idempotent): how a
+        # serving replica advertises its HTTP address + generation so
+        # the router never needs static fleet configuration
+        if hid is None:
+            return {"error": "put_info needs a host id"}
+        state.info[hid] = req.get("info")
+        return {"ok": True}
+    if cmd == "members":
+        # one poll answers the whole routing question: who is
+        # registered (info), who is fenced (lost — versioned by the
+        # caller in _serve), and how stale each liveness lease is.
+        # The server's deadline ships too, so clients can judge a
+        # lease "live-looking" by the SAME bound the monitor fences by
+        return {"n_hosts": state.n_hosts,
+                "hb_deadline_s": state.hb_deadline_s,
+                "hb_age": {str(h): round(now - t, 6)
+                           for h, t in state.hb.items()},
+                "info": {str(h): v for h, v in state.info.items()},
+                "lost": dict(state.lost)}
     return {"error": "unknown cmd %r" % cmd}
 
 

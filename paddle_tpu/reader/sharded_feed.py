@@ -329,11 +329,16 @@ class ShardedFeed(object):
     # -- pod map exchange --------------------------------------------------
     def exchange_state(self):
         """This host's contribution to the window status exchange: its
-        owned lanes' TENTATIVE cursors plus the drained flag. Peers
-        observe it only after the window commits."""
+        owned lanes' TENTATIVE cursors, the drained flag, and its
+        committed stream lag. Peers observe the cursors only after the
+        window commits; the lag rides along so every host can assemble
+        the SAME ``{host: lag}`` snapshot from the frozen round
+        verdicts — the agreed input ``weighted_rebalance`` needs on
+        socket pods whose local event logs diverge."""
         return {"lanes": {str(l): dict(c)
                           for l, c in self._pending.items()},
-                "drained": self.drained}
+                "drained": self.drained,
+                "lag": self.stream_lag()}
 
     def observe(self, peer_state):
         """Fold a peer's (just-committed) exchange contribution into the
@@ -483,12 +488,18 @@ class ShardedFeed(object):
         local resilience event log), least-lagged survivors first;
         without any gauges the round-robin fallback applies unchanged.
 
-        AGREEMENT CAVEAT (weighted mode): every live host must compute
-        the SAME mapping, so the lag inputs must be agreed — the shared
-        event log of the threaded simulation qualifies; separate
-        processes (SocketCoordinator pods) must pass an agreed ``lags``
-        snapshot (e.g. carried on the window status exchange) rather
-        than rely on their local, possibly divergent gauges.
+        AGREEMENT (weighted mode): every live host must compute the
+        SAME mapping, so the lag inputs must be agreed. The elastic
+        trainers satisfy this automatically: :meth:`exchange_state`
+        carries each host's ``stream_lag`` on the window status
+        exchange, and ``ElasticTrainer`` passes the map assembled from
+        the FROZEN round verdicts (``ElasticTrainer._agreed_lags``) to
+        every re-balance AND to the consensus-rewind cursor restore —
+        identical on every host, even on SocketCoordinator pods whose
+        local event logs diverge.
+        Only direct callers that skip the exchange still need to pass
+        an agreed ``lags=`` themselves (the local-gauge default is
+        safe only when the hosts share one event log).
 
         Resumes every lane from the agreed committed cursor, so the dead
         host's unconsumed ranges move wholesale to survivors — no sample
@@ -502,7 +513,8 @@ class ShardedFeed(object):
         from ..framework.resilience import record_event
         record_event("feed_rebalance",
                      capacity="%d/%d" % (len(self._live), self.n_lanes),
-                     gained=sorted(new - old), dropped=sorted(old - new))
+                     gained=sorted(new - old), dropped=sorted(old - new),
+                     weighted=bool(self.weighted_rebalance and lags))
 
     # -- observability -----------------------------------------------------
     def totals(self):
@@ -517,6 +529,17 @@ class ShardedFeed(object):
                 + self._consumed(l, self._known[l])
         return out
 
+    def stream_lag(self):
+        """Committed samples this host's streams trail the most-
+        advanced host — the ``feed_stream_lag`` gauge value, computed
+        straight from the agreed pod map (not the event log, so it is
+        available before any ``record_metrics`` boundary)."""
+        totals = self.totals()
+        if not totals:
+            return 0
+        return int(max(totals.values())
+                   - totals.get(self._host_id, 0))
+
     def record_metrics(self):
         """Emit the feed-plane gauges into the resilience event log:
         ``feed_epoch`` (slowest owned lane, on change) and ``feed_lag``
@@ -527,8 +550,5 @@ class ShardedFeed(object):
         if ep != self._last_epoch_event:
             self._last_epoch_event = ep
             record_event("feed_epoch", epoch=int(ep))
-        totals = self.totals()
-        if totals:
-            mine = totals.get(self._host_id, 0)
-            record_event("feed_lag",
-                         lag=int(max(totals.values()) - mine))
+        if self.totals():
+            record_event("feed_lag", lag=self.stream_lag())

@@ -298,6 +298,52 @@ class ServingPredictor(object):
     def get_output_names(self):
         return list(self._fetch_names)
 
+    def feed_batch_factors(self):
+        """{feed name: batch factor} — feed i's leading dim is
+        factor * request_batch (0 = static feed). This is the export's
+        recorded contract; the fleet router uses it to coalesce and
+        split requests without guessing from runtime shapes."""
+        return dict(zip(self._feed_names,
+                        self._meta["feed_batch_factor"]))
+
+    def fetch_batch_factors(self):
+        """{fetch name: batch factor} — output i's leading dim is
+        factor * request_batch (0 = static output)."""
+        return dict(zip(self._fetch_names,
+                        self._meta["fetch_batch_factor"]))
+
+    def feed_dtypes(self):
+        """{feed name: numpy dtype name} from the export's bucket
+        specs — what a JSON-transported request must be cast back to
+        before the exported computation is called."""
+        first = self._meta["buckets"][sorted(self._meta["buckets"])[0]]
+        return {f["name"]: f["dtype"] for f in first["feeds"]}
+
+    def feed_inner_shapes(self):
+        """{feed name: fixed dims}: for a batch-dynamic feed the
+        trailing dims (everything after the batch-scaled leading dim);
+        for a static feed (factor 0) the FULL shape. What lets a
+        router validate a request's whole shape at admission — a
+        malformed request must be a client error there, never a
+        replica-side failure shared with its coalesced siblings."""
+        first = self._meta["buckets"][sorted(self._meta["buckets"])[0]]
+        factors = self.feed_batch_factors()
+        out = {}
+        for f in first["feeds"]:
+            shape = list(f["shape"])
+            out[f["name"]] = shape[1:] if factors.get(f["name"]) \
+                else shape
+        return out
+
+    @property
+    def dynamic_batch(self):
+        return bool(self._meta["dynamic_batch"])
+
+    @property
+    def max_bucket(self):
+        """Largest exported batch bucket (0 for a static artifact)."""
+        return max(self._fns)
+
     def _bump(self, key):
         with self._lock:
             self._stats[key] += 1

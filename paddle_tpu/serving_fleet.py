@@ -1,0 +1,1473 @@
+"""Serving fleet — multi-replica router with continuous micro-batching
+on the coordination plane.
+
+Reference parity: the reference inference stack serves fleets of C++
+predictors behind load balancers (analysis_predictor + Anakin/TensorRT
+deployments); our port's :class:`~.serving.ServingPredictor` is one
+replica in one process. This module is the fleet story: N predictor
+replicas run as heartbeat-leased members of the PR 5
+:class:`~.framework.transport.CoordServer` plane, and a stdlib-HTTP
+router (the ``resilience.serve_metrics`` style — no dependencies)
+fronts them with continuous micro-batching.
+
+Topology (one coordination group of ``n_replicas + 1`` hosts):
+
+  host 0..N-1   :class:`ReplicaMember` — loads the StableHLO serving
+                artifact, serves ``POST /infer`` over HTTP, heartbeats
+                the CoordServer (its liveness lease), and runs the
+                lockstep *control rounds* that agree admissions.
+  host N        :class:`FleetRouter` — the front door. It is a full
+                group member too (it heartbeats, joins control rounds
+                and admits), which is what makes a single-replica
+                fleet's restart admissible: the router is always a
+                survivor that can vote the joiner back in.
+
+Data plane (router):
+
+  * **Continuous micro-batching.** In-flight requests are coalesced in
+    arrival order up to ``max_batch`` request-batch rows or until the
+    oldest request has waited ``batch_deadline_s``, whichever first;
+    the coalesced feed rides ONE ``/infer`` call (list concatenation
+    along the batch dim, split back per caller by the export's
+    recorded batch factors — never guessed from runtime shapes).
+  * **Least-loaded dispatch.** The routing table derives from the
+    CoordServer ``members`` snapshot (registered info blobs minus the
+    lost map); the live replica with the fewest router-dispatched
+    batches in flight wins, equally-loaded replicas rotating
+    round-robin so no healthy replica is ever shadowed.
+  * **Shed / degrade.** A full router queue sheds with
+    :class:`~.framework.resilience.ServerOverloadedError` (HTTP 503);
+    per-replica policies (in-flight caps, cold-bucket degradation)
+    keep working unchanged — a replica-side 503 is retried on a
+    sibling, and only when every live replica sheds does the caller
+    see 503.
+  * **Retry on a sibling.** A dispatch that dies mid-flight
+    (connection reset = SIGKILLed replica, replica 5xx) is retried on
+    the least-loaded untried sibling within the request deadline, so
+    a replica death costs zero failed requests — not even the ones in
+    flight on it.
+
+Control plane (the elastic path, verbatim from training):
+
+  * **Replica death.** The heartbeat lease fences it (CoordServer's
+    deadline monitor — nobody declares anything); the router's next
+    members poll drops it from rotation and in-flight work re-routes.
+  * **Restart.** The fresh process finds itself fenced and re-admits
+    through the full ``announce_join``/``admit``/``join`` protocol:
+    survivors observe the pending set on their next control round and
+    all admit the same joiner from the same frozen verdicts — the
+    ElasticTrainer window-boundary admission, re-hosted.
+  * **Rolling weight refresh.** ``FleetRouter.rolling_deploy(dir)``
+    drains ONE replica at a time: the replica fences itself (a
+    planned loss, the ``drain_after`` shape), reloads + warms the new
+    artifact while its HTTP server keeps answering (in-flight work
+    completes on the old weights — zero dropped traffic), then
+    rejoins through the same admission. The artifact movement is
+    accounted like the rejoin state-ship: raw vs zlib-wire bytes land
+    in ``resilience.bytes_totals()["stateship"]``.
+
+Observability (rides ``resilience.metrics()`` — see the router series
+there): ``router_requests_total{outcome=}``,
+``router_retries_total{replica=}``, ``router_batch_size`` histogram,
+``router_queue_depth`` and per-replica ``router_replica_inflight``
+gauges — all cumulative counters outside the bounded event log, since
+requests (and shed-storm retries) run at request rate. Rare
+control-plane transitions (a connection-level ``router_retry``,
+``fleet_deploy_*``, ``fleet_rejoin*``) ride the ordinary event log.
+``tools/serving_probe.py --metrics-url`` folds the ``router_*``
+series under a ``"router"`` group.
+
+Deploy via ``tools/servingsvc.py`` (one ``replica`` process per
+replica, one ``router``), against a ``tools/coordsvc.py`` service —
+``--n-hosts auto`` learns the group size from the first member, and
+``--hb-deadline-s`` MUST be armed (fleet liveness is the lease).
+"""
+import collections
+import json
+import os
+import random
+import threading
+import time
+import zlib
+
+from .framework import resilience
+from .framework.coordination import (CoordinationError, HostLostError,
+                                     SocketCoordinator, agreed_pending)
+from .framework.resilience import (DeadlineExceededError,
+                                   ServerOverloadedError, record_event)
+
+__all__ = ["FleetError", "FleetRouter", "ReplicaMember",
+           "router_host_id", "http_json"]
+
+
+class FleetError(RuntimeError):
+    """A fleet-level operation failed (deploy step, no live replica at
+    start, a member that could not be admitted)."""
+
+
+def router_host_id(n_replicas):
+    """The router's host id in the coordination group: replicas are
+    hosts ``0..N-1``, the router is host ``N`` (group size N+1)."""
+    return int(n_replicas)
+
+
+# ---------------------------------------------------------------------------
+# tiny JSON-over-HTTP wire helpers (stdlib only)
+# ---------------------------------------------------------------------------
+
+def http_json(method, url, payload=None, timeout_s=10.0):
+    """One JSON request/response round trip. Returns ``(status,
+    dict)`` — non-2xx responses are returned, not raised, so callers
+    can route on replica-side shed (503) vs deadline (504) vs error.
+    Connection-level failures (dead process, refused) raise OSError."""
+    import urllib.error
+    import urllib.request
+    data = None if payload is None else json.dumps(payload).encode()
+    req = urllib.request.Request(
+        url, data=data, method=method,
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+            body = resp.read().decode() or "{}"
+            return resp.status, json.loads(body)
+    except urllib.error.HTTPError as e:
+        body = e.read().decode() if e.fp is not None else ""
+        try:
+            parsed = json.loads(body) if body else {}
+        except ValueError:
+            parsed = {"error": body}
+        return e.code, parsed
+    except urllib.error.URLError as e:
+        # unwrap to the OSError the retry path classifies on
+        reason = getattr(e, "reason", e)
+        raise reason if isinstance(reason, OSError) \
+            else ConnectionError(str(e))
+
+
+def _start_http(handler_cls, host, port, name):
+    import http.server
+    srv = http.server.ThreadingHTTPServer((host, port), handler_cls)
+    srv.daemon_threads = True
+    t = threading.Thread(target=srv.serve_forever, daemon=True,
+                         name=name)
+    t.start()
+    return srv, t
+
+
+def _live_peers(co, self_id):
+    """Un-fenced members with a live-looking lease, excluding
+    ``self_id``. A lease older than the server's fencing deadline is
+    a leftover from a cleanly-closed member (the hb map never forgets)
+    — counting it as a survivor would make a member self-fence for a
+    peer that cannot admit it back. Empty on a coordinator error."""
+    try:
+        m = co.members()
+    except (CoordinationError, ConnectionError):
+        return []
+    deadline = m.get("hb_deadline_s")
+    return [h for h, age in m["hb_age"].items()
+            if h != self_id and h not in m["lost"]
+            and (deadline is None or age <= deadline)]
+
+
+def _artifact_wire_bytes(dirname, compress="zlib"):
+    """(raw, wire) byte sizes of the serving artifact under
+    ``dirname`` — the rolling-refresh twin of the rejoin state-ship
+    accounting. ``raw`` is the on-disk artifact; ``wire`` is what a
+    zlib transport would move (== raw when compress is None)."""
+    from .serving import MODULE_SUBDIR
+    root = os.path.join(dirname, MODULE_SUBDIR)
+    raw = wire = 0
+    for fname in sorted(os.listdir(root)):
+        path = os.path.join(root, fname)
+        if not os.path.isfile(path):
+            continue
+        size = os.path.getsize(path)
+        raw += size
+        if compress == "zlib":
+            comp = zlib.compressobj(6)
+            n = 0
+            with open(path, "rb") as f:
+                while True:
+                    chunk = f.read(1 << 20)
+                    if not chunk:
+                        break
+                    n += len(comp.compress(chunk))
+            wire += n + len(comp.flush())
+        else:
+            wire += size
+    return raw, wire
+
+
+# ---------------------------------------------------------------------------
+# shared control-plane engine (router and replicas are both members)
+# ---------------------------------------------------------------------------
+
+class _FleetMember(object):
+    """One heartbeat-leased member of the fleet's coordination group.
+
+    Owns the :class:`SocketCoordinator` (hello + liveness lease) and
+    the lockstep *control rounds*: every ``ctl_interval_s`` each live
+    member gathers ``["ok", pending_joins]`` under a shared round
+    counter, so all of them compute the same admission from the same
+    frozen verdicts — the ElasticTrainer window-boundary agreement,
+    without a training loop to ride on. A member that finds itself
+    fenced (SIGKILL restart, deploy self-fence, a heartbeat stall)
+    takes the announce/join path and adopts the survivors' round
+    counter from the admission sync value, so round names never
+    collide across incarnations."""
+
+    def __init__(self, coord_address, n_replicas, host_id,
+                 ctl_interval_s=0.1, hb_interval_s=0.25,
+                 timeout_s=30.0, join_timeout_s=30.0, poll_s=0.005):
+        if int(n_replicas) < 1:
+            raise ValueError("a fleet needs n_replicas >= 1")
+        self._coord_address = coord_address
+        self.n_replicas = int(n_replicas)
+        self._host_id = int(host_id)
+        self._ctl_interval_s = float(ctl_interval_s)
+        self._hb_interval_s = float(hb_interval_s)
+        self._timeout_s = float(timeout_s)
+        self._join_timeout_s = float(join_timeout_s)
+        self._poll_s = float(poll_s)
+        self._co = None
+        self._k = 0
+        self._stop = threading.Event()
+        self._threads = []
+
+    # -- subclass surface --------------------------------------------------
+    def _prepare(self):
+        """Bring the serving surface up BEFORE joining the group (a
+        member must never advertise what it cannot serve)."""
+
+    def _after_join(self):
+        """Start whatever needs the live coordinator (pollers)."""
+
+    def _sync_value(self):
+        """This member's contribution to an admission round:
+        ``[round_k, generation, artifact_dir]``. The joiner adopts the
+        lexicographic max, so the router (no artifact) contributes
+        generation -1 and defers to any replica's value."""
+        return [self._k, -1, ""]
+
+    def _adopt_sync(self, sync):
+        self._k = int(sync[0])
+
+    def _publish_info(self):
+        """Publish this member's registry blob (``put_info``)."""
+
+    # -- lifecycle ---------------------------------------------------------
+    def _preflight_supersede(self):
+        """A QUICK restart — before the previous incarnation's lease
+        was fenced — must not start control rounds at counter 0 while
+        the survivors sit at N: the desynced round names would wedge
+        both sides' gathers. If the server holds a live-looking lease
+        for this host id, fence it (supersede the dead incarnation)
+        so this start takes the ordinary rejoin path and ADOPTS the
+        survivors' counter from the admission sync."""
+        from .framework.transport import CoordClient
+        try:
+            client = CoordClient(self._coord_address,
+                                 host_id=self._host_id)
+            try:
+                resp = client.call("members")
+                has_lease = str(self._host_id) in resp.get("hb_age", {})
+                fenced = str(self._host_id) in resp.get("lost", {})
+                if has_lease and not fenced:
+                    client.call("mark_lost",
+                                reason="superseded: new incarnation "
+                                "of member %d" % self._host_id)
+                    record_event("fleet_supersede",
+                                 member=self._host_id)
+            finally:
+                client.close()
+        except (RuntimeError, OSError):
+            # auto-size server before its first hello, or coordinator
+            # unreachable: nothing to supersede — first-boot path
+            pass
+
+    def start(self):
+        self._prepare()
+        try:
+            self._preflight_supersede()
+            # detect_loss=False: fleet liveness is EXCLUSIVELY the
+            # heartbeat lease (the server monitor). Client-driven
+            # fencing at gather deadlines is a training-plane fallback
+            # that, on a desynced or wedged member, would mark_lost
+            # every healthy peer — a timeout here surfaces as
+            # BarrierTimeoutError and the tick simply retries.
+            self._co = SocketCoordinator(
+                self._coord_address, self.n_replicas + 1,
+                self._host_id, timeout_s=self._timeout_s,
+                poll_s=self._poll_s, mesh_reinit=False,
+                detect_loss=False, hb_interval_s=self._hb_interval_s)
+            if self._host_id in self._co.lost_hosts():
+                # a restarted incarnation: fenced by the previous
+                # one's stale lease (or the preflight supersede) —
+                # re-admit through the full protocol before taking
+                # any traffic-facing role
+                if not self._rejoin() and not self._solo_recover():
+                    raise FleetError(
+                        "member %d is fenced and was not admitted "
+                        "within %.1fs — are the survivors (or the "
+                        "router) up?"
+                        % (self._host_id, self._join_timeout_s))
+            self._publish_info()
+            self._after_join()
+            t = threading.Thread(target=self._control_loop,
+                                 daemon=True,
+                                 name="paddle_tpu-fleet-ctl-%d"
+                                 % self._host_id)
+            t.start()
+            self._threads.append(t)
+        except BaseException:
+            # full teardown on ANY start failure (coordinator
+            # unreachable, pod-size mismatch, not admitted):
+            # _prepare() already bound the HTTP listener and threads,
+            # and a supervisor retry loop must not accumulate one
+            # live listener per failed attempt
+            self.close()
+            raise
+        return self
+
+    def _solo_recover(self):
+        """Last member standing: a fenced member with NO other
+        live-looking member has nobody to admit it — with nothing
+        live there is no split brain to protect against either, so it
+        un-fences itself and restarts the control plane fresh."""
+        try:
+            if _live_peers(self._co, self._host_id):
+                return False
+            self._co.unfence(self._host_id)
+            record_event("fleet_solo_recover", member=self._host_id)
+            return True
+        except (CoordinationError, ConnectionError):
+            return False
+
+    def close(self):
+        self._stop.set()
+        # the client goes first: a control thread blocked in a gather
+        # sees the closed transport raise and exits on the stop flag
+        # instead of riding out a full round timeout
+        if self._co is not None:
+            self._co.close()
+        for t in self._threads:
+            t.join(timeout=5.0)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- the control rounds ------------------------------------------------
+    def _control_loop(self):
+        while not self._stop.is_set():
+            try:
+                self._ctl_tick()
+            except Exception as e:   # noqa: BLE001 - the loop IS the
+                # member's control plane: an unexpected error must cost
+                # one tick, never the thread (a replica with no control
+                # loop can never rejoin and wedges future admissions)
+                record_event("fleet_ctl_error", member=self._host_id,
+                             error=type(e).__name__)
+            self._stop.wait(self._ctl_interval_s)
+
+    def _ctl_tick(self):
+        """One lockstep control round. Always returns True (the loop
+        runs until close): a FENCED member attempts a rejoin and, when
+        not admitted this attempt (a coordinator blip, survivors
+        mid-recovery, a router restart), simply RETRIES next tick —
+        a transient fence must never strand a serving member out of
+        rotation for the life of the process."""
+        co = self._co
+        try:
+            pending = sorted([int(h), int(n)] for h, n
+                             in co.pending_joins().items())
+        except (CoordinationError, ConnectionError):
+            return True     # coordinator unreachable: serve on, retry
+        self._k += 1
+        try:
+            verdicts = co.all_gather("ctl%d" % self._k, self._host_id,
+                                     ["ok", pending])
+        except HostLostError:
+            record_event("fleet_fenced", member=self._host_id)
+            if not self._rejoin():
+                # nobody admitted us this attempt; if nobody live is
+                # LEFT to admit (a 1-replica fleet whose router died),
+                # recover solo — otherwise the next tick retries
+                self._solo_recover()
+            return True
+        # admission from the frozen verdicts: every member admits the
+        # first pending pair EVERY participant observed — identical
+        # on all of them, so the join barrier always completes (the
+        # invariant is shared with ElasticTrainer's window admission)
+        agreed = agreed_pending(verdicts)
+        if agreed is not None:
+            try:
+                sync = co.admit(self._host_id, agreed[0], agreed[1],
+                                self._sync_value(), name="fjoin",
+                                timeout_s=self._join_timeout_s)
+                if sync is not None:
+                    record_event("fleet_admit", member=self._host_id,
+                                 joined=agreed[0])
+            except HostLostError:
+                record_event("fleet_fenced", member=self._host_id)
+                if not self._rejoin():
+                    self._solo_recover()
+            except (CoordinationError, ConnectionError):
+                return True
+        return True
+
+    def _rejoin(self):
+        """Fenced-member tail: announce, wait for the survivors'
+        admission, adopt their round counter (and, for replicas, the
+        fleet's current artifact). Returns False when not admitted —
+        the member stays out and the orchestrator escalates."""
+        co = self._co
+        nonce = random.getrandbits(31)
+        try:
+            co.announce_join(self._host_id, nonce)
+            record_event("fleet_rejoin_announce", member=self._host_id,
+                         nonce=nonce)
+            sync = co.join(self._host_id, nonce, name="fjoin",
+                           timeout_s=self._join_timeout_s)
+        except (CoordinationError, ConnectionError) as e:
+            record_event("fleet_rejoin_failed", member=self._host_id,
+                         error=type(e).__name__)
+            return False
+        self._adopt_sync(sync)
+        self._publish_info()
+        record_event("fleet_rejoin", member=self._host_id)
+        return True
+
+
+# ---------------------------------------------------------------------------
+# replica
+# ---------------------------------------------------------------------------
+
+class ReplicaMember(_FleetMember):
+    """One serving replica: a :class:`~.serving.ServingPredictor`
+    behind a stdlib HTTP endpoint, registered as a heartbeat-leased
+    member of the fleet's coordination group.
+
+    Endpoints:
+      ``POST /infer``           {"feeds": {name: rows}, "deadline_s"?}
+                                -> {"outputs", "dtypes", "replica",
+                                "generation"}; 503 on the predictor's
+                                in-flight shed, 504 on its deadline
+      ``GET /healthz``          ServingPredictor.health() + identity
+      ``GET /meta``             the export contract the router batches
+                                by (feed names/factors/dtypes, buckets)
+      ``POST /admin/refresh``   {"dir": artifact_dir} — queue the
+                                rolling-deploy weight refresh (the
+                                control thread executes it: self-fence,
+                                reload + warm, rejoin)
+
+    The per-replica policies are the predictor's own (``max_in_flight``
+    load shed, ``deadline_s``, warm-bucket degradation) — the router
+    composes with them, never replaces them."""
+
+    def __init__(self, artifact_dir, coord_address, n_replicas,
+                 replica_id, port=0, host="127.0.0.1", warmup=True,
+                 max_in_flight=None, deadline_s=None,
+                 ship_compress="zlib", ctl_interval_s=0.1,
+                 hb_interval_s=0.25, timeout_s=30.0,
+                 join_timeout_s=30.0):
+        if not 0 <= int(replica_id) < int(n_replicas):
+            raise ValueError("replica_id %r out of range for %d "
+                             "replicas" % (replica_id, n_replicas))
+        super(ReplicaMember, self).__init__(
+            coord_address, n_replicas, int(replica_id),
+            ctl_interval_s=ctl_interval_s, hb_interval_s=hb_interval_s,
+            timeout_s=timeout_s, join_timeout_s=join_timeout_s)
+        if ship_compress not in (None, "zlib"):
+            raise ValueError("ship_compress must be None or 'zlib', "
+                             "got %r" % (ship_compress,))
+        self.replica_id = int(replica_id)
+        self._artifact_dir = str(artifact_dir)
+        self._http_host = host
+        self._http_port = int(port)
+        self._warmup = bool(warmup)
+        self._max_in_flight = max_in_flight
+        self._deadline_s = deadline_s
+        self._ship_compress = ship_compress
+        self._pred = None
+        self._pred_lock = threading.Lock()
+        self._generation = 0
+        self._refresh_req = None
+        self._refresh_lock = threading.Lock()
+        self._server = None
+        self.address = None
+
+    # -- serving surface ---------------------------------------------------
+    def _prepare(self):
+        self._load_predictor(self._artifact_dir, account=False)
+        member = self
+        import http.server
+
+        class _Handler(http.server.BaseHTTPRequestHandler):
+            def _send(self, status, payload):
+                body = json.dumps(payload).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):   # noqa: N802 - stdlib naming
+                length = int(self.headers.get("Content-Length", 0))
+                try:
+                    body = json.loads(self.rfile.read(length) or b"{}")
+                except ValueError:
+                    self._send(400, {"error": "malformed JSON body"})
+                    return
+                path = self.path.split("?", 1)[0]
+                if path == "/infer":
+                    status, payload = member._handle_infer(body)
+                    self._send(status, payload)
+                elif path == "/admin/refresh":
+                    new_dir = body.get("dir")
+                    if not new_dir:
+                        self._send(400, {"error": "refresh needs "
+                                         '{"dir": artifact_dir}'})
+                        return
+                    if member.request_refresh(new_dir):
+                        self._send(200, {"ok": True, "queued": new_dir})
+                    else:
+                        self._send(409, {"error": "a refresh is "
+                                         "already queued"})
+                else:
+                    self._send(404, {"error": "try /infer"})
+
+            def do_GET(self):    # noqa: N802 - stdlib naming
+                path = self.path.split("?", 1)[0]
+                if path == "/healthz":
+                    self._send(200, member.health())
+                elif path == "/meta":
+                    self._send(200, member.meta())
+                else:
+                    self._send(404, {"error": "try /healthz or /meta"})
+
+            def log_message(self, *args):   # requests are not log lines
+                pass
+
+        self._server, t = _start_http(
+            _Handler, self._http_host, self._http_port,
+            "paddle_tpu-replica-%d" % self.replica_id)
+        self._threads.append(t)
+        self.address = "%s:%d" % self._server.server_address[:2]
+
+    def _load_predictor(self, dirname, account=True, gen=None):
+        """Load + warm a predictor from ``dirname`` and swap it in.
+        ``account=True`` (every refresh after the first) records the
+        artifact movement as state-ship bytes, the rolling-deploy twin
+        of the elastic rejoin ship. ``gen`` pins the generation (a
+        rejoiner adopting the fleet's current artifact takes the
+        fleet's generation, not its own +1)."""
+        from .serving import ServingPredictor
+        pred = ServingPredictor(dirname,
+                                max_in_flight=self._max_in_flight,
+                                deadline_s=self._deadline_s)
+        if self._warmup:
+            pred.warmup()
+        if account:
+            try:
+                raw, wire = _artifact_wire_bytes(dirname,
+                                                 self._ship_compress)
+                resilience.record_bytes("stateship", raw, wire)
+            except OSError:   # accounting must never fail a deploy
+                pass
+        with self._pred_lock:
+            self._pred = pred
+            self._artifact_dir = str(dirname)
+            self._generation = self._generation + 1 if gen is None \
+                else int(gen)
+
+    def _predictor(self):
+        with self._pred_lock:
+            return self._pred
+
+    @property
+    def generation(self):
+        with self._pred_lock:
+            return self._generation
+
+    def health(self):
+        pred = self._predictor()
+        snap = pred.health()
+        snap.update({"replica": self.replica_id,
+                     "generation": self.generation,
+                     "artifact_dir": self._artifact_dir})
+        return snap
+
+    def meta(self):
+        pred = self._predictor()
+        return {"feed_names": pred.get_input_names(),
+                "fetch_names": pred.get_output_names(),
+                "feed_batch_factors": pred.feed_batch_factors(),
+                "fetch_batch_factors": pred.fetch_batch_factors(),
+                "feed_dtypes": pred.feed_dtypes(),
+                "feed_inner_shapes": pred.feed_inner_shapes(),
+                "dynamic_batch": pred.dynamic_batch,
+                "max_bucket": pred.max_bucket}
+
+    def _handle_infer(self, body):
+        import numpy as np
+        pred = self._predictor()
+        feeds_json = body.get("feeds")
+        if not isinstance(feeds_json, dict):
+            return 400, {"error": 'infer needs {"feeds": {name: rows}}'}
+        deadline_s = body.get("deadline_s")
+        if deadline_s is not None:
+            try:
+                deadline_s = float(deadline_s)
+            except (TypeError, ValueError):
+                return 400, {"error": "deadline_s must be a number, "
+                             "got %r" % (deadline_s,)}
+        dtypes = pred.feed_dtypes()
+        try:
+            feeds = {n: np.asarray(v, dtype=np.dtype(dtypes[n]))
+                     for n, v in feeds_json.items() if n in dtypes}
+            outs = pred.run(feeds, deadline_s=deadline_s)
+        except ServerOverloadedError as e:
+            return 503, {"error": str(e), "kind": "overloaded"}
+        except DeadlineExceededError as e:
+            return 504, {"error": str(e), "kind": "deadline"}
+        except Exception as e:
+            return 500, {"error": "%s: %s" % (type(e).__name__, e),
+                         "kind": "error"}
+        outs = [np.asarray(o) for o in outs]
+        return 200, {"outputs": [o.tolist() for o in outs],
+                     "dtypes": [str(o.dtype) for o in outs],
+                     "replica": self.replica_id,
+                     "generation": self.generation}
+
+    # -- control plane -----------------------------------------------------
+    def _publish_info(self, ready=True):
+        try:
+            self._co.put_info({"kind": "replica", "addr": self.address,
+                               "gen": self.generation,
+                               "dir": self._artifact_dir,
+                               "ready": bool(ready)})
+        except (CoordinationError, ConnectionError):
+            pass   # the next publish (rejoin/deploy) retries
+
+    def _sync_value(self):
+        return [self._k, self.generation, self._artifact_dir]
+
+    def _adopt_sync(self, sync):
+        self._k = int(sync[0])
+        sync_gen = int(sync[1]) if len(sync) > 1 else -1
+        sync_dir = sync[2] if len(sync) > 2 else ""
+        # The admission sync orders by round counter FIRST, so it can
+        # carry a router-only survivor's [k, -1, ""] (1-replica fleet)
+        # or a counter-leading member's lagging artifact view. The
+        # member registry holds every replica's last published
+        # (gen, dir) — including THIS id's previous incarnation — so
+        # the fleet's true current artifact is the max over both.
+        try:
+            m = self._co.members()
+            for info in m["info"].values():
+                if isinstance(info, dict) \
+                        and info.get("kind") == "replica" \
+                        and info.get("dir") \
+                        and int(info.get("gen") or -1) > sync_gen:
+                    sync_gen = int(info["gen"])
+                    sync_dir = info["dir"]
+        except (CoordinationError, ConnectionError):
+            pass
+        # adopt the fleet's artifact only when it is genuinely NEWER
+        # (a higher fleet generation): a deploy-refreshed replica
+        # rejoining must not be flipped BACK to the survivors' not-yet-
+        # refreshed artifact by its own admission sync
+        if sync_dir and sync_gen > self.generation \
+                and sync_dir != self._artifact_dir \
+                and os.path.isdir(sync_dir):
+            try:
+                self._load_predictor(sync_dir, gen=sync_gen)
+                record_event("fleet_adopt", member=self._host_id,
+                             generation=sync_gen)
+            except Exception as e:
+                record_event("fleet_adopt_failed", member=self._host_id,
+                             error=type(e).__name__)
+
+    def request_refresh(self, artifact_dir):
+        """Queue a rolling-deploy weight refresh; the control thread
+        executes it at its next tick (fence -> reload + warm -> rejoin
+        — the HTTP server answers throughout, so in-flight and
+        concurrent requests ride the old weights, never the floor).
+        Returns False (HTTP 409) while another refresh is already
+        queued — a racing second deploy must not silently overwrite
+        the first (the test-and-set is locked against both a
+        concurrent second request and the control thread's claim)."""
+        with self._refresh_lock:
+            if self._refresh_req is not None:
+                return False
+            self._refresh_req = str(artifact_dir)
+        return True
+
+    def _ctl_tick(self):
+        with self._refresh_lock:
+            req, self._refresh_req = self._refresh_req, None
+        if req is not None:
+            self._do_refresh(req)
+            return True
+        return super(ReplicaMember, self)._ctl_tick()
+
+    def _other_live_members(self):
+        """Un-fenced members with a LIVE-LOOKING lease besides this
+        one — when empty (a one-replica fleet with no router, or the
+        router cleanly shut down), the fence/rejoin dance has no
+        survivor to admit us back, so a refresh swaps in place. A
+        lease older than the server's own fencing deadline does not
+        count: a cleanly-closed member's entry lingers in the hb map,
+        and self-fencing on the strength of a peer that cannot admit
+        would strand this replica."""
+        return _live_peers(self._co, self._host_id)
+
+    def _do_refresh(self, new_dir):
+        record_event("fleet_deploy_begin", member=self._host_id,
+                     dir=new_dir)
+        survivors = self._other_live_members()
+        if survivors:
+            # a PLANNED loss (the drain shape): the router stops
+            # routing here the moment its members poll sees the
+            # tombstone; accepted work still completes
+            try:
+                self._co.mark_lost(self._host_id,
+                                   "deploy: rolling weight refresh")
+            except (CoordinationError, ConnectionError) as e:
+                # coordinator unreachable: the admission protocol
+                # cannot complete either — abort this refresh on the
+                # OLD weights (the deploy driver times out and
+                # reports) instead of fencing into a dead end
+                record_event("fleet_deploy_failed",
+                             member=self._host_id,
+                             error=type(e).__name__)
+                return
+        try:
+            self._load_predictor(new_dir)
+        except Exception as e:
+            record_event("fleet_deploy_failed", member=self._host_id,
+                         error=type(e).__name__)
+            # return to rotation on the OLD weights — a broken artifact
+            # must degrade the deploy, not the fleet
+            if survivors:
+                self._rejoin()
+            else:
+                self._publish_info()
+            return
+        if survivors:
+            if not self._rejoin():
+                record_event("fleet_deploy_stranded",
+                             member=self._host_id)
+                return
+        else:
+            self._publish_info()
+        record_event("fleet_deploy_done", member=self._host_id,
+                     generation=self.generation)
+
+    def close(self):
+        if self._co is not None:
+            self._publish_info(ready=False)
+        # HTTP first: its serve_forever thread sits in _threads, and
+        # the base close joins them — a still-serving listener would
+        # ride out the whole join timeout
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+        super(ReplicaMember, self).close()
+
+
+# ---------------------------------------------------------------------------
+# router
+# ---------------------------------------------------------------------------
+
+class _Pending(object):
+    __slots__ = ("feeds", "n", "deadline", "enqueued", "event",
+                 "result", "error", "abandoned")
+
+    def __init__(self, feeds, n, deadline):
+        self.feeds = feeds
+        self.n = n
+        self.deadline = deadline
+        self.enqueued = time.monotonic()
+        self.event = threading.Event()
+        self.result = None
+        self.error = None
+        self.abandoned = False
+
+
+class FleetRouter(_FleetMember):
+    """The fleet's front door: continuous micro-batching over the live
+    replica set.
+
+    Endpoints:
+      ``POST /infer``          same body as a replica's; coalesced,
+                               dispatched, split back — the caller
+                               cannot tell the fleet from one replica
+      ``GET /healthz``         routing table + queue depth
+      ``GET /metrics``         the live resilience exposition (router
+                               series included)
+      ``POST /admin/deploy``   {"dir": artifact_dir} — rolling weight
+                               refresh across every live replica, one
+                               at a time (synchronous; zero dropped
+                               traffic)
+
+    The router is itself a group member (host ``n_replicas``): it
+    heartbeats, votes in control rounds and admits rejoining replicas
+    — so even a 1-replica fleet has a survivor to re-admit a
+    restarted replica, and a restarted ROUTER re-admits itself the
+    same way (serving continues meanwhile: routing needs only the
+    members snapshot, not membership)."""
+
+    def __init__(self, coord_address, n_replicas, port=0,
+                 host="127.0.0.1", max_batch=8, batch_deadline_s=0.005,
+                 max_queue=128, request_deadline_s=10.0,
+                 poll_interval_s=0.05, ctl_interval_s=0.1,
+                 hb_interval_s=0.25, timeout_s=30.0,
+                 join_timeout_s=30.0):
+        super(FleetRouter, self).__init__(
+            coord_address, n_replicas, router_host_id(n_replicas),
+            ctl_interval_s=ctl_interval_s, hb_interval_s=hb_interval_s,
+            timeout_s=timeout_s, join_timeout_s=join_timeout_s)
+        if int(max_batch) < 1:
+            raise ValueError("max_batch must be >= 1")
+        self._http_host = host
+        self._http_port = int(port)
+        self.max_batch = int(max_batch)
+        self.batch_deadline_s = float(batch_deadline_s)
+        self.max_queue = int(max_queue)
+        self.request_deadline_s = float(request_deadline_s)
+        self._poll_interval_s = float(poll_interval_s)
+        self._queue = collections.deque()
+        self._qcond = threading.Condition()
+        self._members_lock = threading.Lock()
+        self._members = {}
+        self._members_sig = None
+        self._inflight = {}
+        self._pick_seq = 0
+        self._meta = None
+        self._meta_lock = threading.Lock()
+        self._deploy_lock = threading.Lock()
+        self._server = None
+        self.address = None
+        self.url = None
+
+    # -- lifecycle ---------------------------------------------------------
+    def _prepare(self):
+        router = self
+        import http.server
+
+        class _Handler(http.server.BaseHTTPRequestHandler):
+            def _send(self, status, payload, raw=None):
+                body = raw if raw is not None \
+                    else json.dumps(payload).encode()
+                self.send_response(status)
+                ctype = "application/json" if raw is None else \
+                    "text/plain; version=0.0.4; charset=utf-8"
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):   # noqa: N802 - stdlib naming
+                length = int(self.headers.get("Content-Length", 0))
+                try:
+                    body = json.loads(self.rfile.read(length) or b"{}")
+                except ValueError:
+                    self._send(400, {"error": "malformed JSON body"})
+                    return
+                path = self.path.split("?", 1)[0]
+                if path == "/infer":
+                    self._send(*router._handle_infer(body))
+                elif path == "/admin/deploy":
+                    new_dir = body.get("dir")
+                    if not new_dir:
+                        self._send(400, {"error": "deploy needs "
+                                         '{"dir": artifact_dir}'})
+                        return
+                    try:
+                        timeout = float(
+                            body.get("per_replica_timeout_s", 60.0))
+                    except (TypeError, ValueError):
+                        self._send(400, {"error":
+                                         "per_replica_timeout_s must "
+                                         "be a number"})
+                        return
+                    try:
+                        summary = router.rolling_deploy(
+                            new_dir, per_replica_timeout_s=timeout)
+                        self._send(200, summary)
+                    except FleetError as e:
+                        self._send(500, {"error": str(e)})
+                else:
+                    self._send(404, {"error": "try /infer"})
+
+            def do_GET(self):    # noqa: N802 - stdlib naming
+                path = self.path.split("?", 1)[0]
+                if path == "/metrics":
+                    text = resilience.metrics_text(
+                        resilience.metrics(by_host=True))
+                    self._send(200, None, raw=text.encode())
+                elif path == "/healthz":
+                    self._send(200, router.health())
+                else:
+                    self._send(404, {"error": "try /infer, /healthz "
+                                     "or /metrics"})
+
+            def log_message(self, *args):
+                pass
+
+        self._server, t = _start_http(
+            _Handler, self._http_host, self._http_port,
+            "paddle_tpu-fleet-router")
+        self._threads.append(t)
+        self.address = "%s:%d" % self._server.server_address[:2]
+        self.url = "http://%s" % self.address
+        bt = threading.Thread(target=self._batch_loop, daemon=True,
+                              name="paddle_tpu-fleet-batcher")
+        bt.start()
+        self._threads.append(bt)
+
+    def _after_join(self):
+        pt = threading.Thread(target=self._members_loop, daemon=True,
+                              name="paddle_tpu-fleet-members")
+        pt.start()
+        self._threads.append(pt)
+        self._refresh_members()
+
+    def _publish_info(self):
+        try:
+            self._co.put_info({"kind": "router", "addr": self.address,
+                               "ready": False})
+        except (CoordinationError, ConnectionError):
+            pass
+
+    def close(self):
+        self._stop.set()
+        with self._qcond:
+            # requests still waiting to be coalesced will never be
+            # dispatched: fail them NOW instead of letting each
+            # caller block out its full request deadline
+            stranded = list(self._queue)
+            self._queue.clear()
+            self._qcond.notify_all()
+        self._fail(stranded, ServerOverloadedError(
+            "router is closing — retry against its replacement"))
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+        super(FleetRouter, self).close()
+
+    # -- membership --------------------------------------------------------
+    def _members_loop(self):
+        while not self._stop.wait(self._poll_interval_s):
+            self._refresh_members()
+
+    def _refresh_members(self):
+        try:
+            m = self._co.members()
+        except (CoordinationError, ConnectionError):
+            return   # keep the last known table; the poll retries
+        table = {}
+        for h, info in m["info"].items():
+            if not isinstance(info, dict) \
+                    or info.get("kind") != "replica" \
+                    or not info.get("ready") or not info.get("addr") \
+                    or h in m["lost"]:
+                continue
+            table[h] = {"addr": info["addr"],
+                        "gen": info.get("gen"),
+                        "dir": info.get("dir"),
+                        "hb_age": m["hb_age"].get(h, 0.0)}
+        # any artifact change in the table (a deploy step landing, a
+        # direct per-replica /admin/refresh) invalidates the cached
+        # export contract — batches must never be merged/split by a
+        # stale factor map while replicas already serve a new artifact
+        sig = tuple(sorted((h, v["gen"], v["dir"])
+                           for h, v in table.items()))
+        with self._members_lock:
+            self._members = table
+        if sig != self._members_sig:
+            self._members_sig = sig
+            with self._meta_lock:
+                self._meta = None
+
+    def routable(self):
+        """{replica_id: {"addr", "gen", "dir", "hb_age"}} of every
+        replica the router would currently dispatch to."""
+        with self._members_lock:
+            return {h: dict(v) for h, v in self._members.items()}
+
+    def health(self):
+        with self._qcond:
+            depth = len(self._queue)
+        with self._members_lock:
+            inflight = dict(self._inflight)
+        return {"live": True, "replicas": self.routable(),
+                "queue_depth": depth, "inflight": inflight,
+                "n_replicas": self.n_replicas,
+                "max_batch": self.max_batch,
+                "batch_deadline_s": self.batch_deadline_s}
+
+    def _pick_replica(self, tried):
+        """Least-loaded live replica not yet tried for this batch:
+        fewest router-dispatched batches in flight; equally-loaded
+        replicas rotate round-robin. (NOT heartbeat freshness: the
+        lease cadences of healthy replicas phase-lock against the
+        members poll, and a fixed freshness tie-break then shadows
+        one replica completely — it never takes traffic and its
+        buckets go cold.)"""
+        with self._members_lock:
+            cands = sorted((self._inflight.get(h, 0), h, v["addr"])
+                           for h, v in self._members.items()
+                           if h not in tried)
+            if not cands:
+                return None
+            least = [c for c in cands if c[0] == cands[0][0]]
+            self._pick_seq += 1
+            _, h, addr = least[self._pick_seq % len(least)]
+        return h, addr
+
+    def _inc_inflight(self, rid, d):
+        with self._members_lock:
+            n = self._inflight.get(rid, 0) + d
+            self._inflight[rid] = max(0, n)
+            val = self._inflight[rid]
+        resilience.set_router_inflight(rid, val)
+
+    # -- the export contract (what batching splits by) ---------------------
+    def _get_meta(self):
+        with self._meta_lock:
+            if self._meta is not None:
+                return self._meta
+        for rid, ent in sorted(self.routable().items()):
+            try:
+                status, resp = http_json(
+                    "GET", "http://%s/meta" % ent["addr"],
+                    timeout_s=5.0)
+            except (OSError, ValueError):
+                continue
+            if status == 200 and "feed_names" in resp:
+                with self._meta_lock:
+                    self._meta = resp
+                return resp
+        return None
+
+    def _request_rows(self, feeds, meta):
+        """The request batch implied by its dynamic feeds' row counts
+        — the export's recorded factors, exactly the ServingPredictor
+        bucket math (dim0 = factor * batch) — plus a DEEP shape check
+        against the export's fixed dims. Validation lives here, at
+        admission: a malformed request (ragged rows, wrong width, a
+        missing feed) coalesced into a micro-batch would otherwise
+        fail on the replica and take every innocent sibling in the
+        batch down with it."""
+        n = None
+        if meta["dynamic_batch"]:
+            for name, f in meta["feed_batch_factors"].items():
+                if not f:
+                    continue
+                if name not in feeds:
+                    raise ValueError("request is missing feed %r"
+                                     % name)
+                rows = len(feeds[name])
+                if rows % f:
+                    raise ValueError(
+                        "feed %r has %d rows, not a multiple of its "
+                        "batch factor %d" % (name, rows, f))
+                got = rows // f
+                if n is None:
+                    n = got
+                elif got != n:
+                    raise ValueError(
+                        "batch-dynamic feeds disagree on the batch: "
+                        "feed %r implies %d, earlier feeds %d"
+                        % (name, got, n))
+        n = 1 if n is None else n
+        inner = meta.get("feed_inner_shapes")
+        if inner:
+            import numpy as np
+            factors = meta["feed_batch_factors"]
+            for name in meta["feed_names"]:
+                if name not in feeds:
+                    raise ValueError("request is missing feed %r"
+                                     % name)
+                f = factors.get(name, 0)
+                want = ([n * f] + list(inner[name])) if f \
+                    else list(inner[name])
+                try:
+                    arr = np.asarray(feeds[name])
+                except Exception:   # ragged nesting raises in numpy
+                    raise ValueError(
+                        "feed %r is ragged/malformed, expected shape "
+                        "%s" % (name, want))
+                if arr.dtype == object or list(arr.shape) != want:
+                    raise ValueError(
+                        "feed %r has shape %s, expected %s"
+                        % (name, list(arr.shape), want))
+        return n
+
+    # -- request intake ----------------------------------------------------
+    def submit(self, feeds, deadline_s=None):
+        """Route one request (dict name -> rows as nested lists).
+        Returns ``{"outputs", "dtypes", "replica", "generation"}``.
+        Raises ServerOverloadedError (queue full / every replica
+        shedding), DeadlineExceededError, ValueError (malformed
+        request) or RuntimeError (upstream failure after retries)."""
+        deadline = time.monotonic() + (
+            self.request_deadline_s if deadline_s is None
+            else float(deadline_s))
+        meta = self._get_meta()
+        if meta is None:
+            resilience.record_router_request("error")
+            raise FleetError("no live replica to learn the export "
+                             "contract from — is the fleet up?")
+        try:
+            n = self._request_rows(feeds, meta)
+            if meta["dynamic_batch"] and meta.get("max_bucket") \
+                    and n > int(meta["max_bucket"]):
+                # reject at ADMISSION: dispatched, this request would
+                # 500 deterministically on every replica — burning a
+                # retry per sibling to turn a client error into a 502
+                raise ValueError(
+                    "request batch %d exceeds the largest exported "
+                    "bucket %d — re-export with a larger batch_sizes "
+                    "entry" % (n, int(meta["max_bucket"])))
+        except ValueError:
+            resilience.record_router_request("error")
+            raise
+        p = _Pending(feeds, n, deadline)
+        with self._qcond:
+            if len(self._queue) >= self.max_queue:
+                resilience.record_router_request("shed")
+                raise ServerOverloadedError(
+                    "router queue is full (%d waiting) — shedding "
+                    "load; retry with backoff" % self.max_queue)
+            self._queue.append(p)
+            resilience.set_router_queue_depth(len(self._queue))
+            self._qcond.notify_all()
+        if not p.event.wait(max(0.0, deadline - time.monotonic())
+                            + 0.05):
+            p.abandoned = True
+            resilience.record_router_request("deadline")
+            raise DeadlineExceededError(
+                "request did not complete within its deadline")
+        if p.error is not None:
+            resilience.record_router_request(
+                "shed" if isinstance(p.error, ServerOverloadedError)
+                else "deadline"
+                if isinstance(p.error, DeadlineExceededError)
+                else "error")
+            raise p.error
+        resilience.record_router_request("ok")
+        return p.result
+
+    def _handle_infer(self, body):
+        feeds = body.get("feeds")
+        if not isinstance(feeds, dict):
+            return 400, {"error": 'infer needs {"feeds": {name: rows}}'}
+        deadline_s = body.get("deadline_s")
+        if deadline_s is not None:
+            try:
+                deadline_s = float(deadline_s)
+            except (TypeError, ValueError):
+                return 400, {"error": "deadline_s must be a number, "
+                             "got %r" % (deadline_s,)}
+        try:
+            return 200, self.submit(feeds, deadline_s=deadline_s)
+        except ServerOverloadedError as e:
+            return 503, {"error": str(e), "kind": "overloaded"}
+        except DeadlineExceededError as e:
+            return 504, {"error": str(e), "kind": "deadline"}
+        except ValueError as e:
+            return 400, {"error": str(e)}
+        except (FleetError, RuntimeError, OSError) as e:
+            # OSError covers the ConnectionError a batch fails with
+            # when EVERY live replica was unreachable — the caller
+            # must see a status code, never an aborted connection
+            return 502, {"error": str(e), "kind": "upstream"}
+
+    # -- continuous micro-batching -----------------------------------------
+    def _batch_loop(self):
+        while not self._stop.is_set():
+            batch = self._cut_batch()
+            if batch:
+                resilience.observe_router_batch(len(batch))
+                t = threading.Thread(target=self._dispatch,
+                                     args=(batch,), daemon=True,
+                                     name="paddle_tpu-fleet-dispatch")
+                t.start()
+
+    def _cut_batch(self):
+        """Block until a batch is due, then cut it: requests coalesce
+        in arrival order while their summed request-batch stays within
+        ``max_batch``; the cut happens the moment the cap is reached
+        or the OLDEST waiting request has aged ``batch_deadline_s``.
+        Expired/abandoned requests are dropped here (their callers
+        already took the deadline path)."""
+        while not self._stop.is_set():
+            # meta resolution happens OUTSIDE _qcond: a cold cache is
+            # an HTTP GET /meta (5s timeout per replica), and holding
+            # the condition through it would stall every submit(),
+            # shed and health() exactly when the fleet is degraded
+            meta = self._get_meta()
+            if meta is None:
+                self._stop.wait(0.05)
+                continue
+            coalescing = bool(meta["dynamic_batch"])
+            # the coalescing cap must respect the EXPORT: a merged
+            # batch larger than the biggest exported bucket would be
+            # a deterministic ValueError on every replica — a
+            # fleet-wide failure that only appears under load
+            cap = self.max_batch
+            if coalescing and meta.get("max_bucket"):
+                cap = min(cap, int(meta["max_bucket"]))
+            # static (factor-0) feeds are shipped ONCE per merged
+            # batch, so requests may only share a batch when their
+            # static tensors are EQUAL — silently computing B's
+            # outputs from A's static feed would be wrong data, not
+            # even an error
+            static_names = [nm for nm, f
+                            in meta["feed_batch_factors"].items()
+                            if not f]
+            with self._qcond:
+                now = time.monotonic()
+                while self._queue and (self._queue[0].abandoned
+                                       or now > self._queue[0].deadline):
+                    self._queue.popleft()
+                if not self._queue:
+                    resilience.set_router_queue_depth(0)
+                    self._qcond.wait(0.05)
+                    continue
+                first = self._queue[0]
+                rows = 0
+                for p in self._queue:
+                    if p.abandoned or now > p.deadline:
+                        continue
+                    rows += p.n
+                cut_at = first.enqueued + self.batch_deadline_s
+                if coalescing and rows < cap and now < cut_at:
+                    self._qcond.wait(min(cut_at - now, 0.05))
+                    continue
+                batch, rows = [], 0
+                while self._queue:
+                    p = self._queue[0]
+                    if p.abandoned or now > p.deadline:
+                        self._queue.popleft()
+                        continue
+                    if batch and (not coalescing
+                                  or rows + p.n > cap
+                                  or any(p.feeds.get(nm)
+                                         != batch[0].feeds.get(nm)
+                                         for nm in static_names)):
+                        break
+                    self._queue.popleft()
+                    batch.append(p)
+                    rows += p.n
+                resilience.set_router_queue_depth(len(self._queue))
+                return batch
+        return []
+
+    @staticmethod
+    def _merge(batch, meta):
+        merged = {}
+        for name in meta["feed_names"]:
+            merged[name] = []
+            for p in batch:
+                merged[name].extend(p.feeds.get(name, []))
+        # a static feed (factor 0) must not be concatenated: every
+        # request carries the same full tensor — ship the first
+        for name, f in meta["feed_batch_factors"].items():
+            if not f and batch:
+                merged[name] = batch[0].feeds.get(name, [])
+        return merged
+
+    def _dispatch(self, batch):
+        """Send one coalesced batch to the least-loaded live replica,
+        retrying on an untried sibling while the deadlines allow — a
+        replica death mid-flight costs a retry, not a failure. The
+        dispatch budget is the batch's MINIMUM remaining deadline, so
+        when a short-deadline member expires it is failed ALONE and
+        the survivors are re-merged and retried on their own budget —
+        one impatient caller must not poison its coalesced siblings."""
+        meta = self._get_meta()
+        if meta is None:
+            self._fail(batch, FleetError("no live replica"))
+            return
+        tried = set()
+        last_err = None
+        merged = None
+        while True:
+            now = time.monotonic()
+            expired = [p for p in batch if now > p.deadline]
+            if expired:
+                self._fail(expired,
+                           last_err or DeadlineExceededError(
+                               "request deadline expired before any "
+                               "replica answered"))
+                batch = [p for p in batch if now <= p.deadline]
+                # the recomposed batch is a NEW dispatch: earlier
+                # failures belonged to the old composition (a replica
+                # that 504'd the impatient member's budget can serve
+                # the survivors' own), so the replica set reopens
+                merged = None
+                tried = set()
+                last_err = None
+            if not batch:
+                return
+            if merged is None:
+                merged = self._merge(batch, meta)
+            remaining = min(p.deadline for p in batch) - now
+            if remaining <= 0:
+                continue             # the loop top expires them
+            target = self._pick_replica(tried)
+            if target is None:
+                self._fail(batch, last_err or ServerOverloadedError(
+                    "no live replica to dispatch to"))
+                return
+            rid, addr = target
+            payload = {"feeds": merged, "deadline_s": remaining}
+            self._inc_inflight(rid, +1)
+            try:
+                status, resp = http_json(
+                    "POST", "http://%s/infer" % addr, payload,
+                    timeout_s=remaining + 0.5)
+            except (OSError, ValueError) as e:
+                # a SIGKILLed replica mid-flight lands here: the
+                # connection resets, the batch retries on a sibling.
+                # Connection-level failures are RARE (a death, not
+                # load) — they warrant an event as well as the counter
+                last_err = ConnectionError(
+                    "replica %d unreachable: %s" % (rid, e))
+                tried.add(rid)
+                resilience.record_router_retry(rid)
+                record_event("router_retry", replica=rid,
+                             error=type(e).__name__)
+                continue
+            finally:
+                self._inc_inflight(rid, -1)
+            if status == 200:
+                self._split(batch, resp, meta)
+                return
+            tried.add(rid)
+            if status == 503:
+                last_err = ServerOverloadedError(
+                    resp.get("error", "replica %d is shedding" % rid))
+            elif status == 504:
+                last_err = DeadlineExceededError(
+                    resp.get("error", "replica %d deadline" % rid))
+            else:
+                last_err = RuntimeError(
+                    resp.get("error",
+                             "replica %d answered HTTP %d"
+                             % (rid, status)))
+            # 5xx retries are LOAD-driven (a shed storm emits one per
+            # tried replica per batch, at request rate): counter only,
+            # never the bounded event log
+            resilience.record_router_retry(rid)
+
+    @staticmethod
+    def _fail(batch, err):
+        for p in batch:
+            p.error = err
+            p.event.set()
+
+    def _split(self, batch, resp, meta):
+        """Give each coalesced request its own slice of the batched
+        outputs, by the EXPORT's fetch factors (factor 0 = static
+        output, replicated to every caller)."""
+        outs = resp.get("outputs", [])
+        dtypes = resp.get("dtypes", [])
+        factors = [meta["fetch_batch_factors"].get(name, 0)
+                   for name in meta["fetch_names"]]
+        off = 0
+        for p in batch:
+            mine = []
+            for o, f in zip(outs, factors):
+                if f and isinstance(o, list):
+                    mine.append(o[off * f:(off + p.n) * f])
+                else:
+                    mine.append(o)
+            p.result = {"outputs": mine, "dtypes": dtypes,
+                        "replica": resp.get("replica"),
+                        "generation": resp.get("generation")}
+            p.error = None
+            p.event.set()
+            off += p.n
+
+    # -- rolling weight refresh --------------------------------------------
+    def rolling_deploy(self, artifact_dir, per_replica_timeout_s=60.0):
+        """Refresh every live replica's weights to ``artifact_dir``,
+        ONE replica at a time: ask it to refresh (it self-fences,
+        reloads + warms, rejoins), wait until it is back in rotation
+        on the new artifact, then move to the next — traffic keeps
+        flowing to the rest throughout, so a deploy drops nothing.
+        Returns ``{"refreshed": [ids], "dir": dir}``; raises
+        :class:`FleetError` when a replica does not come back in
+        time (the deploy stops there — the fleet keeps serving on the
+        replicas already refreshed plus the untouched tail), or when
+        another deploy is already in progress (two interleaved
+        deploys would fence more than one replica at a time).
+
+        A rolling refresh assumes the new artifact keeps the OLD
+        export contract (feed/fetch names and factors) — mixed
+        generations serve side by side mid-deploy. A contract-changing
+        model update needs a blue-green fleet swap instead."""
+        artifact_dir = str(artifact_dir)
+        if not self._deploy_lock.acquire(blocking=False):
+            raise FleetError("a rolling deploy is already in progress")
+        try:
+            return self._rolling_deploy_locked(artifact_dir,
+                                               per_replica_timeout_s)
+        finally:
+            self._deploy_lock.release()
+
+    def _rolling_deploy_locked(self, artifact_dir,
+                               per_replica_timeout_s):
+        targets = sorted(self.routable())
+        if not targets:
+            raise FleetError("no live replica to deploy to")
+        refreshed = []
+        for rid in targets:
+            ent = self.routable().get(rid)
+            if ent is None:
+                continue    # died since the plan was cut: skip it
+            if ent.get("dir") == artifact_dir:
+                refreshed.append(rid)
+                continue
+            try:
+                status, resp = http_json(
+                    "POST", "http://%s/admin/refresh" % ent["addr"],
+                    {"dir": artifact_dir}, timeout_s=5.0)
+            except (OSError, ValueError) as e:
+                raise FleetError("replica %d refused the refresh: %s"
+                                 % (rid, e))
+            if status != 200:
+                raise FleetError("replica %d refused the refresh: %s"
+                                 % (rid, resp.get("error", status)))
+            deadline = time.monotonic() + float(per_replica_timeout_s)
+            back = False
+            while time.monotonic() < deadline:
+                ent = self.routable().get(rid)
+                if ent is not None and ent.get("dir") == artifact_dir:
+                    back = True
+                    break
+                self._stop.wait(0.05)
+                if self._stop.is_set():
+                    raise FleetError("router closed mid-deploy")
+            if not back:
+                raise FleetError(
+                    "replica %d did not return to rotation on %s "
+                    "within %.1fs — deploy stopped (already "
+                    "refreshed: %s)" % (rid, artifact_dir,
+                                        per_replica_timeout_s,
+                                        refreshed))
+            refreshed.append(rid)
+        with self._meta_lock:
+            self._meta = None   # a deploy may change the contract
+        record_event("fleet_deploy_complete", refreshed=refreshed,
+                     dir=artifact_dir)
+        return {"refreshed": refreshed, "dir": artifact_dir}

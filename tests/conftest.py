@@ -67,6 +67,11 @@ def pytest_configure(config):
         "pallas: Pallas kernel-library oracle batteries (blockwise CE / "
         "fused MLM head, fused Adam, fused LayerNorm, autotune cache, "
         "use_pallas dispatch) — interpret mode on CPU, tier-1-safe")
+    config.addinivalue_line(
+        "markers",
+        "fleet: serving-fleet batteries (micro-batching router + "
+        "replica members over CoordServer; SIGKILL chaos under "
+        "sustained load) — wall-bounded, tier-1-safe")
 
 
 @pytest.fixture(autouse=True)

@@ -60,12 +60,23 @@ def test_budget_table_covers_the_contract():
     """The ISSUE-6 contract metrics are all gated (trace+lower, cache
     hit rate, quantized-vs-exact step wall time, byte ratio, feed
     throughput) plus the ISSUE-7 pallas section (per-kernel step wall +
-    max abs error)."""
+    max abs error) and the ISSUE-8 transport/serving sections (round
+    latency, router p50/p99 + shed rate — the last two ROADMAP item 4
+    slices)."""
     assert set(bench_micro.BUDGETS) == {
         "trace_lower_s", "cache_hit_rate", "exact_step_s",
         "quant_step_s", "collective_wire_ratio", "feed_samples_per_s",
         "pallas_ce_step_s", "pallas_adam_step_s", "pallas_ln_step_s",
-        "pallas_ce_err", "pallas_adam_err", "pallas_ln_err"}
+        "pallas_ce_err", "pallas_adam_err", "pallas_ln_err",
+        "transport_roundtrip_ms", "transport_gather_ms",
+        "serving_p50_ms", "serving_p99_ms", "serving_shed_rate",
+        "serving_error_rate"}
+
+
+def test_transport_section_measures_latency():
+    m = bench_micro.bench_transport(roundtrips=50, gathers=5)
+    assert 0 < m["transport_roundtrip_ms"] < 25.0
+    assert 0 < m["transport_gather_ms"] < 250.0
 
 
 def test_pallas_section_measures_all_three_kernels():

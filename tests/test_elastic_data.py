@@ -278,6 +278,53 @@ def test_elastic_census_die_shrink_rejoin_full_mesh(tmp_path):
                for g in m["gauges"])
 
 
+def test_agreed_lags_assembled_from_frozen_verdicts():
+    """The weighted-rebalance agreement input: every host assembles the
+    SAME {host: lag} map from the frozen window verdicts (each peer's
+    exchange_state()["lag"]); exchanges without the key (pre-upgrade
+    peers) yield None so rebalance falls back to its local default."""
+    verdicts = {
+        0: ["ok", [], {"lanes": {}, "drained": False, "lag": 7}, False],
+        1: ["ok", [], {"lanes": {}, "drained": False, "lag": 0}, False],
+    }
+    assert ElasticTrainer._agreed_lags(verdicts) == {0: 7.0, 1: 0.0}
+    assert ElasticTrainer._agreed_lags(
+        {0: ["ok", [], {"lanes": {}, "drained": False}, False]}) is None
+    assert ElasticTrainer._agreed_lags({0: ["ok", [], None, False]}) \
+        is None
+
+
+def test_weighted_rebalance_rides_the_window_exchange(tmp_path):
+    """AGREEMENT caveat closed: an ElasticTrainer shrink re-balances a
+    weighted_rebalance feed with the lag map carried ON the window
+    status exchange — the placement is weighted even though the local
+    event log holds no feed_stream_lag gauges at shrink time (which is
+    exactly the divergent-local-logs situation of a socket pod), and
+    the census stays exactly-once."""
+    files = _sample_files(8, 4)                # 32 samples
+    main, startup, loss, sid = _data_program()
+    trainers = []
+    for h in range(4):
+        sc, exe = Scope(), pt.Executor()
+        with scope_guard(sc):
+            exe.run(startup)
+        feed = ShardedFeed(files, 4, h, seed=5, batch_size=2, epochs=1,
+                           weighted_rebalance=True)
+        trainers.append(ResilientTrainer(
+            exe, main, str(tmp_path / "wl" / ("h%d" % h)),
+            fetch_list=[loss, sid], checkpoint_every=2, scope=sc,
+            retry_policy=_fast_policy(), feed=feed))
+    pod = ElasticTrainer(trainers,
+                         LocalCoordinator(4, timeout_s=POD_TIMEOUT_S))
+    assert not resilience.events("feed_lag")   # no local gauges exist
+    with resilience.inject("step:die@10"):
+        out = pod.run(None, steps=40)
+    shrinks = [e for e in resilience.events("feed_rebalance")
+               if e["capacity"] == "3/4"]
+    assert shrinks and all(e["weighted"] for e in shrinks), shrinks
+    assert _census(out) == list(range(32))
+
+
 def test_topology_change_resume_census(tmp_path):
     """Exact resume ACROSS a topology change: the pod shrinks 3 -> 2
     mid-epoch (no rejoin), then a transient fault rewinds the survivors

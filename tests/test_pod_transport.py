@@ -267,6 +267,58 @@ def test_socket_pod_size_mismatch_is_loud():
                               heartbeat=False)
 
 
+def test_auto_size_learns_pod_size_from_first_hello():
+    """CoordServer(None) (coordsvc --n-hosts auto): the first sized
+    hello fixes the pod size; anything earlier is a loud error, and a
+    later disagreeing hello is the usual mismatch."""
+    from paddle_tpu.framework.transport import CoordClient
+    with contextlib.ExitStack() as stack:
+        srv = CoordServer(None).start()
+        stack.callback(srv.close)
+        probe = CoordClient(srv.address, host_id=0)
+        stack.callback(probe.close)
+        # nothing but hello is served before the size is known
+        with pytest.raises(RuntimeError, match="not learned"):
+            probe.call("lost")
+        with pytest.raises(RuntimeError, match="must carry n_hosts"):
+            probe.call("hello")
+        # an INVALID first hello must not pin the size as a side
+        # effect (the error return would otherwise lock in a bogus
+        # pod size for the service's lifetime)
+        with pytest.raises(RuntimeError, match="out of range"):
+            probe.call("hello", n_hosts=2, host=7)
+        with srv.state.lock:
+            assert srv.state.n_hosts is None
+        resp = probe.call("hello", n_hosts=2, lease=True)
+        assert resp["n_hosts"] == 2
+        with srv.state.lock:
+            assert srv.state.n_hosts == 2
+        # the learned size is now enforced exactly like a fixed one
+        with pytest.raises(CoordinationError, match="pod size mismatch"):
+            SocketCoordinator(srv.address, 3, 0, mesh_reinit=False,
+                              heartbeat=False)
+        co = SocketCoordinator(srv.address, 2, 1, mesh_reinit=False,
+                               heartbeat=False)
+        stack.callback(co.close)
+        assert co.live_hosts() == [0, 1]
+
+
+def test_member_registry_put_info_and_members():
+    """The serving-fleet registry ops: put_info publishes a per-host
+    blob (last write wins), members answers the whole routing question
+    in one poll (info + heartbeat ages + lost map)."""
+    with contextlib.ExitStack() as stack:
+        srv, cos = _socket_pod(stack, 3)
+        cos[0].put_info({"addr": "127.0.0.1:1234", "ready": True})
+        cos[0].put_info({"addr": "127.0.0.1:1234", "ready": False})
+        m = cos[1].members()
+        assert m["n_hosts"] == 3
+        assert m["info"][0]["ready"] is False       # last write won
+        assert 0 in m["hb_age"] and m["hb_age"][0] >= 0.0
+        cos[0].mark_lost(2, "dead")
+        assert 2 in cos[1].members()["lost"]
+
+
 def test_socket_passive_observer_takes_no_liveness_lease():
     """heartbeat=False is the documented observer mode: it must NOT
     register a heartbeat lease, or the deadline monitor would tombstone

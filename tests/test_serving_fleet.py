@@ -1,0 +1,844 @@
+"""Serving fleet battery: the multi-replica micro-batching router on
+the coordination plane (paddle_tpu/serving_fleet.py).
+
+Three tiers, every wait hard-bounded (PR 5 discipline):
+
+  * router units — micro-batch coalescing/splitting correctness vs a
+    direct predictor, queue-full shedding, per-replica shed
+    composition, request deadlines, retry-on-sibling when a replica's
+    endpoint dies mid-flight, router metrics + probe scrape;
+  * fleet lifecycle — rolling weight refresh under sustained load
+    (zero dropped requests, state-ship accounting), single-replica
+    fleets (the router is the admitting survivor);
+  * the chaos battery — REAL replica processes (tools/servingsvc.py)
+    over TCP: SIGKILL one of 3 under sustained synthetic load, assert
+    zero failed requests beyond the in-flight window (the router
+    retries even those on a sibling), the restarted process re-admits
+    through announce/admit/join and takes traffic again.
+"""
+import contextlib
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from collections import Counter
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.framework import resilience
+from paddle_tpu.framework.transport import CoordServer
+from paddle_tpu.serving_fleet import (FleetError, FleetRouter,
+                                      ReplicaMember, http_json,
+                                      router_host_id)
+
+pytestmark = [pytest.mark.faultinject, pytest.mark.fleet]
+
+WAIT_S = 20.0           # hard bound on every readiness/liveness wait
+
+
+@pytest.fixture(autouse=True)
+def _clean_resilience():
+    resilience.install(None)
+    resilience.clear_events()
+    yield
+    resilience.install(None)
+    resilience.clear_events()
+
+
+def _export_artifact(dirname, scale=None, features=6, classes=3,
+                     batch_sizes=(1, 8)):
+    """Tiny softmax-fc artifact; ``scale`` pins the weights (constant
+    init) so two exports are distinguishable by their outputs."""
+    from paddle_tpu.framework.scope import Scope, scope_guard
+    with scope_guard(Scope()):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("x", [features], dtype="float32")
+            if scale is None:
+                y = layers.softmax(layers.fc(x, classes))
+            else:
+                y = layers.fc(x, classes, param_attr=pt.ParamAttr(
+                    name="w",
+                    initializer=pt.initializer.Constant(scale)),
+                    bias_attr=False)
+        exe = pt.Executor()
+        exe.run(startup)
+        pt.save_inference_model(str(dirname), ["x"], [y], exe,
+                                main_program=main, format="stablehlo",
+                                batch_sizes=batch_sizes)
+    return str(dirname)
+
+
+@pytest.fixture(scope="module")
+def artifact(tmp_path_factory):
+    return _export_artifact(tmp_path_factory.mktemp("fleet_artifact"))
+
+
+def _fleet(stack, artifact, n_replicas, hb_deadline_s=2.0,
+           replica_kw=None, router_kw=None):
+    """In-process fleet on an auto-sized CoordServer, torn down by the
+    ExitStack: n replicas + router, all with fast test cadences."""
+    srv = CoordServer(None, hb_deadline_s=hb_deadline_s).start()
+    stack.callback(srv.close)
+    reps = []
+    for i in range(n_replicas):
+        rep = ReplicaMember(artifact, srv.address, n_replicas, i,
+                            ctl_interval_s=0.05, hb_interval_s=0.1,
+                            join_timeout_s=WAIT_S,
+                            **(replica_kw or {})).start()
+        stack.callback(rep.close)
+        reps.append(rep)
+    rkw = dict(max_batch=8, batch_deadline_s=0.01, ctl_interval_s=0.05,
+               hb_interval_s=0.1, poll_interval_s=0.03,
+               join_timeout_s=WAIT_S)
+    rkw.update(router_kw or {})
+    router = FleetRouter(srv.address, n_replicas, **rkw).start()
+    stack.callback(router.close)
+    _wait(lambda: len(router.routable()) == n_replicas,
+          "all replicas routable")
+    return srv, reps, router
+
+
+def _wait(cond, what, timeout_s=WAIT_S):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.02)
+    raise AssertionError("timed out waiting for %s" % what)
+
+
+def _post(router, feeds, deadline_s=None, timeout_s=15.0):
+    body = {"feeds": feeds}
+    if deadline_s is not None:
+        body["deadline_s"] = deadline_s
+    return http_json("POST", router.url + "/infer", body,
+                     timeout_s=timeout_s)
+
+
+# ---------------------------------------------------------------------------
+# router units
+# ---------------------------------------------------------------------------
+
+def test_micro_batching_coalesces_and_splits_correctly(artifact):
+    """Concurrent requests coalesce into shared micro-batches (the
+    batch-size histogram proves it) and every caller gets exactly its
+    own rows back — bitwise what a direct predictor run returns."""
+    from paddle_tpu.serving import load_serving_artifact
+    ref = load_serving_artifact(artifact)
+    with contextlib.ExitStack() as stack:
+        _, _, router = _fleet(stack, artifact, 2)
+        rng = np.random.RandomState(0)
+        inputs = [rng.rand(1 + i % 3, 6).astype(np.float32)
+                  for i in range(12)]
+        results = [None] * len(inputs)
+
+        def worker(i):
+            results[i] = _post(router, {"x": inputs[i].tolist()})
+
+        ts = [threading.Thread(target=worker, args=(i,))
+              for i in range(len(inputs))]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        for i, (status, resp) in enumerate(results):
+            assert status == 200, (i, status, resp)
+            out = np.asarray(resp["outputs"][0],
+                             dtype=resp["dtypes"][0])
+            expect, = ref.run({"x": inputs[i]})
+            np.testing.assert_allclose(out, expect, rtol=1e-5,
+                                       atol=1e-6)
+        tot = resilience.router_totals()
+        assert tot["requests"].get("ok") == len(inputs)
+        # coalescing happened: fewer dispatches than requests
+        assert 0 < tot["batch_count"] < len(inputs)
+        assert tot["batch_sum"] > tot["batch_count"]
+
+
+def test_router_metrics_exported_via_resilience(artifact):
+    """The acceptance observability contract: router_requests_total,
+    router_batch_size, router_queue_depth and router_replica_inflight
+    all ride resilience.metrics()/metrics_text after traffic."""
+    with contextlib.ExitStack() as stack:
+        _, _, router = _fleet(stack, artifact, 2)
+        xv = np.ones((2, 6), np.float32).tolist()
+        for _ in range(4):
+            status, _ = _post(router, {"x": xv})
+            assert status == 200
+        text = resilience.metrics_text()
+        for series in ("router_requests_total", "router_batch_size",
+                       "router_queue_depth",
+                       "router_replica_inflight"):
+            assert "paddle_tpu_resilience_" + series in text, series
+        # and the router's own /metrics endpoint serves the same
+        import urllib.request
+        with urllib.request.urlopen(router.url + "/metrics",
+                                    timeout=5) as resp:
+            body = resp.read().decode()
+        assert "router_requests_total" in body
+
+
+def test_queue_full_sheds_with_503(artifact):
+    """Router-side load shedding: a full coalescing queue answers 503
+    (ServerOverloadedError) instead of collapsing, and the sheds are
+    counted by outcome."""
+    with contextlib.ExitStack() as stack:
+        _, _, router = _fleet(
+            stack, artifact, 1,
+            router_kw=dict(max_queue=2, max_batch=100,
+                           batch_deadline_s=0.4))
+        xv = np.ones((1, 6), np.float32).tolist()
+        results = []
+        lock = threading.Lock()
+
+        def worker():
+            got = _post(router, {"x": xv})
+            with lock:
+                results.append(got[0])
+
+        ts = [threading.Thread(target=worker) for _ in range(6)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        counts = Counter(results)
+        # 2 fit the queue (one coalesced batch at the 0.4s deadline),
+        # the rest shed at admission
+        assert counts[200] >= 2
+        assert counts[503] >= 3, counts
+        tot = resilience.router_totals()
+        assert tot["requests"].get("shed", 0) >= 3
+        # the fleet recovers: a later lone request succeeds
+        status, _ = _post(router, {"x": xv})
+        assert status == 200
+
+
+def test_replica_shed_composes_and_deadline_answers_504(artifact):
+    """Per-replica policies keep working behind the router: with every
+    replica at max_in_flight=1 and an injected slow serve, a burst
+    sheds 503 once every sibling shed too; a request deadline shorter
+    than the slow serve answers 504."""
+    with contextlib.ExitStack() as stack:
+        _, _, router = _fleet(
+            stack, artifact, 2,
+            replica_kw=dict(max_in_flight=1),
+            router_kw=dict(max_batch=1, batch_deadline_s=0.0))
+        xv = np.ones((1, 6), np.float32).tolist()
+        with resilience.inject("serve:slow=0.3~1.0"):
+            results = []
+            lock = threading.Lock()
+
+            def worker():
+                got = _post(router, {"x": xv})
+                with lock:
+                    results.append(got[0])
+
+            ts = [threading.Thread(target=worker) for _ in range(6)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            counts = Counter(results)
+            assert counts[200] >= 1
+            assert counts[503] >= 1, counts
+            # deadline path: shorter than the injected slowness
+            status, resp = _post(router, {"x": xv}, deadline_s=0.1)
+            assert status == 504, (status, resp)
+        tot = resilience.router_totals()
+        assert tot["requests"].get("deadline", 0) >= 1
+        # LOAD-driven 5xx retries ride the cumulative counter, never
+        # the bounded event log (a shed storm at request rate would
+        # evict everything else)
+        assert sum(tot["retries"].values()) >= 1
+        assert not resilience.events("router_retry")
+
+
+def test_dispatch_retries_on_sibling_when_endpoint_dies(artifact):
+    """A replica whose HTTP endpoint dies mid-rotation costs retries,
+    not failures: every request lands on the sibling; once its
+    heartbeat lease lapses the fleet fences it and the routing table
+    shrinks."""
+    with contextlib.ExitStack() as stack:
+        _, reps, router = _fleet(stack, artifact, 2,
+                                 hb_deadline_s=1.0)
+        # kill replica 0's HTTP listener but keep its lease beating:
+        # the router still routes there and must fail over per dispatch
+        reps[0]._server.shutdown()
+        reps[0]._server.server_close()
+        xv = np.ones((2, 6), np.float32).tolist()
+        statuses = set()
+        for _ in range(30):
+            statuses.add(_post(router, {"x": xv})[0])
+            if resilience.events("router_retry"):
+                break
+        assert statuses == {200}
+        # connection-level failovers (a death, not load) DO warrant an
+        # event, alongside the cumulative counter
+        assert resilience.events("router_retry")
+        assert sum(resilience.router_totals()["retries"].values()) >= 1
+        # now stop its control plane too: the lease lapses, the fleet
+        # fences it, and the router stops trying it at all
+        reps[0]._co.close()
+        _wait(lambda: sorted(router.routable()) == [1],
+              "replica 0 fenced out of rotation")
+        status, resp = _post(router, {"x": xv})
+        assert status == 200 and resp["replica"] == 1
+
+
+# ---------------------------------------------------------------------------
+# fleet lifecycle: rolling weight refresh
+# ---------------------------------------------------------------------------
+
+def test_rolling_deploy_zero_dropped_requests(tmp_path):
+    """ACCEPTANCE (deploy): a rolling weight refresh under sustained
+    load completes with zero dropped requests — each replica fences
+    itself (planned loss), reloads + warms, rejoins through
+    announce/admit/join — and the artifact movement is accounted as
+    state-ship bytes (zlib wire < raw)."""
+    d1 = _export_artifact(tmp_path / "g1", scale=1.0)
+    d2 = _export_artifact(tmp_path / "g2", scale=2.0)
+    with contextlib.ExitStack() as stack:
+        _, reps, router = _fleet(stack, d1, 2)
+        xv = np.ones((1, 6), np.float32)
+        stop, failures, served = threading.Event(), [], []
+        lock = threading.Lock()
+
+        def load():
+            while not stop.is_set():
+                try:
+                    status, resp = _post(router, {"x": xv.tolist()})
+                except Exception as e:   # noqa: BLE001 - recorded
+                    status, resp = -1, repr(e)
+                with lock:
+                    (served if status == 200 else failures).append(
+                        (status, resp))
+                time.sleep(0.005)
+
+        loaders = [threading.Thread(target=load, daemon=True)
+                   for _ in range(3)]
+        for t in loaders:
+            t.start()
+        time.sleep(0.3)
+        summary = router.rolling_deploy(d2, per_replica_timeout_s=30.0)
+        time.sleep(0.3)
+        stop.set()
+        for t in loaders:
+            t.join(timeout=5)
+        assert not failures, failures[:5]
+        assert len(served) > 20
+        assert summary["refreshed"] == [0, 1]
+        assert [m.generation for m in reps] == [2, 2]
+        # all traffic now on the gen-2 weights: y = x @ (2 * ones) = 12
+        status, resp = _post(router, {"x": xv.tolist()})
+        assert status == 200
+        np.testing.assert_allclose(np.asarray(resp["outputs"][0]),
+                                   np.full((1, 3), 12.0), rtol=1e-5)
+        ship = resilience.bytes_totals().get("stateship")
+        assert ship and 0 < ship["wire"] < ship["raw"]
+        kinds = {e["kind"] for e in resilience.events()}
+        assert {"fleet_deploy_begin", "fleet_deploy_done",
+                "fleet_rejoin", "fleet_admit",
+                "fleet_deploy_complete"} <= kinds
+
+
+def test_single_replica_fleet_router_is_the_admitting_survivor(
+        tmp_path):
+    """n=1 fleet: the router (a full group member) is the survivor
+    that votes the deploying replica back in — without it there would
+    be nobody to admit the rejoin."""
+    d1 = _export_artifact(tmp_path / "g1", scale=1.0)
+    d2 = _export_artifact(tmp_path / "g2", scale=3.0)
+    with contextlib.ExitStack() as stack:
+        srv, reps, router = _fleet(stack, d1, 1)
+        summary = router.rolling_deploy(d2, per_replica_timeout_s=30.0)
+        assert summary["refreshed"] == [0]
+        assert reps[0].generation == 2
+        xv = np.ones((1, 6), np.float32).tolist()
+        status, resp = _post(router, {"x": xv})
+        assert status == 200
+        np.testing.assert_allclose(np.asarray(resp["outputs"][0]),
+                                   np.full((1, 3), 18.0), rtol=1e-5)
+        assert resilience.events("fleet_admit")
+        # REGRESSION: the replica dies and its orchestrator restarts
+        # it with the ORIGINAL (pre-deploy) command line. The router
+        # is the only survivor, so the admission sync carries no
+        # artifact ([k, -1, ""]) — the rejoiner must adopt the
+        # fleet's current artifact from the member REGISTRY, never
+        # silently revert the deploy to stale weights.
+        reps[0].close()
+        rep0b = ReplicaMember(d1, srv.address, 1, 0,
+                              ctl_interval_s=0.05, hb_interval_s=0.1,
+                              join_timeout_s=WAIT_S).start()
+        stack.callback(rep0b.close)
+        _wait(lambda: 0 in router.routable(), "restart back in rotation")
+        assert rep0b.generation == 2
+        status, resp = _post(router, {"x": xv})
+        assert status == 200
+        np.testing.assert_allclose(np.asarray(resp["outputs"][0]),
+                                   np.full((1, 3), 18.0), rtol=1e-5)
+        assert resilience.events("fleet_adopt")
+
+
+# ---------------------------------------------------------------------------
+# the chaos battery: REAL replica processes, SIGKILL under load
+# ---------------------------------------------------------------------------
+
+def _spawn_replica(artifact, coord, n, rid):
+    tool = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "servingsvc.py")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [p for p in (env.get("PYTHONPATH"),
+                     os.path.dirname(os.path.dirname(tool))) if p])
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    return subprocess.Popen(
+        [sys.executable, tool, "replica", "--coord", coord,
+         "--n-replicas", str(n), "--replica-id", str(rid),
+         "--artifact", artifact, "--ctl-interval-s", "0.05",
+         "--hb-interval-s", "0.1", "--join-timeout-s", "30"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env)
+
+
+def test_chaos_sigkill_replica_under_sustained_load(artifact):
+    """THE fleet acceptance scenario over actual OS processes: 3
+    replica processes serve through the router under sustained load;
+    SIGKILL one mid-traffic — the heartbeat lease fences it, in-flight
+    work retries on a sibling, and ZERO requests fail (even inside the
+    in-flight window); the restarted process re-admits through
+    announce/admit/join and serves again."""
+    srv = CoordServer(4, hb_deadline_s=1.0).start()
+    procs, router = {}, None
+    try:
+        for r in range(3):
+            procs[r] = _spawn_replica(artifact, srv.address, 3, r)
+        for r in range(3):
+            line = procs[r].stdout.readline()
+            assert '"replica_id": %d' % r in line, line
+        router = FleetRouter(srv.address, 3, max_batch=8,
+                             batch_deadline_s=0.005,
+                             ctl_interval_s=0.05, hb_interval_s=0.1,
+                             poll_interval_s=0.03,
+                             join_timeout_s=WAIT_S).start()
+        _wait(lambda: len(router.routable()) == 3, "3 routable")
+        xv = np.ones((2, 6), np.float32).tolist()
+        stop, failures, served = threading.Event(), [], []
+        lock = threading.Lock()
+
+        def load():
+            while not stop.is_set():
+                try:
+                    status, resp = _post(router, {"x": xv})
+                except Exception as e:   # noqa: BLE001 - recorded
+                    status, resp = -1, repr(e)
+                with lock:
+                    if status == 200:
+                        served.append(resp["replica"])
+                    else:
+                        failures.append((status, resp))
+                time.sleep(0.004)
+
+        loaders = [threading.Thread(target=load, daemon=True)
+                   for _ in range(4)]
+        for t in loaders:
+            t.start()
+        time.sleep(0.5)
+        os.kill(procs[2].pid, signal.SIGKILL)
+        procs[2].wait(timeout=10)
+        # fenced by the LEASE (nobody declares anything), out of
+        # rotation within the deadline + a poll
+        _wait(lambda: 2 not in router.routable(),
+              "killed replica out of rotation", timeout_s=10.0)
+        time.sleep(1.0)         # sustained load on the survivors
+        # restart = the SAME command line; it finds itself fenced and
+        # re-admits through announce/admit/join
+        procs["re"] = _spawn_replica(artifact, srv.address, 3, 2)
+        assert '"replica_id": 2' in procs["re"].stdout.readline()
+        _wait(lambda: 2 in router.routable(), "re-admitted",
+              timeout_s=WAIT_S)
+        time.sleep(1.0)         # traffic reaches the rejoined replica
+        stop.set()
+        for t in loaders:
+            t.join(timeout=5)
+        counts = Counter(served)
+        assert not failures, failures[:5]
+        assert len(served) > 100
+        assert counts[2] > 0    # the restarted replica took traffic
+    finally:
+        if router is not None:
+            router.close()
+        for p in procs.values():
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        for p in procs.values():
+            if p.poll() is None:
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+        srv.close()
+
+
+def test_servingsvc_cli_router_round_trip(artifact):
+    """tools/servingsvc.py end to end, router leg included: coordsvc
+    --n-hosts auto sizes the group from the first member, a CLI
+    replica + CLI router serve one inference, SIGTERM shuts both down
+    cleanly."""
+    import json as json_mod
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [p for p in (env.get("PYTHONPATH"), root) if p])
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    procs = []
+    try:
+        coord = subprocess.Popen(
+            [sys.executable, os.path.join(root, "tools", "coordsvc.py"),
+             "--n-hosts", "auto", "--host", "127.0.0.1",
+             "--hb-deadline-s", "5.0"],
+            stdout=subprocess.PIPE, text=True, env=env)
+        procs.append(coord)
+        info = json_mod.loads(coord.stdout.readline())
+        assert info["n_hosts"] is None          # auto: learned later
+        addr = info["address"]
+        rep = _spawn_replica(artifact, addr, 1, 0)
+        procs.append(rep)
+        assert '"replica_id": 0' in rep.stdout.readline()
+        rout = subprocess.Popen(
+            [sys.executable, os.path.join(root, "tools",
+                                          "servingsvc.py"), "router",
+             "--coord", addr, "--n-replicas", "1",
+             "--ctl-interval-s", "0.05", "--hb-interval-s", "0.1"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, env=env)
+        procs.append(rout)
+        rinfo = json_mod.loads(rout.stdout.readline())
+        url = rinfo["url"]
+
+        def ready():
+            try:
+                status, h = http_json("GET", url + "/healthz",
+                                      timeout_s=2.0)
+            except OSError:
+                return False
+            return status == 200 and len(h.get("replicas", {})) == 1
+
+        _wait(ready, "CLI fleet routable")
+        xv = np.ones((1, 6), np.float32).tolist()
+        status, resp = http_json("POST", url + "/infer",
+                                 {"feeds": {"x": xv}}, timeout_s=15.0)
+        assert status == 200 and resp["replica"] == 0
+        for p in reversed(procs):
+            p.send_signal(signal.SIGTERM)
+            assert p.wait(timeout=15) == 0
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+
+def test_coalescing_clamps_to_the_exported_bucket(tmp_path):
+    """REGRESSION: a router max_batch larger than the biggest exported
+    bucket must not coalesce a merged batch no replica can serve (a
+    deterministic fleet-wide 502 that only appears under concurrent
+    load) — the cut clamps to the export's max_bucket."""
+    art = _export_artifact(tmp_path / "small", batch_sizes=(1, 4))
+    with contextlib.ExitStack() as stack:
+        _, _, router = _fleet(stack, art, 2,
+                              router_kw=dict(max_batch=16,
+                                             batch_deadline_s=0.05))
+        xv = np.ones((2, 6), np.float32).tolist()
+        results = []
+        lock = threading.Lock()
+
+        def worker():
+            got = _post(router, {"x": xv})
+            with lock:
+                results.append(got[0])
+
+        ts = [threading.Thread(target=worker) for _ in range(6)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert results == [200] * 6, Counter(results)
+        # and a SINGLE oversized request is a client error at
+        # admission (400) — never dispatched to 500 on every replica
+        # and surfaced as a 502 retry storm
+        big = np.ones((8, 6), np.float32).tolist()
+        status, resp = _post(router, {"x": big})
+        assert status == 400 and "largest exported bucket" \
+            in resp["error"], (status, resp)
+
+
+def test_malformed_request_never_poisons_coalesced_siblings(artifact):
+    """REGRESSION: a wrong-width or ragged request is rejected 400 at
+    admission — coalesced into a batch it would fail on the replica
+    and take every innocent sibling down as a 502."""
+    with contextlib.ExitStack() as stack:
+        _, _, router = _fleet(
+            stack, artifact, 1,
+            router_kw=dict(max_batch=8, batch_deadline_s=0.1))
+        good = np.ones((2, 6), np.float32).tolist()
+        results = {}
+
+        def worker(tag, feeds):
+            results[tag] = _post(router, {"x": feeds}, timeout_s=15.0)
+
+        # wrong inner width + ragged rows race two healthy requests
+        # into the same coalescing window
+        ts = [threading.Thread(target=worker, args=args) for args in
+              (("bad_width", [[1.0, 2.0, 3.0]]),
+               ("ragged", [[1.0] * 6, [1.0] * 4]),
+               ("ok1", good), ("ok2", good))]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert results["bad_width"][0] == 400, results["bad_width"]
+        assert results["ragged"][0] == 400, results["ragged"]
+        assert results["ok1"][0] == 200, results["ok1"]
+        assert results["ok2"][0] == 200, results["ok2"]
+        # a missing feed is caught at admission too
+        status, resp = _post(router, {})
+        assert status == 400 and "missing feed" in resp["error"]
+
+
+def test_static_feed_mismatch_partitions_the_batch(tmp_path):
+    """REGRESSION: a static (factor-0) feed is shipped once per merged
+    batch, so requests with DIFFERENT static tensors must never share
+    one — coalescing them would silently compute every sibling's
+    outputs from the first request's value (wrong data, not even an
+    error)."""
+    from paddle_tpu.framework.scope import Scope, scope_guard
+    with scope_guard(Scope()):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("x", [6], dtype="float32")
+            s = layers.data("s", [1, 6], dtype="float32",
+                            append_batch_size=False)
+            y = layers.elementwise_mul(x, s)
+        exe = pt.Executor()
+        exe.run(startup)
+        pt.save_inference_model(str(tmp_path), ["x", "s"], [y], exe,
+                                main_program=main, format="stablehlo",
+                                batch_sizes=(1, 8))
+    with contextlib.ExitStack() as stack:
+        _, _, router = _fleet(
+            stack, str(tmp_path), 1,
+            router_kw=dict(max_batch=8, batch_deadline_s=0.1))
+        xv = np.ones((1, 6), np.float32).tolist()
+        results = {}
+
+        def worker(tag, scale):
+            sv = np.full((1, 6), scale, np.float32).tolist()
+            results[tag] = _post(router, {"x": xv, "s": sv},
+                                 timeout_s=15.0)
+
+        # same 0.1s window: identical-scale requests may coalesce,
+        # the different-scale one must be partitioned out
+        ts = [threading.Thread(target=worker, args=args) for args in
+              (("a1", 2.0), ("a2", 2.0), ("b", 5.0))]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        for tag, scale in (("a1", 2.0), ("a2", 2.0), ("b", 5.0)):
+            status, resp = results[tag]
+            assert status == 200, (tag, resp)
+            np.testing.assert_allclose(
+                np.asarray(resp["outputs"][0]),
+                np.full((1, 6), scale), rtol=1e-5,
+                err_msg="request %r got another request's static "
+                        "feed" % tag)
+
+
+def test_router_close_fails_queued_requests_promptly(artifact):
+    """REGRESSION: close() fails the requests still waiting in the
+    coalescing queue immediately — their callers must not block out
+    their full request deadline against a router that will never
+    dispatch them."""
+    with contextlib.ExitStack() as stack:
+        _, _, router = _fleet(
+            stack, artifact, 1,
+            router_kw=dict(max_batch=100, batch_deadline_s=5.0,
+                           request_deadline_s=30.0))
+        xv = np.ones((1, 6), np.float32).tolist()
+        errors = []
+        lock = threading.Lock()
+
+        def worker():
+            try:
+                router.submit({"x": xv})
+            except Exception as e:   # noqa: BLE001 - recorded
+                with lock:
+                    errors.append(e)
+
+        ts = [threading.Thread(target=worker) for _ in range(3)]
+        for t in ts:
+            t.start()
+        time.sleep(0.3)          # all three sit in the 5s batch window
+        t0 = time.monotonic()
+        router.close()
+        for t in ts:
+            t.join(timeout=5.0)
+        assert time.monotonic() - t0 < 5.0
+        assert len(errors) == 3
+        from paddle_tpu.framework.resilience import \
+            ServerOverloadedError
+        assert all(isinstance(e, ServerOverloadedError)
+                   for e in errors), errors
+
+
+def test_failed_start_tears_down_the_serving_surface(artifact):
+    """REGRESSION: a start() that fails (coordinator size mismatch
+    here) must not leak the HTTP listener bound by _prepare — a
+    supervisor retry loop would accumulate one live port per
+    attempt."""
+    with contextlib.ExitStack() as stack:
+        srv = CoordServer(2, hb_deadline_s=5.0).start()   # 1 replica
+        stack.callback(srv.close)
+        rep = ReplicaMember(artifact, srv.address, 3, 0,   # wrong size
+                            ctl_interval_s=0.05, hb_interval_s=0.1)
+        with pytest.raises(Exception, match="pod size mismatch"):
+            rep.start()
+        # the listener bound by _prepare is gone: the port refuses
+        addr = rep.address
+        with pytest.raises(OSError):
+            http_json("GET", "http://%s/healthz" % addr, timeout_s=2.0)
+
+
+def test_short_deadline_member_does_not_poison_siblings(artifact):
+    """REGRESSION: a coalesced batch's dispatch budget is its minimum
+    deadline, but when the impatient member expires it must fail
+    ALONE — the surviving members are re-merged and retried on their
+    own budget."""
+    with contextlib.ExitStack() as stack:
+        _, _, router = _fleet(
+            stack, artifact, 1,
+            router_kw=dict(max_batch=8, batch_deadline_s=0.15))
+        xv = np.ones((1, 6), np.float32).tolist()
+        with resilience.inject("serve:slow=0.4~1.0"):
+            results = {}
+
+            def worker(tag, deadline_s):
+                results[tag] = _post(router, {"x": xv},
+                                     deadline_s=deadline_s,
+                                     timeout_s=20.0)
+
+            ta = threading.Thread(target=worker, args=("a", 0.25))
+            tb = threading.Thread(target=worker, args=("b", 15.0))
+            ta.start()
+            tb.start()          # same 0.15s window: they coalesce
+            ta.join()
+            tb.join()
+        # A's 0.25s budget dies against the 0.4s slow serve; B's 15s
+        # budget rides the retry and succeeds
+        assert results["a"][0] == 504, results["a"]
+        assert results["b"][0] == 200, results["b"]
+
+
+def test_quick_restart_supersedes_live_lease(artifact):
+    """REGRESSION: a replica restarted BEFORE its previous lease is
+    fenced must not re-enter at control-round 0 while survivors sit at
+    N (desynced round names would stall both sides' gathers). The
+    preflight fences the stale incarnation and the restart takes the
+    ordinary rejoin path — survivors stay un-fenced and routable
+    throughout."""
+    with contextlib.ExitStack() as stack:
+        # long deadline: the old lease stays "live-looking" while the
+        # replacement starts — the exact window the preflight covers
+        srv, reps, router = _fleet(stack, artifact, 2,
+                                   hb_deadline_s=30.0)
+        # simulate the SIGKILL half: drop replica 0 abruptly, leaving
+        # its fresh lease behind on the server
+        reps[0]._server.shutdown()
+        reps[0]._server.server_close()
+        reps[0]._co.close()
+        with srv.state.lock:
+            assert 0 not in srv.state.lost      # lease not yet fenced
+        rep0b = ReplicaMember(artifact, srv.address, 2, 0,
+                              ctl_interval_s=0.05, hb_interval_s=0.1,
+                              join_timeout_s=WAIT_S).start()
+        stack.callback(rep0b.close)
+        assert resilience.events("fleet_supersede")
+        assert resilience.events("fleet_rejoin")
+        _wait(lambda: sorted(router.routable()) == [0, 1],
+              "superseded restart back in rotation")
+        # nobody else was collateral damage
+        with srv.state.lock:
+            assert 1 not in srv.state.lost
+            assert 2 not in srv.state.lost      # the router
+        xv = np.ones((1, 6), np.float32).tolist()
+        assert _post(router, {"x": xv})[0] == 200
+
+
+def test_concurrent_deploys_are_mutually_exclusive(tmp_path):
+    """REGRESSION: a second rolling deploy racing the first is
+    refused outright — interleaved deploys would fence more than one
+    replica at a time, and a racing per-replica refresh request is
+    answered 409 instead of silently overwriting the queued one."""
+    d1 = _export_artifact(tmp_path / "g1", scale=1.0)
+    d2 = _export_artifact(tmp_path / "g2", scale=2.0)
+    d3 = _export_artifact(tmp_path / "g3", scale=3.0)
+    with contextlib.ExitStack() as stack:
+        _, reps, router = _fleet(stack, d1, 2)
+        # while a deploy holds the mutex, a second one is refused
+        assert router._deploy_lock.acquire(blocking=False)
+        try:
+            with pytest.raises(FleetError, match="already in progress"):
+                router.rolling_deploy(d3, per_replica_timeout_s=1.0)
+        finally:
+            router._deploy_lock.release()
+        # ... and the released mutex lets the real deploy proceed
+        summary = router.rolling_deploy(d2, per_replica_timeout_s=30.0)
+        assert summary["refreshed"] == [0, 1]
+        # the per-replica guard: a second queued refresh is refused
+        # (the HTTP handler maps False onto 409)
+        assert reps[0].request_refresh(d3) is True
+        assert reps[0].request_refresh(d3) is False
+
+
+# ---------------------------------------------------------------------------
+# probe integration
+# ---------------------------------------------------------------------------
+
+def test_probe_scrape_folds_router_series():
+    """tools/serving_probe.py --metrics-url: the router series land in
+    their own "router" group of the scrape summary."""
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    try:
+        import serving_probe
+    finally:
+        sys.path.pop(0)
+    resilience.record_router_request("ok")
+    resilience.record_router_request("shed")
+    resilience.observe_router_batch(4)
+    resilience.set_router_queue_depth(3)
+    resilience.set_router_inflight(1, 2)
+    with resilience.serve_metrics(port=0) as server:
+        got = serving_probe.scrape_metrics(server.url)
+    router = got["router"]
+    assert router["router_requests_total/ok"] == 1.0
+    assert router["router_requests_total/shed"] == 1.0
+    assert router["router_queue_depth"] == 3.0
+    assert router["router_replica_inflight/replica1"] == 2.0
+    assert router["router_batch_size_count"] == 1.0
+    assert router["router_batch_size_sum"] == 4.0
+
+
+def test_router_host_id_and_validation():
+    assert router_host_id(3) == 3
+    with pytest.raises(ValueError, match="replica_id"):
+        ReplicaMember("/nonexistent", "127.0.0.1:1", 2, 5)
+    with pytest.raises(ValueError, match="n_replicas"):
+        FleetRouter("127.0.0.1:1", 0)

@@ -453,3 +453,22 @@ def test_restore_without_owner_map_is_backward_compatible():
     feed2 = ShardedFeed(_files(8, 5), 4, 0, seed=11, batch_size=2)
     feed2.restore(snap, live=[0, 1, 2])
     assert feed2._owner == {l: [0, 1, 2][l % 3] for l in range(4)}
+
+
+def test_exchange_state_carries_stream_lag():
+    """The window status exchange ships each host's committed stream
+    lag (the agreed input for weighted re-balancing on socket pods):
+    a host that trails the pod reports exactly its sample deficit,
+    computed from the agreed map — no event-log gauge needed."""
+    feeds = [ShardedFeed(_files(4, 6), 2, h, seed=3, batch_size=2)
+             for h in range(2)]
+    assert feeds[0].exchange_state()["lag"] == 0
+    # host 0 advances 3 committed batches; host 1 none
+    for _ in range(3):
+        feeds[0].next_batch()
+    feeds[0].commit()
+    # host 1 observes host 0's committed cursors (the exchange path)
+    feeds[1].observe(feeds[0].exchange_state())
+    assert feeds[1].stream_lag() == 6            # 3 batches x 2
+    assert feeds[1].exchange_state()["lag"] == 6
+    assert feeds[0].stream_lag() == 0

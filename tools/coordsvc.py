@@ -31,12 +31,20 @@ tombstones) in memory. Two distinct failure grades:
 Run it under a supervisor either way.
 
 Usage:
-  python tools/coordsvc.py --n-hosts N [--port P] [--host ADDR]
+  python tools/coordsvc.py --n-hosts N|auto [--port P] [--host ADDR]
                            [--hb-deadline-s S]
 
+``--n-hosts auto`` starts the service without a fixed pod size: the
+size is learned from the FIRST hello that carries one (every
+SocketCoordinator/CoordClient hello does) and is fixed for the
+service's lifetime — later hellos must agree. This is how elastic
+group sizes (e.g. the serving fleet) avoid templating N into two
+places; until that first hello, every other op answers a loud
+"pod size not learned yet" error.
+
 Prints one JSON line ``{"address": "host:port", "n_hosts": N}`` once
-listening (orchestrators parse it to template the worker env), then
-serves until SIGTERM/SIGINT.
+listening (orchestrators parse it to template the worker env;
+``n_hosts`` is null in auto mode), then serves until SIGTERM/SIGINT.
 """
 import argparse
 import json
@@ -48,8 +56,9 @@ import threading
 
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("--n-hosts", type=int, required=True,
-                    help="pod size (host ids 0..N-1)")
+    ap.add_argument("--n-hosts", required=True,
+                    help="pod size (host ids 0..N-1), or 'auto' to "
+                         "learn it from the first hello")
     ap.add_argument("--port", type=int, default=0,
                     help="TCP port (0 = ephemeral, printed on stdout)")
     ap.add_argument("--host", default="0.0.0.0",
@@ -65,9 +74,17 @@ def main(argv=None):
                          "monitor — losses then need mark_lost or a "
                          "gather deadline)")
     args = ap.parse_args(argv)
+    if args.n_hosts == "auto":
+        n_hosts = None
+    else:
+        try:
+            n_hosts = int(args.n_hosts)
+        except ValueError:
+            ap.error("--n-hosts must be an integer or 'auto', got %r"
+                     % args.n_hosts)
     from paddle_tpu.framework.transport import CoordServer
     hb = args.hb_deadline_s if args.hb_deadline_s > 0 else None
-    server = CoordServer(args.n_hosts, port=args.port, host=args.host,
+    server = CoordServer(n_hosts, port=args.port, host=args.host,
                          hb_deadline_s=hb).start()
     # the printed address is what orchestrators template into every
     # worker's SocketCoordinator — it must be DIALABLE from remote
@@ -79,7 +96,7 @@ def main(argv=None):
             if bind_host in ("0.0.0.0", "::", "") else bind_host
     print(json.dumps({"address": "%s:%s" % (adv, port),
                       "bind": server.address,
-                      "n_hosts": args.n_hosts,
+                      "n_hosts": n_hosts,
                       "hb_deadline_s": hb}), flush=True)
     stop = threading.Event()
     for sig in (signal.SIGTERM, signal.SIGINT):

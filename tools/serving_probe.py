@@ -71,8 +71,12 @@ def scrape_metrics(url, timeout_s=5.0):
     "feed" section with the elastic-data-plane series
     (feed_rebalance_total, feed_epoch/feed_stream_lag per host), a
     "transport" section with the pod-transport series
-    (transport_reconnects_total, transport_heartbeat_lag per host) and
-    a "bytes" section with the compressed-movement raw-vs-wire pairs
+    (transport_reconnects_total, transport_heartbeat_lag per host), a
+    "router" section with the serving-fleet series
+    (router_requests_total{outcome=}, router_queue_depth,
+    router_replica_inflight per replica, the router_batch_size
+    histogram samples) and a "bytes" section with the
+    compressed-movement raw-vs-wire pairs
     (collective/stateship/ckpt _bytes_total{kind=}) when the replica
     exports any — or raises (caller folds failures into the health
     report)."""
@@ -82,13 +86,22 @@ def scrape_metrics(url, timeout_s=5.0):
     with urllib.request.urlopen(url, timeout=timeout_s) as resp:
         text = resp.read().decode("utf-8")
     samples = parse_metrics_text(text)
-    events, feed, transport, bytes_sec = {}, {}, {}, {}
+    events, feed, transport, router, bytes_sec = {}, {}, {}, {}, {}
     for name, labels, value in samples:
         if name == METRIC_PREFIX + "_events_total":
             key = labels.get("kind", "?")
             if "host" in labels:
                 key += "/host" + labels["host"]
             events[key] = value
+        elif name.startswith(METRIC_PREFIX + "_router_"):
+            key = name[len(METRIC_PREFIX) + 1:]
+            if "outcome" in labels:
+                key += "/" + labels["outcome"]
+            if "replica" in labels:
+                key += "/replica" + labels["replica"]
+            if "le" in labels:
+                key += "/le" + labels["le"]
+            router[key] = value
         elif name.startswith(METRIC_PREFIX) \
                 and name.endswith("_bytes_total"):
             key = name[len(METRIC_PREFIX) + 1:]
@@ -106,6 +119,8 @@ def scrape_metrics(url, timeout_s=5.0):
         out["feed"] = feed
     if transport:
         out["transport"] = transport
+    if router:
+        out["router"] = router
     if bytes_sec:
         out["bytes"] = bytes_sec
     return out

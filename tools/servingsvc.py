@@ -1,0 +1,115 @@
+#!/usr/bin/env python
+"""Serving-fleet daemons — orchestrator glue for paddle_tpu.serving_fleet.
+
+Two subcommands, one process each:
+
+  replica   one ServingPredictor replica: loads the StableHLO artifact,
+            serves POST /infer over HTTP, and registers as a
+            heartbeat-leased member of the fleet's coordination group
+            (tools/coordsvc.py — run it with --hb-deadline-s armed;
+            --n-hosts auto learns the group size from the first
+            member). A RESTARTED replica finds itself fenced and
+            re-admits through announce/admit/join automatically — just
+            re-run the same command line.
+
+  router    the fleet's front door: continuous micro-batching over the
+            live replica set (coalesce up to --max-batch rows or
+            --batch-deadline-s, least-loaded dispatch from the
+            heartbeat/lost map, shed on a full queue, retry a dead
+            replica's in-flight work on a sibling). POST
+            /admin/deploy {"dir": ...} rolls a weight refresh across
+            the fleet one replica at a time with zero dropped traffic.
+
+Each prints ONE JSON line with its address once serving (orchestrators
+parse it), then runs until SIGTERM/SIGINT.
+
+Usage:
+  python tools/servingsvc.py replica --coord HOST:PORT --n-replicas N
+         --replica-id I --artifact DIR [--port P] [--no-warmup]
+         [--max-in-flight M] [--deadline-s S]
+  python tools/servingsvc.py router --coord HOST:PORT --n-replicas N
+         [--port P] [--max-batch B] [--batch-deadline-s S]
+         [--max-queue Q] [--request-deadline-s S]
+"""
+import argparse
+import json
+import signal
+import sys
+import threading
+
+
+def _serve_until_signal(member, line):
+    print(json.dumps(line), flush=True)
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: stop.set())
+    stop.wait()
+    member.close()
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    rp = sub.add_parser("replica", help="one serving replica")
+    rp.add_argument("--coord", required=True,
+                    help="coordsvc address (host:port)")
+    rp.add_argument("--n-replicas", type=int, required=True)
+    rp.add_argument("--replica-id", type=int, required=True)
+    rp.add_argument("--artifact", required=True,
+                    help="artifact dir (holds serving/)")
+    rp.add_argument("--port", type=int, default=0)
+    rp.add_argument("--host", default="127.0.0.1")
+    rp.add_argument("--no-warmup", dest="warmup", action="store_false")
+    rp.add_argument("--max-in-flight", type=int, default=None)
+    rp.add_argument("--deadline-s", type=float, default=None)
+    rp.add_argument("--ctl-interval-s", type=float, default=0.1)
+    rp.add_argument("--hb-interval-s", type=float, default=0.25)
+    rp.add_argument("--join-timeout-s", type=float, default=30.0)
+
+    ro = sub.add_parser("router", help="the fleet router")
+    ro.add_argument("--coord", required=True)
+    ro.add_argument("--n-replicas", type=int, required=True)
+    ro.add_argument("--port", type=int, default=0)
+    ro.add_argument("--host", default="127.0.0.1")
+    ro.add_argument("--max-batch", type=int, default=8)
+    ro.add_argument("--batch-deadline-s", type=float, default=0.005)
+    ro.add_argument("--max-queue", type=int, default=128)
+    ro.add_argument("--request-deadline-s", type=float, default=10.0)
+    ro.add_argument("--ctl-interval-s", type=float, default=0.1)
+    ro.add_argument("--hb-interval-s", type=float, default=0.25)
+    ro.add_argument("--join-timeout-s", type=float, default=30.0)
+
+    args = ap.parse_args(argv)
+    if args.cmd == "replica":
+        from paddle_tpu.serving_fleet import ReplicaMember
+        member = ReplicaMember(
+            args.artifact, args.coord, args.n_replicas,
+            args.replica_id, port=args.port, host=args.host,
+            warmup=args.warmup, max_in_flight=args.max_in_flight,
+            deadline_s=args.deadline_s,
+            ctl_interval_s=args.ctl_interval_s,
+            hb_interval_s=args.hb_interval_s,
+            join_timeout_s=args.join_timeout_s).start()
+        return _serve_until_signal(
+            member, {"kind": "replica", "replica_id": args.replica_id,
+                     "addr": member.address,
+                     "generation": member.generation})
+    from paddle_tpu.serving_fleet import FleetRouter
+    router = FleetRouter(
+        args.coord, args.n_replicas, port=args.port, host=args.host,
+        max_batch=args.max_batch,
+        batch_deadline_s=args.batch_deadline_s,
+        max_queue=args.max_queue,
+        request_deadline_s=args.request_deadline_s,
+        ctl_interval_s=args.ctl_interval_s,
+        hb_interval_s=args.hb_interval_s,
+        join_timeout_s=args.join_timeout_s).start()
+    return _serve_until_signal(
+        router, {"kind": "router", "addr": router.address,
+                 "url": router.url})
+
+
+if __name__ == "__main__":
+    sys.exit(main())
