@@ -23,4 +23,5 @@ from .coordination import (Coordinator, LocalCoordinator,  # noqa
                            PodResilientTrainer,
                            CoordinationError, HostLostError,
                            NoQuorumError)
-from .transport import CoordServer, CoordClient, TransportError  # noqa
+from .transport import (CoordServer, CoordClient, TransportError,  # noqa
+                        replicated_group)

@@ -798,7 +798,15 @@ class SocketCoordinator(Coordinator):
     ``host_id`` binds the object to its host (the heartbeat identity);
     the per-call ``host_id`` arguments of the contract remain and must
     match in a real deployment. ``heartbeat=False`` builds a passive
-    client (observers, tests driving liveness by hand)."""
+    client (observers, tests driving liveness by hand).
+
+    ``address`` may be a LIST of endpoints (``"h:p1,h:p2"`` or a list)
+    — a term-replicated CoordServer group in index order. Failover is
+    transparent: on primary loss the client rotates to the promoted
+    standby inside its retry budget, contributions replay idempotently
+    by ``(name, host_id, token)``, and a stale ex-primary's responses
+    are refused by term (``transport_stale_primary``) — the trainers
+    above this class run UNMODIFIED through a coordinator SIGKILL."""
 
     def __init__(self, address, n_hosts, host_id, timeout_s=30.0,
                  poll_s=0.01, poll_max_s=0.25, detect_loss=True,
@@ -934,7 +942,24 @@ class SocketCoordinator(Coordinator):
                 % (host_id, resp["fenced"]))
         sleep_s = self.poll_s
         while True:
-            resp = self._call("poll", name=name, host=host_id)
+            try:
+                resp = self._call("poll", name=name, host=host_id)
+            except CoordinationError as e:
+                if "unknown" not in str(e):
+                    raise
+                # "round unknown" AFTER our put landed: the service
+                # failed over to a standby the contribution had not
+                # replicated to yet (a sub-sync-window race). The put
+                # is idempotent keyed by (name, host, token) — re-send
+                # it against the promoted member and keep polling; a
+                # replay the new primary DID inherit answers "resent"
+                resp = self._call("put", name=name, host=host_id,
+                                  value=value, token=token)
+                if "fenced" in resp:
+                    raise HostLostError(
+                        "host %d is fenced (%s) — rejoin, don't resume"
+                        % (host_id, resp["fenced"]))
+                continue
             if "fenced" in resp:
                 raise HostLostError(
                     "host %d is fenced (%s) — rejoin, don't resume"
@@ -1374,7 +1399,9 @@ class ElasticTrainer(PodResilientTrainer):
                  host_id=None, rejoin=True, sync_dir=None,
                  lr_rescale=False, grad_merge_steps=1,
                  lr_rescale_hook=None, drain_after=None,
-                 ship_compress="zlib"):
+                 ship_compress="zlib", drain_floor=None,
+                 drain_cooldown=None, drain_hb_lag_s=None,
+                 drain_stream_lag=None):
         super(ElasticTrainer, self).__init__(
             trainers, coordinator=coordinator, max_restarts=max_restarts,
             host_id=host_id)
@@ -1405,6 +1432,57 @@ class ElasticTrainer(PodResilientTrainer):
                              "critical-straggler windows (or None)")
         self._drain_after = None if drain_after is None \
             else int(drain_after)
+        # straggler-aware drain policy (the ROADMAP carry-over): the
+        # latch that rides the exchange is no longer compute-only.
+        #   drain_hb_lag_s:   a host whose heartbeat-cadence lag gauge
+        #                     (transport_heartbeat_lag) exceeds this
+        #                     many seconds counts flagged — NETWORK
+        #                     stragglers drain too. None disables.
+        #   drain_stream_lag: a host whose agreed feed stream lag
+        #                     (feed_stream_lag, committed samples
+        #                     behind the most-advanced host) exceeds
+        #                     this counts flagged — DATA stragglers
+        #                     drain too. None disables.
+        #   drain_floor:      never drain below this capacity — an int
+        #                     is an absolute minimum of live hosts, a
+        #                     float in (0, 1] a fraction of the full
+        #                     pod. None keeps the historical floor of
+        #                     one surviving host.
+        #   drain_cooldown:   at most ONE host drained per this many
+        #                     windows (None = drain_after): the
+        #                     post-shrink pod must re-observe before a
+        #                     second victim is even considered, so a
+        #                     systemic slowdown can never cascade into
+        #                     serial drains.
+        # All four decisions are computed from the FROZEN window
+        # verdicts, so every live host agrees on them exactly.
+        if drain_floor is not None:
+            if isinstance(drain_floor, float):
+                if not 0.0 < drain_floor <= 1.0:
+                    raise ValueError(
+                        "drain_floor as a fraction must be in (0, 1], "
+                        "got %r" % drain_floor)
+            elif int(drain_floor) < 1:
+                raise ValueError("drain_floor as a host count must be "
+                                 ">= 1, got %r" % drain_floor)
+        self._drain_floor = drain_floor
+        if drain_cooldown is not None and int(drain_cooldown) < 1:
+            raise ValueError("drain_cooldown must be >= 1 windows "
+                             "(or None = drain_after)")
+        self._drain_cooldown = self._drain_after \
+            if drain_cooldown is None and self._drain_after \
+            else (None if drain_cooldown is None
+                  else int(drain_cooldown))
+        if drain_hb_lag_s is not None and float(drain_hb_lag_s) <= 0:
+            raise ValueError("drain_hb_lag_s must be > 0 seconds "
+                             "(or None to ignore heartbeat lag)")
+        self._drain_hb_lag_s = None if drain_hb_lag_s is None \
+            else float(drain_hb_lag_s)
+        if drain_stream_lag is not None and float(drain_stream_lag) <= 0:
+            raise ValueError("drain_stream_lag must be > 0 samples "
+                             "(or None to ignore feed stream lag)")
+        self._drain_stream_lag = None if drain_stream_lag is None \
+            else float(drain_stream_lag)
         # lr_rescale=True: the FIXED-PER-HOST-BATCH regime (per-host
         # feed streams — the global batch shrinks with the dp axis), so
         # capacity changes linearly rescale the learning rate,
@@ -1504,6 +1582,48 @@ class ElasticTrainer(PodResilientTrainer):
             if isinstance(exch, dict) and "lag" in exch:
                 lags[h] = float(exch["lag"])
         return lags or None
+
+    def _hb_lag(self, hid):
+        """This host's heartbeat-cadence lag (the value behind the
+        transport_heartbeat_lag gauge) for the window exchange — 0.0
+        on coordinators without a transport client (Local/File)."""
+        client = getattr(self._coordinator, "_client", None)
+        try:
+            return float(getattr(client, "hb_lag_s", 0.0) or 0.0)
+        except (TypeError, ValueError):   # pragma: no cover - foreign
+            return 0.0
+
+    def _drain_floor_hosts(self):
+        """Minimum live hosts that must REMAIN after a drain."""
+        f = self._drain_floor
+        if f is None:
+            return 1
+        if isinstance(f, float):
+            import math
+            return max(1, int(math.ceil(f * self._coordinator.n_hosts)))
+        return max(1, int(f))
+
+    def _drain_flags(self, verdicts):
+        """Per-host straggler flags for this window, computed from the
+        FROZEN verdicts only (identical on every live host): the
+        compute latch (v[3]), the heartbeat-cadence lag it carried
+        (v[4], vs drain_hb_lag_s) and the agreed feed stream lag
+        (vs drain_stream_lag). Pre-upgrade peers' shorter payloads
+        simply contribute no new signals."""
+        lags = self._agreed_lags(verdicts) or {}
+        flags = {}
+        for h, v in verdicts.items():
+            f = bool(v[3]) if len(v) > 3 else False
+            if not f and self._drain_hb_lag_s is not None and len(v) > 4:
+                try:
+                    f = float(v[4] or 0.0) > self._drain_hb_lag_s
+                except (TypeError, ValueError):
+                    f = False
+            if not f and self._drain_stream_lag is not None \
+                    and h in lags:
+                f = lags[h] > self._drain_stream_lag
+            flags[h] = f
+        return flags
 
     # -- gradient-merge-aware LR rescale (fixed-per-host-batch regime) ----
     def _grad_merge_k(self, n_live):
@@ -1709,9 +1829,12 @@ class ElasticTrainer(PodResilientTrainer):
         step, restarts, rnd = 0, 0, 0
         known_live = sorted(co.live_hosts())
         # proactive-drain accounting: per-host consecutive windows the
-        # critical-straggler flag was up (local to this host's loop —
-        # every host computes it from the same frozen verdicts)
+        # critical-straggler flag was up, plus windows since the last
+        # drain (the cooldown clock; None = never drained). Local to
+        # this host's loop — every host computes both from the same
+        # frozen verdicts, so the decisions agree pod-wide.
         strag_counts = {}
+        since_drain = None
         while step < n:
             rnd += 1
             until_ckpt = ckpt_every - (step % ckpt_every)
@@ -1764,7 +1887,8 @@ class ElasticTrainer(PodResilientTrainer):
             strag = bool(self._straggler_flag(hid))
             try:
                 verdicts = co.all_gather("%sw%d" % (run_tag, rnd), hid,
-                                         [status, pending, exch, strag])
+                                         [status, pending, exch, strag,
+                                          self._hb_lag(hid)])
             except HostLostError:
                 # a peer's timeout fenced US (e.g. this host straggled
                 # past the collective deadline): stop competing
@@ -1890,11 +2014,16 @@ class ElasticTrainer(PodResilientTrainer):
                     # here could differ between hosts mid-tombstone
                     # and diverge the agreement
                     frozen_live = sorted(verdicts)
+                    if since_drain is not None:
+                        since_drain += 1
                     # PROACTIVE DRAIN: the rejoin barriers in reverse —
                     # agree the drain (same frozen verdicts on every
-                    # host), fence at the boundary, shrink next window
-                    flags = {h: bool(v[3]) if len(v) > 3 else False
-                             for h, v in verdicts.items()}
+                    # host), fence at the boundary, shrink next window.
+                    # The latch is straggler-AWARE: compute (v[3]),
+                    # network (heartbeat-cadence lag) and data (agreed
+                    # feed stream lag) signatures all count — see
+                    # _drain_flags.
+                    flags = self._drain_flags(verdicts)
                     for h in list(strag_counts):
                         if h not in flags:
                             strag_counts.pop(h)
@@ -1908,13 +2037,35 @@ class ElasticTrainer(PodResilientTrainer):
                     # collective wait inflating everyone's latency),
                     # there is no victim to drain — draining min(due)
                     # would fence a healthy host and cascade
-                    if due and len(due) < len(frozen_live) \
-                            and len(frozen_live) > 1:
+                    asym = due and len(due) < len(frozen_live) \
+                        and len(frozen_live) > 1
+                    if asym and len(frozen_live) - 1 \
+                            < self._drain_floor_hosts():
+                        # capacity floor: below it a straggling pod is
+                        # still a pod — stalling beats shrinking to
+                        # nothing. Deterministic (frozen membership),
+                        # so every host defers together.
+                        record_event("drain_deferred", reason="floor",
+                                     due=sorted(due), step=step)
+                        asym = False
+                        strag_counts.clear()
+                    if asym and since_drain is not None \
+                            and self._drain_cooldown \
+                            and since_drain < self._drain_cooldown:
+                        # rate limit: at most one host per cooldown
+                        # windows — the post-shrink pod re-observes
+                        # before a second victim is considered
+                        record_event("drain_deferred",
+                                     reason="cooldown",
+                                     due=sorted(due), step=step)
+                        asym = False
+                    if asym:
                         drained = min(due)
                         # full hysteresis: EVERY count resets, so the
                         # post-shrink pod re-observes before it may
                         # drain again (never one host per window)
                         strag_counts.clear()
+                        since_drain = 0
                         record_event(
                             "elastic_drain", drained=drained, step=step,
                             capacity="%d/%d"

@@ -373,10 +373,26 @@ def metrics(event_list=None, by_host=False):
       <prefix>_transport_reconnects_total    socket-coordinator client
                                              reconnects (emitted only
                                              once any occurred)
+      <prefix>_transport_failovers_total     client endpoint failovers
+                                             that reached a serving
+                                             (promoted) coordination
+                                             member (emitted only once
+                                             any occurred)
       <prefix>_transport_heartbeat_lag{host=}  gauge: seconds a host's
                                              liveness heartbeat cadence
                                              is running behind (0 when
                                              healthy)
+      <prefix>_transport_term{host=}         gauge: the replication
+                                             term last observed (per
+                                             client host; the unlabeled
+                                             series is the server's own
+                                             promote/demote view) — a
+                                             host pinned BELOW the
+                                             others is talking to a
+                                             stale ex-primary
+      <prefix>_transport_replication_lag     gauge: ops the furthest-
+                                             behind in-sync standby
+                                             trails the primary
       <prefix>_collective_bytes_total{kind=} raw-vs-wire bytes of the
       <prefix>_stateship_bytes_total{kind=}  block-quantized gradient
       <prefix>_ckpt_bytes_total{kind=}       all-reduce / elastic state
@@ -462,6 +478,14 @@ def metrics(event_list=None, by_host=False):
         counters.append(
             {"name": METRIC_PREFIX + "_transport_reconnects_total",
              "labels": {}, "value": n_reconnect})
+    # coordination-plane HA: failovers are the headline counter (a
+    # SIGKILLed primary costs exactly one per client, not an abort)
+    n_failover = sum(1 for e in evs
+                     if e["kind"] == "transport_failover")
+    if n_failover:
+        counters.append(
+            {"name": METRIC_PREFIX + "_transport_failovers_total",
+             "labels": {}, "value": n_failover})
     # compressed-movement byte accounting (quantized collectives, elastic
     # state ship, checkpoint payloads): raw-vs-wire counter pairs from the
     # cumulative process counters — emitted only for channels that moved
@@ -488,6 +512,7 @@ def metrics(event_list=None, by_host=False):
          "labels": {"replica": str(r)}, "value": n}
         for r, n in sorted(rt["retries"].items())]
     last_epoch, last_lag, last_hb = {}, {}, {}
+    last_term, last_repl_lag = {}, {}
     for e in evs:
         if e["kind"] == "feed_epoch":
             last_epoch[e.get("host")] = e.get("epoch", 0)
@@ -495,11 +520,21 @@ def metrics(event_list=None, by_host=False):
             last_lag[e.get("host")] = e.get("lag", 0)
         elif e["kind"] == "transport_hb_lag":
             last_hb[e.get("host")] = e.get("lag_s", 0.0)
+        elif e["kind"] in ("transport_term", "transport_promote"):
+            # per-client-host term views, plus the server's own
+            # (unlabeled) promote/demote view: a host whose gauge sits
+            # below the others is still trusting a stale ex-primary
+            last_term[e.get("host")] = e.get("term", 0)
+        elif e["kind"] == "transport_repl_lag":
+            last_repl_lag[e.get("host")] = e.get("lag", 0)
     gauges = []
     for name, series in ((METRIC_PREFIX + "_feed_epoch", last_epoch),
                          (METRIC_PREFIX + "_feed_stream_lag", last_lag),
                          (METRIC_PREFIX + "_transport_heartbeat_lag",
-                          last_hb)):
+                          last_hb),
+                         (METRIC_PREFIX + "_transport_term", last_term),
+                         (METRIC_PREFIX + "_transport_replication_lag",
+                          last_repl_lag)):
         gauges += [{"name": name,
                     "labels": {} if h is None else {"host": str(h)},
                     "value": v}

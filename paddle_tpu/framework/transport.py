@@ -26,6 +26,42 @@ stdlib:
     daemon heartbeat thread keeps this host live and feeds the
     observability gauges.
 
+Replication (coordination-plane HA): the service itself is no longer a
+single point of failure. A *replication group* is an ordered list of
+endpoints — one PRIMARY plus N warm STANDBYS, wired by
+``configure_replication(index, peers, standby=)`` (or ``coordsvc
+--peers/--repl-index/--standby``). The group is TERM-numbered:
+
+  * the primary streams every state-mutating op (hello, gather
+    contributions, tombstones, unfence, join announcements, put_info,
+    heartbeat leases) to each standby over the same newline-JSON wire
+    discipline, bootstrapping a late/behind standby from a full state
+    snapshot; round-freezing ops are replicated SYNCHRONOUSLY (bounded
+    by ``repl_sync_timeout_s`` — a dead standby is dropped from the
+    wait set, availability over lockstep) so a promoted standby never
+    rewinds a contribution a client was told landed;
+  * on primary loss — judged by the SAME ``hb_deadline_s`` staleness
+    bound the monitor fences hosts by — the lowest-index live standby
+    promotes with a bumped term and refreshes every liveness lease
+    (failover grace: clients must not be fenced for the primary's
+    death);
+  * every response carries the term, so a stale ex-primary that wakes
+    up is fenced by CLIENTS (a lower term than one already observed is
+    refused and the client fails over), and by PEERS (its replication
+    stream is rejected with the higher term and it demotes itself to
+    standby).
+
+:class:`CoordClient` (and therefore ``SocketCoordinator`` and the whole
+serving fleet) accepts a LIST of endpoints — "h:p1,h:p2" or a list —
+and fails over transparently inside its retry budget: round
+re-submission is idempotent keyed by ``(name, host_id)`` + token, so a
+contribution replayed against the promoted standby is a no-op.
+
+Single-node durability: ``snapshot_path=`` (``coordsvc
+--snapshot-path``) persists periodic state snapshots and reloads on
+start, so a SUPERVISED RESTART resumes in-flight rounds instead of
+aborting them (liveness leases are refreshed on load — restart grace).
+
 Wire protocol: newline-delimited JSON, one request object per line, one
 response object per line, connections long-lived. Values are anything
 JSON encodes — the same envelope FileCoordinator already writes to its
@@ -33,12 +69,20 @@ round files.
 
 Observability (rides ``resilience.metrics()``):
   transport_reconnects_total   counter — client reconnect attempts
+  transport_failovers_total    counter — client endpoint failovers that
+                               reached a serving (promoted) member
   transport_heartbeat_lag      per-host gauge — seconds a host's
                                heartbeat cadence is running behind
                                (0 when healthy; grows during stalls)
+  transport_term               gauge — the replication term last
+                               observed (clients per host; the server
+                               on every promote/demote)
+  transport_replication_lag    gauge — ops the furthest-behind in-sync
+                               standby trails the primary
 """
 import collections
 import json
+import os
 import socket
 import socketserver
 import threading
@@ -46,15 +90,44 @@ import time
 
 from .resilience import RetryPolicy, record_event
 
-__all__ = ["TransportError", "CoordServer", "CoordClient"]
+__all__ = ["TransportError", "CoordServer", "CoordClient",
+           "replicated_group"]
 
 _DEFAULT_HB_INTERVAL_S = 0.5
+# ops the primary must confirm on the standbys before answering the
+# client (round contributions, tombstones, membership): everything a
+# promoted standby must never rewind. hb/ack are ASYNC — leases are
+# refreshed at promotion anyway, and a lost ack only delays cleanup.
+_SYNC_CMDS = frozenset(("hello", "mark_lost", "announce_join",
+                        "unfence", "put", "put_info"))
+_MUTATING_CMDS = _SYNC_CMDS | frozenset(("hb", "ack"))
+_REPL_CMDS = frozenset(("repl_sync", "repl_apply", "repl_snapshot",
+                        "repl_hb"))
 
 
 class TransportError(ConnectionError):
     """The coordination service could not be reached (after retries).
     Subclasses ConnectionError so resilience.classify treats it as
     transient — the caller's RetryPolicy decides when to give up."""
+
+
+def _split_addr(address):
+    host, _, port = str(address).rpartition(":")
+    return (host or "127.0.0.1", int(port))
+
+
+def _probe_status(address, timeout_s=1.0):
+    """One-shot ``status`` probe against a group member; None when the
+    member is unreachable (the promotion dance treats that as dead)."""
+    try:
+        with socket.create_connection(_split_addr(address),
+                                      timeout=timeout_s) as s:
+            s.settimeout(timeout_s)
+            s.sendall(json.dumps({"cmd": "status"}).encode() + b"\n")
+            line = s.makefile("rb").readline()
+        return json.loads(line) if line else None
+    except (OSError, ValueError):
+        return None
 
 
 # ---------------------------------------------------------------------------
@@ -75,6 +148,13 @@ class _PodState(object):
     ``completed`` keeps the most recent frozen round names (bounded
     deque — a long-running service must not grow by one string per
     round forever) for test and tooling introspection.
+
+    Replication metadata lives here too, under the same lock:
+    ``role`` ("primary"/"standby" — solo servers are always primary),
+    ``term`` (bumped on every promotion; every response carries it) and
+    ``applied_seq`` (the replication stream position — on the primary
+    the next op gets ``applied_seq + 1``; a standby applies in exactly
+    that order or asks for a snapshot).
 
     ``n_hosts=None`` starts the service in AUTO-SIZE mode: the pod size
     is learned from the first ``hello`` that carries ``n_hosts`` (every
@@ -99,6 +179,15 @@ class _PodState(object):
         self.hb = {}
         self.info = {}
         self.completed = collections.deque(maxlen=2048)
+        self.role = "primary"
+        self.term = 0
+        self.applied_seq = 0
+        # heartbeat scans are HELD OFF until this monotonic instant: a
+        # freshly promoted (or snapshot-restored) member must give
+        # every client a full deadline of grace to re-dial before it
+        # may fence anyone — their silence was the OLD primary's
+        # death, not theirs
+        self.scan_holdoff = 0.0
 
     # -- callers hold self.lock ------------------------------------------
     def _mark_lost(self, host_id, reason):
@@ -112,7 +201,7 @@ class _PodState(object):
     def _scan_heartbeats(self, now):
         """Tombstone every registered, un-fenced host whose heartbeat is
         older than the deadline. Returns the newly lost ids."""
-        if self.hb_deadline_s is None:
+        if self.hb_deadline_s is None or now < self.scan_holdoff:
             return []
         newly = []
         for hid, last in list(self.hb.items()):
@@ -140,13 +229,524 @@ class _PodState(object):
         r["done"] = sorted(present - set(self.lost))
         self.completed.append(name)
 
+    # -- snapshot ser/de (callers hold self.lock) -------------------------
+    def to_snapshot(self):
+        """JSON-ready full-state snapshot: the standby bootstrap payload
+        AND the on-disk restart format (one encoding, two consumers).
+        Heartbeat leases travel as the SET of leased hosts, not their
+        ages — monotonic clocks do not cross processes, and the loader
+        refreshing every lease to its own ``now`` is exactly the
+        restart/failover grace clients need to re-dial."""
+        return {
+            "v": 1,
+            "n_hosts": self.n_hosts,
+            "term": self.term,
+            "seq": self.applied_seq,
+            "lost": {str(h): r for h, r in self.lost.items()},
+            "lost_version": self.lost_version,
+            "joins": {str(h): n for h, n in self.joins.items()},
+            "rounds": {
+                name: {"values": {str(h): v
+                                  for h, v in r["values"].items()},
+                       "tokens": {str(h): t
+                                  for h, t in r["tokens"].items()},
+                       "done": r["done"],
+                       "acks": sorted(r["acks"])}
+                for name, r in self.rounds.items()},
+            "info": {str(h): v for h, v in self.info.items()},
+            "hb_hosts": sorted(self.hb),
+            "completed": list(self.completed),
+        }
+
+    def load_snapshot(self, snap, now):
+        """Adopt a full snapshot (standby bootstrap / restart resume).
+        Every leased host's heartbeat is refreshed to ``now`` so the
+        grace period for clients to re-dial starts here, not at some
+        other process's epoch."""
+        self.n_hosts = None if snap.get("n_hosts") is None \
+            else int(snap["n_hosts"])
+        self.term = int(snap.get("term", 0))
+        self.applied_seq = int(snap.get("seq", 0))
+        self.lost = {int(h): r for h, r in snap.get("lost", {}).items()}
+        self.lost_version = int(snap.get("lost_version", 0))
+        self.joins = {int(h): int(n)
+                      for h, n in snap.get("joins", {}).items()}
+        self.rounds = {
+            name: {"values": {int(h): v
+                              for h, v in r.get("values", {}).items()},
+                   "tokens": {int(h): t
+                              for h, t in r.get("tokens", {}).items()},
+                   "done": r.get("done"),
+                   "acks": set(r.get("acks", ()))}
+            for name, r in snap.get("rounds", {}).items()}
+        self.info = {int(h): v for h, v in snap.get("info", {}).items()}
+        self.hb = {int(h): now for h in snap.get("hb_hosts", ())}
+        if self.hb_deadline_s is not None:
+            # restart grace, same reasoning as the promotion holdoff
+            self.scan_holdoff = now + self.hb_deadline_s
+        self.completed = collections.deque(snap.get("completed", ()),
+                                           maxlen=2048)
+
+
+# ---------------------------------------------------------------------------
+# replication engine (primary streaming + standby promotion)
+# ---------------------------------------------------------------------------
+
+class _Replication(object):
+    """The warm-standby engine of one group member.
+
+    Owns the per-peer sender threads (primary side: stream ops, push
+    snapshots, collect acks) and the promotion watcher (standby side:
+    judge the primary dead by the heartbeat staleness bound, defer to
+    lower-index live standbys, promote with a bumped term). Role and
+    term live on the shared ``_PodState`` under ITS lock; the op log
+    and ack bookkeeping live here under ``self.cond``. Lock order:
+    ``state.lock`` may be held when taking ``self.cond``, NEVER the
+    reverse."""
+
+    LOG_CAP = 4096
+
+    def __init__(self, server, index, peers, standby,
+                 sync_timeout_s=2.0):
+        self.server = server
+        self.state = server._state
+        self.index = int(index)
+        if isinstance(peers, dict):
+            all_peers = {int(i): str(a) for i, a in peers.items()}
+        else:
+            all_peers = {i: str(a) for i, a in enumerate(peers)}
+        # peers = every OTHER member, keyed by its group index; the
+        # index order IS the promotion priority
+        self.peers = {i: a for i, a in all_peers.items()
+                      if i != self.index}
+        self.cond = threading.Condition()
+        self.log = collections.deque(maxlen=self.LOG_CAP)  # (seq, op)
+        self.acked = {}
+        self.in_sync = {}
+        self.sync_timeout_s = float(sync_timeout_s)
+        self.last_stream = time.monotonic()
+        self.primary_index = None if standby else self.index
+        self._lag_rec_t = 0.0
+        self._stop = threading.Event()
+        self._threads = []
+        self.state.role = "standby" if standby else "primary"
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self):
+        self._discover_incumbent()
+        for pidx, addr in sorted(self.peers.items()):
+            t = threading.Thread(target=self._sender_main,
+                                 args=(pidx, addr), daemon=True,
+                                 name="paddle_tpu-repl-%d>%d"
+                                 % (self.index, pidx))
+            t.start()
+            self._threads.append(t)
+        t = threading.Thread(target=self._watch_main, daemon=True,
+                             name="paddle_tpu-repl-watch-%d"
+                             % self.index)
+        t.start()
+        self._threads.append(t)
+
+    def stop(self, join=True):
+        self._stop.set()
+        with self.cond:
+            self.cond.notify_all()
+        if join:
+            for t in self._threads:
+                t.join(timeout=5.0)
+
+    def _discover_incumbent(self):
+        """Startup term discovery: a member booted as primary (e.g. a
+        restarted ex-primary relaunched with its ORIGINAL flags) probes
+        its peers first — finding a higher term, or a live primary at
+        its own term, it starts as a STANDBY instead of splitting the
+        brain. Fresh groups find nothing and keep their configured
+        roles."""
+        with self.state.lock:
+            if self.state.role != "primary" or not self.peers:
+                return
+            my_term = self.state.term
+        best = None
+        for pidx, addr in sorted(self.peers.items()):
+            st = _probe_status(addr)
+            if not st:
+                continue
+            t = int(st.get("term", 0))
+            if t > my_term or (st.get("role") == "primary"
+                               and t >= my_term):
+                if best is None or t > best[0]:
+                    best = (t, pidx)
+        if best is None:
+            return
+        with self.state.lock:
+            self.state.term = max(self.state.term, best[0])
+            self.state.role = "standby"
+            self.primary_index = best[1]
+            self.last_stream = time.monotonic()
+            term = self.state.term
+        record_event("transport_demote", index=self.index, term=term,
+                     reason="incumbent")
+        record_event("transport_term", term=term)
+
+    # -- primary side ------------------------------------------------------
+    def publish_locked(self, seq, op):
+        """Append one op to the stream (caller holds ``state.lock``;
+        the seq was already taken from ``state.applied_seq``)."""
+        with self.cond:
+            self.log.append((seq, op))
+            self.cond.notify_all()
+
+    def wait_replicated(self, target_seq, timeout_s):
+        """Block until every IN-SYNC standby acked ``target_seq``. On
+        timeout the laggards are dropped from the sync set (they will
+        re-position — possibly via snapshot — when they catch up or
+        reconnect): a dead standby must cost one bounded wait, not the
+        pod's availability."""
+        deadline = time.monotonic() + float(timeout_s)
+        with self.cond:
+            while not self._stop.is_set():
+                waiting = [p for p in self.peers
+                           if self.in_sync.get(p)
+                           and self.acked.get(p, 0) < target_seq]
+                if not waiting:
+                    return True
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    for p in waiting:
+                        self.in_sync[p] = False
+                    record_event("transport_repl_desync",
+                                 peers=sorted(waiting),
+                                 seq=target_seq)
+                    return False
+                self.cond.wait(remaining)
+        return False
+
+    def _ack(self, pidx, have):
+        with self.state.lock:
+            head = self.state.applied_seq
+        now = time.monotonic()
+        with self.cond:
+            self.acked[pidx] = have
+            self.in_sync[pidx] = True
+            self.cond.notify_all()
+            lag = max((head - self.acked.get(p, 0)
+                       for p in self.peers if self.in_sync.get(p)),
+                      default=0)
+            due = now - self._lag_rec_t > 1.0
+            if due:
+                self._lag_rec_t = now
+        # the gauge event is throttled like the hb-lag one: the event
+        # log is bounded and acks run at op rate
+        if due:
+            record_event("transport_repl_lag", lag=lag)
+
+    def _next_entry(self, sent, timeout_s):
+        """The next op past ``sent``: an (seq, op) entry, "snapshot"
+        when the log window no longer covers the gap, or None on idle
+        timeout (the sender then heartbeats)."""
+        deadline = time.monotonic() + timeout_s
+        with self.cond:
+            while not self._stop.is_set():
+                if self.log:
+                    first = self.log[0][0]
+                    if sent + 1 < first:
+                        return "snapshot"
+                    idx = sent + 1 - first
+                    if idx < len(self.log):
+                        return self.log[idx]
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return None
+                self.cond.wait(remaining)
+        return None
+
+    @staticmethod
+    def _rpc(sock, rfile, req):
+        sock.sendall(json.dumps(req).encode() + b"\n")
+        line = rfile.readline()
+        if not line:
+            raise ConnectionError("replication peer closed the stream")
+        return json.loads(line)
+
+    def _observe_higher_term(self, term, pidx=None):
+        """A peer answered with a term beyond ours: adopt it, and if we
+        were primary, DEMOTE — we are the stale ex-primary the fencing
+        exists for. The watcher takes over from here (it may promote us
+        again later if the whole group ahead of us dies)."""
+        demoted = False
+        with self.state.lock:
+            if term > self.state.term:
+                self.state.term = term
+                if self.state.role == "primary":
+                    self.state.role = "standby"
+                    demoted = True
+                self.primary_index = pidx
+                self.last_stream = time.monotonic()
+            new_term = self.state.term
+        if demoted:
+            record_event("transport_demote", index=self.index,
+                         term=new_term, reason="higher_term")
+            record_event("transport_term", term=new_term)
+
+    def _send_snapshot(self, sock, rfile, term):
+        with self.state.lock:
+            snap = self.state.to_snapshot()
+        resp = self._rpc(sock, rfile, {"cmd": "repl_snapshot",
+                                       "term": term,
+                                       "index": self.index,
+                                       "state": snap})
+        if resp.get("repl_reject"):
+            self._observe_higher_term(int(resp.get("term", 0)))
+            raise ConnectionError("snapshot rejected (stale term)")
+        return int(resp.get("have", snap["seq"]))
+
+    def _sender_main(self, pidx, addr):
+        """One peer's replication stream: position (sync/snapshot),
+        then apply-op/heartbeat forever. Parked while this member is a
+        standby; reconnects with a small backoff on socket loss."""
+        backoff = 0.05
+        sock = rfile = None
+        sent = -1
+
+        def drop():
+            for c in (rfile, sock):
+                try:
+                    if c is not None:
+                        c.close()
+                except OSError:
+                    pass
+            with self.cond:
+                self.in_sync[pidx] = False
+                self.cond.notify_all()
+
+        hb_s = self.state.hb_deadline_s
+        idle_s = max(0.05, hb_s / 4.0) if hb_s else 0.5
+        while not self._stop.is_set():
+            with self.state.lock:
+                role = self.state.role
+                term = self.state.term
+                head = self.state.applied_seq
+            if role != "primary":
+                if sock is not None:
+                    drop()
+                    sock = rfile = None
+                self._stop.wait(0.2)
+                continue
+            try:
+                if sock is None:
+                    sock = socket.create_connection(_split_addr(addr),
+                                                    timeout=2.0)
+                    sock.settimeout(max(2.0, self.sync_timeout_s * 2))
+                    rfile = sock.makefile("rb")
+                    resp = self._rpc(sock, rfile,
+                                     {"cmd": "repl_sync", "term": term,
+                                      "seq": head, "index": self.index})
+                    if resp.get("repl_reject"):
+                        self._observe_higher_term(
+                            int(resp.get("term", 0)), pidx)
+                        raise ConnectionError("sync rejected")
+                    have = int(resp.get("have", 0))
+                    with self.cond:
+                        covered = bool(self.log) \
+                            and self.log[0][0] <= have + 1
+                    if have < head and not covered:
+                        have = self._send_snapshot(sock, rfile, term)
+                    sent = have
+                    self._ack(pidx, sent)
+                entry = self._next_entry(sent, idle_s)
+                if entry == "snapshot":
+                    sent = self._send_snapshot(sock, rfile, term)
+                    self._ack(pidx, sent)
+                    continue
+                if entry is None:
+                    resp = self._rpc(sock, rfile,
+                                     {"cmd": "repl_hb", "term": term,
+                                      "seq": head, "index": self.index})
+                else:
+                    seq, op = entry
+                    resp = self._rpc(sock, rfile,
+                                     {"cmd": "repl_apply", "term": term,
+                                      "seq": seq, "index": self.index,
+                                      "op": op})
+                if resp.get("repl_reject"):
+                    self._observe_higher_term(
+                        int(resp.get("term", 0)), pidx)
+                    raise ConnectionError("stream rejected")
+                if resp.get("need_snapshot"):
+                    sent = self._send_snapshot(sock, rfile, term)
+                else:
+                    sent = int(resp.get("have", sent))
+                self._ack(pidx, sent)
+                backoff = 0.05
+            except (OSError, ValueError):
+                drop()
+                sock = rfile = None
+                sent = -1
+                self._stop.wait(backoff)
+                backoff = min(0.5, backoff * 2.0)
+        drop()
+
+    # -- standby side ------------------------------------------------------
+    def _watch_main(self):
+        """Promotion watcher: while standby, judge the primary by the
+        SAME heartbeat staleness bound hosts are fenced by; on
+        staleness, defer to any lower-index live standby (the
+        lowest-index live standby promotes), and never promote past a
+        primary that still answers its status probe."""
+        dl = self.state.hb_deadline_s
+        if dl is None:
+            return   # liveness disabled: promotion is manual-only
+        period = max(0.02, dl / 4.0)
+        while not self._stop.wait(period):
+            with self.state.lock:
+                role = self.state.role
+                term = self.state.term
+            if role != "standby":
+                continue
+            if time.monotonic() - self.last_stream <= dl:
+                continue
+            statuses = {}
+            for pidx, addr in sorted(self.peers.items()):
+                st = _probe_status(addr, timeout_s=max(0.2, dl / 4.0))
+                if st:
+                    statuses[pidx] = st
+            if any(st.get("role") == "primary"
+                   and int(st.get("term", 0)) >= term
+                   for st in statuses.values()):
+                # a live primary exists — our stream is partitioned,
+                # not orphaned. Reset the staleness clock and keep
+                # waiting: promoting here WOULD be the split brain.
+                self.last_stream = time.monotonic()
+                continue
+            if any(pidx < self.index and st.get("role") == "standby"
+                   for pidx, st in statuses.items()):
+                continue   # a lower-index live standby will promote
+            self._promote()
+
+    def _promote(self):
+        with self.state.lock:
+            if self.state.role != "standby":
+                return
+            self.state.term += 1
+            self.state.role = "primary"
+            term = self.state.term
+            now = time.monotonic()
+            # failover grace: every lease restarts NOW — plus a full
+            # extra deadline of scan holdoff, because a client deep in
+            # its reconnect backoff may take longer than one deadline
+            # to land its first post-promotion heartbeat
+            for h in list(self.state.hb):
+                self.state.hb[h] = now
+            if self.state.hb_deadline_s is not None:
+                self.state.scan_holdoff = \
+                    now + self.state.hb_deadline_s
+            self.primary_index = self.index
+            with self.cond:
+                # the promoted log starts empty at applied_seq: peers
+                # behind it re-position via snapshot
+                self.log.clear()
+                self.acked = {}
+                self.in_sync = {}
+                self.cond.notify_all()
+        record_event("transport_promote", index=self.index, term=term)
+        record_event("transport_term", term=term)
+
+    # -- repl request handling (both sides; caller holds state.lock) ------
+    def handle_locked(self, state, req, now):
+        cmd = req.get("cmd")
+        term = int(req.get("term", 0))
+        pidx = req.get("index")
+        pidx = None if pidx is None else int(pidx)
+        if term < state.term:
+            # THE ex-primary fence: a stale incarnation's stream is
+            # refused with the new term; it demotes itself on sight
+            return {"repl_reject": True, "term": state.term}
+        if term == state.term and state.role == "primary":
+            # two primaries at one term (a promotion race): the LOWER
+            # index wins outright — deterministic, no negotiation
+            if pidx is not None and pidx < self.index:
+                state.role = "standby"
+                record_event("transport_demote", index=self.index,
+                             term=state.term, reason="tie_break")
+            else:
+                return {"repl_reject": True, "term": state.term}
+        if term > state.term:
+            state.term = term
+            if state.role == "primary":
+                state.role = "standby"
+                record_event("transport_demote", index=self.index,
+                             term=term, reason="higher_term")
+            record_event("transport_term", term=term)
+        self.last_stream = time.monotonic()
+        if pidx is not None:
+            self.primary_index = pidx
+        if cmd in ("repl_sync", "repl_hb"):
+            return {"ok": True, "have": state.applied_seq,
+                    "term": state.term}
+        if cmd == "repl_apply":
+            seq = int(req.get("seq", 0))
+            if seq <= state.applied_seq:
+                return {"ok": True, "have": state.applied_seq}
+            if seq == state.applied_seq + 1:
+                _apply_replicated(state, req.get("op") or {}, now)
+                state.applied_seq = seq
+                return {"ok": True, "have": seq}
+            return {"need_snapshot": True, "have": state.applied_seq}
+        if cmd == "repl_snapshot":
+            state.load_snapshot(req.get("state") or {}, now)
+            state.term = max(state.term, term)
+            state.role = "standby"
+            return {"ok": True, "have": state.applied_seq}
+        return {"error": "unknown repl cmd %r" % cmd}
+
+    def primary_hint(self):
+        """The current primary's address, best-effort (a standby knows
+        it from the stream metadata; None before the first contact —
+        or once the stream has gone STALE: hinting clients at a
+        primary we ourselves judge dead would ping-pong them between
+        a refused connection and this redirect for the whole
+        promotion window)."""
+        if self.primary_index is None:
+            return None
+        if self.primary_index == self.index:
+            return self.server.address
+        dl = self.state.hb_deadline_s
+        if dl is not None \
+                and time.monotonic() - self.last_stream > dl:
+            return None
+        return self.peers.get(self.primary_index)
+
+
+def _apply_replicated(state, op, now):
+    """Apply one replicated op to standby state (caller holds the
+    lock). The response is discarded — determinism comes from applying
+    the SAME op sequence to the SAME starting snapshot; heartbeat
+    leases land on the standby's own clock, which is exactly what its
+    post-promotion monitor must judge by."""
+    cmd = op.get("cmd")
+    hid = op.get("host")
+    hid = None if hid is None else int(hid)
+    try:
+        _dispatch(state, cmd, hid, op, now)
+    except Exception:   # pragma: no cover - a poison op must not
+        pass            # kill the stream; the state simply skips it
+
 
 class CoordServer(object):
     """The rendezvous service: TCP + threads, stdlib only.
 
-    One per pod. Start in-process (tests, or the host-0 sidecar
-    pattern) or standalone through ``tools/coordsvc.py``. ``port=0``
-    binds an ephemeral port — read it back from :attr:`address`.
+    One per pod — or, replicated, one GROUP per pod (see the module
+    docstring): ``configure_replication(index, peers, standby=)``
+    before :meth:`start` wires this member into a term-numbered
+    primary/warm-standby group; :func:`replicated_group` builds a whole
+    in-process group for tests and benches. ``snapshot_path=`` arms
+    periodic on-disk state snapshots (reloaded on construction) so even
+    a SOLO deployment survives a supervised restart with its in-flight
+    rounds intact.
+
+    Start in-process (tests, or the host-0 sidecar pattern) or
+    standalone through ``tools/coordsvc.py``. ``port=0`` binds an
+    ephemeral port — read it back from :attr:`address`.
     ``n_hosts=None`` starts in auto-size mode: the pod size is learned
     from the first hello that carries one (``tools/coordsvc.py
     --n-hosts auto``) — elastic group sizes without up-front config.
@@ -157,41 +757,116 @@ class CoordServer(object):
     observe the tombstone on their next heartbeat/poll and fire their
     loss hooks. ``None`` disables the monitor (losses then come only
     from explicit ``mark_lost`` / gather deadlines, the FileCoordinator
-    default)."""
+    default). The SAME deadline judges the primary in a replicated
+    group: a standby whose replication stream goes stale past it runs
+    the promotion dance."""
 
     def __init__(self, n_hosts, port=0, host="127.0.0.1",
-                 hb_deadline_s=None):
+                 hb_deadline_s=None, snapshot_path=None,
+                 snapshot_every_s=5.0):
         self._state = _PodState(n_hosts, hb_deadline_s=hb_deadline_s)
+        self._repl = None
+        self._snapshot_path = snapshot_path
+        self._snapshot_every_s = float(snapshot_every_s)
+        if snapshot_path and os.path.exists(snapshot_path):
+            try:
+                with open(snapshot_path) as fh:
+                    snap = json.load(fh)
+                with self._state.lock:
+                    self._state.load_snapshot(snap, time.monotonic())
+                record_event("transport_snapshot_load",
+                             seq=self._state.applied_seq,
+                             term=self._state.term)
+            except (OSError, ValueError):
+                # a torn/unreadable snapshot must not block the
+                # restart: the service comes up empty (the pre-snapshot
+                # behavior) and the next period overwrites it
+                record_event("transport_snapshot_corrupt",
+                             path=str(snapshot_path))
         state = self._state
+        server_self = self
 
         class _Handler(socketserver.StreamRequestHandler):
             def handle(self):
-                while True:
-                    line = self.rfile.readline()
-                    if not line:
-                        return
-                    try:
-                        req = json.loads(line)
-                        resp = _serve(state, req)
-                    except Exception as e:   # malformed request
-                        resp = {"error": "%s: %s" % (type(e).__name__, e)}
-                    self.wfile.write(json.dumps(resp).encode() + b"\n")
-                    self.wfile.flush()
+                # register the live connection: kill()/close() sever
+                # every one of them, because a "dead" member that keeps
+                # answering on long-lived sockets is exactly the stale
+                # primary the chaos tests must reproduce
+                with server_self._conns_lock:
+                    server_self._conns.add(self.connection)
+                try:
+                    while not server_self._dead:
+                        line = self.rfile.readline()
+                        if not line:
+                            return
+                        try:
+                            req = json.loads(line)
+                            resp = _serve(server_self, state, req)
+                        except Exception as e:   # malformed request
+                            resp = {"error": "%s: %s"
+                                    % (type(e).__name__, e)}
+                        self.wfile.write(json.dumps(resp).encode()
+                                         + b"\n")
+                        self.wfile.flush()
+                finally:
+                    with server_self._conns_lock:
+                        server_self._conns.discard(self.connection)
 
         class _Server(socketserver.ThreadingTCPServer):
             allow_reuse_address = True
             daemon_threads = True
 
+        self._conns = set()
+        self._conns_lock = threading.Lock()
         self._server = _Server((host, port), _Handler)
         self.address = "%s:%d" % self._server.server_address[:2]
         self._threads = []
         self._closed = threading.Event()
+        self._dead = False
 
     @property
     def state(self):
         """The live :class:`_PodState` — in-process introspection for
         tests and the host-0 sidecar (read under ``state.lock``)."""
         return self._state
+
+    def configure_replication(self, index, peers, standby=False,
+                              sync_timeout_s=2.0):
+        """Wire this member into a replication group BEFORE start():
+        ``peers`` is the ordered endpoint list (or {index: addr} map)
+        of the WHOLE group — own entry included, skipped by ``index``.
+        ``standby=True`` boots in standby role (waits for the stream);
+        a member booted primary still probes its peers first and defers
+        to a higher-term incumbent (the restarted ex-primary path)."""
+        self._repl = _Replication(self, index, peers, standby,
+                                  sync_timeout_s=sync_timeout_s)
+        return self
+
+    def _replicate_locked(self, op):
+        """Primary-side: take the next stream seq for ``op`` and
+        publish it to the senders. Caller holds ``state.lock``. Returns
+        the seq (to sync-wait on), or None when not replicating."""
+        if self._repl is None or self._state.role != "primary":
+            return None
+        self._state.applied_seq += 1
+        seq = self._state.applied_seq
+        self._repl.publish_locked(seq, op)
+        return seq
+
+    def _scan_and_replicate_locked(self, now):
+        """Heartbeat scan + synthetic-tombstone replication, the ONE
+        home for both fencing paths (the monitor thread and the
+        per-request piggyback): monitor tombstones are mutations with
+        no client op behind them, so the stream carries them as
+        synthetic mark_lost ops. Caller holds ``state.lock``; returns
+        the newly fenced ids."""
+        newly = self._state._scan_heartbeats(now)
+        for hid in newly:
+            self._replicate_locked(
+                {"cmd": "mark_lost", "host": hid,
+                 "reason": self._state.lost.get(hid,
+                                                "missed heartbeat")})
+        return newly
 
     def start(self):
         t = threading.Thread(target=self._server.serve_forever,
@@ -203,22 +878,90 @@ class CoordServer(object):
                                  name="paddle_tpu-coordsvc-hb")
             m.start()
             self._threads.append(m)
+        if self._repl is not None:
+            self._repl.start()
+        if self._snapshot_path:
+            s = threading.Thread(target=self._snapshot_loop, daemon=True,
+                                 name="paddle_tpu-coordsvc-snap")
+            s.start()
+            self._threads.append(s)
         return self
 
     def _monitor(self):
         period = max(0.01, self._state.hb_deadline_s / 4.0)
         while not self._closed.wait(period):
             with self._state.lock:
-                newly = self._state._scan_heartbeats(time.monotonic())
+                if self._state.role != "primary":
+                    continue   # only the primary judges host liveness
+                newly = self._scan_and_replicate_locked(time.monotonic())
             for hid in newly:
                 record_event("hb_lost", host_lost=hid)
 
+    def _snapshot_loop(self):
+        while not self._closed.wait(self._snapshot_every_s):
+            self.save_snapshot()
+
+    def save_snapshot(self):
+        """Persist the full state atomically (temp + replace). A no-op
+        without ``snapshot_path``; called periodically and on close."""
+        if not self._snapshot_path:
+            return None
+        with self._state.lock:
+            blob = json.dumps(self._state.to_snapshot())
+        tmp = "%s.tmp.%d" % (self._snapshot_path, os.getpid())
+        try:
+            with open(tmp, "w") as fh:
+                fh.write(blob)
+            os.replace(tmp, self._snapshot_path)
+        except OSError:   # pragma: no cover - disk trouble
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            return None
+        return self._snapshot_path
+
+    def _sever_connections(self):
+        with self._conns_lock:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
+
     def close(self):
+        if self._dead:
+            return
+        self._dead = True
         self._closed.set()
+        if self._repl is not None:
+            self._repl.stop()
+        self.save_snapshot()
         self._server.shutdown()
+        self._sever_connections()
         self._server.server_close()
         for t in self._threads:
             t.join(timeout=5.0)
+
+    def kill(self):
+        """Abrupt in-process death for chaos tests and benches: stop
+        serving NOW — no final snapshot, no graceful joins, every live
+        connection severed — so peers and clients see exactly what a
+        SIGKILL leaves behind."""
+        if self._dead:
+            return
+        self._dead = True
+        self._closed.set()
+        if self._repl is not None:
+            self._repl.stop(join=False)
+        self._server.shutdown()
+        self._sever_connections()
+        self._server.server_close()
 
     def __enter__(self):
         return self
@@ -227,14 +970,57 @@ class CoordServer(object):
         self.close()
 
 
-def _serve(state, req):
-    """Dispatch one request against the pod state. Every op is
-    idempotent so a client may blindly re-send after a reconnect."""
+def replicated_group(n_hosts, n_members=2, host="127.0.0.1",
+                     hb_deadline_s=1.0, snapshot_paths=None,
+                     sync_timeout_s=2.0):
+    """Build + wire + start a whole in-process replication group:
+    member 0 boots primary, the rest warm standbys, all sharing the
+    ordered endpoint list. Returns the server list (same order as the
+    endpoints clients should dial). Tests and bench_micro ride this;
+    production deploys one ``coordsvc --peers ... --repl-index i``
+    per member instead."""
+    servers = [CoordServer(n_hosts, host=host,
+                           hb_deadline_s=hb_deadline_s,
+                           snapshot_path=None if snapshot_paths is None
+                           else snapshot_paths[i])
+               for i in range(n_members)]
+    addrs = [s.address for s in servers]
+    for i, s in enumerate(servers):
+        s.configure_replication(i, addrs, standby=(i != 0),
+                                sync_timeout_s=sync_timeout_s)
+    for s in servers:
+        s.start()
+    return servers
+
+
+def _serve(server, state, req):
+    """Dispatch one request against the pod state. Every client op is
+    idempotent so a client may blindly re-send after a reconnect (or a
+    failover — the promoted standby holds the replicated state)."""
     cmd = req.get("cmd")
+    now = time.monotonic()
+    if cmd in _REPL_CMDS:
+        repl = server._repl
+        if repl is None:
+            return {"error": "replication not configured on this member"}
+        with state.lock:
+            return repl.handle_locked(state, req, now)
+    if cmd == "status":
+        return _serve_status(server, state, now)
     hid = req.get("host")
     hid = None if hid is None else int(hid)
-    now = time.monotonic()
+    wait_seq = None
     with state.lock:
+        if state.role != "primary":
+            # term-fenced redirect: a standby (or a demoted ex-primary)
+            # serves NOTHING mutable — the client fails over on the
+            # not_primary marker, or rejects a stale term outright
+            hint = None if server._repl is None \
+                else server._repl.primary_hint()
+            return {"not_primary": True, "role": state.role,
+                    "term": state.term, "primary": hint,
+                    "error": "not primary (standby at term %d) — dial "
+                    "the primary" % state.term}
         # both guards read state.n_hosts INSIDE the lock: in auto-size
         # mode a non-hello op racing the first sized hello must see
         # one consistent value — a torn read could skip the range
@@ -253,14 +1039,58 @@ def _serve(state, req):
         # the heartbeat monitor owns proactive scans, but piggybacking
         # one on every request keeps detection sharp under load (and
         # makes the deadline hold even on a paused monitor thread)
-        state._scan_heartbeats(now)
+        server._scan_and_replicate_locked(now)
         resp = _dispatch(state, cmd, hid, req, now)
         if "lost" in resp:
             # every lost map ships with its version: the client drops
             # any map older than one it already applied, so a response
             # processed late cannot resurrect a cleared tombstone
             resp["lost_v"] = state.lost_version
-        return resp
+        if cmd in _MUTATING_CMDS and "error" not in resp \
+                and "fenced" not in resp:
+            seq = server._replicate_locked(dict(req, cmd=cmd))
+            if seq is not None and cmd in _SYNC_CMDS:
+                wait_seq = seq
+        # the term rides EVERY response: the client's staleness fence
+        resp["term"] = state.term
+    if wait_seq is not None:
+        # sync replication happens OUTSIDE the lock: a slow standby
+        # must never serialize the whole service behind its socket
+        server._repl.wait_replicated(wait_seq,
+                                     server._repl.sync_timeout_s)
+    return resp
+
+
+def _serve_status(server, state, now):
+    """The ``status`` probe — served by EVERY role (it is how standbys
+    probe each other during the promotion dance, how coordsvc --status
+    answers operators, and how a restarted ex-primary discovers the
+    incumbent)."""
+    repl = server._repl
+    with state.lock:
+        resp = {"ok": True, "role": state.role, "term": state.term,
+                "seq": state.applied_seq, "n_hosts": state.n_hosts,
+                "hb_deadline_s": state.hb_deadline_s,
+                "address": server.address}
+        if repl is not None:
+            resp["index"] = repl.index
+            resp["peers"] = {str(i): a
+                             for i, a in sorted(repl.peers.items())}
+            resp["primary"] = repl.primary_hint()
+            if state.role == "primary":
+                with repl.cond:
+                    resp["repl_acked"] = {str(p): repl.acked.get(p, 0)
+                                          for p in repl.peers}
+                    resp["repl_in_sync"] = {str(p): bool(
+                        repl.in_sync.get(p)) for p in repl.peers}
+                    resp["repl_lag"] = max(
+                        (state.applied_seq - repl.acked.get(p, 0)
+                         for p in repl.peers if repl.in_sync.get(p)),
+                        default=0)
+            else:
+                resp["stream_age_s"] = round(
+                    now - repl.last_stream, 6)
+    return resp
 
 
 def _dispatch(state, cmd, hid, req, now):
@@ -333,8 +1163,9 @@ def _dispatch(state, cmd, hid, req, now):
         token = req.get("token")
         if hid in r["values"]:
             if r["tokens"].get(hid) == token and token is not None:
-                # the same client re-sending after a reconnect:
-                # idempotent, keyed by (name, host_id, token)
+                # the same client re-sending after a reconnect (or a
+                # FAILOVER onto the promoted standby): idempotent,
+                # keyed by (name, host_id, token)
                 return {"ok": True, "resent": True}
             return {"error": "host %d already contributed to round "
                     "%r — collective names must be unique per round"
@@ -404,8 +1235,36 @@ def _dispatch(state, cmd, hid, req, now):
 # client
 # ---------------------------------------------------------------------------
 
+def _parse_endpoints(address):
+    """Accepts one "host:port", a comma-joined list of them, a
+    ("host", port) pair, or a list/tuple of endpoint strings — the
+    replicated-group client shape. Returns [(host, port), ...] in
+    priority order (primary first, by convention)."""
+    if isinstance(address, (tuple, list)):
+        items = list(address)
+        # a 2-tuple whose second element is a (numeric) port is the
+        # classic (host, port) pair — judged by the PORT, not by a ":"
+        # in the host, so IPv6 literals like ("::1", 9000) keep working
+        if len(items) == 2 and isinstance(items[0], str) and (
+                isinstance(items[1], int)
+                or (isinstance(items[1], str) and items[1].isdigit())):
+            return [(items[0], int(items[1]))]
+        out = []
+        for it in items:
+            out.extend(_parse_endpoints(it))
+        return out
+    out = []
+    for part in str(address).split(","):
+        part = part.strip()
+        if part:
+            out.append(_split_addr(part))
+    if not out:
+        raise ValueError("no endpoint in address %r" % (address,))
+    return out
+
+
 class CoordClient(object):
-    """Request/response client with transparent reconnect.
+    """Request/response client with transparent reconnect AND failover.
 
     One per (process, host_id). All requests serialize on one socket
     under a lock — the heartbeat thread shares it, so ordering is
@@ -415,6 +1274,17 @@ class CoordClient(object):
     ``transport_reconnect`` event per re-dial so
     ``transport_reconnects_total`` counts real network pain.
 
+    ``address`` may be a LIST of endpoints (a replication group, in
+    index order): on socket failure — or on a standby's ``not_primary``
+    redirect — the client rotates to the next endpoint inside the same
+    retry budget, so a primary SIGKILL costs one failover, not an
+    error. Every response's ``term`` is tracked: a response carrying a
+    LOWER term than one already observed comes from a stale ex-primary
+    and is REFUSED (``transport_stale_primary`` event + rotate) — the
+    client-side half of the term fence. Successful endpoint switches
+    count in ``transport_failovers_total``; the observed term rides the
+    ``transport_term`` gauge.
+
     ``hb_interval_s`` starts the daemon heartbeat on :meth:`start_heartbeat`
     callers; each beat refreshes this host's liveness lease and records
     the ``transport_hb_lag`` gauge — seconds the cadence is running
@@ -423,16 +1293,14 @@ class CoordClient(object):
 
     def __init__(self, address, host_id=None, retry_policy=None,
                  connect_timeout_s=5.0, io_timeout_s=30.0):
-        if isinstance(address, (tuple, list)):
-            self._addr = (address[0], int(address[1]))
-        else:
-            host, _, port = address.rpartition(":")
-            self._addr = (host or "127.0.0.1", int(port))
+        self._endpoints = _parse_endpoints(address)
+        self._ep_i = 0
+        self._ep_last_ok = None
         self.host_id = None if host_id is None else int(host_id)
         # the default budget rides out a SUPERVISED RESTART of the
-        # rendezvous service (~5-10s of backoff), not just a dropped
-        # connection — the documented "coordinator death is a transient
-        # outage" promise holds only as long as this budget; pass a
+        # rendezvous service (~5-10s of backoff) — and therefore also a
+        # standby PROMOTION, which completes within the group's
+        # heartbeat deadline — not just a dropped connection; pass a
         # bigger retry_policy for slower orchestrators
         self._policy = retry_policy or RetryPolicy(
             max_attempts=9, base_delay_s=0.1, max_delay_s=2.0)
@@ -458,9 +1326,16 @@ class CoordClient(object):
         # map; we only ever apply forward.
         self._lost_lock = threading.Lock()
         self._lost_v = -1
+        # the term fence: the highest replication term any response
+        # carried. Guarded by _lost_lock (same tiny critical sections).
+        self.term_seen = 0
         # instantaneous heartbeat-cadence lag, updated every beat (the
         # recorded gauge EVENTS are throttled — see _hb_loop)
         self.hb_lag_s = 0.0
+
+    @property
+    def _addr(self):
+        return self._endpoints[self._ep_i]
 
     # -- wire --------------------------------------------------------------
     def _connect_locked(self):
@@ -489,35 +1364,143 @@ class CoordClient(object):
                                   "connection")
         return json.loads(line)
 
+    def _rotate_locked(self, hint=None):
+        """Advance to the next endpoint (or jump to the ``primary``
+        hint a standby handed back). A single-endpoint client only
+        reconnects — there is nowhere to fail over to."""
+        if hint:
+            try:
+                hp = _split_addr(hint)
+            except (ValueError, TypeError):
+                hp = None
+            if hp is not None:
+                if hp not in self._endpoints:
+                    self._endpoints.append(hp)
+                self._ep_i = self._endpoints.index(hp)
+                return
+        if len(self._endpoints) > 1:
+            self._ep_i = (self._ep_i + 1) % len(self._endpoints)
+
+    def _screen_response(self, resp):
+        """Term fence + failover redirect. Returns None to ACCEPT the
+        response, or a ("kind", exception) pair describing why it must
+        be retried on another endpoint: kind "stale" (an ex-primary's
+        lower term, refused) or "standby" (a not-yet-promoted member's
+        redirect — wait and re-probe)."""
+        term = resp.get("term")
+        if term is not None:
+            term = int(term)
+            with self._lost_lock:
+                seen = self.term_seen
+                stale = term < seen
+                if term > seen:
+                    self.term_seen = term
+            if stale:
+                # a response from a lower term than one we already
+                # observed: a stale ex-primary woke up. Refuse it — the
+                # promoted member holds the truth.
+                record_event("transport_stale_primary",
+                             host=self.host_id, term=term, seen=seen)
+                with self._lock:
+                    self._teardown_locked()
+                    self._rotate_locked()
+                return ("stale", ConnectionError(
+                    "stale-term response (term %d < observed %d) — "
+                    "refused and failing over" % (term, seen)))
+            if term > seen:
+                record_event("transport_term", host=self.host_id,
+                             term=term)
+        if resp.get("not_primary"):
+            hint = resp.get("primary")
+            with self._lock:
+                self._teardown_locked()
+                self._rotate_locked(hint)
+            return ("standby", ConnectionError(
+                "endpoint is a standby (term %s) — failing over"
+                % resp.get("term")))
+        return None
+
+    # a standby's redirect means the group EXISTS but is mid-promotion:
+    # the wait is bounded by this wall clock (generous vs any sane
+    # hb_deadline_s) at a tight cadence, NOT by the reconnect attempt
+    # budget at full backoff — burning attempts against a known-alive
+    # group would spend the whole budget before promotion lands
+    _STANDBY_WAIT_S = 30.0
+    _STANDBY_POLL_S = 0.05
+
     def request(self, req):
-        """One request/response round trip; reconnects and re-sends on
-        transient socket failure (requests are idempotent server-side).
-        Raises :class:`TransportError` once the retry budget is spent."""
+        """One request/response round trip; reconnects, re-sends and
+        FAILS OVER across the endpoint list on transient failure
+        (requests are idempotent server-side; stale-term responses are
+        refused; a mid-promotion group is waited out). Raises
+        :class:`TransportError` once the retry budget is spent."""
         payload = json.dumps(req).encode() + b"\n"
         last = None
-        for attempt in range(self._policy.max_attempts):
+        attempt = 0
+        standby_deadline = None
+        while True:
+            resp = None
+            socket_err = False
             with self._lock:
                 if self._closed:
                     raise TransportError("client is closed")
                 try:
-                    return self._roundtrip_locked(payload)
+                    resp = self._roundtrip_locked(payload)
                 except (OSError, ValueError) as e:
                     # ValueError: a torn JSON line from a half-closed
                     # socket — same remedy as any socket error
                     last = e
+                    socket_err = True
                     self._teardown_locked()
-            if attempt + 1 >= self._policy.max_attempts:
+            if resp is not None:
+                verdict = self._screen_response(resp)
+                if verdict is None:
+                    ep = self._ep_i
+                    if self._ep_last_ok is not None \
+                            and self._ep_last_ok != ep:
+                        # the first accepted answer from a NEW endpoint
+                        # after talking to another: one failover landed
+                        record_event("transport_failover",
+                                     host=self.host_id,
+                                     endpoint="%s:%d" % self._addr)
+                    self._ep_last_ok = ep
+                    return resp
+                kind, last = verdict
+                if kind == "standby":
+                    now = time.monotonic()
+                    if standby_deadline is None:
+                        standby_deadline = now + self._STANDBY_WAIT_S
+                    if now >= standby_deadline:
+                        break
+                    self._policy.sleep(self._STANDBY_POLL_S)
+                    continue
+            if socket_err and standby_deadline is not None \
+                    and time.monotonic() < standby_deadline:
+                # a live standby already answered this request: the
+                # group EXISTS, we are only waiting out its promotion.
+                # A refused connection (the dead ex-primary) must not
+                # burn the bounded attempt budget with growing backoff
+                # — rotate and keep the tight promotion-wait cadence.
+                with self._lock:
+                    self._rotate_locked()
+                self._policy.sleep(self._STANDBY_POLL_S)
+                continue
+            attempt += 1
+            if attempt >= self._policy.max_attempts:
                 break
-            delay = self._policy.delay_s(attempt)
-            record_event("transport_reconnect", attempt=attempt + 1,
-                         error=type(last).__name__, backoff_s=delay,
-                         host=self.host_id)
+            delay = self._policy.delay_s(attempt - 1)
+            if socket_err:
+                with self._lock:
+                    self._rotate_locked()
+                record_event("transport_reconnect", attempt=attempt,
+                             error=type(last).__name__, backoff_s=delay,
+                             host=self.host_id)
             self._policy.sleep(delay)
         raise TransportError(
-            "coordination service at %s:%d unreachable after %d "
-            "attempts; last error: %r"
-            % (self._addr[0], self._addr[1], self._policy.max_attempts,
-               last))
+            "coordination service unreachable at %s after %d attempts; "
+            "last error: %r"
+            % (["%s:%d" % ep for ep in self._endpoints],
+               self._policy.max_attempts, last))
 
     def call(self, cmd, **fields):
         """request() + server-error unwrapping. Returns the response

@@ -81,6 +81,13 @@ Deploy via ``tools/servingsvc.py`` (one ``replica`` process per
 replica, one ``router``), against a ``tools/coordsvc.py`` service —
 ``--n-hosts auto`` learns the group size from the first member, and
 ``--hb-deadline-s`` MUST be armed (fleet liveness is the lease).
+
+Coordination-plane HA: ``coord_address`` accepts a LIST of endpoints
+(``"h:p0,h:p1"`` or a list) — a term-replicated coordsvc group
+(``--peers`` mode). Every member's SocketCoordinator/CoordClient then
+fails over transparently to the promoted standby, so a coordinator
+SIGKILL — even mid rolling-deploy — fences nobody, drops no traffic
+and aborts no admission: the fleet battery asserts exactly that.
 """
 import collections
 import json

@@ -539,6 +539,109 @@ def test_elastic_proactive_straggler_drain(tmp_path):
         ElasticTrainer(trainers, LocalCoordinator(3), drain_after=0)
 
 
+def _drain_pod(tmp_path, tag, n_hosts=3, **kw):
+    """ElasticTrainer over plain ResilientTrainers + LocalCoordinator
+    for the drain-policy batteries (the straggler seams are overridden
+    per test)."""
+    main, startup, loss = _elastic_program()
+    trainers = []
+    for h in range(n_hosts):
+        sc, exe = Scope(), pt.Executor()
+        with scope_guard(sc):
+            exe.run(startup)
+        trainers.append(ResilientTrainer(
+            exe, main, str(tmp_path / tag / ("h%d" % h)),
+            fetch_list=[loss], checkpoint_every=3, scope=sc,
+            retry_policy=_fast_policy()))
+    pod = ElasticTrainer(
+        trainers, LocalCoordinator(n_hosts, timeout_s=POD_TIMEOUT_S),
+        rejoin=False, **kw)
+    return pod, trainers
+
+
+def test_drain_weighs_heartbeat_lag_not_just_compute(tmp_path):
+    """Straggler-aware drain (ROADMAP carry-over): a host whose
+    heartbeat-cadence lag (the transport_heartbeat_lag gauge value
+    carried on the window exchange) exceeds drain_hb_lag_s is drained
+    exactly like a compute straggler — the compute latch never fires
+    anywhere."""
+    pod, _ = _drain_pod(tmp_path, "hblag", drain_after=2,
+                        drain_hb_lag_s=0.5)
+    pod._straggler_flag = lambda hid: False        # no compute latch
+    pod._hb_lag = lambda hid: 2.0 if hid == 2 else 0.0
+    out = pod.run(_elastic_feeds(6))
+    drains = resilience.events("elastic_drain")
+    assert drains and {e["drained"] for e in drains} == {2}
+    assert resilience.events("elastic_shrink")
+    assert not resilience.events("pod_restore")
+    assert 2 in pod.coordinator.lost_hosts()
+    for h in (0, 1):
+        assert [i for i, o in enumerate(out[h]) if o is None] == []
+
+
+def test_drain_weighs_agreed_feed_stream_lag(tmp_path):
+    """A DATA straggler drains too: the agreed stream-lag map (each
+    host's feed_stream_lag as carried on the frozen exchange — the
+    `exch["lag"]` slot) crossing drain_stream_lag counts as the latch,
+    again with no compute flag anywhere. The exchange is synthesized
+    through the _agreed_lags seam the weighted-rebalance path already
+    rides."""
+    pod, _ = _drain_pod(tmp_path, "datalag", drain_after=2,
+                        drain_stream_lag=100.0)
+    pod._straggler_flag = lambda hid: False
+    pod._agreed_lags = lambda verdicts: {0: 0.0, 1: 3.0, 2: 500.0}
+    out = pod.run(_elastic_feeds(6))
+    drains = resilience.events("elastic_drain")
+    assert drains and {e["drained"] for e in drains} == {2}
+    assert not resilience.events("pod_restore")
+    for h in (0, 1):
+        assert [i for i, o in enumerate(out[h]) if o is None] == []
+
+
+def test_drain_refuses_below_capacity_floor(tmp_path):
+    """drain_floor: a persistent straggler in a pod AT the floor is
+    never drained — the deferral is agreed from the frozen verdicts
+    (drain_deferred reason=floor on every host) and the run completes
+    at full membership."""
+    pod, _ = _drain_pod(tmp_path, "floor", n_hosts=2, drain_after=1,
+                        drain_floor=2)
+    pod._straggler_flag = lambda hid: hid == 1     # forever flagged
+    out = pod.run(_elastic_feeds(6))
+    assert not resilience.events("elastic_drain")
+    assert not resilience.events("elastic_shrink")
+    deferred = resilience.events("drain_deferred")
+    assert deferred and {e["reason"] for e in deferred} == {"floor"}
+    assert {tuple(e["due"]) for e in deferred} == {(1,)}
+    assert pod.coordinator.lost_hosts() == {}
+    for h in (0, 1):
+        assert [i for i, o in enumerate(out[h]) if o is None] == []
+    # a fractional floor validates like the absolute one
+    with pytest.raises(ValueError, match="drain_floor"):
+        _drain_pod(tmp_path, "badfloor", drain_after=1,
+                   drain_floor=1.5)
+
+
+def test_drain_rate_limited_to_one_host_per_cooldown(tmp_path):
+    """drain_cooldown=k: with TWO persistent stragglers, at most one
+    host drains per k windows — the second stays in rotation until the
+    cooldown elapses (here: past the end of the run), with the
+    deferral recorded. No cascade, ever."""
+    pod, _ = _drain_pod(tmp_path, "cool", n_hosts=3, drain_after=1,
+                        drain_cooldown=50)
+    pod._straggler_flag = lambda hid: hid >= 1     # hosts 1 AND 2 lag
+    out = pod.run(_elastic_feeds(6))
+    drains = resilience.events("elastic_drain")
+    # exactly ONE victim (the lowest due id), despite two stragglers
+    assert {e["drained"] for e in drains} == {1}
+    assert len({e["step"] for e in drains}) == 1
+    deferred = [e for e in resilience.events("drain_deferred")
+                if e["reason"] == "cooldown"]
+    assert deferred and {tuple(e["due"]) for e in deferred} == {(2,)}
+    lost = pod.coordinator.lost_hosts()
+    assert 1 in lost and 2 not in lost
+    assert [i for i, o in enumerate(out[0]) if o is None] == []
+
+
 def test_elastic_transient_fault_still_rewinds(tmp_path):
     """A transient compute fault (preemption) on a full pod is NOT a
     membership change: ElasticTrainer falls back to the parent's

@@ -36,7 +36,9 @@ from paddle_tpu.framework.coordination import (
     PodResilientTrainer, SocketCoordinator)
 from paddle_tpu.framework.resilience import ResilientTrainer, RetryPolicy
 from paddle_tpu.framework.scope import Scope, scope_guard
-from paddle_tpu.framework.transport import CoordServer
+from paddle_tpu.framework.transport import (CoordClient, CoordServer,
+                                            _probe_status,
+                                            replicated_group)
 
 pytestmark = [pytest.mark.faultinject, pytest.mark.pod]
 
@@ -371,8 +373,10 @@ def test_coordsvc_cli_round_trip(tmp_path):
 
 
 def test_probe_scrape_folds_transport_series():
-    """tools/serving_probe.py --metrics-url: the transport gauges land
-    in their own section of the scrape summary."""
+    """tools/serving_probe.py --metrics-url: the transport gauges —
+    the coordination-plane-HA series included — land in their own
+    section of the scrape summary, and --strict's term-regression
+    check flags the stale-primary symptoms."""
     sys.path.insert(0, os.path.join(os.path.dirname(
         os.path.dirname(os.path.abspath(__file__))), "tools"))
     try:
@@ -381,10 +385,261 @@ def test_probe_scrape_folds_transport_series():
         sys.path.pop(0)
     resilience.record_event("transport_reconnect", attempt=1)
     resilience.record_event("transport_hb_lag", host=0, lag_s=0.25)
+    resilience.record_event("transport_failover", host=0,
+                            endpoint="127.0.0.1:1")
+    resilience.record_event("transport_term", host=0, term=2)
+    resilience.record_event("transport_term", host=1, term=2)
+    resilience.record_event("transport_repl_lag", lag=3)
     with resilience.serve_metrics(port=0) as server:
         got = serving_probe.scrape_metrics(server.url)
     assert got["transport"]["transport_reconnects_total"] == 1.0
     assert got["transport"]["transport_heartbeat_lag/host0"] == 0.25
+    assert got["transport"]["transport_failovers_total"] == 1.0
+    assert got["transport"]["transport_term/host0"] == 2.0
+    assert got["transport"]["transport_replication_lag"] == 3.0
+    # healthy: terms agree, no stale events — nothing to flag
+    assert serving_probe.term_regression_flags(got) == []
+    # a client pinned below the group term IS a regression...
+    resilience.record_event("transport_term", host=1, term=1)
+    with resilience.serve_metrics(port=0) as server:
+        got = serving_probe.scrape_metrics(server.url)
+    flags = serving_probe.term_regression_flags(got)
+    assert flags and "transport_term" in flags[0]
+    # ...and so is any observed stale-primary response
+    resilience.record_event("transport_stale_primary", host=0,
+                            term=1, seen=2)
+    with resilience.serve_metrics(port=0) as server:
+        got = serving_probe.scrape_metrics(server.url)
+    flags = serving_probe.term_regression_flags(got)
+    assert any("stale-primary" in f for f in flags)
+
+
+# ---------------------------------------------------------------------------
+# replication units: warm standby, term fencing, snapshots (no jax)
+# ---------------------------------------------------------------------------
+
+def test_replicated_group_streams_state_to_standby():
+    """The primary streams every mutating op: after a gather and a
+    tombstone, the standby holds the same rounds/lost/hb picture at
+    the same stream position — the promoted state a failover lands on."""
+    with contextlib.ExitStack() as stack:
+        servers, cos = _replicated_pod(stack, 3)
+        out, errs = _run_hosts(
+            lambda h: cos[h].all_gather("rg", h, h * 2), 3)
+        assert not errs and out[0] == {0: 0, 1: 2, 2: 4}
+        cos[0].mark_lost(2, "declared")
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            with servers[0].state.lock:
+                head = servers[0].state.applied_seq
+            with servers[1].state.lock:
+                have = servers[1].state.applied_seq
+            if head == have and head > 0:
+                break
+            time.sleep(0.02)
+        assert head == have, (head, have)
+        with servers[1].state.lock:
+            assert servers[1].state.role == "standby"
+            assert servers[1].state.lost == {2: "declared"}
+            assert set(servers[1].state.hb) == {0, 1, 2}
+            assert servers[1].state.rounds == {}   # acks replicated too
+
+
+def test_primary_kill_mid_gather_completes_on_promoted_standby():
+    """THE failover acceptance, in-process: host 0's contribution is
+    in flight when the primary dies abruptly — the standby promotes
+    within the heartbeat deadline, BOTH hosts' clients fail over, the
+    round completes with NO aborted gather and NO double-count, and
+    the failover/term series land in resilience.metrics()."""
+    with contextlib.ExitStack() as stack:
+        servers, cos = _replicated_pod(stack, 2, hb_deadline_s=0.5)
+        out, errs = _run_hosts(
+            lambda h: cos[h].all_gather("warm", h, h), 2)
+        assert not errs
+        box, berrs = {}, {}
+
+        def h0():
+            try:
+                box[0] = cos[0].all_gather("fo", 0, "zero")
+            except Exception as e:
+                berrs[0] = e
+
+        t = threading.Thread(target=h0)
+        t.start()
+        # wait until host 0's put landed on the PRIMARY, then kill it:
+        # the round is mid-flight at the moment of death
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            with servers[0].state.lock:
+                if 0 in servers[0].state.rounds.get(
+                        "fo", {}).get("values", {}):
+                    break
+            time.sleep(0.005)
+        servers[0].kill()
+        box[1] = cos[1].all_gather("fo", 1, "one")
+        t.join(timeout=60)
+        assert not berrs, berrs
+        assert box[0] == box[1] == {0: "zero", 1: "one"}
+        with servers[1].state.lock:
+            assert servers[1].state.role == "primary"
+            assert servers[1].state.term == 1
+        assert resilience.events("transport_promote")
+        assert resilience.events("transport_failover")
+        m = resilience.metrics()
+        names = {c["name"] for c in m["counters"]}
+        assert "paddle_tpu_resilience_transport_failovers_total" \
+            in names
+        terms = {g["labels"].get("host"): g["value"]
+                 for g in m["gauges"]
+                 if g["name"].endswith("_transport_term")}
+        assert terms and set(terms.values()) == {1.0}, terms
+
+
+def test_stale_ex_primary_responses_rejected_by_term():
+    """REGRESSION (the fencing the term exists for): an ex-primary
+    that never learned of the promotion keeps answering from its old
+    term — a client that HAS seen the new term refuses the response
+    (transport_stale_primary), fails over and gets the true state."""
+    with contextlib.ExitStack() as stack:
+        # hb_deadline None: no auto-promotion — the zombie stays primary
+        servers = replicated_group(2, n_members=2, hb_deadline_s=None)
+        for s in servers:
+            stack.callback(s.close)
+        # sever BOTH members' replication channels — and JOIN the
+        # threads before promoting, or a parked sender can slip past
+        # the stop flag and stream the new term to the zombie: the
+        # promotion must never reach it (the full partition that
+        # creates a stale primary)
+        servers[0]._repl.stop()
+        servers[1]._repl.stop()
+        servers[1]._repl._promote()
+        with servers[1].state.lock:
+            assert servers[1].state.role == "primary"
+            assert servers[1].state.term == 1
+        with servers[0].state.lock:
+            assert servers[0].state.role == "primary"   # the zombie
+            assert servers[0].state.term == 0
+        client = CoordClient([servers[1].address, servers[0].address],
+                             host_id=0)
+        stack.callback(client.close)
+        client.call("hello", n_hosts=2)
+        assert client.term_seen == 1
+        # force the next request onto the zombie: the stale term must
+        # be refused, not trusted
+        with client._lock:
+            client._teardown_locked()
+            client._ep_i = 1
+        resp = client.call("lost")
+        assert resp["term"] == 1           # answered by the TRUE primary
+        stale = resilience.events("transport_stale_primary")
+        assert stale and stale[-1]["term"] == 0 \
+            and stale[-1]["seen"] == 1
+
+
+def test_restarted_ex_primary_demotes_to_standby_on_discovery():
+    """A SIGKILLed primary restarted with its ORIGINAL (primary-role)
+    flags probes its peers first, finds the promoted incumbent and
+    boots as a STANDBY at the new term — the same command line is safe
+    across the whole failover lifecycle."""
+    with contextlib.ExitStack() as stack:
+        servers, cos = _replicated_pod(stack, 2, hb_deadline_s=0.5)
+        out, errs = _run_hosts(
+            lambda h: cos[h].all_gather("w", h, h), 2)
+        assert not errs
+        servers[0].kill()
+        # a fresh request drives the failover; promotion happens within
+        # the deadline
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            if cos[0].lost_hosts() == {} \
+                    and servers[1].state.role == "primary":
+                break
+            time.sleep(0.05)
+        with servers[1].state.lock:
+            assert servers[1].state.role == "primary"
+            promoted_term = servers[1].state.term
+        assert promoted_term >= 1
+        # "restart" the ex-primary on its ORIGINAL endpoint (the
+        # address its peers are configured to stream to) with its
+        # original primary-role flags
+        old_port = int(servers[0].address.rsplit(":", 1)[1])
+        restarted = CoordServer(2, port=old_port, hb_deadline_s=0.5)
+        stack.callback(restarted.close)
+        restarted.configure_replication(
+            0, {0: restarted.address, 1: servers[1].address},
+            standby=False)
+        restarted.start()
+        with restarted.state.lock:
+            assert restarted.state.role == "standby"
+            assert restarted.state.term >= promoted_term
+        demotes = resilience.events("transport_demote")
+        assert demotes and demotes[-1]["reason"] == "incumbent"
+        # and it catches back up from the incumbent's stream
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            with servers[1].state.lock:
+                head = servers[1].state.applied_seq
+            with restarted.state.lock:
+                have = restarted.state.applied_seq
+            if head == have and head > 0:
+                break
+            time.sleep(0.02)
+        assert head == have, (head, have)
+
+
+def test_snapshot_restart_resumes_inflight_round(tmp_path):
+    """Single-node durability (--snapshot-path): a supervised restart
+    reloads the persisted state — an in-flight round RESUMES with the
+    pre-restart contribution intact instead of aborting, and liveness
+    leases restart with fresh grace."""
+    snap = str(tmp_path / "coord_state.json")
+    srv = CoordServer(2, hb_deadline_s=5.0, snapshot_path=snap).start()
+    c0 = CoordClient(srv.address, host_id=0)
+    c0.call("hello", n_hosts=2, lease=True)
+    c0.call("put", name="persist", value={"w": 7}, token="t0")
+    c0.call("mark_lost", host=1, reason="kept across restarts")
+    c0.call("unfence", host=1)
+    c0.close()
+    srv.close()                      # close() writes the final snapshot
+    assert os.path.exists(snap)
+
+    srv2 = CoordServer(2, hb_deadline_s=5.0, snapshot_path=snap).start()
+    try:
+        with srv2.state.lock:
+            assert 0 in srv2.state.rounds["persist"]["values"]
+            assert srv2.state.lost == {}
+            assert 0 in srv2.state.hb      # lease refreshed on load
+        c1 = CoordClient(srv2.address, host_id=1)
+        c1.call("put", name="persist", value={"w": 9}, token="t1")
+        resp = c1.call("poll", name="persist")
+        assert resp["done"] == [0, 1]
+        assert resp["values"] == {"0": {"w": 7}, "1": {"w": 9}}
+        # idempotent replay ACROSS the restart: same (name, host,
+        # token) is still a no-op, not a split-brain error
+        assert c1.call("put", name="persist", value={"w": 9},
+                       token="t1").get("resent")
+        c1.close()
+    finally:
+        srv2.close()
+
+
+def test_coordsvc_status_probe(tmp_path):
+    """coordsvc --status end to end: probe a live member, get its
+    role/term/seq; exit 0 iff a primary answered."""
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    try:
+        import coordsvc
+    finally:
+        sys.path.pop(0)
+    with CoordServer(2).start() as srv:
+        code, reports = coordsvc.probe_status([srv.address])
+        assert code == 0
+        assert reports[0]["role"] == "primary"
+        assert reports[0]["term"] == 0 and reports[0]["reachable"]
+    code, reports = coordsvc.probe_status([srv.address])
+    assert code == 2 and reports[0] == {"address": srv.address,
+                                        "reachable": False}
 
 
 # ---------------------------------------------------------------------------
@@ -424,22 +679,48 @@ def _host_trainer(tmp_path, tag, hid, main, startup, loss,
         retry_policy=_fast_policy())
 
 
+def _replicated_pod(stack, n, hb_deadline_s=1.0, timeout_s=POD_TIMEOUT_S,
+                    n_members=2):
+    """A term-replicated CoordServer group (primary + warm standbys) +
+    one SocketCoordinator per host dialing the WHOLE endpoint list,
+    all torn down by the ExitStack."""
+    servers = replicated_group(n, n_members=n_members,
+                               hb_deadline_s=hb_deadline_s)
+    for s in servers:
+        stack.callback(s.close)
+    addrs = [s.address for s in servers]
+    cos = []
+    for h in range(n):
+        co = SocketCoordinator(addrs, n, h, timeout_s=timeout_s,
+                               poll_s=0.002, mesh_reinit=False,
+                               hb_interval_s=0.05)
+        stack.callback(co.close)
+        cos.append(co)
+    return servers, cos
+
+
 def _make_coords(kind, stack, n):
-    """One coordinator handle per host: a shared LocalCoordinator, or
-    per-host SocketCoordinators on a fresh in-process server."""
+    """One coordinator handle per host: a shared LocalCoordinator,
+    per-host SocketCoordinators on a fresh in-process server, or the
+    same over a term-replicated primary+standby group (every client
+    dials the full endpoint list)."""
     if kind == "local":
         co = LocalCoordinator(n, timeout_s=POD_TIMEOUT_S,
                               mesh_reinit=False)
         return [co] * n
+    if kind == "replicated":
+        _, cos = _replicated_pod(stack, n)
+        return cos
     _, cos = _socket_pod(stack, n)
     return cos
 
 
-@pytest.mark.parametrize("kind", ["local", "socket"])
+@pytest.mark.parametrize("kind", ["local", "socket", "replicated"])
 def test_pod_consensus_restore_contract_parity(tmp_path, kind):
     """The pod-recovery acceptance scenario (preempt -> scrub -> elect
     -> every host restores the SAME step -> bitwise replay), in host_id
-    mode, over both transports — PodResilientTrainer unmodified."""
+    mode, over all three transports — the replicated primary+standby
+    group included — PodResilientTrainer unmodified."""
     main, startup, loss = _toy_program()
     feeds = _toy_feeds(6)
 
@@ -469,12 +750,13 @@ def test_pod_consensus_restore_contract_parity(tmp_path, kind):
     assert resilience.events("consensus")
 
 
-@pytest.mark.parametrize("kind", ["local", "socket"])
+@pytest.mark.parametrize("kind", ["local", "socket", "replicated"])
 def test_elastic_die_shrink_rejoin_contract_parity(tmp_path, kind):
     """The elastic acceptance scenario (die mid-run -> survivors shrink
     and continue WITHOUT rewind -> the dead host rejoins through
     announce/admit/join with state shipped via sync_dir), in host_id
-    mode, over both transports — ElasticTrainer unmodified."""
+    mode, over all three transports — the replicated primary+standby
+    group included — ElasticTrainer unmodified."""
     main, startup, loss = _toy_program()
     feeds = _toy_feeds(6)
     with contextlib.ExitStack() as stack:
@@ -650,6 +932,156 @@ def test_procpod_sigkill_shrink_and_rejoin(tmp_path):
             if p.poll() is None:
                 p.kill()
         srv.close()
+
+
+_HA_WORKER = """\
+import json
+import os
+import sys
+import time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+addrs, hid = sys.argv[1], int(sys.argv[2])
+
+from paddle_tpu.framework.coordination import (SocketCoordinator,
+                                               HostLostError)
+from paddle_tpu.framework import resilience
+
+N_HOSTS, N_WINDOWS = 3, 60
+co = SocketCoordinator(addrs, N_HOSTS, hid, timeout_s=60.0,
+                       poll_s=0.005, mesh_reinit=False,
+                       hb_interval_s=0.1)
+for w in range(1, N_WINDOWS + 1):
+    try:
+        got = co.all_gather("w%d" % w, hid, hid * 100 + w)
+    except HostLostError:
+        print("FENCED", hid, w, flush=True)
+        sys.exit(4)
+    if sorted(got) != list(range(N_HOSTS)):
+        print("SHRUNK", hid, w, sorted(got), flush=True)
+        sys.exit(5)
+    if got != {h: h * 100 + w for h in range(N_HOSTS)}:
+        print("CORRUPT", hid, w, got, flush=True)
+        sys.exit(6)
+    time.sleep(0.1)
+m = resilience.metrics()
+fo = [c["value"] for c in m["counters"]
+      if c["name"].endswith("transport_failovers_total")]
+terms = [g["value"] for g in m["gauges"]
+         if g["name"].endswith("_transport_term")]
+print(json.dumps({"done": hid, "windows": w,
+                  "failovers_total": fo[0] if fo else 0,
+                  "stale": len(resilience.events(
+                      "transport_stale_primary")),
+                  "term_gauge": max(terms) if terms else 0,
+                  "term_seen": co._client.term_seen}), flush=True)
+co.close()
+"""
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _spawn_coordsvc(extra_args):
+    tool = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "coordsvc.py")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [p for p in (env.get("PYTHONPATH"),
+                     os.path.dirname(os.path.dirname(tool))) if p])
+    env.pop("XLA_FLAGS", None)
+    return subprocess.Popen(
+        [sys.executable, tool] + extra_args,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env)
+
+
+@pytest.mark.procpod
+def test_procpod_sigkill_coordinator_primary_midwindow(tmp_path):
+    """THE coordination-plane-HA acceptance scenario, over actual OS
+    processes: 3 training workers gather windows against a replicated
+    coordsvc pair (primary + warm standby, real processes). SIGKILL
+    the PRIMARY mid-window — the standby promotes within the heartbeat
+    deadline, every in-flight round completes on it with NO fence, NO
+    shrink and NO aborted gather, and the workers' own metrics show
+    the failover (transport_failovers_total >= 1, term gauge = the
+    promoted term). A RESTARTED ex-primary (same command line, same
+    port) discovers the incumbent and demotes itself to standby — the
+    server half of the term fence."""
+    import json as json_mod
+    p0, p1 = _free_port(), _free_port()
+    peers = "127.0.0.1:%d,127.0.0.1:%d" % (p0, p1)
+    base = ["--n-hosts", "3", "--host", "127.0.0.1",
+            "--hb-deadline-s", "1.0", "--peers", peers]
+    primary_args = base + ["--port", str(p0), "--repl-index", "0"]
+    standby_args = base + ["--port", str(p1), "--repl-index", "1",
+                           "--standby"]
+    script = str(tmp_path / "ha_worker.py")
+    with open(script, "w") as fh:
+        fh.write(textwrap.dedent(_HA_WORKER))
+    procs = {}
+    try:
+        procs["primary"] = _spawn_coordsvc(primary_args)
+        ready = json_mod.loads(procs["primary"].stdout.readline())
+        assert ready["role"] == "primary", ready
+        procs["standby"] = _spawn_coordsvc(standby_args)
+        ready = json_mod.loads(procs["standby"].stdout.readline())
+        assert ready["role"] == "standby", ready
+        for h in range(3):
+            procs[h] = _spawn_worker(script, peers, h, "run")
+        # real window traffic flowing (the stream position grows with
+        # every replicated op), then SIGKILL the primary MID-window
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            st = _probe_status("127.0.0.1:%d" % p0)
+            if st and st.get("seq", 0) >= 40:
+                break
+            time.sleep(0.05)
+        else:
+            raise AssertionError("pod never made window progress")
+        os.kill(procs["primary"].pid, signal.SIGKILL)
+        procs["primary"].wait(timeout=10)
+        # the standby promotes on the SAME staleness bound that fences
+        # hosts — no operator, no declaration
+        deadline = time.monotonic() + 20.0
+        promoted_term = None
+        while time.monotonic() < deadline:
+            st = _probe_status("127.0.0.1:%d" % p1)
+            if st and st.get("role") == "primary":
+                promoted_term = st["term"]
+                break
+            time.sleep(0.05)
+        assert promoted_term is not None and promoted_term >= 1
+        # restart the ex-primary with its ORIGINAL command line: the
+        # incumbent discovery demotes it to standby at the new term
+        procs["re"] = _spawn_coordsvc(primary_args)
+        ready = json_mod.loads(procs["re"].stdout.readline())
+        assert ready["role"] == "standby", ready
+        assert ready["term"] >= promoted_term, ready
+        # every worker finishes every window at FULL membership
+        reports = {}
+        for h in range(3):
+            out, _ = procs[h].communicate(timeout=60)
+            assert procs[h].returncode == 0, (h, out)
+            line = [ln for ln in out.splitlines()
+                    if ln.startswith("{")][-1]
+            reports[h] = json_mod.loads(line)
+        for h, rep in reports.items():
+            assert rep["windows"] == 60, rep
+            # the acceptance metrics: at least one failover landed and
+            # the term gauge sits at the promoted term on every worker
+            assert rep["failovers_total"] >= 1, rep
+            assert rep["term_gauge"] == promoted_term, rep
+            assert rep["term_seen"] == promoted_term, rep
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
 
 
 @pytest.mark.procpod

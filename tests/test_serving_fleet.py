@@ -385,6 +385,94 @@ def test_single_replica_fleet_router_is_the_admitting_survivor(
         assert resilience.events("fleet_adopt")
 
 
+def test_coordinator_primary_killed_mid_rolling_deploy(tmp_path):
+    """ACCEPTANCE (coordination-plane HA x deploy): the fleet rides a
+    REPLICATED coordinator group, and the PRIMARY is killed abruptly
+    mid rolling-deploy under sustained load. The standby promotes
+    within the heartbeat deadline, every member's client fails over
+    transparently (admission rounds included), the deploy COMPLETES,
+    and zero requests fail — the serving plane never notices its
+    control plane died."""
+    from paddle_tpu.framework.transport import replicated_group
+    d1 = _export_artifact(tmp_path / "g1", scale=1.0)
+    d2 = _export_artifact(tmp_path / "g2", scale=2.0)
+    with contextlib.ExitStack() as stack:
+        servers = replicated_group(None, n_members=2,
+                                   hb_deadline_s=2.0)
+        for s in servers:
+            stack.callback(s.close)
+        addrs = [s.address for s in servers]
+        reps = []
+        for i in range(2):
+            rep = ReplicaMember(d1, addrs, 2, i, ctl_interval_s=0.05,
+                                hb_interval_s=0.1,
+                                join_timeout_s=WAIT_S).start()
+            stack.callback(rep.close)
+            reps.append(rep)
+        router = FleetRouter(addrs, 2, max_batch=8,
+                             batch_deadline_s=0.01, ctl_interval_s=0.05,
+                             hb_interval_s=0.1, poll_interval_s=0.03,
+                             join_timeout_s=WAIT_S).start()
+        stack.callback(router.close)
+        _wait(lambda: len(router.routable()) == 2, "2 routable")
+        xv = np.ones((1, 6), np.float32)
+        stop, failures, served = threading.Event(), [], []
+        lock = threading.Lock()
+
+        def load():
+            while not stop.is_set():
+                try:
+                    status, resp = _post(router, {"x": xv.tolist()})
+                except Exception as e:   # noqa: BLE001 - recorded
+                    status, resp = -1, repr(e)
+                with lock:
+                    (served if status == 200 else failures).append(
+                        (status, resp))
+                time.sleep(0.005)
+
+        loaders = [threading.Thread(target=load, daemon=True)
+                   for _ in range(3)]
+        for t in loaders:
+            t.start()
+        time.sleep(0.3)
+        deploy_box = {}
+
+        def deploy():
+            try:
+                deploy_box["summary"] = router.rolling_deploy(
+                    d2, per_replica_timeout_s=60.0)
+            except Exception as e:   # noqa: BLE001 - asserted below
+                deploy_box["error"] = e
+
+        dt = threading.Thread(target=deploy)
+        dt.start()
+        time.sleep(0.25)          # the deploy is mid-flight...
+        servers[0].kill()         # ...when the PRIMARY dies
+        dt.join(timeout=120)
+        assert not dt.is_alive(), "rolling deploy wedged"
+        time.sleep(0.3)
+        stop.set()
+        for t in loaders:
+            t.join(timeout=5)
+        assert "error" not in deploy_box, deploy_box
+        assert deploy_box["summary"]["refreshed"] == [0, 1]
+        assert not failures, failures[:5]
+        assert len(served) > 20
+        assert [m.generation for m in reps] == [2, 2]
+        # traffic really moved to the new weights through it all
+        status, resp = _post(router, {"x": xv.tolist()})
+        assert status == 200
+        np.testing.assert_allclose(np.asarray(resp["outputs"][0]),
+                                   np.full((1, 3), 12.0), rtol=1e-5)
+        # the control plane failed over, term-fenced: the standby is
+        # the primary now and every member observed the bumped term
+        with servers[1].state.lock:
+            assert servers[1].state.role == "primary"
+            assert servers[1].state.term >= 1
+        assert resilience.events("transport_promote")
+        assert resilience.events("transport_failover")
+
+
 # ---------------------------------------------------------------------------
 # the chaos battery: REAL replica processes, SIGKILL under load
 # ---------------------------------------------------------------------------

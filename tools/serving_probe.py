@@ -71,7 +71,9 @@ def scrape_metrics(url, timeout_s=5.0):
     "feed" section with the elastic-data-plane series
     (feed_rebalance_total, feed_epoch/feed_stream_lag per host), a
     "transport" section with the pod-transport series
-    (transport_reconnects_total, transport_heartbeat_lag per host), a
+    (transport_reconnects_total, transport_failovers_total,
+    transport_heartbeat_lag per host, and the coordination-plane-HA
+    series: transport_term per host + transport_replication_lag), a
     "router" section with the serving-fleet series
     (router_requests_total{outcome=}, router_queue_depth,
     router_replica_inflight per replica, the router_batch_size
@@ -126,6 +128,33 @@ def scrape_metrics(url, timeout_s=5.0):
     return out
 
 
+def term_regression_flags(summary):
+    """Stale-primary symptoms in a scrape summary (empty = healthy):
+
+      * any ``transport_stale_primary`` event — a client watched the
+        replication term go BACKWARDS, i.e. an ex-primary woke up and
+        answered from a stale term (the client refused it, but the
+        zombie is still reachable and should be restarted/demoted);
+      * per-host ``transport_term`` gauges disagreeing — some client
+        is still pinned to a lower term than its peers observed, the
+        split-brain smell term fencing exists to catch.
+
+    ``--strict`` fails the probe on either."""
+    flags = []
+    stale = {k: v for k, v in summary.get("events_total", {}).items()
+             if k.startswith("transport_stale_primary")}
+    if stale:
+        flags.append("stale-primary responses observed: %s"
+                     % sorted(stale.items()))
+    terms = {k: v for k, v in summary.get("transport", {}).items()
+             if k.startswith("transport_term")}
+    if len(set(terms.values())) > 1:
+        flags.append("transport_term gauges disagree (a client is "
+                     "pinned below the group term): %s"
+                     % sorted(terms.items()))
+    return flags
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("dirname", help="artifact dir (holds serving/)")
@@ -138,7 +167,9 @@ def main(argv=None):
     ap.add_argument("--strict", action="store_true",
                     help="also require status == 'ok': a deadline miss, "
                          "degraded serve or error during the probe "
-                         "itself fails it")
+                         "itself fails it — and, with --metrics-url, "
+                         "any term regression (stale-primary symptom) "
+                         "in the transport series")
     ap.add_argument("--metrics-url", default=None,
                     help="scrape a resilience.serve_metrics endpoint and "
                          "fold the event totals into the report")
@@ -154,6 +185,13 @@ def main(argv=None):
     if args.metrics_url:
         try:
             health["metrics"] = scrape_metrics(args.metrics_url)
+            flags = term_regression_flags(health["metrics"])
+            if flags:
+                # a term regression means a stale ex-primary is still
+                # answering somewhere: serviceable today, split-brain
+                # fuel tomorrow — loud always, fatal under --strict
+                health["term_regression"] = flags
+                metrics_ok = False
         except Exception as e:
             # a loadable replica with a dead metrics endpoint is still
             # serviceable — degrade to exit 1 only under --strict

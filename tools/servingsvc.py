@@ -23,13 +23,19 @@ Two subcommands, one process each:
 Each prints ONE JSON line with its address once serving (orchestrators
 parse it), then runs until SIGTERM/SIGINT.
 
+``--coord`` accepts a comma-joined endpoint LIST when the coordination
+plane is a replicated coordsvc group (``--peers`` mode): members fail
+over to the promoted standby transparently, so a coordinator SIGKILL
+mid-deploy costs the fleet nothing.
+
 Usage:
-  python tools/servingsvc.py replica --coord HOST:PORT --n-replicas N
-         --replica-id I --artifact DIR [--port P] [--no-warmup]
-         [--max-in-flight M] [--deadline-s S]
-  python tools/servingsvc.py router --coord HOST:PORT --n-replicas N
-         [--port P] [--max-batch B] [--batch-deadline-s S]
-         [--max-queue Q] [--request-deadline-s S]
+  python tools/servingsvc.py replica --coord HOST:PORT[,HOST:PORT...]
+         --n-replicas N --replica-id I --artifact DIR [--port P]
+         [--no-warmup] [--max-in-flight M] [--deadline-s S]
+  python tools/servingsvc.py router --coord HOST:PORT[,HOST:PORT...]
+         --n-replicas N [--port P] [--max-batch B]
+         [--batch-deadline-s S] [--max-queue Q]
+         [--request-deadline-s S]
 """
 import argparse
 import json
@@ -54,7 +60,9 @@ def main(argv=None):
 
     rp = sub.add_parser("replica", help="one serving replica")
     rp.add_argument("--coord", required=True,
-                    help="coordsvc address (host:port)")
+                    help="coordsvc address (host:port), or a comma-"
+                         "joined endpoint list for a replicated "
+                         "coordsvc group (failover is transparent)")
     rp.add_argument("--n-replicas", type=int, required=True)
     rp.add_argument("--replica-id", type=int, required=True)
     rp.add_argument("--artifact", required=True,
