@@ -1,6 +1,13 @@
 """Module-path alias for fluid.compiler (ref
-python/paddle/fluid/compiler.py)."""
-from .framework.compiler import CompiledProgram, BuildStrategy, \
-    ExecutionStrategy  # noqa: F401
+python/paddle/fluid/compiler.py).
 
-__all__ = ["CompiledProgram", "BuildStrategy", "ExecutionStrategy"]
+The compile-plan surface (PR 10): ``CompilePlan`` describes how a
+(program, strategy) pair lowers — trace -> cut -> schedule -> jit — and
+``BuildStrategy(pp_stages=K, pp_micro_batches=M, pp_schedule=...)``
+selects the pipeline lowering (GPipe/1F1B over a "pp" mesh axis).
+"""
+from .framework.compiler import CompiledProgram, BuildStrategy, \
+    ExecutionStrategy, CompilePlan  # noqa: F401
+
+__all__ = ["CompiledProgram", "BuildStrategy", "ExecutionStrategy",
+           "CompilePlan"]

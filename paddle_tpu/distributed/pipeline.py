@@ -53,29 +53,29 @@ def _data_spec(dp_axis):
     return P(None, dp_axis) if dp_axis else P()
 
 
-def pipeline_forward(stage_fn, params_stacked, x_micro, mesh,
-                     axis_name="pp", dp_axis=None):
-    """Run a GPipe forward over the pp ring.
+def pipeline_forward_local(stage_fn, n_stage, n_micro, axis_name="pp",
+                           dp_axis=None, replicate_out=True):
+    """The GPipe forward BODY — runs INSIDE a shard_map over the pp(xdp)
+    mesh. Returns ``fwd(params_me, x_local) -> outputs``: params_me is
+    THIS stage's params (no leading stage dim), x_local all microbatches
+    (dp-sharded batch dim), outputs the last stage's results replicated
+    over pp (psum of the one-hot contribution). Exposed so callers that
+    already live inside one shard_map scope (the CompiledProgram pp
+    path, which also traces the optimizer section in the same scope)
+    can compose it; :func:`pipeline_forward` wraps it for library use.
 
-    stage_fn(stage_params, h) -> h        (same signature every stage)
-    params_stacked: pytree with leading dim n_stage (stage-sharded on pp)
-    x_micro: (n_micro, micro_batch, ...) microbatched input
-    dp_axis: optional second mesh axis the micro-batch dim is sharded over
-    (dp x pp: params replicated over dp, XLA psums their grads there).
-    Returns (n_micro, micro_batch, ...) outputs of the LAST stage.
-    """
-    n_stage = mesh.shape[axis_name]
-    n_micro = x_micro.shape[0]
+    replicate_out=False skips the final pp psum and returns each
+    shard's LOCAL outputs buffer (real results only on the last stage)
+    — what a caller that differentiates INSIDE the shard_map needs:
+    under check_rep=False the psum's transpose miscounts the replicated
+    cotangent, so the loss must be masked to the last stage instead
+    (see pipeline_gpipe_local)."""
     ticks = n_micro + n_stage - 1
     perm = [(i, (i + 1) % n_stage) for i in range(n_stage)]
-
     vary_axes = (axis_name, dp_axis)
 
-    def local_fn(params_local, x_local):
-        # params_local: this stage's params (leading dim 1) ; x_local: all
-        # microbatches (replicated input to stage 0, dp-sharded batch dim)
+    def fwd(params_me, x_local):
         stage = lax.axis_index(axis_name)
-        params_me = jax.tree.map(lambda p: p[0], params_local)
         h_shape = x_local.shape[1:]
         carry_in = _pvary(jnp.zeros(h_shape, x_local.dtype), vary_axes)
         outputs = _pvary(jnp.zeros((n_micro,) + h_shape, x_local.dtype),
@@ -103,11 +103,36 @@ def pipeline_forward(stage_fn, params_stacked, x_micro, mesh,
 
         (carry, outputs), _ = lax.scan(tick, (carry_in, outputs),
                                        jnp.arange(ticks))
+        if not replicate_out:
+            return outputs
         # only the last stage holds real outputs; broadcast to all so the
         # result is replicated (psum of one-hot contribution)
         contrib = jnp.where(stage == n_stage - 1, outputs,
                             jnp.zeros_like(outputs))
         return lax.psum(contrib, axis_name)
+
+    return fwd
+
+
+def pipeline_forward(stage_fn, params_stacked, x_micro, mesh,
+                     axis_name="pp", dp_axis=None):
+    """Run a GPipe forward over the pp ring.
+
+    stage_fn(stage_params, h) -> h        (same signature every stage)
+    params_stacked: pytree with leading dim n_stage (stage-sharded on pp)
+    x_micro: (n_micro, micro_batch, ...) microbatched input
+    dp_axis: optional second mesh axis the micro-batch dim is sharded over
+    (dp x pp: params replicated over dp, XLA psums their grads there).
+    Returns (n_micro, micro_batch, ...) outputs of the LAST stage.
+    """
+    n_stage = mesh.shape[axis_name]
+    n_micro = x_micro.shape[0]
+    fwd = pipeline_forward_local(stage_fn, n_stage, n_micro, axis_name,
+                                 dp_axis)
+
+    def local_fn(params_local, x_local):
+        params_me = jax.tree.map(lambda p: p[0], params_local)
+        return fwd(params_me, x_local)
 
     fn = shard_map(
         local_fn, mesh=mesh,
@@ -115,6 +140,49 @@ def pipeline_forward(stage_fn, params_stacked, x_micro, mesh,
                   _data_spec(dp_axis)),
         out_specs=_data_spec(dp_axis))
     return fn(params_stacked, x_micro)
+
+
+def pipeline_gpipe_local(stage_fn, loss_fn, n_stage, n_micro,
+                         axis_name="pp", dp_axis=None):
+    """GPipe loss+grads BODY for single-shard_map callers (the
+    CompiledProgram pp path): ``step(params_me, x_local, y_local) ->
+    (loss, grads_me)`` with autodiff run INSIDE the shard_map scope
+    (vjp of the local forward; ppermute/psum transpose to the reverse
+    ring). loss_fn(h_m, y_m) -> scalar per-microbatch loss; loss/grads
+    are the mean over microbatches, pp-replicated. Like
+    :func:`pipeline_1f1b_local` the dp reduction is LEFT TO THE CALLER
+    (grads come back dp-varying) so a quantized or otherwise custom dp
+    gradient sync can slot in."""
+    # NO final psum in the differentiated forward: under check_rep=False
+    # the psum transpose miscounts a replicated cotangent. The loss is
+    # masked to the last stage instead — its cotangent rides the reverse
+    # ppermute ring back through the stages, and the scalar loss is
+    # pp-psum'd OUTSIDE the grad computation.
+    fwd = pipeline_forward_local(stage_fn, n_stage, n_micro, axis_name,
+                                 dp_axis, replicate_out=False)
+
+    def step(params_me, x_local, y_local):
+        stage = lax.axis_index(axis_name)
+        is_last = stage == n_stage - 1
+        # dp-varying params keep each shard's cotangent local; the
+        # caller runs ONE dp reduction for the whole step (same trick
+        # as pipeline_1f1b_step's params_vjp)
+        params_vjp = params_me if dp_axis is None else jax.tree.map(
+            lambda p: _pvary(p, (dp_axis,)), params_me)
+
+        def total(ps):
+            out = fwd(ps, x_local)
+            losses = jax.vmap(loss_fn)(out, y_local)
+            local = jnp.mean(losses.astype(jnp.float32))
+            # non-last stages ran loss_fn on their (zeros) local buffer:
+            # mask it out — where's vjp seeds the untaken side with zero
+            return jnp.where(is_last, local, 0.0)
+
+        loss, grads = jax.value_and_grad(total)(params_vjp)
+        loss = lax.psum(loss, axis_name)
+        return loss, grads
+
+    return step
 
 
 def pipeline_loss_and_grads(stage_fn, loss_fn, params_stacked, x_micro,
@@ -164,6 +232,40 @@ def pipeline_1f1b_step(stage_fn, loss_fn, params_stacked, x_micro, y_micro,
     """
     n_stage = mesh.shape[axis_name]
     n_micro = x_micro.shape[0]
+    step = pipeline_1f1b_local(stage_fn, loss_fn, n_stage, n_micro,
+                               axis_name, dp_axis)
+
+    def local_fn(params_local, x_local, y_local):
+        params_me = jax.tree.map(lambda p: p[0], params_local)
+        loss, grads = step(params_me, x_local, y_local)
+        if dp_axis is not None:
+            # one batched dp reduction for the whole step (see the
+            # params_vjp note inside the local body)
+            loss = lax.pmean(loss, dp_axis)
+            grads = jax.tree.map(lambda g: lax.pmean(g, dp_axis), grads)
+        grads = jax.tree.map(lambda g: g[None], grads)
+        return loss, grads
+
+    fn = shard_map(
+        local_fn, mesh=mesh,
+        in_specs=(jax.tree.map(lambda _: P(axis_name), params_stacked),
+                  _data_spec(dp_axis),
+                  jax.tree.map(lambda _: _data_spec(dp_axis), y_micro)),
+        out_specs=(P(), jax.tree.map(lambda _: P(axis_name), params_stacked)))
+    return fn(params_stacked, x_micro, y_micro)
+
+
+def pipeline_1f1b_local(stage_fn, loss_fn, n_stage, n_micro,
+                        axis_name="pp", dp_axis=None):
+    """The 1F1B schedule BODY — runs INSIDE a shard_map over the pp(xdp)
+    mesh: ``step(params_me, x_local, y_local) -> (loss, grads_me)``.
+    params_me/grads_me carry NO leading stage dim (this shard's stage);
+    loss is the microbatch mean, pp-replicated via psum. The dp
+    reduction is deliberately LEFT TO THE CALLER — grads (and loss)
+    come back dp-varying so a custom sync (e.g. the quantized
+    collectives' quantize->psum->dequantize) can replace the plain
+    pmean. :func:`pipeline_1f1b_step` wraps this with the shard_map +
+    pmean defaults."""
     ticks = n_micro + 2 * (n_stage - 1)
     slots = 2 * n_stage
     perm_fwd = [(i, (i + 1) % n_stage) for i in range(n_stage)]
@@ -171,9 +273,8 @@ def pipeline_1f1b_step(stage_fn, loss_fn, params_stacked, x_micro, y_micro,
 
     vary_axes = (axis_name, dp_axis)
 
-    def local_fn(params_local, x_local, y_local):
+    def step(params_me, x_local, y_local):
         stage = lax.axis_index(axis_name)
-        params_me = jax.tree.map(lambda p: p[0], params_local)
         h_shape = x_local.shape[1:]
         dtype = x_local.dtype
         zero_h = jnp.zeros(h_shape, dtype)
@@ -249,17 +350,6 @@ def pipeline_1f1b_step(stage_fn, loss_fn, params_stacked, x_micro, y_micro,
         state, _ = lax.scan(tick, init, jnp.arange(ticks))
         loss = lax.psum(state["loss_acc"], axis_name) / n_micro
         grads = jax.tree.map(lambda g: g / n_micro, state["grad_acc"])
-        if dp_axis is not None:
-            # one batched dp reduction for the whole step (see params_vjp)
-            loss = lax.pmean(loss, dp_axis)
-            grads = jax.tree.map(lambda g: lax.pmean(g, dp_axis), grads)
-        grads = jax.tree.map(lambda g: g[None], grads)
         return loss, grads
 
-    fn = shard_map(
-        local_fn, mesh=mesh,
-        in_specs=(jax.tree.map(lambda _: P(axis_name), params_stacked),
-                  _data_spec(dp_axis),
-                  jax.tree.map(lambda _: _data_spec(dp_axis), y_micro)),
-        out_specs=(P(), jax.tree.map(lambda _: P(axis_name), params_stacked)))
-    return fn(params_stacked, x_micro, y_micro)
+    return step

@@ -91,11 +91,16 @@ def _stage_io(block, ops):
     return params, external[0], out
 
 
-def extract_pipeline_plan(program, loss_name, schedule="1f1b", n_micro=1):
-    """Partition `program` into the homogeneous-stage pipeline plan."""
+def extract_pipeline_plan(program, loss_name, schedule="1f1b", n_micro=1,
+                          ops=None):
+    """Partition `program` into the homogeneous-stage pipeline plan.
+
+    ``ops`` restricts the partition to an explicit op list (the
+    CompiledProgram path passes the FORWARD section of a minimized
+    program; the fleet path leaves it None = every op in the block)."""
     blk = program.global_block()
     staged, tail, head = {}, [], []
-    for op in blk.ops:
+    for op in (blk.ops if ops is None else ops):
         s = op.attrs.get("pp_stage")
         if s is None:
             (tail if staged else head).append(op)
@@ -340,3 +345,243 @@ def make_update_fn(inner):
             "pipeline path supports SGD/Momentum/Adam/AdamW (v1); got %s"
             % name)
     return init_fn, update_fn
+
+
+# ---------------------------------------------------------------------------
+# CompiledProgram pp path: cut a MINIMIZED program (fwd + backward + update
+# sections) for the single-shard_map pipelined step. Unlike the fleet path
+# above (which replaces the optimizer with a functional twin), this cut
+# keeps the program's OWN update section — optimizer ops, LR schedules,
+# gradient-merge accumulation, grad clip — and re-runs it SPMD per stage.
+# ---------------------------------------------------------------------------
+
+class CompiledPPCut(object):
+    """Everything the compiler needs to lower a minimized program through
+    the GPipe/1F1B schedules inside one shard_map:
+
+      plan         -- the forward-section PipelinePlan (stages + tail)
+      update_ops   -- [(op, stage|None)] the post-backward non-grad ops in
+                      program order; stage 0 + shared (None) ops are
+                      traced, stage >= 1 ops are the SPMD copies the pp
+                      shards realize implicitly
+      stage_state  -- {template_name: [per-stage var names]} persistable
+                      state stacked on the pp axis (params + optimizer
+                      accumulators + grad-merge buffers)
+      shared_state -- sorted per-replica persistable names (LR vars,
+                      merge step counters): replicated on every shard
+      loss_name    -- the var the backward section seeds
+    """
+
+    __slots__ = ("plan", "update_ops", "stage_state", "shared_state",
+                 "loss_name")
+
+    def __init__(self, **kw):
+        for k in self.__slots__:
+            setattr(self, k, kw.get(k))
+
+    def signature(self):
+        """Cut identity for the executor compile-cache token."""
+        return (self.plan.n_stage, self.plan.schedule, self.plan.n_micro,
+                tuple(self.plan.template_params),
+                tuple(sorted(self.stage_state)),
+                tuple(self.shared_state), self.loss_name)
+
+
+def _map_stage_name(mapping, a, b, s):
+    """Record stage-0 name ``a`` <-> stage-``s`` name ``b``; a name that
+    maps two ways means the update sections are not positionally
+    parallel — a cut we cannot run SPMD."""
+    prev = mapping.get(a)
+    if prev is None:
+        mapping[a] = b
+    elif prev != b:
+        raise ValueError(
+            "update section of pipeline stage %d is not positionally "
+            "parallel to stage 0: stage-0 var %r maps to both %r and %r"
+            % (s, a, prev, b))
+
+
+def extract_compiled_pp_plan(program, n_stage=None, schedule="1f1b",
+                             n_micro=1):
+    """Cut a MINIMIZED program for the CompiledProgram pipeline path.
+
+    The program is split at op_role boundaries: the forward section is
+    stage-partitioned exactly like the fleet path (``pp_stage`` stamps,
+    or an even op-count auto-cut when unstamped), the backward section
+    is DROPPED (the schedule's in-shard_map autodiff replaces it), and
+    the update section (everything after backward that is not a grad
+    op: optimizer ops, LR schedule, gradient-merge accumulation) is
+    validated to be per-stage homogeneous so each pp shard can run the
+    stage-0 template on its own slice of the stacked state."""
+    from ..framework.trace import GRAD_SUFFIX
+    blk = program.global_block()
+    ops = blk.ops
+    first_bwd = next((i for i, op in enumerate(ops)
+                      if op.attrs.get("op_role") == "backward"), None)
+    if first_bwd is None:
+        raise ValueError(
+            "the CompiledProgram pipeline path lowers the whole "
+            "fwd+bwd+optimizer step — minimize() the loss first (the "
+            "program has no backward section)")
+    seed_op = ops[first_bwd]
+    if seed_op.type != "fill_any_like" or "X" not in seed_op.inputs:
+        raise ValueError(
+            "cannot identify the loss: the backward section does not "
+            "start with the append_backward seed (multi-target "
+            "gradients() programs are not supported on the pp path)")
+    loss_name = seed_op.inputs["X"][0]
+    fwd_ops = ops[:first_bwd]
+
+    stamped = any("pp_stage" in op.attrs for op in fwd_ops)
+    if stamped:
+        plan = extract_pipeline_plan(program, loss_name, schedule=schedule,
+                                     n_micro=n_micro, ops=fwd_ops)
+        if n_stage is not None and plan.n_stage != int(n_stage):
+            raise ValueError(
+                "BuildStrategy.pp_stages=%d but the program is stamped "
+                "with %d pipeline stages" % (int(n_stage), plan.n_stage))
+    else:
+        if not n_stage or int(n_stage) < 2:
+            raise ValueError(
+                "auto-cut needs BuildStrategy.pp_stages >= 2 when the "
+                "program carries no pp_stage stamps")
+        plan = _auto_stamp(program, fwd_ops, int(n_stage), loss_name,
+                           schedule, n_micro)
+
+    # ---- update section ---------------------------------------------------
+    update_all = [op for op in ops[first_bwd:]
+                  if op.attrs.get("op_role") != "backward"]
+    stage_of = {}
+    for s in range(plan.n_stage):
+        for pname in plan.stage_params[s]:
+            stage_of[pname] = s
+            stage_of[pname + GRAD_SUFFIX] = s
+    tagged = []
+    for op in update_all:
+        stages = {stage_of[nm] for nm in op.input_names()
+                  if nm in stage_of}
+        if len(stages) > 1:
+            raise ValueError(
+                "update op {%s} reads state of multiple pipeline stages "
+                "(%r) — cross-stage update ops (e.g. a global grad-norm "
+                "clip) are not supported on the pp path (v1)"
+                % (op.type, sorted(stages)))
+        s = stages.pop() if stages else None
+        tagged.append((op, s))
+        if s is not None:
+            for nm in op.output_names():
+                stage_of[nm] = s
+
+    groups = {s: [op for op, st in tagged if st == s]
+              for s in range(plan.n_stage)}
+    sig0 = _stage_signature(groups[0])
+    for s in range(1, plan.n_stage):
+        if _stage_signature(groups[s]) != sig0:
+            raise ValueError(
+                "the update section for pipeline stage %d is not "
+                "structurally identical to stage 0's — the SPMD pp path "
+                "runs ONE update template on every stage's slice" % s)
+
+    # positional stage-0 -> stage-s name maps (how the per-stage
+    # optimizer state columns line up under the template)
+    name_maps = [dict() for _ in range(plan.n_stage)]
+    for s in range(1, plan.n_stage):
+        for op0, op_s in zip(groups[0], groups[s]):
+            for slot in op0.inputs:
+                for a, b in zip(op0.inputs[slot],
+                                op_s.inputs.get(slot, [])):
+                    _map_stage_name(name_maps[s], a, b, s)
+            for slot in op0.outputs:
+                for a, b in zip(op0.outputs[slot],
+                                op_s.outputs.get(slot, [])):
+                    _map_stage_name(name_maps[s], a, b, s)
+
+    def _persistable(nm):
+        var = blk._find_var_recursive(nm)
+        return var is not None and getattr(var, "persistable", False)
+
+    stage_state = {}
+    for j, tname in enumerate(plan.template_params):
+        stage_state[tname] = [plan.stage_params[s][j]
+                              for s in range(plan.n_stage)]
+    for op0 in groups[0]:
+        for nm in op0.output_names():
+            if nm in stage_state or not _persistable(nm):
+                continue
+            cols = [nm] + [name_maps[s].get(nm, nm)
+                           for s in range(1, plan.n_stage)]
+            if len(set(cols)) != plan.n_stage:
+                raise ValueError(
+                    "per-stage update state %r does not map to a "
+                    "distinct var per stage (got %r) — the stages "
+                    "share state the SPMD cut cannot stack" % (nm, cols))
+            stage_state[nm] = cols
+    all_stage_names = {n for cols in stage_state.values() for n in cols}
+    shared = set()
+    for op, s in tagged:
+        for nm in op.input_names() + op.output_names():
+            if nm not in all_stage_names and _persistable(nm):
+                shared.add(nm)
+    return CompiledPPCut(plan=plan, update_ops=tagged,
+                         stage_state=stage_state,
+                         shared_state=sorted(shared),
+                         loss_name=loss_name)
+
+
+def _auto_stamp(program, fwd_ops, n_stage, loss_name, schedule, n_micro):
+    """Even op-count auto-cut: stamp the LONGEST prefix of the forward
+    section that splits into n_stage structurally identical, chaining
+    segments; the remainder is the loss tail. Stamps stick (the program
+    is mutated once; its version bumps so compiled steps re-key)."""
+    n = len(fwd_ops)
+    if n < n_stage:
+        raise ValueError(
+            "auto-cut cannot split %d forward ops into %d pipeline "
+            "stages — lower pp_stages or stamp the model explicitly "
+            "with pp_stage_guard(stage)" % (n, n_stage))
+    last_err = None
+    for seg in range(n // n_stage, 0, -1):
+        cut = seg * n_stage
+        for i, op in enumerate(fwd_ops):
+            if i < cut:
+                op.attrs["pp_stage"] = i // seg
+            else:
+                op.attrs.pop("pp_stage", None)
+        try:
+            plan = extract_pipeline_plan(program, loss_name,
+                                         schedule=schedule,
+                                         n_micro=n_micro, ops=fwd_ops)
+            program._version += 1
+            return plan
+        except ValueError as e:
+            last_err = e
+    for op in fwd_ops:
+        op.attrs.pop("pp_stage", None)
+    raise ValueError(
+        "auto-cut could not split the %d forward ops into %d "
+        "homogeneous pipeline stages — stamp the model explicitly with "
+        "pp_stage_guard(stage). Last attempt failed with: %s"
+        % (n, n_stage, last_err))
+
+
+def make_update_trace_fn(program, cut):
+    """The in-shard_map update-section runner: ``update(env)`` traces the
+    stage-0 template + shared update ops IN PROGRAM ORDER over an env
+    holding this shard's stage slice (template names), the schedule's
+    dp-synced gradients and the replicated shared state. Mutates env."""
+    from ..framework.trace import TraceContext, trace_op, GRAD_SUFFIX
+
+    ops_to_run = [op for op, s in cut.update_ops if s in (None, 0)]
+
+    def update(env):
+        ctx = TraceContext(program,
+                           jax.random.PRNGKey(program.random_seed))
+        # the schedule already dp-synced the injected grads — the
+        # quantized-collectives trace hook must not re-sync anything
+        # the update section happens to (re)bind
+        ctx.synced_grads.update(
+            t + GRAD_SUFFIX for t in cut.plan.template_params)
+        for i, op in enumerate(ops_to_run):
+            trace_op(op, env, ctx, rng_tag=8000003 + i)
+
+    return update

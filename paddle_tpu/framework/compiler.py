@@ -8,11 +8,23 @@ partitions the single fused HLO and inserts ICI collectives (AllReduce/
 AllGather/ReduceScatter) automatically — the north-star design.
 """
 import os
+import threading
 
 import numpy as np
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# XLA's CPU backend runs MANUAL collectives (shard_map ppermute /
+# all_gather — "cross_module" kind) through a process-global rendezvous:
+# two executions in flight from different threads interleave their
+# per-device participant arrivals across run_ids and deadlock (observed
+# live: pipeline steps from 3 simulated pod hosts each stuck waiting for
+# "all participants"). Executions that embed manual collectives
+# therefore serialize through this lock ON CPU ONLY — real accelerator
+# backends rendezvous per-execution, and production pods are one
+# process per host anyway.
+_MANUAL_COLLECTIVE_LOCK = threading.Lock()
 
 
 def _env_timeout_default():
@@ -36,10 +48,14 @@ class BuildStrategy(object):
       - mesh_axes: dict axis name -> size, e.g. {"dp": 2, "mp": 4}
       - data_axis: mesh axis feeds are batch-sharded over (default "dp")
       - check_numerics: insert NaN/Inf guards (reference check_nan_inf)
+      - pp_stages / pp_micro_batches / pp_schedule: pipeline parallelism
+        as a first-class mesh axis (see the pipeline section below)
+    Any knob can be passed as a constructor kwarg:
+    ``BuildStrategy(pp_stages=2, pp_schedule="1f1b")``.
     Reference flags like fuse_all_reduce_ops / memory_optimize are
     no-ops: XLA fuses and plans memory itself (kept for API parity)."""
 
-    def __init__(self):
+    def __init__(self, **kw):
         self.mesh_axes = None
         self.data_axis = "dp"
         self.check_numerics = False
@@ -72,6 +88,38 @@ class BuildStrategy(object):
         # path or an ops.pallas.autotune.AutotuneCache (tools/autotune.py
         # writes it). None = kernel-default block sizes everywhere.
         self.pallas_tune_cache = None
+        # Pipeline parallelism (reference PipelineOptimizer/section_worker,
+        # TPU-native): pp_stages=K cuts the traced Program at its
+        # pp_stage stamps (or an even op-count auto-cut when unstamped)
+        # and lowers the whole fwd+bwd+optimizer step through the
+        # GPipe/1F1B ppermute-ring schedules over the mesh's "pp" axis,
+        # composing with dp gradient sync (quantize_collectives
+        # included) on the data axis. Stage params/optimizer state are
+        # stacked (n_stage, ...) and live only on their pp slice of the
+        # mesh. pp_micro_batches=M splits each batch into M microbatches
+        # (bubble fraction ~ (K-1)/(M+K-1)); pp_schedule picks "1f1b"
+        # (bounded activation stash, rematerialized backward) or "gpipe"
+        # (autodiff through the forward ring). All three join the
+        # compile-cache token: toggling re-lowers.
+        self.pp_stages = None
+        self.pp_micro_batches = 1
+        self.pp_schedule = "1f1b"
+        # once-per-k quantized sync for gradient-merge windows (OPT-IN):
+        # when a grad-merge accumulator structure is detected, the
+        # quantized dp sync moves from every micro step's raw gradient
+        # to the MERGE BOUNDARY (the gated merged gradient, under
+        # lax.cond on the program's own apply predicate) — k-1 of every
+        # k steps ship zero gradient bytes. Accumulation buffers then
+        # hold LOCAL fp32 sums (still exact/bitwise per shard), which
+        # means they are NOT dp-replicated mid-window: a checkpoint
+        # taken off a merge boundary (straggler_ckpt, admission saves)
+        # captures one shard's buffer, and a consensus rewind restoring
+        # it everywhere drops the other shards' accumulation. Enable
+        # only when every snapshot lands on a k-aligned boundary
+        # (checkpoint_every % k == 0 and no unscheduled saves) or the
+        # run tolerates a non-bitwise merge window across a rewind.
+        # False (default) = legacy every-step sync.
+        self.quantize_merge_sync = False
         # parity no-ops
         self.fuse_all_reduce_ops = True
         self.fuse_elewise_add_act_ops = True
@@ -79,6 +127,10 @@ class BuildStrategy(object):
         self.enable_inplace = True
         self.num_trainers = 1
         self.trainer_id = 0
+        for k, v in kw.items():
+            if not hasattr(self, k):
+                raise TypeError("BuildStrategy has no knob %r" % k)
+            setattr(self, k, v)
 
 
 class ExecutionStrategy(object):
@@ -86,6 +138,33 @@ class ExecutionStrategy(object):
         self.num_threads = 1
         self.num_iteration_per_drop_scope = 1
         self.use_experimental_executor = True
+
+
+class CompilePlan(object):
+    """How a (program, strategy) pair lowers: trace -> cut -> schedule ->
+    jit. Retires the old single-jit assumption: the executor consults
+    the plan's ``kind`` to route the step build, and ``token`` (mesh
+    axes + quantize/pallas knobs + pp cut + schedule) keys its compile
+    cache, so toggling the cut or schedule re-lowers while repeat runs
+    hit the cached executable.
+
+      kind      -- "single_jit" | "pipeline"
+      token     -- the strategy cache token (includes pp knobs)
+      cut       -- distributed.pipeline_program.CompiledPPCut (pipeline)
+      schedule  -- "1f1b" | "gpipe" (pipeline)
+      n_micro   -- microbatches per step (pipeline)
+    """
+
+    __slots__ = ("kind", "token", "cut", "schedule", "n_micro")
+
+    def __init__(self, kind, token, cut=None, schedule=None, n_micro=1):
+        self.kind = kind
+        self.cut = cut
+        self.schedule = schedule
+        self.n_micro = int(n_micro)
+        # the cut signature joins the token: two programs whose strategy
+        # knobs agree but whose cuts differ must not share an executable
+        self.token = token if cut is None else token + (cut.signature(),)
 
 
 def make_mesh(mesh_axes, devices=None):
@@ -139,8 +218,15 @@ class CompiledProgram(object):
         if exec_strategy is not None:
             self._exec_strategy = exec_strategy
         if self._build_strategy.mesh_axes is None:
-            self._build_strategy.mesh_axes = {"dp": len(places or
-                                                        jax.devices())}
+            n_dev = len(places or jax.devices())
+            k = int(getattr(self._build_strategy, "pp_stages", 0) or 0)
+            if k > 1:
+                # pp as a first-class axis: "all-device data parallel"
+                # on a pipeline strategy means pp x dp over the devices
+                self._build_strategy.mesh_axes = {
+                    "pp": k, "dp": max(1, n_dev // k)}
+            else:
+                self._build_strategy.mesh_axes = {"dp": n_dev}
         return self
 
     def with_mesh(self, mesh_axes, devices=None):
@@ -184,12 +270,76 @@ class CompiledProgram(object):
                 (getattr(bs, "quantize_collectives", False),
                  getattr(bs, "quantize_block_size", 256),
                  getattr(bs, "quantize_bits", 8),
-                 getattr(bs, "quantize_min_size", None)),
+                 getattr(bs, "quantize_min_size", None),
+                 getattr(bs, "quantize_merge_sync", False)),
                 # Pallas dispatch is baked into the traced step: both the
                 # op set and the tuning-cache identity must key the
                 # executable
                 (tuple(sorted(getattr(bs, "use_pallas", ()) or ())),
-                 tune_tok))
+                 tune_tok),
+                # the pipeline cut/schedule selects a whole different
+                # lowering — toggling pp_stages or the schedule must
+                # re-lower, never reuse a single-jit executable
+                (getattr(bs, "pp_stages", None),
+                 int(getattr(bs, "pp_micro_batches", 1) or 1),
+                 getattr(bs, "pp_schedule", "1f1b")))
+
+    # -- pipeline parallelism ---------------------------------------------
+    def _pp_enabled(self):
+        bs = self._build_strategy
+        if getattr(bs, "pp_stages", None):
+            return True
+        return int((bs.mesh_axes or {}).get("pp", 1) or 1) > 1
+
+    def compile_plan(self):
+        """The lowering route of this (program, strategy) pair — the
+        compile plan object: trace -> cut -> schedule -> jit. A plain
+        strategy lowers as one jit (kind "single_jit"); a pipeline
+        strategy (pp_stages set, or a >1 "pp" mesh axis) cuts the
+        program first (kind "pipeline") and the executor routes the
+        step through the GPipe/1F1B lowering. The plan's token keys the
+        executor step cache: (mesh axes, pp cut, schedule) ride along-
+        side the existing strategy token."""
+        if not self._pp_enabled():
+            return CompilePlan("single_jit", self._cache_token())
+        from ..distributed import pipeline_program as ppp
+        bs = self._build_strategy
+        axes = dict(bs.mesh_axes or {})
+        k = int(bs.pp_stages) if getattr(bs, "pp_stages", None) else None
+        if "pp" not in axes:
+            if k is None:
+                raise ValueError("pipeline strategy needs pp_stages or a "
+                                 "'pp' mesh axis")
+            # first-class default: pp x dp over all devices
+            n_dev = len(getattr(self, "_devices", None) or jax.devices())
+            if axes:
+                raise ValueError(
+                    "mesh_axes %r has no 'pp' axis but pp_stages=%d is "
+                    "set — include pp in the mesh (e.g. {'pp': %d, "
+                    "'dp': %d})" % (axes, k, k, max(1, n_dev // k)))
+            axes = {"pp": k, "dp": max(1, n_dev // k)}
+            bs.mesh_axes = dict(axes)
+        if k is not None and int(axes["pp"]) != k:
+            raise ValueError(
+                "pp_stages=%d does not match the mesh's pp axis (%d)"
+                % (k, int(axes["pp"])))
+        k = int(axes["pp"])
+        schedule = getattr(bs, "pp_schedule", "1f1b")
+        n_micro = int(getattr(bs, "pp_micro_batches", 1) or 1)
+        cache = getattr(self._program, "_pp_cut_cache", None)
+        ck = (k, schedule, n_micro)
+        if cache is not None and cache[0] == (self._program._version,) + ck:
+            cut = cache[1]
+        else:
+            cut = ppp.extract_compiled_pp_plan(
+                self._program, n_stage=k, schedule=schedule,
+                n_micro=n_micro)
+            # store POST-extract version: the auto-cut stamps attrs and
+            # bumps it once
+            self._program._pp_cut_cache = (
+                (self._program._version,) + ck, cut)
+        return CompilePlan("pipeline", self._cache_token(),
+                           cut=cut, schedule=schedule, n_micro=n_micro)
 
     def _mesh_obj(self):
         if self._mesh is None:
@@ -258,16 +408,21 @@ class CompiledProgram(object):
         return self._wrap_sharded(step, mesh, state_sh, feed_sh, out_sh)
 
     # -- quantized collectives --------------------------------------------
-    def _quantize_ctx(self, mesh):
+    def _quantize_ctx(self, mesh, allow_pp=False):
         """Build the per-compile QuantizedSyncContext, or None when the
-        quantized path does not apply (option off / no data axis)."""
+        quantized path does not apply (option off / no data axis).
+        allow_pp: the pipeline lowering runs its own shard_map over
+        pp x dp and applies the quantized sync explicitly on the dp
+        axis, so a pp axis is fine THERE — everywhere else a >1 model
+        axis would silently lose its XLA-inserted collectives."""
         bs = self._build_strategy
         if not getattr(bs, "quantize_collectives", False):
             return None
         if bs.data_axis not in mesh.axis_names:
             return None
+        skip = {bs.data_axis} | ({"pp"} if allow_pp else set())
         bad = {a: int(s) for a, s in mesh.shape.items()
-               if a != bs.data_axis and int(s) > 1}
+               if a not in skip and int(s) > 1}
         if bad:
             raise ValueError(
                 "quantize_collectives lowers the step through shard_map "
@@ -280,7 +435,8 @@ class CompiledProgram(object):
             bs.data_axis,
             block_size=int(getattr(bs, "quantize_block_size", 256)),
             bits=int(getattr(bs, "quantize_bits", 8)),
-            min_size=getattr(bs, "quantize_min_size", None))
+            min_size=getattr(bs, "quantize_min_size", None),
+            merge_window=bool(getattr(bs, "quantize_merge_sync", False)))
 
     def _quantized_fn(self, fn, mesh, state_sh, feed_sh, out_sh, qctx):
         """shard_map the step over the data axis with explicit quantized
@@ -350,8 +506,258 @@ class CompiledProgram(object):
                                mesh_axes=dict(bs.mesh_axes or {}),
                                backend=backend)
 
+    # -- pipeline lowering -------------------------------------------------
+    def _build_pp_step(self, program, cplan, fetch_names, micro_shapes,
+                       check_numerics=False, windowed=False):
+        """Lower the whole fwd+bwd+optimizer step through the pipeline
+        schedule inside ONE shard_map over the pp(xdp) mesh.
+
+        Per pp shard: run this stage's slice of the stacked params
+        through the GPipe/1F1B ring (distributed.pipeline local bodies
+        — the schedule's own autodiff replaces the program's backward
+        section), dp-sync the stage grads (plain pmean, or the
+        quantized collectives when quantize_collectives is on), then
+        trace the program's OWN update section (optimizer ops, LR
+        schedule, gradient-merge accumulation) on the stage-0 template
+        over this shard's state slice. Stage state is stacked
+        (n_stage, ...) and NamedSharded P("pp") — each stage's params
+        and optimizer moments live only on their pp slice of the mesh.
+
+        Returns (state_info, run_step): state_info tells the executor
+        how to stack scope state ((stacked_names, stage_cols,
+        shared_names, feed_order)); run_step has the usual
+        (state_tuple, feed_tuple) dispatch signature."""
+        from ..distributed import pipeline_program as ppp
+        from ..distributed.pipeline import (pipeline_1f1b_local,
+                                            pipeline_gpipe_local,
+                                            pipeline_forward_local)
+        try:
+            from jax import shard_map
+        except ImportError:  # pragma: no cover - older jax
+            from jax.experimental.shard_map import shard_map
+        mesh = self._mesh_obj()
+        cut = cplan.cut
+        plan = cut.plan
+        n_stage = plan.n_stage
+        if int(mesh.shape.get("pp", 0)) != n_stage:
+            raise ValueError(
+                "program cuts into %d pipeline stages but the mesh 'pp' "
+                "axis has %d devices — they must match"
+                % (n_stage, int(mesh.shape.get("pp", 0))))
+        bs = self._build_strategy
+        dp_axis = bs.data_axis if (bs.data_axis in mesh.axis_names and
+                                   mesh.shape[bs.data_axis] > 1) else None
+        bad = {a: int(s) for a, s in mesh.shape.items()
+               if a not in ("pp", dp_axis) and int(s) > 1}
+        if bad:
+            raise ValueError(
+                "the pipeline lowering supports pp x %s meshes only; "
+                "axes %r are unsupported (v1)" % (bs.data_axis, bad))
+        qctx = self._quantize_ctx(mesh, allow_pp=True)
+
+        tail_produced = {n for op in plan.tail_ops
+                         for n in op.output_names()}
+        aux_names = [n for n in fetch_names if n != cut.loss_name]
+        unknown = [n for n in aux_names if n not in tail_produced]
+        if unknown:
+            raise ValueError(
+                "pipeline fetch_list entries must be the loss or vars "
+                "computed by the unstamped loss section; %r are not "
+                "(stage activations stay sharded on the pp ring)"
+                % (unknown,))
+
+        stage_fn = ppp.make_stage_fn(program, plan)
+        loss_fn = ppp.make_loss_fn(program, plan)
+        tail_fn = ppp.make_tail_fn(program, plan, tuple(aux_names)) \
+            if aux_names else None
+        update = ppp.make_update_trace_fn(program, cut)
+        stacked_names = sorted(cut.stage_state)
+        shared_names = list(cut.shared_state)
+        n_stacked = len(stacked_names)
+        tmpl_params = list(plan.template_params)
+        n_micro = plan.n_micro
+        feed_order = [plan.x_feed] + list(plan.y_feeds)
+        from .trace import GRAD_SUFFIX
+
+        if cplan.schedule == "1f1b":
+            sched = pipeline_1f1b_local(stage_fn, loss_fn, n_stage,
+                                        n_micro, "pp", dp_axis)
+        elif cplan.schedule == "gpipe":
+            sched = pipeline_gpipe_local(stage_fn, loss_fn, n_stage,
+                                         n_micro, "pp", dp_axis)
+        else:
+            raise ValueError("unknown pp_schedule %r" % cplan.schedule)
+        fwd = pipeline_forward_local(stage_fn, n_stage, n_micro, "pp",
+                                     dp_axis) if tail_fn else None
+
+        def _unmicro(a):
+            return a.reshape((a.shape[0] * a.shape[1],) + a.shape[2:])
+
+        def _feed_spec(name):
+            # (n_micro, micro_batch, ...): micro dim replicated, batch
+            # dim dp-sharded when it divides; an indivisible batch stays
+            # replicated (every dp shard computes the same full batch)
+            shape = micro_shapes[name]
+            mb = shape[2 if windowed else 1] if len(shape) > \
+                (2 if windowed else 1) else None
+            if dp_axis is not None and mb is not None \
+                    and mb % mesh.shape[dp_axis] == 0:
+                return P(None, dp_axis)
+            return P()
+        feed_specs = tuple(_feed_spec(n) for n in feed_order)
+        feed_sharded = tuple(dp_axis in tuple(s) for s in feed_specs)
+
+        def _gather_rows(a, sharded):
+            # reassemble the FULL batch on every dp shard (contiguous
+            # dim-1 blocks, so tiled all_gather restores serial order)
+            if dp_axis is None or not sharded:
+                return a
+            return jax.lax.all_gather(a, dp_axis, axis=1, tiled=True)
+
+        def local_step(state_tuple, feed_tuple):
+            stacked = dict(zip(stacked_names, state_tuple[:n_stacked]))
+            shared = dict(zip(shared_names, state_tuple[n_stacked:]))
+            x_local = feed_tuple[0]
+            ys_local = tuple(feed_tuple[1:])
+            params_me = {t: stacked[t][0] for t in tmpl_params}
+            loss, grads = sched(params_me, x_local, ys_local)
+            if dp_axis is not None:
+                loss = jax.lax.pmean(loss, dp_axis)
+                if qctx is not None:
+                    grads = {t: qctx.sync(t + GRAD_SUFFIX, g)
+                             for t, g in grads.items()}
+                else:
+                    grads = {t: jax.lax.pmean(g, dp_axis)
+                             for t, g in grads.items()}
+            aux_vals = ()
+            if tail_fn is not None:
+                # aux fetches get EXACT serial semantics: gather the
+                # pp-replicated chain output + label feeds to the full
+                # batch on every dp shard, then run the unstamped tail
+                # un-microbatched — every shard computes the identical
+                # (replicated) value, scalar or per-row
+                h = _gather_rows(fwd(params_me, x_local),
+                                 feed_sharded[0])
+                ys_full = tuple(
+                    _gather_rows(y, sh)
+                    for y, sh in zip(ys_local, feed_sharded[1:]))
+                aux_vals = tail_fn(_unmicro(h),
+                                   tuple(_unmicro(y) for y in ys_full))
+            env = dict(shared)
+            env.update({t: stacked[t][0] for t in stacked_names})
+            env.update({t + GRAD_SUFFIX: grads[t] for t in tmpl_params})
+            update(env)
+            new_state = tuple(env[t][None] for t in stacked_names) \
+                + tuple(env[n] for n in shared_names)
+            fetches = tuple(
+                loss if n == cut.loss_name
+                else aux_vals[aux_names.index(n)] for n in fetch_names)
+            return fetches, new_state
+
+        stacked_spec = tuple(P("pp") for _ in stacked_names)
+        shared_spec = tuple(P() for _ in shared_names)
+        state_specs = stacked_spec + shared_spec
+        fetch_specs = tuple(P() for _ in fetch_names)
+
+        try:
+            body = shard_map(local_step, mesh=mesh,
+                             in_specs=(state_specs, feed_specs),
+                             out_specs=(fetch_specs, state_specs),
+                             check_rep=False)
+        except TypeError:   # newer jax dropped check_rep
+            body = shard_map(local_step, mesh=mesh,
+                             in_specs=(state_specs, feed_specs),
+                             out_specs=(fetch_specs, state_specs))
+
+        def _finite(parts):
+            flag = jnp.asarray(True)
+            for v in parts:
+                if jnp.issubdtype(jnp.result_type(v), jnp.inexact):
+                    flag = jnp.logical_and(flag,
+                                           jnp.all(jnp.isfinite(v)))
+            return flag
+
+        # The step's EXTERNAL state signature is flat per-stage
+        # replicated vars (the scope layout every other path —
+        # checkpoints, elastic shipping — already speaks); the stacking
+        # onto the pp axis and the unstack back happen INSIDE the jit,
+        # so no eager multi-device op ever races another host thread's
+        # dispatch (concurrent eager gathers deadlock the CPU
+        # backend's collective rendezvous), and a run_steps window
+        # carries the pp-sharded stacked state across the whole scan
+        # with zero boundary crossings.
+        def _dstack(vals):
+            # NOT jnp.stack: on this jax a concatenate feeding a
+            # NESTED shard_map mis-partitions the operand (every shard
+            # reads a blend instead of its P("pp") slice — repro: stack
+            # two (8,8) into (2,8,8), pass through shard_map in jit).
+            # dynamic_update_index_in_dim lowers to updates the SPMD
+            # partitioner handles correctly.
+            out = jnp.zeros((len(vals),) + tuple(vals[0].shape),
+                            jnp.result_type(vals[0]))
+            for i, v in enumerate(vals):
+                out = jax.lax.dynamic_update_index_in_dim(
+                    out, v.astype(out.dtype), i, 0)
+            return out
+
+        def _stack_in(state_tuple):
+            stacked = tuple(
+                _dstack(state_tuple[i * n_stage:(i + 1) * n_stage])
+                for i in range(n_stacked))
+            return stacked + tuple(state_tuple[n_stacked * n_stage:])
+
+        def _unstack_out(new_state):
+            out = []
+            for arr in new_state[:n_stacked]:
+                out.extend(arr[s] for s in range(n_stage))
+            out.extend(new_state[n_stacked:])
+            return tuple(out)
+
+        if windowed:
+            def target(state_tuple, feed_stack_tuple):
+                def scan_body(carry, xs):
+                    fetches, new_state = body(carry, xs)
+                    ys = (fetches,)
+                    if check_numerics:
+                        ys += (_finite(list(fetches) + list(new_state)),)
+                    return new_state, ys
+                final_state, ys = jax.lax.scan(scan_body,
+                                               _stack_in(state_tuple),
+                                               feed_stack_tuple)
+                return ys, _unstack_out(final_state)
+        elif check_numerics:
+            def target(state_tuple, feed_tuple):
+                fetches, new_state = body(_stack_in(state_tuple),
+                                          feed_tuple)
+                return fetches, _unstack_out(new_state), \
+                    _finite(list(fetches) + list(new_state))
+        else:
+            def target(state_tuple, feed_tuple):
+                fetches, new_state = body(_stack_in(state_tuple),
+                                          feed_tuple)
+                return fetches, _unstack_out(new_state)
+
+        n_flat = n_stacked * n_stage + len(shared_names)
+        state_sh = tuple(NamedSharding(mesh, P()) for _ in range(n_flat))
+        feed_sh = tuple(
+            NamedSharding(mesh, P(*((None,) + tuple(s))))
+            if windowed else NamedSharding(mesh, s)
+            for s in feed_specs)
+        if check_numerics and not windowed:
+            out_sh = (None, state_sh, None)
+        else:
+            out_sh = (None, state_sh)
+        run_step = self._wrap_sharded(target, mesh, state_sh, feed_sh,
+                                      out_sh, window=windowed, qctx=qctx,
+                                      pipeline=True)
+        state_info = (tuple(stacked_names),
+                      {t: tuple(cut.stage_state[t])
+                       for t in stacked_names},
+                      tuple(shared_names), tuple(feed_order))
+        return state_info, run_step
+
     def _wrap_sharded(self, fn, mesh, state_sh, feed_sh, out_sh,
-                      window=False):
+                      window=False, qctx="auto", pipeline=False):
         """Shared step/window machinery: jit over the mesh, stage inputs
         onto their shardings, and arm the one-behind collective-timeout
         watchdog. With quantize_collectives on, the fn is first lowered
@@ -359,11 +765,17 @@ class CompiledProgram(object):
         accounting (static, accumulated at trace time) is recorded per
         dispatch (x window length for run_steps windows). With use_pallas
         set, the trace runs inside the Pallas dispatch scope so the wired
-        op kernels route to their fused implementations."""
-        qctx = self._quantize_ctx(mesh)
-        if qctx is not None:
-            fn = self._quantized_fn(fn, mesh, state_sh, feed_sh, out_sh,
-                                    qctx)
+        op kernels route to their fused implementations.
+
+        qctx: "auto" builds the QuantizedSyncContext here and wraps fn in
+        the dp shard_map; a caller that already lowered its own shard_map
+        (the pipeline path) passes its context — byte accounting and the
+        watchdog still apply, the extra wrap does not."""
+        if qctx == "auto":
+            qctx = self._quantize_ctx(mesh)
+            if qctx is not None:
+                fn = self._quantized_fn(fn, mesh, state_sh, feed_sh,
+                                        out_sh, qctx)
         pctx = self._pallas_ctx(mesh)
         if pctx is not None:
             from ..ops import pallas_dispatch as pd
@@ -378,6 +790,14 @@ class CompiledProgram(object):
                          out_shardings=out_sh, donate_argnums=(0,))
         timeout_s = getattr(self._build_strategy, "collective_timeout_s",
                             None)
+        # manual collectives on the CPU backend serialize process-wide
+        # (see _MANUAL_COLLECTIVE_LOCK): any quantized or pipeline step
+        # embeds shard_map ppermute/all_gather
+        try:
+            platform = next(iter(mesh.devices.flat)).platform
+        except Exception:  # pragma: no cover - exotic mesh
+            platform = jax.default_backend()
+        serialize = (qctx is not None or pipeline) and platform == "cpu"
         pending = []  # previous call's outputs (one-behind watchdog)
 
         def run_step(state_vals, feed_tuple):
@@ -401,7 +821,16 @@ class CompiledProgram(object):
                 placed_feed = tuple(
                     _place_feed(v, s)
                     for v, s in zip(feed_tuple, feed_sh))
-                out = jitted(placed_state, placed_feed)
+                if serialize:
+                    # hold the lock through COMPLETION: a second
+                    # thread's enqueue against a still-running manual
+                    # collective is exactly the rendezvous interleaving
+                    # that deadlocks the CPU backend
+                    with _MANUAL_COLLECTIVE_LOCK:
+                        out = jitted(placed_state, placed_feed)
+                        jax.block_until_ready(out)
+                else:
+                    out = jitted(placed_state, placed_feed)
                 if timeout_s is not None:
                     pending.append(out)
                 if qctx is not None and qctx.raw_bytes:
@@ -411,8 +840,10 @@ class CompiledProgram(object):
                     from . import resilience
                     n = int(np.shape(feed_tuple[0])[0]) \
                         if window and feed_tuple else 1
+                    # int-cast: merge-boundary syncs amortize bytes by
+                    # 1/k, leaving fractional trace-time totals
                     resilience.record_bytes("collective",
-                                            qctx.raw_bytes * n,
-                                            qctx.wire_bytes * n)
+                                            int(qctx.raw_bytes * n),
+                                            int(qctx.wire_bytes * n))
                 return out
         return run_step

@@ -1669,6 +1669,13 @@ class ElasticTrainer(PodResilientTrainer):
                      factor=round(target, 6), rel=round(rel, 6),
                      grad_merge=k_live)
 
+    @staticmethod
+    def _pp_axes(axes):
+        """True when the trainer's FULL topology carries a >1 pipeline
+        axis — stage state is stacked on pp and never re-shards; host
+        loss takes the consensus-rewind path instead."""
+        return bool(axes) and int(axes.get("pp") or 1) > 1
+
     def _retarget(self, trainer, base_axes, live, kind, **fields):
         """Re-shard this host's live state onto the capacity-scaled mesh
         and record the elastic event. base_axes is the FULL topology —
@@ -1679,6 +1686,16 @@ class ElasticTrainer(PodResilientTrainer):
         strategy = self._target_strategy(trainer)
         if strategy is None or not base_axes:
             record_event(kind, capacity=capacity, resharded=0, **fields)
+            self._apply_lr_scale(trainer, live)
+            return
+        if self._pp_axes(base_axes):
+            # pipeline mesh: each stage's params/moments live only on
+            # their pp slice — there is no smaller mesh to re-shard
+            # onto (re-cutting stages is follow-on work). The mesh and
+            # shardings stay put; capacity changes only move data lanes
+            # and the LR scale.
+            record_event(kind, capacity=capacity, resharded=0, pp=True,
+                         **fields)
             self._apply_lr_scale(trainer, live)
             return
         axes = dict(base_axes)
@@ -1902,11 +1919,27 @@ class ElasticTrainer(PodResilientTrainer):
                 continue
             live = sorted(verdicts)
             lost = sorted(set(known_live) - set(live))
+            pp_rewind = False
             if lost:
-                # ELASTIC SHRINK: no rewind — re-shard and continue
-                self._retarget(trainer, base_axes, live,
-                               "elastic_shrink", lost=lost, step=step)
-                known_live = live
+                if self._pp_axes(base_axes):
+                    # PIPELINE mesh: a lost host's stage slice cannot be
+                    # re-sharded away — fall back to the
+                    # PodResilientTrainer consensus rewind (the shared
+                    # transient tail below): scrub, elect the common
+                    # step, restore, replay bitwise on the unchanged
+                    # mesh. Survivors stay at full mesh; only data
+                    # lanes and the LR scale follow the capacity.
+                    pp_rewind = True
+                    record_event(
+                        "elastic_pp_rewind", lost=lost, step=step,
+                        capacity="%d/%d" % (len(live),
+                                            self._coordinator.n_hosts))
+                    known_live = live
+                else:
+                    # ELASTIC SHRINK: no rewind — re-shard and continue
+                    self._retarget(trainer, base_axes, live,
+                                   "elastic_shrink", lost=lost, step=step)
+                    known_live = live
             statuses = {h: v[0] for h, v in verdicts.items()}
             if any(v == "fatal" for v in statuses.values()):
                 record_event("fatal", step=step,
@@ -1919,6 +1952,15 @@ class ElasticTrainer(PodResilientTrainer):
                     "pod aborted: host(s) %s hit a fatal error at step %d"
                     % (bad, step))
             if all(v == "ok" for v in statuses.values()):
+                # ONE commit protocol for both the ordinary window and
+                # the pp-rewind window: on a pipeline mesh the
+                # SURVIVORS' completed window is still good — keep its
+                # fetches and cursor, then take the consensus rewind
+                # from the advanced position (the election lands on the
+                # newest common checkpoint; a replay refills bitwise).
+                # pp_rewind skips only lane re-homing (the rewind tail
+                # rebalances before the cursor restore) and this
+                # window's admission/drain decisions.
                 for i in range(len(outs) if feed is not None else w):
                     all_fetches[step + i] = outs[i]
                 step += w
@@ -1931,7 +1973,7 @@ class ElasticTrainer(PodResilientTrainer):
                     for h, v in verdicts.items():
                         if h != hid:
                             feed.observe(v[2])
-                    if lost:
+                    if lost and not pp_rewind:
                         # weighted placement reads the AGREED lag map
                         # carried on this very exchange, never the
                         # host-local gauges (socket pods diverge)
@@ -1947,6 +1989,8 @@ class ElasticTrainer(PodResilientTrainer):
                 if strag and step % ckpt_every != 0 and step != n:
                     trainer._save(step)
                     record_event("straggler_ckpt", step=step)
+            if not pp_rewind and all(v == "ok"
+                                     for v in statuses.values()):
                 # admission rides the window boundary: every live host
                 # saw the same gathered pending sets, so they all admit
                 # the same joiner (lowest id fully-observed) together
@@ -2094,19 +2138,29 @@ class ElasticTrainer(PodResilientTrainer):
                 continue
             # -- transient: pod-wide consensus rewind (parent semantics,
             #    restored straight onto the CURRENT — possibly shrunk —
-            #    mesh) --------------------------------------------------
-            restarts += 1
-            if restarts > self._max_restarts:
-                record_event("giveup", step=step, restarts=restarts)
-                raise RestartBudgetExceededError(
-                    "pod restart budget (%d) exhausted at step %d; "
-                    "last local error: %r" % (self._max_restarts, step,
-                                              err))
-            delay = trainer._policy.delay_s(restarts - 1)
-            record_event("pod_restart", step=step, restarts=restarts,
-                         error=type(err).__name__ if err else None,
-                         backoff_s=delay)
-            trainer._policy.sleep(delay)
+            #    mesh). A PURE pp capacity loss (every survivor ok, the
+            #    rewind only re-anchors the pod on the common
+            #    checkpoint) is budget-free like the elastic shrink it
+            #    replaces: no restart counted, no error backoff — a
+            #    long-lived pp pod must survive arbitrarily many host
+            #    losses, and only real FAULTS may exhaust the budget.
+            #    Deterministic pod-wide: pp_rewind and the statuses are
+            #    computed from the same frozen verdicts on every host.
+            free_rewind = pp_rewind and \
+                all(v == "ok" for v in statuses.values())
+            if not free_rewind:
+                restarts += 1
+                if restarts > self._max_restarts:
+                    record_event("giveup", step=step, restarts=restarts)
+                    raise RestartBudgetExceededError(
+                        "pod restart budget (%d) exhausted at step %d; "
+                        "last local error: %r" % (self._max_restarts,
+                                                  step, err))
+                delay = trainer._policy.delay_s(restarts - 1)
+                record_event("pod_restart", step=step, restarts=restarts,
+                             error=type(err).__name__ if err else None,
+                             backoff_s=delay)
+                trainer._policy.sleep(delay)
             from .. import io as io_mod
             report = io_mod.scrub_checkpoint(trainer._ckpt_dir)
             agreed_step = co.elect_restore_step(

@@ -208,6 +208,13 @@ class Executor(object):
                 watchdog.observe_step_latency(time.perf_counter() - det_t0,
                                               what="Executor.run")
             return out
+        if strategy is not None and strategy._pp_enabled():
+            out = self._run_compiled_pp(strategy, program, feed,
+                                        fetch_names, scope, return_numpy)
+            if det_t0 is not None:
+                watchdog.observe_step_latency(time.perf_counter() - det_t0,
+                                              what="Executor.run")
+            return out
 
         # ---- prepare state ------------------------------------------------
         state_names, uses_rng = self._prepare_state(program, feed, scope)
@@ -330,6 +337,10 @@ class Executor(object):
         if getattr(program, "_pp_plan", None) is not None:
             return _observe(self._run_pipeline_steps(
                 program, feed, fetch_names, scope, return_numpy, n_steps))
+        if strategy is not None and strategy._pp_enabled():
+            return _observe(self._run_compiled_pp(
+                strategy, program, feed, fetch_names, scope, return_numpy,
+                windowed=True))
         staged = self._convert_feed(program, feed, steps_axis=True)
 
         check_numerics = bool(
@@ -684,6 +695,111 @@ class Executor(object):
         if return_numpy:
             return [np.asarray(f) for f in stacked]
         return list(stacked)
+
+    # ------------------------------------------------------------------
+    def _run_compiled_pp(self, strategy, program, feed, fetch_names,
+                         scope, return_numpy, windowed=False):
+        """CompiledProgram pipeline path (BuildStrategy.pp_stages / a >1
+        "pp" mesh axis): the strategy's CompilePlan cuts the minimized
+        program (trace -> cut -> schedule -> jit) and the step lowers
+        through the GPipe/1F1B schedule inside one shard_map over the
+        pp x dp mesh — dp gradient sync (quantized included) and the
+        program's own update section run unchanged on the other axes.
+        Scope stays in per-stage var names (checkpoints/elastic
+        machinery see the usual layout); state is stacked onto the pp
+        axis per dispatch and unstacked on the way out."""
+        from ..distributed import pipeline_program as ppp
+        cplan = strategy.compile_plan()
+        cut = cplan.cut
+        plan = cut.plan
+        feed_vals = self._convert_feed(program, feed, steps_axis=windowed)
+        expect = set([plan.x_feed] + list(plan.y_feeds))
+        if set(feed_vals) != expect:
+            raise ValueError(
+                "pipeline program expects exactly the feeds %r; got %r"
+                % (sorted(expect), sorted(feed_vals)))
+        check_numerics = bool(
+            getattr(program, "_check_numerics", False) or
+            getattr(strategy._build_strategy, "check_numerics", False))
+
+        def _micro(name):
+            arr = jnp.asarray(feed_vals[name])
+            if not windowed:
+                return ppp.microbatch(arr, plan.n_micro)
+            if arr.shape[1] % plan.n_micro:
+                raise ValueError(
+                    "per-step batch %d not divisible by pp_micro_batches "
+                    "%d" % (arr.shape[1], plan.n_micro))
+            return arr.reshape((arr.shape[0], plan.n_micro,
+                                arr.shape[1] // plan.n_micro)
+                               + arr.shape[2:])
+
+        feed_order = [plan.x_feed] + list(plan.y_feeds)
+        micro = {n: _micro(n) for n in feed_order}
+        key = (id(program), program._version,
+               tuple((n, tuple(micro[n].shape), str(micro[n].dtype))
+                     for n in feed_order),
+               tuple(fetch_names), check_numerics,
+               "pp_scan" if windowed else "pp", cplan.token)
+        entry = self._cache.get(key)
+        if entry is None:
+            self.cache_misses += 1
+            entry = strategy._build_pp_step(
+                program, cplan, tuple(fetch_names),
+                {n: tuple(micro[n].shape) for n in feed_order},
+                check_numerics, windowed)
+            self._cache[key] = entry
+        else:
+            self.cache_hits += 1
+        (stacked_names, stage_cols, shared_names, forder), step_fn = entry
+
+        # flat state order = the step's external signature: per-stage
+        # vars grouped by template (stage-major within), then shared.
+        # Plain replicated scope arrays in, plain arrays out — the
+        # pp-stacking happens INSIDE the jit (no eager multi-device op
+        # may race another host thread's dispatch)
+        flat_names = [nm for t in stacked_names for nm in stage_cols[t]]
+        flat_names += list(shared_names)
+        state_vals = []
+        for nm in flat_names:
+            v = scope.find_var(nm)
+            if v is None:
+                raise ValueError(
+                    "pipeline state %r not initialized — run the "
+                    "startup program first" % nm)
+            state_vals.append(v)
+        feed_tuple = tuple(micro[n] for n in forder)
+        out = step_fn(tuple(state_vals), feed_tuple)
+
+        def _writeback_pp(new_state):
+            for nm, v in zip(flat_names, new_state):
+                scope.set_var(nm, v)
+
+        if windowed:
+            ys, new_state = out
+            fetch_out = ys[0]
+            if check_numerics:
+                finite = np.asarray(ys[1])
+                if not finite.all():
+                    # state back first: inputs were donated (run() parity)
+                    _writeback_pp(new_state)
+                    raise FloatingPointError(
+                        "check_numerics: non-finite value (NaN/Inf) first "
+                        "detected at step %d of this pipeline run_steps "
+                        "window" % int(np.argmin(finite)))
+        elif check_numerics:
+            fetch_out, new_state, finite = out
+            if not bool(np.asarray(finite)):
+                _writeback_pp(new_state)
+                raise FloatingPointError(
+                    "check_numerics: non-finite value (NaN/Inf) detected "
+                    "in fetches or updated state of this pipeline step")
+        else:
+            fetch_out, new_state = out
+        _writeback_pp(new_state)
+        if return_numpy:
+            return [np.asarray(f) for f in fetch_out]
+        return list(fetch_out)
 
     # ------------------------------------------------------------------
     def dump_hlo(self, program=None, feed=None, fetch_list=None,

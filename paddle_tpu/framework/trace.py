@@ -61,6 +61,18 @@ class TraceContext(object):
         self.synced_grads = set()
         self.bound_axes = () if self.grad_sync is None \
             else (self.grad_sync.axis_name,)
+        # once-per-k quantized sync for grad-merge windows: when the
+        # sync context opts in (BuildStrategy.quantize_merge_sync) and
+        # the program carries GradientMergeOptimizer structure, the raw
+        # per-step grads accumulate LOCALLY (exact fp32) and the sync
+        # moves to the gated merged gradient under lax.cond — see
+        # _maybe_sync_param_grads / _detect_merge_plan
+        if self.grad_sync is not None and \
+                getattr(self.grad_sync, "merge_window", False):
+            self.merge_deferred, self.merge_gated = \
+                _detect_merge_plan(program)
+        else:
+            self.merge_deferred, self.merge_gated = frozenset(), {}
 
     def begin_op(self, rng_tag):
         """rng_tag is the op's structural position (block, index) hash —
@@ -115,6 +127,72 @@ def _rng_tag(block, idx):
 GRAD_SUFFIX = "@GRAD"
 
 
+def _detect_merge_plan(program):
+    """Find GradientMergeOptimizer structure per persistable param:
+
+        g = w@GRAD
+        acc_new    = elementwise_add(acc, g)        # acc: *.grad_acc*
+        apply_grad = scale(acc_new, 1/k)
+        gated      = where(is_apply, apply_grad, zeros)
+        <optimizer op consumes gated as Grad>
+
+    Returns (deferred, gated): ``deferred`` is the raw grad names whose
+    every-step sync is skipped; ``gated`` maps the where-output name ->
+    {"raw": raw grad name, "pred": is_apply var name, "k": merge factor
+    or None}. Cached per (program, version) — attrs-only stamping does
+    not invalidate it, but minimize()/append_op bump the version."""
+    cached = getattr(program, "_merge_plan_cache", None)
+    if cached is not None and cached[0] == program._version:
+        return cached[1], cached[2]
+    blk = program.global_block()
+    producer = {}
+    for op in blk.ops:
+        for nm in op.output_names():
+            producer[nm] = op
+    deferred, gated = set(), {}
+    for op in blk.ops:
+        if op.attrs.get("op_role") != "optimize" or "Grad" not in op.inputs \
+                or "Param" not in op.inputs:
+            continue
+        pname = op.inputs["Param"][0]
+        gname = op.inputs["Grad"][0]
+        raw = pname + GRAD_SUFFIX
+        if gname == raw:
+            continue
+        where_op = producer.get(gname)
+        if where_op is None or where_op.type != "where":
+            continue
+        scale_op = None
+        for slot in ("X", "Y"):
+            cand = producer.get(where_op.inputs.get(slot, [""])[0])
+            if cand is not None and cand.type == "scale":
+                scale_op = cand
+                break
+        if scale_op is None:
+            continue
+        add_op = producer.get(scale_op.inputs["X"][0])
+        if add_op is None or add_op.type != "elementwise_add":
+            continue
+        add_ins = add_op.input_names()
+        if raw not in add_ins:
+            continue
+        acc = next((n for n in add_ins if n != raw), None)
+        acc_var = blk._find_var_recursive(acc) if acc else None
+        if acc_var is None or not getattr(acc_var, "persistable", False) \
+                or ".grad_acc" not in acc:
+            continue
+        s = float(scale_op.attrs.get("scale", 1.0))
+        k = None
+        if 0.0 < s < 1.0 and abs(1.0 / s - round(1.0 / s)) < 1e-6:
+            k = int(round(1.0 / s))
+        deferred.add(raw)
+        gated[gname] = {"raw": raw,
+                        "pred": where_op.inputs["Condition"][0], "k": k}
+    out = (frozenset(deferred), gated)
+    program._merge_plan_cache = (program._version,) + out
+    return out
+
+
 def _maybe_sync_param_grads(op, env, ctx):
     """Quantized data-parallel gradient sync (ctx.grad_sync, installed by
     CompiledProgram under BuildStrategy.quantize_collectives).
@@ -134,11 +212,26 @@ def _maybe_sync_param_grads(op, env, ctx):
     blk = ctx.program.global_block()
     for names in op.outputs.values():
         for n in names:
-            if not n.endswith(GRAD_SUFFIX) or n in ctx.synced_grads \
-                    or n not in env:
+            if n in ctx.synced_grads or n not in env:
+                continue
+            spec = ctx.merge_gated.get(n)
+            if spec is not None and spec["pred"] in env:
+                # merge BOUNDARY: the gated merged gradient syncs under
+                # lax.cond on the program's own apply predicate — the
+                # k-1 non-apply steps skip the collective entirely
+                ctx.synced_grads.add(n)
+                env[n] = sync.sync_merged(spec["raw"], env[n],
+                                          env[spec["pred"]], spec["k"])
+                continue
+            if not n.endswith(GRAD_SUFFIX):
                 continue
             var = blk._find_var_recursive(n[:-len(GRAD_SUFFIX)])
             if var is None or not getattr(var, "persistable", False):
+                continue
+            if n in ctx.merge_deferred:
+                # raw per-step grad of a merged param: accumulate
+                # LOCALLY (exact fp32), sync once at the boundary above
+                ctx.synced_grads.add(n)
                 continue
             ctx.synced_grads.add(n)
             env[n] = sync.sync(n, env[n])

@@ -40,6 +40,33 @@ def softmax_with_cross_entropy(logits, label, soft_label=False,
     return loss
 
 
+def fused_mlm_head_loss(hidden, weight, label, bias=None,
+                        cast_bf16=False):
+    """Fused LM/MLM head: ``hidden (T, D) @ weight^T (+ bias)`` ->
+    per-token softmax CE loss ``(T, 1)`` in ONE op, so the
+    ``[tokens, vocab]`` logits can skip HBM entirely when
+    ``BuildStrategy.use_pallas={"fused_mlm_head_loss"}`` routes it to
+    the Pallas kernel (ops/pallas/blockwise_ce). ``weight`` is the
+    (V, D) tied embedding table; ``cast_bf16`` runs the projection in
+    bf16 with f32 accumulation (models/bert._mlm_decode's MXU trick).
+    The XLA fallback computes the identical matmul + CE chain, so
+    wiring a model head through this layer is loss-curve-neutral with
+    Pallas off."""
+    helper = LayerHelper("fused_mlm_head_loss")
+    t = hidden.shape[0] if hidden.shape else None
+    loss = helper.create_variable_for_type_inference(
+        "float32", (t, 1) if t is not None else None)
+    inputs = {"Hidden": [hidden.name], "Weight": [weight.name],
+              "Label": [label.name]}
+    if bias is not None:
+        inputs["Bias"] = [bias.name]
+    helper.append_op(
+        "fused_mlm_head_loss", inputs=inputs,
+        outputs={"Loss": [loss.name]},
+        attrs={"cast_bf16": bool(cast_bf16)})
+    return loss
+
+
 def square_error_cost(input, label):
     helper = LayerHelper("square_error_cost")
     out = helper.create_variable_for_type_inference(input.dtype, input.shape)

@@ -162,17 +162,11 @@ def bert_encoder(src_ids, position_ids, sentence_ids, input_mask, cfg,
     return x, pooled
 
 
-def _mlm_decode(cfg, trans, word_emb):
-    """Tied-embedding vocab projection. bf16 configs run the (preds x
-    hidden) @ (hidden x vocab) matmul — the largest non-encoder matmul,
-    with two same-sized backward matmuls — in bf16 at full MXU rate,
-    accumulating straight to float32 logits (matmul out_dtype), instead
-    of a float32 matmul at half throughput with 4-byte weight reads."""
-    if cfg.dtype == "bfloat16":
-        return layers.matmul(layers.cast(trans, "bfloat16"),
-                             layers.cast(word_emb, "bfloat16"),
-                             transpose_y=True, out_dtype="float32")
-    return layers.matmul(trans, word_emb, transpose_y=True)
+# The tied-embedding vocab projection now lives INSIDE
+# layers.fused_mlm_head_loss (cast_bf16= keeps the bf16-matmul-with-f32-
+# accumulation MXU trick): the (preds x vocab) logits tensor is an op-
+# internal detail, which is what lets the Pallas blockwise kernel keep
+# it out of HBM entirely under BuildStrategy.use_pallas.
 
 
 def bert_pretrain_program(cfg, batch_size, seq_len, max_preds_per_seq=20,
@@ -209,15 +203,18 @@ def bert_pretrain_program(cfg, batch_size, seq_len, max_preds_per_seq=20,
             trans, begin_norm_axis=1,
             param_attr=ParamAttr(name="mask_lm_trans_ln_s"),
             bias_attr=ParamAttr(name="mask_lm_trans_ln_b"))
-        # decode with tied word embedding (reference: weight sharing)
+        # decode with tied word embedding (reference: weight sharing),
+        # fused with the CE: the (preds, vocab) logits exist only inside
+        # fused_mlm_head_loss — under use_pallas the blockwise kernel
+        # keeps them out of HBM in fwd AND bwd; the XLA fallback is the
+        # same matmul(+bias)+CE math as the old unfused chain
         word_emb = main.global_block().var("word_embedding")
-        mlm_logits = _mlm_decode(cfg, trans, word_emb)
         mlm_bias = layers.create_parameter(
             [cfg.vocab_size], "float32", name="mask_lm_out_fc.b_0",
             default_initializer=pt.initializer.Constant(0.0))
-        mlm_logits = layers.elementwise_add(mlm_logits, mlm_bias)
-        mlm_loss = layers.softmax_with_cross_entropy(mlm_logits, mask_label)
-        mlm_loss = layers.mean(mlm_loss)
+        mlm_loss = layers.mean(layers.fused_mlm_head_loss(
+            trans, word_emb, mask_label, bias=mlm_bias,
+            cast_bf16=cfg.dtype == "bfloat16"))
 
         # ---- NSP head ----
         nsp_logits = layers.fc(
@@ -345,12 +342,13 @@ def ernie2_multitask_program(cfg, batch_size, seq_len, max_preds_per_seq=20,
             param_attr=ParamAttr(name="mask_lm_trans_ln_s"),
             bias_attr=ParamAttr(name="mask_lm_trans_ln_b"))
         word_emb = main.global_block().var("word_embedding")
-        mlm_logits = _mlm_decode(cfg, trans, word_emb)
         mlm_bias = layers.create_parameter(
             [cfg.vocab_size], "float32", name="mask_lm_out_fc.b_0",
             default_initializer=pt.initializer.Constant(0.0))
-        mlm_loss = layers.mean(layers.softmax_with_cross_entropy(
-            layers.elementwise_add(mlm_logits, mlm_bias), mask_label))
+        # fused head (see bert_pretrain_program): logits never leave the op
+        mlm_loss = layers.mean(layers.fused_mlm_head_loss(
+            trans, word_emb, mask_label, bias=mlm_bias,
+            cast_bf16=cfg.dtype == "bfloat16"))
 
         def _cls_head(name, n_cls, label):
             logits = layers.fc(

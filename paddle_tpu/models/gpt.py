@@ -151,10 +151,16 @@ def gpt_pretrain_program(cfg, batch_size, seq_len, optimizer_fn=None,
         lmask = layers.data("loss_mask", [seq_len, 1], dtype="float32")
 
         h = gpt_decoder(tok, pos, cfg, is_test=is_test)  # cfg.dtype
-        logits = _tied_logits(cfg, h, main)
-        flat_logits = layers.reshape(logits, [-1, cfg.vocab_size])
+        # fused tied-embedding head: the (N*T, vocab) logits exist only
+        # inside the op (Pallas keeps them out of HBM under use_pallas;
+        # the XLA fallback is the same _tied_logits+CE math). Decode
+        # programs (gpt_logits_program) still materialize logits — they
+        # ARE the output there.
+        flat_h = layers.reshape(h, [-1, cfg.hidden_size])
         flat_lbl = layers.reshape(lbl, [-1, 1])
-        ce = layers.softmax_with_cross_entropy(flat_logits, flat_lbl)
+        emb = main.global_block().var("gpt_word_embedding")
+        ce = layers.fused_mlm_head_loss(
+            flat_h, emb, flat_lbl, cast_bf16=cfg.dtype == "bfloat16")
         mask = layers.reshape(lmask, [-1, 1])
         loss = layers.elementwise_div(
             layers.reduce_sum(layers.elementwise_mul(ce, mask)),

@@ -182,7 +182,8 @@ class QuantizedSyncContext(object):
     """
 
     def __init__(self, axis_name, block_size=quant_ops.DEFAULT_BLOCK_SIZE,
-                 bits=quant_ops.DEFAULT_BITS, mean=True, min_size=None):
+                 bits=quant_ops.DEFAULT_BITS, mean=True, min_size=None,
+                 merge_window=False):
         self.axis_name = axis_name
         self.block_size = int(block_size)
         self.bits = int(bits)
@@ -193,10 +194,17 @@ class QuantizedSyncContext(object):
         # accuracy is the cheapest to keep
         self.min_size = self.block_size if min_size is None \
             else int(min_size)
+        # merge_window: params under a detected gradient-merge
+        # accumulator defer their sync to the MERGE BOUNDARY (once per
+        # k steps, under lax.cond on the program's own apply predicate)
+        # instead of syncing the raw gradient every micro step — see
+        # sync_merged and framework/trace._maybe_sync_param_grads
+        self.merge_window = bool(merge_window)
         self.raw_bytes = 0
         self.wire_bytes = 0
         self.synced = []      # grad var names, in trace order
         self.synced_exact = []
+        self.synced_merged = []   # grads synced once-per-k at the boundary
 
     def sync(self, name, g):
         size = int(np.prod(g.shape)) if g.shape else 1
@@ -214,6 +222,39 @@ class QuantizedSyncContext(object):
         self.synced.append(name)
         return quantized_psum(g, self.axis_name, self.block_size,
                               self.bits, mean=self.mean)
+
+    def sync_merged(self, name, g, pred, every_k=None):
+        """Merge-boundary sync: the dp reduction runs under lax.cond on
+        the program's own apply predicate, so the k-1 non-apply steps of
+        every merge window ship ZERO gradient bytes (the accumulation
+        stays local, exact fp32 — the bitwise invariant holds on the
+        LOCAL sums). Byte accounting amortizes by every_k when the
+        merge factor is statically known (avg=True merges expose it via
+        the scale op); an unknown k books the full per-step cost — a
+        conservative over-count, never an under-count."""
+        size = int(np.prod(g.shape)) if g.shape else 1
+        itemsize = jnp.dtype(g.dtype).itemsize
+        if size < self.min_size:
+            raw = wire = size * itemsize
+            self.synced_exact.append(name)
+            red = lax.pmean if self.mean else lax.psum
+
+            def sync_fn(v):
+                return red(v, self.axis_name)
+        else:
+            raw, wire = quant_ops.quantized_wire_bytes(
+                size, itemsize, self.block_size, self.bits)
+            self.synced.append(name)
+
+            def sync_fn(v):
+                return quantized_psum(v, self.axis_name, self.block_size,
+                                      self.bits, mean=self.mean)
+        scale = 1.0 / every_k if every_k else 1.0
+        self.raw_bytes += raw * scale
+        self.wire_bytes += wire * scale
+        self.synced_merged.append(name)
+        return lax.cond(jnp.reshape(pred, ()).astype(bool), sync_fn,
+                        lambda v: v, g)
 
 
 _sync_tls = threading.local()

@@ -28,7 +28,8 @@ import threading
 
 #: ops with a Pallas lowering behind this dispatch scope (flash attention
 #: has its own auto-engaging entry in layers.attention and is not listed)
-PALLAS_OPS = ("softmax_with_cross_entropy", "adam", "layer_norm")
+PALLAS_OPS = ("softmax_with_cross_entropy", "adam", "layer_norm",
+              "fused_mlm_head_loss")
 
 _local = threading.local()
 

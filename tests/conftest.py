@@ -72,6 +72,11 @@ def pytest_configure(config):
         "fleet: serving-fleet batteries (micro-batching router + "
         "replica members over CoordServer; SIGKILL chaos under "
         "sustained load) — wall-bounded, tier-1-safe")
+    config.addinivalue_line(
+        "markers",
+        "pp: pipeline-parallel CompiledProgram batteries (pp x dp mesh "
+        "cut/lowering, GPipe/1F1B parity, elastic pp rewind) — CPU "
+        "8-device mesh, tier-1-safe")
 
 
 @pytest.fixture(autouse=True)

@@ -71,7 +71,23 @@ def test_budget_table_covers_the_contract():
         "transport_roundtrip_ms", "transport_gather_ms",
         "transport_failover_ms",
         "serving_p50_ms", "serving_p99_ms", "serving_shed_rate",
-        "serving_error_rate"}
+        "serving_error_rate",
+        "pp_step_s", "pp_bubble_frac", "pp_cache_hit_rate"}
+
+
+def test_pipeline_section_measures_the_pp_path():
+    """ISSUE-10 satellite: the pipeline section reports the pp=2 x dp=4
+    step wall, a bubble fraction in [0, 1] alongside the (M+K-1)/M
+    model value, and a cache-hit rate whose misses equal the number of
+    distinct schedule configs (toggle re-lowers, repeats hit)."""
+    m = bench_micro.bench_pipeline(steps=2)
+    assert 0 < m["pp_step_s"] < 30.0
+    assert 0.0 <= m["pp_bubble_frac"] <= 1.0
+    assert 0.0 < m["pp_bubble_frac_ideal"] < 1.0
+    # 4 toggle runs over 2 distinct schedule configs on one fresh
+    # executor: exactly two lowerings, both repeats hit
+    assert m["pp_cache_compiles"] == 2
+    assert m["pp_cache_hit_rate"] == 0.5
 
 
 def test_transport_section_measures_latency():

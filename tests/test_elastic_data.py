@@ -409,3 +409,79 @@ def test_lr_rescale_gradient_merge_compensation(tmp_path):
     for h in range(2):
         if h not in died:
             assert abs(_lr_value(trainers[h]) - 0.05) < 1e-9
+
+# ---------------------------------------------------------------------------
+# feed-driven CompiledProgram pods (PR 10 satellite: ShardedFeed batches
+# assembled through a dp-sharded CompiledProgram — the carried-over
+# ROADMAP follow-on; lr_rescale applies on the sharded path)
+# ---------------------------------------------------------------------------
+
+def _make_compiled_feed_pod(tmp_path, tag, files, n_hosts, dp=4,
+                            batch=4, **elastic_kw):
+    """_make_feed_pod with each trainer targeting a dp-sharded
+    CompiledProgram: every host draws its OWN lanes' batch and shards it
+    over its dp axis (the per-host batch-assembly convention: host h's
+    ShardedFeed rows ARE its replica's global batch; a real multi-host
+    mesh assembles the rows via the process-local feed path)."""
+    from paddle_tpu.framework.compiler import CompiledProgram
+    main, startup, loss, sid = _data_program()
+    trainers = []
+    for h in range(n_hosts):
+        sc, exe = Scope(), pt.Executor()
+        with scope_guard(sc):
+            exe.run(startup)
+        feed = ShardedFeed(files, n_hosts, h, seed=5, batch_size=batch,
+                           epochs=1)
+        trainers.append(ResilientTrainer(
+            exe, CompiledProgram(main).with_mesh({"dp": dp}),
+            str(tmp_path / tag / ("h%d" % h)), fetch_list=[loss, sid],
+            checkpoint_every=2, scope=sc, retry_policy=_fast_policy(),
+            feed=feed))
+    pod = ElasticTrainer(
+        trainers, LocalCoordinator(n_hosts, timeout_s=POD_TIMEOUT_S),
+        **elastic_kw)
+    return pod, trainers, loss
+
+
+def test_feed_driven_compiled_pod_matches_plain(tmp_path):
+    """The dp-sharded CompiledProgram path is semantics-neutral for a
+    feed-driven pod: identical committed losses + exactly-once census
+    vs the plain-Program pod over the same lanes."""
+    files = _sample_files(6, 4)
+    pod_p, _, _ = _make_feed_pod(tmp_path, "fcp_plain", files, 3,
+                                 batch=4, rejoin=False)
+    ref = pod_p.run(None, steps=60)
+    resilience.clear_events()
+    pod_c, _, _ = _make_compiled_feed_pod(tmp_path, "fcp_comp", files, 3,
+                                          rejoin=False)
+    out = pod_c.run(None, steps=60)
+    assert _census(out) == _census(ref)
+    for h in range(3):
+        np.testing.assert_allclose(_losses(out[h]), _losses(ref[h]),
+                                   rtol=1e-5, atol=1e-7)
+
+
+def test_feed_driven_compiled_pod_lr_rescale_on_shrink(tmp_path):
+    """lr_rescale applies on the SHARDED path: losing 1 of 3 hosts in a
+    compiled feed-driven pod shrinks each survivor's mesh (elastic
+    re-shard) AND scales the LR vars inside the compiled step's state —
+    the next windows train with the rescaled LR."""
+    files = _sample_files(6, 4)
+    pod, trainers, _ = _make_compiled_feed_pod(
+        tmp_path, "fcp_lr", files, 3, dp=2, batch=2, rejoin=False,
+        lr_rescale=True)
+    with resilience.inject("step:die@7"):
+        out = pod.run(None, steps=60)
+    died = {e["host"] for e in resilience.events("host_death")}
+    assert len(died) == 1
+    ev = resilience.events("lr_rescale")
+    assert ev and ev[-1]["capacity"] == "2/3"
+    assert abs(ev[-1]["factor"] - 2.0 / 3.0) < 1e-6
+    shrink = resilience.events("elastic_shrink")
+    assert shrink and shrink[-1]["capacity"] == "2/3"
+    for h in range(3):
+        if h not in died:
+            assert abs(_lr_value(trainers[h]) - 0.05 * 2 / 3) < 1e-6
+    # exactly-once over the survivors + the pre-death commits
+    ids = _census(out)
+    assert len(ids) == len(set(ids))

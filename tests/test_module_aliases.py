@@ -31,10 +31,12 @@ def test_fluid_module_path_resolves(name):
 def test_alias_symbols_are_the_real_ones():
     from paddle_tpu import executor as ex, compiler as co, backward as bw
     from paddle_tpu.framework.executor import Executor
-    from paddle_tpu.framework.compiler import CompiledProgram
+    from paddle_tpu.framework.compiler import CompiledProgram, CompilePlan
     from paddle_tpu.framework.backward import append_backward
     assert ex.Executor is Executor
     assert co.CompiledProgram is CompiledProgram
+    # the PR 10 compile-plan surface rides the fluid.compiler alias too
+    assert co.CompilePlan is CompilePlan
     assert bw.append_backward is append_backward
 
 

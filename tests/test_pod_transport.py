@@ -786,6 +786,88 @@ def test_elastic_die_shrink_rejoin_contract_parity(tmp_path, kind):
     assert [i for i, o in enumerate(out[live]) if o is None] == []
 
 
+def _pp_toy_program():
+    from paddle_tpu.distributed.pipeline_program import pp_stage_guard
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("px", [8, 8], "float32", append_batch_size=False)
+        h = x
+        for i in range(2):
+            with pp_stage_guard(i):
+                h = layers.fc(h, size=8, act="tanh")
+        y = layers.data("py", [8, 8], "float32", append_batch_size=False)
+        loss = layers.reduce_mean(layers.square(h - y))
+        optimizer.SGD(0.2).minimize(loss)
+    return main, startup, loss
+
+
+def _pp_toy_feeds(n, seed=0):
+    rng = np.random.RandomState(seed)
+    return [{"px": rng.randn(8, 8).astype(np.float32),
+             "py": rng.randn(8, 8).astype(np.float32)}
+            for _ in range(n)]
+
+
+def _pp_host_trainer(tmp_path, tag, hid, main, startup, loss):
+    from paddle_tpu.framework.compiler import CompiledProgram, \
+        BuildStrategy
+    sc, exe = Scope(), pt.Executor()
+    with scope_guard(sc):
+        exe.run(startup)
+    bs = BuildStrategy(pp_stages=2, pp_micro_batches=2)
+    bs.mesh_axes = {"pp": 2, "dp": 2}
+    return ResilientTrainer(
+        exe, CompiledProgram(main, bs),
+        str(tmp_path / tag / ("h%d" % hid)), fetch_list=[loss],
+        checkpoint_every=2, scope=sc, retry_policy=_fast_policy())
+
+
+@pytest.mark.parametrize("kind", ["local", "socket", "replicated"])
+def test_elastic_pp_rewind_contract_parity(tmp_path, kind):
+    """PR 10: host loss on a PIPELINE mesh takes the consensus-rewind
+    path (elastic_pp_rewind + pod_restore, never a re-shard), in
+    host_id mode over all three transports, with the survivor's replay
+    BITWISE identical to an uninterrupted reference."""
+    main, startup, loss = _pp_toy_program()
+    feeds = _pp_toy_feeds(6)
+    # uninterrupted reference (replicated feeds: every host's
+    # trajectory is this one)
+    ref = _pp_host_trainer(tmp_path, "ppref_" + kind, 0, main, startup,
+                           loss)
+    ref_out = ref.run(feeds)
+    ref_w = {n: ref._scope.get_numpy(n).copy()
+             for n in ("fc_0.w_0_0", "fc_1.w_0_0")}
+    resilience.clear_events()
+    with contextlib.ExitStack() as stack:
+        cos = _make_coords(kind, stack, 2)
+        pods, trainers = [], []
+        for h in range(2):
+            t = _pp_host_trainer(tmp_path, "pp_" + kind, h, main,
+                                 startup, loss)
+            trainers.append(t)
+            pods.append(ElasticTrainer(
+                [t], cos[h], host_id=h, rejoin=False))
+        with resilience.inject("step:die@3"):   # window 2 of 2-host run
+            out, errs = _run_hosts(lambda h: pods[h].run(feeds), 2)
+        assert not errs, errs
+    assert resilience.events("elastic_pp_rewind")
+    assert resilience.events("pod_restore")       # a real rewind
+    assert not resilience.events("elastic_shrink")
+    assert not resilience.events("reshard")       # the mesh never moved
+    died = {e["host"] for e in resilience.events("host_death")}
+    assert len(died) == 1
+    live = (set(range(2)) - died).pop()
+    # bitwise replay: the survivor's fetches and final params equal the
+    # uninterrupted run exactly
+    assert [i for i, o in enumerate(out[live]) if o is None] == []
+    for i in range(len(feeds)):
+        np.testing.assert_array_equal(np.asarray(out[live][i][0]),
+                                      np.asarray(ref_out[i][0]))
+    for n, want in ref_w.items():
+        np.testing.assert_array_equal(
+            trainers[live]._scope.get_numpy(n), want)
+
+
 # ---------------------------------------------------------------------------
 # the procpod battery: REAL processes, SIGKILL, no shared filesystem
 # ---------------------------------------------------------------------------
@@ -927,6 +1009,174 @@ def test_procpod_sigkill_shrink_and_rejoin(tmp_path):
             done = [ln for ln in outs[key].splitlines()
                     if ln.startswith("DONE")]
             assert done and done[0].split()[-1] == "0,1,2", outs[key]
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+        srv.close()
+
+
+_PP_WORKER = """\
+import hashlib
+import os
+import sys
+import time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+addr, hid, ckroot = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+
+import numpy as np
+import paddle_tpu as pt
+from paddle_tpu import layers, optimizer
+from paddle_tpu.distributed.pipeline_program import pp_stage_guard
+from paddle_tpu.framework.compiler import CompiledProgram, BuildStrategy
+from paddle_tpu.framework.scope import Scope, scope_guard
+from paddle_tpu.framework import resilience
+from paddle_tpu.framework.coordination import (SocketCoordinator,
+                                               ElasticTrainer)
+from paddle_tpu.framework.resilience import ResilientTrainer, RetryPolicy
+
+main, startup = pt.Program(), pt.Program()
+with pt.program_guard(main, startup):
+    x = layers.data("px", [8, 8], "float32", append_batch_size=False)
+    h = x
+    for i in range(2):
+        with pp_stage_guard(i):
+            h = layers.fc(h, size=8, act="tanh")
+    y = layers.data("py", [8, 8], "float32", append_batch_size=False)
+    loss = layers.reduce_mean(layers.square(h - y))
+    optimizer.SGD(0.2).minimize(loss)
+rng = np.random.RandomState(11)
+feeds = [{"px": rng.randn(8, 8).astype(np.float32),
+          "py": rng.randn(8, 8).astype(np.float32)} for _ in range(12)]
+sc, exe = Scope(), pt.Executor()
+with scope_guard(sc):
+    exe.run(startup)
+bs = BuildStrategy(pp_stages=2, pp_micro_batches=2)
+bs.mesh_axes = {"pp": 2, "dp": 2}
+t = ResilientTrainer(
+    exe, CompiledProgram(main, bs), os.path.join(ckroot, "h%d" % hid),
+    fetch_list=[loss], checkpoint_every=2, scope=sc,
+    retry_policy=RetryPolicy(base_delay_s=0.0, jitter=0.0))
+# pace the windows so the parent's SIGKILL reliably lands MID-RUN
+orig = t._dispatch_batches
+def paced(*a, **k):
+    time.sleep(0.2)
+    return orig(*a, **k)
+t._dispatch_batches = paced
+co = SocketCoordinator(addr, 3, hid, timeout_s=60.0, poll_s=0.005,
+                       mesh_reinit=False, hb_interval_s=0.1)
+pod = ElasticTrainer([t], co, host_id=hid, rejoin=False)
+out = pod.run(feeds)
+kinds = sorted({e["kind"] for e in resilience.events()})
+print("EVENTS", hid, ",".join(kinds), flush=True)
+dig = hashlib.sha256()
+for n in ("fc_0.w_0_0", "fc_0.b_0_0", "fc_1.w_0_0", "fc_1.b_0_0"):
+    dig.update(np.ascontiguousarray(sc.get_numpy(n)).tobytes())
+print("PARAMS", hid, dig.hexdigest(), flush=True)
+print("LOSSES", hid,
+      ",".join("%.17g" % float(np.asarray(o[0]).ravel()[0])
+               for o in out), flush=True)
+co.close()
+"""
+
+
+@pytest.mark.procpod
+def test_procpod_pp_pod_sigkill_takes_consensus_rewind(tmp_path):
+    """THE pp chaos acceptance over REAL processes: 3 workers each run
+    an ElasticTrainer around a pp=2 x dp=2 CompiledProgram over a TCP
+    CoordServer; SIGKILL one mid-run. The heartbeat deadline fences it,
+    and the survivors take the CONSENSUS-REWIND path (elastic_pp_rewind
+    + pod_restore, never a re-shard) with BITWISE replay: their losses
+    and final params equal the uninterrupted in-process reference."""
+    import paddle_tpu as _pt
+    from paddle_tpu.distributed.pipeline_program import pp_stage_guard
+    from paddle_tpu.framework.compiler import CompiledProgram, \
+        BuildStrategy
+
+    # the uninterrupted reference, computed in THIS process (same
+    # seeds -> every worker's trajectory is exactly this one)
+    main, startup = _pt.Program(), _pt.Program()
+    with _pt.program_guard(main, startup):
+        x = layers.data("px", [8, 8], "float32", append_batch_size=False)
+        h = x
+        for i in range(2):
+            with pp_stage_guard(i):
+                h = layers.fc(h, size=8, act="tanh")
+        y = layers.data("py", [8, 8], "float32", append_batch_size=False)
+        loss = layers.reduce_mean(layers.square(h - y))
+        optimizer.SGD(0.2).minimize(loss)
+    rng = np.random.RandomState(11)
+    feeds = [{"px": rng.randn(8, 8).astype(np.float32),
+              "py": rng.randn(8, 8).astype(np.float32)}
+             for _ in range(12)]
+    sc, exe = Scope(), pt.Executor()
+    with scope_guard(sc):
+        exe.run(startup)
+    bs = BuildStrategy(pp_stages=2, pp_micro_batches=2)
+    bs.mesh_axes = {"pp": 2, "dp": 2}
+    ref = ResilientTrainer(
+        exe, CompiledProgram(main, bs), str(tmp_path / "ppref"),
+        fetch_list=[loss], checkpoint_every=2, scope=sc,
+        retry_policy=_fast_policy())
+    ref_out = ref.run(feeds)
+    ref_losses = ["%.17g" % float(np.asarray(o[0]).ravel()[0])
+                  for o in ref_out]
+    import hashlib
+    dig = hashlib.sha256()
+    for n in ("fc_0.w_0_0", "fc_0.b_0_0", "fc_1.w_0_0", "fc_1.b_0_0"):
+        dig.update(np.ascontiguousarray(sc.get_numpy(n)).tobytes())
+    ref_hash = dig.hexdigest()
+
+    script = str(tmp_path / "pp_worker.py")
+    with open(script, "w") as fh:
+        fh.write(textwrap.dedent(_PP_WORKER))
+    srv = CoordServer(3, hb_deadline_s=1.0).start()
+    procs = {}
+
+    def spawn(hid):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = os.pathsep.join(
+            [p for p in (env.get("PYTHONPATH"),
+                         os.path.dirname(os.path.dirname(
+                             os.path.abspath(__file__)))) if p])
+        env.pop("XLA_FLAGS", None)   # the worker pins its own 8-dev CPU
+        return subprocess.Popen(
+            [sys.executable, script, srv.address, str(hid),
+             str(tmp_path / "ck")],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env)
+
+    try:
+        for h in range(3):
+            procs[h] = spawn(h)
+        # real progress first (the paced windows leave a wide target),
+        # then SIGKILL host 2 mid-window
+        _wait_state(srv, lambda s: "r1.w2" in s.completed,
+                    "window 2 to complete", timeout_s=120.0)
+        os.kill(procs[2].pid, signal.SIGKILL)
+        procs[2].wait(timeout=10)
+        _wait_state(srv, lambda s: 2 in s.lost, "heartbeat tombstone")
+        outs = {}
+        for h in (0, 1):
+            out, _ = procs[h].communicate(timeout=120)
+            outs[h] = out
+            assert procs[h].returncode == 0, (h, out)
+        for h in (0, 1):
+            events = [ln for ln in outs[h].splitlines()
+                      if ln.startswith("EVENTS %d" % h)][0]
+            assert "elastic_pp_rewind" in events, outs[h]
+            assert "pod_restore" in events, outs[h]
+            assert "elastic_shrink" not in events, outs[h]
+            assert "reshard" not in events.split()[-1].split(","), \
+                outs[h]
+            losses = [ln for ln in outs[h].splitlines()
+                      if ln.startswith("LOSSES %d" % h)][0]
+            assert losses.split()[2].split(",") == ref_losses, outs[h]
+            params = [ln for ln in outs[h].splitlines()
+                      if ln.startswith("PARAMS %d" % h)][0]
+            assert params.split()[2] == ref_hash, outs[h]
     finally:
         for p in procs.values():
             if p.poll() is None:

@@ -1,0 +1,400 @@
+"""Pipeline parallelism as a first-class CompiledProgram path.
+
+The tentpole battery: a pp_stage_guard-stamped model with a NORMAL
+minimize() (backward + optimizer ops in the program) trains through
+``BuildStrategy(pp_stages=K, pp_micro_batches=M, pp_schedule=...)`` on a
+pp x dp mesh — the step lowers through the GPipe/1F1B ring schedules
+inside one shard_map, the program's own update section runs SPMD per
+stage, dp gradient sync (quantized included) rides the data axis, and
+the executor compile cache keys on (mesh axes, pp cut, schedule).
+Elastic: a host loss on a pp pod takes the consensus-rewind path
+(elastic_pp_rewind) with bitwise replay.
+"""
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers, optimizer
+from paddle_tpu.distributed.pipeline_program import pp_stage_guard
+from paddle_tpu.framework.compiler import (CompiledProgram, BuildStrategy,
+                                           CompilePlan)
+from paddle_tpu.framework.scope import Scope, scope_guard
+from paddle_tpu.framework import resilience
+from paddle_tpu.framework.coordination import LocalCoordinator, \
+    ElasticTrainer
+from paddle_tpu.framework.resilience import ResilientTrainer, RetryPolicy
+
+pytestmark = [pytest.mark.pp]
+
+N_LAYER, DM, BATCH = 4, 16, 16
+
+
+def _pp_program(n_stage=2, stamp=True, opt=None, dm=DM, batch=BATCH,
+                n_layer=N_LAYER):
+    """n_layer fc chain cut into n_stage stages + mse loss tail."""
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("pp_x", [batch, dm], "float32",
+                        append_batch_size=False)
+        h = x
+        per = n_layer // n_stage
+        for i in range(n_layer):
+            if stamp:
+                with pp_stage_guard(i // per):
+                    h = layers.fc(h, size=dm, act="tanh")
+            else:
+                h = layers.fc(h, size=dm, act="tanh")
+        y = layers.data("pp_y", [batch, dm], "float32",
+                        append_batch_size=False)
+        loss = layers.reduce_mean(layers.square(h - y))
+        (opt if opt is not None else optimizer.SGD(0.2)).minimize(loss)
+    return main, startup, loss
+
+
+def _data(n_steps, seed=0, dm=DM, batch=BATCH):
+    rng = np.random.RandomState(seed)
+    return [(rng.randn(batch, dm).astype(np.float32),
+             rng.randn(batch, dm).astype(np.float32))
+            for _ in range(n_steps)]
+
+
+def _train(main, startup, loss, strategy, data, fetch=None,
+           return_exe=False):
+    with scope_guard(Scope()):
+        exe = pt.Executor()
+        exe.run(startup)
+        comp = CompiledProgram(main, strategy) if strategy is not None \
+            else main
+        out = []
+        for xv, yv in data:
+            vals = exe.run(comp, feed={"pp_x": xv, "pp_y": yv},
+                           fetch_list=fetch or [loss])
+            out.append([np.asarray(v) for v in vals])
+        final = {n: pt.global_scope().get_numpy(n).copy()
+                 for n in [p.name for p in main.all_parameters()]}
+    losses = [float(v[0].reshape(-1)[0]) for v in out]
+    if return_exe:
+        return losses, final, exe
+    return losses, final
+
+
+def _pp_strategy(schedule="1f1b", quant=False, n_stage=2, m=4):
+    bs = BuildStrategy(pp_stages=n_stage, pp_micro_batches=m,
+                       pp_schedule=schedule)
+    bs.mesh_axes = {"pp": n_stage, "dp": 8 // n_stage}
+    bs.quantize_collectives = quant
+    return bs
+
+
+def _dp_strategy(quant=False):
+    bs = BuildStrategy()
+    bs.mesh_axes = {"dp": 8}
+    bs.quantize_collectives = quant
+    return bs
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance criterion: pp x dp CompiledProgram training matches the
+# single-jit dp-only baseline loss curve, both schedules, quant on/off
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("schedule", ["1f1b", "gpipe"])
+@pytest.mark.parametrize("quant", [False, True])
+def test_pp_matches_dp_baseline_loss_curve(schedule, quant):
+    """20 f32 steps of the stamped 4-layer model on pp=2 x dp=4 vs the
+    SAME program trained single-jit on dp=8 (same seed/init/batches):
+    loss curves within rtol 1e-4, final params within 1e-4. With
+    quantize_collectives the baseline is the quantized dp path — the
+    comparison isolates the pipeline lowering, not the codec."""
+    data = _data(20)
+    main, startup, loss = _pp_program()
+    base_losses, base_params = _train(main, startup, loss,
+                                      _dp_strategy(quant), data)
+    pp_losses, pp_params = _train(main, startup, loss,
+                                  _pp_strategy(schedule, quant), data)
+    assert base_losses[-1] < base_losses[0]      # it actually trains
+    np.testing.assert_allclose(pp_losses, base_losses, rtol=1e-4,
+                               atol=1e-6)
+    # params: tight when exact; the quantized codec rounds differently
+    # per topology (different shard slices -> different block scales),
+    # so quant configs get the PR 6 guardrail envelope instead
+    rtol, atol = (1e-4, 1e-5) if not quant else (5e-3, 1e-3)
+    for n in base_params:
+        np.testing.assert_allclose(pp_params[n], base_params[n],
+                                   rtol=rtol, atol=atol)
+
+
+def test_pp_quantized_sync_moves_real_bytes():
+    """quantize_collectives composes with the pp lowering on the dp
+    axis: the collective byte counters move and wire < raw (the
+    stacked stage grads are big enough to quantize)."""
+    data = _data(4)
+    main, startup, loss = _pp_program()
+    resilience.clear_bytes()
+    _train(main, startup, loss, _pp_strategy("1f1b", quant=True), data)
+    tot = resilience.bytes_totals().get("collective")
+    assert tot and tot["raw"] > 0
+    assert tot["wire"] < tot["raw"]
+
+
+def test_pp_auto_cut_matches_stamped():
+    """An UNSTAMPED program auto-cuts (even op-count) into the same
+    stages the explicit stamps produce — identical training."""
+    data = _data(6)
+    main_s, startup_s, loss_s = _pp_program(stamp=True)
+    ref, _ = _train(main_s, startup_s, loss_s, _pp_strategy(), data)
+    main_u, startup_u, loss_u = _pp_program(stamp=False)
+    got, _ = _train(main_u, startup_u, loss_u, _pp_strategy(), data)
+    np.testing.assert_allclose(got, ref, rtol=1e-6)
+
+
+def test_pp_run_steps_window_matches_sequential():
+    """run_steps on a pp CompiledProgram: one scanned W-step window ==
+    W sequential run() calls."""
+    data = _data(4)
+    main, startup, loss = _pp_program()
+    seq, seq_params = _train(main, startup, loss, _pp_strategy(), data)
+    with scope_guard(Scope()):
+        exe = pt.Executor()
+        exe.run(startup)
+        comp = CompiledProgram(main, _pp_strategy())
+        stacked = {"pp_x": np.stack([d[0] for d in data]),
+                   "pp_y": np.stack([d[1] for d in data])}
+        outs = exe.run_steps(comp, feed=stacked, fetch_list=[loss])
+        win = [float(v) for v in np.asarray(outs[0]).reshape(-1)]
+        win_params = {n: pt.global_scope().get_numpy(n).copy()
+                      for n in seq_params}
+    np.testing.assert_allclose(win, seq, rtol=1e-6)
+    for n in seq_params:
+        np.testing.assert_allclose(win_params[n], seq_params[n],
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_pp_gradient_merge_runs_unchanged():
+    """The program's OWN gradient-merge accumulation runs inside the pp
+    lowering: k=2 merge on pp=2 x dp=4 matches the dp-only merged
+    baseline, and params only move at merge boundaries."""
+    from paddle_tpu.contrib.extend_optimizer import GradientMergeOptimizer
+
+    def gm():
+        return GradientMergeOptimizer(optimizer.SGD(0.2), k_steps=2)
+
+    data = _data(6)
+    main_b, startup_b, loss_b = _pp_program(opt=gm())
+    base, base_params = _train(main_b, startup_b, loss_b,
+                               _dp_strategy(), data)
+    main_p, startup_p, loss_p = _pp_program(opt=gm())
+    got, got_params = _train(main_p, startup_p, loss_p,
+                             _pp_strategy(), data)
+    np.testing.assert_allclose(got, base, rtol=1e-4, atol=1e-6)
+    # params move only at the k=2 boundaries: steps 0 and 1 see the
+    # same (initial) weights, so equal inputs would repeat the loss
+    assert base[0] != base[2]
+
+
+def test_pp_aux_fetches_come_from_the_tail():
+    """fetch_list entries beyond the loss are computed by the unstamped
+    tail on the un-microbatched batch (serial semantics); stage
+    activations are rejected with a named error."""
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("pp_x", [BATCH, DM], "float32",
+                        append_batch_size=False)
+        h = x
+        hs = []
+        for i in range(2):
+            with pp_stage_guard(i):
+                h = layers.fc(h, size=DM, act="tanh")
+                hs.append(h)
+        y = layers.data("pp_y", [BATCH, DM], "float32",
+                        append_batch_size=False)
+        err = layers.square(h - y)
+        loss = layers.reduce_mean(err)
+        optimizer.SGD(0.1).minimize(loss)
+    (xv, yv), = _data(1)
+    with scope_guard(Scope()):
+        exe = pt.Executor()
+        exe.run(startup)
+        comp = CompiledProgram(main, _pp_strategy(m=2))
+        lv, ev = exe.run(comp, feed={"pp_x": xv, "pp_y": yv},
+                         fetch_list=[loss, err])
+        assert np.asarray(ev).shape == (BATCH, DM)
+        np.testing.assert_allclose(float(np.mean(np.asarray(ev))),
+                                   float(np.asarray(lv).reshape(-1)[0]),
+                                   rtol=1e-5)
+        with pytest.raises(ValueError, match="loss section"):
+            exe.run(comp, feed={"pp_x": xv, "pp_y": yv},
+                    fetch_list=[loss, hs[0]])
+
+
+# ---------------------------------------------------------------------------
+# compile plan + executor cache
+# ---------------------------------------------------------------------------
+
+def test_compile_plan_kinds():
+    main, startup, loss = _pp_program()
+    plain = CompiledProgram(main, _dp_strategy()).compile_plan()
+    assert isinstance(plain, CompilePlan)
+    assert plain.kind == "single_jit" and plain.cut is None
+    pp = CompiledProgram(main, _pp_strategy("gpipe")).compile_plan()
+    assert pp.kind == "pipeline"
+    assert pp.schedule == "gpipe" and pp.cut.plan.n_stage == 2
+    # the cut signature joins the token — two schedules never collide
+    pp2 = CompiledProgram(main, _pp_strategy("1f1b")).compile_plan()
+    assert pp.token != pp2.token
+
+
+def test_pp_cache_toggles_relower_and_repeats_hit():
+    """Toggling pp_stages / pp_schedule re-lowers (misses counted);
+    repeat runs of each config hit the cached executable."""
+    data = _data(2)
+    main, startup, loss = _pp_program()
+    with scope_guard(Scope()):
+        exe = pt.Executor()
+        exe.run(startup)
+        configs = [_dp_strategy(), _pp_strategy("1f1b"),
+                   _pp_strategy("gpipe")]
+        comps = [CompiledProgram(main, bs) for bs in configs]
+        for comp in comps:
+            for xv, yv in data:
+                exe.run(comp, feed={"pp_x": xv, "pp_y": yv},
+                        fetch_list=[loss])
+        assert exe.cache_misses == 3      # one lowering per config
+        assert exe.cache_hits == 3        # every repeat hit
+        # second pass over every config: all hits
+        for comp in comps:
+            exe.run(comp, feed=dict(zip(("pp_x", "pp_y"), data[0])),
+                    fetch_list=[loss])
+        assert exe.cache_misses == 3
+        assert exe.cache_hits == 6
+
+
+# ---------------------------------------------------------------------------
+# named errors
+# ---------------------------------------------------------------------------
+
+def test_pp_named_errors():
+    main, startup, loss = _pp_program()
+    (xv, yv), = _data(1)
+    feed = {"pp_x": xv, "pp_y": yv}
+    with scope_guard(Scope()):
+        exe = pt.Executor()
+        exe.run(startup)
+        # mesh pp axis must match the cut
+        bs = BuildStrategy(pp_stages=2)
+        bs.mesh_axes = {"pp": 4, "dp": 2}
+        with pytest.raises(ValueError, match="does not match"):
+            exe.run(CompiledProgram(main, bs), feed=feed,
+                    fetch_list=[loss])
+        # unknown schedule
+        bs = _pp_strategy()
+        bs.pp_schedule = "zigzag"
+        with pytest.raises(ValueError, match="pp_schedule"):
+            exe.run(CompiledProgram(main, bs), feed=feed,
+                    fetch_list=[loss])
+    # un-minimized program: the pp path has no backward section to cut
+    main2, startup2 = pt.Program(), pt.Program()
+    with pt.program_guard(main2, startup2):
+        x = layers.data("pp_x", [BATCH, DM], "float32",
+                        append_batch_size=False)
+        h = x
+        for i in range(2):
+            with pp_stage_guard(i):
+                h = layers.fc(h, size=DM, act="tanh")
+        y = layers.data("pp_y", [BATCH, DM], "float32",
+                        append_batch_size=False)
+        loss2 = layers.reduce_mean(layers.square(h - y))
+    with scope_guard(Scope()):
+        exe = pt.Executor()
+        exe.run(startup2)
+        with pytest.raises(ValueError, match="minimize"):
+            exe.run(CompiledProgram(main2, _pp_strategy()), feed=feed,
+                    fetch_list=[loss2])
+
+
+# ---------------------------------------------------------------------------
+# elastic: host loss on a pp pod = consensus rewind with bitwise replay
+# ---------------------------------------------------------------------------
+
+def _fast_policy():
+    return RetryPolicy(base_delay_s=0.0, jitter=0.0, sleep=lambda s: None)
+
+
+def _pp_pod(tmp_path, tag, main, startup, loss, n_hosts=3, rejoin=True):
+    trainers = []
+    for h in range(n_hosts):
+        sc, exe = Scope(), pt.Executor()
+        with scope_guard(sc):
+            exe.run(startup)
+        trainers.append(ResilientTrainer(
+            exe, CompiledProgram(main, _pp_strategy()),
+            str(tmp_path / tag / ("h%d" % h)), fetch_list=[loss],
+            checkpoint_every=2, scope=sc, retry_policy=_fast_policy()))
+    pod = ElasticTrainer(trainers,
+                         LocalCoordinator(n_hosts, timeout_s=300.0),
+                         rejoin=rejoin)
+    return pod, trainers
+
+
+@pytest.mark.faultinject
+@pytest.mark.pod
+def test_elastic_pp_rewind_bitwise_replay(tmp_path):
+    """SIGKILL-equivalent host death in a pp pod: instead of the
+    elastic re-shard (stage state cannot leave its pp slice), the pod
+    takes the consensus-rewind path — elastic_pp_rewind + pod_restore
+    events, ZERO reshard/elastic_shrink events, and the replay is
+    BITWISE identical to an uninterrupted run on every survivor."""
+    resilience.install(None)
+    resilience.clear_events()
+    n = 6
+    data = _data(n, seed=7)
+    feeds = [{"pp_x": xv, "pp_y": yv} for xv, yv in data]
+    main, startup, loss = _pp_program()
+
+    # uninterrupted single-host reference (replicated feeds: every pod
+    # host's trajectory is exactly this one)
+    sc, exe = Scope(), pt.Executor()
+    with scope_guard(sc):
+        exe.run(startup)
+    ref = ResilientTrainer(
+        exe, CompiledProgram(main, _pp_strategy()),
+        str(tmp_path / "ref"), fetch_list=[loss], checkpoint_every=2,
+        scope=sc, retry_policy=_fast_policy())
+    ref_out = ref.run(feeds)
+    ref_params = {p.name: sc.get_numpy(p.name).copy()
+                  for p in main.all_parameters()}
+
+    resilience.clear_events()
+    pod, trainers = _pp_pod(tmp_path, "chaos", main, startup, loss)
+    # 3 hosts x 1-step windows: fire 10 lands mid-run on one host
+    with resilience.inject("step:die@10"):
+        out = pod.run(feeds)
+
+    kinds = [e["kind"] for e in resilience.events()]
+    assert "elastic_pp_rewind" in kinds
+    # the rewind path, not the re-shard path:
+    assert "elastic_shrink" not in kinds and "reshard" not in kinds
+    assert resilience.events("pod_restore")
+    # a PURE capacity loss is budget-free: no restart counted, no
+    # backoff — only real faults may consume the pod's restart budget
+    assert "pod_restart" not in kinds and "giveup" not in kinds
+    died = {e["host"] for e in resilience.events("host_death")}
+    assert len(died) == 1
+    for h in range(3):
+        if h in died:
+            continue
+        assert all(o is not None for o in out[h])
+        for i in range(n):
+            np.testing.assert_array_equal(np.asarray(out[h][i][0]),
+                                          np.asarray(ref_out[i][0]))
+    # survivors' final params BITWISE match the uninterrupted run
+    for h, t in enumerate(trainers):
+        if h in died and not resilience.events("rejoin"):
+            continue
+        for nm, want in ref_params.items():
+            np.testing.assert_array_equal(t._scope.get_numpy(nm), want)
+    # the mesh never changed: full pp x dp axes on every trainer
+    for t in trainers:
+        assert t._target._build_strategy.mesh_axes == {"pp": 2, "dp": 4}

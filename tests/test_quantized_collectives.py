@@ -144,12 +144,14 @@ def test_quantized_run_steps_window_matches_sequential():
     assert win_bytes == seq_bytes   # 4 steps either way
 
 
-def test_gradient_merge_accumulation_is_exact_fp32():
+@pytest.mark.parametrize("merge_sync", [False, True])
+def test_gradient_merge_accumulation_is_exact_fp32(merge_sync):
     """grad-merge-aware: the accumulator adds the already-synced fp32
-    gradient. With k=3 and the SAME batch twice, acc(2 steps) must be
-    BITWISE 2 * acc(1 step) — fp doubling is exact, so any
-    re-quantization or drift inside the accumulation would break
-    equality. Params must not move before the apply step."""
+    gradient (legacy sync) or the raw shard-local fp32 gradient
+    (quantize_merge_sync). Either way, with k=3 and the SAME batch
+    twice, acc(2 steps) must be BITWISE 2 * acc(1 step) — fp doubling
+    is exact, so any re-quantization or drift inside the accumulation
+    would break equality. Params must not move before the apply step."""
     from paddle_tpu.contrib.extend_optimizer import GradientMergeOptimizer
     rng = np.random.RandomState(2)
     xv = rng.rand(16, 64).astype(np.float32)
@@ -161,7 +163,8 @@ def test_gradient_merge_accumulation_is_exact_fp32():
                 opt=GradientMergeOptimizer(optimizer.SGD(0.1), k_steps=3))
             exe = pt.Executor()
             exe.run(startup)
-            comp = _compiled(main, True)
+            comp = _compiled(main, True,
+                             quantize_merge_sync=merge_sync)
             w0 = pt.global_scope().get_numpy("q_w1").copy()
             for _ in range(n_steps):
                 exe.run(comp, feed={"x": xv, "y": yv}, fetch_list=[loss])
@@ -422,3 +425,72 @@ def test_quantize_min_size_is_in_the_compile_cache_token():
         assert len(exe._cache) == n0 + 1
         exact = resilience.bytes_totals()["collective"]
         assert exact["wire"] == exact["raw"]
+
+
+# ---------------------------------------------------------------------------
+# once-per-k quantized sync for grad-merge windows (PR 10 satellite)
+# ---------------------------------------------------------------------------
+
+def test_merge_window_syncs_once_per_k():
+    """With a GradientMergeOptimizer(k=3) the quantized dp sync moves to
+    the merge boundary: the collective byte counters drop to 1/k of the
+    legacy every-step sync (the lax.cond skips the collective on
+    non-apply steps), while the loss/param trajectories stay inside the
+    quantized guardrail envelope. The knob is OPT-IN (mid-window
+    accumulators hold shard-LOCAL sums, so snapshots must land on
+    k-aligned boundaries — see the BuildStrategy comment); the PR 6
+    bitwise accumulation pin keeps holding either way."""
+    from paddle_tpu.contrib.extend_optimizer import GradientMergeOptimizer
+
+    def gm():
+        return GradientMergeOptimizer(optimizer.SGD(0.1), k_steps=3)
+
+    def run(merge_sync, steps=6):
+        resilience.clear_bytes()
+        losses, w1, _ = _train(True, steps=steps, opt=gm(),
+                               quantize_merge_sync=merge_sync)
+        return losses, w1, dict(resilience.bytes_totals()["collective"])
+
+    legacy, w_legacy, b_legacy = run(False)
+    merged, w_merged, b_merged = run(True)
+    # wire/raw drop to ~1/3 (6 steps = 2 full merge windows; the
+    # amortized accounting divides by the k the scale op exposes)
+    assert b_merged["wire"] * 2.5 < b_legacy["wire"]
+    assert b_merged["raw"] * 2.5 < b_legacy["raw"]
+    np.testing.assert_allclose(merged, legacy, rtol=5e-3, atol=1e-4)
+    np.testing.assert_allclose(w_merged, w_legacy, rtol=5e-3, atol=1e-3)
+
+
+def test_merge_window_toggle_is_a_distinct_cache_entry():
+    """quantize_merge_sync changes WHERE the collective runs inside the
+    traced step — flipping it must re-lower, never reuse the other
+    mode's executable."""
+    from paddle_tpu.contrib.extend_optimizer import GradientMergeOptimizer
+    rng = np.random.RandomState(3)
+    feed = {"x": rng.rand(16, 64).astype(np.float32),
+            "y": rng.randint(0, 8, (16, 1)).astype(np.int64)}
+    with scope_guard(Scope()):
+        main, startup, loss = _mlp_program(
+            opt=GradientMergeOptimizer(optimizer.SGD(0.1), k_steps=3))
+        exe = pt.Executor()
+        exe.run(startup)
+        for flag in (True, False, True):
+            comp = _compiled(main, True, quantize_merge_sync=flag)
+            exe.run(comp, feed=feed, fetch_list=[loss])
+        assert exe.cache_misses == 2 and exe.cache_hits == 1
+
+
+def test_merge_window_without_merge_structure_is_inert():
+    """A plain (no grad-merge) program under quantize_merge_sync=True
+    syncs exactly like the legacy path — detection keys on the
+    accumulator structure, not the flag alone."""
+    resilience.clear_bytes()
+    on_losses, on_w1, _ = _train(True, steps=4, quantize_merge_sync=True)
+    bytes_on = dict(resilience.bytes_totals()["collective"])
+    resilience.clear_bytes()
+    off_losses, off_w1, _ = _train(True, steps=4,
+                                   quantize_merge_sync=False)
+    bytes_off = dict(resilience.bytes_totals()["collective"])
+    assert bytes_on == bytes_off
+    np.testing.assert_allclose(on_losses, off_losses, rtol=1e-6)
+    np.testing.assert_array_equal(on_w1, off_w1)

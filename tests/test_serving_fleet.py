@@ -465,7 +465,17 @@ def test_coordinator_primary_killed_mid_rolling_deploy(tmp_path):
         np.testing.assert_allclose(np.asarray(resp["outputs"][0]),
                                    np.full((1, 3), 12.0), rtol=1e-5)
         # the control plane failed over, term-fenced: the standby is
-        # the primary now and every member observed the bumped term
+        # the primary now and every member observed the bumped term.
+        # Bounded wait — the deploy/traffic asserts above prove the
+        # failover WORKED; the role flip itself trails the hb-deadline
+        # staleness judgement and can lag a loaded suite run past a
+        # fixed sleep (seen flaky at 1/933 under full tier-1)
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            with servers[1].state.lock:
+                if servers[1].state.role == "primary":
+                    break
+            time.sleep(0.05)
         with servers[1].state.lock:
             assert servers[1].state.role == "primary"
             assert servers[1].state.term >= 1
