@@ -127,6 +127,12 @@ BUDGETS = {
     # error-rate gate a broken dispatch path (mass 502s) would leave
     # the latency numbers green on the few requests that survived
     "serving_error_rate": ("max", 0.05),
+    # router-tier HA: kill one of two in-process routers mid-load,
+    # wall until the FleetClient's first successful request on the
+    # survivor (connection-refused rotation + idempotent token
+    # replay). Dominated by the client's per-rotation backoff, not
+    # the heartbeat deadline — leadership can lag, routing cannot.
+    "router_failover_ms": ("max", 15000.0),
     # pipeline-parallel CompiledProgram step on the pp=2 x dp=4 CPU
     # mesh (1F1B, M=4 microbatches): step wall catches a lowering
     # blowup; the MEASURED bubble fraction (per-tick cost fitted from
@@ -572,6 +578,95 @@ def bench_serving(n_replicas=2, clients=4, requests_per_client=30):
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def bench_router_failover(hb_deadline_s=1.0):
+    """Router-tier HA: the outage a killed router costs one client.
+    1 replica + 2 routers (the PR 11 HA tier) on one coordination
+    group; a FleetClient pinned to router 0 (victim-first endpoint
+    order) serves through it, a background client keeps load flowing,
+    then router 0 is severed ABRUPTLY (listener + coordinator client
+    down, no graceful queue drain — the SIGKILL shape an in-process
+    bench can produce) and the clock runs until the pinned client's
+    first successful request on the survivor: connection-refused
+    rotation + idempotent token replay, end to end."""
+    import shutil
+    import tempfile
+    import threading
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    from paddle_tpu.framework.scope import Scope, scope_guard
+    from paddle_tpu.framework.transport import CoordServer
+    from paddle_tpu.serving_fleet import (FleetClient, FleetRouter,
+                                          ReplicaMember)
+    tmp = tempfile.mkdtemp(prefix="paddle_tpu_bench_rtrfo_")
+    members = []
+    try:
+        with scope_guard(Scope()):
+            main, startup = pt.Program(), pt.Program()
+            with pt.program_guard(main, startup):
+                x = layers.data("x", [8], dtype="float32")
+                y = layers.softmax(layers.fc(x, 4))
+            exe = pt.Executor()
+            exe.run(startup)
+            pt.save_inference_model(tmp, ["x"], [y], exe,
+                                    main_program=main,
+                                    format="stablehlo",
+                                    batch_sizes=(8,))
+        srv = CoordServer(3, hb_deadline_s=hb_deadline_s).start()
+        members.append(srv)
+        members.append(ReplicaMember(tmp, srv.address, 1, 0,
+                                     n_routers=2, ctl_interval_s=0.25,
+                                     hb_interval_s=0.25).start())
+        routers = []
+        for rid in (0, 1):
+            r = FleetRouter(srv.address, 1, router_id=rid,
+                            n_routers=2, max_batch=8,
+                            batch_deadline_s=0.002,
+                            ctl_interval_s=0.25, hb_interval_s=0.25,
+                            poll_interval_s=0.05).start()
+            routers.append(r)
+            members.append(r)
+        deadline = time.monotonic() + 10.0
+        while any(len(r.routable()) < 1 for r in routers) \
+                and time.monotonic() < deadline:
+            time.sleep(0.02)
+        xv = [[0.5] * 8, [0.25] * 8]
+        client = FleetClient([routers[0].url, routers[1].url],
+                             request_deadline_s=15.0, backoff_s=0.02)
+        for _ in range(3):    # warm: the client is serving via r0
+            client.infer({"x": xv})
+        stop = threading.Event()
+
+        def load():           # keeps "mid-load" honest
+            side = FleetClient([routers[0].url, routers[1].url],
+                               request_deadline_s=15.0,
+                               backoff_s=0.02)
+            while not stop.is_set():
+                try:
+                    side.infer({"x": xv})
+                except Exception:   # noqa: BLE001 - background load
+                    pass
+        lt = threading.Thread(target=load, daemon=True)
+        lt.start()
+        r0 = routers[0]
+        t0 = time.perf_counter()
+        r0._stop.set()
+        r0._server.shutdown()
+        r0._server.server_close()
+        r0._co.close()
+        client.infer({"x": xv})   # rotates + replays onto the survivor
+        dt = time.perf_counter() - t0
+        stop.set()
+        lt.join(timeout=5.0)
+        return {"router_failover_ms": round(dt * 1e3, 2)}
+    finally:
+        for m in reversed(members):
+            try:
+                m.close()
+            except Exception:   # already severed
+                pass
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def bench_pipeline(steps=4):
     """Pipeline-parallel CompiledProgram on the pp=2 x dp=4 CPU mesh:
     per-step wall of the 1F1B lowering, the measured bubble fraction
@@ -630,12 +725,19 @@ def bench_pipeline(steps=4):
             comp = CompiledProgram(main, strat(schedule, m))
             exe.run(comp, feed={"bp_x": xv, "bp_y": yv},
                     fetch_list=[loss])        # compile + warm
-            t0 = time.perf_counter()
+            # BEST-of-n, not mean: the bubble fraction is fitted from
+            # the difference of two walls, and one contention spike
+            # (GC, a loaded CI box) in the mean inflates the fitted
+            # per-tick cost enough to clamp the fraction at 1
+            best = None
             for _ in range(n):
+                t0 = time.perf_counter()
                 vals = exe.run(comp, feed={"bp_x": xv, "bp_y": yv},
                                fetch_list=[loss])
+                dt = time.perf_counter() - t0
+                best = dt if best is None else min(best, dt)
             assert np.isfinite(np.asarray(vals[0])).all()
-            return (time.perf_counter() - t0) / n, xv, yv
+            return best, xv, yv
 
     m_lo, m_hi = 2, 8
     w_main, xv4, yv4 = wall(4)
@@ -750,7 +852,8 @@ def run_all(rounds_dir=None):
                      ("pipeline", bench_pipeline),
                      ("transport", bench_transport),
                      ("failover", bench_failover),
-                     ("serving", bench_serving)):
+                     ("serving", bench_serving),
+                     ("router_failover", bench_router_failover)):
         t0 = time.perf_counter()
         try:
             metrics.update(fn())

@@ -53,6 +53,12 @@ __all__ = [
     "ElasticTrainer", "agreed_pending",
 ]
 
+# the fence reason dynamic resize stamps on a GROWN slot: the member
+# has never joined, so observers must not treat the tombstone as a
+# host LOSS (no loss hooks, no host_lost event, no mesh re-init) —
+# it clears through the ordinary announce/admit/join path instead
+GROW_FENCE_REASON = "resized: awaiting join"
+
 
 def agreed_pending(verdicts, idx=1):
     """The admission ``[host, nonce]`` pair EVERY participant of a
@@ -165,6 +171,36 @@ class Coordinator(object):
         the split brain fencing exists to prevent."""
         raise NotImplementedError
 
+    def resize(self, n_hosts):
+        """DYNAMIC GROUP RESIZE: change the group size at a round
+        boundary. Grown slots are born FENCED ("resized: awaiting
+        join") so no in-flight gather ever waits for a member that has
+        not joined — the new member's start finds itself fenced and
+        takes the ordinary announce/admit/join path. A shrink only
+        removes TOP ids that are already fenced (drain first); raises
+        :class:`CoordinationError` for the protocol's named refusals
+        (a mid-round call, a live id in the shrink range) and
+        ``ValueError`` for n_hosts < 1. Returns the new size."""
+        raise NotImplementedError
+
+    @staticmethod
+    def _check_resize(n_hosts, current, open_rounds, live_in_range):
+        """Shared resize validation; returns the int size to adopt."""
+        n = int(n_hosts)
+        if n < 1:
+            raise ValueError("resize: n_hosts must be >= 1, got %d" % n)
+        if open_rounds:
+            raise CoordinationError(
+                "resize refused mid-round: gather round(s) %s in "
+                "flight — retry at a round boundary"
+                % sorted(open_rounds)[:3])
+        if n < current and live_in_range:
+            raise CoordinationError(
+                "resize refused: host(s) %s still live — drain/fence "
+                "them before shrinking past their ids"
+                % sorted(live_in_range))
+        return n
+
     # -- shared machinery --------------------------------------------------
     def add_host_loss_hook(self, fn):
         """Register ``fn(lost_ids, live_ids)`` to run on host loss (after
@@ -179,7 +215,7 @@ class Coordinator(object):
         return fn
 
     def admit(self, host_id, joined, nonce, value, name="join",
-              timeout_s=None):
+              timeout_s=None, enact=True, poll_s=0.01):
         """Survivor half of the rejoin protocol.
 
         Every SURVIVOR calls this in the same window (the pending-join
@@ -192,10 +228,29 @@ class Coordinator(object):
         After the barrier the mesh re-absorbs the host
         (:func:`distributed.mesh.absorb_hosts`) and join hooks fire.
 
+        ``enact=False`` is the FOLLOWER half of leader-based admission
+        (the serving fleet's router tier): the caller meets the
+        admission barrier but does NOT un-fence — it waits (bounded by
+        the timeout) for the admission LEADER's un-fence to land
+        first, so the barrier can never freeze without the joiner.
+        Returns None when the leader never enacted in time.
+
         Returns the agreed sync value, or None when the joiner died
         between announcing and the barrier (it is re-fenced by the
         barrier timeout and the admission is abandoned)."""
-        self.unfence(joined)
+        if enact:
+            self.unfence(joined)
+        else:
+            deadline = time.monotonic() + (
+                self.timeout_s if timeout_s is None
+                else float(timeout_s))
+            while joined in self.lost_hosts():
+                if time.monotonic() >= deadline:
+                    record_event("join_abort", host=joined, nonce=nonce,
+                                 reason="admission leader never "
+                                 "enacted")
+                    return None
+                time.sleep(poll_s)
         round_name = "%s:h%d:n%d" % (name, joined, nonce)
         got = self.all_gather(round_name, host_id, value,
                               timeout_s=timeout_s)
@@ -360,6 +415,29 @@ class LocalCoordinator(Coordinator):
             self._lost.pop(host_id, None)
             self._joins.pop(host_id, None)
             self._cond.notify_all()
+
+    def resize(self, n_hosts):
+        with self._cond:
+            open_rounds = [name for name, r in self._rounds.items()
+                           if r["result"] is None]
+            live = [] if int(n_hosts) >= self.n_hosts else \
+                [h for h in range(int(n_hosts), self.n_hosts)
+                 if h not in self._lost]
+            n = self._check_resize(n_hosts, self.n_hosts, open_rounds,
+                                   live)
+            if n == self.n_hosts:
+                return n
+            if n < self.n_hosts:
+                for h in range(n, self.n_hosts):
+                    self._lost.pop(h, None)
+                    self._joins.pop(h, None)
+            else:
+                for h in range(self.n_hosts, n):
+                    self._lost[h] = GROW_FENCE_REASON
+            self.n_hosts = n
+            self._cond.notify_all()
+        record_event("group_resize", n_hosts=n)
+        return n
 
     def all_gather(self, name, host_id, value=None, timeout_s=None):
         deadline = time.monotonic() + (self.timeout_s if timeout_s is None
@@ -528,6 +606,7 @@ class FileCoordinator(Coordinator):
         return out
 
     def live_hosts(self):
+        self._refresh_size()
         lost = self.lost_hosts()
         return [i for i in range(self.n_hosts) if i not in lost]
 
@@ -572,6 +651,57 @@ class FileCoordinator(Coordinator):
                 pass
         # a future re-loss of this host must re-fire _on_loss here
         self._known_lost.discard(host_id)
+
+    def _refresh_size(self):
+        """Adopt a peer's resize: the size record is the one piece of
+        FileCoordinator state every process re-reads (poll-time), since
+        n_hosts otherwise lives only in each object."""
+        import json
+        import os
+        try:
+            with open(os.path.join(self._root, "size.json")) as fh:
+                n = int(json.load(fh)["n_hosts"])
+        except (OSError, ValueError, KeyError):
+            return
+        if n != self.n_hosts:
+            self.n_hosts = n
+            record_event("group_resize", n_hosts=n, adopted=True)
+
+    def resize(self, n_hosts):
+        import json
+        import os
+        from ..io import _atomic_write
+        self._refresh_size()
+        open_rounds = [
+            d for d in os.listdir(self._rounds_dir)
+            if os.path.isdir(os.path.join(self._rounds_dir, d))
+            and not os.path.exists(os.path.join(self._rounds_dir, d,
+                                                "_done.json"))]
+        lost = self.lost_hosts()
+        live = [] if int(n_hosts) >= self.n_hosts else \
+            [h for h in range(int(n_hosts), self.n_hosts)
+             if h not in lost]
+        n = self._check_resize(n_hosts, self.n_hosts, open_rounds, live)
+        if n == self.n_hosts:
+            return n
+        if n < self.n_hosts:
+            for h in range(n, self.n_hosts):
+                self.unfence(h)
+                try:
+                    os.unlink(os.path.join(self._hb_dir,
+                                           "hb_%d.json" % h))
+                except OSError:
+                    pass
+        else:
+            for h in range(self.n_hosts, n):
+                _atomic_write(os.path.join(self._lost_dir,
+                                           "host_%d" % h),
+                              GROW_FENCE_REASON)
+        _atomic_write(os.path.join(self._root, "size.json"),
+                      json.dumps({"n_hosts": n}))
+        self.n_hosts = n
+        record_event("group_resize", n_hosts=n)
+        return n
 
     def _touch_hb(self, host_id):
         """Refresh this host's liveness lease (no-op unless armed)."""
@@ -627,6 +757,7 @@ class FileCoordinator(Coordinator):
         import json
         import os
         from ..io import _atomic_write
+        self._refresh_size()
         deadline = time.monotonic() + (self.timeout_s if timeout_s is None
                                        else float(timeout_s))
         rd = os.path.join(self._rounds_dir, self._safe(name))
@@ -753,9 +884,14 @@ class FileCoordinator(Coordinator):
         # fire for every loss THIS process has not yet reacted to —
         # including tombstones another process won the race to write:
         # mesh re-init is per-process state, so a survivor that merely
-        # OBSERVES a loss must still rebuild its collectives
-        newly_observed = sorted(set(lost) - self._known_lost)
-        self._known_lost.update(lost)
+        # OBSERVES a loss must still rebuild its collectives. Grown
+        # slots are born fenced but were never members: no hooks, and
+        # they stay OUT of _known_lost so a real loss after they join
+        # still fires (LocalCoordinator.resize parity).
+        growing = {h for h, r in lost.items()
+                   if str(r).startswith(GROW_FENCE_REASON)}
+        newly_observed = sorted(set(lost) - growing - self._known_lost)
+        self._known_lost.update(h for h in lost if h not in growing)
         self._on_loss(newly_observed)
         return result
 
@@ -857,9 +993,17 @@ class SocketCoordinator(Coordinator):
                 if version < self._lost_seen_v:
                     return
                 self._lost_seen_v = version
-            newly = sorted(set(lost) - self._known_lost
+            # a GROWN slot's birth fence is not a loss: the host was
+            # never a member, so no hooks fire and it stays out of
+            # _known_lost (else its first REAL loss after joining
+            # would be suppressed) — LocalCoordinator.resize parity
+            growing = {h for h, r in lost.items()
+                       if str(r).startswith(GROW_FENCE_REASON)} \
+                if isinstance(lost, dict) else set()
+            newly = sorted(set(lost) - growing - self._known_lost
                            - {self.host_id})
-            self._known_lost.update(lost)
+            self._known_lost.update(h for h in lost
+                                    if h not in growing)
         if newly:
             self._on_loss(newly)
 
@@ -912,7 +1056,16 @@ class SocketCoordinator(Coordinator):
         judge a lease live-looking by the same bound the server's
         monitor fences by."""
         resp = self._call("members")
+        n = resp.get("n_hosts")
+        if n is not None and int(n) != self.n_hosts:
+            # a peer resized the group (dynamic resize): adopt — the
+            # server is the size's single source of truth, and a stale
+            # client-side n_hosts would mis-enumerate live_hosts()
+            self.n_hosts = int(n)
+            record_event("group_resize", n_hosts=self.n_hosts,
+                         adopted=True)
         return {"n_hosts": resp.get("n_hosts"),
+                "resize_v": resp.get("resize_v"),
                 "hb_deadline_s": resp.get("hb_deadline_s"),
                 "hb_age": {int(h): float(v)
                            for h, v in resp.get("hb_age", {}).items()},
@@ -926,6 +1079,22 @@ class SocketCoordinator(Coordinator):
         with self._known_lock:
             # a future re-loss of this host must re-fire _on_loss here
             self._known_lost.discard(int(host_id))
+
+    def resize(self, n_hosts):
+        """Server-side dynamic resize (primary-replicated, snapshot-
+        covered); adopts the new size locally. Raises
+        CoordinationError mid-round or for a live id in a shrink range
+        (the server's named refusals)."""
+        if int(n_hosts) < 1:
+            # local pre-check so the caller-facing contract matches
+            # Local/File: ValueError for a bad ARGUMENT, reserving
+            # CoordinationError for the protocol's named refusals
+            raise ValueError("resize: n_hosts must be >= 1, got %d"
+                             % int(n_hosts))
+        resp = self._call("resize", n_hosts=int(n_hosts))
+        self.n_hosts = int(resp.get("n_hosts", n_hosts))
+        record_event("group_resize", n_hosts=self.n_hosts)
+        return self.n_hosts
 
     def all_gather(self, name, host_id, value=None, timeout_s=None):
         deadline = time.monotonic() + (self.timeout_s if timeout_s is None

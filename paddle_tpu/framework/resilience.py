@@ -232,87 +232,143 @@ def clear_bytes():
 # exists (router-less jobs export nothing new). Rare router events
 # (a replica dispatch failing over, a rolling-deploy step) still ride
 # the ordinary event log.
+#
+# Every series carries an optional ``router=`` label: N concurrent
+# FleetRouters (the HA router tier) share this process-global state,
+# and an unlabeled gauge would be overwritten by whichever router
+# wrote last — per-router label keys keep the series apart. ``router=
+# None`` keeps the historical unlabeled series (single-router callers
+# and direct test use are unchanged).
 _ROUTER_LOCK = threading.Lock()
 ROUTER_BATCH_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128)
 
 
 def _fresh_router_state():
-    return {"requests": {},                   # outcome -> count
-            "batch_counts": [0] * (len(ROUTER_BATCH_BUCKETS) + 1),
-            "batch_sum": 0.0, "batch_count": 0,
-            "queue_depth": None,              # gauge (None = never set)
-            "inflight": {},                   # replica -> gauge
-            "retries": {}}                    # replica -> count
+    return {"requests": {},      # (router, outcome) -> count
+            "batch": {},         # router -> {"counts", "sum", "count"}
+            "queue_depth": {},   # router -> gauge
+            "inflight": {},      # (router, replica) -> gauge
+            "retries": {}}       # (router, replica) -> count
 
 
 _ROUTER = _fresh_router_state()
 
 
-def record_router_request(outcome):
+def _router_key(router):
+    return None if router is None else str(router)
+
+
+def record_router_request(outcome, router=None):
     """Count one routed request's terminal outcome ("ok", "shed",
-    "deadline", "error", ...). Exported as
-    ``<prefix>_router_requests_total{outcome=}``."""
+    "deadline", "error", "replay", ...). Exported as
+    ``<prefix>_router_requests_total{outcome=[,router=]}``."""
     with _ROUTER_LOCK:
+        key = (_router_key(router), str(outcome))
         r = _ROUTER["requests"]
-        r[str(outcome)] = r.get(str(outcome), 0) + 1
+        r[key] = r.get(key, 0) + 1
 
 
-def record_router_retry(replica):
+def record_router_retry(replica, router=None):
     """Count one failed dispatch attempt that was retried on a
     sibling. A cumulative counter, NOT an event: under a shed storm
     retries run at request rate and would evict the bounded event log
     (the router still records an event for the RARE connection-level
     failures — a replica death — just not for load-driven 5xx)."""
     with _ROUTER_LOCK:
+        key = (_router_key(router), int(replica))
         r = _ROUTER["retries"]
-        r[int(replica)] = r.get(int(replica), 0) + 1
+        r[key] = r.get(key, 0) + 1
 
 
-def observe_router_batch(size):
+def observe_router_batch(size, router=None):
     """Record one dispatched micro-batch's coalesced request count in
-    the ``<prefix>_router_batch_size`` histogram."""
+    the ``<prefix>_router_batch_size`` histogram (per-router series)."""
     size = float(size)
     with _ROUTER_LOCK:
+        b = _ROUTER["batch"].setdefault(
+            _router_key(router),
+            {"counts": [0] * (len(ROUTER_BATCH_BUCKETS) + 1),
+             "sum": 0.0, "count": 0})
         for i, le in enumerate(ROUTER_BATCH_BUCKETS):
             if size <= le:
-                _ROUTER["batch_counts"][i] += 1
+                b["counts"][i] += 1
                 break
         else:
-            _ROUTER["batch_counts"][-1] += 1
-        _ROUTER["batch_sum"] += size
-        _ROUTER["batch_count"] += 1
+            b["counts"][-1] += 1
+        b["sum"] += size
+        b["count"] += 1
 
 
-def set_router_queue_depth(depth):
+def set_router_queue_depth(depth, router=None):
     """Update the ``<prefix>_router_queue_depth`` gauge (requests
-    waiting to be coalesced into a batch)."""
+    waiting to be coalesced into a batch) for ``router``'s series."""
     with _ROUTER_LOCK:
-        _ROUTER["queue_depth"] = float(depth)
+        _ROUTER["queue_depth"][_router_key(router)] = float(depth)
 
 
-def set_router_inflight(replica, n):
+def set_router_inflight(replica, n, router=None):
     """Update the per-replica ``<prefix>_router_replica_inflight``
     gauge (batches the router currently has dispatched to it)."""
     with _ROUTER_LOCK:
-        _ROUTER["inflight"][int(replica)] = float(n)
+        _ROUTER["inflight"][(_router_key(router), int(replica))] = \
+            float(n)
 
 
-def router_totals():
-    """One consistent snapshot of the router accounting (also what
-    :func:`metrics` exports from): ``{"requests": {outcome: n},
-    "batch_counts" (per-bucket, non-cumulative), "batch_count",
-    "batch_sum", "queue_depth", "inflight": {replica: n}}``. Taken
-    under ONE lock acquisition so the histogram's bucket counts can
-    never run ahead of its total (a non-monotonic histogram is
-    invalid to Prometheus consumers)."""
+def router_totals(by_router=False):
+    """One consistent snapshot of the router accounting. The default
+    AGGREGATES across router labels (the historical single-router
+    shape): ``{"requests": {outcome: n}, "batch_counts" (per-bucket,
+    non-cumulative), "batch_count", "batch_sum", "queue_depth",
+    "inflight": {replica: n}, "retries": {replica: n}}``.
+    ``by_router=True`` returns the same shape PER ROUTER KEY (None =
+    the unlabeled series) — what :func:`metrics` exports from, and
+    what the Autoscaler reads its own shed rate out of. Taken under
+    ONE lock acquisition so the histogram's bucket counts can never
+    run ahead of its total (a non-monotonic histogram is invalid to
+    Prometheus consumers)."""
     with _ROUTER_LOCK:
-        return {"requests": dict(_ROUTER["requests"]),
-                "batch_counts": list(_ROUTER["batch_counts"]),
-                "batch_count": _ROUTER["batch_count"],
-                "batch_sum": _ROUTER["batch_sum"],
-                "queue_depth": _ROUTER["queue_depth"],
-                "inflight": dict(_ROUTER["inflight"]),
-                "retries": dict(_ROUTER["retries"])}
+        requests = dict(_ROUTER["requests"])
+        batch = {r: {"counts": list(b["counts"]), "sum": b["sum"],
+                     "count": b["count"]}
+                 for r, b in _ROUTER["batch"].items()}
+        queue_depth = dict(_ROUTER["queue_depth"])
+        inflight = dict(_ROUTER["inflight"])
+        retries = dict(_ROUTER["retries"])
+    routers = (set(r for r, _ in requests) | set(batch)
+               | set(queue_depth) | set(r for r, _ in inflight)
+               | set(r for r, _ in retries))
+    out = {}
+    for rkey in (sorted(routers, key=lambda r: (r is not None, str(r)))
+                 if by_router else [None]):
+        def _mine(k):
+            return by_router is False or k == rkey
+        b_counts = [0] * (len(ROUTER_BATCH_BUCKETS) + 1)
+        b_sum, b_count = 0.0, 0
+        for r, b in batch.items():
+            if _mine(r):
+                b_counts = [a + c for a, c in zip(b_counts, b["counts"])]
+                b_sum += b["sum"]
+                b_count += b["count"]
+        depths = [v for r, v in queue_depth.items() if _mine(r)]
+        ent = {
+            "requests": _sum_by(requests, _mine),
+            "batch_counts": b_counts, "batch_count": b_count,
+            "batch_sum": b_sum,
+            "queue_depth": sum(depths) if depths else None,
+            "inflight": _sum_by(inflight, _mine),
+            "retries": _sum_by(retries, _mine)}
+        if not by_router:
+            return ent
+        out[rkey] = ent
+    return out
+
+
+def _sum_by(pairs, mine):
+    out = {}
+    for (r, k), n in pairs.items():
+        if mine(r):
+            out[k] = out.get(k, 0) + n
+    return out
 
 
 def clear_router():
@@ -501,18 +557,37 @@ def metrics(event_list=None, by_host=False):
     # byte pairs — NOT events; see record_router_request): emitted only
     # once the router did anything, so router-less jobs export nothing
     # new. Counter: requests by terminal outcome. Gauges: queue depth +
-    # per-replica in-flight. Histogram: coalesced batch size.
-    rt = router_totals()
-    counters += [
-        {"name": METRIC_PREFIX + "_router_requests_total",
-         "labels": {"outcome": outcome}, "value": n}
-        for outcome, n in sorted(rt["requests"].items())]
-    counters += [
-        {"name": METRIC_PREFIX + "_router_retries_total",
-         "labels": {"replica": str(r)}, "value": n}
-        for r, n in sorted(rt["retries"].items())]
+    # per-replica in-flight. Histogram: coalesced batch size. Every
+    # series is per-ROUTER (router= label) so N concurrent routers in
+    # one process never overwrite each other; the unlabeled series is
+    # the single-router/legacy shape.
+    by_router = router_totals(by_router=True)
+
+    def _rlbl(rkey, **extra):
+        lbl = dict(extra)
+        if rkey is not None:
+            lbl["router"] = rkey
+        return lbl
+
+    router_hists = []
+    for rkey, rt in by_router.items():
+        counters += [
+            {"name": METRIC_PREFIX + "_router_requests_total",
+             "labels": _rlbl(rkey, outcome=outcome), "value": n}
+            for outcome, n in sorted(rt["requests"].items())]
+        counters += [
+            {"name": METRIC_PREFIX + "_router_retries_total",
+             "labels": _rlbl(rkey, replica=str(r)), "value": n}
+            for r, n in sorted(rt["retries"].items())]
+        if rt["batch_count"]:
+            router_hists.append(_counts_histogram(
+                METRIC_PREFIX + "_router_batch_size",
+                ROUTER_BATCH_BUCKETS, rt["batch_counts"],
+                rt["batch_count"], rt["batch_sum"],
+                labels=_rlbl(rkey)))
     last_epoch, last_lag, last_hb = {}, {}, {}
     last_term, last_repl_lag = {}, {}
+    last_lterm, last_target = {}, {}
     for e in evs:
         if e["kind"] == "feed_epoch":
             last_epoch[e.get("host")] = e.get("epoch", 0)
@@ -527,33 +602,45 @@ def metrics(event_list=None, by_host=False):
             last_term[e.get("host")] = e.get("term", 0)
         elif e["kind"] == "transport_repl_lag":
             last_repl_lag[e.get("host")] = e.get("lag", 0)
+        elif e["kind"] == "fleet_leader_term":
+            # per-router admission-leader term views (the router-tier
+            # twin of transport_term): a router pinned below its peers
+            # is still trusting a stale ex-leader
+            last_lterm[e.get("router")] = e.get("term", 0)
+        elif e["kind"] == "fleet_autoscale":
+            # last autoscale decision's target replica count
+            last_target[None] = e.get("target", 0)
     gauges = []
-    for name, series in ((METRIC_PREFIX + "_feed_epoch", last_epoch),
-                         (METRIC_PREFIX + "_feed_stream_lag", last_lag),
-                         (METRIC_PREFIX + "_transport_heartbeat_lag",
-                          last_hb),
-                         (METRIC_PREFIX + "_transport_term", last_term),
-                         (METRIC_PREFIX + "_transport_replication_lag",
-                          last_repl_lag)):
+    for name, series, label in (
+            (METRIC_PREFIX + "_feed_epoch", last_epoch, "host"),
+            (METRIC_PREFIX + "_feed_stream_lag", last_lag, "host"),
+            (METRIC_PREFIX + "_transport_heartbeat_lag", last_hb,
+             "host"),
+            (METRIC_PREFIX + "_transport_term", last_term, "host"),
+            (METRIC_PREFIX + "_transport_replication_lag",
+             last_repl_lag, "host"),
+            (METRIC_PREFIX + "_fleet_leader_term", last_lterm,
+             "router"),
+            (METRIC_PREFIX + "_fleet_target_replicas", last_target,
+             "router")):
         gauges += [{"name": name,
-                    "labels": {} if h is None else {"host": str(h)},
+                    "labels": {} if h is None else {label: str(h)},
                     "value": v}
                    for h, v in sorted(series.items(),
                                       key=lambda kv: str(kv[0]))]
-    if rt["queue_depth"] is not None:
-        gauges.append({"name": METRIC_PREFIX + "_router_queue_depth",
-                       "labels": {}, "value": rt["queue_depth"]})
-    gauges += [{"name": METRIC_PREFIX + "_router_replica_inflight",
-                "labels": {"replica": str(r)}, "value": v}
-               for r, v in sorted(rt["inflight"].items())]
+    for rkey, rt in by_router.items():
+        if rt["queue_depth"] is not None:
+            gauges.append(
+                {"name": METRIC_PREFIX + "_router_queue_depth",
+                 "labels": _rlbl(rkey), "value": rt["queue_depth"]})
+        gauges += [{"name": METRIC_PREFIX + "_router_replica_inflight",
+                    "labels": _rlbl(rkey, replica=str(r)), "value": v}
+                   for r, v in sorted(rt["inflight"].items())]
     restore_lat = [e["latency_s"] for e in evs
                    if e["kind"] == "restore" and "latency_s" in e]
     histograms = [_histogram(METRIC_PREFIX + "_restore_latency_seconds",
                              restore_lat, RESTORE_LATENCY_BUCKETS)]
-    if rt["batch_count"]:
-        histograms.append(_counts_histogram(
-            METRIC_PREFIX + "_router_batch_size", ROUTER_BATCH_BUCKETS,
-            rt["batch_counts"], rt["batch_count"], rt["batch_sum"]))
+    histograms += router_hists
     return {"counters": counters, "gauges": gauges,
             "histograms": histograms}
 

@@ -88,6 +88,7 @@ import socketserver
 import threading
 import time
 
+from .coordination import GROW_FENCE_REASON
 from .resilience import RetryPolicy, record_event
 
 __all__ = ["TransportError", "CoordServer", "CoordClient",
@@ -99,7 +100,7 @@ _DEFAULT_HB_INTERVAL_S = 0.5
 # promoted standby must never rewind. hb/ack are ASYNC — leases are
 # refreshed at promotion anyway, and a lost ack only delays cleanup.
 _SYNC_CMDS = frozenset(("hello", "mark_lost", "announce_join",
-                        "unfence", "put", "put_info"))
+                        "unfence", "put", "put_info", "resize"))
 _MUTATING_CMDS = _SYNC_CMDS | frozenset(("hb", "ack"))
 _REPL_CMDS = frozenset(("repl_sync", "repl_apply", "repl_snapshot",
                         "repl_hb"))
@@ -174,6 +175,10 @@ class _PodState(object):
         # response processed late can never resurrect a cleared
         # tombstone (or re-fire loss hooks for a readmitted host)
         self.lost_version = 0
+        # bumped on every accepted ``resize``: the hello mismatch error
+        # names a resized group explicitly (a stale-size client must
+        # relaunch with the current size, never land phantom state)
+        self.resize_version = 0
         self.joins = {}
         self.rounds = {}
         self.hb = {}
@@ -244,6 +249,7 @@ class _PodState(object):
             "seq": self.applied_seq,
             "lost": {str(h): r for h, r in self.lost.items()},
             "lost_version": self.lost_version,
+            "resize_version": self.resize_version,
             "joins": {str(h): n for h, n in self.joins.items()},
             "rounds": {
                 name: {"values": {str(h): v
@@ -269,6 +275,9 @@ class _PodState(object):
         self.applied_seq = int(snap.get("seq", 0))
         self.lost = {int(h): r for h, r in snap.get("lost", {}).items()}
         self.lost_version = int(snap.get("lost_version", 0))
+        # absent in PR 9-era snapshots: groups that never resize stay
+        # wire-compatible (default 0 == never resized)
+        self.resize_version = int(snap.get("resize_version", 0))
         self.joins = {int(h): int(n)
                       for h, n in snap.get("joins", {}).items()}
         self.rounds = {
@@ -1112,9 +1121,13 @@ def _dispatch(state, cmd, hid, req, now):
                         "%d-host pod" % (hid, want)}
             state.n_hosts = want
         if int(req.get("n_hosts", state.n_hosts)) != state.n_hosts:
+            resized = (" — the group was RESIZED (v%d): relaunch this "
+                       "member with the current size"
+                       % state.resize_version) \
+                if state.resize_version else ""
             return {"error": "pod size mismatch: server has %d "
-                    "hosts, client expects %s"
-                    % (state.n_hosts, req.get("n_hosts"))}
+                    "hosts, client expects %s%s"
+                    % (state.n_hosts, req.get("n_hosts"), resized)}
         if hid is not None and req.get("lease"):
             # only heartbeating clients take a liveness lease: a
             # passive observer (heartbeat=False) that registered
@@ -1223,10 +1236,61 @@ def _dispatch(state, cmd, hid, req, now):
         # The server's deadline ships too, so clients can judge a
         # lease "live-looking" by the SAME bound the monitor fences by
         return {"n_hosts": state.n_hosts,
+                "resize_v": state.resize_version,
                 "hb_deadline_s": state.hb_deadline_s,
                 "hb_age": {str(h): round(now - t, 6)
                            for h, t in state.hb.items()},
                 "info": {str(h): v for h, v in state.info.items()},
+                "lost": dict(state.lost)}
+    if cmd == "resize":
+        # DYNAMIC GROUP RESIZE: grow/shrink n_hosts at a round
+        # boundary. Grown slots are born FENCED ("resized: awaiting
+        # join") so in-flight gathers never wait for a member that has
+        # not joined — the new member's start finds itself fenced and
+        # takes the ordinary announce/admit/join path. A shrink only
+        # removes TOP ids whose members are already fenced or hold no
+        # live-looking lease (drain first). Primary-replicated
+        # (_SYNC_CMDS) and snapshot-covered, so the resized size
+        # survives failover and restart.
+        try:
+            want = int(req["n_hosts"])
+        except (KeyError, TypeError, ValueError):
+            return {"error": "resize needs an integer n_hosts"}
+        if want < 1:
+            return {"error": "resize: n_hosts must be >= 1, got %d"
+                    % want}
+        open_rounds = sorted(n for n, r in state.rounds.items()
+                             if r["done"] is None)
+        if open_rounds:
+            return {"error": "resize refused mid-round: %d gather "
+                    "round(s) in flight (%s) — retry at a round "
+                    "boundary" % (len(open_rounds), open_rounds[:3])}
+        if want == state.n_hosts:
+            return {"ok": True, "n_hosts": want,
+                    "resize_v": state.resize_version,
+                    "lost": dict(state.lost)}
+        if want < state.n_hosts:
+            dl = state.hb_deadline_s
+            live = [h for h in range(want, state.n_hosts)
+                    if h not in state.lost and h in state.hb
+                    and (dl is None or now - state.hb[h] <= dl)]
+            if live:
+                return {"error": "resize refused: host(s) %s hold a "
+                        "live lease — drain/fence them before "
+                        "shrinking past their ids" % live}
+            for h in range(want, state.n_hosts):
+                state.lost.pop(h, None)
+                state.joins.pop(h, None)
+                state.hb.pop(h, None)
+                state.info.pop(h, None)
+            state.lost_version += 1
+        else:
+            for h in range(state.n_hosts, want):
+                state._mark_lost(h, GROW_FENCE_REASON)
+        state.n_hosts = want
+        state.resize_version += 1
+        return {"ok": True, "n_hosts": want,
+                "resize_v": state.resize_version,
                 "lost": dict(state.lost)}
     return {"error": "unknown cmd %r" % cmd}
 

@@ -10,17 +10,40 @@ replicas run as heartbeat-leased members of the PR 5
 router (the ``resilience.serve_metrics`` style — no dependencies)
 fronts them with continuous micro-batching.
 
-Topology (one coordination group of ``n_replicas + 1`` hosts):
+Topology (one coordination group of ``n_replicas + n_routers`` hosts,
+growable by dynamic resize):
 
-  host 0..N-1   :class:`ReplicaMember` — loads the StableHLO serving
-                artifact, serves ``POST /infer`` over HTTP, heartbeats
-                the CoordServer (its liveness lease), and runs the
-                lockstep *control rounds* that agree admissions.
-  host N        :class:`FleetRouter` — the front door. It is a full
-                group member too (it heartbeats, joins control rounds
-                and admits), which is what makes a single-replica
-                fleet's restart admissible: the router is always a
-                survivor that can vote the joiner back in.
+  host 0..N-1     :class:`ReplicaMember` — loads the StableHLO serving
+                  artifact, serves ``POST /infer`` over HTTP,
+                  heartbeats the CoordServer (its liveness lease), and
+                  runs the lockstep *control rounds* that agree
+                  admissions.
+  host N..N+R-1   :class:`FleetRouter` x R — the replicated front
+                  door. Each router serves ``/infer`` independently
+                  (clients rotate across them — :class:`FleetClient`);
+                  every router is a full group member (it heartbeats,
+                  joins control rounds), which is what makes a
+                  single-replica fleet's restart admissible: a router
+                  is always a survivor that can vote the joiner in.
+  host >= N+R     replicas GROWN at runtime: the Autoscaler resizes
+                  the group (``CoordServer`` ``resize`` op — new slots
+                  are born fenced) and the spawned replica joins
+                  through the ordinary announce/admit/join path.
+
+Router HA (the PR 11 tier): admission stays frozen-verdict-based, but
+it is ENACTED (the joiner un-fenced) only by the **admission leader**
+— the lowest live router id, judged from the heartbeat leases. Leader
+changes are term-stamped in the member registry (each router's info
+blob carries ``lterm``): a takeover bumps the term past every
+observed claim, incumbency is sticky (a restarted ex-leader rejoins
+as a FOLLOWER), and a stale ex-leader's enactment is refused by the
+term check it runs against the registry at enact time — the PR 9
+transport term-fencing discipline, re-hosted one layer up. Replicas
+enact only when NO router holds a live-looking lease (the router-less
+degraded fleet). Routers also share their per-replica in-flight
+counts through their info blobs, so N routers' least-loaded dispatch
+judges the REAL per-replica load, not each router's own slice — and a
+failed-over request does not double-count.
 
 Data plane (router):
 
@@ -103,8 +126,8 @@ from .framework.coordination import (CoordinationError, HostLostError,
 from .framework.resilience import (DeadlineExceededError,
                                    ServerOverloadedError, record_event)
 
-__all__ = ["FleetError", "FleetRouter", "ReplicaMember",
-           "router_host_id", "http_json"]
+__all__ = ["FleetError", "FleetRouter", "ReplicaMember", "FleetClient",
+           "Autoscaler", "router_host_id", "http_json"]
 
 
 class FleetError(RuntimeError):
@@ -112,10 +135,11 @@ class FleetError(RuntimeError):
     start, a member that could not be admitted)."""
 
 
-def router_host_id(n_replicas):
-    """The router's host id in the coordination group: replicas are
-    hosts ``0..N-1``, the router is host ``N`` (group size N+1)."""
-    return int(n_replicas)
+def router_host_id(n_replicas, router_id=0):
+    """Router ``router_id``'s host id in the coordination group: base
+    replicas are hosts ``0..N-1``, routers ``N..N+R-1`` (grown
+    replicas, if any, sit above the router range)."""
+    return int(n_replicas) + int(router_id)
 
 
 # ---------------------------------------------------------------------------
@@ -153,8 +177,17 @@ def http_json(method, url, payload=None, timeout_s=10.0):
 
 def _start_http(handler_cls, host, port, name):
     import http.server
-    srv = http.server.ThreadingHTTPServer((host, port), handler_cls)
-    srv.daemon_threads = True
+
+    class _Server(http.server.ThreadingHTTPServer):
+        # the stdlib default listen backlog of 5 collapses a
+        # connection-per-request burst: overflowed SYNs retransmit
+        # after a full second, so a 24-client surge reaches the
+        # router ~2 requests at a time and its queue/shed load
+        # signals never see the pressure that is actually there
+        request_queue_size = 128
+        daemon_threads = True
+
+    srv = _Server((host, port), handler_cls)
     t = threading.Thread(target=srv.serve_forever, daemon=True,
                          name=name)
     t.start()
@@ -226,11 +259,25 @@ class _FleetMember(object):
 
     def __init__(self, coord_address, n_replicas, host_id,
                  ctl_interval_s=0.1, hb_interval_s=0.25,
-                 timeout_s=30.0, join_timeout_s=30.0, poll_s=0.005):
+                 timeout_s=30.0, join_timeout_s=30.0, poll_s=0.005,
+                 n_routers=1, group_size=None):
         if int(n_replicas) < 1:
             raise ValueError("a fleet needs n_replicas >= 1")
+        if int(n_routers) < 1:
+            raise ValueError("a fleet needs n_routers >= 1")
         self._coord_address = coord_address
         self.n_replicas = int(n_replicas)
+        self.n_routers = int(n_routers)
+        # group_size covers GROWN fleets: base replicas 0..N-1, routers
+        # N..N+R-1, dynamically grown replicas above — a grown member
+        # must hello with the group's CURRENT (post-resize) size
+        self.group_size = int(group_size) if group_size is not None \
+            else self.n_replicas + self.n_routers
+        if self.group_size < self.n_replicas + self.n_routers:
+            raise ValueError(
+                "group_size %d is smaller than the base layout "
+                "(%d replicas + %d routers)"
+                % (self.group_size, self.n_replicas, self.n_routers))
         self._host_id = int(host_id)
         self._ctl_interval_s = float(ctl_interval_s)
         self._hb_interval_s = float(hb_interval_s)
@@ -271,13 +318,20 @@ class _FleetMember(object):
         both sides' gathers. If the server holds a live-looking lease
         for this host id, fence it (supersede the dead incarnation)
         so this start takes the ordinary rejoin path and ADOPTS the
-        survivors' counter from the admission sync."""
+        survivors' counter from the admission sync.
+
+        Returns the server's CURRENT group size (or ``None`` before
+        the first sized hello / when unreachable): a restart after an
+        autoscale resize must hello with the group's live size, not
+        the base layout its command line froze at boot."""
         from .framework.transport import CoordClient
+        server_size = None
         try:
             client = CoordClient(self._coord_address,
                                  host_id=self._host_id)
             try:
                 resp = client.call("members")
+                server_size = resp.get("n_hosts")
                 has_lease = str(self._host_id) in resp.get("hb_age", {})
                 fenced = str(self._host_id) in resp.get("lost", {})
                 if has_lease and not fenced:
@@ -292,11 +346,25 @@ class _FleetMember(object):
             # auto-size server before its first hello, or coordinator
             # unreachable: nothing to supersede — first-boot path
             pass
+        return server_size
 
     def start(self):
         self._prepare()
         try:
-            self._preflight_supersede()
+            server_size = self._preflight_supersede()
+            if server_size is not None \
+                    and int(server_size) != self.group_size \
+                    and int(server_size) \
+                    >= self.n_replicas + self.n_routers:
+                # the server's size is authoritative — a base member
+                # restarted after an autoscale grow/shrink would
+                # otherwise hello with its frozen boot-time size and
+                # be refused with the RESIZED mismatch error forever
+                record_event("fleet_adopt_group_size",
+                             member=self._host_id,
+                             configured=self.group_size,
+                             adopted=int(server_size))
+                self.group_size = int(server_size)
             # detect_loss=False: fleet liveness is EXCLUSIVELY the
             # heartbeat lease (the server monitor). Client-driven
             # fencing at gather deadlines is a training-plane fallback
@@ -304,7 +372,7 @@ class _FleetMember(object):
             # every healthy peer — a timeout here surfaces as
             # BarrierTimeoutError and the tick simply retries.
             self._co = SocketCoordinator(
-                self._coord_address, self.n_replicas + 1,
+                self._coord_address, self.group_size,
                 self._host_id, timeout_s=self._timeout_s,
                 poll_s=self._poll_s, mesh_reinit=False,
                 detect_loss=False, hb_interval_s=self._hb_interval_s)
@@ -405,16 +473,21 @@ class _FleetMember(object):
                 # recover solo — otherwise the next tick retries
                 self._solo_recover()
             return True
-        # admission from the frozen verdicts: every member admits the
-        # first pending pair EVERY participant observed — identical
-        # on all of them, so the join barrier always completes (the
-        # invariant is shared with ElasticTrainer's window admission)
+        # admission from the frozen verdicts: every member meets the
+        # SAME admission barrier for the first pending pair EVERY
+        # participant observed — identical on all of them, so the join
+        # barrier always completes (the invariant is shared with
+        # ElasticTrainer's window admission). The un-fence itself is
+        # ENACTED only by the admission leader (lowest live router id,
+        # term-stamped — see FleetRouter._admission_enactor); everyone
+        # else follows the barrier once the enactment lands.
         agreed = agreed_pending(verdicts)
         if agreed is not None:
             try:
                 sync = co.admit(self._host_id, agreed[0], agreed[1],
                                 self._sync_value(), name="fjoin",
-                                timeout_s=self._join_timeout_s)
+                                timeout_s=self._join_timeout_s,
+                                enact=self._admission_enactor())
                 if sync is not None:
                     record_event("fleet_admit", member=self._host_id,
                                  joined=agreed[0])
@@ -424,6 +497,26 @@ class _FleetMember(object):
                     self._solo_recover()
             except (CoordinationError, ConnectionError):
                 return True
+        return True
+
+    def _admission_enactor(self):
+        """Whether THIS member ENACTS (un-fences) the agreed admission.
+        Base policy (replicas): only when no router holds a
+        live-looking lease — the admission leader (lowest live router
+        id) enacts, and replicas are the fallback for a router-less
+        degraded fleet; FleetRouter overrides with the term-stamped
+        leader check."""
+        try:
+            m = self._co.members()
+        except (CoordinationError, ConnectionError):
+            return True      # cannot judge: enacting is the safe side
+        dl = m.get("hb_deadline_s")
+        for h, info in m["info"].items():
+            if isinstance(info, dict) and info.get("kind") == "router" \
+                    and h not in m["lost"]:
+                age = m["hb_age"].get(h)
+                if age is not None and (dl is None or age <= dl):
+                    return False
         return True
 
     def _rejoin(self):
@@ -480,14 +573,25 @@ class ReplicaMember(_FleetMember):
                  max_in_flight=None, deadline_s=None,
                  ship_compress="zlib", ctl_interval_s=0.1,
                  hb_interval_s=0.25, timeout_s=30.0,
-                 join_timeout_s=30.0):
-        if not 0 <= int(replica_id) < int(n_replicas):
-            raise ValueError("replica_id %r out of range for %d "
-                             "replicas" % (replica_id, n_replicas))
+                 join_timeout_s=30.0, n_routers=1, group_size=None):
+        rid = int(replica_id)
+        gs = int(group_size) if group_size is not None \
+            else int(n_replicas) + int(n_routers)
+        router_lo = int(n_replicas)
+        router_hi = int(n_replicas) + int(n_routers)
+        # valid replica slots: the base tier below the routers, plus
+        # dynamically GROWN slots above them (group resize)
+        if not (0 <= rid < router_lo or router_hi <= rid < gs):
+            raise ValueError(
+                "replica_id %r is not a replica slot (%d base "
+                "replicas, routers %d..%d, group size %d)"
+                % (replica_id, n_replicas, router_lo, router_hi - 1,
+                   gs))
         super(ReplicaMember, self).__init__(
-            coord_address, n_replicas, int(replica_id),
+            coord_address, n_replicas, rid,
             ctl_interval_s=ctl_interval_s, hb_interval_s=hb_interval_s,
-            timeout_s=timeout_s, join_timeout_s=join_timeout_s)
+            timeout_s=timeout_s, join_timeout_s=join_timeout_s,
+            n_routers=n_routers, group_size=group_size)
         if ship_compress not in (None, "zlib"):
             raise ValueError("ship_compress must be None or 'zlib', "
                              "got %r" % (ship_compress,))
@@ -504,6 +608,7 @@ class ReplicaMember(_FleetMember):
         self._generation = 0
         self._refresh_req = None
         self._refresh_lock = threading.Lock()
+        self._draining = False
         self._server = None
         self.address = None
 
@@ -544,6 +649,13 @@ class ReplicaMember(_FleetMember):
                     else:
                         self._send(409, {"error": "a refresh is "
                                          "already queued"})
+                elif path == "/admin/drain":
+                    if member.drain():
+                        self._send(200, {"ok": True, "draining": True})
+                    else:
+                        self._send(503, {"error": "drain could not "
+                                         "reach the coordinator — "
+                                         "retry"})
                 else:
                     self._send(404, {"error": "try /infer"})
 
@@ -714,8 +826,32 @@ class ReplicaMember(_FleetMember):
             self._refresh_req = str(artifact_dir)
         return True
 
+    def drain(self):
+        """PLANNED scale-in (the Autoscaler's shrink path): fence
+        self, stop rejoining, and unpublish — in-flight requests still
+        complete (the HTTP server keeps answering), but the routing
+        tables drop this replica on their next poll and the drained
+        slot can then be resized away. Returns False when the
+        coordinator was unreachable (the caller retries)."""
+        with self._refresh_lock:
+            self._draining = True
+        try:
+            self._co.mark_lost(self._host_id,
+                               "autoscale: drained for scale-in")
+        except (CoordinationError, ConnectionError):
+            with self._refresh_lock:
+                self._draining = False
+            return False
+        self._publish_info(ready=False)
+        record_event("fleet_drained", member=self._host_id)
+        return True
+
     def _ctl_tick(self):
         with self._refresh_lock:
+            if self._draining:
+                # a drained member neither gathers nor rejoins: it is
+                # leaving the group for good (the slot is resized away)
+                return True
             req, self._refresh_req = self._refresh_req, None
         if req is not None:
             self._do_refresh(req)
@@ -829,18 +965,30 @@ class FleetRouter(_FleetMember):
     same way (serving continues meanwhile: routing needs only the
     members snapshot, not membership)."""
 
+    # completed/in-flight request tokens kept for idempotent replay
+    # (a FleetClient that failed over back, or re-sent after a torn
+    # response) — bounded so a long-lived router cannot grow forever
+    TOKEN_CACHE = 4096
+
     def __init__(self, coord_address, n_replicas, port=0,
                  host="127.0.0.1", max_batch=8, batch_deadline_s=0.005,
                  max_queue=128, request_deadline_s=10.0,
                  poll_interval_s=0.05, ctl_interval_s=0.1,
                  hb_interval_s=0.25, timeout_s=30.0,
-                 join_timeout_s=30.0):
+                 join_timeout_s=30.0, router_id=0, n_routers=1,
+                 group_size=None):
+        if not 0 <= int(router_id) < int(n_routers):
+            raise ValueError("router_id %r out of range for %d "
+                             "routers" % (router_id, n_routers))
         super(FleetRouter, self).__init__(
-            coord_address, n_replicas, router_host_id(n_replicas),
+            coord_address, n_replicas,
+            router_host_id(n_replicas, router_id),
             ctl_interval_s=ctl_interval_s, hb_interval_s=hb_interval_s,
-            timeout_s=timeout_s, join_timeout_s=join_timeout_s)
+            timeout_s=timeout_s, join_timeout_s=join_timeout_s,
+            n_routers=n_routers, group_size=group_size)
         if int(max_batch) < 1:
             raise ValueError("max_batch must be >= 1")
+        self.router_id = int(router_id)
         self._http_host = host
         self._http_port = int(port)
         self.max_batch = int(max_batch)
@@ -854,10 +1002,21 @@ class FleetRouter(_FleetMember):
         self._members = {}
         self._members_sig = None
         self._inflight = {}
+        self._peer_inflight = {}
+        self._peer_router_load = {}
         self._pick_seq = 0
         self._meta = None
         self._meta_lock = threading.Lock()
         self._deploy_lock = threading.Lock()
+        # admission-leader state (term-stamped in the member registry)
+        self._leader_lock = threading.Lock()
+        self._is_leader = False
+        self._leader_term = 0
+        self._pub_sig = None
+        # idempotent request replay: token -> _Pending (completed
+        # entries keep their result until evicted)
+        self._tokens = collections.OrderedDict()
+        self._token_lock = threading.Lock()
         self._server = None
         self.address = None
         self.url = None
@@ -946,11 +1105,32 @@ class FleetRouter(_FleetMember):
         self._refresh_members()
 
     def _publish_info(self):
+        """Advertise this router's blob: address (clients/tools
+        discover the tier), its admission-leader claim (``lterm`` /
+        ``leader`` — the term stamp a stale ex-leader is refused by),
+        its per-replica in-flight counts (so sibling routers'
+        least-loaded dispatch sees the REAL load, not just their own
+        slice) and its queue/shed load signals (so the admission
+        leader's autoscaler sees overload concentrated on a FOLLOWER
+        — clients pin one endpoint, and in a multi-process tier the
+        leader cannot read a sibling's process-local counters)."""
+        with self._members_lock:
+            inflight = {str(h): int(n)
+                        for h, n in self._inflight.items() if n}
+        with self._leader_lock:
+            lterm, leader = self._leader_term, self._is_leader
+        queue, shed, total = self._load_signals()
         try:
             self._co.put_info({"kind": "router", "addr": self.address,
-                               "ready": False})
+                               "url": self.url,
+                               "router_id": self.router_id,
+                               "lterm": lterm, "leader": leader,
+                               "inflight": inflight, "ready": False,
+                               "queue": queue, "shed": shed,
+                               "reqs": total})
         except (CoordinationError, ConnectionError):
-            pass
+            return False
+        return True
 
     def close(self):
         self._stop.set()
@@ -978,12 +1158,34 @@ class FleetRouter(_FleetMember):
             m = self._co.members()
         except (CoordinationError, ConnectionError):
             return   # keep the last known table; the poll retries
-        table = {}
+        dl = m.get("hb_deadline_s")
+        table, routers = {}, {}
+        peer_inflight, peer_rload = {}, {}
         for h, info in m["info"].items():
-            if not isinstance(info, dict) \
-                    or info.get("kind") != "replica" \
-                    or not info.get("ready") or not info.get("addr") \
-                    or h in m["lost"]:
+            if not isinstance(info, dict) or h in m["lost"]:
+                continue
+            if info.get("kind") == "router":
+                age = m["hb_age"].get(h)
+                live = h == self._host_id or (
+                    age is not None and (dl is None or age <= dl))
+                if not live:
+                    continue
+                routers[h] = info
+                if h != self._host_id:
+                    for rid, n in (info.get("inflight") or {}).items():
+                        try:
+                            rid = int(rid)
+                        except (TypeError, ValueError):
+                            continue
+                        peer_inflight[rid] = \
+                            peer_inflight.get(rid, 0) + int(n)
+                    peer_rload[h] = {
+                        "queue": int(info.get("queue") or 0),
+                        "shed": int(info.get("shed") or 0),
+                        "reqs": int(info.get("reqs") or 0)}
+                continue
+            if info.get("kind") != "replica" \
+                    or not info.get("ready") or not info.get("addr"):
                 continue
             table[h] = {"addr": info["addr"],
                         "gen": info.get("gen"),
@@ -997,10 +1199,148 @@ class FleetRouter(_FleetMember):
                            for h, v in table.items()))
         with self._members_lock:
             self._members = table
+            self._peer_inflight = peer_inflight
+            self._peer_router_load = peer_rload
         if sig != self._members_sig:
             self._members_sig = sig
             with self._meta_lock:
                 self._meta = None
+        self._update_leadership(m, routers)
+        self._maybe_publish()
+
+    def _update_leadership(self, m, routers):
+        """Admission-leader election from the live member snapshot.
+        Incumbency is STICKY: the live-looking router advertising the
+        highest leader claim keeps the lease (a restarted ex-leader
+        rejoins as a follower); only when no live claim exists does
+        the lowest live router id take over, with a term bumped past
+        every observed claim — the PR 9 term discipline."""
+        with self._leader_lock:
+            my_term, was_leader = self._leader_term, self._is_leader
+        fenced = self._host_id in m["lost"]
+        claims = []       # (lterm, router_host_id) of live claimants
+        max_term = my_term
+        for h, info in routers.items():
+            term = int(info.get("lterm") or 0)
+            if h == self._host_id:
+                # the registry may lag our own state: use it live
+                term, is_leader = my_term, was_leader and not fenced
+            else:
+                is_leader = bool(info.get("leader"))
+            max_term = max(max_term, term)
+            if is_leader:
+                claims.append((term, h))
+        # highest term wins; a same-term double claim (two routers that
+        # raced the first election) breaks to the LOWEST router id
+        incumbent = max(claims, key=lambda c: (c[0], -c[1])) \
+            if claims else None
+        if incumbent is not None and incumbent[0] >= max_term:
+            leader_id = incumbent[1]
+            new_term = incumbent[0]
+        else:
+            leader_id = min(routers) if routers else None
+            new_term = max_term + 1     # takeover: fence every claim
+        changed = False
+        with self._leader_lock:
+            if fenced or leader_id != self._host_id:
+                if self._is_leader:
+                    record_event("fleet_leader_demote",
+                                 router=self._host_id, term=max_term)
+                    changed = True
+                self._is_leader = False
+                if max_term > self._leader_term:
+                    self._leader_term = max_term
+                    changed = True
+            elif not self._is_leader:
+                self._is_leader = True
+                self._leader_term = max(new_term, self._leader_term)
+                record_event("fleet_leader_elect",
+                             router=self._host_id,
+                             term=self._leader_term)
+                changed = True
+            term_now = self._leader_term
+        if changed:
+            record_event("fleet_leader_term", router=self._host_id,
+                         term=term_now)
+
+    def _maybe_publish(self):
+        """Republish the info blob only when it changed (leadership,
+        the in-flight map, or the queue/shed load signals) — put_info
+        is a sync-replicated op and must not run at poll rate for an
+        IDLE router (an idle router's queue is 0 and its counters are
+        static, so the signature holds still)."""
+        with self._members_lock:
+            inflight = tuple(sorted((h, int(n))
+                             for h, n in self._inflight.items() if n))
+        load = self._load_signals()
+        with self._leader_lock:
+            sig = (self._is_leader, self._leader_term, inflight, load)
+        # cache the signature only once the put LANDED: a publish
+        # swallowed during a coordinator failover must be retried on
+        # the next poll, or siblings read a stale leader claim and
+        # stale in-flight counts until the state next changes
+        if sig != self._pub_sig and self._publish_info():
+            self._pub_sig = sig
+
+    def is_leader(self):
+        """Whether this router currently holds the admission lease."""
+        with self._leader_lock:
+            return self._is_leader
+
+    @property
+    def leader_term(self):
+        with self._leader_lock:
+            return self._leader_term
+
+    def queue_depth(self):
+        with self._qcond:
+            return len(self._queue)
+
+    def _load_signals(self):
+        """``(queue_depth, shed_total, requests_total)`` for THIS
+        router — its process-local slice of the fleet-wide autoscale
+        signal (shared with siblings through the info blob)."""
+        totals = resilience.router_totals(by_router=True).get(
+            str(self._host_id), None)
+        reqs = (totals or {"requests": {}})["requests"]
+        return (self.queue_depth(), int(reqs.get("shed", 0)),
+                int(sum(reqs.values())))
+
+    def peer_router_load(self):
+        """{router_host_id: {"queue", "shed", "reqs"}} last read from
+        each live SIBLING router's info blob."""
+        with self._members_lock:
+            return {h: dict(v)
+                    for h, v in self._peer_router_load.items()}
+
+    def _admission_enactor(self):
+        """Only the admission leader enacts — and even the leader
+        re-checks the member registry AT ENACT TIME: a higher term
+        stamped by any router means we are the stale ex-leader the
+        term fence exists for, and the enactment is refused."""
+        with self._leader_lock:
+            if not self._is_leader:
+                return False
+            term = self._leader_term
+        try:
+            m = self._co.members()
+        except (CoordinationError, ConnectionError):
+            return False
+        if self._host_id in m["lost"]:
+            return False
+        for h, info in m["info"].items():
+            if h != self._host_id and isinstance(info, dict) \
+                    and info.get("kind") == "router" \
+                    and int(info.get("lterm") or 0) > term:
+                with self._leader_lock:
+                    self._is_leader = False
+                    self._leader_term = max(self._leader_term,
+                                            int(info["lterm"]))
+                record_event("fleet_leader_stale",
+                             router=self._host_id,
+                             term=int(info["lterm"]))
+                return False
+        return True
 
     def routable(self):
         """{replica_id: {"addr", "gen", "dir", "hb_age"}} of every
@@ -1013,22 +1353,32 @@ class FleetRouter(_FleetMember):
             depth = len(self._queue)
         with self._members_lock:
             inflight = dict(self._inflight)
+        with self._leader_lock:
+            leader, lterm = self._is_leader, self._leader_term
         return {"live": True, "replicas": self.routable(),
                 "queue_depth": depth, "inflight": inflight,
                 "n_replicas": self.n_replicas,
+                "router_id": self.router_id,
+                "n_routers": self.n_routers,
+                "group_size": self.group_size,
+                "leader": leader, "leader_term": lterm,
                 "max_batch": self.max_batch,
                 "batch_deadline_s": self.batch_deadline_s}
 
     def _pick_replica(self, tried):
         """Least-loaded live replica not yet tried for this batch:
-        fewest router-dispatched batches in flight; equally-loaded
-        replicas rotate round-robin. (NOT heartbeat freshness: the
-        lease cadences of healthy replicas phase-lock against the
-        members poll, and a fixed freshness tie-break then shadows
-        one replica completely — it never takes traffic and its
-        buckets go cold.)"""
+        fewest FLEET-WIDE router-dispatched batches in flight (own
+        counts plus every sibling router's, shared through the member
+        registry's info blobs — a failed-over request must not
+        double-count a replica's load); equally-loaded replicas rotate
+        round-robin. (NOT heartbeat freshness: the lease cadences of
+        healthy replicas phase-lock against the members poll, and a
+        fixed freshness tie-break then shadows one replica completely
+        — it never takes traffic and its buckets go cold.)"""
         with self._members_lock:
-            cands = sorted((self._inflight.get(h, 0), h, v["addr"])
+            peers = self._peer_inflight
+            cands = sorted((self._inflight.get(h, 0)
+                            + peers.get(h, 0), h, v["addr"])
                            for h, v in self._members.items()
                            if h not in tried)
             if not cands:
@@ -1042,8 +1392,11 @@ class FleetRouter(_FleetMember):
         with self._members_lock:
             n = self._inflight.get(rid, 0) + d
             self._inflight[rid] = max(0, n)
-            val = self._inflight[rid]
-        resilience.set_router_inflight(rid, val)
+            # the gauge write stays under the lock: published outside
+            # it, a racing +1/-1 pair can land out of order and strand
+            # the exported series at a stale nonzero value
+            resilience.set_router_inflight(
+                rid, self._inflight[rid], router=self._host_id)
 
     # -- the export contract (what batching splits by) ---------------------
     def _get_meta(self):
@@ -1118,18 +1471,67 @@ class FleetRouter(_FleetMember):
         return n
 
     # -- request intake ----------------------------------------------------
-    def submit(self, feeds, deadline_s=None):
+    def _finish_pending(self, p, deadline, outcome_replayed=False):
+        """Wait out one pending request and account its terminal
+        outcome (``replay`` for a token replay riding the original —
+        the caller's view stays one request, the counters stay
+        honest)."""
+        if not p.event.wait(max(0.0, deadline - time.monotonic())
+                            + 0.05):
+            p.abandoned = True
+            resilience.record_router_request("deadline",
+                                             router=self._host_id)
+            raise DeadlineExceededError(
+                "request did not complete within its deadline")
+        if p.error is not None:
+            resilience.record_router_request(
+                "shed" if isinstance(p.error, ServerOverloadedError)
+                else "deadline"
+                if isinstance(p.error, DeadlineExceededError)
+                else "error", router=self._host_id)
+            raise p.error
+        resilience.record_router_request(
+            "replay" if outcome_replayed else "ok",
+            router=self._host_id)
+        return p.result
+
+    def _remember_token(self, token, p):
+        with self._token_lock:
+            self._tokens[token] = p
+            while len(self._tokens) > self.TOKEN_CACHE:
+                self._tokens.popitem(last=False)
+
+    def submit(self, feeds, deadline_s=None, token=None):
         """Route one request (dict name -> rows as nested lists).
         Returns ``{"outputs", "dtypes", "replica", "generation"}``.
+        ``token`` (an opaque client string) makes the request
+        IDEMPOTENT on this router: a replay with the same token rides
+        the original in-flight request (or returns its cached result)
+        instead of enqueueing a duplicate — what lets a FleetClient
+        re-send blindly after a torn response or a failover loop back.
         Raises ServerOverloadedError (queue full / every replica
         shedding), DeadlineExceededError, ValueError (malformed
         request) or RuntimeError (upstream failure after retries)."""
         deadline = time.monotonic() + (
             self.request_deadline_s if deadline_s is None
             else float(deadline_s))
+        if token:
+            with self._token_lock:
+                prev = self._tokens.get(token)
+            # a replay rides only an IN-FLIGHT or SUCCEEDED original.
+            # A failed/abandoned one must NOT answer from the cache:
+            # the client retrying a shed against a single-router
+            # endpoint list would be replayed its own stale failure
+            # forever — the retry re-enqueues fresh (last write wins
+            # in the token cache)
+            if prev is not None and prev.error is None \
+                    and not prev.abandoned:
+                return self._finish_pending(prev, deadline,
+                                            outcome_replayed=True)
         meta = self._get_meta()
         if meta is None:
-            resilience.record_router_request("error")
+            resilience.record_router_request("error",
+                                             router=self._host_id)
             raise FleetError("no live replica to learn the export "
                              "contract from — is the fleet up?")
         try:
@@ -1144,33 +1546,24 @@ class FleetRouter(_FleetMember):
                     "bucket %d — re-export with a larger batch_sizes "
                     "entry" % (n, int(meta["max_bucket"])))
         except ValueError:
-            resilience.record_router_request("error")
+            resilience.record_router_request("error",
+                                             router=self._host_id)
             raise
         p = _Pending(feeds, n, deadline)
         with self._qcond:
             if len(self._queue) >= self.max_queue:
-                resilience.record_router_request("shed")
+                resilience.record_router_request("shed",
+                                                 router=self._host_id)
                 raise ServerOverloadedError(
                     "router queue is full (%d waiting) — shedding "
                     "load; retry with backoff" % self.max_queue)
             self._queue.append(p)
-            resilience.set_router_queue_depth(len(self._queue))
+            resilience.set_router_queue_depth(len(self._queue),
+                                              router=self._host_id)
             self._qcond.notify_all()
-        if not p.event.wait(max(0.0, deadline - time.monotonic())
-                            + 0.05):
-            p.abandoned = True
-            resilience.record_router_request("deadline")
-            raise DeadlineExceededError(
-                "request did not complete within its deadline")
-        if p.error is not None:
-            resilience.record_router_request(
-                "shed" if isinstance(p.error, ServerOverloadedError)
-                else "deadline"
-                if isinstance(p.error, DeadlineExceededError)
-                else "error")
-            raise p.error
-        resilience.record_router_request("ok")
-        return p.result
+        if token:
+            self._remember_token(token, p)
+        return self._finish_pending(p, deadline)
 
     def _handle_infer(self, body):
         feeds = body.get("feeds")
@@ -1183,8 +1576,12 @@ class FleetRouter(_FleetMember):
             except (TypeError, ValueError):
                 return 400, {"error": "deadline_s must be a number, "
                              "got %r" % (deadline_s,)}
+        token = body.get("token")
+        if token is not None and not isinstance(token, str):
+            return 400, {"error": "token must be a string"}
         try:
-            return 200, self.submit(feeds, deadline_s=deadline_s)
+            return 200, self.submit(feeds, deadline_s=deadline_s,
+                                    token=token)
         except ServerOverloadedError as e:
             return 503, {"error": str(e), "kind": "overloaded"}
         except DeadlineExceededError as e:
@@ -1202,7 +1599,8 @@ class FleetRouter(_FleetMember):
         while not self._stop.is_set():
             batch = self._cut_batch()
             if batch:
-                resilience.observe_router_batch(len(batch))
+                resilience.observe_router_batch(len(batch),
+                                                router=self._host_id)
                 t = threading.Thread(target=self._dispatch,
                                      args=(batch,), daemon=True,
                                      name="paddle_tpu-fleet-dispatch")
@@ -1246,7 +1644,8 @@ class FleetRouter(_FleetMember):
                                        or now > self._queue[0].deadline):
                     self._queue.popleft()
                 if not self._queue:
-                    resilience.set_router_queue_depth(0)
+                    resilience.set_router_queue_depth(
+                        0, router=self._host_id)
                     self._qcond.wait(0.05)
                     continue
                 first = self._queue[0]
@@ -1274,7 +1673,8 @@ class FleetRouter(_FleetMember):
                     self._queue.popleft()
                     batch.append(p)
                     rows += p.n
-                resilience.set_router_queue_depth(len(self._queue))
+                resilience.set_router_queue_depth(len(self._queue),
+                                                  router=self._host_id)
                 return batch
         return []
 
@@ -1350,7 +1750,8 @@ class FleetRouter(_FleetMember):
                 last_err = ConnectionError(
                     "replica %d unreachable: %s" % (rid, e))
                 tried.add(rid)
-                resilience.record_router_retry(rid)
+                resilience.record_router_retry(rid,
+                                               router=self._host_id)
                 record_event("router_retry", replica=rid,
                              error=type(e).__name__)
                 continue
@@ -1374,12 +1775,16 @@ class FleetRouter(_FleetMember):
             # 5xx retries are LOAD-driven (a shed storm emits one per
             # tried replica per batch, at request rate): counter only,
             # never the bounded event log
-            resilience.record_router_retry(rid)
+            resilience.record_router_retry(rid, router=self._host_id)
 
     @staticmethod
     def _fail(batch, err):
         for p in batch:
             p.error = err
+            # terminal: a token replay answers from result/error only,
+            # so drop the payload instead of pinning it in the token
+            # cache until 4096 newer requests evict it
+            p.feeds = None
             p.event.set()
 
     def _split(self, batch, resp, meta):
@@ -1402,6 +1807,9 @@ class FleetRouter(_FleetMember):
                         "replica": resp.get("replica"),
                         "generation": resp.get("generation")}
             p.error = None
+            # terminal: replay reads result only — don't pin the
+            # request payload in the token cache
+            p.feeds = None
             p.event.set()
             off += p.n
 
@@ -1478,3 +1886,400 @@ class FleetRouter(_FleetMember):
         record_event("fleet_deploy_complete", refreshed=refreshed,
                      dir=artifact_dir)
         return {"refreshed": refreshed, "dir": artifact_dir}
+
+
+# ---------------------------------------------------------------------------
+# client-side router failover
+# ---------------------------------------------------------------------------
+
+class FleetClient(object):
+    """Thin fail-over client for the replicated router tier.
+
+    Takes a LIST of router endpoints (``"h:p0,h:p1"``, full URLs, or a
+    list of either) and rotates on connection error / 5xx — a router
+    SIGKILL costs one rotation, never a failed request. Every request
+    carries a fresh random TOKEN; replays (a torn response, a failover
+    that loops back to the original router) are IDEMPOTENT router-side
+    — the router returns the original request's result instead of
+    enqueueing a duplicate. 503 (the whole fleet shedding) and 5xx are
+    retried with a tiny backoff until the request deadline, so the
+    caller sees an error only when the deadline is truly spent.
+
+    Thread-safe: N load threads may share one client (the chaos
+    batteries and ``tools/servingsvc.py client`` do)."""
+
+    def __init__(self, endpoints, request_deadline_s=10.0,
+                 backoff_s=0.05):
+        if isinstance(endpoints, str):
+            endpoints = [e.strip() for e in endpoints.split(",")
+                         if e.strip()]
+        self.urls = [u if "://" in u else "http://" + u
+                     for u in endpoints]
+        if not self.urls:
+            raise ValueError("FleetClient needs at least one router "
+                             "endpoint")
+        self.request_deadline_s = float(request_deadline_s)
+        self._backoff_s = float(backoff_s)
+        self._lock = threading.Lock()
+        self._i = 0
+
+    def _url(self):
+        with self._lock:
+            return self.urls[self._i % len(self.urls)]
+
+    def _rotate(self):
+        with self._lock:
+            self._i = (self._i + 1) % len(self.urls)
+
+    def infer(self, feeds, deadline_s=None):
+        """One idempotent request against the router tier. Returns the
+        response dict ({"outputs", "dtypes", "replica", ...}); raises
+        the last error (ConnectionError every router unreachable,
+        ServerOverloadedError whole-fleet shed, DeadlineExceededError,
+        ValueError for a malformed request — never retried) once the
+        deadline is spent."""
+        import uuid
+        deadline = time.monotonic() + (
+            self.request_deadline_s if deadline_s is None
+            else float(deadline_s))
+        token = uuid.uuid4().hex
+        last_err = None
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise last_err if last_err is not None else \
+                    DeadlineExceededError(
+                        "no router answered within the deadline")
+            url = self._url()
+            try:
+                status, resp = http_json(
+                    "POST", url + "/infer",
+                    {"feeds": feeds, "deadline_s": remaining,
+                     "token": token},
+                    timeout_s=remaining + 0.5)
+            except (OSError, ValueError) as e:
+                # a dead/SIGKILLed router: rotate and REPLAY by token
+                # (idempotent even when the loop lands back here)
+                last_err = ConnectionError(
+                    "router %s unreachable: %s" % (url, e))
+                self._rotate()
+                time.sleep(min(self._backoff_s,
+                               max(0.0, deadline - time.monotonic())))
+                continue
+            if status == 200:
+                return resp
+            if status == 400:
+                # malformed request: deterministic on every router —
+                # retrying would only burn the deadline
+                raise ValueError(resp.get("error", "bad request"))
+            if status == 503:
+                last_err = ServerOverloadedError(
+                    resp.get("error", "fleet is shedding"))
+            elif status == 504:
+                last_err = DeadlineExceededError(
+                    resp.get("error", "fleet deadline"))
+            else:
+                last_err = RuntimeError(
+                    resp.get("error",
+                             "router answered HTTP %d" % status))
+            self._rotate()
+            time.sleep(min(self._backoff_s,
+                           max(0.0, deadline - time.monotonic())))
+
+
+# ---------------------------------------------------------------------------
+# replica autoscaling (policy loop on the admission leader)
+# ---------------------------------------------------------------------------
+
+class Autoscaler(object):
+    """Replica autoscaling policy loop, leader-gated.
+
+    Attached to a :class:`FleetRouter`; every ``interval_s`` it samples
+    the router's queue depth, its shed rate (per-router
+    ``router_requests_total`` deltas) and the fleet-wide in-flight
+    total, over a sliding ``window``. Only the ADMISSION LEADER acts
+    (followers keep sampling so a takeover starts warm, but their
+    streaks reset on the leadership edge — a new leader must re-observe
+    before acting):
+
+      * **grow** — ``hysteresis`` consecutive samples with queue depth
+        >= ``grow_queue_depth`` OR window shed rate >=
+        ``grow_shed_rate``: the group is RESIZED one slot larger
+        (``Coordinator.resize`` — the new slot is born fenced) and
+        ``spawner(new_host_id, new_group_size)`` launches the replica,
+        which joins through the ordinary announce/admit/join path.
+      * **shrink** — a full window of idle samples (zero queue, zero
+        in-flight, zero sheds): the HIGHEST grown replica id (only
+        slots above the router range are removable — the id space is
+        contiguous, so only the top can be resized away) is asked to
+        DRAIN (``POST /admin/drain``: it fences itself and stops
+        rejoining), waited out of rotation, the group resized one slot
+        smaller, and ``stopper(host_id)`` reaps the process.
+
+    Hysteresis + ``cooldown_s`` after every action keep a noisy load
+    signal from flapping the fleet. ``min_replicas``/``max_replicas``
+    bound the replica tier — max is enforced against ALLOCATED slots
+    as well as live replicas, so a spawner whose replicas die before
+    joining cannot grow the group without bound; min defaults to the
+    base tier (base replicas are permanent members; the resize seam
+    only moves the top of the id range). Decisions land as
+    ``fleet_autoscale`` events and the ``fleet_target_replicas``
+    gauge."""
+
+    def __init__(self, router, spawner=None, stopper=None,
+                 min_replicas=None, max_replicas=None,
+                 interval_s=0.25, window=8, grow_queue_depth=4.0,
+                 grow_shed_rate=0.05, hysteresis=3, cooldown_s=5.0,
+                 drain_timeout_s=15.0):
+        self.router = router
+        self.spawner = spawner
+        self.stopper = stopper
+        self.min_replicas = int(min_replicas) \
+            if min_replicas is not None else router.n_replicas
+        self.max_replicas = int(max_replicas) \
+            if max_replicas is not None else router.n_replicas + 4
+        if not 1 <= self.min_replicas <= self.max_replicas:
+            raise ValueError(
+                "need 1 <= min_replicas (%d) <= max_replicas (%d)"
+                % (self.min_replicas, self.max_replicas))
+        self.interval_s = float(interval_s)
+        self.window = int(window)
+        self.grow_queue_depth = float(grow_queue_depth)
+        self.grow_shed_rate = float(grow_shed_rate)
+        self.hysteresis = int(hysteresis)
+        self.cooldown_s = float(cooldown_s)
+        self.drain_timeout_s = float(drain_timeout_s)
+        self._samples = collections.deque(maxlen=self.window)
+        self._grow_streak = 0
+        self._ceiling_warned = False
+        self._was_leader = False
+        self._last_action_t = None
+        self._last_shed = None
+        self._stop = threading.Event()
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name="paddle_tpu-fleet-autoscale-%d"
+            % self.router._host_id)
+        self._thread.start()
+        return self
+
+    def close(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.drain_timeout_s + 5.0)
+
+    def _loop(self):
+        while not self._stop.wait(self.interval_s):
+            try:
+                self._tick()
+            except Exception as e:   # noqa: BLE001 - the loop IS the
+                # policy plane: an error costs one tick, not the thread
+                record_event("fleet_autoscale_error",
+                             error=type(e).__name__)
+
+    # -- signal sampling ---------------------------------------------------
+    def _sample(self):
+        """One FLEET-WIDE load sample: this router's own queue/shed
+        plus every live sibling's, read from their info blobs —
+        clients pin one endpoint, so overload routinely lands on a
+        FOLLOWER the leader process cannot observe locally. Queue
+        depth takes the max (the threshold means "some router's queue
+        is this deep"), counters sum."""
+        r = self.router
+        queue, shed, total = r._load_signals()
+        with r._members_lock:
+            inflight = sum(r._inflight.values()) \
+                + sum(r._peer_inflight.values())
+            peers = [dict(v) for v in r._peer_router_load.values()]
+        for p in peers:
+            queue = max(queue, p.get("queue", 0))
+            shed += p.get("shed", 0)
+            total += p.get("reqs", 0)
+        return {"queue": queue, "shed": shed,
+                "total": total, "inflight": inflight}
+
+    def _window_shed_rate(self):
+        if len(self._samples) < 2:
+            return 0.0
+        first, last = self._samples[0], self._samples[-1]
+        d_total = last["total"] - first["total"]
+        d_shed = last["shed"] - first["shed"]
+        return d_shed / float(d_total) if d_total > 0 else 0.0
+
+    def _tick(self):
+        leader = self.router.is_leader()
+        if leader != self._was_leader:
+            # leadership edge: a fresh leader re-observes before it
+            # may act — inherited streaks belong to another router
+            self._grow_streak = 0
+            self._samples.clear()
+            self._was_leader = leader
+        s = self._sample()
+        self._samples.append(s)
+        if s["queue"] >= self.grow_queue_depth \
+                or (len(self._samples) >= 2
+                    and self._window_shed_rate()
+                    >= self.grow_shed_rate):
+            self._grow_streak += 1
+        else:
+            self._grow_streak = 0
+        if not leader:
+            return
+        if self._last_action_t is not None and \
+                time.monotonic() - self._last_action_t \
+                < self.cooldown_s:
+            return
+        live = sorted(self.router.routable())
+        n_live = len(live)
+        if self._grow_streak >= self.hysteresis \
+                and n_live < self.max_replicas:
+            self._grow(n_live)
+        elif len(self._samples) == self.window \
+                and all(x["queue"] == 0 and x["inflight"] == 0
+                        for x in self._samples) \
+                and self._samples[-1]["shed"] \
+                == self._samples[0]["shed"]:
+            if n_live > self.min_replicas:
+                self._shrink(live)
+            else:
+                # even at the live floor an idle window may have a
+                # LEFTOVER to reap: a fenced top slot holds no live
+                # replica, so it never counts toward n_live but wedges
+                # all future scale-in until resized away
+                self._reclaim(live)
+
+    # -- actuation ---------------------------------------------------------
+    def _group_size(self):
+        try:
+            m = self.router._co.members()
+        except (CoordinationError, ConnectionError):
+            return None
+        return m.get("n_hosts")
+
+    def _resize_with_retry(self, n_hosts, action, budget_s=5.0):
+        """The fleet's control rounds tick continuously, so a resize
+        routinely races an open gather ("refused mid-round") — rounds
+        live milliseconds, so a short retry loop rides them out. A
+        bounded failure here matters most on SHRINK, where the victim
+        already drained: bailing would orphan it out of rotation with
+        its slot still counted."""
+        deadline = time.monotonic() + float(budget_s)
+        while True:
+            try:
+                self.router._co.resize(int(n_hosts))
+                return True
+            except (CoordinationError, ConnectionError) as e:
+                if time.monotonic() >= deadline:
+                    record_event("fleet_autoscale_deferred",
+                                 action=action,
+                                 error=type(e).__name__)
+                    return False
+                if self._stop.wait(0.05):
+                    return False
+
+    def _grow(self, n_live):
+        group = self._group_size()
+        if group is None:
+            return
+        if int(group) - self.router.n_routers >= self.max_replicas:
+            # every replica SLOT is already allocated — n_live only
+            # counts joined replicas, so gating on it alone would let
+            # sustained pressure over a broken spawner grow the group
+            # one fenced phantom slot per cooldown without bound
+            if not self._ceiling_warned:
+                self._ceiling_warned = True
+                record_event("fleet_autoscale_deferred", action="grow",
+                             error="replica_slot_ceiling",
+                             group=int(group))
+            return
+        self._ceiling_warned = False
+        new_id, new_group = int(group), int(group) + 1
+        if not self._resize_with_retry(new_group, "grow"):
+            # hysteresis already proved the pressure: next tick retries
+            return
+        self._last_action_t = time.monotonic()
+        self._grow_streak = 0
+        record_event("fleet_autoscale", action="grow",
+                     target=n_live + 1, member=new_id,
+                     group=new_group)
+        if self.spawner is not None:
+            self.spawner(new_id, new_group)
+
+    def _shrink(self, live):
+        group = self._group_size()
+        if group is None:
+            return
+        victim = int(group) - 1
+        # only the TOP id is removable (contiguous id space), and only
+        # GROWN slots above the router range may leave — the base tier
+        # is permanent membership
+        if victim < self.router.n_replicas + self.router.n_routers:
+            return
+        if victim not in live:
+            self._reclaim(live)
+            return
+        ent = self.router.routable().get(victim)
+        if ent is None:
+            return
+        try:
+            status, resp = http_json(
+                "POST", "http://%s/admin/drain" % ent["addr"], {},
+                timeout_s=5.0)
+        except (OSError, ValueError):
+            return                   # unreachable: retry next window
+        if status != 200:
+            return
+        deadline = time.monotonic() + self.drain_timeout_s
+        while time.monotonic() < deadline:
+            if victim not in self.router.routable():
+                break
+            if self._stop.wait(0.05):
+                return
+        else:
+            record_event("fleet_autoscale_deferred", action="shrink",
+                         error="drain_timeout", member=victim)
+            return
+        if not self._resize_with_retry(int(group) - 1, "shrink"):
+            return
+        self._last_action_t = time.monotonic()
+        self._samples.clear()
+        record_event("fleet_autoscale", action="shrink",
+                     target=len(live) - 1, member=victim,
+                     group=int(group) - 1)
+        if self.stopper is not None:
+            self.stopper(victim)
+
+    def _reclaim(self, live):
+        """Reap a fenced, unroutable TOP slot — the leftover that
+        otherwise wedges ALL future scale-in (only the top id is
+        removable, and a fenced slot can never become live on its
+        own): a drain whose follow-up resize exhausted its budget, or
+        a grown replica that died before joining. Only a slot the
+        coordinator confirms FENCED is reclaimed — anything holding a
+        live-looking lease is left alone, and a joiner racing the
+        resize loses to the stale-size named error, never a phantom
+        membership."""
+        try:
+            m = self.router._co.members()
+        except (CoordinationError, ConnectionError):
+            return
+        group = m.get("n_hosts")
+        if group is None:
+            return
+        victim = int(group) - 1
+        if victim < self.router.n_replicas + self.router.n_routers \
+                or victim in live \
+                or victim not in m.get("lost", {}):
+            return
+        if not self._resize_with_retry(int(group) - 1, "shrink"):
+            return
+        self._last_action_t = time.monotonic()
+        self._samples.clear()
+        record_event("fleet_autoscale", action="shrink",
+                     target=len(live), member=victim,
+                     group=int(group) - 1, reclaimed=True)
+        if self.stopper is not None:
+            self.stopper(victim)

@@ -71,7 +71,7 @@ def test_budget_table_covers_the_contract():
         "transport_roundtrip_ms", "transport_gather_ms",
         "transport_failover_ms",
         "serving_p50_ms", "serving_p99_ms", "serving_shed_rate",
-        "serving_error_rate",
+        "serving_error_rate", "router_failover_ms",
         "pp_step_s", "pp_bubble_frac", "pp_cache_hit_rate"}
 
 
@@ -103,6 +103,15 @@ def test_failover_section_measures_promotion_round_trip():
     m = bench_micro.bench_failover(hb_deadline_s=0.4)
     assert 0 < m["transport_failover_ms"] < 15000.0
     assert m["transport_failover_term"] >= 1
+
+
+def test_router_failover_section_measures_client_outage():
+    """ISSUE-11 satellite: one of two in-process routers is severed
+    mid-load and the pinned FleetClient's first successful request on
+    the survivor lands inside the budget — the router tier's outage
+    metric, gated in tier-1 like every other budget."""
+    m = bench_micro.bench_router_failover(hb_deadline_s=0.5)
+    assert 0 < m["router_failover_ms"] < 15000.0
 
 
 def test_fail_on_drift_is_default_on(tmp_path, capsys):

@@ -1,0 +1,318 @@
+"""Dynamic group resize protocol units (ISSUE-11 satellite).
+
+``Coordinator.resize`` changes a group's size at a round boundary:
+grown slots are born FENCED ("resized: awaiting join") and enter
+through the ordinary announce/admit/join path; a shrink removes only
+TOP ids that are already fenced (drain first). Covered here over all
+three coordinator transports — local (threads), socket (CoordServer)
+and replicated (term-fenced CoordServer group) — plus the named
+refusals (mid-round, live id in the shrink range), snapshot round-trip
+of the resized size, and the stale-size client getting a loud RESIZED
+error instead of a phantom membership.
+"""
+import contextlib
+import threading
+import time
+
+import pytest
+
+from paddle_tpu.framework import resilience
+from paddle_tpu.framework.coordination import (CoordinationError,
+                                               FileCoordinator,
+                                               LocalCoordinator,
+                                               SocketCoordinator)
+from paddle_tpu.framework.transport import CoordServer, replicated_group
+
+pytestmark = [pytest.mark.faultinject, pytest.mark.pod]
+
+
+@pytest.fixture(autouse=True)
+def _clean_resilience():
+    resilience.install(None)
+    resilience.clear_events()
+    yield
+    resilience.install(None)
+    resilience.clear_events()
+
+
+def _wait(cond, what, timeout_s=10.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.005)
+    raise AssertionError("timed out waiting for %s" % what)
+
+
+def _run_hosts(fn, hosts):
+    out, errs = {}, {}
+
+    def worker(hid):
+        try:
+            out[hid] = fn(hid)
+        except Exception as e:
+            errs[hid] = e
+
+    ts = [threading.Thread(target=worker, args=(h,)) for h in hosts]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    return out, errs
+
+
+def _socket(stack, srv, n, h, heartbeat=False, timeout_s=20.0):
+    co = SocketCoordinator(srv.address, n, h, timeout_s=timeout_s,
+                           poll_s=0.002, mesh_reinit=False,
+                           heartbeat=heartbeat, hb_interval_s=0.05)
+    stack.callback(co.close)
+    return co
+
+
+# ---------------------------------------------------------------------------
+# local coordinator
+# ---------------------------------------------------------------------------
+
+def test_local_grow_is_born_fenced_then_joins():
+    """Grown slots start FENCED so no in-flight gather waits for them;
+    the new member enters through announce/admit/join and only then
+    counts as live."""
+    co = LocalCoordinator(2, timeout_s=10.0, mesh_reinit=False)
+    assert co.resize(4) == 4
+    lost = co.lost_hosts()
+    assert set(lost) == {2, 3}
+    assert all("awaiting join" in r for r in lost.values())
+    assert co.live_hosts() == [0, 1]
+    # gathers complete WITHOUT the unjoined slots
+    out, errs = _run_hosts(lambda h: co.all_gather("g", h, h), (0, 1))
+    assert not errs and out[0] == {0: 0, 1: 1}
+    # the ordinary admission path brings slot 2 in
+    co.announce_join(2, 7)
+    out, errs = _run_hosts(
+        lambda h: (co.join(2, 7) if h == 2
+                   else co.admit(h, 2, 7, value=40 + h)), (0, 1, 2))
+    assert not errs, errs
+    assert out[2] == 41          # the agreed sync value (max survivor)
+    assert co.live_hosts() == [0, 1, 2]
+
+
+def test_local_shrink_requires_drained_top_ids():
+    co = LocalCoordinator(3, timeout_s=10.0, mesh_reinit=False)
+    with pytest.raises(CoordinationError, match="drain"):
+        co.resize(2)             # host 2 is live
+    with pytest.raises(ValueError):
+        co.resize(0)
+    co.mark_lost(2, "autoscale: drained for scale-in")
+    assert co.resize(2) == 2
+    assert co.live_hosts() == [0, 1]
+    assert co.lost_hosts() == {}   # the tombstone left with the slot
+    # idempotent same-size call is a no-op
+    assert co.resize(2) == 2
+
+
+def test_local_resize_refused_mid_round():
+    """A resize may only land at a round boundary: with a gather in
+    flight it raises the named refusal; once the round completes the
+    same call succeeds."""
+    co = LocalCoordinator(2, timeout_s=10.0, mesh_reinit=False)
+    box = {}
+    t = threading.Thread(
+        target=lambda: box.setdefault(0, co.all_gather("open", 0, 0)))
+    t.start()
+    _wait(lambda: "open" in co._rounds, "round registered")
+    with pytest.raises(CoordinationError, match="mid-round"):
+        co.resize(3)
+    co.all_gather("open", 1, 1)
+    t.join()
+    assert co.resize(3) == 3
+
+
+def test_file_coordinator_peers_adopt_the_resize(tmp_path):
+    """FileCoordinator (multi-process shape): a peer OBJECT with no
+    shared python state adopts the new size from the size record, and
+    the shrink refusals match the local semantics."""
+    root = str(tmp_path / "pod")
+    a = FileCoordinator(root, 2, timeout_s=10.0, poll_s=0.002,
+                        mesh_reinit=False)
+    b = FileCoordinator(root, 2, timeout_s=10.0, poll_s=0.002,
+                        mesh_reinit=False)
+    assert a.resize(3) == 3
+    assert b.live_hosts() == [0, 1]      # poll-time size adoption
+    assert b.n_hosts == 3
+    assert 2 in b.lost_hosts()
+    with pytest.raises(CoordinationError, match="drain"):
+        a.resize(1)                      # host 1 is live
+    a.mark_lost(1, "drained")
+    assert a.resize(1) == 1              # removes fenced 1 and 2
+    assert b.live_hosts() == [0]
+
+
+# ---------------------------------------------------------------------------
+# socket coordinator (CoordServer)
+# ---------------------------------------------------------------------------
+
+def test_socket_grow_adopt_join_and_drained_shrink():
+    """The full socket lifecycle: grow (slot born fenced), the peer
+    adopts the size from members(), the grown member hellos with the
+    NEW size and joins through announce/admit/join, a live-leased
+    member refuses the shrink, and a drained one leaves cleanly."""
+    with contextlib.ExitStack() as stack:
+        srv = CoordServer(2, hb_deadline_s=None).start()
+        stack.callback(srv.close)
+        cos = [_socket(stack, srv, 2, h) for h in range(2)]
+        assert cos[0].resize(3) == 3
+        m = cos[1].members()
+        assert m["n_hosts"] == 3 and m["resize_v"] == 1
+        assert cos[1].n_hosts == 3       # adopted
+        assert 2 in m["lost"]
+        # the grown member joins through the ordinary admission path
+        joiner = _socket(stack, srv, 3, 2, heartbeat=True)
+        joiner.announce_join(2, 9)
+        out, errs = _run_hosts(
+            lambda h: (joiner.join(2, 9) if h == 2
+                       else cos[h].admit(h, 2, 9, value=40 + h)),
+            (0, 1, 2))
+        assert not errs, errs
+        assert out[2] == 41
+        assert sorted(cos[0].live_hosts()) == [0, 1, 2]
+        # its liveness lease blocks the shrink until it drains
+        with pytest.raises(CoordinationError, match="drain"):
+            cos[0].resize(2)
+        cos[0].mark_lost(2, "autoscale: drained for scale-in")
+        assert cos[0].resize(2) == 2
+        assert cos[1].members()["n_hosts"] == 2
+        assert cos[0].live_hosts() == [0, 1]
+
+
+def test_socket_resize_refused_mid_round():
+    with contextlib.ExitStack() as stack:
+        srv = CoordServer(2, hb_deadline_s=None).start()
+        stack.callback(srv.close)
+        cos = [_socket(stack, srv, 2, h) for h in range(2)]
+        box = {}
+        t = threading.Thread(
+            target=lambda: box.setdefault(
+                0, cos[0].all_gather("open", 0, 0)))
+        t.start()
+        _wait(lambda: "open" in srv.state.rounds, "round registered")
+        with pytest.raises(CoordinationError, match="mid-round"):
+            cos[1].resize(3)
+        cos[1].all_gather("open", 1, 1)
+        t.join()
+        assert cos[1].resize(3) == 3
+
+
+def test_stale_size_client_gets_named_resized_error():
+    """A client launched with the PRE-resize size must get a loud,
+    named error at hello — never a phantom membership in a group whose
+    id space moved under it."""
+    with contextlib.ExitStack() as stack:
+        srv = CoordServer(2, hb_deadline_s=None).start()
+        stack.callback(srv.close)
+        co = _socket(stack, srv, 2, 0)
+        assert co.resize(3) == 3
+        with pytest.raises(CoordinationError, match="RESIZED"):
+            SocketCoordinator(srv.address, 2, 1, timeout_s=5.0,
+                              poll_s=0.002, mesh_reinit=False,
+                              heartbeat=False)
+        # the current size is still accepted
+        ok = _socket(stack, srv, 3, 1)
+        assert ok.members()["n_hosts"] == 3
+
+
+def test_snapshot_round_trip_of_the_resized_size(tmp_path):
+    """Solo-deployment durability: a supervised restart from the
+    snapshot resumes with the RESIZED size (and its fenced grown
+    slots), not the command-line size — and groups that never resize
+    stay wire-compatible (resize_v 0)."""
+    snap = str(tmp_path / "coord.snap")
+    srv = CoordServer(2, hb_deadline_s=5.0, snapshot_path=snap).start()
+    with contextlib.ExitStack() as stack:
+        co = _socket(stack, srv, 2, 0)
+        assert co.members()["resize_v"] == 0     # pre-resize wire shape
+        assert co.resize(4) == 4
+    srv.close()                  # close() writes the final snapshot
+    srv2 = CoordServer(2, hb_deadline_s=5.0, snapshot_path=snap).start()
+    with contextlib.ExitStack() as stack:
+        stack.callback(srv2.close)
+        co2 = _socket(stack, srv2, 4, 0)
+        m = co2.members()
+        assert m["n_hosts"] == 4 and m["resize_v"] == 1
+        assert set(m["lost"]) == {2, 3}          # still awaiting join
+
+
+# ---------------------------------------------------------------------------
+# replicated coordinator group
+# ---------------------------------------------------------------------------
+
+def test_replicated_resize_survives_primary_kill():
+    """resize is a _SYNC_CMDS member: the resized size is replicated
+    to the warm standby BEFORE the ack, so a SIGKILLed primary cannot
+    roll the group size back."""
+    servers = replicated_group(2, n_members=2, hb_deadline_s=0.5)
+    with contextlib.ExitStack() as stack:
+        for s in servers:
+            stack.callback(lambda s=s: s.close())
+        addrs = [s.address for s in servers]
+        co = SocketCoordinator(addrs, 2, 0, timeout_s=30.0,
+                               poll_s=0.002, mesh_reinit=False,
+                               heartbeat=False)
+        stack.callback(co.close)
+        assert co.resize(3) == 3
+        servers[0].kill()
+        _wait(lambda: servers[1].state.role == "primary",
+              "standby promotion")
+        m = co.members()         # fails over to the promoted standby
+        assert m["n_hosts"] == 3 and m["resize_v"] == 1
+        assert 2 in m["lost"]
+        assert servers[1].state.n_hosts == 3
+
+
+# ---------------------------------------------------------------------------
+# grow-fence observation semantics
+# ---------------------------------------------------------------------------
+
+def test_grow_fence_is_not_a_host_loss():
+    """The birth fence on a grown slot is bookkeeping, not a loss:
+    observers fire no loss hooks and record no host_lost event for a
+    member that never existed (LocalCoordinator parity) — and because
+    the fence stays OUT of _known_lost, the slot's first REAL loss
+    after joining still fires."""
+    with contextlib.ExitStack() as stack:
+        srv = CoordServer(2, hb_deadline_s=None).start()
+        stack.callback(srv.close)
+        cos = [_socket(stack, srv, 2, h, heartbeat=True)
+               for h in range(2)]
+        seen = []
+        cos[1].add_host_loss_hook(
+            lambda lost, live: seen.append(tuple(lost)))
+        assert cos[0].resize(3) == 3
+        cos[1].lost_hosts()          # forces a lost-map observation
+        time.sleep(0.2)              # ... and heartbeat deliveries
+        assert seen == []
+        assert not resilience.events("host_lost")
+        # the grown member joins, then is REALLY lost: the hook fires
+        joiner = _socket(stack, srv, 3, 2, heartbeat=True)
+        joiner.announce_join(2, 9)
+        out, errs = _run_hosts(
+            lambda h: (joiner.join(2, 9) if h == 2
+                       else cos[h].admit(h, 2, 9, value=40 + h)),
+            (0, 1, 2))
+        assert not errs, errs
+        cos[0].mark_lost(2, "declared lost")
+        _wait(lambda: any(t == (2,) for t in seen),
+              "real loss of the joined slot observed")
+
+
+def test_socket_resize_rejects_bad_size_as_value_error():
+    """Local/File raise ValueError for n_hosts < 1; the socket client
+    pre-validates so the caller-facing contract does not depend on
+    the transport (CoordinationError stays reserved for the
+    protocol's named refusals)."""
+    with contextlib.ExitStack() as stack:
+        srv = CoordServer(2, hb_deadline_s=None).start()
+        stack.callback(srv.close)
+        co = _socket(stack, srv, 2, 0)
+        with pytest.raises(ValueError):
+            co.resize(0)
+        assert co.members()["n_hosts"] == 2

@@ -1,0 +1,952 @@
+"""Router HA tier battery (ISSUE-11): N concurrent FleetRouters with
+leader-based admission, client failover + idempotent replay, replica
+autoscaling over dynamic group resize — in-process units plus chaos
+over REAL ``tools/servingsvc.py`` processes:
+
+  * double-failure: SIGKILL the admission-leader router AND one
+    replica in the same window under multi-client load — zero failed
+    requests, the surviving router inherits admission (term bumped),
+    the killed replica re-admits after restart;
+  * acceptance headline: 2 routers + 3 replicas as real processes
+    under 4-thread client load; leader SIGKILL costs zero requests,
+    the restarted router rejoins as FOLLOWER (sticky incumbency), and
+    a queue-depth surge drives one ``fleet_autoscale`` grow that adds
+    a serving replica through the coordinator's ``resize`` op.
+"""
+import contextlib
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.framework import resilience
+from paddle_tpu.framework.coordination import CoordinationError
+from paddle_tpu.framework.transport import CoordServer
+from paddle_tpu.serving_fleet import (Autoscaler, FleetClient,
+                                      FleetRouter, ReplicaMember,
+                                      http_json)
+
+pytestmark = [pytest.mark.faultinject, pytest.mark.fleet]
+
+WAIT_S = 25.0
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOL = os.path.join(ROOT, "tools", "servingsvc.py")
+
+
+@pytest.fixture(autouse=True)
+def _clean_resilience():
+    resilience.install(None)
+    resilience.clear_events()
+    resilience.clear_router()
+    yield
+    resilience.install(None)
+    resilience.clear_events()
+    resilience.clear_router()
+
+
+def _export_artifact(dirname, features=6, classes=3,
+                     batch_sizes=(1, 8)):
+    from paddle_tpu.framework.scope import Scope, scope_guard
+    with scope_guard(Scope()):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("x", [features], dtype="float32")
+            y = layers.softmax(layers.fc(x, classes))
+        exe = pt.Executor()
+        exe.run(startup)
+        pt.save_inference_model(str(dirname), ["x"], [y], exe,
+                                main_program=main, format="stablehlo",
+                                batch_sizes=batch_sizes)
+    return str(dirname)
+
+
+@pytest.fixture(scope="module")
+def artifact(tmp_path_factory):
+    return _export_artifact(tmp_path_factory.mktemp("ha_artifact"))
+
+
+def _wait(cond, what, timeout_s=WAIT_S):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.02)
+    raise AssertionError("timed out waiting for %s" % what)
+
+
+def _ha_fleet(stack, artifact, n_replicas=1, n_routers=2,
+              hb_deadline_s=1.0, router_kw=None):
+    """In-process HA fleet: n replicas + R routers, fast cadences,
+    torn down by the ExitStack."""
+    srv = CoordServer(n_replicas + n_routers,
+                      hb_deadline_s=hb_deadline_s).start()
+    stack.callback(srv.close)
+    reps = []
+    for i in range(n_replicas):
+        rep = ReplicaMember(artifact, srv.address, n_replicas, i,
+                            n_routers=n_routers, ctl_interval_s=0.05,
+                            hb_interval_s=0.1,
+                            join_timeout_s=WAIT_S).start()
+        stack.callback(rep.close)
+        reps.append(rep)
+    rkw = dict(max_batch=8, batch_deadline_s=0.01, ctl_interval_s=0.05,
+               hb_interval_s=0.1, poll_interval_s=0.03,
+               join_timeout_s=WAIT_S)
+    rkw.update(router_kw or {})
+    routers = []
+    for rid in range(n_routers):
+        r = FleetRouter(srv.address, n_replicas, router_id=rid,
+                        n_routers=n_routers, **rkw).start()
+        stack.callback(r.close)
+        routers.append(r)
+    for r in routers:
+        _wait(lambda r=r: len(r.routable()) == n_replicas,
+              "router %d routable" % r.router_id)
+    return srv, reps, routers
+
+
+def _sever(router):
+    """Abrupt in-process kill: listener + coordinator client down, no
+    graceful queue drain — the closest a thread can come to SIGKILL."""
+    router._stop.set()
+    router._server.shutdown()
+    router._server.server_close()
+    router._co.close()
+
+
+# ---------------------------------------------------------------------------
+# in-process units
+# ---------------------------------------------------------------------------
+
+def test_lowest_live_router_id_is_the_admission_leader(artifact):
+    with contextlib.ExitStack() as stack:
+        _, _, routers = _ha_fleet(stack, artifact, n_replicas=1,
+                                  n_routers=2)
+        _wait(lambda: routers[0].is_leader(), "router 0 leads")
+        assert not routers[1].is_leader()
+        assert routers[0].leader_term >= 1
+        h = routers[0].health()
+        assert h["leader"] and h["router_id"] == 0
+        assert h["n_routers"] == 2
+
+
+def test_leader_failover_bumps_term_and_restart_rejoins_as_follower(
+        artifact):
+    """Kill the leader: the survivor takes over with a HIGHER term
+    (the stale ex-leader's claim is fenced); the restarted router
+    re-admits through announce/admit/join and stays a FOLLOWER
+    (sticky incumbency), its term gauge converging with the leader's."""
+    with contextlib.ExitStack() as stack:
+        srv, _, routers = _ha_fleet(stack, artifact, n_replicas=1,
+                                    n_routers=2)
+        _wait(lambda: routers[0].is_leader(), "router 0 leads")
+        t0 = routers[0].leader_term
+        _sever(routers[0])
+        _wait(lambda: routers[1].is_leader(), "router 1 takes over",
+              timeout_s=WAIT_S)
+        assert routers[1].leader_term > t0     # takeover fences claims
+        kinds = [e for e in resilience.events("fleet_leader_elect")
+                 if e.get("router") == routers[1]._host_id]
+        assert kinds, "takeover did not record an election event"
+        # restart = a fresh object with the same router_id; it finds
+        # itself fenced, rejoins, and DOES NOT reclaim the lease
+        r0b = FleetRouter(srv.address, 1, router_id=0, n_routers=2,
+                          max_batch=8, batch_deadline_s=0.01,
+                          ctl_interval_s=0.05, hb_interval_s=0.1,
+                          poll_interval_s=0.03,
+                          join_timeout_s=WAIT_S).start()
+        stack.callback(r0b.close)
+        _wait(lambda: len(r0b.routable()) == 1, "restarted routable")
+        time.sleep(0.3)                        # a few leadership polls
+        assert routers[1].is_leader()
+        assert not r0b.is_leader()
+        _wait(lambda: r0b.leader_term == routers[1].leader_term,
+              "terms converge")
+        # the serving path never broke: both routers answer /infer
+        xv = np.ones((1, 6), np.float32).tolist()
+        for r in (routers[1], r0b):
+            status, resp = http_json("POST", r.url + "/infer",
+                                     {"feeds": {"x": xv}},
+                                     timeout_s=15.0)
+            assert status == 200, resp
+
+
+def test_router_metrics_are_per_router_series(artifact):
+    """Satellite: N concurrent routers in one process must not
+    overwrite each other's gauges — every router_* series carries a
+    ``router=`` label and the per-router snapshots stay distinct."""
+    with contextlib.ExitStack() as stack:
+        _, _, routers = _ha_fleet(stack, artifact, n_replicas=1,
+                                  n_routers=2)
+        xv = np.ones((2, 6), np.float32).tolist()
+        for r in routers:
+            for _ in range(3):
+                status, _ = http_json("POST", r.url + "/infer",
+                                      {"feeds": {"x": xv}},
+                                      timeout_s=15.0)
+                assert status == 200
+        by = resilience.router_totals(by_router=True)
+        keys = {k for k in by if k is not None}
+        assert {str(r._host_id) for r in routers} <= keys
+        for r in routers:
+            assert by[str(r._host_id)]["requests"].get("ok") == 3
+        # the aggregate (legacy single-router shape) still adds up
+        assert resilience.router_totals()["requests"]["ok"] == 6
+        gauges = resilience.metrics()["gauges"]
+        qd_labels = [g["labels"] for g in gauges
+                     if g["name"].endswith("_router_queue_depth")]
+        routers_seen = {lbl.get("router") for lbl in qd_labels}
+        assert {str(r._host_id) for r in routers} <= routers_seen
+        # and the text exposition round-trips the label
+        assert 'router="' in resilience.metrics_text()
+
+
+def test_probe_strict_flags_router_term_disagreement():
+    """Satellite: ``serving_probe --strict`` fails on DISAGREEING
+    per-router ``fleet_leader_term`` gauges (a router pinned below the
+    admission-leader term), mirroring the transport term check; the
+    ``fleet_*`` gauges fold under the scrape's "router" group."""
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    try:
+        import serving_probe
+    finally:
+        sys.path.pop(0)
+    resilience.record_event("fleet_leader_term", router=3, term=2)
+    resilience.record_event("fleet_leader_term", router=4, term=2)
+    resilience.record_event("fleet_autoscale", action="grow", target=4)
+    with resilience.serve_metrics(port=0) as server:
+        got = serving_probe.scrape_metrics(server.url)
+    assert got["router"]["fleet_leader_term/router3"] == 2.0
+    assert got["router"]["fleet_leader_term/router4"] == 2.0
+    assert got["router"]["fleet_target_replicas"] == 4.0
+    assert serving_probe.term_regression_flags(got) == []
+    # one router pinned below the group's admission term: flagged
+    resilience.record_event("fleet_leader_term", router=4, term=1)
+    with resilience.serve_metrics(port=0) as server:
+        got = serving_probe.scrape_metrics(server.url)
+    flags = serving_probe.term_regression_flags(got)
+    assert flags and "fleet_leader_term" in flags[0]
+
+
+def test_submit_token_replay_is_idempotent(artifact):
+    """A replayed token rides the original request instead of
+    enqueueing a duplicate: same result, one replica execution,
+    outcome counted as ``replay``."""
+    with contextlib.ExitStack() as stack:
+        _, _, routers = _ha_fleet(stack, artifact, n_replicas=1,
+                                  n_routers=1)
+        router = routers[0]
+        xv = np.random.RandomState(3).rand(2, 6)
+        body = {"feeds": {"x": xv.tolist()}, "token": "tok-1"}
+        status1, r1 = http_json("POST", router.url + "/infer", body,
+                                timeout_s=15.0)
+        status2, r2 = http_json("POST", router.url + "/infer", body,
+                                timeout_s=15.0)
+        assert status1 == status2 == 200
+        assert r1["outputs"] == r2["outputs"]
+        tot = resilience.router_totals(by_router=True)[
+            str(router._host_id)]
+        assert tot["requests"].get("ok") == 1
+        assert tot["requests"].get("replay") == 1
+
+
+def test_fleet_client_rotates_past_dead_endpoints(artifact):
+    with contextlib.ExitStack() as stack:
+        _, _, routers = _ha_fleet(stack, artifact, n_replicas=1,
+                                  n_routers=1)
+        client = FleetClient(["127.0.0.1:9", routers[0].url],
+                             request_deadline_s=15.0, backoff_s=0.01)
+        xv = np.ones((1, 6), np.float32).tolist()
+        out = client.infer({"x": xv})
+        assert out["replica"] == 0
+        # malformed requests are NOT retried: deterministic 400
+        with pytest.raises(ValueError):
+            client.infer({"nope": xv})
+
+
+def test_autoscaler_grows_on_shed_surge_and_shrinks_when_idle(
+        artifact):
+    """The full in-process autoscale loop: a shed surge grows the
+    group one slot (dynamic resize + spawner, the new replica joins
+    through announce/admit/join and serves), a sustained idle window
+    drains the grown replica and resizes it away again — with
+    ``fleet_autoscale`` events and the ``fleet_target_replicas``
+    gauge on both edges."""
+    with contextlib.ExitStack() as stack:
+        srv, _, routers = _ha_fleet(
+            stack, artifact, n_replicas=1, n_routers=1,
+            router_kw=dict(max_queue=4, max_batch=1,
+                           batch_deadline_s=0.001))
+        router = routers[0]
+        _wait(lambda: router.is_leader(), "leader")
+        grown = []
+
+        def spawner(new_id, new_group):
+            rep = ReplicaMember(artifact, srv.address, 1, new_id,
+                                n_routers=1, group_size=new_group,
+                                ctl_interval_s=0.05, hb_interval_s=0.1,
+                                join_timeout_s=WAIT_S).start()
+            stack.callback(rep.close)
+            grown.append(rep)
+
+        stopped = []
+        auto = Autoscaler(router, spawner=spawner,
+                          stopper=stopped.append, min_replicas=1,
+                          max_replicas=2, interval_s=0.03, window=8,
+                          grow_queue_depth=3.0, grow_shed_rate=0.05,
+                          hysteresis=2, cooldown_s=0.5,
+                          drain_timeout_s=WAIT_S).start()
+        stack.callback(auto.close)
+        # SUSTAINED shed surge (hysteresis deliberately ignores a
+        # sub-interval blip): looping senders keep the 4-deep queue
+        # full and the shed counter climbing across samples
+        xv = np.ones((1, 6), np.float32).tolist()
+        surge_stop = threading.Event()
+
+        def pound():
+            while not surge_stop.is_set():
+                try:
+                    http_json("POST", router.url + "/infer",
+                              {"feeds": {"x": xv}}, timeout_s=15.0)
+                except (OSError, ValueError):
+                    pass
+
+        ts = [threading.Thread(target=pound, daemon=True)
+              for _ in range(12)]
+        for t in ts:
+            t.start()
+        try:
+            _wait(lambda: any(
+                e.get("action") == "grow"
+                for e in resilience.events("fleet_autoscale")),
+                "autoscale grow", timeout_s=WAIT_S)
+        finally:
+            surge_stop.set()
+            for t in ts:
+                t.join(timeout=5)
+        grow, = [e for e in resilience.events("fleet_autoscale")
+                 if e.get("action") == "grow"]
+        assert grow["member"] == 2 and grow["group"] == 3
+        # the event lands when the resize commits; the spawner then
+        # runs on the autoscaler thread and blocks through the join
+        # handshake — wait for it rather than racing it
+        _wait(lambda: grown, "spawner invoked")
+        # idle: the window drains, the grown slot is drained + resized
+        # away, the stopper reaps it. (The shrink implies the whole
+        # grow path worked: resize → join — the drain REQUIRES the
+        # grown replica in rotation before it may leave.)
+        _wait(lambda: any(e.get("action") == "shrink"
+                          for e in resilience.events("fleet_autoscale")),
+              "autoscale shrink", timeout_s=WAIT_S)
+        _wait(lambda: srv.state.n_hosts == 2, "group resized back to 2")
+        _wait(lambda: len(router.routable()) == 1,
+              "drained replica out of rotation")
+        assert stopped == [2]
+        assert any(e.get("member") == 2
+                   for e in resilience.events("fleet_drained"))
+        assert any(e.get("joined") == 2
+                   for e in resilience.events("fleet_admit")), \
+            "the grown replica never joined"
+        # base tier intact and serving after the round trip
+        status, _ = http_json("POST", router.url + "/infer",
+                              {"feeds": {"x": xv}}, timeout_s=15.0)
+        assert status == 200
+        # the decisions land in the metrics contract too
+        gauges = resilience.metrics()["gauges"]
+        targets = [g for g in gauges
+                   if g["name"].endswith("_fleet_target_replicas")]
+        assert targets and targets[-1]["value"] == 1
+
+
+# ---------------------------------------------------------------------------
+# chaos over real servingsvc processes
+# ---------------------------------------------------------------------------
+
+def _svc_env():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [p for p in (env.get("PYTHONPATH"), ROOT) if p])
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def _spawn_replica_proc(artifact, coord, n, rid, n_routers,
+                        group_size=None, max_in_flight=None,
+                        faults=None):
+    cmd = [sys.executable, TOOL, "replica", "--coord", coord,
+           "--n-replicas", str(n), "--replica-id", str(rid),
+           "--n-routers", str(n_routers), "--artifact", artifact,
+           "--ctl-interval-s", "0.05", "--hb-interval-s", "0.1",
+           "--join-timeout-s", "30"]
+    if group_size is not None:
+        cmd += ["--group-size", str(group_size)]
+    if max_in_flight is not None:
+        cmd += ["--max-in-flight", str(max_in_flight)]
+    env = _svc_env()
+    if faults is not None:
+        # env-driven fault injection (resilience.current_injector):
+        # how a REAL subprocess replica gets e.g. a slowed serve
+        env["PADDLE_TPU_FAULTS"] = faults
+    return subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True,
+                            env=env)
+
+
+def _spawn_router_proc(coord, n, rid, n_routers, extra=()):
+    cmd = [sys.executable, TOOL, "router", "--coord", coord,
+           "--n-replicas", str(n), "--router-id", str(rid),
+           "--n-routers", str(n_routers),
+           "--ctl-interval-s", "0.05", "--hb-interval-s", "0.1",
+           "--join-timeout-s", "30"] + list(extra)
+    return subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True,
+                            env=_svc_env())
+
+
+class _Lines(object):
+    """Background stdout reader so a chatty child never blocks on a
+    full pipe and the test can poll for announced lines."""
+
+    def __init__(self, proc):
+        self._lines = []
+        self._lock = threading.Lock()
+        t = threading.Thread(target=self._drain, args=(proc,),
+                             daemon=True)
+        t.start()
+
+    def _drain(self, proc):
+        for ln in proc.stdout:
+            with self._lock:
+                self._lines.append(ln)
+
+    def first_json(self):
+        _wait(lambda: len(self.all()) > 0, "child announced itself")
+        return json.loads(self.all()[0])
+
+    def all(self):
+        with self._lock:
+            return list(self._lines)
+
+    def find(self, frag):
+        return [ln for ln in self.all() if frag in ln]
+
+
+def _healthz(url):
+    try:
+        status, h = http_json("GET", url + "/healthz", timeout_s=2.0)
+    except (OSError, ValueError):
+        return None
+    return h if status == 200 else None
+
+
+def _leader_health(url):
+    h = _healthz(url)
+    return h if (h and h.get("leader")) else None
+
+
+def _find_leader(urls):
+    """Which router id currently claims the admission lease (None
+    when no live claim yet)."""
+    for r, u in urls.items():
+        if _leader_health(u) is not None:
+            return r
+    return None
+
+
+def _reap(procs):
+    for p in procs:
+        if p is not None and p.poll() is None:
+            p.send_signal(signal.SIGTERM)
+    for p in procs:
+        if p is not None and p.poll() is None:
+            try:
+                p.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+def test_servingsvc_client_mode_round_trip(artifact):
+    """`servingsvc.py client`: stdin/stdout failover client over a
+    router endpoint LIST — rotates past a dead endpoint, answers one
+    JSON line per request, reports a malformed request as ok=False
+    instead of dying."""
+    with contextlib.ExitStack() as stack:
+        _, _, routers = _ha_fleet(stack, artifact, n_replicas=1,
+                                  n_routers=2)
+        proc = subprocess.Popen(
+            [sys.executable, TOOL, "client", "--routers",
+             ",".join(["127.0.0.1:9", routers[0].url,
+                       routers[1].url]),
+             "--deadline-s", "15"],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL, text=True, env=_svc_env())
+        xv = np.ones((1, 6), np.float32).tolist()
+        try:
+            out, _ = proc.communicate(
+                json.dumps({"feeds": {"x": xv}}) + "\n"
+                + json.dumps({"feeds": {"nope": xv}}) + "\n",
+                timeout=90)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+        lines = [json.loads(ln) for ln in out.splitlines()
+                 if ln.strip()]
+        assert lines[0]["ok"] is True and lines[0]["outputs"]
+        assert lines[1]["ok"] is False
+        assert lines[1]["kind"] == "ValueError"
+        assert proc.returncode == 0
+
+
+def test_chaos_double_failure_leader_router_and_replica(artifact):
+    """Satellite chaos: SIGKILL the admission-leader router AND one
+    replica in the same window under multi-client load. Zero failed
+    requests (client failover + idempotent replay + sibling retry),
+    the surviving router inherits admission with a bumped term, and
+    the killed replica re-admits after restart — proving the new
+    leader really can enact admissions."""
+    srv = CoordServer(4, hb_deadline_s=1.0).start()
+    procs = {}
+    try:
+        for r in range(2):
+            procs["rep%d" % r] = _spawn_replica_proc(
+                artifact, srv.address, 2, r, 2)
+        reps = {r: _Lines(procs["rep%d" % r]) for r in range(2)}
+        for r in range(2):
+            assert reps[r].first_json()["replica_id"] == r
+        for r in range(2):
+            procs["rt%d" % r] = _spawn_router_proc(
+                srv.address, 2, r, 2)
+        routers = {r: _Lines(procs["rt%d" % r]) for r in range(2)}
+        urls = {r: routers[r].first_json()["url"] for r in range(2)}
+        _wait(lambda: all(
+            len((_healthz(urls[r]) or {}).get("replicas", {})) == 2
+            for r in range(2)), "both routers route 2 replicas")
+        # whichever router claimed the admission lease first keeps it
+        # (sticky incumbency — usually the lowest id, but a boot race
+        # can elect the other): the chaos targets THE LEADER
+        _wait(lambda: _find_leader(urls) is not None,
+              "a leader emerges")
+        lead = _find_leader(urls)
+        surv = 1 - lead
+        term0 = _leader_health(urls[lead])["leader_term"]
+
+        client = FleetClient([urls[0], urls[1]],
+                             request_deadline_s=20.0, backoff_s=0.02)
+        xv = np.ones((2, 6), np.float32).tolist()
+        stop, failures, served = threading.Event(), [], []
+        lock = threading.Lock()
+
+        def load():
+            while not stop.is_set():
+                t = time.monotonic()
+                try:
+                    resp = client.infer({"x": xv})
+                except Exception as e:   # noqa: BLE001 - recorded
+                    with lock:
+                        failures.append(repr(e))
+                else:
+                    with lock:
+                        served.append((t, resp["replica"]))
+                time.sleep(0.004)
+
+        loaders = [threading.Thread(target=load, daemon=True)
+                   for _ in range(4)]
+        for t in loaders:
+            t.start()
+        time.sleep(0.5)
+        # the double failure, same window
+        os.kill(procs["rt%d" % lead].pid, signal.SIGKILL)
+        os.kill(procs["rep1"].pid, signal.SIGKILL)
+        procs["rt%d" % lead].wait(timeout=10)
+        procs["rep1"].wait(timeout=10)
+        _wait(lambda: _leader_health(urls[surv]) is not None,
+              "survivor inherits admission", timeout_s=10.0)
+        assert _leader_health(urls[surv])["leader_term"] > term0
+        time.sleep(0.5)          # sustained load on the survivors
+        # restart the replica: re-admission needs the NEW leader
+        procs["rep1b"] = _spawn_replica_proc(
+            artifact, srv.address, 2, 1, 2)
+        rep1b = _Lines(procs["rep1b"])
+        assert rep1b.first_json()["replica_id"] == 1
+        _wait(lambda: "1" in (_healthz(urls[surv]) or {}).get(
+            "replicas", {}), "killed replica re-admitted")
+        t_readmit = time.monotonic()
+        time.sleep(0.7)          # traffic reaches the rejoined replica
+        stop.set()
+        for t in loaders:
+            t.join(timeout=5)
+        assert not failures, failures[:5]
+        assert len(served) > 100
+        assert any(rid == 1 for ts, rid in served if ts > t_readmit), \
+            "re-admitted replica took no traffic"
+    finally:
+        _reap(list(procs.values()))
+        srv.close()
+
+
+def test_chaos_acceptance_router_ha_with_autoscale(artifact, tmp_path):
+    """THE ISSUE-11 acceptance headline: 2 routers + 3 replicas as
+    real servingsvc processes under sustained 4-thread client load.
+    SIGKILL the admission-leader router → zero failed requests, the
+    survivor leads within the heartbeat deadline, the restarted router
+    rejoins as FOLLOWER, and a queue-depth surge drives one
+    ``fleet_autoscale`` grow that adds a serving replica via dynamic
+    resize (the spawned process announced by the leader, admitted
+    through announce/admit/join, visible in the routing table)."""
+    srv = CoordServer(5, hb_deadline_s=1.0).start()
+    procs = {}
+    template = (
+        "%s %s replica --coord {coord} --n-replicas 3 --n-routers 2 "
+        "--replica-id {replica_id} --group-size {group_size} "
+        "--artifact %s --max-in-flight 1 --ctl-interval-s 0.05 "
+        "--hb-interval-s 0.1 --join-timeout-s 30"
+        % (sys.executable, TOOL, artifact))
+    # the base replicas run an env-injected 30ms serve (the
+    # subprocess twin of the PR 8 in-process "serve:slow" batteries),
+    # putting honest fleet capacity well below the surge demand: the
+    # router queue fills, dispatch passes find every replica at
+    # max-in-flight shedding, and the terminal sheds — which
+    # FleetClient retries, keeping the CLIENT failure count at zero —
+    # trip the leader's queue-depth/shed-rate windows. The grown
+    # replica inherits the ROUTER's clean env (no injected slowness),
+    # so the grow visibly drains the backlog it was asked to fix
+    auto_args = ["--autoscale", "--spawn-template", template,
+                 "--autoscale-max", "4", "--autoscale-interval-s",
+                 "0.05", "--autoscale-window", "8",
+                 "--autoscale-queue-depth", "6",
+                 "--autoscale-shed-rate", "0.05",
+                 "--autoscale-hysteresis", "2",
+                 "--autoscale-cooldown-s", "30",
+                 "--max-batch", "4", "--batch-deadline-s", "0.02"]
+    try:
+        for r in range(3):
+            procs["rep%d" % r] = _spawn_replica_proc(
+                artifact, srv.address, 3, r, 2, max_in_flight=1,
+                faults="serve:slow=0.03~1.0")
+        reps = {r: _Lines(procs["rep%d" % r]) for r in range(3)}
+        for r in range(3):
+            assert reps[r].first_json()["replica_id"] == r
+        for r in range(2):
+            procs["rt%d" % r] = _spawn_router_proc(
+                srv.address, 3, r, 2, extra=auto_args)
+        routers = {r: _Lines(procs["rt%d" % r]) for r in range(2)}
+        urls = {r: routers[r].first_json()["url"] for r in range(2)}
+        _wait(lambda: all(
+            len((_healthz(urls[r]) or {}).get("replicas", {})) == 3
+            for r in range(2)), "both routers route 3 replicas")
+        # sticky incumbency: target whichever router holds the lease
+        _wait(lambda: _find_leader(urls) is not None,
+              "a leader emerges")
+        lead = _find_leader(urls)
+        surv = 1 - lead
+
+        # 60s deadline: the engineered overload window must cost the
+        # foreground load LATENCY (shed → backoff → retry), never a
+        # deadline-spent failure
+        client = FleetClient([urls[0], urls[1]],
+                             request_deadline_s=60.0, backoff_s=0.02)
+        xv = np.ones((2, 6), np.float32).tolist()
+        stop, failures, served = threading.Event(), [], []
+        lock = threading.Lock()
+
+        def load():
+            while not stop.is_set():
+                try:
+                    resp = client.infer({"x": xv})
+                except Exception as e:   # noqa: BLE001 - recorded
+                    with lock:
+                        failures.append(repr(e))
+                else:
+                    with lock:
+                        served.append(resp["replica"])
+                time.sleep(0.004)
+
+        loaders = [threading.Thread(target=load, daemon=True)
+                   for _ in range(4)]
+        for t in loaders:
+            t.start()
+        time.sleep(0.5)
+        # leader SIGKILL: zero failed requests, survivor leads within
+        # the heartbeat deadline (+ lease/poll slack)
+        t_kill = time.monotonic()
+        os.kill(procs["rt%d" % lead].pid, signal.SIGKILL)
+        procs["rt%d" % lead].wait(timeout=10)
+        _wait(lambda: _leader_health(urls[surv]) is not None,
+              "survivor becomes leader", timeout_s=15.0)
+        takeover_s = time.monotonic() - t_kill
+        assert takeover_s < 15.0, takeover_s
+        term1 = _leader_health(urls[surv])["leader_term"]
+        # restarted router rejoins as FOLLOWER with the agreed term
+        procs["rt%db" % lead] = _spawn_router_proc(
+            srv.address, 3, lead, 2, extra=auto_args)
+        rt_back = _Lines(procs["rt%db" % lead])
+        url_back = rt_back.first_json()["url"]
+        _wait(lambda: len((_healthz(url_back) or {}).get(
+            "replicas", {})) >= 3, "restarted router routable")
+        h_back = _healthz(url_back)
+        assert not h_back["leader"]
+        assert _leader_health(urls[surv])["leader_term"] == term1
+        assert h_back["leader_term"] == term1  # term gauges agree
+        client.urls.append(url_back)
+        # load surge: SIGKILL one replica (capacity drops to 2 slots
+        # at max-in-flight 1 — its in-flight work retries on siblings,
+        # still zero failures) and pound the LEADER with 24 senders;
+        # dispatch passes find every slot busy, the terminal sheds
+        # climb, and the leader's autoscaler grows the fleet
+        os.kill(procs["rep2"].pid, signal.SIGKILL)
+        procs["rep2"].wait(timeout=10)
+        surge_client = FleetClient([urls[surv]],
+                                   request_deadline_s=20.0)
+        surge_stop = threading.Event()
+
+        def pound():
+            while not surge_stop.is_set():
+                try:
+                    surge_client.infer({"x": xv})
+                except Exception:   # noqa: BLE001 - best-effort surge
+                    pass
+
+        burst = [threading.Thread(target=pound, daemon=True)
+                 for _ in range(24)]
+        for t in burst:
+            t.start()
+        try:
+            _wait(lambda: srv.state.n_hosts == 6,
+                  "dynamic resize grew the group", timeout_s=40.0)
+        finally:
+            surge_stop.set()
+            for t in burst:
+                t.join(timeout=5)
+        assert routers[surv].find("autoscale_spawn"), \
+            "leader did not announce the spawned replica"
+        _wait(lambda: "5" in (_healthz(urls[surv]) or {}).get(
+            "replicas", {}), "grown replica admitted and routable",
+            timeout_s=WAIT_S)
+        time.sleep(0.7)          # the grown replica takes traffic
+        stop.set()
+        for t in loaders:
+            t.join(timeout=5)
+        assert not failures, failures[:5]
+        assert len(served) > 100
+    finally:
+        _reap(list(procs.values()))
+        srv.close()
+
+
+def test_autoscaler_reclaims_orphaned_grown_slot(artifact):
+    """A fenced, unroutable TOP slot — a drain whose follow-up resize
+    never landed, or a grown replica that died before joining — would
+    wedge ALL future scale-in (only the top id is removable, and a
+    fenced slot never becomes live on its own). An idle window
+    reclaims it: the group resizes back down and the stopper reaps
+    the process, even at the live floor where the ordinary shrink
+    path is gated off."""
+    with contextlib.ExitStack() as stack:
+        srv, _, routers = _ha_fleet(stack, artifact, n_replicas=1,
+                                    n_routers=1)
+        router = routers[0]
+        _wait(lambda: router.is_leader(), "leader")
+
+        # the orphan: grow the group one slot, spawn NOTHING — the
+        # slot stays birth-fenced, exactly like a drained leftover
+        def _grow():
+            try:
+                return router._co.resize(3) == 3
+            except CoordinationError:    # control round in flight
+                return False
+        _wait(_grow, "grow to 3")
+        stopped = []
+        auto = Autoscaler(router, stopper=stopped.append,
+                          min_replicas=1, max_replicas=2,
+                          interval_s=0.03, window=4, hysteresis=2,
+                          cooldown_s=0.05,
+                          drain_timeout_s=WAIT_S).start()
+        stack.callback(auto.close)
+        _wait(lambda: srv.state.n_hosts == 2, "slot reclaimed")
+        # the stopper runs on the autoscaler thread AFTER the resize
+        # commits — wait for it rather than racing it
+        _wait(lambda: stopped == [2], "stopper reaped the slot")
+        rec, = [e for e in resilience.events("fleet_autoscale")
+                if e.get("reclaimed")]
+        assert rec["action"] == "shrink" and rec["member"] == 2
+        # the base tier is untouched and serving
+        assert len(router.routable()) == 1
+
+
+def test_publish_retry_after_swallowed_put(artifact):
+    """A put_info swallowed during a coordinator hiccup must be
+    retried on the next poll: the publish signature is cached only
+    once the put LANDS, so sibling routers never sit on a stale
+    leader claim / in-flight map until the state happens to change
+    again."""
+    with contextlib.ExitStack() as stack:
+        srv, _, routers = _ha_fleet(stack, artifact, n_replicas=1,
+                                    n_routers=1)
+        router = routers[0]
+        _wait(lambda: router.is_leader(), "leader")
+        orig = router._co.put_info
+        state = {"failed": 0}
+
+        def flaky(info):
+            if not state["failed"]:
+                state["failed"] = 1
+                raise CoordinationError("injected: failover window")
+            return orig(info)
+
+        router._co.put_info = flaky
+        try:
+            with router._members_lock:
+                router._inflight[0] = 7   # changes the signature
+            _wait(lambda: srv.state.info.get(router._host_id, {})
+                  .get("inflight") == {"0": 7},
+                  "swallowed publish retried")
+            assert state["failed"] == 1   # the injected failure fired
+        finally:
+            router._co.put_info = orig
+            with router._members_lock:
+                router._inflight[0] = 0
+
+
+def test_restarted_base_member_adopts_grown_group_size(artifact):
+    """A base member restarted AFTER an autoscale grow re-runs its
+    original command line, which froze the BASE group size — it must
+    adopt the server's current (post-resize) size at preflight and
+    rejoin, not be refused with the RESIZED mismatch error forever."""
+    with contextlib.ExitStack() as stack:
+        srv, reps, routers = _ha_fleet(stack, artifact, n_replicas=1,
+                                       n_routers=1)
+        router = routers[0]
+        _wait(lambda: router.is_leader(), "leader")
+
+        def _grow():
+            try:
+                return router._co.resize(3) == 3
+            except CoordinationError:    # control round in flight
+                return False
+        _wait(_grow, "grow to 3")
+        reps[0].close()
+        # the restart carries the BOOT-TIME layout (group_size=None
+        # derives 1 replica + 1 router = 2) against the server's 3
+        rep2 = ReplicaMember(artifact, srv.address, 1, 0,
+                             n_routers=1, ctl_interval_s=0.05,
+                             hb_interval_s=0.1,
+                             join_timeout_s=WAIT_S).start()
+        stack.callback(rep2.close)
+        assert rep2.group_size == 3
+        adopt, = [e for e in
+                  resilience.events("fleet_adopt_group_size")
+                  if e.get("member") == 0]
+        assert adopt["configured"] == 2 and adopt["adopted"] == 3
+        _wait(lambda: 0 in router.routable(), "replica back in rotation")
+        xv = np.ones((1, 6), np.float32).tolist()
+        status, resp = http_json("POST", router.url + "/infer",
+                                 {"feeds": {"x": xv}}, timeout_s=15.0)
+        assert status == 200
+
+
+def test_grow_ceiling_counts_allocated_slots(artifact):
+    """max_replicas is enforced against ALLOCATED slots, not just
+    live replicas: a grown slot whose replica died before joining
+    must still count, or sustained pressure over a broken spawner
+    grows the group one phantom slot per cooldown without bound."""
+    with contextlib.ExitStack() as stack:
+        srv, _, routers = _ha_fleet(stack, artifact, n_replicas=1,
+                                    n_routers=1)
+        router = routers[0]
+        _wait(lambda: router.is_leader(), "leader")
+
+        def _grow():
+            try:
+                return router._co.resize(3) == 3
+            except CoordinationError:
+                return False
+        _wait(_grow, "grow to 3")     # slot 2: fenced, never joins
+        spawned = []
+        auto = Autoscaler(router, spawner=lambda *a: spawned.append(a),
+                          min_replicas=1, max_replicas=2)
+        auto._grow(n_live=1)          # n_live < max_replicas, but the
+        assert srv.state.n_hosts == 3  # slot ceiling refuses the grow
+        assert not spawned
+        defer, = [e for e in
+                  resilience.events("fleet_autoscale_deferred")
+                  if e.get("error") == "replica_slot_ceiling"]
+        assert defer["action"] == "grow" and defer["group"] == 3
+
+
+def test_leader_autoscaler_sees_follower_overload(artifact):
+    """Clients pin one endpoint, so overload routinely lands on a
+    FOLLOWER router — the leader's autoscaler must read the sibling's
+    queue/shed from its info blob (process-local counters are
+    invisible across a real multi-process tier) and still grow."""
+    with contextlib.ExitStack() as stack:
+        srv, _, routers = _ha_fleet(
+            stack, artifact, n_replicas=1, n_routers=2,
+            router_kw=dict(max_queue=2, max_batch=1,
+                           batch_deadline_s=0.001))
+        leader, follower = routers
+        _wait(lambda: leader.is_leader(), "leader")
+        assert not follower.is_leader()
+        auto = Autoscaler(leader, min_replicas=1, max_replicas=2,
+                          interval_s=0.03, window=8,
+                          grow_queue_depth=3.0, grow_shed_rate=0.05,
+                          hysteresis=2, cooldown_s=5.0).start()
+        stack.callback(auto.close)
+        # pound ONLY the follower: the leader's own queue/shed stay 0
+        xv = np.ones((1, 6), np.float32).tolist()
+        surge_stop = threading.Event()
+
+        def pound():
+            while not surge_stop.is_set():
+                try:
+                    http_json("POST", follower.url + "/infer",
+                              {"feeds": {"x": xv}}, timeout_s=15.0)
+                except (OSError, ValueError):
+                    pass
+
+        ts = [threading.Thread(target=pound, daemon=True)
+              for _ in range(8)]
+        for t in ts:
+            t.start()
+        try:
+            _wait(lambda: any(
+                e.get("action") == "grow"
+                for e in resilience.events("fleet_autoscale")),
+                "grow from follower-side overload", timeout_s=WAIT_S)
+        finally:
+            surge_stop.set()
+            for t in ts:
+                t.join(timeout=5)
+
+
+def test_template_spawner_stop_reaps_grown_process():
+    """The servingsvc autoscale wiring's stopper: a drained,
+    resized-away grown replica's PROCESS must be reaped — without it
+    every grow/shrink cycle leaks a listener + heartbeat thread."""
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    try:
+        import servingsvc
+    finally:
+        sys.path.pop(0)
+    tmpl = ("%s -c \"import time; time.sleep({group_size}0)\""
+            % sys.executable)
+    spawn = servingsvc._template_spawner(tmpl, "127.0.0.1:0")
+    p = spawn(2, 3)
+    try:
+        assert p.poll() is None
+        spawn.stop(2)
+        assert p.poll() is not None
+        # idempotent: a second stop (or an unknown id) is a no-op
+        spawn.stop(2)
+        spawn.stop(99)
+    finally:
+        if p.poll() is None:
+            p.kill()

@@ -95,12 +95,18 @@ def scrape_metrics(url, timeout_s=5.0):
             if "host" in labels:
                 key += "/host" + labels["host"]
             events[key] = value
-        elif name.startswith(METRIC_PREFIX + "_router_"):
+        elif name.startswith(METRIC_PREFIX + "_router_") \
+                or name.startswith(METRIC_PREFIX + "_fleet_"):
+            # the router-TIER series (per-router queue/requests plus
+            # the fleet_leader_term / fleet_target_replicas gauges)
+            # all fold under the "router" group
             key = name[len(METRIC_PREFIX) + 1:]
             if "outcome" in labels:
                 key += "/" + labels["outcome"]
             if "replica" in labels:
                 key += "/replica" + labels["replica"]
+            if "router" in labels:
+                key += "/router" + labels["router"]
             if "le" in labels:
                 key += "/le" + labels["le"]
             router[key] = value
@@ -137,9 +143,13 @@ def term_regression_flags(summary):
         zombie is still reachable and should be restarted/demoted);
       * per-host ``transport_term`` gauges disagreeing — some client
         is still pinned to a lower term than its peers observed, the
-        split-brain smell term fencing exists to catch.
+        split-brain smell term fencing exists to catch;
+      * per-router ``fleet_leader_term`` gauges disagreeing — the
+        router-tier twin of the transport check: a router pinned
+        below its peers' admission-leader term is still trusting a
+        stale ex-leader, and its enactments would be refused.
 
-    ``--strict`` fails the probe on either."""
+    ``--strict`` fails the probe on any of them."""
     flags = []
     stale = {k: v for k, v in summary.get("events_total", {}).items()
              if k.startswith("transport_stale_primary")}
@@ -152,6 +162,12 @@ def term_regression_flags(summary):
         flags.append("transport_term gauges disagree (a client is "
                      "pinned below the group term): %s"
                      % sorted(terms.items()))
+    lterms = {k: v for k, v in summary.get("router", {}).items()
+              if k.startswith("fleet_leader_term")}
+    if len(set(lterms.values())) > 1:
+        flags.append("fleet_leader_term gauges disagree (a router is "
+                     "pinned below the admission-leader term): %s"
+                     % sorted(lterms.items()))
     return flags
 
 

@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """Serving-fleet daemons — orchestrator glue for paddle_tpu.serving_fleet.
 
-Two subcommands, one process each:
+Three subcommands, one process each:
 
   replica   one ServingPredictor replica: loads the StableHLO artifact,
             serves POST /infer over HTTP, and registers as a
@@ -10,18 +10,42 @@ Two subcommands, one process each:
             --n-hosts auto learns the group size from the first
             member). A RESTARTED replica finds itself fenced and
             re-admits through announce/admit/join automatically — just
-            re-run the same command line.
+            re-run the same command line. A replica SPAWNED by the
+            autoscaler (a grown slot above the router range) passes
+            --group-size with the post-resize size.
 
-  router    the fleet's front door: continuous micro-batching over the
-            live replica set (coalesce up to --max-batch rows or
-            --batch-deadline-s, least-loaded dispatch from the
-            heartbeat/lost map, shed on a full queue, retry a dead
-            replica's in-flight work on a sibling). POST
-            /admin/deploy {"dir": ...} rolls a weight refresh across
-            the fleet one replica at a time with zero dropped traffic.
+  router    the fleet's front door — now a replicated TIER: run R of
+            these (--router-id 0..R-1 --n-routers R), each serving
+            /infer independently (clients take the whole endpoint
+            list — `servingsvc.py client`, or FleetClient in code).
+            Admission is enacted only by the term-stamped admission
+            LEADER (lowest live router id); continuous micro-batching,
+            least-loaded dispatch over fleet-wide shared in-flight
+            counts, shed on a full queue, retry a dead replica's
+            in-flight work on a sibling. POST /admin/deploy
+            {"dir": ...} rolls a weight refresh across the fleet one
+            replica at a time with zero dropped traffic.
+            --autoscale arms the leader-gated replica autoscaler:
+            queue-depth/shed-rate surges grow the fleet through the
+            coordinator's dynamic `resize` op, and --spawn-template
+            (placeholders {replica_id} {group_size} {coord}) is the
+            command launched for each grown replica; a sustained idle
+            window drains + removes grown replicas again. Spawned
+            processes are SUPERVISED by this router process (announced
+            as {"kind": "autoscale_spawn", "pid": ...} lines, reaped
+            on shutdown) — production orchestrators should instead
+            watch the fleet_autoscale events and actuate themselves.
 
-Each prints ONE JSON line with its address once serving (orchestrators
-parse it), then runs until SIGTERM/SIGINT.
+  client    stdin/stdout failover client for a multi-router
+            deployment: --routers URL[,URL...] (both tiers take
+            endpoint LISTS — --coord for the coordination group,
+            --routers for the router tier). Reads one JSON request per
+            line ({"feeds": {name: rows}[, "deadline_s": S]}), rotates
+            on connection error/5xx and replays idempotently by
+            request token, writes one JSON line per result.
+
+Each daemon prints ONE JSON line with its address once serving
+(orchestrators parse it), then runs until SIGTERM/SIGINT.
 
 ``--coord`` accepts a comma-joined endpoint LIST when the coordination
 plane is a replicated coordsvc group (``--peers`` mode): members fail
@@ -31,11 +55,18 @@ mid-deploy costs the fleet nothing.
 Usage:
   python tools/servingsvc.py replica --coord HOST:PORT[,HOST:PORT...]
          --n-replicas N --replica-id I --artifact DIR [--port P]
-         [--no-warmup] [--max-in-flight M] [--deadline-s S]
+         [--n-routers R] [--group-size G] [--no-warmup]
+         [--max-in-flight M] [--deadline-s S]
   python tools/servingsvc.py router --coord HOST:PORT[,HOST:PORT...]
-         --n-replicas N [--port P] [--max-batch B]
-         [--batch-deadline-s S] [--max-queue Q]
-         [--request-deadline-s S]
+         --n-replicas N [--router-id I --n-routers R] [--port P]
+         [--max-batch B] [--batch-deadline-s S] [--max-queue Q]
+         [--request-deadline-s S] [--autoscale
+          --spawn-template 'python tools/servingsvc.py replica
+          --coord {coord} --n-replicas N --n-routers R
+          --replica-id {replica_id} --group-size {group_size}
+          --artifact DIR' [--autoscale-max M] ...]
+  python tools/servingsvc.py client --routers URL[,URL...]
+         [--deadline-s S]
 """
 import argparse
 import json
@@ -44,13 +75,77 @@ import sys
 import threading
 
 
-def _serve_until_signal(member, line):
+def _serve_until_signal(member, line, cleanup=None):
     print(json.dumps(line), flush=True)
     stop = threading.Event()
     for sig in (signal.SIGTERM, signal.SIGINT):
         signal.signal(sig, lambda *_: stop.set())
     stop.wait()
+    if cleanup is not None:
+        cleanup()
     member.close()
+    return 0
+
+
+def _template_spawner(template, coord):
+    """Build the autoscaler's spawner from a command template with
+    {replica_id}/{group_size}/{coord} placeholders. Spawned processes
+    are tracked by replica id so ``spawn.stop`` (the autoscaler's
+    stopper) can reap a drained, resized-away replica — without it a
+    shrink leaves the process's HTTP listener and heartbeat thread
+    running until router shutdown — and announced as one JSON line
+    each so orchestrators/tests can adopt them."""
+    import shlex
+    import subprocess
+    procs = []
+    by_id = {}
+
+    def spawn(replica_id, group_size):
+        cmd = [a.format(replica_id=replica_id, group_size=group_size,
+                        coord=coord) for a in shlex.split(template)]
+        p = subprocess.Popen(cmd)
+        procs.append(p)
+        by_id[int(replica_id)] = p
+        print(json.dumps({"kind": "autoscale_spawn", "pid": p.pid,
+                          "replica_id": replica_id,
+                          "group_size": group_size}), flush=True)
+        return p
+
+    def stop(replica_id):
+        p = by_id.pop(int(replica_id), None)
+        if p is None or p.poll() is not None:
+            return
+        p.terminate()
+        try:
+            p.wait(timeout=5.0)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            p.wait()
+        print(json.dumps({"kind": "autoscale_stop", "pid": p.pid,
+                          "replica_id": replica_id}), flush=True)
+
+    spawn.procs = procs
+    spawn.stop = stop
+    return spawn
+
+
+def _client_main(args):
+    from paddle_tpu.serving_fleet import FleetClient
+    client = FleetClient(args.routers,
+                         request_deadline_s=args.deadline_s)
+    for line in sys.stdin:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            req = json.loads(line)
+            out = client.infer(req["feeds"],
+                               deadline_s=req.get("deadline_s"))
+            out = dict(out, ok=True)
+        except Exception as e:   # noqa: BLE001 - reported on the wire
+            out = {"ok": False, "error": str(e),
+                   "kind": type(e).__name__}
+        print(json.dumps(out), flush=True)
     return 0
 
 
@@ -69,6 +164,13 @@ def main(argv=None):
                     help="artifact dir (holds serving/)")
     rp.add_argument("--port", type=int, default=0)
     rp.add_argument("--host", default="127.0.0.1")
+    rp.add_argument("--n-routers", type=int, default=1,
+                    help="router-tier size (group = replicas + "
+                         "routers [+ grown slots])")
+    rp.add_argument("--group-size", type=int, default=None,
+                    help="the group's CURRENT total size — required "
+                         "for a replica spawned into a GROWN slot "
+                         "(id above the router range)")
     rp.add_argument("--no-warmup", dest="warmup", action="store_false")
     rp.add_argument("--max-in-flight", type=int, default=None)
     rp.add_argument("--deadline-s", type=float, default=None)
@@ -76,9 +178,13 @@ def main(argv=None):
     rp.add_argument("--hb-interval-s", type=float, default=0.25)
     rp.add_argument("--join-timeout-s", type=float, default=30.0)
 
-    ro = sub.add_parser("router", help="the fleet router")
+    ro = sub.add_parser("router", help="one fleet router (run "
+                        "--n-routers of these for the HA tier)")
     ro.add_argument("--coord", required=True)
     ro.add_argument("--n-replicas", type=int, required=True)
+    ro.add_argument("--router-id", type=int, default=0)
+    ro.add_argument("--n-routers", type=int, default=1)
+    ro.add_argument("--group-size", type=int, default=None)
     ro.add_argument("--port", type=int, default=0)
     ro.add_argument("--host", default="127.0.0.1")
     ro.add_argument("--max-batch", type=int, default=8)
@@ -88,8 +194,30 @@ def main(argv=None):
     ro.add_argument("--ctl-interval-s", type=float, default=0.1)
     ro.add_argument("--hb-interval-s", type=float, default=0.25)
     ro.add_argument("--join-timeout-s", type=float, default=30.0)
+    ro.add_argument("--autoscale", action="store_true",
+                    help="arm the leader-gated replica autoscaler")
+    ro.add_argument("--spawn-template", default=None,
+                    help="command template for grown replicas; "
+                         "placeholders {replica_id} {group_size} "
+                         "{coord}")
+    ro.add_argument("--autoscale-min", type=int, default=None)
+    ro.add_argument("--autoscale-max", type=int, default=None)
+    ro.add_argument("--autoscale-interval-s", type=float, default=0.25)
+    ro.add_argument("--autoscale-window", type=int, default=8)
+    ro.add_argument("--autoscale-queue-depth", type=float, default=4.0)
+    ro.add_argument("--autoscale-shed-rate", type=float, default=0.05)
+    ro.add_argument("--autoscale-hysteresis", type=int, default=3)
+    ro.add_argument("--autoscale-cooldown-s", type=float, default=5.0)
+
+    cl = sub.add_parser("client", help="stdin/stdout failover client")
+    cl.add_argument("--routers", required=True,
+                    help="comma-joined router endpoint list (URLs or "
+                         "host:port)")
+    cl.add_argument("--deadline-s", type=float, default=10.0)
 
     args = ap.parse_args(argv)
+    if args.cmd == "client":
+        return _client_main(args)
     if args.cmd == "replica":
         from paddle_tpu.serving_fleet import ReplicaMember
         member = ReplicaMember(
@@ -99,12 +227,14 @@ def main(argv=None):
             deadline_s=args.deadline_s,
             ctl_interval_s=args.ctl_interval_s,
             hb_interval_s=args.hb_interval_s,
-            join_timeout_s=args.join_timeout_s).start()
+            join_timeout_s=args.join_timeout_s,
+            n_routers=args.n_routers,
+            group_size=args.group_size).start()
         return _serve_until_signal(
             member, {"kind": "replica", "replica_id": args.replica_id,
                      "addr": member.address,
                      "generation": member.generation})
-    from paddle_tpu.serving_fleet import FleetRouter
+    from paddle_tpu.serving_fleet import Autoscaler, FleetRouter
     router = FleetRouter(
         args.coord, args.n_replicas, port=args.port, host=args.host,
         max_batch=args.max_batch,
@@ -113,10 +243,36 @@ def main(argv=None):
         request_deadline_s=args.request_deadline_s,
         ctl_interval_s=args.ctl_interval_s,
         hb_interval_s=args.hb_interval_s,
-        join_timeout_s=args.join_timeout_s).start()
+        join_timeout_s=args.join_timeout_s,
+        router_id=args.router_id, n_routers=args.n_routers,
+        group_size=args.group_size).start()
+    auto, spawner = None, None
+    if args.autoscale:
+        if args.spawn_template:
+            spawner = _template_spawner(args.spawn_template, args.coord)
+        auto = Autoscaler(
+            router, spawner=spawner,
+            stopper=spawner.stop if spawner is not None else None,
+            min_replicas=args.autoscale_min,
+            max_replicas=args.autoscale_max,
+            interval_s=args.autoscale_interval_s,
+            window=args.autoscale_window,
+            grow_queue_depth=args.autoscale_queue_depth,
+            grow_shed_rate=args.autoscale_shed_rate,
+            hysteresis=args.autoscale_hysteresis,
+            cooldown_s=args.autoscale_cooldown_s).start()
+
+    def cleanup():
+        if auto is not None:
+            auto.close()
+        for p in (spawner.procs if spawner is not None else ()):
+            if p.poll() is None:
+                p.terminate()
+
     return _serve_until_signal(
-        router, {"kind": "router", "addr": router.address,
-                 "url": router.url})
+        router, {"kind": "router", "router_id": args.router_id,
+                 "addr": router.address, "url": router.url},
+        cleanup=cleanup)
 
 
 if __name__ == "__main__":
