@@ -102,34 +102,91 @@ def _probe_backend_subprocess(timeout):
     (the plugin holds its init lock forever), so retrying in-process after
     a hang can never succeed.  A subprocess probe leaves THIS process's
     jax un-imported until a probe reports the fabric healthy.  Returns
-    (platforms, error)."""
+    (platforms, error, transient, timeline) — ``timeline`` is the
+    per-phase attach triage record (see _attach_timeline)."""
     import subprocess
     # The axon sitecustomize forces jax_platforms at import, overriding the
     # JAX_PLATFORMS env var — apply the env var via config.update so an
     # explicit JAX_PLATFORMS=cpu (tests) actually probes CPU.
-    code = ("import os, jax, json;"
+    # Each phase is stamped (flushed — a hang must not trap the stamps
+    # in a block buffer) so a TimeoutExpired's partial stdout still
+    # shows WHICH phase hung: the r3-r5 rounds said only "fabric hang",
+    # never whether the plugin import or the jax.devices() device
+    # enumeration was the wedge.
+    code = ("import os, time, json;"
+            "st=lambda p: print('PHASE:'+json.dumps"
+            "({'phase': p, 't': time.time()}), flush=True);"
+            "st('spawned');"
+            "import jax;"
+            "st('backend_import');"
             "p=os.environ.get('JAX_PLATFORMS');"
             "p and jax.config.update('jax_platforms', p);"
+            "d=jax.devices();"
+            "st('devices');"
             "print('PLATFORMS:'+json.dumps("
-            "sorted({d.platform for d in jax.devices()})))")
+            "sorted({x.platform for x in d})))")
+    t_spawn = time.time()
     try:
         proc = subprocess.run(
             [sys.executable, "-c", code], text=True, timeout=timeout,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
-    except subprocess.TimeoutExpired:
-        # a hang can be a transient fabric wedge — worth retrying
+    except subprocess.TimeoutExpired as e:
+        # a hang can be a transient fabric wedge — worth retrying; the
+        # partial stdout carries every phase stamp that DID land
+        timeline = _attach_timeline(t_spawn, e.stdout or "",
+                                    timeout, hung=True)
         return None, "backend init exceeded %.0fs (fabric hang)" % timeout, \
-            True
+            True, timeline
     except Exception as e:  # pragma: no cover
-        return None, "probe subprocess failed: %r" % (e,), False
+        return None, "probe subprocess failed: %r" % (e,), False, None
+    timeline = _attach_timeline(t_spawn, proc.stdout, timeout,
+                                hung=False)
     for ln in proc.stdout.splitlines():
         if ln.startswith("PLATFORMS:"):
-            return json.loads(ln[len("PLATFORMS:"):]), None, False
+            return json.loads(ln[len("PLATFORMS:"):]), None, False, \
+                timeline
     # an instant nonzero exit (import error, broken plugin) is
     # deterministic — retrying until the deadline would only delay the
     # error headline by ~15 minutes
     return None, ("backend init failed rc=%d: %s"
-                  % (proc.returncode, proc.stdout.strip()[-300:])), False
+                  % (proc.returncode, proc.stdout.strip()[-300:])), \
+        False, timeline
+
+
+# the probe's phase order — _attach_timeline names the first missing
+# one as the hang site
+_PROBE_PHASES = ("spawned", "backend_import", "devices")
+
+
+def _attach_timeline(t_spawn, stdout, timeout_s, hung):
+    """The attach triage record the headline carries next to
+    attach_verdict: per-phase seconds since the probe subprocess was
+    spawned (subprocess spawn -> python up -> jax/plugin import ->
+    jax.devices() return), plus which phase a hang died inside. The
+    next fabric-hang round then shows WHETHER the wedge is plugin
+    import or device enumeration — the attribution ROADMAP's
+    cross-cutting blocker has been missing."""
+    stamps = {}
+    if isinstance(stdout, bytes):
+        # TimeoutExpired carries the partial capture as bytes on some
+        # interpreter versions even under text=True
+        stdout = stdout.decode("utf-8", "replace")
+    for ln in (stdout or "").splitlines():
+        if ln.startswith("PHASE:"):
+            try:
+                d = json.loads(ln[len("PHASE:"):])
+                stamps[d["phase"]] = round(float(d["t"]) - t_spawn, 3)
+            except (ValueError, KeyError, TypeError):
+                continue
+    missing = [p for p in _PROBE_PHASES if p not in stamps]
+    timeline = {"phases": {p: stamps[p] for p in _PROBE_PHASES
+                           if p in stamps},
+                "probe_timeout_s": timeout_s}
+    if hung:
+        # the hang lives between the last stamped phase and the first
+        # missing one: name the missing one (what never returned)
+        timeline["hung_phase"] = missing[0] if missing else "report"
+    return timeline
 
 
 def _probe_backend(timeout=_PROBE_TIMEOUT_S):
@@ -140,27 +197,32 @@ def _probe_backend(timeout=_PROBE_TIMEOUT_S):
     whole measurement window.  Budget: leave _MEASURE_RESERVE_S of the
     global deadline for the actual measurement once the fabric answers.
 
-    Returns ``(platforms, err, verdict)`` where verdict classifies the
-    attach for the headline JSON: ``"ok"``, ``"hang"`` (every bounded
-    probe timed out — the r3–r5 fabric symptom, the chip MAY be healthy
-    next round) or ``"error"`` (deterministic init failure — plugin or
-    environment, retrying won't help)."""
+    Returns ``(platforms, err, verdict, timeline)`` where verdict
+    classifies the attach for the headline JSON: ``"ok"``, ``"hang"``
+    (every bounded probe timed out — the r3–r5 fabric symptom, the chip
+    MAY be healthy next round) or ``"error"`` (deterministic init
+    failure — plugin or environment, retrying won't help); ``timeline``
+    is the LAST probe attempt's per-phase triage record (plus the
+    attempt count), stamped into the headline next to attach_verdict."""
     attempt = 0
     while True:
         attempt += 1
         _STATE["stage"] = "backend-probe-%d" % attempt
-        platforms, err, transient = _probe_backend_subprocess(timeout)
+        platforms, err, transient, timeline = \
+            _probe_backend_subprocess(timeout)
+        if timeline is not None:
+            timeline["attempt"] = attempt
         if err is None:
             sys.stderr.write("backend probe %d: ok\n" % attempt)
-            return platforms, None, "ok"
+            return platforms, None, "ok", timeline
         remaining = _DEADLINE_S - _elapsed()
         sys.stderr.write("backend probe %d failed (%s); %.0fs to deadline\n"
                          % (attempt, err, remaining))
         if not transient:
-            return None, err, "error"
+            return None, err, "error", timeline
         if remaining < _MEASURE_RESERVE_S + timeout:
             return None, "%s after %d probe attempts" % (err, attempt), \
-                "hang"
+                "hang", timeline
         time.sleep(min(30.0 * attempt, 120.0,
                        max(remaining - _MEASURE_RESERVE_S - timeout, 0)))
 
@@ -959,7 +1021,7 @@ def run_all():
     # isolation exists precisely because plugin discovery in THIS process
     # can wedge on a sick fabric with no way to retry.
     _STATE["stage"] = "backend-probe"
-    platforms, err, attach_verdict = _probe_backend()
+    platforms, err, attach_verdict, attach_timeline = _probe_backend()
     if err is not None:
         # never again a zero-signal round: the CPU microbench suite
         # ships a perf verdict as a secondary line by DEFAULT (r3–r5
@@ -976,6 +1038,7 @@ def run_all():
                 micro_ok = True
         head = json.loads(_error_headline(err))
         head["attach_verdict"] = attach_verdict
+        head["attach_timeline"] = attach_timeline
         head["micro_fallback"] = micro_ok
         _STATE["headline"] = json.dumps(head)
         _flush_and_exit(0)
@@ -997,10 +1060,12 @@ def run_all():
     try:
         head = json.loads(measure_headline())
         head["attach_verdict"] = attach_verdict
+        head["attach_timeline"] = attach_timeline
         _STATE["headline"] = json.dumps(head)
     except Exception as e:
         head = json.loads(_error_headline("headline failed: %r" % (e,)))
         head["attach_verdict"] = attach_verdict
+        head["attach_timeline"] = attach_timeline
         _STATE["headline"] = json.dumps(head)
         _flush_and_exit(0)
 

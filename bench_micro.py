@@ -42,6 +42,11 @@ surfaces that move on every PR, on JAX_PLATFORMS=cpu, in seconds:
                              load (2 in-process replicas, continuous
                              micro-batching) — the serving-path
                              regression canary
+  * obs_*                  — tracing-overhead gate: the same dp step
+                             and router request measured spans-off vs
+                             spans-on (median ratio) plus the per-span
+                             record cost — the obs layer must never
+                             silently tax a hot path
 
 Output contract: ONE JSON line (dict with "metric": "bench_micro" and a
 "metrics" sub-dict). tests/test_bench_micro.py re-runs the suite
@@ -133,6 +138,15 @@ BUDGETS = {
     # replay). Dominated by the client's per-rotation backoff, not
     # the heartbeat deadline — leadership can lag, routing cannot.
     "router_failover_ms": ("max", 15000.0),
+    # obs tracing overhead (the spans tentpole's tier-1 gate): the
+    # SAME dp step / router request measured spans-off vs spans-on as
+    # a median-of-N ratio, plus the absolute per-span record cost.
+    # The layer must be ~free — a ratio creeping past the margin means
+    # tracing started taxing the hot path (the budget is sized for
+    # shared-CI noise on ~ms walls, not single-digit drift)
+    "obs_step_overhead_ratio": ("max", 1.75),
+    "obs_router_overhead_ratio": ("max", 1.75),
+    "obs_span_record_us": ("max", 200.0),
     # pipeline-parallel CompiledProgram step on the pp=2 x dp=4 CPU
     # mesh (1F1B, M=4 microbatches): step wall catches a lowering
     # blowup; the MEASURED bubble fraction (per-tick cost fitted from
@@ -772,6 +786,143 @@ def bench_pipeline(steps=4):
     return out
 
 
+def bench_obs(steps=11, requests=21):
+    """Tracing-overhead gate (the obs spans tentpole): the exact same
+    dp-sharded executor step and router /infer request measured
+    spans-OFF then spans-ON — median walls and their ratio — plus the
+    absolute cost of recording one span. The obs layer sits on every
+    hot path (executor dispatch, router intake, coordination rounds),
+    so this section is what keeps it from ever silently taxing them:
+    the ratios are BUDGETS-gated in tier-1."""
+    import numpy as np
+    import jax
+    import paddle_tpu as pt
+    from paddle_tpu import layers
+    from paddle_tpu.framework import obs
+    from paddle_tpu.framework.compiler import CompiledProgram, \
+        BuildStrategy
+    from paddle_tpu.framework.scope import Scope, scope_guard
+    from paddle_tpu.framework.transport import CoordServer
+    from paddle_tpu.serving_fleet import (FleetRouter, ReplicaMember,
+                                          http_json)
+    import shutil
+    import tempfile
+
+    was_enabled = obs.enabled()
+    out = {}
+
+    def median(walls):
+        walls = sorted(walls)
+        return walls[len(walls) // 2]
+
+    try:
+        # -- executor leg: dp CompiledProgram step ----------------------
+        n_dev = min(8, len(jax.devices()))
+        feed = _batch(np.random.RandomState(0), n=2 * n_dev)
+        with scope_guard(Scope()):
+            main, startup, loss = _build_train()
+            exe = pt.Executor()
+            exe.run(startup)
+            bs = BuildStrategy()
+            bs.mesh_axes = {"dp": n_dev}
+            comp = CompiledProgram(main, bs)
+            exe.run(comp, feed=feed, fetch_list=[loss])   # compile+warm
+
+            def step_walls():
+                walls = []
+                for _ in range(steps):
+                    t0 = time.perf_counter()
+                    exe.run(comp, feed=feed, fetch_list=[loss])
+                    walls.append(time.perf_counter() - t0)
+                return median(walls)
+
+            obs.disable()
+            off = step_walls()
+            obs.enable()
+            on = step_walls()
+            obs.disable()
+            obs.clear()
+        out["obs_step_off_s"] = round(off, 5)
+        out["obs_step_on_s"] = round(on, 5)
+        out["obs_step_overhead_ratio"] = round(
+            on / off if off > 0 else 1.0, 4)
+
+        # -- span record microcost -------------------------------------
+        obs.enable()
+        n = 2000
+        t0 = time.perf_counter()
+        for _ in range(n):
+            with obs.span("bench.noop", k=1):
+                pass
+        dt = time.perf_counter() - t0
+        obs.disable()
+        obs.clear()
+        out["obs_span_record_us"] = round(dt / n * 1e6, 3)
+
+        # -- router leg: one replica + router, sequential requests -----
+        tmp = tempfile.mkdtemp(prefix="paddle_tpu_bench_obs_")
+        members = []
+        try:
+            with scope_guard(Scope()):
+                main, startup = pt.Program(), pt.Program()
+                with pt.program_guard(main, startup):
+                    x = layers.data("x", [8], dtype="float32")
+                    y = layers.softmax(layers.fc(x, 4))
+                exe = pt.Executor()
+                exe.run(startup)
+                pt.save_inference_model(tmp, ["x"], [y], exe,
+                                        main_program=main,
+                                        format="stablehlo",
+                                        batch_sizes=(8,))
+            srv = CoordServer(2, hb_deadline_s=5.0).start()
+            members.append(srv)
+            members.append(ReplicaMember(tmp, srv.address, 1, 0,
+                                         ctl_interval_s=0.25,
+                                         hb_interval_s=0.25).start())
+            router = FleetRouter(srv.address, 1, max_batch=8,
+                                 batch_deadline_s=0.001,
+                                 ctl_interval_s=0.25,
+                                 hb_interval_s=0.25,
+                                 poll_interval_s=0.05).start()
+            members.append(router)
+            deadline = time.monotonic() + 10.0
+            while not router.routable() \
+                    and time.monotonic() < deadline:
+                time.sleep(0.02)
+            xv = np.ones((2, 8), np.float32).tolist()
+
+            def request_walls():
+                walls = []
+                for _ in range(requests):
+                    t0 = time.perf_counter()
+                    status, _ = http_json("POST",
+                                          router.url + "/infer",
+                                          {"feeds": {"x": xv}},
+                                          timeout_s=10.0)
+                    walls.append(time.perf_counter() - t0)
+                    assert status == 200, status
+                return median(walls)
+
+            request_walls()               # warm the serving path
+            obs.disable()
+            r_off = request_walls()
+            obs.enable()
+            r_on = request_walls()
+            obs.disable()
+            obs.clear()
+        finally:
+            for m in reversed(members):
+                m.close()
+            shutil.rmtree(tmp, ignore_errors=True)
+        out["obs_router_off_ms"] = round(r_off * 1e3, 3)
+        out["obs_router_on_ms"] = round(r_on * 1e3, 3)
+        out["obs_router_overhead_ratio"] = round(
+            r_on / r_off if r_off > 0 else 1.0, 4)
+    finally:
+        (obs.enable if was_enabled else obs.disable)()
+    return out
+
+
 # ---------------------------------------------------------------------------
 # round trend tracking
 # ---------------------------------------------------------------------------
@@ -853,7 +1004,8 @@ def run_all(rounds_dir=None):
                      ("transport", bench_transport),
                      ("failover", bench_failover),
                      ("serving", bench_serving),
-                     ("router_failover", bench_router_failover)):
+                     ("router_failover", bench_router_failover),
+                     ("obs", bench_obs)):
         t0 = time.perf_counter()
         try:
             metrics.update(fn())

@@ -9,6 +9,7 @@ from .backward import append_backward, gradients  # noqa
 from .compiler import CompiledProgram, BuildStrategy, ExecutionStrategy  # noqa
 from . import unique_name  # noqa
 from . import watchdog  # noqa
+from . import obs  # noqa
 from . import resilience  # noqa
 from . import coordination  # noqa
 from . import transport  # noqa

@@ -43,6 +43,7 @@ import collections
 import threading
 import time
 
+from . import obs
 from . import resilience
 from .resilience import RestartBudgetExceededError, record_event
 
@@ -238,6 +239,13 @@ class Coordinator(object):
         Returns the agreed sync value, or None when the joiner died
         between announcing and the barrier (it is re-fenced by the
         barrier timeout and the admission is abandoned)."""
+        with obs.span("coord.admit", joined=joined, host=host_id,
+                      enact=bool(enact)):
+            return self._admit_traced(host_id, joined, nonce, value,
+                                      name, timeout_s, enact, poll_s)
+
+    def _admit_traced(self, host_id, joined, nonce, value, name,
+                      timeout_s, enact, poll_s):
         if enact:
             self.unfence(joined)
         else:
@@ -268,26 +276,28 @@ class Coordinator(object):
         Returns the survivors' agreed sync value. Raises
         BarrierTimeoutError when no admission lands in time (the host
         stays fenced — escalate to the orchestrator)."""
-        deadline = time.monotonic() + (self.timeout_s if timeout_s is None
-                                       else float(timeout_s))
-        while host_id in self.lost_hosts():
-            if time.monotonic() >= deadline:
-                raise BarrierTimeoutError(
-                    "host %d announced a rejoin but was not admitted in "
-                    "time — survivors may be mid-recovery or gone"
-                    % host_id)
-            time.sleep(poll_s)
-        round_name = "%s:h%d:n%d" % (name, host_id, nonce)
-        got = self.all_gather(round_name, host_id, None,
-                              timeout_s=timeout_s)
-        values = [v for v in got.values() if v is not None]
-        if not values:
-            raise CoordinationError(
-                "admission round %r carried no sync value from any "
-                "survivor" % round_name)
-        sync = max(values)
-        self._on_join([host_id], nonce, sync)
-        return sync
+        with obs.span("coord.join", host=host_id):
+            deadline = time.monotonic() + (
+                self.timeout_s if timeout_s is None
+                else float(timeout_s))
+            while host_id in self.lost_hosts():
+                if time.monotonic() >= deadline:
+                    raise BarrierTimeoutError(
+                        "host %d announced a rejoin but was not "
+                        "admitted in time — survivors may be "
+                        "mid-recovery or gone" % host_id)
+                time.sleep(poll_s)
+            round_name = "%s:h%d:n%d" % (name, host_id, nonce)
+            got = self.all_gather(round_name, host_id, None,
+                                  timeout_s=timeout_s)
+            values = [v for v in got.values() if v is not None]
+            if not values:
+                raise CoordinationError(
+                    "admission round %r carried no sync value from "
+                    "any survivor" % round_name)
+            sync = max(values)
+            self._on_join([host_id], nonce, sync)
+            return sync
 
     def _on_join(self, joined, nonce, sync):
         """Fan out an admission exactly once per coordinator object:
@@ -971,7 +981,17 @@ class SocketCoordinator(Coordinator):
                                    retry_policy=retry_policy)
         # hello validates the pod size before anything else rides the
         # connection; the heartbeat (when armed) then takes the lease
-        self._call("hello", n_hosts=self.n_hosts)
+        with obs.span("coord.hello", host=self.host_id):
+            self._call("hello", n_hosts=self.n_hosts)
+        if obs.enabled():
+            # align this process's span timestamps to the coordination
+            # server's clock (min-RTT midpoint probe) — what lets one
+            # merged timeline order spans across hosts. Best-effort:
+            # an old server without the `time` op changes nothing.
+            try:
+                obs.probe_clock_offset(lambda cmd: self._call(cmd))
+            except Exception:
+                pass
         if heartbeat:
             self._client.start_heartbeat(interval_s=hb_interval_s,
                                          on_lost=self._observe_lost)
@@ -1097,6 +1117,15 @@ class SocketCoordinator(Coordinator):
         return self.n_hosts
 
     def all_gather(self, name, host_id, value=None, timeout_s=None):
+        # the span covers put + poll-to-freeze + ack: the whole
+        # barrier WAIT, which is exactly what makes an elastic window
+        # barrier attributable (compute vs coordination) on a merged
+        # timeline
+        with obs.span("coord.gather", round=name, host=host_id):
+            return self._all_gather_traced(name, host_id, value,
+                                           timeout_s)
+
+    def _all_gather_traced(self, name, host_id, value, timeout_s):
         deadline = time.monotonic() + (self.timeout_s if timeout_s is None
                                        else float(timeout_s))
         with self._known_lock:

@@ -25,6 +25,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from . import obs
 from . import resilience
 from . import trace as trace_mod
 from . import watchdog
@@ -216,7 +217,22 @@ class Executor(object):
                                               what="Executor.run")
             return out
 
-        # ---- prepare state ------------------------------------------------
+        # ---- the jitted single-step path ---------------------------------
+        # phase spans (exec.step > compile/execute/writeback) + the
+        # always-on executor_step_seconds{kind=} histograms — the obs
+        # layer's executor leg
+        with obs.span("exec.step", entry="run") as sp:
+            out = self._run_jitted(program, feed, fetch_names, scope,
+                                   return_numpy, use_program_cache,
+                                   strategy, sp)
+        if det_t0 is not None:
+            watchdog.observe_step_latency(time.perf_counter() - det_t0,
+                                          what="Executor.run")
+        return out
+
+    def _run_jitted(self, program, feed, fetch_names, scope,
+                    return_numpy, use_program_cache, strategy, sp):
+        t_total = time.perf_counter()
         state_names, uses_rng = self._prepare_state(program, feed, scope)
         feed_vals = self._convert_feed(program, feed)
         check_numerics = bool(
@@ -229,35 +245,51 @@ class Executor(object):
         entry = self._cache.get(key) if use_program_cache else None
         if entry is None:
             self.cache_misses += 1
-            entry = self._compile(program, feed_vals, fetch_names,
-                                  state_names, uses_rng, strategy,
-                                  check_numerics)
+            sp.set(cache="miss")
+            t0 = time.perf_counter()
+            with obs.span("exec.compile"):
+                entry = self._compile(program, feed_vals, fetch_names,
+                                      state_names, uses_rng, strategy,
+                                      check_numerics)
+            resilience.observe_executor_step(
+                "compile", time.perf_counter() - t0)
             if use_program_cache:
                 self._cache[key] = entry
         else:
             self.cache_hits += 1
+            sp.set(cache="hit")
         step_fn = entry
 
         state_vals = tuple(scope.find_var(n) for n in state_names)
         feed_tuple = tuple(feed_vals[k] for k in sorted(feed_vals))
-        if check_numerics:
-            fetches, new_state, finite = step_fn(state_vals, feed_tuple)
-            if not bool(np.asarray(finite)):
-                # write the new state back first: the inputs were donated,
-                # so leaving the scope pointing at them would poison every
-                # later run for callers that catch this to inspect/resume
-                self._writeback(scope, state_names, new_state, (), False)
-                raise FloatingPointError(
-                    "check_numerics: non-finite value (NaN/Inf) detected "
-                    "in fetches or updated state of this step (reference "
-                    "parity: check_nan_inf)")
-        else:
-            fetches, new_state = step_fn(state_vals, feed_tuple)
-        out = self._writeback(scope, state_names, new_state, fetches,
-                              return_numpy)
-        if det_t0 is not None:
-            watchdog.observe_step_latency(time.perf_counter() - det_t0,
-                                          what="Executor.run")
+        t0 = time.perf_counter()
+        with obs.span("exec.execute"):
+            if check_numerics:
+                fetches, new_state, finite = step_fn(state_vals,
+                                                     feed_tuple)
+                if not bool(np.asarray(finite)):
+                    # write the new state back first: the inputs were
+                    # donated, so leaving the scope pointing at them
+                    # would poison every later run for callers that
+                    # catch this to inspect/resume
+                    self._writeback(scope, state_names, new_state, (),
+                                    False)
+                    raise FloatingPointError(
+                        "check_numerics: non-finite value (NaN/Inf) "
+                        "detected in fetches or updated state of this "
+                        "step (reference parity: check_nan_inf)")
+            else:
+                fetches, new_state = step_fn(state_vals, feed_tuple)
+        resilience.observe_executor_step(
+            "execute", time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        with obs.span("exec.writeback"):
+            out = self._writeback(scope, state_names, new_state, fetches,
+                                  return_numpy)
+        resilience.observe_executor_step(
+            "writeback", time.perf_counter() - t0)
+        resilience.observe_executor_step(
+            "total", time.perf_counter() - t_total)
         return out
 
     @staticmethod
@@ -341,6 +373,18 @@ class Executor(object):
             return _observe(self._run_compiled_pp(
                 strategy, program, feed, fetch_names, scope, return_numpy,
                 windowed=True))
+        # one exec.step parent per window — the run() path's grouping,
+        # so the window's compile/execute/writeback phases share one
+        # trace even when no ambient span is open around the caller
+        with obs.span("exec.step", entry="run_steps",
+                      steps=n_steps) as sp:
+            return _observe(self._run_steps_jitted(
+                program, strategy, feed, fetch_names, scope,
+                return_numpy, use_program_cache, n_steps, sp))
+
+    def _run_steps_jitted(self, program, strategy, feed, fetch_names,
+                          scope, return_numpy, use_program_cache,
+                          n_steps, sp):
         staged = self._convert_feed(program, feed, steps_axis=True)
 
         check_numerics = bool(
@@ -352,11 +396,16 @@ class Executor(object):
                _feed_signature(staged), tuple(fetch_names),
                tuple(state_names), check_numerics, "scan",
                None if strategy is None else strategy._cache_token())
+        t_total = time.perf_counter()
         fn = self._cache.get(key) if use_program_cache else None
         if fn is not None:
             self.cache_hits += 1
+            sp.set(cache="hit")
         else:
             self.cache_misses += 1
+            sp.set(cache="miss")
+            t_compile = time.perf_counter()
+            w_compile = obs.now()
             base_step = self._make_step(program, sorted(staged),
                                         fetch_names, state_names, uses_rng,
                                         check_numerics)
@@ -383,9 +432,16 @@ class Executor(object):
                         return jitted(state_vals, feed_tuple)
             if use_program_cache:
                 self._cache[key] = fn
+            resilience.observe_executor_step(
+                "compile", time.perf_counter() - t_compile)
+            obs.record("exec.compile", w_compile, obs.now())
         state_vals = tuple(scope.find_var(n) for n in state_names)
         feed_tuple = tuple(staged[k] for k in sorted(staged))
-        ys, new_state = fn(state_vals, feed_tuple)
+        t_exec = time.perf_counter()
+        with obs.span("exec.execute"):
+            ys, new_state = fn(state_vals, feed_tuple)
+        resilience.observe_executor_step(
+            "execute", time.perf_counter() - t_exec)
         if check_numerics:
             finite = np.asarray(ys[1])
             if not finite.all():
@@ -401,8 +457,15 @@ class Executor(object):
                     "check_numerics: non-finite value (NaN/Inf) first "
                     "detected at step %d of this run_steps window"
                     % int(np.argmin(finite)))
-        return _observe(self._writeback(scope, state_names, new_state,
-                                        ys[0], return_numpy))
+        t_wb = time.perf_counter()
+        with obs.span("exec.writeback"):
+            out = self._writeback(scope, state_names, new_state,
+                                  ys[0], return_numpy)
+        resilience.observe_executor_step(
+            "writeback", time.perf_counter() - t_wb)
+        resilience.observe_executor_step(
+            "total", time.perf_counter() - t_total)
+        return out
 
     # ------------------------------------------------------------------
     def _convert_feed(self, program, feed, steps_axis=False):

@@ -54,7 +54,9 @@ __all__ = [
     "record_router_request", "record_router_retry",
     "observe_router_batch",
     "set_router_queue_depth", "set_router_inflight",
+    "record_router_slow",
     "router_totals", "clear_router",
+    "observe_executor_step", "executor_step_totals", "clear_exec",
 ]
 
 INJECTION_POINTS = ("step", "ckpt_write", "serve")
@@ -180,6 +182,7 @@ def clear_events():
     _LOG.clear()
     clear_bytes()
     clear_router()
+    clear_exec()
 
 
 # ---------------------------------------------------------------------------
@@ -224,6 +227,47 @@ def clear_bytes():
         _BYTES.clear()
 
 
+# Executor step-phase latency (the obs tentpole's always-on metrics
+# half): per-phase cumulative histograms OUTSIDE the event log — steps
+# run at dispatch rate. Kind is the phase ("compile", "execute",
+# "writeback", "total"); buckets span a CPU toy step (~ms) to a cold
+# multi-minute XLA compile.
+EXEC_STEP_BUCKETS = (0.0005, 0.002, 0.01, 0.05, 0.25, 1.0, 5.0, 30.0,
+                     120.0)
+_EXEC = {}
+_EXEC_LOCK = threading.Lock()
+
+
+def observe_executor_step(kind, seconds):
+    """Record one executor step phase's wall time in the
+    ``<prefix>_executor_step_seconds{kind=}`` histogram."""
+    seconds = float(seconds)
+    with _EXEC_LOCK:
+        h = _EXEC.setdefault(
+            str(kind), {"counts": [0] * (len(EXEC_STEP_BUCKETS) + 1),
+                        "sum": 0.0, "count": 0})
+        for i, le in enumerate(EXEC_STEP_BUCKETS):
+            if seconds <= le:
+                h["counts"][i] += 1
+                break
+        else:
+            h["counts"][-1] += 1
+        h["sum"] += seconds
+        h["count"] += 1
+
+
+def executor_step_totals():
+    """{kind: {"counts", "sum", "count"}} snapshot."""
+    with _EXEC_LOCK:
+        return {k: {"counts": list(h["counts"]), "sum": h["sum"],
+                    "count": h["count"]} for k, h in _EXEC.items()}
+
+
+def clear_exec():
+    with _EXEC_LOCK:
+        _EXEC.clear()
+
+
 # Serving-fleet router accounting (serving_fleet.FleetRouter). Same
 # design pressure as the byte counters: the router serves at request
 # rate, and one event per request would evict the whole bounded log in
@@ -243,12 +287,16 @@ _ROUTER_LOCK = threading.Lock()
 ROUTER_BATCH_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128)
 
 
+ROUTER_SLOW_K = 8
+
+
 def _fresh_router_state():
     return {"requests": {},      # (router, outcome) -> count
             "batch": {},         # router -> {"counts", "sum", "count"}
             "queue_depth": {},   # router -> gauge
             "inflight": {},      # (router, replica) -> gauge
-            "retries": {}}       # (router, replica) -> count
+            "retries": {},       # (router, replica) -> count
+            "slow": {}}          # router -> top-K [(latency_s, trace)]
 
 
 _ROUTER = _fresh_router_state()
@@ -299,6 +347,21 @@ def observe_router_batch(size, router=None):
         b["count"] += 1
 
 
+def record_router_slow(latency_s, trace=None, router=None):
+    """Keep this request as a slow-request EXEMPLAR if it makes the
+    router's top-K by latency. Exemplars pair the p99 a histogram can
+    only bound with the trace id that lets an operator pull the exact
+    offending timeline (``tools/traceview.py``) — the classic
+    metrics-to-trace bridge. Exported by :func:`router_totals` as
+    ``slow_requests``."""
+    latency_s = float(latency_s)
+    with _ROUTER_LOCK:
+        top = _ROUTER["slow"].setdefault(_router_key(router), [])
+        top.append((latency_s, None if trace is None else str(trace)))
+        top.sort(key=lambda e: -e[0])
+        del top[ROUTER_SLOW_K:]
+
+
 def set_router_queue_depth(depth, router=None):
     """Update the ``<prefix>_router_queue_depth`` gauge (requests
     waiting to be coalesced into a batch) for ``router``'s series."""
@@ -325,7 +388,9 @@ def router_totals(by_router=False):
     what the Autoscaler reads its own shed rate out of. Taken under
     ONE lock acquisition so the histogram's bucket counts can never
     run ahead of its total (a non-monotonic histogram is invalid to
-    Prometheus consumers)."""
+    Prometheus consumers). ``slow_requests`` carries the top-K
+    slow-request exemplars as ``[{"latency_s", "trace"}]``, worst
+    first (see :func:`record_router_slow`)."""
     with _ROUTER_LOCK:
         requests = dict(_ROUTER["requests"])
         batch = {r: {"counts": list(b["counts"]), "sum": b["sum"],
@@ -334,9 +399,10 @@ def router_totals(by_router=False):
         queue_depth = dict(_ROUTER["queue_depth"])
         inflight = dict(_ROUTER["inflight"])
         retries = dict(_ROUTER["retries"])
+        slow = {r: list(v) for r, v in _ROUTER["slow"].items()}
     routers = (set(r for r, _ in requests) | set(batch)
                | set(queue_depth) | set(r for r, _ in inflight)
-               | set(r for r, _ in retries))
+               | set(r for r, _ in retries) | set(slow))
     out = {}
     for rkey in (sorted(routers, key=lambda r: (r is not None, str(r)))
                  if by_router else [None]):
@@ -350,13 +416,18 @@ def router_totals(by_router=False):
                 b_sum += b["sum"]
                 b_count += b["count"]
         depths = [v for r, v in queue_depth.items() if _mine(r)]
+        merged_slow = sorted(
+            (e for r, top in slow.items() if _mine(r) for e in top),
+            key=lambda e: -e[0])[:ROUTER_SLOW_K]
         ent = {
             "requests": _sum_by(requests, _mine),
             "batch_counts": b_counts, "batch_count": b_count,
             "batch_sum": b_sum,
             "queue_depth": sum(depths) if depths else None,
             "inflight": _sum_by(inflight, _mine),
-            "retries": _sum_by(retries, _mine)}
+            "retries": _sum_by(retries, _mine),
+            "slow_requests": [{"latency_s": lat, "trace": tr}
+                              for lat, tr in merged_slow]}
         if not by_router:
             return ent
         out[rkey] = ent
@@ -641,15 +712,61 @@ def metrics(event_list=None, by_host=False):
     histograms = [_histogram(METRIC_PREFIX + "_restore_latency_seconds",
                              restore_lat, RESTORE_LATENCY_BUCKETS)]
     histograms += router_hists
+    # executor step-phase latency (the obs layer's always-on metrics
+    # half): per-kind histograms from the cumulative process counters —
+    # emitted only for phases that ran, so executor-less jobs export
+    # nothing new
+    for kind, h in sorted(executor_step_totals().items()):
+        if h["count"]:
+            histograms.append(_counts_histogram(
+                METRIC_PREFIX + "_executor_step_seconds",
+                EXEC_STEP_BUCKETS, h["counts"], h["count"], h["sum"],
+                labels={"kind": kind}))
+    # span-ring overflow (obs tentpole): dropped spans mean a merged
+    # timeline is LYING about what happened — exported whenever the
+    # engine is on (0 = trustworthy) or anything was ever dropped, so
+    # serving_probe --strict can gate on it; tracing-off jobs export
+    # nothing new
+    from . import obs
+    if obs.enabled() or obs.dropped_total():
+        counters.append(
+            {"name": METRIC_PREFIX + "_trace_spans_dropped_total",
+             "labels": {}, "value": obs.dropped_total()})
     return {"counters": counters, "gauges": gauges,
             "histograms": histograms}
+
+
+def _escape_label_value(v):
+    """Prometheus exposition escaping for label VALUES: backslash,
+    double quote and newline (in that order — escaping the escape
+    first keeps it reversible). An unescaped quote in, say, a
+    replica-address label would tear the sample line into invalid
+    exposition text that every scraper rejects."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _unescape_label_value(v):
+    out, i = [], 0
+    while i < len(v):
+        c = v[i]
+        if c == "\\" and i + 1 < len(v):
+            nxt = v[i + 1]
+            out.append({"n": "\n", "\\": "\\", '"': '"'}.get(nxt,
+                                                            c + nxt))
+            i += 2
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
 
 
 def _fmt_labels(labels):
     if not labels:
         return ""
-    return "{%s}" % ",".join('%s="%s"' % (k, v)
-                             for k, v in sorted(labels.items()))
+    return "{%s}" % ",".join(
+        '%s="%s"' % (k, _escape_label_value(v))
+        for k, v in sorted(labels.items()))
 
 
 def metrics_text(m=None):
@@ -688,19 +805,27 @@ def parse_metrics_text(text):
     the round-trip half used by tests and by scrapers that want the
     samples without a Prometheus client library."""
     import re
+    # label values are quoted strings with \\, \" and \n escapes (see
+    # _escape_label_value) — the blob/value regexes must track quoting
+    # or a value containing '}' / '"' tears the parse
+    label_val = r'"(?:[^"\\]|\\.)*"'
+    line_re = re.compile(
+        r'^([A-Za-z_:][\w:]*)(\{(?:[^"{}]|%s)*\})?\s+(\S+)$'
+        % label_val)
+    pair_re = re.compile(r'(\w+)=(%s)' % label_val)
     samples = []
     for line in text.splitlines():
         line = line.strip()
         if not line or line.startswith("#"):
             continue
-        m = re.match(r'^([A-Za-z_:][\w:]*)(\{[^}]*\})?\s+(\S+)$', line)
+        m = line_re.match(line)
         if not m:
             raise ValueError("unparsable metrics line: %r" % line)
         name, labelblob, value = m.groups()
         labels = {}
         if labelblob:
-            for part in re.findall(r'(\w+)="([^"]*)"', labelblob):
-                labels[part[0]] = part[1]
+            for k, quoted in pair_re.findall(labelblob):
+                labels[k] = _unescape_label_value(quoted[1:-1])
         samples.append((name, labels, float(value)))
     return samples
 

@@ -1016,6 +1016,12 @@ def _serve(server, state, req):
             return repl.handle_locked(state, req, now)
     if cmd == "status":
         return _serve_status(server, state, now)
+    if cmd == "time":
+        # the obs clock-offset probe (obs.probe_clock_offset): the
+        # server's wall clock, answered statelessly so it works before
+        # the first sized hello and on standbys alike — tracing
+        # alignment must not depend on group membership
+        return {"ok": True, "wall": time.time()}
     hid = req.get("host")
     hid = None if hid is None else int(hid)
     wait_seq = None

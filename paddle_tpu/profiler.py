@@ -2,17 +2,28 @@
 
 Reference parity: python/paddle/fluid/profiler.py — but TPU profiling goes
 through jax.profiler (XPlane traces viewable in TensorBoard/Perfetto).
+
+Rides the framework.obs spans engine as well: ``annotate`` opens an obs
+span alongside the jax TraceAnnotation (so user annotations land BOTH
+inside the XLA trace and on the cross-process obs timeline), and
+``profile_program`` records per-op obs spans — one merged
+``tools/traceview.py`` timeline can therefore show user annotations,
+executor phases, router/replica serving legs and coordination waits
+together, with jax.profiler covering the XLA interior.
 """
 import contextlib
 
 import jax
+
+from .framework import obs
 
 
 @contextlib.contextmanager
 def profiler(state="All", sorted_key=None, profile_path="/tmp/paddle_tpu_profile"):
     jax.profiler.start_trace(profile_path)
     try:
-        yield
+        with obs.span("profiler.trace", path=str(profile_path)):
+            yield
     finally:
         jax.profiler.stop_trace()
 
@@ -33,7 +44,8 @@ def reset_profiler():
 @contextlib.contextmanager
 def annotate(name):
     with jax.profiler.TraceAnnotation(name):
-        yield
+        with obs.span(str(name)):
+            yield
 
 
 def profile_program(program, feed, scope=None, repeat=3, sorted_key="total",
@@ -75,11 +87,12 @@ def profile_program(program, feed, scope=None, repeat=3, sorted_key="total",
         block = program.global_block()
         for i, op in enumerate(block.ops):
             t0 = time.perf_counter()
-            trace_op(op, env, ctx, _rng_tag(block, i))
-            for out_name in op.output_names():
-                v = env.get(out_name)
-                if hasattr(v, "block_until_ready"):
-                    v.block_until_ready()
+            with obs.span("op.%s" % op.type, repeat=rep):
+                trace_op(op, env, ctx, _rng_tag(block, i))
+                for out_name in op.output_names():
+                    v = env.get(out_name)
+                    if hasattr(v, "block_until_ready"):
+                        v.block_until_ready()
             dt = time.perf_counter() - t0
             if rep > 0:  # first pass pays compilation; attribute after
                 totals[op.type] += dt

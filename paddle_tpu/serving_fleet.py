@@ -120,6 +120,7 @@ import threading
 import time
 import zlib
 
+from .framework import obs
 from .framework import resilience
 from .framework.coordination import (CoordinationError, HostLostError,
                                      SocketCoordinator, agreed_pending)
@@ -146,17 +147,21 @@ def router_host_id(n_replicas, router_id=0):
 # tiny JSON-over-HTTP wire helpers (stdlib only)
 # ---------------------------------------------------------------------------
 
-def http_json(method, url, payload=None, timeout_s=10.0):
+def http_json(method, url, payload=None, timeout_s=10.0, headers=None):
     """One JSON request/response round trip. Returns ``(status,
     dict)`` — non-2xx responses are returned, not raised, so callers
     can route on replica-side shed (503) vs deadline (504) vs error.
-    Connection-level failures (dead process, refused) raise OSError."""
+    Connection-level failures (dead process, refused) raise OSError.
+    ``headers`` adds/overrides request headers (the trace-context
+    ``x-trace-id`` rides here)."""
     import urllib.error
     import urllib.request
     data = None if payload is None else json.dumps(payload).encode()
+    hdrs = {"Content-Type": "application/json"}
+    if headers:
+        hdrs.update(headers)
     req = urllib.request.Request(
-        url, data=data, method=method,
-        headers={"Content-Type": "application/json"})
+        url, data=data, method=method, headers=hdrs)
     try:
         with urllib.request.urlopen(req, timeout=timeout_s) as resp:
             body = resp.read().decode() or "{}"
@@ -614,6 +619,8 @@ class ReplicaMember(_FleetMember):
 
     # -- serving surface ---------------------------------------------------
     def _prepare(self):
+        # name this process's span dumps (deployment env wins)
+        obs.set_service("replica%d" % self.replica_id, force=False)
         self._load_predictor(self._artifact_dir, account=False)
         member = self
         import http.server
@@ -636,7 +643,18 @@ class ReplicaMember(_FleetMember):
                     return
                 path = self.path.split("?", 1)[0]
                 if path == "/infer":
-                    status, payload = member._handle_infer(body)
+                    # the serve span adopts the router's (or a direct
+                    # caller's) trace context from the x-trace-id
+                    # header — the replica leg of the one-request
+                    # timeline
+                    tr, parent = obs.parse_header(
+                        self.headers.get("x-trace-id"))
+                    with obs.span("replica.serve", trace_id=tr,
+                                  parent=parent,
+                                  replica=member.replica_id,
+                                  generation=member.generation) as sp:
+                        status, payload = member._handle_infer(body)
+                        sp.set(status=status)
                     self._send(status, payload)
                 elif path == "/admin/refresh":
                     new_dir = body.get("dir")
@@ -665,6 +683,10 @@ class ReplicaMember(_FleetMember):
                     self._send(200, member.health())
                 elif path == "/meta":
                     self._send(200, member.meta())
+                elif path == "/admin/trace":
+                    # live span pull: tools/traceview.py merges these
+                    # across fleet members into one timeline
+                    self._send(200, obs.dump_dict())
                 else:
                     self._send(404, {"error": "try /healthz or /meta"})
 
@@ -929,7 +951,8 @@ class ReplicaMember(_FleetMember):
 
 class _Pending(object):
     __slots__ = ("feeds", "n", "deadline", "enqueued", "event",
-                 "result", "error", "abandoned")
+                 "result", "error", "abandoned", "trace", "span",
+                 "t_enq")
 
     def __init__(self, feeds, n, deadline):
         self.feeds = feeds
@@ -940,6 +963,13 @@ class _Pending(object):
         self.result = None
         self.error = None
         self.abandoned = False
+        # trace context (obs tentpole): the request's trace id, the
+        # router serve span the queue/dispatch child spans parent
+        # under, and the obs-time enqueue stamp the retroactive queue
+        # span starts at — all None while tracing is off
+        self.trace = None
+        self.span = None
+        self.t_enq = None
 
 
 class FleetRouter(_FleetMember):
@@ -1023,6 +1053,7 @@ class FleetRouter(_FleetMember):
 
     # -- lifecycle ---------------------------------------------------------
     def _prepare(self):
+        obs.set_service("router%d" % self.router_id, force=False)
         router = self
         import http.server
 
@@ -1047,7 +1078,9 @@ class FleetRouter(_FleetMember):
                     return
                 path = self.path.split("?", 1)[0]
                 if path == "/infer":
-                    self._send(*router._handle_infer(body))
+                    self._send(*router._handle_infer(
+                        body,
+                        trace_header=self.headers.get("x-trace-id")))
                 elif path == "/admin/deploy":
                     new_dir = body.get("dir")
                     if not new_dir:
@@ -1079,6 +1112,8 @@ class FleetRouter(_FleetMember):
                     self._send(200, None, raw=text.encode())
                 elif path == "/healthz":
                     self._send(200, router.health())
+                elif path == "/admin/trace":
+                    self._send(200, obs.dump_dict())
                 else:
                     self._send(404, {"error": "try /infer, /healthz "
                                      "or /metrics"})
@@ -1475,14 +1510,28 @@ class FleetRouter(_FleetMember):
         """Wait out one pending request and account its terminal
         outcome (``replay`` for a token replay riding the original —
         the caller's view stays one request, the counters stay
-        honest)."""
+        honest). Non-replay completions additionally feed the top-K
+        slow-request exemplars (latency + trace id) that
+        ``router_totals()`` exports — the bridge from a fat p99 to
+        the exact timeline behind it."""
         if not p.event.wait(max(0.0, deadline - time.monotonic())
                             + 0.05):
             p.abandoned = True
             resilience.record_router_request("deadline",
                                              router=self._host_id)
+            if not outcome_replayed:
+                # a token replay waiting out the same _Pending must
+                # not double-spend a top-K exemplar slot on one
+                # logical request
+                resilience.record_router_slow(
+                    time.monotonic() - p.enqueued, trace=p.trace,
+                    router=self._host_id)
             raise DeadlineExceededError(
                 "request did not complete within its deadline")
+        if not outcome_replayed:
+            resilience.record_router_slow(
+                time.monotonic() - p.enqueued, trace=p.trace,
+                router=self._host_id)
         if p.error is not None:
             resilience.record_router_request(
                 "shed" if isinstance(p.error, ServerOverloadedError)
@@ -1501,7 +1550,7 @@ class FleetRouter(_FleetMember):
             while len(self._tokens) > self.TOKEN_CACHE:
                 self._tokens.popitem(last=False)
 
-    def submit(self, feeds, deadline_s=None, token=None):
+    def submit(self, feeds, deadline_s=None, token=None, trace=None):
         """Route one request (dict name -> rows as nested lists).
         Returns ``{"outputs", "dtypes", "replica", "generation"}``.
         ``token`` (an opaque client string) makes the request
@@ -1509,9 +1558,20 @@ class FleetRouter(_FleetMember):
         the original in-flight request (or returns its cached result)
         instead of enqueueing a duplicate — what lets a FleetClient
         re-send blindly after a torn response or a failover loop back.
+        ``trace`` is the propagated ``(trace_id, parent_span_id)``
+        context from the caller's ``x-trace-id`` header — the request
+        gets a ``router.serve`` span (with queue/dispatch children)
+        under the caller's trace, so one client request is one
+        timeline across processes.
         Raises ServerOverloadedError (queue full / every replica
         shedding), DeadlineExceededError, ValueError (malformed
         request) or RuntimeError (upstream failure after retries)."""
+        tr, parent = trace if trace else (None, None)
+        with obs.span("router.serve", trace_id=tr, parent=parent,
+                      router=self._host_id) as sp:
+            return self._submit_traced(feeds, deadline_s, token, sp)
+
+    def _submit_traced(self, feeds, deadline_s, token, sp):
         deadline = time.monotonic() + (
             self.request_deadline_s if deadline_s is None
             else float(deadline_s))
@@ -1550,6 +1610,8 @@ class FleetRouter(_FleetMember):
                                              router=self._host_id)
             raise
         p = _Pending(feeds, n, deadline)
+        if sp.trace is not None:
+            p.trace, p.span, p.t_enq = sp.trace, sp.id, obs.now()
         with self._qcond:
             if len(self._queue) >= self.max_queue:
                 resilience.record_router_request("shed",
@@ -1565,7 +1627,7 @@ class FleetRouter(_FleetMember):
             self._remember_token(token, p)
         return self._finish_pending(p, deadline)
 
-    def _handle_infer(self, body):
+    def _handle_infer(self, body, trace_header=None):
         feeds = body.get("feeds")
         if not isinstance(feeds, dict):
             return 400, {"error": 'infer needs {"feeds": {name: rows}}'}
@@ -1581,7 +1643,9 @@ class FleetRouter(_FleetMember):
             return 400, {"error": "token must be a string"}
         try:
             return 200, self.submit(feeds, deadline_s=deadline_s,
-                                    token=token)
+                                    token=token,
+                                    trace=obs.parse_header(
+                                        trace_header))
         except ServerOverloadedError as e:
             return 503, {"error": str(e), "kind": "overloaded"}
         except DeadlineExceededError as e:
@@ -1675,6 +1739,24 @@ class FleetRouter(_FleetMember):
                     rows += p.n
                 resilience.set_router_queue_depth(len(self._queue),
                                                   router=self._host_id)
+                if batch and obs.enabled():
+                    # retroactive per-request queue spans (enqueue ->
+                    # cut) + one coalesce span on the oldest member:
+                    # "was the latency queue wait or replica time" is
+                    # answerable per request
+                    t_cut = obs.now()
+                    lead = next((p for p in batch
+                                 if p.trace is not None), None)
+                    if lead is not None:
+                        obs.record("router.coalesce", lead.t_enq,
+                                   t_cut, trace_id=lead.trace,
+                                   parent=lead.span,
+                                   batch=len(batch))
+                    for p in batch:
+                        if p.trace is not None:
+                            obs.record("router.queue", p.t_enq,
+                                       t_cut, trace_id=p.trace,
+                                       parent=p.span)
                 return batch
         return []
 
@@ -1707,6 +1789,7 @@ class FleetRouter(_FleetMember):
         tried = set()
         last_err = None
         merged = None
+        attempt = 0
         while True:
             now = time.monotonic()
             expired = [p for p in batch if now > p.deadline]
@@ -1737,11 +1820,26 @@ class FleetRouter(_FleetMember):
                 return
             rid, addr = target
             payload = {"feeds": merged, "deadline_s": remaining}
+            # propagate the (lead) trace context to the replica so its
+            # serve span joins the same timeline; the per-attempt
+            # dispatch spans below are recorded per coalesced request,
+            # tagged replica + outcome — a retry-on-sibling is two
+            # dispatch spans under one router.serve parent
+            traced = obs.enabled()
+            headers = None
+            if traced:
+                attempt += 1
+                t_att = obs.now()
+                lead = next((p for p in batch
+                             if p.trace is not None), None)
+                if lead is not None:
+                    headers = {"x-trace-id":
+                               "%s:%s" % (lead.trace, lead.span)}
             self._inc_inflight(rid, +1)
             try:
                 status, resp = http_json(
                     "POST", "http://%s/infer" % addr, payload,
-                    timeout_s=remaining + 0.5)
+                    timeout_s=remaining + 0.5, headers=headers)
             except (OSError, ValueError) as e:
                 # a SIGKILLed replica mid-flight lands here: the
                 # connection resets, the batch retries on a sibling.
@@ -1754,28 +1852,52 @@ class FleetRouter(_FleetMember):
                                                router=self._host_id)
                 record_event("router_retry", replica=rid,
                              error=type(e).__name__)
+                if traced:
+                    self._record_dispatch(batch, t_att, rid,
+                                          "unreachable", attempt)
                 continue
             finally:
                 self._inc_inflight(rid, -1)
             if status == 200:
+                if traced:
+                    self._record_dispatch(batch, t_att, rid, "ok",
+                                          attempt)
                 self._split(batch, resp, meta)
                 return
             tried.add(rid)
             if status == 503:
+                outcome = "shed"
                 last_err = ServerOverloadedError(
                     resp.get("error", "replica %d is shedding" % rid))
             elif status == 504:
+                outcome = "deadline"
                 last_err = DeadlineExceededError(
                     resp.get("error", "replica %d deadline" % rid))
             else:
+                outcome = "error"
                 last_err = RuntimeError(
                     resp.get("error",
                              "replica %d answered HTTP %d"
                              % (rid, status)))
+            if traced:
+                self._record_dispatch(batch, t_att, rid, outcome,
+                                      attempt)
             # 5xx retries are LOAD-driven (a shed storm emits one per
             # tried replica per batch, at request rate): counter only,
             # never the bounded event log
             resilience.record_router_retry(rid, router=self._host_id)
+
+    @staticmethod
+    def _record_dispatch(batch, t0, rid, outcome, attempt):
+        """One finished dispatch-attempt span per coalesced traced
+        request, parented under its router.serve span."""
+        t1 = obs.now()
+        for p in batch:
+            if p.trace is not None:
+                obs.record("router.dispatch", t0, t1,
+                           trace_id=p.trace, parent=p.span,
+                           replica=rid, outcome=outcome,
+                           attempt=attempt)
 
     @staticmethod
     def _fail(batch, err):
@@ -1937,12 +2059,26 @@ class FleetClient(object):
         the last error (ConnectionError every router unreachable,
         ServerOverloadedError whole-fleet shed, DeadlineExceededError,
         ValueError for a malformed request — never retried) once the
-        deadline is spent."""
+        deadline is spent.
+
+        With the obs spans engine enabled, each request is the ROOT of
+        a distributed trace: the ``client.infer`` span's context rides
+        the ``x-trace-id`` header into the router (and on to the
+        replica), so ``tools/traceview.py`` can render one client
+        request end to end across the fleet's processes."""
+        with obs.span("client.infer") as sp:
+            return self._infer_traced(feeds, deadline_s, sp)
+
+    def _infer_traced(self, feeds, deadline_s, sp):
         import uuid
         deadline = time.monotonic() + (
             self.request_deadline_s if deadline_s is None
             else float(deadline_s))
         token = uuid.uuid4().hex
+        headers = None
+        if sp.trace is not None:
+            sp.set(token=token)
+            headers = {"x-trace-id": "%s:%s" % (sp.trace, sp.id)}
         last_err = None
         while True:
             remaining = deadline - time.monotonic()
@@ -1956,7 +2092,7 @@ class FleetClient(object):
                     "POST", url + "/infer",
                     {"feeds": feeds, "deadline_s": remaining,
                      "token": token},
-                    timeout_s=remaining + 0.5)
+                    timeout_s=remaining + 0.5, headers=headers)
             except (OSError, ValueError) as e:
                 # a dead/SIGKILLed router: rotate and REPLAY by token
                 # (idempotent even when the loop lands back here)
@@ -1967,6 +2103,7 @@ class FleetClient(object):
                                max(0.0, deadline - time.monotonic())))
                 continue
             if status == 200:
+                sp.set(outcome="ok", replica=resp.get("replica"))
                 return resp
             if status == 400:
                 # malformed request: deterministic on every router —

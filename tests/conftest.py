@@ -77,6 +77,12 @@ def pytest_configure(config):
         "pp: pipeline-parallel CompiledProgram batteries (pp x dp mesh "
         "cut/lowering, GPipe/1F1B parity, elastic pp rewind) — CPU "
         "8-device mesh, tier-1-safe")
+    config.addinivalue_line(
+        "markers",
+        "obs: distributed-tracing / step-phase-profiler batteries "
+        "(obs spans engine, trace-context propagation across the "
+        "fleet, traceview merge, tracing-overhead gate) — "
+        "tier-1-safe")
 
 
 @pytest.fixture(autouse=True)

@@ -72,7 +72,9 @@ def test_budget_table_covers_the_contract():
         "transport_failover_ms",
         "serving_p50_ms", "serving_p99_ms", "serving_shed_rate",
         "serving_error_rate", "router_failover_ms",
-        "pp_step_s", "pp_bubble_frac", "pp_cache_hit_rate"}
+        "pp_step_s", "pp_bubble_frac", "pp_cache_hit_rate",
+        "obs_step_overhead_ratio", "obs_router_overhead_ratio",
+        "obs_span_record_us"}
 
 
 def test_pipeline_section_measures_the_pp_path():

@@ -220,6 +220,26 @@ def test_metrics_on_snapshot_and_empty_log():
         resilience.parse_metrics_text("what even is this line")
 
 
+def test_label_values_escape_per_prometheus_and_round_trip():
+    """REGRESSION (ISSUE 12 satellite): a label value carrying quotes,
+    backslashes or newlines — e.g. a replica-address blob that picked
+    up a quoted hostname — must render as VALID exposition text
+    (escaped per the Prometheus spec) and parse back bitwise."""
+    nasty = 'replica "quoted" back\\slash\nnewline }brace'
+    m = {"counters": [
+        {"name": resilience.METRIC_PREFIX + "_router_requests_total",
+         "labels": {"addr": nasty, "outcome": "ok"}, "value": 3}],
+        "gauges": [], "histograms": []}
+    text = resilience.metrics_text(m)
+    # one sample line, no raw newline/quote tearing the exposition
+    body = [ln for ln in text.splitlines() if not ln.startswith("#")]
+    assert len(body) == 1
+    assert '\\"quoted\\"' in body[0] and "\\n" in body[0]
+    (name, labels, value), = resilience.parse_metrics_text(text)
+    assert labels["addr"] == nasty          # bitwise round trip
+    assert labels["outcome"] == "ok" and value == 3.0
+
+
 # ---------------------------------------------------------------------------
 # RetryPolicy
 # ---------------------------------------------------------------------------

@@ -77,11 +77,14 @@ def scrape_metrics(url, timeout_s=5.0):
     "router" section with the serving-fleet series
     (router_requests_total{outcome=}, router_queue_depth,
     router_replica_inflight per replica, the router_batch_size
-    histogram samples) and a "bytes" section with the
-    compressed-movement raw-vs-wire pairs
-    (collective/stateship/ckpt _bytes_total{kind=}) when the replica
-    exports any — or raises (caller folds failures into the health
-    report)."""
+    histogram samples), an "obs" section with the tracing layer's
+    series (the ``executor_step_seconds{kind=}`` step-phase histogram
+    samples and ``trace_spans_dropped_total`` — nonzero means the
+    span ring overflowed and any merged timeline is missing spans)
+    and a "bytes" section with the compressed-movement raw-vs-wire
+    pairs (collective/stateship/ckpt _bytes_total{kind=}) when the
+    replica exports any — or raises (caller folds failures into the
+    health report)."""
     import urllib.request
     from paddle_tpu.framework.resilience import (METRIC_PREFIX,
                                                  parse_metrics_text)
@@ -89,12 +92,22 @@ def scrape_metrics(url, timeout_s=5.0):
         text = resp.read().decode("utf-8")
     samples = parse_metrics_text(text)
     events, feed, transport, router, bytes_sec = {}, {}, {}, {}, {}
+    obs_sec = {}
     for name, labels, value in samples:
         if name == METRIC_PREFIX + "_events_total":
             key = labels.get("kind", "?")
             if "host" in labels:
                 key += "/host" + labels["host"]
             events[key] = value
+        elif name.startswith(METRIC_PREFIX + "_executor_step_seconds") \
+                or name.startswith(METRIC_PREFIX + "_trace_spans"):
+            # the obs tentpole's series fold under one "obs" group
+            key = name[len(METRIC_PREFIX) + 1:]
+            if "kind" in labels:
+                key += "/" + labels["kind"]
+            if "le" in labels:
+                key += "/le" + labels["le"]
+            obs_sec[key] = value
         elif name.startswith(METRIC_PREFIX + "_router_") \
                 or name.startswith(METRIC_PREFIX + "_fleet_"):
             # the router-TIER series (per-router queue/requests plus
@@ -129,9 +142,24 @@ def scrape_metrics(url, timeout_s=5.0):
         out["transport"] = transport
     if router:
         out["router"] = router
+    if obs_sec:
+        out["obs"] = obs_sec
     if bytes_sec:
         out["bytes"] = bytes_sec
     return out
+
+
+def obs_overflow_flags(summary):
+    """Span-ring overflow symptoms in a scrape summary (empty =
+    healthy): a nonzero ``trace_spans_dropped_total`` means the
+    tracing ring evicted spans, so any merged timeline pulled from
+    this process is LYING by omission — ``--strict`` fails on it
+    (raise PADDLE_TPU_TRACE_RING or pull /admin/trace more often)."""
+    dropped = summary.get("obs", {}).get("trace_spans_dropped_total", 0)
+    if dropped:
+        return ["span ring overflowed: trace_spans_dropped_total=%g — "
+                "merged timelines are missing spans" % dropped]
+    return []
 
 
 def term_regression_flags(summary):
@@ -185,7 +213,9 @@ def main(argv=None):
                          "degraded serve or error during the probe "
                          "itself fails it — and, with --metrics-url, "
                          "any term regression (stale-primary symptom) "
-                         "in the transport series")
+                         "in the transport series or span-ring "
+                         "overflow (trace_spans_dropped_total > 0) in "
+                         "the obs series")
     ap.add_argument("--metrics-url", default=None,
                     help="scrape a resilience.serve_metrics endpoint and "
                          "fold the event totals into the report")
@@ -207,6 +237,12 @@ def main(argv=None):
                 # answering somewhere: serviceable today, split-brain
                 # fuel tomorrow — loud always, fatal under --strict
                 health["term_regression"] = flags
+                metrics_ok = False
+            oflags = obs_overflow_flags(health["metrics"])
+            if oflags:
+                # dropped spans mean the timeline is lying — loud
+                # always, fatal under --strict
+                health["obs_overflow"] = oflags
                 metrics_ok = False
         except Exception as e:
             # a loadable replica with a dead metrics endpoint is still

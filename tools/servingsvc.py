@@ -47,6 +47,13 @@ Three subcommands, one process each:
 Each daemon prints ONE JSON line with its address once serving
 (orchestrators parse it), then runs until SIGTERM/SIGINT.
 
+Distributed tracing: launch any daemon with ``PADDLE_TPU_TRACE=1``
+and it records obs spans (router queue/dispatch, replica serve,
+coordination waits) with trace context propagated via the
+``x-trace-id`` header; pull each process's spans from
+``GET /admin/trace`` and merge them with ``tools/traceview.py`` into
+one Perfetto timeline. See PORTING.md "Observability & tracing".
+
 ``--coord`` accepts a comma-joined endpoint LIST when the coordination
 plane is a replicated coordsvc group (``--peers`` mode): members fail
 over to the promoted standby transparently, so a coordinator SIGKILL

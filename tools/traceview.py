@@ -1,0 +1,130 @@
+#!/usr/bin/env python
+"""Merge per-process span dumps into ONE Perfetto timeline.
+
+The obs spans engine (paddle_tpu/framework/obs.py) records each
+process's spans in a bounded ring; this tool merges any number of
+per-process dumps — files written by ``obs.dump(path)`` and/or LIVE
+pulls from fleet members' ``GET /admin/trace`` endpoints — into one
+Chrome-trace-event JSON that chrome://tracing and
+https://ui.perfetto.dev load directly. Because trace context
+propagates across processes (the ``x-trace-id`` header), a single
+client request shows up as ONE tree: ``client.infer`` ->
+``router.serve`` (queue / coalesce / dispatch attempts) ->
+``replica.serve`` -> executor phases — with each process on its own
+named track and every event carrying its trace/span/parent ids in
+``args`` (filter a timeline to one request by its trace id).
+
+Reference parity: tools/timeline.py of the reference stack renders
+profiler records into a chrome://tracing file; this is the same move
+for the DISTRIBUTED layers the reference never had.
+
+Usage:
+  python tools/traceview.py -o trace.json dump1.json dump2.json ...
+  python tools/traceview.py -o trace.json --from URL[,URL...] [files]
+  python tools/traceview.py --stdout dump1.json
+
+``--from`` takes fleet-member base URLs (router or replica,
+``http://h:p`` or ``h:p``) and pulls each one's ``/admin/trace``.
+Exit code 1 when any input failed to load (the merge of the rest is
+still written); 2 when NO spans were collected at all.
+"""
+import argparse
+import json
+import sys
+
+
+def pull_live(url, timeout_s=5.0):
+    """Fetch one live member's span dump from GET /admin/trace."""
+    import urllib.request
+    base = url if "://" in url else "http://" + url
+    with urllib.request.urlopen(base.rstrip("/") + "/admin/trace",
+                                timeout=timeout_s) as resp:
+        return json.loads(resp.read().decode("utf-8"))
+
+
+def load_dump(path):
+    with open(path) as f:
+        d = json.load(f)
+    if not isinstance(d, dict) or "spans" not in d:
+        raise ValueError("%s is not an obs span dump "
+                         "(expected a dict with a 'spans' list)" % path)
+    return d
+
+
+def merge(dumps):
+    """Merged Chrome trace dict from a list of dump blobs."""
+    from paddle_tpu.framework import obs
+    return obs.chrome_trace(list(dumps))
+
+
+def summarize(dumps):
+    """One human line per process + per-trace span counts (stderr)."""
+    lines = []
+    traces = {}
+    for d in dumps:
+        spans = d.get("spans", [])
+        lines.append("  %-16s pid=%-7s spans=%-5d dropped=%s"
+                     % (d.get("service"), d.get("pid"), len(spans),
+                        d.get("dropped", 0)))
+        for s in spans:
+            traces[s["trace"]] = traces.get(s["trace"], 0) + 1
+    multi = sorted(traces.items(), key=lambda kv: -kv[1])[:5]
+    if multi:
+        lines.append("  top traces: " + ", ".join(
+            "%s (%d spans)" % kv for kv in multi))
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("dumps", nargs="*",
+                    help="span dump files (obs.dump / /admin/trace "
+                         "JSON)")
+    ap.add_argument("--from", dest="live", default=None,
+                    help="comma-joined fleet member base URLs to pull "
+                         "/admin/trace from live")
+    ap.add_argument("-o", "--out", default=None,
+                    help="output Chrome trace JSON path")
+    ap.add_argument("--stdout", action="store_true",
+                    help="write the merged trace to stdout instead")
+    args = ap.parse_args(argv)
+    if not args.out and not args.stdout:
+        ap.error("need -o OUT or --stdout")
+    blobs, failed = [], 0
+    for path in args.dumps:
+        try:
+            blobs.append(load_dump(path))
+        except (OSError, ValueError) as e:
+            print("skipping %s: %s" % (path, e), file=sys.stderr)
+            failed += 1
+    for url in (args.live.split(",") if args.live else []):
+        url = url.strip()
+        if not url:
+            continue
+        try:
+            blobs.append(pull_live(url))
+        except Exception as e:  # noqa: BLE001 - reported, not fatal
+            print("live pull %s failed: %s" % (url, e),
+                  file=sys.stderr)
+            failed += 1
+    total = sum(len(b.get("spans", [])) for b in blobs)
+    if total == 0:
+        print("no spans collected (is PADDLE_TPU_TRACE=1 set on the "
+              "fleet?)", file=sys.stderr)
+        return 2
+    trace = merge(blobs)
+    print("merged %d spans from %d process dump(s):\n%s"
+          % (total, len(blobs), summarize(blobs)), file=sys.stderr)
+    out = json.dumps(trace)
+    if args.stdout:
+        print(out)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(out)
+        print("wrote %s (load it at https://ui.perfetto.dev or "
+              "chrome://tracing)" % args.out, file=sys.stderr)
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
