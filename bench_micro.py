@@ -27,6 +27,13 @@ surfaces that move on every PR, on JAX_PLATFORMS=cpu, in seconds:
                              LayerNorm): fwd+bwd step wall + max abs
                              error per kernel — the kernels' tier-1
                              perf-and-parity canary
+  * costmodel_*            — the kernel-selection cost model (ISSUE
+                             13): fit wall over the committed
+                             tools/tuned/ cache, per-query ranking
+                             cost (what a trace-time cache miss
+                             pays — must be ≪ one sweep probe), and
+                             the measured-best-in-top-3 rate on the
+                             banked keys
   * transport_*            — coordination-plane latency over an
                              in-process CoordServer: single
                              request/response round trip, a 2-host
@@ -110,6 +117,15 @@ BUDGETS = {
     "pallas_ce_err": ("max", 1e-4),
     "pallas_adam_err": ("max", 1e-5),
     "pallas_ln_err": ("max", 1e-4),
+    # kernel-selection cost model (ISSUE 13): fitting over the whole
+    # committed banked cache and ranking a candidate space must stay
+    # FAR below one sweep probe (~ms-to-minutes) — the model only pays
+    # for itself while a query is nearly free. The top-3 rate gates
+    # the committed cache's ranking quality at the same bar
+    # tools/tunecheck.py enforces.
+    "costmodel_fit_s": ("max", 2.0),
+    "costmodel_rank_us": ("max", 20000.0),
+    "costmodel_top3_rate": ("min", 0.8),
     # coordination-plane latency (in-process CoordServer over loopback
     # TCP): a round trip is ~100us healthy; a 2-host gather round adds
     # the poll cadence. Budgets catch a protocol/serialization blowup.
@@ -400,6 +416,48 @@ def bench_pallas(steps=2):
         [jnp.max(jnp.abs(ln_pallas(x, sc, bi) - ln_ref(x, sc, bi)))] +
         [jnp.max(jnp.abs(a - b))
          for a, b in zip(lg_p(x, sc, bi), lg_r(x, sc, bi))]))
+    return out
+
+
+def bench_costmodel(rank_queries=50):
+    """Kernel-selection cost model overhead + quality (ISSUE 13): wall
+    time to fit the model from the committed tools/tuned/ cache, the
+    per-query ranking cost over the interpret candidate space (this is
+    what every trace-time cache miss pays — it must be ≪ one probe),
+    and the in-sample measured-best-in-top-3 rate on the banked keys
+    (the tunecheck quality bar, gated here so a bench round always
+    carries a model verdict too)."""
+    from paddle_tpu.ops.pallas import autotune as at
+    from paddle_tpu.ops.pallas import costmodel as cmod
+
+    out = {}
+    cache = at.AutotuneCache(at.banked_cache_path("cpu"))
+    t0 = time.perf_counter()
+    model = at.fit_cost_model(cache, interpret=True)
+    # force the lazy per-segment fits so fit_s covers the regression —
+    # backend="cpu" targets the segments the banked rows actually live
+    # in (the same query trace-time dispatch issues); the default "-"
+    # segment has no rows and would time the analytic path instead
+    for op in at.CANDIDATES:
+        model.rank(op, at.DRY_SHAPES[op], backend="cpu",
+                   interpret=True)
+    out["costmodel_fit_s"] = round(time.perf_counter() - t0, 5)
+    out["costmodel_rows"] = model.rows_total()
+
+    shapes = [("softmax_with_cross_entropy", (48, 320)),
+              ("adam", (12345,)), ("layer_norm", (96, 192)),
+              ("fused_mlm_head_loss", (40, 384))]
+    t0 = time.perf_counter()
+    for i in range(rank_queries):
+        op, shape = shapes[i % len(shapes)]
+        model.rank(op, shape, backend="cpu", interpret=True)
+    out["costmodel_rank_us"] = round(
+        (time.perf_counter() - t0) / rank_queries * 1e6, 2)
+
+    hits, judged = cmod.measured_best_in_topk(cache, model=model)
+    out["costmodel_top3_rate"] = round(hits / judged, 4) if judged \
+        else 0.0
+    out["costmodel_keys_judged"] = judged
     return out
 
 
@@ -1000,6 +1058,7 @@ def run_all(rounds_dir=None):
                      ("quantized_step", bench_quantized_step),
                      ("feed", bench_feed),
                      ("pallas", bench_pallas),
+                     ("costmodel", bench_costmodel),
                      ("pipeline", bench_pipeline),
                      ("transport", bench_transport),
                      ("failover", bench_failover),

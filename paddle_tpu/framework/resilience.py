@@ -183,6 +183,7 @@ def clear_events():
     clear_bytes()
     clear_router()
     clear_exec()
+    clear_kernel_choice()
 
 
 # ---------------------------------------------------------------------------
@@ -213,6 +214,35 @@ def record_bytes(channel, raw, wire):
         c = _BYTES.setdefault(str(channel), {"raw": 0, "wire": 0})
         c["raw"] += int(raw)
         c["wire"] += int(wire)
+
+
+# Trace-time kernel-selection accounting (ops.pallas_dispatch.choose):
+# one increment per call-site decision at COMPILE rate, so cumulative
+# process counters (not events) keyed (op, impl, source) — "is the
+# fleet actually running the tuned/predicted kernels it thinks it is"
+# becomes a scrapeable series instead of a log grep.
+_KCHOICE = {}
+_KCHOICE_LOCK = threading.Lock()
+
+
+def record_kernel_choice(op, impl, source):
+    """Count one trace-time kernel decision (see pallas_dispatch.
+    KernelChoice): exported by :func:`metrics` as
+    ``<prefix>_kernel_choice_total{op=,impl=,source=}``."""
+    with _KCHOICE_LOCK:
+        k = (str(op), str(impl), str(source))
+        _KCHOICE[k] = _KCHOICE.get(k, 0) + 1
+
+
+def kernel_choice_totals():
+    """Snapshot ``{(op, impl, source): count}``."""
+    with _KCHOICE_LOCK:
+        return dict(_KCHOICE)
+
+
+def clear_kernel_choice():
+    with _KCHOICE_LOCK:
+        _KCHOICE.clear()
 
 
 def bytes_totals():
@@ -624,6 +654,15 @@ def metrics(event_list=None, by_host=False):
             counters.append(
                 {"name": "%s_%s_bytes_total" % (METRIC_PREFIX, ch),
                  "labels": {"kind": kind}, "value": tot[kind]})
+    # trace-time kernel-selection decisions (pallas_dispatch.choose):
+    # cumulative process counters like the byte pairs — emitted only
+    # once a compile made a decision, so pallas-less jobs export
+    # nothing new
+    for (op, impl, source), n in sorted(kernel_choice_totals().items()):
+        counters.append(
+            {"name": METRIC_PREFIX + "_kernel_choice_total",
+             "labels": {"op": op, "impl": impl, "source": source},
+             "value": n})
     # serving-fleet router series (cumulative process counters like the
     # byte pairs — NOT events; see record_router_request): emitted only
     # once the router did anything, so router-less jobs export nothing

@@ -17,8 +17,12 @@ don't tile, and — for the registry-wired ops — trace-time dispatch via
   fused_layer_norm  one-pass LayerNorm fwd + bwd with saved residuals
   AutotuneCache / autotune_op
                     per-(op, shape, dtype, mesh, backend) block-size
-                    sweep with a persistent JSON cache
-                    (tools/autotune.py is the CLI)
+                    sweep with a persistent, versioned JSON cache
+                    (tools/autotune.py is the CLI), cost-model-pruned
+                    to ``top_k`` measured candidates
+  CostModel         analytic+fitted kernel cost model (costmodel):
+                    ranks candidate configs, predicts configs for
+                    never-swept shapes at trace time, prunes sweeps
 """
 from . import flash_attention  # noqa: F401  (module — see docstring)
 from .blockwise_ce import (  # noqa: F401
@@ -28,7 +32,9 @@ from .fused_adam import fused_adam  # noqa: F401  (function shadows its
 #                                      from .fused_adam directly)
 from .layer_norm import fused_layer_norm  # noqa: F401
 from .autotune import (  # noqa: F401
-    AutotuneCache, autotune_op, default_cache_path, CANDIDATES)
+    AutotuneCache, autotune_op, default_cache_path, CANDIDATES,
+    fit_cost_model, banked_cache_path)
+from .costmodel import CostModel  # noqa: F401
 from ..pallas_dispatch import (  # noqa: F401
-    PallasConfig, cache_key, scope as pallas_scope, enabled as
-    pallas_enabled, PALLAS_OPS)
+    PallasConfig, KernelChoice, cache_key, scope as pallas_scope,
+    enabled as pallas_enabled, PALLAS_OPS, KERNEL_POLICIES)

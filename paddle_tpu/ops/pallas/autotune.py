@@ -6,42 +6,75 @@ topology, backend) it runs on, so the sweep and the kernels ship
 together. For each kernel this module:
 
   1. enumerates its candidate block configs (``CANDIDATES``),
-  2. times fwd+bwd of each candidate with the bounded-probe discipline
-     bench.py uses (compile once, best-of-k timed calls, a per-candidate
-     wall deadline so one pathological config can't eat the sweep),
-  3. times the pure-XLA baseline the op registry would otherwise lower,
-  4. persists the winner in a JSON cache keyed like the executor's step
-     cache (op | shape | dtype | mesh axes | backend —
-     ``pallas_dispatch.cache_key``). When the best Pallas candidate
+  2. optionally PRUNES them through the analytic+fitted cost model
+     (``costmodel.CostModel`` fit over every measured row already
+     banked in the cache): ``top_k=K`` measures only the K
+     best-predicted candidates instead of the full space — the
+     TVM-style sweep compression ISSUE 13 exists for,
+  3. times fwd+bwd of each surviving candidate with the bounded-probe
+     discipline bench.py uses (compile once, best-of-k timed calls, a
+     per-candidate wall deadline so one pathological config can't eat
+     the sweep),
+  4. times the pure-XLA baseline the op registry would otherwise lower,
+  5. persists the winner AND every candidate's measured seconds in a
+     versioned JSON cache keyed like the executor's step cache
+     (``pallas_dispatch.cache_key``) — the per-candidate rows are what
+     future cost-model fits learn from. When the best Pallas candidate
      LOSES to XLA the entry records ``impl: "xla"`` and trace-time
      dispatch routes the op back to the XLA lowering.
 
 At trace time `CompiledProgram` loads the cache (``BuildStrategy.
-pallas_tune_cache``) into the dispatch scope; kernels consult it via
-``pallas_dispatch.choose``. `tools/autotune.py` is the CLI; its
-``--dry-run`` sweeps tiny shapes in interpret mode on CPU so tier-1
-exercises the harness itself.
+pallas_tune_cache``, or the in-repo banked ``tools/tuned/{backend}.
+json`` under ``kernel_policy="auto"``) into the dispatch scope; kernels
+consult it via ``pallas_dispatch.choose``, and a cache MISS resolves to
+a cost-model-predicted config instead of the hardcoded default.
+`tools/autotune.py` is the CLI; its ``--dry-run`` sweeps tiny shapes in
+interpret mode on CPU so tier-1 exercises the harness itself, and its
+``--bank BACKEND`` refreshes the committed per-backend cache that
+`tools/tunecheck.py` validates in tier-1.
 
 jax imports stay inside functions: loading the cache API must not drag
 the kernel modules in.
 """
+import hashlib
 import json
 import os
 import time
 
+from . import costmodel as cm
 from .. import pallas_dispatch as pd
 
 DEFAULT_CACHE_ENV = "PADDLE_TPU_PALLAS_TUNE_CACHE"
 
-#: candidate block configs per op — kwargs of the kernel entry points
+#: banked-cache JSON format (AutotuneCache envelope): bump on schema
+#: breaks. Unknown versions load EMPTY (trace time never bricks) and
+#: fail tools/tunecheck.py loudly.
+FORMAT_VERSION = 1
+
+#: candidate block configs per op — kwargs of the kernel entry points.
+#: Deliberately WIDE (TVM-style): the cost model prunes this space to
+#: ``top_k`` measured candidates, so enumerating generously costs
+#: prediction microseconds, not sweep minutes. Degenerate fits (a
+#: block larger than its axis halves until it divides) mean some
+#: candidates coincide on small shapes — the ranking dedups nothing,
+#: the measurement loop just sees equal times.
 CANDIDATES = {
     "softmax_with_cross_entropy": [
         {"block_t": bt, "block_v": bv}
-        for bt in (128, 256) for bv in (256, 512, 1024)],
-    "adam": [{"block_rows": r} for r in (64, 128, 256, 512)],
+        for bt in (128, 256, 512, 1024)
+        for bv in (256, 512, 1024, 2048, 4096)],
+    "adam": [{"block_rows": r}
+             for r in (32, 64, 128, 256, 512, 1024, 2048, 4096,
+                       8192, 16384)],
     # >= 128 rows per tile: the (8, block_rows) residual layout puts
     # block_rows on the lane dim, and compiled Mosaic wants it aligned
-    "layer_norm": [{"block_rows": r} for r in (128, 256, 512)],
+    "layer_norm": [{"block_rows": r}
+                   for r in (128, 256, 384, 512, 768, 1024, 1536,
+                             2048, 3072, 4096)],
+    "fused_mlm_head_loss": [
+        {"block_t": bt, "block_v": bv}
+        for bt in (128, 256, 512, 1024)
+        for bv in (256, 512, 1024, 2048)],
 }
 
 #: interpret-mode candidates for --dry-run / tier-1 (tiny tiles)
@@ -50,12 +83,15 @@ DRY_CANDIDATES = {
         {"block_t": 8, "block_v": 64}, {"block_t": 16, "block_v": 128}],
     "adam": [{"block_rows": 8}, {"block_rows": 16}],
     "layer_norm": [{"block_rows": 8}, {"block_rows": 16}],
+    "fused_mlm_head_loss": [
+        {"block_t": 8, "block_v": 64}, {"block_t": 16, "block_v": 64}],
 }
 
 DRY_SHAPES = {
     "softmax_with_cross_entropy": (32, 128),
     "adam": (2048,),
     "layer_norm": (32, 128),
+    "fused_mlm_head_loss": (32, 256),
 }
 
 #: real-chip default sweep shapes (the ERNIE-base headline geometry)
@@ -63,7 +99,57 @@ DEFAULT_SHAPES = {
     "softmax_with_cross_entropy": (2560, 32768),
     "adam": (1024 * 1024,),
     "layer_norm": (16384, 768),
+    "fused_mlm_head_loss": (2560, 32768),
 }
+
+#: the cpu-interpret BANKING grid (tools/autotune.py --bank
+#: cpu-interpret -> tools/tuned/cpu-interpret.json): several shapes
+#: per family so the cost-model fit has cross-shape signal, candidate
+#: tiles kept small enough that the interpreter's unrolled grids stay
+#: tractable in CI. Real backends bank DEFAULT_SHAPES x CANDIDATES.
+BANK_CANDIDATES = {
+    "softmax_with_cross_entropy": [
+        {"block_t": bt, "block_v": bv}
+        for bt in (8, 16, 32) for bv in (32, 64, 128)],
+    "adam": [{"block_rows": r} for r in (8, 16, 32, 64, 128)],
+    "layer_norm": [{"block_rows": r} for r in (8, 16, 32, 64)],
+    "fused_mlm_head_loss": [
+        {"block_t": bt, "block_v": bv}
+        for bt in (8, 16) for bv in (64, 128)],
+}
+
+BANK_SHAPES = {
+    "softmax_with_cross_entropy": [(32, 128), (64, 128), (32, 256),
+                                   (64, 256)],
+    "adam": [(2048,), (8192,), (65536,)],
+    "layer_norm": [(32, 128), (128, 256), (256, 512)],
+    "fused_mlm_head_loss": [(32, 256), (64, 256), (32, 512)],
+}
+
+
+def candidates_for(op, interpret):
+    """The candidate space trace-time prediction and banking rank over:
+    the interpreter's small-tile grid off-chip, the full Mosaic grid on
+    it."""
+    return (BANK_CANDIDATES if interpret else CANDIDATES).get(op, [])
+
+
+_SEL_FP = None
+
+
+def selection_fingerprint():
+    """Identity of the kernel-selection machinery (cost-model version +
+    the full candidate space): joins the executor compile-cache token so
+    changing either re-lowers instead of reusing a stale executable."""
+    global _SEL_FP
+    if _SEL_FP is None:
+        h = hashlib.sha1()
+        h.update(b"model-v%d|" % cm.MODEL_VERSION)
+        h.update(json.dumps({"chip": CANDIDATES,
+                             "interpret": BANK_CANDIDATES},
+                            sort_keys=True).encode())
+        _SEL_FP = h.hexdigest()[:12]
+    return _SEL_FP
 
 
 def default_cache_path():
@@ -74,19 +160,71 @@ def default_cache_path():
                         "pallas_autotune.json")
 
 
-class AutotuneCache(object):
-    """JSON-file persistence of sweep winners. Schema: one top-level
-    dict ``{key: entry}`` where key is ``pallas_dispatch.cache_key`` and
-    entry is ``{"impl": "pallas"|"xla", "config": {...}, "pallas_s":
-    float, "xla_s": float, ...}``. Loads lazily, writes atomically
-    (tmp + rename), tolerates a missing/corrupt file (treated empty —
-    a torn write must not brick trace time)."""
+def tuned_dir():
+    """The in-repo banked-cache directory (``tools/tuned/``)."""
+    return os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))), "tools", "tuned")
 
-    def __init__(self, path=None):
+
+def banked_cache_name(backend):
+    """Backend platform -> banked-cache basename: CPU verdicts are
+    interpreter timings (Mosaic never ran), so the file says so."""
+    return "cpu-interpret" if backend == "cpu" else str(backend)
+
+
+def banked_cache_path(backend):
+    """Path of the committed per-backend tuned cache CI/bench/serving
+    replicas share (``tools/tuned/{backend}.json``)."""
+    return os.path.join(tuned_dir(), banked_cache_name(backend) + ".json")
+
+
+class AutotuneCache(object):
+    """Versioned JSON-file persistence of sweep results.
+
+    On-disk envelope (``FORMAT_VERSION``):
+    ``{"format_version": 1, ...meta..., "entries": {key: entry}}``
+    where key is ``pallas_dispatch.cache_key`` and entry is
+    ``{"impl": "pallas"|"xla"|"pallas_q", "config": {...}, "pallas_s":
+    float, "xla_s": float, "results": {tag: seconds}, ...}`` — the
+    per-candidate ``results`` rows feed cost-model fits. Legacy flat
+    ``{key: entry}`` files still load (read-only compat); every save
+    writes the envelope.
+
+    Concurrency contract: loads lazily and re-reads on file-stat
+    change; :meth:`save` is a cross-process MERGE — it re-reads the
+    file fresh, overlays only this object's unsaved puts and replaces
+    atomically (tmp + fsync + ``os.replace``), so concurrent autotune
+    runs and a serving replica sharing one cache file can neither tear
+    the JSON nor erase each other's keys. A missing/corrupt/
+    future-versioned file is treated empty (a torn write must not
+    brick trace time; tunecheck is where it fails loudly)."""
+
+    def __init__(self, path=None, meta=None):
         self.path = path or default_cache_path()
         self._data = None
-        self._dirty = False
+        self.meta = dict(meta or {})
+        self._dirty = {}          # unsaved put()s: key -> entry
         self._loaded_stat = None
+
+    @staticmethod
+    def parse_blob(raw):
+        """(entries, meta) from a parsed JSON blob — versioned envelope
+        or legacy flat dict. Unknown format versions yield empty
+        entries with the meta preserved (so tunecheck can report WHAT
+        it refused)."""
+        if not isinstance(raw, dict):
+            return {}, {}
+        if "format_version" in raw:
+            meta = {k: v for k, v in raw.items() if k != "entries"}
+            try:
+                ver = int(raw["format_version"])
+            except (TypeError, ValueError):
+                ver = None
+            entries = raw.get("entries")
+            if ver != FORMAT_VERSION or not isinstance(entries, dict):
+                return {}, meta
+            return dict(entries), meta
+        return dict(raw), {}
 
     def _stat(self):
         try:
@@ -95,19 +233,25 @@ class AutotuneCache(object):
         except OSError:
             return None
 
+    def _read_disk(self):
+        try:
+            with open(self.path) as f:
+                return self.parse_blob(json.load(f))
+        except (OSError, ValueError):
+            return {}, {}
+
     def load(self):
-        """Parsed cache contents, re-read when the file changed on disk
+        """Parsed cache entries, re-read when the file changed on disk
         (a re-run of tools/autotune.py must be visible to a live
         process) — unless this object holds unsaved put()s."""
         st = self._stat()
         if self._data is None or (not self._dirty and
                                   st != self._loaded_stat):
-            try:
-                with open(self.path) as f:
-                    data = json.load(f)
-                self._data = data if isinstance(data, dict) else {}
-            except (OSError, ValueError):
-                self._data = {}
+            self._data, file_meta = self._read_disk()
+            if file_meta:
+                merged = dict(file_meta)
+                merged.update(self.meta)
+                self.meta = merged
             self._loaded_stat = st
         return self._data
 
@@ -116,23 +260,55 @@ class AutotuneCache(object):
 
     def put(self, key, entry):
         self.load()[key] = entry
-        self._dirty = True
+        self._dirty[key] = entry
 
     def save(self):
-        data = self.load()
+        self.load()
         d = os.path.dirname(os.path.abspath(self.path))
         if d and not os.path.isdir(d):
             os.makedirs(d, exist_ok=True)
+        # cross-process merge: overlay ONLY this object's unsaved puts
+        # onto a fresh read, so two sweeps interleaving save() keep
+        # both key sets (last-writer-wins per key, never per file)
+        disk_entries, disk_meta = self._read_disk()
+        merged = dict(disk_entries)
+        if self._dirty:
+            merged.update(self._dirty)
+        else:
+            merged.update(self._data or {})
+        meta = dict(disk_meta)
+        meta.update(self.meta)
+        meta.pop("format_version", None)
+        blob = {"format_version": FORMAT_VERSION}
+        blob.update(sorted(meta.items()))
+        blob["entries"] = merged
         tmp = self.path + ".tmp.%d" % os.getpid()
         with open(tmp, "w") as f:
-            json.dump(data, f, indent=1, sort_keys=True)
+            json.dump(blob, f, indent=1, sort_keys=True)
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, self.path)
-        self._dirty = False
+        self._data = merged
+        self._dirty = {}
         self._loaded_stat = self._stat()
         return self.path
 
     def __len__(self):
         return len(self.load())
+
+
+def fit_cost_model(cache=None, interpret=None):
+    """A :class:`costmodel.CostModel` over this module's candidate
+    grids, fit from every measured row ``cache`` banked (analytic-only
+    when the cache is empty/absent). ``interpret`` selects which grid
+    the model ranks by default (None = the dispatch default)."""
+    if interpret is None:
+        interpret = pd.default_interpret()
+    model = cm.CostModel(candidates={
+        op: candidates_for(op, interpret) for op in CANDIDATES})
+    if cache is not None:
+        model.fit_cache(cache)
+    return model
 
 
 # ---------------------------------------------------------------------------
@@ -192,6 +368,36 @@ def _workloads(op, shape, dtype, interpret):
             return lambda: g(logits)
         xla_g = jax.jit(jax.grad(ref_loss))
         return make, lambda: xla_g(logits)
+
+    if op == "fused_mlm_head_loss":
+        from .blockwise_ce import fused_mlm_head_loss
+        t, v = shape
+        d = cm.HEAD_D["interpret" if interpret else "compiled"]
+        hidden = jnp.asarray(rng.randn(t, d) * 0.3, dtype)
+        weight = jnp.asarray(rng.randn(d, v) * 0.2, dtype)
+        bias = jnp.asarray(rng.randn(v).astype(np.float32) * 0.1)
+        labels = jnp.asarray(rng.randint(0, v, (t,)), jnp.int32)
+
+        def ref_loss(h, w, b):
+            logits = jnp.matmul(h, w, preferred_element_type=jnp.float32)
+            logits = logits.astype(jnp.float32) + b[None, :]
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            picked = jnp.take_along_axis(logp, labels[:, None], axis=1)
+            return jnp.sum(-picked)
+
+        def make(config):
+            cfg = dict(config or {})
+
+            def loss(h, w, b):
+                out = fused_mlm_head_loss(h, w, labels, bias=b,
+                                          interpret=interpret, **cfg)
+                if out is None:
+                    raise ValueError("shape does not tile under %r" % cfg)
+                return jnp.sum(out)
+            g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+            return lambda: g(hidden, weight, bias)
+        xla_g = jax.jit(jax.grad(ref_loss, argnums=(0, 1, 2)))
+        return make, lambda: xla_g(hidden, weight, bias)
 
     if op == "adam":
         from .fused_adam import fused_adam
@@ -253,10 +459,23 @@ def _workloads(op, shape, dtype, interpret):
 
 def autotune_op(op, shape, dtype="float32", probes=3, interpret=None,
                 cache=None, candidates=None, mesh_axes=None,
-                backend=None, candidate_deadline_s=120.0):
-    """Sweep one (op, shape, dtype): time every candidate and the XLA
-    baseline, persist the winner (or the XLA fallback verdict) under
-    the executor-style cache key, and return the summary dict."""
+                backend=None, candidate_deadline_s=120.0, top_k=None,
+                cost_model=None, cost_model_only=False):
+    """Tune one (op, shape, dtype): rank every candidate through the
+    cost model, measure the ``top_k`` best-predicted ones (None =
+    exhaustive legacy sweep) plus the XLA baseline, persist the winner
+    AND the per-candidate rows under the executor-style cache key, and
+    return the summary dict.
+
+    ``cost_model_only=True`` measures NOTHING: the top-ranked predicted
+    config is banked directly (entry ``source: "costmodel"``) — the
+    zero-probe mode for fleets that need a config for a new shape
+    before any sweep window opens. ``cost_model`` injects a pre-fitted
+    model (default: fit from ``cache``'s own banked rows).
+
+    Per-candidate summary rows carry predicted AND measured seconds:
+    ``{tag: {"predicted_s", "source", "measured_s", "status"}}`` with
+    status "ok" | "failed" | "pruned"."""
     import jax
     if interpret is None:
         interpret = pd.default_interpret()
@@ -266,15 +485,77 @@ def autotune_op(op, shape, dtype="float32", probes=3, interpret=None,
         cache = AutotuneCache()
     if candidates is None:
         candidates = (DRY_CANDIDATES if interpret else CANDIDATES)[op]
-    make, xla_fn = _workloads(op, tuple(shape), dtype, interpret)
+    model = cost_model
+    if model is None and (top_k or cost_model_only):
+        model = fit_cost_model(cache, interpret=interpret)
     results = {}
-    best_cfg, best_s = None, None
+    predicted = {}
+    if model is not None:
+        for cfg, sec, src in model.rank(op, tuple(shape), candidates,
+                                        backend=backend,
+                                        interpret=interpret):
+            predicted[cm.config_tag(cfg)] = (sec, src)
     for config in candidates:
-        tag = ",".join("%s=%s" % kv for kv in sorted(config.items()))
+        tag = cm.config_tag(config)
+        sec_src = predicted.get(tag)
+        results[tag] = {
+            "predicted_s": round(sec_src[0], 9) if sec_src else None,
+            "source": sec_src[1] if sec_src else None,
+            "measured_s": None,
+            "status": "pruned" if (top_k or cost_model_only) else
+                      "pending"}
+
+    key = pd.cache_key(op, shape, dtype, mesh_axes, backend)
+    if cost_model_only:
+        ranked = model.top_k(op, tuple(shape), candidates, k=1,
+                             backend=backend, interpret=interpret)
+        pred = {"config": ranked[0][0],
+                "predicted_s": ranked[0][1]} if ranked else None
+        entry = {
+            "impl": "pallas",
+            "config": pred["config"] if pred else None,
+            "pallas_s": None, "xla_s": None, "probes": 0,
+            "interpret": bool(interpret), "backend": backend,
+            "predicted_s": round(pred["predicted_s"], 9) if pred
+            else None,
+            "source": "costmodel",
+        }
+        cache.put(key, entry)
+        cache.save()
+        return {"op": op, "key": key, "entry": entry,
+                "results": results, "cache": cache.path,
+                "candidates_total": len(candidates),
+                "candidates_measured": 0, "top_k": top_k}
+
+    if top_k:
+        measure = [c for c, _s, _src in model.top_k(
+            op, tuple(shape), candidates, k=top_k, backend=backend,
+            interpret=interpret)]
+        if not measure:
+            # nothing in the space tiles this shape: fall back to the
+            # exhaustive list so the size guards get to say "failed"
+            measure = list(candidates)
+    else:
+        measure = list(candidates)
+
+    make, xla_fn = _workloads(op, tuple(shape), dtype, interpret)
+    best_cfg, best_s = None, None
+    measured_rows = {}
+    for config in measure:
+        tag = cm.config_tag(config)
         dt = _time_fn(make(config), probes, candidate_deadline_s)
-        results[tag] = round(dt, 6) if dt is not None else "failed"
+        row = results.setdefault(tag, {"predicted_s": None,
+                                       "source": None})
+        if dt is None:
+            row["measured_s"], row["status"] = None, "failed"
+        else:
+            row["measured_s"], row["status"] = round(dt, 6), "ok"
+            measured_rows[tag] = round(dt, 6)
         if dt is not None and (best_s is None or dt < best_s):
             best_cfg, best_s = dict(config), dt
+    for row in results.values():
+        if row.get("status") == "pending":
+            row["status"] = "failed"
     xla_s = _time_fn(xla_fn, probes, candidate_deadline_s)
     # Fall back to XLA when the best Pallas candidate loses (or none
     # ran). Interpret-mode sweeps NEVER conclude "xla" — not even when
@@ -284,7 +565,8 @@ def autotune_op(op, shape, dtype="float32", probes=3, interpret=None,
     # guards still fall back dynamically at trace time).
     pallas_wins = interpret or (best_s is not None and
                                 (xla_s is None or best_s <= xla_s))
-    key = pd.cache_key(op, shape, dtype, mesh_axes, backend)
+    best_pred = predicted.get(cm.config_tag(best_cfg)) if best_cfg \
+        else None
     entry = {
         "impl": "pallas" if pallas_wins else "xla",
         "config": best_cfg if pallas_wins else None,
@@ -293,8 +575,13 @@ def autotune_op(op, shape, dtype="float32", probes=3, interpret=None,
         "probes": probes,
         "interpret": bool(interpret),
         "backend": backend,
+        "results": measured_rows,
+        "source": "sweep",
     }
+    if best_pred is not None:
+        entry["predicted_s"] = round(best_pred[0], 9)
     cache.put(key, entry)
     cache.save()
     return {"op": op, "key": key, "entry": entry, "results": results,
-            "cache": cache.path}
+            "cache": cache.path, "candidates_total": len(candidates),
+            "candidates_measured": len(measure), "top_k": top_k}

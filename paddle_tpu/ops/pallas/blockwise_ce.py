@@ -44,6 +44,10 @@ except Exception:  # pragma: no cover
     pltpu = None
 
 from .flash_attention import _dot_precision
+from .costmodel import fit_blocks  # noqa: F401 - the kernels' tiling
+# math lives in costmodel (pure, jax-free) so the cost model and the
+# size guards can never disagree; re-exported here for the callers/
+# tests that always imported it from this module
 from .. import pallas_dispatch as pd
 
 _NEG_INF = -1e30
@@ -53,24 +57,6 @@ def _label_zero_cot(labels):
     """Cotangent for an integer labels input: float0 zeros (the value
     jax.vjp expects for int primals; discarded by every caller)."""
     return np.zeros(np.shape(labels), dtype=jax.dtypes.float0)
-
-
-def fit_blocks(t, v, block_t, block_v, interpret):
-    """(bt, bv) tile sizes for a (T, V) problem, or None when it cannot
-    tile: halve each block until it divides its axis; sub-8 tiles never
-    tile, and compiled Mosaic needs the 128-lane alignment (the loss/lse
-    outputs put block_t on the lane dim). Interpret mode (CPU tests)
-    accepts any divisible >= 8 tile."""
-    bt, bv = min(block_t, t), min(block_v, v)
-    while bt >= 1 and t % bt:
-        bt //= 2
-    while bv >= 1 and v % bv:
-        bv //= 2
-    if bt < 8 or bv < 8:
-        return None
-    if not interpret and (bt < 128 or bv < 128):
-        return None
-    return bt, bv
 
 
 def _rows8(x, dtype):

@@ -1,0 +1,472 @@
+"""Analytic + fitted kernel cost model — the sweep-pruning layer.
+
+TVM (PAPERS.md) showed a cost model turning an exhaustive schedule
+sweep into a handful of measured candidates; Tensor Processing
+Primitives showed one selection layer picking the best primitive
+implementation per call site. This module is both halves for the
+Pallas kernel library:
+
+  * **Analytic features** per (op, shape, block config): the tiling
+    math of each kernel family (mirrored from the kernels' own size
+    guards, kept pure so this module never imports jax), the grid
+    size, the VMEM footprint of one tile, the total padded HBM
+    traffic and the padding waste.
+  * **Fitted model**: a least-squares fit of measured seconds over
+    those features, using every ``(key, config, seconds)`` row banked
+    in an :class:`~.autotune.AutotuneCache` (the sweeps now persist
+    ALL candidate timings, not just the winner). One weight vector
+    per (kernel family, backend, interpret) segment — interpret-mode
+    wall time and Mosaic wall time are different physics and never
+    share a fit.
+  * **Ranking**: :meth:`CostModel.rank` orders a candidate list by
+    predicted seconds (fitted when a segment has enough rows, the
+    analytic proxy otherwise), :meth:`CostModel.top_k` prunes a sweep
+    to K candidates, and :meth:`CostModel.predict_config` gives a
+    NEVER-SWEPT shape a predicted config at trace time instead of the
+    hardcoded kernel default.
+
+Everything here is numpy + stdlib: ``pallas_dispatch`` (imported on
+every trace) and ``framework/compiler`` consult the model without
+dragging the kernel modules or jax.experimental.pallas in.
+"""
+import hashlib
+import json
+import math
+
+import numpy as np
+
+#: bumped whenever the feature map or the fit changes shape — part of
+#: the executor compile-cache token (a stale jitted program must not
+#: survive a model upgrade) and of the banked-cache check line.
+MODEL_VERSION = 1
+
+LANES = 128
+#: per-core VMEM envelope the analytic proxy penalizes against (bytes).
+#: Deliberately below the hardware's ~16 MiB: double-buffered pipelines
+#: need headroom, and a config near the cliff is a bad bet anyway.
+VMEM_BUDGET = 12 * 2 ** 20
+
+#: assumed hidden size of the fused-MLM-head matmul when the call site
+#: keys only (tokens, vocab): a shared per-family constant the fit
+#: absorbs into its coefficients (interpret sweeps use tiny models)
+HEAD_D = {"interpret": 16, "compiled": 768}
+
+#: analytic proxy constants (seconds): per-grid-step overhead and
+#: per-byte cost. Interpret mode executes the kernel body through the
+#: Pallas interpreter, so its step cost dwarfs its byte cost; compiled
+#: Mosaic is the opposite. Only the RANKING matters — the fitted model
+#: replaces these the moment a sweep lands rows.
+_STEP_S = {"interpret": 2e-4, "compiled": 2e-6}
+_BYTE_S = {"interpret": 2e-9, "compiled": 1.2e-12}
+
+
+def _mode(interpret):
+    return "interpret" if interpret else "compiled"
+
+
+# ---------------------------------------------------------------------------
+# tiling feasibility — the kernels' size-guard math, kept pure
+# ---------------------------------------------------------------------------
+
+def fit_blocks(t, v, block_t, block_v, interpret):
+    """(bt, bv) tile sizes for a (T, V) blockwise-CE/MLM-head problem,
+    or None when it cannot tile: halve each block until it divides its
+    axis; sub-8 tiles never tile, and compiled Mosaic needs the
+    128-lane alignment (the loss/lse outputs put block_t on the lane
+    dim). Interpret mode (CPU tests) accepts any divisible >= 8 tile.
+    (Single source of truth — ``blockwise_ce.fit_blocks`` re-exports
+    this.)"""
+    bt, bv = min(block_t, t), min(block_v, v)
+    while bt >= 1 and t % bt:
+        bt //= 2
+    while bv >= 1 and v % bv:
+        bv //= 2
+    if bt < 8 or bv < 8:
+        return None
+    if not interpret and (bt < 128 or bv < 128):
+        return None
+    return bt, bv
+
+
+def _adam_tiles(n, block_rows, interpret):
+    """(block_rows_eff, rows_padded) of the fused-adam lane layout for
+    an n-element parameter, or None (too small / misaligned) — mirrors
+    fused_adam's own guards."""
+    rows = -(-int(n) // LANES)
+    if rows < 8:
+        return None
+    rows = -(-rows // 8) * 8
+    br = min(int(block_rows), rows)
+    if not interpret and br % 8:
+        return None
+    rows_p = -(-rows // br) * br
+    return br, rows_p
+
+
+def _ln_tiles(rows, cols, block_rows, interpret):
+    """(block_rows_eff, rows_padded) for fused_layer_norm, or None —
+    mirrors its guards (compiled Mosaic wants cols 128-aligned and a
+    128-multiple row block)."""
+    rows, cols = int(rows), int(cols)
+    if rows < 1 or cols < 8:
+        return None
+    br = min(int(block_rows), max(rows, 1))
+    if not interpret:
+        br = (br // 128) * 128
+        if cols % 128 or br < 128:
+            return None
+    br = max(br, 1)
+    rows_p = -(-rows // br) * br
+    return br, rows_p
+
+
+# ---------------------------------------------------------------------------
+# analytic features
+# ---------------------------------------------------------------------------
+
+def features(op, shape, config, interpret):
+    """Feature dict for one (op, shape, block config), or None when the
+    config cannot tile the shape (mirrors the kernel size guards, so an
+    infeasible candidate is pruned before anything is measured):
+
+      grid        -- total grid steps across the op's fwd+bwd kernels
+      tile_bytes  -- VMEM-resident bytes of one grid step
+      total_bytes -- padded HBM traffic of one fwd+bwd step
+      pad_waste   -- padded/real element ratio - 1
+    """
+    shape = tuple(int(d) for d in shape)
+    cfg = dict(config or {})
+    if op in ("softmax_with_cross_entropy", "fused_mlm_head_loss"):
+        if len(shape) != 2:
+            return None
+        t, v = shape
+        fit = fit_blocks(t, v, cfg.get("block_t", 128),
+                         cfg.get("block_v", 512), interpret)
+        if fit is None:
+            return None
+        bt, bv = fit
+        grid1 = (t // bt) * (v // bv)
+        if op == "softmax_with_cross_entropy":
+            # fwd reads logits, bwd reads them again and writes dx
+            return {"grid": 2 * grid1, "tile_bytes": 4 * bt * bv,
+                    "total_bytes": 3 * 4 * t * v, "pad_waste": 0.0}
+        d = HEAD_D[_mode(interpret)]
+        if d % 8:
+            return None
+        # fwd + dh + dwb kernels; each tile holds the (bt, d) hidden
+        # block, the (d, bv) weight block and the in-VMEM logits tile
+        tile = 4 * (bt * d + d * bv + bt * bv)
+        total = 3 * 4 * grid1 * (bt * d + d * bv)
+        return {"grid": 3 * grid1, "tile_bytes": tile,
+                "total_bytes": total, "pad_waste": 0.0}
+    if op == "adam":
+        n = int(np.prod(shape, dtype=np.int64))
+        fit = _adam_tiles(n, cfg.get("block_rows", 256), interpret)
+        if fit is None:
+            return None
+        br, rows_p = fit
+        padded = rows_p * LANES
+        # read p/g/m1/m2, write p/m1/m2 — 7 streams of the lane layout
+        return {"grid": rows_p // br, "tile_bytes": 7 * 4 * br * LANES,
+                "total_bytes": 7 * 4 * padded,
+                "pad_waste": padded / float(max(n, 1)) - 1.0}
+    if op == "layer_norm":
+        if len(shape) != 2:
+            return None
+        r, c = shape
+        fit = _ln_tiles(r, c, cfg.get("block_rows", 128), interpret)
+        if fit is None:
+            return None
+        br, rows_p = fit
+        padded = rows_p * c
+        # fwd reads x writes y; bwd reads x/g writes dx (+ row residuals)
+        return {"grid": 2 * (rows_p // br),
+                "tile_bytes": 2 * 4 * br * c,
+                "total_bytes": 5 * 4 * padded,
+                "pad_waste": padded / float(max(r * c, 1)) - 1.0}
+    return None
+
+
+def _phi(f):
+    """Fit basis: [1, grid, total_MB, tile_MB, waste_MB] — small, all
+    physically monotonic, shared by every family (the per-family
+    weight vectors give each its own physics)."""
+    total_mb = f["total_bytes"] / 1e6
+    return np.array([1.0, float(f["grid"]), total_mb,
+                     f["tile_bytes"] / 1e6, f["pad_waste"] * total_mb],
+                    dtype=np.float64)
+
+
+def analytic_seconds(f, interpret):
+    """The no-data proxy: bytes over bandwidth + per-grid-step
+    overhead, with a soft cliff past the VMEM budget. Replaced by the
+    fitted model as soon as a segment has rows; until then only the
+    RANKING it induces matters."""
+    mode = _mode(interpret)
+    t = f["total_bytes"] * _BYTE_S[mode] + f["grid"] * _STEP_S[mode]
+    if f["tile_bytes"] > VMEM_BUDGET:
+        t *= 4.0 * f["tile_bytes"] / VMEM_BUDGET
+    return t
+
+
+# ---------------------------------------------------------------------------
+# cache-key / tag plumbing shared with autotune
+# ---------------------------------------------------------------------------
+
+def config_tag(config):
+    """The sweep's per-candidate tag: ``"block_t=8,block_v=64"``."""
+    return ",".join("%s=%s" % kv for kv in sorted((config or {}).items()))
+
+
+def parse_tag(tag):
+    """Inverse of :func:`config_tag` (int-valued block knobs)."""
+    cfg = {}
+    for item in str(tag).split(","):
+        if not item:
+            continue
+        k, _, v = item.partition("=")
+        try:
+            cfg[k] = int(v)
+        except ValueError:
+            cfg[k] = v
+    return cfg
+
+
+def parse_key(key):
+    """Split a ``pallas_dispatch.cache_key`` back into
+    ``(op, shape, dtype, axes, backend)`` — how the fit recovers the
+    problem geometry from banked rows. Returns None for keys this
+    model version cannot parse (forward compat: unknown keys are
+    skipped, never fatal)."""
+    parts = str(key).split("|")
+    if len(parts) != 5:
+        return None
+    op, dims, dtype, axes, backend = parts
+    try:
+        shape = tuple(int(d) for d in dims.split("x"))
+    except ValueError:
+        return None
+    return op, shape, dtype, axes, backend
+
+
+# ---------------------------------------------------------------------------
+# the model
+# ---------------------------------------------------------------------------
+
+#: fewer measured rows than this on a key and "best in top-k" is moot —
+#: shared by tunecheck, bench_micro and the ranking-quality tests
+MIN_RANK_ROWS = 4
+
+
+def measured_best_in_topk(entries, model=None, k=3,
+                          min_rows=MIN_RANK_ROWS):
+    """Ranking-quality census over banked entries: ``(hits, judged)``
+    where judged counts keys with >= ``min_rows`` numeric per-candidate
+    rows and hits counts those whose measured-best config lands in the
+    model's top-``k`` ranking of exactly those rows' configs. The ONE
+    implementation behind the tunecheck gate, the bench_micro budget
+    and the test batteries — they must all judge the same population.
+    ``model`` defaults to an in-sample fit over ``entries``."""
+    data = entries.load() if hasattr(entries, "load") else dict(entries)
+    if model is None:
+        model = CostModel().fit_cache(data)
+    hits = judged = 0
+    for key, entry in data.items():
+        parsed = parse_key(key)
+        if parsed is None or not isinstance(entry, dict):
+            continue
+        results = {t: s for t, s in (entry.get("results") or {}).items()
+                   if isinstance(s, (int, float))}
+        if len(results) < min_rows:
+            continue
+        op, shape, _dtype, _axes, backend = parsed
+        ranked = model.rank(op, shape,
+                            [parse_tag(t) for t in results],
+                            backend=backend,
+                            interpret=bool(entry.get("interpret")))
+        topk = [config_tag(c) for c, _s, _src in ranked[:k]]
+        judged += 1
+        hits += min(results, key=results.get) in topk
+    return hits, judged
+
+
+class CostModel(object):
+    """Per-family analytic+fitted cost model.
+
+    ``candidates`` maps op -> candidate config list: the space
+    :meth:`rank`/:meth:`predict_config` search when the caller does not
+    hand one in (normally ``autotune.candidates_for``'s grids). Rows
+    are segmented by (op, backend, interpret) so chip measurements
+    never contaminate interpreter predictions.
+    """
+
+    def __init__(self, candidates=None):
+        self.candidates = {op: [dict(c) for c in cfgs]
+                           for op, cfgs in (candidates or {}).items()}
+        self._rows = {}          # segment -> [(phi, seconds)]
+        self._theta = {}         # segment -> weight vector
+        self._n_rows = 0
+        self._src = None         # (path, entry count) of the last fit
+
+    # -- rows ----------------------------------------------------------
+    def add_row(self, op, shape, config, seconds, backend=None,
+                interpret=False):
+        """One measured (op, shape, config) -> seconds observation."""
+        f = features(op, shape, config, interpret)
+        if f is None or seconds is None:
+            return False
+        seg = (op, backend or "-", bool(interpret))
+        self._rows.setdefault(seg, []).append(
+            (_phi(f), float(seconds)))
+        self._n_rows += 1
+        self._theta.pop(seg, None)     # refit lazily
+        return True
+
+    def fit_cache(self, cache):
+        """Ingest every measured row an AutotuneCache banked: each
+        entry's per-candidate ``results`` (all sweep timings) plus the
+        winner's own ``pallas_s``. Unparseable keys/tags are skipped —
+        a hand-edited cache degrades the fit, never the load."""
+        data = cache.load() if hasattr(cache, "load") else dict(cache)
+        for key, entry in data.items():
+            parsed = parse_key(key)
+            if parsed is None or not isinstance(entry, dict):
+                continue
+            op, shape, _dtype, _axes, backend = parsed
+            interp = bool(entry.get("interpret"))
+            results = entry.get("results") or {}
+            seen = False
+            for tag, sec in results.items():
+                if isinstance(sec, dict):      # rich summary row
+                    sec = sec.get("measured_s")
+                if not isinstance(sec, (int, float)):
+                    continue
+                seen |= self.add_row(op, shape, parse_tag(tag), sec,
+                                     backend=backend, interpret=interp)
+            if not seen and entry.get("impl") == "pallas" and \
+                    entry.get("config") and entry.get("pallas_s"):
+                self.add_row(op, shape, entry["config"],
+                             entry["pallas_s"], backend=backend,
+                             interpret=interp)
+        self._src = (getattr(cache, "path", None), len(data))
+        return self
+
+    # -- fit / predict -------------------------------------------------
+    def _weights(self, seg):
+        """Per-segment weight vector over log-seconds (predictions are
+        ``exp(phi . theta)`` — always positive, so one ranking never
+        mixes fitted and analytic scales), or None below the row floor.
+        """
+        if seg in self._theta:
+            return self._theta[seg]
+        rows = self._rows.get(seg)
+        theta = None
+        if rows and len(rows) >= 6:    # > basis size: never underdetermined
+            A = np.stack([r[0] for r in rows])
+            b = np.log(np.maximum([r[1] for r in rows], 1e-12))
+            # column scaling keeps lstsq conditioned across the MB/grid
+            # magnitude spread
+            scale = np.maximum(np.abs(A).max(axis=0), 1e-12)
+            sol = np.linalg.lstsq(A / scale, b, rcond=None)[0]
+            theta = sol / scale
+        self._theta[seg] = theta
+        return theta
+
+    #: reported predicted seconds stay within this factor of the
+    #: analytic proxy: a fit extrapolated far outside its banked shape
+    #: range keeps its RANKING (the raw score orders candidates) but
+    #: must not export an absurd magnitude to spans/summaries
+    REPORT_ENVELOPE = 50.0
+
+    def _predict_raw(self, op, shape, config, backend=None,
+                     interpret=False):
+        """(reported_s, raw_score, source) or None when infeasible —
+        raw_score is the pure fit (what rankings sort by), reported_s
+        the envelope-clamped value callers may show humans."""
+        f = features(op, shape, config, interpret)
+        if f is None:
+            return None
+        ana = analytic_seconds(f, interpret)
+        theta = self._weights((op, backend or "-", bool(interpret)))
+        if theta is not None:
+            logt = float(np.dot(_phi(f), theta))
+            if math.isfinite(logt):
+                raw = math.exp(min(max(logt, -46.0), 46.0))
+                env = self.REPORT_ENVELOPE
+                return min(max(raw, ana / env), ana * env), raw, "fitted"
+        return ana, ana, "analytic"
+
+    def predict(self, op, shape, config, backend=None, interpret=False):
+        """(seconds, source) for one candidate, or (None, None) when it
+        cannot tile. source is "fitted" | "analytic"."""
+        out = self._predict_raw(op, shape, config, backend=backend,
+                                interpret=interpret)
+        if out is None:
+            return None, None
+        return out[0], out[2]
+
+    def rank(self, op, shape, candidates=None, backend=None,
+             interpret=False):
+        """Candidates ordered by predicted seconds (infeasible ones
+        dropped): list of ``(config, predicted_s, source)``. The order
+        comes from the raw fit scores; the listed seconds are the
+        envelope-clamped reported values."""
+        if candidates is None:
+            candidates = self.candidates.get(op, ())
+        scored = []
+        for cfg in candidates:
+            out = self._predict_raw(op, shape, cfg, backend=backend,
+                                    interpret=interpret)
+            if out is not None:
+                scored.append((dict(cfg), out[0], out[2], out[1]))
+        scored.sort(key=lambda x: x[3])
+        return [(c, t, src) for c, t, src, _raw in scored]
+
+    def top_k(self, op, shape, candidates=None, k=3, backend=None,
+              interpret=False):
+        """The pruned sweep: the K best-predicted feasible candidates
+        (the whole point — autotune measures these instead of the full
+        space)."""
+        return self.rank(op, shape, candidates, backend=backend,
+                         interpret=interpret)[:max(1, int(k))]
+
+    def predict_config(self, op, shape, backend=None, interpret=False):
+        """Best predicted config for a NEVER-SWEPT shape (trace-time
+        cache miss), or None when nothing in the candidate space tiles
+        it — the caller then keeps the kernel-default fallback."""
+        best = self.top_k(op, shape, k=1, backend=backend,
+                          interpret=interpret)
+        if not best:
+            return None
+        cfg, sec, src = best[0]
+        return {"config": cfg, "predicted_s": sec, "source": src}
+
+    # -- identity ------------------------------------------------------
+    def rows_total(self):
+        return self._n_rows
+
+    def fingerprint(self):
+        """Stable identity of (model version, candidate space, fitted
+        rows) — joins the executor compile-cache token so flipping the
+        model or re-banking a cache re-lowers."""
+        h = hashlib.sha1()
+        h.update(b"v%d|" % MODEL_VERSION)
+        h.update(json.dumps(self.candidates, sort_keys=True,
+                            default=str).encode())
+        for seg in sorted(self._rows):
+            rows = self._rows[seg]
+            h.update(("%s|%d|" % (seg, len(rows))).encode())
+            h.update(np.array([r[1] for r in rows]).tobytes())
+        return h.hexdigest()[:16]
+
+    def stats(self):
+        segs = sorted(self._rows)
+        return {"model_version": MODEL_VERSION,
+                "rows": self._n_rows,
+                "segments": ["%s@%s%s" % (op, be, "/interp" if it
+                                          else "")
+                             for op, be, it in segs],
+                "fitted": ["%s@%s%s" % (op, be, "/interp" if it else "")
+                           for op, be, it in segs
+                           if self._weights((op, be, it)) is not None],
+                "fingerprint": self.fingerprint()}

@@ -421,7 +421,8 @@ def test_autotune_all_failed_interpret_sweep_never_says_xla(tmp_path):
     # 512 elements -> 4 lane rows < 8: every adam candidate raises
     summary = at.autotune_op("adam", (512,), probes=1, interpret=True,
                              cache=cache)
-    assert all(v == "failed" for v in summary["results"].values())
+    assert all(r["status"] == "failed"
+               for r in summary["results"].values())
     assert summary["entry"]["impl"] == "pallas"
     assert summary["entry"]["config"] is None
 
@@ -475,7 +476,12 @@ def test_autotune_op_dry_sweep_persists_winner(tmp_path):
     assert os.path.exists(cache.path)
     fresh = at.AutotuneCache(cache.path)
     assert fresh.lookup(summary["key"])["config"] == entry["config"]
-    assert all(isinstance(v, float) for v in summary["results"].values())
+    assert all(r["status"] == "ok" and
+               isinstance(r["measured_s"], float)
+               for r in summary["results"].values())
+    # the winner's per-candidate rows are banked for future model fits
+    assert entry["results"] and all(
+        isinstance(s, float) for s in entry["results"].values())
 
 
 def test_tools_autotune_cli_dry_run(tmp_path, capsys):
@@ -494,8 +500,10 @@ def test_tools_autotune_cli_dry_run(tmp_path, capsys):
     assert report["metric"] == "pallas_autotune" and report["ok"]
     assert report["dry_run"] and report["entries"] == 2
     data = json.load(open(cache))
-    assert len(data) == 2
-    for entry in data.values():
+    # versioned envelope (tools/tunecheck.py's format contract)
+    assert data["format_version"] == at.FORMAT_VERSION
+    assert len(data["entries"]) == 2
+    for entry in data["entries"].values():
         assert entry["impl"] == "pallas" and entry["interpret"]
     # bad op name is a usage error, not a crash
     with pytest.raises(SystemExit):

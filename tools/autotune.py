@@ -2,12 +2,20 @@
 """Pallas kernel autotuner CLI.
 
 Sweeps each kernel's block configs per (op, shape, dtype, topology,
-backend) with bounded probes, persists the winners (or the XLA-fallback
-verdict) in the JSON cache `CompiledProgram` loads at trace time via
-``BuildStrategy.pallas_tune_cache``, and prints ONE JSON summary line.
+backend) with bounded probes — pruned to the cost model's ``--top-k``
+best-predicted candidates by default (the full space with
+``--top-k 0``) — persists the winners (or the XLA-fallback verdict)
+plus every measured candidate row in the versioned JSON cache
+`CompiledProgram` loads at trace time via ``BuildStrategy.
+pallas_tune_cache`` / ``kernel_policy="auto"``, and prints ONE JSON
+summary line with predicted vs measured seconds per candidate.
 
 Usage:
-  python tools/autotune.py                       # all ops, chip shapes
+  python tools/autotune.py                       # all ops, chip shapes,
+                                                 # cost-model top-3
+  python tools/autotune.py --top-k 0             # exhaustive sweep
+  python tools/autotune.py --cost-model-only     # zero probes: bank the
+                                                 # predicted configs
   python tools/autotune.py --ops adam,layer_norm
   python tools/autotune.py --shape adam=1048576 \\
       --shape layer_norm=16384x768               # override sweep shapes
@@ -15,10 +23,16 @@ Usage:
   python tools/autotune.py --dry-run             # tiny shapes, interpret
                                                  # mode, CPU — the tier-1
                                                  # smoke of the harness
+  python tools/autotune.py --bank cpu-interpret  # refresh the committed
+                                                 # tools/tuned/ cache
+                                                 # (exhaustive, so the
+                                                 # fit rows stay whole)
 
 --dry-run never concludes "xla" (interpreter wall time says nothing
-about Mosaic) and defaults its cache to a throwaway file so a CI run
-cannot poison the real fleet cache.
+about Mosaic), defaults its cache to a throwaway file, and REFUSES to
+write into tools/tuned/ — a CI smoke cannot poison the banked fleet
+caches; only --bank (validated by tools/tunecheck.py afterwards) may
+write there.
 """
 import argparse
 import json
@@ -32,6 +46,15 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 def _parse_shape(text):
     return tuple(int(d) for d in text.lower().split("x"))
+
+
+def _under_tuned_dir(path, tuned_dir):
+    try:
+        return os.path.commonpath(
+            [os.path.abspath(path), os.path.abspath(tuned_dir)]) == \
+            os.path.abspath(tuned_dir)
+    except ValueError:  # pragma: no cover - different drives (win)
+        return False
 
 
 def main(argv=None):
@@ -49,10 +72,17 @@ def main(argv=None):
     ap.add_argument("--mesh-axes", default=None, metavar="AXIS=N,...",
                     help="mesh axes of the compile the cache will serve, "
                          "e.g. dp=8 — must match BuildStrategy.mesh_axes "
-                         "or the trace-time lookup misses (default: no "
-                         "mesh in the key)")
+                         "or the trace-time lookup falls back to the "
+                         "mesh-less key (default: no mesh in the key, "
+                         "serving every topology)")
     ap.add_argument("--probes", type=int, default=3,
                     help="timed calls per candidate (best-of)")
+    ap.add_argument("--top-k", type=int, default=3, metavar="K",
+                    help="measure only the K best cost-model-predicted "
+                         "candidates (0 = exhaustive sweep)")
+    ap.add_argument("--cost-model-only", action="store_true",
+                    help="measure NOTHING: bank the model's top "
+                         "predicted config per key (zero probes)")
     ap.add_argument("--cache", default=None,
                     help="cache JSON path (default: %s or ~/.cache/"
                          "paddle_tpu/pallas_autotune.json)"
@@ -62,6 +92,12 @@ def main(argv=None):
     ap.add_argument("--dry-run", action="store_true",
                     help="tiny shapes + interpret mode + 1 probe: "
                          "exercises the sweep harness itself on CPU")
+    ap.add_argument("--bank", default=None, metavar="BACKEND",
+                    help="refresh the committed tools/tuned/{BACKEND}"
+                         ".json: exhaustive sweep over the banking grid "
+                         "(cpu-interpret = interpret-mode multi-shape "
+                         "grid; anything else = DEFAULT_SHAPES on the "
+                         "attached backend)")
     args = ap.parse_args(argv)
 
     ops = [o.strip() for o in args.ops.split(",") if o.strip()]
@@ -69,6 +105,15 @@ def main(argv=None):
     if unknown:
         ap.error("unknown ops %r (available: %s)"
                  % (unknown, ",".join(pd.PALLAS_OPS)))
+    if args.dry_run and args.bank:
+        ap.error("--dry-run and --bank are mutually exclusive (a smoke "
+                 "run must never write the committed caches)")
+    if args.cost_model_only and args.bank:
+        ap.error("--cost-model-only and --bank are mutually exclusive: "
+                 "the committed caches hold MEASURED rows (the cost "
+                 "model fits learn from them) — a zero-probe bank would "
+                 "pass tunecheck's format/coverage gates while teaching "
+                 "future fits nothing")
     mesh_axes = None
     if args.mesh_axes:
         try:
@@ -78,40 +123,88 @@ def main(argv=None):
         except ValueError:
             ap.error("bad --mesh-axes %r (want AXIS=N,...)"
                      % args.mesh_axes)
-    shapes = dict(at.DRY_SHAPES if args.dry_run else at.DEFAULT_SHAPES)
-    for item in args.shape:
-        op, _, dims = item.partition("=")
-        if op not in shapes or not dims:
-            ap.error("bad --shape %r (want OP=DIMxDIM)" % item)
-        shapes[op] = _parse_shape(dims)
 
-    cache_path = args.cache
-    if cache_path is None and args.dry_run:
-        fd, cache_path = tempfile.mkstemp(prefix="pallas_autotune_dry_",
-                                          suffix=".json")
-        os.close(fd)
-    cache = at.AutotuneCache(cache_path)
+    bank_interpret = None
+    per_op_shapes = None
+    if args.bank:
+        name = args.bank
+        bank_interpret = name.endswith("-interpret")
+        if bank_interpret:
+            per_op_shapes = {op: list(at.BANK_SHAPES.get(op, ()))
+                             for op in ops}
+        else:
+            per_op_shapes = {op: [at.DEFAULT_SHAPES[op]] for op in ops}
+        cache_path = os.path.join(at.tuned_dir(), name + ".json")
+    else:
+        shapes = dict(at.DRY_SHAPES if args.dry_run
+                      else at.DEFAULT_SHAPES)
+        for item in args.shape:
+            op, _, dims = item.partition("=")
+            if op not in shapes or not dims:
+                ap.error("bad --shape %r (want OP=DIMxDIM)" % item)
+            shapes[op] = _parse_shape(dims)
+        per_op_shapes = {op: [shapes[op]] for op in ops}
+        cache_path = args.cache
+        if cache_path is None and args.dry_run:
+            fd, cache_path = tempfile.mkstemp(
+                prefix="pallas_autotune_dry_", suffix=".json")
+            os.close(fd)
+
+    if args.dry_run and cache_path and \
+            _under_tuned_dir(cache_path, at.tuned_dir()):
+        ap.error("--dry-run refuses to write into tools/tuned/ (%s): "
+                 "the committed banked caches are refreshed by --bank "
+                 "only" % cache_path)
+
+    meta = None
+    if args.bank:
+        meta = {"backend": args.bank,
+                "interpret": bool(bank_interpret),
+                "model_version": at.cm.MODEL_VERSION,
+                "grid": {op: [list(s) for s in shp]
+                         for op, shp in per_op_shapes.items()}}
+    cache = at.AutotuneCache(cache_path, meta=meta)
 
     probes = 1 if args.dry_run else args.probes
-    interpret = True if args.dry_run else None
+    interpret = True if (args.dry_run or bank_interpret) else None
+    # banking keeps the rows whole (the fit learns from ALL of them);
+    # --top-k 0 is the explicit exhaustive switch elsewhere
+    top_k = None if (args.bank or args.top_k <= 0 or
+                     args.cost_model_only) else args.top_k
+    candidates = None
     summaries = {}
     ok = True
     for op in ops:
-        try:
-            summaries[op] = at.autotune_op(
-                op, shapes[op], dtype=args.dtype, probes=probes,
-                interpret=interpret, cache=cache, mesh_axes=mesh_axes,
-                candidate_deadline_s=args.candidate_deadline_s)
-        except Exception as e:  # one broken sweep must not eat the rest
-            summaries[op] = {"op": op, "error": "%s: %s"
-                             % (type(e).__name__, e)}
-            ok = False
+        op_sums = []
+        for shape in per_op_shapes.get(op, ()):
+            if args.bank and bank_interpret:
+                candidates = at.BANK_CANDIDATES.get(op)
+            try:
+                op_sums.append(at.autotune_op(
+                    op, shape, dtype=args.dtype, probes=probes,
+                    interpret=interpret, cache=cache,
+                    candidates=candidates, mesh_axes=mesh_axes,
+                    candidate_deadline_s=args.candidate_deadline_s,
+                    top_k=top_k,
+                    cost_model_only=args.cost_model_only))
+            except Exception as e:  # one broken sweep must not eat the rest
+                op_sums.append({"op": op, "shape": list(shape),
+                                "error": "%s: %s"
+                                % (type(e).__name__, e)})
+                ok = False
+        summaries[op] = op_sums[0] if len(op_sums) == 1 else op_sums
     print(json.dumps({
         "metric": "pallas_autotune",
         "dry_run": bool(args.dry_run),
+        "bank": args.bank,
+        "top_k": top_k,
+        "cost_model_only": bool(args.cost_model_only),
         "cache": cache.path,
         "entries": len(cache),
-        "ok": ok and all("entry" in s for s in summaries.values()),
+        "ok": ok and all(
+            "entry" in s
+            for sums in summaries.values()
+            for s in (sums if isinstance(sums, list) else [sums])),
         "sweeps": summaries,
     }))
     return 0 if ok else 1

@@ -1,0 +1,159 @@
+#!/usr/bin/env python
+"""Banked tuned-cache validator — the tier-1 gate for ``tools/tuned/``.
+
+The per-backend caches committed under tools/tuned/ are shared fleet
+state: CI, bench rounds and serving replicas all trace against their
+verdicts (``BuildStrategy.kernel_policy="auto"``). A torn, stale or
+hand-mangled file there would silently mistune every consumer, so this
+tool fails FAST instead. Per file it checks:
+
+  1. **format**: parseable JSON, versioned envelope with
+     ``format_version == autotune.FORMAT_VERSION``, ``backend`` meta
+     matching the filename;
+  2. **entries**: every key parses back into a known kernel family
+     with integer shapes and the file's platform; impls are
+     ``pallas|xla|pallas_q``; a winner config actually tiles its shape
+     (the cost model's feature map — which mirrors the kernel size
+     guards — accepts it);
+  3. **coverage**: every (op, shape) of the backend's sweep grid is
+     banked — the interpret banking grid (``autotune.BANK_SHAPES``)
+     for cpu-interpret, ``autotune.DEFAULT_SHAPES`` (the ERNIE
+     headline geometry) for real backends;
+  4. **ranking quality**: a cost model fit on the file's own measured
+     rows must place the measured-best config in its top-3 ranking on
+     >= 80% (``--min-top3``) of the keys that banked enough rows to
+     judge — the gate that keeps the top-k pruned sweeps honest.
+
+Prints ONE JSON line; exit 0 only when every checked file passes.
+
+Usage:
+  python tools/tunecheck.py                  # every tools/tuned/*.json
+  python tools/tunecheck.py --file tools/tuned/cpu-interpret.json
+  python tools/tunecheck.py --min-top3 0.9
+"""
+import argparse
+import glob
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+
+def check_file(path, min_top3=0.8):
+    """Validate one banked cache; returns the per-file report dict
+    (``ok`` False plus a ``problems`` list on any failure)."""
+    from paddle_tpu.ops.pallas import autotune as at
+    from paddle_tpu.ops.pallas import costmodel as cm
+    problems = []
+    name = os.path.splitext(os.path.basename(path))[0]
+    platform = "cpu" if name == "cpu-interpret" else name
+    try:
+        with open(path) as f:
+            raw = json.load(f)
+    except (OSError, ValueError) as e:
+        return {"file": path, "ok": False,
+                "problems": ["unreadable/torn JSON: %s" % e]}
+    if not isinstance(raw, dict) or "format_version" not in raw:
+        return {"file": path, "ok": False,
+                "problems": ["not a versioned banked cache (no "
+                             "format_version envelope)"]}
+    entries, meta = at.AutotuneCache.parse_blob(raw)
+    try:
+        ver = int(raw["format_version"])
+    except (TypeError, ValueError):
+        ver = None
+    if ver != at.FORMAT_VERSION:
+        problems.append("format_version %r unsupported (this build "
+                        "speaks %d)" % (raw["format_version"],
+                                        at.FORMAT_VERSION))
+    if meta.get("backend") != name:
+        problems.append("backend meta %r does not match filename %r"
+                        % (meta.get("backend"), name))
+    interpret = bool(meta.get("interpret"))
+    if (name == "cpu-interpret") != interpret:
+        problems.append("interpret meta %r inconsistent with backend "
+                        "%r" % (meta.get("interpret"), name))
+
+    # -- entries ------------------------------------------------------
+    banked = set()
+    for key, entry in sorted(entries.items()):
+        parsed = cm.parse_key(key)
+        if parsed is None:
+            problems.append("unparseable key %r" % key)
+            continue
+        op, shape, _dtype, _axes, backend = parsed
+        if op not in at.CANDIDATES:
+            problems.append("key %r names unknown kernel family %r"
+                            % (key, op))
+            continue
+        if backend != platform:
+            problems.append("key %r banked for backend %r in the %s "
+                            "file" % (key, backend, name))
+        if not isinstance(entry, dict) or entry.get("impl") not in (
+                "pallas", "xla", "pallas_q"):
+            problems.append("key %r has invalid impl %r"
+                            % (key, entry.get("impl")
+                               if isinstance(entry, dict) else entry))
+            continue
+        if interpret and entry.get("impl") == "xla":
+            problems.append("key %r: an interpret sweep banked an "
+                            "'xla' verdict (interpreter wall time says "
+                            "nothing about Mosaic)" % key)
+        config = entry.get("config")
+        if config is not None and cm.features(
+                op, shape, config, bool(entry.get("interpret",
+                                                  interpret))) is None:
+            problems.append("key %r winner config %r cannot tile its "
+                            "shape" % (key, config))
+        banked.add((op, shape))
+
+    # -- coverage -----------------------------------------------------
+    required = at.BANK_SHAPES if interpret else \
+        {op: [at.DEFAULT_SHAPES[op]] for op in at.DEFAULT_SHAPES}
+    missing = ["%s@%s" % (op, "x".join(map(str, shape)))
+               for op, shapes in sorted(required.items())
+               for shape in shapes if (op, tuple(shape)) not in banked]
+    if missing:
+        problems.append("grid coverage holes: %s" % ", ".join(missing))
+
+    # -- ranking quality ----------------------------------------------
+    model = cm.CostModel().fit_cache(entries)
+    hits, judged = cm.measured_best_in_topk(entries, model=model)
+    top3_rate = round(hits / judged, 4) if judged else None
+    if judged and top3_rate < min_top3:
+        problems.append("cost-model ranking too weak: measured-best in "
+                        "model top-3 on only %.0f%% of %d keys (< %.0f%%)"
+                        % (100 * top3_rate, judged, 100 * min_top3))
+
+    return {"file": path, "ok": not problems, "backend": name,
+            "entries": len(entries), "coverage_missing": len(missing),
+            "rank_keys_judged": judged, "top3_rate": top3_rate,
+            "model_rows": model.rows_total(),
+            "problems": problems or None}
+
+
+def main(argv=None):
+    from paddle_tpu.ops.pallas import autotune as at
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--file", action="append", default=[],
+                    help="banked cache file(s) to check (default: "
+                         "every tools/tuned/*.json)")
+    ap.add_argument("--min-top3", type=float, default=0.8,
+                    help="minimum measured-best-in-model-top-3 rate")
+    args = ap.parse_args(argv)
+    files = args.file or sorted(glob.glob(
+        os.path.join(at.tuned_dir(), "*.json")))
+    reports = [check_file(p, min_top3=args.min_top3) for p in files]
+    ok = bool(reports) and all(r["ok"] for r in reports)
+    print(json.dumps({"metric": "tunecheck", "ok": ok,
+                      "files": reports or
+                      [{"problems": ["no banked caches found under %s"
+                                     % at.tuned_dir()]}]}))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
