@@ -174,6 +174,15 @@ BUDGETS = {
     "pp_step_s": ("max", 30.0),
     "pp_bubble_frac": ("max", 0.95),
     "pp_cache_hit_rate": ("min", 0.4),
+    # Program verifier (ISSUE 15): one strict walk over the BERT-base
+    # pretrain program must stay interactive (it is pure Python, no
+    # tracing), and on the shared small step it must cost well under
+    # the trace+lower wall it fronts — "warn" by default stays free.
+    # Zero error-severity diagnostics on the clean headline program is
+    # the bench-side no-false-positive gate.
+    "analysis_verify_s": ("max", 10.0),
+    "analysis_overhead_ratio": ("max", 0.5),
+    "analysis_bert_errors": ("max", 0),
 }
 
 # metric -> worsening factor vs the rounds-history median that counts as
@@ -981,6 +990,61 @@ def bench_obs(steps=11, requests=21):
     return out
 
 
+def bench_analysis():
+    """Program-verifier wall (ISSUE 15): the cost of keeping
+    BuildStrategy.verify_program="warn" ON by default.
+
+      analysis_verify_s        — one strict verifier walk over the
+                                 ERNIE/BERT-base pretrain program (the
+                                 headline graph: 12 layers, full op
+                                 count — graph size is what the walk
+                                 scales with, feed shapes are free)
+      analysis_overhead_ratio  — verifier wall / trace+lower wall on
+                                 the SAME small train step: the
+                                 verifier must stay ≪ the compile work
+                                 it fronts, or "warn by default" stops
+                                 being free
+      analysis_bert_errors     — error-severity diagnostics on the
+                                 clean headline program (must be 0:
+                                 the no-false-positive contract,
+                                 gated here as well as in tests)
+    """
+    import numpy as np
+    import paddle_tpu as pt
+    from paddle_tpu.framework import analysis
+    from paddle_tpu.framework.scope import Scope, scope_guard
+    from paddle_tpu.models import bert
+
+    cfg = bert.bert_base()
+    main, startup, feeds, fetch = bert.bert_pretrain_program(
+        cfg, batch_size=8, seq_len=128)
+    feed_names = [getattr(f, "name", f) for f in (
+        feeds.values() if isinstance(feeds, dict) else feeds)]
+    t0 = time.perf_counter()
+    result = analysis.verify_program(main, feeds=feed_names,
+                                     fetch_list=list(fetch.values()))
+    verify_s = time.perf_counter() - t0
+
+    with scope_guard(Scope()):
+        small_main, small_startup, loss = _build_train()
+        exe = pt.Executor()
+        exe.run(small_startup)
+        feed = _batch(np.random.RandomState(0))
+        t0 = time.perf_counter()
+        exe.dump_hlo(small_main, feed=feed, fetch_list=[loss],
+                     include_compiled=False)
+        lower_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        analysis.verify_program(
+            small_main, feeds={k: np.shape(v) for k, v in feed.items()},
+            fetch_list=[loss])
+        small_verify_s = time.perf_counter() - t0
+    return {"analysis_verify_s": round(verify_s, 4),
+            "analysis_overhead_ratio": round(
+                small_verify_s / max(lower_s, 1e-9), 4),
+            "analysis_bert_errors": len(result.errors())}
+
+
 # ---------------------------------------------------------------------------
 # round trend tracking
 # ---------------------------------------------------------------------------
@@ -1064,7 +1128,8 @@ def run_all(rounds_dir=None):
                      ("failover", bench_failover),
                      ("serving", bench_serving),
                      ("router_failover", bench_router_failover),
-                     ("obs", bench_obs)):
+                     ("obs", bench_obs),
+                     ("analysis", bench_analysis)):
         t0 = time.perf_counter()
         try:
             metrics.update(fn())

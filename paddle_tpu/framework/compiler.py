@@ -27,6 +27,80 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 _MANUAL_COLLECTIVE_LOCK = threading.Lock()
 
 
+def _env_verify_default():
+    """Suite-wide verifier arming without code changes:
+    BuildStrategy.verify_program defaults to PADDLE_TPU_VERIFY
+    ("strict" | "warn" | "off"; unset/unknown = "warn" — diagnostics
+    are logged, never fatal). The test suite pins "strict"."""
+    from .analysis import env_verify_mode
+    return env_verify_mode()
+
+
+def verify_for_compile(program, build_strategy=None, feeds=None,
+                       fetch_names=None, source="compile"):
+    """Run the Program verifier at a compile seam (framework/analysis).
+
+    Mode comes from BuildStrategy.verify_program (env default for the
+    plain-Executor path): "off" returns immediately — byte-for-byte
+    inert on the compile path; "warn" logs errors/warnings and records
+    the analysis metrics; "strict" raises ProgramVerificationError
+    when any error-severity diagnostic survives, listing ALL of them.
+
+    Memoized per (program version, mode, mesh, feed/fetch signature) on
+    the program object, so only compile-cache misses pay the walk and
+    repeat dispatches cost one dict probe."""
+    mode = getattr(build_strategy, "verify_program", None) \
+        if build_strategy is not None else None
+    if mode is None:
+        mode = _env_verify_default()
+    if mode == "off":
+        return None
+    feed_sig = None if feeds is None else tuple(
+        sorted((k, tuple(np.shape(v)) if not isinstance(v, tuple)
+                else v) for k, v in feeds.items()))
+    bs = build_strategy
+    if bs is None:
+        mesh, strat_sig = None, None
+    else:
+        mesh = getattr(bs, "mesh_axes", None)
+        # every strategy knob a pass consumes joins the memo key — two
+        # strategies sharing one Program must never share a verdict
+        strat_sig = (getattr(bs, "data_axis", "dp"),
+                     getattr(bs, "quantize_collectives", False),
+                     getattr(bs, "pp_stages", None),
+                     getattr(bs, "pp_micro_batches", 1),
+                     getattr(bs, "pp_schedule", "1f1b"))
+    key = (program._version, mode,
+           None if mesh is None else tuple(sorted(mesh.items())),
+           strat_sig, feed_sig,
+           None if fetch_names is None else tuple(fetch_names))
+    cache = getattr(program, "_verify_cache", None)
+    if cache is None:
+        cache = program._verify_cache = {}
+    if key in cache:
+        result = cache[key]
+    else:
+        # evict verdicts of older program versions — a mutate-run loop
+        # must not accumulate one AnalysisResult per historical version
+        for k in [k for k in cache if k[0] != program._version]:
+            del cache[k]
+        from . import analysis
+        result = analysis.verify_program(
+            program, feeds=feeds, fetch_list=fetch_names,
+            build_strategy=build_strategy)
+        analysis.report(result, mode=mode, source=source)
+        cache[key] = result
+        if result.errors() or result.warnings():
+            import logging
+            logging.getLogger("paddle_tpu").warning(
+                "program verification (%s mode): %s", mode,
+                result.summary())
+    if mode == "strict" and result.errors():
+        from .analysis import ProgramVerificationError
+        raise ProgramVerificationError(result)
+    return result
+
+
 def _env_timeout_default():
     """Fleet-wide watchdog arming without code changes: BuildStrategy's
     collective_timeout_s defaults to PADDLE_TPU_COLLECTIVE_TIMEOUT_S
@@ -125,6 +199,15 @@ class BuildStrategy(object):
         self.pp_stages = None
         self.pp_micro_batches = 1
         self.pp_schedule = "1f1b"
+        # Program IR verification at CompilePlan build time
+        # (framework/analysis.py): "strict" fails the compile on any
+        # error-severity diagnostic (ALL violations listed, not
+        # first-error-wins), "warn" (default; env PADDLE_TPU_VERIFY
+        # overrides) logs + exports analysis metrics, "off" skips the
+        # verifier entirely. Diagnostics-only — the knob can never
+        # change the lowered executable, so it is deliberately NOT part
+        # of the compile-cache token (tools/codelint.py allowlists it).
+        self.verify_program = _env_verify_default()
         # once-per-k quantized sync for gradient-merge windows (OPT-IN):
         # when a grad-merge accumulator structure is detected, the
         # quantized dp sync moves from every micro step's raw gradient
@@ -356,7 +439,21 @@ class CompiledProgram(object):
         program first (kind "pipeline") and the executor routes the
         step through the GPipe/1F1B lowering. The plan's token keys the
         executor step cache: (mesh axes, pp cut, schedule) ride along-
-        side the existing strategy token."""
+        side the existing strategy token.
+
+        The Program verifier runs HERE, before any lowering work — on
+        the pp route that means pipeline misconfiguration surfaces as a
+        complete diagnostics list BEFORE extract_compiled_pp_plan's
+        first-named-error (framework/analysis.py). Skipped when this
+        program version was already verified (the executor's pp seam
+        runs a STRONGER feed-ful walk just before calling here — a
+        second feed-less walk would only double-count the analysis
+        metrics)."""
+        cache = getattr(self._program, "_verify_cache", None)
+        if not cache or all(k[0] != self._program._version
+                            for k in cache):
+            verify_for_compile(self._program, self._build_strategy,
+                               source="compile_plan")
         if not self._pp_enabled():
             return CompilePlan("single_jit", self._cache_token())
         from ..distributed import pipeline_program as ppp

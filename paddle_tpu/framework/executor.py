@@ -406,6 +406,13 @@ class Executor(object):
             sp.set(cache="miss")
             t_compile = time.perf_counter()
             w_compile = obs.now()
+            from .compiler import verify_for_compile
+            verify_for_compile(
+                program,
+                None if strategy is None else strategy._build_strategy,
+                feeds={k: tuple(np.shape(v)[1:])
+                       for k, v in staged.items()},
+                fetch_names=fetch_names, source="compile")
             base_step = self._make_step(program, sorted(staged),
                                         fetch_names, state_names, uses_rng,
                                         check_numerics)
@@ -561,6 +568,15 @@ class Executor(object):
 
     def _compile(self, program, feed_vals, fetch_names, state_names,
                  uses_rng, strategy, check_numerics=False):
+        # Program verification at the compile seam (one walk per cache
+        # miss): located diagnostics BEFORE the trace turns a malformed
+        # program into a first-named-error or a jax traceback
+        from .compiler import verify_for_compile
+        verify_for_compile(
+            program,
+            None if strategy is None else strategy._build_strategy,
+            feeds={k: np.shape(v) for k, v in feed_vals.items()},
+            fetch_names=fetch_names, source="compile")
         step = self._make_step(program, sorted(feed_vals), fetch_names,
                                state_names, uses_rng, check_numerics)
         if strategy is not None:
@@ -772,10 +788,21 @@ class Executor(object):
         machinery see the usual layout); state is stacked onto the pp
         axis per dispatch and unstacked on the way out."""
         from ..distributed import pipeline_program as ppp
+        feed_vals = self._convert_feed(program, feed, steps_axis=windowed)
+        # verify WITH the real feed shapes + fetch roots before the cut:
+        # feed-dependent pp checks (micro-batch divisibility, dp batch
+        # divisibility, dead ops) must fire on the actual pp seam, not
+        # only in compile_plan's feed-less guard
+        from .compiler import verify_for_compile
+        verify_for_compile(
+            program, strategy._build_strategy,
+            feeds={k: (tuple(np.shape(v)[1:]) if windowed
+                       else tuple(np.shape(v)))
+                   for k, v in feed_vals.items()},
+            fetch_names=fetch_names, source="compile")
         cplan = strategy.compile_plan()
         cut = cplan.cut
         plan = cut.plan
-        feed_vals = self._convert_feed(program, feed, steps_axis=windowed)
         expect = set([plan.x_feed] + list(plan.y_feeds))
         if set(feed_vals) != expect:
             raise ValueError(

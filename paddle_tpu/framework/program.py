@@ -416,6 +416,14 @@ class Program(object):
         p._version = 0
         p.random_seed = self.random_seed
         p._op_role = "forward"
+        # vetted analysis exemptions (framework/analysis.allowlist) are
+        # a property of the graph, not the object: a clone — including
+        # clone(for_test=True) eval programs and _prune results — keeps
+        # them, or every eval compile would re-flag (or strict-fail) a
+        # diagnostic the builder already vetted
+        allow = getattr(self, "_analysis_allowlist", None)
+        if allow:
+            p._analysis_allowlist = dict(allow)
         for blk in self.blocks:
             nb = Block(p, blk.idx, blk.parent_idx)
             for v in blk.vars.values():

@@ -57,6 +57,7 @@ __all__ = [
     "record_router_slow",
     "router_totals", "clear_router",
     "observe_executor_step", "executor_step_totals", "clear_exec",
+    "record_analysis", "analysis_totals", "clear_analysis",
 ]
 
 INJECTION_POINTS = ("step", "ckpt_write", "serve")
@@ -184,6 +185,7 @@ def clear_events():
     clear_router()
     clear_exec()
     clear_kernel_choice()
+    clear_analysis()
 
 
 # ---------------------------------------------------------------------------
@@ -243,6 +245,34 @@ def kernel_choice_totals():
 def clear_kernel_choice():
     with _KCHOICE_LOCK:
         _KCHOICE.clear()
+
+
+# Program-verifier accounting (framework/analysis.py): one increment per
+# diagnostic at COMPILE rate, so cumulative process counters keyed
+# (pass, severity) — "is the fleet compiling clean programs" becomes a
+# scrapeable series; the per-verification summary rides the event log
+# as `program_analysis` events (analysis.report).
+_ANALYSIS = {}
+_ANALYSIS_LOCK = threading.Lock()
+
+
+def record_analysis(pass_name, severity, n=1):
+    """Count verifier diagnostics: exported by :func:`metrics` as
+    ``<prefix>_analysis_diagnostics_total{pass=,severity=}``."""
+    with _ANALYSIS_LOCK:
+        k = (str(pass_name), str(severity))
+        _ANALYSIS[k] = _ANALYSIS.get(k, 0) + int(n)
+
+
+def analysis_totals():
+    """Snapshot ``{(pass, severity): count}``."""
+    with _ANALYSIS_LOCK:
+        return dict(_ANALYSIS)
+
+
+def clear_analysis():
+    with _ANALYSIS_LOCK:
+        _ANALYSIS.clear()
 
 
 def bytes_totals():
@@ -662,6 +692,14 @@ def metrics(event_list=None, by_host=False):
         counters.append(
             {"name": METRIC_PREFIX + "_kernel_choice_total",
              "labels": {"op": op, "impl": impl, "source": source},
+             "value": n})
+    # program-verifier diagnostics (framework/analysis.py): cumulative
+    # per-(pass, severity) counters — emitted only once a verification
+    # produced diagnostics, so clean jobs export nothing new
+    for (pass_name, severity), n in sorted(analysis_totals().items()):
+        counters.append(
+            {"name": METRIC_PREFIX + "_analysis_diagnostics_total",
+             "labels": {"pass": pass_name, "severity": severity},
              "value": n})
     # serving-fleet router series (cumulative process counters like the
     # byte pairs — NOT events; see record_router_request): emitted only

@@ -86,3 +86,33 @@ def has_op(type):
 
 def registered_ops():
     return sorted(_REGISTRY)
+
+
+# ---------------------------------------------------------------------------
+# static shape/dtype inference rules (framework/analysis.py's shape pass)
+#
+# Colocated with the kernel registry the same way the reference colocates
+# InferShape with each OpMaker: a rule is the kernel's static twin —
+# fn(op, ins, attrs) -> {out_slot: [TensorMeta, ...]} over abstract
+# (shape, dtype) metadata, raising ops.shape_rules.ShapeError on a
+# violation. Ops WITHOUT a rule infer top (unknown) and never produce a
+# diagnostic — the verifier must not false-positive on exotic kernels.
+# ---------------------------------------------------------------------------
+
+_SHAPE_RULES = {}
+
+
+def register_shape_rule(*types):
+    def deco(fn):
+        for t in types:
+            if t in _SHAPE_RULES:
+                raise ValueError("shape rule for %r already registered" % t)
+            _SHAPE_RULES[t] = fn
+        return fn
+    return deco
+
+
+def get_shape_rule(type):
+    """The op's static shape/dtype rule, or None (infer unknown)."""
+    from . import shape_rules  # noqa: F401  (registers the rule set)
+    return _SHAPE_RULES.get(type)

@@ -277,6 +277,13 @@ class ServingPredictor(object):
                 "this library's %d"
                 % (dirname, self._meta["format_version"],
                    SERVING_FORMAT_VERSION))
+        # progcheck at load (framework/analysis.py): when the export
+        # shipped its Program IR (__model__.json beside serving/), a
+        # corrupt program refuses to LOAD — so a bad artifact fails the
+        # rolling-deploy drain step (the replica returns to rotation on
+        # its old weights) instead of the first live request. Disable
+        # only via PADDLE_TPU_VERIFY=off (debug escape hatch).
+        self._verify_exported_program(dirname)
         if "feed_batch_factor" not in self._meta:
             # v1 artifacts: booleans, factor 1 semantics; outputs were
             # sliced when dim0 == bucket (factor 1)
@@ -291,6 +298,28 @@ class ServingPredictor(object):
             with open(os.path.join(out_dir, "export_b%s.bin" % key),
                       "rb") as f:
                 self._fns[int(key)] = jax_export.deserialize(f.read())
+
+    @staticmethod
+    def _verify_exported_program(dirname):
+        from .framework import analysis
+        if analysis.env_verify_mode() == "off":
+            return
+        model_path = os.path.join(dirname, "__model__.json")
+        if not os.path.exists(model_path):
+            return    # serving-only artifact: no IR shipped to vet
+        try:
+            with open(model_path) as f:
+                meta = json.load(f)
+            result = analysis.verify_model_meta(meta)
+        except (ValueError, TypeError) as e:
+            raise ValueError(
+                "serving artifact %s ships a corrupt program IR "
+                "(%s) — refusing to load it" % (dirname, e))
+        analysis.report(result, mode="strict", source="serving_load")
+        if result.errors():
+            raise ValueError(
+                "serving artifact %s failed program verification — "
+                "refusing to load it:\n%s" % (dirname, result.summary()))
 
     def get_input_names(self):
         return list(self._feed_names)

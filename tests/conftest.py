@@ -11,6 +11,14 @@ if "xla_force_host_platform_device_count" not in flags:
 
 import jax  # noqa: E402
 
+# the Program verifier (framework/analysis.py) runs STRICT across the
+# whole suite: every program any test compiles must verify clean (or
+# carry an explicit analysis.allowlist) — the acceptance bar for the
+# verifier's no-false-positive contract. Respect an explicit override
+# so `PADDLE_TPU_VERIFY=off pytest` can bisect verifier-vs-product
+# failures.
+os.environ.setdefault("PADDLE_TPU_VERIFY", "strict")
+
 # site customizations (e.g. the axon TPU plugin) may force jax_platforms;
 # override via config so tests always get the 8-device virtual CPU mesh.
 jax.config.update("jax_platforms", "cpu")
@@ -83,6 +91,11 @@ def pytest_configure(config):
         "(obs spans engine, trace-context propagation across the "
         "fleet, traceview merge, tracing-overhead gate) — "
         "tier-1-safe")
+    config.addinivalue_line(
+        "markers",
+        "analysis: Program IR verifier batteries (analysis-pass "
+        "framework, adversarial broken-program corpus, progcheck/"
+        "codelint tools, strict-mode model sweep) — tier-1-safe")
 
 
 @pytest.fixture(autouse=True)

@@ -75,7 +75,24 @@ def test_budget_table_covers_the_contract():
         "serving_error_rate", "router_failover_ms",
         "pp_step_s", "pp_bubble_frac", "pp_cache_hit_rate",
         "obs_step_overhead_ratio", "obs_router_overhead_ratio",
-        "obs_span_record_us"}
+        "obs_span_record_us",
+        # ISSUE-15 program-verifier section: one walk of the BERT-base
+        # pretrain program, the verify/trace+lower overhead ratio, and
+        # the zero-false-positive gate on the clean headline program
+        "analysis_verify_s", "analysis_overhead_ratio",
+        "analysis_bert_errors"}
+
+
+def test_analysis_section_measures_the_verifier():
+    """ISSUE-15 satellite: the analysis section walks the BERT-base
+    pretrain program (clean: zero errors — the bench-side
+    no-false-positive gate) and the verifier stays well under the
+    trace+lower wall it fronts, so warn-by-default is free to keep
+    on."""
+    m = bench_micro.bench_analysis()
+    assert 0 < m["analysis_verify_s"] < 10.0
+    assert 0 < m["analysis_overhead_ratio"] < 0.5
+    assert m["analysis_bert_errors"] == 0
 
 
 def test_pipeline_section_measures_the_pp_path():

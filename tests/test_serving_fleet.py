@@ -912,6 +912,38 @@ def test_concurrent_deploys_are_mutually_exclusive(tmp_path):
         assert reps[0].request_refresh(d3) is False
 
 
+def test_corrupt_artifact_deploy_refused_traffic_stays_on_old(tmp_path):
+    """REGRESSION (ISSUE 15): a deploy artifact whose exported Program
+    IR is corrupt fails the rolling-deploy DRAIN step — the predictor's
+    load-time progcheck (framework/analysis.py) refuses it, the replica
+    returns to rotation on its OLD weights, and live traffic never sees
+    the bad program."""
+    import json as _json
+    d1 = _export_artifact(tmp_path / "g1", scale=1.0)
+    d2 = _export_artifact(tmp_path / "g2", scale=2.0)
+    # corrupt g2's shipped IR: an op now reads a var that does not exist
+    model = os.path.join(d2, "__model__.json")
+    with open(model) as f:
+        meta = _json.load(f)
+    op0 = meta["program"]["blocks"][0]["ops"][0]
+    op0["inputs"] = {k: ["vanished_by_corruption"] for k in op0["inputs"]}
+    with open(model, "w") as f:
+        _json.dump(meta, f)
+    with contextlib.ExitStack() as stack:
+        _, reps, router = _fleet(stack, d1, 2)
+        xv = np.ones((1, 6), np.float32)
+        with pytest.raises(FleetError):
+            router.rolling_deploy(d2, per_replica_timeout_s=3.0)
+        # the refusal is observable: fleet_deploy_failed on the member
+        assert resilience.events("fleet_deploy_failed")
+        # and the fleet still serves — on the OLD (scale=1) weights
+        status, resp = _post(router, {"x": xv.tolist()})
+        assert status == 200
+        np.testing.assert_allclose(np.asarray(resp["outputs"][0]),
+                                   6.0 * np.ones((1, 3)), rtol=1e-5)
+        assert all(m.generation == 1 for m in reps)
+
+
 # ---------------------------------------------------------------------------
 # probe integration
 # ---------------------------------------------------------------------------
