@@ -148,6 +148,15 @@ BUDGETS = {
     # error-rate gate a broken dispatch path (mass 502s) would leave
     # the latency numbers green on the few requests that survived
     "serving_error_rate": ("max", 0.05),
+    # multi-tenant QoS (ISSUE 16): the same fleet re-run behind a
+    # classed router (gold/silver/bronze under weighted-fair
+    # queueing). Gold p99 gates the highest class's latency with the
+    # WFQ cutter in the path; the fairness metric is Jain's index
+    # over per-class success ratios — 1.0 when every class's requests
+    # complete alike, collapsing toward 1/n when the scheduler starts
+    # starving a class the quota/brownout config says it should not.
+    "serving_gold_p99_ms": ("max", 2000.0),
+    "serving_fairness": ("min", 0.6),
     # router-tier HA: kill one of two in-process routers mid-load,
     # wall until the FleetClient's first successful request on the
     # survivor (connection-refused rotation + idempotent token
@@ -645,14 +654,80 @@ def bench_serving(n_replicas=2, clients=4, requests_per_client=30):
         p50 = lat[len(lat) // 2] * 1e3 if lat else fail_ms
         p99 = lat[min(len(lat) - 1, int(len(lat) * 0.99))] * 1e3 \
             if lat else fail_ms
-        return {"serving_p50_ms": round(p50, 3),
-                "serving_p99_ms": round(p99, 3),
-                "serving_shed_rate": round(shed[0] / float(total), 4)
-                if total else 1.0,
-                "serving_error_rate": round(errs[0] / float(total), 4)
-                if total else 1.0,
-                "serving_errors": errs[0],
-                "serving_requests": total}
+        out = {"serving_p50_ms": round(p50, 3),
+               "serving_p99_ms": round(p99, 3),
+               "serving_shed_rate": round(shed[0] / float(total), 4)
+               if total else 1.0,
+               "serving_error_rate": round(errs[0] / float(total), 4)
+               if total else 1.0,
+               "serving_errors": errs[0],
+               "serving_requests": total}
+
+        # ---- multi-tenant QoS phase: the same replicas behind a
+        # CLASSED router (fresh coordination group so both routers
+        # never share a leader lease). One client per class; gold p99
+        # and Jain's fairness index over per-class success ratios
+        # x_c = ok_c / offered_c: J = (sum x)^2 / (n * sum x^2)
+        srv2 = CoordServer(n_replicas + 1, hb_deadline_s=5.0).start()
+        members.append(srv2)
+        for i in range(n_replicas):
+            members.append(ReplicaMember(tmp, srv2.address,
+                                         n_replicas, i,
+                                         ctl_interval_s=0.25,
+                                         hb_interval_s=0.25).start())
+        qrouter = FleetRouter(
+            srv2.address, n_replicas, max_batch=8,
+            batch_deadline_s=0.002, ctl_interval_s=0.25,
+            hb_interval_s=0.25, poll_interval_s=0.05,
+            tenant_classes={
+                "gold": {"weight": 4, "priority": 2},
+                "silver": {"weight": 2, "priority": 1},
+                "bronze": {"weight": 1, "priority": 0}}).start()
+        members.append(qrouter)
+        deadline = time.monotonic() + 10.0
+        while len(qrouter.routable()) < n_replicas \
+                and time.monotonic() < deadline:
+            time.sleep(0.02)
+        classes = ("gold", "silver", "bronze")
+        qlat = {c: [] for c in classes}
+        qok = {c: 0 for c in classes}
+
+        def qclient(tenant):
+            for _ in range(requests_per_client):
+                t0 = time.perf_counter()
+                try:
+                    status, _ = http_json(
+                        "POST", qrouter.url + "/infer",
+                        {"feeds": {"x": xv}}, timeout_s=10.0,
+                        headers={"x-tenant": tenant,
+                                 "x-deadline-ms": "10000"})
+                except (OSError, ValueError):
+                    status = -1
+                dt = time.perf_counter() - t0
+                with lock:
+                    if status == 200:
+                        qok[tenant] += 1
+                        qlat[tenant].append(dt)
+
+        ts = [threading.Thread(target=qclient, args=(c,))
+              for c in classes for _ in range(max(1, clients // 3))]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        offered = requests_per_client * max(1, clients // 3)
+        ratios = [qok[c] / float(offered) for c in classes]
+        sq = sum(r * r for r in ratios)
+        fairness = (sum(ratios) ** 2) / (len(ratios) * sq) \
+            if sq else 0.0
+        glat = sorted(qlat["gold"])
+        gold_p99 = glat[min(len(glat) - 1,
+                            int(len(glat) * 0.99))] * 1e3 \
+            if glat else fail_ms
+        out.update({"serving_gold_p99_ms": round(gold_p99, 3),
+                    "serving_fairness": round(fairness, 4),
+                    "serving_class_ok": dict(qok)})
+        return out
     finally:
         for m in reversed(members):
             m.close()

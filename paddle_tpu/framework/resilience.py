@@ -356,7 +356,14 @@ def _fresh_router_state():
             "queue_depth": {},   # router -> gauge
             "inflight": {},      # (router, replica) -> gauge
             "retries": {},       # (router, replica) -> count
-            "slow": {}}          # router -> top-K [(latency_s, trace)]
+            "slow": {},          # router -> top-K
+                                 #   [(latency_s, trace, tenant)]
+            # multi-tenant QoS series (additive: the aggregate series
+            # above are written unconditionally, so a tenant-less
+            # deployment's exposition is bit-for-bit the old one)
+            "tenant_requests": {},  # (router, tenant, outcome) -> n
+            "expired": {},          # (router, tenant, where) -> n
+            "tenant_queue": {}}     # (router, tenant) -> gauge
 
 
 _ROUTER = _fresh_router_state()
@@ -366,14 +373,22 @@ def _router_key(router):
     return None if router is None else str(router)
 
 
-def record_router_request(outcome, router=None):
+def record_router_request(outcome, router=None, tenant=None):
     """Count one routed request's terminal outcome ("ok", "shed",
     "deadline", "error", "replay", ...). Exported as
-    ``<prefix>_router_requests_total{outcome=[,router=]}``."""
+    ``<prefix>_router_requests_total{outcome=[,router=]}``. When the
+    caller knows the tenant a SECOND, ``tenant=``-labelled series is
+    bumped alongside (never instead of) the aggregate — per-class SLO
+    accounting without perturbing the historical series, and the probe
+    cross-checks the two for quota-accounting drift."""
     with _ROUTER_LOCK:
         key = (_router_key(router), str(outcome))
         r = _ROUTER["requests"]
         r[key] = r.get(key, 0) + 1
+        if tenant is not None:
+            tkey = (_router_key(router), str(tenant), str(outcome))
+            t = _ROUTER["tenant_requests"]
+            t[tkey] = t.get(tkey, 0) + 1
 
 
 def record_router_retry(replica, router=None):
@@ -407,19 +422,47 @@ def observe_router_batch(size, router=None):
         b["count"] += 1
 
 
-def record_router_slow(latency_s, trace=None, router=None):
+def record_router_slow(latency_s, trace=None, router=None,
+                       tenant=None):
     """Keep this request as a slow-request EXEMPLAR if it makes the
     router's top-K by latency. Exemplars pair the p99 a histogram can
     only bound with the trace id that lets an operator pull the exact
     offending timeline (``tools/traceview.py``) — the classic
-    metrics-to-trace bridge. Exported by :func:`router_totals` as
+    metrics-to-trace bridge — and the tenant, so "whose request was
+    slow" is one lookup. Exported by :func:`router_totals` as
     ``slow_requests``."""
     latency_s = float(latency_s)
     with _ROUTER_LOCK:
         top = _ROUTER["slow"].setdefault(_router_key(router), [])
-        top.append((latency_s, None if trace is None else str(trace)))
+        top.append((latency_s, None if trace is None else str(trace),
+                    None if tenant is None else str(tenant)))
         top.sort(key=lambda e: -e[0])
         del top[ROUTER_SLOW_K:]
+
+
+def record_router_expired(where, tenant=None, router=None):
+    """Count one request whose propagated deadline budget had already
+    expired, by WHERE the expiry was caught:
+
+      * ``"queue"``    expired while waiting in (or arriving at) the
+                       router queue — failed 504 WITHOUT dispatching;
+      * ``"dispatch"`` expired between batch cut and dispatch — the
+                       member is failed alone and the batch recomposed;
+      * ``"replica"``  the replica-side guard refused dispatched work
+                       that was already expired on arrival. The router
+                       checks remaining budget immediately before every
+                       send, so this series staying at ZERO is the
+                       counter-assertable form of "no request is ever
+                       dispatched after its budget expired".
+
+    Exported as ``<prefix>_router_deadline_expired_total{where=,
+    tenant=[,router=]}``."""
+    with _ROUTER_LOCK:
+        key = (_router_key(router),
+               "default" if tenant is None else str(tenant),
+               str(where))
+        e = _ROUTER["expired"]
+        e[key] = e.get(key, 0) + 1
 
 
 def set_router_queue_depth(depth, router=None):
@@ -437,6 +480,16 @@ def set_router_inflight(replica, n, router=None):
             float(n)
 
 
+def set_router_tenant_queue_depth(tenant, depth, router=None):
+    """Update the per-tenant ``<prefix>_router_tenant_queue_depth``
+    gauge (requests waiting in that tenant's WFQ queue). Written only
+    by QoS-mode routers, so tenant-less deployments export nothing
+    new."""
+    with _ROUTER_LOCK:
+        _ROUTER["tenant_queue"][(_router_key(router), str(tenant))] = \
+            float(depth)
+
+
 def router_totals(by_router=False):
     """One consistent snapshot of the router accounting. The default
     AGGREGATES across router labels (the historical single-router
@@ -449,8 +502,11 @@ def router_totals(by_router=False):
     ONE lock acquisition so the histogram's bucket counts can never
     run ahead of its total (a non-monotonic histogram is invalid to
     Prometheus consumers). ``slow_requests`` carries the top-K
-    slow-request exemplars as ``[{"latency_s", "trace"}]``, worst
-    first (see :func:`record_router_slow`)."""
+    slow-request exemplars as ``[{"latency_s", "trace", "tenant"}]``,
+    worst first (see :func:`record_router_slow`). QoS additions ride
+    as ``"tenants"`` ({tenant: {outcome: n}}), ``"expired"``
+    ({where: {tenant: n}}) and ``"tenant_queue_depth"``
+    ({tenant: depth}) — all empty for tenant-less deployments."""
     with _ROUTER_LOCK:
         requests = dict(_ROUTER["requests"])
         batch = {r: {"counts": list(b["counts"]), "sum": b["sum"],
@@ -460,9 +516,15 @@ def router_totals(by_router=False):
         inflight = dict(_ROUTER["inflight"])
         retries = dict(_ROUTER["retries"])
         slow = {r: list(v) for r, v in _ROUTER["slow"].items()}
+        tenant_requests = dict(_ROUTER["tenant_requests"])
+        expired = dict(_ROUTER["expired"])
+        tenant_queue = dict(_ROUTER["tenant_queue"])
     routers = (set(r for r, _ in requests) | set(batch)
                | set(queue_depth) | set(r for r, _ in inflight)
-               | set(r for r, _ in retries) | set(slow))
+               | set(r for r, _ in retries) | set(slow)
+               | set(r for r, _, _ in tenant_requests)
+               | set(r for r, _, _ in expired)
+               | set(r for r, _ in tenant_queue))
     out = {}
     for rkey in (sorted(routers, key=lambda r: (r is not None, str(r)))
                  if by_router else [None]):
@@ -479,6 +541,20 @@ def router_totals(by_router=False):
         merged_slow = sorted(
             (e for r, top in slow.items() if _mine(r) for e in top),
             key=lambda e: -e[0])[:ROUTER_SLOW_K]
+        tmap = {}
+        for (r, t, o), n in tenant_requests.items():
+            if _mine(r):
+                d = tmap.setdefault(t, {})
+                d[o] = d.get(o, 0) + n
+        emap = {}
+        for (r, t, w), n in expired.items():
+            if _mine(r):
+                d = emap.setdefault(w, {})
+                d[t] = d.get(t, 0) + n
+        tq = {}
+        for (r, t), v in tenant_queue.items():
+            if _mine(r):
+                tq[t] = tq.get(t, 0.0) + v
         ent = {
             "requests": _sum_by(requests, _mine),
             "batch_counts": b_counts, "batch_count": b_count,
@@ -486,8 +562,11 @@ def router_totals(by_router=False):
             "queue_depth": sum(depths) if depths else None,
             "inflight": _sum_by(inflight, _mine),
             "retries": _sum_by(retries, _mine),
-            "slow_requests": [{"latency_s": lat, "trace": tr}
-                              for lat, tr in merged_slow]}
+            "tenants": tmap, "expired": emap,
+            "tenant_queue_depth": tq,
+            "slow_requests": [{"latency_s": lat, "trace": tr,
+                               "tenant": tn}
+                              for lat, tr, tn in merged_slow]}
         if not by_router:
             return ent
         out[rkey] = ent
@@ -727,6 +806,21 @@ def metrics(event_list=None, by_host=False):
             {"name": METRIC_PREFIX + "_router_retries_total",
              "labels": _rlbl(rkey, replica=str(r)), "value": n}
             for r, n in sorted(rt["retries"].items())]
+        # QoS additions: per-tenant outcome counters alongside the
+        # aggregate (never instead of it — the aggregate above is the
+        # tenant-less deployment's exact historical series), plus the
+        # deadline-budget-expiry counters by catch point
+        counters += [
+            {"name": METRIC_PREFIX + "_router_requests_total",
+             "labels": _rlbl(rkey, outcome=outcome, tenant=t),
+             "value": n}
+            for t, by_out in sorted(rt["tenants"].items())
+            for outcome, n in sorted(by_out.items())]
+        counters += [
+            {"name": METRIC_PREFIX + "_router_deadline_expired_total",
+             "labels": _rlbl(rkey, where=where, tenant=t), "value": n}
+            for where, by_t in sorted(rt["expired"].items())
+            for t, n in sorted(by_t.items())]
         if rt["batch_count"]:
             router_hists.append(_counts_histogram(
                 METRIC_PREFIX + "_router_batch_size",
@@ -784,6 +878,9 @@ def metrics(event_list=None, by_host=False):
         gauges += [{"name": METRIC_PREFIX + "_router_replica_inflight",
                     "labels": _rlbl(rkey, replica=str(r)), "value": v}
                    for r, v in sorted(rt["inflight"].items())]
+        gauges += [{"name": METRIC_PREFIX + "_router_tenant_queue_depth",
+                    "labels": _rlbl(rkey, tenant=t), "value": v}
+                   for t, v in sorted(rt["tenant_queue_depth"].items())]
     restore_lat = [e["latency_s"] for e in evs
                    if e["kind"] == "restore" and "latency_s" in e]
     histograms = [_histogram(METRIC_PREFIX + "_restore_latency_seconds",

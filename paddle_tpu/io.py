@@ -116,7 +116,7 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
                          params_filename=None, export_for_deployment=True,
                          program_only=False, format="default",
                          batch_sizes=(1, 8, 32), example_feed=None,
-                         feed_batch_factors=None):
+                         feed_batch_factors=None, weight_compress=None):
     """Freeze: clone for_test, prune to feeds/targets, save IR + params.
 
     format="stablehlo" additionally writes a deployable serving artifact
@@ -124,7 +124,10 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
     MLIR text a C++ PjRt service can compile without Python (the
     reference's C++ PaddlePredictor capability, paddle_api.h:148); load
     with paddle_tpu.serving.load_serving_artifact. batch_sizes are the
-    exported batch buckets (XLA artifacts are static-shape)."""
+    exported batch buckets (XLA artifacts are static-shape).
+    weight_compress="q8" ships the serving artifact's weights as
+    block-quantized int8 beside the export instead of baked fp32
+    constants inside it — see serving.export_serving_artifact."""
     if format not in ("default", "stablehlo"):
         # validate BEFORE writing anything: a typo'd format must not
         # leave a half-configured artifact directory behind
@@ -160,7 +163,8 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
                                 executor, batch_sizes=batch_sizes,
                                 pruned_program=pruned,
                                 example_feed=example_feed,
-                                feed_batch_factors=feed_batch_factors)
+                                feed_batch_factors=feed_batch_factors,
+                                weight_compress=weight_compress)
     return target_names
 
 

@@ -25,14 +25,27 @@ import numpy as np
 MODULE_SUBDIR = "serving"
 # v1: feed_batch_dynamic (bool per feed). v2: feed_batch_factor /
 # fetch_batch_factor (ints; dim0 = factor * batch, 0 = static).
-SERVING_FORMAT_VERSION = 2
+# v3: optional weight_compress="q8" — weights ship as a block-quantized
+# int8 npz (the PR 6 checkpoint codec) and enter the exported
+# computation as ARGUMENTS instead of baked constants. LOSSY, so only
+# q8 exports are stamped v3: an older library refuses them instead of
+# serving garbage, while plain exports stay v2-readable everywhere.
+SERVING_FORMAT_VERSION = 3
+WEIGHTS_Q8_FILE = "weights_q8.npz"
 
 
-def _infer_fn(program, feed_names, fetch_names, scope):
+def _infer_fn(program, feed_names, fetch_names, scope,
+              weights_as_args=False):
     """Close the trained weights over a pure (feeds) -> fetches function.
 
     jax.export turns closure arrays into embedded constants, which is
-    exactly the frozen-artifact contract: the .bin is self-contained."""
+    exactly the frozen-artifact contract: the .bin is self-contained.
+
+    ``weights_as_args=True`` is the quantized-artifact variant: the
+    weights become LEADING arguments (sorted by name) instead of baked
+    constants, so the .bin stays weight-free and the int8 weight file
+    shipped beside it is the only weight payload. Returns
+    ``(fn, weight_names, weight_arrays)`` in that mode."""
     import jax
     from .framework import executor as ex_mod
     from .framework.trace import TraceContext, trace_block
@@ -41,14 +54,28 @@ def _infer_fn(program, feed_names, fetch_names, scope):
     state = {n: scope.find_var(n) for n in sorted(persistable)
              if scope.find_var(n) is not None}
 
-    def fn(*feeds):
-        env = dict(state)
-        env.update(zip(feed_names, feeds))
+    if not weights_as_args:
+        def fn(*feeds):
+            env = dict(state)
+            env.update(zip(feed_names, feeds))
+            ctx = TraceContext(program, jax.random.PRNGKey(0),
+                               frozenset())
+            trace_block(program.global_block(), env, ctx)
+            return tuple(env[n] for n in fetch_names)
+
+        return fn
+
+    weight_names = sorted(state)
+
+    def wfn(*args):
+        env = dict(zip(weight_names, args[:len(weight_names)]))
+        env.update(zip(feed_names, args[len(weight_names):]))
         ctx = TraceContext(program, jax.random.PRNGKey(0), frozenset())
         trace_block(program.global_block(), env, ctx)
         return tuple(env[n] for n in fetch_names)
 
-    return fn
+    return wfn, weight_names, \
+        [np.asarray(state[n]) for n in weight_names]
 
 
 def infer_batch_factors(dyn_dims, overrides=None):
@@ -145,7 +172,8 @@ def export_serving_artifact(dirname, feeded_var_names, target_vars,
                             executor=None, main_program=None,
                             batch_sizes=(1, 8, 32), scope=None,
                             pruned_program=None, example_feed=None,
-                            feed_batch_factors=None):
+                            feed_batch_factors=None,
+                            weight_compress=None):
     """Freeze + export the inference program as StableHLO.
 
     Writes under dirname/serving/. target_vars may be Variables or names.
@@ -154,7 +182,17 @@ def export_serving_artifact(dirname, feeded_var_names, target_vars,
     representative feed dict) teaches the export which batch-dynamic
     feeds scale as a MULTIPLE of the request batch (BERT's flat mask_pos
     = batch * max_preds); without it every dynamic feed is assumed
-    factor 1. Returns the list of written export paths."""
+    factor 1. Returns the list of written export paths.
+
+    weight_compress="q8" writes the QUANTIZED artifact layout: instead
+    of baking fp32 weights into every per-bucket .bin as constants, the
+    weights enter the computation as arguments and ship ONCE as
+    block-quantized int8 + per-block fp32 scales (the PR 6 checkpoint
+    codec, serving/weights_q8.npz) — the artifact a rolling deploy
+    ships shrinks by roughly the weight bytes' 4x. LOSSY: outputs match
+    the fp32 artifact only to quantization tolerance, so q8 is strictly
+    opt-in and the meta is stamped format_version 3 (older loaders
+    refuse it rather than serve garbage)."""
     import jax
     from jax import export as jax_export
     from .framework.program import default_main_program
@@ -162,6 +200,9 @@ def export_serving_artifact(dirname, feeded_var_names, target_vars,
 
     if not batch_sizes:
         raise ValueError("serving export needs at least one batch size")
+    if weight_compress not in (None, "q8"):
+        raise ValueError("serving export weight_compress must be None "
+                         "or 'q8', got %r" % (weight_compress,))
     scope = scope or global_scope()
     target_names = [getattr(v, "name", v) for v in target_vars]
     if pruned_program is not None:
@@ -180,7 +221,25 @@ def export_serving_artifact(dirname, feeded_var_names, target_vars,
         import shutil
         shutil.rmtree(out_dir)
     os.makedirs(out_dir)
-    fn = _infer_fn(pruned, list(feeded_var_names), target_names, scope)
+    if weight_compress == "q8":
+        fn, weight_names, weight_arrays = _infer_fn(
+            pruned, list(feeded_var_names), target_names, scope,
+            weights_as_args=True)
+        from .io import _decode_member, _encode_payload
+        payload = _encode_payload(
+            dict(zip(weight_names, weight_arrays)), "q8")
+        np.savez(os.path.join(out_dir, WEIGHTS_Q8_FILE), **payload)
+        # the exported computation is traced against (and will be FED)
+        # the dequantized weights — quantize/dequantize here so export-
+        # time eval_shape and load-time serving see the same values
+        with np.load(os.path.join(out_dir, WEIGHTS_Q8_FILE)) as z:
+            weight_arrays = [_decode_member(z, n) for n in weight_names]
+        weight_avals = [jax.ShapeDtypeStruct(a.shape, a.dtype)
+                        for a in weight_arrays]
+    else:
+        fn = _infer_fn(pruned, list(feeded_var_names), target_names,
+                       scope)
+        weight_names, weight_avals = [], []
 
     factors = _feed_factors(pruned, feeded_var_names, example_feed,
                             overrides=feed_batch_factors)
@@ -194,10 +253,10 @@ def export_serving_artifact(dirname, feeded_var_names, target_vars,
     # not get sliced).
     fetch_factors = [0] * len(target_names)
     if dynamic:
-        o1 = jax.eval_shape(fn, *_feed_avals(pruned, feeded_var_names, 1,
-                                             factors))
-        o2 = jax.eval_shape(fn, *_feed_avals(pruned, feeded_var_names, 2,
-                                             factors))
+        o1 = jax.eval_shape(fn, *(weight_avals + _feed_avals(
+            pruned, feeded_var_names, 1, factors)))
+        o2 = jax.eval_shape(fn, *(weight_avals + _feed_avals(
+            pruned, feeded_var_names, 2, factors)))
         for i, (s1, s2) in enumerate(zip(o1, o2)):
             if s1.shape and s2.shape and s2.shape[0] != s1.shape[0]:
                 fetch_factors[i] = s2.shape[0] - s1.shape[0]
@@ -205,7 +264,8 @@ def export_serving_artifact(dirname, feeded_var_names, target_vars,
     written, bucket_meta = [], {}
     for b in buckets:
         avals = _feed_avals(pruned, feeded_var_names, b or 1, factors)
-        exported = jax_export.export(jax.jit(fn))(*avals)
+        exported = jax_export.export(jax.jit(fn))(*(weight_avals
+                                                    + avals))
         blob = exported.serialize()
         bin_path = os.path.join(out_dir, "export_b%d.bin" % b)
         with open(bin_path, "wb") as f:
@@ -218,13 +278,18 @@ def export_serving_artifact(dirname, feeded_var_names, target_vars,
                        "dtype": np.dtype(a.dtype).name}
                       for n, a in zip(feeded_var_names, avals)]}
 
-    meta = {"format_version": SERVING_FORMAT_VERSION,
+    # plain exports stay stamped v2 so every older loader keeps reading
+    # them; only the lossy q8 layout needs the v3 fence
+    meta = {"format_version": 3 if weight_compress else 2,
             "feed_var_names": list(feeded_var_names),
             "fetch_var_names": target_names,
             "dynamic_batch": dynamic,
             "feed_batch_factor": factors,
             "fetch_batch_factor": fetch_factors,
             "buckets": bucket_meta}
+    if weight_compress:
+        meta["weight_compress"] = weight_compress
+        meta["weight_names"] = weight_names
     with open(os.path.join(out_dir, "meta.json"), "w") as f:
         json.dump(meta, f, indent=1)
     import shutil
@@ -293,11 +358,41 @@ class ServingPredictor(object):
                 1] * len(self._meta["fetch_var_names"])
         self._feed_names = self._meta["feed_var_names"]
         self._fetch_names = self._meta["fetch_var_names"]
+        # quantized artifacts (v3, weight_compress="q8") ship the
+        # weights OUTSIDE the .bin as block-quantized int8; dequantize
+        # once at load and prepend them to every exported call — the
+        # computation took them as leading arguments at export
+        wc = self._meta.get("weight_compress")
+        if wc not in (None, "q8"):
+            raise ValueError(
+                "serving artifact %s has unknown weight_compress %r"
+                % (dirname, wc))
+        self._weight_args = []
+        if wc == "q8":
+            from .io import _decode_member
+            with np.load(os.path.join(out_dir, WEIGHTS_Q8_FILE)) as z:
+                self._weight_args = [
+                    _decode_member(z, n)
+                    for n in self._meta["weight_names"]]
         self._fns = {}
         for key in self._meta["buckets"]:
             with open(os.path.join(out_dir, "export_b%s.bin" % key),
                       "rb") as f:
                 self._fns[int(key)] = jax_export.deserialize(f.read())
+
+    @property
+    def weight_compress(self):
+        """None for a classic baked-constants artifact, "q8" when the
+        weights ride beside the export as block-quantized int8."""
+        return self._meta.get("weight_compress")
+
+    def _call_bucket(self, b, feeds):
+        """Invoke one exported bucket, prepending the artifact's
+        dequantized weights when it shipped them as arguments."""
+        if self._weight_args:
+            return self._fns[b].call(*(self._weight_args
+                                       + list(feeds)))
+        return self._fns[b].call(*feeds)
 
     @staticmethod
     def _verify_exported_program(dirname):
@@ -489,7 +584,7 @@ class ServingPredictor(object):
             spec = self._meta["buckets"][str(b)]["feeds"]
             feeds = [np.zeros(f["shape"], dtype=np.dtype(f["dtype"]))
                      for f in spec]
-            for o in self._fns[b].call(*feeds):
+            for o in self._call_bucket(b, feeds):
                 np.asarray(o)
             self._mark_warm(b)
 
@@ -515,8 +610,8 @@ class ServingPredictor(object):
             import time
             time.sleep(actions["slow_s"])
         if not self._meta["dynamic_batch"]:
-            outs = self._fns[0].call(
-                *[np.asarray(inputs[n]) for n in self._feed_names])
+            outs = self._call_bucket(
+                0, [np.asarray(inputs[n]) for n in self._feed_names])
             outs = [np.asarray(o) for o in outs]
             self._mark_warm(0)
             return outs
@@ -531,7 +626,7 @@ class ServingPredictor(object):
                     [(0, 0)] * (arr.ndim - 1)
                 arr = np.pad(arr, pad)
             feeds.append(arr)
-        outs = self._fns[b].call(*feeds)
+        outs = self._call_bucket(b, feeds)
         self._mark_warm(b)
         # slice batch-scaled outputs per the EXPORT-time factors — never
         # guessed from runtime shapes (a static dim that happens to

@@ -576,7 +576,8 @@ class ReplicaMember(_FleetMember):
     def __init__(self, artifact_dir, coord_address, n_replicas,
                  replica_id, port=0, host="127.0.0.1", warmup=True,
                  max_in_flight=None, deadline_s=None,
-                 ship_compress="zlib", ctl_interval_s=0.1,
+                 ship_compress="zlib", artifact_compress=None,
+                 ctl_interval_s=0.1,
                  hb_interval_s=0.25, timeout_s=30.0,
                  join_timeout_s=30.0, n_routers=1, group_size=None):
         rid = int(replica_id)
@@ -600,6 +601,9 @@ class ReplicaMember(_FleetMember):
         if ship_compress not in (None, "zlib"):
             raise ValueError("ship_compress must be None or 'zlib', "
                              "got %r" % (ship_compress,))
+        if artifact_compress not in (None, "q8"):
+            raise ValueError("artifact_compress must be None or 'q8', "
+                             "got %r" % (artifact_compress,))
         self.replica_id = int(replica_id)
         self._artifact_dir = str(artifact_dir)
         self._http_host = host
@@ -608,6 +612,14 @@ class ReplicaMember(_FleetMember):
         self._max_in_flight = max_in_flight
         self._deadline_s = deadline_s
         self._ship_compress = ship_compress
+        self._artifact_compress = artifact_compress
+        # deadline-budget guard counter: dispatched work refused
+        # because its x-deadline-ms budget was already spent on
+        # arrival. The router checks remaining budget immediately
+        # before every send, so a live fleet holds this at ZERO — the
+        # soak test counter-asserts it (a nonzero value means a
+        # request WAS dispatched after expiry)
+        self._expired_refused = 0
         self._pred = None
         self._pred_lock = threading.Lock()
         self._generation = 0
@@ -649,11 +661,17 @@ class ReplicaMember(_FleetMember):
                     # timeline
                     tr, parent = obs.parse_header(
                         self.headers.get("x-trace-id"))
+                    tenant = self.headers.get("x-tenant") \
+                        or body.get("tenant") or "default"
                     with obs.span("replica.serve", trace_id=tr,
                                   parent=parent,
                                   replica=member.replica_id,
-                                  generation=member.generation) as sp:
-                        status, payload = member._handle_infer(body)
+                                  generation=member.generation,
+                                  tenant=tenant) as sp:
+                        status, payload = member._handle_infer(
+                            body, tenant=tenant,
+                            deadline_ms=self.headers.get(
+                                "x-deadline-ms"))
                         sp.set(status=status)
                     self._send(status, payload)
                 elif path == "/admin/refresh":
@@ -710,6 +728,16 @@ class ReplicaMember(_FleetMember):
         pred = ServingPredictor(dirname,
                                 max_in_flight=self._max_in_flight,
                                 deadline_s=self._deadline_s)
+        if self._artifact_compress == "q8" \
+                and pred.weight_compress != "q8":
+            # deploy-time guard: a replica provisioned for quantized
+            # artifacts (the shrunken ship-bytes budget) must refuse a
+            # full-precision artifact at LOAD, not discover the 4x
+            # state-ship blowup on its next rolling deploy
+            raise FleetError(
+                "replica %d runs with artifact_compress='q8' but %s "
+                "is a full-precision export — re-export it with "
+                "weight_compress='q8'" % (self.replica_id, dirname))
         if self._warmup:
             pred.warmup()
         if account:
@@ -737,9 +765,12 @@ class ReplicaMember(_FleetMember):
     def health(self):
         pred = self._predictor()
         snap = pred.health()
+        with self._pred_lock:
+            expired_refused = self._expired_refused
         snap.update({"replica": self.replica_id,
                      "generation": self.generation,
-                     "artifact_dir": self._artifact_dir})
+                     "artifact_dir": self._artifact_dir,
+                     "expired_refused": expired_refused})
         return snap
 
     def meta(self):
@@ -753,7 +784,7 @@ class ReplicaMember(_FleetMember):
                 "dynamic_batch": pred.dynamic_batch,
                 "max_bucket": pred.max_bucket}
 
-    def _handle_infer(self, body):
+    def _handle_infer(self, body, tenant=None, deadline_ms=None):
         import numpy as np
         pred = self._predictor()
         feeds_json = body.get("feeds")
@@ -766,6 +797,29 @@ class ReplicaMember(_FleetMember):
             except (TypeError, ValueError):
                 return 400, {"error": "deadline_s must be a number, "
                              "got %r" % (deadline_s,)}
+        if deadline_ms is not None:
+            try:
+                deadline_ms = float(deadline_ms)
+            except (TypeError, ValueError):
+                return 400, {"error": "x-deadline-ms must be a "
+                             "number, got %r" % (deadline_ms,)}
+            if deadline_ms <= 0:
+                # the propagated budget is already SPENT: refuse
+                # before the predictor burns a batch slot — the
+                # caller's _finish_pending gave up long ago, so any
+                # work here is pure waste (satellite guard; the
+                # "replica" series must stay 0 in a healthy fleet)
+                with self._pred_lock:
+                    self._expired_refused += 1
+                resilience.record_router_expired("replica",
+                                                 tenant=tenant)
+                return 504, {"error": "deadline budget exhausted "
+                             "before serving — refused without "
+                             "entering the batch window",
+                             "kind": "deadline"}
+            budget_s = deadline_ms / 1000.0
+            deadline_s = budget_s if deadline_s is None \
+                else min(deadline_s, budget_s)
         dtypes = pred.feed_dtypes()
         try:
             feeds = {n: np.asarray(v, dtype=np.dtype(dtypes[n]))
@@ -949,12 +1003,99 @@ class ReplicaMember(_FleetMember):
 # router
 # ---------------------------------------------------------------------------
 
+DEFAULT_TENANT = "default"
+
+
+class TenantClass(object):
+    """One QoS class: the knobs a router schedules a tenant by.
+
+    ``weight``       weighted-fair share of the batch cut (start-time
+                     fair queuing — a weight-4 class drains 4x a
+                     weight-1 class's rows under contention)
+    ``priority``     brownout rank: under sustained overload the
+                     router sheds the LOWEST live priority first; the
+                     highest class is never floor-shed
+    ``rate``/``burst``   token-bucket admission quota (requests/s,
+                     bucket size; None = unmetered)
+    ``max_inflight`` per-tenant cap on requests admitted and not yet
+                     finished (None = uncapped)
+    ``tenants``      explicit tenant ids mapped to this class; a
+                     tenant naming no class maps by its own name,
+                     else to the "default" class"""
+
+    __slots__ = ("name", "weight", "priority", "rate", "burst",
+                 "max_inflight", "tenants")
+
+    def __init__(self, name, weight=1.0, priority=0, rate=None,
+                 burst=None, max_inflight=None, tenants=()):
+        self.name = str(name)
+        self.weight = float(weight)
+        if self.weight <= 0:
+            raise ValueError("tenant class %r needs weight > 0, got "
+                             "%r" % (name, weight))
+        self.priority = int(priority)
+        self.rate = None if rate is None else float(rate)
+        if self.rate is not None and self.rate <= 0:
+            raise ValueError("tenant class %r needs rate > 0 (or "
+                             "None), got %r" % (name, rate))
+        if burst is not None:
+            self.burst = float(burst)
+        else:
+            self.burst = None if self.rate is None \
+                else max(1.0, self.rate)
+        if self.burst is not None and self.burst < 1:
+            raise ValueError("tenant class %r needs burst >= 1, got "
+                             "%r" % (name, burst))
+        self.max_inflight = None if max_inflight is None \
+            else int(max_inflight)
+        if self.max_inflight is not None and self.max_inflight < 1:
+            raise ValueError("tenant class %r needs max_inflight >= 1"
+                             " (or None), got %r"
+                             % (name, max_inflight))
+        self.tenants = frozenset(str(t) for t in tenants)
+
+
+def parse_tenant_classes(spec):
+    """{class_name: TenantClass} from a config mapping (or a list of
+    dicts carrying "name") — the ``--tenant-classes`` JSON shape:
+
+        {"gold":   {"weight": 4, "priority": 2},
+         "silver": {"weight": 2, "priority": 1},
+         "bronze": {"weight": 1, "priority": 0,
+                    "rate": 50, "max_inflight": 8,
+                    "tenants": ["batch-jobs", "crawler"]}}
+
+    Empty/None disables QoS entirely (the router runs the classic
+    single-FIFO path)."""
+    if not spec:
+        return {}
+    if isinstance(spec, dict):
+        items = spec.items()
+    else:
+        items = [(c.get("name"), c) for c in spec]
+    out = {}
+    for name, cfg in items:
+        if name is None:
+            raise ValueError("tenant class list entries need a "
+                             '"name" key')
+        cfg = {k: v for k, v in dict(cfg or {}).items() if k != "name"}
+        unknown = set(cfg) - {"weight", "priority", "rate", "burst",
+                              "max_inflight", "tenants"}
+        if unknown:
+            raise ValueError("tenant class %r has unknown keys %s"
+                             % (name, sorted(unknown)))
+        out[str(name)] = TenantClass(name, **cfg)
+    return out
+
+
 class _Pending(object):
     __slots__ = ("feeds", "n", "deadline", "enqueued", "event",
                  "result", "error", "abandoned", "trace", "span",
-                 "t_enq")
+                 "t_enq", "tenant", "retry_budget", "vstart",
+                 "vfinish")
 
-    def __init__(self, feeds, n, deadline):
+    def __init__(self, feeds, n, deadline, tenant=DEFAULT_TENANT,
+                 retry_budget=None):
         self.feeds = feeds
         self.n = n
         self.deadline = deadline
@@ -970,6 +1111,13 @@ class _Pending(object):
         self.trace = None
         self.span = None
         self.t_enq = None
+        # QoS context: the owning tenant, the bounded cross-hop retry
+        # budget (None = unbounded, the historical behavior) and the
+        # start-time-fair-queuing virtual tags the WFQ cut orders by
+        self.tenant = tenant
+        self.retry_budget = retry_budget
+        self.vstart = 0.0
+        self.vfinish = 0.0
 
 
 class FleetRouter(_FleetMember):
@@ -1006,7 +1154,9 @@ class FleetRouter(_FleetMember):
                  poll_interval_s=0.05, ctl_interval_s=0.1,
                  hb_interval_s=0.25, timeout_s=30.0,
                  join_timeout_s=30.0, router_id=0, n_routers=1,
-                 group_size=None):
+                 group_size=None, tenant_classes=None,
+                 brownout_queue_depth=None, brownout_shed_rate=0.5,
+                 qos_interval_s=0.1, qos_hysteresis=3):
         if not 0 <= int(router_id) < int(n_routers):
             raise ValueError("router_id %r out of range for %d "
                              "routers" % (router_id, n_routers))
@@ -1028,6 +1178,42 @@ class FleetRouter(_FleetMember):
         self._poll_interval_s = float(poll_interval_s)
         self._queue = collections.deque()
         self._qcond = threading.Condition()
+        # -- multi-tenant QoS (tentpole). No classes configured =
+        # QoS OFF: every request takes the classic single-FIFO path
+        # bit-for-bit; the per-tenant structures below stay empty.
+        self._classes = parse_tenant_classes(tenant_classes)
+        self._qos = bool(self._classes)
+        self._class_default = self._classes.get(
+            DEFAULT_TENANT, TenantClass(DEFAULT_TENANT))
+        self._tenant_to_class = {}
+        for c in self._classes.values():
+            for t in c.tenants:
+                self._tenant_to_class[t] = c
+        # WFQ state, all under _qcond: per-tenant FIFO queues, the
+        # start-time-fair-queuing virtual clock, and per-tenant
+        # {finish tag, token bucket, inflight} scheduler state
+        self._tqueues = {}
+        self._tstate = {}
+        self._vclock = 0.0
+        # brownout (priority shed): the enacted verdict is a MINIMUM
+        # admissible priority, escalated/relaxed only by the QoS
+        # sampling thread on hysteresis streaks — admission reads the
+        # frozen verdict, never the raw signals (the autoscaler's
+        # frozen-signal discipline)
+        self._bo_floor = None
+        self._bo_levels = sorted(set(
+            [c.priority for c in self._classes.values()]
+            + [self._class_default.priority]))
+        self._bo_hot = 0
+        self._bo_cool = 0
+        self._bo_prev = None
+        self._brownout_queue_depth = (
+            max(2, int(0.75 * int(max_queue)))
+            if brownout_queue_depth is None
+            else int(brownout_queue_depth))
+        self._brownout_shed_rate = float(brownout_shed_rate)
+        self._qos_interval_s = float(qos_interval_s)
+        self._qos_hysteresis = int(qos_hysteresis)
         self._members_lock = threading.Lock()
         self._members = {}
         self._members_sig = None
@@ -1080,7 +1266,14 @@ class FleetRouter(_FleetMember):
                 if path == "/infer":
                     self._send(*router._handle_infer(
                         body,
-                        trace_header=self.headers.get("x-trace-id")))
+                        trace_header=self.headers.get("x-trace-id"),
+                        headers={
+                            "x-tenant":
+                                self.headers.get("x-tenant"),
+                            "x-deadline-ms":
+                                self.headers.get("x-deadline-ms"),
+                            "x-retry-budget":
+                                self.headers.get("x-retry-budget")}))
                 elif path == "/admin/deploy":
                     new_dir = body.get("dir")
                     if not new_dir:
@@ -1131,6 +1324,11 @@ class FleetRouter(_FleetMember):
                               name="paddle_tpu-fleet-batcher")
         bt.start()
         self._threads.append(bt)
+        if self._qos:
+            qt = threading.Thread(target=self._qos_loop, daemon=True,
+                                  name="paddle_tpu-fleet-qos")
+            qt.start()
+            self._threads.append(qt)
 
     def _after_join(self):
         pt = threading.Thread(target=self._members_loop, daemon=True,
@@ -1162,7 +1360,8 @@ class FleetRouter(_FleetMember):
                                "lterm": lterm, "leader": leader,
                                "inflight": inflight, "ready": False,
                                "queue": queue, "shed": shed,
-                               "reqs": total})
+                               "reqs": total,
+                               "hq": self.high_priority_queue_depth()})
         except (CoordinationError, ConnectionError):
             return False
         return True
@@ -1175,6 +1374,9 @@ class FleetRouter(_FleetMember):
             # caller block out its full request deadline
             stranded = list(self._queue)
             self._queue.clear()
+            for q in self._tqueues.values():
+                stranded.extend(q)
+                q.clear()
             self._qcond.notify_all()
         self._fail(stranded, ServerOverloadedError(
             "router is closing — retry against its replacement"))
@@ -1217,7 +1419,8 @@ class FleetRouter(_FleetMember):
                     peer_rload[h] = {
                         "queue": int(info.get("queue") or 0),
                         "shed": int(info.get("shed") or 0),
-                        "reqs": int(info.get("reqs") or 0)}
+                        "reqs": int(info.get("reqs") or 0),
+                        "hq": int(info.get("hq") or 0)}
                 continue
             if info.get("kind") != "replica" \
                     or not info.get("ready") or not info.get("addr"):
@@ -1307,7 +1510,8 @@ class FleetRouter(_FleetMember):
         with self._members_lock:
             inflight = tuple(sorted((h, int(n))
                              for h, n in self._inflight.items() if n))
-        load = self._load_signals()
+        load = self._load_signals() + (
+            self.high_priority_queue_depth(),)
         with self._leader_lock:
             sig = (self._is_leader, self._leader_term, inflight, load)
         # cache the signature only once the put LANDED: a publish
@@ -1329,7 +1533,26 @@ class FleetRouter(_FleetMember):
 
     def queue_depth(self):
         with self._qcond:
-            return len(self._queue)
+            return self._qdepth_locked()
+
+    def _qdepth_locked(self):
+        # exactly one of the two layouts holds requests: the single
+        # FIFO (QoS off) or the per-tenant WFQ queues (QoS on)
+        return len(self._queue) + sum(len(q)
+                                      for q in self._tqueues.values())
+
+    def high_priority_queue_depth(self):
+        """Waiting requests belonging to the HIGHEST-priority class —
+        the autoscaler's class-aware pressure signal: sustained
+        high-class queueing grows the fleet even while total depth
+        looks tame (the brownout already shed the rest). 0 when QoS
+        is off."""
+        if not self._qos:
+            return 0
+        hi = self._bo_levels[-1]
+        with self._qcond:
+            return sum(len(q) for t, q in self._tqueues.items()
+                       if self._class_of(t).priority >= hi)
 
     def _load_signals(self):
         """``(queue_depth, shed_total, requests_total)`` for THIS
@@ -1385,20 +1608,31 @@ class FleetRouter(_FleetMember):
 
     def health(self):
         with self._qcond:
-            depth = len(self._queue)
+            depth = self._qdepth_locked()
+            tenant_depth = {t: len(q)
+                            for t, q in self._tqueues.items() if q}
+            bo_floor = self._bo_floor
         with self._members_lock:
             inflight = dict(self._inflight)
         with self._leader_lock:
             leader, lterm = self._is_leader, self._leader_term
-        return {"live": True, "replicas": self.routable(),
-                "queue_depth": depth, "inflight": inflight,
-                "n_replicas": self.n_replicas,
-                "router_id": self.router_id,
-                "n_routers": self.n_routers,
-                "group_size": self.group_size,
-                "leader": leader, "leader_term": lterm,
-                "max_batch": self.max_batch,
-                "batch_deadline_s": self.batch_deadline_s}
+        out = {"live": True, "replicas": self.routable(),
+               "queue_depth": depth, "inflight": inflight,
+               "n_replicas": self.n_replicas,
+               "router_id": self.router_id,
+               "n_routers": self.n_routers,
+               "group_size": self.group_size,
+               "leader": leader, "leader_term": lterm,
+               "max_batch": self.max_batch,
+               "batch_deadline_s": self.batch_deadline_s}
+        if self._qos:
+            out["qos"] = {
+                "classes": sorted(self._classes),
+                "tenant_queue_depth": tenant_depth,
+                "brownout_floor": bo_floor,
+                "high_priority_queue_depth":
+                    self.high_priority_queue_depth()}
+        return out
 
     def _pick_replica(self, tried):
         """Least-loaded live replica not yet tried for this batch:
@@ -1518,30 +1752,31 @@ class FleetRouter(_FleetMember):
                             + 0.05):
             p.abandoned = True
             resilience.record_router_request("deadline",
-                                             router=self._host_id)
+                                             router=self._host_id,
+                                             tenant=p.tenant)
             if not outcome_replayed:
                 # a token replay waiting out the same _Pending must
                 # not double-spend a top-K exemplar slot on one
                 # logical request
                 resilience.record_router_slow(
                     time.monotonic() - p.enqueued, trace=p.trace,
-                    router=self._host_id)
+                    router=self._host_id, tenant=p.tenant)
             raise DeadlineExceededError(
                 "request did not complete within its deadline")
         if not outcome_replayed:
             resilience.record_router_slow(
                 time.monotonic() - p.enqueued, trace=p.trace,
-                router=self._host_id)
+                router=self._host_id, tenant=p.tenant)
         if p.error is not None:
             resilience.record_router_request(
                 "shed" if isinstance(p.error, ServerOverloadedError)
                 else "deadline"
                 if isinstance(p.error, DeadlineExceededError)
-                else "error", router=self._host_id)
+                else "error", router=self._host_id, tenant=p.tenant)
             raise p.error
         resilience.record_router_request(
             "replay" if outcome_replayed else "ok",
-            router=self._host_id)
+            router=self._host_id, tenant=p.tenant)
         return p.result
 
     def _remember_token(self, token, p):
@@ -1550,7 +1785,9 @@ class FleetRouter(_FleetMember):
             while len(self._tokens) > self.TOKEN_CACHE:
                 self._tokens.popitem(last=False)
 
-    def submit(self, feeds, deadline_s=None, token=None, trace=None):
+    def submit(self, feeds, deadline_s=None, token=None, trace=None,
+               tenant=None, deadline_budget_ms=None,
+               retry_budget=None):
         """Route one request (dict name -> rows as nested lists).
         Returns ``{"outputs", "dtypes", "replica", "generation"}``.
         ``token`` (an opaque client string) makes the request
@@ -1563,15 +1800,48 @@ class FleetRouter(_FleetMember):
         gets a ``router.serve`` span (with queue/dispatch children)
         under the caller's trace, so one client request is one
         timeline across processes.
-        Raises ServerOverloadedError (queue full / every replica
-        shedding), DeadlineExceededError, ValueError (malformed
-        request) or RuntimeError (upstream failure after retries)."""
+        ``tenant`` is the request's QoS identity (the ``x-tenant``
+        header / ``"tenant"`` body field; absent = ``"default"``):
+        with tenant classes configured it selects the class whose
+        weight/quota/priority govern admission and queueing, and it
+        labels every counter, exemplar and span either way.
+        ``deadline_budget_ms`` is the REMAINING cross-hop deadline
+        budget (the ``x-deadline-ms`` header): it caps ``deadline_s``,
+        an already-spent budget is refused 504-style WITHOUT queueing,
+        and whatever is left at dispatch time rides the next hop's
+        ``x-deadline-ms``. ``retry_budget`` (``x-retry-budget``) caps
+        how many replica attempts this request may burn across
+        retry-on-sibling.
+        Raises ServerOverloadedError (queue full / quota or brownout
+        shed / every replica shedding), DeadlineExceededError,
+        ValueError (malformed request) or RuntimeError (upstream
+        failure after retries)."""
         tr, parent = trace if trace else (None, None)
+        tenant = tenant or DEFAULT_TENANT
         with obs.span("router.serve", trace_id=tr, parent=parent,
-                      router=self._host_id) as sp:
-            return self._submit_traced(feeds, deadline_s, token, sp)
+                      router=self._host_id, tenant=tenant) as sp:
+            return self._submit_traced(feeds, deadline_s, token, sp,
+                                       tenant, deadline_budget_ms,
+                                       retry_budget)
 
-    def _submit_traced(self, feeds, deadline_s, token, sp):
+    def _submit_traced(self, feeds, deadline_s, token, sp, tenant,
+                       deadline_budget_ms, retry_budget):
+        if deadline_budget_ms is not None:
+            budget_s = float(deadline_budget_ms) / 1000.0
+            if budget_s <= 0:
+                # the budget died upstream (a slow client hop, a
+                # queueing router ahead of us): refuse WITHOUT
+                # queueing — dispatching would burn replica time on
+                # an answer nobody is waiting for
+                resilience.record_router_expired(
+                    "queue", tenant=tenant, router=self._host_id)
+                resilience.record_router_request(
+                    "deadline", router=self._host_id, tenant=tenant)
+                raise DeadlineExceededError(
+                    "deadline budget exhausted before admission — "
+                    "refused without queueing")
+            deadline_s = budget_s if deadline_s is None \
+                else min(float(deadline_s), budget_s)
         deadline = time.monotonic() + (
             self.request_deadline_s if deadline_s is None
             else float(deadline_s))
@@ -1591,7 +1861,8 @@ class FleetRouter(_FleetMember):
         meta = self._get_meta()
         if meta is None:
             resilience.record_router_request("error",
-                                             router=self._host_id)
+                                             router=self._host_id,
+                                             tenant=tenant)
             raise FleetError("no live replica to learn the export "
                              "contract from — is the fleet up?")
         try:
@@ -1607,27 +1878,46 @@ class FleetRouter(_FleetMember):
                     "entry" % (n, int(meta["max_bucket"])))
         except ValueError:
             resilience.record_router_request("error",
-                                             router=self._host_id)
+                                             router=self._host_id,
+                                             tenant=tenant)
             raise
-        p = _Pending(feeds, n, deadline)
+        p = _Pending(feeds, n, deadline, tenant=tenant,
+                     retry_budget=retry_budget)
         if sp.trace is not None:
             p.trace, p.span, p.t_enq = sp.trace, sp.id, obs.now()
         with self._qcond:
-            if len(self._queue) >= self.max_queue:
-                resilience.record_router_request("shed",
-                                                 router=self._host_id)
-                raise ServerOverloadedError(
-                    "router queue is full (%d waiting) — shedding "
-                    "load; retry with backoff" % self.max_queue)
-            self._queue.append(p)
-            resilience.set_router_queue_depth(len(self._queue),
-                                              router=self._host_id)
+            if self._qos:
+                msg = self._qos_admit_locked(p, time.monotonic())
+                if msg is not None:
+                    resilience.record_router_request(
+                        "shed", router=self._host_id, tenant=tenant)
+                    raise ServerOverloadedError(msg)
+            else:
+                if len(self._queue) >= self.max_queue:
+                    resilience.record_router_request(
+                        "shed", router=self._host_id, tenant=tenant)
+                    raise ServerOverloadedError(
+                        "router queue is full (%d waiting) — "
+                        "shedding load; retry with backoff"
+                        % self.max_queue)
+                self._queue.append(p)
+                resilience.set_router_queue_depth(
+                    len(self._queue), router=self._host_id)
             self._qcond.notify_all()
         if token:
             self._remember_token(token, p)
-        return self._finish_pending(p, deadline)
+        if not self._qos:
+            return self._finish_pending(p, deadline)
+        try:
+            return self._finish_pending(p, deadline)
+        finally:
+            # the in-flight quota covers admission -> completion
+            # (queued OR dispatched), whatever path ended it
+            with self._qcond:
+                self._tstate_for(p.tenant)["inflight"] -= 1
 
-    def _handle_infer(self, body, trace_header=None):
+    def _handle_infer(self, body, trace_header=None, headers=None):
+        headers = headers or {}
         feeds = body.get("feeds")
         if not isinstance(feeds, dict):
             return 400, {"error": 'infer needs {"feeds": {name: rows}}'}
@@ -1641,11 +1931,31 @@ class FleetRouter(_FleetMember):
         token = body.get("token")
         if token is not None and not isinstance(token, str):
             return 400, {"error": "token must be a string"}
+        tenant = headers.get("x-tenant") or body.get("tenant")
+        if tenant is not None and not isinstance(tenant, str):
+            return 400, {"error": "tenant must be a string"}
+        deadline_budget_ms = headers.get("x-deadline-ms")
+        if deadline_budget_ms is not None:
+            try:
+                deadline_budget_ms = float(deadline_budget_ms)
+            except (TypeError, ValueError):
+                return 400, {"error": "x-deadline-ms must be a "
+                             "number, got %r" % (deadline_budget_ms,)}
+        retry_budget = headers.get("x-retry-budget")
+        if retry_budget is not None:
+            try:
+                retry_budget = int(retry_budget)
+            except (TypeError, ValueError):
+                return 400, {"error": "x-retry-budget must be an "
+                             "integer, got %r" % (retry_budget,)}
+            if retry_budget < 1:
+                return 400, {"error": "x-retry-budget must be >= 1"}
         try:
-            return 200, self.submit(feeds, deadline_s=deadline_s,
-                                    token=token,
-                                    trace=obs.parse_header(
-                                        trace_header))
+            return 200, self.submit(
+                feeds, deadline_s=deadline_s, token=token,
+                trace=obs.parse_header(trace_header), tenant=tenant,
+                deadline_budget_ms=deadline_budget_ms,
+                retry_budget=retry_budget)
         except ServerOverloadedError as e:
             return 503, {"error": str(e), "kind": "overloaded"}
         except DeadlineExceededError as e:
@@ -1658,10 +1968,132 @@ class FleetRouter(_FleetMember):
             # must see a status code, never an aborted connection
             return 502, {"error": str(e), "kind": "upstream"}
 
+    # -- multi-tenant QoS --------------------------------------------------
+    def _class_of(self, tenant):
+        """Resolve a tenant to its :class:`TenantClass`: an explicit
+        ``tenants`` membership wins, then a class NAMED like the
+        tenant, then the ``default`` class (implicit weight-1
+        priority-0 unless configured)."""
+        c = self._tenant_to_class.get(tenant)
+        if c is not None:
+            return c
+        return self._classes.get(tenant, self._class_default)
+
+    def _tstate_for(self, tenant):
+        """Per-tenant mutable QoS state (caller holds ``_qcond``):
+        token-bucket level, in-flight count and the SFQ finish tag of
+        the tenant's last admitted request."""
+        st = self._tstate.get(tenant)
+        if st is None:
+            c = self._class_of(tenant)
+            st = self._tstate[tenant] = {
+                "tokens": c.burst if c.burst is not None else 0.0,
+                "t_tok": time.monotonic(),
+                "inflight": 0, "finish": 0.0}
+        return st
+
+    def _qos_admit_locked(self, p, now):
+        """Classed admission (caller holds ``_qcond``): brownout
+        floor, global queue cap, token-bucket rate, in-flight quota —
+        in that order, so a browned-out class cannot drain tokens it
+        would not get to spend. Returns the shed reason (``None`` =
+        admitted: the request is tagged with its SFQ virtual times
+        and appended to its tenant's queue)."""
+        c = self._class_of(p.tenant)
+        if self._bo_floor is not None and c.priority < self._bo_floor:
+            return ("brownout shed: class %r (priority %d) is below "
+                    "the current floor %d — the router keeps only "
+                    "its highest classes under overload; retry with "
+                    "backoff" % (c.name, c.priority, self._bo_floor))
+        if self._qdepth_locked() >= self.max_queue:
+            return ("router queue is full (%d waiting) — shedding "
+                    "load; retry with backoff" % self.max_queue)
+        st = self._tstate_for(p.tenant)
+        if c.rate is not None:
+            st["tokens"] = min(c.burst, st["tokens"]
+                               + (now - st["t_tok"]) * c.rate)
+            st["t_tok"] = now
+            if st["tokens"] < 1.0:
+                return ("tenant %r is over class %r's rate quota "
+                        "(%g req/s) — shedding; retry with backoff"
+                        % (p.tenant, c.name, c.rate))
+            st["tokens"] -= 1.0
+        if c.max_inflight is not None \
+                and st["inflight"] >= c.max_inflight:
+            return ("tenant %r is at class %r's in-flight quota (%d) "
+                    "— shedding; retry with backoff"
+                    % (p.tenant, c.name, c.max_inflight))
+        st["inflight"] += 1
+        p.vstart = max(self._vclock, st["finish"])
+        p.vfinish = p.vstart + p.n / c.weight
+        st["finish"] = p.vfinish
+        q = self._tqueues.get(p.tenant)
+        if q is None:
+            q = self._tqueues[p.tenant] = collections.deque()
+        q.append(p)
+        resilience.set_router_tenant_queue_depth(
+            p.tenant, len(q), router=self._host_id)
+        resilience.set_router_queue_depth(self._qdepth_locked(),
+                                          router=self._host_id)
+        return None
+
+    def _qos_loop(self):
+        while not self._stop.wait(self._qos_interval_s):
+            self._qos_tick()
+
+    def _qos_tick(self):
+        """Brownout controller tick — the autoscaler's frozen-signal
+        discipline applied to shedding: sample queue depth and the
+        shed-rate delta, and only a ``qos_hysteresis``-long streak of
+        hot (cool) samples moves the admissible-priority floor one
+        class level up (down). Admission reads the FROZEN verdict
+        (``_bo_floor``) — per-request heuristics would flap at
+        request rate. The floor never exceeds the highest configured
+        priority, so the highest class is never browned out."""
+        depth = self.queue_depth()
+        _, shed, total = self._load_signals()
+        prev = self._bo_prev
+        self._bo_prev = (shed, total)
+        if prev is None:
+            return
+        d_shed, d_total = shed - prev[0], total - prev[1]
+        rate = float(d_shed) / d_total if d_total > 0 else 0.0
+        hot = depth >= self._brownout_queue_depth \
+            or rate >= self._brownout_shed_rate
+        with self._qcond:
+            levels, cur = self._bo_levels, self._bo_floor
+            nxt = cur
+            if hot:
+                self._bo_hot += 1
+                self._bo_cool = 0
+                if self._bo_hot >= self._qos_hysteresis:
+                    above = [lv for lv in levels
+                             if cur is None or lv > cur]
+                    # the top level stays admissible: the floor may
+                    # reach levels[-1] (only the highest class kept),
+                    # never pass it
+                    if len(above) > (1 if cur is None else 0):
+                        nxt = above[1] if cur is None else above[0]
+            else:
+                self._bo_cool += 1
+                self._bo_hot = 0
+                if self._bo_cool >= self._qos_hysteresis \
+                        and cur is not None:
+                    idx = levels.index(cur)
+                    nxt = levels[idx - 1] if idx > 1 else None
+            if nxt != cur:
+                self._bo_floor = nxt
+                self._bo_hot = self._bo_cool = 0
+        if nxt != cur:
+            record_event("router_brownout", router=self._host_id,
+                         floor=nxt, queue=depth,
+                         shed_rate=round(rate, 3))
+
     # -- continuous micro-batching -----------------------------------------
     def _batch_loop(self):
+        cut = self._cut_batch_wfq if self._qos else self._cut_batch
         while not self._stop.is_set():
-            batch = self._cut_batch()
+            batch = cut()
             if batch:
                 resilience.observe_router_batch(len(batch),
                                                 router=self._host_id)
@@ -1706,7 +2138,8 @@ class FleetRouter(_FleetMember):
                 now = time.monotonic()
                 while self._queue and (self._queue[0].abandoned
                                        or now > self._queue[0].deadline):
-                    self._queue.popleft()
+                    self._drop_expired_locked(self._queue.popleft(),
+                                              now)
                 if not self._queue:
                     resilience.set_router_queue_depth(
                         0, router=self._host_id)
@@ -1726,7 +2159,8 @@ class FleetRouter(_FleetMember):
                 while self._queue:
                     p = self._queue[0]
                     if p.abandoned or now > p.deadline:
-                        self._queue.popleft()
+                        self._drop_expired_locked(
+                            self._queue.popleft(), now)
                         continue
                     if batch and (not coalescing
                                   or rows + p.n > cap
@@ -1740,23 +2174,113 @@ class FleetRouter(_FleetMember):
                 resilience.set_router_queue_depth(len(self._queue),
                                                   router=self._host_id)
                 if batch and obs.enabled():
-                    # retroactive per-request queue spans (enqueue ->
-                    # cut) + one coalesce span on the oldest member:
-                    # "was the latency queue wait or replica time" is
-                    # answerable per request
-                    t_cut = obs.now()
-                    lead = next((p for p in batch
-                                 if p.trace is not None), None)
-                    if lead is not None:
-                        obs.record("router.coalesce", lead.t_enq,
-                                   t_cut, trace_id=lead.trace,
-                                   parent=lead.span,
-                                   batch=len(batch))
-                    for p in batch:
-                        if p.trace is not None:
-                            obs.record("router.queue", p.t_enq,
-                                       t_cut, trace_id=p.trace,
-                                       parent=p.span)
+                    self._record_cut_spans(batch)
+                return batch
+        return []
+
+    def _drop_expired_locked(self, p, now):
+        """Account one request dropped from a queue without ever
+        being dispatched. ``where="queue"`` on the deadline-expired
+        counter is the propagated-budget discipline in action: the
+        budget died while the request waited, so no replica slot is
+        burnt on it (the caller already took the deadline path)."""
+        if now > p.deadline:
+            resilience.record_router_expired(
+                "queue", tenant=p.tenant, router=self._host_id)
+
+    def _record_cut_spans(self, batch):
+        # retroactive per-request queue spans (enqueue -> cut) + one
+        # coalesce span on the oldest member: "was the latency queue
+        # wait or replica time" is answerable per request
+        t_cut = obs.now()
+        lead = next((p for p in batch if p.trace is not None), None)
+        if lead is not None:
+            obs.record("router.coalesce", lead.t_enq, t_cut,
+                       trace_id=lead.trace, parent=lead.span,
+                       batch=len(batch))
+        for p in batch:
+            if p.trace is not None:
+                obs.record("router.queue", p.t_enq, t_cut,
+                           trace_id=p.trace, parent=p.span,
+                           tenant=p.tenant)
+
+    def _cut_batch_wfq(self):
+        """The QoS cutter: like :meth:`_cut_batch`, but requests wait
+        in PER-TENANT queues and the cut drains them by start-time
+        fair queueing — each queue head carries a virtual finish tag
+        stamped at admission (``vstart = max(vclock, tenant's last
+        finish)``, ``vfinish = vstart + rows / weight``) and the
+        cutter repeatedly picks the smallest ``vfinish`` among heads,
+        advancing the virtual clock to the pick's ``vstart``. Over
+        any busy interval each tenant's served rows converge to its
+        weight share, an idle tenant builds no credit (its next
+        vstart jumps to the live vclock), and a flooding tenant only
+        queues behind its own backlog — the isolation the single
+        FIFO cannot give."""
+        while not self._stop.is_set():
+            meta = self._get_meta()
+            if meta is None:
+                self._stop.wait(0.05)
+                continue
+            coalescing = bool(meta["dynamic_batch"])
+            cap = self.max_batch
+            if coalescing and meta.get("max_bucket"):
+                cap = min(cap, int(meta["max_bucket"]))
+            static_names = [nm for nm, f
+                            in meta["feed_batch_factors"].items()
+                            if not f]
+            with self._qcond:
+                now = time.monotonic()
+                for t, q in self._tqueues.items():
+                    while q and (q[0].abandoned
+                                 or now > q[0].deadline):
+                        self._drop_expired_locked(q.popleft(), now)
+                heads = [q[0] for q in self._tqueues.values() if q]
+                if not heads:
+                    resilience.set_router_queue_depth(
+                        0, router=self._host_id)
+                    self._qcond.wait(0.05)
+                    continue
+                rows = sum(p.n for q in self._tqueues.values()
+                           for p in q
+                           if not (p.abandoned or now > p.deadline))
+                cut_at = min(p.enqueued for p in heads) \
+                    + self.batch_deadline_s
+                if coalescing and rows < cap and now < cut_at:
+                    self._qcond.wait(min(cut_at - now, 0.05))
+                    continue
+                batch, rows = [], 0
+                while True:
+                    head = None
+                    for q in self._tqueues.values():
+                        if q and (head is None
+                                  or q[0].vfinish < head[0].vfinish):
+                            head = q
+                    if head is None:
+                        break
+                    p = head[0]
+                    if p.abandoned or now > p.deadline:
+                        self._drop_expired_locked(head.popleft(), now)
+                        continue
+                    if batch and (not coalescing
+                                  or rows + p.n > cap
+                                  or any(p.feeds.get(nm)
+                                         != batch[0].feeds.get(nm)
+                                         for nm in static_names)):
+                        break
+                    head.popleft()
+                    self._vclock = max(self._vclock, p.vstart)
+                    batch.append(p)
+                    rows += p.n
+                for t, q in self._tqueues.items():
+                    resilience.set_router_tenant_queue_depth(
+                        t, len(q), router=self._host_id)
+                resilience.set_router_queue_depth(
+                    self._qdepth_locked(), router=self._host_id)
+                if not batch:
+                    continue   # everything waiting had expired
+                if obs.enabled():
+                    self._record_cut_spans(batch)
                 return batch
         return []
 
@@ -1790,10 +2314,25 @@ class FleetRouter(_FleetMember):
         last_err = None
         merged = None
         attempt = 0
+        # retry-on-sibling is bounded by the STRICTEST member budget
+        # (x-retry-budget): a replica outage under load must cost a
+        # bounded number of attempts per request, not a retry storm
+        retry_budget = None
+        for p in batch:
+            if p.retry_budget is not None:
+                retry_budget = p.retry_budget if retry_budget is None \
+                    else min(retry_budget, p.retry_budget)
+        n_attempts = 0
         while True:
             now = time.monotonic()
             expired = [p for p in batch if now > p.deadline]
             if expired:
+                for p in expired:
+                    # cut but never answered: the budget died between
+                    # the cut and a successful dispatch
+                    resilience.record_router_expired(
+                        "dispatch", tenant=p.tenant,
+                        router=self._host_id)
                 self._fail(expired,
                            last_err or DeadlineExceededError(
                                "request deadline expired before any "
@@ -1808,6 +2347,11 @@ class FleetRouter(_FleetMember):
                 last_err = None
             if not batch:
                 return
+            if retry_budget is not None and n_attempts >= retry_budget:
+                self._fail(batch, last_err or ServerOverloadedError(
+                    "retry budget (%d attempts) exhausted"
+                    % retry_budget))
+                return
             if merged is None:
                 merged = self._merge(batch, meta)
             remaining = min(p.deadline for p in batch) - now
@@ -1820,21 +2364,29 @@ class FleetRouter(_FleetMember):
                 return
             rid, addr = target
             payload = {"feeds": merged, "deadline_s": remaining}
+            # the remaining budget rides the next hop as x-deadline-ms
+            # (RE-COMPUTED per attempt — each retry ships a smaller
+            # budget), so the replica can refuse already-expired work
+            # before burning a batch slot on it. x-tenant carries the
+            # LEAD member's identity (a coalesced batch may mix
+            # tenants; per-request identity lives router-side)
+            headers = {"x-deadline-ms": "%d" % int(remaining * 1000.0),
+                       "x-tenant": batch[0].tenant}
+            n_attempts += 1
             # propagate the (lead) trace context to the replica so its
             # serve span joins the same timeline; the per-attempt
             # dispatch spans below are recorded per coalesced request,
             # tagged replica + outcome — a retry-on-sibling is two
             # dispatch spans under one router.serve parent
             traced = obs.enabled()
-            headers = None
             if traced:
                 attempt += 1
                 t_att = obs.now()
                 lead = next((p for p in batch
                              if p.trace is not None), None)
                 if lead is not None:
-                    headers = {"x-trace-id":
-                               "%s:%s" % (lead.trace, lead.span)}
+                    headers["x-trace-id"] = \
+                        "%s:%s" % (lead.trace, lead.span)
             self._inc_inflight(rid, +1)
             try:
                 status, resp = http_json(
@@ -1897,7 +2449,7 @@ class FleetRouter(_FleetMember):
                 obs.record("router.dispatch", t0, t1,
                            trace_id=p.trace, parent=p.span,
                            replica=rid, outcome=outcome,
-                           attempt=attempt)
+                           attempt=attempt, tenant=p.tenant)
 
     @staticmethod
     def _fail(batch, err):
@@ -2031,7 +2583,7 @@ class FleetClient(object):
     batteries and ``tools/servingsvc.py client`` do)."""
 
     def __init__(self, endpoints, request_deadline_s=10.0,
-                 backoff_s=0.05):
+                 backoff_s=0.05, tenant=None, retry_budget=None):
         if isinstance(endpoints, str):
             endpoints = [e.strip() for e in endpoints.split(",")
                          if e.strip()]
@@ -2042,6 +2594,17 @@ class FleetClient(object):
                              "endpoint")
         self.request_deadline_s = float(request_deadline_s)
         self._backoff_s = float(backoff_s)
+        # QoS identity: rides every request as x-tenant (None = the
+        # router's "default" tenant); retry_budget bounds the total
+        # replica attempts a request may burn ACROSS hops — it rides
+        # as x-retry-budget and bounds this client's own router
+        # attempts too, so an outage under load cannot amplify into
+        # attempts(client) x attempts(router) retries
+        self.tenant = tenant if tenant is None else str(tenant)
+        self.retry_budget = retry_budget if retry_budget is None \
+            else int(retry_budget)
+        if self.retry_budget is not None and self.retry_budget < 1:
+            raise ValueError("retry_budget must be >= 1")
         self._lock = threading.Lock()
         self._i = 0
 
@@ -2075,18 +2638,38 @@ class FleetClient(object):
             self.request_deadline_s if deadline_s is None
             else float(deadline_s))
         token = uuid.uuid4().hex
-        headers = None
         if sp.trace is not None:
             sp.set(token=token)
-            headers = {"x-trace-id": "%s:%s" % (sp.trace, sp.id)}
+            if self.tenant is not None:
+                sp.set(tenant=self.tenant)
         last_err = None
+        attempts = 0
         while True:
             remaining = deadline - time.monotonic()
             if remaining <= 0:
                 raise last_err if last_err is not None else \
                     DeadlineExceededError(
                         "no router answered within the deadline")
+            if self.retry_budget is not None \
+                    and attempts >= self.retry_budget:
+                raise last_err if last_err is not None else \
+                    ServerOverloadedError(
+                        "retry budget (%d attempts) exhausted"
+                        % self.retry_budget)
             url = self._url()
+            # the deadline budget is RE-STAMPED per attempt: each hop
+            # (and each retry) ships only what is left, so a request
+            # that dies in a queue somewhere is refused downstream
+            # instead of dispatched into the void
+            headers = {"x-deadline-ms": "%d" % int(remaining * 1000.0)}
+            if self.tenant is not None:
+                headers["x-tenant"] = self.tenant
+            if self.retry_budget is not None:
+                headers["x-retry-budget"] = \
+                    "%d" % (self.retry_budget - attempts)
+            if sp.trace is not None:
+                headers["x-trace-id"] = "%s:%s" % (sp.trace, sp.id)
+            attempts += 1
             try:
                 status, resp = http_json(
                     "POST", url + "/infer",
@@ -2167,7 +2750,7 @@ class Autoscaler(object):
                  min_replicas=None, max_replicas=None,
                  interval_s=0.25, window=8, grow_queue_depth=4.0,
                  grow_shed_rate=0.05, hysteresis=3, cooldown_s=5.0,
-                 drain_timeout_s=15.0):
+                 drain_timeout_s=15.0, grow_high_queue_depth=None):
         self.router = router
         self.spawner = spawner
         self.stopper = stopper
@@ -2182,6 +2765,15 @@ class Autoscaler(object):
         self.interval_s = float(interval_s)
         self.window = int(window)
         self.grow_queue_depth = float(grow_queue_depth)
+        # class-aware growth: sustained HIGHEST-priority-class queue
+        # depth grows the fleet even when brownout shedding keeps the
+        # total depth under grow_queue_depth — paying for capacity is
+        # the remedy for high-class pressure, shedding is not.
+        # Defaults to half the global threshold (min 1) with tenant
+        # classes configured; no-op on a classless router (hq == 0)
+        self.grow_high_queue_depth = float(grow_high_queue_depth) \
+            if grow_high_queue_depth is not None \
+            else max(1.0, self.grow_queue_depth / 2.0)
         self.grow_shed_rate = float(grow_shed_rate)
         self.hysteresis = int(hysteresis)
         self.cooldown_s = float(cooldown_s)
@@ -2227,16 +2819,18 @@ class Autoscaler(object):
         is this deep"), counters sum."""
         r = self.router
         queue, shed, total = r._load_signals()
+        hq = r.high_priority_queue_depth()
         with r._members_lock:
             inflight = sum(r._inflight.values()) \
                 + sum(r._peer_inflight.values())
             peers = [dict(v) for v in r._peer_router_load.values()]
         for p in peers:
             queue = max(queue, p.get("queue", 0))
+            hq = max(hq, p.get("hq", 0))
             shed += p.get("shed", 0)
             total += p.get("reqs", 0)
         return {"queue": queue, "shed": shed,
-                "total": total, "inflight": inflight}
+                "total": total, "inflight": inflight, "hq": hq}
 
     def _window_shed_rate(self):
         if len(self._samples) < 2:
@@ -2257,6 +2851,7 @@ class Autoscaler(object):
         s = self._sample()
         self._samples.append(s)
         if s["queue"] >= self.grow_queue_depth \
+                or s["hq"] >= self.grow_high_queue_depth \
                 or (len(self._samples) >= 2
                     and self._window_shed_rate()
                     >= self.grow_shed_rate):

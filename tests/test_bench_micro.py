@@ -73,6 +73,10 @@ def test_budget_table_covers_the_contract():
         "transport_failover_ms",
         "serving_p50_ms", "serving_p99_ms", "serving_shed_rate",
         "serving_error_rate", "router_failover_ms",
+        # ISSUE-16 multi-tenant QoS slice of the serving section:
+        # highest-class p99 behind the WFQ cutter + Jain's fairness
+        # index over per-class success ratios
+        "serving_gold_p99_ms", "serving_fairness",
         "pp_step_s", "pp_bubble_frac", "pp_cache_hit_rate",
         "obs_step_overhead_ratio", "obs_router_overhead_ratio",
         "obs_span_record_us",

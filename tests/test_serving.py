@@ -124,3 +124,135 @@ def test_stablehlo_export_batch_factor_feeds(tmp_path):
     out, = pred.run({k: np.asarray(v) for k, v in feed.items()})
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# quantized serving artifacts (ISSUE 16 satellite, ROADMAP 3c): the
+# EQuARX-grounded q8 block codec from the checkpoint/state-ship path
+# reused for the serving export — weights ride BESIDE the .bin as
+# block-quantized int8 and are dequantized once at load
+# ---------------------------------------------------------------------------
+
+def test_q8_export_shrinks_and_roundtrips(tmp_path):
+    """weight_compress='q8': the .bin holds no baked weights (the
+    artifact shrinks ~4x on weight-dominated exports), the predictor
+    dequantizes at load, and predictions match the full-precision
+    export within the codec's block-quantization tolerance."""
+    main, exe, y = _build_and_train()
+    xv = np.random.RandomState(0).rand(5, 6).astype(np.float32)
+
+    fp = str(tmp_path / "fp32")
+    q8 = str(tmp_path / "q8")
+    pt.save_inference_model(fp, ["x"], [y], exe, main_program=main,
+                            format="stablehlo", batch_sizes=(8,))
+    pt.save_inference_model(q8, ["x"], [y], exe, main_program=main,
+                            format="stablehlo", batch_sizes=(8,),
+                            weight_compress="q8")
+
+    from paddle_tpu.serving import (SERVING_FORMAT_VERSION,
+                                    WEIGHTS_Q8_FILE,
+                                    load_serving_artifact)
+    meta = json.load(open(os.path.join(q8, "serving", "meta.json")))
+    assert meta["format_version"] == SERVING_FORMAT_VERSION == 3
+    assert meta["weight_compress"] == "q8"
+    assert sorted(meta["weight_names"])
+    assert os.path.exists(os.path.join(q8, "serving", WEIGHTS_Q8_FILE))
+    # the bins carry the computation only; the weights moved into the
+    # int8 npz — the EXPORT pair proves the ship-bytes shrink
+    bin_fp = os.path.getsize(os.path.join(fp, "serving",
+                                          "export_b8.bin"))
+    bin_q8 = os.path.getsize(os.path.join(q8, "serving",
+                                          "export_b8.bin"))
+    assert bin_q8 < bin_fp
+
+    ref_pred = load_serving_artifact(fp)
+    q8_pred = load_serving_artifact(q8)
+    assert ref_pred.weight_compress is None
+    assert q8_pred.weight_compress == "q8"
+    ref, = ref_pred.run({"x": xv})
+    out, = q8_pred.run({"x": xv})
+    assert out.shape == ref.shape
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-2)
+
+
+def test_q8_artifact_wire_bytes_shrink(tmp_path):
+    """The state-ship accounting a q8 replica reports: the artifact's
+    (raw, wire) byte pair — what _load_predictor feeds the stateship
+    counters — must SHRINK vs the full-precision export of the same
+    model, not just be assumed to.  Uses a weight-dominated model:
+    the codec only block-quantizes arrays past its block size, and
+    the fixed MLIR/meta overhead must not mask the weight savings."""
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [64], dtype="float32")
+        h = layers.fc(x, 256, act="relu")
+        y = layers.softmax(layers.fc(h, 8))
+    exe = pt.Executor()
+    exe.run(startup)
+    fp = str(tmp_path / "fp32")
+    q8 = str(tmp_path / "q8")
+    pt.save_inference_model(fp, ["x"], [y], exe, main_program=main,
+                            format="stablehlo", batch_sizes=(8,))
+    pt.save_inference_model(q8, ["x"], [y], exe, main_program=main,
+                            format="stablehlo", batch_sizes=(8,),
+                            weight_compress="q8")
+    from paddle_tpu.serving_fleet import _artifact_wire_bytes
+    raw_fp, wire_fp = _artifact_wire_bytes(fp)
+    raw_q8, wire_q8 = _artifact_wire_bytes(q8)
+    assert raw_q8 < raw_fp
+    assert wire_q8 < wire_fp
+
+
+def test_q8_format_fences(tmp_path):
+    """The lossy export is fenced both ways: an unknown compression
+    scheme is refused at export AND at load (a v3 artifact from a
+    newer codec must never be served as garbage), while a PLAIN
+    export stays format_version 2 — old loaders keep working."""
+    main, exe, y = _build_and_train()
+    plain = str(tmp_path / "plain")
+    pt.save_inference_model(plain, ["x"], [y], exe, main_program=main,
+                            format="stablehlo", batch_sizes=(8,))
+    meta = json.load(open(os.path.join(plain, "serving", "meta.json")))
+    assert meta["format_version"] == 2
+    assert "weight_compress" not in meta
+
+    with pytest.raises(ValueError, match="weight_compress"):
+        pt.save_inference_model(str(tmp_path / "bad"), ["x"], [y],
+                                exe, main_program=main,
+                                format="stablehlo", batch_sizes=(8,),
+                                weight_compress="zstd")
+
+    q8 = str(tmp_path / "q8")
+    pt.save_inference_model(q8, ["x"], [y], exe, main_program=main,
+                            format="stablehlo", batch_sizes=(8,),
+                            weight_compress="q8")
+    mpath = os.path.join(q8, "serving", "meta.json")
+    meta = json.load(open(mpath))
+    meta["weight_compress"] = "zstd9"
+    with open(mpath, "w") as f:
+        json.dump(meta, f)
+    from paddle_tpu.serving import load_serving_artifact
+    with pytest.raises(ValueError, match="weight_compress"):
+        load_serving_artifact(q8)
+
+
+def test_q8_artifact_still_verified_at_load(tmp_path, monkeypatch):
+    """progcheck at load survives the codec: a q8 artifact shipping a
+    CORRUPT program IR refuses to load exactly like a full-precision
+    one — compression must not open a verification bypass."""
+    main, exe, y = _build_and_train()
+    q8 = str(tmp_path / "q8")
+    pt.save_inference_model(q8, ["x"], [y], exe, main_program=main,
+                            format="stablehlo", batch_sizes=(8,),
+                            weight_compress="q8")
+    model_path = os.path.join(q8, "__model__.json")
+    assert os.path.exists(model_path)
+    meta = json.load(open(model_path))
+    # first op loses its type: the verifier's strict walk must refuse
+    meta["program"]["blocks"][0]["ops"][0].pop("type", None)
+    with open(model_path, "w") as f:
+        json.dump(meta, f)
+    from paddle_tpu.serving import load_serving_artifact
+    with pytest.raises(ValueError):
+        load_serving_artifact(q8)

@@ -1,0 +1,659 @@
+"""Multi-tenant QoS battery (ISSUE 16 tentpole): weighted-fair
+queueing, deadline-budget propagation, priority shed and per-tenant
+accounting on the serving fleet (paddle_tpu/serving_fleet.py).
+
+Three tiers, every wait hard-bounded (PR 5 discipline):
+
+  * scheduler units — TenantClass/parse_tenant_classes validation,
+    the start-time-fair-queuing drain order, token-bucket and
+    in-flight quotas, the brownout floor controller (driven tick by
+    tick with frozen fake signals);
+  * fleet semantics over HTTP — expired-in-queue answers 504 WITHOUT
+    dispatching (counter-asserted), the replica-side expired guard,
+    bounded retry budgets client- and router-side, and the classless
+    parity contract (no classes = the classic path, default-tenant
+    series mirror the aggregate);
+  * the multi-tenant chaos soak — REAL replica processes loaded from
+    a QUANTIZED (q8) artifact, three tenant classes with an abusive
+    bronze flood, a SIGKILL mid-soak, and the acceptance asserts:
+    zero gold failures, bronze shed, fairness ordering, and the
+    "never dispatched after expiry" counters flat at zero.
+"""
+import collections
+import contextlib
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.framework import resilience
+from paddle_tpu.framework.transport import CoordServer
+from paddle_tpu.serving_fleet import (DEFAULT_TENANT, FleetClient,
+                                      FleetError, FleetRouter,
+                                      ReplicaMember, TenantClass,
+                                      _Pending, http_json,
+                                      parse_tenant_classes)
+
+pytestmark = [pytest.mark.faultinject, pytest.mark.fleet]
+
+WAIT_S = 20.0           # hard bound on every readiness/liveness wait
+
+
+@pytest.fixture(autouse=True)
+def _clean_resilience():
+    resilience.install(None)
+    resilience.clear_events()
+    resilience.clear_router()
+    yield
+    resilience.install(None)
+    resilience.clear_events()
+    resilience.clear_router()
+
+
+def _export_artifact(dirname, features=6, classes=3,
+                     batch_sizes=(1, 8), weight_compress=None):
+    from paddle_tpu.framework.scope import Scope, scope_guard
+    with scope_guard(Scope()):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("x", [features], dtype="float32")
+            y = layers.softmax(layers.fc(x, classes))
+        exe = pt.Executor()
+        exe.run(startup)
+        pt.save_inference_model(str(dirname), ["x"], [y], exe,
+                                main_program=main, format="stablehlo",
+                                batch_sizes=batch_sizes,
+                                weight_compress=weight_compress)
+    return str(dirname)
+
+
+@pytest.fixture(scope="module")
+def artifact(tmp_path_factory):
+    return _export_artifact(tmp_path_factory.mktemp("qos_artifact"))
+
+
+def _fleet(stack, artifact, n_replicas, hb_deadline_s=2.0,
+           replica_kw=None, router_kw=None):
+    srv = CoordServer(None, hb_deadline_s=hb_deadline_s).start()
+    stack.callback(srv.close)
+    reps = []
+    for i in range(n_replicas):
+        rep = ReplicaMember(artifact, srv.address, n_replicas, i,
+                            ctl_interval_s=0.05, hb_interval_s=0.1,
+                            join_timeout_s=WAIT_S,
+                            **(replica_kw or {})).start()
+        stack.callback(rep.close)
+        reps.append(rep)
+    rkw = dict(max_batch=8, batch_deadline_s=0.01, ctl_interval_s=0.05,
+               hb_interval_s=0.1, poll_interval_s=0.03,
+               join_timeout_s=WAIT_S)
+    rkw.update(router_kw or {})
+    router = FleetRouter(srv.address, n_replicas, **rkw).start()
+    stack.callback(router.close)
+    _wait(lambda: len(router.routable()) == n_replicas,
+          "all replicas routable")
+    return srv, reps, router
+
+
+def _wait(cond, what, timeout_s=WAIT_S):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.02)
+    raise AssertionError("timed out waiting for %s" % what)
+
+
+def _post(router, feeds, deadline_s=None, timeout_s=15.0,
+          headers=None):
+    body = {"feeds": feeds}
+    if deadline_s is not None:
+        body["deadline_s"] = deadline_s
+    return http_json("POST", router.url + "/infer", body,
+                     timeout_s=timeout_s, headers=headers)
+
+
+def _scheduler(classes, max_queue=128, hysteresis=3,
+               brownout_queue_depth=96, brownout_shed_rate=0.5):
+    """A FleetRouter reduced to its QoS scheduler state — no threads,
+    no sockets: _qos_admit_locked / _qos_tick / the WFQ pick operate
+    on exactly these attributes, so the units can drive them
+    deterministically (frozen clock, hand-fed signals)."""
+    r = object.__new__(FleetRouter)
+    r._classes = parse_tenant_classes(classes)
+    r._qos = bool(r._classes)
+    r._class_default = r._classes.get(
+        DEFAULT_TENANT, TenantClass(DEFAULT_TENANT))
+    r._tenant_to_class = {}
+    for c in r._classes.values():
+        for t in c.tenants:
+            r._tenant_to_class[t] = c
+    r._tqueues = {}
+    r._tstate = {}
+    r._vclock = 0.0
+    r._queue = collections.deque()
+    r._qcond = threading.Condition()
+    r.max_queue = max_queue
+    r._host_id = 99
+    r._bo_floor = None
+    r._bo_levels = sorted(set(
+        [c.priority for c in r._classes.values()]
+        + [r._class_default.priority]))
+    r._bo_hot = r._bo_cool = 0
+    r._bo_prev = None
+    r._brownout_queue_depth = brownout_queue_depth
+    r._brownout_shed_rate = brownout_shed_rate
+    r._qos_interval_s = 0.01
+    r._qos_hysteresis = hysteresis
+    return r
+
+
+def _admit(r, tenant, now, n=1):
+    p = _Pending({}, n, time.monotonic() + 100.0, tenant=tenant)
+    with r._qcond:
+        return p, r._qos_admit_locked(p, now)
+
+
+def _wfq_pick(r):
+    """One cutter pick: the smallest vfinish among queue heads (the
+    loop body of _cut_batch_wfq, minus batching concerns)."""
+    head = None
+    for q in r._tqueues.values():
+        if q and (head is None or q[0].vfinish < head[0].vfinish):
+            head = q
+    if head is None:
+        return None
+    p = head.popleft()
+    r._vclock = max(r._vclock, p.vstart)
+    return p
+
+
+# ---------------------------------------------------------------------------
+# scheduler units
+# ---------------------------------------------------------------------------
+
+def test_tenant_class_validation():
+    """TenantClass rejects unschedulable knobs; parse_tenant_classes
+    takes both config shapes and refuses typo'd keys."""
+    with pytest.raises(ValueError, match="weight"):
+        TenantClass("g", weight=0)
+    with pytest.raises(ValueError, match="rate"):
+        TenantClass("g", rate=-1)
+    with pytest.raises(ValueError, match="burst"):
+        TenantClass("g", rate=5, burst=0.5)
+    with pytest.raises(ValueError, match="max_inflight"):
+        TenantClass("g", max_inflight=0)
+    # burst defaults to max(1, rate): a sub-1 rate still admits one
+    assert TenantClass("g", rate=0.5).burst == 1.0
+    assert TenantClass("g", rate=8).burst == 8.0
+    assert TenantClass("g").burst is None
+
+    by_dict = parse_tenant_classes(
+        {"gold": {"weight": 4, "priority": 2},
+         "bronze": {"rate": 10, "tenants": ["crawler"]}})
+    assert by_dict["gold"].weight == 4.0
+    assert by_dict["bronze"].tenants == frozenset(["crawler"])
+    by_list = parse_tenant_classes(
+        [{"name": "gold", "weight": 4}])
+    assert by_list["gold"].weight == 4.0
+    with pytest.raises(ValueError, match='"name"'):
+        parse_tenant_classes([{"weight": 4}])
+    with pytest.raises(ValueError, match="unknown keys"):
+        parse_tenant_classes({"gold": {"wieght": 4}})
+    assert parse_tenant_classes(None) == {}
+    assert parse_tenant_classes({}) == {}
+
+
+def test_wfq_drains_by_weight_share():
+    """Start-time fair queueing: with gold at weight 4 and bronze at
+    weight 1 both backlogged, the first 10 picks split 8:2 — each
+    class converges to its weight share of the drain, and the bronze
+    flood queues only behind itself."""
+    r = _scheduler({"gold": {"weight": 4},
+                    "bronze": {"weight": 1}})
+    now = time.monotonic()
+    for _ in range(12):
+        for t in ("gold", "bronze"):
+            _, msg = _admit(r, t, now)
+            assert msg is None
+    picks = [_wfq_pick(r).tenant for _ in range(10)]
+    counts = collections.Counter(picks)
+    assert counts["gold"] == 8 and counts["bronze"] == 2, picks
+    # an idle tenant builds no credit: after the backlog drains, a
+    # late arrival's vstart jumps to the live virtual clock
+    while _wfq_pick(r) is not None:
+        pass
+    late, msg = _admit(r, "bronze", now)
+    assert msg is None
+    assert late.vstart >= r._vclock
+
+
+def test_wfq_tracks_high_priority_queue_depth():
+    """high_priority_queue_depth counts only waiting requests in
+    classes at the TOP priority level — the autoscaler's "grow on
+    high-class pressure" signal ignores the bronze flood."""
+    r = _scheduler({"gold": {"weight": 4, "priority": 2},
+                    "bronze": {"weight": 1, "priority": 0}})
+    now = time.monotonic()
+    for _ in range(3):
+        _admit(r, "gold", now)
+    for _ in range(7):
+        _admit(r, "bronze", now)
+    assert r.high_priority_queue_depth() == 3
+    assert r._qdepth_locked() == 10
+
+
+def test_token_bucket_and_inflight_quotas_shed():
+    """Admission quotas: the token bucket refuses the burst-exhausted
+    tenant until time refills it; the in-flight cap refuses until a
+    completion returns the slot."""
+    r = _scheduler({"metered": {"rate": 5, "burst": 2},
+                    "slot": {"max_inflight": 1}})
+    # a frozen "now" safely past the bucket's creation stamp: the
+    # first refill clamps at the burst EXACTLY, so the arithmetic
+    # below is deterministic
+    t0 = time.monotonic() + 1.0
+    assert _admit(r, "metered", t0)[1] is None
+    assert _admit(r, "metered", t0)[1] is None
+    _, msg = _admit(r, "metered", t0)
+    assert msg is not None and "rate quota" in msg
+    # 0.6s at 5 req/s refills 3 tokens, capped at the burst of 2
+    assert _admit(r, "metered", t0 + 0.6)[1] is None
+
+    assert _admit(r, "slot", t0)[1] is None
+    _, msg = _admit(r, "slot", t0)
+    assert msg is not None and "in-flight quota" in msg
+    with r._qcond:
+        r._tstate_for("slot")["inflight"] -= 1    # one completes
+    assert _admit(r, "slot", t0)[1] is None
+
+
+def test_brownout_floor_escalates_relaxes_and_sheds():
+    """The brownout controller: a hysteresis-long streak of hot
+    samples raises the admissible-priority floor one level at a time
+    (never past the top class), a cool streak walks it back down, and
+    admission sheds strictly below the frozen floor."""
+    r = _scheduler({"gold": {"priority": 2},
+                    "silver": {"priority": 1},
+                    "bronze": {"priority": 0}},
+                   hysteresis=2, brownout_queue_depth=10)
+    sig = {"depth": 0, "shed": 0, "total": 0}
+    r.queue_depth = lambda: sig["depth"]
+    r._load_signals = lambda: (0, sig["shed"], sig["total"])
+
+    r._qos_tick()                      # primes the shed-rate delta
+    assert r._bo_floor is None
+    sig["depth"] = 50                  # hot: queue past the threshold
+    for _ in range(2):
+        r._qos_tick()
+    assert r._bo_floor == 1            # bronze shed, silver+gold live
+    for _ in range(2):
+        r._qos_tick()
+    assert r._bo_floor == 2            # only gold admitted...
+    for _ in range(4):
+        r._qos_tick()
+    assert r._bo_floor == 2            # ...and NEVER past the top
+    assert resilience.events("router_brownout")
+
+    now = time.monotonic()
+    _, msg = _admit(r, "bronze", now)
+    assert msg is not None and "brownout" in msg
+    _, msg = _admit(r, "silver", now)
+    assert msg is not None and "brownout" in msg
+    assert _admit(r, "gold", now)[1] is None
+
+    sig["depth"] = 0                   # cool: walk the floor back
+    for _ in range(2):
+        r._qos_tick()
+    assert r._bo_floor == 1
+    for _ in range(2):
+        r._qos_tick()
+    assert r._bo_floor is None
+    assert _admit(r, "bronze", now)[1] is None
+
+
+# ---------------------------------------------------------------------------
+# fleet semantics over HTTP
+# ---------------------------------------------------------------------------
+
+def test_expired_in_queue_answers_504_without_dispatching(artifact):
+    """ACCEPTANCE (deadline propagation): a request whose propagated
+    x-deadline-ms budget dies while QUEUED answers 504 and is never
+    dispatched — the where="queue" counter bumps, where="replica"
+    stays flat, and the replica's own guard counter stays zero."""
+    with contextlib.ExitStack() as stack:
+        _, reps, router = _fleet(
+            stack, artifact, 1,
+            router_kw=dict(
+                batch_deadline_s=0.5,       # the cutter lingers...
+                tenant_classes={"gold": {"weight": 2,
+                                         "priority": 1}}))
+        xv = np.ones((2, 6), np.float32).tolist()
+        # ...so a 60ms budget is spent before the cut ever happens
+        status, resp = _post(router, {"x": xv},
+                             headers={"x-tenant": "gold",
+                                      "x-deadline-ms": "60"})
+        assert status == 504, resp
+        assert resp["kind"] == "deadline"
+        # an ARRIVAL-expired budget is refused without even queueing
+        status, resp = _post(router, {"x": xv},
+                             headers={"x-tenant": "gold",
+                                      "x-deadline-ms": "0"})
+        assert status == 504, resp
+        assert "without queueing" in resp["error"]
+        _wait(lambda: resilience.router_totals()["expired"]
+              .get("queue", {}).get("gold", 0) >= 2,
+              "expired-in-queue counted")
+        totals = resilience.router_totals()
+        assert not totals["expired"].get("replica")
+        assert reps[0].health()["expired_refused"] == 0
+        # the router stays healthy for well-budgeted traffic, and its
+        # health blob exposes the QoS posture
+        status, resp = _post(router, {"x": xv},
+                             headers={"x-tenant": "gold",
+                                      "x-deadline-ms": "10000"})
+        assert status == 200, resp
+        h = router.health()
+        assert h["qos"]["brownout_floor"] is None
+        assert "gold" in h["qos"]["classes"]
+
+
+def test_replica_guard_refuses_expired_budget(artifact):
+    """Satellite: the replica-side guard — dispatched work arriving
+    with a spent x-deadline-ms budget is refused 504 BEFORE the batch
+    window, counted in expired_refused and the where="replica"
+    series (the counter a healthy fleet holds at zero)."""
+    with contextlib.ExitStack() as stack:
+        _, reps, _ = _fleet(stack, artifact, 1)
+        xv = np.ones((1, 6), np.float32).tolist()
+        status, resp = http_json(
+            "POST", "http://%s/infer" % reps[0].address,
+            {"feeds": {"x": xv}}, timeout_s=10.0,
+            headers={"x-tenant": "gold", "x-deadline-ms": "0"})
+        assert status == 504, resp
+        assert resp["kind"] == "deadline"
+        assert reps[0].health()["expired_refused"] == 1
+        totals = resilience.router_totals()
+        assert totals["expired"]["replica"]["gold"] == 1
+        # a live budget serves normally — the guard costs nothing
+        status, resp = http_json(
+            "POST", "http://%s/infer" % reps[0].address,
+            {"feeds": {"x": xv}}, timeout_s=10.0,
+            headers={"x-deadline-ms": "10000"})
+        assert status == 200, resp
+        assert reps[0].health()["expired_refused"] == 1
+
+
+def test_client_retry_budget_bounds_attempts():
+    """Satellite: FleetClient(retry_budget=N) stops after N attempts
+    — an unreachable tier costs N rotations, not a deadline's worth
+    of spinning."""
+    with pytest.raises(ValueError, match="retry_budget"):
+        FleetClient(["127.0.0.1:1"], retry_budget=0)
+    client = FleetClient(["127.0.0.1:1"], request_deadline_s=30.0,
+                         backoff_s=0.01, retry_budget=2)
+    t0 = time.monotonic()
+    with pytest.raises(ConnectionError):
+        client.infer({"x": [[0.0] * 6]})
+    assert time.monotonic() - t0 < 10.0
+
+
+def test_router_retry_budget_bounds_sibling_attempts(artifact):
+    """Satellite: x-retry-budget caps the router's retry-on-sibling
+    loop — with every replica endpoint dead, budget 1 fails fast as
+    a 502 instead of burning the whole request deadline."""
+    with contextlib.ExitStack() as stack:
+        _, reps, router = _fleet(stack, artifact, 2,
+                                 hb_deadline_s=5.0)
+        for rep in reps:
+            rep._server.shutdown()
+            rep._server.server_close()
+        xv = np.ones((1, 6), np.float32).tolist()
+        status, resp = _post(router, {"x": xv}, deadline_s=10.0,
+                             headers={"x-retry-budget": "1"})
+        assert status == 502, resp
+        # malformed budgets are a caller bug, answered deterministic
+        status, resp = _post(router, {"x": xv},
+                             headers={"x-retry-budget": "0"})
+        assert status == 400, resp
+        status, resp = _post(router, {"x": xv},
+                             headers={"x-retry-budget": "nope"})
+        assert status == 400, resp
+
+
+def test_classless_fleet_runs_the_legacy_path(artifact):
+    """ACCEPTANCE (parity): with no tenant classes configured the
+    router runs the classic single-FIFO path — outputs match a
+    direct predictor bitwise, health carries no qos blob, and the
+    default-tenant series is exactly the aggregate series plus the
+    label."""
+    from paddle_tpu.serving import load_serving_artifact
+    ref = load_serving_artifact(artifact)
+    with contextlib.ExitStack() as stack:
+        _, _, router = _fleet(stack, artifact, 1)
+        assert not router._qos
+        assert router.high_priority_queue_depth() == 0
+        assert "qos" not in router.health()
+        xv = np.random.RandomState(7).rand(2, 6).astype(np.float32)
+        for _ in range(5):
+            status, resp = _post(router, {"x": xv.tolist()})
+            assert status == 200
+        want, = ref.run({"x": xv})
+        np.testing.assert_array_equal(
+            np.asarray(resp["outputs"][0], np.float32),
+            np.asarray(want))
+        totals = resilience.router_totals()
+        assert totals["requests"]["ok"] == 5
+        # the tenant-labelled series is ADDITIVE: the old aggregate
+        # numbers, re-published under tenant="default"
+        assert totals["tenants"][DEFAULT_TENANT]["ok"] == 5
+        assert totals["tenant_queue_depth"] == {}
+
+
+def test_replica_artifact_compress_mismatch_refused(artifact):
+    """Satellite: a replica provisioned --artifact-compress q8 must
+    refuse a full-precision artifact at LOAD (FleetError), and the
+    knob itself rejects unknown schemes."""
+    with pytest.raises(ValueError, match="artifact_compress"):
+        ReplicaMember(artifact, "127.0.0.1:1", 1, 0,
+                      artifact_compress="zstd")
+    srv = CoordServer(None).start()
+    try:
+        rep = ReplicaMember(artifact, srv.address, 1, 0,
+                            ctl_interval_s=0.05, hb_interval_s=0.1,
+                            join_timeout_s=WAIT_S,
+                            artifact_compress="q8")
+        with pytest.raises(FleetError, match="full-precision"):
+            rep.start()
+        with contextlib.suppress(Exception):
+            rep.close()
+    finally:
+        srv.close()
+
+
+def test_probe_folds_qos_series_and_flags_drift():
+    """Satellite: serving_probe folds every tenant-labelled series
+    under its own "qos" group, and qos_quota_flags stays empty while
+    tenant sums match the aggregate — then flags synthetic drift."""
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    try:
+        import serving_probe
+    finally:
+        sys.path.pop(0)
+    resilience.record_router_request("ok", tenant="gold")
+    resilience.record_router_request("ok", tenant="gold")
+    resilience.record_router_request("shed", tenant="bronze")
+    resilience.record_router_expired("queue", tenant="bronze")
+    resilience.set_router_tenant_queue_depth("gold", 3)
+    with resilience.serve_metrics(port=0) as server:
+        got = serving_probe.scrape_metrics(server.url)
+    qos = got["qos"]
+    assert qos["router_requests_total/ok/tenant:gold"] == 2.0
+    assert qos["router_requests_total/shed/tenant:bronze"] == 1.0
+    assert qos["router_deadline_expired_total/queue/tenant:bronze"] \
+        == 1.0
+    assert qos["router_tenant_queue_depth/tenant:gold"] == 3.0
+    assert serving_probe.qos_quota_flags(got) == []
+    # drift: the tenant series sum past the aggregate (a double bump)
+    flags = serving_probe.qos_quota_flags(
+        {"router": {"router_requests_total/ok": 3.0},
+         "qos": {"router_requests_total/ok/tenant:gold": 2.0}})
+    assert len(flags) == 1 and "drift" in flags[0]
+    # drift: a tenant series with NO aggregate at all
+    flags = serving_probe.qos_quota_flags(
+        {"router": {},
+         "qos": {"router_requests_total/shed/tenant:b": 1.0}})
+    assert len(flags) == 1
+
+
+# ---------------------------------------------------------------------------
+# the multi-tenant chaos soak: REAL q8 replica processes, an abusive
+# tenant, a SIGKILL — the ISSUE 16 acceptance scenario end to end
+# ---------------------------------------------------------------------------
+
+def _spawn_q8_replica(artifact, coord, n, rid):
+    tool = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "servingsvc.py")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [p for p in (env.get("PYTHONPATH"),
+                     os.path.dirname(os.path.dirname(tool))) if p])
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    return subprocess.Popen(
+        [sys.executable, tool, "replica", "--coord", coord,
+         "--n-replicas", str(n), "--replica-id", str(rid),
+         "--artifact", artifact, "--artifact-compress", "q8",
+         "--ctl-interval-s", "0.05", "--hb-interval-s", "0.1",
+         "--join-timeout-s", "30"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env)
+
+
+def test_chaos_multitenant_soak_q8_fleet(tmp_path):
+    """THE multi-tenant acceptance scenario over actual OS processes:
+    3 replica processes serve a QUANTIZED (q8) artifact through a
+    classed router while three tenants load it — gold (weight 4, top
+    priority), silver, and an abusive bronze flooding at quota. One
+    replica is SIGKILLed mid-soak. Asserts: gold finishes with ZERO
+    failures, bronze got shed (quota fairness), gold's success ratio
+    dominates bronze's, arrival-expired probes were refused without
+    queueing, and the "dispatched after expiry" counters — the
+    router's where="replica" series and every surviving replica's
+    expired_refused — read zero."""
+    artifact = _export_artifact(tmp_path / "q8", weight_compress="q8")
+    srv = CoordServer(4, hb_deadline_s=1.0).start()
+    procs, router = {}, None
+    try:
+        addrs = {}
+        for r in range(3):
+            procs[r] = _spawn_q8_replica(artifact, srv.address, 3, r)
+        for r in range(3):
+            line = json.loads(procs[r].stdout.readline())
+            assert line["replica_id"] == r, line
+            addrs[r] = line["addr"]
+        router = FleetRouter(
+            srv.address, 3, max_batch=8, batch_deadline_s=0.005,
+            ctl_interval_s=0.05, hb_interval_s=0.1,
+            poll_interval_s=0.03, join_timeout_s=WAIT_S,
+            max_queue=64,
+            tenant_classes={
+                "gold": {"weight": 4, "priority": 2},
+                "silver": {"weight": 2, "priority": 1},
+                "bronze": {"weight": 1, "priority": 0,
+                           "rate": 40, "burst": 8,
+                           "max_inflight": 8}}).start()
+        _wait(lambda: len(router.routable()) == 3, "3 routable")
+        xv = np.ones((2, 6), np.float32).tolist()
+        stop = threading.Event()
+        lock = threading.Lock()
+        stats = {t: {"offered": 0, "ok": 0, "fails": []}
+                 for t in ("gold", "silver", "bronze")}
+
+        def load(tenant, pause):
+            client = FleetClient([router.url],
+                                 request_deadline_s=30.0,
+                                 backoff_s=0.02, tenant=tenant)
+            while not stop.is_set():
+                try:
+                    client.infer({"x": xv})
+                    ok, err = True, None
+                except Exception as e:  # noqa: BLE001 - recorded
+                    ok, err = False, repr(e)
+                with lock:
+                    stats[tenant]["offered"] += 1
+                    if ok:
+                        stats[tenant]["ok"] += 1
+                    else:
+                        stats[tenant]["fails"].append(err)
+                if pause:
+                    time.sleep(pause)
+
+        loaders = [threading.Thread(target=load, args=a, daemon=True)
+                   for a in [("gold", 0.01)] * 2
+                   + [("silver", 0.01)] * 2
+                   + [("bronze", 0.0)] * 3]
+        for t in loaders:
+            t.start()
+        time.sleep(0.8)
+        os.kill(procs[2].pid, signal.SIGKILL)
+        procs[2].wait(timeout=10)
+        _wait(lambda: 2 not in router.routable(),
+              "killed replica out of rotation", timeout_s=10.0)
+        # arrival-expired probes DURING the soak: the budget died
+        # upstream, the router must refuse without queueing
+        for _ in range(3):
+            status, resp = _post(router, {"x": xv},
+                                 headers={"x-tenant": "gold",
+                                          "x-deadline-ms": "0"})
+            assert status == 504, resp
+        time.sleep(1.5)          # sustained classed load, 2 survivors
+        stop.set()
+        for t in loaders:
+            t.join(timeout=35)
+        totals = resilience.router_totals()
+
+        # zero high-class failures through the SIGKILL
+        assert not stats["gold"]["fails"], stats["gold"]["fails"][:5]
+        assert stats["gold"]["ok"] > 10
+        # the abusive tenant hit its quota: real shed, counted to it
+        assert totals["tenants"].get("bronze", {}).get("shed", 0) > 0
+        # fairness ordering: gold's success ratio dominates bronze's
+        ratios = {t: s["ok"] / float(max(1, s["offered"]))
+                  for t, s in stats.items()}
+        assert ratios["gold"] == 1.0, stats["gold"]["fails"][:5]
+        assert ratios["gold"] >= ratios["bronze"]
+        # the doomed probes were refused in the queue...
+        assert totals["expired"].get("queue", {}).get("gold", 0) >= 3
+        # ...and NOTHING was ever dispatched after its budget died:
+        # the router-side series is flat and every surviving replica
+        # process's own guard counter reads zero
+        assert not totals["expired"].get("replica")
+        for r in (0, 1):
+            status, h = http_json("GET",
+                                  "http://%s/healthz" % addrs[r],
+                                  timeout_s=10.0)
+            assert status == 200
+            assert h["expired_refused"] == 0, h
+    finally:
+        if router is not None:
+            router.close()
+        for p in procs.values():
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        for p in procs.values():
+            if p.poll() is None:
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+        srv.close()

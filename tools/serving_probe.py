@@ -77,7 +77,11 @@ def scrape_metrics(url, timeout_s=5.0):
     "router" section with the serving-fleet series
     (router_requests_total{outcome=}, router_queue_depth,
     router_replica_inflight per replica, the router_batch_size
-    histogram samples), an "obs" section with the tracing layer's
+    histogram samples), a "qos" section with every ``tenant=``-
+    labelled router series (per-tenant requests/expired-deadline
+    counters and queue-depth gauges, keyed ``.../tenant:<id>`` —
+    kept apart from "router" so the aggregate keys never collide),
+    an "obs" section with the tracing layer's
     series (the ``executor_step_seconds{kind=}`` step-phase histogram
     samples and ``trace_spans_dropped_total`` — nonzero means the
     span ring overflowed and any merged timeline is missing spans)
@@ -92,7 +96,7 @@ def scrape_metrics(url, timeout_s=5.0):
         text = resp.read().decode("utf-8")
     samples = parse_metrics_text(text)
     events, feed, transport, router, bytes_sec = {}, {}, {}, {}, {}
-    obs_sec = {}
+    obs_sec, qos = {}, {}
     for name, labels, value in samples:
         if name == METRIC_PREFIX + "_events_total":
             key = labels.get("kind", "?")
@@ -108,6 +112,23 @@ def scrape_metrics(url, timeout_s=5.0):
             if "le" in labels:
                 key += "/le" + labels["le"]
             obs_sec[key] = value
+        elif name.startswith(METRIC_PREFIX + "_router_") \
+                and "tenant" in labels:
+            # the tenant-labelled QoS series fold under their own
+            # "qos" group BEFORE the router fold — a tenant-labelled
+            # router_requests_total sample colliding into the
+            # aggregate's key would silently overwrite it. The key
+            # mirrors the router section's, ending "/tenant:<id>" so
+            # qos_quota_flags can re-derive the aggregate key
+            key = name[len(METRIC_PREFIX) + 1:]
+            if "where" in labels:
+                key += "/" + labels["where"]
+            if "outcome" in labels:
+                key += "/" + labels["outcome"]
+            if "router" in labels:
+                key += "/router" + labels["router"]
+            key += "/tenant:" + labels["tenant"]
+            qos[key] = value
         elif name.startswith(METRIC_PREFIX + "_router_") \
                 or name.startswith(METRIC_PREFIX + "_fleet_"):
             # the router-TIER series (per-router queue/requests plus
@@ -144,9 +165,38 @@ def scrape_metrics(url, timeout_s=5.0):
         out["router"] = router
     if obs_sec:
         out["obs"] = obs_sec
+    if qos:
+        out["qos"] = qos
     if bytes_sec:
         out["bytes"] = bytes_sec
     return out
+
+
+def qos_quota_flags(summary):
+    """Quota-accounting drift in a scrape summary (empty = healthy):
+    per (outcome, router), the tenant-labelled
+    ``router_requests_total`` series must sum EXACTLY to the
+    aggregate series — both are bumped under the same lock on the
+    same request, so any gap means an admission path recorded one
+    side without the other (a shed that charged no tenant, a tenant
+    series double-bump) and per-class SLO accounting cannot be
+    trusted. ``--strict`` fails the probe on any drift."""
+    flags = []
+    qos = summary.get("qos", {})
+    router = summary.get("router", {})
+    sums = {}
+    for k, v in qos.items():
+        if not k.startswith("router_requests_total"):
+            continue
+        base = k.rpartition("/tenant:")[0]
+        sums[base] = sums.get(base, 0) + v
+    for base, total in sorted(sums.items()):
+        agg = router.get(base)
+        if agg is None or abs(agg - total) > 1e-9:
+            flags.append("quota accounting drift on %s: tenant "
+                         "series sum to %g, aggregate reads %s"
+                         % (base, total, agg))
+    return flags
 
 
 def obs_overflow_flags(summary):
@@ -213,9 +263,10 @@ def main(argv=None):
                          "degraded serve or error during the probe "
                          "itself fails it — and, with --metrics-url, "
                          "any term regression (stale-primary symptom) "
-                         "in the transport series or span-ring "
+                         "in the transport series, span-ring "
                          "overflow (trace_spans_dropped_total > 0) in "
-                         "the obs series")
+                         "the obs series, or tenant-vs-aggregate "
+                         "quota-accounting drift in the qos series")
     ap.add_argument("--metrics-url", default=None,
                     help="scrape a resilience.serve_metrics endpoint and "
                          "fold the event totals into the report")
@@ -243,6 +294,13 @@ def main(argv=None):
                 # dropped spans mean the timeline is lying — loud
                 # always, fatal under --strict
                 health["obs_overflow"] = oflags
+                metrics_ok = False
+            qflags = qos_quota_flags(health["metrics"])
+            if qflags:
+                # tenant series out of step with the aggregate: the
+                # per-class SLO numbers cannot be trusted — loud
+                # always, fatal under --strict
+                health["qos_drift"] = qflags
                 metrics_ok = False
         except Exception as e:
             # a loadable replica with a dead metrics endpoint is still

@@ -13,6 +13,10 @@ Three subcommands, one process each:
             re-run the same command line. A replica SPAWNED by the
             autoscaler (a grown slot above the router range) passes
             --group-size with the post-resize size.
+            --artifact-compress q8 requires the artifact's weights in
+            the int8 block codec (export with weight_compress='q8')
+            and refuses a full-precision export at load — the ship-
+            bytes savings are asserted, never assumed.
 
   router    the fleet's front door — now a replicated TIER: run R of
             these (--router-id 0..R-1 --n-routers R), each serving
@@ -35,6 +39,11 @@ Three subcommands, one process each:
             as {"kind": "autoscale_spawn", "pid": ...} lines, reaped
             on shutdown) — production orchestrators should instead
             watch the fleet_autoscale events and actuate themselves.
+            --tenant-classes arms multi-tenant QoS: per-tenant queues
+            drained by weighted-fair queueing, token-bucket/in-flight
+            quotas, and priority-classed brownout shedding under
+            overload (see PORTING.md "Multi-tenant QoS"); requests
+            carry x-tenant / x-deadline-ms / x-retry-budget headers.
 
   client    stdin/stdout failover client for a multi-router
             deployment: --routers URL[,URL...] (both tiers take
@@ -139,7 +148,9 @@ def _template_spawner(template, coord):
 def _client_main(args):
     from paddle_tpu.serving_fleet import FleetClient
     client = FleetClient(args.routers,
-                         request_deadline_s=args.deadline_s)
+                         request_deadline_s=args.deadline_s,
+                         tenant=args.tenant,
+                         retry_budget=args.retry_budget)
     for line in sys.stdin:
         line = line.strip()
         if not line:
@@ -154,6 +165,18 @@ def _client_main(args):
                    "kind": type(e).__name__}
         print(json.dumps(out), flush=True)
     return 0
+
+
+def _load_tenant_classes(spec):
+    """--tenant-classes value -> config dict (inline JSON, or a JSON
+    file via '@path'). Validation happens in parse_tenant_classes at
+    router construction."""
+    if not spec:
+        return None
+    if spec.startswith("@"):
+        with open(spec[1:]) as f:
+            return json.load(f)
+    return json.loads(spec)
 
 
 def main(argv=None):
@@ -184,6 +207,12 @@ def main(argv=None):
     rp.add_argument("--ctl-interval-s", type=float, default=0.1)
     rp.add_argument("--hb-interval-s", type=float, default=0.25)
     rp.add_argument("--join-timeout-s", type=float, default=30.0)
+    rp.add_argument("--artifact-compress", default=None,
+                    choices=("q8",),
+                    help="require the artifact's weights in this "
+                         "compressed form (q8 = the int8 block codec;"
+                         " export with weight_compress='q8') — a "
+                         "full-precision artifact is refused at load")
 
     ro = sub.add_parser("router", help="one fleet router (run "
                         "--n-routers of these for the HA tier)")
@@ -215,12 +244,40 @@ def main(argv=None):
     ro.add_argument("--autoscale-shed-rate", type=float, default=0.05)
     ro.add_argument("--autoscale-hysteresis", type=int, default=3)
     ro.add_argument("--autoscale-cooldown-s", type=float, default=5.0)
+    ro.add_argument("--autoscale-high-queue-depth", type=float,
+                    default=None,
+                    help="grow when the HIGHEST-priority class queues"
+                         " this deep (default: half the global "
+                         "threshold) — needs --tenant-classes")
+    ro.add_argument("--tenant-classes", default=None,
+                    help="tenant QoS classes as JSON ('@file' reads a"
+                         " file): {name: {weight, priority, rate, "
+                         "burst, max_inflight, tenants}}; absent = "
+                         "the classic single-FIFO router")
+    ro.add_argument("--brownout-queue-depth", type=float, default=None,
+                    help="queue depth that counts as a hot brownout "
+                         "sample (default 0.75 * max-queue)")
+    ro.add_argument("--brownout-shed-rate", type=float, default=0.5,
+                    help="shed-rate delta that counts as a hot "
+                         "brownout sample")
+    ro.add_argument("--qos-interval-s", type=float, default=0.1,
+                    help="brownout controller sampling interval")
+    ro.add_argument("--qos-hysteresis", type=int, default=3,
+                    help="consecutive hot/cool samples before the "
+                         "brownout floor moves one class level")
 
     cl = sub.add_parser("client", help="stdin/stdout failover client")
     cl.add_argument("--routers", required=True,
                     help="comma-joined router endpoint list (URLs or "
                          "host:port)")
     cl.add_argument("--deadline-s", type=float, default=10.0)
+    cl.add_argument("--tenant", default=None,
+                    help="QoS identity sent as x-tenant on every "
+                         "request (absent = the 'default' tenant)")
+    cl.add_argument("--retry-budget", type=int, default=None,
+                    help="max replica attempts a request may burn "
+                         "across hops (x-retry-budget; absent = "
+                         "retry until the deadline)")
 
     args = ap.parse_args(argv)
     if args.cmd == "client":
@@ -236,7 +293,8 @@ def main(argv=None):
             hb_interval_s=args.hb_interval_s,
             join_timeout_s=args.join_timeout_s,
             n_routers=args.n_routers,
-            group_size=args.group_size).start()
+            group_size=args.group_size,
+            artifact_compress=args.artifact_compress).start()
         return _serve_until_signal(
             member, {"kind": "replica", "replica_id": args.replica_id,
                      "addr": member.address,
@@ -252,7 +310,12 @@ def main(argv=None):
         hb_interval_s=args.hb_interval_s,
         join_timeout_s=args.join_timeout_s,
         router_id=args.router_id, n_routers=args.n_routers,
-        group_size=args.group_size).start()
+        group_size=args.group_size,
+        tenant_classes=_load_tenant_classes(args.tenant_classes),
+        brownout_queue_depth=args.brownout_queue_depth,
+        brownout_shed_rate=args.brownout_shed_rate,
+        qos_interval_s=args.qos_interval_s,
+        qos_hysteresis=args.qos_hysteresis).start()
     auto, spawner = None, None
     if args.autoscale:
         if args.spawn_template:
@@ -267,7 +330,9 @@ def main(argv=None):
             grow_queue_depth=args.autoscale_queue_depth,
             grow_shed_rate=args.autoscale_shed_rate,
             hysteresis=args.autoscale_hysteresis,
-            cooldown_s=args.autoscale_cooldown_s).start()
+            cooldown_s=args.autoscale_cooldown_s,
+            grow_high_queue_depth=args.autoscale_high_queue_depth
+            ).start()
 
     def cleanup():
         if auto is not None:
