@@ -192,6 +192,18 @@ BUDGETS = {
     "analysis_verify_s": ("max", 10.0),
     "analysis_overhead_ratio": ("max", 0.5),
     "analysis_bert_errors": ("max", 0),
+    # numeric-fault plane (ISSUE 17): the in-graph finite mask
+    # (BuildStrategy.numeric_policy) measured against the plain dp step
+    # as a median of strictly interleaved pairwise on/off ratios, and
+    # the wall of one poisoned-step skip recovery (failpoint-corrupted
+    # batch -> localize culprit -> in-graph state revert). The healthy
+    # mask cost is single-digit percent (the design target is <=5%);
+    # the gate is sized for shared-CI noise on ~ms CPU walls, where the
+    # same binary measures anywhere up to ~10% run-over-run — it
+    # catches the mask growing a real extra pass over the state, not
+    # scheduler jitter (drift tracking watches the slide below it).
+    "numerics_overhead_frac": ("max", 0.25),
+    "fault_recovery_ms": ("max", 2000.0),
 }
 
 # metric -> worsening factor vs the rounds-history median that counts as
@@ -1120,6 +1132,81 @@ def bench_analysis():
             "analysis_bert_errors": len(result.errors())}
 
 
+def bench_numerics(pairs=25, steps_budget=3):
+    """Numeric-fault plane costs (ISSUE 17).
+
+      numerics_overhead_frac — the in-graph per-var finite mask
+          (numeric_policy="raise") vs the plain dp step on the SAME
+          warmed CompiledProgram pair. Measured as the median of
+          strictly interleaved pairwise ratios (off_i then on_i,
+          ratio_i = on_i/off_i): pairing adjacent walls cancels the
+          slow frequency/load drift that makes sequential medians lie
+          on shared boxes. Clamped at 0 — the mask cannot speed a step
+          up; a negative frac is pure noise.
+      fault_recovery_ms — wall of the ONE poisoned step under
+          numeric_policy="skip": a failpoint corrupts the batch on the
+          wire, the mask localizes the culprit var, the in-graph
+          jnp.where revert discards the update. This is the unit of
+          work every skip/rewind recovery pays per bad batch.
+    """
+    import numpy as np
+    import jax
+    import paddle_tpu as pt
+    from paddle_tpu.framework import faultinject
+    from paddle_tpu.framework.compiler import CompiledProgram, \
+        BuildStrategy
+    from paddle_tpu.framework.scope import Scope, scope_guard
+
+    n_dev = min(8, len(jax.devices()))
+    feed = _batch(np.random.RandomState(0), n=4 * n_dev)
+    out = {}
+
+    def setup(policy):
+        sc = Scope()
+        with scope_guard(sc):
+            main, startup, loss = _build_train()
+            exe = pt.Executor()
+            exe.run(startup)
+            bs = BuildStrategy()
+            bs.mesh_axes = {"dp": n_dev}
+            if policy is not None:
+                bs.numeric_policy = policy
+            comp = CompiledProgram(main, bs)
+            for _ in range(steps_budget):          # compile + warm
+                exe.run(comp, feed=feed, fetch_list=[loss])
+        return sc, exe, comp, loss
+
+    def one(leg):
+        sc, exe, comp, loss = leg
+        with scope_guard(sc):
+            t0 = time.perf_counter()
+            exe.run(comp, feed=feed, fetch_list=[loss])
+            return time.perf_counter() - t0
+
+    plain, masked = setup(None), setup("raise")
+    ratios = []
+    for _ in range(pairs):
+        off = one(plain)
+        on = one(masked)
+        ratios.append(on / off if off > 0 else 1.0)
+    ratios.sort()
+    med = ratios[len(ratios) // 2]
+    out["numerics_step_off_s"] = round(one(plain), 5)
+    out["numerics_step_on_s"] = round(one(masked), 5)
+    out["numerics_overhead_frac"] = round(max(0.0, med - 1.0), 4)
+
+    # -- skip-path recovery: one poisoned step, wall to discard -------
+    sc, exe, comp, loss = setup("skip")
+    with scope_guard(sc):
+        with faultinject.failpoints(["executor.step:corrupt=x@1"]):
+            t0 = time.perf_counter()
+            exe.run(comp, feed=feed, fetch_list=[loss])
+            recovery = time.perf_counter() - t0
+        exe.run(comp, feed=feed, fetch_list=[loss])   # budget resets
+    out["fault_recovery_ms"] = round(recovery * 1e3, 3)
+    return out
+
+
 # ---------------------------------------------------------------------------
 # round trend tracking
 # ---------------------------------------------------------------------------
@@ -1204,7 +1291,8 @@ def run_all(rounds_dir=None):
                      ("serving", bench_serving),
                      ("router_failover", bench_router_failover),
                      ("obs", bench_obs),
-                     ("analysis", bench_analysis)):
+                     ("analysis", bench_analysis),
+                     ("numerics", bench_numerics)):
         t0 = time.perf_counter()
         try:
             metrics.update(fn())

@@ -133,6 +133,36 @@ class BuildStrategy(object):
         self.mesh_axes = None
         self.data_axis = "dp"
         self.check_numerics = False
+        # what happens when check_numerics trips (framework/executor):
+        #   "raise"  -- today's behavior: FloatingPointError, state
+        #               already written back (donated buffers), caller
+        #               (ResilientTrainer) restores. The in-graph guard
+        #               also LOCALIZES the first offending fetch/var by
+        #               name, so the error and the numeric_fault event
+        #               say WHICH tensor blew up, not just "somewhere".
+        #   "skip"   -- discard the step in-graph: every state leaf
+        #               (optimizer moments + PRNG counter included)
+        #               reverts to its pre-step value under a jnp.where
+        #               on the all-finite flag, the data cursor moves
+        #               past the poison batch, and a numeric_fault
+        #               event names the culprit. Bounded by
+        #               numeric_skip_budget CONSECUTIVE skips — a
+        #               persistent fault escalates to
+        #               SkipBudgetExceededError instead of silently
+        #               dropping the stream.
+        #   "rewind" -- raise resilience.NumericFaultError (a
+        #               FloatingPointError carrying step + culprit):
+        #               the (Pod/Elastic) trainer's existing
+        #               consensus-rewind recovery restores the last
+        #               checkpoint and REPLAYS WITH THE POISON BATCH
+        #               SKIPPED, so the recovered trajectory equals the
+        #               uninterrupted run without that batch, bitwise.
+        # Implies check_numerics when set to "skip"/"rewind". Part of
+        # the compile-cache token: the lowered step differs per policy.
+        self.numeric_policy = "raise"
+        # max CONSECUTIVE steps numeric_policy="skip" may discard
+        # before escalating (a clean step resets the streak)
+        self.numeric_skip_budget = 3
         # halt detection: bound each step's completion (None = no guard);
         # consumed by the run_step watchdog (framework/watchdog.py)
         self.collective_timeout_s = _env_timeout_default()
@@ -235,6 +265,12 @@ class BuildStrategy(object):
             if not hasattr(self, k):
                 raise TypeError("BuildStrategy has no knob %r" % k)
             setattr(self, k, v)
+        if self.numeric_policy not in ("raise", "skip", "rewind"):
+            raise ValueError(
+                "numeric_policy must be 'raise', 'skip' or 'rewind', "
+                "got %r" % (self.numeric_policy,))
+        if int(self.numeric_skip_budget) < 1:
+            raise ValueError("numeric_skip_budget must be >= 1")
 
 
 class ExecutionStrategy(object):
@@ -422,7 +458,11 @@ class CompiledProgram(object):
                 # re-lower, never reuse a single-jit executable
                 (getattr(bs, "pp_stages", None),
                  int(getattr(bs, "pp_micro_batches", 1) or 1),
-                 getattr(bs, "pp_schedule", "1f1b")))
+                 getattr(bs, "pp_schedule", "1f1b")),
+                # numeric_policy changes the lowered step (per-var
+                # finite mask, in-graph skip select) — "skip" and
+                # "raise" must never share an executable
+                getattr(bs, "numeric_policy", "raise"))
 
     # -- pipeline parallelism ---------------------------------------------
     def _pp_enabled(self):
@@ -458,6 +498,11 @@ class CompiledProgram(object):
             return CompilePlan("single_jit", self._cache_token())
         from ..distributed import pipeline_program as ppp
         bs = self._build_strategy
+        if getattr(bs, "numeric_policy", "raise") != "raise":
+            raise ValueError(
+                "numeric_policy=%r is not supported with pipeline "
+                "parallelism yet — the pp lowering keeps raise-only "
+                "check_numerics" % (bs.numeric_policy,))
         axes = dict(bs.mesh_axes or {})
         k = int(bs.pp_stages) if getattr(bs, "pp_stages", None) else None
         if "pp" not in axes:
@@ -584,6 +629,14 @@ class CompiledProgram(object):
                 "supports pure data-parallel meshes only; model axes %r "
                 "would lose their XLA-inserted collectives. Drop the "
                 "option or the model axes." % (bs.data_axis, bad))
+        if getattr(bs, "numeric_policy", "raise") == "skip":
+            raise ValueError(
+                "numeric_policy='skip' reverts state in-graph from the "
+                "GLOBAL all-finite verdict, but the quantized shard_map "
+                "lowering evaluates per-shard flags before the sync — "
+                "shards could revert divergently. Use "
+                "numeric_policy='rewind' (host-side, sees the AND-ed "
+                "flag) or disable quantize_collectives.")
         from ..ops.collective_ops import QuantizedSyncContext
         return QuantizedSyncContext(
             bs.data_axis,

@@ -1322,6 +1322,37 @@ class PodResilientTrainer(object):
     def coordinator(self):
         return self._coordinator
 
+    def _agree_poison(self, co, hid, run_tag, rnd, trainer, step, err):
+        """Pod-wide poison-batch agreement — one extra gather in the
+        recovery round. The host whose numeric policy localized a
+        :class:`~.resilience.NumericFaultError` publishes the bad
+        batch's global index; EVERY host adds the agreed union to its
+        trainer's poison set, so the post-restore replay skips the
+        batch pod-wide and the recovered trajectory stays lockstep
+        (bitwise equal to an uninterrupted run without that batch).
+        Hosts with nothing to report still join the gather — recovery
+        rounds are lockstep like everything else."""
+        from . import resilience
+        mine = []
+        if isinstance(err, resilience.NumericFaultError) \
+                and not isinstance(err,
+                                   resilience.SkipBudgetExceededError):
+            b = err.batch_index
+            if b is None:
+                b = step + int(err.window_offset or 0)
+            mine = [int(b)]
+        shared = co.all_gather("%sp%d" % (run_tag, rnd), hid, mine)
+        agreed = sorted({int(b) for v in shared.values()
+                         for b in (v or [])})
+        culprit = getattr(err, "culprit", None)
+        for b in agreed:
+            if b not in trainer._poison_batches:
+                trainer._poison_batches.add(b)
+                record_event("poison_batch", batch=b, step=step,
+                             **({} if culprit is None
+                                else {"culprit": culprit}))
+        return agreed
+
     def run(self, feeds, fetch_list=None, steps=None):
         """Run the pod to completion, recovering from transient faults.
 
@@ -1436,9 +1467,11 @@ class PodResilientTrainer(object):
                 if feed is not None:
                     # per-host stream: ≤ w batches (fewer at the drain
                     # tail); the window COUNT still advances by w on
-                    # every host, so checkpoint boundaries stay lockstep
-                    outs = trainer._dispatch_batches(feed.draw(w),
-                                                     fetch_list)
+                    # every host, so checkpoint boundaries stay lockstep.
+                    # The window filter drops pod-agreed poison batches
+                    # on replay (numeric_policy="rewind").
+                    outs = trainer._dispatch_window(feed.draw(w), step,
+                                                    fetch_list)
                 else:
                     outs = trainer._dispatch(feeds, step, w, fetch_list)
                     if (step + w) % ckpt_every == 0 or step + w == n:
@@ -1493,6 +1526,11 @@ class PodResilientTrainer(object):
                          error=type(err).__name__ if err else None,
                          backoff_s=delay)
             trainer._policy.sleep(delay)
+            # numeric_policy="rewind": agree the poison batch so every
+            # host's replay skips it — without this only the faulting
+            # host would skip and the pod would fall out of lockstep
+            self._agree_poison(co, hid, run_tag, rnd, trainer, step,
+                               err)
             from .. import io as io_mod
             report = io_mod.scrub_checkpoint(trainer._ckpt_dir)
             agreed = co.elect_restore_step(hid, report["valid_steps"],
@@ -1599,7 +1637,7 @@ class ElasticTrainer(PodResilientTrainer):
                  lr_rescale_hook=None, drain_after=None,
                  ship_compress="zlib", drain_floor=None,
                  drain_cooldown=None, drain_hb_lag_s=None,
-                 drain_stream_lag=None):
+                 drain_stream_lag=None, sdc_detect=None):
         super(ElasticTrainer, self).__init__(
             trainers, coordinator=coordinator, max_restarts=max_restarts,
             host_id=host_id)
@@ -1681,6 +1719,29 @@ class ElasticTrainer(PodResilientTrainer):
                              "(or None to ignore feed stream lag)")
         self._drain_stream_lag = None if drain_stream_lag is None \
             else float(drain_stream_lag)
+        # sdc_detect arms the silent-data-corruption sweep: every
+        # window each host publishes its float-state L2 norm on the
+        # status exchange and every host runs the SAME pod-median
+        # outlier test (resilience.SDCDetector) over the frozen map.
+        # A host whose norm deviates for the detector's `consecutive`
+        # windows is a SUSPECTED-SDC host: flagged into the proactive
+        # drain latch, so with drain_after armed the pod drains it
+        # like any critical straggler (the corruption it would keep
+        # feeding the collectives is worse than losing its capacity).
+        # True = default detector; a dict = SDCDetector kwargs. The
+        # detector is instantiated PER HOST LOOP from the same config
+        # and fed the same frozen verdicts, so every host's suspect
+        # set agrees without any extra exchange.
+        if sdc_detect in (None, False):
+            self._sdc_cfg = None
+        elif sdc_detect is True:
+            self._sdc_cfg = {}
+        elif isinstance(sdc_detect, dict):
+            self._sdc_cfg = dict(sdc_detect)
+        else:
+            raise ValueError(
+                "sdc_detect must be None/False, True, or a dict of "
+                "SDCDetector kwargs, got %r" % (sdc_detect,))
         # lr_rescale=True: the FIXED-PER-HOST-BATCH regime (per-host
         # feed streams — the global batch shrinks with the dp axis), so
         # capacity changes linearly rescale the learning rate,
@@ -1801,14 +1862,41 @@ class ElasticTrainer(PodResilientTrainer):
             return max(1, int(math.ceil(f * self._coordinator.n_hosts)))
         return max(1, int(f))
 
-    def _drain_flags(self, verdicts):
+    @staticmethod
+    def _sdc_norm(trainer):
+        """This host's state-norm signal for the SDC sweep: the L2
+        norm over every floating scope var (params + optimizer
+        moments), accumulated in float64 in sorted-name order so
+        identical states produce identical norms. In the replicated-
+        feed regime healthy replicas are BITWISE identical, so any
+        silent corruption — even one flipped mantissa bit — moves
+        this host's norm off the pod median while the median's MAD
+        stays ~0; per-host-stream pods fall back to the detector's
+        threshold test. A NaN norm counts as an outlier outright."""
+        import numpy as np
+        sc = ElasticTrainer._scope_of(trainer)
+        total = 0.0
+        for name in sorted(sc.keys()):
+            val = sc.find_var(name)
+            if val is None:
+                continue
+            arr = np.asarray(val)
+            if not np.issubdtype(arr.dtype, np.floating):
+                continue
+            total += float(np.sum(np.square(arr.astype(np.float64))))
+        return float(np.sqrt(total))
+
+    def _drain_flags(self, verdicts, sdc=None):
         """Per-host straggler flags for this window, computed from the
         FROZEN verdicts only (identical on every live host): the
         compute latch (v[3]), the heartbeat-cadence lag it carried
-        (v[4], vs drain_hb_lag_s) and the agreed feed stream lag
-        (vs drain_stream_lag). Pre-upgrade peers' shorter payloads
+        (v[4], vs drain_hb_lag_s), the agreed feed stream lag
+        (vs drain_stream_lag) and — when the SDC sweep is armed — the
+        detector's suspect set (itself fed from frozen verdicts, so
+        it agrees pod-wide too). Pre-upgrade peers' shorter payloads
         simply contribute no new signals."""
         lags = self._agreed_lags(verdicts) or {}
+        suspects = sdc.suspects() if sdc is not None else ()
         flags = {}
         for h, v in verdicts.items():
             f = bool(v[3]) if len(v) > 3 else False
@@ -1820,6 +1908,8 @@ class ElasticTrainer(PodResilientTrainer):
             if not f and self._drain_stream_lag is not None \
                     and h in lags:
                 f = lags[h] > self._drain_stream_lag
+            if not f and h in suspects:
+                f = True
             flags[h] = f
         return flags
 
@@ -2050,6 +2140,11 @@ class ElasticTrainer(PodResilientTrainer):
         # frozen verdicts, so the decisions agree pod-wide.
         strag_counts = {}
         since_drain = None
+        # SDC sweep: one detector per host loop, every instance fed
+        # the same frozen norm map — suspect sets agree pod-wide with
+        # no extra exchange (see sdc_detect in __init__)
+        sdc = None if self._sdc_cfg is None \
+            else resilience.SDCDetector(**self._sdc_cfg)
         while step < n:
             rnd += 1
             until_ckpt = ckpt_every - (step % ckpt_every)
@@ -2060,9 +2155,11 @@ class ElasticTrainer(PodResilientTrainer):
                     # the boundary save moves AFTER the status exchange:
                     # the checkpoint must carry the agreed cursor map at
                     # this exact boundary, which only exists once every
-                    # live host's window cursor has been gathered
-                    outs = trainer._dispatch_batches(feed.draw(w),
-                                                     fetch_list)
+                    # live host's window cursor has been gathered. The
+                    # window filter drops pod-agreed poison batches on
+                    # replay (numeric_policy="rewind").
+                    outs = trainer._dispatch_window(feed.draw(w), step,
+                                                    fetch_list)
                 else:
                     outs = trainer._dispatch(feeds, step, w, fetch_list)
                     if (step + w) % ckpt_every == 0 or step + w == n:
@@ -2100,10 +2197,14 @@ class ElasticTrainer(PodResilientTrainer):
             # the pod-agreed view is what the proactive drain (and the
             # pre-emptive straggler_ckpt below) acts on
             strag = bool(self._straggler_flag(hid))
+            # the SDC sweep's norm rides the same exchange (v[5]):
+            # computed AFTER the window ran, so this window's silent
+            # corruption is already visible in it
+            norm = None if sdc is None else self._sdc_norm(trainer)
             try:
                 verdicts = co.all_gather("%sw%d" % (run_tag, rnd), hid,
                                          [status, pending, exch, strag,
-                                          self._hb_lag(hid)])
+                                          self._hb_lag(hid), norm])
             except HostLostError:
                 # a peer's timeout fenced US (e.g. this host straggled
                 # past the collective deadline): stop competing
@@ -2189,6 +2290,12 @@ class ElasticTrainer(PodResilientTrainer):
                     record_event("straggler_ckpt", step=step)
             if not pp_rewind and all(v == "ok"
                                      for v in statuses.values()):
+                if sdc is not None:
+                    # every host folds the SAME frozen norm map into
+                    # its detector: suspect sets stay pod-agreed
+                    sdc.observe({h: v[5] for h, v in verdicts.items()
+                                 if len(v) > 5 and v[5] is not None},
+                                step=step)
                 # admission rides the window boundary: every live host
                 # saw the same gathered pending sets, so they all admit
                 # the same joiner (lowest id fully-observed) together
@@ -2265,7 +2372,7 @@ class ElasticTrainer(PodResilientTrainer):
                     # network (heartbeat-cadence lag) and data (agreed
                     # feed stream lag) signatures all count — see
                     # _drain_flags.
-                    flags = self._drain_flags(verdicts)
+                    flags = self._drain_flags(verdicts, sdc=sdc)
                     for h in list(strag_counts):
                         if h not in flags:
                             strag_counts.pop(h)
@@ -2308,12 +2415,19 @@ class ElasticTrainer(PodResilientTrainer):
                         # drain again (never one host per window)
                         strag_counts.clear()
                         since_drain = 0
+                        was_sdc = sdc is not None \
+                            and drained in sdc.suspects()
+                        if was_sdc:
+                            # a re-admitted replacement starts with a
+                            # clean record — the suspicion belonged to
+                            # the drained incarnation's hardware
+                            sdc.clear(drained)
                         record_event(
                             "elastic_drain", drained=drained, step=step,
                             capacity="%d/%d"
                             % (len(frozen_live) - 1,
                                self._coordinator.n_hosts),
-                            windows=self._drain_after)
+                            windows=self._drain_after, sdc=was_sdc)
                         if drained == hid:
                             # a PLANNED loss: fence ourselves at the
                             # window boundary so the survivors' next
@@ -2323,9 +2437,11 @@ class ElasticTrainer(PodResilientTrainer):
                             # restarts us; a healthy incarnation
                             # rejoins through the normal admission.
                             co.mark_lost(
-                                hid, "drained: critical straggler for "
+                                hid, "drained: %s for "
                                 "%d consecutive windows"
-                                % self._drain_after)
+                                % ("suspected SDC host" if was_sdc
+                                   else "critical straggler",
+                                   self._drain_after))
                             record_event("host_exit", step=step)
                             return result()
                 if feed is not None and feed.all_drained():
@@ -2359,6 +2475,11 @@ class ElasticTrainer(PodResilientTrainer):
                              error=type(err).__name__ if err else None,
                              backoff_s=delay)
                 trainer._policy.sleep(delay)
+            # numeric_policy="rewind": agree the poison batch so every
+            # host's replay skips it (lockstep gather — the free pp
+            # rewind publishes an empty set like any healthy host)
+            self._agree_poison(co, hid, run_tag, rnd, trainer, step,
+                               err)
             from .. import io as io_mod
             report = io_mod.scrub_checkpoint(trainer._ckpt_dir)
             agreed_step = co.elect_restore_step(

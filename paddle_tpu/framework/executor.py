@@ -25,6 +25,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from . import faultinject
 from . import obs
 from . import resilience
 from . import trace as trace_mod
@@ -78,6 +79,59 @@ def _uses_rng(program):
     return False
 
 
+def _numeric_config(program, strategy):
+    """Resolve (check_numerics, policy, skip_budget) for one run.
+
+    A numeric_policy other than "raise" implies the finite guard even
+    when check_numerics was left False — "skip"/"rewind" without the
+    mask would be dead knobs."""
+    policy, budget = "raise", 3
+    if strategy is not None:
+        bs = strategy._build_strategy
+        policy = getattr(bs, "numeric_policy", "raise") or "raise"
+        budget = int(getattr(bs, "numeric_skip_budget", 3) or 1)
+    check = bool(
+        getattr(program, "_check_numerics", False)
+        or (strategy is not None and
+            getattr(strategy._build_strategy, "check_numerics", False))
+        or policy != "raise")
+    return check, policy, budget
+
+
+def _skip_guard(step):
+    """numeric_policy="skip", the in-graph half: when ANY fetch/state
+    var went non-finite this step, every state leaf (params, optimizer
+    moments, PRNG counter) reverts to its pre-step value under one
+    scalar select — the step simply never happened on-device. Works
+    WITH buffer donation because the select runs inside the jitted
+    computation; the host never has to resurrect a donated input."""
+    def guarded(state_tuple, feed_tuple):
+        fetches, new_state, finite = step(state_tuple, feed_tuple)
+        ok = jnp.all(finite)
+        new_state = tuple(jnp.where(ok, n, o)
+                          for o, n in zip(state_tuple, new_state))
+        return fetches, new_state, finite
+    return guarded
+
+
+def _first_offender(finite_row, fetch_names, state_names):
+    """Name the first non-finite var from one per-var finite mask row
+    (mask order: fetches, then carried state)."""
+    finite_row = np.asarray(finite_row)
+    if finite_row.ndim == 0:    # legacy scalar flag: no localization
+        return None
+    names = list(fetch_names) + list(state_names)
+    idx = int(np.argmin(finite_row))
+    return names[idx] if idx < len(names) else None
+
+
+def _hit_step_feed(feed):
+    """executor.step failpoint: lets a chaos schedule NaN-poison or
+    bit-flip a named feed array (or raise/delay) at a chosen step."""
+    out = faultinject.hit("executor.step", feed)
+    return feed if out is faultinject.DROP else out
+
+
 class Executor(object):
     def __init__(self, place=None):
         # Remember whether the caller chose the device. Only an EXPLICIT
@@ -94,6 +148,10 @@ class Executor(object):
         # the cached executable
         self.cache_hits = 0
         self.cache_misses = 0
+        # numeric_policy="skip" accounting: CONSECUTIVE steps discarded
+        # by the in-graph revert; any clean step resets it, crossing
+        # the strategy's numeric_skip_budget escalates
+        self._numeric_skips = 0
 
     def _device_ctx(self):
         """default_device context for execution: pin only when the user
@@ -197,6 +255,7 @@ class Executor(object):
         # (startup/eager programs don't count). A no-op unless a
         # FaultInjector is installed (resilience.inject / PADDLE_TPU_FAULTS).
         resilience.fire("step", what="Executor.run")
+        feed = _hit_step_feed(feed)
         # straggler wiring: when detection is armed, the whole dispatch+
         # writeback (return_numpy syncs the fetches) is the step latency
         det_t0 = time.perf_counter() \
@@ -235,10 +294,8 @@ class Executor(object):
         t_total = time.perf_counter()
         state_names, uses_rng = self._prepare_state(program, feed, scope)
         feed_vals = self._convert_feed(program, feed)
-        check_numerics = bool(
-            getattr(program, "_check_numerics", False) or
-            (strategy is not None and
-             getattr(strategy._build_strategy, "check_numerics", False)))
+        check_numerics, policy, skip_budget = _numeric_config(program,
+                                                             strategy)
         key = (id(program), program._version, _feed_signature(feed_vals),
                tuple(fetch_names), tuple(state_names), check_numerics,
                None if strategy is None else strategy._cache_token())
@@ -250,7 +307,7 @@ class Executor(object):
             with obs.span("exec.compile"):
                 entry = self._compile(program, feed_vals, fetch_names,
                                       state_names, uses_rng, strategy,
-                                      check_numerics)
+                                      check_numerics, policy)
             resilience.observe_executor_step(
                 "compile", time.perf_counter() - t0)
             if use_program_cache:
@@ -267,17 +324,13 @@ class Executor(object):
             if check_numerics:
                 fetches, new_state, finite = step_fn(state_vals,
                                                      feed_tuple)
-                if not bool(np.asarray(finite)):
-                    # write the new state back first: the inputs were
-                    # donated, so leaving the scope pointing at them
-                    # would poison every later run for callers that
-                    # catch this to inspect/resume
-                    self._writeback(scope, state_names, new_state, (),
-                                    False)
-                    raise FloatingPointError(
-                        "check_numerics: non-finite value (NaN/Inf) "
-                        "detected in fetches or updated state of this "
-                        "step (reference parity: check_nan_inf)")
+                finite = np.asarray(finite)
+                if not finite.all():
+                    self._numeric_fault(scope, state_names, new_state,
+                                        finite, fetch_names, policy,
+                                        skip_budget)
+                elif policy == "skip":
+                    self._numeric_skips = 0   # clean step ends a streak
             else:
                 fetches, new_state = step_fn(state_vals, feed_tuple)
         resilience.observe_executor_step(
@@ -301,6 +354,66 @@ class Executor(object):
         if return_numpy:
             return [np.asarray(f) for f in fetches]
         return list(fetches)
+
+    @staticmethod
+    def _state_step_no(state_names, new_state):
+        """The program's PRNG step counter value, when it carries one —
+        names the step in numeric_fault events."""
+        try:
+            i = state_names.index(STEP_VAR)
+        except ValueError:
+            return None
+        return int(np.asarray(new_state[i]))
+
+    def _numeric_fault(self, scope, state_names, new_state, finite_row,
+                       fetch_names, policy, skip_budget,
+                       window_offset=0):
+        """One step went non-finite: localize the first offending var,
+        record the numeric_fault event, and apply the policy tail.
+
+        "skip": the in-graph guard already reverted the state — count
+        the consecutive discard (SkipBudgetExceededError past the
+        budget) and RETURN so the caller commits the reverted state.
+        "rewind"/"raise": write the state back first (the inputs were
+        donated, so leaving the scope pointing at them would poison
+        every later run for callers that catch this to inspect/resume)
+        and raise — NumericFaultError for the trainer's
+        rewind-and-skip-the-batch recovery, today's plain
+        FloatingPointError otherwise."""
+        culprit = _first_offender(finite_row, fetch_names, state_names)
+        step_no = self._state_step_no(state_names, new_state)
+        evt = {"policy": policy}
+        if culprit is not None:
+            evt["culprit"] = culprit
+        if step_no is not None:
+            evt["step"] = step_no
+        resilience.record_event("numeric_fault", **evt)
+        where = "var %r" % culprit if culprit is not None \
+            else "fetches or updated state"
+        if policy == "skip":
+            self._numeric_skips += 1
+            if self._numeric_skips > skip_budget:
+                self._writeback(scope, state_names, new_state, (),
+                                False)
+                raise resilience.SkipBudgetExceededError(
+                    "numeric_policy='skip' discarded %d consecutive "
+                    "steps (budget %d); last offender: %s — the fault "
+                    "is persistent, not a poison batch"
+                    % (self._numeric_skips, skip_budget, where),
+                    step=step_no, culprit=culprit,
+                    window_offset=window_offset)
+            return
+        self._writeback(scope, state_names, new_state, (), False)
+        if policy == "rewind":
+            raise resilience.NumericFaultError(
+                "numeric fault: non-finite value (NaN/Inf) in %s of "
+                "this step — rewinding to the last checkpoint with the "
+                "poison batch skipped on replay" % where,
+                step=step_no, culprit=culprit,
+                window_offset=window_offset)
+        raise FloatingPointError(
+            "check_numerics: non-finite value (NaN/Inf) detected in "
+            "%s of this step (reference parity: check_nan_inf)" % where)
 
     # ------------------------------------------------------------------
     def run_steps(self, program=None, feed=None, fetch_list=None,
@@ -356,6 +469,7 @@ class Executor(object):
         # one fire per scanned WINDOW (a window is one device dispatch —
         # the granularity at which a real preemption would kill the step)
         resilience.fire("step", what="Executor.run_steps")
+        feed = _hit_step_feed(feed)
         # per-step straggler latency = window wall-clock / window length
         det_t0 = time.perf_counter() \
             if watchdog.straggler_detector() is not None else None
@@ -387,10 +501,8 @@ class Executor(object):
                           n_steps, sp):
         staged = self._convert_feed(program, feed, steps_axis=True)
 
-        check_numerics = bool(
-            getattr(program, "_check_numerics", False) or
-            (strategy is not None and
-             getattr(strategy._build_strategy, "check_numerics", False)))
+        check_numerics, policy, skip_budget = _numeric_config(program,
+                                                              strategy)
         state_names, uses_rng = self._prepare_state(program, staged, scope)
         key = (id(program), program._version,
                _feed_signature(staged), tuple(fetch_names),
@@ -416,6 +528,10 @@ class Executor(object):
             base_step = self._make_step(program, sorted(staged),
                                         fetch_names, state_names, uses_rng,
                                         check_numerics)
+            if check_numerics and policy == "skip":
+                # revert inside each scan iteration: a poisoned step's
+                # state never reaches the next step of the window
+                base_step = _skip_guard(base_step)
 
             def multi(state_tuple, feed_stack_tuple):
                 def body(carry, xs):
@@ -451,19 +567,68 @@ class Executor(object):
             "execute", time.perf_counter() - t_exec)
         if check_numerics:
             finite = np.asarray(ys[1])
-            if not finite.all():
-                # write the post-window state back first — the input
-                # buffers were donated, so leaving the scope pointing at
-                # them would poison every later run. Unlike run(),
-                # detection lands after the scanned window completes (a
-                # scan cannot abort mid-flight) — the step index still
-                # names the first offender
-                self._writeback(scope, state_names, new_state, (),
-                                False)
-                raise FloatingPointError(
-                    "check_numerics: non-finite value (NaN/Inf) first "
-                    "detected at step %d of this run_steps window"
-                    % int(np.argmin(finite)))
+            # per-step verdicts: (n_steps, n_vars) mask rows, or the
+            # legacy (n_steps,) scalar flags
+            step_ok = finite.all(axis=1) if finite.ndim == 2 else finite
+            if not step_ok.all():
+                k = int(np.argmax(~step_ok))
+                if policy == "skip":
+                    # each bad step's state already reverted in-graph
+                    # inside the scan; account every discard, honoring
+                    # a streak carried in from previous windows
+                    streak, worst, last = self._numeric_skips, 0, None
+                    for i, ok_i in enumerate(step_ok):
+                        if ok_i:
+                            streak = 0
+                            continue
+                        streak += 1
+                        worst = max(worst, streak)
+                        last = i
+                        c = _first_offender(finite[i], fetch_names,
+                                            state_names)
+                        resilience.record_event(
+                            "numeric_fault", policy="skip", step=i,
+                            **({} if c is None else {"culprit": c}))
+                    self._numeric_skips = streak
+                    if worst > skip_budget:
+                        self._writeback(scope, state_names, new_state,
+                                        (), False)
+                        raise resilience.SkipBudgetExceededError(
+                            "numeric_policy='skip' discarded %d "
+                            "consecutive steps (budget %d) inside one "
+                            "run_steps window" % (worst, skip_budget),
+                            step=last, window_offset=last)
+                else:
+                    # write the post-window state back first — the
+                    # input buffers were donated, so leaving the scope
+                    # pointing at them would poison every later run.
+                    # Unlike run(), detection lands after the scanned
+                    # window completes (a scan cannot abort mid-flight)
+                    # — the step index still names the first offender
+                    self._writeback(scope, state_names, new_state, (),
+                                    False)
+                    culprit = _first_offender(
+                        finite[k] if finite.ndim == 2 else finite[k],
+                        fetch_names, state_names)
+                    resilience.record_event(
+                        "numeric_fault", policy=policy, step=k,
+                        **({} if culprit is None
+                           else {"culprit": culprit}))
+                    tail = "" if culprit is None \
+                        else " (first offender: %r)" % culprit
+                    if policy == "rewind":
+                        raise resilience.NumericFaultError(
+                            "numeric fault: non-finite value first "
+                            "detected at step %d of this run_steps "
+                            "window%s — rewinding with the poison "
+                            "batch skipped on replay" % (k, tail),
+                            step=k, culprit=culprit, window_offset=k)
+                    raise FloatingPointError(
+                        "check_numerics: non-finite value (NaN/Inf) "
+                        "first detected at step %d of this run_steps "
+                        "window%s" % (k, tail))
+            elif policy == "skip":
+                self._numeric_skips = 0
         t_wb = time.perf_counter()
         with obs.span("exec.writeback"):
             out = self._writeback(scope, state_names, new_state,
@@ -556,18 +721,27 @@ class Executor(object):
                 trace_mod._lookup(env, n, _FetchOp) for n in fetch_names)
             new_state = tuple(env[n] for n in state_names)
             if check_numerics:
-                flag = jnp.asarray(True)
+                # PER-VAR finite mask, index-aligned with fetch_names +
+                # state_names so the host can NAME the first offender
+                # (reference check_nan_inf names the op; we name the
+                # tensor). Non-inexact vars hold a constant-folded True
+                # placeholder purely to keep the indices aligned.
+                flags = []
                 for v in list(fetches) + list(new_state):
                     if jnp.issubdtype(jnp.result_type(v), jnp.inexact):
-                        flag = jnp.logical_and(flag,
-                                               jnp.all(jnp.isfinite(v)))
+                        flags.append(jnp.all(jnp.isfinite(v)))
+                    else:
+                        flags.append(jnp.asarray(True))
+                flag = jnp.stack(flags) if flags \
+                    else jnp.ones((0,), jnp.bool_)
                 return fetches, new_state, flag
             return fetches, new_state
 
         return step
 
     def _compile(self, program, feed_vals, fetch_names, state_names,
-                 uses_rng, strategy, check_numerics=False):
+                 uses_rng, strategy, check_numerics=False,
+                 numeric_policy="raise"):
         # Program verification at the compile seam (one walk per cache
         # miss): located diagnostics BEFORE the trace turns a malformed
         # program into a first-named-error or a jax traceback
@@ -579,6 +753,10 @@ class Executor(object):
             fetch_names=fetch_names, source="compile")
         step = self._make_step(program, sorted(feed_vals), fetch_names,
                                state_names, uses_rng, check_numerics)
+        if check_numerics and numeric_policy == "skip":
+            # wrap BEFORE any strategy lowering so the revert select is
+            # part of the (globally-viewed) jitted computation
+            step = _skip_guard(step)
         if strategy is not None:
             return strategy._build_step(self, step, program, state_names,
                                         sorted(feed_vals), feed_vals,

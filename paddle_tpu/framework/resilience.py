@@ -45,6 +45,7 @@ __all__ = [
     "SimulatedPreemptionError", "SimulatedHostDeathError",
     "ServerOverloadedError",
     "DeadlineExceededError", "RestartBudgetExceededError",
+    "NumericFaultError", "SkipBudgetExceededError", "SDCDetector",
     "fire", "inject", "install", "current_injector", "reload_env",
     "events", "record_event", "clear_events", "classify",
     "run_with_deadline", "INJECTION_POINTS", "context",
@@ -105,6 +106,38 @@ class DeadlineExceededError(CollectiveTimeoutError):
 class RestartBudgetExceededError(RuntimeError):
     """ResilientTrainer exhausted its restart budget — the fault is not
     transient at this rate; escalate to the orchestrator."""
+
+
+class NumericFaultError(FloatingPointError):
+    """A step produced a non-finite value and the numeric policy wants
+    a recovery, not a plain raise.  Subclasses FloatingPointError so
+    every existing handler (and the transient classifier) treats it
+    like today's check_numerics raise; additionally carries WHERE the
+    fault was localized so recovery can name the culprit and skip the
+    poison batch on replay.
+
+    ``step``    executor step counter at the faulting step
+    ``culprit`` first offending var name (fetch/param/grad), or None
+    ``batch_index`` global batch index of the poison batch (filled in
+                by the trainer's feed loop; None when not feed-driven)
+    """
+
+    def __init__(self, msg, step=None, culprit=None, batch_index=None,
+                 window_offset=0):
+        super(NumericFaultError, self).__init__(msg)
+        self.step = step
+        self.culprit = culprit
+        self.batch_index = batch_index
+        # which batch INSIDE the faulting dispatch window blew up
+        # (run_steps localizes it post-hoc); the trainer adds its own
+        # window base to get the global batch_index
+        self.window_offset = window_offset
+
+
+class SkipBudgetExceededError(NumericFaultError):
+    """numeric_policy="skip" discarded more consecutive steps than the
+    configured budget allows — the fault is persistent, not a one-batch
+    poison; escalate instead of silently dropping the whole stream."""
 
 
 # ---------------------------------------------------------------------------
@@ -896,6 +929,30 @@ def metrics(event_list=None, by_host=False):
                 METRIC_PREFIX + "_executor_step_seconds",
                 EXEC_STEP_BUCKETS, h["counts"], h["count"], h["sum"],
                 labels={"kind": kind}))
+    # failpoint plane (framework/faultinject.py): fired-hit counters by
+    # site plus an armed gauge — emitted only when something armed or
+    # fired, so production processes export nothing new; when anything
+    # IS exported, serving_probe --strict refuses the scrape on
+    # armed=1 (live failpoints have no business in production)
+    from . import faultinject
+    counters += [
+        {"name": METRIC_PREFIX + "_failpoint_hits_total",
+         "labels": {"site": site}, "value": n}
+        for site, n in sorted(faultinject.hits_total().items())]
+    if faultinject.armed() or faultinject.hits_total():
+        gauges.append(
+            {"name": METRIC_PREFIX + "_faultinject_armed",
+             "labels": {}, "value": 1 if faultinject.armed() else 0})
+    # numeric-fault recovery (BuildStrategy numeric_policy): one
+    # counter per (policy, culprit) from the numeric_fault events —
+    # the chaos battery and serving_probe assert on the culprit label
+    nf_counts = collections.Counter(
+        (e.get("policy", "?"), e.get("culprit", "?"))
+        for e in evs if e["kind"] == "numeric_fault")
+    counters += [
+        {"name": METRIC_PREFIX + "_numeric_fault_total",
+         "labels": {"policy": p, "culprit": c}, "value": n}
+        for (p, c), n in sorted(nf_counts.items())]
     # span-ring overflow (obs tentpole): dropped spans mean a merged
     # timeline is LYING about what happened — exported whenever the
     # engine is on (0 = trustworthy) or anything was ever dropped, so
@@ -1217,8 +1274,18 @@ def current_injector():
         _state["env_loaded"] = True
         spec = os.environ.get("PADDLE_TPU_FAULTS", "")
         if spec:
-            seed = int(os.environ.get("PADDLE_TPU_FAULT_SEED", "0") or 0)
-            _state["injector"] = FaultInjector(spec, seed=seed)
+            # the env var is shared with framework/faultinject.py:
+            # dotted-site specs ("transport.send:raise@3") belong to
+            # the failpoint plane; only bare legacy points are ours
+            parts = [s for chunk in spec.split(";")
+                     for s in chunk.split(",") if s.strip()]
+            legacy = [s for s in parts
+                      if "." not in s.strip().split(":", 1)[0]]
+            if legacy:
+                seed = int(os.environ.get("PADDLE_TPU_FAULT_SEED",
+                                          "0") or 0)
+                _state["injector"] = FaultInjector(",".join(legacy),
+                                                   seed=seed)
     return _state["injector"]
 
 
@@ -1249,6 +1316,93 @@ def fire(point, what=""):
     if inj is None:
         return {}
     return inj.fire(point, what=what)
+
+
+# ---------------------------------------------------------------------------
+# silent-data-corruption suspicion
+# ---------------------------------------------------------------------------
+
+class SDCDetector(object):
+    """Per-host gradient-norm outlier detection — the SDC tripwire.
+
+    A host with a flaky ALU produces gradients that are WRONG but
+    finite, so no finite-mask sees them; what does show is that host's
+    gradient norm drifting away from its peers on identical replicated
+    math. Feed one scalar per host per observation window (the pod
+    gathers them anyway for its window verdicts); a host whose
+    robust deviation from the pod median
+
+        |x_h - median(x)| / (MAD(x) + eps)
+
+    exceeds ``threshold`` for ``consecutive`` windows in a row within
+    the sliding ``window`` is flagged a suspect exactly once, a
+    ``sdc_suspect`` event is recorded, and the caller hands it to the
+    drain path (ElasticTrainer host drain). Median/MAD (not mean/std)
+    so the corrupt host's own wild values cannot mask themselves, and
+    a single-step spike (a legitimate loss blip hits EVERY host's norm
+    together) never trips the consecutive gate."""
+
+    def __init__(self, threshold=6.0, consecutive=3, window=32,
+                 eps=1e-12):
+        if consecutive < 1:
+            raise ValueError("consecutive must be >= 1")
+        self.threshold = float(threshold)
+        self.consecutive = int(consecutive)
+        self.window = int(window)
+        self.eps = float(eps)
+        self._streak = {}      # host -> consecutive outlier windows
+        self._history = collections.deque(maxlen=self.window)
+        self._suspects = set()
+        self._lock = threading.Lock()
+
+    def observe(self, norms, step=None):
+        """One observation window: ``{host: grad_norm}``. Returns the
+        list of NEWLY flagged suspect hosts (usually empty)."""
+        vals = {h: float(v) for h, v in norms.items()}
+        if len(vals) < 3:
+            return []   # a median of 2 cannot tell who is wrong
+        xs = sorted(vals.values())
+        mid = len(xs) // 2
+        med = xs[mid] if len(xs) % 2 else 0.5 * (xs[mid - 1] + xs[mid])
+        devs = sorted(abs(v - med) for v in xs)
+        mad = devs[mid] if len(devs) % 2 \
+            else 0.5 * (devs[mid - 1] + devs[mid])
+        new = []
+        with self._lock:
+            self._history.append(dict(vals))
+            for h, v in vals.items():
+                score = abs(v - med) / (mad + self.eps)
+                # a non-finite norm is an outlier by definition (the
+                # numeric policy handles the step; the detector only
+                # counts the host's streak)
+                outlier = score > self.threshold or v != v
+                self._streak[h] = self._streak.get(h, 0) + 1 \
+                    if outlier else 0
+                if self._streak[h] >= self.consecutive \
+                        and h not in self._suspects:
+                    self._suspects.add(h)
+                    new.append(h)
+                    record_event("sdc_suspect", host_suspect=str(h),
+                                 score=round(score, 3),
+                                 streak=self._streak[h],
+                                 **({} if step is None
+                                    else {"step": int(step)}))
+        return new
+
+    def suspects(self):
+        with self._lock:
+            return set(self._suspects)
+
+    def clear(self, host=None):
+        """Forget a drained-and-replaced host (or everything)."""
+        with self._lock:
+            if host is None:
+                self._suspects.clear()
+                self._streak.clear()
+                self._history.clear()
+            else:
+                self._suspects.discard(host)
+                self._streak.pop(host, None)
 
 
 # ---------------------------------------------------------------------------
@@ -1428,6 +1582,11 @@ class ResilientTrainer(object):
         # snapshot ("zlib" = lossless deflate, "q8" = lossy block codec —
         # see io.save_checkpoint; restores are transparent either way)
         self._ckpt_compress = ckpt_compress
+        # numeric_policy="rewind" recovery: global batch indices whose
+        # data poisoned a step — the replay after the consensus/local
+        # rewind SKIPS them, so the recovered trajectory is the
+        # uninterrupted no-poison-batch run, bit for bit
+        self._poison_batches = set()
 
     # -- events convenience ------------------------------------------------
     @staticmethod
@@ -1501,7 +1660,29 @@ class ResilientTrainer(object):
         return got
 
     def _dispatch(self, feeds, step, w, fetch_list):
-        return self._dispatch_batches(feeds[step:step + w], fetch_list)
+        return self._dispatch_window(feeds[step:step + w], step,
+                                     fetch_list)
+
+    def _dispatch_window(self, batches, base_step, fetch_list):
+        """Dispatch one window, dropping any batch whose global index
+        was marked poisoned by a numeric-fault rewind. Skipped slots
+        report ``None`` fetches; the step counter still advances over
+        them so the checkpoint cadence and caller indexing hold."""
+        if self._poison_batches:
+            keep, skipped = [], []
+            for i, b in enumerate(batches):
+                if base_step + i in self._poison_batches:
+                    skipped.append(base_step + i)
+                else:
+                    keep.append(b)
+            if skipped:
+                for idx in skipped:
+                    record_event("poison_skip", batch=idx)
+                outs = iter(self._dispatch_batches(keep, fetch_list)
+                            if keep else [])
+                return [None if base_step + i in self._poison_batches
+                        else next(outs) for i in range(len(batches))]
+        return self._dispatch_batches(batches, fetch_list)
 
     def _dispatch_batches(self, batches, fetch_list):
         """Run one window of batch feed dicts; returns the per-batch
@@ -1601,6 +1782,20 @@ class ResilientTrainer(object):
         if not self._policy.is_transient(e):
             record_event("fatal", step=step, error=type(e).__name__)
             raise e
+        if isinstance(e, NumericFaultError) \
+                and not isinstance(e, SkipBudgetExceededError):
+            # numeric_policy="rewind": remember WHICH batch poisoned the
+            # step so the post-restore replay runs without it — the
+            # recovered trajectory equals the uninterrupted run minus
+            # the poison batch (a deterministic NaN would otherwise
+            # re-fire every replay until the budget converts it to a
+            # hard failure)
+            if e.batch_index is None:
+                e.batch_index = step + int(e.window_offset or 0)
+            if e.batch_index not in self._poison_batches:
+                self._poison_batches.add(e.batch_index)
+                record_event("poison_batch", batch=e.batch_index,
+                             step=step, culprit=e.culprit)
         restarts += 1
         if restarts > self._max_restarts:
             record_event("giveup", step=step, restarts=restarts,
@@ -1643,7 +1838,7 @@ class ResilientTrainer(object):
             w = min(self._steps_per_dispatch, n - step, until_ckpt)
             try:
                 batches = self._feed.draw(w)
-                outs = self._dispatch_batches(batches, fetch_list)
+                outs = self._dispatch_window(batches, step, fetch_list)
                 # the window ran: publish the cursor — a later fault
                 # rewinds it to the last checkpoint with the params
                 self._feed.commit()

@@ -88,6 +88,7 @@ import socketserver
 import threading
 import time
 
+from . import faultinject
 from .coordination import GROW_FENCE_REASON
 from .resilience import RetryPolicy, record_event
 
@@ -1427,7 +1428,15 @@ class CoordClient(object):
     def _roundtrip_locked(self, payload):
         if self._sock is None:
             self._connect_locked()
-        self._sock.sendall(payload)
+        # chaos surface: a raise here is caught by request()'s socket-
+        # error handler (reconnect/rotate/backoff); DROP models a
+        # message lost in flight without waiting out the read timeout
+        out = faultinject.hit("transport.send", payload,
+                              host=self.host_id)
+        if out is faultinject.DROP:
+            self._teardown_locked()
+            raise ConnectionError("transport.send: dropped by failpoint")
+        self._sock.sendall(out)
         line = self._rfile.readline()
         if not line:
             raise ConnectionError("coordination service closed the "
@@ -1627,8 +1636,15 @@ class CoordClient(object):
         beats = 0
         while not self._hb_stop.wait(self._hb_interval_s):
             try:
+                # DROP loses the beat silently; an injected raise is
+                # swallowed like any transport failure — either way the
+                # server-side lease ages until the deadline monitor
+                # declares this host lost
+                if faultinject.hit("coordination.hb",
+                                   host=self.host_id) is faultinject.DROP:
+                    continue
                 self.call("hb")
-            except (TransportError, RuntimeError):
+            except (TransportError, RuntimeError, ConnectionError):
                 # the reconnect events already counted the pain; the
                 # lease simply ages until the server or network heals
                 continue

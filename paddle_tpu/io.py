@@ -31,6 +31,24 @@ def _collect(program, scope, predicate):
     return out
 
 
+def _fsync_dir(dirname):
+    """Flush the DIRECTORY entry after an os.replace: the rename itself
+    is atomic in the page cache, but a power cut can still lose it
+    unless the directory metadata reaches disk too. Best-effort —
+    platforms that cannot fsync a directory fd keep the rename-only
+    guarantee."""
+    try:
+        fd = os.open(dirname, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
 def _atomic_savez(dirname, filename, arrays, compressed=False):
     os.makedirs(dirname, exist_ok=True)
     fd, tmp = tempfile.mkstemp(dir=dirname, suffix=".tmp")
@@ -38,7 +56,14 @@ def _atomic_savez(dirname, filename, arrays, compressed=False):
     try:
         with open(tmp, "wb") as f:
             (np.savez_compressed if compressed else np.savez)(f, **arrays)
+            # durability, not just atomicity: without the fsync a crash
+            # after the rename can leave a VALID directory entry over
+            # torn page-cache payloads — a checkpoint that lists as
+            # complete but loads garbage
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, os.path.join(dirname, filename))
+        _fsync_dir(dirname)
     finally:
         if os.path.exists(tmp):
             os.unlink(tmp)
@@ -52,7 +77,12 @@ def _atomic_write(path, text):
     try:
         with open(tmp, "w") as f:
             f.write(text)
+            # the manifest IS the commit record: it must be durable
+            # BEFORE the rename publishes it (see _atomic_savez)
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, path)
+        _fsync_dir(d)
     finally:
         if os.path.exists(tmp):
             os.unlink(tmp)
@@ -454,8 +484,10 @@ def save_checkpoint(executor, dirname, main_program=None, step=None,
     n_proc = jax.process_count()
 
     def commit():
+        from .framework import faultinject
         raw_bytes = sum(int(a.nbytes) for a in own.values())
         shard_file = "shards_p%d.npz" % pid
+        faultinject.hit("io.member_write", host=pid)
         _atomic_savez(full_dir, shard_file,
                       _encode_payload(own, compress),
                       compressed=compress is not None)
@@ -484,6 +516,10 @@ def save_checkpoint(executor, dirname, main_program=None, step=None,
                 manifest["compress"] = compress
             if feed_state is not None:
                 manifest["feed_state"] = feed_state
+            # shards are on disk but the manifest — the commit record —
+            # is not: a fault HERE must leave a torn step dir that
+            # load_checkpoint quarantines, never a half-trusted one
+            faultinject.hit("io.manifest_write", host=pid)
             _atomic_write(os.path.join(full_dir, MANIFEST_FILE),
                           json.dumps(manifest))
             _atomic_write(os.path.join(dirname, "latest"), step_dir)

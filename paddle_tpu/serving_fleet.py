@@ -120,6 +120,7 @@ import threading
 import time
 import zlib
 
+from .framework import faultinject
 from .framework import obs
 from .framework import resilience
 from .framework.coordination import (CoordinationError, HostLostError,
@@ -824,6 +825,14 @@ class ReplicaMember(_FleetMember):
         try:
             feeds = {n: np.asarray(v, dtype=np.dtype(dtypes[n]))
                      for n, v in feeds_json.items() if n in dtypes}
+            # an injected raise surfaces as this replica's 500 — the
+            # router treats it like any replica fault and retries the
+            # batch on a sibling (``host`` filter = this replica's id)
+            feeds = faultinject.hit("serving.infer", feeds,
+                                    host=self.replica_id)
+            if feeds is faultinject.DROP:
+                raise RuntimeError("serving.infer: request dropped by "
+                                   "failpoint")
             outs = pred.run(feeds, deadline_s=deadline_s)
         except ServerOverloadedError as e:
             return 503, {"error": str(e), "kind": "overloaded"}
@@ -2389,6 +2398,10 @@ class FleetRouter(_FleetMember):
                         "%s:%s" % (lead.trace, lead.span)
             self._inc_inflight(rid, +1)
             try:
+                # inside the try on purpose: an injected OSError takes
+                # the exact retry-on-sibling path a dead replica does
+                # (``host`` filter = target replica id)
+                faultinject.hit("serving.dispatch", host=rid)
                 status, resp = http_json(
                     "POST", "http://%s/infer" % addr, payload,
                     timeout_s=remaining + 0.5, headers=headers)

@@ -99,6 +99,18 @@ def pytest_configure(config):
 
 
 @pytest.fixture(autouse=True)
+def disarmed_failpoints():
+    """No test leaks an armed fault schedule (or stale hit counters)
+    into the next — the fault-injection plane starts and ends cold."""
+    from paddle_tpu.framework import faultinject
+    faultinject.disarm()
+    faultinject.reset_counters()
+    yield
+    faultinject.disarm()
+    faultinject.reset_counters()
+
+
+@pytest.fixture(autouse=True)
 def fresh_programs():
     """Give every test fresh default programs + scope + name generator."""
     import paddle_tpu as pt

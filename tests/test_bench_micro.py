@@ -84,7 +84,11 @@ def test_budget_table_covers_the_contract():
         # pretrain program, the verify/trace+lower overhead ratio, and
         # the zero-false-positive gate on the clean headline program
         "analysis_verify_s", "analysis_overhead_ratio",
-        "analysis_bert_errors"}
+        "analysis_bert_errors",
+        # ISSUE-17 numeric-fault plane: the in-graph finite-mask cost
+        # vs the plain dp step and the wall of one failpoint-poisoned
+        # skip-policy recovery
+        "numerics_overhead_frac", "fault_recovery_ms"}
 
 
 def test_analysis_section_measures_the_verifier():

@@ -1,0 +1,264 @@
+"""Deterministic failpoint twins of the two slowest real-process
+chaos soaks (ISSUE-17 satellite).
+
+The originals stay where they are with their ``procpod``/``fleet``
+markers — real OS processes, real SIGKILL:
+
+  * router leader kill — test_router_ha.py
+    test_chaos_double_failure_leader_router_and_replica
+  * replica SIGKILL mid-deploy — test_serving_fleet.py
+    test_chaos_sigkill_replica_under_sustained_load (+ the rolling
+    deploy battery)
+
+These twins drive the SAME assertions in one process through
+``framework.faultinject``: the victim's coordination plane is severed
+by a deterministic ``transport.send`` raise schedule (a process whose
+transport never answers is indistinguishable from a SIGKILLed one to
+the rest of the group), so the failover path runs on every CI box the
+same way — no process spawn, no scheduler roulette on the kill
+window."""
+import contextlib
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers
+from paddle_tpu.framework import faultinject, resilience
+from paddle_tpu.framework.transport import CoordServer
+from paddle_tpu.serving_fleet import (FleetClient, FleetRouter,
+                                      ReplicaMember, http_json,
+                                      router_host_id)
+
+pytestmark = [pytest.mark.faultinject, pytest.mark.fleet]
+
+WAIT_S = 25.0
+
+
+@pytest.fixture(autouse=True)
+def _clean_resilience():
+    resilience.install(None)
+    resilience.clear_events()
+    resilience.clear_router()
+    yield
+    resilience.install(None)
+    resilience.clear_events()
+    resilience.clear_router()
+
+
+def _export_artifact(dirname, scale=None, features=6, classes=3):
+    from paddle_tpu.framework.scope import Scope, scope_guard
+    with scope_guard(Scope()):
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("x", [features], dtype="float32")
+            if scale is None:
+                y = layers.softmax(layers.fc(x, classes))
+            else:
+                y = layers.fc(x, classes, param_attr=pt.ParamAttr(
+                    name="w",
+                    initializer=pt.initializer.Constant(scale)),
+                    bias_attr=False)
+        exe = pt.Executor()
+        exe.run(startup)
+        pt.save_inference_model(str(dirname), ["x"], [y], exe,
+                                main_program=main, format="stablehlo",
+                                batch_sizes=(1, 8))
+    return str(dirname)
+
+
+def _wait(cond, what, timeout_s=WAIT_S):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.02)
+    raise AssertionError("timed out waiting for %s" % what)
+
+
+def _load_threads(n, fn):
+    stop, failures = threading.Event(), []
+    lock = threading.Lock()
+
+    def run():
+        while not stop.is_set():
+            try:
+                fn()
+            except Exception as e:    # noqa: BLE001 - recorded
+                with lock:
+                    failures.append(repr(e))
+            time.sleep(0.01)
+
+    ts = [threading.Thread(target=run, daemon=True) for _ in range(n)]
+    for t in ts:
+        t.start()
+    return stop, ts, failures
+
+
+def test_twin_router_leader_kill_failover_and_follower_rejoin(
+        tmp_path):
+    """Failpoint twin of the router-leader-kill soak: sever the
+    admission leader's coordination plane at a deterministic point
+    (every ``transport.send`` from its host raises from the first hit
+    on) — the survivor takes over with a HIGHER term, client load
+    loses ZERO requests across the failover, and on disarm the
+    ex-leader rejoins as a FOLLOWER (sticky incumbency), exactly the
+    real-process soak's assertions."""
+    artifact = _export_artifact(tmp_path / "art")
+    with contextlib.ExitStack() as stack:
+        srv = CoordServer(3, hb_deadline_s=1.0).start()
+        stack.callback(srv.close)
+        rep = ReplicaMember(artifact, srv.address, 1, 0, n_routers=2,
+                            ctl_interval_s=0.05, hb_interval_s=0.1,
+                            join_timeout_s=WAIT_S).start()
+        stack.callback(rep.close)
+        routers = []
+        for rid in range(2):
+            r = FleetRouter(srv.address, 1, router_id=rid, n_routers=2,
+                            max_batch=8, batch_deadline_s=0.01,
+                            ctl_interval_s=0.05, hb_interval_s=0.1,
+                            poll_interval_s=0.03,
+                            join_timeout_s=WAIT_S).start()
+            stack.callback(r.close)
+            routers.append(r)
+        for r in routers:
+            _wait(lambda r=r: len(r.routable()) == 1,
+                  "router %d routable" % r.router_id)
+        _wait(lambda: routers[0].is_leader(), "router 0 leads")
+        t0 = routers[0].leader_term
+        leader_host = router_host_id(1, 0)
+
+        client = FleetClient([routers[0].url, routers[1].url],
+                             request_deadline_s=15.0)
+        xv = np.ones((1, 6), np.float32).tolist()
+        served = []
+        stop, ts, failures = _load_threads(
+            2, lambda: served.append(client.infer({"x": xv})["replica"]))
+        try:
+            time.sleep(0.2)
+            # the "SIGKILL": from its first post-arm send on, the
+            # leader's transport raises — heartbeats, ctl rounds and
+            # rejoin attempts all die until disarm, which is what the
+            # rest of the group sees of a killed process
+            faultinject.arm(["transport.send:raise=ConnectionError"
+                             "@1+^%d" % leader_host])
+            try:
+                _wait(lambda: routers[1].is_leader(),
+                      "router 1 takes over")
+                assert routers[1].leader_term > t0   # fences the claim
+                elects = [e for e in
+                          resilience.events("fleet_leader_elect")
+                          if e.get("router") == routers[1]._host_id]
+                assert elects, "takeover did not record an election"
+                # the fault plane drove it, and says so
+                assert faultinject.hits_total()["transport.send"] > 0
+                time.sleep(0.3)       # sustained load on the survivor
+            finally:
+                faultinject.disarm()
+            # "restart": the severed router's own ctl loop finds
+            # itself fenced and re-admits through announce/admit/join
+            # — and must NOT reclaim the lease it lost
+            _wait(lambda: len(routers[0].routable()) == 1,
+                  "ex-leader routable again")
+            _wait(lambda: routers[0].leader_term ==
+                  routers[1].leader_term, "terms converge")
+            assert routers[1].is_leader()
+            assert not routers[0].is_leader()
+        finally:
+            stop.set()
+            for t in ts:
+                t.join(timeout=5)
+        assert not failures, failures[:5]
+        assert served, "load never completed a request"
+        # both routers answer on the serving path after recovery
+        for r in routers:
+            status, resp = http_json("POST", r.url + "/infer",
+                                     {"feeds": {"x": xv}},
+                                     timeout_s=15.0)
+            assert status == 200, resp
+
+
+def test_twin_replica_killed_mid_deploy_skipped_then_converges(
+        tmp_path):
+    """Failpoint twin of replica death mid rolling-deploy: replica 2's
+    coordination plane is severed under sustained load, the lease
+    fences it out of rotation, and a rolling deploy COMPLETES over the
+    survivors with the dead replica skipped — zero failed requests.
+    On disarm the replica re-admits through announce/admit/join and
+    the fleet converges on the new artifact: the admission sync adopts
+    the survivors' newer generation (or a sweep deploy refreshes the
+    straggler), the already-current replicas short-circuiting on
+    their dir match."""
+    d1 = _export_artifact(tmp_path / "d1", scale=0.5)
+    d2 = _export_artifact(tmp_path / "d2", scale=2.0)
+    with contextlib.ExitStack() as stack:
+        srv = CoordServer(None, hb_deadline_s=0.5).start()
+        stack.callback(srv.close)
+        reps = []
+        for i in range(3):
+            rep = ReplicaMember(d1, srv.address, 3, i,
+                                ctl_interval_s=0.05, hb_interval_s=0.1,
+                                join_timeout_s=WAIT_S).start()
+            stack.callback(rep.close)
+            reps.append(rep)
+        router = FleetRouter(srv.address, 3, max_batch=8,
+                             batch_deadline_s=0.01, ctl_interval_s=0.05,
+                             hb_interval_s=0.1, poll_interval_s=0.03,
+                             join_timeout_s=WAIT_S).start()
+        stack.callback(router.close)
+        _wait(lambda: len(router.routable()) == 3, "3 routable")
+
+        xv = np.ones((2, 6), np.float32).tolist()
+
+        def one_request():
+            status, resp = http_json("POST", router.url + "/infer",
+                                     {"feeds": {"x": xv},
+                                      "deadline_s": 15.0},
+                                     timeout_s=20.0)
+            assert status == 200, (status, resp)
+
+        stop, ts, failures = _load_threads(2, one_request)
+        try:
+            time.sleep(0.2)
+            # sever replica 2: coordination dead (lease will lapse),
+            # serving path poisoned (dispatches to it 500 and retry on
+            # a sibling) — the in-process shape of a SIGKILLed replica
+            faultinject.arm(["transport.send:raise=ConnectionError@1+^2",
+                             "serving.infer:raise=RuntimeError@1+^2"])
+            try:
+                _wait(lambda: 2 not in router.routable(),
+                      "fenced out of rotation")
+                summary = router.rolling_deploy(
+                    d2, per_replica_timeout_s=30.0)
+                # the dead replica is SKIPPED, never waited on
+                assert summary["refreshed"] == [0, 1]
+                assert faultinject.hits_total()["transport.send"] > 0
+            finally:
+                faultinject.disarm()
+            # "restart": hb resumes, the replica finds itself fenced
+            # and re-admits. Usually its admission sync ADOPTS the
+            # survivors' newer artifact generation on the way in
+            # (fleet_adopt); if that best-effort sync was skipped, the
+            # sweep deploy below refreshes it. Either way the fleet
+            # converges, the already-current replicas short-circuiting
+            # on their dir match.
+            _wait(lambda: 2 in router.routable(), "re-admitted")
+            summary2 = router.rolling_deploy(d2,
+                                             per_replica_timeout_s=30.0)
+            assert summary2["refreshed"] == [0, 1, 2]
+            _wait(lambda: router.routable().get(2, {}).get("dir") == d2,
+                  "replica 2 on the new artifact")
+        finally:
+            stop.set()
+            for t in ts:
+                t.join(timeout=5)
+        assert not failures, failures[:5]
+        # every replica now serves the NEW weights (w pinned to 2.0)
+        status, resp = http_json("POST", router.url + "/infer",
+                                 {"feeds": {"x": xv}}, timeout_s=15.0)
+        assert status == 200, resp
+        out = np.asarray(resp["outputs"][0], dtype=resp["dtypes"][0])
+        np.testing.assert_allclose(out, np.full_like(out, 12.0),
+                                   rtol=1e-5)

@@ -25,6 +25,7 @@ def test_repo_is_clean():
     assert report["cache_token"] == [], report["cache_token"]
     assert report["free_floating_locks"] == [], \
         report["free_floating_locks"]
+    assert report["failpoint_sites"] == [], report["failpoint_sites"]
 
 
 def test_lint_sees_the_real_knobs():
@@ -136,3 +137,45 @@ def test_cli_exit_codes(tmp_path, capsys):
     assert codelint.main(["--json"]) == 0
     out = capsys.readouterr().out
     assert '"ok": true' in out
+
+
+def test_failpoint_site_catalog_matches_runtime():
+    """Rule 3's AST-parsed catalog and the live SITES registry must be
+    the same set — a drift here means the lint guards a phantom."""
+    from paddle_tpu.framework import faultinject
+    assert codelint._site_catalog() == set(faultinject.SITES)
+
+
+def test_uncatalogued_failpoint_site_is_caught(tmp_path):
+    p = tmp_path / "mod.py"
+    p.write_text(
+        "from paddle_tpu.framework import faultinject\n"
+        "def f():\n"
+        "    faultinject.hit('io.not_a_real_site')\n")
+    v = codelint.lint_failpoint_sites(paths=[str(p)])
+    assert len(v) == 1 and "names a site missing" in v[0]
+    # the short alias used in hot modules is linted too
+    q = tmp_path / "alias.py"
+    q.write_text(
+        "from paddle_tpu.framework import faultinject as fi\n"
+        "def f():\n"
+        "    fi.hit('serving.not_a_real_site')\n")
+    v = codelint.lint_failpoint_sites(paths=[str(q)])
+    assert len(v) == 1 and "names a site missing" in v[0]
+
+
+def test_computed_failpoint_site_is_caught(tmp_path):
+    p = tmp_path / "mod.py"
+    p.write_text(
+        "from paddle_tpu.framework import faultinject\n"
+        "def f(which):\n"
+        "    faultinject.hit('io.' + which)\n")
+    v = codelint.lint_failpoint_sites(paths=[str(p)])
+    assert len(v) == 1 and "string literal" in v[0]
+    # a catalogued literal site is clean
+    q = tmp_path / "ok.py"
+    q.write_text(
+        "from paddle_tpu.framework import faultinject\n"
+        "def f():\n"
+        "    faultinject.hit('transport.send')\n")
+    assert codelint.lint_failpoint_sites(paths=[str(q)]) == []

@@ -666,3 +666,60 @@ def test_elastic_transient_fault_still_rewinds(tmp_path):
         np.testing.assert_array_equal(
             np.asarray([o[0] for o in out[h]]),
             np.asarray([o[0] for o in ref_out[h]]))
+
+
+# ---------------------------------------------------------------------------
+# ISSUE-17: SDC host suspicion -> the drain path
+# ---------------------------------------------------------------------------
+
+def test_sdc_suspect_host_drains_with_zero_survivor_divergence(
+        tmp_path):
+    """THE ISSUE-17 SDC acceptance: a failpoint flips one low mantissa
+    bit of host 1's feed from step 5 on — silently WRONG but finite,
+    so no finite-mask can see it. What shows is host 1's float-state
+    norm drifting from its peers on replicated math: the per-window
+    SDCDetector (median/MAD over the gathered norms, identical config
+    + frozen verdicts on every host = pod-agreed suspects with no
+    shared state) flags it, the existing drain path removes it, and
+    the SURVIVORS finish bitwise-identical to a clean run — the
+    corrupt host never contaminated a collective."""
+    from paddle_tpu.framework import faultinject
+
+    feeds = _elastic_feeds(18)
+    ref_pod, ref_tr = _drain_pod(tmp_path, "sdc_ref")
+    ref_pod.run(feeds)
+    ref_w = [t._scope.get_numpy("el_w").copy() for t in ref_tr]
+    resilience.clear_events()
+
+    pod, trainers = _drain_pod(
+        tmp_path, "sdc", drain_after=1,
+        sdc_detect={"consecutive": 2, "threshold": 6.0})
+    with faultinject.failpoints("executor.step:flip=x@5+^1"):
+        out = pod.run(feeds)
+
+    assert {e["host_suspect"]
+            for e in resilience.events("sdc_suspect")} == {"1"}
+    drains = resilience.events("elastic_drain")
+    assert {e["drained"] for e in drains} == {1}
+    assert all(e.get("sdc") for e in drains)
+    # the tombstone says WHY (operator-facing triage)
+    assert "suspected SDC" in pod.coordinator.lost_hosts()[1]
+    # survivors never diverged from the clean trajectory
+    for h in (0, 2):
+        np.testing.assert_array_equal(
+            ref_w[h], trainers[h]._scope.get_numpy("el_w"))
+    # the drained host committed fewer steps than the survivors
+    assert len([o for o in out[1] if o is not None]) \
+        < len([o for o in out[0] if o is not None])
+
+
+def test_sdc_detect_config_validates():
+    main, startup, loss = _elastic_program()
+    sc, exe = Scope(), pt.Executor()
+    with scope_guard(sc):
+        exe.run(startup)
+    tr = ResilientTrainer(exe, main, "/tmp/unused_sdc_cfg",
+                          fetch_list=[loss], scope=sc)
+    with pytest.raises(ValueError, match="sdc_detect"):
+        ElasticTrainer([tr], LocalCoordinator(1), host_id=0,
+                       sdc_detect="yes")

@@ -1,0 +1,447 @@
+"""ISSUE-17 tentpole battery: the deterministic failpoint plane
+(framework/faultinject.py) and the numeric-fault recovery policies
+(BuildStrategy.numeric_policy = raise | skip | rewind).
+
+Covers, in order:
+  * FailSpec parsing + the (site, hit-count, host) match semantics;
+  * every action (raise / delay / drop / corrupt / flip), determinism
+    of @N / @N+ / ~p schedules, PADDLE_TPU_FAULTS env split with the
+    legacy resilience injector, counter + metrics export, and the
+    unarmed fast path staying a no-op;
+  * numeric_policy: "raise" names the culprit var (and stays today's
+    FloatingPointError), "skip" discards the poisoned step with a
+    bit-exact in-graph state revert under the consecutive-skip budget
+    (run() and run_steps() windows both), "rewind" raises the typed
+    NumericFaultError the trainers route through consensus rewind;
+    the quantize_collectives x skip and pipeline x non-raise refusals;
+  * the SDCDetector median/MAD tripwire unit.
+"""
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import layers, optimizer
+from paddle_tpu.framework import faultinject, resilience
+from paddle_tpu.framework.compiler import BuildStrategy, CompiledProgram
+from paddle_tpu.framework.faultinject import DROP, FailSpec
+from paddle_tpu.framework.scope import Scope, scope_guard
+
+pytestmark = pytest.mark.faultinject
+
+
+# ---------------------------------------------------------------------------
+# FailSpec parsing + matching
+# ---------------------------------------------------------------------------
+
+def test_parse_full_spec_forms():
+    s = FailSpec.parse("transport.send:raise=TimeoutError/slow@3+^h2")
+    assert (s.site, s.action, s.arg) == ("transport.send", "raise",
+                                         "TimeoutError/slow")
+    assert (s.at, s.at_plus, s.host) == (3, True, "h2")
+    s = FailSpec.parse("executor.step:corrupt=x@5")
+    assert (s.action, s.arg, s.at, s.at_plus) == ("corrupt", "x", 5,
+                                                  False)
+    s = FailSpec.parse("coordination.hb:drop~0.25")
+    assert s.action == "drop" and s.prob == 0.25 and s.at is None
+    s = FailSpec.parse("io.manifest_write:delay=0.01")
+    assert s.action == "delay" and s.arg == "0.01"
+    # default schedule: every visit
+    s = FailSpec.parse("serving.infer:raise")
+    assert s.at is None and s.prob is None and s.host is None
+
+
+def test_parse_rejects_bad_specs():
+    with pytest.raises(ValueError, match="unknown failpoint site"):
+        FailSpec.parse("transport.sned:raise")     # typo'd site
+    with pytest.raises(ValueError, match="unknown failpoint action"):
+        FailSpec.parse("transport.send:explode")
+    with pytest.raises(ValueError, match="target array name"):
+        FailSpec.parse("executor.step:corrupt")    # corrupt needs =arr
+    with pytest.raises(ValueError, match="needs the form"):
+        FailSpec.parse("no-colon-here")
+
+
+def test_unarmed_hit_is_an_identity_no_op():
+    assert not faultinject.armed()
+    payload = {"x": np.ones(3)}
+    assert faultinject.hit("transport.send", payload) is payload
+    # no visit accounting happens on the fast path
+    assert faultinject.hits_total() == {}
+    # even an uncatalogued site passes through unarmed (the catalog
+    # check is part of the armed path; codelint guards the literals)
+    assert faultinject.hit("not.a.site") is None
+
+
+def test_armed_hit_rejects_uncatalogued_site():
+    with faultinject.failpoints(["transport.send:drop"]):
+        with pytest.raises(ValueError, match="uncatalogued site"):
+            faultinject.hit("not.a.site")
+
+
+def test_exact_count_schedule_fires_once():
+    with faultinject.failpoints(["transport.send:raise@3"]):
+        faultinject.hit("transport.send")
+        faultinject.hit("transport.send")
+        with pytest.raises(ConnectionError, match="visit 3"):
+            faultinject.hit("transport.send")
+        faultinject.hit("transport.send")           # 4th: clean again
+        assert faultinject.hits_total() == {"transport.send": 1}
+
+
+def test_from_count_and_host_filter_are_per_host():
+    spec = ["coordination.hb:drop@2+^1"]
+    with faultinject.failpoints(spec):
+        # host 0 never matches, any visit
+        for _ in range(3):
+            assert faultinject.hit("coordination.hb", host=0) is None
+        # host 1: visit 1 clean, visits 2+ dropped — ints and strings
+        # name the same host (visit counting is per str(host))
+        assert faultinject.hit("coordination.hb", host=1) is None
+        assert faultinject.hit("coordination.hb", host="1") is DROP
+        assert faultinject.hit("coordination.hb", host=1) is DROP
+
+
+def test_host_context_falls_back_to_resilience_tag():
+    with faultinject.failpoints(["coordination.hb:drop^h7"]):
+        assert faultinject.hit("coordination.hb") is None
+        with resilience.context(host="h7"):
+            assert faultinject.hit("coordination.hb") is DROP
+        assert faultinject.hit("coordination.hb") is None
+
+
+def test_probability_schedule_replays_under_a_seed():
+    def draw():
+        with faultinject.failpoints(["transport.send:drop~0.5"],
+                                    seed=1234):
+            return [faultinject.hit("transport.send") is DROP
+                    for _ in range(64)]
+
+    a, b = draw(), draw()
+    assert a == b                      # seeded: bitwise replayable
+    assert any(a) and not all(a)       # and actually probabilistic
+
+
+def test_raise_action_typed_errors():
+    # site default class
+    with faultinject.failpoints(["io.member_write:raise"]):
+        with pytest.raises(OSError):
+            faultinject.hit("io.member_write")
+    # explicit class + message
+    with faultinject.failpoints(
+            ["transport.send:raise=TimeoutError/too slow"]):
+        with pytest.raises(TimeoutError, match="too slow"):
+            faultinject.hit("transport.send")
+    # unknown class name fails loudly, not silently
+    with faultinject.failpoints(["transport.send:raise=NoSuchError"]):
+        with pytest.raises(ValueError, match="names no known"):
+            faultinject.hit("transport.send")
+
+
+def test_delay_action_sleeps_then_passes_through():
+    with faultinject.failpoints(["serving.infer:delay=0.05"]):
+        t0 = time.perf_counter()
+        out = faultinject.hit("serving.infer", {"a": 1})
+        assert time.perf_counter() - t0 >= 0.04
+        assert out == {"a": 1}
+
+
+def test_corrupt_poisons_a_copy_and_flip_stays_finite():
+    feed = {"x": np.ones((2, 3), np.float32),
+            "y": np.zeros(2, np.int64)}
+    with faultinject.failpoints(["executor.step:corrupt=x"]):
+        out = faultinject.hit("executor.step", feed)
+    assert np.isnan(out["x"]).sum() == 1
+    assert np.isfinite(feed["x"]).all()       # original untouched
+    assert out["y"] is feed["y"]              # other arrays shared
+    with faultinject.failpoints(["executor.step:flip=x"]):
+        out = faultinject.hit("executor.step", feed)
+    assert np.isfinite(out["x"]).all()        # SDC: wrong but finite
+    assert (out["x"] != feed["x"]).sum() == 1
+    # a mis-aimed corrupt passes through instead of crashing the site
+    with faultinject.failpoints(["executor.step:corrupt=nope"]):
+        assert faultinject.hit("executor.step", feed) is feed
+
+
+def test_failpoints_context_restores_specs_and_counters():
+    faultinject.arm(["transport.send:drop@1"])
+    faultinject.hit("transport.send")
+    before = faultinject.hits_total()
+    with faultinject.failpoints(["coordination.hb:drop"]):
+        assert faultinject.hit("coordination.hb") is DROP
+        assert [s.site for s in faultinject.schedules()] \
+            == ["coordination.hb"]
+    assert [s.site for s in faultinject.schedules()] \
+        == ["transport.send"]
+    assert faultinject.hits_total() == before
+    faultinject.disarm()
+
+
+def test_env_var_split_dotted_vs_legacy(monkeypatch):
+    """PADDLE_TPU_FAULTS is SHARED with the legacy resilience
+    injector: dotted-site specs arm this plane, bare legacy points are
+    left for resilience.FaultInjector — neither steals the other's."""
+    monkeypatch.setenv("PADDLE_TPU_FAULTS",
+                       "transport.send:drop@1;step:raise@2,"
+                       "io.manifest_write:raise")
+    monkeypatch.setenv("PADDLE_TPU_FAULT_SEED", "7")
+    try:
+        parsed = faultinject.reload_env()
+        assert sorted(s.site for s in parsed) \
+            == ["io.manifest_write", "transport.send"]
+        assert faultinject.armed()
+    finally:
+        faultinject.disarm()
+    monkeypatch.setenv("PADDLE_TPU_FAULTS", "")
+    assert faultinject.reload_env() == []
+    assert not faultinject.armed()
+
+
+def test_metrics_export_counters_and_armed_gauge():
+    resilience.clear_events()
+    # cold plane: no failpoint series pollute production metrics
+    text = resilience.metrics_text()
+    assert "failpoint_hits_total" not in text
+    assert "faultinject_armed" not in text
+    with faultinject.failpoints(["transport.send:drop@1"]):
+        faultinject.hit("transport.send")
+        text = resilience.metrics_text()
+        assert 'failpoint_hits_total{site="transport.send"} 1' in text
+        assert "faultinject_armed 1" in text
+        # the fired hit also lands in the bounded event log
+        evs = resilience.events("failpoint")
+        assert evs and evs[-1]["site"] == "transport.send"
+        assert evs[-1]["action"] == "drop" and evs[-1]["visit"] == 1
+    resilience.clear_events()
+
+
+# ---------------------------------------------------------------------------
+# numeric_policy: raise / skip / rewind
+# ---------------------------------------------------------------------------
+
+def _train_setup(policy=None, check=False, skip_budget=None,
+                 lr=0.1, **bs_kw):
+    """Tiny fc trainer on a dp=1 mesh; returns (exe, comp, loss,
+    feed, params_fn) inside a fresh scope guard the CALLER holds."""
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [4], dtype="float32")
+        y = layers.data("y", [1], dtype="int64")
+        h = layers.fc(x, size=8, act="relu")
+        logits = layers.fc(h, size=3)
+        loss = layers.mean(
+            layers.softmax_with_cross_entropy(logits, y))
+        optimizer.SGD(lr).minimize(loss)
+    exe = pt.Executor()
+    exe.run(startup)
+    bs = BuildStrategy()
+    bs.mesh_axes = {"dp": 1}
+    bs.check_numerics = check
+    if policy is not None:
+        bs.numeric_policy = policy
+    if skip_budget is not None:
+        bs.numeric_skip_budget = skip_budget
+    for k, v in bs_kw.items():
+        setattr(bs, k, v)
+    return exe, CompiledProgram(main, bs), loss
+
+
+def _feed(rng, n=8):
+    return {"x": rng.rand(n, 4).astype(np.float32),
+            "y": rng.randint(0, 3, (n, 1)).astype(np.int64)}
+
+
+def _params(scope):
+    sc = scope or pt.global_scope()
+    return {n: np.array(sc.find_var(n)) for n in sc.keys()
+            if np.asarray(sc.find_var(n)).dtype.kind == "f"}
+
+
+def test_raise_policy_names_the_culprit_var():
+    resilience.clear_events()
+    with scope_guard(Scope()):
+        exe, comp, loss = _train_setup(policy="raise", check=True)
+        feed = _feed(np.random.RandomState(0))
+        exe.run(comp, feed=feed, fetch_list=[loss])
+        bad = dict(feed)
+        bad["x"] = feed["x"].copy()
+        bad["x"][0, 0] = np.nan
+        # today's class, but the error now NAMES the first offender
+        with pytest.raises(FloatingPointError, match="var '"):
+            exe.run(comp, feed=bad, fetch_list=[loss])
+    evs = resilience.events("numeric_fault")
+    assert evs and evs[-1]["policy"] == "raise"
+    assert evs[-1].get("culprit")   # localized, not "somewhere"
+
+
+def test_skip_policy_discards_the_step_bit_exactly():
+    resilience.clear_events()
+    with scope_guard(Scope()):
+        exe, comp, loss = _train_setup(policy="skip")
+        rng = np.random.RandomState(0)
+        feed = _feed(rng)
+        exe.run(comp, feed=feed, fetch_list=[loss])
+        before = _params(None)
+        # a failpoint NaN-poisons the NEXT step's batch on the wire
+        with faultinject.failpoints(["executor.step:corrupt=x@1"]):
+            out, = exe.run(comp, feed=feed, fetch_list=[loss])
+        assert not np.isfinite(out)            # the fetch says why
+        after = _params(None)
+        for n, v in before.items():            # in-graph revert:
+            np.testing.assert_array_equal(v, after[n])  # bit-exact
+        # the job keeps training afterwards, and converges
+        losses = [float(np.ravel(exe.run(comp, feed=feed,
+                                         fetch_list=[loss])[0])[0])
+                  for _ in range(12)]
+        assert losses[-1] < losses[0]
+    evs = resilience.events("numeric_fault")
+    assert [e["policy"] for e in evs] == ["skip"]
+    assert evs[0].get("culprit")
+
+
+def test_skip_budget_escalates_on_persistent_fault():
+    with scope_guard(Scope()):
+        exe, comp, loss = _train_setup(policy="skip", skip_budget=2)
+        feed = _feed(np.random.RandomState(0))
+        exe.run(comp, feed=feed, fetch_list=[loss])
+        with faultinject.failpoints(["executor.step:corrupt=x@1+"]):
+            exe.run(comp, feed=feed, fetch_list=[loss])   # skip 1
+            exe.run(comp, feed=feed, fetch_list=[loss])   # skip 2
+            with pytest.raises(resilience.SkipBudgetExceededError,
+                               match="persistent"):
+                exe.run(comp, feed=feed, fetch_list=[loss])
+        # a clean step ends the streak and resets the budget
+        exe.run(comp, feed=feed, fetch_list=[loss])
+        with faultinject.failpoints(["executor.step:corrupt=x@1"]):
+            exe.run(comp, feed=feed, fetch_list=[loss])   # skips again
+
+
+def test_rewind_policy_raises_typed_error_with_state_intact():
+    with scope_guard(Scope()):
+        exe, comp, loss = _train_setup(policy="rewind")
+        feed = _feed(np.random.RandomState(0))
+        exe.run(comp, feed=feed, fetch_list=[loss])
+        before = _params(None)
+        with faultinject.failpoints(["executor.step:corrupt=x@1"]):
+            with pytest.raises(resilience.NumericFaultError) as ei:
+                exe.run(comp, feed=feed, fetch_list=[loss])
+        assert ei.value.culprit
+        assert ei.value.window_offset == 0
+        assert isinstance(ei.value, FloatingPointError)  # catchable
+        # the scope was written back (live readable arrays, not
+        # donated buffers) — it holds the POISONED post-step state,
+        # which is exactly why the rewind contract hands recovery to
+        # the trainer's checkpoint restore, not to the caller
+        after = _params(None)
+        assert set(after) == set(before)
+        assert any(not np.isfinite(v).all() for v in after.values())
+
+
+def test_run_steps_window_skips_inside_the_scan():
+    resilience.clear_events()
+    with scope_guard(Scope()):
+        exe, comp, loss = _train_setup(policy="skip")
+        rng = np.random.RandomState(0)
+        n_steps, n = 4, 8
+        stacked = {"x": rng.rand(n_steps, n, 4).astype(np.float32),
+                   "y": rng.randint(0, 3, (n_steps, n, 1))
+                   .astype(np.int64)}
+        stacked["x"][2, 0, 0] = np.nan        # poison step 2 of 4
+        exe.run_steps(comp, feed={k: v.copy()
+                                  for k, v in stacked.items()},
+                      fetch_list=[loss])
+    evs = resilience.events("numeric_fault")
+    assert [(e["policy"], e["step"]) for e in evs] == [("skip", 2)]
+    assert evs[0].get("culprit")
+
+
+def test_run_steps_window_rewind_names_the_step_offset():
+    with scope_guard(Scope()):
+        exe, comp, loss = _train_setup(policy="rewind")
+        rng = np.random.RandomState(0)
+        stacked = {"x": rng.rand(3, 8, 4).astype(np.float32),
+                   "y": rng.randint(0, 3, (3, 8, 1)).astype(np.int64)}
+        stacked["x"][1, 0, 0] = np.nan
+        with pytest.raises(resilience.NumericFaultError) as ei:
+            exe.run_steps(comp, feed=stacked, fetch_list=[loss])
+        # window_offset lets the trainer compute the global poison
+        # batch index: window base + 1
+        assert ei.value.window_offset == 1
+
+
+def test_skip_refused_with_quantized_collectives():
+    with scope_guard(Scope()):
+        exe, comp, loss = _train_setup(policy="skip",
+                                       quantize_collectives=True)
+        with pytest.raises(ValueError, match="quantized shard_map"):
+            exe.run(comp, feed=_feed(np.random.RandomState(0)),
+                    fetch_list=[loss])
+
+
+def test_pipeline_refuses_non_raise_policy():
+    from paddle_tpu.distributed.pipeline_program import pp_stage_guard
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("x", [4], dtype="float32")
+        with pp_stage_guard(0):
+            h = layers.fc(x, size=8, act="relu")
+        with pp_stage_guard(1):
+            y = layers.fc(h, size=3)
+        loss = layers.mean(y)
+        optimizer.SGD(0.1).minimize(loss)
+    bs = BuildStrategy(pp_stages=2)
+    bs.numeric_policy = "skip"
+    comp = CompiledProgram(main, bs)
+    with pytest.raises(ValueError, match="pipeline"):
+        comp.compile_plan()
+
+
+def test_build_strategy_validates_policy_values():
+    with pytest.raises(ValueError, match="numeric_policy"):
+        BuildStrategy(numeric_policy="retry")
+    with pytest.raises(ValueError, match="numeric_skip_budget"):
+        BuildStrategy(numeric_skip_budget=0)
+
+
+# ---------------------------------------------------------------------------
+# SDCDetector unit
+# ---------------------------------------------------------------------------
+
+def test_sdc_detector_flags_persistent_outlier_only():
+    resilience.clear_events()
+    det = resilience.SDCDetector(threshold=6.0, consecutive=3)
+    base = {h: 1.0 + 1e-9 * h for h in range(4)}
+    for _ in range(3):
+        assert det.observe(dict(base)) == []
+    # one wild window on host 2: a blip, not a suspect yet
+    spike = dict(base)
+    spike[2] = 50.0
+    assert det.observe(spike, step=10) == []
+    assert det.observe(dict(base)) == []       # streak broken
+    # persistent deviation: exactly `consecutive` windows flips it
+    assert det.observe(spike, step=20) == []
+    assert det.observe(spike, step=21) == []
+    assert det.observe(spike, step=22) == [2]
+    assert det.suspects() == {2}
+    # flagged ONCE — later windows do not re-flag
+    assert det.observe(spike, step=23) == []
+    ev = resilience.events("sdc_suspect")[-1]
+    assert ev["host_suspect"] == "2" and ev["step"] == 22
+    det.clear(2)
+    assert det.suspects() == set()
+    resilience.clear_events()
+
+
+def test_sdc_detector_nan_norm_is_an_outlier_and_small_pods_pass():
+    det = resilience.SDCDetector(consecutive=1)
+    # fewer than 3 hosts: a median of 2 cannot say who is wrong
+    assert det.observe({0: 1.0, 1: 99.0}) == []
+    assert det.observe({0: 1.0, 1: 1.0, 2: float("nan")}) == [2]
+
+
+def test_sdc_detector_identical_norms_never_trip():
+    det = resilience.SDCDetector(consecutive=1)
+    for _ in range(8):
+        assert det.observe({h: 3.25 for h in range(4)}) == []
+    assert det.suspects() == set()

@@ -848,3 +848,104 @@ def test_py_func_skip_vars_rejected():
             layers.py_func(func=lambda a: a, x=x, out=out,
                            backward_func=lambda a, o, g: g,
                            skip_vars_in_backward_input=[x])
+
+
+@pytest.mark.faultinject
+def test_manifest_write_fault_never_publishes_torn_step(tmp_path):
+    """ISSUE-17 durability proof, driven through the fault plane: kill
+    the save at the ``io.manifest_write`` failpoint — shards on disk,
+    commit record not — and the torn step must be invisible to every
+    reader path. 'latest' still names the previous step (the manifest
+    IS the commit, and it never landed), scrub classifies the dir
+    incomplete, and a pointer-less restore quarantines it instead of
+    trusting it."""
+    import jax.numpy as jnp
+    from paddle_tpu.framework import faultinject, resilience
+    from paddle_tpu.framework.scope import Scope, scope_guard
+    from paddle_tpu.io import load_checkpoint, save_checkpoint, \
+        scrub_checkpoint
+    d = _two_step_ckpt_dir(tmp_path)
+    sc = Scope()
+    with scope_guard(sc):
+        sc.set_var("w_q", jnp.ones(4, jnp.float32) * 3)
+        with faultinject.failpoints(["io.manifest_write:raise"]):
+            with pytest.raises(OSError, match="manifest_write"):
+                save_checkpoint(None, d, step=3)
+            assert faultinject.hits_total()["io.manifest_write"] == 1
+    # torn on disk exactly as the commit order promises: payload bytes
+    # are present, the commit record is not
+    assert os.path.exists(os.path.join(d, "step_3", "shards_p0.npz"))
+    assert not os.path.exists(
+        os.path.join(d, "step_3", "manifest.json"))
+    with open(os.path.join(d, "latest")) as f:
+        assert f.read().strip() == "step_2"   # never advanced
+    report = scrub_checkpoint(d)
+    assert report["steps"][3]["status"] == "incomplete"
+    assert report["valid_steps"] == [1, 2]
+    # restore path 1: the honest pointer means the torn dir is never
+    # even consulted
+    s2 = Scope()
+    with scope_guard(s2):
+        assert load_checkpoint(None, d) == 2
+        np.testing.assert_allclose(np.asarray(s2.find_var("w_q")),
+                                   np.ones(4) * 2)
+    # restore path 2: even with the pointer gone (newest-first scan),
+    # the torn dir is quarantined, not restored from
+    os.unlink(os.path.join(d, "latest"))
+    resilience.clear_events()
+    s3 = Scope()
+    with scope_guard(s3):
+        assert load_checkpoint(None, d) == 2
+        np.testing.assert_allclose(np.asarray(s3.find_var("w_q")),
+                                   np.ones(4) * 2)
+    assert os.path.isdir(os.path.join(d, "step_3.corrupt"))
+    assert not os.path.exists(os.path.join(d, "step_3"))
+    assert resilience.events("ckpt_quarantine")
+
+
+@pytest.mark.faultinject
+def test_member_write_fault_leaves_save_retryable(tmp_path):
+    """A fault at ``io.member_write`` (before any payload byte lands)
+    must leave history untouched and the save cleanly retryable."""
+    import jax.numpy as jnp
+    from paddle_tpu.framework import faultinject
+    from paddle_tpu.framework.scope import Scope, scope_guard
+    from paddle_tpu.io import load_checkpoint, save_checkpoint
+    d = _two_step_ckpt_dir(tmp_path)
+    sc = Scope()
+    with scope_guard(sc):
+        sc.set_var("w_q", jnp.ones(4, jnp.float32) * 3)
+        with faultinject.failpoints(["io.member_write:raise"]):
+            with pytest.raises(OSError, match="member_write"):
+                save_checkpoint(None, d, step=3)
+        assert not os.path.exists(
+            os.path.join(d, "step_3", "manifest.json"))
+        with open(os.path.join(d, "latest")) as f:
+            assert f.read().strip() == "step_2"
+        save_checkpoint(None, d, step=3)    # plain retry, no cleanup
+    s2 = Scope()
+    with scope_guard(s2):
+        assert load_checkpoint(None, d) == 3
+        np.testing.assert_allclose(np.asarray(s2.find_var("w_q")),
+                                   np.ones(4) * 3)
+
+
+def test_checkpoint_commit_fsyncs_payload_and_directory(tmp_path,
+                                                        monkeypatch):
+    """The commit path fsyncs the shard file, the manifest, AND the
+    directory entries — without all three, a power cut after the
+    atomic rename can publish a valid-looking name over torn
+    page-cache payloads (the exact hole ISSUE-17 closes)."""
+    import jax.numpy as jnp
+    from paddle_tpu.framework.scope import Scope, scope_guard
+    from paddle_tpu.io import save_checkpoint
+    real_fsync, fds = os.fsync, []
+    monkeypatch.setattr(
+        os, "fsync", lambda fd: (fds.append(fd), real_fsync(fd))[1])
+    sc = Scope()
+    with scope_guard(sc):
+        sc.set_var("w_q", jnp.ones(4, jnp.float32))
+        save_checkpoint(None, str(tmp_path), step=1)
+    # shard npz + manifest + latest, each followed by its directory
+    # entry: at least 3 file fsyncs and 3 directory fsyncs
+    assert len(fds) >= 6

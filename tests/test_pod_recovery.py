@@ -625,3 +625,86 @@ def test_pod_rejects_mismatched_trainer_config(tmp_path):
         PodResilientTrainer(trainers)
     with pytest.raises(ValueError, match="expects 2 hosts"):
         PodResilientTrainer([trainers[0]], LocalCoordinator(2))
+
+
+# ---------------------------------------------------------------------------
+# ISSUE-17: numeric-fault rewind — pod-wide poison-batch agreement
+# ---------------------------------------------------------------------------
+
+def _numeric_pod(tmp_path, tag, n_hosts=3, policy="rewind"):
+    """Pod whose hosts run a CompiledProgram with a numeric policy:
+    the in-graph finite mask + the trainers' consensus rewind."""
+    main, startup, loss = _toy_program()
+    bs = pt.BuildStrategy()
+    bs.mesh_axes = {"dp": 1}
+    bs.numeric_policy = policy
+    prog = pt.CompiledProgram(main, bs)
+    trainers = []
+    for h in range(n_hosts):
+        sc, exe = Scope(), pt.Executor()
+        with scope_guard(sc):
+            exe.run(startup)
+        trainers.append(ResilientTrainer(
+            exe, prog, str(tmp_path / tag / ("h%d" % h)),
+            fetch_list=[loss], checkpoint_every=3, scope=sc,
+            retry_policy=_fast_policy()))
+    pod = PodResilientTrainer(
+        trainers, LocalCoordinator(n_hosts, timeout_s=POD_TIMEOUT_S))
+    return pod, trainers, loss
+
+
+def test_pod_rewind_skips_poison_batch_bitwise(tmp_path):
+    """THE ISSUE-17 rewind acceptance: a failpoint NaN-poisons ONE
+    host's batch 4 on the wire (executor.step visit 5 of host 1 —
+    checkpoints land every 3 steps, so the fault strikes one step past
+    the step-3 snapshot). numeric_policy="rewind" raises the typed
+    NumericFaultError, the pod agrees the poison batch index in an
+    extra gather round, EVERY host restores to step 3 and replays with
+    batch 4 dispatched to nobody — final params bitwise-identical on
+    every host to a clean pod run on feeds-minus-batch-4, and slot 4
+    is None (skipped, not silently renumbered) in every host's
+    fetches."""
+    from paddle_tpu.framework import faultinject
+
+    feeds = _toy_feeds(9)
+    ref_pod, ref_tr, _ = _numeric_pod(tmp_path, "ref")
+    ref_pod.run([f for i, f in enumerate(feeds) if i != 4])
+    ref_w = _pod_params(ref_tr)
+    resilience.clear_events()
+
+    pod, trainers, _ = _numeric_pod(tmp_path, "chaos")
+    with faultinject.failpoints("executor.step:corrupt=x@5^1"):
+        out = pod.run(feeds)
+
+    # the culprit was LOCALIZED, the batch agreed pod-wide
+    faults = resilience.events("numeric_fault")
+    assert faults and faults[0]["policy"] == "rewind"
+    assert faults[0].get("culprit")
+    poisons = resilience.events("poison_batch")
+    assert {e.get("batch") for e in poisons} == {4}
+    # every host restored from the step-3 snapshot (consensus rewind)
+    assert [e.get("step") for e in
+            resilience.events("pod_restore")] == [3, 3, 3]
+    # the replay skipped batch 4 on EVERY host, not just the victim
+    assert {(e.get("batch"), e.get("host"))
+            for e in resilience.events("poison_skip")} \
+        == {(4, h) for h in range(3)}
+    for h in range(3):
+        assert out[h][4] is None
+        assert all(o is not None
+                   for i, o in enumerate(out[h]) if i != 4)
+        # recovered trajectory == uninterrupted run minus the batch
+        np.testing.assert_array_equal(ref_w[h], _pod_params(trainers)[h])
+
+
+def test_pod_rewind_skip_budget_fault_stays_fatal(tmp_path):
+    """A PERSISTENT numeric fault (every batch poisoned) must not loop
+    the pod forever: each replay re-fires the NaN, the restart budget
+    converts it into the usual hard failure."""
+    from paddle_tpu.framework import faultinject
+
+    pod, trainers, _ = _numeric_pod(tmp_path, "fatal")
+    with faultinject.failpoints("executor.step:corrupt=x"):
+        with pytest.raises(RestartBudgetExceededError,
+                           match="pod restart budget"):
+            pod.run(_toy_feeds(6))

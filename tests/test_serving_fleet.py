@@ -973,6 +973,45 @@ def test_probe_scrape_folds_router_series():
     assert router["router_batch_size_sum"] == 4.0
 
 
+@pytest.mark.faultinject
+def test_probe_scrape_folds_fault_plane_and_strict_gates_on_armed():
+    """ISSUE-17 probe satellite: the fault-plane series fold under one
+    "faults" group, and ``fault_plane_flags`` (the --strict gate) fires
+    on a LIVE armed schedule but not on the fired-counter forensics a
+    finished drill leaves behind."""
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    try:
+        import serving_probe
+    finally:
+        sys.path.pop(0)
+    from paddle_tpu.framework import faultinject
+    faultinject.arm(["transport.send:drop@1"])
+    try:
+        assert faultinject.hit("transport.send") is faultinject.DROP
+        resilience.record_event("numeric_fault", policy="skip",
+                                culprit="loss")
+        with resilience.serve_metrics(port=0) as server:
+            got = serving_probe.scrape_metrics(server.url)
+        faults = got["faults"]
+        assert faults["failpoint_hits_total/site:transport.send"] == 1.0
+        assert faults["faultinject_armed"] == 1.0
+        assert faults["numeric_fault_total/skip/loss"] == 1.0
+        flags = serving_probe.fault_plane_flags(got)
+        assert flags and "disarm the fault plane" in flags[0]
+    finally:
+        faultinject.disarm()
+    # drill over: hit counters stay behind for forensics, the armed
+    # gauge drops to 0, and the probe stops flagging — fired history
+    # alone is never fatal
+    with resilience.serve_metrics(port=0) as server:
+        got2 = serving_probe.scrape_metrics(server.url)
+    assert got2["faults"]["failpoint_hits_total/site:transport.send"] \
+        == 1.0
+    assert got2["faults"]["faultinject_armed"] == 0.0
+    assert serving_probe.fault_plane_flags(got2) == []
+
+
 def test_router_host_id_and_validation():
     assert router_host_id(3) == 3
     with pytest.raises(ValueError, match="replica_id"):

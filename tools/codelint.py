@@ -18,7 +18,13 @@ lock, which is exactly the interleaving bug the lock was meant to
 prevent. The lock must be stored (module global, ``self._lock``, a
 closure var shared with the threads) before it can serialize anything.
 
-Both rules run as a tier-1 test (tests/test_codelint.py) so the bug
+Rule 3 — **failpoint site catalog** (the fault-injection plane's typo
+guard): every ``faultinject.hit("...")`` call site must name its site
+as a string LITERAL that appears in ``framework/faultinject.py``'s
+``SITES`` catalog. A typo'd or uncatalogued site string would parse,
+arm, and then silently never fire — a chaos test that tests nothing.
+
+All rules run as a tier-1 test (tests/test_codelint.py) so the bug
 classes stay extinct. Exit 0 clean, 1 violations.
 
 Usage:
@@ -248,9 +254,77 @@ def lint_free_floating_locks(root=None, paths=None):
     return violations
 
 
+FAULTINJECT_PY = os.path.join(REPO, "paddle_tpu", "framework",
+                              "faultinject.py")
+# module aliases a hit() call may hang off; anything else (a local
+# helper also named hit, a mock) is not this plane's call
+_FAULTINJECT_ALIASES = {"faultinject", "fi"}
+
+
+def _site_catalog(src=None):
+    """The SITES keys from faultinject.py — parsed from the AST so the
+    lint never imports (and thereby arms) the plane it checks."""
+    if src is None:
+        with open(FAULTINJECT_PY) as f:
+            src = f.read()
+    for node in ast.walk(ast.parse(src)):
+        if isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Dict):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "SITES":
+                    return {k.value for k in node.value.keys
+                            if isinstance(k, ast.Constant)
+                            and isinstance(k.value, str)}
+    raise ValueError("SITES catalog not found in faultinject.py")
+
+
+def lint_failpoint_sites(root=None, paths=None, catalog=None):
+    """Rule 3. Returns a list of violation strings (empty = clean)."""
+    catalog = _site_catalog() if catalog is None else set(catalog)
+    if paths is None:
+        root = root or REPO
+        paths = []
+        for base in ("paddle_tpu", "tools"):
+            for dirpath, _, files in os.walk(os.path.join(root, base)):
+                paths.extend(os.path.join(dirpath, f) for f in files
+                             if f.endswith(".py"))
+    violations = []
+    for path in sorted(paths):
+        with open(path) as f:
+            src = f.read()
+        try:
+            tree = ast.parse(src)
+        except SyntaxError as e:
+            violations.append("%s: unparseable: %s" % (path, e))
+            continue
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "hit"
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id in _FAULTINJECT_ALIASES):
+                continue
+            a0 = node.args[0] if node.args else None
+            if not (isinstance(a0, ast.Constant)
+                    and isinstance(a0.value, str)):
+                violations.append(
+                    "%s:%d: faultinject.hit() site must be a string "
+                    "literal from the SITES catalog — a computed site "
+                    "name defeats the static typo guard"
+                    % (path, node.lineno))
+            elif a0.value not in catalog:
+                violations.append(
+                    "%s:%d: faultinject.hit(%r) names a site missing "
+                    "from framework/faultinject.py's SITES catalog — "
+                    "it would arm and then silently never fire"
+                    % (path, node.lineno, a0.value))
+    return violations
+
+
 def run_all():
     return {"cache_token": lint_cache_token(),
-            "free_floating_locks": lint_free_floating_locks()}
+            "free_floating_locks": lint_free_floating_locks(),
+            "failpoint_sites": lint_failpoint_sites()}
 
 
 def main(argv=None):

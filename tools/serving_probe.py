@@ -85,10 +85,15 @@ def scrape_metrics(url, timeout_s=5.0):
     series (the ``executor_step_seconds{kind=}`` step-phase histogram
     samples and ``trace_spans_dropped_total`` — nonzero means the
     span ring overflowed and any merged timeline is missing spans)
-    and a "bytes" section with the compressed-movement raw-vs-wire
+    a "bytes" section with the compressed-movement raw-vs-wire
     pairs (collective/stateship/ckpt _bytes_total{kind=}) when the
-    replica exports any — or raises (caller folds failures into the
-    health report)."""
+    replica exports any, and a "faults" section with the fault-plane
+    series (failpoint_hits_total{site=}, the faultinject_armed gauge
+    and numeric_fault_total{policy=,culprit=}) — ``--strict`` FAILS
+    the probe when the armed gauge is nonzero, because live failpoint
+    schedules in a production replica mean requests will be failed on
+    purpose — or raises (caller folds failures into the health
+    report)."""
     import urllib.request
     from paddle_tpu.framework.resilience import (METRIC_PREFIX,
                                                  parse_metrics_text)
@@ -96,8 +101,24 @@ def scrape_metrics(url, timeout_s=5.0):
         text = resp.read().decode("utf-8")
     samples = parse_metrics_text(text)
     events, feed, transport, router, bytes_sec = {}, {}, {}, {}, {}
-    obs_sec, qos = {}, {}
+    obs_sec, qos, faults = {}, {}, {}
     for name, labels, value in samples:
+        if name.startswith(METRIC_PREFIX + "_failpoint_") \
+                or name.startswith(METRIC_PREFIX + "_faultinject_") \
+                or name.startswith(METRIC_PREFIX + "_numeric_fault_"):
+            # the fault plane folds under one "faults" group: the
+            # failpoint fired-hit counters by site, the armed gauge
+            # (nonzero = live failpoints — production poison) and the
+            # numeric-fault counters by (policy, culprit)
+            key = name[len(METRIC_PREFIX) + 1:]
+            if "site" in labels:
+                key += "/site:" + labels["site"]
+            if "policy" in labels:
+                key += "/" + labels["policy"]
+            if "culprit" in labels:
+                key += "/" + labels["culprit"]
+            faults[key] = value
+            continue
         if name == METRIC_PREFIX + "_events_total":
             key = labels.get("kind", "?")
             if "host" in labels:
@@ -169,6 +190,8 @@ def scrape_metrics(url, timeout_s=5.0):
         out["qos"] = qos
     if bytes_sec:
         out["bytes"] = bytes_sec
+    if faults:
+        out["faults"] = faults
     return out
 
 
@@ -249,6 +272,22 @@ def term_regression_flags(summary):
     return flags
 
 
+def fault_plane_flags(summary):
+    """Fault-plane poison in a scrape summary (empty = healthy): a
+    nonzero ``faultinject_armed`` gauge means live failpoint schedules
+    are armed in the scraped process — chaos-drill instrumentation
+    that has NO business in a production replica (the next matching
+    request will be failed on purpose). Fired-hit counters alone are
+    only reported, not fatal: a drill that was since disarmed leaves
+    its counters behind. ``--strict`` fails the probe on armed."""
+    armed = summary.get("faults", {}).get("faultinject_armed", 0)
+    if armed:
+        return ["failpoints armed in the scraped process "
+                "(faultinject_armed=%g): disarm the fault plane before "
+                "serving production traffic" % armed]
+    return []
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("dirname", help="artifact dir (holds serving/)")
@@ -265,8 +304,10 @@ def main(argv=None):
                          "any term regression (stale-primary symptom) "
                          "in the transport series, span-ring "
                          "overflow (trace_spans_dropped_total > 0) in "
-                         "the obs series, or tenant-vs-aggregate "
-                         "quota-accounting drift in the qos series")
+                         "the obs series, tenant-vs-aggregate "
+                         "quota-accounting drift in the qos series, or "
+                         "armed failpoints (faultinject_armed > 0) in "
+                         "the faults series")
     ap.add_argument("--metrics-url", default=None,
                     help="scrape a resilience.serve_metrics endpoint and "
                          "fold the event totals into the report")
@@ -301,6 +342,13 @@ def main(argv=None):
                 # per-class SLO numbers cannot be trusted — loud
                 # always, fatal under --strict
                 health["qos_drift"] = qflags
+                metrics_ok = False
+            fflags = fault_plane_flags(health["metrics"])
+            if fflags:
+                # armed failpoints in a production scrape: requests
+                # WILL be failed on purpose — loud always, fatal
+                # under --strict
+                health["faults_armed"] = fflags
                 metrics_ok = False
         except Exception as e:
             # a loadable replica with a dead metrics endpoint is still
