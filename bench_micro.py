@@ -183,6 +183,13 @@ BUDGETS = {
     "pp_step_s": ("max", 30.0),
     "pp_bubble_frac": ("max", 0.95),
     "pp_cache_hit_rate": ("min", 0.4),
+    # Elastic pp re-cut (ISSUE 18): the full outage of a host-loss
+    # re-cut on the in-process pp=2 pod — decision commit through the
+    # first completed post-re-cut step, which includes compiling the
+    # re-cut executable. Sized like pp_step_s for shared-CI CPU boxes:
+    # it catches the re-cut path growing a second re-lowering or a
+    # full-state rewrite, not scheduler jitter.
+    "pp_recut_ms": ("max", 30000.0),
     # Program verifier (ISSUE 15): one strict walk over the BERT-base
     # pretrain program must stay interactive (it is pure Python, no
     # tracing), and on the shared small step it must cost well under
@@ -940,6 +947,89 @@ def bench_pipeline(steps=4):
     return out
 
 
+def bench_pp_recut(n_steps=8):
+    """Elastic pp re-cut wall (ISSUE-18): an in-process 3-host
+    pp=2 x dp=4 pod loses one host mid-run, the survivors re-stack both
+    stages onto one slot, and pp_recut_ms is the wall from the re-cut
+    decision committing (the start of the re-lowering) to the FIRST
+    completed post-re-cut training step — i.e. re-lower + state
+    re-placement + the re-cut executable's compile, the whole outage
+    the elastic path trades against a consensus rewind."""
+    import tempfile
+
+    import numpy as np
+    import paddle_tpu as pt
+    from paddle_tpu import layers, optimizer
+    from paddle_tpu.distributed.pipeline_program import pp_stage_guard
+    from paddle_tpu.framework import resilience
+    from paddle_tpu.framework.compiler import CompiledProgram, \
+        BuildStrategy
+    from paddle_tpu.framework.coordination import (ElasticTrainer,
+                                                   LocalCoordinator)
+    from paddle_tpu.framework.resilience import (ResilientTrainer,
+                                                 RetryPolicy)
+    from paddle_tpu.framework.scope import Scope, scope_guard
+
+    dm, batch = 16, 16
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("br_x", [batch, dm], "float32",
+                        append_batch_size=False)
+        h = x
+        for i in range(4):
+            with pp_stage_guard(i // 2):
+                h = layers.fc(h, size=dm, act="tanh")
+        y = layers.data("br_y", [batch, dm], "float32",
+                        append_batch_size=False)
+        loss = layers.reduce_mean(layers.square(h - y))
+        optimizer.SGD(0.2).minimize(loss)
+    rng = np.random.RandomState(3)
+    feeds = [{"br_x": rng.randn(batch, dm).astype(np.float32),
+              "br_y": rng.randn(batch, dm).astype(np.float32)}
+             for _ in range(n_steps)]
+    root = tempfile.mkdtemp(prefix="bench_pp_recut_")
+    resilience.install(None)
+    resilience.clear_events()
+    trainers, walls = [], []
+    for hid in range(3):
+        sc, exe = Scope(), pt.Executor()
+        with scope_guard(sc):
+            exe.run(startup)
+        bs = BuildStrategy(pp_stages=2, pp_micro_batches=4)
+        bs.mesh_axes = {"pp": 2, "dp": 4}
+        t = ResilientTrainer(
+            exe, CompiledProgram(main, bs),
+            os.path.join(root, "h%d" % hid), fetch_list=[loss],
+            checkpoint_every=2, scope=sc,
+            retry_policy=RetryPolicy(base_delay_s=0.0, jitter=0.0,
+                                     sleep=lambda s: None))
+        def timed(*a, _orig=t._dispatch_batches, **kw):
+            out = _orig(*a, **kw)
+            walls.append(time.time())
+            return out
+
+        t._dispatch_batches = timed
+        trainers.append(t)
+    pod = ElasticTrainer(trainers, LocalCoordinator(3, timeout_s=300.0),
+                         rejoin=False)
+    with resilience.inject("step:die@%d" % (n_steps + 2)):
+        pod.run(feeds)
+    recuts = resilience.events("elastic_pp_recut")
+    out = {}
+    if recuts:
+        # decision commit = event stamp minus the re-lowering latency
+        # it reports; first post-re-cut step = first dispatch wall
+        # after the LAST survivor finished re-cutting
+        t_start = min(e["time"] - e["latency_s"] for e in recuts)
+        t_done = max(e["time"] for e in recuts)
+        post = [w for w in walls if w > t_done]
+        if post:
+            out["pp_recut_ms"] = round((min(post) - t_start) * 1e3, 3)
+            out["pp_recut_resharded"] = int(recuts[0]["resharded"])
+    resilience.clear_events()
+    return out
+
+
 def bench_obs(steps=11, requests=21):
     """Tracing-overhead gate (the obs spans tentpole): the exact same
     dp-sharded executor step and router /infer request measured
@@ -1286,6 +1376,7 @@ def run_all(rounds_dir=None):
                      ("pallas", bench_pallas),
                      ("costmodel", bench_costmodel),
                      ("pipeline", bench_pipeline),
+                     ("pp_recut", bench_pp_recut),
                      ("transport", bench_transport),
                      ("failover", bench_failover),
                      ("serving", bench_serving),

@@ -564,6 +564,145 @@ def _auto_stamp(program, fwd_ops, n_stage, loss_name, schedule, n_micro):
         % (n, n_stage, last_err))
 
 
+# ---------------------------------------------------------------------------
+# Elastic pp re-cut (ISSUE 18): stage -> slot re-mapping over a shrunk mesh.
+# When a pp pod loses a host but the survivors can still hold every logical
+# stage, the K stages are RE-STACKED over n_slots < K mesh slots — each slot
+# runs a contiguous run of logical stages as one "super-stage" on the same
+# GPipe/1F1B ring (ring size n_slots). The scope keeps the flat per-stage
+# var layout, so checkpoints and elastic state-shipping stay wire-compatible;
+# only the in-jit stacking geometry changes: (K, ...) -> (n_slots, k_per, ...).
+# ---------------------------------------------------------------------------
+
+class PPRecutError(ValueError):
+    """A re-cut that cannot be built. ``reason`` is the typed label the
+    elastic fallback stamps on its ``elastic_pp_rewind`` event so an
+    operator can tell a policy refusal from a genuine infeasibility."""
+    reason = "infeasible_slots"
+
+
+class PPRecutInfeasibleError(PPRecutError):
+    reason = "infeasible_slots"
+
+
+class PPRecutHeterogeneousError(PPRecutError):
+    reason = "heterogeneous_stages"
+
+
+def recut_min_slots(k_stages):
+    """The feasibility floor: K logical stages re-cut onto no fewer than
+    ceil(K/2) slots (at most two stages per slot keeps the super-stage
+    compute/stash growth bounded — the K-1..ceil(K/2) contract)."""
+    return (int(k_stages) + 1) // 2
+
+
+class RecutPlan(object):
+    """A stage->slot re-mapping: K logical stages over n_slots mesh slots.
+
+      counts[j]        -- logical stages resident in slot j (contiguous,
+                          larger counts first, every slot non-empty; the
+                          LAST logical stage always lands in the LAST
+                          slot, so the schedules' is-last masking and the
+                          loss seed work unchanged with ring size n_slots)
+      starts[j]        -- first logical stage of slot j
+      slot_of[s]       -- the slot logical stage s lives in
+      k_per            -- max(counts): the stacked row count per slot
+      stage_idx[j][i]  -- the logical stage stored at stacked row (j, i);
+                          pad rows (i >= counts[j]) repeat the slot's last
+                          real stage so the padded compute is numerically
+                          benign — its output is discarded by the valid
+                          mask and it is never written back to the scope
+      valid[j][i]      -- True for real rows, False for pads
+    """
+
+    __slots__ = ("k_stages", "n_slots", "counts", "starts", "slot_of",
+                 "k_per", "stage_idx", "valid")
+
+    def __init__(self, k_stages, n_slots, counts, starts, slot_of, k_per,
+                 stage_idx, valid):
+        self.k_stages = k_stages
+        self.n_slots = n_slots
+        self.counts = counts
+        self.starts = starts
+        self.slot_of = slot_of
+        self.k_per = k_per
+        self.stage_idx = stage_idx
+        self.valid = valid
+
+    def signature(self):
+        """Re-cut identity for the executor compile-cache token."""
+        return (self.k_stages, self.n_slots, self.counts)
+
+
+def recut_plan(k_stages, n_slots, stage_signatures=None):
+    """Build the stage->slot re-mapping for K stages over n_slots slots.
+
+    Balanced CONTIGUOUS partition, larger counts first: (3, 2) -> [2, 1],
+    (4, 3) -> [2, 1, 1]. Raises the typed :class:`PPRecutError` family on
+    an impossible request: n_slots < 1 or n_slots > k_stages
+    (PPRecutInfeasibleError), or — when per-stage structural signatures
+    are supplied — stages that are not structurally identical
+    (PPRecutHeterogeneousError: a super-stage can only iterate one
+    template)."""
+    k, n = int(k_stages), int(n_slots)
+    if k < 1:
+        raise PPRecutInfeasibleError(
+            "re-cut needs at least one logical stage; got k_stages=%d" % k)
+    if n < 1:
+        raise PPRecutInfeasibleError(
+            "re-cut infeasible: %d pipeline stages cannot be re-stacked "
+            "over %d mesh slots (need 1..%d)" % (k, n, k))
+    if n > k:
+        raise PPRecutInfeasibleError(
+            "re-cut infeasible: %d slots exceed the %d logical stages — "
+            "a slot cannot be empty (grow back to the 1-stage-per-slot "
+            "plan instead)" % (n, k))
+    if stage_signatures is not None:
+        sigs = list(stage_signatures)
+        if any(s != sigs[0] for s in sigs[1:]):
+            raise PPRecutHeterogeneousError(
+                "re-cut infeasible: pipeline stages are not structurally "
+                "identical — the slot super-stage iterates ONE stage "
+                "template over its resident stages")
+    counts = tuple(k // n + (1 if j < k % n else 0) for j in range(n))
+    starts, acc = [], 0
+    for c in counts:
+        starts.append(acc)
+        acc += c
+    starts = tuple(starts)
+    slot_of = tuple(j for j, c in enumerate(counts) for _ in range(c))
+    k_per = max(counts)
+    stage_idx = tuple(
+        tuple(starts[j] + min(i, counts[j] - 1) for i in range(k_per))
+        for j in range(n))
+    valid = tuple(tuple(i < counts[j] for i in range(k_per))
+                  for j in range(n))
+    return RecutPlan(k_stages=k, n_slots=n, counts=counts, starts=starts,
+                     slot_of=slot_of, k_per=k_per, stage_idx=stage_idx,
+                     valid=valid)
+
+
+def make_slot_stage_fn(stage_fn, recut, axis_name="pp"):
+    """Wrap a per-stage callable into the per-SLOT super-stage the
+    re-cut ring runs: ``slot_fn({template_name: (k_per, ...)}, h) ->
+    h_out`` iterates the slot's resident logical stages in chain order.
+    Pad rows repeat the slot's last real stage (see RecutPlan), so their
+    forward is well-conditioned; the valid mask discards their output
+    and — through jnp.where's vjp — zeroes their gradient rows."""
+    valid = np.asarray(recut.valid, bool)          # (n_slots, k_per)
+
+    def slot_fn(params_me, h):
+        slot = jax.lax.axis_index(axis_name)
+        row_valid = jax.lax.dynamic_index_in_dim(
+            jnp.asarray(valid), slot, 0, keepdims=False)
+        for i in range(recut.k_per):
+            p_i = {t: v[i] for t, v in params_me.items()}
+            h = jnp.where(row_valid[i], stage_fn(p_i, h), h)
+        return h
+
+    return slot_fn
+
+
 def make_update_trace_fn(program, cut):
     """The in-shard_map update-section runner: ``update(env)`` traces the
     stage-0 template + shared update ops IN PROGRAM ORDER over an env

@@ -632,7 +632,21 @@ def _pass_pipeline(ctx):
             hint="pick a supported pipeline schedule")
     mesh_pp = int((ctx.mesh_axes or {}).get("pp", 0) or 0)
     bs_k = ctx.bs_attr("pp_stages")
-    if bs_k and mesh_pp and int(bs_k) != mesh_pp:
+    recut_n = int(ctx.bs_attr("pp_recut_slots") or 0)
+    if recut_n:
+        # elastic re-cut armed: the mesh pp axis counts SLOTS, each
+        # holding >= 1 logical stages; feasibility is the ceil(K/2) bound
+        if mesh_pp and recut_n != mesh_pp:
+            err("pp_recut_slots=%d does not match the mesh's pp axis "
+                "(%d)" % (recut_n, mesh_pp),
+                hint="the re-cut mesh carries one slot per surviving "
+                     "pp rank")
+        if bs_k and recut_n > int(bs_k):
+            err("pp_recut_slots=%d exceeds pp_stages=%d — a re-cut "
+                "slot cannot be empty" % (recut_n, int(bs_k)),
+                hint="clear pp_recut_slots to grow back to the "
+                     "1-stage-per-slot plan")
+    elif bs_k and mesh_pp and int(bs_k) != mesh_pp:
         err("pp_stages=%d does not match the mesh's pp axis (%d)"
             % (int(bs_k), mesh_pp),
             hint="make BuildStrategy.pp_stages agree with mesh_axes")

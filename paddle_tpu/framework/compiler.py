@@ -69,7 +69,8 @@ def verify_for_compile(program, build_strategy=None, feeds=None,
                      getattr(bs, "quantize_collectives", False),
                      getattr(bs, "pp_stages", None),
                      getattr(bs, "pp_micro_batches", 1),
-                     getattr(bs, "pp_schedule", "1f1b"))
+                     getattr(bs, "pp_schedule", "1f1b"),
+                     getattr(bs, "pp_recut_slots", None))
     key = (program._version, mode,
            None if mesh is None else tuple(sorted(mesh.items())),
            strat_sig, feed_sig,
@@ -229,6 +230,15 @@ class BuildStrategy(object):
         self.pp_stages = None
         self.pp_micro_batches = 1
         self.pp_schedule = "1f1b"
+        # Elastic pp re-cut (ISSUE 18): n_slots < pp_stages re-stacks the
+        # K logical stages over n_slots mesh slots (multiple stages per
+        # slot, (n_slots, k_per, ...) stacked state INSIDE the jit; the
+        # scope keeps the flat per-stage layout, so checkpoints/elastic
+        # state-shipping stay wire-compatible). The mesh's "pp" axis must
+        # equal pp_recut_slots while armed. ElasticTrainer arms this on a
+        # survivable pp host loss and clears it on re-grow; joins the
+        # compile-cache token — a re-cut re-lowers, repeats hit.
+        self.pp_recut_slots = None
         # Program IR verification at CompilePlan build time
         # (framework/analysis.py): "strict" fails the compile on any
         # error-severity diagnostic (ALL violations listed, not
@@ -271,6 +281,14 @@ class BuildStrategy(object):
                 "got %r" % (self.numeric_policy,))
         if int(self.numeric_skip_budget) < 1:
             raise ValueError("numeric_skip_budget must be >= 1")
+        if self.pp_recut_slots is not None:
+            if int(self.pp_recut_slots) < 1:
+                raise ValueError("pp_recut_slots must be >= 1 (a re-cut "
+                                 "keeps every logical stage resident)")
+            if not self.pp_stages:
+                raise ValueError(
+                    "pp_recut_slots needs pp_stages: the re-cut maps K "
+                    "logical stages (pp_stages) onto n_slots mesh slots")
 
 
 class ExecutionStrategy(object):
@@ -293,18 +311,25 @@ class CompilePlan(object):
       cut       -- distributed.pipeline_program.CompiledPPCut (pipeline)
       schedule  -- "1f1b" | "gpipe" (pipeline)
       n_micro   -- microbatches per step (pipeline)
+      recut     -- distributed.pipeline_program.RecutPlan when the
+                   elastic re-cut is armed (K stages over n_slots < K
+                   mesh slots), else None
     """
 
-    __slots__ = ("kind", "token", "cut", "schedule", "n_micro")
+    __slots__ = ("kind", "token", "cut", "schedule", "n_micro", "recut")
 
-    def __init__(self, kind, token, cut=None, schedule=None, n_micro=1):
+    def __init__(self, kind, token, cut=None, schedule=None, n_micro=1,
+                 recut=None):
         self.kind = kind
         self.cut = cut
         self.schedule = schedule
         self.n_micro = int(n_micro)
+        self.recut = recut
         # the cut signature joins the token: two programs whose strategy
         # knobs agree but whose cuts differ must not share an executable
         self.token = token if cut is None else token + (cut.signature(),)
+        if recut is not None:
+            self.token = self.token + (recut.signature(),)
 
 
 def make_mesh(mesh_axes, devices=None):
@@ -458,7 +483,11 @@ class CompiledProgram(object):
                 # re-lower, never reuse a single-jit executable
                 (getattr(bs, "pp_stages", None),
                  int(getattr(bs, "pp_micro_batches", 1) or 1),
-                 getattr(bs, "pp_schedule", "1f1b")),
+                 getattr(bs, "pp_schedule", "1f1b"),
+                 # the elastic re-cut slot map selects a different
+                 # stacking geometry + ring size: arming/clearing it
+                 # must re-lower, repeats at the same slot count hit
+                 getattr(bs, "pp_recut_slots", None)),
                 # numeric_policy changes the lowered step (per-var
                 # finite mask, in-graph skip select) — "skip" and
                 # "raise" must never share an executable
@@ -505,8 +534,13 @@ class CompiledProgram(object):
                 "check_numerics" % (bs.numeric_policy,))
         axes = dict(bs.mesh_axes or {})
         k = int(bs.pp_stages) if getattr(bs, "pp_stages", None) else None
+        recut_n = getattr(bs, "pp_recut_slots", None)
+        recut_n = int(recut_n) if recut_n else None
+        # with the elastic re-cut armed the mesh's pp axis counts SLOTS
+        # (one per surviving pp rank), not logical stages
+        ring = recut_n if recut_n is not None else k
         if "pp" not in axes:
-            if k is None:
+            if ring is None:
                 raise ValueError("pipeline strategy needs pp_stages or a "
                                  "'pp' mesh axis")
             # first-class default: pp x dp over all devices
@@ -515,14 +549,20 @@ class CompiledProgram(object):
                 raise ValueError(
                     "mesh_axes %r has no 'pp' axis but pp_stages=%d is "
                     "set — include pp in the mesh (e.g. {'pp': %d, "
-                    "'dp': %d})" % (axes, k, k, max(1, n_dev // k)))
-            axes = {"pp": k, "dp": max(1, n_dev // k)}
+                    "'dp': %d})" % (axes, k, ring, max(1, n_dev // ring)))
+            axes = {"pp": ring, "dp": max(1, n_dev // ring)}
             bs.mesh_axes = dict(axes)
-        if k is not None and int(axes["pp"]) != k:
+        if ring is not None and int(axes["pp"]) != ring:
+            if recut_n is not None:
+                raise ValueError(
+                    "pp_recut_slots=%d does not match the mesh's pp axis "
+                    "(%d) — the re-cut mesh carries one slot per "
+                    "surviving pp rank" % (recut_n, int(axes["pp"])))
             raise ValueError(
                 "pp_stages=%d does not match the mesh's pp axis (%d)"
                 % (k, int(axes["pp"])))
-        k = int(axes["pp"])
+        if k is None:
+            k = int(axes["pp"])
         schedule = getattr(bs, "pp_schedule", "1f1b")
         n_micro = int(getattr(bs, "pp_micro_batches", 1) or 1)
         cache = getattr(self._program, "_pp_cut_cache", None)
@@ -537,8 +577,14 @@ class CompiledProgram(object):
             # bumps it once
             self._program._pp_cut_cache = (
                 (self._program._version,) + ck, cut)
+        # identity re-cut (n_slots == K) lowers through the ordinary
+        # 1-stage-per-slot path; n_slots > K raises the typed
+        # PPRecutInfeasibleError from recut_plan
+        rplan = ppp.recut_plan(k, recut_n) \
+            if recut_n is not None and recut_n != k else None
         return CompilePlan("pipeline", self._cache_token(),
-                           cut=cut, schedule=schedule, n_micro=n_micro)
+                           cut=cut, schedule=schedule, n_micro=n_micro,
+                           recut=rplan)
 
     def _mesh_obj(self):
         if self._mesh is None:
@@ -784,7 +830,18 @@ class CompiledProgram(object):
         cut = cplan.cut
         plan = cut.plan
         n_stage = plan.n_stage
-        if int(mesh.shape.get("pp", 0)) != n_stage:
+        rec = cplan.recut
+        # with the elastic re-cut armed the ring runs over n_slots SLOTS
+        # (each a super-stage iterating its resident logical stages);
+        # otherwise one slot per stage, ring size n_stage
+        n_ring = rec.n_slots if rec is not None else n_stage
+        if int(mesh.shape.get("pp", 0)) != n_ring:
+            if rec is not None:
+                raise ValueError(
+                    "re-cut plan stacks %d pipeline stages over %d slots "
+                    "but the mesh 'pp' axis has %d devices — they must "
+                    "match" % (n_stage, n_ring,
+                               int(mesh.shape.get("pp", 0))))
             raise ValueError(
                 "program cuts into %d pipeline stages but the mesh 'pp' "
                 "axis has %d devices — they must match"
@@ -812,6 +869,11 @@ class CompiledProgram(object):
                 % (unknown,))
 
         stage_fn = ppp.make_stage_fn(program, plan)
+        if rec is not None:
+            # the ring body sees ONE callable per slot; the wrapper
+            # iterates the slot's resident stages over its (k_per, ...)
+            # rows of the stacked state
+            stage_fn = ppp.make_slot_stage_fn(stage_fn, rec, "pp")
         loss_fn = ppp.make_loss_fn(program, plan)
         tail_fn = ppp.make_tail_fn(program, plan, tuple(aux_names)) \
             if aux_names else None
@@ -825,14 +887,14 @@ class CompiledProgram(object):
         from .trace import GRAD_SUFFIX
 
         if cplan.schedule == "1f1b":
-            sched = pipeline_1f1b_local(stage_fn, loss_fn, n_stage,
+            sched = pipeline_1f1b_local(stage_fn, loss_fn, n_ring,
                                         n_micro, "pp", dp_axis)
         elif cplan.schedule == "gpipe":
-            sched = pipeline_gpipe_local(stage_fn, loss_fn, n_stage,
+            sched = pipeline_gpipe_local(stage_fn, loss_fn, n_ring,
                                          n_micro, "pp", dp_axis)
         else:
             raise ValueError("unknown pp_schedule %r" % cplan.schedule)
-        fwd = pipeline_forward_local(stage_fn, n_stage, n_micro, "pp",
+        fwd = pipeline_forward_local(stage_fn, n_ring, n_micro, "pp",
                                      dp_axis) if tail_fn else None
 
         def _unmicro(a):
@@ -945,16 +1007,40 @@ class CompiledProgram(object):
                     out, v.astype(out.dtype), i, 0)
             return out
 
+        def _dstack_recut(vals):
+            # re-cut geometry: (n_slots, k_per, ...) with row (j, i)
+            # holding logical stage rec.stage_idx[j][i] (pads repeat the
+            # slot's last real stage — never read back). Same
+            # dynamic_update lowering as _dstack for the same
+            # partitioner reason.
+            shape = tuple(vals[0].shape)
+            dt = jnp.result_type(vals[0])
+            out = jnp.zeros((rec.n_slots, rec.k_per) + shape, dt)
+            for j in range(rec.n_slots):
+                for i in range(rec.k_per):
+                    v = vals[rec.stage_idx[j][i]].astype(dt)
+                    out = jax.lax.dynamic_update_slice(
+                        out, v[None, None], (j, i) + (0,) * len(shape))
+            return out
+
+        stack_vals = _dstack if rec is None else _dstack_recut
+
         def _stack_in(state_tuple):
             stacked = tuple(
-                _dstack(state_tuple[i * n_stage:(i + 1) * n_stage])
+                stack_vals(state_tuple[i * n_stage:(i + 1) * n_stage])
                 for i in range(n_stacked))
             return stacked + tuple(state_tuple[n_stacked * n_stage:])
 
         def _unstack_out(new_state):
             out = []
             for arr in new_state[:n_stacked]:
-                out.extend(arr[s] for s in range(n_stage))
+                if rec is None:
+                    out.extend(arr[s] for s in range(n_stage))
+                else:
+                    out.extend(
+                        arr[rec.slot_of[s],
+                            s - rec.starts[rec.slot_of[s]]]
+                        for s in range(n_stage))
             out.extend(new_state[n_stacked:])
             return tuple(out)
 

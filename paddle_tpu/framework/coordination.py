@@ -1637,11 +1637,20 @@ class ElasticTrainer(PodResilientTrainer):
                  lr_rescale_hook=None, drain_after=None,
                  ship_compress="zlib", drain_floor=None,
                  drain_cooldown=None, drain_hb_lag_s=None,
-                 drain_stream_lag=None, sdc_detect=None):
+                 drain_stream_lag=None, sdc_detect=None,
+                 pp_recut=True):
         super(ElasticTrainer, self).__init__(
             trainers, coordinator=coordinator, max_restarts=max_restarts,
             host_id=host_id)
         self._rejoin = bool(rejoin)
+        # pp_recut=True (default): a host loss on a >1 pp mesh re-cuts
+        # the K logical stages over the surviving slots (multiple
+        # stages per slot — distributed/pipeline_program.recut_plan)
+        # instead of taking the consensus rewind, whenever the
+        # survivors can still hold every stage. False restores the
+        # PR 10 behavior: every pp host loss rewinds on the unchanged
+        # mesh (elastic_pp_rewind carries reason="disabled").
+        self._pp_recut = bool(pp_recut)
         self._sync_dir = sync_dir
         # ship_compress: codec for the rejoin state ship (ops/quant_ops
         # host codec in the threaded simulation, io.save_checkpoint
@@ -1960,9 +1969,58 @@ class ElasticTrainer(PodResilientTrainer):
     @staticmethod
     def _pp_axes(axes):
         """True when the trainer's FULL topology carries a >1 pipeline
-        axis — stage state is stacked on pp and never re-shards; host
-        loss takes the consensus-rewind path instead."""
+        axis — stage state is stacked on pp; host loss either RE-CUTS
+        the stages over the surviving slots (pp_recut=True and
+        feasible) or takes the consensus-rewind path."""
         return bool(axes) and int(axes.get("pp") or 1) > 1
+
+    def _pp_stage_signatures(self, trainer):
+        """Per-stage structural signatures of the trainer's stamped
+        forward ops (None when unstamped — the auto-cut already proved
+        homogeneity). Fed to recut_plan so a heterogeneous cut is a
+        TYPED refusal (reason=heterogeneous_stages), never a broken
+        super-stage."""
+        from ..distributed import pipeline_program as ppp
+        strategy = self._target_strategy(trainer)
+        if strategy is None:
+            return None
+        staged = {}
+        for op in strategy._program.global_block().ops:
+            s = op.attrs.get("pp_stage")
+            if s is not None:
+                staged.setdefault(int(s), []).append(op)
+        if not staged:
+            return None
+        return [ppp._stage_signature(staged[s]) for s in sorted(staged)]
+
+    def _pp_recut_decision(self, trainer, base_axes, n_live):
+        """(n_slots, reason) for a pp host loss at the frozen live
+        count: the slot count a re-cut would target, or None with the
+        typed reason the pod must rewind instead (disabled |
+        infeasible_slots | heterogeneous_stages). Deterministic in
+        (base_axes, n_live), so every host that gathered the same
+        frozen verdicts decides the same way."""
+        from ..distributed import pipeline_program as ppp
+        if not self._pp_recut:
+            return None, "disabled"
+        k = int(base_axes.get("pp") or 1)
+        n_total = self._coordinator.n_hosts
+        # slots scale with capacity like the dp axis does — and a host
+        # loss must shrink the ring by at least one slot (survivors
+        # cannot keep a slot the dead host owned)
+        n_slots = min(k - 1, max(1, k * n_live // n_total))
+        if n_slots < ppp.recut_min_slots(k):
+            # below the K-1..ceil(K/2) contract: more than two stages
+            # per slot — the super-stage compute/stash growth is
+            # unbounded, so the pod rewinds and waits for capacity
+            return None, "infeasible_slots"
+        try:
+            ppp.recut_plan(k, n_slots,
+                           stage_signatures=self._pp_stage_signatures(
+                               trainer))
+        except ppp.PPRecutError as e:
+            return None, e.reason
+        return n_slots, None
 
     def _retarget(self, trainer, base_axes, live, kind, **fields):
         """Re-shard this host's live state onto the capacity-scaled mesh
@@ -1977,12 +2035,52 @@ class ElasticTrainer(PodResilientTrainer):
             self._apply_lr_scale(trainer, live)
             return
         if self._pp_axes(base_axes):
-            # pipeline mesh: each stage's params/moments live only on
-            # their pp slice — there is no smaller mesh to re-shard
-            # onto (re-cutting stages is follow-on work). The mesh and
-            # shardings stay put; capacity changes only move data lanes
-            # and the LR scale.
-            record_event(kind, capacity=capacity, resharded=0, pp=True,
+            k = int(base_axes.get("pp") or 1)
+            bs = strategy._build_strategy
+            cur = getattr(bs, "pp_recut_slots", None)
+            want = fields.pop("recut_slots", None)
+            if want is None and cur is not None \
+                    and len(live) >= n_total:
+                # RE-GROW: every host is back — return to the
+                # 1-stage-per-slot plan at this window boundary (the
+                # cache token keyed the full-plan executable, so the
+                # grow re-uses it instead of recompiling)
+                want = k
+            if want is None or want == (cur if cur is not None else k):
+                # pipeline mesh at an unchanged cut: the mesh and
+                # shardings stay put; capacity changes only move data
+                # lanes and the LR scale.
+                record_event(kind, capacity=capacity, resharded=0,
+                             pp=True, **fields)
+                self._apply_lr_scale(trainer, live)
+                return
+            # RE-CUT (or re-grow): the K logical stages re-stack over
+            # `want` mesh slots. The scope keeps the flat per-stage
+            # layout — only the mesh and the in-jit stacking geometry
+            # change, so this is a set_mesh_axes + state re-placement,
+            # never a state rewrite.
+            t0 = time.monotonic()
+            axes = dict(base_axes)
+            axes["pp"] = want
+            bs.pp_recut_slots = None if want == k else want
+            old_mesh = strategy._mesh_obj()
+            strategy.set_mesh_axes(axes)
+            new_mesh = strategy._mesh_obj()
+            moved = 0
+            if new_mesh != old_mesh:
+                sc = self._scope_of(trainer)
+                new_state = mesh_mod.reshard_state(dict(sc.items()),
+                                                   old_mesh, new_mesh)
+                for name, val in new_state.items():
+                    if val is not sc.find_var(name):
+                        sc.set_var(name, val)
+                        moved += 1
+            record_event(kind, capacity=capacity,
+                         mesh={a: int(s)
+                               for a, s in new_mesh.shape.items()},
+                         resharded=moved, pp=True, pp_slots=want,
+                         pp_stages=k,
+                         latency_s=round(time.monotonic() - t0, 6),
                          **fields)
             self._apply_lr_scale(trainer, live)
             return
@@ -2218,21 +2316,38 @@ class ElasticTrainer(PodResilientTrainer):
                 continue
             live = sorted(verdicts)
             lost = sorted(set(known_live) - set(live))
-            pp_rewind = False
+            pp_rewind, pp_recut = False, None
             if lost:
                 if self._pp_axes(base_axes):
-                    # PIPELINE mesh: a lost host's stage slice cannot be
-                    # re-sharded away — fall back to the
-                    # PodResilientTrainer consensus rewind (the shared
-                    # transient tail below): scrub, elect the common
-                    # step, restore, replay bitwise on the unchanged
-                    # mesh. Survivors stay at full mesh; only data
-                    # lanes and the LR scale follow the capacity.
-                    pp_rewind = True
-                    record_event(
-                        "elastic_pp_rewind", lost=lost, step=step,
-                        capacity="%d/%d" % (len(live),
-                                            self._coordinator.n_hosts))
+                    # PIPELINE mesh host loss: RE-CUT when the
+                    # survivors can still hold every logical stage
+                    # (multiple stages per slot — recut_plan), REWIND
+                    # otherwise. The decision reads only the frozen
+                    # verdicts (live count) and static plan facts, so
+                    # every host decides identically; the re-cut
+                    # itself waits for the all-ok commit below (the
+                    # PR 10 fetch-hole discipline — the survivors'
+                    # completed window is kept either way).
+                    n_slots, why = self._pp_recut_decision(
+                        trainer, base_axes, len(live))
+                    all_ok = all(v[0] == "ok"
+                                 for v in verdicts.values())
+                    if n_slots is not None and all_ok:
+                        pp_recut = n_slots
+                    else:
+                        # consensus rewind (the shared transient tail
+                        # below): scrub, elect the common step,
+                        # restore, replay bitwise on the unchanged
+                        # mesh. reason= tells a policy refusal from a
+                        # genuine infeasibility — a faulted window
+                        # rewinds regardless of slot feasibility.
+                        pp_rewind = True
+                        record_event(
+                            "elastic_pp_rewind", lost=lost, step=step,
+                            capacity="%d/%d"
+                            % (len(live), self._coordinator.n_hosts),
+                            reason=why if n_slots is None
+                            else "faulted_window")
                     known_live = live
                 else:
                     # ELASTIC SHRINK: no rewind — re-shard and continue
@@ -2288,6 +2403,37 @@ class ElasticTrainer(PodResilientTrainer):
                 if strag and step % ckpt_every != 0 and step != n:
                     trainer._save(step)
                     record_event("straggler_ckpt", step=step)
+            if pp_recut is not None:
+                # RE-CUT at the committed boundary: the survivors'
+                # all-ok window is already committed above, so the
+                # re-stacked plan starts from an agreed position. A
+                # fault here — the coordination.recut failpoint, or a
+                # real failure inside the retarget — falls back to the
+                # budget-free consensus rewind on the RESTORED full
+                # plan: never a crash, never a silent shrink.
+                from . import faultinject
+                try:
+                    faultinject.hit("coordination.recut",
+                                    {"step": step, "slots": pp_recut},
+                                    host=hid)
+                    self._retarget(trainer, base_axes, live,
+                                   "elastic_pp_recut", lost=lost,
+                                   step=step, recut_slots=pp_recut)
+                except Exception as e:
+                    pp_rewind = True
+                    st = self._target_strategy(trainer)
+                    if st is not None:
+                        # undo any half-applied mesh move before the
+                        # rewind: the restore's shardings come from the
+                        # CURRENT strategy, which must be the full
+                        # 1-stage-per-slot plan again
+                        st._build_strategy.pp_recut_slots = None
+                        st.set_mesh_axes(dict(base_axes))
+                    record_event(
+                        "elastic_pp_rewind", lost=lost, step=step,
+                        capacity="%d/%d"
+                        % (len(live), self._coordinator.n_hosts),
+                        reason="recut_failed", error=type(e).__name__)
             if not pp_rewind and all(v == "ok"
                                      for v in statuses.values()):
                 if sdc is not None:

@@ -104,6 +104,11 @@ SITES = {
     # one executor step about to run; payload = feeds dict, so
     # ``corrupt``/``flip`` can poison a named input array
     "executor.step": FloatingPointError,
+    # elastic pp re-cut about to re-target the survivors' mesh (a
+    # raise here exercises the half-completed-re-cut window: the pod
+    # must fall back to the consensus rewind, never crash or shrink
+    # silently)
+    "coordination.recut": RuntimeError,
     # router about to dispatch a coalesced micro-batch to a replica
     "serving.dispatch": OSError,
     # replica about to run one /infer body

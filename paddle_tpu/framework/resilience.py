@@ -914,6 +914,34 @@ def metrics(event_list=None, by_host=False):
         gauges += [{"name": METRIC_PREFIX + "_router_tenant_queue_depth",
                     "labels": _rlbl(rkey, tenant=t), "value": v}
                    for t, v in sorted(rt["tenant_queue_depth"].items())]
+    # elastic pp re-cut (stage re-stacking over a shrunk mesh): the
+    # re-cut counter, the last re-cut's retarget wall, and the CURRENT
+    # slot count + live-host pair (both from the last pp retarget
+    # event — re-grow moves them back) — emitted only for pods that
+    # ever re-cut, so plain pods export nothing new. serving_probe
+    # --strict cross-checks pp_slots against pp_live_hosts: more slots
+    # than surviving hosts means a torn re-cut.
+    recut_evs = [e for e in evs if e["kind"] == "elastic_pp_recut"]
+    if recut_evs:
+        counters.append({"name": METRIC_PREFIX + "_pp_recut_total",
+                         "labels": {}, "value": len(recut_evs)})
+        last_ms = next((1000.0 * float(e["latency_s"])
+                        for e in reversed(recut_evs)
+                        if "latency_s" in e), None)
+        if last_ms is not None:
+            gauges.append({"name": METRIC_PREFIX + "_pp_recut_ms",
+                           "labels": {}, "value": round(last_ms, 3)})
+    last_pp = next((e for e in reversed(evs)
+                    if "pp_slots" in e
+                    and e["kind"] in ("elastic_pp_recut",
+                                      "elastic_grow")), None)
+    if last_pp is not None:
+        gauges.append({"name": METRIC_PREFIX + "_pp_slots",
+                       "labels": {}, "value": int(last_pp["pp_slots"])})
+        cap = str(last_pp.get("capacity", "")).partition("/")[0]
+        if cap.isdigit():
+            gauges.append({"name": METRIC_PREFIX + "_pp_live_hosts",
+                           "labels": {}, "value": int(cap)})
     restore_lat = [e["latency_s"] for e in evs
                    if e["kind"] == "restore" and "latency_s" in e]
     histograms = [_histogram(METRIC_PREFIX + "_restore_latency_seconds",

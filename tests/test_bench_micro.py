@@ -88,7 +88,10 @@ def test_budget_table_covers_the_contract():
         # ISSUE-17 numeric-fault plane: the in-graph finite-mask cost
         # vs the plain dp step and the wall of one failpoint-poisoned
         # skip-policy recovery
-        "numerics_overhead_frac", "fault_recovery_ms"}
+        "numerics_overhead_frac", "fault_recovery_ms",
+        # ISSUE-18 elastic pp re-cut: decision commit -> first
+        # completed post-re-cut step on the in-process pp=2 pod
+        "pp_recut_ms"}
 
 
 def test_analysis_section_measures_the_verifier():
@@ -116,6 +119,17 @@ def test_pipeline_section_measures_the_pp_path():
     # executor: exactly two lowerings, both repeats hit
     assert m["pp_cache_compiles"] == 2
     assert m["pp_cache_hit_rate"] == 0.5
+
+
+def test_pp_recut_section_measures_the_recut_wall():
+    """ISSUE-18 satellite: the pp_recut section kills one host of the
+    in-process pp=2 pod and reports the wall from the re-cut decision
+    committing to the first completed post-re-cut step, plus the
+    re-placed state leaf count (the re-cut moves state, it never
+    rewrites it)."""
+    m = bench_micro.bench_pp_recut()
+    assert 0 < m["pp_recut_ms"] < 30000.0
+    assert m["pp_recut_resharded"] > 0
 
 
 def test_transport_section_measures_latency():

@@ -262,3 +262,170 @@ def test_twin_replica_killed_mid_deploy_skipped_then_converges(
         out = np.asarray(resp["outputs"][0], dtype=resp["dtypes"][0])
         np.testing.assert_allclose(out, np.full_like(out, 12.0),
                                    rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# pp re-cut twins (ISSUE-18): the deterministic single-process mirror of
+# test_pod_transport.py::test_procpod_pp_pod_sigkill_recuts.  One pp host
+# dies mid-run (resilience's step:die failpoint instead of SIGKILL), the
+# survivors re-stack the K logical stages over the shrunk slot count, and
+# -- because the re-cut lowering is trajectory-equivalent -- their losses
+# are BITWISE those of a pod born shrunk.  The in-process pod also covers
+# the leg a killed OS process cannot: the dead host rejoins through the
+# fence and the pod re-grows back to the full plan at a window boundary.
+# ---------------------------------------------------------------------------
+
+_PP_DM, _PP_BATCH = 16, 16
+
+
+def _pp_pod_program(n_stage=2):
+    from paddle_tpu import optimizer
+    from paddle_tpu.distributed.pipeline_program import pp_stage_guard
+    per = 2 if n_stage == 2 else 1
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = layers.data("pp_x", [_PP_BATCH, _PP_DM], "float32",
+                        append_batch_size=False)
+        h = x
+        for i in range(n_stage * per):
+            with pp_stage_guard(i // per):
+                h = layers.fc(h, size=_PP_DM, act="tanh")
+        y = layers.data("pp_y", [_PP_BATCH, _PP_DM], "float32",
+                        append_batch_size=False)
+        loss = layers.reduce_mean(layers.square(h - y))
+        optimizer.SGD(0.2).minimize(loss)
+    return main, startup, loss
+
+
+def _pp_pod_feeds(n, seed=7):
+    rng = np.random.RandomState(seed)
+    return [{"pp_x": rng.randn(_PP_BATCH, _PP_DM).astype(np.float32),
+             "pp_y": rng.randn(_PP_BATCH, _PP_DM).astype(np.float32)}
+            for _ in range(n)]
+
+
+def _pp_pod_trainer(main, startup, loss, ckdir, schedule="1f1b",
+                    pp=2, dp=4, m=4, recut=None):
+    from paddle_tpu.framework.compiler import (BuildStrategy,
+                                               CompiledProgram)
+    from paddle_tpu.framework.resilience import (ResilientTrainer,
+                                                 RetryPolicy)
+    from paddle_tpu.framework.scope import Scope, scope_guard
+    sc, exe = Scope(), pt.Executor()
+    with scope_guard(sc):
+        exe.run(startup)
+    bs = BuildStrategy(pp_stages=pp, pp_micro_batches=m,
+                       pp_schedule=schedule, pp_recut_slots=recut)
+    bs.mesh_axes = {"pp": recut or pp, "dp": dp}
+    return ResilientTrainer(
+        exe, CompiledProgram(main, bs), str(ckdir), fetch_list=[loss],
+        checkpoint_every=2, scope=sc,
+        retry_policy=RetryPolicy(base_delay_s=0.0, jitter=0.0,
+                                 sleep=lambda s: None))
+
+
+@pytest.mark.parametrize("schedule", ["1f1b", "gpipe"])
+def test_twin_pp_host_kill_recuts_then_regrows(tmp_path, schedule):
+    """Kill one host of a 3-host pp=2 pod mid-run: the survivors emit
+    elastic_pp_recut (K=2 stages onto 1 slot, capacity 2/3) instead of
+    any rewind/restore, their losses are BITWISE the born-shrunk
+    reference's, and when the host rejoins the pod re-grows to the
+    full plan -- every trainer ends on pp=2 with the slot override
+    cleared."""
+    from paddle_tpu.framework.coordination import (ElasticTrainer,
+                                                   LocalCoordinator)
+    n_steps = 8
+    feeds = _pp_pod_feeds(n_steps)
+    main, startup, loss = _pp_pod_program()
+
+    # born-shrunk reference: same program lowered with
+    # pp_recut_slots=1 on the {pp:1, dp:4} mesh from step 0
+    born = _pp_pod_trainer(main, startup, loss, tmp_path / "born",
+                           schedule=schedule, recut=1, dp=4)
+    born_losses = [float(np.asarray(o[0]).ravel()[0])
+                   for o in born.run(feeds)]
+
+    resilience.clear_events()
+    trainers = [
+        _pp_pod_trainer(main, startup, loss, tmp_path / ("h%d" % h),
+                        schedule=schedule)
+        for h in range(3)]
+    pod = ElasticTrainer(trainers, LocalCoordinator(3, timeout_s=300.0),
+                         rejoin=True)
+    with resilience.inject("step:die@10"):
+        out = pod.run(feeds)
+
+    kinds = [e["kind"] for e in resilience.events()]
+    assert "elastic_pp_recut" in kinds, kinds
+    for banned in ("elastic_pp_rewind", "pod_restore", "pod_restart"):
+        assert banned not in kinds, kinds
+    rec = resilience.events("elastic_pp_recut")[0]
+    assert rec["pp_slots"] == 1 and rec["pp_stages"] == 2, rec
+    assert rec["capacity"] == "2/3", rec
+    assert rec["resharded"] > 0, rec
+    # the returning host triggers a re-grow back to the full plan
+    grows = resilience.events("elastic_grow")
+    assert any(g.get("pp_slots") == 2 for g in grows), grows
+    died = {e["host"] for e in resilience.events("host_death")}
+    assert len(died) == 1, died
+    for h in range(3):
+        if h in died:
+            continue
+        losses = [float(np.asarray(o[0]).ravel()[0]) for o in out[h]]
+        assert len(losses) == n_steps
+        assert losses == born_losses, (h, losses, born_losses)
+    for t in trainers:
+        bs = t._target._build_strategy
+        assert bs.mesh_axes == {"pp": 2, "dp": 4}, bs.mesh_axes
+        assert bs.pp_recut_slots is None
+    # the resilience endpoint exports the re-cut series
+    m = resilience.metrics()
+    counters = {c["name"]: c["value"] for c in m["counters"]}
+    gauges = {g["name"]: g["value"] for g in m["gauges"]}
+    assert counters["paddle_tpu_resilience_pp_recut_total"] == len(
+        resilience.events("elastic_pp_recut"))
+    assert gauges["paddle_tpu_resilience_pp_slots"] == 2   # regrown
+    assert gauges["paddle_tpu_resilience_pp_live_hosts"] == 3
+    assert "paddle_tpu_resilience_pp_recut_ms" in gauges
+
+
+def test_twin_pp_recut_infeasible_falls_back_to_rewind(tmp_path):
+    """A 2-host K=3 pod loses a host: 1 survivor is below the
+    ceil(K/2)=2 slot floor, so the pod takes the consensus rewind --
+    elastic_pp_rewind with reason="infeasible_slots", never an
+    elastic_pp_recut -- and still finishes with bitwise-replay
+    losses."""
+    from paddle_tpu.framework.coordination import (ElasticTrainer,
+                                                   LocalCoordinator)
+    n_steps = 8
+    feeds = _pp_pod_feeds(n_steps)
+    main, startup, loss = _pp_pod_program(n_stage=3)
+
+    ref = _pp_pod_trainer(main, startup, loss, tmp_path / "ref",
+                          pp=3, dp=2, m=2)
+    ref_losses = [float(np.asarray(o[0]).ravel()[0])
+                  for o in ref.run(feeds)]
+
+    resilience.clear_events()
+    trainers = [
+        _pp_pod_trainer(main, startup, loss, tmp_path / ("h%d" % h),
+                        pp=3, dp=2, m=2)
+        for h in range(2)]
+    pod = ElasticTrainer(trainers, LocalCoordinator(2, timeout_s=300.0),
+                         rejoin=True)
+    with resilience.inject("step:die@6"):
+        out = pod.run(feeds)
+
+    kinds = [e["kind"] for e in resilience.events()]
+    assert "elastic_pp_recut" not in kinds, kinds
+    rewinds = resilience.events("elastic_pp_rewind")
+    assert rewinds and all(
+        e["reason"] == "infeasible_slots" for e in rewinds), rewinds
+    assert "pod_restore" in kinds, kinds
+    died = {e["host"] for e in resilience.events("host_death")}
+    assert len(died) == 1, died
+    for h in range(2):
+        if h in died:
+            continue
+        losses = [float(np.asarray(o[0]).ravel()[0]) for o in out[h]]
+        assert losses == ref_losses, (h, losses, ref_losses)

@@ -445,3 +445,94 @@ def test_sdc_detector_identical_norms_never_trip():
     for _ in range(8):
         assert det.observe({h: 3.25 for h in range(4)}) == []
     assert det.suspects() == set()
+
+
+# ---------------------------------------------------------------------------
+# coordination.recut failpoint (ISSUE-18 satellite): a fault injected at
+# the re-cut commit point must degrade to the consensus rewind -- never a
+# crash, never a silently half-re-cut pod
+# ---------------------------------------------------------------------------
+
+def test_recut_failpoint_falls_back_to_consensus_rewind(tmp_path):
+    """Arm ``coordination.recut:raise@1`` and kill one host of a
+    3-host pp=2 pod mid-run.  The survivors' re-cut decision is
+    feasible, but the armed failpoint detonates at the commit point:
+    the pod must fall back to the consensus rewind (elastic_pp_rewind
+    with reason="recut_failed" + pod_restore), restore the FULL base
+    mesh on every survivor, and still finish with the uninterrupted
+    reference's bitwise losses -- no crash, no silent shrink."""
+    from paddle_tpu.distributed.pipeline_program import pp_stage_guard
+    from paddle_tpu.framework.coordination import (ElasticTrainer,
+                                                   LocalCoordinator)
+    from paddle_tpu.framework.resilience import (ResilientTrainer,
+                                                 RetryPolicy)
+
+    def build():
+        main, startup = pt.Program(), pt.Program()
+        with pt.program_guard(main, startup):
+            x = layers.data("fx", [16, 16], "float32",
+                            append_batch_size=False)
+            h = x
+            for i in range(4):
+                with pp_stage_guard(i // 2):
+                    h = layers.fc(h, size=16, act="tanh")
+            y = layers.data("fy", [16, 16], "float32",
+                            append_batch_size=False)
+            loss = layers.reduce_mean(layers.square(h - y))
+            optimizer.SGD(0.2).minimize(loss)
+        return main, startup, loss
+
+    def trainer(ckdir):
+        sc, exe = Scope(), pt.Executor()
+        with scope_guard(sc):
+            exe.run(startup)
+        bs = BuildStrategy(pp_stages=2, pp_micro_batches=4)
+        bs.mesh_axes = {"pp": 2, "dp": 4}
+        return ResilientTrainer(
+            exe, CompiledProgram(main, bs), str(ckdir),
+            fetch_list=[loss], checkpoint_every=2, scope=sc,
+            retry_policy=RetryPolicy(base_delay_s=0.0, jitter=0.0,
+                                     sleep=lambda s: None))
+
+    main, startup, loss = build()
+    rng = np.random.RandomState(7)
+    feeds = [{"fx": rng.randn(16, 16).astype(np.float32),
+              "fy": rng.randn(16, 16).astype(np.float32)}
+             for _ in range(8)]
+    ref = trainer(tmp_path / "ref")
+    ref_losses = [float(np.asarray(o[0]).ravel()[0])
+                  for o in ref.run(feeds)]
+
+    resilience.install(None)
+    resilience.clear_events()
+    trainers = [trainer(tmp_path / ("h%d" % h)) for h in range(3)]
+    pod = ElasticTrainer(trainers, LocalCoordinator(3, timeout_s=300.0),
+                         rejoin=True)
+    with faultinject.failpoints(["coordination.recut:raise@1"]):
+        with resilience.inject("step:die@10"):
+            out = pod.run(feeds)
+        # @1 schedules are per-host: each of the 2 survivors hit once
+        assert faultinject.hits_total().get("coordination.recut") == 2
+
+    kinds = [e["kind"] for e in resilience.events()]
+    assert "elastic_pp_recut" not in kinds, kinds
+    rewinds = resilience.events("elastic_pp_rewind")
+    assert rewinds, kinds
+    assert all(e["reason"] == "recut_failed" for e in rewinds), rewinds
+    assert all(e["error"] == "RuntimeError" for e in rewinds), rewinds
+    assert "pod_restore" in kinds, kinds
+    died = {e["host"] for e in resilience.events("host_death")}
+    assert len(died) == 1, died
+    for h in range(3):
+        if h in died:
+            continue
+        losses = [float(np.asarray(o[0]).ravel()[0]) for o in out[h]]
+        assert losses == ref_losses, (h, losses)
+    # no silent shrink: every survivor is back on the FULL base mesh
+    for h, t in enumerate(trainers):
+        if h in died:
+            continue
+        bs = t._target._build_strategy
+        assert bs.mesh_axes == {"pp": 2, "dp": 4}, bs.mesh_axes
+        assert bs.pp_recut_slots is None
+    resilience.clear_events()

@@ -7,8 +7,10 @@ pp x dp mesh — the step lowers through the GPipe/1F1B ring schedules
 inside one shard_map, the program's own update section runs SPMD per
 stage, dp gradient sync (quantized included) rides the data axis, and
 the executor compile cache keys on (mesh axes, pp cut, schedule).
-Elastic: a host loss on a pp pod takes the consensus-rewind path
-(elastic_pp_rewind) with bitwise replay.
+Elastic: a host loss on a pp pod re-cuts the K stages over the
+surviving slots when feasible (elastic_pp_recut — see
+test_chaos_twins.py); with pp_recut=False it takes the consensus-rewind
+path (elastic_pp_rewind reason="disabled") with bitwise replay.
 """
 import threading
 
@@ -322,7 +324,8 @@ def _fast_policy():
     return RetryPolicy(base_delay_s=0.0, jitter=0.0, sleep=lambda s: None)
 
 
-def _pp_pod(tmp_path, tag, main, startup, loss, n_hosts=3, rejoin=True):
+def _pp_pod(tmp_path, tag, main, startup, loss, n_hosts=3, rejoin=True,
+            pp_recut=True):
     trainers = []
     for h in range(n_hosts):
         sc, exe = Scope(), pt.Executor()
@@ -334,18 +337,19 @@ def _pp_pod(tmp_path, tag, main, startup, loss, n_hosts=3, rejoin=True):
             checkpoint_every=2, scope=sc, retry_policy=_fast_policy()))
     pod = ElasticTrainer(trainers,
                          LocalCoordinator(n_hosts, timeout_s=300.0),
-                         rejoin=rejoin)
+                         rejoin=rejoin, pp_recut=pp_recut)
     return pod, trainers
 
 
 @pytest.mark.faultinject
 @pytest.mark.pod
 def test_elastic_pp_rewind_bitwise_replay(tmp_path):
-    """SIGKILL-equivalent host death in a pp pod: instead of the
-    elastic re-shard (stage state cannot leave its pp slice), the pod
-    takes the consensus-rewind path — elastic_pp_rewind + pod_restore
-    events, ZERO reshard/elastic_shrink events, and the replay is
-    BITWISE identical to an uninterrupted run on every survivor."""
+    """SIGKILL-equivalent host death in a pp pod with the elastic
+    re-cut DISABLED (pp_recut=False — the PR 10 contract): the pod
+    takes the consensus-rewind path — elastic_pp_rewind (tagged
+    reason="disabled") + pod_restore events, ZERO reshard/
+    elastic_shrink events, and the replay is BITWISE identical to an
+    uninterrupted run on every survivor."""
     resilience.install(None)
     resilience.clear_events()
     n = 6
@@ -367,13 +371,18 @@ def test_elastic_pp_rewind_bitwise_replay(tmp_path):
                   for p in main.all_parameters()}
 
     resilience.clear_events()
-    pod, trainers = _pp_pod(tmp_path, "chaos", main, startup, loss)
+    pod, trainers = _pp_pod(tmp_path, "chaos", main, startup, loss,
+                            pp_recut=False)
     # 3 hosts x 1-step windows: fire 10 lands mid-run on one host
     with resilience.inject("step:die@10"):
         out = pod.run(feeds)
 
     kinds = [e["kind"] for e in resilience.events()]
     assert "elastic_pp_rewind" in kinds
+    # the reason label tells a POLICY refusal from an infeasible cut
+    assert all(e["reason"] == "disabled"
+               for e in resilience.events("elastic_pp_rewind"))
+    assert "elastic_pp_recut" not in kinds
     # the rewind path, not the re-shard path:
     assert "elastic_shrink" not in kinds and "reshard" not in kinds
     assert resilience.events("pod_restore")
@@ -398,3 +407,130 @@ def test_elastic_pp_rewind_bitwise_replay(tmp_path):
     # the mesh never changed: full pp x dp axes on every trainer
     for t in trainers:
         assert t._target._build_strategy.mesh_axes == {"pp": 2, "dp": 4}
+
+
+# ---------------------------------------------------------------------------
+# re-cut lowering (ISSUE-18): recut_plan slot maps, named infeasibility,
+# cache-token identity, and window parity across a re-cut boundary
+# ---------------------------------------------------------------------------
+
+def test_recut_plan_slot_maps():
+    """Balanced contiguous partition, larger counts first, last stage
+    in the LAST slot, pad rows repeating the slot's last real stage."""
+    from paddle_tpu.distributed import pipeline_program as ppp
+    cases = {
+        (2, 1): dict(counts=(2,), starts=(0,), slot_of=(0, 0), k_per=2,
+                     stage_idx=((0, 1),), valid=((True, True),)),
+        (3, 2): dict(counts=(2, 1), starts=(0, 2), slot_of=(0, 0, 1),
+                     k_per=2, stage_idx=((0, 1), (2, 2)),
+                     valid=((True, True), (True, False))),
+        (4, 2): dict(counts=(2, 2), starts=(0, 2),
+                     slot_of=(0, 0, 1, 1), k_per=2,
+                     stage_idx=((0, 1), (2, 3)),
+                     valid=((True, True), (True, True))),
+        (4, 3): dict(counts=(2, 1, 1), starts=(0, 2, 3),
+                     slot_of=(0, 0, 1, 2), k_per=2,
+                     stage_idx=((0, 1), (2, 2), (3, 3)),
+                     valid=((True, True), (True, False),
+                            (True, False))),
+    }
+    for (k, n), want in cases.items():
+        plan = ppp.recut_plan(k, n)
+        assert plan.k_stages == k and plan.n_slots == n
+        for field, val in want.items():
+            assert getattr(plan, field) == val, ((k, n), field)
+        # invariants the schedules rely on
+        assert sum(plan.counts) == k
+        assert all(c >= 1 for c in plan.counts)
+        assert plan.stage_idx[-1][plan.counts[-1] - 1] == k - 1
+        assert plan.signature() == (k, n, plan.counts)
+    # the feasibility floor the elastic decision enforces
+    from paddle_tpu.distributed.pipeline_program import recut_min_slots
+    assert [recut_min_slots(k) for k in (1, 2, 3, 4, 5, 8)] \
+        == [1, 1, 2, 2, 3, 4]
+
+
+def test_recut_plan_named_errors():
+    from paddle_tpu.distributed import pipeline_program as ppp
+    with pytest.raises(ppp.PPRecutInfeasibleError,
+                       match="over 0 mesh slots") as ei:
+        ppp.recut_plan(4, 0)
+    assert ei.value.reason == "infeasible_slots"
+    with pytest.raises(ppp.PPRecutInfeasibleError,
+                       match="cannot be empty"):
+        ppp.recut_plan(2, 3)                   # more slots than stages
+    with pytest.raises(ppp.PPRecutInfeasibleError,
+                       match="at least one logical stage"):
+        ppp.recut_plan(0, 1)
+    sigs = [("fc", "tanh"), ("fc", "relu")]
+    with pytest.raises(ppp.PPRecutHeterogeneousError,
+                       match="structurally") as eh:
+        ppp.recut_plan(2, 1, stage_signatures=sigs)
+    assert eh.value.reason == "heterogeneous_stages"
+    assert isinstance(eh.value, ppp.PPRecutError)   # one catchable family
+
+
+def test_recut_cache_toggle_and_hits():
+    """pp_recut_slots joins the compile-cache token: the re-cut plan is
+    its own executable (a miss), repeats hit, and toggling BACK to the
+    full plan re-uses the original executable without re-lowering."""
+    data = _data(2)
+    main, startup, loss = _pp_program()
+    full = _pp_strategy()
+    recut = _pp_strategy()
+    recut.pp_recut_slots = 1
+    recut.mesh_axes = {"pp": 1, "dp": 4}
+    with scope_guard(Scope()):
+        exe = pt.Executor()
+        exe.run(startup)
+        for bs in (full, recut):
+            comp = CompiledProgram(main, bs)
+            for xv, yv in data:
+                exe.run(comp, feed={"pp_x": xv, "pp_y": yv},
+                        fetch_list=[loss])
+        assert exe.cache_misses == 2      # full and re-cut each lower once
+        assert exe.cache_hits == 2
+        # the grow-back: same token as the first lowering -> pure hits
+        comp = CompiledProgram(main, _pp_strategy())
+        exe.run(comp, feed=dict(zip(("pp_x", "pp_y"), data[0])),
+                fetch_list=[loss])
+        assert exe.cache_misses == 2
+        assert exe.cache_hits == 3
+
+
+def test_recut_run_steps_window_parity_across_boundary():
+    """Two run_steps windows with an in-place re-cut between them ==
+    the uninterrupted full-plan run: the scope layout is unchanged by
+    the re-cut, so only the mesh placement moves."""
+    from paddle_tpu.distributed import mesh as mesh_mod
+    n_steps = 8
+    data = _data(n_steps)
+    main, startup, loss = _pp_program()
+    ref, ref_params = _train(main, startup, loss, _pp_strategy(), data)
+    with scope_guard(Scope()):
+        exe = pt.Executor()
+        exe.run(startup)
+        comp = CompiledProgram(main, _pp_strategy())
+
+        def window(chunk):
+            stacked = {"pp_x": np.stack([d[0] for d in chunk]),
+                       "pp_y": np.stack([d[1] for d in chunk])}
+            outs = exe.run_steps(comp, feed=stacked, fetch_list=[loss])
+            return [float(v) for v in np.asarray(outs[0]).reshape(-1)]
+        losses = window(data[:4])
+        # the elastic re-cut, replayed by hand: arm the slot override,
+        # swap the mesh, re-place the live state (what _retarget does)
+        old_mesh = comp._mesh_obj()
+        comp._build_strategy.pp_recut_slots = 1
+        comp.set_mesh_axes({"pp": 1, "dp": 4})
+        sc = pt.global_scope()
+        new_state = mesh_mod.reshard_state(dict(sc.items()), old_mesh,
+                                           comp._mesh_obj())
+        for name, val in new_state.items():
+            sc.set_var(name, val)
+        losses += window(data[4:])
+        got_params = {n: sc.get_numpy(n).copy() for n in ref_params}
+    np.testing.assert_allclose(losses, ref, rtol=1e-6)
+    for n in ref_params:
+        np.testing.assert_allclose(got_params[n], ref_params[n],
+                                   rtol=1e-5, atol=1e-6)

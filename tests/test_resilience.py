@@ -954,6 +954,48 @@ def test_serving_probe_scrapes_metrics_url(tmp_path, capsys):
     capsys.readouterr()
 
 
+def test_serving_probe_elastic_group_and_topology_flag(tmp_path,
+                                                       capsys):
+    """ISSUE-18 satellite: the probe folds the pp_* resilience series
+    under an "elastic" group, and --strict fails the probe when the
+    exported pp_slots disagrees with the live-host count (a re-cut
+    that thinks it holds more slots than there are hosts)."""
+    import json
+    _export_predictor(tmp_path)
+    probe = _probe_module()
+    resilience.record_event("elastic_pp_recut", capacity="2/3",
+                            lost=[2], step=4, resharded=9, pp=True,
+                            pp_slots=1, pp_stages=2, latency_s=0.25)
+    with resilience.serve_metrics(port=0) as srv:
+        summary = probe.scrape_metrics(srv.url)
+        el = summary["elastic"]
+        assert el["pp_recut_total"] == 1.0
+        assert el["pp_recut_ms"] == 250.0
+        assert el["pp_slots"] == 1.0
+        assert el["pp_live_hosts"] == 2.0
+        assert probe.elastic_topology_flags(summary) == []
+        # consistent topology: the lax AND strict probes both pass
+        assert probe.main([str(tmp_path), "--warmup", "--strict",
+                           "--metrics-url", srv.url]) == 0
+        capsys.readouterr()
+        # a later event claims MORE slots than live hosts: flagged,
+        # and only --strict turns the flag into a failure
+        resilience.record_event("elastic_pp_recut", capacity="1/3",
+                                lost=[1], step=8, resharded=0, pp=True,
+                                pp_slots=2, pp_stages=2,
+                                latency_s=0.1)
+        summary = probe.scrape_metrics(srv.url)
+        flags = probe.elastic_topology_flags(summary)
+        assert flags and "pp_slots=2" in flags[0], flags
+        assert probe.main([str(tmp_path), "--warmup",
+                           "--metrics-url", srv.url]) == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["elastic_topology"] == flags
+        assert probe.main([str(tmp_path), "--warmup", "--strict",
+                           "--metrics-url", srv.url]) == 1
+        capsys.readouterr()
+
+
 # ---------------------------------------------------------------------------
 # straggler mitigation (elastic PR satellite)
 # ---------------------------------------------------------------------------

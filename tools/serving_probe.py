@@ -101,8 +101,15 @@ def scrape_metrics(url, timeout_s=5.0):
         text = resp.read().decode("utf-8")
     samples = parse_metrics_text(text)
     events, feed, transport, router, bytes_sec = {}, {}, {}, {}, {}
-    obs_sec, qos, faults = {}, {}, {}
+    obs_sec, qos, faults, elastic = {}, {}, {}, {}
     for name, labels, value in samples:
+        if name.startswith(METRIC_PREFIX + "_pp_"):
+            # the elastic pipeline-re-cut series (pp_recut_total,
+            # pp_recut_ms, pp_slots, pp_live_hosts) fold under one
+            # "elastic" group — --strict cross-checks pp_slots
+            # against pp_live_hosts (see elastic_topology_flags)
+            elastic[name[len(METRIC_PREFIX) + 1:]] = value
+            continue
         if name.startswith(METRIC_PREFIX + "_failpoint_") \
                 or name.startswith(METRIC_PREFIX + "_faultinject_") \
                 or name.startswith(METRIC_PREFIX + "_numeric_fault_"):
@@ -192,6 +199,8 @@ def scrape_metrics(url, timeout_s=5.0):
         out["bytes"] = bytes_sec
     if faults:
         out["faults"] = faults
+    if elastic:
+        out["elastic"] = elastic
     return out
 
 
@@ -272,6 +281,23 @@ def term_regression_flags(summary):
     return flags
 
 
+def elastic_topology_flags(summary):
+    """Elastic pp-topology disagreement in a scrape summary (empty =
+    healthy): after a pipeline re-cut the ``pp_slots`` gauge (slots
+    the survivors' mesh carries) must never EXCEED ``pp_live_hosts``
+    (the live-host count the same retarget event recorded) — more
+    slots than surviving hosts means a torn re-cut left the pod
+    planning stages onto capacity it no longer has. ``--strict``
+    fails the probe on it."""
+    el = summary.get("elastic", {})
+    slots, live = el.get("pp_slots"), el.get("pp_live_hosts")
+    if slots is not None and live is not None and slots > live:
+        return ["pp re-cut topology disagreement: pp_slots=%g exceeds "
+                "pp_live_hosts=%g — the surviving hosts cannot hold "
+                "the mesh's slot count" % (slots, live)]
+    return []
+
+
 def fault_plane_flags(summary):
     """Fault-plane poison in a scrape summary (empty = healthy): a
     nonzero ``faultinject_armed`` gauge means live failpoint schedules
@@ -305,9 +331,11 @@ def main(argv=None):
                          "in the transport series, span-ring "
                          "overflow (trace_spans_dropped_total > 0) in "
                          "the obs series, tenant-vs-aggregate "
-                         "quota-accounting drift in the qos series, or "
+                         "quota-accounting drift in the qos series, "
                          "armed failpoints (faultinject_armed > 0) in "
-                         "the faults series")
+                         "the faults series, or a pp_slots-vs-"
+                         "pp_live_hosts disagreement in the elastic "
+                         "series")
     ap.add_argument("--metrics-url", default=None,
                     help="scrape a resilience.serve_metrics endpoint and "
                          "fold the event totals into the report")
@@ -349,6 +377,13 @@ def main(argv=None):
                 # WILL be failed on purpose — loud always, fatal
                 # under --strict
                 health["faults_armed"] = fflags
+                metrics_ok = False
+            eflags = elastic_topology_flags(health["metrics"])
+            if eflags:
+                # a re-cut mesh with more slots than live hosts is a
+                # torn elastic transition — loud always, fatal under
+                # --strict
+                health["elastic_topology"] = eflags
                 metrics_ok = False
         except Exception as e:
             # a loadable replica with a dead metrics endpoint is still
