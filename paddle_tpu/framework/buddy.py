@@ -1,0 +1,251 @@
+# Copyright (c) 2026 PaddlePaddle-on-JAX growth authors.
+#
+# Licensed under the Apache License, Version 2.0 (the "License");
+# you may not use this file except in compliance with the License.
+# You may obtain a copy of the License at
+#
+#     http://www.apache.org/licenses/LICENSE-2.0
+#
+# Unless required by applicable law or agreed to in writing, software
+# distributed under the License is distributed on an "AS IS" BASIS,
+# WITHOUT WARRANTIES OR CONDITIONS OF ANY KIND, either express or implied.
+"""In-memory buddy checkpointing: sub-window recovery without disk rewind.
+
+Every disk-rewind recovery path (pod consensus rewind, numeric-fault
+rewind, the infeasible-re-cut fallback) loses up to a full checkpoint
+interval of work plus a cold disk restore — for the MOST COMMON fault,
+a single host loss. This module keeps a warm replica of each host's
+scope one hop away instead:
+
+* **Ring.** ``buddy(i) = next live host after i`` on the sorted frozen
+  membership (``ring_buddies``). Deterministic from the same frozen
+  verdicts every host already agrees on, re-derived on every elastic
+  resize/re-cut — no extra coordination.
+* **Send.** At each committed window boundary every host encodes its
+  scope with the CHECKPOINT codec (:func:`io.encode_state_blob` —
+  zlib default is bitwise-lossless, q8 opt-in rides
+  ``ops/quant_ops``) and ships it to the coordination plane via
+  ``put_blob``, stamped with the boundary step as its *generation*.
+  The server keeps ONE generation per owner (bounded memory) and
+  refuses generation rewinds, so a delayed put can never clobber what
+  a restore may already have adopted. Send failures NEVER fail
+  training — the previous generation simply stays restorable.
+* **Restore.** On a fault the pod first tries the buddy tier: every
+  live host polls mailbox METADATA for the owners it needs, computes
+  the same typed verdict, and one gather agrees it pod-wide
+  (conservative merge — any host's doubt falls everyone back to the
+  disk rewind with a typed reason: ``buddy_missing``,
+  ``buddy_stale``, ``buddy_and_host_lost``). When agreed, each host
+  fetches and DECODES its own snapshot without touching its scope,
+  a second gather confirms every decode, and only then does anyone
+  adopt — a torn snapshot (``snapshot_torn``) can never leave the pod
+  half-restored. A buddy restore loses at most one window and is
+  bitwise equal to the uninterrupted reference (zlib codec).
+
+The mailbox rides the existing CoordServer wire: synchronously
+replicated to standbys and snapshot-covered, so an acked snapshot
+survives coordinator failover. FileCoordinator pods have no shared
+mailbox (the base store is per-process) — every restore attempt there
+consistently reports ``buddy_missing`` and takes the disk rewind,
+which is the documented degradation, not an error.
+"""
+
+from __future__ import print_function
+
+import time
+
+import numpy as np
+
+from . import faultinject, obs, resilience
+from .resilience import record_event
+
+__all__ = ["ring_buddies", "buddy_of", "send_snapshot", "plan_restore",
+           "agree_plan", "restore_agreed", "fetch_and_decode",
+           "adopt_arrays", "FALLBACK_REASONS"]
+
+# typed disk-fallback reasons, in conservative-merge precedence order:
+# when hosts disagree (e.g. a racing eviction made one host see a miss
+# where another saw the double loss), the pod adopts the FIRST reason
+# by this ranking so every host records the same label
+FALLBACK_REASONS = ("buddy_and_host_lost", "buddy_missing",
+                    "buddy_stale", "snapshot_torn")
+
+
+# -- ring assignment --------------------------------------------------------
+def ring_buddies(members):
+    """``{host: buddy}`` over the sorted membership ring —
+    ``buddy(i) = (i+1) % n`` in ring position, so every host has
+    exactly one buddy and is exactly one host's buddy. Empty for
+    fewer than two members (a ring of one would buddy a host to
+    itself, which replicates nothing)."""
+    ring = sorted({int(m) for m in members})
+    if len(ring) < 2:
+        return {}
+    return {h: ring[(i + 1) % len(ring)] for i, h in enumerate(ring)}
+
+
+def buddy_of(host, members):
+    """``host``'s buddy under ``members``' ring, or None."""
+    return ring_buddies(members).get(int(host))
+
+
+# -- window-boundary send ---------------------------------------------------
+def send_snapshot(co, host_id, members, gen, scope, compress="zlib",
+                  feed=None, reset=False):
+    """Encode this host's scope (+ feed cursor) and mail it to the
+    coordination plane under generation ``gen``.
+
+    A send failure NEVER fails training: any exception (including the
+    catalogued ``buddy.send`` failpoint and a coordinator outage) is
+    swallowed into a ``buddy_send_fail`` event and the mailbox keeps
+    the PREVIOUS generation, still restorable. Returns True when the
+    snapshot landed. Skipped (False) for rings of fewer than two
+    members — there is no peer RAM to replicate into."""
+    from .. import io as io_mod
+    hid, gen = int(host_id), int(gen)
+    buds = ring_buddies(members)
+    if hid not in buds:
+        return False
+    try:
+        with obs.span("buddy.send", host=hid, gen=gen,
+                      buddy=buds[hid]):
+            arrays = {}
+            for name, val in sorted(scope.items()):
+                if val is None:
+                    continue
+                arrays[name] = np.asarray(val)
+            feed_state = None if feed is None else feed.global_state()
+            # the failpoint fires BEFORE the put: a fault mid-send
+            # must leave the server holding the previous generation
+            faultinject.hit("buddy.send", {"gen": gen}, host=hid)
+            blob, raw, wire = io_mod.encode_state_blob(
+                arrays, gen, compress=compress, feed_state=feed_state)
+            co.put_blob(hid, gen, buds[hid], blob, reset=reset)
+        resilience.record_bytes("buddy_snapshot", raw, wire)
+        resilience.record_buddy_gen(hid, gen)
+        return True
+    except Exception as e:
+        record_event("buddy_send_fail", host=hid, gen=gen,
+                     error=type(e).__name__)
+        return False
+
+
+# -- restore: verdict, agreement, adoption ----------------------------------
+def plan_restore(co, live, lost, prev_members, expected_gen):
+    """This host's LOCAL buddy-restore verdict from mailbox metadata
+    only (no payload fetched): None when a buddy restore at
+    ``expected_gen`` looks possible, else the typed fallback reason.
+
+    ``prev_members`` is the membership the last sends were ringed
+    over (live + the hosts lost THIS round): a lost owner whose buddy
+    under that ring is also gone means the replica's RAM died with it
+    (``buddy_and_host_lost``). Every owner — live and lost — must
+    hold exactly ``expected_gen``: an absent mailbox is
+    ``buddy_missing``, any other generation ``buddy_stale``."""
+    lost = sorted({int(h) for h in lost})
+    owners = sorted({int(h) for h in live} | set(lost))
+    buds = ring_buddies(prev_members)
+    for o in lost:
+        b = buds.get(o)
+        if b is None or b in lost:
+            return "buddy_and_host_lost"
+    for o in owners:
+        try:
+            meta = co.get_blob(o, meta_only=True)
+        except Exception:
+            meta = None
+        if meta is None:
+            return "buddy_missing"
+        if int(meta["gen"]) != int(expected_gen):
+            return "buddy_stale"
+    return None
+
+
+def agree_plan(co, hid, name, live, lost, prev_members, expected_gen):
+    """Pod-wide buddy-restore election (gather #1): every live host
+    publishes its local :func:`plan_restore` verdict and the frozen
+    gather merges them CONSERVATIVELY — any host's doubt falls the
+    whole pod back, under the first reason by
+    :data:`FALLBACK_REASONS` precedence so every host records the
+    same label. Returns None (agreed: restore at ``expected_gen``)
+    or the agreed reason."""
+    local = plan_restore(co, live, lost, prev_members, expected_gen)
+    verd = co.all_gather(name + "v", hid,
+                         "ok" if local is None else local)
+    reasons = [r for r in verd.values() if r != "ok"]
+    if not reasons:
+        return None
+    rank = {r: i for i, r in enumerate(FALLBACK_REASONS)}
+    return min(reasons, key=lambda r: (rank.get(r, len(rank)), r))
+
+
+def fetch_and_decode(co, host_id, gen, need_feed_state=False):
+    """Pull THIS host's snapshot payload and decode it to host arrays
+    WITHOUT touching the scope. Raises on any tear: a moved
+    generation, a decode failure, a missing cursor when the caller
+    needs one — the caller treats every raise as ``snapshot_torn``.
+    The catalogued ``buddy.restore`` failpoint fires between fetch
+    and decode."""
+    from .. import io as io_mod
+    hid, gen = int(host_id), int(gen)
+    rec = co.get_blob(hid)
+    if rec is None:
+        raise LookupError("no buddy snapshot for host %d" % hid)
+    if int(rec["gen"]) != gen:
+        raise LookupError(
+            "buddy snapshot for host %d moved to gen %d while "
+            "restoring gen %d" % (hid, int(rec["gen"]), gen))
+    faultinject.hit("buddy.restore", {"gen": gen}, host=hid)
+    arrays, got, feed_state = io_mod.decode_state_blob(rec["blob"])
+    if int(got) != gen:
+        raise ValueError(
+            "buddy snapshot for host %d carries step %d inside a "
+            "gen-%d mailbox" % (hid, int(got), gen))
+    if need_feed_state and feed_state is None:
+        raise ValueError(
+            "buddy snapshot for host %d has no feed cursor but the "
+            "trainer drives a ShardedFeed" % hid)
+    return arrays, feed_state
+
+
+def adopt_arrays(scope, arrays, shardings=None):
+    """Install decoded host arrays into the scope, re-sharding each
+    device value onto ``shardings`` (or its CURRENT sharding when the
+    map has no entry — the unchanged-mesh case). Only called after
+    the pod agreed every host's decode succeeded."""
+    import jax
+    for name, host_arr in sorted(arrays.items()):
+        sh = None if shardings is None else shardings.get(name)
+        if sh is None:
+            cur = scope.find_var(name)
+            if isinstance(cur, jax.Array):
+                sh = cur.sharding
+        scope.set_var(name, host_arr if sh is None
+                      else jax.device_put(host_arr, sh))
+
+
+def restore_agreed(co, hid, name, gen, scope, shardings=None,
+                   need_feed_state=False):
+    """Stage 2, after :func:`agree_plan` said ok: fetch + decode this
+    host's snapshot (scope untouched), agree every host's decode
+    outcome on gather #2, and only then adopt. Returns
+    ``(True, feed_state)`` on success, ``(False, None)`` when any
+    host's decode tore — nobody adopted anything, the caller takes
+    the disk rewind with ``snapshot_torn``."""
+    t0 = time.perf_counter()
+    ok, arrays, feed_state = True, None, None
+    try:
+        with obs.span("buddy.restore", host=int(hid), gen=int(gen)):
+            arrays, feed_state = fetch_and_decode(
+                co, hid, gen, need_feed_state=need_feed_state)
+    except Exception as e:
+        ok = False
+        record_event("buddy_decode_fail", host=int(hid), gen=int(gen),
+                     error=type(e).__name__)
+    outs = co.all_gather(name + "d", hid, bool(ok))
+    if not all(outs.values()):
+        return False, None
+    adopt_arrays(scope, arrays, shardings=shardings)
+    record_event("buddy_adopt", host=int(hid), gen=int(gen),
+                 latency_s=round(time.perf_counter() - t0, 6))
+    return True, feed_state

@@ -134,6 +134,14 @@ class Coordinator(object):
         # own mesh — same guard, right semantics in both topologies
         self._absorbed = set()
         self._absorb_lock = threading.Lock()
+        # buddy-snapshot mailboxes, default in-memory store (Local
+        # shares ONE coordinator object across simulated hosts, so the
+        # store is naturally pod-wide; File is per-process, so a dead
+        # host's mailbox is simply absent there and restores fall back
+        # to disk). SocketCoordinator overrides put_blob/get_blob to
+        # keep the mailboxes on the CoordServer instead.
+        self._blobs = {}
+        self._blob_lock = threading.Lock()
 
     # -- subclass surface --------------------------------------------------
     def all_gather(self, name, host_id, value=None, timeout_s=None):
@@ -355,10 +363,66 @@ class Coordinator(object):
                      quorum=need)
         return step
 
+    # -- buddy-snapshot mailboxes (framework/buddy.py rides these) --------
+    def put_blob(self, host_id, gen, buddy, blob, reset=False):
+        """Store ``host_id``'s buddy snapshot. ONE generation is kept
+        per owner (bounded memory): a higher ``gen`` overwrites in
+        place, the same ``gen`` is an idempotent re-send, and a LOWER
+        one raises CoordinationError — a delayed put must never rewind
+        the mailbox below what a restore may already have adopted.
+        ``reset=True`` force-overwrites regardless of generation: the
+        post-disk-restore re-seed, where the pod legitimately rewound
+        below the mailbox gen (and a poison-batch replay may change
+        the trajectory, making even an equal-gen blob stale)."""
+        gen, owner = int(gen), int(host_id)
+        if owner in self.lost_hosts():
+            raise HostLostError(
+                "host %d is fenced — a fenced host must not publish "
+                "buddy snapshots" % owner)
+        with self._blob_lock:
+            prev = self._blobs.get(owner)
+            if reset:
+                self._blobs[owner] = {"gen": gen, "buddy": int(buddy),
+                                      "blob": blob}
+                return
+            if prev is not None and gen < prev["gen"]:
+                raise CoordinationError(
+                    "put_blob generation rewind: host %d is at gen %d, "
+                    "refused gen %d" % (owner, prev["gen"], gen))
+            if prev is None or gen > prev["gen"]:
+                self._blobs[owner] = {"gen": gen, "buddy": int(buddy),
+                                      "blob": blob}
+
+    def get_blob(self, owner, meta_only=False):
+        """Fetch ``owner``'s buddy snapshot record
+        ``{"gen", "buddy"[, "blob"]}`` or None when no mailbox exists
+        (``meta_only=True`` skips the payload — the restore election
+        polls generations cheaply). Read-only and unfenced: a fenced
+        survivor reading its own last snapshot IS the restore path."""
+        with self._blob_lock:
+            rec = self._blobs.get(int(owner))
+            if rec is None:
+                return None
+            out = {"gen": rec["gen"], "buddy": rec["buddy"]}
+            if not meta_only:
+                out["blob"] = rec["blob"]
+            return out
+
+    def _evict_orphan_blobs(self):
+        """Drop mailboxes whose owner AND recorded buddy are both lost
+        (the physical bytes lived in the buddy's RAM — a double
+        failure loses them; see transport._PodState)."""
+        lost = set(self.lost_hosts())
+        with self._blob_lock:
+            for o in [o for o, rec in self._blobs.items()
+                      if o in lost and rec["buddy"] in lost]:
+                del self._blobs[o]
+
     def _on_loss(self, newly_lost):
         """Fan out a host-loss: resilience event, mesh re-init, hooks."""
         if not newly_lost:
             return
+        self._evict_orphan_blobs()
         live = self.live_hosts()
         record_event("host_lost", hosts=sorted(newly_lost),
                      live=list(live))
@@ -1067,6 +1131,30 @@ class SocketCoordinator(Coordinator):
         router needs no static fleet configuration."""
         self._call("put_info", info=info)
 
+    # -- buddy-snapshot mailboxes (server-side store) ----------------------
+    def put_blob(self, host_id, gen, buddy, blob, reset=False):
+        """Mailbox write on the CoordServer (see Coordinator.put_blob):
+        synchronously replicated to standbys and snapshot-covered, so
+        an acked snapshot survives coordinator failover."""
+        resp = self._call("put_blob", host=int(host_id), gen=int(gen),
+                          buddy=int(buddy), blob=blob,
+                          reset=bool(reset))
+        if "fenced" in resp:
+            raise HostLostError(
+                "host %d is fenced (%s) — a fenced host must not "
+                "publish buddy snapshots" % (int(host_id),
+                                             resp["fenced"]))
+
+    def get_blob(self, owner, meta_only=False):
+        resp = self._call("get_blob", owner=int(owner),
+                          meta_only=bool(meta_only))
+        if resp.get("miss"):
+            return None
+        out = {"gen": int(resp["gen"]), "buddy": int(resp["buddy"])}
+        if not meta_only:
+            out["blob"] = resp.get("blob")
+        return out
+
     def members(self):
         """One snapshot of the whole membership picture:
         ``{"n_hosts", "hb_deadline_s", "hb_age": {host: seconds},
@@ -1238,16 +1326,32 @@ class PodResilientTrainer(object):
     """
 
     def __init__(self, trainers, coordinator=None, max_restarts=3,
-                 host_id=None):
+                 host_id=None, buddy=True, buddy_compress="zlib"):
         """``host_id=None`` (simulation): ``trainers`` holds ALL N hosts
         and run() drives them on N threads. ``host_id=i`` (production,
         one process per host): ``trainers`` holds exactly THIS host's
         trainer, ``coordinator`` is the shared rendezvous (e.g. a
         FileCoordinator over a common root with ``n_hosts`` = pod size),
         and run() drives the single host loop in the calling thread —
-        its peers are other processes, not threads."""
+        its peers are other processes, not threads.
+
+        ``buddy=True`` (default) arms the in-memory buddy-checkpoint
+        tier (:mod:`framework.buddy`): every committed window boundary
+        each host mails a compressed scope snapshot to its ring buddy
+        through the coordination plane, and a recovery round first
+        tries the agreed buddy restore (≤ 1 window lost, no disk read)
+        before the consensus disk rewind. ``buddy_compress`` picks the
+        snapshot codec: "zlib" (default) is bitwise-lossless — the
+        restore stays bitwise the uninterrupted reference; "q8" is the
+        lossy block codec for operators who accept its error envelope;
+        None mails full-width bytes."""
         if not trainers:
             raise ValueError("PodResilientTrainer needs >= 1 trainer")
+        if buddy_compress not in (None, "zlib", "q8"):
+            raise ValueError("buddy_compress must be None, 'zlib' or "
+                             "'q8', got %r" % (buddy_compress,))
+        self._buddy = bool(buddy)
+        self._buddy_compress = buddy_compress
         self._trainers = list(trainers)
         every = {t._checkpoint_every for t in self._trainers}
         window = {t._steps_per_dispatch for t in self._trainers}
@@ -1353,6 +1457,69 @@ class PodResilientTrainer(object):
                                 else {"culprit": culprit}))
         return agreed
 
+    @staticmethod
+    def _scope_of(trainer):
+        from .scope import global_scope
+        return trainer._scope if trainer._scope is not None \
+            else global_scope()
+
+    def _buddy_send(self, co, hid, trainer, members, gen, feed,
+                    reset=False):
+        """Mail this window boundary's snapshot to the ring buddy —
+        best-effort by construction (:func:`buddy.send_snapshot`
+        swallows every failure into a ``buddy_send_fail`` event), so
+        the training loop's control flow never depends on it."""
+        if not self._buddy:
+            return
+        from . import buddy as buddy_mod
+        buddy_mod.send_snapshot(co, hid, members, gen,
+                                self._scope_of(trainer),
+                                compress=self._buddy_compress,
+                                feed=feed, reset=reset)
+
+    def _buddy_restore(self, co, hid, run_tag, rnd, trainer, gen, live,
+                       lost=(), shardings=None, feed=None,
+                       feed_lags=None, agreed=False, reason=None):
+        """Pod-agreed buddy restore at generation ``gen``: the warm
+        path every recovery round tries before the consensus disk
+        rewind. Returns the restored step (== ``gen``) on success or
+        None for the disk fallback — the typed reason
+        (:data:`buddy.FALLBACK_REASONS`) is recorded on the
+        ``buddy_restore`` event either way. ``agreed=True`` means the
+        caller already ran :func:`buddy.agree_plan` this round
+        (ElasticTrainer does, BEFORE the budget block — a
+        ``buddy_and_host_lost`` verdict demotes the free pp rewind)
+        and passes its ``reason``."""
+        if not self._buddy:
+            return None
+        from . import buddy as buddy_mod
+        name = "%sb%d" % (run_tag, rnd)
+        live, lost = sorted(live), sorted(lost)
+        if not agreed:
+            reason = buddy_mod.agree_plan(
+                co, hid, name, live, lost,
+                sorted(set(live) | set(lost)), gen)
+        if reason is None:
+            ok, feed_state = buddy_mod.restore_agreed(
+                co, hid, name, gen, self._scope_of(trainer),
+                shardings=shardings,
+                need_feed_state=feed is not None)
+            if ok:
+                if feed is not None:
+                    feed.restore(feed_state, lags=feed_lags)
+                # the buddy election IS this round's restore
+                # consensus: record it in the same shape as
+                # elect_restore_step so the recovery contract
+                # (consensus + pod_restore events) holds unchanged
+                record_event("consensus", step=int(gen),
+                             hosts=len(live), quorum=len(live))
+                record_event("buddy_restore", outcome="ok",
+                             step=int(gen))
+                return int(gen)
+            reason = "snapshot_torn"
+        record_event("buddy_restore", outcome=reason, step=int(gen))
+        return None
+
     def run(self, feeds, fetch_list=None, steps=None):
         """Run the pod to completion, recovering from transient faults.
 
@@ -1451,6 +1618,13 @@ class PodResilientTrainer(object):
         trainer._require_fresh_dir()
         trainer._save(0)
         co.barrier(run_tag + "pod_start", hid)
+        # seed the buddy mailboxes at gen 0 (after the barrier: the
+        # ring must be derived from a membership every host agrees
+        # on) so a round-1 fault is already buddy-recoverable. reset=
+        # because a SECOND run() on the same coordinator starts a new
+        # trajectory below the previous run's mailbox generations.
+        self._buddy_send(co, hid, trainer, sorted(co.live_hosts()), 0,
+                         feed, reset=True)
         if n == 0:
             co.barrier(run_tag + "pod_end", hid)
             return []
@@ -1512,6 +1686,10 @@ class PodResilientTrainer(object):
                         feed.record_metrics()
                     if drained:
                         break          # every host's feed is drained
+                # every committed boundary refreshes the buddy tier:
+                # the mailbox generation tracks the agreed step exactly
+                self._buddy_send(co, hid, trainer, sorted(verdicts),
+                                 step, feed)
                 continue
             # -- pod-wide recovery ------------------------------------
             restarts += 1   # lockstep on every host: the SHARED budget
@@ -1531,11 +1709,25 @@ class PodResilientTrainer(object):
             # host would skip and the pod would fall out of lockstep
             self._agree_poison(co, hid, run_tag, rnd, trainer, step,
                                err)
-            from .. import io as io_mod
-            report = io_mod.scrub_checkpoint(trainer._ckpt_dir)
-            agreed = co.elect_restore_step(hid, report["valid_steps"],
-                                           name="%se%d" % (run_tag, rnd))
-            got = trainer._restore(step=agreed)
+            # WARM path first: the buddy tier holds every host's state
+            # at this very boundary (gen == step) — adopting it loses
+            # no committed work and reads no disk. Any doubt falls the
+            # whole pod back to the consensus rewind below.
+            got = self._buddy_restore(co, hid, run_tag, rnd, trainer,
+                                      step, sorted(verdicts), feed=feed)
+            if got is None:
+                from .. import io as io_mod
+                report = io_mod.scrub_checkpoint(trainer._ckpt_dir)
+                agreed = co.elect_restore_step(
+                    hid, report["valid_steps"],
+                    name="%se%d" % (run_tag, rnd))
+                got = trainer._restore(step=agreed)
+                # the disk rewind moved the pod below the mailbox
+                # generations (and a poison-batch replay may change
+                # the trajectory): re-seed the buddy tier from the
+                # restored state, reset= bypassing the rewind fence
+                self._buddy_send(co, hid, trainer, sorted(verdicts),
+                                 got, feed, reset=True)
             record_event("pod_restore", step=got)
             step = got
         co.barrier(run_tag + "pod_end", hid)
@@ -1638,10 +1830,10 @@ class ElasticTrainer(PodResilientTrainer):
                  ship_compress="zlib", drain_floor=None,
                  drain_cooldown=None, drain_hb_lag_s=None,
                  drain_stream_lag=None, sdc_detect=None,
-                 pp_recut=True):
+                 pp_recut=True, buddy=True, buddy_compress="zlib"):
         super(ElasticTrainer, self).__init__(
             trainers, coordinator=coordinator, max_restarts=max_restarts,
-            host_id=host_id)
+            host_id=host_id, buddy=buddy, buddy_compress=buddy_compress)
         self._rejoin = bool(rejoin)
         # pp_recut=True (default): a host loss on a >1 pp mesh re-cuts
         # the K logical stages over the surviving slots (multiple
@@ -1800,12 +1992,6 @@ class ElasticTrainer(PodResilientTrainer):
         from .compiler import CompiledProgram
         t = trainer._target
         return t if isinstance(t, CompiledProgram) else None
-
-    @staticmethod
-    def _scope_of(trainer):
-        from .scope import global_scope
-        return trainer._scope if trainer._scope is not None \
-            else global_scope()
 
     def _current_shardings(self, trainer):
         """{var: NamedSharding} of every scope var over the trainer's
@@ -2216,6 +2402,11 @@ class ElasticTrainer(PodResilientTrainer):
         trainer._require_fresh_dir()
         trainer._save(0)
         co.barrier(run_tag + "pod_start", hid)
+        # seed the buddy mailboxes at gen 0 (post-barrier membership =
+        # the agreed ring); reset= because a second run() on the same
+        # coordinator starts below the previous run's generations
+        self._buddy_send(co, hid, trainer, sorted(co.live_hosts()), 0,
+                         feed, reset=True)
         if n == 0:
             co.barrier(run_tag + "pod_end", hid)
             return []
@@ -2403,6 +2594,17 @@ class ElasticTrainer(PodResilientTrainer):
                 if strag and step % ckpt_every != 0 and step != n:
                     trainer._save(step)
                     record_event("straggler_ckpt", step=step)
+                if not pp_rewind and pp_recut is None:
+                    # buddy send rides the committed boundary, ringed
+                    # over THIS round's frozen live set (an elastic
+                    # shrink re-rings automatically). A pp-loss round
+                    # SKIPS it: the lost host's mailbox is pinned at
+                    # the previous boundary and the rewind tail below
+                    # needs every owner at that same generation —
+                    # survivors advancing would turn a recoverable
+                    # loss into buddy_stale
+                    self._buddy_send(co, hid, trainer, live, step,
+                                     feed)
             if pp_recut is not None:
                 # RE-CUT at the committed boundary: the survivors'
                 # all-ok window is already committed above, so the
@@ -2419,6 +2621,10 @@ class ElasticTrainer(PodResilientTrainer):
                     self._retarget(trainer, base_axes, live,
                                    "elastic_pp_recut", lost=lost,
                                    step=step, recut_slots=pp_recut)
+                    # re-cut committed: refresh the buddy tier over
+                    # the re-stacked membership at this boundary
+                    self._buddy_send(co, hid, trainer, live, step,
+                                     feed)
                 except Exception as e:
                     pp_rewind = True
                     st = self._target_strategy(trainer)
@@ -2483,6 +2689,13 @@ class ElasticTrainer(PodResilientTrainer):
                             # saved by this window's normal save.
                             if step % ckpt_every != 0 and step != n:
                                 trainer._save(step)
+                            # the ring changed (the joiner is back):
+                            # re-seed every mailbox over the NEW
+                            # membership at the common sync step —
+                            # reset= because the pod may sit below a
+                            # pre-rejoin mailbox generation
+                            self._buddy_send(co, hid, trainer, live,
+                                             step, feed, reset=True)
                     except HostLostError:
                         # WE were fenced mid-admission (e.g. our ship
                         # write outlasted a barrier timeout): the same
@@ -2606,8 +2819,25 @@ class ElasticTrainer(PodResilientTrainer):
             #    losses, and only real FAULTS may exhaust the budget.
             #    Deterministic pod-wide: pp_rewind and the statuses are
             #    computed from the same frozen verdicts on every host.
-            free_rewind = pp_rewind and \
-                all(v == "ok" for v in statuses.values())
+            all_ok = all(v == "ok" for v in statuses.values())
+            free_rewind = pp_rewind and all_ok
+            # buddy generation this round can agree on: a COMMITTED
+            # pp-loss round already advanced step (its buddy send was
+            # skipped), so the mailboxes sit at the previous boundary;
+            # an uncommitted fault round's mailboxes match this one
+            bgen = step - w if all_ok else step
+            breason = None
+            if self._buddy:
+                from . import buddy as buddy_mod
+                breason = buddy_mod.agree_plan(
+                    co, hid, "%sb%d" % (run_tag, rnd), live, lost,
+                    sorted(set(live) | set(lost)), bgen)
+                if breason == "buddy_and_host_lost":
+                    # the lost shard's warm replica died WITH it: real
+                    # state is gone and the recovery is no longer the
+                    # budget-free re-anchoring — this double failure
+                    # charges the restart budget exactly once
+                    free_rewind = False
             if not free_rewind:
                 restarts += 1
                 if restarts > self._max_restarts:
@@ -2626,28 +2856,46 @@ class ElasticTrainer(PodResilientTrainer):
             # rewind publishes an empty set like any healthy host)
             self._agree_poison(co, hid, run_tag, rnd, trainer, step,
                                err)
-            from .. import io as io_mod
-            report = io_mod.scrub_checkpoint(trainer._ckpt_dir)
-            agreed_step = co.elect_restore_step(
-                hid, report["valid_steps"],
-                name="%se%d" % (run_tag, rnd))
             if feed is not None and lost:
-                # a shrink and a transient fault in the SAME window:
-                # re-home the dead host's lanes first so the cursor
-                # restore maps lane ownership onto the surviving set
+                # a shrink and a fault in the SAME window: re-home the
+                # dead host's lanes first so the cursor restore (buddy
+                # or disk) maps lane ownership onto the surviving set
                 feed.rebalance(live, lags=self._agreed_lags(verdicts))
-            got = trainer._restore(
-                step=agreed_step,
-                shardings=self._current_shardings(trainer),
-                # the checkpoint's owner map may predate this window's
-                # membership — any orphan re-placement inside the
-                # cursor restore must use the AGREED lag snapshot, not
-                # each process's local gauges
+            # WARM path first: adopt the agreed buddy generation —
+            # at most one window lost, no disk read. Any typed doubt
+            # (breason) already fell the pod back below.
+            got = self._buddy_restore(
+                co, hid, run_tag, rnd, trainer, bgen, live, lost=lost,
+                shardings=self._current_shardings(trainer), feed=feed,
                 feed_lags=None if feed is None
-                else self._agreed_lags(verdicts))
+                else self._agreed_lags(verdicts),
+                agreed=True, reason=breason)
+            from_disk = got is None
+            if from_disk:
+                from .. import io as io_mod
+                report = io_mod.scrub_checkpoint(trainer._ckpt_dir)
+                agreed_step = co.elect_restore_step(
+                    hid, report["valid_steps"],
+                    name="%se%d" % (run_tag, rnd))
+                got = trainer._restore(
+                    step=agreed_step,
+                    shardings=self._current_shardings(trainer),
+                    # the checkpoint's owner map may predate this
+                    # window's membership — any orphan re-placement
+                    # inside the cursor restore must use the AGREED
+                    # lag snapshot, not each process's local gauges
+                    feed_lags=None if feed is None
+                    else self._agreed_lags(verdicts))
             # the restored scope carries the LR (and applied-factor
             # marker) from save time — reconcile with CURRENT capacity
             self._apply_lr_scale(trainer, live)
+            if from_disk:
+                # the disk rewind moved the pod below the mailbox
+                # generations (and a poison replay may change the
+                # trajectory): re-seed the buddy tier from the
+                # restored state, reset= bypassing the rewind fence
+                self._buddy_send(co, hid, trainer, live, got, feed,
+                                 reset=True)
             record_event("pod_restore", step=got)
             step = got
         co.barrier(run_tag + "pod_end", hid)
@@ -2689,6 +2937,11 @@ class ElasticTrainer(PodResilientTrainer):
             # the sync step becomes that common point (survivors write
             # it too when it is not already a boundary they saved)
             trainer._save(new_step)
+            # rejoin re-seed, mirroring the survivors' (they re-ring
+            # over the grown membership at this same sync step): this
+            # host's mailbox still holds its pre-death generation
+            self._buddy_send(co, hid, trainer, live, new_step,
+                             trainer._feed, reset=True)
         except HostLostError:
             # fenced AGAIN mid-admission (we were too slow to meet a
             # ship barrier): the survivors already moved on — stay out

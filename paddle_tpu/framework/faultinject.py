@@ -113,6 +113,14 @@ SITES = {
     "serving.dispatch": OSError,
     # replica about to run one /infer body
     "serving.infer": RuntimeError,
+    # buddy-checkpoint tier: one window-boundary snapshot is about to
+    # be put_blob'd to the buddy host (a raise here must leave the
+    # PREVIOUS generation on the coord server, still restorable)
+    "buddy.send": ConnectionError,
+    # buddy restore about to decode an adopted snapshot (a raise here
+    # is a torn snapshot: the pod must fall back to the disk rewind
+    # with reason="snapshot_torn", never adopt half-decoded state)
+    "buddy.restore": RuntimeError,
 }
 
 # exception classes a ``raise=ExcName`` arg may name

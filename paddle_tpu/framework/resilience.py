@@ -52,6 +52,7 @@ __all__ = [
     "metrics", "metrics_text", "parse_metrics_text",
     "serve_metrics", "MetricsServer", "ElasticTrainer",
     "record_bytes", "bytes_totals", "clear_bytes",
+    "record_buddy_gen", "buddy_gens", "clear_buddy_gens",
     "record_router_request", "record_router_retry",
     "observe_router_batch",
     "set_router_queue_depth", "set_router_inflight",
@@ -219,6 +220,7 @@ def clear_events():
     clear_exec()
     clear_kernel_choice()
     clear_analysis()
+    clear_buddy_gens()
 
 
 # ---------------------------------------------------------------------------
@@ -237,7 +239,7 @@ RESTORE_LATENCY_BUCKETS = (0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0, 120.0)
 # and counters must never wrap anyway. Channel -> {"raw", "wire"}.
 _BYTES = {}
 _BYTES_LOCK = threading.Lock()
-BYTES_CHANNELS = ("collective", "stateship", "ckpt")
+BYTES_CHANNELS = ("collective", "stateship", "ckpt", "buddy_snapshot")
 
 
 def record_bytes(channel, raw, wire):
@@ -249,6 +251,35 @@ def record_bytes(channel, raw, wire):
         c = _BYTES.setdefault(str(channel), {"raw": 0, "wire": 0})
         c["raw"] += int(raw)
         c["wire"] += int(wire)
+
+
+# Buddy-snapshot generation gauges: one value per host at WINDOW rate —
+# a per-window event would churn the bounded log, so the last published
+# generation lives in a cumulative store (cleared with the log). The
+# serving probe's strict mode compares these across live hosts: a
+# divergence of more than one window means some host's snapshots are
+# not landing.
+_BUDDY_GEN = {}
+_BUDDY_GEN_LOCK = threading.Lock()
+
+
+def record_buddy_gen(host, gen):
+    """Record the buddy-snapshot generation ``host`` last published
+    (or adopted at restore). Exported by :func:`metrics` as the gauge
+    ``<prefix>_buddy_generation{host=}``."""
+    with _BUDDY_GEN_LOCK:
+        _BUDDY_GEN[int(host)] = int(gen)
+
+
+def buddy_gens():
+    """{host: generation} snapshot of the buddy-generation gauges."""
+    with _BUDDY_GEN_LOCK:
+        return dict(_BUDDY_GEN)
+
+
+def clear_buddy_gens():
+    with _BUDDY_GEN_LOCK:
+        _BUDDY_GEN.clear()
 
 
 # Trace-time kernel-selection accounting (ops.pallas_dispatch.choose):
@@ -727,6 +758,23 @@ def metrics(event_list=None, by_host=False):
       <prefix>_restore_latency_seconds       checkpoint-restore wall time
                                              (from restore events'
                                              latency_s)
+      <prefix>_buddy_snapshot_bytes_total{kind=}  raw-vs-wire bytes of
+                                             the buddy-checkpoint tier's
+                                             window snapshots (rides the
+                                             same record_bytes channel
+                                             discipline as the pairs
+                                             above)
+      <prefix>_buddy_restore_total{outcome=} buddy-restore attempts by
+                                             outcome (ok, or the typed
+                                             disk-fallback reason:
+                                             buddy_missing/buddy_stale/
+                                             buddy_and_host_lost/
+                                             snapshot_torn)
+      <prefix>_buddy_generation{host=}       gauge: the buddy-snapshot
+                                             generation each host last
+                                             published (strict probes
+                                             compare these across live
+                                             hosts)
 
     The result dict also carries a ``gauges`` list (same shape as
     counters) for the feed-plane last-value series.
@@ -981,6 +1029,23 @@ def metrics(event_list=None, by_host=False):
         {"name": METRIC_PREFIX + "_numeric_fault_total",
          "labels": {"policy": p, "culprit": c}, "value": n}
         for (p, c), n in sorted(nf_counts.items())]
+    # buddy-checkpoint tier (framework/buddy.py): restore outcomes by
+    # label plus the per-host last-published-generation gauge — emitted
+    # only for pods that ever ran the buddy tier, so plain jobs export
+    # nothing new. serving_probe --strict compares the generation
+    # gauges across live hosts (divergence > 1 window = some host's
+    # snapshots are not landing).
+    br_counts = collections.Counter(
+        e.get("outcome", "?") for e in evs
+        if e["kind"] == "buddy_restore")
+    counters += [
+        {"name": METRIC_PREFIX + "_buddy_restore_total",
+         "labels": {"outcome": o}, "value": n}
+        for o, n in sorted(br_counts.items())]
+    gauges += [
+        {"name": METRIC_PREFIX + "_buddy_generation",
+         "labels": {"host": str(h)}, "value": g}
+        for h, g in sorted(buddy_gens().items())]
     # span-ring overflow (obs tentpole): dropped spans mean a merged
     # timeline is LYING about what happened — exported whenever the
     # engine is on (0 = trustworthy) or anything was ever dropped, so

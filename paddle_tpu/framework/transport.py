@@ -101,7 +101,8 @@ _DEFAULT_HB_INTERVAL_S = 0.5
 # promoted standby must never rewind. hb/ack are ASYNC — leases are
 # refreshed at promotion anyway, and a lost ack only delays cleanup.
 _SYNC_CMDS = frozenset(("hello", "mark_lost", "announce_join",
-                        "unfence", "put", "put_info", "resize"))
+                        "unfence", "put", "put_info", "put_blob",
+                        "resize"))
 _MUTATING_CMDS = _SYNC_CMDS | frozenset(("hb", "ack"))
 _REPL_CMDS = frozenset(("repl_sync", "repl_apply", "repl_snapshot",
                         "repl_hb"))
@@ -184,6 +185,13 @@ class _PodState(object):
         self.rounds = {}
         self.hb = {}
         self.info = {}
+        # buddy-checkpoint mailboxes: {owner: {"gen", "buddy", "blob"}}.
+        # Bounded by construction — ONE generation per owner, overwritten
+        # in place every window (put_blob refuses a gen rewind). An
+        # entry models a replica living in the buddy host's RAM, so it
+        # is evicted only when owner AND buddy are both tombstoned —
+        # the one case where nobody holds the bytes anymore.
+        self.blobs = {}
         self.completed = collections.deque(maxlen=2048)
         self.role = "primary"
         self.term = 0
@@ -202,7 +210,19 @@ class _PodState(object):
         self.lost[host_id] = str(reason)
         self.lost_version += 1
         self.joins.pop(host_id, None)
+        self._evict_orphan_blobs()
         return True
+
+    def _evict_orphan_blobs(self):
+        """Drop buddy snapshots whose owner AND recorded buddy are both
+        tombstoned: in the physical system those bytes lived in the
+        buddy's RAM, so a double failure loses them — keeping the
+        mailbox would let a restore adopt state no live host vouches
+        for. A dead owner whose buddy is alive keeps its mailbox:
+        that IS the buddy-restore case."""
+        for owner in [o for o, rec in self.blobs.items()
+                      if o in self.lost and rec["buddy"] in self.lost]:
+            del self.blobs[owner]
 
     def _scan_heartbeats(self, now):
         """Tombstone every registered, un-fenced host whose heartbeat is
@@ -261,6 +281,7 @@ class _PodState(object):
                        "acks": sorted(r["acks"])}
                 for name, r in self.rounds.items()},
             "info": {str(h): v for h, v in self.info.items()},
+            "blobs": {str(h): rec for h, rec in self.blobs.items()},
             "hb_hosts": sorted(self.hb),
             "completed": list(self.completed),
         }
@@ -290,6 +311,9 @@ class _PodState(object):
                    "acks": set(r.get("acks", ()))}
             for name, r in snap.get("rounds", {}).items()}
         self.info = {int(h): v for h, v in snap.get("info", {}).items()}
+        # absent in pre-buddy snapshots (default: no mailboxes)
+        self.blobs = {int(h): rec
+                      for h, rec in snap.get("blobs", {}).items()}
         self.hb = {int(h): now for h in snap.get("hb_hosts", ())}
         if self.hb_deadline_s is not None:
             # restart grace, same reasoning as the promotion holdoff
@@ -1236,6 +1260,59 @@ def _dispatch(state, cmd, hid, req, now):
             return {"error": "put_info needs a host id"}
         state.info[hid] = req.get("info")
         return {"ok": True}
+    if cmd == "put_blob":
+        # buddy-checkpoint mailbox write: ONE generation per owner
+        # (bounded memory), generation-fenced so a delayed/replayed
+        # put can never rewind the mailbox below what a restore may
+        # already have adopted. Primary-replicated (_SYNC_CMDS) and
+        # snapshot-covered: a coordinator failover mid-window keeps
+        # every acked snapshot.
+        if hid is None:
+            return {"error": "put_blob needs a host id"}
+        if hid in state.lost:
+            return {"fenced": state.lost[hid], "lost": dict(state.lost)}
+        try:
+            gen = int(req["gen"])
+            buddy = int(req["buddy"])
+        except (KeyError, TypeError, ValueError):
+            return {"error": "put_blob needs integer gen and buddy"}
+        prev = state.blobs.get(hid)
+        if req.get("reset"):
+            # post-disk-restore re-seed: the pod legitimately rewound
+            # below the mailbox generation (and a poison-batch replay
+            # may change the trajectory, so even an equal-gen blob is
+            # from the WRONG history) — force-overwrite, bypassing the
+            # rewind fence
+            state.blobs[hid] = {"gen": gen, "buddy": buddy,
+                                "blob": req.get("blob")}
+            return {"ok": True, "reset": True}
+        if prev is not None and gen < int(prev["gen"]):
+            return {"error": "put_blob generation rewind: host %d is "
+                    "at gen %d on the server, refused gen %d"
+                    % (hid, int(prev["gen"]), gen)}
+        if prev is not None and gen == int(prev["gen"]):
+            # same client re-sending after a reconnect or a failover
+            # onto the promoted standby: idempotent, keyed by gen
+            return {"ok": True, "resent": True}
+        state.blobs[hid] = {"gen": gen, "buddy": buddy,
+                            "blob": req.get("blob")}
+        return {"ok": True}
+    if cmd == "get_blob":
+        # read-only mailbox fetch; meta_only skips the payload so the
+        # restore election can poll generations cheaply. No fencing:
+        # a fenced survivor reading its own (or a dead peer's) last
+        # snapshot is exactly the restore path.
+        try:
+            owner = int(req["owner"])
+        except (KeyError, TypeError, ValueError):
+            return {"error": "get_blob needs an integer owner"}
+        rec = state.blobs.get(owner)
+        if rec is None:
+            return {"miss": True}
+        resp = {"gen": int(rec["gen"]), "buddy": int(rec["buddy"])}
+        if not req.get("meta_only"):
+            resp["blob"] = rec["blob"]
+        return resp
     if cmd == "members":
         # one poll answers the whole routing question: who is
         # registered (info), who is fenced (lost — versioned by the
@@ -1290,6 +1367,7 @@ def _dispatch(state, cmd, hid, req, now):
                 state.joins.pop(h, None)
                 state.hb.pop(h, None)
                 state.info.pop(h, None)
+                state.blobs.pop(h, None)
             state.lost_version += 1
         else:
             for h in range(state.n_hosts, want):

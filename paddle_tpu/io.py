@@ -9,6 +9,7 @@ checkpoint/resume safety.
 import json
 import os
 import tempfile
+import threading
 
 import numpy as np
 
@@ -310,6 +311,64 @@ def _decode_member(z, key):
     return arr
 
 
+def encode_state_blob(arrays, step, compress="zlib", feed_state=None):
+    """One JSON-safe blob of a ``{name: array}`` state snapshot, using
+    the CHECKPOINT payload codec (:func:`_encode_payload` /
+    :func:`_decode_member`, same npz member layout and q8 companions) —
+    the buddy-checkpoint tier and any future in-memory state movement
+    share the disk format's exact encode/decode instead of growing a
+    second one. ``compress`` follows save_checkpoint: None (plain npz),
+    "zlib" (LOSSLESS deflate — the bitwise-parity default), "q8"
+    (block-quantized, LOSSY).
+
+    Returns ``(blob, raw_bytes, wire_bytes)`` where ``blob`` is a JSON-
+    serializable dict (the npz bytes ride base64) and the byte pair is
+    the record_bytes raw-vs-wire accounting."""
+    import base64
+    from io import BytesIO
+    if compress not in (None, "zlib", "q8"):
+        raise ValueError("encode_state_blob compress must be None, "
+                         "'zlib' or 'q8', got %r" % (compress,))
+    own, names = {}, {}
+    for name, arr in sorted(arrays.items()):
+        a = np.asarray(arr)
+        safe = name.replace("/", "#SL#")
+        names[safe] = name
+        own[safe] = a
+    raw = sum(int(a.nbytes) for a in own.values())
+    buf = BytesIO()
+    (np.savez_compressed if compress is not None else np.savez)(
+        buf, **_encode_payload(own, compress))
+    data = buf.getvalue()
+    blob = {"v": 1, "step": int(step),
+            "names": names,
+            "npz": base64.b64encode(data).decode("ascii")}
+    if compress is not None:
+        blob["compress"] = compress
+    if feed_state is not None:
+        blob["feed_state"] = feed_state
+    return blob, raw, len(data)
+
+
+def decode_state_blob(blob):
+    """Inverse of :func:`encode_state_blob`: returns
+    ``(arrays, step, feed_state)`` with q8 members transparently
+    dequantized. Raises on a torn/garbage blob (ValueError/KeyError/
+    zipfile errors) — callers treat any failure as ``snapshot_torn``
+    and fall back to the disk path."""
+    import base64
+    from io import BytesIO
+    data = base64.b64decode(blob["npz"])
+    names = blob.get("names", {})
+    out = {}
+    with np.load(BytesIO(data), allow_pickle=False) as z:
+        for key in z.files:
+            if key.endswith((_Q8_SCALE, _Q8_SHAPE, _Q8_DTYPE)):
+                continue
+            out[names.get(key, key)] = _decode_member(z, key)
+    return out, int(blob["step"]), blob.get("feed_state")
+
+
 class CheckpointFormatError(RuntimeError):
     """The checkpoint on disk is VALID but written by a newer library.
     Deliberately not an OSError/ValueError: load_checkpoint's corruption
@@ -588,22 +647,39 @@ def _prune_step_dirs(dirname, keep_last):
     newest ~keep_last dirs are classified (manifest JSON + npz member
     lists, never payloads), so the cost per save stays O(keep_last).
     keep_last <= 0 prunes nothing (the historical behavior — it must
-    never delete the checkpoint that was just committed)."""
+    never delete the checkpoint that was just committed).
+
+    Serialized against scrub_checkpoint by _RETENTION_LOCK: an async
+    commit's GC racing a restore election's scrub could otherwise
+    collect the very step the scrub just called valid (the buddy-tier
+    disk fallback elects from that report) — classification and
+    deletion must observe each other atomically."""
     import shutil
     if keep_last <= 0:
         return
-    kids = sorted([d for d in os.listdir(dirname)
-                   if d.startswith("step_")
-                   and d.split("_", 1)[1].isdigit()],
-                  key=lambda d: int(d.split("_")[1]), reverse=True)
-    seen_valid = 0
-    for d in kids:
-        if seen_valid >= keep_last:
-            shutil.rmtree(os.path.join(dirname, d), ignore_errors=True)
-            continue
-        status, _reason = _classify_step_dir(dirname, d)
-        if status == "valid":
-            seen_valid += 1
+    with _RETENTION_LOCK:
+        kids = sorted([d for d in os.listdir(dirname)
+                       if d.startswith("step_")
+                       and d.split("_", 1)[1].isdigit()],
+                      key=lambda d: int(d.split("_")[1]), reverse=True)
+        seen_valid = 0
+        for d in kids:
+            if seen_valid >= keep_last:
+                shutil.rmtree(os.path.join(dirname, d),
+                              ignore_errors=True)
+                continue
+            status, _reason = _classify_step_dir(dirname, d)
+            if status == "valid":
+                seen_valid += 1
+
+
+# One lock serializes retention GC (_prune_step_dirs, possibly on an
+# async-commit thread) against restore-side scrub classification
+# (scrub_checkpoint): a GC deleting dirs mid-scrub would let the scrub
+# report a valid step that no longer exists by the time the pod elects
+# it. Process-local by design — cross-process writers already serialize
+# through the pid0-only commit protocol.
+_RETENTION_LOCK = threading.Lock()
 
 
 def _stitch(meta, req, readers, dtype, name="<var>"):
@@ -758,23 +834,27 @@ def scrub_checkpoint(dirname):
     except OSError:
         pass
     counts = {"valid": 0, "corrupt": 0, "incomplete": 0}
-    for d in kids:
-        if not d.startswith("step_"):
-            continue
-        if ".corrupt" in d:
-            report["quarantined"].append(d)
-            continue
-        if not d.split("_", 1)[1].isdigit():
-            continue
-        status, reason = _classify_step_dir(dirname, d)
-        counts[status] += 1
-        step_no = _step_no(d)
-        report["steps"][step_no] = {"dir": d, "status": status,
-                                    "reason": reason}
-        if status == "valid" and reason is None:
-            # reason != None on a valid dir means "newer format" —
-            # intact, but THIS library cannot restore it
-            report["valid_steps"].append(step_no)
+    # classification runs under the retention lock (shared with
+    # _prune_step_dirs): a concurrent keep_last GC must not collect a
+    # step between this scrub calling it valid and the pod electing it
+    with _RETENTION_LOCK:
+        for d in kids:
+            if not d.startswith("step_"):
+                continue
+            if ".corrupt" in d:
+                report["quarantined"].append(d)
+                continue
+            if not d.split("_", 1)[1].isdigit():
+                continue
+            status, reason = _classify_step_dir(dirname, d)
+            counts[status] += 1
+            step_no = _step_no(d)
+            report["steps"][step_no] = {"dir": d, "status": status,
+                                        "reason": reason}
+            if status == "valid" and reason is None:
+                # reason != None on a valid dir means "newer format" —
+                # intact, but THIS library cannot restore it
+                report["valid_steps"].append(step_no)
     report["valid_steps"].sort()
     from .framework import resilience
     resilience.record_event("scrub", dirname=dirname,

@@ -91,7 +91,12 @@ def test_budget_table_covers_the_contract():
         "numerics_overhead_frac", "fault_recovery_ms",
         # ISSUE-18 elastic pp re-cut: decision commit -> first
         # completed post-re-cut step on the in-process pp=2 pod
-        "pp_recut_ms"}
+        "pp_recut_ms",
+        # ISSUE-19 in-memory buddy checkpointing: the per-window
+        # snapshot encode+send tax, the buddy restore wall, and the
+        # disk load_checkpoint wall it front-runs
+        "buddy_snapshot_ms", "buddy_restore_ms",
+        "buddy_disk_restore_ms"}
 
 
 def test_analysis_section_measures_the_verifier():
@@ -130,6 +135,18 @@ def test_pp_recut_section_measures_the_recut_wall():
     m = bench_micro.bench_pp_recut()
     assert 0 < m["pp_recut_ms"] < 30000.0
     assert m["pp_recut_resharded"] > 0
+
+
+def test_buddy_section_measures_both_restore_paths():
+    """ISSUE-19 satellite: the buddy section reports the per-window
+    snapshot encode+send tax and both recovery walls — the buddy
+    mailbox restore and the disk load_checkpoint it front-runs — all
+    inside their budgets (the section itself asserts the restored
+    state is bitwise, so a green wall is a CORRECT wall)."""
+    m = bench_micro.bench_buddy(windows=3)
+    assert 0 < m["buddy_snapshot_ms"] < 5000.0
+    assert 0 < m["buddy_restore_ms"] < 5000.0
+    assert 0 < m["buddy_disk_restore_ms"] < 10000.0
 
 
 def test_transport_section_measures_latency():

@@ -305,7 +305,7 @@ def _pp_pod_feeds(n, seed=7):
 
 
 def _pp_pod_trainer(main, startup, loss, ckdir, schedule="1f1b",
-                    pp=2, dp=4, m=4, recut=None):
+                    pp=2, dp=4, m=4, recut=None, ck_every=2):
     from paddle_tpu.framework.compiler import (BuildStrategy,
                                                CompiledProgram)
     from paddle_tpu.framework.resilience import (ResilientTrainer,
@@ -319,7 +319,7 @@ def _pp_pod_trainer(main, startup, loss, ckdir, schedule="1f1b",
     bs.mesh_axes = {"pp": recut or pp, "dp": dp}
     return ResilientTrainer(
         exe, CompiledProgram(main, bs), str(ckdir), fetch_list=[loss],
-        checkpoint_every=2, scope=sc,
+        checkpoint_every=ck_every, scope=sc,
         retry_policy=RetryPolicy(base_delay_s=0.0, jitter=0.0,
                                  sleep=lambda s: None))
 
@@ -429,3 +429,128 @@ def test_twin_pp_recut_infeasible_falls_back_to_rewind(tmp_path):
             continue
         losses = [float(np.asarray(o[0]).ravel()[0]) for o in out[h]]
         assert losses == ref_losses, (h, losses, ref_losses)
+
+
+# ---------------------------------------------------------------------------
+# buddy-checkpoint twins (ISSUE-19): the deterministic single-process
+# mirror of the procpod SIGKILL scenarios.  Disk checkpoints land every
+# 8 windows, so a host death in window 5 would cost a 4-window disk
+# rewind -- the buddy tier instead restores the gen-4 snapshots from
+# the coordination-plane mailboxes (<= 1 window lost, restart budget
+# untouched).  The double-failure twin kills a host AND its ring buddy
+# in the same window: the warm replica died with it, so the pod takes
+# the typed disk rewind and the budget is charged exactly once.
+# ---------------------------------------------------------------------------
+
+def test_twin_buddy_restore_skips_disk_rewind(tmp_path):
+    """Kill one host of a 3-host pp=2 pod in window 5 (pp_recut
+    disabled, disk checkpoints every 8): the pod restores WARM from
+    the buddy snapshots at step 4 -- not the step-0 disk baseline --
+    with zero pod_restart, no scrub, and survivor losses BITWISE the
+    uninterrupted reference's."""
+    from paddle_tpu.framework.coordination import (ElasticTrainer,
+                                                   LocalCoordinator)
+    n_steps = 8
+    feeds = _pp_pod_feeds(n_steps)
+    main, startup, loss = _pp_pod_program()
+
+    ref = _pp_pod_trainer(main, startup, loss, tmp_path / "ref",
+                          ck_every=8)
+    ref_losses = [float(np.asarray(o[0]).ravel()[0])
+                  for o in ref.run(feeds)]
+
+    resilience.clear_events()
+    trainers = [
+        _pp_pod_trainer(main, startup, loss, tmp_path / ("h%d" % h),
+                        ck_every=8)
+        for h in range(3)]
+    pod = ElasticTrainer(trainers, LocalCoordinator(3, timeout_s=300.0),
+                         rejoin=True, pp_recut=False)
+    # 3 hosts x 1-step windows: fires 13..15 are window 5, so the
+    # mailboxes hold the gen-4 boundary when the death lands
+    with resilience.inject("step:die@13"):
+        out = pod.run(feeds)
+
+    kinds = [e["kind"] for e in resilience.events()]
+    # warm recovery: a restore happened, but NOT from disk and NOT on
+    # the restart budget
+    assert "pod_restore" in kinds, kinds
+    for banned in ("pod_restart", "elastic_pp_recut", "scrub",
+                   "buddy_send_fail"):
+        assert banned not in kinds, kinds
+    rewinds = resilience.events("elastic_pp_rewind")
+    assert rewinds and all(e["reason"] == "disabled" for e in rewinds)
+    # the agreed restore point is the LAST WINDOW BOUNDARY (step 4),
+    # far past the only disk checkpoint (step 0): <= 1 window lost
+    assert {e["step"] for e in resilience.events("pod_restore")} == {4}
+    br = resilience.events("buddy_restore")
+    assert br and {e["outcome"] for e in br} == {"ok"}
+    assert {e["step"] for e in br} == {4}
+    died = {e["host"] for e in resilience.events("host_death")}
+    assert len(died) == 1, died
+    for h in range(3):
+        if h in died:
+            continue
+        losses = [float(np.asarray(o[0]).ravel()[0]) for o in out[h]]
+        assert len(losses) == n_steps
+        assert losses == ref_losses, (h, losses, ref_losses)
+    # metrics contract: the restore outcome counter and the per-host
+    # generation gauges ride resilience.metrics()
+    m = resilience.metrics()
+    br_counts = {c["labels"]["outcome"]: c["value"]
+                 for c in m["counters"]
+                 if c["name"].endswith("_buddy_restore_total")}
+    assert br_counts == {"ok": 2}
+    gens = {g["labels"]["host"]: g["value"] for g in m["gauges"]
+            if g["name"].endswith("_buddy_generation")}
+    assert len(gens) == 3
+
+
+def test_twin_buddy_and_host_lost_takes_typed_disk_rewind(tmp_path):
+    """The double failure: TWO of three hosts die in the same window.
+    On a 3-ring any dead pair is ring-adjacent, so one victim was the
+    other's buddy -- the warm replica is gone, the survivor agrees
+    ``buddy_and_host_lost``, takes the DISK rewind to the step-0
+    baseline, and the restart budget is charged EXACTLY once."""
+    from paddle_tpu.framework.coordination import (ElasticTrainer,
+                                                   LocalCoordinator)
+    n_steps = 8
+    feeds = _pp_pod_feeds(n_steps)
+    main, startup, loss = _pp_pod_program()
+
+    ref = _pp_pod_trainer(main, startup, loss, tmp_path / "ref",
+                          ck_every=8)
+    ref_losses = [float(np.asarray(o[0]).ravel()[0])
+                  for o in ref.run(feeds)]
+
+    resilience.clear_events()
+    trainers = [
+        _pp_pod_trainer(main, startup, loss, tmp_path / ("h%d" % h),
+                        ck_every=8)
+        for h in range(3)]
+    pod = ElasticTrainer(trainers, LocalCoordinator(3, timeout_s=300.0),
+                         rejoin=False, pp_recut=False)
+    # fires 13 and 14 both land in window 5: two distinct hosts die
+    # before the boundary commits
+    with resilience.inject("step:die@13;step:die@14"):
+        out = pod.run(feeds)
+
+    died = {e["host"] for e in resilience.events("host_death")}
+    assert len(died) == 2, died
+    survivor = (set(range(3)) - died).pop()
+    # the typed verdict: the buddy tier refused (replica died with its
+    # owner) and said so with one agreed label
+    br = resilience.events("buddy_restore")
+    assert br and {e["outcome"] for e in br} == {"buddy_and_host_lost"}
+    # the fallback is the real disk machinery: scrub + election to the
+    # step-0 baseline (next checkpoint would have been step 8)
+    assert resilience.events("scrub")
+    assert {e["step"] for e in resilience.events("pod_restore")} == {0}
+    # the double failure is NOT the budget-free pp re-anchoring:
+    # charged exactly once
+    restarts = resilience.events("pod_restart")
+    assert len(restarts) == 1, restarts
+    assert restarts[0]["restarts"] == 1
+    losses = [float(np.asarray(o[0]).ravel()[0]) for o in out[survivor]]
+    assert len(losses) == n_steps
+    assert losses == ref_losses, (losses, ref_losses)

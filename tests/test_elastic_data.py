@@ -336,9 +336,12 @@ def test_topology_change_resume_census(tmp_path):
     files = _sample_files(6, 4)                # 24 samples
     # checkpoint_every huge: the only common checkpoint is step 0, so
     # the rewind MUST cross the membership change
+    # buddy=False: the point is the DISK rewind crossing a membership
+    # change (a 3-lane cursor map re-mapped onto 2 survivors); the
+    # buddy tier would restore the newer post-shrink boundary instead
     pod, trainers, _ = _make_feed_pod(tmp_path, "topo", files, 3,
                                       checkpoint_every=100,
-                                      rejoin=False)
+                                      rejoin=False, buddy=False)
     with resilience.inject("step:die@7;step:preempt@12"):
         out = pod.run(None, steps=60)
     assert resilience.events("elastic_shrink")

@@ -424,7 +424,8 @@ def _toy_feeds(n, seed=0, batch=4):
     return out
 
 
-def _make_pod(tmp_path, tag, n_hosts=4, checkpoint_every=3, **trainer_kw):
+def _make_pod(tmp_path, tag, n_hosts=4, checkpoint_every=3, buddy=True,
+              **trainer_kw):
     """N simulated hosts: same program, per-host Scope/Executor/ckpt dir
     (initialized identically — the replicated-data-parallel shape)."""
     main, startup, loss = _toy_program()
@@ -438,7 +439,8 @@ def _make_pod(tmp_path, tag, n_hosts=4, checkpoint_every=3, **trainer_kw):
             fetch_list=[loss], checkpoint_every=checkpoint_every,
             scope=sc, retry_policy=_fast_policy(), **trainer_kw))
     pod = PodResilientTrainer(
-        trainers, LocalCoordinator(n_hosts, timeout_s=POD_TIMEOUT_S))
+        trainers, LocalCoordinator(n_hosts, timeout_s=POD_TIMEOUT_S),
+        buddy=buddy)
     return pod, trainers, loss
 
 
@@ -494,7 +496,10 @@ def test_pod_preempt_consensus_restore_bitwise_identical(tmp_path,
     ref_w = _pod_params(ref_trainers)
 
     guard = _ScrubPayloadGuard(monkeypatch)
-    chaos_pod, chaos_trainers, _ = _make_pod(tmp_path, "chaos")
+    # buddy=False: this is THE disk-consensus acceptance — the buddy
+    # tier would recover warm and the scrub phase under test never runs
+    chaos_pod, chaos_trainers, _ = _make_pod(tmp_path, "chaos",
+                                             buddy=False)
     with resilience.inject("step:preempt@7"):
         got_fetches = chaos_pod.run(feeds)
     got_w = _pod_params(chaos_trainers)
@@ -545,7 +550,10 @@ def test_pod_torn_checkpoint_lowers_consensus(tmp_path):
     ref_fetches = ref_pod.run(feeds)
     ref_w = _pod_params(ref_trainers)
 
-    chaos_pod, chaos_trainers, _ = _make_pod(tmp_path, "chaos")
+    # buddy=False: the torn-checkpoint ELECTION is what this test
+    # exercises — a warm buddy restore would never consult the scrub
+    chaos_pod, chaos_trainers, _ = _make_pod(tmp_path, "chaos",
+                                             buddy=False)
     # ckpt_write fires 1-4 are the per-host step-0 baselines; 5-8 the
     # step-3 saves -> @6 tears the second host to reach its save
     with resilience.inject("ckpt_write:io_error@6"):
@@ -631,7 +639,7 @@ def test_pod_rejects_mismatched_trainer_config(tmp_path):
 # ISSUE-17: numeric-fault rewind — pod-wide poison-batch agreement
 # ---------------------------------------------------------------------------
 
-def _numeric_pod(tmp_path, tag, n_hosts=3, policy="rewind"):
+def _numeric_pod(tmp_path, tag, n_hosts=3, policy="rewind", buddy=True):
     """Pod whose hosts run a CompiledProgram with a numeric policy:
     the in-graph finite mask + the trainers' consensus rewind."""
     main, startup, loss = _toy_program()
@@ -649,7 +657,8 @@ def _numeric_pod(tmp_path, tag, n_hosts=3, policy="rewind"):
             fetch_list=[loss], checkpoint_every=3, scope=sc,
             retry_policy=_fast_policy()))
     pod = PodResilientTrainer(
-        trainers, LocalCoordinator(n_hosts, timeout_s=POD_TIMEOUT_S))
+        trainers, LocalCoordinator(n_hosts, timeout_s=POD_TIMEOUT_S),
+        buddy=buddy)
     return pod, trainers, loss
 
 
@@ -672,7 +681,10 @@ def test_pod_rewind_skips_poison_batch_bitwise(tmp_path):
     ref_w = _pod_params(ref_tr)
     resilience.clear_events()
 
-    pod, trainers, _ = _numeric_pod(tmp_path, "chaos")
+    # buddy=False: the ISSUE-17 acceptance pins the DISK rewind to the
+    # step-3 snapshot; the buddy tier (tested in test_buddy) would
+    # restore the newer boundary instead
+    pod, trainers, _ = _numeric_pod(tmp_path, "chaos", buddy=False)
     with faultinject.failpoints("executor.step:corrupt=x@5^1"):
         out = pod.run(feeds)
 

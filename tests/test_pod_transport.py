@@ -1406,3 +1406,260 @@ def test_procpod_plain_gather_round_trip(tmp_path):
             if p.poll() is None:
                 p.kill()
         srv.close()
+
+
+# ---------------------------------------------------------------------------
+# the buddy-checkpoint procpod headline (ISSUE-19): real processes,
+# real SIGKILL, disk checkpoints every 8 windows -- the warm mailbox
+# tier absorbs a single host loss, and only the host+buddy double
+# failure pays the disk rewind
+# ---------------------------------------------------------------------------
+
+_BUDDY_WORKER = """\
+import hashlib
+import os
+import sys
+import time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+addr, hid, ckroot = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+
+import numpy as np
+import paddle_tpu as pt
+from paddle_tpu import layers, optimizer
+from paddle_tpu.distributed.pipeline_program import pp_stage_guard
+from paddle_tpu.framework.compiler import CompiledProgram, BuildStrategy
+from paddle_tpu.framework.scope import Scope, scope_guard
+from paddle_tpu.framework import resilience
+from paddle_tpu.framework.coordination import (SocketCoordinator,
+                                               ElasticTrainer)
+from paddle_tpu.framework.resilience import ResilientTrainer, RetryPolicy
+
+main, startup = pt.Program(), pt.Program()
+with pt.program_guard(main, startup):
+    x = layers.data("px", [8, 8], "float32", append_batch_size=False)
+    h = x
+    for i in range(2):
+        with pp_stage_guard(i):
+            h = layers.fc(h, size=8, act="tanh")
+    y = layers.data("py", [8, 8], "float32", append_batch_size=False)
+    loss = layers.reduce_mean(layers.square(h - y))
+    optimizer.SGD(0.2).minimize(loss)
+rng = np.random.RandomState(11)
+feeds = [{"px": rng.randn(8, 8).astype(np.float32),
+          "py": rng.randn(8, 8).astype(np.float32)} for _ in range(12)]
+sc, exe = Scope(), pt.Executor()
+with scope_guard(sc):
+    exe.run(startup)
+bs = BuildStrategy(pp_stages=2, pp_micro_batches=2)
+bs.mesh_axes = {"pp": 2, "dp": 2}
+# checkpoint_every=8: the ONLY disk checkpoints are the step-0
+# baseline and step 8 -- a mid-run fault that restores past 0 before
+# window 8 can only have come from the buddy mailboxes
+t = ResilientTrainer(
+    exe, CompiledProgram(main, bs), os.path.join(ckroot, "h%d" % hid),
+    fetch_list=[loss], checkpoint_every=8, scope=sc,
+    retry_policy=RetryPolicy(base_delay_s=0.0, jitter=0.0))
+# pace the windows so the parent's SIGKILL reliably lands mid-window
+orig = t._dispatch_batches
+def paced(*a, **k):
+    time.sleep(0.25)
+    return orig(*a, **k)
+t._dispatch_batches = paced
+co = SocketCoordinator(addr, 3, hid, timeout_s=60.0, poll_s=0.005,
+                       mesh_reinit=False, hb_interval_s=0.1)
+pod = ElasticTrainer([t], co, host_id=hid, rejoin=False,
+                     pp_recut=False)
+out = pod.run(feeds)
+kinds = sorted({e["kind"] for e in resilience.events()})
+print("EVENTS", hid, ",".join(kinds), flush=True)
+print("RESTORES", hid, ",".join(
+    str(e["step"]) for e in resilience.events("pod_restore")) or "-",
+    flush=True)
+print("BUDDY", hid, ",".join(
+    e["outcome"] for e in resilience.events("buddy_restore")) or "-",
+    flush=True)
+print("RESTARTS", hid, len(resilience.events("pod_restart")),
+      flush=True)
+dig = hashlib.sha256()
+for n in ("fc_0.w_0_0", "fc_0.b_0_0", "fc_1.w_0_0", "fc_1.b_0_0"):
+    dig.update(np.ascontiguousarray(sc.get_numpy(n)).tobytes())
+print("PARAMS", hid, dig.hexdigest(), flush=True)
+print("LOSSES", hid,
+      ",".join("%.17g" % float(np.asarray(o[0]).ravel()[0])
+               for o in out), flush=True)
+co.close()
+"""
+
+
+def _buddy_reference(tmp_path):
+    """The uninterrupted reference, computed in THIS process: the same
+    program/feeds/plan as _BUDDY_WORKER with no fault. A buddy restore
+    is bitwise (zlib codec), so survivors must reproduce exactly this
+    loss sequence and these final params."""
+    import hashlib
+    import paddle_tpu as _pt
+    from paddle_tpu.distributed.pipeline_program import pp_stage_guard
+    from paddle_tpu.framework.compiler import CompiledProgram, \
+        BuildStrategy
+
+    main, startup = _pt.Program(), _pt.Program()
+    with _pt.program_guard(main, startup):
+        x = layers.data("px", [8, 8], "float32", append_batch_size=False)
+        h = x
+        for i in range(2):
+            with pp_stage_guard(i):
+                h = layers.fc(h, size=8, act="tanh")
+        y = layers.data("py", [8, 8], "float32", append_batch_size=False)
+        loss = layers.reduce_mean(layers.square(h - y))
+        optimizer.SGD(0.2).minimize(loss)
+    rng = np.random.RandomState(11)
+    feeds = [{"px": rng.randn(8, 8).astype(np.float32),
+              "py": rng.randn(8, 8).astype(np.float32)}
+             for _ in range(12)]
+    sc, exe = Scope(), pt.Executor()
+    with scope_guard(sc):
+        exe.run(startup)
+    bs = BuildStrategy(pp_stages=2, pp_micro_batches=2)
+    bs.mesh_axes = {"pp": 2, "dp": 2}
+    ref = ResilientTrainer(
+        exe, CompiledProgram(main, bs), str(tmp_path / "buddyref"),
+        fetch_list=[loss], checkpoint_every=8, scope=sc,
+        retry_policy=_fast_policy())
+    losses = ["%.17g" % float(np.asarray(o[0]).ravel()[0])
+              for o in ref.run(feeds)]
+    dig = hashlib.sha256()
+    for n in ("fc_0.w_0_0", "fc_0.b_0_0", "fc_1.w_0_0", "fc_1.b_0_0"):
+        dig.update(np.ascontiguousarray(sc.get_numpy(n)).tobytes())
+    return losses, dig.hexdigest()
+
+
+def _spawn_buddy_worker(script, addr, hid, tmp_path):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [p for p in (env.get("PYTHONPATH"),
+                     os.path.dirname(os.path.dirname(
+                         os.path.abspath(__file__)))) if p])
+    env.pop("XLA_FLAGS", None)   # the worker pins its own 8-dev CPU
+    return subprocess.Popen(
+        [sys.executable, script, addr, str(hid), str(tmp_path / "ck")],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env)
+
+
+def _field(out, tag, hid):
+    lines = [ln for ln in out.splitlines()
+             if ln.startswith("%s %d" % (tag, hid))]
+    assert lines, (tag, out)
+    return lines[0].split(None, 2)[2]
+
+
+def _wait_equal_gens(srv, floor, timeout_s=240.0):
+    """Block until every host's mailbox holds the SAME generation
+    >= floor — i.e. a window boundary's sends have all landed and the
+    next boundary hasn't started committing."""
+    def cond(s):
+        gens = {s.blobs.get(h, {}).get("gen", -1) for h in range(3)}
+        return len(gens) == 1 and gens.pop() >= floor
+    _wait_state(srv, cond, "equal gen>=%d mailboxes" % floor,
+                timeout_s=timeout_s)
+
+
+@pytest.mark.procpod
+def test_procpod_buddy_restore_after_sigkill(tmp_path):
+    """THE buddy acceptance over REAL processes: 3 workers train a
+    pp=2 x dp=2 pod with disk checkpoints every 8 windows; SIGKILL one
+    mid-window once the gen-4 snapshots are acked. The survivors agree
+    the buddy restore -- pod_restore lands on a window boundary >= 4
+    (the only disk checkpoint behind them is step 0), at most one
+    window is lost, the restart budget is untouched, and their full
+    12-step loss sequence and final params are BITWISE the
+    uninterrupted reference's."""
+    ref_losses, ref_hash = _buddy_reference(tmp_path)
+    script = str(tmp_path / "buddy_worker.py")
+    with open(script, "w") as fh:
+        fh.write(textwrap.dedent(_BUDDY_WORKER))
+    srv = CoordServer(3, hb_deadline_s=1.0).start()
+    procs = {}
+    try:
+        for h in range(3):
+            procs[h] = _spawn_buddy_worker(script, srv.address, h,
+                                           tmp_path)
+        _wait_equal_gens(srv, 4)
+        os.kill(procs[2].pid, signal.SIGKILL)
+        procs[2].wait(timeout=10)
+        _wait_state(srv, lambda s: 2 in s.lost, "heartbeat tombstone")
+        outs = {}
+        for h in (0, 1):
+            out, _ = procs[h].communicate(timeout=180)
+            outs[h] = out
+            assert procs[h].returncode == 0, (h, out)
+        for h in (0, 1):
+            kinds = _field(outs[h], "EVENTS", h).split(",")
+            assert "pod_restore" in kinds, outs[h]
+            assert "buddy_restore" in kinds, outs[h]
+            # never the disk machinery, never the restart budget
+            for banned in ("pod_restart", "scrub", "elastic_pp_recut",
+                           "buddy_send_fail"):
+                assert banned not in kinds, (banned, outs[h])
+            assert _field(outs[h], "RESTARTS", h) == "0", outs[h]
+            # ONE warm restore, on a boundary the disk never saw:
+            # the step-0 baseline is the only checkpoint behind it
+            restores = _field(outs[h], "RESTORES", h).split(",")
+            assert len(restores) == 1, outs[h]
+            assert 4 <= int(restores[0]) < 12, outs[h]
+            assert _field(outs[h], "BUDDY", h) == "ok", outs[h]
+            got = _field(outs[h], "LOSSES", h).split(",")
+            assert got == ref_losses, (h, got, ref_losses)
+            assert _field(outs[h], "PARAMS", h) == ref_hash, outs[h]
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+        srv.close()
+
+
+@pytest.mark.procpod
+def test_procpod_host_and_buddy_sigkill_falls_back_to_disk(tmp_path):
+    """The double-failure leg over REAL processes: SIGKILL TWO of the
+    three workers back to back in the same window. On a 3-ring one
+    victim is always the other's buddy, so the survivor agrees the
+    typed ``buddy_and_host_lost`` verdict, rewinds from the step-0
+    DISK baseline (scrub + election), is charged EXACTLY one restart,
+    and still finishes bitwise equal to the reference."""
+    ref_losses, ref_hash = _buddy_reference(tmp_path)
+    script = str(tmp_path / "buddy_worker.py")
+    with open(script, "w") as fh:
+        fh.write(textwrap.dedent(_BUDDY_WORKER))
+    srv = CoordServer(3, hb_deadline_s=1.0).start()
+    procs = {}
+    try:
+        for h in range(3):
+            procs[h] = _spawn_buddy_worker(script, srv.address, h,
+                                           tmp_path)
+        _wait_equal_gens(srv, 4)
+        for h in (1, 2):
+            os.kill(procs[h].pid, signal.SIGKILL)
+        for h in (1, 2):
+            procs[h].wait(timeout=10)
+        _wait_state(srv, lambda s: {1, 2} <= set(s.lost),
+                    "both heartbeat tombstones")
+        out, _ = procs[0].communicate(timeout=180)
+        assert procs[0].returncode == 0, out
+        kinds = _field(out, "EVENTS", 0).split(",")
+        for needed in ("pod_restore", "buddy_restore", "pod_restart",
+                       "scrub"):
+            assert needed in kinds, (needed, out)
+        # the typed reason label, and the budget charged exactly once
+        assert _field(out, "BUDDY", 0) == "buddy_and_host_lost", out
+        assert _field(out, "RESTARTS", 0) == "1", out
+        assert _field(out, "RESTORES", 0) == "0", out
+        got = _field(out, "LOSSES", 0).split(",")
+        assert got == ref_losses, (got, ref_losses)
+        assert _field(out, "PARAMS", 0) == ref_hash, out
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+        srv.close()

@@ -87,7 +87,13 @@ def scrape_metrics(url, timeout_s=5.0):
     span ring overflowed and any merged timeline is missing spans)
     a "bytes" section with the compressed-movement raw-vs-wire
     pairs (collective/stateship/ckpt _bytes_total{kind=}) when the
-    replica exports any, and a "faults" section with the fault-plane
+    replica exports any, a "buddy" section with the buddy-checkpoint
+    tier's series (buddy_snapshot_bytes_total{kind=} raw/wire pairs,
+    buddy_restore_total{outcome=}, the per-host buddy_generation
+    gauges — ``--strict`` FAILS the probe when live hosts' generation
+    gauges diverge by more than one window, because a lagging mailbox
+    turns the next host loss into a full disk rewind), and a "faults"
+    section with the fault-plane
     series (failpoint_hits_total{site=}, the faultinject_armed gauge
     and numeric_fault_total{policy=,culprit=}) — ``--strict`` FAILS
     the probe when the armed gauge is nonzero, because live failpoint
@@ -101,7 +107,7 @@ def scrape_metrics(url, timeout_s=5.0):
         text = resp.read().decode("utf-8")
     samples = parse_metrics_text(text)
     events, feed, transport, router, bytes_sec = {}, {}, {}, {}, {}
-    obs_sec, qos, faults, elastic = {}, {}, {}, {}
+    obs_sec, qos, faults, elastic, buddy = {}, {}, {}, {}, {}
     for name, labels, value in samples:
         if name.startswith(METRIC_PREFIX + "_pp_"):
             # the elastic pipeline-re-cut series (pp_recut_total,
@@ -109,6 +115,23 @@ def scrape_metrics(url, timeout_s=5.0):
             # "elastic" group — --strict cross-checks pp_slots
             # against pp_live_hosts (see elastic_topology_flags)
             elastic[name[len(METRIC_PREFIX) + 1:]] = value
+            continue
+        if name.startswith(METRIC_PREFIX + "_buddy_"):
+            # the buddy-checkpoint tier folds under one "buddy" group:
+            # the snapshot raw/wire byte pairs, restore outcomes and
+            # the per-host last-published-generation gauges. Claimed
+            # BEFORE the generic *_bytes_total fold so the snapshot
+            # byte pairs don't scatter into "bytes" — --strict
+            # cross-checks the generation gauges across hosts (see
+            # buddy_generation_flags)
+            key = name[len(METRIC_PREFIX) + 1:]
+            if "kind" in labels:
+                key += "/" + labels["kind"]
+            if "outcome" in labels:
+                key += "/" + labels["outcome"]
+            if "host" in labels:
+                key += "/host" + labels["host"]
+            buddy[key] = value
             continue
         if name.startswith(METRIC_PREFIX + "_failpoint_") \
                 or name.startswith(METRIC_PREFIX + "_faultinject_") \
@@ -201,6 +224,8 @@ def scrape_metrics(url, timeout_s=5.0):
         out["faults"] = faults
     if elastic:
         out["elastic"] = elastic
+    if buddy:
+        out["buddy"] = buddy
     return out
 
 
@@ -298,6 +323,25 @@ def elastic_topology_flags(summary):
     return []
 
 
+def buddy_generation_flags(summary):
+    """Buddy-mailbox lag in a scrape summary (empty = healthy): the
+    per-host ``buddy_generation`` gauges record the last window
+    generation each live host streamed into its ring buddy's mailbox.
+    Hosts legitimately straddle ONE window boundary (a scrape can land
+    mid-round), but a spread beyond one window means some host's
+    snapshots are not landing — its buddy's mailbox is going stale,
+    and the next loss of that host becomes a full disk rewind
+    (reason=buddy_stale) instead of the warm sub-window restore the
+    tier exists for. ``--strict`` fails the probe on it."""
+    gens = {k: v for k, v in summary.get("buddy", {}).items()
+            if k.startswith("buddy_generation/")}
+    if gens and max(gens.values()) - min(gens.values()) > 1:
+        return ["buddy generation gauges diverge by more than one "
+                "window (a stale mailbox rewinds to disk on the next "
+                "host loss): %s" % sorted(gens.items())]
+    return []
+
+
 def fault_plane_flags(summary):
     """Fault-plane poison in a scrape summary (empty = healthy): a
     nonzero ``faultinject_armed`` gauge means live failpoint schedules
@@ -333,9 +377,10 @@ def main(argv=None):
                          "the obs series, tenant-vs-aggregate "
                          "quota-accounting drift in the qos series, "
                          "armed failpoints (faultinject_armed > 0) in "
-                         "the faults series, or a pp_slots-vs-"
+                         "the faults series, a pp_slots-vs-"
                          "pp_live_hosts disagreement in the elastic "
-                         "series")
+                         "series, or buddy_generation gauges diverging "
+                         "by more than one window in the buddy series")
     ap.add_argument("--metrics-url", default=None,
                     help="scrape a resilience.serve_metrics endpoint and "
                          "fold the event totals into the report")
@@ -384,6 +429,13 @@ def main(argv=None):
                 # torn elastic transition — loud always, fatal under
                 # --strict
                 health["elastic_topology"] = eflags
+                metrics_ok = False
+            bflags = buddy_generation_flags(health["metrics"])
+            if bflags:
+                # a host whose buddy snapshots stopped landing is one
+                # failure away from a disk rewind the tier was built
+                # to avoid — loud always, fatal under --strict
+                health["buddy_lag"] = bflags
                 metrics_ok = False
         except Exception as e:
             # a loadable replica with a dead metrics endpoint is still
