@@ -206,6 +206,15 @@ BUDGETS = {
     "buddy_snapshot_ms": ("max", 5000.0),
     "buddy_restore_ms": ("max", 5000.0),
     "buddy_disk_restore_ms": ("max", 10000.0),
+    # P2p buddy mailboxes + delta snapshots (ISSUE 20): one host-to-
+    # host deposit (encode + own-mailbox + buddy-mailbox + metadata
+    # commit) must stay in the same class as the legacy coordinator
+    # put, and on the churn-skewed reference scope (one large static
+    # embedding leaf + small churning leaves) the delta wire must move
+    # UNDER HALF the full-scope wire — the tier's pitch is "replicate
+    # every window without re-streaming the static majority".
+    "buddy_p2p_send_ms": ("max", 5000.0),
+    "buddy_delta_bytes_ratio": ("max", 0.5),
     # Program verifier (ISSUE 15): one strict walk over the BERT-base
     # pretrain program must stay interactive (it is pure Python, no
     # tracing), and on the shared small step it must cost well under
@@ -1121,6 +1130,39 @@ def bench_buddy(windows=5):
         assert got == windows
     finally:
         shutil.rmtree(root, ignore_errors=True)
+
+    # p2p + delta walls (ISSUE 20): the churn-skewed reference scope —
+    # one large STATIC embedding-style leaf (the bulk of real scopes:
+    # frozen or slowly-moving tables) plus small leaves that churn
+    # every window. The delta path should skip the static leaf after
+    # the first full send, so the per-window wire collapses to the
+    # churning minority; buddy_delta_bytes_ratio is the median
+    # delta-wire / last-full-wire across the timed windows.
+    rng = np.random.RandomState(7)
+    churn = {"emb/table": rng.randn(1024, 256).astype(np.float32)}
+    for i in range(4):
+        churn["head/w%d" % i] = rng.randn(64, 64).astype(np.float32)
+    co2 = LocalCoordinator(2, timeout_s=60.0)
+    tracker = buddy.DeltaTracker(rebase_every=windows + 2)
+    assert buddy.send_snapshot(co2, 0, members, 0, churn,
+                               tracker=tracker)   # seed full (untimed)
+    p2p_walls, ratios = [], []
+    for gen in range(1, windows + 1):
+        for i in range(4):   # only the small heads churn
+            churn["head/w%d" % i] = rng.randn(64, 64).astype(np.float32)
+        t0 = time.perf_counter()
+        assert buddy.send_snapshot(co2, 0, members, gen, churn,
+                                   tracker=tracker)
+        p2p_walls.append((time.perf_counter() - t0) * 1e3)
+        ratios.append(resilience.buddy_delta_ratio())
+    out["buddy_p2p_send_ms"] = round(statistics.median(p2p_walls), 3)
+    out["buddy_delta_bytes_ratio"] = round(statistics.median(ratios), 6)
+    # the chain restores bitwise through the delta links
+    rec = co2.mailbox_of(1).reconstruct(0)
+    got_arrays, step, _fs = io_mod.decode_state_blob(rec["blob"])
+    assert step == windows
+    for name, ref in churn.items():
+        np.testing.assert_array_equal(got_arrays[name], ref)
     resilience.clear_buddy_gens()
     return out
 

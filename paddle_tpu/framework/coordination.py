@@ -100,6 +100,16 @@ class NoQuorumError(CoordinationError):
     escalate to the orchestrator (cold start or manual repair)."""
 
 
+class BlobTooLargeError(CoordinationError):
+    """A legacy-mode ``put_blob`` payload exceeded the coordinator's
+    ``blob_max_bytes`` ceiling. Named so a misconfigured pod fails
+    TYPED (the buddy tier records buddy_send_fail and training keeps
+    the disk fallback) instead of silently growing the coordinator
+    process until the OOM killer fences the whole control plane. The
+    p2p mailbox tier has no such ceiling — payloads live in peer
+    host RAM."""
+
+
 # ---------------------------------------------------------------------------
 # coordinator contract + shared consensus logic
 # ---------------------------------------------------------------------------
@@ -142,6 +152,21 @@ class Coordinator(object):
         # keep the mailboxes on the CoordServer instead.
         self._blobs = {}
         self._blob_lock = threading.Lock()
+        # legacy put_blob payload ceiling (None = unbounded, the
+        # in-process default; CoordServer enforces its own finite one)
+        self.blob_max_bytes = None
+        # p2p buddy tier: per-host BuddyMailbox registry + the
+        # {owner: (gen, buddy, digest, nbytes)} metadata table. Same
+        # topology note as _blobs — Local's shared object makes the
+        # registry pod-wide (deposits really land in "the other
+        # host's" mailbox), File's per-process registry degrades every
+        # restore to buddy_missing. SocketCoordinator overrides the
+        # mailbox_*/put_buddy_meta surface to run a real per-host
+        # MailboxServer endpoint and keep the metadata on the
+        # CoordServer.
+        self._mailboxes = {}
+        self._buddy_meta = {}
+        self._mailbox_lock = threading.Lock()
 
     # -- subclass surface --------------------------------------------------
     def all_gather(self, name, host_id, value=None, timeout_s=None):
@@ -379,6 +404,15 @@ class Coordinator(object):
             raise HostLostError(
                 "host %d is fenced — a fenced host must not publish "
                 "buddy snapshots" % owner)
+        if self.blob_max_bytes is not None:
+            nb = len(blob.get("npz", "")) if isinstance(blob, dict) \
+                else (0 if blob is None else len(str(blob)))
+            if nb > self.blob_max_bytes:
+                raise BlobTooLargeError(
+                    "put_blob of %d bytes for host %d exceeds the "
+                    "coordinator's blob_max_bytes=%d ceiling — use "
+                    "the p2p mailbox tier for scopes this size"
+                    % (nb, owner, self.blob_max_bytes))
         with self._blob_lock:
             prev = self._blobs.get(owner)
             if reset:
@@ -408,6 +442,74 @@ class Coordinator(object):
                 out["blob"] = rec["blob"]
             return out
 
+    # -- p2p buddy mailboxes + metadata table -----------------------------
+    def mailbox_of(self, host_id):
+        """``host_id``'s :class:`buddy.BuddyMailbox`, created on first
+        touch. In the base (in-process) plane the registry is shared
+        by every host the coordinator object serves."""
+        from . import buddy as buddy_mod
+        hid = int(host_id)
+        with self._mailbox_lock:
+            mb = self._mailboxes.get(hid)
+            if mb is None:
+                mb = self._mailboxes[hid] = \
+                    buddy_mod.BuddyMailbox(host_id=hid)
+            return mb
+
+    def mailbox_send(self, owner, at, payload):
+        """Deposit ``owner``'s payload into host ``at``'s mailbox and
+        return the mailbox's ack/refusal dict. ``at == owner`` is the
+        free local self-deposit; anything else models the p2p stream
+        (a real one over MailboxServer in the socket plane)."""
+        return self.mailbox_of(at).deposit(owner, payload)
+
+    def mailbox_fetch(self, owner, at):
+        """Reconstruct ``owner``'s resident generation out of host
+        ``at``'s mailbox: ``{"gen", "digest", "blob"}``, or None when
+        the mailbox/slot is absent. Raises on chain/digest corruption
+        — the buddy tier maps every raise to ``snapshot_torn``."""
+        with self._mailbox_lock:
+            mb = self._mailboxes.get(int(at))
+        if mb is None:
+            return None
+        try:
+            return mb.reconstruct(owner)
+        except LookupError:
+            return None
+
+    def put_buddy_meta(self, host_id, gen, buddy, digest, nbytes,
+                       reset=False):
+        """Commit ``host_id``'s metadata row ``{gen, buddy, digest,
+        nbytes}`` — called ONLY after the buddy's mailbox acked the
+        deposit (ack-before-commit). Same generation fence and reset
+        bypass as :meth:`put_blob`, but metadata-sized."""
+        gen, owner = int(gen), int(host_id)
+        if owner in self.lost_hosts():
+            raise HostLostError(
+                "host %d is fenced — a fenced host must not publish "
+                "buddy metadata" % owner)
+        row = {"gen": gen, "buddy": int(buddy), "digest": digest,
+               "nbytes": int(nbytes)}
+        with self._mailbox_lock:
+            prev = self._buddy_meta.get(owner)
+            if reset:
+                self._buddy_meta[owner] = row
+                return
+            if prev is not None and gen < prev["gen"]:
+                raise CoordinationError(
+                    "put_buddy_meta generation rewind: host %d is at "
+                    "gen %d, refused gen %d" % (owner, prev["gen"],
+                                                gen))
+            if prev is None or gen > prev["gen"]:
+                self._buddy_meta[owner] = row
+
+    def buddy_meta(self, owner):
+        """``owner``'s committed metadata row (a copy) or None.
+        Read-only and unfenced, same reasoning as :meth:`get_blob`."""
+        with self._mailbox_lock:
+            rec = self._buddy_meta.get(int(owner))
+            return None if rec is None else dict(rec)
+
     def _evict_orphan_blobs(self):
         """Drop mailboxes whose owner AND recorded buddy are both lost
         (the physical bytes lived in the buddy's RAM — a double
@@ -417,6 +519,10 @@ class Coordinator(object):
             for o in [o for o, rec in self._blobs.items()
                       if o in lost and rec["buddy"] in lost]:
                 del self._blobs[o]
+        with self._mailbox_lock:
+            for o in [o for o, rec in self._buddy_meta.items()
+                      if o in lost and rec["buddy"] in lost]:
+                del self._buddy_meta[o]
 
     def _on_loss(self, newly_lost):
         """Fan out a host-loss: resilience event, mesh re-init, hooks."""
@@ -1021,12 +1127,15 @@ class SocketCoordinator(Coordinator):
     def __init__(self, address, n_hosts, host_id, timeout_s=30.0,
                  poll_s=0.01, poll_max_s=0.25, detect_loss=True,
                  mesh_reinit=True, heartbeat=True, hb_interval_s=0.5,
-                 retry_policy=None):
+                 retry_policy=None, mailbox=True,
+                 mailbox_host="127.0.0.1", mailbox_port=0):
         super(SocketCoordinator, self).__init__(
             n_hosts, timeout_s=timeout_s, detect_loss=detect_loss,
             mesh_reinit=mesh_reinit)
         from .transport import CoordClient
         self.host_id = int(host_id)
+        self._mb_server = None
+        self._mb_addrs = {}
         self.poll_s = float(poll_s)
         self.poll_max_s = max(self.poll_s, float(poll_max_s))
         self._known_lost = set()
@@ -1047,6 +1156,17 @@ class SocketCoordinator(Coordinator):
         # connection; the heartbeat (when armed) then takes the lease
         with obs.span("coord.hello", host=self.host_id):
             self._call("hello", n_hosts=self.n_hosts)
+        if mailbox:
+            # p2p buddy mailbox endpoint: started and registered BEFORE
+            # this constructor returns (and so before any pod_start
+            # barrier completes), so every peer can resolve this host's
+            # address by the time the first gen-0 seed streams.
+            from . import buddy as buddy_mod
+            from .transport import MailboxServer
+            self._mb_server = MailboxServer(
+                buddy_mod.BuddyMailbox(host_id=self.host_id),
+                host=mailbox_host, port=int(mailbox_port))
+            self._call("mailbox_hello", addr=self._mb_server.address)
         if obs.enabled():
             # align this process's span timestamps to the coordination
             # server's clock (min-RTT midpoint probe) — what lets one
@@ -1135,10 +1255,17 @@ class SocketCoordinator(Coordinator):
     def put_blob(self, host_id, gen, buddy, blob, reset=False):
         """Mailbox write on the CoordServer (see Coordinator.put_blob):
         synchronously replicated to standbys and snapshot-covered, so
-        an acked snapshot survives coordinator failover."""
-        resp = self._call("put_blob", host=int(host_id), gen=int(gen),
-                          buddy=int(buddy), blob=blob,
-                          reset=bool(reset))
+        an acked snapshot survives coordinator failover. The server's
+        ``blob_max_bytes`` refusal surfaces as
+        :class:`BlobTooLargeError`."""
+        try:
+            resp = self._call("put_blob", host=int(host_id),
+                              gen=int(gen), buddy=int(buddy),
+                              blob=blob, reset=bool(reset))
+        except CoordinationError as e:
+            if "blob_max_bytes" in str(e):
+                raise BlobTooLargeError(str(e))
+            raise
         if "fenced" in resp:
             raise HostLostError(
                 "host %d is fenced (%s) — a fenced host must not "
@@ -1153,6 +1280,111 @@ class SocketCoordinator(Coordinator):
         out = {"gen": int(resp["gen"]), "buddy": int(resp["buddy"])}
         if not meta_only:
             out["blob"] = resp.get("blob")
+        return out
+
+    # -- p2p buddy mailboxes (real per-host endpoints) ---------------------
+    def mailbox_of(self, host_id):
+        """This host's own mailbox when the endpoint is armed; the
+        in-process base registry otherwise (mailbox=False clients,
+        observers)."""
+        if self._mb_server is not None \
+                and int(host_id) == self.host_id:
+            return self._mb_server.mailbox
+        return super(SocketCoordinator, self).mailbox_of(host_id)
+
+    def _mailbox_addr(self, host_id):
+        """Resolve a peer's MailboxServer address from the local cache,
+        refreshed from the coordinator's replicated address book on a
+        miss."""
+        h = int(host_id)
+        addr = self._mb_addrs.get(h)
+        if addr is None:
+            resp = self._call("buddy_meta")
+            self._mb_addrs.update(
+                {int(k): a
+                 for k, a in resp.get("addrs", {}).items()})
+            addr = self._mb_addrs.get(h)
+        return addr
+
+    def _mailbox_request(self, host_id, req):
+        """One-shot request against ``host_id``'s mailbox endpoint. A
+        dead/renumbered endpoint drops the cached address before the
+        ConnectionError propagates, so the next attempt re-resolves."""
+        from .transport import mailbox_request
+        h = int(host_id)
+        addr = self._mailbox_addr(h)
+        if addr is None:
+            raise ConnectionError(
+                "no mailbox endpoint registered for host %d" % h)
+        try:
+            return mailbox_request(addr, req)
+        except ConnectionError:
+            self._mb_addrs.pop(h, None)
+            raise
+
+    def mailbox_send(self, owner, at, payload):
+        at = int(at)
+        if self._mb_server is not None and at == self.host_id:
+            return self._mb_server.mailbox.deposit(owner, payload)
+        if self._mb_server is None:
+            return super(SocketCoordinator, self).mailbox_send(
+                owner, at, payload)
+        resp = self._mailbox_request(
+            at, {"cmd": "mb_deposit", "owner": int(owner),
+                 "payload": payload})
+        if "error" in resp:
+            raise ConnectionError(
+                "mailbox deposit for host %d failed: %s"
+                % (int(owner), resp["error"]))
+        return resp
+
+    def mailbox_fetch(self, owner, at):
+        at = int(at)
+        if self._mb_server is not None and at == self.host_id:
+            try:
+                return self._mb_server.mailbox.reconstruct(owner)
+            except LookupError:
+                return None
+        if self._mb_server is None:
+            return super(SocketCoordinator, self).mailbox_fetch(
+                owner, at)
+        resp = self._mailbox_request(
+            at, {"cmd": "mb_fetch", "owner": int(owner)})
+        if resp.get("miss"):
+            return None
+        if "refused" in resp or "error" in resp:
+            raise RuntimeError(
+                "mailbox fetch for host %d refused: %s"
+                % (int(owner),
+                   resp.get("refused") or resp.get("error")))
+        return resp
+
+    def put_buddy_meta(self, host_id, gen, buddy, digest, nbytes,
+                       reset=False):
+        """Metadata commit on the CoordServer (replicated + snapshot-
+        covered) — see Coordinator.put_buddy_meta."""
+        resp = self._call("put_buddy_meta", host=int(host_id),
+                          gen=int(gen), buddy=int(buddy),
+                          digest=digest, nbytes=int(nbytes),
+                          reset=bool(reset))
+        if "fenced" in resp:
+            raise HostLostError(
+                "host %d is fenced (%s) — a fenced host must not "
+                "publish buddy metadata" % (int(host_id),
+                                            resp["fenced"]))
+
+    def buddy_meta(self, owner):
+        resp = self._call("buddy_meta", owner=int(owner))
+        if resp.get("miss"):
+            return None
+        out = {"gen": int(resp["gen"]), "buddy": int(resp["buddy"]),
+               "digest": resp.get("digest"),
+               "nbytes": int(resp.get("nbytes", 0))}
+        if resp.get("addr"):
+            # piggybacked address of the recorded buddy's endpoint —
+            # prime the cache so the restore-time pull needs no extra
+            # round-trip
+            self._mb_addrs[out["buddy"]] = resp["addr"]
         return out
 
     def members(self):
@@ -1281,6 +1513,8 @@ class SocketCoordinator(Coordinator):
         return result
 
     def close(self):
+        if self._mb_server is not None:
+            self._mb_server.close()
         self._client.close()
 
     def __enter__(self):
@@ -1326,7 +1560,9 @@ class PodResilientTrainer(object):
     """
 
     def __init__(self, trainers, coordinator=None, max_restarts=3,
-                 host_id=None, buddy=True, buddy_compress="zlib"):
+                 host_id=None, buddy=True, buddy_compress="zlib",
+                 buddy_p2p=True, buddy_delta=True,
+                 buddy_rebase_every=8):
         """``host_id=None`` (simulation): ``trainers`` holds ALL N hosts
         and run() drives them on N threads. ``host_id=i`` (production,
         one process per host): ``trainers`` holds exactly THIS host's
@@ -1344,14 +1580,33 @@ class PodResilientTrainer(object):
         snapshot codec: "zlib" (default) is bitwise-lossless — the
         restore stays bitwise the uninterrupted reference; "q8" is the
         lossy block codec for operators who accept its error envelope;
-        None mails full-width bytes."""
+        None mails full-width bytes.
+
+        ``buddy_p2p=True`` (default) keeps snapshot PAYLOADS in peer
+        mailboxes (the owner's own plus its ring buddy's) with the
+        coordinator holding only the metadata table;
+        ``buddy_p2p=False`` is the legacy coordinator-mailbox mode
+        (payloads ride put_blob, bounded by the coordinator's
+        blob_max_bytes ceiling). ``buddy_delta=True`` ships only the
+        leaves whose digest changed since the last acked generation,
+        re-based to a full send every ``buddy_rebase_every`` windows;
+        deltas require a bitwise codec, so q8 always sends full."""
         if not trainers:
             raise ValueError("PodResilientTrainer needs >= 1 trainer")
         if buddy_compress not in (None, "zlib", "q8"):
             raise ValueError("buddy_compress must be None, 'zlib' or "
                              "'q8', got %r" % (buddy_compress,))
+        if int(buddy_rebase_every) < 1:
+            raise ValueError("buddy_rebase_every must be >= 1, got %r"
+                             % (buddy_rebase_every,))
         self._buddy = bool(buddy)
         self._buddy_compress = buddy_compress
+        self._buddy_p2p = bool(buddy_p2p)
+        self._buddy_delta = bool(buddy_delta)
+        self._buddy_rebase_every = int(buddy_rebase_every)
+        # per-host sender-side delta trackers (simulation mode runs
+        # every host in this one object, so a dict keyed by host id)
+        self._buddy_trackers = {}
         self._trainers = list(trainers)
         every = {t._checkpoint_every for t in self._trainers}
         window = {t._steps_per_dispatch for t in self._trainers}
@@ -1472,10 +1727,18 @@ class PodResilientTrainer(object):
         if not self._buddy:
             return
         from . import buddy as buddy_mod
+        tracker = None
+        if self._buddy_p2p and self._buddy_delta:
+            tracker = self._buddy_trackers.get(int(hid))
+            if tracker is None:
+                tracker = self._buddy_trackers[int(hid)] = \
+                    buddy_mod.DeltaTracker(
+                        rebase_every=self._buddy_rebase_every)
         buddy_mod.send_snapshot(co, hid, members, gen,
                                 self._scope_of(trainer),
                                 compress=self._buddy_compress,
-                                feed=feed, reset=reset)
+                                feed=feed, reset=reset,
+                                p2p=self._buddy_p2p, tracker=tracker)
 
     def _buddy_restore(self, co, hid, run_tag, rnd, trainer, gen, live,
                        lost=(), shardings=None, feed=None,
@@ -1498,12 +1761,14 @@ class PodResilientTrainer(object):
         if not agreed:
             reason = buddy_mod.agree_plan(
                 co, hid, name, live, lost,
-                sorted(set(live) | set(lost)), gen)
+                sorted(set(live) | set(lost)), gen,
+                p2p=self._buddy_p2p)
         if reason is None:
             ok, feed_state = buddy_mod.restore_agreed(
                 co, hid, name, gen, self._scope_of(trainer),
                 shardings=shardings,
-                need_feed_state=feed is not None)
+                need_feed_state=feed is not None,
+                p2p=self._buddy_p2p)
             if ok:
                 if feed is not None:
                     feed.restore(feed_state, lags=feed_lags)
@@ -1830,10 +2095,14 @@ class ElasticTrainer(PodResilientTrainer):
                  ship_compress="zlib", drain_floor=None,
                  drain_cooldown=None, drain_hb_lag_s=None,
                  drain_stream_lag=None, sdc_detect=None,
-                 pp_recut=True, buddy=True, buddy_compress="zlib"):
+                 pp_recut=True, buddy=True, buddy_compress="zlib",
+                 buddy_p2p=True, buddy_delta=True,
+                 buddy_rebase_every=8):
         super(ElasticTrainer, self).__init__(
             trainers, coordinator=coordinator, max_restarts=max_restarts,
-            host_id=host_id, buddy=buddy, buddy_compress=buddy_compress)
+            host_id=host_id, buddy=buddy, buddy_compress=buddy_compress,
+            buddy_p2p=buddy_p2p, buddy_delta=buddy_delta,
+            buddy_rebase_every=buddy_rebase_every)
         self._rejoin = bool(rejoin)
         # pp_recut=True (default): a host loss on a >1 pp mesh re-cuts
         # the K logical stages over the surviving slots (multiple
@@ -2831,7 +3100,8 @@ class ElasticTrainer(PodResilientTrainer):
                 from . import buddy as buddy_mod
                 breason = buddy_mod.agree_plan(
                     co, hid, "%sb%d" % (run_tag, rnd), live, lost,
-                    sorted(set(live) | set(lost)), bgen)
+                    sorted(set(live) | set(lost)), bgen,
+                    p2p=self._buddy_p2p)
                 if breason == "buddy_and_host_lost":
                     # the lost shard's warm replica died WITH it: real
                     # state is gone and the recovery is no longer the

@@ -121,6 +121,21 @@ SITES = {
     # is a torn snapshot: the pod must fall back to the disk rewind
     # with reason="snapshot_torn", never adopt half-decoded state)
     "buddy.restore": RuntimeError,
+    # p2p buddy mailbox: the window snapshot (full or delta) is about
+    # to be streamed into the ring buddy's mailbox endpoint (a raise
+    # here is a torn stream: the buddy never acks, the coordinator
+    # metadata row is NOT advanced, and restore must plan buddy_stale
+    # -> disk, never elect the half-written payload)
+    "buddy.p2p_send": ConnectionError,
+    # p2p restore about to pull the snapshot host-to-host from the
+    # buddy's mailbox (a raise here must resolve to the typed
+    # snapshot_torn disk fallback, never a hang or a partial adopt)
+    "buddy.p2p_fetch": ConnectionError,
+    # buddy mailbox about to apply ONE delta link while reconstructing
+    # a chained snapshot (a raise here is a broken chain: reconstruct
+    # fails typed, the adopter falls back to disk, and the next send
+    # is forced full)
+    "buddy.delta_apply": RuntimeError,
 }
 
 # exception classes a ``raise=ExcName`` arg may name

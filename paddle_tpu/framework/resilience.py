@@ -53,6 +53,9 @@ __all__ = [
     "serve_metrics", "MetricsServer", "ElasticTrainer",
     "record_bytes", "bytes_totals", "clear_bytes",
     "record_buddy_gen", "buddy_gens", "clear_buddy_gens",
+    "record_buddy_resident", "buddy_resident",
+    "record_buddy_delta_ratio", "buddy_delta_ratio",
+    "record_buddy_fetch_ms", "buddy_fetch_ms",
     "record_router_request", "record_router_retry",
     "observe_router_batch",
     "set_router_queue_depth", "set_router_inflight",
@@ -280,6 +283,60 @@ def buddy_gens():
 def clear_buddy_gens():
     with _BUDDY_GEN_LOCK:
         _BUDDY_GEN.clear()
+    with _BUDDY_P2P_LOCK:
+        _BUDDY_RESIDENT.clear()
+        _BUDDY_P2P.clear()
+
+
+# P2p buddy-mailbox gauges (window/restore rate, so cumulative stores
+# outside the event log, cleared with the generation gauges).
+# _BUDDY_RESIDENT keys are STRINGS: mailbox hosts record under their
+# host id, the coordinator records its legacy-blob + metadata residency
+# under "coord" — the strict probe's memory-ceiling gate reads that row
+# and fails if the coordinator is holding payloads again.
+_BUDDY_RESIDENT = {}
+_BUDDY_P2P = {}
+_BUDDY_P2P_LOCK = threading.Lock()
+
+
+def record_buddy_resident(host, nbytes):
+    """Record the bytes resident in ``host``'s buddy mailbox (or, for
+    host="coord", in the coordinator's buddy stores). Exported by
+    :func:`metrics` as ``<prefix>_buddy_resident_bytes{host=}``."""
+    with _BUDDY_P2P_LOCK:
+        _BUDDY_RESIDENT[str(host)] = int(nbytes)
+
+
+def buddy_resident():
+    """{host: bytes} snapshot of the mailbox-residency gauges."""
+    with _BUDDY_P2P_LOCK:
+        return dict(_BUDDY_RESIDENT)
+
+
+def record_buddy_delta_ratio(ratio):
+    """Record one boundary send's wire ratio (this send's wire bytes /
+    the last FULL send's wire bytes — 1.0 for a full send, < 1 when the
+    delta skip is earning its keep). Exported as the gauge
+    ``<prefix>_buddy_delta_ratio``."""
+    with _BUDDY_P2P_LOCK:
+        _BUDDY_P2P["delta_ratio"] = float(ratio)
+
+
+def buddy_delta_ratio():
+    with _BUDDY_P2P_LOCK:
+        return _BUDDY_P2P.get("delta_ratio")
+
+
+def record_buddy_fetch_ms(ms):
+    """Record one host-to-host mailbox pull's latency. Exported as the
+    gauge ``<prefix>_buddy_p2p_fetch_ms``."""
+    with _BUDDY_P2P_LOCK:
+        _BUDDY_P2P["fetch_ms"] = float(ms)
+
+
+def buddy_fetch_ms():
+    with _BUDDY_P2P_LOCK:
+        return _BUDDY_P2P.get("fetch_ms")
 
 
 # Trace-time kernel-selection accounting (ops.pallas_dispatch.choose):
@@ -1046,6 +1103,21 @@ def metrics(event_list=None, by_host=False):
         {"name": METRIC_PREFIX + "_buddy_generation",
          "labels": {"host": str(h)}, "value": g}
         for h, g in sorted(buddy_gens().items())]
+    # p2p mailbox gauges: residency per mailbox host (the coordinator's
+    # row, host="coord", is the memory-ceiling gate serving_probe
+    # --strict enforces), the last send's delta wire ratio, and the
+    # last host-to-host pull latency. Nothing recorded -> nothing
+    # exported.
+    gauges += [
+        {"name": METRIC_PREFIX + "_buddy_resident_bytes",
+         "labels": {"host": str(h)}, "value": b}
+        for h, b in sorted(buddy_resident().items())]
+    if buddy_delta_ratio() is not None:
+        gauges.append({"name": METRIC_PREFIX + "_buddy_delta_ratio",
+                       "labels": {}, "value": buddy_delta_ratio()})
+    if buddy_fetch_ms() is not None:
+        gauges.append({"name": METRIC_PREFIX + "_buddy_p2p_fetch_ms",
+                       "labels": {}, "value": buddy_fetch_ms()})
     # span-ring overflow (obs tentpole): dropped spans mean a merged
     # timeline is LYING about what happened — exported whenever the
     # engine is on (0 = trustworthy) or anything was ever dropped, so

@@ -90,10 +90,10 @@ import time
 
 from . import faultinject
 from .coordination import GROW_FENCE_REASON
-from .resilience import RetryPolicy, record_event
+from .resilience import RetryPolicy, record_buddy_resident, record_event
 
 __all__ = ["TransportError", "CoordServer", "CoordClient",
-           "replicated_group"]
+           "replicated_group", "MailboxServer", "mailbox_request"]
 
 _DEFAULT_HB_INTERVAL_S = 0.5
 # ops the primary must confirm on the standbys before answering the
@@ -102,6 +102,7 @@ _DEFAULT_HB_INTERVAL_S = 0.5
 # refreshed at promotion anyway, and a lost ack only delays cleanup.
 _SYNC_CMDS = frozenset(("hello", "mark_lost", "announce_join",
                         "unfence", "put", "put_info", "put_blob",
+                        "put_buddy_meta", "mailbox_hello",
                         "resize"))
 _MUTATING_CMDS = _SYNC_CMDS | frozenset(("hb", "ack"))
 _REPL_CMDS = frozenset(("repl_sync", "repl_apply", "repl_snapshot",
@@ -117,6 +118,27 @@ class TransportError(ConnectionError):
 def _split_addr(address):
     host, _, port = str(address).rpartition(":")
     return (host or "127.0.0.1", int(port))
+
+
+def _blob_nbytes(blob):
+    """Resident size of one legacy put_blob payload (the base64 npz
+    text dominates; non-dict payloads are sized by their repr)."""
+    if isinstance(blob, dict):
+        return len(blob.get("npz", ""))
+    return 0 if blob is None else len(str(blob))
+
+
+def _record_coord_resident(state):
+    """Export what THIS coordinator process holds for the buddy tier
+    (legacy blob payloads + the p2p metadata table) as the
+    ``buddy_resident_bytes{host="coord"}`` gauge — the memory-ceiling
+    regression gate serving_probe --strict enforces. Callers hold
+    ``state.lock``."""
+    n = sum(_blob_nbytes(rec.get("blob"))
+            for rec in state.blobs.values())
+    n += len(json.dumps(
+        {str(h): rec for h, rec in state.buddy_meta.items()}))
+    record_buddy_resident("coord", n)
 
 
 def _probe_status(address, timeout_s=1.0):
@@ -192,6 +214,18 @@ class _PodState(object):
         # is evicted only when owner AND buddy are both tombstoned —
         # the one case where nobody holds the bytes anymore.
         self.blobs = {}
+        # legacy-mailbox payload ceiling: put_blob refuses a single
+        # payload above this many bytes with a NAMED error instead of
+        # letting a misconfigured legacy-mode pod grow the coordinator
+        # until the OOM killer arrives. None disables the check.
+        self.blob_max_bytes = None
+        # p2p buddy tier: the coordinator holds only this METADATA
+        # table — {owner: {"gen", "buddy", "digest", "nbytes"}} — while
+        # payloads live in the hosts' own MailboxServer endpoints,
+        # registered in mailbox_addrs ({host: "ip:port"}). Same
+        # generation fence and double-tombstone eviction as blobs.
+        self.buddy_meta = {}
+        self.mailbox_addrs = {}
         self.completed = collections.deque(maxlen=2048)
         self.role = "primary"
         self.term = 0
@@ -223,6 +257,9 @@ class _PodState(object):
         for owner in [o for o, rec in self.blobs.items()
                       if o in self.lost and rec["buddy"] in self.lost]:
             del self.blobs[owner]
+        for owner in [o for o, rec in self.buddy_meta.items()
+                      if o in self.lost and rec["buddy"] in self.lost]:
+            del self.buddy_meta[owner]
 
     def _scan_heartbeats(self, now):
         """Tombstone every registered, un-fenced host whose heartbeat is
@@ -282,6 +319,10 @@ class _PodState(object):
                 for name, r in self.rounds.items()},
             "info": {str(h): v for h, v in self.info.items()},
             "blobs": {str(h): rec for h, rec in self.blobs.items()},
+            "buddy_meta": {str(h): rec
+                           for h, rec in self.buddy_meta.items()},
+            "mailbox_addrs": {str(h): a
+                              for h, a in self.mailbox_addrs.items()},
             "hb_hosts": sorted(self.hb),
             "completed": list(self.completed),
         }
@@ -314,6 +355,13 @@ class _PodState(object):
         # absent in pre-buddy snapshots (default: no mailboxes)
         self.blobs = {int(h): rec
                       for h, rec in snap.get("blobs", {}).items()}
+        # absent in pre-p2p snapshots (default: no p2p metadata)
+        self.buddy_meta = {int(h): rec
+                           for h, rec in
+                           snap.get("buddy_meta", {}).items()}
+        self.mailbox_addrs = {int(h): a
+                              for h, a in
+                              snap.get("mailbox_addrs", {}).items()}
         self.hb = {int(h): now for h in snap.get("hb_hosts", ())}
         if self.hb_deadline_s is not None:
             # restart grace, same reasoning as the promotion holdoff
@@ -797,8 +845,14 @@ class CoordServer(object):
 
     def __init__(self, n_hosts, port=0, host="127.0.0.1",
                  hb_deadline_s=None, snapshot_path=None,
-                 snapshot_every_s=5.0):
+                 snapshot_every_s=5.0, blob_max_bytes=64 * 1024 * 1024):
         self._state = _PodState(n_hosts, hb_deadline_s=hb_deadline_s)
+        # legacy-mailbox ceiling (server config, not replicated state):
+        # finite by default so a legacy-mode pod with an oversized scope
+        # gets a NAMED refusal instead of silently growing this process
+        # by n_hosts x scope. None disables.
+        self._state.blob_max_bytes = None if blob_max_bytes is None \
+            else int(blob_max_bytes)
         self._repl = None
         self._snapshot_path = snapshot_path
         self._snapshot_every_s = float(snapshot_every_s)
@@ -1276,6 +1330,19 @@ def _dispatch(state, cmd, hid, req, now):
             buddy = int(req["buddy"])
         except (KeyError, TypeError, ValueError):
             return {"error": "put_blob needs integer gen and buddy"}
+        nb = _blob_nbytes(req.get("blob"))
+        if state.blob_max_bytes is not None \
+                and nb > state.blob_max_bytes:
+            # named refusal the client maps to BlobTooLargeError: a
+            # legacy-mode pod whose scope outgrew the coordinator gets
+            # a typed error (and falls back to the disk tier), never a
+            # silent coordinator OOM. The p2p tier has no such ceiling
+            # — payloads live in peer mailboxes.
+            return {"error": "blob_max_bytes exceeded: put_blob of %d "
+                    "bytes for host %d is over the coordinator's %d-"
+                    "byte ceiling — use the p2p mailbox tier for "
+                    "scopes this size" % (nb, hid,
+                                          state.blob_max_bytes)}
         prev = state.blobs.get(hid)
         if req.get("reset"):
             # post-disk-restore re-seed: the pod legitimately rewound
@@ -1285,6 +1352,7 @@ def _dispatch(state, cmd, hid, req, now):
             # rewind fence
             state.blobs[hid] = {"gen": gen, "buddy": buddy,
                                 "blob": req.get("blob")}
+            _record_coord_resident(state)
             return {"ok": True, "reset": True}
         if prev is not None and gen < int(prev["gen"]):
             return {"error": "put_blob generation rewind: host %d is "
@@ -1296,6 +1364,7 @@ def _dispatch(state, cmd, hid, req, now):
             return {"ok": True, "resent": True}
         state.blobs[hid] = {"gen": gen, "buddy": buddy,
                             "blob": req.get("blob")}
+        _record_coord_resident(state)
         return {"ok": True}
     if cmd == "get_blob":
         # read-only mailbox fetch; meta_only skips the payload so the
@@ -1313,6 +1382,69 @@ def _dispatch(state, cmd, hid, req, now):
         if not req.get("meta_only"):
             resp["blob"] = rec["blob"]
         return resp
+    if cmd == "mailbox_hello":
+        # p2p buddy tier: a host registers its MailboxServer endpoint
+        # so restore-time peers can resolve host-to-host pulls.
+        # Primary-replicated and snapshot-covered — the address book
+        # must survive coordinator failover just like the metadata.
+        if hid is None:
+            return {"error": "mailbox_hello needs a host id"}
+        addr = req.get("addr")
+        if not addr:
+            return {"error": "mailbox_hello needs an addr"}
+        state.mailbox_addrs[hid] = str(addr)
+        return {"ok": True}
+    if cmd == "put_buddy_meta":
+        # p2p buddy tier COMMIT: after the ring buddy's mailbox acked
+        # the deposited payload, the sender publishes this metadata row
+        # — {gen, buddy, digest, nbytes}, a few hundred bytes per host
+        # regardless of scope size. Same generation fence as put_blob:
+        # a delayed/replayed commit can never rewind the row below
+        # what a restore may already have elected. Replicated
+        # (_SYNC_CMDS) and snapshot-covered.
+        if hid is None:
+            return {"error": "put_buddy_meta needs a host id"}
+        if hid in state.lost:
+            return {"fenced": state.lost[hid], "lost": dict(state.lost)}
+        try:
+            gen = int(req["gen"])
+            buddy = int(req["buddy"])
+        except (KeyError, TypeError, ValueError):
+            return {"error": "put_buddy_meta needs integer gen and "
+                    "buddy"}
+        row = {"gen": gen, "buddy": buddy,
+               "digest": req.get("digest"),
+               "nbytes": int(req.get("nbytes", 0))}
+        prev = state.buddy_meta.get(hid)
+        if req.get("reset"):
+            state.buddy_meta[hid] = row
+            _record_coord_resident(state)
+            return {"ok": True, "reset": True}
+        if prev is not None and gen < int(prev["gen"]):
+            return {"error": "put_buddy_meta generation rewind: host "
+                    "%d is at gen %d on the server, refused gen %d"
+                    % (hid, int(prev["gen"]), gen)}
+        if prev is not None and gen == int(prev["gen"]):
+            return {"ok": True, "resent": True}
+        state.buddy_meta[hid] = row
+        _record_coord_resident(state)
+        return {"ok": True}
+    if cmd == "buddy_meta":
+        # read-only metadata fetch for restore planning — one owner's
+        # row, or the whole table + mailbox address book when no owner
+        # is named. No fencing, same reasoning as get_blob.
+        owner = req.get("owner")
+        if owner is not None:
+            rec = state.buddy_meta.get(int(owner))
+            if rec is None:
+                return {"miss": True}
+            resp = dict(rec)
+            resp["addr"] = state.mailbox_addrs.get(int(rec["buddy"]))
+            return resp
+        return {"meta": {str(h): dict(r)
+                         for h, r in state.buddy_meta.items()},
+                "addrs": {str(h): a
+                          for h, a in state.mailbox_addrs.items()}}
     if cmd == "members":
         # one poll answers the whole routing question: who is
         # registered (info), who is fenced (lost — versioned by the
@@ -1368,6 +1500,8 @@ def _dispatch(state, cmd, hid, req, now):
                 state.hb.pop(h, None)
                 state.info.pop(h, None)
                 state.blobs.pop(h, None)
+                state.buddy_meta.pop(h, None)
+                state.mailbox_addrs.pop(h, None)
             state.lost_version += 1
         else:
             for h in range(state.n_hosts, want):
@@ -1752,6 +1886,126 @@ class CoordClient(object):
         with self._lock:
             self._closed = True
             self._teardown_locked()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+# ---------------------------------------------------------------------------
+# p2p buddy mailbox endpoint (one per host)
+# ---------------------------------------------------------------------------
+
+def mailbox_request(address, req, timeout_s=5.0):
+    """One-shot newline-JSON request against a peer's MailboxServer.
+    Raises ConnectionError on any wire failure — the buddy tier maps
+    every raise to its typed fallbacks, never a hang (the socket
+    timeout bounds the wait)."""
+    try:
+        with socket.create_connection(_split_addr(address),
+                                      timeout=timeout_s) as s:
+            s.settimeout(timeout_s)
+            s.sendall(json.dumps(req).encode() + b"\n")
+            line = s.makefile("rb").readline()
+    except OSError as e:
+        raise ConnectionError(
+            "mailbox at %s unreachable: %s" % (address, e))
+    if not line:
+        raise ConnectionError(
+            "mailbox at %s closed the connection mid-request"
+            % (address,))
+    try:
+        return json.loads(line)
+    except ValueError as e:
+        raise ConnectionError(
+            "mailbox at %s sent a torn response: %s" % (address, e))
+
+
+class MailboxServer(object):
+    """One host's p2p buddy-mailbox endpoint: a tiny ThreadingTCPServer
+    on the CoordServer newline-JSON wire, serving deposits into and
+    fetches out of a :class:`buddy.BuddyMailbox` that lives in THIS
+    host's RAM. The coordinator never sees a payload — only the
+    metadata row the sender commits after the deposit is acked here.
+
+    Ops (one JSON line in, one out):
+      mb_deposit {owner, payload}   -> the mailbox's ack/refusal dict
+      mb_fetch   {owner}            -> {gen, digest, blob} |
+                                       {miss: true} | {refused: ...}
+      mb_status  {}                 -> {owners: {o: meta},
+                                       resident_bytes}
+
+    ``port=0`` binds an ephemeral port — read :attr:`address` back and
+    register it with the coordinator via ``mailbox_hello``."""
+
+    def __init__(self, mailbox, host="127.0.0.1", port=0):
+        self.mailbox = mailbox
+        self._dead = False
+        server_self = self
+
+        class _Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                while not server_self._dead:
+                    line = self.rfile.readline()
+                    if not line:
+                        return
+                    try:
+                        req = json.loads(line)
+                        resp = server_self._serve(req)
+                    except Exception as e:   # malformed request
+                        resp = {"error": "%s: %s"
+                                % (type(e).__name__, e)}
+                    self.wfile.write(json.dumps(resp).encode() + b"\n")
+                    self.wfile.flush()
+
+        class _Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = _Server((host, int(port)), _Handler)
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            kwargs={"poll_interval": 0.05},
+            name="paddle-tpu-mailbox", daemon=True)
+        self._thread.start()
+
+    @property
+    def address(self):
+        h, p = self._server.server_address[:2]
+        return "%s:%d" % (h, p)
+
+    def _serve(self, req):
+        cmd = req.get("cmd")
+        if cmd == "mb_deposit":
+            return self.mailbox.deposit(int(req["owner"]),
+                                        req["payload"])
+        if cmd == "mb_fetch":
+            try:
+                return self.mailbox.reconstruct(int(req["owner"]))
+            except LookupError:
+                return {"miss": True}
+            except Exception as e:
+                # chain/digest corruption: a TYPED refusal the fetching
+                # side surfaces as snapshot_torn, never a wedged socket
+                return {"refused": "%s: %s" % (type(e).__name__, e)}
+        if cmd == "mb_status":
+            return {"owners": {str(o): m for o, m in
+                               (self.mailbox.meta() or {}).items()},
+                    "resident_bytes": self.mailbox.resident_bytes()}
+        return {"error": "unknown cmd %r" % cmd}
+
+    def close(self):
+        if self._dead:
+            return
+        self._dead = True
+        try:
+            self._server.shutdown()
+            self._server.server_close()
+        except OSError:
+            pass
+        self._thread.join(timeout=2.0)
 
     def __enter__(self):
         return self

@@ -369,6 +369,40 @@ def decode_state_blob(blob):
     return out, int(blob["step"]), blob.get("feed_state")
 
 
+def leaf_digest(arr):
+    """Content digest of ONE state leaf: sha256 over dtype + shape +
+    raw bytes (C-order). Drives the buddy delta-snapshot skip test — a
+    leaf whose digest is unchanged since the last acked generation is
+    not re-sent — so it must be bitwise-exact, never approximate."""
+    import hashlib
+    a = np.ascontiguousarray(np.asarray(arr))
+    h = hashlib.sha256()
+    h.update(str(a.dtype.str).encode("ascii"))
+    h.update(repr(tuple(a.shape)).encode("ascii"))
+    h.update(a.tobytes())
+    return h.hexdigest()
+
+
+def leaf_digests(arrays):
+    """``{name: leaf_digest(arr)}`` for a state mapping."""
+    return {name: leaf_digest(arr) for name, arr in arrays.items()}
+
+
+def state_digest(arrays):
+    """Order-independent digest of a WHOLE ``{name: array}`` state:
+    sha256 over the sorted (name, leaf_digest) pairs. The buddy tier
+    publishes this to the coordinator metadata table and every restore
+    verifies the reconstructed state against it, so a torn p2p stream
+    or a corrupt delta chain can never be silently adopted."""
+    import hashlib
+    h = hashlib.sha256()
+    for name in sorted(arrays):
+        h.update(str(name).encode("utf-8"))
+        h.update(b"\x00")
+        h.update(leaf_digest(arrays[name]).encode("ascii"))
+    return h.hexdigest()
+
+
 class CheckpointFormatError(RuntimeError):
     """The checkpoint on disk is VALID but written by a newer library.
     Deliberately not an OSError/ValueError: load_checkpoint's corruption

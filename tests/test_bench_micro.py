@@ -96,7 +96,11 @@ def test_budget_table_covers_the_contract():
         # snapshot encode+send tax, the buddy restore wall, and the
         # disk load_checkpoint wall it front-runs
         "buddy_snapshot_ms", "buddy_restore_ms",
-        "buddy_disk_restore_ms"}
+        "buddy_disk_restore_ms",
+        # ISSUE-20 p2p buddy mailboxes + delta snapshots: one dual
+        # deposit (own + buddy mailbox + metadata commit) and the
+        # delta-wire fraction on the churn-skewed reference scope
+        "buddy_p2p_send_ms", "buddy_delta_bytes_ratio"}
 
 
 def test_analysis_section_measures_the_verifier():
@@ -147,6 +151,13 @@ def test_buddy_section_measures_both_restore_paths():
     assert 0 < m["buddy_snapshot_ms"] < 5000.0
     assert 0 < m["buddy_restore_ms"] < 5000.0
     assert 0 < m["buddy_disk_restore_ms"] < 10000.0
+    # ISSUE-20: the p2p dual deposit stays in the same class as the
+    # legacy put, and on the churn-skewed scope (one large static leaf
+    # + small churning leaves) the delta wire moves under HALF the
+    # full-scope wire — the section asserts the chain reconstructs
+    # bitwise, so a green ratio is a CORRECT ratio
+    assert 0 < m["buddy_p2p_send_ms"] < 5000.0
+    assert 0 < m["buddy_delta_bytes_ratio"] < 0.5
 
 
 def test_transport_section_measures_latency():

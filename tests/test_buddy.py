@@ -42,11 +42,12 @@ import paddle_tpu.io as io_mod
 from paddle_tpu import layers, optimizer
 from paddle_tpu.framework import buddy, faultinject, resilience
 from paddle_tpu.framework.coordination import (
-    CoordinationError, FileCoordinator, HostLostError, LocalCoordinator,
-    PodResilientTrainer, SocketCoordinator)
+    BlobTooLargeError, CoordinationError, FileCoordinator, HostLostError,
+    LocalCoordinator, PodResilientTrainer, SocketCoordinator)
 from paddle_tpu.framework.resilience import ResilientTrainer, RetryPolicy
 from paddle_tpu.framework.scope import Scope, scope_guard
-from paddle_tpu.framework.transport import CoordServer, replicated_group
+from paddle_tpu.framework.transport import (
+    CoordServer, MailboxServer, mailbox_request, replicated_group)
 
 pytestmark = [pytest.mark.faultinject, pytest.mark.pod]
 
@@ -342,8 +343,8 @@ def test_fault_mid_send_keeps_previous_generation_restorable():
     fails = resilience.events("buddy_send_fail")
     assert fails and fails[-1]["host"] == 0 \
         and fails[-1]["error"] == "ConnectionError"
-    # the PREVIOUS generation is still there and still decodes bitwise
-    assert co.get_blob(0, meta_only=True)["gen"] == 0
+    # the PREVIOUS generation is still committed and decodes bitwise
+    assert co.buddy_meta(0)["gen"] == 0
     got, _ = buddy.fetch_and_decode(co, 0, 0)
     for n in gen0:
         np.testing.assert_array_equal(got[n], gen0[n])
@@ -351,7 +352,7 @@ def test_fault_mid_send_keeps_previous_generation_restorable():
     assert resilience.buddy_gens()[0] == 0
     # disarmed, the resend of the same boundary lands normally
     assert buddy.send_snapshot(co, 0, [0, 1], 1, gen1)
-    assert co.get_blob(0, meta_only=True)["gen"] == 1
+    assert co.buddy_meta(0)["gen"] == 1
     assert resilience.buddy_gens()[0] == 1
 
 
@@ -446,9 +447,14 @@ def test_restore_agreed_torn_blob_nobody_adopts():
     mutation, the second gather spreads the doubt, and BOTH hosts
     return unrestored — a torn snapshot can never half-restore a pod."""
     co = _seeded_co(2, 4)
-    with co._blob_lock:
-        co._blobs[1]["blob"] = dict(co._blobs[1]["blob"],
-                                    npz="!not-base64!")
+    # garble owner 1's payload in BOTH resident mailboxes (its own
+    # self-deposit and the buddy replica) so every fetch path sees it
+    for at in (0, 1):
+        mb = co.mailbox_of(at)
+        with mb._lock:
+            slot = mb._slots.get(1)
+            if slot is not None:
+                slot["base"] = dict(slot["base"], npz="!not-base64!")
     scopes = {h: _DictScope(w=np.full((3, 4), -1.0, np.float32))
               for h in range(2)}
     out, errs = _run_hosts(
@@ -495,6 +501,408 @@ def test_file_coordinator_degrades_to_buddy_missing(tmp_path):
                                    _arrays(seed=h))
     assert buddy.plan_restore(cos[0], [0, 1], [], [0, 1], 1) \
         == "buddy_missing"
+
+
+# ---------------------------------------------------------------------------
+# p2p mailboxes: single-generation residency + typed delta protocol
+# ---------------------------------------------------------------------------
+
+def _full_payload(arrays, gen, reset=False):
+    blob, _, _ = io_mod.encode_state_blob(arrays, gen, compress="zlib")
+    p = {"kind": "full", "gen": gen,
+         "digest": io_mod.state_digest(arrays), "blob": blob}
+    if reset:
+        p["reset"] = True
+    return p
+
+
+def _delta_payload(changed, gen, prev_gen, prev_digest, full_arrays,
+                   removed=()):
+    blob, _, _ = io_mod.encode_state_blob(changed, gen, compress="zlib")
+    return {"kind": "delta", "gen": gen, "prev_gen": prev_gen,
+            "prev_digest": prev_digest,
+            "digest": io_mod.state_digest(full_arrays),
+            "removed": list(removed), "blob": blob}
+
+
+def test_mailbox_one_generation_resident_fence_and_reset():
+    """A mailbox slot holds exactly ONE generation: a full deposit
+    replaces wholesale, a rewind is a typed refusal (reset bypasses),
+    and resident bytes track the single resident payload — never an
+    accumulation of generations."""
+    mb = buddy.BuddyMailbox(host_id=0)
+    a3, a5 = _arrays(seed=3), _arrays(seed=5)
+    ack = mb.deposit(7, _full_payload(a3, 3))
+    assert ack["ok"] and ack["gen"] == 3 and ack["chain_len"] == 0
+    ack = mb.deposit(7, _full_payload(a5, 5))
+    assert ack["ok"] and ack["gen"] == 5
+    # ONE generation resident: gen-3 is gone, resident == gen-5 bytes
+    assert mb.meta(7)["gen"] == 5
+    assert mb.resident_bytes() == ack["nbytes"]
+    got, step, _ = io_mod.decode_state_blob(mb.reconstruct(7)["blob"])
+    assert step == 5
+    for n in a5:
+        np.testing.assert_array_equal(got[n], a5[n])
+    # rewind refused (typed, not raised) ...
+    ref = mb.deposit(7, _full_payload(a3, 2))
+    assert ref == {"ok": False, "refused": "gen_rewind", "gen": 5}
+    assert mb.meta(7)["gen"] == 5
+    # ... unless it is a reset re-seed
+    ack = mb.deposit(7, _full_payload(a3, 2, reset=True))
+    assert ack["ok"] and mb.meta(7)["gen"] == 2
+    # the per-host resident gauge follows (host_id was given)
+    assert resilience.buddy_resident()["0"] == mb.resident_bytes()
+    mb.drop(7)
+    assert mb.meta(7) is None and mb.resident_bytes() == 0
+
+
+def test_mailbox_delta_refusals_are_typed():
+    """Every way a delta deposit can be unappliable is a TYPED refusal
+    the sender converts into one forced full — no exceptions, no
+    partial slot mutation."""
+    mb = buddy.BuddyMailbox(host_id=1, max_chain=2)
+    base = _arrays(seed=0)
+    # delta into an empty slot: no base to chain onto
+    ref = mb.deposit(4, _delta_payload({"w": base["w"]}, 1, 0, "x", base))
+    assert ref["ok"] is False and ref["refused"] == "delta_chain_broken"
+    ack = mb.deposit(4, _full_payload(base, 1))
+    assert ack["ok"]
+    d1 = dict(base, w=base["w"] + 1)
+    # wrong prev_gen: the sender's chain state diverged from the slot
+    ref = mb.deposit(4, _delta_payload({"w": d1["w"]}, 2, 0,
+                                       ack["digest"], d1))
+    assert ref == {"ok": False, "refused": "delta_chain_broken", "gen": 1}
+    # right prev_gen but wrong prev_digest: content diverged
+    ref = mb.deposit(4, _delta_payload({"w": d1["w"]}, 2, 1,
+                                       "not-the-digest", d1))
+    assert ref == {"ok": False, "refused": "digest_mismatch", "gen": 1}
+    # a non-advancing delta generation is a rewind
+    ref = mb.deposit(4, _delta_payload({"w": d1["w"]}, 1, 1,
+                                       ack["digest"], d1))
+    assert ref == {"ok": False, "refused": "gen_rewind", "gen": 1}
+    # a valid chain applies ... up to max_chain, then refuses typed
+    ack1 = mb.deposit(4, _delta_payload({"w": d1["w"]}, 2, 1,
+                                        ack["digest"], d1))
+    assert ack1["ok"] and ack1["chain_len"] == 1
+    d2 = dict(d1, w=d1["w"] + 1)
+    ack2 = mb.deposit(4, _delta_payload({"w": d2["w"]}, 3, 2,
+                                        ack1["digest"], d2))
+    assert ack2["ok"] and ack2["chain_len"] == 2
+    d3 = dict(d2, w=d2["w"] + 1)
+    ref = mb.deposit(4, _delta_payload({"w": d3["w"]}, 4, 3,
+                                       ack2["digest"], d3))
+    assert ref["ok"] is False and ref["refused"] == "delta_chain_broken"
+    # the capped slot still reconstructs its committed generation
+    got, step, _ = io_mod.decode_state_blob(mb.reconstruct(4)["blob"])
+    assert step == 3
+    np.testing.assert_array_equal(got["w"], d2["w"])
+
+
+def test_delta_sends_skip_unchanged_leaves_and_rebase():
+    """Sender-side delta protocol over LocalCoordinator: unchanged
+    leaves never move again (delta wire << full wire on a static-heavy
+    scope), the chain re-bases to a forced full every rebase_every
+    sends, and the restore after a re-base boundary is bitwise."""
+    co = LocalCoordinator(2, timeout_s=5.0)
+    tracker = buddy.DeltaTracker(rebase_every=2)
+    rng = np.random.RandomState(0)
+    scope = {"static/table": rng.randn(64, 32).astype(np.float32),
+             "churn/w": rng.randn(3, 4).astype(np.float32)}
+    assert buddy.send_snapshot(co, 0, [0, 1], 0, scope, tracker=tracker)
+    full_wire = tracker.full_wire
+    assert tracker.chain_len == 0 and full_wire
+    for gen in (1, 2):   # deltas: only churn/w moves
+        scope = dict(scope, **{"churn/w": rng.randn(3, 4)
+                               .astype(np.float32)})
+        assert buddy.send_snapshot(co, 0, [0, 1], gen, scope,
+                                   tracker=tracker)
+        assert tracker.chain_len == gen
+        assert resilience.buddy_delta_ratio() < 0.5
+    # the next send finds the chain at rebase_every: forced full, the
+    # buddy slot's chain collapses
+    scope = dict(scope, **{"churn/w": rng.randn(3, 4)
+                           .astype(np.float32)})
+    assert buddy.send_snapshot(co, 0, [0, 1], 3, scope, tracker=tracker)
+    assert tracker.chain_len == 0
+    assert co.mailbox_of(1).meta(0) \
+        == dict(co.mailbox_of(0).meta(0))   # both replicas identical
+    assert co.mailbox_of(1).meta(0)["chain_len"] == 0
+    # post-re-base restore is bitwise
+    got, _ = buddy.fetch_and_decode(co, 0, 3)
+    for n in scope:
+        np.testing.assert_array_equal(got[n], scope[n])
+    # metadata row tracks the re-based generation
+    assert co.buddy_meta(0)["gen"] == 3
+
+
+def test_fault_mid_p2p_send_meta_not_advanced_typed():
+    """Twin for the catalogued ``buddy.p2p_send`` failpoint: the
+    stream to the buddy tears AFTER the local deposit — ack-before-
+    commit keeps the metadata row at the previous generation, so the
+    torn generation can never be elected and the next restore plan is
+    the TYPED buddy_stale disk fallback, not a wedge."""
+    co = LocalCoordinator(2, timeout_s=5.0)
+    gen0, gen1 = _arrays(seed=20), _arrays(seed=21)
+    assert buddy.send_snapshot(co, 0, [0, 1], 0, gen0)
+    assert buddy.send_snapshot(co, 1, [0, 1], 0, _arrays(seed=29))
+    faultinject.arm(["buddy.p2p_send:raise@1^0"])
+    try:
+        assert not buddy.send_snapshot(co, 0, [0, 1], 1, gen1)
+    finally:
+        faultinject.disarm()
+    fails = resilience.events("buddy_send_fail")
+    assert fails and fails[-1]["host"] == 0 \
+        and fails[-1]["error"] == "ConnectionError"
+    # metadata never advanced: gen 0 is still the committed truth
+    assert co.buddy_meta(0)["gen"] == 0
+    # ... so planning a restore at the torn gen 1 is typed stale
+    assert buddy.plan_restore(co, [1], [0], [0, 1], 1) == "buddy_stale"
+    # and gen 0 itself still restores bitwise from the buddy replica
+    got, _ = buddy.fetch_and_decode(co, 0, 0)
+    for n in gen0:
+        np.testing.assert_array_equal(got[n], gen0[n])
+    fired = [e for e in resilience.events("failpoint")
+             if e["site"] == "buddy.p2p_send"]
+    assert fired and fired[0]["host"] == "0"
+
+
+def test_fault_mid_p2p_fetch_nobody_adopts_typed():
+    """Twin for the catalogued ``buddy.p2p_fetch`` failpoint: the
+    host-to-host pull tears mid-stream during an agreed restore — the
+    decode gather spreads the doubt, nobody adopts, and the caller
+    takes the typed snapshot_torn disk rewind (never a wedge)."""
+    co = _seeded_co(2, 2)
+    # host 0 restarted: its local replica is gone, forcing the p2p hop
+    co.mailbox_of(0).clear()
+    scopes = {h: _DictScope(w=np.full((3, 4), -1.0, np.float32))
+              for h in range(2)}
+    faultinject.arm(["buddy.p2p_fetch:raise@1^0"])
+    try:
+        out, errs = _run_hosts(
+            lambda h: buddy.restore_agreed(co, h, "r", 2, scopes[h]), 2)
+    finally:
+        faultinject.disarm()
+    assert not errs
+    assert all(o == (False, None) for o in out.values())
+    for h in range(2):   # nobody half-restored
+        np.testing.assert_array_equal(
+            scopes[h].vars["w"], np.full((3, 4), -1.0, np.float32))
+    assert {e["host"] for e in resilience.events("buddy_decode_fail")} \
+        == {0}
+    fired = [e for e in resilience.events("failpoint")
+             if e["site"] == "buddy.p2p_fetch"]
+    assert fired and fired[0]["host"] == "0"
+    # disarmed, the same p2p pull succeeds bitwise (typed ≠ terminal)
+    got, _ = buddy.fetch_and_decode(co, 0, 2)
+    want = _arrays(seed=100)
+    for n in want:
+        np.testing.assert_array_equal(got[n], want[n])
+    assert resilience.buddy_fetch_ms() is not None
+
+
+def test_fault_delta_apply_reconstruct_torn_typed():
+    """Twin for the catalogued ``buddy.delta_apply`` failpoint: a
+    fault while replaying a chain link makes reconstruct raise, the
+    fetch surfaces it as a decode failure and the pod takes the typed
+    no-adoption path — a torn chain can never half-restore."""
+    co = LocalCoordinator(2, timeout_s=5.0)
+    tracker = buddy.DeltaTracker(rebase_every=8)
+    arrays = _arrays(seed=40)
+    assert buddy.send_snapshot(co, 0, [0, 1], 0, arrays,
+                               tracker=tracker)
+    arrays = dict(arrays, w=arrays["w"] + 1)
+    assert buddy.send_snapshot(co, 0, [0, 1], 1, arrays,
+                               tracker=tracker)
+    assert co.mailbox_of(1).meta(0)["chain_len"] == 1
+    faultinject.arm(["buddy.delta_apply:raise@1+"])
+    try:
+        with pytest.raises(Exception):
+            buddy.fetch_and_decode(co, 0, 1)
+    finally:
+        faultinject.disarm()
+    # disarmed, the same chain reconstructs bitwise
+    got, _ = buddy.fetch_and_decode(co, 0, 1)
+    for n in arrays:
+        np.testing.assert_array_equal(got[n], arrays[n])
+
+
+def test_delta_chain_corruption_fails_digest_typed():
+    """A corrupted stored chain link reconstructs to the WRONG state:
+    the slot's end-to-end digest catches it and the fetch raises — the
+    typed snapshot_torn input, never a silent wrong-weights adopt."""
+    co = LocalCoordinator(2, timeout_s=5.0)
+    tracker = buddy.DeltaTracker(rebase_every=8)
+    arrays = _arrays(seed=50)
+    assert buddy.send_snapshot(co, 0, [0, 1], 0, arrays,
+                               tracker=tracker)
+    arrays = dict(arrays, w=arrays["w"] + 1)
+    assert buddy.send_snapshot(co, 0, [0, 1], 1, arrays,
+                               tracker=tracker)
+    # tamper the delta link's payload in BOTH resident mailboxes with a
+    # VALID encoding of different content — only the digest can tell
+    evil, _, _ = io_mod.encode_state_blob(
+        {"w": np.zeros((3, 4), np.float32)}, 1, compress="zlib")
+    for at in (0, 1):
+        mb = co.mailbox_of(at)
+        with mb._lock:
+            mb._slots[0]["chain"][0]["blob"] = evil
+    with pytest.raises(ValueError, match="digest"):
+        buddy.fetch_and_decode(co, 0, 1)
+
+
+def test_double_loss_typed_from_recorded_buddy():
+    """Owner AND its META-recorded buddy both lost: even when the
+    current ring would assign a different buddy, the replica lived in
+    the RECORDED buddy's RAM — plan says buddy_and_host_lost."""
+    co = _seeded_co(3, 4)   # ring 0->1->2->0, meta records buddy(1)=2
+    # hosts 1 and 2 die together: host 1's replica was in host 2's RAM
+    assert buddy.plan_restore(co, [0], [1, 2], [0, 1, 2], 4) \
+        == "buddy_and_host_lost"
+    # the meta-recorded check also catches a STALE ring: host 1's last
+    # committed send pre-dated a membership change, so the current ring
+    # says buddy(1)=0 but the payload sits in dead host 2's mailbox
+    assert buddy.plan_restore(co, [0], [1, 2], [0, 1, 2, 3], 4) \
+        in ("buddy_and_host_lost",)
+
+
+def test_restore_parity_delta_full_legacy_bitwise():
+    """Acceptance: the p2p delta-chain restore, the p2p full-snapshot
+    restore and the legacy coordinator-mailbox restore all reconstruct
+    BITWISE-identical state from the same send history."""
+    rng = np.random.RandomState(3)
+    history = []
+    state = {"static/emb": rng.randn(32, 16).astype(np.float32),
+             "churn/w": rng.randn(3, 4).astype(np.float32)}
+    for gen in range(4):
+        state = dict(state, **{"churn/w": rng.randn(3, 4)
+                               .astype(np.float32)})
+        history.append((gen, state))
+    co_d = LocalCoordinator(2, timeout_s=5.0)   # p2p + deltas
+    co_f = LocalCoordinator(2, timeout_s=5.0)   # p2p, full every time
+    co_l = LocalCoordinator(2, timeout_s=5.0)   # legacy put_blob
+    tracker = buddy.DeltaTracker(rebase_every=8)
+    peer = _arrays(seed=90)   # host 1 participates so plans can pass
+    for gen, st in history:
+        assert buddy.send_snapshot(co_d, 0, [0, 1], gen, st,
+                                   tracker=tracker)
+        assert buddy.send_snapshot(co_f, 0, [0, 1], gen, st)
+        assert buddy.send_snapshot(co_l, 0, [0, 1], gen, st, p2p=False)
+        for co, p2p in ((co_d, True), (co_f, True), (co_l, False)):
+            assert buddy.send_snapshot(co, 1, [0, 1], gen, peer,
+                                       p2p=p2p)
+    assert co_d.mailbox_of(1).meta(0)["chain_len"] == 3
+    final = history[-1][1]
+    got_d, _ = buddy.fetch_and_decode(co_d, 0, 3)
+    got_f, _ = buddy.fetch_and_decode(co_f, 0, 3)
+    got_l, _ = buddy.fetch_and_decode(co_l, 0, 3, p2p=False)
+    for n in final:
+        np.testing.assert_array_equal(got_d[n], final[n])
+        np.testing.assert_array_equal(got_f[n], final[n])
+        np.testing.assert_array_equal(got_l[n], final[n])
+    # and all three plans agree the restore is possible
+    for co, p2p in ((co_d, True), (co_f, True), (co_l, False)):
+        assert buddy.plan_restore(co, [1], [0], [0, 1], 3, p2p=p2p) \
+            is None
+
+
+# ---------------------------------------------------------------------------
+# p2p over sockets: MailboxServer endpoints + the metadata-only plane
+# ---------------------------------------------------------------------------
+
+def test_mailbox_server_wire_roundtrip():
+    """The MailboxServer speaks the newline-JSON wire: deposit, fetch,
+    status and the typed miss — and a dead endpoint raises
+    ConnectionError (the sender's swallow-into-event input), never
+    hangs."""
+    arrays = _arrays(seed=60)
+    with MailboxServer(buddy.BuddyMailbox(host_id=3)) as srv:
+        ack = mailbox_request(srv.address, {
+            "cmd": "mb_deposit", "owner": 2,
+            "payload": _full_payload(arrays, 5)})
+        assert ack["ok"] and ack["gen"] == 5
+        rec = mailbox_request(srv.address, {"cmd": "mb_fetch",
+                                            "owner": 2})
+        got, step, _ = io_mod.decode_state_blob(rec["blob"])
+        assert step == 5
+        for n in arrays:
+            np.testing.assert_array_equal(got[n], arrays[n])
+        assert mailbox_request(srv.address,
+                               {"cmd": "mb_fetch", "owner": 9}) \
+            == {"miss": True}
+        st = mailbox_request(srv.address, {"cmd": "mb_status"})
+        assert st["owners"]["2"]["gen"] == 5
+        assert st["resident_bytes"] == ack["nbytes"]
+        addr = srv.address
+    with pytest.raises(ConnectionError):
+        mailbox_request(addr, {"cmd": "mb_status"}, timeout_s=0.5)
+
+
+def test_socket_p2p_coordinator_holds_metadata_only():
+    """THE tentpole invariant over real sockets: snapshot payloads
+    live only in the hosts' MailboxServer endpoints; the CoordServer
+    keeps a metadata table whose resident footprint is O(bytes of
+    JSON), counter-asserted against the gauge — and a host-to-host
+    pull after a restart restores bitwise."""
+    with contextlib.ExitStack() as stack:
+        srv = CoordServer(2, hb_deadline_s=30.0).start()
+        stack.callback(srv.close)
+        cos = _socket_pod(stack, srv.address, 2)
+        refs = {h: _arrays(seed=70 + h) for h in range(2)}
+        for h in range(2):
+            assert buddy.send_snapshot(cos[h], h, [0, 1], 1, refs[h])
+        with srv.state.lock:
+            # NO payloads on the coordination plane — metadata only
+            assert srv.state.blobs == {}
+            meta = dict(srv.state.buddy_meta)
+            addrs = dict(srv.state.mailbox_addrs)
+        assert set(meta) == {0, 1} and set(addrs) == {0, 1}
+        assert meta[0]["buddy"] == 1 and meta[1]["buddy"] == 0
+        assert meta[0]["nbytes"] > 0 and meta[0]["digest"]
+        # the coordinator's resident gauge is metadata-sized: far
+        # below ONE snapshot payload, under the probe's strict bound
+        resident = resilience.buddy_resident()["coord"]
+        assert 0 < resident < min(m["nbytes"] for m in meta.values())
+        from tools.serving_probe import BUDDY_COORD_RESIDENT_BOUND
+        assert resident < BUDDY_COORD_RESIDENT_BOUND
+        # host 0 "restarts": local mailbox replica gone — the restore
+        # pulls host-to-host from host 1's endpoint, bitwise
+        cos[0].mailbox_of(0).clear()
+        got, _ = buddy.fetch_and_decode(cos[0], 0, 1)
+        for n in refs[0]:
+            np.testing.assert_array_equal(got[n], refs[0][n])
+        assert resilience.buddy_fetch_ms() is not None
+        # both hosts' mailbox endpoints carry exactly one replica each
+        # now (host 0's cleared slot is only in host 1's RAM)
+        assert cos[1].mailbox_of(1).owners() == [0, 1]
+
+
+def test_put_blob_ceiling_is_a_named_error():
+    """Satellite bugfix: legacy put_blob/get_blob stay for
+    compatibility but the coordinator now enforces blob_max_bytes —
+    an oversized legacy payload is the NAMED BlobTooLargeError, in
+    process and across the wire, and the mailbox keeps its previous
+    committed generation."""
+    # in-process: the ceiling is opt-in (None = unbounded, compat)
+    co = LocalCoordinator(2, timeout_s=5.0)
+    big, _, _ = io_mod.encode_state_blob(
+        {"w": np.zeros((64, 64), np.float32)}, 1, compress=None)
+    co.put_blob(0, 1, 1, big)          # unbounded: fine
+    co.blob_max_bytes = 1024
+    with pytest.raises(BlobTooLargeError, match="blob_max_bytes"):
+        co.put_blob(0, 2, 1, big)
+    assert co.get_blob(0, meta_only=True)["gen"] == 1   # not torn
+    # over the wire: CoordServer defaults the ceiling ON (64 MiB);
+    # shrink it to prove the typed path end to end
+    with contextlib.ExitStack() as stack:
+        srv = CoordServer(2, hb_deadline_s=30.0,
+                          blob_max_bytes=1024).start()
+        stack.callback(srv.close)
+        cos = _socket_pod(stack, srv.address, 2)
+        small, _, _ = io_mod.encode_state_blob(_arrays(), 1)
+        cos[0].put_blob(0, 1, 1, small)
+        with pytest.raises(BlobTooLargeError, match="blob_max_bytes"):
+            cos[0].put_blob(0, 2, 1, big)
+        assert cos[1].get_blob(0, meta_only=True)["gen"] == 1
 
 
 # ---------------------------------------------------------------------------
@@ -760,3 +1168,41 @@ def test_probe_folds_buddy_group_and_strict_gen_divergence():
         report = serving_probe.scrape_metrics(srv.url)
     flags = serving_probe.buddy_generation_flags(report)
     assert len(flags) == 1 and "more than one window" in flags[0]
+
+
+def test_probe_strict_coordinator_resident_bound():
+    """tools/serving_probe.py: the p2p-tier gauges
+    (buddy_resident_bytes{host=}, buddy_delta_ratio,
+    buddy_p2p_fetch_ms) fold into the "buddy" group, and
+    buddy_resident_flags trips ONLY when the COORDINATOR's resident
+    gauge exceeds the metadata-sized bound — payload-sized mailboxes
+    on the hosts themselves are exactly what the tier wants."""
+    import sys
+    resilience.clear_bytes()
+    resilience.clear_buddy_gens()
+    resilience.record_buddy_resident(0, 5 * 1024 * 1024)  # host RAM: fine
+    resilience.record_buddy_resident("coord", 512)        # metadata: fine
+    resilience.record_buddy_delta_ratio(0.07)
+    resilience.record_buddy_fetch_ms(1.25)
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tools"))
+    try:
+        import serving_probe
+    finally:
+        sys.path.pop(0)
+    with resilience.serve_metrics(port=0) as srv:
+        report = serving_probe.scrape_metrics(srv.url)
+    assert report["buddy"]["buddy_resident_bytes/host0"] \
+        == 5 * 1024 * 1024.0
+    assert report["buddy"]["buddy_resident_bytes/hostcoord"] == 512.0
+    assert report["buddy"]["buddy_delta_ratio"] == 0.07
+    assert report["buddy"]["buddy_p2p_fetch_ms"] == 1.25
+    assert serving_probe.buddy_resident_flags(report) == []
+    # a payload-sized COORDINATOR residency trips the strict flag: the
+    # memory ceiling the p2p mailboxes lifted is back
+    resilience.record_buddy_resident("coord", 5 * 1024 * 1024)
+    with resilience.serve_metrics(port=0) as srv:
+        report = serving_probe.scrape_metrics(srv.url)
+    flags = serving_probe.buddy_resident_flags(report)
+    assert len(flags) == 1 and "metadata bound" in flags[0] \
+        and "coord" in flags[0]

@@ -1556,13 +1556,15 @@ def _field(out, tag, hid):
 
 
 def _wait_equal_gens(srv, floor, timeout_s=240.0):
-    """Block until every host's mailbox holds the SAME generation
-    >= floor — i.e. a window boundary's sends have all landed and the
-    next boundary hasn't started committing."""
+    """Block until every host's committed buddy-metadata row holds the
+    SAME generation >= floor — i.e. a window boundary's p2p deposits
+    have all been acked and committed, and the next boundary hasn't
+    started committing."""
     def cond(s):
-        gens = {s.blobs.get(h, {}).get("gen", -1) for h in range(3)}
+        gens = {s.buddy_meta.get(h, {}).get("gen", -1)
+                for h in range(3)}
         return len(gens) == 1 and gens.pop() >= floor
-    _wait_state(srv, cond, "equal gen>=%d mailboxes" % floor,
+    _wait_state(srv, cond, "equal gen>=%d buddy metadata" % floor,
                 timeout_s=timeout_s)
 
 
@@ -1587,6 +1589,14 @@ def test_procpod_buddy_restore_after_sigkill(tmp_path):
             procs[h] = _spawn_buddy_worker(script, srv.address, h,
                                            tmp_path)
         _wait_equal_gens(srv, 4)
+        with srv.state.lock:
+            # the tentpole invariant: snapshot payloads live in the
+            # workers' p2p mailboxes — the coordinator holds ONLY the
+            # {host: (gen, buddy, digest, nbytes)} metadata table and
+            # the mailbox address registry, never a blob
+            assert srv.state.blobs == {}
+            assert set(srv.state.buddy_meta) == {0, 1, 2}
+            assert set(srv.state.mailbox_addrs) == {0, 1, 2}
         os.kill(procs[2].pid, signal.SIGKILL)
         procs[2].wait(timeout=10)
         _wait_state(srv, lambda s: 2 in s.lost, "heartbeat tombstone")

@@ -89,10 +89,15 @@ def scrape_metrics(url, timeout_s=5.0):
     pairs (collective/stateship/ckpt _bytes_total{kind=}) when the
     replica exports any, a "buddy" section with the buddy-checkpoint
     tier's series (buddy_snapshot_bytes_total{kind=} raw/wire pairs,
-    buddy_restore_total{outcome=}, the per-host buddy_generation
-    gauges — ``--strict`` FAILS the probe when live hosts' generation
-    gauges diverge by more than one window, because a lagging mailbox
-    turns the next host loss into a full disk rewind), and a "faults"
+    buddy_restore_total{outcome=}, the per-host buddy_generation and
+    buddy_resident_bytes gauges plus the p2p-tier buddy_delta_ratio /
+    buddy_p2p_fetch_ms gauges — ``--strict`` FAILS the probe when live
+    hosts' generation gauges diverge by more than one window, because
+    a lagging mailbox turns the next host loss into a full disk
+    rewind, and when the COORDINATOR's resident-bytes gauge exceeds a
+    metadata-sized bound, because snapshot payloads parked on the
+    coordination plane re-impose the memory ceiling the p2p mailboxes
+    removed), and a "faults"
     section with the fault-plane
     series (failpoint_hits_total{site=}, the faultinject_armed gauge
     and numeric_fault_total{policy=,culprit=}) — ``--strict`` FAILS
@@ -342,6 +347,35 @@ def buddy_generation_flags(summary):
     return []
 
 
+#: --strict ceiling for the coordinator's buddy_resident_bytes gauge.
+#: The p2p tier keeps snapshot PAYLOADS in peer mailboxes and only a
+#: {host: (gen, buddy, digest, nbytes)} metadata table (plus any
+#: legacy-mode blobs) on the coordinator — metadata for even a large
+#: pod fits well under 64 KiB, so anything above it means payload
+#: bytes are parked on the coordination plane.
+BUDDY_COORD_RESIDENT_BOUND = 64 * 1024
+
+
+def buddy_resident_flags(summary, bound=BUDDY_COORD_RESIDENT_BOUND):
+    """Coordinator memory-ceiling regression in a scrape summary
+    (empty = healthy): the ``buddy_resident_bytes{host="coord"}``
+    gauge records what the coordination plane itself holds for the
+    buddy tier. Under the p2p-mailbox topology that must be METADATA
+    sized — a value above ``bound`` means full snapshot payloads are
+    resident on the coordinator (legacy ``put_blob`` traffic, or a
+    regression in the ack-before-commit path), re-imposing the
+    coordinator memory ceiling the tier was rebuilt to remove.
+    ``--strict`` fails the probe on it."""
+    resident = summary.get("buddy", {}).get(
+        "buddy_resident_bytes/hostcoord")
+    if resident is not None and resident > bound:
+        return ["coordinator buddy residency is payload-sized: "
+                "buddy_resident_bytes{host=coord}=%g exceeds the "
+                "%d-byte metadata bound — snapshot payloads are "
+                "parked on the coordination plane" % (resident, bound)]
+    return []
+
+
 def fault_plane_flags(summary):
     """Fault-plane poison in a scrape summary (empty = healthy): a
     nonzero ``faultinject_armed`` gauge means live failpoint schedules
@@ -379,8 +413,10 @@ def main(argv=None):
                          "armed failpoints (faultinject_armed > 0) in "
                          "the faults series, a pp_slots-vs-"
                          "pp_live_hosts disagreement in the elastic "
-                         "series, or buddy_generation gauges diverging "
-                         "by more than one window in the buddy series")
+                         "series, buddy_generation gauges diverging "
+                         "by more than one window in the buddy series, "
+                         "or a coordinator buddy_resident_bytes gauge "
+                         "above the metadata-sized bound")
     ap.add_argument("--metrics-url", default=None,
                     help="scrape a resilience.serve_metrics endpoint and "
                          "fold the event totals into the report")
@@ -436,6 +472,13 @@ def main(argv=None):
                 # failure away from a disk rewind the tier was built
                 # to avoid — loud always, fatal under --strict
                 health["buddy_lag"] = bflags
+                metrics_ok = False
+            rflags = buddy_resident_flags(health["metrics"])
+            if rflags:
+                # payload-sized residency on the coordinator means the
+                # memory ceiling the p2p mailboxes lifted is back —
+                # loud always, fatal under --strict
+                health["buddy_resident"] = rflags
                 metrics_ok = False
         except Exception as e:
             # a loadable replica with a dead metrics endpoint is still
